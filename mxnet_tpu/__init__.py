@@ -1,0 +1,31 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capability
+surface of Apache MXNet 1.x (reference: yanghaojin/incubator-mxnet).
+
+Built from scratch on JAX/XLA (+Pallas for custom kernels): XLA replaces the
+reference's ThreadedEngine/mshadow/cuDNN stack, ``hybridize()`` lowers Gluon
+blocks to jitted XLA computations (the reference's CachedOp), and the KVStore
+facade maps onto ``jax.lax.psum`` over a device mesh. See SURVEY.md for the
+full reference analysis and design-mapping table.
+
+Usage mirrors the reference::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+
+    x = nd.ones((2, 3), ctx=mx.tpu())
+    with autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import (Context, cpu, cpu_pinned, cpu_shared, current_context,
+                      gpu, gpu_memory_info, num_gpus, num_tpus, tpu)
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random

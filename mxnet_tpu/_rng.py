@@ -1,0 +1,31 @@
+"""Global PRNG state for eager execution.
+
+The reference keeps per-device RNG states in the resource manager
+(ref: src/resource.cc ResourceRequest::kRandom, mx.random.seed). JAX RNG is
+stateless, so the eager (`mx.nd`) layer keeps ONE root key here and splits a
+fresh subkey per sampling op; jitted/hybridized code threads keys explicitly
+instead (see gluon.block), which is the TPU-idiomatic path.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_key = jax.random.PRNGKey(0)
+
+
+def seed(seed_state: int):
+    """ref: mx.random.seed — reseed the global generator."""
+    global _key
+    with _lock:
+        _key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split off a fresh subkey for one op invocation."""
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+    return sub

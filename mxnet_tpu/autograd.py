@@ -1,0 +1,272 @@
+"""Autograd: define-by-run automatic differentiation.
+
+API-compatible with the reference's ``mxnet.autograd`` (ref:
+python/mxnet/autograd.py — record/pause/train_mode/predict_mode/backward/grad,
+backed by Imperative::RecordOp / Imperative::Backward in
+src/imperative/imperative.cc). The TPU-native mechanism is different and
+simpler: while recording, every dispatched op runs through ``jax.vjp``, whose
+returned pullback is stored on a tape node; ``backward()`` walks the tape in
+reverse topological order pushing cotangents through the stored pullbacks.
+XLA still sees whole fused programs when models are hybridized, because a
+hybridized block records ONE tape node for its entire jitted forward.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "get_symbol"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+class _RecordingScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._old
+
+
+def record(train_mode: bool = True):
+    """Scope that turns on recording (and, by default, training mode)."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    """Scope that turns off recording (ref: autograd.pause)."""
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+class TapeNode:
+    """One recorded op: holds the jax.vjp pullback and the graph wiring."""
+    __slots__ = ("vjp_fn", "parents", "out_avals", "n_outputs", "grad_buffers",
+                 "pending", "__weakref__")
+
+    def __init__(self, vjp_fn, parents, out_avals):
+        self.vjp_fn = vjp_fn
+        # parents[i] corresponds to the i-th primal input of the vjp:
+        # each entry is (TapeNode | None, out_index, leaf_NDArray | None)
+        self.parents = parents
+        self.out_avals = out_avals      # list of jax.ShapeDtypeStruct
+        self.n_outputs = len(out_avals)
+
+
+def _zeros_for(aval):
+    import jax.numpy as jnp
+    if jax.dtypes.issubdtype(aval.dtype, jax.numpy.inexact):
+        return jnp.zeros(aval.shape, aval.dtype)
+    # integer/bool outputs get symbolic-zero cotangents
+    return _np.zeros(aval.shape, dtype=jax.dtypes.float0)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """ref: autograd.mark_variables — attach grad buffers to leaves."""
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g
+        var._grad_req = req
+        var._tape_node = None          # marking detaches from any prior graph
+        var._tape_out_idx = 0
+
+
+def _toposort(roots: List[TapeNode]):
+    order = []
+    seen = set()
+    stack = [(r, False) for r in roots]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for parent, _idx, _leaf in node.parents:
+            if parent is not None and id(parent) not in seen:
+                stack.append((parent, False))
+    return order  # children appear after parents; reverse for backward
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             _leaf_filter=None):
+    """Compute gradients of `heads` w.r.t. all marked leaves
+    (ref: MXAutogradBackwardEx -> Imperative::Backward).
+
+    ``_leaf_filter``: internal — a set of leaf ids to restrict deposits to
+    (used by :func:`grad` so it never touches other arrays' ``.grad``)."""
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # seed cotangents per tape node; leaf grads accumulate here during the
+    # pass and are deposited once at the end (grad_req governs cross-pass
+    # behavior, matching the reference)
+    cotangents = {}   # id(node) -> list per output
+    leaf_accum = {}   # id(leaf NDArray) -> (leaf, accumulated grad)
+    roots = []
+    for h, hg in zip(heads, head_grads):
+        node = getattr(h, "_tape_node", None)
+        if node is None:
+            if getattr(h, "_grad", None) is not None:
+                g = jnp.ones(h.shape, h._data.dtype) if hg is None else hg._data
+                _accum_leaf(leaf_accum, h, g)
+            continue
+        roots.append(node)
+        ct = cotangents.setdefault(
+            id(node), [_zeros_for(a) for a in node.out_avals])
+        seed = jnp.ones(h.shape, h._data.dtype) if hg is None else hg._data
+        idx = h._tape_out_idx
+        if isinstance(ct[idx], _np.ndarray) and ct[idx].dtype == jax.dtypes.float0:
+            pass  # non-differentiable head: nothing to do
+        else:
+            ct[idx] = ct[idx] + seed
+    if not roots:
+        if not any(getattr(h, "_grad", None) is not None for h in heads):
+            raise MXNetError("backward: no recorded graph reaches these heads "
+                             "(did you call attach_grad() and compute inside "
+                             "autograd.record()?)")
+        return
+
+    order = _toposort(roots)
+    for node in reversed(order):
+        ct = cotangents.get(id(node))
+        if ct is None:
+            continue
+        if node.vjp_fn is None:
+            raise MXNetError("backward: graph was already freed by a previous "
+                             "backward pass; pass retain_graph=True to keep it")
+        ct_arg = tuple(ct) if node.n_outputs > 1 else ct[0]
+        in_cts = node.vjp_fn(ct_arg)
+        for (parent, out_idx, leaf), g in zip(node.parents, in_cts):
+            if isinstance(g, _np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            if leaf is not None:
+                if _leaf_filter is None or id(leaf) in _leaf_filter:
+                    _accum_leaf(leaf_accum, leaf, g)
+            elif parent is not None:
+                pct = cotangents.setdefault(
+                    id(parent), [_zeros_for(a) for a in parent.out_avals])
+                prev = pct[out_idx]
+                if isinstance(prev, _np.ndarray) and prev.dtype == jax.dtypes.float0:
+                    continue
+                pct[out_idx] = prev + g
+        if not retain_graph:
+            cotangents.pop(id(node), None)
+
+    if not retain_graph:
+        # free the recorded graph (ref: Imperative::Backward releases the
+        # tape unless retain_graph): drop pullback closures so forward
+        # residuals/activations aren't pinned by retained outputs
+        for node in order:
+            node.vjp_fn = None
+            node.parents = []
+
+    for leaf, g in leaf_accum.values():
+        _deposit_leaf(leaf, g)
+
+
+def _accum_leaf(leaf_accum, leaf, g):
+    key = id(leaf)
+    if key in leaf_accum:
+        leaf_accum[key] = (leaf, leaf_accum[key][1] + g)
+    else:
+        leaf_accum[key] = (leaf, g)
+
+
+def _deposit_leaf(leaf, g):
+    req = getattr(leaf, "_grad_req", "write")
+    if req == "null" or leaf._grad is None:
+        return
+    g = g.astype(leaf._grad._data.dtype)
+    if req == "add":
+        leaf._grad._rebind(leaf._grad._data + g)
+    else:
+        leaf._grad._rebind(g)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """ref: autograd.grad — returns grads instead of writing .grad."""
+    from .ndarray import NDArray
+    if create_graph:
+        raise MXNetError("autograd.grad(create_graph=True) (higher-order) is "
+                         "not supported yet; use jax.grad composition via "
+                         "hybridized blocks instead")
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "write"))
+             for v in variables]
+    from .ndarray import zeros
+    for v in variables:
+        v._grad = zeros(v.shape, dtype=v.dtype, ctx=v.ctx)
+        v._grad_req = "add"
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
+                 _leaf_filter={id(v) for v in variables})
+        out = [v._grad for v in variables]
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._grad, v._grad_req = g, r
+    return out[0] if single else out
+
+
+def get_symbol(x):
+    raise MXNetError("autograd.get_symbol is not supported: the TPU build "
+                     "records jax pullbacks, not NNVM nodes; use "
+                     "HybridBlock.export for graph capture")

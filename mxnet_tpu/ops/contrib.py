@@ -1,0 +1,283 @@
+"""Contrib ops — detection kernels and misc.
+
+TPU-native equivalent of ``src/operator/contrib/`` (MultiBoxPrior, box_nms,
+ROIAlign, BilinearResize2D, ...). The reference hand-writes CUDA for these;
+here they are static-shape jnp/lax formulations (greedy NMS as a fori_loop,
+ROIAlign as vectorized bilinear gathers) which XLA compiles for the VPU; a
+Pallas fast path can slot in later where profiling justifies it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import OpParam, register
+
+
+def _box_iou_corner(a, b):
+    """IoU between (..., N, 4) and (..., M, 4) corner boxes -> (..., N, M)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0) * jnp.maximum(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0) * jnp.maximum(b[..., 3] - b[..., 1], 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, jnp.zeros_like(inter))
+
+
+@register("_contrib_box_iou", aliases=["box_iou"], num_inputs=2,
+          params=[OpParam("format", str, "corner")],
+          differentiable=False,
+          doc="Pairwise IoU (ref: src/operator/contrib/bounding_box.cc box_iou)")
+def _box_iou(lhs, rhs, format="corner"):
+    if format == "center":
+        def c2c(b):
+            xy = b[..., :2]
+            wh = b[..., 2:] / 2
+            return jnp.concatenate([xy - wh, xy + wh], axis=-1)
+        lhs, rhs = c2c(lhs), c2c(rhs)
+    return _box_iou_corner(lhs, rhs)
+
+
+@register("_contrib_box_nms", aliases=["box_nms"],
+          params=[OpParam("overlap_thresh", float, 0.5),
+                  OpParam("valid_thresh", float, 0.0),
+                  OpParam("topk", int, -1),
+                  OpParam("coord_start", int, 2),
+                  OpParam("score_index", int, 1),
+                  OpParam("id_index", int, -1),
+                  OpParam("background_id", int, -1),
+                  OpParam("force_suppress", bool, False),
+                  OpParam("in_format", str, "corner"),
+                  OpParam("out_format", str, "corner")],
+          differentiable=False,
+          doc="Greedy non-max suppression, static shapes: suppressed entries "
+              "are filled with -1 like the reference "
+              "(ref: src/operator/contrib/bounding_box.cc box_nms)")
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1, background_id=-1,
+             force_suppress=False, in_format="corner", out_format="corner"):
+    batched = data.ndim == 3
+    if not batched:
+        data = data[None]
+
+    def nms_one(rows):
+        scores = rows[:, score_index]
+        boxes = lax.dynamic_slice_in_dim(rows, coord_start, 4, axis=1)
+        if in_format == "center":
+            xy, wh = boxes[:, :2], boxes[:, 2:] / 2
+            boxes = jnp.concatenate([xy - wh, xy + wh], axis=-1)
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= rows[:, id_index] != background_id
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        n = rows.shape[0]
+        k = n if topk <= 0 else min(topk, n)
+        iou = _box_iou_corner(boxes[order], boxes[order])
+        if id_index >= 0 and not force_suppress:
+            ids = rows[order, id_index]
+            iou = jnp.where(ids[:, None] == ids[None, :], iou, 0.0)
+        valid_sorted = valid[order]
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & keep[i] & (jnp.arange(n) > i)
+            return jnp.where(sup, False, keep)
+
+        keep = lax.fori_loop(0, k, body, valid_sorted)
+        keep &= jnp.arange(n) < k
+        # compact kept rows to the top (stable), suppressed slots become -1
+        perm = jnp.argsort(~keep, stable=True)
+        compacted = jnp.where(jnp.sort(~keep, stable=True)[:, None],
+                              -jnp.ones_like(rows), rows[order][perm])
+        return compacted
+
+    out = jax.vmap(nms_one)(data)
+    return out if batched else out[0]
+
+
+@register("_contrib_BilinearResize2D", aliases=["BilinearResize2D"],
+          params=[OpParam("height", int, 0), OpParam("width", int, 0),
+                  OpParam("scale_height", float, None),
+                  OpParam("scale_width", float, None),
+                  OpParam("mode", str, "size"),
+                  OpParam("align_corners", bool, True)],
+          doc="ref: src/operator/contrib/bilinear_resize.cc")
+def _bilinear_resize(x, height=0, width=0, scale_height=None, scale_width=None,
+                     mode="size", align_corners=True):
+    n, c, h, w = x.shape
+    if scale_height is not None:
+        height = int(h * scale_height)
+        width = int(w * scale_width)
+    if align_corners and height > 1 and width > 1:
+        ys = jnp.linspace(0.0, h - 1.0, height)
+        xs = jnp.linspace(0.0, w - 1.0, width)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0).reshape(1, 1, -1, 1)
+        wx = (xs - x0).reshape(1, 1, 1, -1)
+        g = lambda yy, xx: x[:, :, yy][:, :, :, xx]
+        out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1, x0) * wy * (1 - wx)
+               + g(y0, x1) * (1 - wy) * wx + g(y1, x1) * wy * wx)
+        return out.astype(x.dtype)
+    return jax.image.resize(x, (n, c, height, width), method="bilinear").astype(x.dtype)
+
+
+@register("_contrib_AdaptiveAvgPooling2D", aliases=["AdaptiveAvgPooling2D"],
+          params=[OpParam("output_size", tuple, None)],
+          doc="ref: src/operator/contrib/adaptive_avg_pooling.cc")
+def _adaptive_avg_pool(x, output_size=None):
+    n, c, h, w = x.shape
+    if not output_size:
+        oh = ow = 1
+    elif len(output_size) == 1:
+        oh = ow = int(output_size[0])
+    else:
+        oh, ow = int(output_size[0]), int(output_size[1])
+    if h % oh == 0 and w % ow == 0:
+        x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x.mean(axis=(3, 5))
+    # general case: average over adaptive windows via interpolation-free loop
+    out = jnp.zeros((n, c, oh, ow), dtype=x.dtype)
+    rows = [(int(jnp.floor(i * h / oh)), int(-(-((i + 1) * h) // oh))) for i in range(oh)]
+    cols = [(int(jnp.floor(j * w / ow)), int(-(-((j + 1) * w) // ow))) for j in range(ow)]
+    parts = []
+    for (r0, r1) in rows:
+        row = [x[:, :, r0:r1, c0:c1].mean(axis=(2, 3)) for (c0, c1) in cols]
+        parts.append(jnp.stack(row, axis=-1))
+    return jnp.stack(parts, axis=-2)
+
+
+@register("_contrib_ROIAlign", aliases=["ROIAlign"], num_inputs=2,
+          params=[OpParam("pooled_size", tuple, None, required=True),
+                  OpParam("spatial_scale", float, 1.0),
+                  OpParam("sample_ratio", int, -1),
+                  OpParam("position_sensitive", bool, False),
+                  OpParam("aligned", bool, False)],
+          doc="ROI Align via vectorized bilinear gathers "
+              "(ref: src/operator/contrib/roi_align.cc)")
+def _roi_align(features, rois, pooled_size=None, spatial_scale=1.0,
+               sample_ratio=-1, position_sensitive=False, aligned=False):
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    n, c, h, w = features.shape
+    sr = sample_ratio if sample_ratio > 0 else 2
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        batch_idx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[1] * spatial_scale - offset,
+                          roi[2] * spatial_scale - offset,
+                          roi[3] * spatial_scale - offset,
+                          roi[4] * spatial_scale - offset)
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_h, bin_w = rh / ph, rw / pw
+        # sample grid: (ph*sr, pw*sr)
+        ys = y1 + (jnp.arange(ph * sr) + 0.5) * bin_h / sr
+        xs = x1 + (jnp.arange(pw * sr) + 0.5) * bin_w / sr
+        img = lax.dynamic_index_in_dim(features, batch_idx, axis=0, keepdims=False)
+
+        def bilinear(yy, xx):
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1)
+            x1i = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yy - y0, 0, 1).reshape(1, -1, 1)
+            wx = jnp.clip(xx - x0, 0, 1).reshape(1, 1, -1)
+            g = lambda a, b: img[:, a][:, :, b]
+            return (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1i, x0) * wy * (1 - wx)
+                    + g(y0, x1i) * (1 - wy) * wx + g(y1i, x1i) * wy * wx)
+
+        samples = bilinear(ys, xs)                       # (c, ph*sr, pw*sr)
+        samples = samples.reshape(c, ph, sr, pw, sr)
+        return samples.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_MultiBoxPrior", aliases=["MultiBoxPrior"],
+          params=[OpParam("sizes", tuple, (1.0,)),
+                  OpParam("ratios", tuple, (1.0,)),
+                  OpParam("clip", bool, False),
+                  OpParam("steps", tuple, (-1.0, -1.0)),
+                  OpParam("offsets", tuple, (0.5, 0.5))],
+          differentiable=False,
+          doc="SSD anchor generation (ref: src/operator/contrib/multibox_prior.cc)")
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([cx.ravel(), cy.ravel()], axis=-1)      # (h*w, 2)
+    # reference: num_anchors = len(sizes) + len(ratios) - 1
+    whs = []
+    for s in sizes:
+        whs.append((s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        whs.append((sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r)))
+    whs = jnp.asarray(whs)                                       # (A, 2)
+    half = whs / 2
+    boxes = jnp.concatenate([
+        centers[:, None, :] - half[None, :, :],
+        centers[:, None, :] + half[None, :, :]], axis=-1)        # (h*w, A, 4)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+@register("arange_like", num_inputs=1,
+          params=[OpParam("start", float, 0.0), OpParam("step", float, 1.0),
+                  OpParam("repeat", int, 1), OpParam("axis", int, None)],
+          differentiable=False,
+          doc="ref: src/operator/contrib/arange_like op")
+def _arange_like(x, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = x.size
+        return (start + step * jnp.arange(n)).reshape(x.shape).astype(x.dtype)
+    n = x.shape[axis]
+    return (start + step * jnp.arange(n)).astype(x.dtype)
+
+
+@register("_contrib_div_sqrt_dim", aliases=["div_sqrt_dim"],
+          doc="x / sqrt(last_dim) — attention scaling helper "
+              "(ref: src/operator/contrib/transformer.cc)")
+def _div_sqrt_dim(x):
+    return x / jnp.sqrt(float(x.shape[-1]))
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk", num_inputs=1,
+          params=[OpParam("heads", int, None, required=True)],
+          doc="Transformer fused self-attention QK^T "
+              "(ref: src/operator/contrib/transformer.cc). Input (T, N, 3*E) "
+              "interleaved qkv projections.")
+def _interleaved_qk(qkv, heads=None):
+    t, n, e3 = qkv.shape
+    e = e3 // 3
+    hd = e // heads
+    qkv = qkv.reshape(t, n, heads, 3, hd)
+    q = qkv[:, :, :, 0]                                  # (T, N, H, D)
+    k = qkv[:, :, :, 1]
+    q = q.transpose(1, 2, 0, 3).reshape(n * heads, t, hd)
+    k = k.transpose(1, 2, 0, 3).reshape(n * heads, t, hd)
+    return jnp.matmul(q, k.transpose(0, 2, 1)) / jnp.sqrt(float(hd))
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt", num_inputs=2,
+          params=[OpParam("heads", int, None, required=True)],
+          doc="Transformer fused attention AV (ref: contrib/transformer.cc)")
+def _interleaved_valatt(qkv, att, heads=None):
+    t, n, e3 = qkv.shape
+    e = e3 // 3
+    hd = e // heads
+    v = qkv.reshape(t, n, heads, 3, hd)[:, :, :, 2]
+    v = v.transpose(1, 2, 0, 3).reshape(n * heads, t, hd)
+    out = jnp.matmul(att, v)                             # (N*H, T, D)
+    out = out.reshape(n, heads, t, hd).transpose(2, 0, 1, 3)
+    return out.reshape(t, n, e)

@@ -1,0 +1,664 @@
+"""Neural-network operators.
+
+TPU-native equivalent of ``src/operator/nn/`` — the reference's cuDNN-backed
+Convolution/Pooling/BatchNorm/etc. become ``lax.conv_general_dilated`` /
+``lax.reduce_window`` / jnp compositions that XLA tiles onto the MXU. The
+fused cuDNN RNN op (ref: src/operator/rnn.cc) becomes a ``lax.scan`` cell;
+dropout threads explicit PRNG keys (JAX-idiomatic replacement for the
+reference's Resource-managed RNG states, ref: src/resource.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, _as_np_dtype
+from .registry import OpParam, register
+
+
+def _pair(v, n):
+    v = tuple(v) if not isinstance(v, int) else (v,) * n
+    if len(v) == 1:
+        v = v * n
+    return v
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (ref: src/operator/nn/fully_connected.cc)
+# ---------------------------------------------------------------------------
+@register("FullyConnected", num_inputs=-1,
+          params=[OpParam("num_hidden", int, None, required=True),
+                  OpParam("no_bias", bool, False),
+                  OpParam("flatten", bool, True)],
+          doc="y = x W^T + b (ref: src/operator/nn/fully_connected.cc); the "
+              "canonical MXU matmul — keep batched and wide")
+def _fully_connected(x, weight, *bias, num_hidden=None, no_bias=False, flatten=True):
+    if flatten:
+        x = x.reshape(x.shape[0], -1)
+    y = jnp.matmul(x, weight.T)
+    if not no_bias:
+        y = y + bias[0]
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (ref: src/operator/nn/convolution.cc,
+# src/operator/nn/cudnn/cudnn_convolution-inl.h — autotune is XLA's job here)
+# ---------------------------------------------------------------------------
+def _conv_dims(ndim):
+    if ndim == 3:
+        return ("NCW", "OIW", "NCW")
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW")
+    if ndim == 5:
+        return ("NCDHW", "OIDHW", "NCDHW")
+    raise MXNetError(f"Convolution: unsupported input ndim {ndim}")
+
+
+@register("Convolution", num_inputs=-1,
+          params=[OpParam("kernel", tuple, None, required=True),
+                  OpParam("stride", tuple, None),
+                  OpParam("dilate", tuple, None),
+                  OpParam("pad", tuple, None),
+                  OpParam("num_filter", int, None, required=True),
+                  OpParam("num_group", int, 1),
+                  OpParam("no_bias", bool, False),
+                  OpParam("layout", str, None),
+                  OpParam("cudnn_tune", str, None),
+                  OpParam("cudnn_off", bool, False),
+                  OpParam("workspace", int, 1024)],
+          doc="N-D convolution, NCHW/OIHW layouts "
+              "(ref: src/operator/nn/convolution.cc ConvolutionCompute)")
+def _convolution(x, weight, *bias, kernel=None, stride=None, dilate=None,
+                 pad=None, num_filter=None, num_group=1, no_bias=False,
+                 layout=None, cudnn_tune=None, cudnn_off=False, workspace=1024):
+    nd = len(kernel)
+    stride = _pair(stride or 1, nd)
+    dilate = _pair(dilate or 1, nd)
+    pad = _pair(pad or 0, nd)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _conv_dims(x.ndim))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group)
+    if not no_bias:
+        out = out + bias[0].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", num_inputs=-1,
+          params=[OpParam("kernel", tuple, None, required=True),
+                  OpParam("stride", tuple, None),
+                  OpParam("dilate", tuple, None),
+                  OpParam("pad", tuple, None),
+                  OpParam("adj", tuple, None),
+                  OpParam("num_filter", int, None, required=True),
+                  OpParam("num_group", int, 1),
+                  OpParam("no_bias", bool, True),
+                  OpParam("layout", str, None),
+                  OpParam("workspace", int, 1024),
+                  OpParam("cudnn_tune", str, None),
+                  OpParam("cudnn_off", bool, False),
+                  OpParam("target_shape", tuple, None)],
+          doc="Transposed convolution (ref: src/operator/nn/deconvolution.cc)")
+def _deconvolution(x, weight, *bias, kernel=None, stride=None, dilate=None,
+                   pad=None, adj=None, num_filter=None, num_group=1,
+                   no_bias=True, layout=None, workspace=1024, cudnn_tune=None,
+                   cudnn_off=False, target_shape=None):
+    nd = len(kernel)
+    stride = _pair(stride or 1, nd)
+    dilate = _pair(dilate or 1, nd)
+    pad = _pair(pad or 0, nd)
+    adj = _pair(adj or 0, nd)
+    # grad-of-conv formulation: lhs_dilation=stride implements the transpose
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _conv_dims(x.ndim))
+    k_eff = [(kernel[i] - 1) * dilate[i] + 1 for i in range(nd)]
+    padding = [(k_eff[i] - 1 - pad[i], k_eff[i] - 1 - pad[i] + adj[i])
+               for i in range(nd)]
+    # weight layout for deconv in the reference is (in, out/g, *k): swap I/O and
+    # flip spatial axes to express as a regular conv
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if num_group > 1:
+        ci = w.shape[0]
+        w = w.reshape((num_group, ci // num_group) + w.shape[1:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((w.shape[0] * w.shape[1], ci // num_group) + w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group)
+    if not no_bias and bias:
+        out = out + bias[0].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (ref: src/operator/nn/pooling.cc)
+# ---------------------------------------------------------------------------
+@register("Pooling",
+          params=[OpParam("kernel", tuple, ()),
+                  OpParam("pool_type", str, "max"),
+                  OpParam("global_pool", bool, False),
+                  OpParam("stride", tuple, None),
+                  OpParam("pad", tuple, None),
+                  OpParam("pooling_convention", str, "valid"),
+                  OpParam("count_include_pad", bool, True),
+                  OpParam("cudnn_off", bool, False),
+                  OpParam("layout", str, None)],
+          doc="Max/avg/sum/lp pooling via lax.reduce_window "
+              "(ref: src/operator/nn/pooling.cc)")
+def _pooling(x, kernel=(), pool_type="max", global_pool=False, stride=None,
+             pad=None, pooling_convention="valid", count_include_pad=True,
+             cudnn_off=False, layout=None):
+    nd = x.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, x.ndim))
+        if pool_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        return jnp.mean(x, axis=axes, keepdims=True)
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride or 1, nd)
+    pad = _pair(pad or 0, nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: add extra right-padding so the last window fits
+        extra = []
+        for i in range(nd):
+            size = x.shape[2 + i] + 2 * pad[i] - kernel[i]
+            rem = size % stride[i]
+            extra.append((stride[i] - rem) % stride[i] if rem else 0)
+        padding = ((0, 0), (0, 0)) + tuple(
+            (pad[i], pad[i] + extra[i]) for i in range(nd))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            return summed / float(jnp.prod(jnp.asarray(kernel)))
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return summed / counts
+    if pool_type == "lp":
+        p = 2.0
+        s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, padding)
+        return s ** (1.0 / p)
+    raise MXNetError(f"Pooling: unknown pool_type {pool_type!r}")
+
+
+# ---------------------------------------------------------------------------
+# Activations (ref: src/operator/nn/activation.cc, leaky_relu.cc)
+# ---------------------------------------------------------------------------
+@register("Activation", params=[OpParam("act_type", str, None, required=True)],
+          doc="ref: src/operator/nn/activation.cc")
+def _activation(x, act_type=None):
+    if act_type == "relu":
+        return jnp.maximum(x, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    raise MXNetError(f"Activation: unknown act_type {act_type!r}")
+
+
+@register("LeakyReLU", num_inputs=-1,
+          params=[OpParam("act_type", str, "leaky"),
+                  OpParam("slope", float, 0.25),
+                  OpParam("lower_bound", float, 0.125),
+                  OpParam("upper_bound", float, 0.334)],
+          doc="leaky/prelu/elu/selu/gelu family (ref: src/operator/leaky_relu.cc)")
+def _leaky_relu(x, *gamma, act_type="leaky", slope=0.25, lower_bound=0.125,
+                upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(x >= 0, x, slope * x)
+    if act_type == "prelu":
+        g = gamma[0]
+        if g.ndim == 1 and x.ndim > 1:
+            g = g.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x >= 0, x, g * x)
+    if act_type == "elu":
+        return jnp.where(x >= 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        return jax.nn.selu(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(x >= 0, x, mid * x)
+    raise MXNetError(f"LeakyReLU: unknown act_type {act_type!r}")
+
+
+@register("softmax", params=[OpParam("axis", int, -1),
+                             OpParam("temperature", float, None),
+                             OpParam("length", tuple, None),
+                             OpParam("dtype", str, None)],
+          doc="ref: src/operator/nn/softmax.cc")
+def _softmax(x, axis=-1, temperature=None, length=None, dtype=None):
+    if temperature:
+        x = x / temperature
+    out = jax.nn.softmax(x, axis=axis)
+    return out.astype(_as_np_dtype(dtype)) if dtype else out
+
+
+@register("log_softmax", params=[OpParam("axis", int, -1),
+                                 OpParam("temperature", float, None)],
+          doc="ref: src/operator/nn/softmax.cc log_softmax")
+def _log_softmax(x, axis=-1, temperature=None):
+    if temperature:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin", params=[OpParam("axis", int, -1)])
+def _softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register("SoftmaxActivation", params=[OpParam("mode", str, "instance")])
+def _softmax_activation(x, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Normalization (ref: src/operator/nn/batch_norm.cc, layer_norm.cc,
+# group_norm.cc, instance_norm.cc, l2_normalization.cc)
+# ---------------------------------------------------------------------------
+@register("BatchNorm", num_inputs=5, num_outputs=3, needs_mode=True,
+          params=[OpParam("eps", float, 1e-3),
+                  OpParam("momentum", float, 0.9),
+                  OpParam("fix_gamma", bool, True),
+                  OpParam("use_global_stats", bool, False),
+                  OpParam("output_mean_var", bool, False),
+                  OpParam("axis", int, 1),
+                  OpParam("cudnn_off", bool, False)],
+          doc="Batch normalization. Inputs: data, gamma, beta, moving_mean, "
+              "moving_var. Outputs: (out, batch_mean, batch_var) — like the "
+              "reference's three NNVM outputs; running-stat update is done "
+              "functionally by the caller (ref: src/operator/nn/batch_norm.cc)")
+def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+                fix_gamma=True, use_global_stats=False, output_mean_var=False,
+                axis=1, cudnn_off=False, training=False):
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    bshape = [1] * x.ndim
+    bshape[axis % x.ndim] = x.shape[axis % x.ndim]
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if training and not use_global_stats:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps).reshape(bshape)
+    out = (x - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
+        + beta.reshape(bshape)
+    return out, mean, var
+
+
+@register("LayerNorm", num_inputs=3,
+          params=[OpParam("axis", int, -1), OpParam("eps", float, 1e-5),
+                  OpParam("output_mean_var", bool, False)],
+          doc="ref: src/operator/nn/layer_norm.cc")
+def _layer_norm(x, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    bshape = [1] * x.ndim
+    bshape[axis % x.ndim] = x.shape[axis % x.ndim]
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("GroupNorm", num_inputs=3,
+          params=[OpParam("num_groups", int, 1), OpParam("eps", float, 1e-5)],
+          doc="ref: src/operator/nn/group_norm.cc")
+def _group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + eps)
+    out = xg.reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm", num_inputs=3, params=[OpParam("eps", float, 1e-3)],
+          doc="ref: src/operator/instance_norm.cc")
+def _instance_norm(x, gamma, beta, eps=1e-3):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization",
+          params=[OpParam("eps", float, 1e-10), OpParam("mode", str, "instance")],
+          doc="ref: src/operator/l2_normalization.cc")
+def _l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        norm = jnp.sqrt(jnp.sum(jnp.square(x.reshape(x.shape[0], -1)), axis=1) + eps)
+        return x / norm.reshape((-1,) + (1,) * (x.ndim - 1))
+    if mode == "channel":
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+        return x / norm
+    if mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+        return x / norm
+    raise MXNetError(f"L2Normalization: unknown mode {mode!r}")
+
+
+@register("RMSNorm", num_inputs=2,
+          params=[OpParam("axis", int, -1), OpParam("eps", float, 1e-6)],
+          doc="RMSNorm (new op — modern LLM parity; no reference analog)")
+def _rms_norm(x, gamma, axis=-1, eps=1e-6):
+    ms = jnp.mean(jnp.square(x), axis=axis, keepdims=True)
+    return x * lax.rsqrt(ms + eps) * gamma
+
+
+# ---------------------------------------------------------------------------
+# Dropout (ref: src/operator/nn/dropout.cc) — explicit PRNG key threading
+# ---------------------------------------------------------------------------
+@register("Dropout", needs_rng=True, needs_mode=True,
+          params=[OpParam("p", float, 0.5),
+                  OpParam("mode", str, "training"),
+                  OpParam("axes", tuple, ())],
+          doc="Inverted dropout; rng key threaded explicitly "
+              "(ref: src/operator/nn/dropout.cc)")
+def _dropout(x, rng=None, p=0.5, mode="training", axes=(), training=False):
+    if p <= 0 or (not training and mode != "always"):
+        return x
+    shape = list(x.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = jax.random.bernoulli(rng, 1.0 - p, tuple(shape))
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+
+
+# ---------------------------------------------------------------------------
+# Embedding (ref: src/operator/tensor/indexing_op.cc EmbeddingOpForward)
+# ---------------------------------------------------------------------------
+@register("Embedding", num_inputs=2,
+          params=[OpParam("input_dim", int, None, required=True),
+                  OpParam("output_dim", int, None, required=True),
+                  OpParam("dtype", str, "float32"),
+                  OpParam("sparse_grad", bool, False)],
+          doc="Lookup table (ref: indexing_op.cc Embedding)")
+def _embedding(indices, weight, input_dim=None, output_dim=None,
+               dtype="float32", sparse_grad=False):
+    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# SoftmaxOutput — softmax forward + CE gradient in backward, the Module-era
+# classification head (ref: src/operator/softmax_output.cc)
+# ---------------------------------------------------------------------------
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, normalization, out_grad, smooth_alpha):
+    return jax.nn.softmax(data, axis=-1)
+
+
+@jax.custom_vjp
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_core_fwd(data, label, grad_scale, ignore_label, use_ignore):
+    out = jax.nn.softmax(data, axis=-1)
+    return out, (out, label, grad_scale, ignore_label, use_ignore)
+
+
+def _softmax_output_core_bwd(res, g):
+    out, label, grad_scale, ignore_label, use_ignore = res
+    num_classes = out.shape[-1]
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), num_classes, dtype=out.dtype)
+    grad = (out - onehot) * grad_scale
+    if use_ignore:
+        mask = (label != ignore_label).astype(out.dtype)
+        grad = grad * mask[..., None]
+    # reference ignores incoming head gradient (it's a terminal loss op)
+    return grad, jnp.zeros_like(label, dtype=out.dtype), None, None, None
+
+
+_softmax_output_core.defvjp(_softmax_output_core_fwd, _softmax_output_core_bwd)
+
+
+@register("SoftmaxOutput", num_inputs=2,
+          params=[OpParam("grad_scale", float, 1.0),
+                  OpParam("ignore_label", float, -1.0),
+                  OpParam("multi_output", bool, False),
+                  OpParam("use_ignore", bool, False),
+                  OpParam("preserve_shape", bool, False),
+                  OpParam("normalization", str, "null"),
+                  OpParam("out_grad", bool, False),
+                  OpParam("smooth_alpha", float, 0.0)],
+          doc="Softmax with cross-entropy backward "
+              "(ref: src/operator/softmax_output.cc)")
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    orig_shape = data.shape
+    if multi_output and data.ndim > 2:
+        # (N, C, d...) -> softmax over C per spatial position
+        data2 = jnp.moveaxis(data, 1, -1)
+        out = _softmax_output_core(data2.reshape(-1, data2.shape[-1]),
+                                   label.reshape(-1).astype(data.dtype),
+                                   grad_scale, ignore_label, use_ignore)
+        out = out.reshape(data2.shape)
+        return jnp.moveaxis(out, -1, 1)
+    if data.ndim > 2 and not preserve_shape:
+        data = data.reshape(data.shape[0], -1)
+    return _softmax_output_core(data, label.astype(data.dtype), grad_scale,
+                                ignore_label, use_ignore).reshape(orig_shape)
+
+
+@register("MakeLoss", params=[OpParam("grad_scale", float, 1.0),
+                              OpParam("valid_thresh", float, 0.0),
+                              OpParam("normalization", str, "null")],
+          doc="Marks a symbol as a loss: forward=identity, backward=grad_scale "
+              "(ref: src/operator/make_loss.cc)")
+def _make_loss(x, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    @jax.custom_vjp
+    def core(v):
+        return v
+
+    def fwd(v):
+        return v, v.shape
+
+    def bwd(shape, g):
+        return (jnp.full(shape, grad_scale),)
+
+    core.defvjp(fwd, bwd)
+    return core(x)
+
+
+@register("smooth_l1", params=[OpParam("scalar", float, 1.0)],
+          doc="Huber-like loss elementwise (ref: src/operator/tensor/"
+              "elemwise_binary_scalar_op_extended.cc smooth_l1)")
+def _smooth_l1(x, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x),
+                     jnp.abs(x) - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN (ref: src/operator/rnn.cc — cuDNN fused multi-layer RNN).
+# Parameters arrive as ONE flat vector in cuDNN layout order, exactly like the
+# reference, so checkpoints/scripts port directly. Compute is lax.scan over
+# time — XLA compiles to a tight TPU loop.
+# ---------------------------------------------------------------------------
+def _rnn_gate_count(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def _rnn_unpack(params, mode, num_layers, input_size, state_size, bidirectional,
+                projection_size=None):
+    """Slice the flat param vector into per-layer (Wx, Wh, bx, bh) in the
+    reference's layout: all weights first (layer-major, i2h then h2h,
+    directions interleaved), then all biases."""
+    g = _rnn_gate_count(mode)
+    d = 2 if bidirectional else 1
+    layers = []
+    off = 0
+    sizes = []
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        for _dir in range(d):
+            sizes.append(("wx", g * state_size, in_sz))
+            sizes.append(("wh", g * state_size, state_size))
+    mats = []
+    for kind, r, c in sizes:
+        mats.append(params[off:off + r * c].reshape(r, c))
+        off += r * c
+    biases = []
+    for layer in range(num_layers):
+        for _dir in range(d):
+            biases.append(params[off:off + g * state_size]); off += g * state_size
+            biases.append(params[off:off + g * state_size]); off += g * state_size
+    out = []
+    mi = 0
+    bi = 0
+    for layer in range(num_layers):
+        dirs = []
+        for _dir in range(d):
+            wx, wh = mats[mi], mats[mi + 1]; mi += 2
+            bx, bh = biases[bi], biases[bi + 1]; bi += 2
+            dirs.append((wx, wh, bx, bh))
+        out.append(dirs)
+    return out
+
+
+def _rnn_cell_step(mode, carry, x_t, wx, wh, bx, bh, state_size):
+    if mode == "lstm":
+        h, c = carry
+        gates = x_t @ wx.T + bx + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+    if mode == "gru":
+        h = carry[0]
+        gx = x_t @ wx.T + bx
+        gh = h @ wh.T + bh
+        rx, zx, nx = jnp.split(gx, 3, axis=-1)
+        rh, zh, nh = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(rx + rh)
+        z = jax.nn.sigmoid(zx + zh)
+        n = jnp.tanh(nx + r * nh)
+        h = (1 - z) * n + z * h
+        return (h,), h
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+    h = carry[0]
+    h = act(x_t @ wx.T + bx + h @ wh.T + bh)
+    return (h,), h
+
+
+def _rnn_layer_scan(mode, x, h0, c0, weights, state_size, reverse=False):
+    wx, wh, bx, bh = weights
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+
+    def step(carry, x_t):
+        return _rnn_cell_step(mode, carry, x_t, wx, wh, bx, bh, state_size)
+
+    carry, ys = lax.scan(step, carry0, x, reverse=reverse)
+    return carry, ys
+
+
+def _rnn_outputs(params):
+    mode = params.get("mode", "lstm")
+    if not params.get("state_outputs", False):
+        return 1
+    return 3 if mode == "lstm" else 2
+
+
+@register("RNN", num_inputs=-1, num_outputs=_rnn_outputs, needs_rng=True,
+          needs_mode=True,
+          params=[OpParam("state_size", int, None, required=True),
+                  OpParam("num_layers", int, None, required=True),
+                  OpParam("mode", str, "lstm"),
+                  OpParam("bidirectional", bool, False),
+                  OpParam("p", float, 0.0, doc="dropout between layers"),
+                  OpParam("state_outputs", bool, False),
+                  OpParam("projection_size", int, None),
+                  OpParam("use_sequence_length", bool, False)],
+          doc="Fused multi-layer RNN/LSTM/GRU over time via lax.scan "
+              "(ref: src/operator/rnn.cc, rnn-inl.h; cuDNN-layout flat params). "
+              "Inputs: data (T,N,C), params(flat), state, [state_cell].")
+def _rnn(data, params, state, *rest, rng=None, state_size=None, num_layers=None,
+         mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+         projection_size=None, use_sequence_length=False, training=False):
+    if projection_size is not None:
+        raise MXNetError("RNN: projection_size not supported yet")
+    if use_sequence_length:
+        raise MXNetError("RNN: use_sequence_length not supported yet — mask "
+                         "inputs with SequenceMask and select final states "
+                         "with SequenceLast instead")
+    state_cell = rest[0] if (mode == "lstm" and rest) else None
+    d = 2 if bidirectional else 1
+    layers = _rnn_unpack(params, mode, num_layers, data.shape[-1], state_size,
+                         bidirectional)
+    x = data
+    hs, cs = [], []
+    for li, dirs in enumerate(layers):
+        outs = []
+        for di, weights in enumerate(dirs):
+            idx = li * d + di
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else None
+            carry, ys = _rnn_layer_scan(mode, x, h0, c0, weights, state_size,
+                                        reverse=(di == 1))
+            if di == 1:
+                pass  # lax.scan(reverse=True) already emits outputs in orig order
+            outs.append(ys)
+            hs.append(carry[0])
+            if mode == "lstm":
+                cs.append(carry[1])
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0 and training and li < len(layers) - 1 and rng is not None:
+            keep = jax.random.bernoulli(jax.random.fold_in(rng, li), 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+    hy = jnp.stack(hs, axis=0)
+    if not state_outputs:
+        return x
+    if mode == "lstm":
+        return x, hy, jnp.stack(cs, axis=0)
+    return x, hy
+
+
+# ---------------------------------------------------------------------------
+# correlation / upsampling / misc layers used by zoos
+# ---------------------------------------------------------------------------
+@register("UpSampling", num_inputs=-1,
+          params=[OpParam("scale", int, 1, required=True),
+                  OpParam("sample_type", str, "nearest"),
+                  OpParam("num_args", int, 1),
+                  OpParam("num_filter", int, 0),
+                  OpParam("multi_input_mode", str, "concat"),
+                  OpParam("workspace", int, 512)],
+          doc="ref: src/operator/upsampling.cc (nearest mode)")
+def _upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
+                multi_input_mode="concat", workspace=512):
+    x = args[0]
+    if sample_type != "nearest":
+        raise MXNetError("UpSampling: only nearest supported; use "
+                         "contrib.BilinearResize2D for bilinear")
+    out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    return out

@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware (the reference's analog:
+`tools/launch.py --launcher local` fakes a cluster with local processes,
+SURVEY §4 'Distributed/nightly' row)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+# hard override (not setdefault): the environment may pin JAX_PLATFORMS to a
+# TPU tunnel; unit tests must run on the virtual CPU mesh and must not claim
+# the (single-client) TPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    """ref: tests/python/unittest/common.py @with_seed — reproducible RNG
+    per test."""
+    np.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,162 @@
+"""Autograd semantics (ref test: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_and_shared_subexpression():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        z = y * y + y     # dz/dx = (2y*3) + 3 = 39 at x=2
+    z.backward()
+    assert x.grad.asscalar() == 39.0
+
+
+def test_grad_req_add_and_write():
+    x = nd.ones((2,))
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert x.grad.asnumpy().tolist() == [6, 6]
+    x.attach_grad(grad_req="write")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert x.grad.asnumpy().tolist() == [2, 2]
+
+
+def test_is_recording_training_scopes():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert autograd.is_recording()
+        assert not autograd.is_training()
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    assert x.grad.asnumpy().tolist() == [20, 200]
+
+
+def test_detach_blocks_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * x
+    z.backward()
+    assert x.grad.asscalar() == 6.0  # only the direct path
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * 2) * x
+    y.backward()
+    assert x.grad.asscalar() == 6.0
+
+
+def test_autograd_grad_function():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad([y], [x])
+    assert g.asscalar() == 12.0
+    assert x.grad.asnumpy().tolist() == [0.0]  # .grad untouched by grad()
+
+
+def test_mark_variables():
+    x = nd.array([4.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 5
+    y.backward()
+    assert x.grad.asscalar() == 5.0
+
+
+def test_indexing_gradient():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = x[0].sum() * 2
+    y.backward()
+    assert x.grad.asnumpy().tolist() == [[2, 2], [0, 0]]
+
+
+def test_multi_head_backward():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y1 = (x * 2).sum()
+        y2 = (x * 3).sum()
+    autograd.backward([y1, y2])
+    assert x.grad.asnumpy().tolist() == [5, 5]
+
+
+def test_second_use_after_backward():
+    # backward with retain_graph allows a second pass
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asscalar()
+    y.backward()
+    assert x.grad.asscalar() == g1
+
+
+def test_grad_does_not_clobber_other_leaves():
+    x = nd.array([1.0]); x.attach_grad()
+    w = nd.array([2.0]); w.attach_grad()
+    with autograd.record():
+        y = x * w
+    (gw,) = autograd.grad([y], [w])
+    assert gw.asscalar() == 1.0
+    assert x.grad.asscalar() == 0.0   # untouched
+
+
+def test_backward_frees_graph():
+    import pytest
+    x = nd.array([2.0]); x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    with pytest.raises(Exception):
+        y.backward()   # graph freed; second pass must raise, not mis-compute
+
+
+def test_moveaxis_records_gradient():
+    x = nd.ones((2, 3)); x.attach_grad()
+    with autograd.record():
+        y = nd.moveaxis(x, 0, 1).sum()
+    y.backward()
+    assert x.grad.asnumpy().tolist() == [[1, 1, 1], [1, 1, 1]]

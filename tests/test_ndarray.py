@@ -1,0 +1,166 @@
+"""NDArray basics (ref test: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, same
+
+
+def test_creation():
+    x = nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == np.float32
+    assert x.ctx == mx.cpu(0)
+    assert same(x, np.zeros((2, 3)))
+
+    y = nd.ones((4,), dtype="int32")
+    assert y.dtype == np.int32
+
+    z = nd.full((2, 2), 7)
+    assert z.asnumpy().ravel().tolist() == [7, 7, 7, 7]
+
+    a = nd.arange(0, 10, 2)
+    assert a.asnumpy().tolist() == [0, 2, 4, 6, 8]
+
+    assert nd.eye(3).asnumpy()[1, 1] == 1.0
+    assert nd.linspace(0, 1, 5).shape == (5,)
+
+
+def test_array_dtype_defaults():
+    assert nd.array([1, 2, 3]).dtype == np.float32
+    # documented divergence: 64-bit ints downcast to 32-bit (TPU-native build)
+    assert nd.array(np.array([1, 2, 3], dtype=np.int64)).dtype == np.int32
+    assert nd.array(np.array([1, 2], dtype=np.int16)).dtype == np.int16
+    assert nd.array(np.zeros((2, 2))).dtype == np.float32  # f64 -> f32
+
+
+def test_arithmetic():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    y = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert_almost_equal(x + y, np.array([[11, 22], [33, 44]]))
+    assert_almost_equal(x * 2, np.array([[2, 4], [6, 8]]))
+    assert_almost_equal(2 - x, np.array([[1, 0], [-1, -2]]))
+    assert_almost_equal(1.0 / x, 1.0 / x.asnumpy())
+    assert_almost_equal(x ** 2, x.asnumpy() ** 2)
+    assert_almost_equal(-x, -x.asnumpy())
+    assert_almost_equal(abs(x - 2.5), np.abs(x.asnumpy() - 2.5))
+
+
+def test_inplace_rebinds():
+    x = nd.ones((3,))
+    x += 1
+    assert x.asnumpy().tolist() == [2, 2, 2]
+    x *= 3
+    assert x.asnumpy().tolist() == [6, 6, 6]
+
+
+def test_comparison_ops():
+    x = nd.array([1.0, 2.0, 3.0])
+    assert (x > 2).asnumpy().tolist() == [0, 0, 1]
+    assert (x == 2).asnumpy().tolist() == [0, 1, 0]
+    assert (x <= 2).asnumpy().tolist() == [1, 1, 0]
+
+
+def test_indexing():
+    x = nd.array(np.arange(12).reshape(3, 4))
+    assert x[1].shape == (4,)
+    assert x[1, 2].asscalar() == 6
+    assert x[0:2].shape == (2, 4)
+    assert x[:, 1].asnumpy().tolist() == [1, 5, 9]
+    x[0, 0] = 99
+    assert x[0, 0].asscalar() == 99
+    x[1] = 0
+    assert x[1].asnumpy().tolist() == [0, 0, 0, 0]
+
+
+def test_shape_methods():
+    x = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert x.reshape(6, 4).shape == (6, 4)
+    assert x.reshape((-1, 4)).shape == (6, 4)
+    assert x.reshape(0, -1).shape == (2, 12)        # reference code 0 = copy
+    assert x.transpose().shape == (4, 3, 2)
+    assert x.T.shape == (4, 3, 2)
+    assert x.swapaxes(0, 2).shape == (4, 3, 2)
+    assert x.flatten().shape == (2, 12)
+    assert x.expand_dims(0).shape == (1, 2, 3, 4)
+    assert nd.moveaxis(x, 0, 2).shape == (3, 4, 2)
+
+
+def test_scalar_conversions():
+    x = nd.array([3.5])
+    assert x.asscalar() == 3.5
+    assert float(x) == 3.5
+    assert int(nd.array([7])) == 7
+    with pytest.raises(Exception):
+        nd.zeros((2, 2)).asscalar()
+
+
+def test_copy_and_context():
+    x = nd.ones((2, 2))
+    y = x.copy()
+    y += 1
+    assert x.asnumpy()[0, 0] == 1  # copy is independent
+    z = x.as_in_context(mx.cpu(0))
+    assert z is x                   # same-context no-op, like the reference
+    w = nd.zeros((2, 2))
+    x.copyto(w)
+    assert same(w, x)
+
+
+def test_astype():
+    x = nd.array([1.5, 2.5])
+    assert x.astype("int32").dtype == np.int32
+    assert x.astype(np.float16).dtype == np.float16
+
+
+def test_concat_stack_split():
+    x = nd.ones((2, 3))
+    y = nd.zeros((2, 3))
+    c = nd.concat(x, y, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(x, y, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(nd.array(np.arange(12).reshape(2, 6)), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+
+
+def test_save_load_roundtrip(tmp_path):
+    fname = str(tmp_path / "x.params")
+    d = {"weight": nd.random.normal(shape=(3, 4)),
+         "bias": nd.zeros((4,), dtype="float32")}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"weight", "bias"}
+    assert_almost_equal(loaded["weight"], d["weight"])
+
+    lst = [nd.ones((2,)), nd.arange(0, 3)]
+    nd.save(fname, lst)
+    back = nd.load(fname)
+    assert len(back) == 2 and same(back[0], lst[0])
+
+
+def test_wait_and_iter():
+    x = nd.ones((4, 2))
+    x.wait_to_read()
+    nd.waitall()
+    rows = list(x)
+    assert len(rows) == 4 and rows[0].shape == (2,)
+
+
+def test_dtype_bf16():
+    x = nd.zeros((2, 2), dtype="bfloat16")
+    assert "bfloat16" in str(x.dtype)
+    y = (x + 1.5) * 2
+    assert y.asnumpy().astype(np.float32)[0, 0] == 3.0
+
+
+def test_randn_positional_shape():
+    x = mx.random.randn(2, 3)
+    assert x.shape == (2, 3)
+    assert abs(float(x.asnumpy().mean())) < 3.0
+
+
+def test_random_ctx_placement():
+    x = nd.random.uniform(0, 1, shape=(2, 2), ctx=mx.cpu(0))
+    assert x.ctx == mx.cpu(0)

@@ -1,0 +1,321 @@
+"""Per-op correctness + gradient checks
+(ref test: tests/python/unittest/test_operator.py — the reference's largest
+test file; method: numpy forward parity + central-finite-difference grads)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  rand_ndarray)
+
+
+def test_unary_forward_parity():
+    x_np = np.random.uniform(0.1, 2.0, size=(3, 4)).astype(np.float32)
+    x = nd.array(x_np)
+    cases = {
+        "exp": np.exp, "log": np.log, "sqrt": np.sqrt, "square": np.square,
+        "abs": np.abs, "sign": np.sign, "floor": np.floor, "ceil": np.ceil,
+        "sin": np.sin, "cos": np.cos, "tanh": np.tanh,
+        "sigmoid": lambda v: 1 / (1 + np.exp(-v)),
+        "relu": lambda v: np.maximum(v, 0),
+        "log1p": np.log1p, "expm1": np.expm1, "rsqrt": lambda v: 1 / np.sqrt(v),
+    }
+    for name, ref in cases.items():
+        out = getattr(nd, name)(x)
+        assert_almost_equal(out, ref(x_np), rtol=1e-4, atol=1e-5,
+                            names=(name, "numpy"))
+
+
+def test_binary_broadcast():
+    a = nd.array(np.random.rand(2, 1, 4).astype(np.float32))
+    b = nd.array(np.random.rand(1, 3, 4).astype(np.float32))
+    assert_almost_equal(nd.broadcast_add(a, b), a.asnumpy() + b.asnumpy())
+    assert_almost_equal(nd.broadcast_mul(a, b), a.asnumpy() * b.asnumpy())
+    assert_almost_equal(nd.broadcast_maximum(a, b),
+                        np.maximum(a.asnumpy(), b.asnumpy()))
+
+
+def test_reductions():
+    x_np = np.random.rand(2, 3, 4).astype(np.float32)
+    x = nd.array(x_np)
+    assert_almost_equal(nd.sum(x), x_np.sum())
+    assert_almost_equal(nd.sum(x, axis=1), x_np.sum(axis=1))
+    assert_almost_equal(nd.sum(x, axis=(0, 2), keepdims=True),
+                        x_np.sum(axis=(0, 2), keepdims=True))
+    assert_almost_equal(nd.mean(x, axis=1, exclude=True),
+                        x_np.mean(axis=(0, 2)))
+    assert_almost_equal(nd.max(x, axis=2), x_np.max(axis=2))
+    assert_almost_equal(nd.argmax(x, axis=1), x_np.argmax(axis=1))
+    assert_almost_equal(nd.norm(x), np.sqrt((x_np ** 2).sum()), rtol=1e-4)
+
+
+def test_dot():
+    a = rand_ndarray((3, 4))
+    b = rand_ndarray((4, 5))
+    assert_almost_equal(nd.dot(a, b), a.asnumpy() @ b.asnumpy(), rtol=1e-4)
+    assert_almost_equal(nd.dot(a, b.T, transpose_b=True)._data.shape, (3, 4) @ np.zeros((4, 3)).shape if False else nd.dot(a, b.T, transpose_b=True).asnumpy().shape)
+    c = rand_ndarray((2, 3, 4))
+    d = rand_ndarray((2, 4, 5))
+    assert_almost_equal(nd.batch_dot(c, d),
+                        np.matmul(c.asnumpy(), d.asnumpy()), rtol=1e-4)
+
+
+def test_gradients_numeric():
+    check_numeric_gradient(lambda x: nd.tanh(x), [rand_ndarray((3, 3))])
+    check_numeric_gradient(lambda x: nd.sigmoid(x), [rand_ndarray((3, 3))])
+    check_numeric_gradient(lambda a, b: nd.dot(a, b),
+                           [rand_ndarray((3, 4)), rand_ndarray((4, 2))])
+    check_numeric_gradient(lambda x: nd.softmax(x), [rand_ndarray((2, 5))])
+    check_numeric_gradient(
+        lambda x: nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg"),
+        [rand_ndarray((1, 2, 4, 4))])
+
+
+def test_fully_connected():
+    x = rand_ndarray((2, 3, 4))
+    w = rand_ndarray((8, 12))
+    b = rand_ndarray((8,))
+    out = nd.FullyConnected(x, w, b, num_hidden=8)
+    expect = x.asnumpy().reshape(2, 12) @ w.asnumpy().T + b.asnumpy()
+    assert_almost_equal(out, expect, rtol=1e-4)
+    out2 = nd.FullyConnected(x, nd.array(np.random.rand(8, 4).astype(np.float32)),
+                             b, num_hidden=8, flatten=False)
+    assert out2.shape == (2, 3, 8)
+
+
+def test_convolution_vs_numpy():
+    # naive conv reference
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    w = np.random.rand(3, 2, 3, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.zeros((3,)),
+                         kernel=(3, 3), num_filter=3).asnumpy()
+    ref = np.zeros((1, 3, 3, 3), dtype=np.float32)
+    for o in range(3):
+        for i in range(3):
+            for j in range(3):
+                ref[0, o, i, j] = (x[0, :, i:i+3, j:j+3] * w[o]).sum()
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_conv_grad():
+    check_numeric_gradient(
+        lambda x, w: nd.Convolution(x, w, kernel=(3, 3), num_filter=2,
+                                    no_bias=True, pad=(1, 1)),
+        [rand_ndarray((1, 2, 4, 4)), rand_ndarray((2, 2, 3, 3))],
+        rtol=2e-2, atol=1e-2)
+
+
+def test_pooling_modes():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert mp.asnumpy().ravel().tolist() == [5, 7, 13, 15]
+    ap = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert ap.asnumpy().ravel().tolist() == [2.5, 4.5, 10.5, 12.5]
+    gp = nd.Pooling(x, pool_type="max", global_pool=True)
+    assert gp.asnumpy().ravel().tolist() == [15]
+
+
+def test_batchnorm_inference_and_training():
+    x = rand_ndarray((4, 3, 2, 2))
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mean, var = nd.zeros((3,)), nd.ones((3,))
+    out, m, v = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+    assert_almost_equal(out, x.asnumpy() / np.sqrt(1 + 1e-3), rtol=1e-3)
+    with autograd.record():
+        out_t, m_t, v_t = nd.BatchNorm(x, gamma, beta, mean, var,
+                                       fix_gamma=False)
+    x_np = x.asnumpy()
+    assert_almost_equal(m_t, x_np.mean(axis=(0, 2, 3)), rtol=1e-4)
+
+
+def test_layernorm():
+    x = rand_ndarray((2, 5))
+    g, b = nd.ones((5,)), nd.zeros((5,))
+    out = nd.LayerNorm(x, g, b)
+    x_np = x.asnumpy()
+    ref = (x_np - x_np.mean(-1, keepdims=True)) / np.sqrt(
+        x_np.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(lambda a: nd.LayerNorm(a, g, b), [x], rtol=2e-2)
+
+
+def test_softmax_ce_gradient():
+    # SoftmaxOutput backward = softmax - onehot
+    x = rand_ndarray((3, 5))
+    label = nd.array([0, 2, 4])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    p = out.asnumpy()
+    onehot = np.eye(5, dtype=np.float32)[[0, 2, 4]]
+    assert_almost_equal(x.grad, p - onehot, rtol=1e-4, atol=1e-5)
+
+
+def test_take_embedding():
+    w = rand_ndarray((10, 4))
+    idx = nd.array([1, 5, 9])
+    out = nd.Embedding(idx, w, input_dim=10, output_dim=4)
+    assert_almost_equal(out, w.asnumpy()[[1, 5, 9]])
+    out2 = nd.take(w, idx)
+    assert_almost_equal(out2, w.asnumpy()[[1, 5, 9]])
+
+
+def test_embedding_grad_accumulates():
+    w = rand_ndarray((5, 3))
+    w.attach_grad()
+    idx = nd.array([1, 1, 2])
+    with autograd.record():
+        out = nd.Embedding(idx, w, input_dim=5, output_dim=3).sum()
+    out.backward()
+    g = w.grad.asnumpy()
+    assert g[1].tolist() == [2, 2, 2]   # index 1 used twice
+    assert g[2].tolist() == [1, 1, 1]
+    assert g[0].tolist() == [0, 0, 0]
+
+
+def test_ordering():
+    x = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    assert nd.sort(x).asnumpy()[0].tolist() == [1, 2, 3]
+    assert nd.argsort(x).asnumpy()[0].tolist() == [1, 2, 0]
+    vals, idx = nd.topk(x, k=2, ret_typ="both")
+    assert vals.asnumpy()[0].tolist() == [3, 2]
+    assert idx.asnumpy()[0].tolist() == [0, 2]
+
+
+def test_where_clip_onehot():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x, y = nd.ones((3,)), nd.zeros((3,))
+    assert nd.where(cond, x, y).asnumpy().tolist() == [1, 0, 1]
+    assert nd.clip(nd.array([-2.0, 0.5, 9.0]), 0.0, 1.0).asnumpy().tolist() == [0, 0.5, 1]
+    oh = nd.one_hot(nd.array([0, 2]), 3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+
+
+def test_slicing_ops():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    s = nd.slice(x, begin=(0, 1, 0), end=(2, 3, 2))
+    assert s.shape == (2, 2, 2)
+    sa = nd.slice_axis(x, axis=2, begin=1, end=3)
+    assert sa.shape == (2, 3, 2)
+    sl = nd.slice_like(x, nd.zeros((1, 2, 2)))
+    assert sl.shape == (1, 2, 2)
+
+
+def test_gather_scatter():
+    data = nd.array(np.arange(9, dtype=np.float32).reshape(3, 3))
+    idx = nd.array([[0, 2], [1, 0]])   # (2 index dims, 2 points)
+    out = nd.gather_nd(data, idx)
+    assert out.asnumpy().tolist() == [1, 6]
+    scat = nd.scatter_nd(nd.array([5.0, 7.0]), idx, shape=(3, 3))
+    assert scat.asnumpy()[0, 1] == 5 and scat.asnumpy()[2, 0] == 7
+
+
+def test_rnn_lstm_shapes_and_grad():
+    T, N, C, H, L = 3, 2, 4, 5, 1
+    g = 4
+    nparams = g * H * (C + H) + 2 * g * H
+    data = rand_ndarray((T, N, C))
+    params = rand_ndarray((nparams,), scale=0.1)
+    h0 = nd.zeros((L, N, H))
+    c0 = nd.zeros((L, N, H))
+    out = nd.RNN(data, params, h0, c0, state_size=H, num_layers=L, mode="lstm")
+    assert out.shape == (T, N, H)
+    outs = nd.RNN(data, params, h0, c0, state_size=H, num_layers=L,
+                  mode="lstm", state_outputs=True)
+    assert outs[1].shape == (L, N, H) and outs[2].shape == (L, N, H)
+    # bidirectional
+    nparams_bi = 2 * (g * H * (C + H) + 2 * g * H) + 0
+    # layer0 reverse dir input is C too
+    out_bi = nd.RNN(data, rand_ndarray((nparams_bi,), scale=0.1),
+                    nd.zeros((2, N, H)), nd.zeros((2, N, H)),
+                    state_size=H, num_layers=1, mode="lstm", bidirectional=True)
+    assert out_bi.shape == (T, N, 2 * H)
+
+
+def test_sequence_ops():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 2, 2))
+    lens = nd.array([2, 3])
+    m = nd.SequenceMask(x, lens, use_sequence_length=True, value=-1)
+    assert m.asnumpy()[2, 0].tolist() == [-1, -1]   # seq 0 len 2 -> step 2 masked
+    assert m.asnumpy()[2, 1].tolist() == [10, 11]
+    last = nd.SequenceLast(x, lens, use_sequence_length=True)
+    assert last.asnumpy()[0].tolist() == [4, 5]     # step 1 of seq 0
+    rev = nd.SequenceReverse(x, lens, use_sequence_length=True)
+    assert rev.asnumpy()[0, 0].tolist() == [4, 5]
+
+
+def test_optimizer_update_ops():
+    w = nd.ones((4,))
+    g = nd.full((4,), 0.5)
+    out = nd.sgd_update(w, g, lr=0.1)
+    assert_almost_equal(out, np.full(4, 1 - 0.05), rtol=1e-5)
+    mom = nd.zeros((4,))
+    w2, m2 = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    assert_almost_equal(w2, np.full(4, 0.95), rtol=1e-5)
+    mean, var = nd.zeros((4,)), nd.zeros((4,))
+    w3, m3, v3 = nd.adam_update(w, g, mean, var, lr=0.1)
+    assert w3.shape == (4,)
+
+
+def test_contrib_box_ops():
+    boxes = nd.array([[0, 0, 1, 1], [0.5, 0.5, 1.5, 1.5], [5, 5, 6, 6]])
+    iou = nd.contrib.box_iou(boxes, boxes)
+    assert_almost_equal(np.diag(iou.asnumpy()), np.ones(3), rtol=1e-5)
+    assert abs(iou.asnumpy()[0, 1] - 0.25 / 1.75) < 1e-5
+    # NMS: rows [cls, score, x1, y1, x2, y2]
+    dets = nd.array([[0, 0.9, 0, 0, 1, 1],
+                     [0, 0.8, 0.05, 0.05, 1.05, 1.05],
+                     [0, 0.7, 5, 5, 6, 6]])
+    kept = nd.contrib.box_nms(dets, overlap_thresh=0.5)
+    k = kept.asnumpy()
+    assert k[0, 1] == pytest.approx(0.9)
+    assert k[1, 1] == pytest.approx(0.7)    # overlapping 0.8 suppressed
+    assert (k[2] == -1).all()
+
+
+def test_smooth_l1_and_makeloss():
+    x = nd.array([-2.0, -0.5, 0.5, 2.0])
+    out = nd.smooth_l1(x, scalar=1.0)
+    ref = np.where(np.abs(x.asnumpy()) < 1, 0.5 * x.asnumpy() ** 2,
+                   np.abs(x.asnumpy()) - 0.5)
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_cast_and_amp_cast():
+    x = nd.array([1.7, 2.3])
+    assert nd.Cast(x, dtype="int32").asnumpy().tolist() == [1, 2]
+    assert "bfloat16" in str(nd.amp_cast(x, dtype="bfloat16").dtype)
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    out = nd.Dropout(x, p=0.5)          # not training: identity
+    assert (out.asnumpy() == 1).all()
+    with autograd.record():
+        out_t = nd.Dropout(x, p=0.5)
+    frac = (out_t.asnumpy() == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = out_t.asnumpy()[out_t.asnumpy() != 0]
+    assert np.allclose(kept, 2.0)       # inverted dropout scaling
+
+
+def test_random_ops_distributions():
+    u = nd.random.uniform(0, 1, shape=(1000,))
+    assert 0 <= u.asnumpy().min() and u.asnumpy().max() < 1
+    n = nd.random.normal(0, 1, shape=(5000,))
+    assert abs(n.asnumpy().mean()) < 0.1
+    r = nd.random.randint(0, 10, shape=(100,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+
+
+def test_activation_variants():
+    x = nd.array([-1.0, 0.0, 1.0])
+    assert_almost_equal(nd.Activation(x, act_type="relu"), [0, 0, 1])
+    assert_almost_equal(nd.LeakyReLU(x, act_type="leaky", slope=0.1),
+                        [-0.1, 0, 1], rtol=1e-5)
+    elu = nd.LeakyReLU(x, act_type="elu", slope=1.0)
+    assert_almost_equal(elu, [np.expm1(-1), 0, 1], rtol=1e-4)
+    gelu = nd.LeakyReLU(x, act_type="gelu")
+    assert abs(gelu.asnumpy()[2] - 0.8413) < 1e-3
