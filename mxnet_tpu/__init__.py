@@ -29,3 +29,11 @@ from . import ndarray
 from . import ndarray as nd
 from . import autograd
 from . import random
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import kvstore
+from . import kvstore as kv
+from . import gluon

@@ -8,12 +8,14 @@ instead (see gluon.block), which is the TPU-idiomatic path.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 
 import jax
 
 _lock = threading.Lock()
 _key = jax.random.PRNGKey(0)
+_trace = threading.local()
 
 
 def seed(seed_state: int):
@@ -24,8 +26,36 @@ def seed(seed_state: int):
 
 
 def next_key():
-    """Split off a fresh subkey for one op invocation."""
+    """Split off a fresh subkey for one op invocation.
+
+    Inside a hybridize trace (``trace_key`` scope) the subkey is derived from
+    the *traced* key argument via ``fold_in``, so the jitted program takes the
+    key as a runtime input — each call of the compiled function sees fresh
+    randomness instead of a baked-in constant."""
+    stack = getattr(_trace, "stack", None)
+    if stack:
+        entry = stack[-1]
+        entry[1] += 1
+        return jax.random.fold_in(entry[0], entry[1])
     global _key
     with _lock:
         _key, sub = jax.random.split(_key)
     return sub
+
+
+@contextlib.contextmanager
+def trace_key(key):
+    """Scope used while tracing a hybridized block: route ``next_key`` through
+    a traced key argument (the TPU-idiomatic explicit-key threading)."""
+    stack = getattr(_trace, "stack", None)
+    if stack is None:
+        stack = _trace.stack = []
+    stack.append([key, 0])
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def in_trace() -> bool:
+    return bool(getattr(_trace, "stack", None))

@@ -47,7 +47,7 @@ def on_op_done(out_data):
     """Called by the dispatch layer after every op; in NaiveEngine mode this
     blocks, making failures deterministic and ordered (the reference's
     debugging mode)."""
-    if is_naive():
+    if is_naive() and not isinstance(out_data, jax.core.Tracer):
         jax.block_until_ready(out_data)
     return out_data
 

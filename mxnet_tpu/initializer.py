@@ -152,6 +152,11 @@ class One(Constant):
         super().__init__(1.0)
 
 
+# the reference accepts 'zeros'/'ones' spellings (mx.init.Zero aliases)
+_REGISTRY["zeros"] = Zero
+_REGISTRY["ones"] = One
+
+
 @register
 class Xavier(Initializer):
     """Glorot init (ref: initializer.py Xavier) — default for conv nets."""

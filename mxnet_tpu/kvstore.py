@@ -1,0 +1,190 @@
+"""KVStore — the parameter-synchronization facade.
+
+TPU-native re-design of the reference's key→value store
+(ref: include/mxnet/kvstore.h KVStore::Create; src/kvstore/kvstore_local.h,
+comm.h CommDevice, kvstore_nccl.h, kvstore_dist.h). Mapping (SURVEY §5.8):
+
+- ``local``/``device``/``nccl``: single-process aggregation. The reference
+  reduces gradients across GPU replicas with P2P copies or NCCL rings; here
+  replica arrays live on one process and XLA's ``psum`` handles the *sharded*
+  fast path (mxnet_tpu.parallel.Trainer runs it inside the jitted step over
+  ICI). This facade keeps the push/pull API for script compatibility.
+- ``dist_sync``/``dist_device_sync``: multi-host data parallel. The reference
+  uses a ZMQ parameter server (ps-lite); the TPU path is
+  ``jax.distributed.initialize`` + GSPMD collectives over DCN. Server-side
+  optimizer semantics are preserved (``set_optimizer`` installs an updater
+  applied at push time — exactly the reference's DataHandleEx flow).
+- ``dist_async`` (fully asynchronous PS) has NO TPU analog and raises — the
+  documented intentional divergence (SURVEY §2.4 #27).
+"""
+from __future__ import annotations
+
+import pickle
+
+from . import ndarray as nd
+from . import optimizer as opt
+from .base import MXNetError
+
+__all__ = ["KVStore", "create"]
+
+
+def create(name="local"):
+    """ref: mx.kv.create(type)."""
+    return KVStore(name)
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        kv_type = kv_type.lower()
+        known = ("local", "local_allreduce_cpu", "local_allreduce_device",
+                 "device", "nccl", "dist_sync", "dist_device_sync", "dist",
+                 "horovod", "p3", "dist_sync_device")
+        if kv_type == "dist_async":
+            raise MXNetError(
+                "kvstore 'dist_async' (asynchronous parameter server) has no "
+                "TPU analog: XLA collectives are bulk-synchronous. Use "
+                "'dist_sync' (sync data parallel over DCN). This divergence "
+                "is documented in SURVEY §2.4 #27.")
+        if kv_type not in known:
+            raise MXNetError(f"unknown kvstore type {kv_type!r}")
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._states = {}
+        self._compression = None
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """Worker rank (ref: KVStore::get_rank). Multi-host: process index."""
+        if self._type.startswith("dist"):
+            import jax
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self):
+        if self._type.startswith("dist"):
+            import jax
+            return jax.process_count()
+        return 1
+
+    # -- core API ------------------------------------------------------------
+    def _norm_keys(self, key):
+        single = not isinstance(key, (list, tuple))
+        keys = [key] if single else list(key)
+        return single, [str(k) for k in keys]
+
+    def _norm_vals(self, value, n):
+        if isinstance(value, nd.NDArray):
+            return [[value]] * 1 if n == 1 else [[value]]
+        if n == 1 and isinstance(value, (list, tuple)) and \
+                all(isinstance(v, nd.NDArray) for v in value):
+            return [list(value)]
+        return [v if isinstance(v, (list, tuple)) else [v] for v in value]
+
+    def init(self, key, value):
+        """ref: KVStore::Init — register initial weights."""
+        single, keys = self._norm_keys(key)
+        vals = self._norm_vals(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                continue
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate gradients into the store; if an optimizer is installed
+        the update is applied here (the reference's server-side update)."""
+        single, keys = self._norm_keys(key)
+        vals = self._norm_vals(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError(f"kvstore: key {k} was not init()ed")
+            agg = vlist[0]
+            for v in vlist[1:]:
+                agg = agg + v.as_in_context(agg.ctx)
+            agg = self._allreduce_dcn(agg)
+            if self._updater is not None:
+                self._updater(k, agg, self._store[k])
+            else:
+                self._store[k]._rebind(agg.as_in_context(
+                    self._store[k].ctx)._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """ref: KVStore::Pull — broadcast current values into `out`."""
+        if out is None:
+            raise MXNetError("kvstore.pull requires out=")
+        single, keys = self._norm_keys(key)
+        outs = self._norm_vals(out, len(keys))
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"kvstore: key {k} was not init()ed")
+            src = self._store[k]
+            for o in olist:
+                o._rebind(src.as_in_context(o.ctx)._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (ref: KVStore::PushPull, the 1.6+ API)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse pull degrades to dense pull (sparse storage deferred)."""
+        self.pull(key, out=out, priority=priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    # -- optimizer on the store (ref: kv.set_optimizer → server pickle) ------
+    def set_optimizer(self, optimizer):
+        # round-trip through pickle like the reference ships it to servers —
+        # catches unpicklable optimizers early and proves ckpt-ability
+        self._optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._updater = opt.get_updater(self._optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        ctype = compression_params.get("type", "2bit")
+        raise MXNetError(
+            f"gradient compression {ctype!r} is not implemented on the TPU "
+            f"build yet (reference: src/kvstore/gradient_compression.cc); "
+            f"XLA int8 collective experiments are planned")
+
+    # -- multi-host ----------------------------------------------------------
+    def _allreduce_dcn(self, arr):
+        """dist_*: sum across worker processes over DCN. Single-process runs
+        (including the driver's virtual mesh) are the identity."""
+        if not self._type.startswith("dist"):
+            return arr
+        import jax
+        if jax.process_count() == 1:
+            return arr
+        # cross-process eager all-reduce: route through a tiny pjit'ed psum
+        # over the global device mesh (SURVEY §5.8 TPU-native equivalent)
+        from .parallel import allreduce_across_processes
+        return allreduce_across_processes(arr)
+
+    def barrier(self):
+        """ref: KVStore::Barrier (ps-lite barrier)."""
+        nd.waitall()
+
+    # -- checkpointing of optimizer state (ref: kv.save/load_optimizer_states)
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer installed on this kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer installed on this kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())

@@ -662,3 +662,109 @@ def _upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
                          "contrib.BilinearResize2D for bilinear")
     out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
     return out
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (ref: src/operator/contrib/ctc_loss.cc / 3rdparty warp-ctc).
+# TPU-native design: the alpha recursion is a lax.scan over time — static
+# shapes, log-space accumulation, fully fused by XLA.
+# ---------------------------------------------------------------------------
+def _ctc_alpha_scan(logp, ext_labels, T_mask, S_len):
+    """logp: (T, N, C) log-probs; ext_labels: (N, S) blank-interleaved labels;
+    T_mask: (T, N) bool valid-time mask; S_len: (N,) valid ext length."""
+    T, N, C = logp.shape
+    S = ext_labels.shape[1]
+    neg_inf = jnp.asarray(-1e30, logp.dtype)
+    # emission log-probs per extended label position: (T, N, S)
+    emit = jnp.take_along_axis(
+        logp, jnp.broadcast_to(ext_labels[None], (T, N, S)), axis=2)
+
+    # allow skip from s-2 when current label != label at s-2 and != blank
+    can_skip = jnp.concatenate(
+        [jnp.zeros((N, 2), bool),
+         (ext_labels[:, 2:] != ext_labels[:, :-2]) &
+         (ext_labels[:, 2:] != C - 1)],
+        axis=1)
+
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(emit[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(S_len > 1, emit[0, :, 1], neg_inf))
+
+    def step(alpha, inputs):
+        emit_t, valid_t = inputs
+        shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]],
+                                 axis=1)
+        shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]],
+                                 axis=1)
+        shift2 = jnp.where(can_skip, shift2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2) + emit_t
+        new = jnp.where(valid_t[:, None], merged, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, (emit[1:], T_mask[1:]))
+    last = jnp.take_along_axis(alpha, (S_len - 1)[:, None], axis=1)[:, 0]
+    last2 = jnp.take_along_axis(
+        alpha, jnp.maximum(S_len - 2, 0)[:, None], axis=1)[:, 0]
+    return -jnp.logaddexp(last, jnp.where(S_len > 1, last2, neg_inf))
+
+
+@register("CTCLoss", num_inputs=-1, aliases=["ctc_loss", "_contrib_CTCLoss"],
+          params=[OpParam("use_data_lengths", bool, False),
+                  OpParam("use_label_lengths", bool, False),
+                  OpParam("blank_label", str, "last"),
+                  OpParam("data_lengths", None, None),
+                  OpParam("label_lengths", None, None)],
+          doc="CTC loss, alpha recursion as lax.scan "
+              "(ref: src/operator/contrib/ctc_loss.cc). Input (T, N, C) "
+              "activations (softmax applied internally), labels (N, L).")
+def _ctc_loss(data, labels, *lens, use_data_lengths=False,
+              use_label_lengths=False, blank_label="last", data_lengths=None,
+              label_lengths=None):
+    li = list(lens)
+    if use_data_lengths and data_lengths is None:
+        data_lengths = li.pop(0)
+    if use_label_lengths and label_lengths is None:
+        label_lengths = li.pop(0)
+    # lengths may arrive as kwargs carrying NDArrays (the reference's calling
+    # convention) — unwrap to jax arrays
+    if data_lengths is not None:
+        data_lengths = jnp.asarray(getattr(data_lengths, "_data", data_lengths))
+    if label_lengths is not None:
+        label_lengths = jnp.asarray(getattr(label_lengths, "_data",
+                                            label_lengths))
+    T, N, C = data.shape
+    if labels.shape[1] == 0:
+        # no labels: the only path is all blanks
+        logp0 = jax.nn.log_softmax(data, axis=2)
+        blank0 = C - 1 if blank_label == "last" else 0
+        t_mask = jnp.arange(T)[:, None] < (
+            data_lengths.astype(jnp.int32)[None, :] if data_lengths is not None
+            else jnp.full((1, N), T))
+        return -jnp.sum(jnp.where(t_mask, logp0[:, :, blank0], 0.0), axis=0)
+    logp = jax.nn.log_softmax(data, axis=2)
+    labels = labels.astype(jnp.int32)
+    L = labels.shape[1]
+    if blank_label == "last":
+        blank = C - 1
+    else:  # 'first': class 0 is blank; shift labels down like the reference
+        blank = C - 1
+        logp = jnp.concatenate([logp[:, :, 1:], logp[:, :, :1]], axis=2)
+        labels = labels - 1
+    if label_lengths is None:
+        # labels padded with values < 0 (or == blank) don't count
+        label_len = jnp.sum((labels >= 0) & (labels < C - 1), axis=1)
+    else:
+        label_len = label_lengths.astype(jnp.int32)
+    if data_lengths is None:
+        t_len = jnp.full((N,), T, jnp.int32)
+    else:
+        t_len = data_lengths.astype(jnp.int32)
+
+    # blank-interleaved extended labels: (N, 2L+1)
+    S = 2 * L + 1
+    ext = jnp.full((N, S), blank, jnp.int32)
+    safe_labels = jnp.clip(labels, 0, C - 1)
+    ext = ext.at[:, 1::2].set(safe_labels)
+    S_len = 2 * label_len + 1
+    T_mask = (jnp.arange(T)[:, None] < t_len[None, :])
+    return _ctc_alpha_scan(logp, ext, T_mask, S_len)

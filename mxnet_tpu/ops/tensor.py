@@ -504,3 +504,10 @@ def _diag(x, k=0):
 @register("embedding_like_dot", num_inputs=2, doc="helper: a @ b.T")
 def _dot_t(a, b):
     return jnp.matmul(a, jnp.swapaxes(b, -1, -2))
+
+
+@register("reshape_like", num_inputs=2,
+          doc="Reshape lhs to rhs's shape (ref: src/operator/tensor/"
+              "elemwise_unary_op_basic.cc reshape_like)")
+def _reshape_like(lhs, rhs):
+    return lhs.reshape(rhs.shape)

@@ -1,0 +1,306 @@
+"""Gluon tests (modeled on ref: tests/python/unittest/test_gluon.py —
+eager/hybrid consistency is this build's analog of the reference's CPU↔GPU
+check_consistency, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.init.One())
+    assert p.data().shape == (3, 4)
+    assert np.allclose(p.data().asnumpy(), 1)
+    assert p.grad().shape == (3, 4)
+    p.zero_grad()
+    assert np.allclose(p.grad().asnumpy(), 0)
+
+
+def test_parameter_deferred_init():
+    dense = nn.Dense(5)
+    dense.initialize()
+    with pytest.raises(Exception):
+        dense.weight.data()
+    out = dense(nd.ones((2, 7)))
+    assert out.shape == (2, 5)
+    assert dense.weight.shape == (5, 7)
+
+
+def test_parameter_grad_req_null():
+    p = gluon.Parameter("aux", shape=(2,), grad_req="null")
+    p.initialize()
+    with pytest.raises(Exception):
+        p.grad()
+
+
+def test_dense_numeric():
+    dense = nn.Dense(3, use_bias=True, in_units=4)
+    dense.initialize(mx.init.One())
+    x = nd.array(np.arange(8).reshape(2, 4).astype(np.float32))
+    out = dense(x).asnumpy()
+    expected = x.asnumpy().sum(axis=1, keepdims=True) * np.ones((2, 3))
+    assert np.allclose(out, expected)
+
+
+def test_sequential_and_getitem():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+    net.initialize()
+    assert net(nd.ones((1, 3))).shape == (1, 2)
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(8, kernel_size=3, strides=2, padding=1)
+    conv.initialize()
+    out = conv(nd.ones((2, 3, 16, 16)))
+    assert out.shape == (2, 8, 8, 8)
+    assert conv.weight.shape == (8, 3, 3, 3)
+
+
+def test_conv_groups():
+    conv = nn.Conv2D(8, kernel_size=1, groups=2, use_bias=False)
+    conv.initialize()
+    out = conv(nd.ones((1, 4, 5, 5)))
+    assert out.shape == (1, 8, 5, 5)
+    assert conv.weight.shape == (8, 2, 1, 1)
+
+
+def test_conv_transpose():
+    deconv = nn.Conv2DTranspose(4, kernel_size=2, strides=2)
+    deconv.initialize()
+    out = deconv(nd.ones((1, 3, 8, 8)))
+    assert out.shape == (1, 4, 16, 16)
+
+
+def test_pooling_layers():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    assert np.allclose(nn.MaxPool2D()(x).asnumpy().ravel(),
+                       [5, 7, 13, 15])
+    assert np.allclose(nn.AvgPool2D()(x).asnumpy().ravel(),
+                       [2.5, 4.5, 10.5, 12.5])
+    assert nn.GlobalAvgPool2D()(x).shape == (1, 1, 1, 1)
+    assert np.allclose(nn.GlobalMaxPool2D()(x).asnumpy().ravel(), [15])
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = nd.array(np.random.randn(8, 3, 4, 4).astype(np.float32) * 3 + 1)
+    with autograd.record():
+        out = bn(x)
+    # training: output is normalized per-batch
+    o = out.asnumpy()
+    assert abs(o.mean()) < 1e-2
+    assert abs(o.std() - 1) < 1e-1
+    # running stats moved toward batch stats
+    assert not np.allclose(bn.running_mean.data().asnumpy(), 0)
+    # eval mode uses running stats (different result)
+    out_eval = bn(x).asnumpy()
+    assert not np.allclose(o, out_eval)
+
+
+def test_dropout_train_eval():
+    do = nn.Dropout(0.5)
+    x = nd.ones((100, 100))
+    with autograd.record():
+        y = do(x).asnumpy()
+    assert (y == 0).mean() > 0.3
+    y_eval = do(x).asnumpy()
+    assert np.allclose(y_eval, 1)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([[1, 2], [3, 4]]))
+    assert out.shape == (2, 2, 4)
+
+
+def test_layernorm_layer():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    x = nd.array(np.random.randn(3, 6).astype(np.float32) * 5)
+    o = ln(x).asnumpy()
+    assert np.allclose(o.mean(axis=-1), 0, atol=1e-5)
+
+
+def test_hybridize_consistency_forward_grad():
+    """The §4 'check_consistency' analog: same math eager vs jitted."""
+    np.random.seed(2)
+    results = []
+    for hyb in (False, True):
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize(mx.init.Xavier(), force_reinit=True)
+        # identical init across the two nets
+        for p, val in zip(net.collect_params().values(),
+                          results[0][2] if results else []):
+            p.set_data(nd.array(val))
+        if hyb:
+            net.hybridize()
+        x = nd.array(np.random.RandomState(0).randn(5, 8).astype(np.float32))
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        grads = [p.grad().asnumpy() for p in net.collect_params().values()]
+        vals = [p.data().asnumpy() for p in net.collect_params().values()]
+        results.append((loss.asscalar(), grads, vals))
+    assert np.allclose(results[0][0], results[1][0], atol=1e-5)
+    for g0, g1 in zip(results[0][1], results[1][1]):
+        assert np.allclose(g0, g1, atol=1e-5)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    x = nd.ones((2, 4))
+    ref_out = net(x).asnumpy()
+    path = str(tmp_path / "m.params")
+    net.save_parameters(path)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net2.load_parameters(path)
+    assert np.allclose(net2(x).asnumpy(), ref_out, atol=1e-6)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, use_bias=False, in_units=1)
+    net.initialize(mx.init.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.array([[2.0]])
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    # w=1: dL/dw = 2*(w*2)*2 = 8 → w' = 1 - 0.8
+    assert np.allclose(net.weight.data().asnumpy(), 0.2, atol=1e-6)
+
+
+def test_trainer_states_roundtrip(tmp_path):
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = nd.ones((4, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+    path = str(tmp_path / "trainer.states")
+    trainer.save_states(path)
+    trainer.load_states(path)
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)  # should not raise; state shapes consistent
+
+
+def test_losses_against_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as TF
+    np.random.seed(3)
+    pred = np.random.randn(6, 5).astype(np.float32)
+    label = np.random.randint(0, 5, (6,))
+
+    l_mx = gluon.loss.SoftmaxCrossEntropyLoss()(
+        nd.array(pred), nd.array(label)).asnumpy()
+    l_th = TF.cross_entropy(torch.tensor(pred), torch.tensor(label),
+                            reduction="none").numpy()
+    assert np.allclose(l_mx, l_th, atol=1e-5)
+
+    tgt = np.random.randn(6, 5).astype(np.float32)
+    l2_mx = gluon.loss.L2Loss()(nd.array(pred), nd.array(tgt)).asnumpy()
+    l2_ref = 0.5 * ((pred - tgt) ** 2).mean(axis=1)
+    assert np.allclose(l2_mx, l2_ref, atol=1e-5)
+
+    l1_mx = gluon.loss.L1Loss()(nd.array(pred), nd.array(tgt)).asnumpy()
+    assert np.allclose(l1_mx, np.abs(pred - tgt).mean(axis=1), atol=1e-5)
+
+    bce_mx = gluon.loss.SigmoidBCELoss()(
+        nd.array(pred), nd.array((tgt > 0).astype(np.float32))).asnumpy()
+    bce_th = TF.binary_cross_entropy_with_logits(
+        torch.tensor(pred), torch.tensor((tgt > 0).astype(np.float32)),
+        reduction="none").numpy().mean(axis=1)
+    assert np.allclose(bce_mx, bce_th, atol=1e-5)
+
+
+def test_ctc_loss_against_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as TF
+    T, N, C, L = 10, 2, 6, 3
+    np.random.seed(4)
+    logits = np.random.randn(N, T, C).astype(np.float32)
+    labels = np.random.randint(0, C - 1, (N, L)).astype(np.float32)
+    loss = gluon.loss.CTCLoss()(nd.array(logits), nd.array(labels)).asnumpy()
+    ref = TF.ctc_loss(
+        torch.log_softmax(torch.tensor(logits.transpose(1, 0, 2)), 2),
+        torch.tensor(labels, dtype=torch.long),
+        torch.full((N,), T, dtype=torch.long),
+        torch.full((N,), L, dtype=torch.long),
+        blank=C - 1, reduction="none").numpy()
+    assert np.allclose(loss, ref, atol=1e-4)
+
+
+def test_metrics():
+    acc = mx.metric.Accuracy()
+    acc.update(nd.array([1, 0, 1]), nd.array([[0.2, 0.8], [0.9, 0.1],
+                                              [0.4, 0.6]]))
+    assert acc.get()[1] == 1.0
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update(nd.array([2]), nd.array([[0.3, 0.4, 0.33]]))
+    assert topk.get()[1] == 1.0
+    mse = mx.metric.create("mse")
+    mse.update(nd.array([1.0, 2.0]), nd.array([1.5, 2.5]))
+    assert np.allclose(mse.get()[1], 0.25)
+    comp = mx.metric.create(["acc", "mse"])
+    names, values = (comp.get())
+    assert len(names) == 2
+
+
+def test_kvstore_push_pull():
+    kv = mx.kv.create("device")
+    kv.init("w", nd.ones((2, 2)))
+    out = nd.zeros((2, 2))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 1)
+    kv.push("w", [nd.ones((2, 2)) * 2, nd.ones((2, 2)) * 3])
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 5)
+
+
+def test_kvstore_optimizer():
+    kv = mx.kv.create("device")
+    kv.init(0, nd.ones((3,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push(0, nd.ones((3,)))
+    out = nd.zeros((3,))
+    kv.pull(0, out=out)
+    assert np.allclose(out.asnumpy(), 0.9)
+
+
+def test_kvstore_dist_async_rejected():
+    with pytest.raises(Exception):
+        mx.kv.create("dist_async")
+
+
+def test_split_and_load():
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    parts = gluon.utils.split_and_load(nd.arange(8).reshape(4, 2), ctxs)
+    assert len(parts) == 2
+    assert parts[0].shape == (2, 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2,)) * 3, nd.ones((2,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert norm == pytest.approx(np.sqrt(9 * 2 + 16 * 2), rel=1e-4)
+    total = sum(float(nd.sum(nd.square(a)).asscalar()) for a in arrays)
+    assert np.sqrt(total) == pytest.approx(1.0, rel=1e-3)
