@@ -37,3 +37,4 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv
 from . import gluon
+from . import parallel

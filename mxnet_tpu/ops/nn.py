@@ -209,6 +209,8 @@ def _activation(x, act_type=None):
         return jax.nn.softplus(x)
     if act_type == "softsign":
         return jax.nn.soft_sign(x)
+    if act_type == "relu6":
+        return jnp.clip(x, 0, 6)
     raise MXNetError(f"Activation: unknown act_type {act_type!r}")
 
 
