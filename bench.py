@@ -1,0 +1,65 @@
+"""Benchmark of record: ResNet-50 training throughput (images/sec/chip).
+
+Runs the flagship training step — the full fused SPMD program (forward,
+softmax-CE loss, backward, SGD-momentum update) — on the available device
+and reports steady-state throughput, per BASELINE.md's measurement protocol.
+
+``vs_baseline`` is measured / derived-ceiling, where the ceiling is
+BASELINE.md's ≈4000 img/s/chip (TPU v5e at 50% MFU). On non-TPU hosts the
+number is only a smoke signal.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    batch = 256 if on_tpu else 8
+    warmup = 3
+    steps = 20 if on_tpu else 2
+
+    net = vision.resnet50_v1()
+    net.initialize()
+    mesh = parallel.make_mesh({"data": len(jax.devices())})
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        mesh=mesh)
+
+    x = np.random.randn(batch, 3, 224, 224).astype(np.float32)
+    y = np.random.randint(0, 1000, (batch,))
+
+    for _ in range(warmup):
+        trainer.step(x, y).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    n_chips = len(jax.devices())
+    img_per_sec_per_chip = batch * steps / dt / n_chips
+    baseline_ceiling = 4000.0  # BASELINE.md derived v5e 50%-MFU ceiling
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec_per_chip, 2),
+        "unit": f"images/sec/chip ({platform}, batch={batch})",
+        "vs_baseline": round(img_per_sec_per_chip / baseline_ceiling, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

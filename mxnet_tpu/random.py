@@ -5,8 +5,18 @@ global eager PRNG (stateless JAX keys under the hood, see _rng.py).
 """
 from __future__ import annotations
 
-from ._rng import seed
+from ._rng import seed as _seed_jax
 from .ndarray import random as _ndrandom
+
+
+def seed(seed_state):
+    """ref: mx.random.seed — seeds every generator the framework draws
+    from: the JAX key chain (nd.random ops) AND the numpy global RNG
+    (weight initializers sample through numpy on the host, matching the
+    reference where MXRandomSeed seeds all engines)."""
+    import numpy as _np
+    _seed_jax(seed_state)
+    _np.random.seed(int(seed_state) % (2 ** 32))
 
 uniform = _ndrandom.uniform
 normal = _ndrandom.normal

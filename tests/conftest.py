@@ -1,18 +1,58 @@
 """Test configuration: force an 8-device virtual CPU mesh so multi-chip
 sharding paths are exercised without TPU hardware (the reference's analog:
 `tools/launch.py --launcher local` fakes a cluster with local processes,
-SURVEY §4 'Distributed/nightly' row)."""
-import os
+SURVEY §4 'Distributed/nightly' row).
 
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
-# hard override (not setdefault): the environment may pin JAX_PLATFORMS to a
-# TPU tunnel; unit tests must run on the virtual CPU mesh and must not claim
-# the (single-client) TPU.
-os.environ["JAX_PLATFORMS"] = "cpu"
+The environment may pre-import jax at interpreter startup (a site hook that
+registers the single-chip TPU tunnel and force-selects it) — env vars set
+here are too late in that case, so the suite re-runs itself once in a clean
+subprocess with the right env and without the site hook.
+"""
+import os
+import subprocess
+import sys
 
 import numpy as np
 import pytest
+
+_ENV = {"JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+
+
+def _env_ok():
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return False
+    if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        return False
+    if "jax" in sys.modules:
+        import jax
+        try:
+            return jax.devices()[0].platform == "cpu" and \
+                len(jax.devices()) >= 8
+        except Exception:
+            return True
+    return True
+
+
+def pytest_configure(config):
+    if _env_ok():
+        return
+    if os.environ.get("_MXTPU_TEST_REEXEC") == "1":
+        raise RuntimeError("could not obtain an 8-device CPU mesh even "
+                           "after re-exec; check JAX_PLATFORMS/XLA_FLAGS")
+    env = dict(os.environ)
+    env.update(_ENV)
+    env["_MXTPU_TEST_REEXEC"] = "1"
+    # drop the TPU-tunnel site hook so the child interpreter starts clean
+    path = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and ".axon_site" not in p]
+    env["PYTHONPATH"] = os.pathsep.join(path)
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.suspend_global_capture(in_=True)
+    rc = subprocess.run([sys.executable, "-m", "pytest"] + sys.argv[1:],
+                        env=env).returncode
+    os._exit(rc)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,62 @@
+"""Model-zoo construction + forward-shape tests
+(ref: tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def _check(name, x_shape, classes=10):
+    net = vision.get_model(name, classes=classes)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(*x_shape).astype(np.float32))
+    out = net(x)
+    assert out.shape == (x_shape[0], classes)
+
+
+def test_resnet18_v1_thumbnail():
+    net = vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize()
+    out = net(mx.nd.array(np.random.randn(2, 3, 32, 32).astype(np.float32)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet_both_versions_agree_on_shape():
+    for name in ("resnet18_v1", "resnet18_v2"):
+        _check(name, (1, 3, 224, 224))
+
+
+def test_mobilenet_v1_v2():
+    _check("mobilenet0.25", (1, 3, 224, 224))
+    _check("mobilenetv2_0.25", (1, 3, 224, 224))
+
+
+def test_squeezenet():
+    _check("squeezenet1.1", (1, 3, 224, 224))
+
+
+def test_get_model_unknown():
+    with pytest.raises(mx.MXNetError):
+        vision.get_model("resnet1337_v9")
+
+
+def test_pretrained_raises():
+    with pytest.raises(mx.MXNetError):
+        vision.get_model("resnet18_v1", pretrained=True)
+
+
+def test_model_zoo_hybridize_train_step():
+    """Flagship-family model trains one step under the fused SPMD path."""
+    from mxnet_tpu import gluon, parallel
+    net = vision.resnet18_v1(classes=8, thumbnail=True)
+    net.initialize()
+    tr = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=parallel.make_mesh({"data": 8}))
+    x = np.random.randn(16, 3, 32, 32).astype(np.float32)
+    y = np.random.randint(0, 8, (16,))
+    l0 = tr.step(x, y).asscalar()
+    l1 = tr.step(x, y).asscalar()
+    assert np.isfinite(l0) and np.isfinite(l1)
