@@ -189,13 +189,19 @@ class ShardedTrainer:
 
     def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
                  mesh: Mesh = None, param_rules=None, batch_axis=0,
-                 donate=True):
+                 donate=True, compute_dtype=None):
         from .. import optimizer as opt_mod
         self._block = block
         self._loss = loss_fn
         optimizer_params = optimizer_params or {}
         self._optimizer = (optimizer if isinstance(optimizer, opt_mod.Optimizer)
                            else opt_mod.create(optimizer, **optimizer_params))
+        # compute_dtype="bfloat16": forward/backward in bf16 on the MXU with
+        # fp32 master weights — the reference's multi-precision (`mp_*`)
+        # scheme (ref: src/operator/optimizer_op.cc mp_sgd_update) fused
+        # into the step; the optimizer update stays fp32.
+        self._compute_dtype = (jnp.dtype(compute_dtype)
+                               if compute_dtype is not None else None)
         self._mesh = mesh
         self._param_rules = [(re.compile(pat), spec)
                              for pat, spec in (param_rules or [])]
@@ -229,6 +235,15 @@ class ShardedTrainer:
     def _shard(self, data, spec):
         return jax.device_put(data, NamedSharding(self.mesh, spec))
 
+    def _shard_batch_arg(self, b):
+        """Batch arg → data-sharded device array. Already-placed jax.Arrays
+        pass through (device_put with an identical sharding is a no-op), so
+        a prefetching input pipeline avoids re-uploads."""
+        data = b._data if isinstance(b, nd.NDArray) else b
+        if not isinstance(data, jax.Array):
+            data = np.asarray(data)
+        return self._shard(data, self._batch_spec(np.ndim(data)))
+
     # -- setup ---------------------------------------------------------------
     def _prepare(self, args):
         if self._prepared:
@@ -260,15 +275,29 @@ class ShardedTrainer:
                     for i in range(len(self._trainable))]
         clip = opt.clip_gradient if opt.clip_gradient is not None else -1.0
 
+        cdt = self._compute_dtype
+
         def step(tr, aux, states, key, lr, t, rescale, *batch):
             inputs, label = batch[:-1], batch[-1]
 
             def loss_of(tr_):
+                if cdt is not None:
+                    tr_ = [w.astype(cdt) if jnp.issubdtype(w.dtype,
+                                                           jnp.floating)
+                           else w for w in tr_]
+                    inputs_c = [i.astype(cdt) if jnp.issubdtype(
+                        jnp.asarray(i).dtype, jnp.floating) else i
+                        for i in inputs]
+                else:
+                    inputs_c = inputs
                 outs, treedef, aux_new = functional_apply(
-                    block, key, tr_, aux, inputs, training=True)
+                    block, key, tr_, aux, inputs_c, training=True)
                 self._out_treedef = treedef
-                out_nds = [nd.NDArray(o, _skip_device_put=True)
-                           for o in outs]
+                # loss math in fp32 regardless of compute dtype
+                out_nds = [nd.NDArray(
+                    o.astype(jnp.float32) if jnp.issubdtype(o.dtype,
+                                                            jnp.floating)
+                    else o, _skip_device_put=True) for o in outs]
                 label_nd = nd.NDArray(label, _skip_device_put=True)
                 with autograd.pause(train_mode=True):
                     loss_nd = loss_block(out_nds[0] if len(out_nds) == 1
@@ -278,6 +307,7 @@ class ShardedTrainer:
 
             (loss_val, (outs, aux_new)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(list(tr))
+            aux_new = [a.astype(a0.dtype) for a, a0 in zip(aux_new, aux)]
             new_tr, new_states = [], []
             for i, (w, g, s) in enumerate(zip(tr, grads, states)):
                 w2, s2 = _opt_apply(opt, w, g, s, lr * lr_mults[i], t,
@@ -315,12 +345,7 @@ class ShardedTrainer:
         self._prepare(args)
         if self._step_fn is None:
             self._step_fn = self._build_step(len(args))
-        mesh = self.mesh
-        bspec = lambda a: self._batch_spec(np.ndim(
-            a._data if isinstance(a, nd.NDArray) else a))
-        batch_datas = [self._shard(
-            b._data if isinstance(b, nd.NDArray) else np.asarray(b),
-            bspec(b)) for b in batch]
+        batch_datas = [self._shard_batch_arg(b) for b in batch]
         self._num_update += 1
         t = self._num_update
         self._optimizer.num_update = t
@@ -362,11 +387,7 @@ class ShardedTrainer:
                 return jnp.mean(loss_nd._data.astype(jnp.float32)), \
                     tuple(outs)
             self._eval_fn = jax.jit(eval_step)
-        batch_datas = [self._shard(
-            b._data if isinstance(b, nd.NDArray) else np.asarray(b),
-            self._batch_spec(np.ndim(
-                b._data if isinstance(b, nd.NDArray) else b)))
-            for b in batch]
+        batch_datas = [self._shard_batch_arg(b) for b in batch]
         tr = [p._data[0]._data for p in self._trainable]
         aux = [p._data[0]._data for p in self._aux]
         loss_val, outs = self._eval_fn(tr, aux, _rng.next_key(),
