@@ -1,0 +1,134 @@
+"""gluon.rnn tests (ref: tests/python/unittest/test_gluon_rnn.py):
+cell/layer shapes, fused-vs-cell consistency, bidirectional, autograd."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+
+
+def test_rnn_cell_shapes():
+    cell = rnn.RNNCell(16, input_size=8)
+    cell.initialize()
+    x = mx.nd.random.normal(shape=(4, 8))
+    states = cell.begin_state(4)
+    out, new_states = cell(x, states)
+    assert out.shape == (4, 16)
+    assert new_states[0].shape == (4, 16)
+
+
+def test_lstm_cell_unroll():
+    cell = rnn.LSTMCell(10, input_size=6)
+    cell.initialize()
+    x = mx.nd.random.normal(shape=(2, 5, 6))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC")
+    assert outputs.shape == (2, 5, 10)
+    assert len(states) == 2
+
+
+def test_gru_cell_deferred_init():
+    cell = rnn.GRUCell(12)
+    cell.initialize()
+    out, states = cell(mx.nd.random.normal(shape=(3, 7)),
+                       cell.begin_state(3))
+    assert out.shape == (3, 12)
+
+
+def test_lstm_layer_forward():
+    layer = rnn.LSTM(20, num_layers=2)
+    layer.initialize()
+    x = mx.nd.random.normal(shape=(5, 3, 10))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 20)
+    out, states = layer(x, layer.begin_state(3))
+    assert out.shape == (5, 3, 20)
+    assert states[0].shape == (2, 3, 20)
+    assert states[1].shape == (2, 3, 20)
+
+
+def test_bidirectional_lstm_layer():
+    layer = rnn.LSTM(8, num_layers=1, bidirectional=True, layout="NTC")
+    layer.initialize()
+    x = mx.nd.random.normal(shape=(2, 6, 4))
+    out = layer(x)
+    assert out.shape == (2, 6, 16)
+
+
+def test_gru_layer_matches_cell():
+    """Fused GRU layer ≡ stepping the GRUCell with the same weights — the
+    reference's fused-vs-unfused consistency check."""
+    T, N, C, H = 4, 2, 3, 5
+    layer = rnn.GRU(H, input_size=C)
+    layer.initialize()
+    x = mx.nd.random.normal(shape=(T, N, C))
+    out = layer(x)
+
+    cell = rnn.GRUCell(H, input_size=C)
+    cell.initialize()
+    # copy the layer's weights into the cell
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    states = cell.begin_state(N)
+    outs = []
+    for t in range(T):
+        o, states = cell(x[t], states)
+        outs.append(o.asnumpy())
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.stack(outs, axis=0), rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_layer_matches_cell():
+    T, N, C, H = 3, 2, 4, 6
+    layer = rnn.LSTM(H, input_size=C)
+    layer.initialize()
+    x = mx.nd.random.normal(shape=(T, N, C))
+    out = layer(x)
+
+    cell = rnn.LSTMCell(H, input_size=C)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    states = cell.begin_state(N)
+    outs = []
+    for t in range(T):
+        o, states = cell(x[t], states)
+        outs.append(o.asnumpy())
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.stack(outs, axis=0), rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_layer_backward():
+    layer = rnn.LSTM(8, num_layers=1)
+    layer.initialize()
+    x = mx.nd.random.normal(shape=(3, 2, 5))
+    with autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad()
+    assert g.shape == layer.l0_i2h_weight.shape
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_sequential_cell_and_residual():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=8))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(8, input_size=8)))
+    stack.initialize()
+    x = mx.nd.random.normal(shape=(2, 6, 8))
+    out, states = stack.unroll(6, x, layout="NTC")
+    assert out.shape == (2, 6, 8)
+
+
+def test_bidirectional_cell_unroll():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(5, input_size=4),
+                               rnn.LSTMCell(5, input_size=4))
+    bi.initialize()
+    x = mx.nd.random.normal(shape=(2, 3, 4))
+    out, states = bi.unroll(3, x, layout="NTC")
+    assert out.shape == (2, 3, 10)
