@@ -38,3 +38,6 @@ from . import kvstore
 from . import kvstore as kv
 from . import gluon
 from . import parallel
+from . import recordio
+from . import io
+from . import image

@@ -1,0 +1,478 @@
+"""``mx.io`` — data iterators (ref: python/mxnet/io/io.py, src/io/).
+
+The reference's C++ iterator pipeline (parser → augmenter → batcher →
+prefetcher, src/io/iter_image_recordio_2.cc) maps to Python iterators with a
+background prefetch thread staging batches while the TPU step runs — the
+double-buffering that hides input latency under compute (SURVEY §2.5 #34).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import queue
+import struct
+import threading
+from collections import OrderedDict, namedtuple
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """ref: io.py DataDesc — name/shape/dtype/layout of one input."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    """ref: io.py DataBatch."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """ref: io.py DataIter — the iterator protocol all trainers consume."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """ref: io.py _init_data — normalize array/list/dict to [(name, array)]."""
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (np.ndarray, nd.NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if len(data) == 1:
+            data = OrderedDict([(default_name, data[0])])
+        else:
+            data = OrderedDict([(f"_{i}_{default_name}", d)
+                                for i, d in enumerate(data)])
+    if not isinstance(data, dict):
+        raise MXNetError("data must be array, list of arrays, or dict")
+    return [(k, v if isinstance(v, np.ndarray) else v.asnumpy())
+            for k, v in data.items()]
+
+
+class NDArrayIter(DataIter):
+    """Batches over in-memory arrays (ref: io.py NDArrayIter): shuffle,
+    last_batch_handle pad/discard/roll_over."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        for k, v in self.data + self.label:
+            if v.shape[0] != self.num_data:
+                raise MXNetError(f"{k}: all arrays must share dim 0")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self._order = np.arange(self.num_data)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self._order)
+        # roll_over: keep leftover rows at the front of the next epoch
+        if self.last_batch_handle == "roll_over" and \
+                getattr(self, "_leftover", None) is not None:
+            self._order = np.concatenate([self._leftover, self._order])
+            self._leftover = None
+        self._cursor = 0
+
+    def iter_next(self):
+        return self._cursor < self.num_batches * self.batch_size and \
+            self._cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            if self.last_batch_handle == "roll_over":
+                start = (self.num_data // self.batch_size) * self.batch_size
+                if start < self.num_data:
+                    self._leftover = self._order[start:]
+            raise StopIteration
+        start = self._cursor
+        stop = min(start + self.batch_size, self.num_data)
+        idx = self._order[start:stop]
+        pad = 0
+        if stop - start < self.batch_size:  # pad from the beginning
+            pad = self.batch_size - (stop - start)
+            idx = np.concatenate([idx, self._order[:pad]])
+        self._cursor += self.batch_size
+        data = [nd.array(v[idx]) for _, v in self.data]
+        label = [nd.array(v[idx]) for _, v in self.label]
+        return DataBatch(data=data, label=label, pad=pad, index=idx,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def getpad(self):
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (ref: ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        return self.cur < self.size
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (ref: io.py PrefetchingIter /
+    src/io/iter_prefetcher.h): the host prepares batch N+1 while the device
+    runs batch N."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if isinstance(iters, (list, tuple)):
+            if len(iters) != 1:
+                raise MXNetError("multi-iter PrefetchingIter is not "
+                                 "supported; compose datasets instead")
+            iters = iters[0]
+        super().__init__(iters.batch_size)
+        self.iter = iters
+        self._depth = prefetch_depth
+        self._queue = None
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self._depth)
+
+        def worker():
+            try:
+                for batch in self.iter:
+                    self._queue.put(batch)
+            finally:
+                self._queue.put(None)
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._thread is not None and self._thread.is_alive():
+            while self._queue.get() is not None:
+                pass
+            self._thread.join()
+        self.iter.reset()
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    def iter_next(self):
+        raise MXNetError("PrefetchingIter supports only next()/iteration")
+
+
+class CSVIter(DataIter):
+    """ref: src/io/iter_csv.cc — streams batches out of CSV files."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32,
+                          ndmin=2).reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2).reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[0])
+        else:
+            label = np.zeros((data.shape[0],), dtype=np.float32)
+        self._inner = NDArrayIter(data, label, batch_size=batch_size,
+                                  last_batch_handle="pad"
+                                  if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+def _read_idx_file(path):
+    """MNIST idx format (also handles .gz)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        dt = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32,
+              13: np.float32, 14: np.float64}[dtype_code]
+        return np.frombuffer(f.read(), dtype=dt).reshape(shape)
+
+
+class MNISTIter(DataIter):
+    """ref: src/io/iter_mnist.cc — reads the raw MNIST ubyte files."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=0, **kwargs):
+        super().__init__(batch_size)
+        imgs = _read_idx_file(image).astype(np.float32) / 255.0
+        lbls = _read_idx_file(label).astype(np.float32)
+        if flat:
+            imgs = imgs.reshape(imgs.shape[0], -1)
+        else:
+            imgs = imgs.reshape(imgs.shape[0], 1, imgs.shape[1],
+                                imgs.shape[2])
+        self._inner = NDArrayIter(imgs, lbls, batch_size=batch_size,
+                                  shuffle=shuffle,
+                                  last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class ImageRecordIter(DataIter):
+    """ref: src/io/iter_image_recordio_2.cc ImageRecordIter — multithreaded
+    decode+augment over an indexed RecordIO pack, with prefetch.
+
+    Supported params mirror the reference's hot subset: path_imgrec/
+    path_imgidx, data_shape (C,H,W), batch_size, shuffle, rand_crop,
+    rand_mirror, resize, mean_{r,g,b}, std_{r,g,b}, scale.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, rand_crop=False,
+                 rand_mirror=False, resize=-1, mean_r=0.0, mean_g=0.0,
+                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                 label_width=1, preprocess_threads=4, seed=0, **kwargs):
+        super().__init__(batch_size)
+        from .. import recordio
+        self._data_shape = tuple(data_shape)
+        if path_imgidx and os.path.exists(path_imgidx):
+            self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec,
+                                                   "r")
+            self._keys = list(self._rec.keys)
+        else:
+            self._rec = recordio.MXRecordIO(path_imgrec, "r")
+            self._keys = None
+        self._shuffle = shuffle
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._resize = resize
+        self._mean = np.array([mean_r, mean_g, mean_b],
+                              dtype=np.float32).reshape(3, 1, 1)
+        self._std = np.array([std_r, std_g, std_b],
+                             dtype=np.float32).reshape(3, 1, 1)
+        self._scale = scale
+        self._label_width = label_width
+        self._rng = np.random.RandomState(seed)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self._label_width == 1 else \
+            (self.batch_size, self._label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        if self._keys is not None:
+            self._order = list(self._keys)
+            if self._shuffle:
+                self._rng.shuffle(self._order)
+            self._pos = 0
+        else:
+            self._rec.reset()
+
+    def _next_record(self):
+        from .. import recordio
+        if self._keys is not None:
+            if self._pos >= len(self._order):
+                return None
+            s = self._rec.read_idx(self._order[self._pos])
+            self._pos += 1
+        else:
+            s = self._rec.read()
+            if s is None:
+                return None
+        header, img = recordio.unpack_img(s, iscolor=1)
+        return header.label, img
+
+    def _augment(self, img):
+        import cv2
+        c, h, w = self._data_shape
+        if self._resize > 0:
+            short = min(img.shape[:2])
+            ratio = self._resize / short
+            img = cv2.resize(img, (int(round(img.shape[1] * ratio)),
+                                   int(round(img.shape[0] * ratio))))
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            img = cv2.resize(img, (max(w, iw), max(h, ih)))
+            ih, iw = img.shape[:2]
+        if self._rand_crop:
+            y = self._rng.randint(0, ih - h + 1)
+            x = self._rng.randint(0, iw - w + 1)
+        else:
+            y, x = (ih - h) // 2, (iw - w) // 2
+        img = img[y:y + h, x:x + w]
+        if self._rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img[:, :, ::-1]  # BGR (cv2) → RGB, like the reference
+        chw = img.transpose(2, 0, 1).astype(np.float32)
+        chw = (chw - self._mean) / self._std * self._scale
+        return chw
+
+    def next(self):
+        datas, labels = [], []
+        while len(datas) < self.batch_size:
+            rec = self._next_record()
+            if rec is None:
+                break
+            label, img = rec
+            datas.append(self._augment(img))
+            labels.append(np.asarray(label, dtype=np.float32).reshape(-1)
+                          [:self._label_width])
+        if not datas:
+            raise StopIteration
+        pad = self.batch_size - len(datas)
+        while len(datas) < self.batch_size:
+            datas.append(datas[-1])
+            labels.append(labels[-1])
+        label_arr = np.stack(labels)
+        if self._label_width == 1:
+            label_arr = label_arr.reshape(-1)
+        return DataBatch(data=[nd.array(np.stack(datas))],
+                         label=[nd.array(label_arr)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -1,0 +1,233 @@
+"""Data & I/O tests (ref: tests/python/unittest/test_io.py,
+test_recordio.py, test_gluon_data.py)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, io, recordio
+from mxnet_tpu.gluon.data import ArrayDataset, BatchSampler, DataLoader, \
+    RandomSampler, SequentialSampler, SimpleDataset
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+# -- recordio ----------------------------------------------------------------
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == f"record{i}".encode()
+    assert r.read() is None
+    r.close()
+
+
+def test_recordio_byte_layout(tmp_path):
+    """Byte framing matches dmlc recordio.h: magic, lrec, payload, pad."""
+    path = str(tmp_path / "layout.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"abcde")             # 5 bytes → 3 pad bytes
+    w.close()
+    raw = open(path, "rb").read()
+    magic, lrec = struct.unpack("<II", raw[:8])
+    assert magic == 0xced7230a
+    assert lrec >> 29 == 0        # cflag whole
+    assert lrec & ((1 << 29) - 1) == 5
+    assert raw[8:13] == b"abcde"
+    assert len(raw) == 16         # 8 header + 5 data + 3 pad
+
+
+def test_recordio_magic_in_payload(tmp_path):
+    """Payload containing the magic at 4B alignment must round-trip via
+    the multi-part split (ref: RecordIOWriter::WriteRecord)."""
+    payload = b"0123" + struct.pack("<I", 0xced7230a) + b"tail"
+    path = str(tmp_path / "split.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(payload)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == payload
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(10):
+        w.write_idx(i, f"data{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert r.read_idx(7) == b"data7"
+    assert r.read_idx(2) == b"data2"
+    assert sorted(r.keys) == list(range(10))
+    r.close()
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 3.0, 42, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.label == 3.0 and h2.id == 42
+    # array label
+    h = recordio.IRHeader(0, np.array([1.0, 2.0], dtype=np.float32), 7, 0)
+    h2, payload = recordio.unpack(recordio.pack(h, b"x"))
+    np.testing.assert_allclose(h2.label, [1.0, 2.0])
+
+
+def test_pack_img_unpack_img():
+    img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          quality=100, img_fmt=".png")
+    header, img2 = recordio.unpack_img(s)
+    assert header.label == 1.0
+    np.testing.assert_array_equal(img, img2)
+
+
+# -- io iterators ------------------------------------------------------------
+def test_ndarray_iter_basic():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = io.NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard():
+    data = np.zeros((10, 2), dtype=np.float32)
+    it = io.NDArrayIter(data, None, batch_size=3,
+                        last_batch_handle="discard")
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    data = np.arange(8).reshape(8, 1).astype(np.float32)
+    it = io.NDArrayIter(data, np.arange(8), batch_size=4, shuffle=True)
+    seen = []
+    for b in it:
+        seen.extend(b.label[0].asnumpy().tolist())
+    assert sorted(seen) == list(range(8))
+
+
+def test_csv_iter(tmp_path):
+    data_csv = str(tmp_path / "d.csv")
+    np.savetxt(data_csv, np.arange(12).reshape(6, 2), delimiter=",")
+    it = io.CSVIter(data_csv=data_csv, data_shape=(2,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 2)
+
+
+def test_image_record_iter(tmp_path):
+    """Pack images with the reference tooling path, read with
+    ImageRecordIter."""
+    rec = str(tmp_path / "imgs.rec")
+    idx = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(6):
+        img = (np.random.rand(40, 40, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png"))
+    w.close()
+    it = io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                            data_shape=(3, 32, 32), batch_size=4,
+                            shuffle=True, rand_crop=True, rand_mirror=True)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4,)
+
+
+def test_prefetching_iter():
+    data = np.random.randn(20, 3).astype(np.float32)
+    inner = io.NDArrayIter(data, np.arange(20), batch_size=5)
+    it = io.PrefetchingIter(inner)
+    assert len(list(it)) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+# -- gluon.data --------------------------------------------------------------
+def test_array_dataset_and_loader():
+    x = np.random.randn(10, 3).astype(np.float32)
+    y = np.arange(10).astype(np.int32)
+    ds = ArrayDataset(x, y)
+    assert len(ds) == 10
+    loader = DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert xb.shape == (4, 3)
+    np.testing.assert_allclose(xb.asnumpy(), x[:4], rtol=1e-6)
+
+
+def test_dataloader_workers_match_serial():
+    x = np.arange(24).reshape(12, 2).astype(np.float32)
+    ds = ArrayDataset(x)
+    serial = [b.asnumpy() for b in DataLoader(ds, 4, num_workers=0)]
+    threaded = [b.asnumpy() for b in DataLoader(ds, 4, num_workers=3)]
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dataset_transform_and_shard():
+    ds = SimpleDataset(list(range(10)))
+    t = ds.transform(lambda x: x * 2)
+    assert t[3] == 6
+    sh = ds.shard(3, 0)
+    assert len(sh) == 4   # 10 = 4+3+3
+    assert len(ds.shard(3, 2)) == 3
+
+
+def test_batch_sampler_rollover():
+    bs = BatchSampler(SequentialSampler(10), 4, "rollover")
+    first = list(bs)
+    assert [len(b) for b in first] == [4, 4]
+    second = list(bs)
+    assert len(second[0]) == 4  # 2 rolled + 2 new
+
+
+def test_transforms_compose():
+    img = mx.nd.array((np.random.rand(40, 30, 3) * 255).astype(np.uint8))
+    fn = transforms.Compose([
+        transforms.Resize(36),
+        transforms.CenterCrop(32),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2)),
+    ])
+    out = fn(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+
+
+# -- mx.image ---------------------------------------------------------------
+def test_image_imdecode_resize():
+    import cv2
+    img = (np.random.rand(48, 64, 3) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".png", img)
+    arr = mx.image.imdecode(buf.tobytes())
+    assert arr.shape == (48, 64, 3)
+    small = mx.image.imresize(arr, 32, 24)
+    assert small.shape == (24, 32, 3)
+    short = mx.image.resize_short(arr, 32)
+    assert min(short.shape[:2]) == 32
+
+
+def test_image_augmenter_pipeline():
+    auglist = mx.image.CreateAugmenter((3, 32, 32), resize=36,
+                                       rand_crop=True, rand_mirror=True,
+                                       mean=True, std=True)
+    img = mx.nd.array((np.random.rand(50, 60, 3) * 255).astype(np.uint8))
+    for aug in auglist:
+        img = aug(img)
+    assert img.shape == (32, 32, 3)
+    assert img.dtype == np.float32
