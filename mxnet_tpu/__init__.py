@@ -41,3 +41,8 @@ from . import parallel
 from . import recordio
 from . import io
 from . import image
+from . import symbol
+from . import symbol as sym
+from . import model
+from . import module
+from . import module as mod

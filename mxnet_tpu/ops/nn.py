@@ -470,6 +470,53 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                                 ignore_label, use_ignore).reshape(orig_shape)
 
 
+def _regression_core(link, grad_fn):
+    @jax.custom_vjp
+    def core(data, label, grad_scale):
+        return link(data)
+
+    def fwd(data, label, grad_scale):
+        return link(data), (link(data), label, grad_scale)
+
+    def bwd(res, g):
+        out, label, grad_scale = res
+        n = out.shape[1] if out.ndim > 1 else 1
+        grad = grad_fn(out, label.reshape(out.shape)) * grad_scale / n
+        return grad, jnp.zeros_like(out), None
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+_linear_reg = _regression_core(lambda x: x, lambda o, l: o - l)
+_mae_reg = _regression_core(lambda x: x, lambda o, l: jnp.sign(o - l))
+_logistic_reg = _regression_core(lambda x: jax.nn.sigmoid(x),
+                                 lambda o, l: o - l)
+
+
+@register("LinearRegressionOutput", num_inputs=2,
+          params=[OpParam("grad_scale", float, 1.0)],
+          doc="Identity forward, (pred-label) backward "
+              "(ref: src/operator/regression_output.cc)")
+def _linear_regression_output(data, label, grad_scale=1.0):
+    return _linear_reg(data, label.astype(data.dtype), grad_scale)
+
+
+@register("MAERegressionOutput", num_inputs=2,
+          params=[OpParam("grad_scale", float, 1.0)],
+          doc="ref: src/operator/regression_output.cc (MAE head)")
+def _mae_regression_output(data, label, grad_scale=1.0):
+    return _mae_reg(data, label.astype(data.dtype), grad_scale)
+
+
+@register("LogisticRegressionOutput", num_inputs=2,
+          params=[OpParam("grad_scale", float, 1.0)],
+          doc="Sigmoid forward, (sigmoid-label) backward "
+              "(ref: src/operator/regression_output.cc)")
+def _logistic_regression_output(data, label, grad_scale=1.0):
+    return _logistic_reg(data, label.astype(data.dtype), grad_scale)
+
+
 @register("MakeLoss", params=[OpParam("grad_scale", float, 1.0),
                               OpParam("valid_thresh", float, 0.0),
                               OpParam("normalization", str, "null")],
