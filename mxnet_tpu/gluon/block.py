@@ -326,7 +326,7 @@ class HybridBlock(Block):
         for param in self._reg_params.values():
             param._finish_deferred_init()
 
-    def forward(self, *args):
+    def forward(self, *args, **kwargs):
         """Gather this block's registered params and run ``hybrid_forward``."""
         if self._deferred_pending():
             self._finish_deferred(*args)
@@ -342,7 +342,7 @@ class HybridBlock(Block):
             self._finish_deferred(*args)
             params = {name: p.data(ctx)
                       for name, p in self._reg_params.items()}
-        return self.hybrid_forward(nd, *args, **params)
+        return self.hybrid_forward(nd, *args, **kwargs, **params)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError

@@ -281,3 +281,40 @@ def _interleaved_valatt(qkv, att, heads=None):
     out = jnp.matmul(att, v)                             # (N*H, T, D)
     out = out.reshape(n, heads, t, hd).transpose(2, 0, 1, 3)
     return out.reshape(t, n, e)
+
+
+@register("_contrib_flash_attention", num_inputs=3,
+          params=[OpParam("block_size", int, 512),
+                  OpParam("causal", bool, False)],
+          doc="Blockwise online-softmax attention on [B, H, S, D] inputs — "
+              "memory-efficient long-context attention (net-new TPU "
+              "capability, SURVEY §5.7; no reference analog — MXNet 1.x "
+              "used full attention). Sequence-parallel variant: "
+              "mxnet_tpu.parallel.ring_attention.")
+def _flash_attention(q, k, v, block_size=512, causal=False):
+    from ..parallel.ring_attention import blockwise_attention
+    return blockwise_attention(q, k, v, block_size=block_size, causal=causal)
+
+
+@register("_contrib_ring_attention", num_inputs=3,
+          params=[OpParam("axis_name", str, "seq"),
+                  OpParam("causal", bool, False),
+                  OpParam("batch_axis", str, "data"),
+                  OpParam("head_axis", str, None)],
+          doc="Sequence-parallel ring attention over the current mesh's "
+              "ICI ring (lax.ppermute of K/V shards + online softmax). "
+              "Net-new TPU capability (SURVEY §5.7); composes under jit "
+              "via shard_map.")
+def _ring_attention_op(q, k, v, axis_name="seq", causal=False,
+                       batch_axis="data", head_axis=None):
+    import jax
+    from ..parallel.ring_attention import blockwise_attention, ring_attention
+    from ..parallel.mesh import current_mesh
+    if not isinstance(q, jax.core.Tracer):
+        # eager execution (shape resolution, debugging): same math on one
+        # device via the blockwise kernel; the ring engages under jit
+        return blockwise_attention(q, k, v, block_size=q.shape[-2],
+                                   causal=causal)
+    return ring_attention(q, k, v, mesh=current_mesh(),
+                          axis_name=axis_name, causal=causal,
+                          batch_axis=batch_axis, head_axis=head_axis)

@@ -248,8 +248,11 @@ class ShardedTrainer:
     def _prepare(self, args):
         if self._prepared:
             return
-        self._block._ensure_ready(tuple(
-            a if isinstance(a, nd.NDArray) else nd.array(a) for a in args))
+        from .mesh import use_mesh
+        with use_mesh(self.mesh):   # deferred-init pass may hit mesh ops
+            self._block._ensure_ready(tuple(
+                a if isinstance(a, nd.NDArray) else nd.array(a)
+                for a in args))
         trainable, aux = self._block._param_split()
         self._trainable, self._aux = trainable, aux
         self._tr_specs = [self._param_spec(p) for p in trainable]
@@ -355,10 +358,12 @@ class ShardedTrainer:
         rescale = self._optimizer.rescale_grad
         tr = [p._data[0]._data for p in self._trainable]
         aux = [p._data[0]._data for p in self._aux]
-        new_tr, aux_new, new_states, loss_val, outs = self._step_fn(
-            tr, aux, self._states, _rng.next_key(),
-            jnp.float32(lr), jnp.float32(t), jnp.float32(rescale),
-            *batch_datas)
+        from .mesh import use_mesh
+        with use_mesh(self.mesh):   # mesh-aware ops (ring attention) trace
+            new_tr, aux_new, new_states, loss_val, outs = self._step_fn(
+                tr, aux, self._states, _rng.next_key(),
+                jnp.float32(lr), jnp.float32(t), jnp.float32(rescale),
+                *batch_datas)
         for p, w in zip(self._trainable, new_tr):
             p._data[0]._rebind(w)
         for p, a in zip(self._aux, aux_new):
