@@ -46,3 +46,6 @@ from . import symbol as sym
 from . import model
 from . import module
 from . import module as mod
+from . import callback
+from . import profiler
+from . import contrib

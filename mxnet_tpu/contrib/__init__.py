@@ -1,0 +1,6 @@
+"""``mx.contrib`` (ref: python/mxnet/contrib/__init__.py): amp, plus
+stubs that document intentional TPU divergences."""
+from . import amp
+from . import quantization
+
+__all__ = ["amp", "quantization"]

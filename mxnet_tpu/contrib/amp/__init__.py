@@ -1,0 +1,129 @@
+"""AMP — automatic mixed precision (ref: python/mxnet/contrib/amp/amp.py).
+
+The reference monkey-patches the op namespaces to insert ``amp_cast`` pairs
+from fp16 allow/deny lists and wraps the Trainer with a dynamic loss scaler.
+TPU-native translation (SURVEY §2.6 #50):
+
+- the natural target dtype is **bfloat16** (MXU-native, fp32 dynamic range
+  ⇒ no loss scaling needed);
+- casting happens at the compiled-step boundary: ``amp.init()`` sets the
+  process-wide compute dtype that ``parallel.ShardedTrainer`` (and bench)
+  pick up — one cast into the program, fp32 master weights, fp32 loss math,
+  which is exactly where the reference's graph-pass lands after XLA fusion;
+- fp16 parity keeps the reference's ``DynamicLossScaler`` (skip-step on
+  overflow, ref: amp.py DynamicLossScaler) for scripts that ask for fp16.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_hybrid_block", "DynamicLossScaler", "amp_dtype"]
+
+_state = {"initialized": False, "dtype": None}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """ref: amp.init — enable mixed precision process-wide."""
+    target_dtype = str(np.dtype(target_dtype)) if target_dtype != "bfloat16" \
+        else "bfloat16"
+    if target_dtype not in ("float16", "bfloat16"):
+        raise MXNetError("AMP target_dtype must be float16 or bfloat16 "
+                         "(bfloat16 recommended on TPU)")
+    _state["initialized"] = True
+    _state["dtype"] = target_dtype
+
+
+def amp_dtype():
+    """The active AMP compute dtype, or None (read by ShardedTrainer)."""
+    return _state["dtype"] if _state["initialized"] else None
+
+
+class DynamicLossScaler:
+    """ref: amp.py DynamicLossScaler — grow scale on stability, halve and
+    skip the step on overflow. bf16 does not need it; kept for fp16."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.0):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        for p in params:
+            g = p._grad[0] if getattr(p, "_grad", None) else None
+            if g is None:
+                continue
+            a = g.asnumpy()
+            if not np.isfinite(a).all():
+                return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    """ref: amp.init_trainer — attach a loss scaler to a gluon Trainer."""
+    if not _state["initialized"]:
+        raise MXNetError("call amp.init() before amp.init_trainer()")
+    trainer._amp_loss_scaler = DynamicLossScaler()
+    return trainer
+
+
+class _ScaledLoss:
+    def __init__(self, loss, scaler):
+        self._loss = loss
+        self._scaler = scaler
+
+    def __enter__(self):
+        s = self._scaler.loss_scale
+        if isinstance(self._loss, (list, tuple)):
+            return [l * s for l in self._loss]
+        return self._loss * s
+
+    def __exit__(self, *exc):
+        return False
+
+
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as L: L.backward()``
+    (ref: amp.scale_loss). The matching unscale happens in unscale()."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("trainer was not passed through amp.init_trainer")
+    # Trainer.step uses rescale_grad = _scale / batch_size, so dividing
+    # the scale back out happens there (ref: Trainer._amp integration)
+    trainer._scale = 1.0 / scaler.loss_scale
+    return _ScaledLoss(loss, scaler)
+
+
+def unscale(trainer):
+    """Divide accumulated gradients by the current loss scale."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("trainer was not passed through amp.init_trainer")
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._grad is not None:
+            for g in p._grad:
+                g._rebind((g * inv)._data)
+    trainer._scale = 1.0
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", ctx=None):
+    """Cast a block's parameters for low-precision inference
+    (ref: amp.convert_hybrid_block)."""
+    block.cast(target_dtype)
+    return block

@@ -1,0 +1,70 @@
+"""INT8 quantization (ref: python/mxnet/contrib/quantization.py).
+
+The reference's calibration flow (entropy/minmax thresholds feeding
+quantized_conv/fc kernels, SURVEY §2 #19) targets INT8 GEMMs. On TPU the
+idiomatic equivalent is AQT-style quantized XLA matmuls; this round ships
+calibration utilities and documents the kernel gap explicitly rather than
+pretending parity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_model", "calib_thresholds_minmax",
+           "calib_thresholds_entropy"]
+
+
+def calib_thresholds_minmax(arrays):
+    """Per-tensor min/max calibration (ref: quantization.py _LayerOutput
+    MinMaxCollector)."""
+    out = {}
+    for name, arr in arrays.items():
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+        out[name] = (float(a.min()), float(a.max()))
+    return out
+
+
+def calib_thresholds_entropy(arrays, num_bins=8001, num_quantized_bins=255):
+    """KL-divergence threshold search (ref: quantization.py
+    _get_optimal_threshold)."""
+    out = {}
+    for name, arr in arrays.items():
+        a = np.abs(np.asarray(arr.asnumpy() if hasattr(arr, "asnumpy")
+                              else arr)).ravel()
+        amax = a.max() if a.size else 0.0
+        if amax == 0:
+            out[name] = (0.0, 0.0)
+            continue
+        hist, edges = np.histogram(a, bins=num_bins, range=(0, amax))
+        best_kl, best_t = np.inf, amax
+        for i in range(num_quantized_bins, num_bins,
+                       max(1, num_bins // 64)):
+            p = hist[:i].astype(np.float64).copy()
+            p[-1] += hist[i:].sum()
+            if p.sum() == 0:
+                continue
+            factor = i / num_quantized_bins
+            q = np.repeat(
+                np.add.reduceat(p, np.arange(0, i,
+                                             max(1, int(factor)))),
+                max(1, int(factor)))[:i]
+            p /= p.sum()
+            q = q / q.sum()
+            mask = p > 0
+            kl = float(np.sum(p[mask] * np.log(p[mask]
+                                               / np.maximum(q[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_t = kl, edges[i]
+        out[name] = (-best_t, best_t)
+    return out
+
+
+def quantize_model(*args, **kwargs):
+    raise MXNetError(
+        "INT8 quantized inference kernels are not implemented in the TPU "
+        "build yet (reference: src/operator/quantization/). The TPU path "
+        "is AQT-style int8 XLA matmuls; bf16 inference via "
+        "amp.convert_hybrid_block covers most deployment cases today. "
+        "Calibration utilities (calib_thresholds_*) are available.")

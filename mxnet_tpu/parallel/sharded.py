@@ -199,7 +199,11 @@ class ShardedTrainer:
         # compute_dtype="bfloat16": forward/backward in bf16 on the MXU with
         # fp32 master weights — the reference's multi-precision (`mp_*`)
         # scheme (ref: src/operator/optimizer_op.cc mp_sgd_update) fused
-        # into the step; the optimizer update stays fp32.
+        # into the step; the optimizer update stays fp32. When unset, the
+        # process-wide AMP dtype applies (contrib.amp.init).
+        if compute_dtype is None:
+            from ..contrib.amp import amp_dtype
+            compute_dtype = amp_dtype()
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype is not None else None)
         self._mesh = mesh

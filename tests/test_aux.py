@@ -1,0 +1,119 @@
+"""Aux subsystems: profiler facade, callbacks, AMP, quantization calib
+(SURVEY §5.1/§2.6 #49/#50, §2 #19)."""
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.contrib import amp, quantization
+
+
+def test_profiler_trace_and_marker(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "prof.json"))
+    mx.profiler.set_state("run")
+    with mx.profiler.Marker("my_region"):
+        x = mx.nd.ones((64, 64))
+        y = mx.nd.dot(x, x)
+        y.wait_to_read()
+    mx.profiler.set_state("stop")
+    table = mx.profiler.dumps()
+    assert "my_region" in table
+    trace_dir = str(tmp_path / "prof_trace")
+    assert os.path.isdir(trace_dir) and os.listdir(trace_dir), \
+        "profiler must write an XLA trace directory"
+
+
+def test_speedometer_runs(caplog):
+    sp = mx.callback.Speedometer(batch_size=32, frequent=2)
+
+    class P:
+        epoch = 0
+        nbatch = 0
+        eval_metric = None
+    p = P()
+    with caplog.at_level(logging.INFO):
+        for i in range(5):
+            p.nbatch = i
+            sp(p)
+
+
+def test_do_checkpoint(tmp_path):
+    from mxnet_tpu import sym
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    cb = mx.callback.do_checkpoint(str(tmp_path / "m"))
+    arg = {"fc_weight": mx.nd.ones((2, 3)), "fc_bias": mx.nd.zeros((2,))}
+    cb(0, net, arg, {})
+    assert os.path.exists(str(tmp_path / "m-symbol.json"))
+    assert os.path.exists(str(tmp_path / "m-0001.params"))
+    sym2, a2, _ = mx.model.load_checkpoint(str(tmp_path / "m"), 1)
+    np.testing.assert_allclose(a2["fc_weight"].asnumpy(), np.ones((2, 3)))
+
+
+def test_amp_init_applies_to_sharded_trainer():
+    from mxnet_tpu import parallel
+    amp.init("bfloat16")
+    try:
+        net = gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        tr = parallel.ShardedTrainer(net, gluon.loss.L2Loss(), "sgd",
+                                     {"learning_rate": 0.1},
+                                     mesh=parallel.make_mesh({"data": 8}))
+        assert str(tr._compute_dtype) == "bfloat16"
+        x = np.random.randn(8, 8).astype(np.float32)
+        y = np.random.randn(8, 4).astype(np.float32)
+        loss = tr.step(x, y)
+        assert np.isfinite(loss.asscalar())
+        # master weights stay fp32
+        assert net.weight.data().dtype == np.float32
+    finally:
+        amp._state["initialized"] = False
+        amp._state["dtype"] = None
+
+
+def test_amp_loss_scaler():
+    s = amp.DynamicLossScaler(init_scale=1024, scale_factor=2.0,
+                              scale_window=2)
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 2048
+    s.update_scale(True)
+    assert s.loss_scale == 1024
+
+
+def test_amp_scale_loss_roundtrip():
+    amp.init("float16")
+    try:
+        net = gluon.nn.Dense(2, in_units=4)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.0})
+        amp.init_trainer(trainer)
+        x = mx.nd.ones((2, 4))
+        with autograd.record():
+            out = net(x)
+            loss = (out * out).sum()
+            with amp.scale_loss(loss, trainer) as scaled:
+                pass
+        scaled.backward()
+        g_scaled = net.weight.grad().asnumpy().copy()
+        amp.unscale(trainer)
+        g = net.weight.grad().asnumpy()
+        np.testing.assert_allclose(
+            g, g_scaled / trainer._amp_loss_scaler.loss_scale, rtol=1e-6)
+    finally:
+        amp._state["initialized"] = False
+        amp._state["dtype"] = None
+
+
+def test_quantization_calibration():
+    arrays = {"a": mx.nd.array(np.linspace(-1, 1, 1000))}
+    mm = quantization.calib_thresholds_minmax(arrays)
+    assert mm["a"][0] == pytest.approx(-1.0)
+    ent = quantization.calib_thresholds_entropy(arrays)
+    assert ent["a"][1] > 0
+    with pytest.raises(mx.MXNetError):
+        quantization.quantize_model()
