@@ -1,0 +1,186 @@
+"""ctypes bindings for the native runtime (native/libmxtpu.so).
+
+The reference's runtime substrate is C++ (dmlc-core recordio, the
+ThreadedEngine); this build keeps those components native and binds them
+with ctypes (no pybind11 in the image — SURVEY environment notes). The
+library builds lazily with g++ on first use and is cached; everything has
+a pure-Python fallback so the package works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_native_dir = os.path.join(_here, "native")
+_lib_path = os.path.join(_native_dir, "libmxtpu.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    srcs = [os.path.join(_native_dir, f)
+            for f in ("recordio.cc", "engine.cc")]
+    if not all(os.path.exists(s) for s in srcs):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+             "-o", _lib_path] + srcs,
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_lib_path) or (
+                os.path.exists(os.path.join(_native_dir, "recordio.cc"))
+                and os.path.getmtime(_lib_path)
+                < os.path.getmtime(os.path.join(_native_dir,
+                                                "recordio.cc"))):
+            if not _build() and not os.path.exists(_lib_path):
+                return None
+        try:
+            lib = ctypes.CDLL(_lib_path)
+        except OSError:
+            return None
+        # recordio
+        lib.mxio_writer_open.restype = ctypes.c_void_p
+        lib.mxio_writer_open.argtypes = [ctypes.c_char_p]
+        lib.mxio_writer_write.restype = ctypes.c_int
+        lib.mxio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_uint64]
+        lib.mxio_writer_tell.restype = ctypes.c_int64
+        lib.mxio_writer_tell.argtypes = [ctypes.c_void_p]
+        lib.mxio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.mxio_reader_open.restype = ctypes.c_void_p
+        lib.mxio_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.mxio_reader_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.mxio_reader_next.restype = ctypes.c_int
+        lib.mxio_reader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.mxio_reader_close.argtypes = [ctypes.c_void_p]
+        # engine
+        lib.mxengine_create.restype = ctypes.c_void_p
+        lib.mxengine_create.argtypes = [ctypes.c_int]
+        lib.mxengine_destroy.argtypes = [ctypes.c_void_p]
+        lib.mxengine_new_var.restype = ctypes.c_uint64
+        lib.mxengine_new_var.argtypes = [ctypes.c_void_p]
+        lib.mxengine_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        lib.mxengine_wait_all.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+ENGINE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class NativeReader:
+    """Sequential native RecordIO reader, optionally with background
+    prefetch (prefetch_depth > 0 — the ThreadedIter analog)."""
+
+    def __init__(self, path, prefetch_depth=0):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.mxio_reader_open(path.encode(),
+                                             int(prefetch_depth))
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+
+    def seek(self, pos):
+        self._lib.mxio_reader_seek(self._h, pos)
+
+    def read(self):
+        data = ctypes.c_char_p()
+        length = ctypes.c_uint64()
+        r = self._lib.mxio_reader_next(self._h, ctypes.byref(data),
+                                       ctypes.byref(length))
+        if r == 0:
+            return None
+        if r < 0:
+            raise IOError("corrupt recordio stream")
+        return ctypes.string_at(data, length.value)
+
+    def close(self):
+        if self._h:
+            self._lib.mxio_reader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+
+class NativeWriter:
+    def __init__(self, path):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.mxio_writer_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path} for writing")
+
+    def write(self, buf):
+        if self._lib.mxio_writer_write(self._h, buf, len(buf)) != 0:
+            raise IOError("recordio write failed")
+
+    def tell(self):
+        return self._lib.mxio_writer_tell(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.mxio_writer_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+
+class NativeEngine:
+    """The ThreadedEngine facade: push host tasks with read/write var
+    deps; the C++ scheduler runs them race-free on a thread pool."""
+
+    def __init__(self, num_workers=4):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.mxengine_create(num_workers)
+        self._keep = []          # keep callback trampolines alive
+
+    def new_var(self):
+        return self._lib.mxengine_new_var(self._h)
+
+    def push(self, fn, read_vars=(), write_vars=()):
+        cb = ENGINE_CB(lambda _arg, f=fn: f())
+        self._keep.append(cb)
+        r = (ctypes.c_uint64 * len(read_vars))(*read_vars)
+        w = (ctypes.c_uint64 * len(write_vars))(*write_vars)
+        self._lib.mxengine_push(
+            self._h, ctypes.cast(cb, ctypes.c_void_p), None,
+            r, len(read_vars), w, len(write_vars))
+
+    def wait_all(self):
+        self._lib.mxengine_wait_all(self._h)
+        self._keep.clear()
+
+    def close(self):
+        if self._h:
+            self._lib.mxengine_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()

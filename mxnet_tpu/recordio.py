@@ -47,16 +47,38 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        self._native = None
         if self.flag == "w":
-            self.fid = open(self.uri, "wb")
             self.writable = True
+            self._native = self._try_native_writer()
+            self.fid = None if self._native else open(self.uri, "wb")
         elif self.flag == "r":
-            self.fid = open(self.uri, "rb")
             self.writable = False
+            self._native = self._try_native_reader()
+            self.fid = None if self._native else open(self.uri, "rb")
         else:
             raise MXNetError(f"invalid flag {self.flag!r} (use 'r' or 'w')")
 
+    def _try_native_reader(self):
+        """Prefer the C++ reader (native/recordio.cc) — same byte format,
+        no Python framing overhead."""
+        try:
+            from ._native import NativeReader
+            return NativeReader(self.uri)
+        except Exception:
+            return None
+
+    def _try_native_writer(self):
+        try:
+            from ._native import NativeWriter
+            return NativeWriter(self.uri)
+        except Exception:
+            return None
+
     def close(self):
+        if getattr(self, "_native", None) is not None:
+            self._native.close()
+            self._native = None
         if self.fid is not None:
             self.fid.close()
             self.fid = None
@@ -68,6 +90,7 @@ class MXRecordIO:
         """Pickling (e.g. into DataLoader workers) reopens by path."""
         d = dict(self.__dict__)
         d["fid"] = None
+        d["_native"] = None
         if self.writable:
             raise MXNetError("cannot pickle a writable MXRecordIO")
         return d
@@ -81,6 +104,8 @@ class MXRecordIO:
         self.open()
 
     def tell(self):
+        if self._native is not None and self.writable:
+            return self._native.tell()
         return self.fid.tell()
 
     def write(self, buf):
@@ -91,6 +116,9 @@ class MXRecordIO:
         if isinstance(buf, str):
             buf = buf.encode("utf-8")
         buf = bytes(buf)
+        if self._native is not None:
+            self._native.write(buf)
+            return
         # find 4-byte-aligned magic occurrences
         splits = []
         for off in range(0, len(buf) - 3, 4):
@@ -137,6 +165,8 @@ class MXRecordIO:
         """Next record payload, or None at EOF (ref: MXRecordIO.read)."""
         if self.writable:
             raise MXNetError("recordio not opened for reading")
+        if self._native is not None:
+            return self._native.read()
         cflag, data = self._read_one_part()
         if cflag is None:
             return None
@@ -182,14 +212,20 @@ class MXIndexedRecordIO(MXRecordIO):
                     self.keys.append(key)
 
     def close(self):
-        if self.fid is not None and self.writable:
+        is_open = self.fid is not None or \
+            getattr(self, "_native", None) is not None
+        if is_open and self.writable:
             with open(self.idx_path, "w") as f:
                 for key in self.keys:
                     f.write(f"{key}\t{self.idx[key]}\n")
         super().close()
 
     def seek(self, idx):
-        self.fid.seek(self.idx[idx])
+        pos = self.idx[idx]
+        if self._native is not None:
+            self._native.seek(pos)
+        else:
+            self.fid.seek(pos)
 
     def read_idx(self, idx):
         self.seek(idx)

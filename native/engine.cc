@@ -1,0 +1,184 @@
+// Var-dependency task engine — the reference's ThreadedEngine design
+// (ref: src/engine/threaded_engine.{h,cc}, threaded_engine_perdevice.cc)
+// re-scoped for the TPU build: XLA/PjRt already dataflow-orders device
+// compute, so the native engine's remaining job is HOST-side work — decode,
+// augment, pack, checkpoint IO — scheduled race-free by declared var deps.
+//
+// Semantics (the reference's Engine::PushAsync contract):
+//  - an op declares const (read) vars and mutable (write) vars;
+//  - a read waits on the latest pending write of each read var; a write
+//    waits on every pending op of each written var (RAW/WAR/WAW ordering;
+//    concurrent readers allowed);
+//  - worker threads drain the ready queue; WaitForAll blocks the caller.
+//
+// Scheduling uses explicit reverse edges resolved at push time: each
+// blocker records its dependents and decrements them on completion — the
+// same bookkeeping as ThreadedVar::CompleteReadDependency /
+// CompleteWriteDependency, flattened.
+//
+// C ABI for ctypes; callbacks are C function pointers (Python passes
+// CFUNCTYPE trampolines — used for IO-bound work where the GIL releases).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Callback = void (*)(void*);
+
+struct Opr {
+  Callback fn;
+  void* arg;
+  std::vector<uint64_t> read_vars;
+  std::vector<uint64_t> write_vars;
+  std::vector<Opr*> dependents;   // ops whose wait_count includes me
+  int wait_count = 0;
+  bool completed = false;
+};
+
+struct Var {
+  std::deque<std::pair<Opr*, bool>> pending;  // (op, is_write), FIFO
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) {
+    if (num_workers <= 0) num_workers = 2;
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+      cv_ready_.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+  }
+
+  uint64_t NewVar() {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint64_t id = next_var_++;
+    vars_.emplace(id, Var{});
+    return id;
+  }
+
+  void Push(Callback fn, void* arg, const uint64_t* reads, int n_reads,
+            const uint64_t* writes, int n_writes) {
+    auto* op = new Opr();
+    op->fn = fn;
+    op->arg = arg;
+    op->read_vars.assign(reads, reads + n_reads);
+    op->write_vars.assign(writes, writes + n_writes);
+    std::lock_guard<std::mutex> lk(mu_);
+    ++outstanding_;
+    int blockers = 0;
+    for (uint64_t v : op->read_vars) {
+      auto& q = vars_[v].pending;
+      for (auto it = q.rbegin(); it != q.rend(); ++it) {
+        if (it->second) {               // latest pending write
+          it->first->dependents.push_back(op);
+          ++blockers;
+          break;
+        }
+      }
+      q.emplace_back(op, false);
+    }
+    for (uint64_t v : op->write_vars) {
+      auto& q = vars_[v].pending;
+      for (auto& entry : q) {           // every pending op
+        entry.first->dependents.push_back(op);
+        ++blockers;
+      }
+      q.emplace_back(op, true);
+    }
+    op->wait_count = blockers;
+    if (blockers == 0) {
+      ready_.push_back(op);
+      cv_ready_.notify_one();
+    }
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_done_.wait(lk, [this] { return outstanding_ == 0; });
+  }
+
+ private:
+  void WorkerLoop() {
+    while (true) {
+      Opr* op;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_ready_.wait(lk, [this] { return stop_ || !ready_.empty(); });
+        if (stop_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop_front();
+      }
+      op->fn(op->arg);
+      Complete(op);
+    }
+  }
+
+  void Complete(Opr* op) {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (Opr* dep : op->dependents) {
+      if (--dep->wait_count == 0) {
+        ready_.push_back(dep);
+        cv_ready_.notify_one();
+      }
+    }
+    auto erase_from = [op](std::deque<std::pair<Opr*, bool>>& q) {
+      for (auto it = q.begin(); it != q.end(); ++it) {
+        if (it->first == op) {
+          q.erase(it);
+          break;
+        }
+      }
+    };
+    for (uint64_t v : op->read_vars) erase_from(vars_[v].pending);
+    for (uint64_t v : op->write_vars) erase_from(vars_[v].pending);
+    --outstanding_;
+    if (outstanding_ == 0) cv_done_.notify_all();
+    delete op;
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_ready_, cv_done_;
+  std::deque<Opr*> ready_;
+  std::unordered_map<uint64_t, Var> vars_;
+  std::vector<std::thread> workers_;
+  uint64_t next_var_ = 1;
+  bool stop_ = false;
+  int outstanding_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mxengine_create(int num_workers) { return new Engine(num_workers); }
+
+void mxengine_destroy(void* e) { delete static_cast<Engine*>(e); }
+
+uint64_t mxengine_new_var(void* e) {
+  return static_cast<Engine*>(e)->NewVar();
+}
+
+void mxengine_push(void* e, void (*fn)(void*), void* arg,
+                   const uint64_t* reads, int n_reads,
+                   const uint64_t* writes, int n_writes) {
+  static_cast<Engine*>(e)->Push(fn, arg, reads, n_reads, writes, n_writes);
+}
+
+void mxengine_wait_all(void* e) { static_cast<Engine*>(e)->WaitForAll(); }
+
+}  // extern "C"
