@@ -49,3 +49,5 @@ from . import module as mod
 from . import callback
 from . import profiler
 from . import contrib
+from . import numpy as np
+from . import numpy_extension as npx

@@ -1,0 +1,99 @@
+"""mx.np / mx.npx namespace tests (ref: tests/python/unittest/
+test_numpy_op.py / test_numpy_ndarray.py, shrunk to the semantics that
+matter: numpy-identical results + autograd through the np namespace)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_np_basic_functions_match_numpy():
+    x = onp.random.RandomState(0).randn(4, 5).astype(onp.float32)
+    a = mx.np.array(x)
+    for fn in ["exp", "tanh", "abs", "floor", "sign"]:
+        got = getattr(mx.np, fn)(a).asnumpy()
+        want = getattr(onp, fn)(x)
+        onp.testing.assert_allclose(got, want, rtol=1e-6)
+    onp.testing.assert_allclose(mx.np.sum(a, axis=1).asnumpy(),
+                                x.sum(axis=1), rtol=1e-6)
+    onp.testing.assert_allclose(mx.np.mean(a, axis=0,
+                                           keepdims=True).asnumpy(),
+                                x.mean(axis=0, keepdims=True), rtol=1e-6)
+
+
+def test_np_zero_dim_and_broadcasting():
+    """The semantics the reference built mx.np for: 0-d arrays, numpy
+    broadcasting, integer dtypes."""
+    s = mx.np.array(3.0)
+    assert s.shape == ()
+    out = mx.np.add(s, mx.np.ones((2, 3)))
+    assert out.shape == (2, 3)
+    m = mx.np.arange(6).reshape((3, 2)) if hasattr(
+        mx.np.arange(6), "reshape") else None
+    a = mx.np.arange(6)
+    assert a.dtype == onp.int32 or a.dtype == onp.int64
+
+
+def test_np_matmul_einsum():
+    rng = onp.random.RandomState(1)
+    a = rng.randn(3, 4).astype(onp.float32)
+    b = rng.randn(4, 5).astype(onp.float32)
+    got = mx.np.matmul(mx.np.array(a), mx.np.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, a @ b, rtol=1e-5)
+    got2 = mx.np.einsum("ij,jk->ik", mx.np.array(a), mx.np.array(b))
+    onp.testing.assert_allclose(got2.asnumpy(), a @ b, rtol=1e-5)
+
+
+def test_np_autograd():
+    a = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    a.attach_grad()
+    with autograd.record():
+        y = mx.np.sum(mx.np.exp(a) * 2.0)
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(),
+                                2.0 * onp.exp([[1, 2], [3, 4]]), rtol=1e-5)
+
+
+def test_np_linalg_fft_random():
+    m = onp.eye(3, dtype=onp.float32) * 4.0
+    inv = mx.np.linalg.inv(mx.np.array(m))
+    onp.testing.assert_allclose(inv.asnumpy(), onp.linalg.inv(m), rtol=1e-5)
+    x = mx.np.random.normal(size=(16,))
+    assert x.shape == (16,)
+    f = mx.np.fft.fft(mx.np.array(onp.ones(8, onp.float32)))
+    assert f.shape == (8,)
+
+
+def test_np_sort_where_unique():
+    x = mx.np.array([3.0, 1.0, 2.0, 1.0])
+    onp.testing.assert_allclose(mx.np.sort(x).asnumpy(), [1, 1, 2, 3])
+    w = mx.np.where(x > 1.5, x, mx.np.zeros_like(x))
+    onp.testing.assert_allclose(w.asnumpy(), [3, 0, 2, 0])
+
+
+def test_npx_ops():
+    x = mx.np.array([[1.0, 2.0, 3.0]])
+    sm = mx.npx.softmax(x)
+    onp.testing.assert_allclose(sm.asnumpy().sum(), 1.0, rtol=1e-6)
+    assert mx.npx.relu(mx.np.array([-1.0, 2.0])).asnumpy().tolist() == \
+        [0.0, 2.0]
+    oh = mx.npx.one_hot(mx.np.array([0, 2]), 3)
+    onp.testing.assert_allclose(oh.asnumpy(),
+                                [[1, 0, 0], [0, 0, 1]])
+    mx.npx.set_np()
+    assert mx.npx.is_np_array()
+    mx.npx.reset_np()
+    assert not mx.npx.is_np_array()
+
+
+def test_npx_fully_connected_and_norm():
+    x = mx.np.array(onp.random.randn(2, 4).astype(onp.float32))
+    w = mx.np.array(onp.random.randn(3, 4).astype(onp.float32))
+    out = mx.npx.fully_connected(x, w, num_hidden=3)
+    assert out.shape == (2, 3)
+    g = mx.np.ones((4,))
+    b = mx.np.zeros((4,))
+    ln = mx.npx.layer_norm(x, g, b, axis=-1)
+    onp.testing.assert_allclose(ln.asnumpy().mean(axis=-1), [0, 0],
+                                atol=1e-6)

@@ -1,0 +1,88 @@
+"""tools/ tests: im2rec list+pack round-trip, launch.py local mode env
+wiring, parse_log (ref: the reference's tools/ + nightly launcher tests)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_im2rec_list_and_pack(tmp_path):
+    import cv2
+    # build a tiny class-folder dataset
+    for cls in ("cat", "dog"):
+        d = tmp_path / "data" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            img = (np.random.rand(20, 20, 3) * 255).astype(np.uint8)
+            cv2.imwrite(str(d / f"{i}.png"), img)
+    prefix = str(tmp_path / "pack")
+    root = str(tmp_path / "data")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run([sys.executable, os.path.join(REPO, "tools",
+                                                     "im2rec.py"),
+                        "--list", "--recursive", prefix, root],
+                       capture_output=True, env=env, text=True)
+    assert r.returncode == 0, r.stderr
+    lst = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lst) == 6
+    r = subprocess.run([sys.executable, os.path.join(REPO, "tools",
+                                                     "im2rec.py"),
+                        "--encoding", ".png", prefix, root],
+                       capture_output=True, env=env, text=True)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(rec.keys) == 6
+    header, img = recordio.unpack_img(rec.read_idx(rec.keys[0]))
+    assert img.shape == (20, 20, 3)
+
+
+def test_launch_local_env(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, json, sys\n"
+        "out = {k: os.environ[k] for k in"
+        " ('MXTPU_PROC_ID', 'MXTPU_NUM_PROC', 'MXTPU_COORD_ADDR',"
+        "  'DMLC_ROLE')}\n"
+        "path = os.path.join(os.path.dirname(__file__),"
+        " f\"out_{out['MXTPU_PROC_ID']}.json\")\n"
+        "json.dump(out, open(path, 'w'))\n")
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "launch.py"),
+                        "-n", "3", "--launcher", "local",
+                        sys.executable, str(script)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    ranks = set()
+    for i in range(3):
+        data = json.load(open(tmp_path / f"out_{i}.json"))
+        ranks.add(data["MXTPU_PROC_ID"])
+        assert data["MXTPU_NUM_PROC"] == "3"
+        assert data["DMLC_ROLE"] == "worker"
+    assert ranks == {"0", "1", "2"}
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Batch [50]\tSpeed: 1000.00 samples/sec\t"
+        "accuracy=0.5\n"
+        "INFO:root:Epoch[0] Train-accuracy=0.612\n"
+        "INFO:root:Epoch[0] Time cost=12.5\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.587\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.701\n")
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "parse_log.py"),
+                        str(log), "--format", "csv"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0].startswith("epoch,")
+    assert "0.612" in lines[1] and "0.587" in lines[1]
