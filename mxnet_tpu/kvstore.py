@@ -33,6 +33,29 @@ def create(name="local"):
     return KVStore(name)
 
 
+_dist_initialized = False
+
+
+def _ensure_distributed():
+    """Join the multi-host job described by the launcher env
+    (tools/launch.py sets MXTPU_COORD_ADDR/NUM_PROC/PROC_ID): the JAX
+    coordination service replaces the ps-lite scheduler (SURVEY §5.8).
+    No-op in single-process runs."""
+    global _dist_initialized
+    import os
+    if _dist_initialized:
+        return
+    addr = os.environ.get("MXTPU_COORD_ADDR")
+    if not addr:
+        return
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(os.environ["MXTPU_NUM_PROC"]),
+        process_id=int(os.environ["MXTPU_PROC_ID"]))
+    _dist_initialized = True
+
+
 class KVStore:
     def __init__(self, kv_type="local"):
         kv_type = kv_type.lower()
@@ -48,6 +71,8 @@ class KVStore:
         if kv_type not in known:
             raise MXNetError(f"unknown kvstore type {kv_type!r}")
         self._type = kv_type
+        if kv_type.startswith("dist"):
+            _ensure_distributed()
         self._store = {}
         self._updater = None
         self._optimizer = None

@@ -318,3 +318,133 @@ def _ring_attention_op(q, k, v, axis_name="seq", causal=False,
     return ring_attention(q, k, v, mesh=current_mesh(),
                           axis_name=axis_name, causal=causal,
                           batch_axis=batch_axis, head_axis=head_axis)
+
+
+@register("_contrib_MultiBoxTarget", aliases=["MultiBoxTarget"],
+          num_inputs=3, num_outputs=3,
+          params=[OpParam("overlap_threshold", float, 0.5),
+                  OpParam("ignore_label", float, -1.0),
+                  OpParam("negative_mining_ratio", float, -1.0),
+                  OpParam("negative_mining_thresh", float, 0.5),
+                  OpParam("minimum_negative_samples", int, 0),
+                  OpParam("variances", tuple, (0.1, 0.1, 0.2, 0.2))],
+          differentiable=False,
+          doc="SSD training target assignment: anchors x gt labels → "
+              "(loc_target, loc_mask, cls_target). Static shapes, vmapped "
+              "over the batch (ref: src/operator/contrib/"
+              "multibox_target.cc). gt label rows are [cls, x0, y0, x1, "
+              "y1], padded with cls=-1.")
+def _multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    anc = anchors.reshape(-1, 4)                      # (A, 4) corner
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-12)
+    ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-12)
+    A = anc.shape[0]
+
+    def one(label, cls_pred):
+        gt_cls = label[:, 0]
+        gt_box = label[:, 1:5]
+        valid = gt_cls >= 0                           # (M,)
+        iou = _box_iou_corner(anc, gt_box)            # (A, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)             # (A,)
+        best_iou = jnp.max(iou, axis=1)
+        # every gt's best anchor is forced positive (reference bipartite
+        # matching stage)
+        best_anchor = jnp.argmax(iou, axis=0)         # (M,)
+        forced = jnp.zeros(A, bool).at[best_anchor].set(valid)
+        forced_gt = jnp.zeros(A, jnp.int32).at[best_anchor].set(
+            jnp.arange(gt_box.shape[0], dtype=jnp.int32))
+        pos = forced | (best_iou >= overlap_threshold)
+        gt_idx = jnp.where(forced, forced_gt, best_gt)
+        # classification target: 0 = background, cls+1 for positives
+        cls_t = jnp.where(pos, gt_cls[gt_idx] + 1.0, 0.0)
+        # optional hard-negative mining: keep top-k negatives by max
+        # class prob, others → ignore_label
+        if negative_mining_ratio > 0:
+            prob = jax.nn.softmax(cls_pred, axis=-1)
+            neg_score = 1.0 - prob[:, 0]              # objectness-like
+            num_pos = jnp.sum(pos)
+            max_neg = jnp.maximum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                minimum_negative_samples)
+            neg_rank = jnp.argsort(jnp.argsort(
+                -jnp.where(pos, -jnp.inf, neg_score)))
+            keep_neg = (~pos) & (neg_rank < max_neg)
+            cls_t = jnp.where(pos | keep_neg, cls_t, ignore_label)
+        # localization target: encoded offsets with variances
+        g = gt_box[gt_idx]
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-12)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+        loc_t = jnp.stack([
+            (gcx - acx) / aw / variances[0],
+            (gcy - acy) / ah / variances[1],
+            jnp.log(gw / aw) / variances[2],
+            jnp.log(gh / ah) / variances[3]], axis=-1)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0)
+        loc_m = jnp.broadcast_to(pos[:, None], loc_t.shape).astype(
+            loc_t.dtype)
+        return (loc_t.reshape(-1), loc_m.reshape(-1), cls_t)
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(labels, cls_preds)
+    return loc_t, loc_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection", aliases=["MultiBoxDetection"],
+          num_inputs=3,
+          params=[OpParam("clip", bool, True),
+                  OpParam("threshold", float, 0.01),
+                  OpParam("background_id", int, 0),
+                  OpParam("nms_threshold", float, 0.5),
+                  OpParam("force_suppress", bool, False),
+                  OpParam("variances", tuple, (0.1, 0.1, 0.2, 0.2)),
+                  OpParam("nms_topk", int, -1)],
+          differentiable=False,
+          doc="SSD inference: decode anchors+offsets, per-class NMS; "
+              "output rows [cls_id, score, x0, y0, x1, y1], suppressed "
+              "rows -1 (static shape, ref: src/operator/contrib/"
+              "multibox_detection.cc)")
+def _multibox_detection(cls_prob, loc_pred, anchors, clip=True,
+                        threshold=0.01, background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    anc = anchors.reshape(-1, 4)
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+
+    def one(probs, loc):
+        # probs: (C, A); loc: (A*4,)
+        loc = loc.reshape(-1, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                           cy + h / 2], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best foreground class per anchor (reference picks argmax)
+        fg = jnp.where(jnp.arange(probs.shape[0])[:, None] == background_id,
+                       -jnp.inf, probs)
+        cls_id = jnp.argmax(fg, axis=0).astype(boxes.dtype)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        cls_id = jnp.where(keep, cls_id - (background_id == 0), -1.0)
+        score = jnp.where(keep, score, -1.0)
+        rows = jnp.concatenate([cls_id[:, None], score[:, None], boxes],
+                               axis=-1)
+        return rows
+
+    rows = jax.vmap(one)(cls_prob, loc_pred)
+    return _box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                    topk=nms_topk, coord_start=2, score_index=1,
+                    id_index=0, background_id=-1,
+                    force_suppress=force_suppress)

@@ -1,0 +1,99 @@
+"""Detection path tests: MultiBox ops + SSD model (driver config #5;
+ref: tests/python/unittest/test_contrib_operator.py multibox tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.model_zoo import ssd
+
+
+def test_multibox_prior_counts():
+    x = mx.nd.zeros((1, 3, 8, 8))
+    anchors = mx.nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25),
+                                          ratios=(1, 2))
+    # A = len(sizes) + len(ratios) - 1 = 3 per pixel
+    assert anchors.shape == (1, 8 * 8 * 3, 4)
+
+
+def test_multibox_target_assigns_gt():
+    # two anchors: one matching the gt box, one far away
+    anchors = mx.nd.array([[[0.1, 0.1, 0.4, 0.4],
+                            [0.6, 0.6, 0.9, 0.9]]])
+    # one gt: class 0 at the first anchor's location
+    labels = mx.nd.array([[[0, 0.1, 0.1, 0.4, 0.4]]])
+    cls_preds = mx.nd.zeros((1, 2, 2))   # (N, A, C+1) scores, unused here
+    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+        anchors, labels, cls_preds)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 1.0      # positive: class 0 → target 1
+    assert ct[1] == 0.0      # background
+    lm = loc_m.asnumpy()[0].reshape(2, 4)
+    assert lm[0].sum() == 4 and lm[1].sum() == 0
+    # perfect match ⇒ zero encoded offsets
+    lt = loc_t.asnumpy()[0].reshape(2, 4)
+    np.testing.assert_allclose(lt[0], 0.0, atol=1e-5)
+
+
+def test_multibox_target_detection_roundtrip():
+    """Encode with MultiBoxTarget, decode with MultiBoxDetection — boxes
+    must come back (the reference's numerical contract between the ops)."""
+    rng = np.random.RandomState(0)
+    anchors = mx.nd.array(rng.uniform(0.1, 0.4, (1, 6, 4)).astype(
+        np.float32))
+    a = anchors.asnumpy()[0].copy()
+    a[:, 2:] = a[:, :2] + 0.3          # valid corner boxes
+    anchors = mx.nd.array(a[None])
+    gt = np.array([[[1, 0.15, 0.15, 0.45, 0.5]]], dtype=np.float32)
+    labels = mx.nd.array(gt)
+    cls_preds = mx.nd.zeros((1, 6, 3))
+    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(anchors, labels,
+                                                       cls_preds)
+    # build a fake perfect network output: probs one-hot to cls_t
+    ct = cls_t.asnumpy()[0].astype(int)
+    probs = np.zeros((1, 3, 6), dtype=np.float32)
+    for i, c in enumerate(ct):
+        probs[0, c, i] = 1.0
+    out = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.array(probs), loc_t, anchors, nms_threshold=1.01)
+    rows = out.asnumpy()[0]
+    kept = rows[rows[:, 0] >= 0]
+    assert len(kept) >= 1
+    # the decoded box must match the gt box
+    best = kept[np.argmax(kept[:, 1])]
+    np.testing.assert_allclose(best[2:], gt[0, 0, 1:], atol=1e-3)
+    assert best[0] == 1.0  # class id (background_id=0 shifts by 1... cls 1)
+
+
+def test_ssd_forward_shapes():
+    net = ssd.get_ssd("resnet18_v1", classes=4, num_scales=3,
+                      thumbnail=True)
+    net.initialize()
+    x = mx.nd.random.normal(shape=(2, 3, 64, 64))
+    anchors, cls_preds, box_preds = net(x)
+    a = anchors.shape[1]
+    assert anchors.shape == (1, a, 4)
+    assert cls_preds.shape == (2, a, 5)
+    assert box_preds.shape == (2, a * 4)
+
+
+def test_ssd_train_step_runs():
+    from mxnet_tpu import autograd
+    net = ssd.get_ssd("resnet18_v1", classes=2, num_scales=2,
+                      thumbnail=True)
+    net.initialize()
+    loss_fn = ssd.SSDMultiBoxLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    x = mx.nd.random.normal(shape=(2, 3, 32, 32))
+    labels = mx.nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.5, 0.5]], [[1, 0.3, 0.3, 0.8, 0.8]]],
+        dtype=np.float32))
+    with autograd.record():
+        anchors, cls_preds, box_preds = net(x)
+        loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+            anchors, labels, cls_preds)
+        loss = loss_fn(cls_preds, box_preds, cls_t, loc_t, loc_m)
+    loss.backward()
+    trainer.step(2)
+    assert np.isfinite(loss.asnumpy()).all()
