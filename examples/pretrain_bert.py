@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Config #3 — BERT-base masked-LM pretraining (GluonNLP's
+scripts/bert/run_pretraining.py shape).
+
+Runs the fused SPMD step over a dp(×sp) mesh; --seq-parallel shards long
+sequences over the `seq` axis with ring attention (net-new TPU capability,
+SURVEY §5.7). Synthetic corpus by default.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon.model_zoo import bert
+
+
+class MLMWrapper(gluon.HybridBlock):
+    def __init__(self, inner, vocab):
+        super().__init__()
+        self.inner = inner
+        self._vocab = vocab
+
+    def hybrid_forward(self, F, tokens):
+        seq, mlm = self.inner(tokens)
+        return F.reshape(mlm, (-1, self._vocab))
+
+
+class FlatCE(gluon.loss.Loss):
+    def __init__(self):
+        super().__init__(None, 0)
+        self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def hybrid_forward(self, F, pred, label):
+        return self._ce(pred, F.reshape(label, (-1,)))
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="bert_12_768_12")
+    p.add_argument("--vocab-size", type=int, default=30522)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--seq-length", type=int, default=128)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--seq-parallel", type=int, default=1,
+                   help="size of the seq mesh axis (ring attention)")
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--no-bf16", dest="bf16", action="store_false")
+    args = p.parse_args()
+
+    import jax
+    n_dev = len(jax.devices())
+    axes = {"data": n_dev // args.seq_parallel}
+    if args.seq_parallel > 1:
+        axes["seq"] = args.seq_parallel
+    mesh = parallel.make_mesh(axes)
+
+    net = bert.get_bert_model(
+        args.model, vocab_size=args.vocab_size,
+        max_length=max(512, args.seq_length),
+        use_pooler=False, use_classifier=False,
+        seq_parallel=args.seq_parallel > 1)
+    net.initialize(mx.init.Normal(0.02))
+    trainer = parallel.ShardedTrainer(
+        MLMWrapper(net, args.vocab_size), FlatCE(), "adam",
+        optimizer_params={"learning_rate": args.lr},
+        mesh=mesh, compute_dtype="bfloat16" if args.bf16 else None)
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, args.vocab_size,
+                         (args.batch_size, args.seq_length))
+    tic, seen = time.time(), 0
+    for step in range(args.steps):
+        loss = trainer.step(tokens, tokens)
+        seen += args.batch_size
+        if step == 2:            # drop compile time from throughput
+            tic, seen = time.time(), 0
+        if step % 10 == 0:
+            logging.info("Batch [%d]\tmlm_loss=%.4f", step,
+                         loss.asscalar())
+    dt = time.time() - tic
+    logging.info("Speed: %.2f samples/sec (%d chips, seq=%d)",
+                 seen / dt, n_dev, args.seq_length)
+
+
+if __name__ == "__main__":
+    main()
