@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Scaling-efficiency harness (BASELINE.md metric: per-chip throughput at
+8 vs 64 chips, target ≥90%).
+
+Runs the fused SPMD ResNet-50 step at a ladder of data-parallel mesh sizes
+over the available devices and reports per-chip throughput + efficiency
+relative to the smallest mesh. On a real pod slice this measures ICI
+all-reduce overlap; on the CPU-device fallback it validates the harness
+(numbers are not meaningful for the target).
+
+Prints one JSON line per mesh size, then a summary line.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def measure(n_chips, batch_per_chip, steps, warmup, network, classes,
+            image, bf16):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    devices = jax.devices()[:n_chips]
+    mesh = parallel.make_mesh({"data": n_chips}, devices=devices)
+    net = vision.get_model(network, classes=classes)
+    net.initialize(mx.init.Xavier())
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=mesh, compute_dtype="bfloat16" if bf16 else None)
+    batch = batch_per_chip * n_chips
+    x_host = np.random.randn(batch, 3, image, image).astype(np.float32)
+    y_host = np.random.randint(0, classes, (batch,))
+    trainer._prepare((x_host,))
+    x = trainer._shard_batch_arg(x_host)
+    y = trainer._shard_batch_arg(y_host)
+    for _ in range(warmup):
+        trainer.step(x, y).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+    return batch * steps / dt / n_chips
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", default="resnet50_v1")
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--image", type=int, default=224)
+    p.add_argument("--batch-per-chip", type=int, default=128)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--sizes", default=None,
+                   help="comma list of mesh sizes (default: 1,2,4,… up to "
+                        "visible devices)")
+    p.add_argument("--no-bf16", dest="bf16", action="store_false",
+                   default=True)
+    args = p.parse_args()
+
+    import jax
+    n = len(jax.devices())
+    if args.sizes:
+        sizes = [int(s) for s in args.sizes.split(",")]
+    else:
+        sizes = [s for s in (1, 2, 4, 8, 16, 32, 64) if s <= n]
+    results = {}
+    for s in sizes:
+        per_chip = measure(s, args.batch_per_chip, args.steps, args.warmup,
+                           args.network, args.classes, args.image,
+                           args.bf16)
+        results[s] = per_chip
+        print(json.dumps({"chips": s,
+                          "images_per_sec_per_chip": round(per_chip, 2)}))
+    base = results[sizes[0]]
+    print(json.dumps({
+        "metric": "scaling_efficiency",
+        "base_chips": sizes[0], "max_chips": sizes[-1],
+        "value": round(results[sizes[-1]] / base, 4),
+        "target": 0.9,
+    }))
+
+
+if __name__ == "__main__":
+    main()
