@@ -292,7 +292,20 @@ def _interleaved_valatt(qkv, att, heads=None):
               "used full attention). Sequence-parallel variant: "
               "mxnet_tpu.parallel.ring_attention.")
 def _flash_attention(q, k, v, block_size=512, causal=False):
+    import jax
     from ..parallel.ring_attention import blockwise_attention
+    # on TPU hardware route to the hand-tiled Pallas kernel (MXU-tiled
+    # blocks, VMEM-resident online softmax); the jnp blockwise kernel is
+    # the portable fallback and the CPU-test oracle
+    if jax.default_backend() == "tpu" and q.shape[-2] % 128 == 0 and \
+            q.shape[-1] >= 64:
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as _pallas_fa)
+            return _pallas_fa(q, k, v, causal=causal,
+                              sm_scale=float(q.shape[-1]) ** -0.5)
+        except Exception:
+            pass
     return blockwise_attention(q, k, v, block_size=block_size, causal=causal)
 
 
