@@ -461,3 +461,134 @@ def _multibox_detection(cls_prob, loc_pred, anchors, clip=True,
                     topk=nms_topk, coord_start=2, score_index=1,
                     id_index=0, background_id=-1,
                     force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# Binary-network ops — the BMXNet fork delta (SURVEY §2 #23: yanghaojin is
+# the BMXNet author; upstream BMXNet adds QConvolution/QFullyConnected/
+# QActivation and det_sign with gradient cancellation, smd_hpi/src/).
+# TPU design: binarization is sign() with a straight-through estimator;
+# the "XNOR-popcount GEMM" becomes a ±1 matmul in bf16 on the MXU — the
+# MXU at bf16 rate IS the fast binary GEMM on this hardware (no integer
+# popcount unit to beat it).
+# ---------------------------------------------------------------------------
+def _ste_sign(x, grad_cancel=1.0):
+    @jax.custom_vjp
+    def core(v):
+        return jnp.where(v >= 0, 1.0, -1.0).astype(v.dtype)
+
+    def fwd(v):
+        return core(v), v
+
+    def bwd(v, g):
+        # straight-through with cancellation: pass grad only where |x|<=t
+        return (jnp.where(jnp.abs(v) <= grad_cancel, g,
+                          jnp.zeros_like(g)),)
+
+    core.defvjp(fwd, bwd)
+    return core(x)
+
+
+@register("det_sign", params=[OpParam("grad_cancel", float, 1.0)],
+          doc="Deterministic sign with straight-through gradient, zeroed "
+              "where |x| > grad_cancel (BMXNet det_sign / grad cancellation)")
+def _det_sign(x, grad_cancel=1.0):
+    return _ste_sign(x, grad_cancel)
+
+
+@register("approx_sign", params=[],
+          doc="ApproxSign (Bi-Real Net): sign forward, piecewise-parabolic "
+              "backward (2-2|x| for |x|<=1) — BMXNet approx_sign")
+def _approx_sign(x):
+    @jax.custom_vjp
+    def core(v):
+        return jnp.where(v >= 0, 1.0, -1.0).astype(v.dtype)
+
+    def fwd(v):
+        return core(v), v
+
+    def bwd(v, g):
+        slope = jnp.where(jnp.abs(v) <= 1.0, 2.0 - 2.0 * jnp.abs(v), 0.0)
+        return (g * slope,)
+
+    core.defvjp(fwd, bwd)
+    return core(x)
+
+
+@register("QFullyConnected", num_inputs=-1,
+          params=[OpParam("num_hidden", int, None, required=True),
+                  OpParam("no_bias", bool, False),
+                  OpParam("binarize_input", bool, True),
+                  OpParam("scaling", bool, True)],
+          doc="Binary fully-connected (BMXNet QFullyConnected): ±1 weights "
+              "(and optionally inputs), XNOR-Net alpha scaling = mean|W|")
+def _q_fully_connected(x, weight, *bias, num_hidden=None, no_bias=False,
+                       binarize_input=True, scaling=True):
+    xb = _ste_sign(x) if binarize_input else x
+    wb = _ste_sign(weight)
+    y = jnp.matmul(xb.reshape(xb.shape[0], -1), wb.T)
+    if scaling:
+        alpha = jnp.mean(jnp.abs(weight))
+        y = y * alpha
+    if not no_bias and bias:
+        y = y + bias[0]
+    return y
+
+
+@register("QConvolution", num_inputs=-1,
+          params=[OpParam("kernel", tuple, None, required=True),
+                  OpParam("num_filter", int, None, required=True),
+                  OpParam("stride", tuple, (1, 1)),
+                  OpParam("pad", tuple, (0, 0)),
+                  OpParam("dilate", tuple, (1, 1)),
+                  OpParam("num_group", int, 1),
+                  OpParam("no_bias", bool, True),
+                  OpParam("binarize_input", bool, True),
+                  OpParam("scaling", bool, True)],
+          doc="Binary convolution (BMXNet QConvolution): ±1 weights/input, "
+              "per-filter alpha scaling; lowers to a bf16 MXU conv")
+def _q_convolution(x, weight, *bias, kernel=None, num_filter=None,
+                   stride=(1, 1), pad=(0, 0), dilate=(1, 1), num_group=1,
+                   no_bias=True, binarize_input=True, scaling=True):
+    xb = _ste_sign(x) if binarize_input else x
+    wb = _ste_sign(weight)
+    nd_spatial = len(kernel)
+    dn = lax.conv_dimension_numbers(
+        xb.shape, wb.shape,
+        ("NCHW", "OIHW", "NCHW") if nd_spatial == 2 else
+        ("NCW", "OIW", "NCW"))
+    y = lax.conv_general_dilated(
+        xb, wb, window_strides=tuple(stride), padding=[(p, p) for p in pad],
+        rhs_dilation=tuple(dilate), dimension_numbers=dn,
+        feature_group_count=num_group)
+    if scaling:
+        alpha = jnp.mean(jnp.abs(weight), axis=tuple(
+            range(1, weight.ndim)))                     # per output filter
+        y = y * alpha.reshape((1, -1) + (1,) * nd_spatial)
+    if not no_bias and bias:
+        y = y + bias[0].reshape((1, -1) + (1,) * nd_spatial)
+    return y
+
+
+@register("QActivation", params=[OpParam("act_bit", int, 1),
+                                OpParam("backward_only", bool, False)],
+          doc="Quantized activation (BMXNet QActivation): 1 bit = STE sign "
+              "of clipped input; k bit = uniform quantization of clip(x,0,1)")
+def _q_activation(x, act_bit=1, backward_only=False):
+    if act_bit == 1:
+        return _ste_sign(jnp.clip(x, -1.0, 1.0))
+    levels = (1 << act_bit) - 1
+
+    @jax.custom_vjp
+    def core(v):
+        c = jnp.clip(v, 0.0, 1.0)
+        return jnp.round(c * levels) / levels
+
+    def fwd(v):
+        return core(v), v
+
+    def bwd(v, g):
+        return (jnp.where((v >= 0) & (v <= 1), g, jnp.zeros_like(g)),)
+
+    core.defvjp(fwd, bwd)
+    return core(x)

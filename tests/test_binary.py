@@ -1,0 +1,86 @@
+"""Binary-network (BMXNet fork delta) tests: det_sign STE, QDense/QConv2D
+layers, and that a binary MLP actually trains (the BMXNet paper's core
+claim, shrunk)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def test_det_sign_values_and_ste():
+    x = mx.nd.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.det_sign(x)
+        loss = (y * mx.nd.array([1, 1, 1, 1, 1])).sum()
+    np.testing.assert_array_equal(y.asnumpy(), [-1, -1, 1, 1, 1])
+    loss.backward()
+    # straight-through inside |x|<=1, cancelled outside
+    np.testing.assert_array_equal(x.grad.asnumpy(), [0, 1, 1, 1, 0])
+
+
+def test_approx_sign_grad_shape():
+    x = mx.nd.array([-0.5, 0.25])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.approx_sign(x)
+        y.sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0, 1.5], rtol=1e-6)
+
+
+def test_qactivation_bits():
+    x = mx.nd.array([-0.7, 0.3, 0.9])
+    one = mx.nd.QActivation(x, act_bit=1)
+    np.testing.assert_array_equal(one.asnumpy(), [-1, 1, 1])
+    two = mx.nd.QActivation(x, act_bit=2)
+    np.testing.assert_allclose(two.asnumpy(), [0.0, 1 / 3, 1.0], atol=1e-6)
+
+
+def test_qdense_binary_output():
+    layer = gluon.nn.QDense(4, in_units=8, binarize_input=True,
+                            scaling=False)
+    layer.initialize()
+    x = mx.nd.random.normal(shape=(2, 8))
+    out = layer(x)
+    # output of ±1 @ ±1 matmul over 8 inputs: even integers in [-8, 8]
+    vals = out.asnumpy()
+    assert np.all(np.abs(vals) <= 8.0)
+    assert np.allclose(vals, np.round(vals))
+
+
+def test_qconv2d_shapes():
+    layer = gluon.nn.QConv2D(6, 3, padding=1)
+    layer.initialize()
+    x = mx.nd.random.normal(shape=(2, 3, 8, 8))
+    out = layer(x)
+    assert out.shape == (2, 6, 8, 8)
+
+
+def test_binary_mlp_trains():
+    rng = np.random.RandomState(0)
+    n, d = 256, 16
+    w_true = rng.randn(d, 4)
+    x = rng.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1).astype(np.float32)
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, activation="tanh"))
+        net.add(gluon.nn.QDense(64, binarize_input=True))
+        net.add(gluon.nn.BatchNorm())
+        net.add(gluon.nn.Activation("tanh"))
+        net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    for epoch in range(20):
+        with autograd.record():
+            out = net(mx.nd.array(x))
+            loss = loss_fn(out, mx.nd.array(y))
+        loss.backward()
+        trainer.step(n)
+    metric.update([mx.nd.array(y)], [net(mx.nd.array(x))])
+    assert metric.get()[1] > 0.6, metric.get()
