@@ -51,3 +51,6 @@ from . import profiler
 from . import contrib
 from . import numpy as np
 from . import numpy_extension as npx
+from . import visualization
+from . import visualization as viz
+from . import test_utils

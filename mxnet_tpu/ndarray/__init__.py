@@ -111,6 +111,24 @@ def _as_method(fn):
 _expose()
 
 # `_shuffle` is exposed as nd.random.shuffle in the reference
+from . import sparse                      # noqa: E402
+from .sparse import (CSRNDArray, RowSparseNDArray, csr_matrix,  # noqa: E402
+                     row_sparse_array)
+
+
+def _nd_tostype(self, stype):
+    """ref: NDArray.tostype — convert between storage types."""
+    if stype == "default":
+        return self
+    if stype == "csr":
+        return sparse.csr_matrix(self)
+    if stype == "row_sparse":
+        return sparse.row_sparse_array(self)
+    raise ValueError(f"unknown storage type {stype!r}")
+
+
+NDArray.tostype = _nd_tostype
+
 random.shuffle = getattr(_internal, "_shuffle")
 random.bernoulli = _make_wrapper("_random_bernoulli",
                                  _registry.get("_random_bernoulli"))

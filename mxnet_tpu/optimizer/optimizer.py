@@ -19,8 +19,9 @@ from ..base import MXNetError
 from .. import ndarray as nd
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "RMSProp",
-           "AdaGrad", "FTRL", "Signum", "SGLD", "Updater", "create",
-           "register", "get_updater"]
+           "AdaGrad", "FTRL", "Signum", "SGLD", "AdaDelta", "Nadam",
+           "DCASGD", "FTML", "Updater", "create", "register",
+           "get_updater"]
 
 _REGISTRY = {}
 
@@ -426,3 +427,143 @@ def _state_from_np(s):
 
 def get_updater(optimizer):
     return Updater(optimizer)
+
+
+@register
+class AdaDelta(Optimizer):
+    """ref: optimizer.py AdaDelta (no learning rate in the update)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight
+        acc_g, acc_delta = state
+        acc_g_new = self.rho * acc_g + (1.0 - self.rho) * g * g
+        delta = nd.sqrt(acc_delta + self.epsilon) / \
+            nd.sqrt(acc_g_new + self.epsilon) * g
+        acc_delta_new = self.rho * acc_delta + (1.0 - self.rho) * delta * delta
+        acc_g._rebind(acc_g_new._data)
+        acc_delta._rebind(acc_delta_new._data)
+        weight._rebind((weight - delta)._data)
+
+
+@register
+class Nadam(Optimizer):
+    """Adam with Nesterov momentum schedule (ref: optimizer.py Nadam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (
+            t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** (
+            (t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        mean, var = state
+        m_new = self.beta1 * mean + (1.0 - self.beta1) * g
+        v_new = self.beta2 * var + (1.0 - self.beta2) * g * g
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = m_new / (1.0 - m_schedule_next)
+        v_prime = v_new / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        mean._rebind(m_new._data)
+        var._rebind(v_new._data)
+        weight._rebind((weight - lr * m_bar /
+                        (nd.sqrt(v_prime) + self.epsilon))._data)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (ref: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = None
+        if self.momentum != 0.0:
+            mom = nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.ctx)
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        comp = g + wd * weight + self.lamda * g * g * (weight - prev)
+        if mom is None:
+            step = -lr * comp
+        else:
+            mom._rebind((self.momentum * mom - lr * comp)._data)
+            step = mom
+        prev._rebind(weight._data)
+        weight._rebind((weight + step)._data)
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (ref: optimizer.py FTML / ftml_update)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return tuple(nd.zeros(weight.shape, dtype=weight.dtype,
+                              ctx=weight.ctx) for _ in range(3))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight
+        d, v, z = state
+        v_new = self.beta2 * v + (1.0 - self.beta2) * g * g
+        d_new = (1.0 - self.beta1 ** t) / lr * (
+            nd.sqrt(v_new / (1.0 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_new - self.beta1 * d
+        z_new = self.beta1 * z + (1.0 - self.beta1) * g - sigma * weight
+        v._rebind(v_new._data)
+        d._rebind(d_new._data)
+        z._rebind(z_new._data)
+        weight._rebind((-z_new / d_new)._data)

@@ -1,0 +1,59 @@
+"""Sparse storage + visualization tests (ref: tests/python/unittest/
+test_sparse_ndarray.py shrunk to the supported surface)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def test_csr_roundtrip_and_dot():
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], dtype=np.float32)
+    csr = mx.nd.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_array_equal(csr.asnumpy(), dense)
+    back = csr.tostype("default")
+    np.testing.assert_array_equal(back.asnumpy(), dense)
+    rhs = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out = csr.dot(mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-6)
+
+
+def test_csr_from_tuple():
+    csr = mx.nd.csr_matrix((np.array([1.0, 2.0]), np.array([1, 0]),
+                            np.array([0, 1, 2])), shape=(2, 3))
+    want = np.array([[0, 1, 0], [2, 0, 0]], dtype=np.float32)
+    np.testing.assert_array_equal(csr.asnumpy(), want)
+
+
+def test_row_sparse_roundtrip_retain():
+    dense = np.zeros((5, 3), dtype=np.float32)
+    dense[1] = 1.0
+    dense[3] = 2.0
+    rs = mx.nd.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert list(rs.indices) == [1, 3]
+    np.testing.assert_array_equal(rs.asnumpy(), dense)
+    kept = rs.retain([3])
+    assert list(kept.indices) == [3]
+    np.testing.assert_array_equal(kept.asnumpy()[3], dense[3])
+
+
+def test_ndarray_tostype():
+    x = mx.nd.array([[1.0, 0.0], [0.0, 2.0]])
+    csr = x.tostype("csr")
+    assert csr.stype == "csr"
+    assert x.tostype("default") is x
+
+
+def test_print_summary_and_plot(capsys):
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    out = sym.SoftmaxOutput(fc1, name="softmax")
+    total = mx.viz.print_summary(out, shape={"data": (2, 4),
+                                             "softmax_label": (2,)})
+    captured = capsys.readouterr().out
+    assert "fc1" in captured
+    assert total == 8 * 4 + 8          # weight + bias
+    dot = mx.viz.plot_network(out)
+    assert "fc1" in dot.source and "digraph" in dot.source
