@@ -21,6 +21,22 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# Multi-host: when launched by tools/launch.py (MXTPU_* env protocol), the
+# coordination service must be joined BEFORE any jax backend touch — do it
+# at package import, the earliest point we control (the kvstore would be
+# too late: importing this package already initializes devices).
+import os as _os
+
+if _os.environ.get("MXTPU_COORD_ADDR"):
+    import jax as _jax
+    try:
+        _jax.distributed.initialize(
+            coordinator_address=_os.environ["MXTPU_COORD_ADDR"],
+            num_processes=int(_os.environ["MXTPU_NUM_PROC"]),
+            process_id=int(_os.environ["MXTPU_PROC_ID"]))
+    except RuntimeError:
+        pass          # already joined (re-import / interactive)
+
 from .base import MXNetError
 from .context import (Context, cpu, cpu_pinned, cpu_shared, current_context,
                       gpu, gpu_memory_info, num_gpus, num_tpus, tpu)

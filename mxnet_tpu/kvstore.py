@@ -49,10 +49,13 @@ def _ensure_distributed():
     if not addr:
         return
     import jax
-    jax.distributed.initialize(
-        coordinator_address=addr,
-        num_processes=int(os.environ["MXTPU_NUM_PROC"]),
-        process_id=int(os.environ["MXTPU_PROC_ID"]))
+    try:
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(os.environ["MXTPU_NUM_PROC"]),
+            process_id=int(os.environ["MXTPU_PROC_ID"]))
+    except RuntimeError:
+        pass       # already joined at package import (mxnet_tpu/__init__)
     _dist_initialized = True
 
 

@@ -1,0 +1,53 @@
+"""Multi-process dist kvstore test (ref: tests/nightly/
+dist_sync_kvstore.py run via `tools/launch.py -n 2 --launcher local`):
+worker processes join through the JAX coordination service and verify
+push/pull aggregates across processes."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_sync")
+rank = kv.rank
+n = kv.num_workers
+assert n == 2, n
+
+val = mx.nd.ones((4,)) * (rank + 1)     # worker 0: 1s, worker 1: 2s
+kv.init(3, mx.nd.zeros((4,)))
+kv.push(3, val)
+out = mx.nd.zeros((4,))
+kv.pull(3, out=out)
+expect = np.full(4, 3.0)                 # 1 + 2 summed across workers
+np.testing.assert_allclose(out.asnumpy(), expect)
+print(f"rank {rank} OK")
+"""
+
+
+def test_dist_sync_kvstore_two_processes(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER % {"repo": REPO})
+    env = dict(os.environ)
+    # clean slate: the TPU-tunnel site hook must not claim the chip in
+    # both workers
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "-p", "9233",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=280, env=env)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "rank 0 OK" in r.stdout
+    assert "rank 1 OK" in r.stdout
