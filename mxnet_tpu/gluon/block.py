@@ -327,7 +327,14 @@ class HybridBlock(Block):
             param._finish_deferred_init()
 
     def forward(self, *args, **kwargs):
-        """Gather this block's registered params and run ``hybrid_forward``."""
+        """Gather this block's registered params and run ``hybrid_forward``.
+        Symbol inputs trace symbolically (F = mx.sym, params become
+        variables) — the reference's dual-world dispatch."""
+        from .. import symbol as sym_mod
+        if args and isinstance(args[0], sym_mod.Symbol):
+            params = {name: p.var()
+                      for name, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_mod, *args, **kwargs, **params)
         if self._deferred_pending():
             self._finish_deferred(*args)
         ctx = None
@@ -349,6 +356,11 @@ class HybridBlock(Block):
 
     # -- the CachedOp equivalent ---------------------------------------------
     def __call__(self, *args, **kwargs):
+        from .. import symbol as sym_mod
+        if args and isinstance(args[0], sym_mod.Symbol):
+            return super().__call__(*args, **kwargs)   # symbolic trace
+        if args:
+            self._num_inputs = len(args)
         if self._active and not _rng.in_trace():
             return self._call_cached(*args)
         return super().__call__(*args, **kwargs)
@@ -473,36 +485,45 @@ class HybridBlock(Block):
 
     # -- deployment (ref: HybridBlock.export → -symbol.json + .params) -------
     def export(self, path, epoch=0, remove_amp_cast=True):
-        """Serialize for deployment: ``path-symbol.json`` holds the traced
-        program (jaxpr text + signature); ``path-%04d.params`` the weights."""
-        if not self._cached_fns and self._out_treedef is None:
-            raise MXNetError("export() requires the block to have been "
-                             "hybridized and run at least once")
+        """Serialize for deployment: trace the block symbolically into a
+        real ``path-symbol.json`` graph (loadable by SymbolBlock.imports /
+        mx.sym.load — the reference's deployment contract, SURVEY §3.5) +
+        ``path-%04d.params`` weights with arg:/aux: keys."""
+        from .. import symbol as sym_mod
+        n = getattr(self, "_num_inputs", 1)
+        names = ["data"] if n == 1 else [f"data{i}" for i in range(n)]
+        out = self(*[sym_mod.var(nm) for nm in names])
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        out.save(f"{path}-symbol.json")
         params = {}
         for name, param in self.collect_params().items():
             params[("arg:" if param.grad_req != "null" else "aux:") + name] = \
                 param.data(param.list_ctx()[0])
         nd.save(f"{path}-{epoch:04d}.params", params)
-        graph = {
-            "format": "mxnet_tpu-jaxpr-v1",
-            "block": self.__class__.__name__,
-            "prefix": self.prefix,
-            "params": [p.name for p in self.collect_params().values()],
-        }
-        with open(f"{path}-symbol.json", "w") as f:
-            json.dump(graph, f, indent=2)
         return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
 
 
 class SymbolBlock(HybridBlock):
-    """Imports a serialized symbolic checkpoint (ref: gluon SymbolBlock).
-    Full symbol-graph import lands with mxnet_tpu.symbol; constructing one
-    directly from a Symbol is supported there."""
+    """Runs a loaded Symbol graph as a Gluon block (ref: gluon
+    SymbolBlock): the deployment path for ``HybridBlock.export`` /
+    ``mx.model.save_checkpoint`` artifacts."""
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=params)
+        if isinstance(outputs, (list, tuple)):
+            from .. import symbol as sym_mod
+            outputs = sym_mod.Group(list(outputs))
         self._outputs = outputs
         self._inputs = inputs
+        input_names = {s.name for s in inputs}
+        aux = set(outputs.list_auxiliary_states())
+        for name in (outputs.list_arguments()
+                     + outputs.list_auxiliary_states()):
+            if name in input_names or name in self._params:
+                continue
+            self.params.get(name, grad_req="null" if name in aux
+                            else "write", allow_deferred_init=True)
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
@@ -513,7 +534,9 @@ class SymbolBlock(HybridBlock):
         inputs = [sym_mod.var(n) for n in input_names]
         block = SymbolBlock(symbol, inputs)
         if param_file:
-            block.collect_params().load(param_file, ctx=ctx)
+            block.collect_params().load(param_file, ctx=ctx,
+                                        allow_missing=False,
+                                        ignore_extra=True)
         return block
 
     def forward(self, *args):

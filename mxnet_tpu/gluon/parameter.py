@@ -268,8 +268,12 @@ class Parameter:
             self._init_grad()
 
     def var(self):
-        raise MXNetError("Parameter.var(): symbolic variables are created via "
-                         "mxnet_tpu.symbol; not needed in hybridize paths")
+        """A symbolic variable bound to this parameter (ref: Parameter.var —
+        used when tracing a block into a Symbol graph for export)."""
+        from .. import symbol as sym_mod
+        return sym_mod.var(self.name,
+                           shape=self.shape if not self._shape_incomplete()
+                           else None)
 
 
 class Constant(Parameter):
@@ -405,6 +409,10 @@ class ParameterDict:
         loaded = nd.load(filename)
         if not isinstance(loaded, dict):
             raise MXNetError(f"{filename} does not contain a name→array dict")
+        # strip arg:/aux: prefixes from export/save_checkpoint artifacts
+        # (ref: ParameterDict.load does the same)
+        loaded = {(k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                   else k): v for k, v in loaded.items()}
         if restore_prefix:
             loaded = {restore_prefix + k: v for k, v in loaded.items()}
         for name, param in self.items():

@@ -227,6 +227,19 @@ class Symbol:
                 outs.append(Symbol(node, i))
         return Group(outs)
 
+    def __iter__(self):
+        """Iterate over this node's outputs (lets ``a, b = F.split(...)``
+        style unpacking work identically to the nd namespace)."""
+        if self._node.op == "_group":
+            return iter(self._node.inputs)
+        return (Symbol(self._node, i)
+                for i in range(self._node.num_outputs))
+
+    def __len__(self):
+        if self._node.op == "_group":
+            return len(self._node.inputs)
+        return self._node.num_outputs
+
     def __getitem__(self, index):
         if isinstance(index, str):
             for i, name in enumerate(self.list_outputs()):

@@ -1,0 +1,82 @@
+"""Deployment round trip (ref: gluon/block.py export + SymbolBlock.imports,
+SURVEY §3.5): gluon model → -symbol.json + .params → SymbolBlock → same
+outputs."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.BatchNorm())
+        net.add(gluon.nn.Dense(4))
+    return net
+
+
+def test_export_import_roundtrip(tmp_path):
+    net = _mlp()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.random.normal(shape=(3, 8))
+    want = net(x).asnumpy()
+
+    prefix = str(tmp_path / "model")
+    sym_file, param_file = net.export(prefix, epoch=7)
+    assert sym_file.endswith("-symbol.json")
+    assert param_file.endswith("-0007.params")
+
+    block = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    got = block(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_export_conv_roundtrip(tmp_path):
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"))
+        net.add(gluon.nn.MaxPool2D(2))
+        net.add(gluon.nn.Flatten())
+        net.add(gluon.nn.Dense(5))
+    net.initialize()
+    x = mx.nd.random.normal(shape=(2, 3, 8, 8))
+    want = net(x).asnumpy()
+    prefix = str(tmp_path / "conv")
+    sym_file, param_file = net.export(prefix)
+    block = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    np.testing.assert_allclose(block(x).asnumpy(), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_exported_symbol_loadable_by_sym_api(tmp_path):
+    """The exported graph is a plain mx.sym graph (deployment parity with
+    the C predict API consumers)."""
+    from mxnet_tpu import sym
+    net = _mlp()
+    net.initialize()
+    x = mx.nd.random.normal(shape=(2, 6))
+    net(x)
+    prefix = str(tmp_path / "m")
+    sym_file, _ = net.export(prefix)
+    graph = sym.load(sym_file)
+    args = graph.list_arguments()
+    assert "data" in args
+    assert any(a.endswith("weight") for a in args)
+    # moving stats are aux, not args
+    aux = graph.list_auxiliary_states()
+    assert any("running_mean" in a for a in aux)
+
+
+def test_resnet_export_roundtrip(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize()
+    x = mx.nd.random.normal(shape=(1, 3, 32, 32))
+    want = net(x).asnumpy()
+    prefix = str(tmp_path / "rn")
+    sym_file, param_file = net.export(prefix)
+    block = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    np.testing.assert_allclose(block(x).asnumpy(), want, rtol=1e-4,
+                               atol=1e-5)
