@@ -342,6 +342,8 @@ class ShardedTrainer:
             rep, None,
         )
         donate = (0, 2) if self._donate else ()
+        self._raw_step = step
+        self._shardings = (in_shardings, out_shardings, donate)
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=donate)
 
@@ -375,6 +377,62 @@ class ShardedTrainer:
         self._states = new_states
         self.last_outputs = [nd.NDArray(o, _skip_device_put=True)
                              for o in outs]
+        return nd.NDArray(loss_val, _skip_device_put=True)
+
+    def run_steps(self, *batch, num_steps=8):
+        """Run ``num_steps`` train steps as ONE compiled program
+        (``lax.scan`` over the step body). Amortizes host-dispatch latency
+        — the TPU analog of the reference's engine keeping a deep async
+        queue ahead of the Python loop (SURVEY §3.2: "the loop
+        synchronizes only at metric.update"). The batch is reused each
+        inner step; returns the last step's loss."""
+        args = batch[:-1]
+        self._prepare(args)
+        if self._step_fn is None:
+            self._step_fn = self._build_step(len(args))
+        key = f"multi{num_steps}"
+        if not hasattr(self, "_multi_fns"):
+            self._multi_fns = {}
+        if key not in self._multi_fns:
+            raw = self._raw_step
+            in_sh, out_sh, donate = self._shardings
+
+            def multi(tr, aux, states, rng, lr, t, rescale, *b):
+                def body(carry, i):
+                    tr_, aux_, states_, t_ = carry
+                    k = jax.random.fold_in(rng, i)
+                    ntr, naux, nst, loss, _ = raw(tr_, aux_, states_, k,
+                                                  lr, t_, rescale, *b)
+                    return (ntr, naux, nst, t_ + 1.0), loss
+
+                (tr, aux, states, _), losses = jax.lax.scan(
+                    body, (tr, aux, states, t), jnp.arange(num_steps))
+                return tr, aux, states, losses[-1]
+
+            self._multi_fns[key] = jax.jit(
+                multi, in_shardings=in_sh,
+                out_shardings=out_sh[:3] + (out_sh[3],),
+                donate_argnums=donate)
+        batch_datas = [self._shard_batch_arg(b) for b in batch]
+        t = self._num_update + 1
+        self._num_update += num_steps
+        self._optimizer.num_update = self._num_update
+        lr = self._optimizer.learning_rate
+        if self._optimizer.lr_scheduler is not None:
+            lr = self._optimizer.lr_scheduler(t)
+        tr = [p._data[0]._data for p in self._trainable]
+        aux = [p._data[0]._data for p in self._aux]
+        from .mesh import use_mesh
+        with use_mesh(self.mesh):
+            new_tr, aux_new, new_states, loss_val = self._multi_fns[key](
+                tr, aux, self._states, _rng.next_key(), jnp.float32(lr),
+                jnp.float32(t),
+                jnp.float32(self._optimizer.rescale_grad), *batch_datas)
+        for p, w in zip(self._trainable, new_tr):
+            p._data[0]._rebind(w)
+        for p, a in zip(self._aux, aux_new):
+            p._data[0]._rebind(a)
+        self._states = new_states
         return nd.NDArray(loss_val, _skip_device_put=True)
 
     def evaluate(self, *batch):

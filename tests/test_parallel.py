@@ -136,3 +136,38 @@ def test_graft_entry_dryrun():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.dryrun_multichip(8)
+
+
+def test_run_steps_matches_single_steps():
+    """run_steps (lax.scan fused multi-step) must be bit-equal to N single
+    steps for a deterministic model."""
+    def make():
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Dense(16, activation="tanh", in_units=8))
+            net.add(gluon.nn.Dense(4, in_units=16))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    x = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, (16,))
+    mx.random.seed(3)
+    a = make()
+    mx.random.seed(3)
+    b = make()
+    mesh = parallel.make_mesh({"data": 8})
+    ta = parallel.ShardedTrainer(a, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 "sgd", {"learning_rate": 0.1,
+                                         "momentum": 0.9}, mesh=mesh)
+    tb = parallel.ShardedTrainer(b, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 "sgd", {"learning_rate": 0.1,
+                                         "momentum": 0.9}, mesh=mesh)
+    for _ in range(6):
+        la = ta.step(x, y)
+    lb = tb.run_steps(x, y, num_steps=6)
+    assert abs(la.asscalar() - lb.asscalar()) < 1e-6
+    for pa, pb in zip(a.collect_params().values(),
+                      b.collect_params().values()):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(), rtol=1e-5,
+                                   atol=1e-6)
