@@ -135,6 +135,10 @@ class KVStore:
             agg = vlist[0]
             for v in vlist[1:]:
                 agg = agg + v.as_in_context(agg.ctx)
+            if self._compression is not None:
+                agg = nd.NDArray(
+                    self._compression.compress(k, agg._data),
+                    ctx=agg.ctx, _skip_device_put=True)
             agg = self._allreduce_dcn(agg)
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
@@ -180,11 +184,11 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params):
-        ctype = compression_params.get("type", "2bit")
-        raise MXNetError(
-            f"gradient compression {ctype!r} is not implemented on the TPU "
-            f"build yet (reference: src/kvstore/gradient_compression.cc); "
-            f"XLA int8 collective experiments are planned")
+        """ref: kv.set_gradient_compression({'type': '2bit',
+        'threshold': t}) — 2-bit quantization + error feedback around the
+        cross-worker reduce."""
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression(**compression_params)
 
     # -- multi-host ----------------------------------------------------------
     def _allreduce_dcn(self, arr):

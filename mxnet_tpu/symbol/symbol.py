@@ -281,7 +281,8 @@ class Symbol:
                     op = _registry.get(node.op)
                     arrays = [compute(s._node)[s._index]
                               for s in node.inputs]
-                    kwargs = dict(node.attrs)
+                    kwargs = {k: v for k, v in node.attrs.items()
+                              if not k.startswith("__")}
                     if op.needs_rng:
                         kwargs["rng"] = _rng.next_key()
                     if op.needs_mode:
@@ -369,7 +370,8 @@ class Symbol:
                     return None
                 in_shapes.append(r[s._index])
             op = _registry.get(node.op)
-            kwargs2 = dict(node.attrs)
+            kwargs2 = {k: v for k, v in node.attrs.items()
+                       if not k.startswith("__")}
             if op.needs_rng:
                 kwargs2["rng"] = jax.ShapeDtypeStruct((2,), np.uint32)
             if op.needs_mode:
@@ -569,10 +571,19 @@ def _num_outputs_of(op, attrs):
 
 def _create(opname, input_syms, kwargs, name=None):
     """Create an op node (the generated mx.sym.<op> wrappers call this)."""
+    from .. import attribute as _attr_mod
+    from .. import name as _name_mod
     op = _registry.get(opname)
     attrs = op.coerce_params(kwargs)
     hint = opname.lower().lstrip("_")
+    scoped = _name_mod.current()
+    if name is None and type(scoped) is not _name_mod.NameManager:
+        name = scoped.get(None, hint)       # Prefix or custom manager
     name = name or _NameManager.next_name(hint)
+    # scoped attrs (ctx_group & friends, ref: AttrScope.get)
+    scope_attrs = _attr_mod.current().get()
+    for k, v in scope_attrs.items():
+        attrs.setdefault(f"__{k}__" if not k.startswith("__") else k, v)
     # auto-create missing parameter variables with reference naming
     names, n_aux = _OP_INPUTS.get(opname, (None, 0))
     if names is not None:
@@ -629,7 +640,11 @@ def load_json(json_str):
                          num_outputs=len(inputs))
         else:
             op = _registry.get(entry["op"])
-            attrs = op.coerce_params(entry.get("attrs", {}))
+            raw = entry.get("attrs", {})
+            extra = {k: v for k, v in raw.items() if k.startswith("__")}
+            attrs = op.coerce_params({k: v for k, v in raw.items()
+                                      if not k.startswith("__")})
+            attrs.update(extra)
             node = _Node(entry["op"], entry["name"], inputs, attrs,
                          num_outputs=_num_outputs_of(op, attrs))
         built.append(node)

@@ -1,0 +1,97 @@
+"""Misc parity: AttrScope, NameManager/Prefix, gradient compression,
+BucketingModule+RNN bucketing end-to-end (Sockeye path, SURVEY §3.3)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, sym
+from mxnet_tpu.attribute import AttrScope
+from mxnet_tpu.name import Prefix
+
+
+def test_attr_scope_attaches():
+    with AttrScope(ctx_group="dev1", mood="testy"):
+        a = sym.var("a")
+        fc = sym.FullyConnected(a, num_hidden=4, name="fc")
+    assert fc.attr("__ctx_group__") == "dev1"
+    fc2 = sym.FullyConnected(sym.var("b"), num_hidden=4, name="fc2")
+    assert fc2.attr("__ctx_group__") is None
+
+
+def test_attr_scope_still_evaluates():
+    with AttrScope(ctx_group="dev1"):
+        a = sym.var("a")
+        out = sym.FullyConnected(a, num_hidden=3, name="fq")
+    exe = out.simple_bind(a=(2, 5))
+    exe.forward()          # scoped attr must not leak into op kwargs
+
+
+def test_name_prefix_scope():
+    with Prefix("mynet_"):
+        a = sym.var("x")
+        fc = sym.FullyConnected(a, num_hidden=2)
+    assert fc._node.name.startswith("mynet_")
+
+
+def test_gradient_compression_2bit():
+    from mxnet_tpu.gradient_compression import GradientCompression
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    g = mx.nd.array([0.9, -0.7, 0.1, -0.2])._data
+    q1 = np.asarray(gc.compress("k", g))
+    np.testing.assert_allclose(q1, [0.5, -0.5, 0.0, 0.0])
+    # error feedback: residual [0.4, -0.2, 0.1, -0.2] adds to next grad
+    q2 = np.asarray(gc.compress("k", g))
+    np.testing.assert_allclose(q2, [0.5, -0.5, 0.0, 0.0])
+    # accumulated residual eventually pushes small values over threshold
+    q3 = np.asarray(gc.compress("k", g))
+    assert q3[2] == 0.0 and q3[3] == -0.5
+
+
+def test_kvstore_compression_path():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, mx.nd.zeros((3,)))
+    kv.push(0, mx.nd.array([1.0, 0.2, -0.9]))
+    out = mx.nd.zeros((3,))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, -0.5])
+
+
+def test_bucketing_module_rnn_shared_params():
+    """The Sockeye-style path: per-seq-len buckets over one fused RNN,
+    parameters shared across buckets (SURVEY §3.3 switch_bucket)."""
+    H, V = 8, 20
+
+    def sym_gen(seq_len):
+        data = sym.var("data")                       # (T, N)
+        embed = sym.Embedding(data, input_dim=V, output_dim=H,
+                              name="embed")
+        rnn = sym.RNN(embed, state_size=H, num_layers=1, mode="lstm",
+                      name="lstm")
+        last = sym.SequenceLast(rnn)
+        fc = sym.FullyConnected(last, num_hidden=V, name="fc")
+        return sym.SoftmaxOutput(fc, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10)
+
+    def batch(T):
+        return io.DataBatch(
+            data=[mx.nd.array(np.random.randint(0, V, (T, 4)))],
+            label=[mx.nd.zeros((4,))], bucket_key=T,
+            provide_data=[io.DataDesc("data", (T, 4))],
+            provide_label=[io.DataDesc("softmax_label", (4,))])
+
+    mod.bind(batch(10).provide_data, batch(10).provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for T in (10, 5, 10, 7):
+        b = batch(T)
+        mod.forward_backward(b)
+        mod.update()
+    # all buckets must share the SAME weight arrays (reference contract)
+    default = mod._buckets[10]
+    for key, m in mod._buckets.items():
+        assert m._exec.arg_dict["lstm_parameters"] is \
+            default._exec.arg_dict["lstm_parameters"], key
