@@ -1,0 +1,76 @@
+"""Numeric-gradient sweep over representative ops — the reference's
+primary per-op test method (ref: tests/python/unittest/test_operator.py's
+check_numeric_gradient usage, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _rand(*shape, scale=1.0, seed=0):
+    return (np.random.RandomState(seed).randn(*shape) * scale) \
+        .astype(np.float32)
+
+
+CASES = {
+    "fully_connected": (
+        lambda x, w, b: mx.nd.FullyConnected(x, w, b, num_hidden=3),
+        [_rand(2, 4), _rand(3, 4), _rand(3)]),
+    "convolution": (
+        lambda x, w, b: mx.nd.Convolution(x, w, b, kernel=(3, 3),
+                                          num_filter=2, pad=(1, 1)),
+        [_rand(1, 2, 5, 5), _rand(2, 2, 3, 3), _rand(2)]),
+    "softmax": (lambda x: mx.nd.softmax(x, axis=-1), [_rand(3, 5)]),
+    "log_softmax": (lambda x: mx.nd.log_softmax(x, axis=-1),
+                    [_rand(3, 5)]),
+    "tanh": (lambda x: mx.nd.tanh(x), [_rand(3, 4)]),
+    "sigmoid": (lambda x: mx.nd.sigmoid(x), [_rand(3, 4)]),
+    "exp": (lambda x: mx.nd.exp(x), [_rand(3, 4, scale=0.5)]),
+    "layer_norm": (
+        lambda x, g, b: mx.nd.LayerNorm(x, g, b, axis=-1),
+        [_rand(3, 6), _rand(6, scale=0.5, seed=1) + 1.0, _rand(6, seed=2)]),
+    "pooling_avg": (
+        lambda x: mx.nd.Pooling(x, pool_type="avg", kernel=(2, 2),
+                                stride=(2, 2)),
+        [_rand(1, 2, 4, 4)]),
+    "broadcast_mul": (lambda a, b: mx.nd.broadcast_mul(a, b),
+                      [_rand(3, 4), _rand(1, 4, seed=3)]),
+    "dot": (lambda a, b: mx.nd.dot(a, b), [_rand(3, 4), _rand(4, 2)]),
+    "batch_dot": (lambda a, b: mx.nd.batch_dot(a, b),
+                  [_rand(2, 3, 4), _rand(2, 4, 2)]),
+    "embedding": (
+        lambda w: mx.nd.Embedding(mx.nd.array([[0, 2], [1, 3]]), w,
+                                  input_dim=4, output_dim=3),
+        [_rand(4, 3)]),
+    "concat": (lambda a, b: mx.nd.concat(a, b, dim=1),
+               [_rand(2, 3), _rand(2, 4, seed=4)]),
+    "transpose": (lambda x: mx.nd.transpose(x, axes=(1, 0)),
+                  [_rand(3, 4)]),
+    "sum_axis": (lambda x: mx.nd.sum(x, axis=1), [_rand(3, 4)]),
+    "mean": (lambda x: mx.nd.mean(x, axis=0), [_rand(3, 4)]),
+    "smooth_l1": (lambda x: mx.nd.smooth_l1(x, scalar=1.0),
+                  [_rand(3, 4, scale=2.0)]),
+    "slice": (lambda x: mx.nd.slice(x, begin=(1, 0), end=(3, 2)),
+              [_rand(4, 3)]),
+    "reshape": (lambda x: mx.nd.reshape(x, (6, 2)), [_rand(3, 4)]),
+    "leaky_relu": (lambda x: mx.nd.LeakyReLU(x, act_type="leaky",
+                                             slope=0.25),
+                   [_rand(3, 4) + 0.05]),
+    "gelu_npx": (lambda x: mx.npx.gelu(x), [_rand(3, 4)]),
+    "where": (lambda a, b: mx.nd.where(
+        mx.nd.array([[1, 0], [0, 1]]), a, b),
+        [_rand(2, 2), _rand(2, 2, seed=5)]),
+    "batchnorm_inference": (
+        lambda x, g, b: mx.nd.BatchNorm(
+            x, g, b, mx.nd.zeros((3,)), mx.nd.ones((3,)),
+            use_global_stats=True, fix_gamma=False)[0],
+        [_rand(2, 3, 4), _rand(3, seed=6) + 1.0, _rand(3, seed=7)]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_numeric_gradient(name):
+    fn, inputs = CASES[name]
+    check_numeric_gradient(fn, [mx.nd.array(x) for x in inputs],
+                           rtol=2e-2, atol=2e-3, eps=1e-3)
