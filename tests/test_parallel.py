@@ -171,3 +171,66 @@ def test_run_steps_matches_single_steps():
         np.testing.assert_allclose(pa.data().asnumpy(),
                                    pb.data().asnumpy(), rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_pipeline_parallel_matches_sequential():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import pipeline_apply
+    P_, D, B = 4, 8, 16
+    rng = np.random.RandomState(0)
+    Ws = jnp.asarray(rng.randn(P_, D, D).astype(np.float32) * 0.3)
+    bs = jnp.asarray(rng.randn(P_, D).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.randn(B, D).astype(np.float32))
+
+    def stage(params, h):
+        W, b = params
+        return jnp.tanh(h @ W + b)
+
+    mesh = parallel.make_mesh({"pipe": 4, "data": 2})
+    h = x
+    for i in range(P_):
+        h = stage((Ws[i], bs[i]), h)
+    got = pipeline_apply(stage, (Ws, bs), x, mesh=mesh,
+                         num_microbatches=8)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(got), atol=1e-6)
+
+    def loss_seq(Ws, bs):
+        h = x
+        for i in range(P_):
+            h = stage((Ws[i], bs[i]), h)
+        return jnp.sum(h ** 2)
+
+    def loss_pipe(Ws, bs):
+        return jnp.sum(pipeline_apply(stage, (Ws, bs), x, mesh=mesh,
+                                      num_microbatches=8) ** 2)
+
+    g1 = jax.grad(loss_seq, argnums=(0, 1))(Ws, bs)
+    g2 = jax.jit(jax.grad(loss_pipe, argnums=(0, 1)))(Ws, bs)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_moe_expert_parallel():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import moe_apply
+    E, D, B = 4, 6, 10
+    rng = np.random.RandomState(0)
+    Ws = jnp.asarray(rng.randn(E, D, D).astype(np.float32) * 0.4)
+    x = jnp.asarray(rng.randn(B, D).astype(np.float32))
+    gate = jnp.asarray(rng.randn(B, E).astype(np.float32))
+
+    def expert(W, h):
+        return jnp.tanh(h @ W)
+
+    mesh = parallel.make_mesh({"expert": 4, "data": 2})
+    got = moe_apply(expert, Ws, gate, x, mesh=mesh)
+    probs = jax.nn.softmax(gate, -1)
+    top = np.asarray(jnp.argmax(probs, -1))
+    want = np.stack([np.asarray(probs[i, top[i]])
+                     * np.asarray(expert(Ws[top[i]], x[i:i + 1])[0])
+                     for i in range(B)])
+    np.testing.assert_allclose(want, np.asarray(got), rtol=1e-5,
+                               atol=1e-6)
