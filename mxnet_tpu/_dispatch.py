@@ -72,14 +72,17 @@ def invoke(op, inputs: Sequence, kwargs: dict, out=None):
         outs = list(out_data) if isinstance(out_data, tuple) else [out_data]
         avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
         parents = []
-        for x in inputs:
+        fwd_inputs = []
+        for x, d in zip(inputs, datas):
             if isinstance(x, NDArray) and getattr(x, "_grad", None) is not None:
                 parents.append((None, 0, x))            # leaf
             elif isinstance(x, NDArray) and getattr(x, "_tape_node", None) is not None:
                 parents.append((x._tape_node, x._tape_out_idx, None))
             else:
                 parents.append((None, 0, None))         # constant
-        node = TapeNode(vjp_fn, parents, avals)
+            fwd_inputs.append(x if isinstance(x, NDArray) else d)
+        node = TapeNode(vjp_fn, parents, avals, fwd_fn=op.fn,
+                        fwd_kwargs=call_kwargs, fwd_inputs=fwd_inputs)
     else:
         out_data = op.fn(*datas, **call_kwargs)
         outs = list(out_data) if isinstance(out_data, tuple) else [out_data]

@@ -82,17 +82,28 @@ def is_training() -> bool:
 # tape
 # ---------------------------------------------------------------------------
 class TapeNode:
-    """One recorded op: holds the jax.vjp pullback and the graph wiring."""
-    __slots__ = ("vjp_fn", "parents", "out_avals", "n_outputs", "grad_buffers",
-                 "pending", "__weakref__")
+    """One recorded op: holds the jax.vjp pullback and the graph wiring.
 
-    def __init__(self, vjp_fn, parents, out_avals):
+    For higher-order gradients the node can also carry the forward recipe
+    (``fwd_fn``/``fwd_kwargs``/``fwd_inputs``): ``create_graph`` backward
+    re-derives the pullback from it under recording, so grad-of-grad sees
+    the full dependence on the primals (the stored ``vjp_fn`` closure holds
+    them as constants and is only used by the fast first-order path)."""
+    __slots__ = ("vjp_fn", "parents", "out_avals", "n_outputs", "grad_buffers",
+                 "pending", "fwd_fn", "fwd_kwargs", "fwd_inputs",
+                 "__weakref__")
+
+    def __init__(self, vjp_fn, parents, out_avals, fwd_fn=None,
+                 fwd_kwargs=None, fwd_inputs=None):
         self.vjp_fn = vjp_fn
         # parents[i] corresponds to the i-th primal input of the vjp:
         # each entry is (TapeNode | None, out_index, leaf_NDArray | None)
         self.parents = parents
         self.out_avals = out_avals      # list of jax.ShapeDtypeStruct
         self.n_outputs = len(out_avals)
+        self.fwd_fn = fwd_fn
+        self.fwd_kwargs = fwd_kwargs or {}
+        self.fwd_inputs = fwd_inputs    # list of NDArray | jax.Array
 
 
 def _zeros_for(aval):
@@ -239,14 +250,107 @@ def _deposit_leaf(leaf, g):
         leaf._grad._rebind(g)
 
 
+def _replay_vjp(node, ct_nds):
+    """Recompute the node's pullback from the forward recipe with BOTH
+    primals and cotangents as recorded inputs — the create_graph backward
+    step (differentiating through jax.vjp is jax-native)."""
+    from .numpy import _call
+    from .ndarray import NDArray
+    fn, kwargs = node.fwd_fn, node.fwd_kwargs
+    n_in = len(node.fwd_inputs)
+    n_out = node.n_outputs
+
+    def replay(*vals):
+        xs, cts = vals[:n_in], vals[n_in:]
+        _, vjp = jax.vjp(lambda *a: fn(*a, **kwargs), *xs)
+        res = tuple(vjp(tuple(cts) if n_out > 1 else cts[0]))
+        return res[0] if len(res) == 1 else res
+
+    out = _call(replay, *node.fwd_inputs, *ct_nds)
+    return out if isinstance(out, tuple) else (out,)
+
+
+def _backward_create_graph(heads, head_grads, leaf_filter):
+    """Tape walk with NDArray cotangents under recording → leaf grads that
+    are themselves differentiable (ref: Imperative::Backward with
+    create_graph=True)."""
+    from .ndarray import NDArray, zeros as nd_zeros
+
+    cotangents = {}
+    leaf_accum = {}
+    roots = []
+    for h, hg in zip(heads, head_grads):
+        node = getattr(h, "_tape_node", None)
+        seed = hg if hg is not None else \
+            NDArray(jax.numpy.ones(h.shape, h._data.dtype),
+                    _skip_device_put=True)
+        if node is None:
+            if getattr(h, "_grad", None) is not None:
+                _accum_leaf(leaf_accum, h, seed)
+            continue
+        roots.append(node)
+        ct = cotangents.setdefault(
+            id(node), [None] * node.n_outputs)
+        idx = h._tape_out_idx
+        ct[idx] = seed if ct[idx] is None else ct[idx] + seed
+    if not roots and not leaf_accum:
+        raise MXNetError("backward: no recorded graph reaches these heads")
+
+    order = _toposort(roots)
+    for node in reversed(order):
+        ct = cotangents.get(id(node))
+        if ct is None:
+            continue
+        if node.fwd_fn is None:
+            raise MXNetError(
+                "create_graph backward needs the forward recipe on every "
+                "tape node; this graph contains a node recorded without "
+                "one (custom Function?)")
+        ct_full = [c if c is not None else
+                   NDArray(jax.numpy.zeros(a.shape, a.dtype),
+                           _skip_device_put=True)
+                   for c, a in zip(ct, node.out_avals)]
+        in_cts = _replay_vjp(node, ct_full)
+        for (parent, out_idx, leaf), g in zip(node.parents, in_cts):
+            if not isinstance(g, NDArray):
+                continue
+            if leaf is not None:
+                if leaf_filter is None or id(leaf) in leaf_filter:
+                    _accum_leaf(leaf_accum, leaf, g)
+            elif parent is not None:
+                pct = cotangents.setdefault(
+                    id(parent), [None] * parent.n_outputs)
+                pct[out_idx] = g if pct[out_idx] is None else \
+                    pct[out_idx] + g
+    return leaf_accum
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
-    """ref: autograd.grad — returns grads instead of writing .grad."""
+    """ref: autograd.grad — returns grads instead of writing .grad.
+    ``create_graph=True`` returns differentiable gradients (higher-order
+    autograd via pullback replay)."""
     from .ndarray import NDArray
     if create_graph:
-        raise MXNetError("autograd.grad(create_graph=True) (higher-order) is "
-                         "not supported yet; use jax.grad composition via "
-                         "hybridized blocks instead")
+        if isinstance(heads, NDArray):
+            heads = [heads]
+        if head_grads is None:
+            head_grads = [None] * len(heads)
+        elif not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+        single = isinstance(variables, NDArray)
+        var_list = [variables] if single else list(variables)
+        with record(train_mode):
+            leaf_accum = _backward_create_graph(
+                heads, head_grads, {id(v) for v in var_list})
+        out = []
+        for v in var_list:
+            if id(v) in leaf_accum:
+                out.append(leaf_accum[id(v)][1])
+            else:
+                out.append(NDArray(jax.numpy.zeros(v.shape, v._data.dtype),
+                                   _skip_device_put=True))
+        return out[0] if single else out
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]

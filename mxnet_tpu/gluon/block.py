@@ -460,7 +460,11 @@ class HybridBlock(Block):
                 else:
                     parents.append((None, 0, None))
             avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_all]
-            node = autograd.TapeNode(vjp_fn, parents, avals)
+            fwd_inputs = [p._data[idx] for p in trainable] + [
+                a if isinstance(a, nd.NDArray) else d
+                for a, d in zip(args, in_datas)]
+            node = autograd.TapeNode(vjp_fn, parents, avals,
+                                     fwd_fn=wrapped, fwd_inputs=fwd_inputs)
         else:
             out_all = jitted(rng_key, tr_datas, aux_datas, *in_datas)
             node = None

@@ -81,7 +81,8 @@ def _call(fn, *args, **kwargs):
                 parents.append((a._tape_node, a._tape_out_idx, None))
             else:
                 parents.append((None, 0, None))
-        node = autograd.TapeNode(vjp_fn, parents, avals)
+        node = autograd.TapeNode(vjp_fn, parents, avals, fwd_fn=wrapped,
+                                 fwd_inputs=list(nd_inputs))
     else:
         out_data = fn(*datas, **kwargs)
         outs = list(out_data) if isinstance(out_data, tuple) else [out_data]
