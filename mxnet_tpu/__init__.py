@@ -70,3 +70,4 @@ from . import numpy_extension as npx
 from . import visualization
 from . import visualization as viz
 from . import test_utils
+from . import operator

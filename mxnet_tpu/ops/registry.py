@@ -83,6 +83,7 @@ class Operator:
     needs_rng: bool = False      # dispatch passes a PRNG key as `rng=` kwarg
                                  # (replaces the reference's ResourceRequest::kRandom)
     needs_mode: bool = False     # dispatch passes `training=` from autograd state
+    allow_unknown_params: bool = False   # Custom op forwards user kwargs
 
     def coerce_params(self, kwargs: dict) -> dict:
         spec = {p.name: p for p in self.params}
@@ -90,6 +91,8 @@ class Operator:
         for key, val in kwargs.items():
             if key in spec:
                 out[key] = spec[key].coerce(val)
+            elif self.allow_unknown_params:
+                out[key] = val
             else:
                 # tolerate unknown kwargs the way generated wrappers do not:
                 # raise, to catch typos early

@@ -1,0 +1,72 @@
+"""Custom Python operators (ref: tests/python/unittest/test_operator.py
+test_custom_op): numpy forward/backward via host callback, composing with
+autograd and jit."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.operator import CustomOp, CustomOpProp, register
+
+
+@register("scaled_square")
+class ScaledSquareProp(CustomOpProp):
+    def __init__(self, scale=1.0):
+        super().__init__(need_top_grad=True)
+        self._scale = float(scale)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        scale = self._scale
+
+        class _Op(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0],
+                            scale * in_data[0] ** 2)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0],
+                            2.0 * scale * in_data[0] * out_grad[0])
+        return _Op()
+
+
+def test_custom_forward():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    y = mx.nd.Custom(x, op_type="scaled_square", scale=2.0)
+    np.testing.assert_allclose(y.asnumpy(), [2.0, 8.0, 18.0])
+
+
+def test_custom_backward():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="scaled_square", scale=3.0)
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6.0 * np.array([1, 2, 3]))
+
+
+def test_custom_composes_with_ops():
+    x = mx.nd.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.tanh(mx.nd.Custom(x, op_type="scaled_square"))
+        loss = y.sum()
+    loss.backward()
+    want = (1 - np.tanh(np.array([0.25, 0.25])) ** 2) * 2 * \
+        np.array([0.5, -0.5])
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-5)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="no_such_op")
