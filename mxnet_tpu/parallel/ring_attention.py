@@ -34,7 +34,8 @@ try:                                    # jax>=0.8 top-level; older versions
 except ImportError:                     # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["blockwise_attention", "ring_attention", "attention_reference"]
+__all__ = ["blockwise_attention", "ring_attention",
+           "ulysses_attention", "attention_reference"]
 
 _NEG = -1e30
 
@@ -158,6 +159,48 @@ def ring_attention(q, k, v, mesh: Mesh = None, axis_name="seq",
     q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
     body = functools.partial(_ring_body, axis_name=axis_name, causal=causal,
                              scale=scale)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh = None, axis_name="seq",
+                      causal=False, scale=None, batch_axis="data"):
+    """Ulysses/DeepSpeed-style sequence parallelism: instead of rotating
+    K/V around the ring, one ``all_to_all`` re-shards [B,H,S,D] from
+    S-sharded to H-sharded, each device runs FULL attention over its head
+    slice, and a second all_to_all restores S-sharding. Preferable to ring
+    attention when heads ≥ shards and the sequence fits per-device memory
+    (2 collectives total vs P-1 permutes). SURVEY §5.7 names this as the
+    alternative design; net-new vs the reference."""
+    from .mesh import current_mesh
+    mesh = mesh or current_mesh()
+    if axis_name not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {axis_name!r}")
+    p = mesh.shape[axis_name]
+    if q.shape[1] % p:
+        raise MXNetError(f"num_heads {q.shape[1]} must be divisible by the "
+                         f"{axis_name} axis size {p}")
+    d = q.shape[-1]
+    scale = scale if scale is not None else float(1.0 / (d ** 0.5))
+    b_ax = batch_axis if batch_axis in mesh.axis_names else None
+    spec = P(b_ax, None, axis_name, None)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+
+    def body(q_l, k_l, v_l):
+        # local: [b, H, S/p, d] → all_to_all → [b, H/p, S, d]
+        def scatter(x):
+            return lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+        def gather(x):
+            return lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+        qh, kh, vh = scatter(q_l), scatter(k_l), scatter(v_l)
+        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+        return gather(out)
+
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec)
     return fn(q, k, v)
