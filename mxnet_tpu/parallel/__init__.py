@@ -8,7 +8,7 @@ from .mesh import (Mesh, NamedSharding, PartitionSpec, current_mesh,
 from .moe import moe_apply
 from .pipeline import pipeline_apply
 from .ring_attention import (attention_reference, blockwise_attention,
-                             ring_attention)
+                             ring_attention, ulysses_attention)
 from .sharded import (ShardedTrainer, allreduce_across_processes,
                       functional_apply)
 
@@ -16,4 +16,5 @@ __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "current_mesh",
            "data_parallel_spec", "default_mesh", "make_mesh", "replicated",
            "use_mesh", "ShardedTrainer", "allreduce_across_processes",
            "functional_apply", "ring_attention", "blockwise_attention",
-           "attention_reference", "pipeline_apply", "moe_apply"]
+           "ulysses_attention", "attention_reference", "pipeline_apply",
+           "moe_apply"]

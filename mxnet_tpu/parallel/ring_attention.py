@@ -84,9 +84,13 @@ def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None):
     vb = jnp.moveaxis(v.reshape(v.shape[:-2] + (n_blocks, block_size, d)),
                       -3, 0)
     s_q = q.shape[-2]
-    o0 = jnp.zeros(q.shape[:-1] + (d,), jnp.float32)
-    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
-    m0 = jnp.full(q.shape[:-1], _NEG, jnp.float32)
+    # derive accumulators from q so their device-varying type matches under
+    # shard_map (a plain zeros constant is 'unvarying' and scan rejects the
+    # carry mismatch)
+    zero_like_q = (q * 0).astype(jnp.float32)
+    o0 = zero_like_q
+    l0 = zero_like_q[..., 0]
+    m0 = zero_like_q[..., 0] + _NEG
     q_pos = jnp.arange(s_q)
 
     @jax.checkpoint
@@ -181,6 +185,9 @@ def ulysses_attention(q, k, v, mesh: Mesh = None, axis_name="seq",
     if q.shape[1] % p:
         raise MXNetError(f"num_heads {q.shape[1]} must be divisible by the "
                          f"{axis_name} axis size {p}")
+    if q.shape[-2] % p:
+        raise MXNetError(f"sequence length {q.shape[-2]} must be divisible "
+                         f"by the {axis_name} axis size {p}")
     d = q.shape[-1]
     scale = scale if scale is not None else float(1.0 / (d ** 0.5))
     b_ax = batch_axis if batch_axis in mesh.axis_names else None
@@ -198,7 +205,9 @@ def ulysses_attention(q, k, v, mesh: Mesh = None, axis_name="seq",
             return lax.all_to_all(x, axis_name, split_axis=2,
                                   concat_axis=1, tiled=True)
         qh, kh, vh = scatter(q_l), scatter(k_l), scatter(v_l)
-        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+        # blockwise kernel keeps per-device memory O(block) not O(S^2) —
+        # the long-context point of sequence parallelism
+        out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale)
         return gather(out)
 
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
