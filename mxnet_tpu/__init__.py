@@ -71,3 +71,7 @@ from . import visualization
 from . import visualization as viz
 from . import test_utils
 from . import operator
+from . import runtime
+from . import attribute
+from .attribute import AttrScope
+from . import name

@@ -163,3 +163,106 @@ class RandomFlipTopBottom(Block):
         if np.random.rand() < 0.5:
             return nd.array(x.asnumpy()[::-1].copy())
         return x
+
+
+class RandomBrightness(Block):
+    """ref: transforms.py RandomBrightness — scale by U[max(0,1-b), 1+b]."""
+
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        f = np.random.uniform(max(0, 1 - self._b), 1 + self._b)
+        return (x.astype("float32") * f)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        f = np.random.uniform(max(0, 1 - self._c), 1 + self._c)
+        x = x.astype("float32")
+        arr = x.asnumpy()
+        gray = arr.mean()
+        return nd.array(gray + (arr - gray) * f)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        f = np.random.uniform(max(0, 1 - self._s), 1 + self._s)
+        arr = x.astype("float32").asnumpy()
+        gray = arr.mean(axis=-1, keepdims=True)
+        return nd.array(gray + (arr - gray) * f)
+
+
+class RandomHue(Block):
+    """Approximate hue jitter by channel rotation mixing (the reference
+    uses the HSV transform; this keeps the augmentation cheap and
+    dependency-free)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        t = np.random.uniform(-self._h, self._h) * np.pi
+        arr = x.astype("float32").asnumpy()
+        u, w = np.cos(t), np.sin(t)
+        m = np.array([[0.299, 0.587, 0.114]] * 3)
+        rot = m + u * (np.eye(3) - m) + w * np.array(
+            [[0.0, -0.577, 0.577], [0.577, 0.0, -0.577],
+             [-0.577, 0.577, 0.0]])
+        return nd.array(arr @ rot.T.astype(np.float32))
+
+
+class RandomColorJitter(Block):
+    """ref: transforms.py RandomColorJitter — compose the four jitters in
+    random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (ref: transforms.py
+    RandomLighting)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], dtype=np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.814],
+                        [-0.5836, -0.6948, 0.4203]], dtype=np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = np.random.normal(0, self._alpha, 3).astype(np.float32)
+        noise = (self._eigvec * a * self._eigval).sum(axis=1)
+        return x.astype("float32") + nd.array(noise)
+
+
+__all__ += ["RandomBrightness", "RandomContrast", "RandomSaturation",
+            "RandomHue", "RandomColorJitter", "RandomLighting"]

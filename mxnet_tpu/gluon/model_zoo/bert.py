@@ -37,6 +37,10 @@ class MultiHeadAttention(HybridBlock):
         self._num_heads = num_heads
         self._causal = causal
         self._block = attention_block_size
+        if seq_parallel not in (False, True, "ring", "ulysses"):
+            raise MXNetError(
+                f"seq_parallel must be False, True/'ring', or 'ulysses'; "
+                f"got {seq_parallel!r}")
         self._seq_parallel = seq_parallel
         with self.name_scope():
             self.qkv = nn.Dense(3 * units, flatten=False, use_bias=use_bias,
@@ -55,7 +59,14 @@ class MultiHeadAttention(HybridBlock):
         qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))       # (3, B, H, S, D)
         q, k, v = qkv[0], qkv[1], qkv[2]
         if self._seq_parallel:
-            out = F.contrib.ring_attention(q, k, v, causal=self._causal)
+            # seq_parallel=True/'ring' → ring attention; 'ulysses' → the
+            # all-to-all head-scatter variant (better when heads ≥ shards)
+            if self._seq_parallel == "ulysses":
+                out = F.contrib.ulysses_attention(q, k, v,
+                                                  causal=self._causal)
+            else:
+                out = F.contrib.ring_attention(q, k, v,
+                                               causal=self._causal)
         else:
             blk = min(self._block, s)
             while s % blk:

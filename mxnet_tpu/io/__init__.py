@@ -461,8 +461,14 @@ class ImageRecordIter(DataIter):
                 break
             label, img = rec
             datas.append(self._augment(img))
-            labels.append(np.asarray(label, dtype=np.float32).reshape(-1)
-                          [:self._label_width])
+            vals = np.asarray(label, dtype=np.float32).reshape(-1)
+            # pad ragged label rows (variable object counts in detection
+            # packs) to label_width so the batch stacks
+            row = np.full(self._label_width,
+                          getattr(self, "_pad_value", 0.0), np.float32)
+            n = min(len(vals), self._label_width)
+            row[:n] = vals[:n]
+            labels.append(row)
         if not datas:
             raise StopIteration
         pad = self.batch_size - len(datas)
@@ -476,3 +482,18 @@ class ImageRecordIter(DataIter):
                          label=[nd.array(label_arr)], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection variant (ref: src/io/iter_image_det_recordio.cc): labels
+    are variable-length [header_width, obj_width, cls, x0, y0, x1, y1, ...]
+    padded to label_width per image; this build reads the same packs with
+    label_width = label_pad_width boxes."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 label_pad_width=35, label_pad_value=-1.0, **kwargs):
+        kwargs.setdefault("label_width", label_pad_width)
+        super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
+        self._pad_value = label_pad_value
+
+__all__.append("ImageDetRecordIter")

@@ -592,3 +592,25 @@ def _q_activation(x, act_bit=1, backward_only=False):
 
     core.defvjp(fwd, bwd)
     return core(x)
+
+
+@register("_contrib_ulysses_attention", num_inputs=3,
+          params=[OpParam("axis_name", str, "seq"),
+                  OpParam("causal", bool, False),
+                  OpParam("batch_axis", str, "data")],
+          doc="Ulysses all-to-all sequence-parallel attention over the "
+              "current mesh (head-scatter alternative to ring attention; "
+              "SURVEY §5.7). Eager execution falls back to the blockwise "
+              "kernel like _contrib_ring_attention.")
+def _ulysses_attention_op(q, k, v, axis_name="seq", causal=False,
+                          batch_axis="data"):
+    import jax
+    from ..parallel.ring_attention import (blockwise_attention,
+                                           ulysses_attention)
+    from ..parallel.mesh import current_mesh
+    if not isinstance(q, jax.core.Tracer):
+        return blockwise_attention(q, k, v, block_size=q.shape[-2],
+                                   causal=causal)
+    return ulysses_attention(q, k, v, mesh=current_mesh(),
+                             axis_name=axis_name, causal=causal,
+                             batch_axis=batch_axis)

@@ -75,10 +75,9 @@ def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None):
     s_k = k.shape[-2]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
     block_size = min(block_size, s_k)
-    if s_k % block_size != 0:
-        raise MXNetError(f"sequence length {s_k} must be divisible by "
-                         f"block_size {block_size}")
-    n_blocks = s_k // block_size
+    while s_k % block_size:        # shrink to the nearest divisor so any
+        block_size -= 1            # sequence length works (block size is a
+    n_blocks = s_k // block_size   # perf knob, not a correctness contract)
     kb = jnp.moveaxis(k.reshape(k.shape[:-2] + (n_blocks, block_size, d)),
                       -3, 0)
     vb = jnp.moveaxis(v.reshape(v.shape[:-2] + (n_blocks, block_size, d)),
