@@ -1,0 +1,38 @@
+"""Examples must keep running end-to-end (the reference's example/ scripts
+are exercised by CI the same way — SURVEY §2.7 runtime_functions.sh)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=280):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout + r.stderr
+
+
+def test_train_mnist_gluon():
+    out = _run("train_mnist.py", "--epochs", "1", "--batch-size", "256")
+    assert "final accuracy" in out
+
+
+def test_train_nmt_smoke():
+    out = _run("train_nmt.py", "--steps", "3", "--units", "32",
+               "--batch-size", "4", "--num-layers", "1")
+    assert "greedy-decode token accuracy" in out
+
+
+def test_train_ssd_smoke():
+    out = _run("train_ssd.py", "--steps", "2", "--batch-size", "2",
+               "--data-shape", "64")
+    assert "detections" in out
