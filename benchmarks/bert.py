@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""BERT-base pretraining throughput (BASELINE.md metric of record #2:
+samples/sec/chip at seq 128; derived 50%-MFU ceiling ≈ 1.2k/chip on v5e).
+
+Same methodology as bench.py: fused multi-step dispatch + best of three
+hard-synced windows. Prints one JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    batch = 64 if on_tpu else 4
+    seq = 128 if on_tpu else 32
+    vocab = 30522 if on_tpu else 512
+    k = 8 if on_tpu else 2
+    steps = 4 if on_tpu else 1
+    windows = 3 if on_tpu else 1
+
+    if on_tpu:
+        net = bert.get_bert_model(
+            "bert_12_768_12", vocab_size=vocab, max_length=512,
+            dropout=0.1, use_pooler=False, use_classifier=False)
+    else:            # tiny config for the CPU smoke path
+        net = bert.BERTModel(num_layers=2, units=64, hidden_size=128,
+                             num_heads=4, max_length=128, vocab_size=vocab,
+                             use_pooler=False, use_classifier=False)
+    net.initialize(mx.init.Normal(0.02))
+
+    class MLMWrapper(gluon.HybridBlock):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def hybrid_forward(self, F, tokens):
+            _, mlm = self.inner(tokens)
+            return F.reshape(mlm, (-1, vocab))
+
+    class FlatCE(gluon.loss.Loss):
+        def __init__(self):
+            super().__init__(None, 0)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, pred, label):
+            return self._ce(pred, F.reshape(label, (-1,)))
+
+    mesh = parallel.make_mesh({"data": len(jax.devices())})
+    trainer = parallel.ShardedTrainer(
+        MLMWrapper(net), FlatCE(), "adam", {"learning_rate": 1e-4},
+        mesh=mesh, compute_dtype="bfloat16" if on_tpu else None)
+
+    toks = np.random.randint(0, vocab, (batch, seq))
+    trainer.run_steps(toks, toks, num_steps=k).wait_to_read()
+    best = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = trainer.run_steps(toks, toks, num_steps=k)
+        np.asarray(loss.asnumpy())
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    n_chips = len(jax.devices())
+    sps = batch * steps * k / best / n_chips
+    print(json.dumps({
+        "metric": "bert_base_train_samples_per_sec_per_chip",
+        "value": round(sps, 2),
+        "unit": f"samples/sec/chip ({platform}, batch={batch}, seq={seq})",
+        "vs_baseline": round(sps / 1200.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -21,8 +21,11 @@ def _run(script, *args, timeout=280):
     return r.stdout + r.stderr
 
 
-def test_train_mnist_gluon():
-    out = _run("train_mnist.py", "--epochs", "1", "--batch-size", "256")
+def test_train_mnist_gluon(tmp_path):
+    # explicit empty data dir pins the synthetic fallback (hermetic: never
+    # trains on a host's real MNIST download)
+    out = _run("train_mnist.py", "--epochs", "1", "--batch-size", "256",
+               "--data-dir", str(tmp_path))
     assert "final accuracy" in out
 
 
