@@ -14,9 +14,12 @@ from ..ops import registry as _registry
 from .executor import Executor
 from .symbol import (Group, Symbol, Variable, arange, load, load_json, ones,
                      var, zeros)
+from . import passes
+from .passes import apply_pass, list_passes, register_pass
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
-           "zeros", "ones", "arange", "Executor", "eval_symbol"]
+           "zeros", "ones", "arange", "Executor", "eval_symbol",
+           "passes", "apply_pass", "register_pass", "list_passes"]
 
 
 def _make_wrapper(opname, op):

@@ -22,6 +22,8 @@ class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
                  aux_states=None):
         from .. import ndarray as nd
+        from .passes import apply_env_passes
+        symbol = apply_env_passes(symbol)   # MXNET_SUBGRAPH_BACKEND hook
         self._symbol = symbol
         self._ctx = ctx or current_context()
         self.arg_dict = dict(args)

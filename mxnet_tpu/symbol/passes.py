@@ -1,0 +1,108 @@
+"""Graph passes — the NNVM pass machinery + subgraph-hook analog
+(ref: nnvm::ApplyPass / src/operator/subgraph/ SubgraphProperty,
+env MXNET_SUBGRAPH_BACKEND; SURVEY §2.2 #12).
+
+XLA already does the heavy rewriting (fusion, layout, CSE *within* a
+compiled program); these passes operate on the Symbol DAG *before* bind,
+where graph-level decisions live — dedup of repeated subgraphs across the
+Python-built DAG, pattern substitutions toward custom kernels, etc.
+Custom backends register passes and are selected with
+``MXNET_SUBGRAPH_BACKEND=<name>[,<name>…]`` exactly like the reference's
+subgraph-backend hook.
+"""
+from __future__ import annotations
+
+import warnings
+
+from ..base import MXNetError, getenv
+from ..ops import registry as _registry
+from .symbol import Symbol, _Node
+
+__all__ = ["register_pass", "apply_pass", "apply_env_passes", "list_passes"]
+
+_PASSES = {}
+
+
+def register_pass(name):
+    """Decorator: register ``fn(Symbol) -> Symbol`` as a named pass."""
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+    return deco
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+def apply_pass(sym: Symbol, name: str) -> Symbol:
+    """ref: nnvm::ApplyPass."""
+    if name not in _PASSES:
+        raise MXNetError(f"unknown graph pass {name!r}; "
+                         f"known: {list_passes()}")
+    return _PASSES[name](sym)
+
+
+def apply_env_passes(sym: Symbol) -> Symbol:
+    """Apply the passes selected by MXNET_SUBGRAPH_BACKEND (comma list) —
+    the reference's subgraph-backend activation point (bind time)."""
+    backends = getenv("MXNET_SUBGRAPH_BACKEND", "")
+    for name in filter(None, (b.strip() for b in backends.split(","))):
+        if name in _PASSES:
+            sym = _PASSES[name](sym)
+        else:                  # lenient like the reference, but visible
+            warnings.warn(f"MXNET_SUBGRAPH_BACKEND: unknown pass {name!r} "
+                          f"ignored (known: {list_passes()})")
+    return sym
+
+
+@register_pass("CSE")
+def common_subexpression_elimination(sym: Symbol) -> Symbol:
+    """Merge structurally identical nodes (same op, same attrs, same
+    inputs) so duplicated Python-built subgraphs compile & execute once
+    (ref: nnvm pass 'CommonSubexprElim' era; XLA CSEs *within* a program,
+    this dedups at the graph level so shared work is traced once)."""
+    canon = {}      # signature -> canonical _Node
+    rebuilt = {}    # id(old node) -> new _Node
+
+    def key_of(node, new_inputs):
+        # op node signature: names intentionally excluded — structurally
+        # identical ops are the same computation regardless of name
+        attrs = tuple(sorted((k, str(v)) for k, v in node.attrs.items()))
+        ins = tuple((id(s._node), s._index) for s in new_inputs)
+        return (node.op, attrs, ins)
+
+    def _mergeable(node):
+        if node.op is None or node.op == "_group":
+            return False
+        try:
+            op = _registry.get(node.op)
+        except MXNetError:
+            return False
+        # stochastic ops draw a fresh PRNG key per node — merging them
+        # would collapse independent random draws into one shared draw
+        return not op.needs_rng
+
+    def rebuild(node):
+        if id(node) in rebuilt:
+            return rebuilt[id(node)]
+        new_inputs = [Symbol(rebuild(s._node), s._index)
+                      for s in node.inputs]
+        # variables unify by NAME (two auto-created `fc_weight` vars are
+        # one argument — binding is name-keyed); ops unify structurally
+        if node.op is None:
+            sig = ("var", node.name)
+        elif _mergeable(node):
+            sig = key_of(node, new_inputs)
+        else:
+            sig = ("unique", id(node))
+        if sig in canon:
+            new = canon[sig]
+        else:
+            new = _Node(node.op, node.name, new_inputs, dict(node.attrs),
+                        num_outputs=node.num_outputs)
+            canon[sig] = new
+        rebuilt[id(node)] = new
+        return new
+
+    return Symbol(rebuild(sym._node), sym._index)

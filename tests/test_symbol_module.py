@@ -176,3 +176,38 @@ def test_bucketing_module():
     mod.backward()
     mod.update()
     assert mod._curr_bucket_key == 5
+
+
+def test_graph_pass_cse():
+    """CSE pass merges identical subgraphs (SURVEY §2.2 #12 machinery)."""
+    a = sym.var("a")
+    b1 = sym.FullyConnected(a, num_hidden=4, name="fc")
+    # build the SAME node twice through different Python objects
+    t1 = sym.Activation(b1, act_type="tanh", name="t1")
+    t2 = sym.Activation(b1, act_type="tanh", name="t1")
+    out = t1 + t2
+    n_before = len(out._topo())
+    deduped = sym.apply_pass(out, "CSE")
+    n_after = len(deduped._topo())
+    assert n_after == n_before - 1   # one duplicate Activation removed
+    # numerics unchanged
+    w = mx.nd.random.normal(shape=(4, 3))
+    bias = mx.nd.zeros((4,))
+    x = mx.nd.random.normal(shape=(2, 3))
+    got1 = out.eval(a=x, fc_weight=w, fc_bias=bias)[0].asnumpy()
+    got2 = deduped.eval(a=x, fc_weight=w, fc_bias=bias)[0].asnumpy()
+    np.testing.assert_allclose(got1, got2, rtol=1e-6)
+
+
+def test_env_subgraph_backend_hook(monkeypatch):
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "CSE")
+    a = sym.var("a")
+    t1 = sym.Activation(a, act_type="tanh", name="t")
+    t2 = sym.Activation(a, act_type="tanh", name="t")
+    out = t1 + t2
+    exe = out.simple_bind(a=(2, 3))
+    exe.arg_dict["a"][:] = mx.nd.ones((2, 3))
+    res = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(res, 2 * np.tanh(np.ones((2, 3))),
+                               rtol=1e-6)
+    assert len(exe._symbol._topo()) < len(out._topo())
