@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import MXNetError
 from .registry import OpParam, register
 
 
@@ -614,3 +615,113 @@ def _ulysses_attention_op(q, k, v, axis_name="seq", causal=False,
     return ulysses_attention(q, k, v, mesh=current_mesh(),
                              axis_name=axis_name, causal=causal,
                              batch_axis=batch_axis)
+
+
+def _proposal_outputs(params):
+    return 2 if params.get("output_score") else 1
+
+
+@register("_contrib_Proposal", aliases=["Proposal"], num_inputs=3,
+          num_outputs=_proposal_outputs,
+          params=[OpParam("rpn_pre_nms_top_n", int, 6000),
+                  OpParam("rpn_post_nms_top_n", int, 300),
+                  OpParam("threshold", float, 0.7),
+                  OpParam("rpn_min_size", int, 16),
+                  OpParam("scales", tuple, (4.0, 8.0, 16.0, 32.0)),
+                  OpParam("ratios", tuple, (0.5, 1.0, 2.0)),
+                  OpParam("feature_stride", int, 16),
+                  OpParam("output_score", bool, False),
+                  OpParam("iou_loss", bool, False)],
+          differentiable=False,
+          doc="RPN proposal generation (ref: src/operator/contrib/"
+              "proposal.cc): anchors + bbox deltas -> decode, clip, filter "
+              "small, NMS, fixed top-N rows [batch_idx, x0, y0, x1, y1] "
+              "(padded with -1) — static shapes throughout, vmapped over "
+              "the batch.")
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+              feature_stride=16, output_score=False, iou_loss=False):
+    # cls_prob: (N, 2A, H, W) bg/fg per anchor; bbox_pred: (N, 4A, H, W)
+    n, c, h, w = cls_prob.shape
+    a = len(scales) * len(ratios)
+    if c != 2 * a or bbox_pred.shape[1] != 4 * a:
+        raise MXNetError(
+            f"Proposal: cls_prob needs 2*A={2 * a} channels and bbox_pred "
+            f"4*A={4 * a} for {len(scales)} scales x {len(ratios)} ratios; "
+            f"got {c} and {bbox_pred.shape[1]}")
+    # base anchors centered on each stride cell (reference GenerateAnchors)
+    base = []
+    cx = cy = (feature_stride - 1) / 2.0
+    base_size = float(feature_stride)
+    for r in ratios:
+        size = base_size * base_size / r
+        ws = jnp.sqrt(size)
+        hs = ws * r
+        for s in scales:
+            bw, bh = ws * s, hs * s
+            base.append([cx - (bw - 1) / 2, cy - (bh - 1) / 2,
+                         cx + (bw - 1) / 2, cy + (bh - 1) / 2])
+    base = jnp.asarray(base)                                  # (A, 4)
+    sx = jnp.arange(w) * feature_stride
+    sy = jnp.arange(h) * feature_stride
+    sx, sy = jnp.meshgrid(sx, sy, indexing="xy")
+    shifts = jnp.stack([sx.ravel(), sy.ravel(),
+                        sx.ravel(), sy.ravel()], axis=1)      # (H*W, 4)
+    anchors = (base[None, :, :] + shifts[:, None, :]).reshape(-1, 4)
+
+    def one(scores_map, deltas_map, info):
+        im_h, im_w, im_scale = info[0], info[1], info[2]
+        scores = scores_map[a:].transpose(1, 2, 0).reshape(-1)  # fg probs
+        deltas = deltas_map.transpose(1, 2, 0).reshape(-1, 4)
+        if iou_loss:
+            # corner-delta decode (reference IoUTransformInv)
+            boxes = anchors + deltas
+        else:
+            # center-offset decode (reference NonLinearTransformInv)
+            aw = anchors[:, 2] - anchors[:, 0] + 1.0
+            ah = anchors[:, 3] - anchors[:, 1] + 1.0
+            acx = anchors[:, 0] + 0.5 * (aw - 1)
+            acy = anchors[:, 1] + 0.5 * (ah - 1)
+            cx2 = deltas[:, 0] * aw + acx
+            cy2 = deltas[:, 1] * ah + acy
+            w2 = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+            h2 = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+            boxes = jnp.stack(
+                [cx2 - 0.5 * (w2 - 1), cy2 - 0.5 * (h2 - 1),
+                 cx2 + 0.5 * (w2 - 1), cy2 + 0.5 * (h2 - 1)], axis=1)
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_w - 1),
+                           jnp.clip(boxes[:, 1], 0, im_h - 1),
+                           jnp.clip(boxes[:, 2], 0, im_w - 1),
+                           jnp.clip(boxes[:, 3], 0, im_h - 1)], axis=1)
+        # min-size filter in SCALED image pixels (reference: min_size *
+        # im_info[2])
+        min_sz = rpn_min_size * im_scale
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_sz)
+                & (boxes[:, 3] - boxes[:, 1] + 1 >= min_sz))
+        scores = jnp.where(keep, scores, -1.0)
+        pre_n = min(rpn_pre_nms_top_n, scores.shape[0])
+        top_scores, order = jax.lax.top_k(scores, pre_n)
+        rows = jnp.concatenate([top_scores[:, None], boxes[order]], axis=1)
+        # NMS over ALL pre_nms candidates, then take the first post_n
+        # SURVIVORS (compacted to the top) — the reference keeps scanning
+        # past rank post_n until post_n survivors are collected
+        nmsed = _box_nms(rows, overlap_thresh=threshold, valid_thresh=0.0,
+                         topk=-1, coord_start=1, score_index=0,
+                         id_index=-1)
+        out_n = rpn_post_nms_top_n
+        padded = jnp.full((out_n, 5), -1.0, rows.dtype)
+        take = min(out_n, nmsed.shape[0])
+        padded = padded.at[:take].set(nmsed[:take])
+        return padded
+
+    per_img = jax.vmap(one)(cls_prob, bbox_pred, im_info)   # (N, topN, 5)
+    batch_idx = jnp.repeat(jnp.arange(n, dtype=per_img.dtype),
+                           rpn_post_nms_top_n).reshape(n, -1, 1)
+    valid = per_img[:, :, 0:1] >= 0
+    rois = jnp.concatenate(
+        [jnp.where(valid, batch_idx, -1.0), per_img[:, :, 1:5]], axis=-1)
+    rois = rois.reshape(-1, 5)
+    if output_score:
+        return rois, per_img[:, :, 0].reshape(-1, 1)
+    return rois

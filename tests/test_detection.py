@@ -97,3 +97,38 @@ def test_ssd_train_step_runs():
     loss.backward()
     trainer.step(2)
     assert np.isfinite(loss.asnumpy()).all()
+
+
+def test_proposal_op():
+    """RPN proposal generation (Faster-RCNN path, SURVEY §2 #18)."""
+    N, A, H, W = 1, 12, 4, 4     # 4 scales x 3 ratios
+    rng = np.random.RandomState(0)
+    cls_prob = rng.rand(N, 2 * A, H, W).astype(np.float32)
+    bbox_pred = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], dtype=np.float32)
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+        mx.nd.array(im_info), rpn_pre_nms_top_n=50,
+        rpn_post_nms_top_n=10, threshold=0.7, rpn_min_size=4)
+    out = rois.asnumpy()
+    assert out.shape == (10, 5)
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) >= 1
+    # rois clipped to the image
+    assert (kept[:, 1] >= 0).all() and (kept[:, 3] <= 63.0 + 1e-3).all()
+    assert (kept[:, 2] >= 0).all() and (kept[:, 4] <= 63.0 + 1e-3).all()
+    # batch index column is 0 for the single image
+    assert (kept[:, 0] == 0).all()
+
+
+def test_proposal_with_scores():
+    N, A, H, W = 2, 3, 3, 3      # 1 scale x 3 ratios
+    rng = np.random.RandomState(1)
+    rois, scores = mx.nd.contrib.Proposal(
+        mx.nd.array(rng.rand(N, 2 * A, H, W).astype(np.float32)),
+        mx.nd.array((rng.randn(N, 4 * A, H, W) * 0.05).astype(np.float32)),
+        mx.nd.array(np.array([[48.0, 48.0, 1.0]] * N, dtype=np.float32)),
+        scales=(8.0,), rpn_pre_nms_top_n=20, rpn_post_nms_top_n=5,
+        rpn_min_size=2, output_score=True)
+    assert rois.shape == (10, 5)
+    assert scores.shape == (10, 1)
