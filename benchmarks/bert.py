@@ -26,7 +26,7 @@ def main():
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
-    batch = 64 if on_tpu else 4
+    batch = 128 if on_tpu else 4
     seq = 128 if on_tpu else 32
     vocab = 30522 if on_tpu else 512
     k = 8 if on_tpu else 2
@@ -53,6 +53,8 @@ def main():
             return F.reshape(mlm, (-1, vocab))
 
     class FlatCE(gluon.loss.Loss):
+        amp_safe = property(lambda self: self._ce.amp_safe)
+
         def __init__(self):
             super().__init__(None, 0)
             self._ce = gluon.loss.SoftmaxCrossEntropyLoss()

@@ -1,0 +1,156 @@
+"""Piecewise ResNet-50 step profiler — where does the step time go?
+
+Methodology note (axon/tunneled TPU): ``block_until_ready`` does not
+honestly synchronize over the tunnel and a single dispatch costs ~90 ms
+of round-trip latency. Every sub-program is therefore measured as a
+k-iteration ``lax.scan`` (serialized by a carry data-dependency) with a
+host transfer as the sync point, at two different k; the difference
+cancels both the dispatch latency and the transfer cost:
+
+    t_per_iter = (t(k2) - t(k1)) / (k2 - k1)
+
+Usage: PYTHONPATH=. python benchmarks/perf_probe.py [--batch 256 512]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def _timed(call, iters=3):
+    best = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        call()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def _scan_time(jit_fn, args, k1=2, k2=12):
+    """jit_fn(k)(args...) -> scalar; returns seconds per inner iteration."""
+    import jax
+    f1, f2 = jit_fn(k1), jit_fn(k2)
+    np.asarray(f1(*args))              # compile + warm
+    np.asarray(f2(*args))
+    t1 = _timed(lambda: np.asarray(f1(*args)))
+    t2 = _timed(lambda: np.asarray(f2(*args)))
+    return (t2 - t1) / (k2 - k1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, nargs="+", default=[256])
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import functional_apply
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    peak = 197e12 if on_tpu else 1e12     # v5e bf16 peak
+    fwd_flops = 4.1e9                     # RN50 @224, per image
+    print(f"platform={platform} devices={len(jax.devices())}")
+
+    for batch in args.batch:
+        net = vision.resnet50_v1()
+        net.initialize()
+        mesh = parallel.make_mesh({"data": len(jax.devices())})
+        trainer = parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "wd": 1e-4},
+            mesh=mesh, compute_dtype="bfloat16" if on_tpu else None)
+        x_host = np.random.randn(batch, 3, 224, 224).astype(np.float32)
+        y_host = np.random.randint(0, 1000, (batch,))
+        trainer._prepare((x_host,))
+        x = trainer._shard(x_host, trainer._batch_spec(4))
+        y = trainer._shard(y_host, trainer._batch_spec(1))
+        tr = [p._data[0]._data for p in trainer._trainable]
+        aux = [p._data[0]._data for p in trainer._aux]
+
+        cdt = jnp.bfloat16 if on_tpu else jnp.float32
+
+        def cast_all(ws):
+            return [w.astype(cdt) if jnp.issubdtype(w.dtype, jnp.floating)
+                    else w for w in ws]
+
+        def fwd_once(tr_, aux_, x_):
+            outs, _, _ = functional_apply(
+                net, jax.random.PRNGKey(0), tr_, aux_, [x_],
+                training=True)   # training mode: batch stats, like the step
+            return outs[0]
+
+        def make_fwd(k):
+            def run(tr_, aux_, x_):
+                tr_ = cast_all(tr_)
+                aux_ = cast_all(aux_)
+                x_ = x_.astype(cdt)
+
+                def body(c, _):
+                    out = fwd_once(tr_, aux_, x_ + c * 1e-30)
+                    return jnp.mean(out).astype(x_.dtype), None
+                c, _ = jax.lax.scan(body, jnp.zeros((), x_.dtype),
+                                    None, length=k)
+                return c
+            return jax.jit(run)
+
+        def loss_of(tr_, aux_, x_, y_):
+            outs, _, _ = functional_apply(
+                net, jax.random.PRNGKey(0), tr_, aux_, [x_], training=True)
+            logits = outs[0].astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            nll = lse - jnp.take_along_axis(
+                logits, y_[:, None], axis=-1)[:, 0]
+            return jnp.mean(nll)
+
+        def make_grad(k):
+            def run(tr_, aux_, x_, y_):
+                tr_ = cast_all(tr_)
+                aux_ = cast_all(aux_)
+                x_ = x_.astype(cdt)
+
+                def body(c, _):
+                    g = jax.grad(loss_of)(
+                        [w + (c * 1e-30).astype(w.dtype) for w in tr_],
+                        aux_, x_, y_)
+                    return jnp.mean(g[0]).astype(jnp.float32), None
+                c, _ = jax.lax.scan(body, jnp.zeros(()), None, length=k)
+                return c
+            return jax.jit(run)
+
+        t_fwd = _scan_time(make_fwd, (tr, aux, x))
+        t_grad = _scan_time(make_grad, (tr, aux, x, y))
+
+        # full fused train step (trainer.run_steps scan), same differencing
+        def full_k(k):
+            def call():
+                np.asarray(
+                    trainer.run_steps(x, y, num_steps=k).asnumpy())
+            return call
+        for k in (2, 12):
+            full_k(k)()          # compile + warm both variants
+        tf1 = _timed(full_k(2))
+        tf2 = _timed(full_k(12))
+        t_step = (tf2 - tf1) / 10
+
+        n = len(jax.devices())
+
+        def rep(name, t, mult):
+            ips = batch / t / n
+            mfu = mult * fwd_flops * ips / peak
+            print(f"  batch={batch:4d} {name:12s} {t*1e3:8.2f} ms  "
+                  f"{ips:8.0f} img/s/chip  MFU={mfu*100:5.1f}%")
+        rep("forward", t_fwd, 1)
+        rep("fwd+bwd", t_grad, 3)
+        rep("full step", t_step, 3)
+
+
+if __name__ == "__main__":
+    main()

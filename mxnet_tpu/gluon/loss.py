@@ -119,7 +119,27 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._sparse_label = sparse_label
         self._from_logits = from_logits
 
+    @property
+    def amp_safe(self):
+        """True when this loss does its own fp32-accumulated reductions on
+        reduced-precision inputs, so callers (ShardedTrainer) may skip the
+        fp32 pre-cast of model outputs. Only the fused sparse path
+        qualifies; the generic paths do elementwise math in the input
+        dtype and want fp32 inputs under AMP."""
+        return self._sparse_label and not self._from_logits
+
     def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if self._sparse_label and not self._from_logits:
+            # fused path: loss = lse(pred) - pred[label]. Never materializes
+            # the [.., C] log-prob tensor — under bf16 AMP with a large
+            # vocabulary the log_softmax intermediate dominates HBM traffic
+            # (docs/perf_notes.md); the backward is softmax - onehot, fused
+            # the same way (ref: src/operator/softmax_output.cc backward).
+            lse = F.logsumexp(pred, axis=self._axis, keepdims=True)
+            picked = F.pick(pred, label, axis=self._axis, keepdims=True)
+            loss = lse - F.cast(picked, "float32")
+            loss = _apply_weighting(F, loss, self._weight, sample_weight)
+            return self._mean_over_nonbatch(F, loss)
         if not self._from_logits:
             pred = F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:

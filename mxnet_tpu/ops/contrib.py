@@ -295,6 +295,16 @@ def _interleaved_valatt(qkv, att, heads=None):
 def _flash_attention(q, k, v, block_size=512, causal=False):
     import jax
     from ..parallel.ring_attention import blockwise_attention
+    if k.shape[-2] <= 1024:
+        # short KV: one fused softmax(QK^T)V straight on the MXU via the
+        # shared dense-attention definition (attention_reference — one
+        # mask convention, fp32-accumulated row sums). The s_q x s_kv
+        # score tensor is small here, and a single batched matmul pair
+        # beats any streaming kernel (measured: the Pallas kernels cost
+        # ~20x at S=128 — see docs/perf_notes.md).
+        from ..parallel.ring_attention import attention_reference
+        return attention_reference(q, k, v, causal=causal,
+                                   scale=float(q.shape[-1]) ** -0.5)
     # on TPU hardware route to the hand-tiled Pallas kernel (MXU-tiled
     # blocks, VMEM-resident online softmax); the jnp blockwise kernel is
     # the portable fallback and the CPU-test oracle

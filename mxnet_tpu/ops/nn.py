@@ -259,7 +259,12 @@ def _softmax(x, axis=-1, temperature=None, length=None, dtype=None):
 def _log_softmax(x, axis=-1, temperature=None):
     if temperature:
         x = x / temperature
-    return jax.nn.log_softmax(x, axis=axis)
+    # max-shifted with fp32-accumulated row sums: under bf16 AMP this is one
+    # fused read of x with no fp32 materialization of the full tensor (the
+    # [tokens, vocab] MLM-head case is HBM-dominant otherwise)
+    from .tensor import shifted_expsum
+    _, shifted, se32 = shifted_expsum(x, axis=axis)
+    return shifted - jnp.log(se32).astype(x.dtype)
 
 
 @register("softmin", params=[OpParam("axis", int, -1)])
@@ -299,14 +304,33 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
     if training and not use_global_stats:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        # one-pass batch stats accumulated in fp32: a single fused read of x
+        # instead of jnp.var's mean-then-centered-moments passes — this
+        # keeps the op HBM-minimal under bf16 AMP, where the step is
+        # bandwidth-bound (see docs/perf_notes.md). The raw E[x^2]-E[x]^2
+        # form cancels catastrophically when |mean| >> std, so both moments
+        # are taken about the (stop-gradient) running mean: once stats are
+        # warm the shift ~equals the batch mean and the subtraction is
+        # exact; cold-start equals the unshifted form (flax's behavior).
+        c = lax.stop_gradient(moving_mean.astype(jnp.float32))
+        cb = c.reshape(bshape)
+        xc = x.astype(jnp.float32) - cb
+        mean_c = jnp.mean(xc, axis=axes)
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xc), axis=axes) - jnp.square(mean_c), 0.0)
+        mean = mean_c + c
     else:
-        mean, var = moving_mean, moving_var
-    inv = lax.rsqrt(var + eps).reshape(bshape)
-    out = (x - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
-        + beta.reshape(bshape)
-    return out, mean, var
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
+    # fold (mean, var, gamma, beta) into per-channel scale/offset in fp32,
+    # cast once to the compute dtype: the normalize pass over x is then a
+    # single fused multiply-add in x's dtype (no fp32 upcast of the tensor)
+    inv = lax.rsqrt(var + eps)
+    scale = inv * gamma.astype(jnp.float32)
+    offset = beta.astype(jnp.float32) - mean * scale
+    out = x * scale.astype(x.dtype).reshape(bshape) \
+        + offset.astype(x.dtype).reshape(bshape)
+    return out, mean.astype(moving_mean.dtype), var.astype(moving_var.dtype)
 
 
 @register("LayerNorm", num_inputs=3,

@@ -247,6 +247,34 @@ def _slice_like(x, like, axes=None):
     return x[tuple(idx)]
 
 
+def shifted_expsum(x, axis=-1):
+    """Shared numerically-stable exp-sum core: returns
+    ``(m, shifted, se32)`` where ``m = stop_grad(max(x))``,
+    ``shifted = x - m`` (input dtype, elementwise — fuses into consumers)
+    and ``se32 = sum(exp(shifted))`` accumulated in fp32 without
+    materializing an fp32 tensor of x's shape. One definition backs
+    log_softmax, logsumexp and the short-sequence attention softmax so
+    their numerics stay consistent."""
+    m = jax.lax.stop_gradient(jnp.max(x, axis=axis, keepdims=True))
+    shifted = x - m
+    se32 = jnp.sum(jnp.exp(shifted).astype(jnp.float32), axis=axis,
+                   keepdims=True)
+    return m, shifted, se32
+
+
+@register("logsumexp",
+          params=[OpParam("axis", int, -1), OpParam("keepdims", bool, False)],
+          doc="Numerically-stable log-sum-exp with fp32 accumulation; "
+              "gradient is softmax in the input dtype. Backs the fused "
+              "sparse softmax-CE loss path (no [.., C] log-prob tensor is "
+              "materialized; the reference fuses equivalently in "
+              "src/operator/softmax_output.cc)")
+def _logsumexp(x, axis=-1, keepdims=False):
+    m, _, se32 = shifted_expsum(x, axis=axis)
+    out = m.astype(jnp.float32) + jnp.log(se32)
+    return out if keepdims else jnp.squeeze(out, axis)
+
+
 @register("take", num_inputs=2,
           params=[OpParam("axis", int, 0), OpParam("mode", str, "clip")],
           doc="Gather rows by index (ref: src/operator/tensor/indexing_op.cc Take)")

@@ -42,15 +42,26 @@ _NEG = -1e30
 
 def attention_reference(q, k, v, causal=False, scale=None):
     """Plain softmax(QK^T)V — the correctness oracle (the reference's
-    full-attention BERT path, SURVEY §5.7)."""
+    full-attention BERT path, SURVEY §5.7) AND the production short-KV
+    path of ops.contrib flash_attention (one definition, one mask
+    convention). Causal masking is bottom-right aligned (query i attends
+    keys j <= i + s_kv - s_q — the decode-cache convention); softmax row
+    sums accumulate in fp32 via the shared shifted_expsum core, so bf16
+    inputs never materialize an fp32 score tensor. Rows whose allowed-key
+    set is empty (causal with s_q > s_kv) yield zeros."""
+    from ..ops.tensor import shifted_expsum
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
     scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    mask = None
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        scores = jnp.where(mask, scores, _NEG)
-    w = jax.nn.softmax(scores, axis=-1)
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    _, shifted, se32 = shifted_expsum(scores, axis=-1)
+    w = (jnp.exp(shifted).astype(jnp.float32) / se32).astype(q.dtype)
+    if mask is not None:
+        w = w * mask.any(-1, keepdims=True).astype(w.dtype)
     return jnp.einsum("...qk,...kd->...qd", w, v)
 
 
@@ -97,8 +108,10 @@ def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None):
         blk_idx, k_blk, v_blk = inputs
         mask = None
         if causal:
+            # bottom-right aligned, matching attention_reference and the
+            # short-KV path: query i attends keys j <= i + (s_k - s_q)
             k_pos = blk_idx * block_size + jnp.arange(block_size)
-            mask = q_pos[:, None] >= k_pos[None, :]
+            mask = q_pos[:, None] + (s_k - s_q) >= k_pos[None, :]
             mask = jnp.broadcast_to(mask, carry[0].shape[:-1]
                                     + (block_size,))
         new = _online_block(carry, q.astype(jnp.float32),

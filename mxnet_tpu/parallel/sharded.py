@@ -300,11 +300,19 @@ class ShardedTrainer:
                 outs, treedef, aux_new = functional_apply(
                     block, key, tr_, aux, inputs_c, training=True)
                 self._out_treedef = treedef
-                # loss math in fp32 regardless of compute dtype
-                out_nds = [nd.NDArray(
-                    o.astype(jnp.float32) if jnp.issubdtype(o.dtype,
-                                                            jnp.floating)
-                    else o, _skip_device_put=True) for o in outs]
+                # loss math in fp32 by default; a loss that does its own
+                # fp32-accumulated reductions (amp_safe, e.g. the fused
+                # sparse softmax-CE) takes compute-dtype outputs directly —
+                # for a [tokens, vocab] MLM head the blanket fp32 cast
+                # alone materializes GBs of HBM traffic per step
+                if getattr(loss_block, "amp_safe", False):
+                    out_nds = [nd.NDArray(o, _skip_device_put=True)
+                               for o in outs]
+                else:
+                    out_nds = [nd.NDArray(
+                        o.astype(jnp.float32) if jnp.issubdtype(
+                            o.dtype, jnp.floating) else o,
+                        _skip_device_put=True) for o in outs]
                 label_nd = nd.NDArray(label, _skip_device_put=True)
                 with autograd.pause(train_mode=True):
                     loss_nd = loss_block(out_nds[0] if len(out_nds) == 1
