@@ -1,0 +1,320 @@
+"""Symbol-DAG -> ONNX graph conversion (ref: python/mxnet/contrib/onnx/
+mx2onnx/_op_translations.py). Each MX op converter returns a list of ONNX
+node dicts; the registry is open (@mx2onnx) so new ops slot in the same
+way the reference's @mx_op.register does."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+
+_EXPORTERS = {}
+
+
+def mx2onnx(op_name):
+    def deco(fn):
+        _EXPORTERS[op_name] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Per-export state: tensor naming, generated initializers."""
+
+    def __init__(self, params):
+        self.params = params
+        self.extra_initializers = []
+        self.renames = {}        # identity-folded tensors (Dropout, etc.)
+        self._uid = 0
+
+    def tname(self, sym):
+        node = sym._node
+        if node.op is None:
+            name = node.name
+        elif node.num_outputs == 1:
+            name = node.name
+        else:
+            name = f"{node.name}_out{sym._index}"
+        return self.renames.get(name, name)
+
+    def out_name(self, node, index=0):
+        if node.num_outputs == 1:
+            return node.name
+        return f"{node.name}_out{index}"
+
+    def add_initializer(self, hint, arr):
+        self._uid += 1
+        name = f"_{hint}_{self._uid}"
+        self.extra_initializers.append(
+            {"name": name, "data": np.asarray(arr)})
+        return name
+
+
+def _pads(pad):
+    pad = tuple(pad or ())
+    return list(pad) + list(pad)          # symmetric begin+end
+
+
+@mx2onnx("Convolution")
+def _conv(node, ins, out, attrs, ctx):
+    onnx_attrs = {"kernel_shape": list(attrs["kernel"]),
+                  "strides": list(attrs.get("stride") or
+                                  (1,) * len(attrs["kernel"])),
+                  "dilations": list(attrs.get("dilate") or
+                                    (1,) * len(attrs["kernel"])),
+                  "pads": _pads(attrs.get("pad") or
+                                (0,) * len(attrs["kernel"])),
+                  "group": int(attrs.get("num_group") or 1)}
+    return [{"op_type": "Conv", "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": onnx_attrs}]
+
+
+@mx2onnx("FullyConnected")
+def _fc(node, ins, out, attrs, ctx):
+    nodes = []
+    data = ins[0]
+    if attrs.get("flatten", True):
+        flat = f"{node.name}_flat"
+        nodes.append({"op_type": "Flatten", "name": flat, "inputs": [data],
+                      "outputs": [flat], "attrs": {"axis": 1}})
+        data = flat
+    gemm_in = [data, ins[1]] + (ins[2:] if not attrs.get("no_bias") else [])
+    nodes.append({"op_type": "Gemm", "name": node.name, "inputs": gemm_in,
+                  "outputs": [out],
+                  "attrs": {"alpha": 1.0, "beta": 1.0, "transA": 0,
+                            "transB": 1}})
+    return nodes
+
+
+@mx2onnx("BatchNorm")
+def _bn(node, ins, out, attrs, ctx):
+    if attrs.get("fix_gamma"):
+        gname = ins[1]
+        if gname in ctx.params:
+            ins = list(ins)
+            ins[1] = ctx.add_initializer(
+                "ones", np.ones_like(np.asarray(ctx.params[gname])))
+    return [{"op_type": "BatchNormalization", "name": node.name,
+             "inputs": list(ins), "outputs": [out],
+             "attrs": {"epsilon": float(attrs.get("eps", 1e-3)),
+                       "momentum": float(attrs.get("momentum", 0.9))}}]
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@mx2onnx("Activation")
+def _act(node, ins, out, attrs, ctx):
+    act = attrs.get("act_type", "relu")
+    if act not in _ACT:
+        raise MXNetError(f"ONNX export: unsupported activation {act}")
+    return [{"op_type": _ACT[act], "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": {}}]
+
+
+for _mx, _onnx in [("relu", "Relu"), ("sigmoid", "Sigmoid"),
+                   ("tanh", "Tanh"), ("exp", "Exp"), ("log", "Log"),
+                   ("sqrt", "Sqrt"), ("abs", "Abs"), ("negative", "Neg"),
+                   ("erf", "Erf"), ("floor", "Floor"), ("ceil", "Ceil")]:
+    def _make_unary(onnx_type):
+        def conv(node, ins, out, attrs, ctx):
+            return [{"op_type": onnx_type, "name": node.name,
+                     "inputs": ins, "outputs": [out], "attrs": {}}]
+        return conv
+    _EXPORTERS[_mx] = _make_unary(_onnx)
+
+
+@mx2onnx("Pooling")
+def _pool(node, ins, out, attrs, ctx):
+    ptype = attrs.get("pool_type", "max")
+    if ptype not in ("max", "avg"):
+        raise MXNetError(f"ONNX export: unsupported pool_type {ptype}")
+    if attrs.get("global_pool"):
+        op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        return [{"op_type": op, "name": node.name, "inputs": ins,
+                 "outputs": [out], "attrs": {}}]
+    kernel = attrs["kernel"]
+    onnx_attrs = {"kernel_shape": list(kernel),
+                  "strides": list(attrs.get("stride") or (1,) * len(kernel)),
+                  "pads": _pads(attrs.get("pad") or (0,) * len(kernel)),
+                  "ceil_mode": int(attrs.get("pooling_convention",
+                                             "valid") == "full")}
+    if ptype == "avg":
+        onnx_attrs["count_include_pad"] = int(
+            bool(attrs.get("count_include_pad", True)))
+    op = "MaxPool" if ptype == "max" else "AveragePool"
+    return [{"op_type": op, "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": onnx_attrs}]
+
+
+@mx2onnx("Flatten")
+def _flatten(node, ins, out, attrs, ctx):
+    return [{"op_type": "Flatten", "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": {"axis": 1}}]
+
+
+for _mx, _onnx in [("elemwise_add", "Add"), ("broadcast_add", "Add"),
+                   ("elemwise_sub", "Sub"), ("broadcast_sub", "Sub"),
+                   ("elemwise_mul", "Mul"), ("broadcast_mul", "Mul"),
+                   ("elemwise_div", "Div"), ("broadcast_div", "Div"),
+                   ("broadcast_maximum", "Max"),
+                   ("broadcast_minimum", "Min")]:
+    def _make_binary(onnx_type):
+        def conv(node, ins, out, attrs, ctx):
+            return [{"op_type": onnx_type, "name": node.name,
+                     "inputs": ins, "outputs": [out], "attrs": {}}]
+        return conv
+    _EXPORTERS[_mx] = _make_binary(_onnx)
+
+
+@mx2onnx("softmax")
+def _softmax(node, ins, out, attrs, ctx):
+    return [{"op_type": "Softmax", "name": node.name, "inputs": ins[:1],
+             "outputs": [out], "attrs": {"axis": int(attrs.get("axis",
+                                                               -1))}}]
+
+
+@mx2onnx("log_softmax")
+def _logsoftmax(node, ins, out, attrs, ctx):
+    return [{"op_type": "LogSoftmax", "name": node.name, "inputs": ins[:1],
+             "outputs": [out], "attrs": {"axis": int(attrs.get("axis",
+                                                               -1))}}]
+
+
+@mx2onnx("SoftmaxOutput")
+def _softmax_output(node, ins, out, attrs, ctx):
+    # inference export: drop the label input (ref: mx2onnx softmax_output)
+    return [{"op_type": "Softmax", "name": node.name, "inputs": ins[:1],
+             "outputs": [out], "attrs": {"axis": -1}}]
+
+
+@mx2onnx("Dropout")
+def _dropout(node, ins, out, attrs, ctx):
+    ctx.renames[out] = ctx.renames.get(ins[0], ins[0])   # inference no-op
+    return []
+
+
+@mx2onnx("identity")
+def _identity(node, ins, out, attrs, ctx):
+    ctx.renames[out] = ctx.renames.get(ins[0], ins[0])
+    return []
+
+
+@mx2onnx("reshape")
+def _reshape(node, ins, out, attrs, ctx):
+    shape = tuple(attrs.get("shape") or ())
+    if any(s in (-2, -3, -4) for s in shape):
+        raise MXNetError("ONNX export: reshape special codes -2/-3/-4 have "
+                         "no ONNX equivalent")
+    shape_name = ctx.add_initializer("shape",
+                                     np.asarray(shape, dtype=np.int64))
+    return [{"op_type": "Reshape", "name": node.name,
+             "inputs": [ins[0], shape_name], "outputs": [out], "attrs": {}}]
+
+
+@mx2onnx("transpose")
+def _transpose(node, ins, out, attrs, ctx):
+    return [{"op_type": "Transpose", "name": node.name, "inputs": ins,
+             "outputs": [out],
+             "attrs": {"perm": list(attrs.get("axes") or [])}}]
+
+
+@mx2onnx("Concat")
+def _concat(node, ins, out, attrs, ctx):
+    return [{"op_type": "Concat", "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": {"axis": int(attrs.get("dim", 1))}}]
+
+
+@mx2onnx("clip")
+def _clip(node, ins, out, attrs, ctx):
+    lo = ctx.add_initializer("min", np.float32(attrs.get("a_min")))
+    hi = ctx.add_initializer("max", np.float32(attrs.get("a_max")))
+    return [{"op_type": "Clip", "name": node.name,
+             "inputs": [ins[0], lo, hi], "outputs": [out], "attrs": {}}]
+
+
+@mx2onnx("LeakyReLU")
+def _leaky(node, ins, out, attrs, ctx):
+    act = attrs.get("act_type", "leaky")
+    if act == "leaky":
+        return [{"op_type": "LeakyRelu", "name": node.name,
+                 "inputs": ins[:1], "outputs": [out],
+                 "attrs": {"alpha": float(attrs.get("slope", 0.25))}}]
+    if act == "elu":
+        return [{"op_type": "Elu", "name": node.name, "inputs": ins[:1],
+                 "outputs": [out],
+                 "attrs": {"alpha": float(attrs.get("slope", 0.25))}}]
+    if act == "prelu":
+        return [{"op_type": "PRelu", "name": node.name, "inputs": ins[:2],
+                 "outputs": [out], "attrs": {}}]
+    raise MXNetError(f"ONNX export: LeakyReLU act_type {act} unsupported")
+
+
+@mx2onnx("mean")
+def _mean(node, ins, out, attrs, ctx):
+    axes = attrs.get("axis")
+    a = {"keepdims": int(bool(attrs.get("keepdims", False)))}
+    if axes is not None:
+        a["axes"] = list(axes) if isinstance(axes, (tuple, list)) \
+            else [int(axes)]
+    return [{"op_type": "ReduceMean", "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": a}]
+
+
+def export_graph(sym, params, in_shapes=None, in_types=None,
+                 graph_name="mxnet_tpu"):
+    """Symbol + params -> dict-proto model (pure data transform, no I/O).
+
+    ``params``: {name: array} — "arg:"/"aux:" prefixes accepted.
+    ``in_shapes``/``in_types``: per data input, in list_arguments order.
+    """
+    params = {(k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k):
+              np.asarray(getattr(v, "asnumpy", lambda: v)())
+              for k, v in (params or {}).items()}
+    ctx = _Ctx(params)
+    topo = sym._topo()
+    out_syms = sym._output_symbols() if hasattr(sym, "_output_symbols") \
+        else [sym]
+
+    data_inputs = []
+    initializers = [{"name": k, "data": v} for k, v in params.items()]
+    nodes = []
+    n_data = 0
+    for node in topo:
+        if node.op is None:
+            if node.name not in params:
+                shape = tuple(in_shapes[n_data]) if in_shapes else ()
+                dtype = (in_types[n_data] if in_types else "float32")
+                data_inputs.append({"name": node.name,
+                                    "dtype": str(np.dtype(dtype)),
+                                    "shape": shape})
+                n_data += 1
+            continue
+        if node.op == "_group":
+            continue
+        conv = _EXPORTERS.get(node.op)
+        if conv is None:
+            raise MXNetError(
+                f"ONNX export: no converter for op {node.op!r} "
+                f"(node {node.name!r}); register one with "
+                f"@mxnet_tpu.contrib.onnx.mx2onnx.mx2onnx")
+        from ...symbol.symbol import Symbol as _Sym
+        ins = [ctx.tname(s) for s in node.inputs]
+        out = ctx.out_name(node)
+        nodes.extend(conv(node, ins, out, dict(node.attrs), ctx))
+    initializers.extend(ctx.extra_initializers)
+
+    outputs = []
+    for s in out_syms:
+        nm = ctx.tname(s)
+        outputs.append({"name": nm, "dtype": "float32", "shape": ()})
+    used = set()
+    for n in nodes:
+        used.update(n["inputs"])
+    used.update(o["name"] for o in outputs)
+    initializers = [t for t in initializers if t["name"] in used]
+    return {"ir_version": 8, "opset": 13, "producer_name": "mxnet_tpu",
+            "graph": {"name": graph_name, "nodes": nodes,
+                      "initializers": initializers,
+                      "inputs": data_inputs, "outputs": outputs}}

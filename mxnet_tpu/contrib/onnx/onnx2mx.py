@@ -1,0 +1,312 @@
+"""ONNX graph -> Symbol conversion (ref: python/mxnet/contrib/onnx/
+onnx2mx/_op_translations.py). Returns (sym, arg_params, aux_params) like
+the reference's import_model; the importer registry is open (@onnx2mx)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+
+_IMPORTERS = {}
+
+
+def onnx2mx(op_type):
+    def deco(fn):
+        _IMPORTERS[op_type] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    def __init__(self):
+        self.tensors = {}       # tensor name -> Symbol
+        self.params = {}        # param name -> np.ndarray
+        self.aux_names = set()
+
+    def sym(self, name):
+        if name not in self.tensors:
+            raise MXNetError(f"ONNX import: undefined tensor {name!r} "
+                             f"(graph not topologically ordered?)")
+        return self.tensors[name]
+
+    def const_value(self, name):
+        """The numpy value behind an initializer input (e.g. Reshape's
+        shape); removes it from the importable params."""
+        if name not in self.params:
+            raise MXNetError(
+                f"ONNX import: input {name!r} must be a constant "
+                f"initializer for this op")
+        return self.params.pop(name)
+
+
+def _sym_mod():
+    from ... import symbol
+    return symbol
+
+
+def _sympair(pads, op):
+    pads = list(pads or [])
+    if not pads:
+        return None
+    half = len(pads) // 2
+    begin, end = pads[:half], pads[half:]
+    if begin != end:
+        raise MXNetError(f"ONNX import: asymmetric pads {pads} not "
+                         f"supported for {op}")
+    return tuple(begin)
+
+
+@onnx2mx("Conv")
+def _conv(node, ins, attrs, ctx):
+    sym = _sym_mod()
+    wname = node["inputs"][1]
+    if wname not in ctx.params:
+        raise MXNetError("ONNX import: Conv weight must be an initializer")
+    wshape = ctx.params[wname].shape
+    kernel = tuple(attrs.get("kernel_shape") or wshape[2:])
+    return sym.Convolution(
+        *ins, kernel=kernel,
+        stride=tuple(attrs.get("strides") or (1,) * len(kernel)),
+        dilate=tuple(attrs.get("dilations") or (1,) * len(kernel)),
+        pad=_sympair(attrs.get("pads"), "Conv") or (0,) * len(kernel),
+        num_filter=int(wshape[0]),
+        num_group=int(attrs.get("group", 1)),
+        no_bias=len(ins) < 3, name=node.get("name") or None)
+
+
+@onnx2mx("Gemm")
+def _gemm(node, ins, attrs, ctx):
+    sym = _sym_mod()
+    if int(attrs.get("transA", 0)):
+        raise MXNetError("ONNX import: Gemm transA=1 unsupported")
+    wname = node["inputs"][1]
+    if wname not in ctx.params:
+        raise MXNetError("ONNX import: Gemm B must be an initializer")
+    w = ctx.params[wname]
+    if not int(attrs.get("transB", 0)):
+        ctx.params[wname] = w = np.ascontiguousarray(w.T)
+    alpha = float(attrs.get("alpha", 1.0))
+    if alpha != 1.0:
+        ctx.params[wname] = w = w * alpha
+    beta = float(attrs.get("beta", 1.0))
+    if len(ins) > 2 and beta != 1.0:
+        bname = node["inputs"][2]
+        if bname in ctx.params:
+            ctx.params[bname] = ctx.params[bname] * beta
+    return sym.FullyConnected(*ins, num_hidden=int(w.shape[0]),
+                              no_bias=len(ins) < 3, flatten=True,
+                              name=node.get("name") or None)
+
+
+@onnx2mx("MatMul")
+def _matmul(node, ins, attrs, ctx):
+    sym = _sym_mod()
+    wname = node["inputs"][1]
+    if wname in ctx.params and ctx.params[wname].ndim == 2:
+        ctx.params[wname] = np.ascontiguousarray(ctx.params[wname].T)
+        return sym.FullyConnected(
+            ins[0], ctx.sym(wname),
+            num_hidden=int(ctx.params[wname].shape[0]), no_bias=True,
+            flatten=False, name=node.get("name") or None)
+    return sym.dot(*ins, name=node.get("name") or None)
+
+
+@onnx2mx("BatchNormalization")
+def _bn(node, ins, attrs, ctx):
+    sym = _sym_mod()
+    for nm in node["inputs"][3:5]:
+        ctx.aux_names.add(nm)
+    return sym.BatchNorm(*ins, eps=float(attrs.get("epsilon", 1e-5)),
+                         momentum=float(attrs.get("momentum", 0.9)),
+                         fix_gamma=False, use_global_stats=False,
+                         name=node.get("name") or None)
+
+
+for _onnx, _act in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
+                    ("Tanh", "tanh"), ("Softplus", "softrelu"),
+                    ("Softsign", "softsign")]:
+    def _make_act(act_type):
+        def conv(node, ins, attrs, ctx):
+            return _sym_mod().Activation(ins[0], act_type=act_type,
+                                         name=node.get("name") or None)
+        return conv
+    _IMPORTERS[_onnx] = _make_act(_act)
+
+for _onnx, _mx in [("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"),
+                   ("Abs", "abs"), ("Neg", "negative"), ("Erf", "erf"),
+                   ("Floor", "floor"), ("Ceil", "ceil")]:
+    def _make_unary(mx_name):
+        def conv(node, ins, attrs, ctx):
+            return getattr(_sym_mod(), mx_name)(
+                ins[0], name=node.get("name") or None)
+        return conv
+    _IMPORTERS[_onnx] = _make_unary(_mx)
+
+for _onnx, _mx in [("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+                   ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
+                   ("Max", "broadcast_maximum"),
+                   ("Min", "broadcast_minimum")]:
+    def _make_binary(mx_name):
+        def conv(node, ins, attrs, ctx):
+            return getattr(_sym_mod(), mx_name)(
+                ins[0], ins[1], name=node.get("name") or None)
+        return conv
+    _IMPORTERS[_onnx] = _make_binary(_mx)
+
+
+def _pool(node, ins, attrs, ctx, ptype, global_pool):
+    sym = _sym_mod()
+    if global_pool:
+        return sym.Pooling(ins[0], kernel=(1, 1), pool_type=ptype,
+                           global_pool=True,
+                           name=node.get("name") or None)
+    kernel = tuple(attrs["kernel_shape"])
+    return sym.Pooling(
+        ins[0], kernel=kernel, pool_type=ptype,
+        stride=tuple(attrs.get("strides") or (1,) * len(kernel)),
+        pad=_sympair(attrs.get("pads"), "Pool") or (0,) * len(kernel),
+        pooling_convention="full" if int(attrs.get("ceil_mode", 0))
+        else "valid",
+        count_include_pad=bool(attrs.get("count_include_pad", 1)),
+        name=node.get("name") or None)
+
+
+_IMPORTERS["MaxPool"] = lambda n, i, a, c: _pool(n, i, a, c, "max", False)
+_IMPORTERS["AveragePool"] = lambda n, i, a, c: _pool(n, i, a, c, "avg",
+                                                     False)
+_IMPORTERS["GlobalMaxPool"] = lambda n, i, a, c: _pool(n, i, a, c, "max",
+                                                       True)
+_IMPORTERS["GlobalAveragePool"] = lambda n, i, a, c: _pool(n, i, a, c,
+                                                           "avg", True)
+
+
+@onnx2mx("Flatten")
+def _flatten(node, ins, attrs, ctx):
+    axis = int(attrs.get("axis", 1))
+    if axis != 1:
+        raise MXNetError(f"ONNX import: Flatten axis={axis} unsupported")
+    return _sym_mod().Flatten(ins[0], name=node.get("name") or None)
+
+
+@onnx2mx("Softmax")
+def _softmax(node, ins, attrs, ctx):
+    return _sym_mod().softmax(ins[0], axis=int(attrs.get("axis", -1)),
+                              name=node.get("name") or None)
+
+
+@onnx2mx("LogSoftmax")
+def _log_softmax(node, ins, attrs, ctx):
+    return _sym_mod().log_softmax(ins[0], axis=int(attrs.get("axis", -1)),
+                                  name=node.get("name") or None)
+
+
+@onnx2mx("Reshape")
+def _reshape(node, ins, attrs, ctx):
+    shape = tuple(int(s) for s in ctx.const_value(node["inputs"][1]))
+    return _sym_mod().reshape(ins[0], shape=shape,
+                              name=node.get("name") or None)
+
+
+@onnx2mx("Transpose")
+def _transpose(node, ins, attrs, ctx):
+    return _sym_mod().transpose(ins[0],
+                                axes=tuple(attrs.get("perm") or ()),
+                                name=node.get("name") or None)
+
+
+@onnx2mx("Concat")
+def _concat(node, ins, attrs, ctx):
+    return _sym_mod().Concat(*ins, dim=int(attrs.get("axis", 1)),
+                             name=node.get("name") or None)
+
+
+@onnx2mx("Clip")
+def _clip(node, ins, attrs, ctx):
+    lo = attrs.get("min")
+    hi = attrs.get("max")
+    if lo is None and len(node["inputs"]) > 1:
+        lo = float(ctx.const_value(node["inputs"][1]))
+    if hi is None and len(node["inputs"]) > 2:
+        hi = float(ctx.const_value(node["inputs"][2]))
+    return _sym_mod().clip(ins[0], a_min=float(lo), a_max=float(hi),
+                           name=node.get("name") or None)
+
+
+@onnx2mx("LeakyRelu")
+def _leaky(node, ins, attrs, ctx):
+    return _sym_mod().LeakyReLU(ins[0], act_type="leaky",
+                                slope=float(attrs.get("alpha", 0.01)),
+                                name=node.get("name") or None)
+
+
+@onnx2mx("Elu")
+def _elu(node, ins, attrs, ctx):
+    return _sym_mod().LeakyReLU(ins[0], act_type="elu",
+                                slope=float(attrs.get("alpha", 1.0)),
+                                name=node.get("name") or None)
+
+
+@onnx2mx("ReduceMean")
+def _reduce_mean(node, ins, attrs, ctx):
+    return _sym_mod().mean(ins[0], axis=tuple(attrs.get("axes") or ()),
+                           keepdims=bool(attrs.get("keepdims", 1)),
+                           name=node.get("name") or None)
+
+
+@onnx2mx("Dropout")
+def _dropout(node, ins, attrs, ctx):
+    return ins[0]                 # inference identity
+
+
+@onnx2mx("Identity")
+def _identity(node, ins, attrs, ctx):
+    return ins[0]
+
+
+@onnx2mx("Constant")
+def _constant(node, ins, attrs, ctx):
+    val = attrs.get("value")
+    if val is None:
+        raise MXNetError("ONNX import: Constant without value")
+    name = node["outputs"][0]
+    ctx.params[name] = np.asarray(val)
+    return _sym_mod().var(name)
+
+
+def import_graph(model):
+    """dict-proto model -> (sym, arg_params {name: np}, aux_params)."""
+    from ...symbol import Group, var
+    g = model["graph"]
+    ctx = _Ctx()
+    for t in g.get("initializers", []):
+        ctx.params[t["name"]] = np.asarray(t["data"])
+        ctx.tensors[t["name"]] = var(t["name"])
+    for vi in g["inputs"]:
+        if vi["name"] not in ctx.tensors:
+            ctx.tensors[vi["name"]] = var(vi["name"])
+    for node in g["nodes"]:
+        imp = _IMPORTERS.get(node["op_type"])
+        if imp is None:
+            raise MXNetError(
+                f"ONNX import: no converter for op_type "
+                f"{node['op_type']!r} (node {node.get('name')!r}); "
+                f"register one with "
+                f"@mxnet_tpu.contrib.onnx.onnx2mx.onnx2mx")
+        ins = [ctx.sym(n) for n in node["inputs"] if n]
+        out_syms = imp(node, ins, node.get("attrs", {}), ctx)
+        outs = node["outputs"]
+        if not isinstance(out_syms, (list, tuple)):
+            out_syms = [out_syms]
+        for nm, s in zip(outs, out_syms):
+            ctx.tensors[nm] = s
+    out_names = [o["name"] for o in g["outputs"]]
+    outs = [ctx.sym(n) for n in out_names]
+    sym = outs[0] if len(outs) == 1 else Group(outs)
+    # split params by BN-aux slots; only tensors still referenced count
+    ref_args = set(sym.list_arguments())
+    ref_aux = set(sym.list_auxiliary_states())
+    arg_params = {k: v for k, v in ctx.params.items()
+                  if k in ref_args and k not in ctx.aux_names}
+    aux_params = {k: v for k, v in ctx.params.items()
+                  if k in ref_aux or k in ctx.aux_names}
+    return sym, arg_params, aux_params

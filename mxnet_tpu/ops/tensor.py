@@ -255,9 +255,10 @@ def shifted_expsum(x, axis=-1):
     materializing an fp32 tensor of x's shape. One definition backs
     log_softmax, logsumexp and the short-sequence attention softmax so
     their numerics stay consistent."""
+    acc = jnp.promote_types(x.dtype, jnp.float32)   # fp64 in stays fp64
     m = jax.lax.stop_gradient(jnp.max(x, axis=axis, keepdims=True))
     shifted = x - m
-    se32 = jnp.sum(jnp.exp(shifted).astype(jnp.float32), axis=axis,
+    se32 = jnp.sum(jnp.exp(shifted).astype(acc), axis=axis,
                    keepdims=True)
     return m, shifted, se32
 
@@ -271,7 +272,7 @@ def shifted_expsum(x, axis=-1):
               "src/operator/softmax_output.cc)")
 def _logsumexp(x, axis=-1, keepdims=False):
     m, _, se32 = shifted_expsum(x, axis=axis)
-    out = m.astype(jnp.float32) + jnp.log(se32)
+    out = m.astype(se32.dtype) + jnp.log(se32)
     return out if keepdims else jnp.squeeze(out, axis)
 
 

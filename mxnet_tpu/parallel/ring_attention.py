@@ -120,7 +120,14 @@ def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None):
 
     (o, l, m), _ = lax.scan(step, (o0, l0, m0),
                             (jnp.arange(n_blocks), kb, vb))
-    return (o / l[..., None]).astype(q.dtype)
+    out = (o / l[..., None]).astype(q.dtype)
+    if causal and s_q > s_k:
+        # bottom-right alignment leaves queries i < s_q - s_k with an
+        # empty allowed-key set; zero them like attention_reference does
+        # (an all-masked row otherwise softmaxes uniformly over _NEG)
+        valid = (jnp.arange(s_q) + (s_k - s_q) >= 0)
+        out = out * valid[:, None].astype(out.dtype)
+    return out
 
 
 def _ring_body(q, k, v, axis_name, causal, scale, f32=jnp.float32):

@@ -1,0 +1,132 @@
+"""ONNX export/import: wire codec round trips, model round trips
+(ref test analog: tests/python-pytest/onnx/ in the reference)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import onnx as mxonnx
+from mxnet_tpu.contrib.onnx import proto
+
+
+def test_proto_codec_roundtrip():
+    model = {
+        "ir_version": 8, "opset": 13, "producer_name": "mxnet_tpu",
+        "graph": {
+            "name": "g",
+            "inputs": [{"name": "x", "dtype": "float32",
+                        "shape": (2, 3)}],
+            "outputs": [{"name": "y", "dtype": "float32", "shape": ()}],
+            "initializers": [
+                {"name": "w", "data": np.arange(6, dtype=np.float32)
+                 .reshape(2, 3)},
+                {"name": "idx", "data": np.asarray([-1, 0, 7],
+                                                   np.int64)}],
+            "nodes": [{"op_type": "Gemm", "name": "n0",
+                       "inputs": ["x", "w"], "outputs": ["y"],
+                       "attrs": {"alpha": 1.5, "transB": 1,
+                                 "axis": -1, "mode": "test",
+                                 "ints": [1, -2, 3],
+                                 "floats": [0.5, 1.25]}}],
+        }}
+    buf = proto.encode_model(model)
+    got = proto.decode_model(bytes(buf))
+    assert got["ir_version"] == 8 and got["opset"] == 13
+    g = got["graph"]
+    assert g["inputs"][0]["shape"] == (2, 3)
+    w = {t["name"]: t["data"] for t in g["initializers"]}
+    np.testing.assert_array_equal(
+        w["w"], np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_array_equal(w["idx"], [-1, 0, 7])
+    a = g["nodes"][0]["attrs"]
+    assert a["alpha"] == pytest.approx(1.5)
+    assert a["transB"] == 1 and a["axis"] == -1
+    assert a["mode"] == "test"
+    assert a["ints"] == [1, -2, 3]
+    assert a["floats"] == pytest.approx([0.5, 1.25])
+
+
+def _lenet():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(6, 5, activation="tanh"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(16, 5, activation="tanh"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(120, activation="tanh"),
+            gluon.nn.Dense(84, activation="tanh"),
+            gluon.nn.Dense(10))
+    return net
+
+
+def _roundtrip(net, x, tmp_path, name, tol=1e-4):
+    net.initialize()
+    net.hybridize()
+    want = net(nd.array(x)).asnumpy()
+    prefix = str(tmp_path / name)
+    net.export(prefix)
+    path = mxonnx.export_model(
+        f"{prefix}-symbol.json", f"{prefix}-0000.params",
+        input_shape=[x.shape], onnx_file_path=f"{prefix}.onnx")
+    sym, arg_params, aux_params = mxonnx.import_model(path)
+    data = [n for n in sym.list_arguments() if n not in arg_params]
+    assert len(data) == 1
+    ex = sym.bind(mx.cpu(), dict({data[0]: nd.array(x)}, **arg_params),
+                  aux_states=aux_params)
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, atol=tol, rtol=tol)
+    return path, want
+
+
+def test_lenet_roundtrip(tmp_path):
+    x = np.random.randn(4, 1, 28, 28).astype(np.float32)
+    path, want = _roundtrip(_lenet(), x, tmp_path, "lenet")
+    meta = mxonnx.get_model_metadata(path)
+    assert meta["input_tensor_data"][0][1] == (4, 1, 28, 28)
+
+
+def test_resnet18_roundtrip_and_gluon_import(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    # make BN running stats non-trivial before export
+    for _ in range(2):
+        with autograd.record():
+            net(nd.array(np.random.randn(4, 3, 32, 32)
+                         .astype(np.float32)))
+    x = np.random.randn(2, 3, 32, 32).astype(np.float32)
+    path, want = _roundtrip(net, x, tmp_path, "rn18", tol=1e-3)
+    blk = mxonnx.import_to_gluon(path)
+    got = blk(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+
+
+def test_export_unsupported_op_message(tmp_path):
+    s = mx.sym.var("a")
+    out = mx.sym.topk(s, k=2)
+    with pytest.raises(MXNetError, match="no converter"):
+        mxonnx.export_model(out, {}, input_shape=[(3, 4)],
+                            onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_import_graph_dict_level():
+    w = np.random.randn(4, 3).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    model = {"ir_version": 8, "opset": 13, "graph": {
+        "name": "mlp",
+        "inputs": [{"name": "x", "dtype": "float32", "shape": (2, 3)}],
+        "outputs": [{"name": "y", "dtype": "float32", "shape": ()}],
+        "initializers": [{"name": "w", "data": w},
+                         {"name": "b", "data": b}],
+        "nodes": [
+            {"op_type": "Gemm", "name": "fc", "inputs": ["x", "w", "b"],
+             "outputs": ["h"], "attrs": {"transB": 1}},
+            {"op_type": "Relu", "name": "act", "inputs": ["h"],
+             "outputs": ["y"], "attrs": {}}]}}
+    sym, arg_params, aux_params = mxonnx.import_model(model)
+    x = np.random.randn(2, 3).astype(np.float32)
+    ex = sym.bind(mx.cpu(), dict({"x": nd.array(x)}, **arg_params))
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, np.maximum(x @ w.T + b, 0),
+                               atol=1e-5)
