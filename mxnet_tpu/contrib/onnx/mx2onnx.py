@@ -299,7 +299,6 @@ def export_graph(sym, params, in_shapes=None, in_types=None,
                 f"ONNX export: no converter for op {node.op!r} "
                 f"(node {node.name!r}); register one with "
                 f"@mxnet_tpu.contrib.onnx.mx2onnx.mx2onnx")
-        from ...symbol.symbol import Symbol as _Sym
         ins = [ctx.tname(s) for s in node.inputs]
         out = ctx.out_name(node)
         nodes.extend(conv(node, ins, out, dict(node.attrs), ctx))
@@ -308,7 +307,7 @@ def export_graph(sym, params, in_shapes=None, in_types=None,
     outputs = []
     for s in out_syms:
         nm = ctx.tname(s)
-        outputs.append({"name": nm, "dtype": "float32", "shape": ()})
+        outputs.append({"name": nm, "dtype": "float32", "shape": None})
     used = set()
     for n in nodes:
         used.update(n["inputs"])

@@ -18,10 +18,12 @@ def onnx2mx(op_type):
 
 
 class _Ctx:
-    def __init__(self):
+    def __init__(self, use_count=None):
         self.tensors = {}       # tensor name -> Symbol
         self.params = {}        # param name -> np.ndarray
         self.aux_names = set()
+        self.const_used = set()  # names consumed as op constants
+        self.use_count = use_count or {}
 
     def sym(self, name):
         if name not in self.tensors:
@@ -31,12 +33,32 @@ class _Ctx:
 
     def const_value(self, name):
         """The numpy value behind an initializer input (e.g. Reshape's
-        shape); removes it from the importable params."""
+        shape). Non-destructive: names whose only consumers are constant
+        reads are dropped from the params at the end of the import."""
         if name not in self.params:
             raise MXNetError(
                 f"ONNX import: input {name!r} must be a constant "
                 f"initializer for this op")
-        return self.params.pop(name)
+        self.const_used.add(name)
+        return self.params[name]
+
+    def transform_param(self, name, fn):
+        """Apply a value transform (transpose/scale) to an initializer.
+        A shared initializer (used by several nodes) is copied under a
+        fresh name so other consumers see the original value; returns the
+        name to reference."""
+        if self.use_count.get(name, 1) > 1:
+            new = name
+            i = 1
+            while new in self.params:
+                new = f"{name}__t{i}"
+                i += 1
+            self.params[new] = fn(self.params[name])
+            from ...symbol import var
+            self.tensors[new] = var(new)
+            return new
+        self.params[name] = fn(self.params[name])
+        return name
 
 
 def _sym_mod():
@@ -82,19 +104,23 @@ def _gemm(node, ins, attrs, ctx):
     wname = node["inputs"][1]
     if wname not in ctx.params:
         raise MXNetError("ONNX import: Gemm B must be an initializer")
-    w = ctx.params[wname]
-    if not int(attrs.get("transB", 0)):
-        ctx.params[wname] = w = np.ascontiguousarray(w.T)
     alpha = float(attrs.get("alpha", 1.0))
-    if alpha != 1.0:
-        ctx.params[wname] = w = w * alpha
+    trans_b = int(attrs.get("transB", 0))
+    if not trans_b or alpha != 1.0:
+        wname = ctx.transform_param(
+            wname, lambda w: (w if trans_b
+                              else np.ascontiguousarray(w.T)) * alpha)
+    w = ctx.params[wname]
     beta = float(attrs.get("beta", 1.0))
-    if len(ins) > 2 and beta != 1.0:
+    bias = []
+    if len(node["inputs"]) > 2:
         bname = node["inputs"][2]
-        if bname in ctx.params:
-            ctx.params[bname] = ctx.params[bname] * beta
-    return sym.FullyConnected(*ins, num_hidden=int(w.shape[0]),
-                              no_bias=len(ins) < 3, flatten=True,
+        if beta != 1.0 and bname in ctx.params:
+            bname = ctx.transform_param(bname, lambda b: b * beta)
+        bias = [ctx.sym(bname)]
+    return sym.FullyConnected(ins[0], ctx.sym(wname), *bias,
+                              num_hidden=int(w.shape[0]),
+                              no_bias=not bias, flatten=True,
                               name=node.get("name") or None)
 
 
@@ -103,7 +129,8 @@ def _matmul(node, ins, attrs, ctx):
     sym = _sym_mod()
     wname = node["inputs"][1]
     if wname in ctx.params and ctx.params[wname].ndim == 2:
-        ctx.params[wname] = np.ascontiguousarray(ctx.params[wname].T)
+        wname = ctx.transform_param(
+            wname, lambda w: np.ascontiguousarray(w.T))
         return sym.FullyConnected(
             ins[0], ctx.sym(wname),
             num_hidden=int(ctx.params[wname].shape[0]), no_bias=True,
@@ -167,7 +194,7 @@ def _pool(node, ins, attrs, ctx, ptype, global_pool):
         pad=_sympair(attrs.get("pads"), "Pool") or (0,) * len(kernel),
         pooling_convention="full" if int(attrs.get("ceil_mode", 0))
         else "valid",
-        count_include_pad=bool(attrs.get("count_include_pad", 1)),
+        count_include_pad=bool(attrs.get("count_include_pad", 0)),
         name=node.get("name") or None)
 
 
@@ -224,11 +251,14 @@ def _concat(node, ins, attrs, ctx):
 def _clip(node, ins, attrs, ctx):
     lo = attrs.get("min")
     hi = attrs.get("max")
-    if lo is None and len(node["inputs"]) > 1:
+    if lo is None and len(node["inputs"]) > 1 and node["inputs"][1]:
         lo = float(ctx.const_value(node["inputs"][1]))
-    if hi is None and len(node["inputs"]) > 2:
+    if hi is None and len(node["inputs"]) > 2 and node["inputs"][2]:
         hi = float(ctx.const_value(node["inputs"][2]))
-    return _sym_mod().clip(ins[0], a_min=float(lo), a_max=float(hi),
+    # ONNX spec: absent bound means unbounded on that side
+    lo = float(lo) if lo is not None else float(np.finfo(np.float32).min)
+    hi = float(hi) if hi is not None else float(np.finfo(np.float32).max)
+    return _sym_mod().clip(ins[0], a_min=lo, a_max=hi,
                            name=node.get("name") or None)
 
 
@@ -277,7 +307,11 @@ def import_graph(model):
     """dict-proto model -> (sym, arg_params {name: np}, aux_params)."""
     from ...symbol import Group, var
     g = model["graph"]
-    ctx = _Ctx()
+    use_count = {}
+    for node in g["nodes"]:
+        for n in node["inputs"]:
+            use_count[n] = use_count.get(n, 0) + 1
+    ctx = _Ctx(use_count)
     for t in g.get("initializers", []):
         ctx.params[t["name"]] = np.asarray(t["data"])
         ctx.tensors[t["name"]] = var(t["name"])
@@ -302,11 +336,14 @@ def import_graph(model):
     out_names = [o["name"] for o in g["outputs"]]
     outs = [ctx.sym(n) for n in out_names]
     sym = outs[0] if len(outs) == 1 else Group(outs)
-    # split params by BN-aux slots; only tensors still referenced count
+    # split params by BN-aux slots; keep only tensors the rebuilt graph
+    # still references (constant-only inputs like Reshape shapes drop out
+    # here naturally — they never become graph variables)
     ref_args = set(sym.list_arguments())
     ref_aux = set(sym.list_auxiliary_states())
     arg_params = {k: v for k, v in ctx.params.items()
                   if k in ref_args and k not in ctx.aux_names}
     aux_params = {k: v for k, v in ctx.params.items()
-                  if k in ref_aux or k in ctx.aux_names}
+                  if k in ref_aux or (k in ctx.aux_names
+                                      and k in ref_aux | ref_args)}
     return sym, arg_params, aux_params

@@ -161,13 +161,18 @@ def _enc_attr(name, value):
 
 
 def _enc_value_info(vi):
-    shape_msg = b"".join(
-        _ld(1, _vint(1, d) if isinstance(d, int) and d > 0
-            else _vstr(2, str(d or "?")))
-        for d in vi.get("shape", ()))
     tensor_type = _vint(1, DTYPE_TO_ONNX[np.dtype(vi.get("dtype",
                                                          "float32"))])
-    tensor_type += _ld(2, shape_msg)
+    shape = vi.get("shape")
+    if shape is not None and shape != ():
+        # absent shape field = unknown rank (ONNX semantics); an empty
+        # TensorShapeProto would instead declare a rank-0 scalar, so
+        # unknown shapes (None or ()) omit the field entirely
+        shape_msg = b"".join(
+            _ld(1, _vint(1, d) if isinstance(d, int) and d > 0
+                else _vstr(2, str(d or "?")))
+            for d in shape)
+        tensor_type += _ld(2, shape_msg)
     return _vstr(1, vi["name"]) + _ld(2, _ld(1, tensor_type))
 
 

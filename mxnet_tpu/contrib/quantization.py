@@ -12,7 +12,7 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["quantize_model", "calib_thresholds_minmax",
+__all__ = ["quantize_model", "quantize_net", "calib_thresholds_minmax",
            "calib_thresholds_entropy"]
 
 
@@ -26,45 +26,297 @@ def calib_thresholds_minmax(arrays):
     return out
 
 
-def calib_thresholds_entropy(arrays, num_bins=8001, num_quantized_bins=255):
-    """KL-divergence threshold search (ref: quantization.py
-    _get_optimal_threshold)."""
+def _smooth(p, eps=0.0001):
+    """ref: quantization.py _smooth_distribution — move eps mass onto
+    zero bins so KL is defined."""
+    is_zero = p == 0
+    n_zero = is_zero.sum()
+    n_nonzero = p.size - n_zero
+    if n_nonzero == 0:
+        return None
+    eps1 = eps * n_zero / n_nonzero
+    out = p.astype(np.float64).copy()
+    out[is_zero] = eps
+    out[~is_zero] -= eps1
+    if (out[~is_zero] <= 0).any():
+        return None
+    return out
+
+
+def _optimal_threshold(a, num_bins=2001, num_quantized_bins=255):
+    """KL-divergence threshold search over the |activation| histogram
+    (ref: quantization.py _get_optimal_threshold). Clipped distribution p
+    (outlier mass saturated into the last bin) is compared against its
+    255-level quantization q, with q's per-group mass redistributed over
+    the group's nonzero bins like the reference does."""
+    amax = float(a.max()) if a.size else 0.0
+    if amax == 0:
+        return 0.0
+    hist, edges = np.histogram(a, bins=num_bins, range=(0, amax))
+    best_kl, best_t = np.inf, amax
+    step = max(1, (num_bins - num_quantized_bins) // 256)
+    for i in range(num_quantized_bins, num_bins + 1, step):
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()
+        if p.sum() == 0:
+            continue
+        nonzero = (p != 0)
+        # quantize the i bins into num_quantized_bins groups
+        group = (np.arange(i) * num_quantized_bins) // i
+        sums = np.bincount(group, weights=hist[:i].astype(np.float64),
+                           minlength=num_quantized_bins)
+        counts = np.bincount(group, weights=nonzero.astype(np.float64),
+                             minlength=num_quantized_bins)
+        q = np.zeros(i)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per_bin = np.where(counts > 0, sums / np.maximum(counts, 1),
+                               0.0)
+        q[nonzero] = per_bin[group[nonzero]]
+        # smooth the raw count vectors (reference order: smooth, then the
+        # KL normalizes) — smoothing after normalization would drive small
+        # bins negative and skip valid candidates
+        ps = _smooth(p)
+        qs = _smooth(q) if q.sum() else None
+        if ps is None or qs is None:
+            continue
+        ps = ps / ps.sum()
+        qs = qs / qs.sum()
+        kl = float(np.sum(ps * np.log(ps / qs)))
+        if kl < best_kl:
+            best_kl, best_t = kl, edges[i]
+    return best_t
+
+
+def calib_thresholds_entropy(arrays, num_bins=2001, num_quantized_bins=255):
+    """KL-divergence calibration per tensor (ref: quantization.py
+    _get_optimal_thresholds)."""
     out = {}
     for name, arr in arrays.items():
         a = np.abs(np.asarray(arr.asnumpy() if hasattr(arr, "asnumpy")
                               else arr)).ravel()
-        amax = a.max() if a.size else 0.0
-        if amax == 0:
-            out[name] = (0.0, 0.0)
-            continue
-        hist, edges = np.histogram(a, bins=num_bins, range=(0, amax))
-        best_kl, best_t = np.inf, amax
-        for i in range(num_quantized_bins, num_bins,
-                       max(1, num_bins // 64)):
-            p = hist[:i].astype(np.float64).copy()
-            p[-1] += hist[i:].sum()
-            if p.sum() == 0:
-                continue
-            factor = i / num_quantized_bins
-            q = np.repeat(
-                np.add.reduceat(p, np.arange(0, i,
-                                             max(1, int(factor)))),
-                max(1, int(factor)))[:i]
-            p /= p.sum()
-            q = q / q.sum()
-            mask = p > 0
-            kl = float(np.sum(p[mask] * np.log(p[mask]
-                                               / np.maximum(q[mask], 1e-12))))
-            if kl < best_kl:
-                best_kl, best_t = kl, edges[i]
-        out[name] = (-best_t, best_t)
+        t = _optimal_threshold(a, num_bins=num_bins,
+                               num_quantized_bins=num_quantized_bins)
+        out[name] = (-t, t)
     return out
 
 
-def quantize_model(*args, **kwargs):
-    raise MXNetError(
-        "INT8 quantized inference kernels are not implemented in the TPU "
-        "build yet (reference: src/operator/quantization/). The TPU path "
-        "is AQT-style int8 XLA matmuls; bf16 inference via "
-        "amp.convert_hybrid_block covers most deployment cases today. "
-        "Calibration utilities (calib_thresholds_*) are available.")
+def _collect_layer_inputs(sym, arg_params, aux_params, calib_data,
+                          data_names, tensor_names, max_batches):
+    """Run calib batches through the graph internals and collect the
+    fp32 values of ``tensor_names`` (the inputs of to-be-quantized ops)
+    (ref: quantization.py _collect_layer_statistics)."""
+    from .. import ndarray as nd
+    from ..context import current_context
+    internals = sym.get_internals()
+    by_name = {}
+    for s in internals:
+        by_name.setdefault(s.name, s)
+    wanted = [n for n in tensor_names if n in by_name]
+    if not wanted:
+        return {}
+    from ..symbol import Group
+    group = Group([by_name[n] for n in wanted])
+    collected = {n: [] for n in wanted}
+    n_done = 0
+    for batch in calib_data:
+        datas = batch if isinstance(batch, (list, tuple)) else [batch]
+        binds = dict(zip(data_names, [nd.array(d) for d in datas]))
+        binds.update({k: nd.array(v.asnumpy() if hasattr(v, "asnumpy")
+                                  else v) for k, v in arg_params.items()})
+        ex = group.bind(current_context(), binds,
+                        aux_states={k: nd.array(
+                            v.asnumpy() if hasattr(v, "asnumpy") else v)
+                            for k, v in aux_params.items()})
+        outs = ex.forward()
+        for n, o in zip(wanted, outs):
+            collected[n].append(o.asnumpy())
+        n_done += 1
+        if max_batches is not None and n_done >= max_batches:
+            break
+    return {n: np.concatenate([a.ravel() for a in arrs])
+            for n, arrs in collected.items() if arrs}
+
+
+_QUANTIZABLE = ("Convolution", "FullyConnected")
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=(), calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", ctx=None, logger=None):
+    """Rewrite Convolution/FullyConnected nodes to int8 compute
+    (ref: python/mxnet/contrib/quantization.py quantize_model).
+
+    Returns (qsym, qarg_params, aux_params). Weights are pre-quantized
+    per-output-channel; activations quantize at runtime with a static
+    scale when calibrated (``calib_mode`` 'naive'/'entropy') or a dynamic
+    per-batch scale (``calib_mode='none'``). Compute is a real int8
+    GEMM/conv accumulated in int32 (ops/quantization.py).
+    """
+    from ..symbol.symbol import Symbol, _create, var
+    if quantized_dtype != "int8":
+        raise MXNetError(f"quantized_dtype {quantized_dtype!r}: only "
+                         f"'int8' is supported (symmetric)")
+    excluded = set(excluded_sym_names or ())
+    arg_np = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+              for k, v in arg_params.items()}
+
+    topo = sym._topo()
+    # which tensors need activation calibration: data inputs of q-ops
+    def _tensor_name(s):
+        return s.name
+
+    calib_tensors = []
+    for node in topo:
+        if node.op in _QUANTIZABLE and node.name not in excluded:
+            calib_tensors.append(_tensor_name(node.inputs[0]))
+    thresholds = {}
+    if calib_mode in ("naive", "entropy"):
+        if calib_data is None:
+            raise MXNetError(f"calib_mode={calib_mode!r} needs calib_data")
+        arrays = _collect_layer_inputs(
+            sym, arg_params, aux_params, calib_data, list(data_names),
+            calib_tensors, num_calib_examples)
+        calib_fn = (calib_thresholds_minmax if calib_mode == "naive"
+                    else calib_thresholds_entropy)
+        thresholds = calib_fn(arrays)
+
+    qargs = {}
+    new_of = {}                 # id(old node) -> list[Symbol] outputs
+
+    def mapped(s):
+        node = s._node
+        if node.op is None:
+            return Symbol(node, s._index)
+        return new_of[id(node)][s._index]
+
+    for node in topo:
+        if node.op is None or node.op == "_group":
+            continue
+        ins = [mapped(s) for s in node.inputs]
+        if node.op in _QUANTIZABLE and node.name not in excluded \
+                and node.inputs[1]._node.op is None \
+                and node.inputs[1]._node.name in arg_np:
+            wname = node.inputs[1]._node.name
+            # don't pop: another (e.g. excluded or weight-sharing) layer
+            # may still reference the fp32 weight; unreferenced originals
+            # are dropped against the rebuilt graph at the end
+            w = arg_np[wname]
+            if wname + "_quantized" not in qargs:
+                from ..ops.quantization import quantize_array
+                wq, wscale = quantize_array(w, channel_axis=0)
+                qargs[wname + "_quantized"] = np.asarray(wq)
+                qargs[wname + "_scale"] = np.asarray(wscale)
+            wq_sym = var(wname + "_quantized")
+            ws_sym = var(wname + "_scale")
+            in_name = _tensor_name(node.inputs[0])
+            qkw = {}
+            if in_name in thresholds:
+                lo, hi = thresholds[in_name]
+                qkw = {"min_calib_range": float(lo),
+                       "max_calib_range": float(hi)}
+            xq_pair = _create("_contrib_quantize_v2", [ins[0]], qkw,
+                              name=f"{node.name}_x_quantize")
+            xq, xscale = xq_pair[0], xq_pair[1]
+            bias_ins = ins[2:] if not node.attrs.get("no_bias") else []
+            if node.op == "FullyConnected":
+                out = _create(
+                    "_contrib_quantized_fully_connected",
+                    [xq, wq_sym, xscale, ws_sym] + bias_ins,
+                    {"num_hidden": node.attrs["num_hidden"],
+                     "no_bias": node.attrs.get("no_bias", False),
+                     "flatten": node.attrs.get("flatten", True)},
+                    name=f"{node.name}_quantized")
+            else:
+                out = _create(
+                    "_contrib_quantized_conv",
+                    [xq, wq_sym, xscale, ws_sym] + bias_ins,
+                    {"kernel": node.attrs["kernel"],
+                     "stride": node.attrs.get("stride"),
+                     "dilate": node.attrs.get("dilate"),
+                     "pad": node.attrs.get("pad"),
+                     "num_filter": node.attrs["num_filter"],
+                     "num_group": node.attrs.get("num_group", 1),
+                     "no_bias": node.attrs.get("no_bias", False)},
+                    name=f"{node.name}_quantized")
+            new_of[id(node)] = [out]
+        else:
+            # scoped attrs (__ctx_group__ etc.) aren't op params; re-add
+            # them after creation like symbol.load_json does
+            plain = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            scoped = {k: v for k, v in node.attrs.items()
+                      if k.startswith("__")}
+            out = _create(node.op, ins, plain, name=node.name)
+            out._node.attrs.update(scoped)
+            new_of[id(node)] = [Symbol(out._node, i)
+                                for i in range(node.num_outputs)]
+
+    out_syms = sym._output_symbols() if hasattr(sym, "_output_symbols") \
+        else [sym]
+    mapped_outs = [mapped(s) for s in out_syms]
+    from ..symbol import Group
+    qsym = mapped_outs[0] if len(mapped_outs) == 1 else Group(mapped_outs)
+    from .. import ndarray as nd
+    still_referenced = set(qsym.list_arguments()) \
+        | set(qsym.list_auxiliary_states())
+    qarg_params = {k: nd.array(v) for k, v in arg_np.items()
+                   if k in still_referenced}
+    qarg_params.update({k: nd.array(v) for k, v in qargs.items()})
+    return qsym, qarg_params, dict(aux_params)
+
+
+def quantize_net(network, calib_data=None, calib_mode="none",
+                 data_shapes=None, excluded_sym_names=(),
+                 num_calib_examples=None):
+    """Gluon route: HybridBlock -> int8 SymbolBlock
+    (ref: quantization.py quantize_net). ``data_shapes`` is required when
+    ``calib_data`` is None (to trace the network)."""
+    import tempfile
+
+    from .. import ndarray as nd
+    from .. import symbol as sym_mod
+    from ..gluon import SymbolBlock
+    from ..model import load_checkpoint
+
+    if calib_data is not None:
+        first = calib_data[0] if isinstance(calib_data, (list, tuple)) \
+            else calib_data
+        example = first if not isinstance(first, (list, tuple)) else \
+            first[0]
+        x = nd.array(example)
+    elif data_shapes:
+        x = nd.zeros(data_shapes[0])
+    else:
+        raise MXNetError("quantize_net needs calib_data or data_shapes")
+    network.hybridize()
+    network(x)
+    with tempfile.TemporaryDirectory() as td:
+        prefix = f"{td}/net"
+        network.export(prefix)
+        sym, arg_params, aux_params = load_checkpoint(prefix, 0)
+    batches = None
+    if calib_data is not None:
+        batches = calib_data if isinstance(calib_data, (list, tuple)) \
+            else [calib_data]
+    data_name = [n for n in sym.list_arguments()
+                 if n not in arg_params
+                 and n not in sym.list_auxiliary_states()]
+    qsym, qarg, qaux = quantize_model(
+        sym, arg_params, aux_params, data_names=data_name,
+        excluded_sym_names=excluded_sym_names, calib_mode=calib_mode,
+        calib_data=batches, num_calib_examples=num_calib_examples)
+    inputs = [sym_mod.var(n) for n in data_name]
+    net = SymbolBlock(qsym, inputs)
+    params = net.collect_params()
+    from ..context import current_context
+    ctx = current_context()
+    for name, arr in list(qarg.items()) + list(qaux.items()):
+        if name in params:
+            # int8 weights / fp32 scales must keep their dtype — the
+            # SymbolBlock default (fp32) would silently turn the int8
+            # GEMM into an fp32 one
+            params[name].dtype = arr.asnumpy().dtype \
+                if hasattr(arr, "asnumpy") else np.asarray(arr).dtype
+            params[name]._load_init(arr, ctx)
+    return net

@@ -20,4 +20,5 @@ from . import nn              # ref: src/operator/nn/
 from . import random          # ref: src/operator/random/
 from . import optimizer_op    # ref: src/operator/optimizer_op.cc
 from . import contrib         # ref: src/operator/contrib/
+from . import quantization    # ref: src/operator/quantization/
 from . import sequence        # ref: src/operator/sequence_*.cc

@@ -1,0 +1,139 @@
+"""INT8 quantization: op numerics, calibration, model conversion
+(ref: tests/python/quantization/test_quantization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.ops.quantization import quantize_array
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.random.randn(64, 32).astype(np.float32) * 3
+    xq, scale = nd.contrib.quantize_v2(nd.array(x))
+    assert xq.asnumpy().dtype == np.int8
+    back = nd.contrib.dequantize(xq, scale).asnumpy()
+    assert np.abs(back - x).max() <= float(scale.asnumpy()) + 1e-6
+
+
+def test_quantize_static_range_saturates():
+    x = np.array([[-10.0, -1.0, 0.5, 1.0, 10.0]], np.float32)
+    xq, scale = nd.contrib.quantize_v2(nd.array(x), min_calib_range=-1.0,
+                                       max_calib_range=1.0)
+    qv = xq.asnumpy()[0]
+    assert qv[0] == -127 and qv[-1] == 127          # clipped
+    assert abs(qv[2] - 64) <= 1                     # 0.5 / (1/127)
+
+
+def test_quantized_fc_matches_fp32():
+    x = np.random.randn(8, 16).astype(np.float32)
+    w = np.random.randn(4, 16).astype(np.float32)
+    b = np.random.randn(4).astype(np.float32)
+    xq, xs = quantize_array(x)
+    wq, ws = quantize_array(w, channel_axis=0)
+    out = nd.contrib.quantized_fully_connected(
+        nd.array(np.asarray(xq)), nd.array(np.asarray(wq)),
+        nd.array(np.asarray(xs)), nd.array(np.asarray(ws)),
+        nd.array(b), num_hidden=4).asnumpy()
+    want = x @ w.T + b
+    assert np.abs(out - want).max() / np.abs(want).max() < 0.05
+
+
+def test_quantized_conv_matches_fp32():
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    w = np.random.randn(5, 3, 3, 3).astype(np.float32)
+    xq, xs = quantize_array(x)
+    wq, ws = quantize_array(w, channel_axis=0)
+    out = nd.contrib.quantized_conv(
+        nd.array(np.asarray(xq)), nd.array(np.asarray(wq)),
+        nd.array(np.asarray(xs)), nd.array(np.asarray(ws)),
+        kernel=(3, 3), pad=(1, 1), num_filter=5, no_bias=True).asnumpy()
+    want = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                          pad=(1, 1), num_filter=5,
+                          no_bias=True).asnumpy()
+    assert np.abs(out - want).max() / np.abs(want).max() < 0.05
+
+
+def test_entropy_threshold_reasonable():
+    # long-tailed data: threshold should clip the tail, not the body
+    a = np.concatenate([np.random.randn(100000) * 0.5,
+                        np.array([50.0, -60.0])]).astype(np.float32)
+    (lo, hi), = q.calib_thresholds_entropy({"t": a}).values()
+    assert 1.0 < hi < 20.0, hi
+
+
+def _train_mlp():
+    np.random.seed(7)
+    X = np.random.randn(512, 32).astype(np.float32)
+    Y = (X @ np.random.randn(32, 5).astype(np.float32)).argmax(1)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(5))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01})
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(50):
+        with autograd.record():
+            loss = lf(net(nd.array(X)), nd.array(Y))
+        loss.backward()
+        tr.step(512)
+    return net, X, Y
+
+
+@pytest.mark.parametrize("mode", ["none", "naive", "entropy"])
+def test_quantize_net_accuracy_parity(mode):
+    net, X, Y = _train_mlp()
+    fp32_acc = (net(nd.array(X)).asnumpy().argmax(1) == Y).mean()
+    qnet = q.quantize_net(net, calib_data=[X[:128], X[128:256]],
+                          calib_mode=mode)
+    q_acc = (qnet(nd.array(X)).asnumpy().argmax(1) == Y).mean()
+    assert abs(q_acc - fp32_acc) <= 0.01
+    params = qnet.collect_params()
+    qw = [k for k in params if k.endswith("_quantized")]
+    assert qw and params[qw[0]].data().asnumpy().dtype == np.int8
+
+
+def test_quantize_model_symbol_level_conv():
+    # LeNet-ish conv net through the symbol-level API
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, activation="relu"),
+            gluon.nn.MaxPool2D(2), gluon.nn.Flatten(),
+            gluon.nn.Dense(10))
+    net.initialize()
+    net.hybridize()
+    x = np.random.randn(4, 1, 12, 12).astype(np.float32)
+    want = net(nd.array(x)).asnumpy()
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        net.export(f"{td}/n")
+        from mxnet_tpu.model import load_checkpoint
+        sym, arg_params, aux_params = load_checkpoint(f"{td}/n", 0)
+    qsym, qarg, qaux = q.quantize_model(
+        sym, arg_params, aux_params, data_names=["data"],
+        calib_mode="naive", calib_data=[x])
+    data = [n for n in qsym.list_arguments() if n not in qarg][0]
+    ex = qsym.bind(mx.cpu(), dict({data: nd.array(x)}, **qarg),
+                   aux_states=qaux)
+    got = ex.forward()[0].asnumpy()
+    assert np.abs(got - want).max() / max(np.abs(want).max(), 1e-6) < 0.1
+    # excluded layers stay fp32
+    qsym2, qarg2, _ = q.quantize_model(
+        sym, arg_params, aux_params,
+        excluded_sym_names=[n.name for n in sym._topo()
+                            if n.op == "Convolution"])
+    assert not any(k.endswith("conv0_weight_quantized") for k in qarg2)
+
+
+def test_quantize_model_rejects_other_dtypes():
+    import tempfile
+    net, X, _ = _train_mlp()
+    net.hybridize()
+    net(nd.array(X[:1]))
+    with tempfile.TemporaryDirectory() as td:
+        net.export(f"{td}/n")
+        from mxnet_tpu.model import load_checkpoint
+        sym, a, x = load_checkpoint(f"{td}/n", 0)
+    with pytest.raises(MXNetError, match="int8"):
+        q.quantize_model(sym, a, x, quantized_dtype="uint8")
