@@ -284,7 +284,7 @@ def export_graph(sym, params, in_shapes=None, in_types=None,
     for node in topo:
         if node.op is None:
             if node.name not in params:
-                shape = tuple(in_shapes[n_data]) if in_shapes else ()
+                shape = tuple(in_shapes[n_data]) if in_shapes else None
                 dtype = (in_types[n_data] if in_types else "float32")
                 data_inputs.append({"name": node.name,
                                     "dtype": str(np.dtype(dtype)),

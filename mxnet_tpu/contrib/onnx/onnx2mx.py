@@ -22,7 +22,6 @@ class _Ctx:
         self.tensors = {}       # tensor name -> Symbol
         self.params = {}        # param name -> np.ndarray
         self.aux_names = set()
-        self.const_used = set()  # names consumed as op constants
         self.use_count = use_count or {}
 
     def sym(self, name):
@@ -33,13 +32,12 @@ class _Ctx:
 
     def const_value(self, name):
         """The numpy value behind an initializer input (e.g. Reshape's
-        shape). Non-destructive: names whose only consumers are constant
-        reads are dropped from the params at the end of the import."""
+        shape). Non-destructive: initializers the rebuilt graph no longer
+        references are filtered out at the end of import_graph."""
         if name not in self.params:
             raise MXNetError(
                 f"ONNX import: input {name!r} must be a constant "
                 f"initializer for this op")
-        self.const_used.add(name)
         return self.params[name]
 
     def transform_param(self, name, fn):
@@ -113,7 +111,8 @@ def _gemm(node, ins, attrs, ctx):
     w = ctx.params[wname]
     beta = float(attrs.get("beta", 1.0))
     bias = []
-    if len(node["inputs"]) > 2:
+    if len(node["inputs"]) > 2 and node["inputs"][2]:
+        # C omitted via empty-string input name is legal ONNX
         bname = node["inputs"][2]
         if beta != 1.0 and bname in ctx.params:
             bname = ctx.transform_param(bname, lambda b: b * beta)

@@ -164,10 +164,10 @@ def _enc_value_info(vi):
     tensor_type = _vint(1, DTYPE_TO_ONNX[np.dtype(vi.get("dtype",
                                                          "float32"))])
     shape = vi.get("shape")
-    if shape is not None and shape != ():
-        # absent shape field = unknown rank (ONNX semantics); an empty
-        # TensorShapeProto would instead declare a rank-0 scalar, so
-        # unknown shapes (None or ()) omit the field entirely
+    if shape is not None:
+        # absent shape field = unknown rank (ONNX semantics), encoded as
+        # shape=None; shape=() is a genuine rank-0 scalar and gets an
+        # empty TensorShapeProto
         shape_msg = b"".join(
             _ld(1, _vint(1, d) if isinstance(d, int) and d > 0
                 else _vstr(2, str(d or "?")))

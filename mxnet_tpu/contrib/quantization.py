@@ -117,16 +117,17 @@ def _collect_layer_inputs(sym, arg_params, aux_params, calib_data,
     from ..symbol import Group
     group = Group([by_name[n] for n in wanted])
     collected = {n: [] for n in wanted}
+    # convert params once, outside the per-batch loop
+    args_nd = {k: v if isinstance(v, nd.NDArray) else nd.array(v)
+               for k, v in arg_params.items()}
+    aux_nd = {k: v if isinstance(v, nd.NDArray) else nd.array(v)
+              for k, v in aux_params.items()}
     n_done = 0
     for batch in calib_data:
         datas = batch if isinstance(batch, (list, tuple)) else [batch]
         binds = dict(zip(data_names, [nd.array(d) for d in datas]))
-        binds.update({k: nd.array(v.asnumpy() if hasattr(v, "asnumpy")
-                                  else v) for k, v in arg_params.items()})
-        ex = group.bind(current_context(), binds,
-                        aux_states={k: nd.array(
-                            v.asnumpy() if hasattr(v, "asnumpy") else v)
-                            for k, v in aux_params.items()})
+        binds.update(args_nd)
+        ex = group.bind(current_context(), binds, aux_states=aux_nd)
         outs = ex.forward()
         for n, o in zip(wanted, outs):
             collected[n].append(o.asnumpy())

@@ -82,11 +82,31 @@ def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
             iou = jnp.where(ids[:, None] == ids[None, :], iou, 0.0)
         valid_sorted = valid[order]
 
-        def body(i, keep):
-            sup = (iou[i] > overlap_thresh) & keep[i] & (jnp.arange(n) > i)
-            return jnp.where(sup, False, keep)
+        # Greedy NMS as a fixed-point iteration instead of a sequential
+        # O(topk) loop: keep_i = valid_i AND no kept higher-ranked j with
+        # IoU > t. Each sweep is one n x n matmul (MXU work), and the
+        # iteration reaches the greedy fixpoint in suppression-chain-depth
+        # sweeps (typically < 10) rather than topk sequential steps —
+        # the survey's planned TPU formulation (SURVEY §7: "Pallas for
+        # ... NMS"; measured speedup in benchmarks/nms_bench.py).
+        ranks = jnp.arange(n)
+        adj = (iou > overlap_thresh) & (ranks[None, :] < ranks[:, None]) \
+            & (ranks[None, :] < k)          # j can suppress i: j<i, j<topk
+        adjf = adj.astype(jnp.float32)
 
-        keep = lax.fori_loop(0, k, body, valid_sorted)
+        def fp_cond(state):
+            _, changed, it = state
+            return changed & (it < n)
+
+        def fp_body(state):
+            keep, _, it = state
+            suppressed = (adjf @ keep.astype(jnp.float32)) > 0
+            new = valid_sorted & ~suppressed
+            return new, jnp.any(new != keep), it + 1
+
+        keep, _, _ = lax.while_loop(
+            fp_cond, fp_body, (valid_sorted, jnp.bool_(True),
+                               jnp.int32(0)))
         keep &= jnp.arange(n) < k
         # compact kept rows to the top (stable), suppressed slots become -1
         perm = jnp.argsort(~keep, stable=True)
@@ -735,3 +755,166 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     if output_score:
         return rois, per_img[:, :, 0].reshape(-1, 1)
     return rois
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution (ref: src/operator/contrib/deformable_convolution.cc
+# + ../modulated_deformable_convolution.cc — hand-CUDA deformable_im2col
+# there; here a fully vectorized bilinear-gather that XLA fuses, followed by
+# one grouped einsum on the MXU. Differentiable in data/offset/mask/weight
+# via autodiff (the reference hand-writes all three backward kernels).
+# ---------------------------------------------------------------------------
+def _deformable_sample(data, offset, mask, kernel, stride, dilate, pad,
+                       num_deformable_group):
+    """Bilinear-sample data at kernel-tap positions displaced by offset.
+
+    data (N,C,H,W); offset (N, dg*2*kh*kw, oh, ow) with per-dg-block
+    channel layout [2*t]=dy, [2*t+1]=dx of tap t (reference
+    deformable_im2col channel order); mask (N, dg*kh*kw, oh, ow) or None.
+    Returns columns (N, C, kh*kw, oh, ow).
+    """
+    n, c, h, w = data.shape
+    kh, kw = kernel
+    dg = num_deformable_group
+    oh = (h + 2 * pad[0] - (dilate[0] * (kh - 1) + 1)) // stride[0] + 1
+    ow = (w + 2 * pad[1] - (dilate[1] * (kw - 1) + 1)) // stride[1] + 1
+    k = kh * kw
+    off = offset.reshape(n, dg, k, 2, oh, ow)
+    base_y = (jnp.arange(oh) * stride[0] - pad[0])[None, None, None, :,
+                                                   None]
+    base_x = (jnp.arange(ow) * stride[1] - pad[1])[None, None, None, None,
+                                                   :]
+    tap_y = jnp.repeat(jnp.arange(kh) * dilate[0],
+                       kw).reshape(1, 1, k, 1, 1)
+    tap_x = jnp.tile(jnp.arange(kw) * dilate[1],
+                     kh).reshape(1, 1, k, 1, 1)
+    py = base_y + tap_y + off[:, :, :, 0]           # (N, dg, K, oh, ow)
+    px = base_x + tap_x + off[:, :, :, 1]
+
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy1 = (py - y0).astype(data.dtype)
+    wx1 = (px - x0).astype(data.dtype)
+    dataf = data.reshape(n, dg, c // dg, h * w)
+
+    def corner(yi, xi, wgt):
+        # reference dmcn_im2col_bilinear: zero contribution outside
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        idx = (yc * w + xc).reshape(n, dg, -1)
+        gathered = jnp.take_along_axis(
+            dataf, jnp.broadcast_to(idx[:, :, None, :],
+                                    (n, dg, c // dg, idx.shape[-1])),
+            axis=3).reshape(n, dg, c // dg, k, oh, ow)
+        wgt = jnp.where(valid, wgt, 0.0).astype(data.dtype)
+        return gathered * wgt[:, :, None]
+
+    cols = (corner(y0, x0, (1 - wy1) * (1 - wx1))
+            + corner(y0, x0 + 1, (1 - wy1) * wx1)
+            + corner(y0 + 1, x0, wy1 * (1 - wx1))
+            + corner(y0 + 1, x0 + 1, wy1 * wx1))
+    if mask is not None:
+        m = mask.reshape(n, dg, 1, k, oh, ow).astype(data.dtype)
+        cols = cols * m
+    return cols.reshape(n, c, k, oh, ow)
+
+
+def _deformable_conv_impl(data, offset, mask, weight, bias, kernel, stride,
+                          dilate, pad, num_filter, num_group,
+                          num_deformable_group):
+    n, c, _, _ = data.shape
+    kh, kw = kernel
+    cols = _deformable_sample(data, offset, mask, kernel, stride, dilate,
+                              pad, num_deformable_group)
+    _, _, _, oh, ow = cols.shape
+    g = num_group
+    colsr = cols.reshape(n, g, c // g, kh * kw, oh, ow)
+    wr = weight.reshape(g, num_filter // g, c // g, kh * kw)
+    out = jnp.einsum("ngckyx,gock->ngoyx", colsr, wr)
+    out = out.reshape(n, num_filter, oh, ow)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pairify(v, n=2):
+    v = (v,) * n if isinstance(v, int) else tuple(v)
+    return v * n if len(v) == 1 else v
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=["DeformableConvolution"], num_inputs=-1,
+          params=[OpParam("kernel", tuple, None, required=True),
+                  OpParam("stride", tuple, None),
+                  OpParam("dilate", tuple, None),
+                  OpParam("pad", tuple, None),
+                  OpParam("num_filter", int, None, required=True),
+                  OpParam("num_group", int, 1),
+                  OpParam("num_deformable_group", int, 1),
+                  OpParam("no_bias", bool, False),
+                  OpParam("layout", str, None),
+                  OpParam("workspace", int, 1024)],
+          doc="Deformable convolution v1 (ref: src/operator/contrib/"
+              "deformable_convolution.cc). Inputs: data, offset "
+              "(N, dg*2*kh*kw, oh, ow), weight, [bias]. Completes the "
+              "Faster-RCNN/DCN op family.")
+def _deformable_convolution(data, offset, weight, *bias, kernel=None,
+                            stride=None, dilate=None, pad=None,
+                            num_filter=None, num_group=1,
+                            num_deformable_group=1, no_bias=False,
+                            layout=None, workspace=1024):
+    stride = _pairify(stride or 1)
+    dilate = _pairify(dilate or 1)
+    pad = _pairify(pad or 0)
+    return _deformable_conv_impl(
+        data, offset, None, weight,
+        None if no_bias or not bias else bias[0], tuple(kernel), stride,
+        dilate, pad, num_filter, num_group, num_deformable_group)
+
+
+@register("_contrib_ModulatedDeformableConvolution",
+          aliases=["ModulatedDeformableConvolution"], num_inputs=-1,
+          params=[OpParam("kernel", tuple, None, required=True),
+                  OpParam("stride", tuple, None),
+                  OpParam("dilate", tuple, None),
+                  OpParam("pad", tuple, None),
+                  OpParam("num_filter", int, None, required=True),
+                  OpParam("num_group", int, 1),
+                  OpParam("num_deformable_group", int, 1),
+                  OpParam("no_bias", bool, False),
+                  OpParam("layout", str, None),
+                  OpParam("workspace", int, 1024)],
+          doc="DCNv2: adds a per-tap modulation mask input (ref: "
+              "src/operator/contrib/modulated_deformable_convolution.cc). "
+              "Inputs: data, offset, mask (N, dg*kh*kw, oh, ow), weight, "
+              "[bias].")
+def _modulated_deformable_convolution(data, offset, mask, weight, *bias,
+                                      kernel=None, stride=None,
+                                      dilate=None, pad=None,
+                                      num_filter=None, num_group=1,
+                                      num_deformable_group=1,
+                                      no_bias=False, layout=None,
+                                      workspace=1024):
+    stride = _pairify(stride or 1)
+    dilate = _pairify(dilate or 1)
+    pad = _pairify(pad or 0)
+    return _deformable_conv_impl(
+        data, offset, mask, weight,
+        None if no_bias or not bias else bias[0], tuple(kernel), stride,
+        dilate, pad, num_filter, num_group, num_deformable_group)
+
+
+@register("_contrib_count_sketch", aliases=["count_sketch"], num_inputs=3,
+          params=[OpParam("out_dim", int, None, required=True),
+                  OpParam("processing_batch_size", int, 32)],
+          doc="Count sketch projection (ref: src/operator/contrib/"
+              "count_sketch.cc, compact bilinear pooling): out[n, h[i]] "
+              "+= s[i] * data[n, i]. Linear, so autodiff provides the "
+              "reference's hand-written backward.")
+def _count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
+    n, in_dim = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros((n, out_dim), data.dtype)
+    return out.at[:, hh].add(data * ss[None, :])

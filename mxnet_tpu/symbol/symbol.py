@@ -44,6 +44,10 @@ _OP_INPUTS = {
     "GroupNorm": (["data", "gamma", "beta"], 0),
     "InstanceNorm": (["data", "gamma", "beta"], 0),
     "Embedding": (["data", "weight"], 0),
+    "_contrib_DeformableConvolution": (
+        ["data", "offset", "weight", "bias"], 0),
+    "_contrib_ModulatedDeformableConvolution": (
+        ["data", "offset", "mask", "weight", "bias"], 0),
     "RNN": (["data", "parameters", "state", "state_cell"], 0),
     "LeakyReLU": (["data", "gamma"], 0),
     "SoftmaxOutput": (["data", "label"], 0),

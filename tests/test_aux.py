@@ -115,5 +115,8 @@ def test_quantization_calibration():
     assert mm["a"][0] == pytest.approx(-1.0)
     ent = quantization.calib_thresholds_entropy(arrays)
     assert ent["a"][1] > 0
-    with pytest.raises(mx.MXNetError):
-        quantization.quantize_model()
+    # quantize_model is implemented now (tests/test_quantization.py);
+    # unsupported dtypes still raise the documented error
+    with pytest.raises(mx.MXNetError, match="int8"):
+        quantization.quantize_model(mx.sym.var("x"), {}, {},
+                                    quantized_dtype="uint8")

@@ -1,0 +1,144 @@
+"""DeformableConvolution / ModulatedDeformableConvolution / count_sketch
+(ref: src/operator/contrib/deformable_convolution.cc,
+modulated_deformable_convolution.cc, count_sketch.cc; test analog
+tests/python/unittest/test_contrib_operator.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _setup(seed=0):
+    r = np.random.RandomState(seed)
+    x = r.randn(2, 4, 9, 9).astype(np.float32)
+    w = r.randn(6, 4, 3, 3).astype(np.float32)
+    b = r.randn(6).astype(np.float32)
+    return x, w, b
+
+
+def test_zero_offset_equals_convolution():
+    x, w, b = _setup()
+    off = np.zeros((2, 18, 9, 9), np.float32)
+    got = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+        kernel=(3, 3), pad=(1, 1), num_filter=6).asnumpy()
+    want = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                          kernel=(3, 3), pad=(1, 1),
+                          num_filter=6).asnumpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_integer_offset_equals_shifted_image():
+    x, w, b = _setup(1)
+    off = np.zeros((2, 18, 9, 9), np.float32)
+    off[:, 1::2] = 1.0                       # dx = +1 for every tap
+    got = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+        kernel=(3, 3), pad=(1, 1), num_filter=6).asnumpy()
+    xs = np.zeros_like(x)
+    xs[:, :, :, :-1] = x[:, :, :, 1:]
+    want = nd.Convolution(nd.array(xs), nd.array(w), nd.array(b),
+                          kernel=(3, 3), pad=(1, 1),
+                          num_filter=6).asnumpy()
+    np.testing.assert_allclose(got[:, :, 1:-1, 1:-1],
+                               want[:, :, 1:-1, 1:-1], atol=1e-4)
+
+
+def test_fractional_offset_bilinear():
+    # constant 0.5 x-offset on a linear ramp image: sampled value is the
+    # midpoint of neighbors, so a 1x1 kernel returns the average
+    x = np.tile(np.arange(8, dtype=np.float32), (1, 1, 8, 1))
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 8, 8), np.float32)
+    off[:, 1] = 0.5
+    got = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(1, 1),
+        num_filter=1, no_bias=True).asnumpy()
+    want = x + 0.5
+    np.testing.assert_allclose(got[..., :-1], want[..., :-1], atol=1e-5)
+
+
+def test_modulated_mask_semantics():
+    x, w, b = _setup(2)
+    off = np.zeros((2, 18, 9, 9), np.float32)
+    ones = np.ones((2, 9, 9, 9), np.float32)
+    v1 = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+        kernel=(3, 3), pad=(1, 1), num_filter=6).asnumpy()
+    mod = nd.contrib.ModulatedDeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(ones), nd.array(w),
+        nd.array(b), kernel=(3, 3), pad=(1, 1), num_filter=6).asnumpy()
+    np.testing.assert_allclose(mod, v1, atol=1e-4)
+    half = nd.contrib.ModulatedDeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(ones * 0.5), nd.array(w),
+        nd.array(b), kernel=(3, 3), pad=(1, 1), num_filter=6,
+        no_bias=True).asnumpy()
+    nob = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        pad=(1, 1), num_filter=6, no_bias=True).asnumpy()
+    np.testing.assert_allclose(half, 0.5 * nob, atol=1e-4)
+
+
+def test_groups_and_deformable_groups():
+    r = np.random.RandomState(3)
+    x = r.randn(1, 4, 7, 7).astype(np.float32)
+    w = r.randn(4, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 36, 7, 7), np.float32)
+    got = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        pad=(1, 1), num_filter=4, num_group=2, num_deformable_group=2,
+        no_bias=True).asnumpy()
+    want = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                          pad=(1, 1), num_filter=4, num_group=2,
+                          no_bias=True).asnumpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_stride_and_dilate():
+    r = np.random.RandomState(4)
+    x = r.randn(1, 3, 11, 11).astype(np.float32)
+    w = r.randn(5, 3, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 6, 6), np.float32)
+    got = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        stride=(2, 2), dilate=(2, 2), pad=(2, 2), num_filter=5,
+        no_bias=True).asnumpy()
+    want = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                          stride=(2, 2), dilate=(2, 2), pad=(2, 2),
+                          num_filter=5, no_bias=True).asnumpy()
+    assert got.shape == want.shape == (1, 5, 6, 6)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_count_sketch_matches_loop():
+    r = np.random.RandomState(5)
+    d = r.randn(3, 10).astype(np.float32)
+    h = r.randint(0, 6, (1, 10))
+    s = r.choice([-1.0, 1.0], (1, 10)).astype(np.float32)
+    got = nd.contrib.count_sketch(
+        nd.array(d), nd.array(h.astype(np.float32)), nd.array(s),
+        out_dim=6).asnumpy()
+    want = np.zeros((3, 6), np.float32)
+    for i in range(10):
+        want[:, h[0, i]] += s[0, i] * d[:, i]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_symbol_path():
+    x, w, b = _setup(6)
+    ds = mx.sym.var("data")
+    os_ = mx.sym.var("off")
+    out = mx.sym.contrib.DeformableConvolution(
+        ds, os_, kernel=(3, 3), pad=(1, 1), num_filter=6)
+    args = out.list_arguments()
+    assert "data" in args and "off" in args
+    off = np.zeros((2, 18, 9, 9), np.float32)
+    wname = [a for a in args if a.endswith("weight")][0]
+    bname = [a for a in args if a.endswith("bias")][0]
+    ex = out.bind(mx.cpu(), {"data": nd.array(x), "off": nd.array(off),
+                             wname: nd.array(w), bname: nd.array(b)})
+    got = ex.forward()[0].asnumpy()
+    want = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                          kernel=(3, 3), pad=(1, 1),
+                          num_filter=6).asnumpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)

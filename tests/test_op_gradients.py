@@ -66,6 +66,31 @@ CASES = {
             x, g, b, mx.nd.zeros((3,)), mx.nd.ones((3,)),
             use_global_stats=True, fix_gamma=False)[0],
         [_rand(2, 3, 4), _rand(3, seed=6) + 1.0, _rand(3, seed=7)]),
+    # data, offset AND weight gradients of the bilinear-sampled conv
+    # (the reference hand-writes all three backward CUDA kernels,
+    # ref: src/operator/contrib/deformable_convolution.cc)
+    # offsets kept strictly inside a bilinear cell (0.3..0.5): the sample
+    # gradient is discontinuous at integer offsets, where central
+    # differences straddle the kink
+    "deformable_conv": (
+        lambda x, off, w, b: mx.nd.contrib.DeformableConvolution(
+            x, off, w, b, kernel=(3, 3), pad=(1, 1), num_filter=2),
+        [_rand(1, 2, 5, 5),
+         _rand(1, 18, 5, 5, scale=0.05, seed=8) + 0.4,
+         _rand(2, 2, 3, 3, seed=9), _rand(2, seed=10)]),
+    "modulated_deformable_conv": (
+        lambda x, off, m, w: mx.nd.contrib.ModulatedDeformableConvolution(
+            x, off, m, w, kernel=(3, 3), pad=(1, 1), num_filter=2,
+            no_bias=True),
+        [_rand(1, 2, 5, 5),
+         _rand(1, 18, 5, 5, scale=0.05, seed=11) + 0.4,
+         _rand(1, 9, 5, 5, seed=12) * 0.5 + 1.0,
+         _rand(2, 2, 3, 3, seed=13)]),
+    "count_sketch": (
+        lambda x: mx.nd.contrib.count_sketch(
+            x, mx.nd.array([[0, 2, 1, 2, 0]]),
+            mx.nd.array([[1, -1, 1, 1, -1]]), out_dim=3),
+        [_rand(2, 5)]),
 }
 
 
