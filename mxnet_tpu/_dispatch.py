@@ -39,6 +39,24 @@ def _as_context(value):
     raise MXNetError(f"invalid ctx argument: {value!r}")
 
 
+def _tape_wiring(inputs, datas):
+    """Per-input tape graph wiring: (parents, fwd_inputs) where each
+    parent is (TapeNode | None, out_index, leaf_NDArray | None)."""
+    from .ndarray import NDArray
+    parents = []
+    fwd_inputs = []
+    for x, d in zip(inputs, datas):
+        if isinstance(x, NDArray) and getattr(x, "_grad", None) is not None:
+            parents.append((None, 0, x))            # leaf
+        elif isinstance(x, NDArray) and \
+                getattr(x, "_tape_node", None) is not None:
+            parents.append((x._tape_node, x._tape_out_idx, None))
+        else:
+            parents.append((None, 0, None))         # constant
+        fwd_inputs.append(x if isinstance(x, NDArray) else d)
+    return parents, fwd_inputs
+
+
 def invoke(op, inputs: Sequence, kwargs: dict, out=None):
     """Run operator `op` on NDArray `inputs`; returns NDArray or list."""
     from .autograd import TapeNode, is_recording, is_training
@@ -66,21 +84,39 @@ def invoke(op, inputs: Sequence, kwargs: dict, out=None):
     recording = (is_recording() and op.differentiable
                  and any(_tracked(x) for x in inputs if isinstance(x, NDArray)))
 
-    if recording:
+    if recording and op.name == "Embedding" \
+            and call_kwargs.get("sparse_grad") \
+            and not isinstance(datas[0], jax.core.Tracer):
+        # eager sparse-grad path: the weight cotangent is emitted as a
+        # row-sparse (rows=batch indices, values=output cotangent) instead
+        # of a dense scatter over the full table (ref: indexing_op.cc
+        # SparseEmbeddingOpBackwardRspImpl). Under jit tracing (hybridize/
+        # ShardedTrainer) the dense path below applies — XLA fuses the
+        # scatter there anyway.
+        from .ndarray.sparse import _RowSparseCT
+        out_data = op.fn(*datas, **call_kwargs)
+        idx_data, w_data = datas[0], datas[1]
+        w_shape = tuple(w_data.shape)
+
+        def sparse_vjp(ct):
+            import numpy as _np
+            import jax.numpy as jnp
+            rows = jnp.reshape(idx_data, (-1,)).astype(jnp.int32)
+            vals = jnp.reshape(ct, (rows.shape[0], w_shape[1]))
+            idx_ct = _np.zeros(idx_data.shape, dtype=jax.dtypes.float0)
+            return (idx_ct, _RowSparseCT(rows, vals, w_shape))
+
+        outs = [out_data]
+        avals = [jax.ShapeDtypeStruct(out_data.shape, out_data.dtype)]
+        parents, fwd_inputs = _tape_wiring(inputs, datas)
+        node = TapeNode(sparse_vjp, parents, avals, fwd_fn=op.fn,
+                        fwd_kwargs=call_kwargs, fwd_inputs=fwd_inputs)
+    elif recording:
         fn = lambda *arrays: op.fn(*arrays, **call_kwargs)
         out_data, vjp_fn = jax.vjp(fn, *datas)
         outs = list(out_data) if isinstance(out_data, tuple) else [out_data]
         avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
-        parents = []
-        fwd_inputs = []
-        for x, d in zip(inputs, datas):
-            if isinstance(x, NDArray) and getattr(x, "_grad", None) is not None:
-                parents.append((None, 0, x))            # leaf
-            elif isinstance(x, NDArray) and getattr(x, "_tape_node", None) is not None:
-                parents.append((x._tape_node, x._tape_out_idx, None))
-            else:
-                parents.append((None, 0, None))         # constant
-            fwd_inputs.append(x if isinstance(x, NDArray) else d)
+        parents, fwd_inputs = _tape_wiring(inputs, datas)
         node = TapeNode(vjp_fn, parents, avals, fwd_fn=op.fn,
                         fwd_kwargs=call_kwargs, fwd_inputs=fwd_inputs)
     else:

@@ -215,6 +215,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
                 prev = pct[out_idx]
                 if isinstance(prev, _np.ndarray) and prev.dtype == jax.dtypes.float0:
                     continue
+                from .ndarray.sparse import _RowSparseCT
+                if isinstance(g, _RowSparseCT):
+                    g = g.todense()   # sparse stays sparse only to leaves
                 pct[out_idx] = prev + g
         if not retain_graph:
             cotangents.pop(id(node), None)
@@ -232,17 +235,49 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
 
 
 def _accum_leaf(leaf_accum, leaf, g):
+    from .ndarray.sparse import _RowSparseCT
     key = id(leaf)
-    if key in leaf_accum:
-        leaf_accum[key] = (leaf, leaf_accum[key][1] + g)
-    else:
+    if key not in leaf_accum:
         leaf_accum[key] = (leaf, g)
+        return
+    prev = leaf_accum[key][1]
+    if isinstance(prev, _RowSparseCT) and isinstance(g, _RowSparseCT):
+        import jax.numpy as jnp
+        merged = _RowSparseCT(jnp.concatenate([prev.rows, g.rows]),
+                              jnp.concatenate([prev.values, g.values]),
+                              prev.shape)
+        leaf_accum[key] = (leaf, merged)
+    elif isinstance(prev, _RowSparseCT) or isinstance(g, _RowSparseCT):
+        dense_p = prev.todense() if isinstance(prev, _RowSparseCT) else prev
+        dense_g = g.todense() if isinstance(g, _RowSparseCT) else g
+        leaf_accum[key] = (leaf, dense_p + dense_g)
+    else:
+        leaf_accum[key] = (leaf, prev + g)
 
 
 def _deposit_leaf(leaf, g):
+    from .ndarray.sparse import _RowSparseCT, dedupe_rows
     req = getattr(leaf, "_grad_req", "write")
     if req == "null" or leaf._grad is None:
         return
+    if isinstance(g, _RowSparseCT):
+        rs = dedupe_rows(g)
+        if req == "add":
+            prev = getattr(leaf._grad, "_sparse", None)
+            if prev is not None:
+                import numpy as np
+                merged = _RowSparseCT(
+                    np.concatenate([prev.indices, rs.indices]),
+                    np.concatenate([prev.data, rs.data]), rs.shape)
+                rs = dedupe_rows(merged)
+            else:
+                # dense buffer may hold prior dense grads; fold them in
+                rs = None
+        if rs is not None:
+            leaf._grad._sparse = rs
+            return
+        g = g.todense()
+    leaf._grad._sparse = None      # dense deposit invalidates sparse view
     g = g.astype(leaf._grad._data.dtype)
     if req == "add":
         leaf._grad._rebind(leaf._grad._data + g)

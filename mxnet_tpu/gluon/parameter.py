@@ -58,9 +58,15 @@ class Parameter:
         if not differentiable:
             grad_req = "null"
         self.grad_req = grad_req
-        if stype != "default" or grad_stype != "default":
+        if stype != "default":
             raise MXNetError("sparse parameter storage is not supported on "
-                             "the TPU build yet (stype must be 'default')")
+                             "the TPU build (stype must be 'default'); "
+                             "grad_stype='row_sparse' IS supported for "
+                             "Embedding-style sparse gradients")
+        if grad_stype not in ("default", "row_sparse"):
+            raise MXNetError(f"grad_stype {grad_stype!r}: must be "
+                             f"'default' or 'row_sparse'")
+        self._grad_stype = grad_stype
 
     def __repr__(self):
         return (f"Parameter {self.name} (shape={self.shape}, "
@@ -197,7 +203,12 @@ class Parameter:
         self._check_initialized(ctx)
         if self._grad is None:
             raise MXNetError(f"parameter {self.name} has grad_req='null'")
-        return self._grad[self._ctx_index(ctx)]
+        buf = self._grad[self._ctx_index(ctx)]
+        if getattr(self, "_grad_stype", "default") == "row_sparse":
+            rs = getattr(buf, "_sparse", None)
+            if rs is not None:
+                return rs        # RowSparseNDArray: only touched rows
+        return buf
 
     def list_grad(self):
         self._check_initialized()
@@ -244,6 +255,7 @@ class Parameter:
         if self._grad is None:
             return
         for g in self._grad:
+            g._sparse = None     # drop any stale row-sparse view too
             g._rebind(nd.zeros(self.shape, dtype=self.dtype, ctx=g.ctx)._data)
 
     def reset_ctx(self, ctx):

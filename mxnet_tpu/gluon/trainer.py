@@ -123,6 +123,15 @@ class Trainer:
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
+            if getattr(param, "_grad_stype", "default") == "row_sparse" \
+                    and any(getattr(g, "_sparse", None) is not None
+                            for g in param.list_grad()):
+                raise MXNetError(
+                    f"parameter {param.name}: row-sparse gradients with a "
+                    f"reducing kvstore (multi-replica / update_on_kvstore) "
+                    f"are not supported — use kvstore=None (single device) "
+                    f"or dense gradients; the dense buffer here would push "
+                    f"stale zeros")
             grads = param.list_grad()
             if self._optimizer_applied_on_kv:
                 self._kvstore.push(i, grads)
@@ -146,7 +155,14 @@ class Trainer:
             for upd, arr, grad in zip(
                     self._updaters * len(param.list_data()),
                     param.list_data(), param.list_grad()):
-                upd(i, grad, arr)
+                g = grad
+                if getattr(param, "_grad_stype", "default") \
+                        == "row_sparse":
+                    rs = getattr(grad, "_sparse", None)
+                    if rs is not None:
+                        g = rs              # touched-rows-only update
+                        grad._sparse = None  # consumed; avoid staleness
+                upd(i, g, arr)
 
     def save_states(self, fname):
         """ref: Trainer.save_states — optimizer/updater state checkpoint."""

@@ -108,10 +108,12 @@ class KVStore:
         return single, [str(k) for k in keys]
 
     def _norm_vals(self, value, n):
-        if isinstance(value, nd.NDArray):
+        from .ndarray.sparse import BaseSparseNDArray
+        kinds = (nd.NDArray, BaseSparseNDArray)
+        if isinstance(value, kinds):
             return [[value]] * 1 if n == 1 else [[value]]
         if n == 1 and isinstance(value, (list, tuple)) and \
-                all(isinstance(v, nd.NDArray) for v in value):
+                all(isinstance(v, kinds) for v in value):
             return [list(value)]
         return [v if isinstance(v, (list, tuple)) else [v] for v in value]
 
@@ -127,11 +129,37 @@ class KVStore:
     def push(self, key, value, priority=0):
         """Aggregate gradients into the store; if an optimizer is installed
         the update is applied here (the reference's server-side update)."""
+        from .ndarray.sparse import RowSparseNDArray, _RowSparseCT, \
+            dedupe_rows
         single, keys = self._norm_keys(key)
         vals = self._norm_vals(value, len(keys))
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError(f"kvstore: key {k} was not init()ed")
+            if any(isinstance(v, RowSparseNDArray) for v in vlist):
+                if not all(isinstance(v, RowSparseNDArray) for v in vlist):
+                    raise MXNetError(
+                        f"kvstore.push key {k}: mixed dense and "
+                        f"row_sparse values in one push are not "
+                        f"supported — convert with tostype()")
+                # row-sparse push: aggregate the devices' touched rows
+                # (ref: kvstore_dist.h row_sparse push path)
+                import numpy as np
+                rows = np.concatenate(
+                    [np.asarray(v.indices) for v in vlist])
+                data = np.concatenate(
+                    [np.asarray(v.data) for v in vlist])
+                rs = dedupe_rows(_RowSparseCT(rows, data,
+                                              vlist[0].shape))
+                if self._updater is not None:
+                    self._updater(k, rs, self._store[k])
+                else:
+                    # same replace semantics as the dense push: the store
+                    # holds the latest pushed value on the touched rows
+                    dst = self._store[k]
+                    dst._rebind(dst._data.at[np.asarray(rs.indices)].set(
+                        np.asarray(rs.data)))
+                continue
             agg = vlist[0]
             for v in vlist[1:]:
                 agg = agg + v.as_in_context(agg.ctx)
@@ -166,8 +194,35 @@ class KVStore:
             self.pull(key, out=out, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Sparse pull degrades to dense pull (sparse storage deferred)."""
-        self.pull(key, out=out, priority=priority)
+        """Pull ONLY the requested rows (ref: KVStore::PullRowSparse /
+        kvstore_dist.h PullRowSparseImpl). With ``row_ids`` given,
+        returns RowSparseNDArray(s) of those rows; without, falls back
+        to a dense pull."""
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        import numpy as np
+
+        from .ndarray.sparse import RowSparseNDArray
+        single, keys = self._norm_keys(key)
+        if isinstance(row_ids, (list, tuple)) and len(row_ids) == len(keys):
+            rid_list = list(row_ids)
+        else:
+            # one row_ids set broadcast to every key
+            rid_list = [row_ids] * len(keys)
+        results = []
+        for k, rids in zip(keys, rid_list):
+            if k not in self._store:
+                raise MXNetError(f"kvstore: key {k} was not init()ed")
+            rids_np = np.unique(np.asarray(
+                rids.asnumpy() if isinstance(rids, nd.NDArray) else rids,
+                dtype=np.int64))
+            src = self._store[k]
+            rows = np.asarray(src._data)[rids_np]
+            results.append(RowSparseNDArray(rows, rids_np, src.shape))
+        if out is not None:
+            raise MXNetError("row_sparse_pull with row_ids returns the "
+                             "rows; out= is not supported on this build")
+        return results[0] if single else results
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

@@ -34,7 +34,7 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
 
 class NDArray:
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_node",
-                 "_tape_out_idx", "__weakref__")
+                 "_tape_out_idx", "_sparse", "__weakref__")
 
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None,
                  _skip_device_put: bool = False):
@@ -81,6 +81,13 @@ class NDArray:
 
     @property
     def grad(self):
+        # a row-sparse deposit (Embedding sparse_grad backward) lives on
+        # the buffer as `_sparse`; surface it so raw-autograd users never
+        # read the stale dense buffer
+        if self._grad is not None:
+            rs = getattr(self._grad, "_sparse", None)
+            if rs is not None:
+                return rs
         return self._grad
 
     @property

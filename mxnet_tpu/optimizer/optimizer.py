@@ -128,7 +128,53 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    def update_row_sparse(self, index, weight, rs_grad, state):
+        """Apply this optimizer's own rule to ONLY the touched rows of a
+        RowSparseNDArray gradient (the reference's lazy_update sparse
+        semantics, ref: optimizer.py sgd/adam sparse paths +
+        src/operator/optimizer_op.cc *_update row_sparse kernels):
+        weight rows and state rows are gathered, the dense rule runs on
+        the gathered slab, and results scatter back — untouched rows see
+        no weight decay and no momentum decay."""
+        from .. import ndarray as nd
+        rows = np.asarray(rs_grad.indices)
+        w_rows = nd.NDArray(weight._data[rows], _skip_device_put=True)
+        g_rows = nd.NDArray(np.asarray(rs_grad.data), ctx=weight.ctx)
+
+        def gather(s):
+            if s is None:
+                return None
+            if isinstance(s, (tuple, list)):
+                return tuple(gather(x) for x in s)
+            return nd.NDArray(s._data[rows], _skip_device_put=True)
+
+        def scatter(dst, src):
+            if dst is None:
+                return
+            if isinstance(dst, (tuple, list)):
+                for d, s in zip(dst, src):
+                    scatter(d, s)
+                return
+            dst._rebind(dst._data.at[rows].set(src._data))
+
+        state_rows = gather(state)
+        self.update(index, w_rows, g_rows, state_rows)
+        weight._rebind(weight._data.at[rows].set(w_rows._data))
+        scatter(state, state_rows)
+
     def update_multi_precision(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            if self.multi_precision and weight.dtype != np.float32:
+                inner_state, master = state
+                rs32 = RowSparseNDArray(
+                    np.asarray(grad.data, np.float32), grad.indices,
+                    grad.shape)
+                self.update_row_sparse(index, master, rs32, inner_state)
+                weight._rebind(master.astype(weight.dtype)._data)
+            else:
+                self.update_row_sparse(index, weight, grad, state)
+            return
         if self.multi_precision and weight.dtype != np.float32:
             inner_state, master = state
             grad32 = grad.astype(np.float32)

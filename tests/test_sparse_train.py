@@ -1,0 +1,185 @@
+"""Sparse training path: row-sparse Embedding gradients, lazy sparse
+optimizer updates touching only active rows, kvstore row_sparse push/pull
+(ref: tests/python/unittest/test_sparse_operator.py + test_module.py
+sparse embedding tests; SURVEY §2 #2/#15/#27)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+VOCAB, DIM = 50, 8
+
+
+def _embed_net(sparse):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Embedding(VOCAB, DIM, sparse_grad=sparse),
+            gluon.nn.Dense(4, flatten=False))
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.zeros((1, 2))))     # resolve deferred shapes
+    return net
+
+
+def test_sparse_grad_is_row_sparse_touching_only_batch_rows():
+    net = _embed_net(sparse=True)
+    tokens = np.array([[3, 7, 7], [11, 3, 42]])
+    with autograd.record():
+        out = net(nd.array(tokens))
+        loss = out.sum()
+    loss.backward()
+    emb_w = net[0].weight
+    g = emb_w.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert set(g.indices.tolist()) == {3, 7, 11, 42}
+    # duplicate index 3 and 7 contributions summed: compare to dense run
+    net_d = _embed_net(sparse=False)
+    net_d[0].weight.set_data(emb_w.data())
+    net_d[1].weight.set_data(net[1].weight.data())
+    net_d[1].bias.set_data(net[1].bias.data())
+    with autograd.record():
+        loss_d = net_d(nd.array(tokens)).sum()
+    loss_d.backward()
+    dense_g = net_d[0].weight.grad().asnumpy()
+    np.testing.assert_allclose(g.asnumpy(), dense_g, rtol=1e-5,
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("optname,opt_kw", [
+    ("sgd", {"learning_rate": 0.1}),
+])
+def test_sparse_training_matches_dense(optname, opt_kw):
+    # plain SGD, wd=0: a zero-gradient row's dense update is a no-op, so
+    # lazy row-sparse training is mathematically identical to dense.
+    # (With momentum/adam the dense path decays state on EVERY row each
+    # step; lazy sparse intentionally differs — covered by
+    # test_lazy_momentum_reference below, the reference's lazy_update
+    # semantics.)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, VOCAB, (6, 4, 3))
+    targets = rng.randn(6, 4, 3, 4).astype(np.float32)
+
+    def run(sparse):
+        net = _embed_net(sparse)
+        # identical init
+        for p, q in zip(_ref_params, net.collect_params().values()):
+            q.set_data(nd.array(p))
+        tr = gluon.Trainer(net.collect_params(), optname, dict(opt_kw),
+                           kvstore=None)
+        lf = gluon.loss.L2Loss()
+        for i in range(len(tokens)):
+            with autograd.record():
+                l = lf(net(nd.array(tokens[i])), nd.array(targets[i]))
+            l.backward()
+            tr.step(4)
+        return [v.data().asnumpy()
+                for v in net.collect_params().values()]
+
+    global _ref_params
+    ref_net = _embed_net(False)
+    _ref_params = [v.data().asnumpy()
+                   for v in ref_net.collect_params().values()]
+    dense = run(False)
+    sparse = run(True)
+    for i, (s_arr, d_arr) in enumerate(zip(sparse, dense)):
+        np.testing.assert_allclose(s_arr, d_arr, rtol=1e-5,
+                                   atol=1e-6, err_msg=str(i))
+
+
+def test_lazy_update_skips_untouched_rows():
+    # with wd > 0 the dense path decays EVERY row; the sparse path must
+    # leave untouched rows exactly as they were (reference lazy_update)
+    net = _embed_net(sparse=True)
+    w0 = net[0].weight.data().asnumpy().copy()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.5, "wd": 0.1}, kvstore=None)
+    tokens = np.array([[1, 2, 3]])
+    with autograd.record():
+        l = net(nd.array(tokens)).sum()
+    l.backward()
+    tr.step(1)
+    w1 = net[0].weight.data().asnumpy()
+    touched = [1, 2, 3]
+    untouched = [i for i in range(VOCAB) if i not in touched]
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+    assert not np.allclose(w1[touched], w0[touched])
+
+
+def test_momentum_state_only_touched_rows():
+    net = _embed_net(sparse=True)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9},
+                       kvstore=None)
+    tokens = np.array([[5, 9]])
+    with autograd.record():
+        net(nd.array(tokens)).sum().backward()
+    tr.step(1)
+    mom = tr._updaters[0].states[0]
+    mom_np = (mom[0] if isinstance(mom, (tuple, list)) else mom).asnumpy()
+    nz = np.nonzero(np.any(mom_np != 0, axis=1))[0]
+    assert set(nz.tolist()) <= {5, 9}
+
+
+def test_kvstore_row_sparse_push_pull():
+    kv = mx.kv.create("local")
+    w = np.random.randn(VOCAB, DIM).astype(np.float32)
+    kv.init(0, nd.array(w))
+    rows = np.array([4, 17])
+    vals = np.ones((2, DIM), np.float32)
+    # push replaces the touched rows (same semantics as the dense push)
+    kv.push(0, RowSparseNDArray(vals, rows, (VOCAB, DIM)))
+    got = kv.row_sparse_pull(0, row_ids=np.array([4, 17, 30]))
+    assert isinstance(got, RowSparseNDArray)
+    assert got.indices.tolist() == [4, 17, 30]
+    np.testing.assert_allclose(got.data[0], np.ones(DIM), rtol=1e-6)
+    np.testing.assert_allclose(got.data[2], w[30], rtol=1e-6)
+
+
+def test_hybridized_sparse_embedding_falls_back_dense():
+    # under jit tracing the dense scatter path applies; training must
+    # still work and grads remain correct
+    net = _embed_net(sparse=True)
+    net.hybridize()
+    tokens = np.array([[3, 7]])
+    with autograd.record():
+        net(nd.array(tokens)).sum().backward()
+    g = net[0].weight.grad()
+    # dense buffer (tracing path) — values still correct
+    gn = g.asnumpy() if not isinstance(g, RowSparseNDArray) else g.asnumpy()
+    nz = np.nonzero(np.any(gn != 0, axis=1))[0]
+    assert set(nz.tolist()) <= {3, 7}
+
+
+def test_lazy_momentum_reference():
+    # sparse SGD+momentum equals a hand-computed LAZY update: momentum
+    # decays only on rows present in that step's batch
+    net = _embed_net(sparse=True)
+    w = net[0].weight.data().asnumpy().copy()
+    mom = np.zeros_like(w)
+    lr, mu = 0.1, 0.9
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": lr, "momentum": mu},
+                       kvstore=None)
+    batches = [np.array([[1, 2]]), np.array([[2, 5]]),
+               np.array([[1, 5]])]
+    for tokens in batches:
+        with autograd.record():
+            out = net(nd.array(tokens))
+            loss = out.sum()
+        loss.backward()
+        # expected gradient of embedding under sum() head: sum over
+        # occurrences of dense-layer backprop; compute via dense twin
+        twin = _embed_net(sparse=False)
+        for p, q in zip(net.collect_params().values(),
+                        twin.collect_params().values()):
+            q.set_data(p.data())
+        twin[0].weight.set_data(nd.array(w))
+        with autograd.record():
+            twin(nd.array(tokens)).sum().backward()
+        g = twin[0].weight.grad().asnumpy()
+        rows = np.unique(tokens)
+        mom[rows] = mu * mom[rows] + g[rows]     # lazy: touched rows only
+        w[rows] = w[rows] - lr * mom[rows]
+        tr.step(1)
+        np.testing.assert_allclose(net[0].weight.data().asnumpy(), w,
+                                   rtol=1e-5, atol=1e-6)
