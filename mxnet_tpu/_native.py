@@ -23,7 +23,7 @@ _tried = False
 
 def _build():
     srcs = [os.path.join(_native_dir, f)
-            for f in ("recordio.cc", "engine.cc")]
+            for f in ("recordio.cc", "engine.cc", "predict.cc")]
     if not all(os.path.exists(s) for s in srcs):
         return False
     try:

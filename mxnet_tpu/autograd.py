@@ -270,14 +270,16 @@ def _deposit_leaf(leaf, g):
                     np.concatenate([prev.indices, rs.indices]),
                     np.concatenate([prev.data, rs.data]), rs.shape)
                 rs = dedupe_rows(merged)
-            else:
-                # dense buffer may hold prior dense grads; fold them in
+            elif not getattr(leaf._grad, "_zeroed", False):
+                # dense buffer holds prior dense grads; fold them in
                 rs = None
         if rs is not None:
             leaf._grad._sparse = rs
+            leaf._grad._zeroed = False
             return
         g = g.todense()
     leaf._grad._sparse = None      # dense deposit invalidates sparse view
+    leaf._grad._zeroed = False
     g = g.astype(leaf._grad._data.dtype)
     if req == "add":
         leaf._grad._rebind(leaf._grad._data + g)

@@ -166,6 +166,8 @@ class Parameter:
     def _init_grad(self):
         self._grad = [nd.zeros(self.shape, dtype=self.dtype, ctx=c)
                       for c in self._ctx_list]
+        for g in self._grad:
+            g._zeroed = True     # fresh: sparse add-deposits may stay sparse
         for arr, g in zip(self._data, self._grad):
             arr._grad = g
             arr._grad_req = self.grad_req
@@ -256,6 +258,7 @@ class Parameter:
             return
         for g in self._grad:
             g._sparse = None     # drop any stale row-sparse view too
+            g._zeroed = True     # fresh buffer: sparse adds may stay sparse
             g._rebind(nd.zeros(self.shape, dtype=self.dtype, ctx=g.ctx)._data)
 
     def reset_ctx(self, ctx):

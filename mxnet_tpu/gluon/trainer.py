@@ -160,8 +160,9 @@ class Trainer:
                         == "row_sparse":
                     rs = getattr(grad, "_sparse", None)
                     if rs is not None:
-                        g = rs              # touched-rows-only update
-                        grad._sparse = None  # consumed; avoid staleness
+                        g = rs    # touched-rows-only update; the sparse
+                        # view stays readable (param.grad()) until the
+                        # next backward or zero_grad replaces it
                 upd(i, g, arr)
 
     def save_states(self, fname):

@@ -45,19 +45,27 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
 def clip_global_norm(arrays, max_norm, check_isfinite=True):
     """Rescale arrays so their joint L2 norm ≤ max_norm
     (ref: gluon/utils.py clip_global_norm)."""
+    from ..ndarray.sparse import RowSparseNDArray
     if not arrays:
         raise MXNetError("clip_global_norm: empty array list")
-    total = None
+    total = 0.0
     for arr in arrays:
-        sq = nd.sum(nd.square(arr.reshape(-1)))
-        total = sq if total is None else total + sq
-    norm = float(nd.sqrt(total).asscalar())
+        if isinstance(arr, RowSparseNDArray):
+            # row-sparse grads: only stored rows contribute (ref:
+            # gluon/utils.py supports row_sparse grad clipping)
+            total += float(np.sum(np.square(arr.data)))
+        else:
+            total += float(nd.sum(nd.square(arr.reshape(-1))).asscalar())
+    norm = float(np.sqrt(total))
     if check_isfinite and not np.isfinite(norm):
         return norm
     scale = max_norm / (norm + 1e-8)
     if scale < 1.0:
         for arr in arrays:
-            arr *= scale
+            if isinstance(arr, RowSparseNDArray):
+                arr.data = arr.data * np.asarray(scale, arr.data.dtype)
+            else:
+                arr *= scale
     return norm
 
 

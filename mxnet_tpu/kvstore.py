@@ -142,6 +142,12 @@ class KVStore:
                         f"kvstore.push key {k}: mixed dense and "
                         f"row_sparse values in one push are not "
                         f"supported — convert with tostype()")
+                if self.num_workers > 1:
+                    raise MXNetError(
+                        "row_sparse push on a multi-host kvstore is not "
+                        "supported: the cross-host (DCN) reduce only "
+                        "covers dense values — push dense gradients "
+                        "(tostype('default')) for distributed training")
                 # row-sparse push: aggregate the devices' touched rows
                 # (ref: kvstore_dist.h row_sparse push path)
                 import numpy as np

@@ -34,7 +34,7 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
 
 class NDArray:
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_node",
-                 "_tape_out_idx", "_sparse", "__weakref__")
+                 "_tape_out_idx", "_sparse", "_zeroed", "__weakref__")
 
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None,
                  _skip_device_put: bool = False):
@@ -163,7 +163,8 @@ class NDArray:
         """ref: python/mxnet/ndarray/ndarray.py attach_grad — marks this array
         as a differentiation leaf (detaches it from any recorded graph)."""
         self._grad = zeros(self.shape, dtype=self.dtype, ctx=self._ctx)
-        self._grad_req = grad_req
+        self._grad._zeroed = True     # fresh buffer: sparse add-deposits
+        self._grad_req = grad_req     # may stay sparse
         self._tape_node = None
         self._tape_out_idx = 0
 

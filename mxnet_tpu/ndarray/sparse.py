@@ -160,7 +160,8 @@ def dedupe_rows(ct):
     summed = np.zeros((len(uniq), vals.shape[1]), vals.dtype)
     np.add.at(summed, inv, vals)
     return RowSparseNDArray(
-        summed.reshape((len(uniq),) + ct.shape[1:]), uniq, ct.shape)
+        summed.reshape((len(uniq),) + ct.shape[1:]), uniq, ct.shape,
+        dtype=vals.dtype)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):

@@ -182,7 +182,10 @@ def _pooling(x, kernel=(), pool_type="max", global_pool=False, stride=None,
         if pool_type == "sum":
             return summed
         if count_include_pad:
-            return summed / float(jnp.prod(jnp.asarray(kernel)))
+            # python-level product: kernel is static, and a jnp.prod here
+            # becomes a traced op under jit (float() then fails)
+            import math
+            return summed / float(math.prod(kernel))
         ones = jnp.ones_like(x)
         counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
         return summed / counts

@@ -169,9 +169,13 @@ class Optimizer:
                 inner_state, master = state
                 rs32 = RowSparseNDArray(
                     np.asarray(grad.data, np.float32), grad.indices,
-                    grad.shape)
+                    grad.shape, dtype=np.float32)
                 self.update_row_sparse(index, master, rs32, inner_state)
-                weight._rebind(master.astype(weight.dtype)._data)
+                # write back only the touched rows — a full-table
+                # master.astype() every step would erase the sparse win
+                rows = np.asarray(grad.indices)
+                weight._rebind(weight._data.at[rows].set(
+                    master._data[rows].astype(weight.dtype)))
             else:
                 self.update_row_sparse(index, weight, grad, state)
             return

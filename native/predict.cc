@@ -1,0 +1,709 @@
+// C predict API — standalone native inference over an exported
+// `-symbol.json` + `.params` pair, no Python dependency
+// (ref: src/c_api/c_predict_api.cc MXPredCreate/SetInput/Forward/
+// GetOutput/Free; the reference drives the full C++ runtime, here a
+// self-contained CPU graph interpreter covers the deployment path the
+// reference's amalgamation/mobile builds serve).
+//
+// Supported ops: Convolution, FullyConnected, BatchNorm (inference),
+// Activation, Pooling, Flatten, Reshape, elemwise_add/mul,
+// broadcast_add/mul, Concat, softmax, log_softmax, Dropout (identity),
+// LeakyReLU — the exported-model op set of the model zoo's image
+// classifiers (LeNet/MLP/ResNet/VGG).
+//
+// Build: part of libmxtpu.so (see Makefile). C ABI mirrors the
+// reference's signatures.
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace predict {
+
+// ---------------------------------------------------------------------------
+// minimal JSON parser (objects, arrays, strings, numbers, bool, null)
+// ---------------------------------------------------------------------------
+struct JValue {
+  enum Kind { OBJ, ARR, STR, NUM, BOOL, NUL } kind = NUL;
+  std::map<std::string, JValue> obj;
+  std::vector<JValue> arr;
+  std::string str;
+  double num = 0;
+  bool b = false;
+  const JValue& operator[](const std::string& k) const {
+    static JValue nul;
+    auto it = obj.find(k);
+    return it == obj.end() ? nul : it->second;
+  }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  explicit JParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+  void skip() { while (p < end && std::isspace((unsigned char)*p)) ++p; }
+  [[noreturn]] void fail(const char* msg) {
+    throw std::runtime_error(std::string("json: ") + msg);
+  }
+  JValue parse() { skip(); return value(); }
+  JValue value() {
+    skip();
+    if (p >= end) fail("eof");
+    switch (*p) {
+      case '{': return object();
+      case '[': return array();
+      case '"': { JValue v; v.kind = JValue::STR; v.str = string(); return v; }
+      case 't': p += 4; { JValue v; v.kind = JValue::BOOL; v.b = true; return v; }
+      case 'f': p += 5; { JValue v; v.kind = JValue::BOOL; v.b = false; return v; }
+      case 'n': p += 4; return JValue{};
+      default: return number();
+    }
+  }
+  JValue object() {
+    JValue v; v.kind = JValue::OBJ; ++p;  // '{'
+    skip();
+    if (p < end && *p == '}') { ++p; return v; }
+    while (true) {
+      skip();
+      std::string key = string();
+      skip();
+      if (p >= end || *p != ':') fail("expected :");
+      ++p;
+      v.obj[key] = value();
+      skip();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; break; }
+      fail("expected , or }");
+    }
+    return v;
+  }
+  JValue array() {
+    JValue v; v.kind = JValue::ARR; ++p;  // '['
+    skip();
+    if (p < end && *p == ']') { ++p; return v; }
+    while (true) {
+      v.arr.push_back(value());
+      skip();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; break; }
+      fail("expected , or ]");
+    }
+    return v;
+  }
+  std::string string() {
+    if (*p != '"') fail("expected string");
+    ++p;
+    std::string out;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'u': p += 4; out += '?'; break;  // no unicode in our files
+          default: out += *p;
+        }
+      } else {
+        out += *p;
+      }
+      ++p;
+    }
+    ++p;
+    return out;
+  }
+  JValue number() {
+    char* np = nullptr;
+    JValue v; v.kind = JValue::NUM;
+    v.num = std::strtod(p, &np);
+    if (np == p) fail("bad number");
+    p = np;
+    return v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// attr parsing (python-repr strings: "(3, 3)", "64", "True", "relu")
+// ---------------------------------------------------------------------------
+static std::vector<long> parse_tuple(const std::string& s) {
+  std::vector<long> out;
+  long cur = 0;
+  bool in_num = false, neg = false;
+  for (char c : s) {
+    if (std::isdigit((unsigned char)c)) { cur = cur * 10 + (c - '0'); in_num = true; }
+    else if (c == '-') { neg = true; }
+    else if (in_num) { out.push_back(neg ? -cur : cur); cur = 0; in_num = false; neg = false; }
+  }
+  if (in_num) out.push_back(neg ? -cur : cur);
+  return out;
+}
+static long parse_int(const std::string& s, long dflt) {
+  if (s.empty()) return dflt;
+  try { return std::stol(s); } catch (...) { return dflt; }
+}
+static double parse_float(const std::string& s, double dflt) {
+  if (s.empty()) return dflt;
+  try { return std::stod(s); } catch (...) { return dflt; }
+}
+static bool parse_bool(const std::string& s, bool dflt) {
+  if (s == "True" || s == "true" || s == "1") return true;
+  if (s == "False" || s == "false" || s == "0") return false;
+  return dflt;
+}
+
+// ---------------------------------------------------------------------------
+// tensors
+// ---------------------------------------------------------------------------
+struct Tensor {
+  std::vector<long> shape;
+  std::vector<float> data;
+  long size() const {
+    long n = 1;
+    for (long s : shape) n *= s;
+    return n;
+  }
+  void alloc() { data.assign(size(), 0.f); }
+};
+
+// ---------------------------------------------------------------------------
+// .params reader (format: ndarray.py save — list magic, ndarray records,
+// then names; names carry arg:/aux: prefixes)
+// ---------------------------------------------------------------------------
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  Reader(const void* buf, size_t n)
+      : p((const uint8_t*)buf), end((const uint8_t*)buf + n) {}
+  template <typename T> T get() {
+    if (p + sizeof(T) > end) throw std::runtime_error("params: truncated");
+    T v;
+    std::memcpy(&v, p, sizeof(T));
+    p += sizeof(T);
+    return v;
+  }
+};
+
+static std::map<std::string, Tensor> load_params(const void* buf, size_t n) {
+  Reader r(buf, n);
+  uint64_t magic = r.get<uint64_t>();
+  if (magic != 0x112) throw std::runtime_error("params: bad list magic");
+  r.get<uint64_t>();  // reserved
+  uint64_t count = r.get<uint64_t>();
+  std::vector<Tensor> arrays(count);
+  for (uint64_t i = 0; i < count; ++i) {
+    uint32_t nd_magic = r.get<uint32_t>();
+    if (nd_magic != 0xF993FAC9) throw std::runtime_error("params: bad nd magic");
+    uint32_t ndim = r.get<uint32_t>();
+    Tensor t;
+    for (uint32_t d = 0; d < ndim; ++d) t.shape.push_back((long)r.get<int64_t>());
+    r.get<int32_t>();  // dev_type
+    r.get<int32_t>();  // dev_id
+    int32_t dtype = r.get<int32_t>();
+    long sz = t.size();
+    t.data.resize(sz);
+    if (dtype == 0) {  // float32
+      for (long j = 0; j < sz; ++j) t.data[j] = r.get<float>();
+    } else if (dtype == 1) {  // float64
+      for (long j = 0; j < sz; ++j) t.data[j] = (float)r.get<double>();
+    } else if (dtype == 6) {  // int64  (code table: ndarray.py _DTYPE_CODE)
+      for (long j = 0; j < sz; ++j) t.data[j] = (float)r.get<int64_t>();
+    } else if (dtype == 4) {  // int32
+      for (long j = 0; j < sz; ++j) t.data[j] = (float)r.get<int32_t>();
+    } else {
+      throw std::runtime_error("params: unsupported dtype code " +
+                               std::to_string(dtype));
+    }
+    arrays[i] = std::move(t);
+  }
+  uint64_t n_names = r.get<uint64_t>();
+  std::map<std::string, Tensor> out;
+  for (uint64_t i = 0; i < n_names; ++i) {
+    uint64_t len = r.get<uint64_t>();
+    std::string name((const char*)r.p, len);
+    r.p += len;
+    // strip arg:/aux: prefixes
+    auto pos = name.find(':');
+    if (pos != std::string::npos) name = name.substr(pos + 1);
+    out[name] = arrays[i];
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// op kernels (NCHW, fp32, plain loops — deployment-correctness path)
+// ---------------------------------------------------------------------------
+static void conv2d(const Tensor& x, const Tensor& w, const Tensor* bias,
+                   const std::vector<long>& stride, const std::vector<long>& pad,
+                   const std::vector<long>& dilate, long groups, Tensor& out) {
+  long N = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+  long O = w.shape[0], KH = w.shape[2], KW = w.shape[3];
+  long SH = stride[0], SW = stride[1], PH = pad[0], PW = pad[1];
+  long DH = dilate[0], DW = dilate[1];
+  long OH = (H + 2 * PH - (DH * (KH - 1) + 1)) / SH + 1;
+  long OW = (W + 2 * PW - (DW * (KW - 1) + 1)) / SW + 1;
+  long Cg = C / groups, Og = O / groups;
+  out.shape = {N, O, OH, OW};
+  out.alloc();
+  for (long n = 0; n < N; ++n)
+    for (long o = 0; o < O; ++o) {
+      long g = o / Og;
+      for (long oy = 0; oy < OH; ++oy)
+        for (long ox = 0; ox < OW; ++ox) {
+          float acc = bias ? bias->data[o] : 0.f;
+          for (long c = 0; c < Cg; ++c)
+            for (long ky = 0; ky < KH; ++ky) {
+              long iy = oy * SH - PH + ky * DH;
+              if (iy < 0 || iy >= H) continue;
+              for (long kx = 0; kx < KW; ++kx) {
+                long ix = ox * SW - PW + kx * DW;
+                if (ix < 0 || ix >= W) continue;
+                acc += x.data[((n * C + g * Cg + c) * H + iy) * W + ix] *
+                       w.data[((o * Cg + c) * KH + ky) * KW + kx];
+              }
+            }
+          out.data[((n * O + o) * OH + oy) * OW + ox] = acc;
+        }
+    }
+}
+
+static void fully_connected(const Tensor& x, const Tensor& w,
+                            const Tensor* bias, bool flatten, Tensor& out) {
+  long K = w.shape[1], O = w.shape[0];
+  long N;
+  std::vector<long> lead;
+  if (flatten || x.shape.size() == 2) {
+    N = x.shape[0];
+    lead = {N};
+  } else {
+    N = x.size() / x.shape.back();
+    lead.assign(x.shape.begin(), x.shape.end() - 1);
+  }
+  out.shape = lead;
+  out.shape.push_back(O);
+  out.alloc();
+  for (long n = 0; n < N; ++n)
+    for (long o = 0; o < O; ++o) {
+      float acc = bias ? bias->data[o] : 0.f;
+      const float* xr = &x.data[n * K];
+      const float* wr = &w.data[o * K];
+      for (long k = 0; k < K; ++k) acc += xr[k] * wr[k];
+      out.data[n * O + o] = acc;
+    }
+}
+
+static void batchnorm(const Tensor& x, const Tensor& gamma, const Tensor& beta,
+                      const Tensor& mean, const Tensor& var, double eps,
+                      bool fix_gamma, Tensor& out) {
+  out.shape = x.shape;
+  out.alloc();
+  long C = x.shape.size() > 1 ? x.shape[1] : x.shape[0];
+  long inner = 1;
+  for (size_t i = 2; i < x.shape.size(); ++i) inner *= x.shape[i];
+  long N = x.shape[0];
+  for (long c = 0; c < C; ++c) {
+    float g = fix_gamma ? 1.f : gamma.data[c];
+    float inv = 1.f / std::sqrt(var.data[c] + (float)eps);
+    float scale = g * inv;
+    float offset = beta.data[c] - mean.data[c] * scale;
+    for (long n = 0; n < N; ++n) {
+      float* po = &out.data[(n * C + c) * inner];
+      const float* px = &x.data[(n * C + c) * inner];
+      for (long i = 0; i < inner; ++i) po[i] = px[i] * scale + offset;
+    }
+  }
+}
+
+static void pooling(const Tensor& x, const std::string& type, bool global_pool,
+                    const std::vector<long>& kernel,
+                    const std::vector<long>& stride,
+                    const std::vector<long>& pad, bool ceil_mode,
+                    bool count_include_pad, Tensor& out) {
+  long N = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+  if (global_pool) {
+    out.shape = {N, C, 1, 1};
+    out.alloc();
+    for (long n = 0; n < N; ++n)
+      for (long c = 0; c < C; ++c) {
+        const float* px = &x.data[(n * C + c) * H * W];
+        float acc = type == "max" ? -1e30f : 0.f;
+        for (long i = 0; i < H * W; ++i)
+          acc = type == "max" ? std::max(acc, px[i]) : acc + px[i];
+        out.data[(n * C + c)] = type == "max" ? acc : acc / (float)(H * W);
+      }
+    return;
+  }
+  long KH = kernel[0], KW = kernel[1];
+  long SH = stride[0], SW = stride[1], PH = pad[0], PW = pad[1];
+  auto osize = [&](long in, long k, long s, long p) {
+    double v = (double)(in + 2 * p - k) / s + 1;
+    return (long)(ceil_mode ? std::ceil(v) : std::floor(v));
+  };
+  long OH = osize(H, KH, SH, PH), OW = osize(W, KW, SW, PW);
+  out.shape = {N, C, OH, OW};
+  out.alloc();
+  for (long n = 0; n < N; ++n)
+    for (long c = 0; c < C; ++c)
+      for (long oy = 0; oy < OH; ++oy)
+        for (long ox = 0; ox < OW; ++ox) {
+          float acc = type == "max" ? -1e30f : 0.f;
+          long cnt = 0;
+          for (long ky = 0; ky < KH; ++ky) {
+            long iy = oy * SH - PH + ky;
+            if (iy < 0 || iy >= H) continue;
+            for (long kx = 0; kx < KW; ++kx) {
+              long ix = ox * SW - PW + kx;
+              if (ix < 0 || ix >= W) continue;
+              float v = x.data[((n * C + c) * H + iy) * W + ix];
+              acc = type == "max" ? std::max(acc, v) : acc + v;
+              ++cnt;
+            }
+          }
+          if (type != "max")
+            acc /= (float)(count_include_pad ? KH * KW : std::max(cnt, 1L));
+          out.data[((n * C + c) * OH + oy) * OW + ox] = acc;
+        }
+}
+
+static void softmax_rows(Tensor& t) {
+  long C = t.shape.back();
+  long rows = t.size() / C;
+  for (long r = 0; r < rows; ++r) {
+    float* p = &t.data[r * C];
+    float m = *std::max_element(p, p + C);
+    double s = 0;
+    for (long c = 0; c < C; ++c) { p[c] = std::exp(p[c] - m); s += p[c]; }
+    for (long c = 0; c < C; ++c) p[c] = (float)(p[c] / s);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// the graph executor
+// ---------------------------------------------------------------------------
+struct Node {
+  std::string op, name;
+  std::map<std::string, std::string> attrs;
+  std::vector<std::pair<long, long>> inputs;  // (node_id, out_index)
+};
+
+struct Predictor {
+  std::vector<Node> nodes;
+  std::vector<std::pair<long, long>> heads;
+  std::map<std::string, Tensor> params;
+  std::map<std::string, long> var_nodes;          // name -> node id
+  std::vector<std::vector<Tensor>> values;        // per node outputs
+  std::vector<Tensor> inputs_by_node;             // bound inputs
+  std::vector<Tensor> outputs;
+  std::string last_error;
+
+  void load_graph(const std::string& json) {
+    JParser parser(json);
+    JValue root = parser.parse();
+    const JValue& jnodes = root["nodes"];
+    for (const JValue& jn : jnodes.arr) {
+      Node n;
+      n.op = jn["op"].str;
+      n.name = jn["name"].str;
+      for (auto& kv : jn["attrs"].obj) n.attrs[kv.first] = kv.second.str;
+      for (const JValue& ji : jn["inputs"].arr)
+        n.inputs.push_back({(long)ji.arr[0].num, (long)ji.arr[1].num});
+      if (n.op == "null") var_nodes[n.name] = (long)nodes.size();
+      nodes.push_back(std::move(n));
+    }
+    for (const JValue& jh : root["heads"].arr)
+      heads.push_back({(long)jh.arr[0].num, (long)jh.arr[1].num});
+    values.resize(nodes.size());
+  }
+
+  void set_input(const std::string& name, const float* data,
+                 const std::vector<long>& shape) {
+    auto it = var_nodes.find(name);
+    if (it == var_nodes.end())
+      throw std::runtime_error("unknown input " + name);
+    Tensor t;
+    t.shape = shape;
+    t.data.assign(data, data + t.size());
+    values[it->second] = {std::move(t)};
+  }
+
+  const Tensor& in(const Node& n, size_t i) {
+    auto [nid, oi] = n.inputs[i];
+    if (values[nid].empty())
+      throw std::runtime_error("node input not computed for " + n.name);
+    return values[nid][oi < (long)values[nid].size() ? oi : 0];
+  }
+
+  void forward() {
+    // bind parameters into variable nodes
+    for (auto& [name, nid] : var_nodes) {
+      if (!values[nid].empty()) continue;  // user-set input
+      auto it = params.find(name);
+      if (it == params.end())
+        throw std::runtime_error("unbound variable " + name +
+                                 " (not an input, not in params)");
+      values[nid] = {it->second};
+    }
+    for (size_t id = 0; id < nodes.size(); ++id) {
+      Node& n = nodes[id];
+      if (n.op == "null") continue;
+      Tensor out;
+      auto a = [&](const char* k) {
+        auto it = n.attrs.find(k);
+        return it == n.attrs.end() ? std::string() : it->second;
+      };
+      if (n.op == "Convolution") {
+        auto kernel = parse_tuple(a("kernel"));
+        auto stride = a("stride").empty() ? std::vector<long>{1, 1}
+                                          : parse_tuple(a("stride"));
+        auto pad = a("pad").empty() ? std::vector<long>{0, 0}
+                                    : parse_tuple(a("pad"));
+        auto dilate = a("dilate").empty() ? std::vector<long>{1, 1}
+                                          : parse_tuple(a("dilate"));
+        bool no_bias = parse_bool(a("no_bias"), false);
+        conv2d(in(n, 0), in(n, 1), no_bias ? nullptr : &in(n, 2), stride,
+               pad, dilate, parse_int(a("num_group"), 1), out);
+      } else if (n.op == "FullyConnected") {
+        bool no_bias = parse_bool(a("no_bias"), false);
+        fully_connected(in(n, 0), in(n, 1),
+                        no_bias ? nullptr : &in(n, 2),
+                        parse_bool(a("flatten"), true), out);
+      } else if (n.op == "BatchNorm") {
+        batchnorm(in(n, 0), in(n, 1), in(n, 2), in(n, 3), in(n, 4),
+                  parse_float(a("eps"), 1e-3),
+                  parse_bool(a("fix_gamma"), true), out);
+        values[id] = {out, in(n, 3), in(n, 4)};
+        continue;
+      } else if (n.op == "Activation") {
+        out = in(n, 0);
+        std::string act = a("act_type");
+        for (float& v : out.data) {
+          if (act == "relu") v = std::max(v, 0.f);
+          else if (act == "sigmoid") v = 1.f / (1.f + std::exp(-v));
+          else if (act == "tanh") v = std::tanh(v);
+          else if (act == "softrelu") v = std::log1p(std::exp(v));
+          else throw std::runtime_error("activation " + act);
+        }
+      } else if (n.op == "relu") {
+        out = in(n, 0);
+        for (float& v : out.data) v = std::max(v, 0.f);
+      } else if (n.op == "LeakyReLU") {
+        out = in(n, 0);
+        float slope = (float)parse_float(a("slope"), 0.25);
+        std::string act = a("act_type");
+        if (!act.empty() && act != "leaky")
+          throw std::runtime_error("LeakyReLU act_type " + act);
+        for (float& v : out.data) v = v > 0 ? v : slope * v;
+      } else if (n.op == "Pooling") {
+        auto kernel = a("kernel").empty() ? std::vector<long>{1, 1}
+                                          : parse_tuple(a("kernel"));
+        if (kernel.size() == 1) kernel.push_back(kernel[0]);
+        auto stride = a("stride").empty() ? std::vector<long>{1, 1}
+                                          : parse_tuple(a("stride"));
+        if (stride.size() == 1) stride.push_back(stride[0]);
+        auto pad = a("pad").empty() ? std::vector<long>{0, 0}
+                                    : parse_tuple(a("pad"));
+        if (pad.size() == 1) pad.push_back(pad[0]);
+        pooling(in(n, 0), a("pool_type").empty() ? "max" : a("pool_type"),
+                parse_bool(a("global_pool"), false), kernel, stride, pad,
+                a("pooling_convention") == "full",
+                parse_bool(a("count_include_pad"), true), out);
+      } else if (n.op == "Flatten") {
+        out = in(n, 0);
+        long n0 = out.shape[0];
+        out.shape = {n0, out.size() / n0};
+      } else if (n.op == "reshape" || n.op == "Reshape") {
+        out = in(n, 0);
+        auto shape = parse_tuple(a("shape"));
+        long known = 1, infer = -1;
+        for (size_t i = 0; i < shape.size(); ++i) {
+          if (shape[i] == -1) infer = (long)i;
+          else if (shape[i] == 0) { shape[i] = out.shape[i]; known *= shape[i]; }
+          else known *= shape[i];
+        }
+        if (infer >= 0) shape[infer] = out.size() / known;
+        out.shape.assign(shape.begin(), shape.end());
+      } else if (n.op == "elemwise_add" || n.op == "broadcast_add" ||
+                 n.op == "elemwise_mul" || n.op == "broadcast_mul") {
+        const Tensor& lhs = in(n, 0);
+        const Tensor& rhs = in(n, 1);
+        if (lhs.size() != rhs.size())
+          throw std::runtime_error("broadcast in " + n.op +
+                                   " beyond same-shape unsupported");
+        out = lhs;
+        bool mul = n.op.find("mul") != std::string::npos;
+        for (long i = 0; i < out.size(); ++i)
+          out.data[i] = mul ? out.data[i] * rhs.data[i]
+                            : out.data[i] + rhs.data[i];
+      } else if (n.op == "Concat") {
+        long dim = parse_int(a("dim"), 1);
+        const Tensor& first = in(n, 0);
+        out.shape = first.shape;
+        long total = 0;
+        for (size_t i = 0; i < n.inputs.size(); ++i) total += in(n, i).shape[dim];
+        out.shape[dim] = total;
+        out.alloc();
+        long outer = 1, inner = 1;
+        for (long d = 0; d < dim; ++d) outer *= first.shape[d];
+        for (size_t d = dim + 1; d < first.shape.size(); ++d)
+          inner *= first.shape[d];
+        long off = 0;
+        for (size_t i = 0; i < n.inputs.size(); ++i) {
+          const Tensor& t = in(n, i);
+          long chunk = t.shape[dim] * inner;
+          for (long o = 0; o < outer; ++o)
+            std::memcpy(&out.data[(o * out.shape[dim] + off) * inner],
+                        &t.data[o * chunk], chunk * sizeof(float));
+          off += t.shape[dim];
+        }
+      } else if (n.op == "softmax" || n.op == "SoftmaxOutput") {
+        out = in(n, 0);
+        softmax_rows(out);
+      } else if (n.op == "log_softmax") {
+        out = in(n, 0);
+        softmax_rows(out);
+        for (float& v : out.data) v = std::log(std::max(v, 1e-30f));
+      } else if (n.op == "Dropout" || n.op == "identity") {
+        out = in(n, 0);
+      } else {
+        throw std::runtime_error("predict: unsupported op " + n.op +
+                                 " (node " + n.name + ")");
+      }
+      values[id] = {std::move(out)};
+    }
+    outputs.clear();
+    for (auto [nid, oi] : heads) outputs.push_back(values[nid][oi]);
+    // free intermediates, keep variables (params) for the next forward
+    for (size_t id = 0; id < nodes.size(); ++id)
+      if (nodes[id].op != "null") values[id].clear();
+  }
+};
+
+}  // namespace predict
+
+// ---------------------------------------------------------------------------
+// C ABI (ref: include/mxnet/c_predict_api.h)
+// ---------------------------------------------------------------------------
+extern "C" {
+
+typedef void* PredictorHandle;
+static thread_local std::string mxpred_last_error;
+
+const char* MXPredGetLastError() { return mxpred_last_error.c_str(); }
+
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 unsigned num_input_nodes, const char** input_keys,
+                 const unsigned* input_shape_indptr,
+                 const unsigned* input_shape_data, PredictorHandle* out) {
+  (void)dev_type; (void)dev_id;
+  (void)num_input_nodes; (void)input_keys;
+  (void)input_shape_indptr; (void)input_shape_data;
+  try {
+    auto* p = new predict::Predictor();
+    p->load_graph(symbol_json);
+    p->params = predict::load_params(param_bytes, (size_t)param_size);
+    *out = p;
+    return 0;
+  } catch (const std::exception& e) {
+    mxpred_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, unsigned size) {
+  auto* p = (predict::Predictor*)handle;
+  try {
+    auto it = p->var_nodes.find(key);
+    if (it == p->var_nodes.end())
+      throw std::runtime_error(std::string("unknown input ") + key);
+    // shape must have been provided via MXPredSetInputShape or reuse
+    if (p->inputs_by_node.empty()) p->inputs_by_node.resize(p->nodes.size());
+    predict::Tensor& t = p->inputs_by_node[it->second];
+    if (t.shape.empty())
+      throw std::runtime_error(std::string("set shape first for ") + key);
+    if ((unsigned)t.size() != size)
+      throw std::runtime_error("input size mismatch");
+    t.data.assign(data, data + size);
+    p->values[it->second] = {t};
+    return 0;
+  } catch (const std::exception& e) {
+    mxpred_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXPredSetInputShape(PredictorHandle handle, const char* key,
+                        const long* shape, unsigned ndim) {
+  auto* p = (predict::Predictor*)handle;
+  try {
+    auto it = p->var_nodes.find(key);
+    if (it == p->var_nodes.end())
+      throw std::runtime_error(std::string("unknown input ") + key);
+    if (p->inputs_by_node.empty()) p->inputs_by_node.resize(p->nodes.size());
+    predict::Tensor& t = p->inputs_by_node[it->second];
+    t.shape.assign(shape, shape + ndim);
+    return 0;
+  } catch (const std::exception& e) {
+    mxpred_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXPredForward(PredictorHandle handle) {
+  auto* p = (predict::Predictor*)handle;
+  try {
+    p->forward();
+    return 0;
+  } catch (const std::exception& e) {
+    mxpred_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, unsigned index,
+                         long* shape_data, unsigned* ndim) {
+  auto* p = (predict::Predictor*)handle;
+  try {
+    if (index >= p->outputs.size())
+      throw std::runtime_error("output index out of range");
+    const auto& s = p->outputs[index].shape;
+    *ndim = (unsigned)s.size();
+    if (shape_data)
+      for (size_t i = 0; i < s.size(); ++i) shape_data[i] = s[i];
+    return 0;
+  } catch (const std::exception& e) {
+    mxpred_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXPredGetOutput(PredictorHandle handle, unsigned index, float* data,
+                    unsigned size) {
+  auto* p = (predict::Predictor*)handle;
+  try {
+    if (index >= p->outputs.size())
+      throw std::runtime_error("output index out of range");
+    const predict::Tensor& t = p->outputs[index];
+    if ((unsigned)t.size() != size)
+      throw std::runtime_error("output size mismatch");
+    std::memcpy(data, t.data.data(), size * sizeof(float));
+    return 0;
+  } catch (const std::exception& e) {
+    mxpred_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXPredFree(PredictorHandle handle) {
+  delete (predict::Predictor*)handle;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,81 @@
+/* C client of the predict API: loads an exported LeNet and classifies
+ * digits from a raw float file; pure C, links only libmxtpu.so
+ * (ref: the reference's image-classification/predict-cpp example). */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* PredictorHandle;
+extern int MXPredCreate(const char*, const void*, int, int, int, unsigned,
+                        const char**, const unsigned*, const unsigned*,
+                        PredictorHandle*);
+extern int MXPredSetInputShape(PredictorHandle, const char*, const long*,
+                               unsigned);
+extern int MXPredSetInput(PredictorHandle, const char*, const float*,
+                          unsigned);
+extern int MXPredForward(PredictorHandle);
+extern int MXPredGetOutputShape(PredictorHandle, unsigned, long*, unsigned*);
+extern int MXPredGetOutput(PredictorHandle, unsigned, float*, unsigned);
+extern int MXPredFree(PredictorHandle);
+extern const char* MXPredGetLastError(void);
+
+static char* read_file(const char* path, long* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(1); }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) exit(1);
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s sym.json model.params input.f32 batch\n",
+            argv[0]);
+    return 2;
+  }
+  long sym_size, param_size, in_size;
+  char* sym = read_file(argv[1], &sym_size);
+  char* params = read_file(argv[2], &param_size);
+  char* input = read_file(argv[3], &in_size);
+  long batch = atol(argv[4]);
+  long feat = in_size / (long)sizeof(float) / batch;
+
+  PredictorHandle h;
+  if (MXPredCreate(sym, params, (int)param_size, 1, 0, 0, NULL, NULL, NULL,
+                   &h)) {
+    fprintf(stderr, "create failed: %s\n", MXPredGetLastError());
+    return 1;
+  }
+  long shape[4] = {batch, 1, 28, 28};
+  unsigned ndim = 4;
+  if (feat != 784) { shape[1] = feat; ndim = 2; }
+  if (MXPredSetInputShape(h, "data", shape, ndim) ||
+      MXPredSetInput(h, "data", (const float*)input,
+                     (unsigned)(in_size / sizeof(float))) ||
+      MXPredForward(h)) {
+    fprintf(stderr, "forward failed: %s\n", MXPredGetLastError());
+    return 1;
+  }
+  long oshape[8];
+  unsigned ondim;
+  MXPredGetOutputShape(h, 0, oshape, &ondim);
+  long osz = 1;
+  for (unsigned i = 0; i < ondim; ++i) osz *= oshape[i];
+  float* out = (float*)malloc(osz * sizeof(float));
+  MXPredGetOutput(h, 0, out, (unsigned)osz);
+  long classes = oshape[ondim - 1];
+  for (long n = 0; n < batch; ++n) {
+    long best = 0;
+    for (long c = 1; c < classes; ++c)
+      if (out[n * classes + c] > out[n * classes + best]) best = c;
+    printf("%ld\n", best);
+  }
+  MXPredFree(h);
+  free(sym); free(params); free(input); free(out);
+  return 0;
+}

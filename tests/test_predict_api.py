@@ -1,0 +1,129 @@
+"""C predict API: the native (no-Python) inference path over exported
+-symbol.json + .params (ref: src/c_api/c_predict_api.cc; example client
+analog: the reference's predict-cpp image-classification example)."""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu._native import get_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _predict_native(lib, sym_path, params_path, x):
+    lib.MXPredCreate.restype = ctypes.c_int
+    lib.MXPredGetLastError.restype = ctypes.c_char_p
+    handle = ctypes.c_void_p()
+    sym = open(sym_path, "rb").read()
+    params = open(params_path, "rb").read()
+    rc = lib.MXPredCreate(ctypes.c_char_p(sym), params, len(params), 1, 0,
+                          0, None, None, None, ctypes.byref(handle))
+    assert rc == 0, lib.MXPredGetLastError().decode()
+    shape = (ctypes.c_long * x.ndim)(*x.shape)
+    assert lib.MXPredSetInputShape(handle, b"data", shape, x.ndim) == 0
+    flat = np.ascontiguousarray(x, dtype=np.float32)
+    assert lib.MXPredSetInput(
+        handle, b"data",
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat.size) == 0, lib.MXPredGetLastError().decode()
+    rc = lib.MXPredForward(handle)
+    assert rc == 0, lib.MXPredGetLastError().decode()
+    oshape = (ctypes.c_long * 8)()
+    ondim = ctypes.c_uint()
+    assert lib.MXPredGetOutputShape(handle, 0, oshape,
+                                    ctypes.byref(ondim)) == 0
+    out_shape = tuple(oshape[i] for i in range(ondim.value))
+    out = np.zeros(out_shape, np.float32)
+    assert lib.MXPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size) == 0
+    lib.MXPredFree(handle)
+    return out
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "MXPredCreate"):
+        pytest.skip("native library unavailable")
+    return lib
+
+
+def test_lenet_matches_python(native_lib, tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 5, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(16, 5, activation="tanh"),
+            gluon.nn.AvgPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = np.random.rand(4, 1, 28, 28).astype(np.float32)
+    want = net(nd.array(x)).asnumpy()
+    prefix = str(tmp_path / "lenet")
+    net.export(prefix)
+    got = _predict_native(native_lib, f"{prefix}-symbol.json",
+                          f"{prefix}-0000.params", x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_resnet18_matches_python(native_lib, tmp_path):
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    for _ in range(2):    # warm BN running stats
+        with autograd.record():
+            net(nd.array(np.random.randn(4, 3, 32, 32)
+                         .astype(np.float32)))
+    net.hybridize()
+    x = np.random.randn(2, 3, 32, 32).astype(np.float32)
+    want = net(nd.array(x)).asnumpy()
+    prefix = str(tmp_path / "rn18")
+    net.export(prefix)
+    got = _predict_native(native_lib, f"{prefix}-symbol.json",
+                          f"{prefix}-0000.params", x)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_error_paths(native_lib, tmp_path):
+    lib = native_lib
+    handle = ctypes.c_void_p()
+    rc = lib.MXPredCreate(b"not json at all", b"junk", 4, 1, 0, 0, None,
+                          None, None, ctypes.byref(handle))
+    assert rc != 0
+    assert lib.MXPredGetLastError().decode()
+
+
+def test_c_client_end_to_end(native_lib, tmp_path):
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = np.random.rand(8, 784).astype(np.float32)
+    want = net(nd.array(x)).asnumpy().argmax(1)
+    prefix = str(tmp_path / "mlp")
+    net.export(prefix)
+    x.tofile(str(tmp_path / "in.f32"))
+    exe = str(tmp_path / "client")
+    native_dir = os.path.join(REPO, "native")
+    subprocess.run(
+        [cc, "-o", exe, os.path.join(native_dir, "test_predict.c"),
+         f"-L{native_dir}", "-lmxtpu", f"-Wl,-rpath,{native_dir}"],
+        check=True, capture_output=True)
+    out = subprocess.run(
+        [exe, f"{prefix}-symbol.json", f"{prefix}-0000.params",
+         str(tmp_path / "in.f32"), "8"],
+        check=True, capture_output=True, text=True)
+    got = np.array([int(v) for v in out.stdout.split()])
+    np.testing.assert_array_equal(got, want)
