@@ -275,9 +275,16 @@ def _deposit_leaf(leaf, g):
                 rs = None
         if rs is not None:
             leaf._grad._sparse = rs
+            leaf._grad._sparse_used = False
             leaf._grad._zeroed = False
             return
         g = g.todense()
+    prev_rs = getattr(leaf._grad, "_sparse", None)
+    if prev_rs is not None and req == "add":
+        # a dense add-deposit must fold the retained sparse view in (the
+        # dense buffer under it is still zeros), not discard it
+        import jax.numpy as jnp
+        g = g + jnp.asarray(prev_rs.asnumpy(), dtype=g.dtype)
     leaf._grad._sparse = None      # dense deposit invalidates sparse view
     leaf._grad._zeroed = False
     g = g.astype(leaf._grad._data.dtype)

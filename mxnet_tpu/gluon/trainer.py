@@ -159,10 +159,16 @@ class Trainer:
                 if getattr(param, "_grad_stype", "default") \
                         == "row_sparse":
                     rs = getattr(grad, "_sparse", None)
-                    if rs is not None:
-                        g = rs    # touched-rows-only update; the sparse
-                        # view stays readable (param.grad()) until the
-                        # next backward or zero_grad replaces it
+                    if rs is not None and \
+                            not getattr(grad, "_sparse_used", False):
+                        g = rs    # touched-rows-only update. The view
+                        # stays readable (param.grad()) but is marked
+                        # consumed so a step without a fresh backward
+                        # doesn't re-apply it (the dense path's stale
+                        # grad is the zero buffer).
+                        grad._sparse_used = True
+                    elif rs is not None:
+                        continue  # stale sparse grad: nothing new to apply
                 upd(i, g, arr)
 
     def save_states(self, fname):

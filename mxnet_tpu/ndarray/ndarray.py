@@ -34,7 +34,8 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
 
 class NDArray:
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_node",
-                 "_tape_out_idx", "_sparse", "_zeroed", "__weakref__")
+                 "_tape_out_idx", "_sparse", "_sparse_used", "_zeroed",
+                 "__weakref__")
 
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None,
                  _skip_device_put: bool = False):

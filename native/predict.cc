@@ -223,9 +223,13 @@ static std::map<std::string, Tensor> load_params(const void* buf, size_t n) {
     arrays[i] = std::move(t);
   }
   uint64_t n_names = r.get<uint64_t>();
+  if (n_names > count)
+    throw std::runtime_error("params: more names than arrays");
   std::map<std::string, Tensor> out;
   for (uint64_t i = 0; i < n_names; ++i) {
     uint64_t len = r.get<uint64_t>();
+    if (r.p + len > r.end)
+      throw std::runtime_error("params: truncated name");
     std::string name((const char*)r.p, len);
     r.p += len;
     // strip arg:/aux: prefixes
@@ -436,7 +440,10 @@ struct Predictor {
     auto [nid, oi] = n.inputs[i];
     if (values[nid].empty())
       throw std::runtime_error("node input not computed for " + n.name);
-    return values[nid][oi < (long)values[nid].size() ? oi : 0];
+    if (oi >= (long)values[nid].size())
+      throw std::runtime_error("output index " + std::to_string(oi) +
+                               " out of range for node feeding " + n.name);
+    return values[nid][oi];
   }
 
   void forward() {
@@ -602,12 +609,28 @@ int MXPredCreate(const char* symbol_json, const void* param_bytes,
                  const unsigned* input_shape_indptr,
                  const unsigned* input_shape_data, PredictorHandle* out) {
   (void)dev_type; (void)dev_id;
-  (void)num_input_nodes; (void)input_keys;
-  (void)input_shape_indptr; (void)input_shape_data;
   try {
     auto* p = new predict::Predictor();
     p->load_graph(symbol_json);
     p->params = predict::load_params(param_bytes, (size_t)param_size);
+    // the reference workflow passes input shapes here (c_predict_api.h):
+    // seed them so MXPredSetInput works without a separate
+    // MXPredSetInputShape call
+    if (num_input_nodes > 0 && input_keys && input_shape_indptr &&
+        input_shape_data) {
+      p->inputs_by_node.resize(p->nodes.size());
+      for (unsigned i = 0; i < num_input_nodes; ++i) {
+        auto it = p->var_nodes.find(input_keys[i]);
+        if (it == p->var_nodes.end())
+          throw std::runtime_error(std::string("unknown input ") +
+                                   input_keys[i]);
+        predict::Tensor& t = p->inputs_by_node[it->second];
+        t.shape.clear();
+        for (unsigned d = input_shape_indptr[i];
+             d < input_shape_indptr[i + 1]; ++d)
+          t.shape.push_back((long)input_shape_data[d]);
+      }
+    }
     *out = p;
     return 0;
   } catch (const std::exception& e) {
