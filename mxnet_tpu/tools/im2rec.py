@@ -81,18 +81,18 @@ def make_list(args):
                        chunk[sep_test:sep_test + sep])
 
 
-def image_encode(args, i, item, q_out):
-    import cv2
-    import numpy as np
+def image_encode(args, item):
     from mxnet_tpu import recordio
 
     fullpath = os.path.join(args.root, item[1])
     header = recordio.IRHeader(0, item[2] if len(item[2]) > 1
                                else item[2][0], item[0], 0)
     if args.pass_through:
+        # raw pack never decodes: keep cv2 optional for this mode
         with open(fullpath, "rb") as fin:
             img = fin.read()
         return recordio.pack(header, img)
+    import cv2
     img = cv2.imread(fullpath, args.color)
     if img is None:
         print(f"imread error: {fullpath}", file=sys.stderr)
@@ -129,7 +129,7 @@ def make_rec(args):
                                             "w")
         count = 0
         for item in read_list(lst_path):
-            packed = image_encode(args, count, item, None)
+            packed = image_encode(args, item)
             if packed is None:
                 continue
             record.write_idx(item[0], packed)

@@ -66,6 +66,8 @@ def launch_local(args, command):
 
 
 def launch_ssh(args, command):
+    if not args.hostfile:
+        raise SystemExit("--launcher ssh requires -H/--hostfile")
     with open(args.hostfile) as f:
         hosts = [h.strip() for h in f if h.strip()]
     if len(hosts) < args.num_workers:

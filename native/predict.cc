@@ -228,14 +228,14 @@ static std::map<std::string, Tensor> load_params(const void* buf, size_t n) {
   std::map<std::string, Tensor> out;
   for (uint64_t i = 0; i < n_names; ++i) {
     uint64_t len = r.get<uint64_t>();
-    if (r.p + len > r.end)
+    if (len > (size_t)(r.end - r.p))   // no pointer arithmetic: huge len
       throw std::runtime_error("params: truncated name");
     std::string name((const char*)r.p, len);
     r.p += len;
     // strip arg:/aux: prefixes
     auto pos = name.find(':');
     if (pos != std::string::npos) name = name.substr(pos + 1);
-    out[name] = arrays[i];
+    out[name] = std::move(arrays[i]);
   }
   return out;
 }
@@ -610,7 +610,7 @@ int MXPredCreate(const char* symbol_json, const void* param_bytes,
                  const unsigned* input_shape_data, PredictorHandle* out) {
   (void)dev_type; (void)dev_id;
   try {
-    auto* p = new predict::Predictor();
+    auto p = std::make_unique<predict::Predictor>();
     p->load_graph(symbol_json);
     p->params = predict::load_params(param_bytes, (size_t)param_size);
     // the reference workflow passes input shapes here (c_predict_api.h):
@@ -631,7 +631,7 @@ int MXPredCreate(const char* symbol_json, const void* param_bytes,
           t.shape.push_back((long)input_shape_data[d]);
       }
     }
-    *out = p;
+    *out = p.release();
     return 0;
   } catch (const std::exception& e) {
     mxpred_last_error = e.what();
