@@ -41,6 +41,7 @@ from .base import MXNetError
 from .context import (Context, cpu, cpu_pinned, cpu_shared, current_context,
                       gpu, gpu_memory_info, num_gpus, num_tpus, tpu)
 from . import engine
+from . import library
 from . import ndarray
 from . import ndarray as nd
 from . import autograd

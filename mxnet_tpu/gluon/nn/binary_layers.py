@@ -13,7 +13,7 @@ from __future__ import annotations
 from ...base import MXNetError
 from ..block import HybridBlock
 
-__all__ = ["QActivation", "QDense", "QConv2D"]
+__all__ = ["QActivation", "QDense", "QConv2D", "pack_binary_weights"]
 
 
 class QActivation(HybridBlock):
@@ -109,3 +109,41 @@ class QConv2D(HybridBlock):
     def hybrid_forward(self, F, x, weight, bias=None):
         args = [x, weight] + ([bias] if bias is not None else [])
         return F.QConvolution(*args, no_bias=bias is None, **self._kwargs)
+
+
+def pack_binary_weights(layer):
+    """Pre-pack a trained QDense/QConv2D layer's weights for XNOR-popcount
+    inference (32x weight compression — the BMXNet deployment flow, where
+    binary_word-packed models ship to mobile). Returns:
+
+    - QDense:  (w_packed uint32 [units, W32], alpha or None,
+                bias or None)
+    - QConv2D: (w_packed uint32 [channels, W32] over C*kh*kw,
+                alpha or None, bias or None)
+
+    Use with ``nd.contrib.xnor_fully_connected`` /
+    ``nd.contrib.xnor_convolution`` — pass alpha and bias positionally in
+    that order (alpha may be a ones-scalar when the layer has
+    scaling=False but a bias); outputs then equal the layer's own forward
+    for sign-binarized inputs (tests/test_binary.py).
+    """
+    from ... import ndarray as nd_mod
+    w = layer.weight.data()
+    scaling = layer._scaling
+    bias = layer.bias.data() if getattr(layer, "bias", None) is not None \
+        else None
+    if isinstance(layer, QDense):
+        wp = nd_mod.contrib.binary_pack(w)
+        alpha = nd_mod.mean(nd_mod.abs(w)) if scaling else None
+        if alpha is None and bias is not None:
+            alpha = nd_mod.ones((1,))   # keep the positional slots aligned
+        return wp, alpha, bias
+    if isinstance(layer, QConv2D):
+        w2 = w.reshape((w.shape[0], -1))
+        wp = nd_mod.contrib.binary_pack(w2)
+        alpha = nd_mod.mean(nd_mod.abs(w2), axis=1) if scaling else None
+        if alpha is None and bias is not None:
+            alpha = nd_mod.ones((1,))
+        return wp, alpha, bias
+    raise MXNetError(f"pack_binary_weights: unsupported layer "
+                     f"{type(layer).__name__}")

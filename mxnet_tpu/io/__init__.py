@@ -497,3 +497,97 @@ class ImageDetRecordIter(ImageRecordIter):
         self._pad_value = label_pad_value
 
 __all__.append("ImageDetRecordIter")
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text-format iterator (ref: src/io/iter_libsvm.cc LibSVMIter):
+    lines of ``label idx:val idx:val ...`` (indices 0-based like the
+    reference's default). Data batches are CSRNDArray (the reference
+    yields csr storage); labels are dense. Optional ``label_libsvm``
+    holds multi-dim labels in the same format."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = tuple(data_shape) if not isinstance(
+            data_shape, int) else (data_shape,)
+        self._label_shape = (tuple(label_shape) if not isinstance(
+            label_shape, int) else (label_shape,)) if label_shape else None
+        self._rows = self._parse(data_libsvm, want_label=True)
+        self._labels_ext = None
+        if label_libsvm:
+            self._labels_ext = self._parse(label_libsvm, want_label=False)
+            if len(self._labels_ext) != len(self._rows):
+                raise MXNetError(
+                    f"LibSVMIter: label file has {len(self._labels_ext)} "
+                    f"rows, data file {len(self._rows)}")
+        self._pos = 0
+
+    @staticmethod
+    def _parse(path, want_label):
+        rows = []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                if want_label:
+                    label = float(parts[0])
+                    feats = parts[1:]
+                else:
+                    label = None
+                    feats = parts
+                idx, val = [], []
+                for tok in feats:
+                    i, v = tok.split(":")
+                    idx.append(int(i))
+                    val.append(float(v))
+                rows.append((label, idx, val))
+        return rows
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data",
+                         (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        if self._label_shape:
+            return [DataDesc("softmax_label",
+                             (self.batch_size,) + self._label_shape)]
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._pos = 0
+
+    def next(self):
+        from ..ndarray.sparse import CSRNDArray
+        if self._pos + self.batch_size > len(self._rows):
+            raise StopIteration
+        dim = self._data_shape[0]
+        data, indices, indptr = [], [], [0]
+        labels = []
+        for j in range(self.batch_size):
+            row = self._pos + j
+            label, idx, val = self._rows[row]
+            indices.extend(idx)
+            data.extend(val)
+            indptr.append(len(indices))
+            if self._labels_ext is not None:
+                # separate label file: each row is idx:val pairs densified
+                # over label_shape (ref: iter_libsvm.cc label_libsvm)
+                ldim = self._label_shape[0] if self._label_shape else 1
+                lrow = np.zeros(ldim, np.float32)
+                _, lidx, lval = self._labels_ext[row]
+                lrow[np.asarray(lidx, np.int64)] = lval
+                labels.append(lrow if ldim > 1 else float(lrow[0]))
+            else:
+                labels.append(label if label is not None else 0.0)
+        self._pos += self.batch_size
+        csr = CSRNDArray(np.asarray(data, np.float32),
+                         np.asarray(indices, np.int64),
+                         np.asarray(indptr, np.int64),
+                         (self.batch_size, dim))
+        return DataBatch(data=[csr],
+                         label=[nd.array(np.asarray(labels, np.float32))])
+__all__.append("LibSVMIter")

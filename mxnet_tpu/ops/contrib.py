@@ -306,15 +306,17 @@ def _interleaved_valatt(qkv, att, heads=None):
 
 @register("_contrib_flash_attention", num_inputs=3,
           params=[OpParam("block_size", int, 512),
-                  OpParam("causal", bool, False)],
+                  OpParam("causal", bool, False),
+                  OpParam("sm_scale", float, None)],
           doc="Blockwise online-softmax attention on [B, H, S, D] inputs — "
               "memory-efficient long-context attention (net-new TPU "
               "capability, SURVEY §5.7; no reference analog — MXNet 1.x "
               "used full attention). Sequence-parallel variant: "
               "mxnet_tpu.parallel.ring_attention.")
-def _flash_attention(q, k, v, block_size=512, causal=False):
+def _flash_attention(q, k, v, block_size=512, causal=False, sm_scale=None):
     import jax
     from ..parallel.ring_attention import blockwise_attention
+    scale = float(q.shape[-1]) ** -0.5 if sm_scale is None else sm_scale
     if k.shape[-2] <= 1024:
         # short KV: one fused softmax(QK^T)V straight on the MXU via the
         # shared dense-attention definition (attention_reference — one
@@ -323,8 +325,7 @@ def _flash_attention(q, k, v, block_size=512, causal=False):
         # beats any streaming kernel (measured: the Pallas kernels cost
         # ~20x at S=128 — see docs/perf_notes.md).
         from ..parallel.ring_attention import attention_reference
-        return attention_reference(q, k, v, causal=causal,
-                                   scale=float(q.shape[-1]) ** -0.5)
+        return attention_reference(q, k, v, causal=causal, scale=scale)
     # on TPU hardware route to the hand-tiled Pallas kernel (MXU-tiled
     # blocks, VMEM-resident online softmax); the jnp blockwise kernel is
     # the portable fallback and the CPU-test oracle
@@ -333,11 +334,19 @@ def _flash_attention(q, k, v, block_size=512, causal=False):
         try:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention as _pallas_fa)
-            return _pallas_fa(q, k, v, causal=causal,
-                              sm_scale=float(q.shape[-1]) ** -0.5)
-        except Exception:
-            pass
-    return blockwise_attention(q, k, v, block_size=block_size, causal=causal)
+            return _pallas_fa(q, k, v, causal=causal, sm_scale=scale)
+        except Exception as e:
+            # a silent fallback would hide a perf cliff on hardware:
+            # surface it once (weak-spot noted in round-1 review)
+            import warnings
+            if not getattr(_flash_attention, "_warned_fallback", False):
+                _flash_attention._warned_fallback = True
+                warnings.warn(
+                    f"flash_attention: Pallas TPU kernel unavailable "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    f"jnp blockwise kernel", RuntimeWarning)
+    return blockwise_attention(q, k, v, block_size=block_size,
+                               causal=causal, scale=scale)
 
 
 @register("_contrib_ring_attention", num_inputs=3,
@@ -918,3 +927,93 @@ def _count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
     ss = s.reshape(-1).astype(data.dtype)
     out = jnp.zeros((n, out_dim), data.dtype)
     return out.at[:, hh].add(data * ss[None, :])
+
+
+# ---------------------------------------------------------------------------
+# XNOR-popcount packed binary inference (the BMXNet fork's signature
+# capability, SURVEY §2 #23: smd_hpi/src xnor GEMM with int32 bit packing).
+# Weights/activations store ONE BIT per value (32x memory compression);
+# the ±1 dot product is  K - 2*popcount(xor(a, b))  over packed words,
+# computed with lax.population_count on the VPU. On TPU the bf16 MXU
+# matmul of ±1 values is usually FASTER (docs/divergences.md) — the packed
+# path's win is memory/bandwidth (deployment), exactly like the
+# reference's mobile targets.
+# ---------------------------------------------------------------------------
+def _pack_bits_lastdim(x):
+    """Sign-bit pack the last dim into uint32 words (bit i of word j =
+    sign(x[..., 32j+i]) >= 0). Pad tail bits with +1 (consistent packing
+    of both operands makes pads xor to 0 and drop out of the popcount)."""
+    k = x.shape[-1]
+    words = -(-k // 32)
+    pad = words * 32 - k
+    bits = (x >= 0)
+    if pad:
+        bits = jnp.concatenate(
+            [bits, jnp.ones(x.shape[:-1] + (pad,), bool)], axis=-1)
+    bits = bits.reshape(x.shape[:-1] + (words, 32))
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(bits.astype(jnp.uint32) * weights, axis=-1,
+                   dtype=jnp.uint32)
+
+
+@register("_contrib_binary_pack", aliases=["binary_pack"],
+          differentiable=False,
+          doc="Pack sign bits of the last dim into uint32 words "
+              "(BMXNet binary_word packing, 32x weight compression)")
+def _binary_pack(x):
+    return _pack_bits_lastdim(x)
+
+
+@register("_contrib_xnor_fully_connected", num_inputs=-1,
+          params=[OpParam("in_dim", int, None, required=True)],
+          differentiable=False,
+          doc="Packed-binary GEMM: y = in_dim - 2*popcount(xor) over "
+              "uint32-packed ±1 rows (BMXNet xnor_gemm). Inputs: x_packed "
+              "[N, W32], w_packed [num_hidden, W32], (alpha [num_hidden] "
+              "fp32 scale), (bias).")
+def _xnor_fully_connected(xp, wp, *rest, in_dim=None):
+    pc = jnp.sum(lax.population_count(
+        jnp.bitwise_xor(xp[:, None, :], wp[None, :, :])).astype(jnp.int32),
+        axis=-1)
+    y = (in_dim - 2 * pc).astype(jnp.float32)
+    if rest:
+        y = y * rest[0]      # alpha: scalar or [num_hidden], broadcasts
+    if len(rest) > 1:
+        y = y + rest[1]
+    return y
+
+
+@register("_contrib_xnor_convolution", num_inputs=-1,
+          params=[OpParam("kernel", tuple, None, required=True),
+                  OpParam("num_filter", int, None, required=True),
+                  OpParam("stride", tuple, (1, 1)),
+                  OpParam("pad", tuple, (0, 0))],
+          differentiable=False,
+          doc="Packed-binary convolution: im2col patches packed to uint32, "
+              "then the xnor-popcount GEMM (BMXNet binary conv inference). "
+              "Inputs: x fp (binarized+packed internally), w_packed "
+              "[num_filter, W32] packed over (C*kh*kw), (alpha), (bias). "
+              "Padding uses +1 bits (BMXNet pads with +1, not 0).")
+def _xnor_convolution(x, wp, *rest, kernel=None, num_filter=None,
+                      stride=(1, 1), pad=(0, 0)):
+    kh, kw = kernel
+    n = x.shape[0]
+    # im2col: [N, C*kh*kw, OH, OW] patches; pad value +1 keeps the ±1
+    # algebra exact (sign bit of +1 is 1)
+    xpad = jnp.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]),
+                       (pad[1], pad[1])), constant_values=1.0)
+    patches = lax.conv_general_dilated_patches(
+        xpad, filter_shape=(kh, kw), window_strides=tuple(stride),
+        padding=[(0, 0), (0, 0)])
+    _, ckk, oh, ow = patches.shape
+    cols = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ckk)
+    xp = _pack_bits_lastdim(cols)
+    pc = jnp.sum(lax.population_count(
+        jnp.bitwise_xor(xp[:, None, :], wp[None, :, :])).astype(jnp.int32),
+        axis=-1)
+    y = (ckk - 2 * pc).astype(jnp.float32)
+    if rest:
+        y = y * rest[0]      # alpha: scalar or [num_filter], broadcasts
+    if len(rest) > 1:
+        y = y + rest[1]
+    return y.reshape(n, oh, ow, num_filter).transpose(0, 3, 1, 2)

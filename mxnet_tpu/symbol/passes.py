@@ -106,3 +106,132 @@ def common_subexpression_elimination(sym: Symbol) -> Symbol:
         return new
 
     return Symbol(rebuild(sym._node), sym._index)
+
+
+@register_pass("FuseAttention")
+def fuse_attention(sym: Symbol) -> Symbol:
+    """Rewrite full-attention subgraphs to the fused flash-attention op at
+    bind time — the stated purpose of keeping the subgraph hook (SURVEY §2
+    #12: 'keep a pass hook for Pallas-fused attention'). Two patterns:
+
+    1. ``batch_dot(softmax(batch_dot(q, k, transpose_b=True) [*/ scale],
+       axis=-1), v)`` -> ``_contrib_flash_attention(q*, k, v)`` with any
+       explicit scale folded into q (the flash op applies d^-0.5
+       internally).
+    2. The reference's fused transformer pair
+       ``_contrib_interleaved_matmul_selfatt_valatt(qkv,
+       softmax(_contrib_interleaved_matmul_selfatt_qk(qkv, heads)))``
+       -> reshape/transpose + flash + inverse reshape (one compiled
+       attention kernel instead of two matmuls with a materialized
+       [B*H, S, S] score tensor).
+
+    Activate with ``MXNET_SUBGRAPH_BACKEND=FuseAttention`` like the
+    reference's subgraph backends.
+    """
+    from .symbol import _create
+
+    rebuilt = {}
+
+    def is_softmax_lastdim(node):
+        # a temperature or length attr changes the math / applies masking:
+        # those softmaxes must NOT be rewritten away
+        return node.op in ("softmax", "Softmax") and \
+            int(node.attrs.get("axis", -1)) in (-1,) and \
+            not node.attrs.get("temperature") and \
+            node.attrs.get("length") is None
+
+    def match_pattern1(node):
+        """outer batch_dot(att, v): returns (q, k, v, scale) or None."""
+        if node.op != "batch_dot" or node.attrs.get("transpose_a") or \
+                node.attrs.get("transpose_b"):
+            return None
+        att, v = node.inputs
+        an = att._node
+        if not is_softmax_lastdim(an):
+            return None
+        scores = an.inputs[0]._node
+        scale = 1.0
+        if scores.op == "_mul_scalar":
+            scale = float(scores.attrs.get("scalar", 1.0))
+            scores = scores.inputs[0]._node
+        elif scores.op == "_div_scalar":
+            scale = 1.0 / float(scores.attrs.get("scalar", 1.0))
+            scores = scores.inputs[0]._node
+        if scores.op != "batch_dot" or scores.attrs.get("transpose_a") \
+                or not scores.attrs.get("transpose_b"):
+            return None
+        q, k = scores.inputs
+        return q, k, v, scale
+
+    def match_pattern2(node):
+        """valatt(qkv, softmax(qk(qkv))): returns (qkv, heads) or None."""
+        if node.op != "_contrib_interleaved_matmul_selfatt_valatt":
+            return None
+        qkv, att = node.inputs
+        an = att._node
+        if not is_softmax_lastdim(an):
+            return None
+        qk = an.inputs[0]._node
+        if qk.op != "_contrib_interleaved_matmul_selfatt_qk":
+            return None
+        if qk.inputs[0]._node is not qkv._node:
+            return None
+        return qkv, int(qk.attrs["heads"])
+
+    def rebuild(node):
+        if id(node) in rebuilt:
+            return rebuilt[id(node)]
+        m1 = match_pattern1(node) if node.op else None
+        m2 = match_pattern2(node) if node.op else None
+        if m1 is not None:
+            q, k, v, scale = m1
+            qn = Symbol(rebuild(q._node), q._index)
+            kn = Symbol(rebuild(k._node), k._index)
+            vn = Symbol(rebuild(v._node), v._index)
+            # the graph's explicit scale (or 1.0 when it had none) passes
+            # through sm_scale verbatim — exact rewrite, no shape needed
+            new = _create("_contrib_flash_attention", [qn, kn, vn],
+                          {"sm_scale": scale}, name=node.name + "_flash")
+            rebuilt[id(node)] = new._node
+            return new._node
+        if m2 is not None:
+            qkv, heads = m2
+            qkvn = Symbol(rebuild(qkv._node), qkv._index)
+            h = heads
+            # interleaved layout: (T, N, 3E) decomposes per head as
+            # (T, N, H, 3, D) — see _interleaved_qk's reshape. Slice
+            # q/k/v on the '3' axis, go to (N, H, T, D) for flash, and
+            # invert afterwards.
+            r1 = _create("reshape", [qkvn], {"shape": (0, 0, -4, h, -1)},
+                         name=node.name + "_qh")       # (T, N, H, 3D)
+            r2 = _create("reshape", [r1],
+                         {"shape": (0, 0, 0, -4, 3, -1)},
+                         name=node.name + "_q3")       # (T, N, H, 3, D)
+            outs = []
+            for i, nm in enumerate(("q", "k", "v")):
+                sl = _create("slice_axis", [r2],
+                             {"axis": 3, "begin": i, "end": i + 1},
+                             name=f"{node.name}_{nm}sl")  # (T,N,H,1,D)
+                sq = _create("reshape", [sl], {"shape": (0, 0, 0, -1)},
+                             name=f"{node.name}_{nm}sq")  # (T, N, H, D)
+                tr = _create("transpose", [sq],
+                             {"axes": (1, 2, 0, 3)},
+                             name=f"{node.name}_{nm}t")   # (N, H, T, D)
+                outs.append(tr)
+            fa = _create("_contrib_flash_attention", outs, {},
+                         name=node.name + "_flash")
+            # (N, H, T, D) -> (T, N, E)
+            back = _create("transpose", [fa], {"axes": (2, 0, 1, 3)},
+                           name=node.name + "_bt")
+            out = _create("reshape", [back], {"shape": (0, 0, -3)},
+                          name=node.name + "_merge")
+            rebuilt[id(node)] = out._node
+            return out._node
+        new_inputs = [Symbol(rebuild(s._node), s._index)
+                      for s in node.inputs]
+        new = _Node(node.op, node.name, new_inputs, dict(node.attrs),
+                    num_outputs=node.num_outputs)
+        rebuilt[id(node)] = new
+        return new
+
+    return Symbol(rebuild(sym._node), sym._index)

@@ -46,23 +46,55 @@ def worker_env(rank, n, coord_addr):
 
 
 def launch_local(args, command):
-    procs = []
+    """Spawn the job; heartbeat-monitor the workers and auto-restart the
+    whole job on failure up to --max-restarts (SURVEY §5.3's TPU plan:
+    'checkpoint + relaunch; add heartbeat + auto-resume in the launcher'
+    — the training script resumes from its own latest checkpoint, like
+    the reference's recovery story)."""
+    import time
     coord = f"127.0.0.1:{args.port}"
-    for rank in range(args.num_workers):
-        p = subprocess.Popen(command,
-                             env=worker_env(rank, args.num_workers, coord))
-        procs.append(p)
+    attempts = 0
+    while True:
+        procs = [subprocess.Popen(
+            command, env=dict(worker_env(r, args.num_workers, coord),
+                              MXTPU_RESTART=str(attempts)))
+            for r in range(args.num_workers)]
 
-    def _terminate(signum, frame):
+        def _terminate(signum, frame):
+            for p in procs:
+                p.terminate()
+            sys.exit(1)
+        signal.signal(signal.SIGINT, _terminate)
+        signal.signal(signal.SIGTERM, _terminate)
+
+        # heartbeat loop: poll liveness; one dead worker fails the job
+        # (dist_sync semantics — the reference's dist_sync also cannot
+        # survive a lost worker; recovery = relaunch from checkpoint)
+        failed = False
+        while True:
+            time.sleep(args.heartbeat_interval)
+            codes = [p.poll() for p in procs]
+            if any(c is not None and c != 0 for c in codes):
+                failed = True
+                break
+            if all(c == 0 for c in codes):
+                break
+        if not failed:
+            return 0
         for p in procs:
-            p.terminate()
-        sys.exit(1)
-    signal.signal(signal.SIGINT, _terminate)
-    signal.signal(signal.SIGTERM, _terminate)
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            p.wait()
+        attempts += 1
+        if attempts > args.max_restarts:
+            print(f"launch: job failed after {attempts - 1} restarts",
+                  file=sys.stderr)
+            return 1
+        print(f"launch: worker died; restarting job "
+              f"(attempt {attempts}/{args.max_restarts}, scripts resume "
+              f"from their checkpoints; MXTPU_RESTART={attempts})",
+              file=sys.stderr)
 
 
 def launch_ssh(args, command):
@@ -101,6 +133,12 @@ def main():
     parser.add_argument("--launcher", type=str, default="local",
                         choices=["local", "ssh"])
     parser.add_argument("-p", "--port", type=int, default=9099)
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="auto-restart the job this many times when a "
+                             "worker dies (local launcher); scripts resume "
+                             "from their own checkpoints")
+    parser.add_argument("--heartbeat-interval", type=float, default=0.5,
+                        help="worker liveness poll interval, seconds")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:

@@ -1,0 +1,33 @@
+// Example dynamic op library for mxnet_tpu.library.load — the lib_api.h
+// analog (ref: example/extensions/lib_custom_op in the reference). Builds
+// standalone: g++ -shared -fPIC -o libexample_plugin.so example_plugin.cc
+#include <cmath>
+
+extern "C" {
+
+int mxtpu_plugin_op_count(void) { return 2; }
+
+const char* mxtpu_plugin_op_name(int i) {
+  return i == 0 ? "plugin_gelu_tanh" : "plugin_mish";
+}
+
+int mxtpu_plugin_op_compute(int i, const float* x, float* y, long n) {
+  if (i == 0) {
+    for (long j = 0; j < n; ++j) {
+      float v = x[j];
+      y[j] = 0.5f * v *
+             (1.f + std::tanh(0.7978845608f * (v + 0.044715f * v * v * v)));
+    }
+    return 0;
+  }
+  if (i == 1) {
+    for (long j = 0; j < n; ++j) {
+      float v = x[j];
+      y[j] = v * std::tanh(std::log1p(std::exp(v)));
+    }
+    return 0;
+  }
+  return 1;
+}
+
+}  // extern "C"

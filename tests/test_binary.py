@@ -84,3 +84,67 @@ def test_binary_mlp_trains():
         trainer.step(n)
     metric.update([mx.nd.array(y)], [net(mx.nd.array(x))])
     assert metric.get()[1] > 0.6, metric.get()
+
+
+def test_xnor_packed_fc_matches_sign_matmul():
+    rng = np.random.RandomState(0)
+    for k in (64, 70, 17):
+        x = rng.randn(5, k).astype(np.float32)
+        w = rng.randn(7, k).astype(np.float32)
+        xp = mx.nd.contrib.binary_pack(mx.nd.array(x))
+        wp = mx.nd.contrib.binary_pack(mx.nd.array(w))
+        assert xp.asnumpy().dtype == np.uint32
+        assert xp.shape[-1] == -(-k // 32)      # 32x compression
+        y = mx.nd.contrib.xnor_fully_connected(
+            xp, wp, in_dim=k).asnumpy()
+        sx = np.where(x >= 0, 1.0, -1.0)
+        sw = np.where(w >= 0, 1.0, -1.0)
+        np.testing.assert_allclose(y, sx @ sw.T, atol=1e-5)
+
+
+def test_xnor_packed_conv_matches_qconv():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 9, 9).astype(np.float32)
+    w = rng.randn(6, 4, 3, 3).astype(np.float32)
+    wp = mx.nd.contrib.binary_pack(mx.nd.array(w.reshape(6, -1)))
+    for pad in ((0, 0), (1, 1)):
+        got = mx.nd.contrib.xnor_convolution(
+            mx.nd.array(x), wp, kernel=(3, 3), num_filter=6,
+            pad=pad).asnumpy()
+        # reference semantics: binary conv pads with +1 (BMXNet), so
+        # compare against QConvolution on a +1-padded input
+        xp1 = np.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]),
+                         (pad[1], pad[1])), constant_values=1.0)
+        want = mx.nd.QConvolution(
+            mx.nd.array(xp1), mx.nd.array(w), kernel=(3, 3),
+            num_filter=6, scaling=False, no_bias=True).asnumpy()
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_pack_binary_weights_layer_inference():
+    from mxnet_tpu.gluon.nn.binary_layers import pack_binary_weights
+    net = mx.gluon.nn.QDense(8, in_units=64)
+    net.initialize()
+    x = np.random.RandomState(2).randn(4, 64).astype(np.float32)
+    want = net(mx.nd.array(x)).asnumpy()
+    wp, alpha, bias = pack_binary_weights(net)
+    xp = mx.nd.contrib.binary_pack(mx.nd.array(x))
+    args = [xp, wp] + ([alpha] if alpha is not None else []) \
+        + ([bias] if bias is not None else [])
+    got = mx.nd.contrib.xnor_fully_connected(
+        *args, in_dim=64).asnumpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_pack_binary_weights_with_bias():
+    from mxnet_tpu.gluon.nn.binary_layers import pack_binary_weights
+    net = mx.gluon.nn.QDense(8, in_units=64, use_bias=True, scaling=False)
+    net.initialize()
+    x = np.random.RandomState(3).randn(4, 64).astype(np.float32)
+    want = net(mx.nd.array(x)).asnumpy()
+    wp, alpha, bias = pack_binary_weights(net)
+    assert bias is not None and alpha is not None   # ones placeholder
+    got = mx.nd.contrib.xnor_fully_connected(
+        mx.nd.contrib.binary_pack(mx.nd.array(x)), wp, alpha, bias,
+        in_dim=64).asnumpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)

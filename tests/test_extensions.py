@@ -1,0 +1,152 @@
+"""Round-2 extension surface: FuseAttention graph pass, dynamic op
+libraries (lib_api.h analog), launcher auto-restart, LibSVMIter
+(ref: src/operator/subgraph/, include/mxnet/lib_api.h,
+tools/launch.py tracker, src/io/iter_libsvm.cc)."""
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import nd
+from mxnet_tpu.symbol import passes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestFuseAttention:
+    def _binds(self, B=3, S=10, D=8):
+        r = np.random.RandomState(0)
+        return {k: nd.array(r.randn(B, S, D).astype(np.float32))
+                for k in ("q", "k", "v")}
+
+    def test_batch_dot_pattern_with_scale(self):
+        q, k, v = mx.sym.var("q"), mx.sym.var("k"), mx.sym.var("v")
+        out = mx.sym.batch_dot(
+            mx.sym.softmax(mx.sym.batch_dot(q, k, transpose_b=True)
+                           * (1.0 / np.sqrt(8)), axis=-1), v)
+        fused = passes.apply_pass(out, "FuseAttention")
+        ops = [n.op for n in fused._topo() if n.op]
+        assert "_contrib_flash_attention" in ops
+        assert "batch_dot" not in ops
+        binds = self._binds()
+        want = out.bind(mx.cpu(), dict(binds)).forward()[0].asnumpy()
+        got = fused.bind(mx.cpu(), dict(binds)).forward()[0].asnumpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_batch_dot_pattern_no_scale(self):
+        q, k, v = mx.sym.var("q"), mx.sym.var("k"), mx.sym.var("v")
+        out = mx.sym.batch_dot(
+            mx.sym.softmax(mx.sym.batch_dot(q, k, transpose_b=True),
+                           axis=-1), v)
+        fused = passes.apply_pass(out, "FuseAttention")
+        binds = self._binds()
+        want = out.bind(mx.cpu(), dict(binds)).forward()[0].asnumpy()
+        got = fused.bind(mx.cpu(), dict(binds)).forward()[0].asnumpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_interleaved_pattern(self):
+        T, N, E, H = 6, 2, 16, 4
+        qkv = mx.sym.var("qkv")
+        sc = mx.sym.contrib.interleaved_matmul_selfatt_qk(qkv, heads=H)
+        out = mx.sym.contrib.interleaved_matmul_selfatt_valatt(
+            qkv, mx.sym.softmax(sc, axis=-1), heads=H)
+        fused = passes.apply_pass(out, "FuseAttention")
+        ops = [n.op for n in fused._topo() if n.op]
+        assert "_contrib_flash_attention" in ops
+        x = np.random.RandomState(1).randn(T, N, 3 * E) \
+            .astype(np.float32)
+        want = out.bind(mx.cpu(),
+                        {"qkv": nd.array(x)}).forward()[0].asnumpy()
+        got = fused.bind(mx.cpu(),
+                         {"qkv": nd.array(x)}).forward()[0].asnumpy()
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_non_matching_graph_unchanged(self):
+        a, b = mx.sym.var("a"), mx.sym.var("b")
+        out = mx.sym.batch_dot(a, b)        # no softmax: no rewrite
+        fused = passes.apply_pass(out, "FuseAttention")
+        assert [n.op for n in fused._topo() if n.op] == ["batch_dot"]
+
+
+class TestLibraryLoad:
+    def test_python_plugin(self, tmp_path):
+        plug = tmp_path / "plug.py"
+        plug.write_text(
+            "import jax.numpy as jnp\n"
+            "from mxnet_tpu.ops import register\n"
+            "@register('plugin_cube_t', doc='x^3')\n"
+            "def _cube(x):\n"
+            "    return x * x * x\n")
+        names = mx.library.load(str(plug), verbose=False)
+        assert names == ["plugin_cube_t"]
+        x = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            nd.plugin_cube_t(nd.array(x)).asnumpy(), x ** 3, atol=1e-5)
+        # also visible in the symbol namespace
+        s = mx.sym.plugin_cube_t(mx.sym.var("a"))
+        got = s.bind(mx.cpu(), {"a": nd.array(x)}).forward()[0].asnumpy()
+        np.testing.assert_allclose(got, x ** 3, atol=1e-5)
+
+    def test_native_plugin(self, tmp_path):
+        if shutil.which("g++") is None:
+            pytest.skip("no g++")
+        so = tmp_path / "libplug.so"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", str(so),
+             os.path.join(REPO, "native", "example_plugin.cc")],
+            check=True, capture_output=True)
+        names = mx.library.load(str(so), verbose=False)
+        assert names == ["plugin_gelu_tanh", "plugin_mish"]
+        x = np.random.randn(4, 5).astype(np.float32)
+        got = nd.plugin_mish(nd.array(x)).asnumpy()
+        np.testing.assert_allclose(
+            got, x * np.tanh(np.log1p(np.exp(x))), atol=1e-5)
+
+    def test_bad_library(self, tmp_path):
+        bad = tmp_path / "x.txt"
+        bad.write_text("nope")
+        with pytest.raises(mx.MXNetError, match="py or .so"):
+            mx.library.load(str(bad))
+
+
+def test_launcher_auto_restart(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, sys\n"
+        "marker = sys.argv[1] + '.' + os.environ['MXTPU_PROC_ID']\n"
+        "if os.environ.get('MXTPU_RESTART') == '0' and \\\n"
+        "        os.environ['MXTPU_PROC_ID'] == '0':\n"
+        "    sys.exit(3)\n"
+        "open(marker, 'w').write(os.environ['MXTPU_RESTART'])\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--max-restarts", "2",
+         "--heartbeat-interval", "0.2",
+         sys.executable, str(script), str(tmp_path / "m")],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "restarting job" in r.stderr
+    assert (tmp_path / "m.0").read_text() == "1"
+
+
+def test_libsvm_iter(tmp_path):
+    f = tmp_path / "t.libsvm"
+    f.write_text("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n0 0:2.0\n")
+    it = mio.LibSVMIter(str(f), data_shape=4, batch_size=2)
+    b = it.next()
+    assert b.data[0].stype == "csr"
+    np.testing.assert_allclose(b.data[0].asnumpy(),
+                               [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    np.testing.assert_allclose(b.label[0].asnumpy(), [1, 0])
+    it.next()
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    np.testing.assert_allclose(it.next().label[0].asnumpy(), [1, 0])
