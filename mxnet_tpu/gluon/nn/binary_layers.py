@@ -125,23 +125,32 @@ def pack_binary_weights(layer):
     ``nd.contrib.xnor_convolution`` — pass alpha and bias positionally in
     that order (alpha may be a ones-scalar when the layer has
     scaling=False but a bias); outputs then equal the layer's own forward
-    for sign-binarized inputs (tests/test_binary.py).
+    for sign-binarized inputs (tests/test_binary.py). Caveat for padded
+    convolutions: the float-simulation layer zero-pads (border taps
+    contribute 0) while the packed path pads with +1 like BMXNet's
+    binary algebra — border outputs differ between the two by design.
     """
     from ... import ndarray as nd_mod
     w = layer.weight.data()
-    scaling = layer._scaling
     bias = layer.bias.data() if getattr(layer, "bias", None) is not None \
         else None
     if isinstance(layer, QDense):
         wp = nd_mod.contrib.binary_pack(w)
-        alpha = nd_mod.mean(nd_mod.abs(w)) if scaling else None
+        alpha = nd_mod.mean(nd_mod.abs(w)) if layer._scaling else None
         if alpha is None and bias is not None:
             alpha = nd_mod.ones((1,))   # keep the positional slots aligned
         return wp, alpha, bias
     if isinstance(layer, QConv2D):
+        if layer._kwargs["num_group"] != 1 or \
+                tuple(layer._kwargs["dilate"]) != (1, 1):
+            raise MXNetError(
+                "pack_binary_weights: xnor_convolution supports only "
+                "groups=1, dilation=1 — this layer's packed inference "
+                "would be silently wrong")
         w2 = w.reshape((w.shape[0], -1))
         wp = nd_mod.contrib.binary_pack(w2)
-        alpha = nd_mod.mean(nd_mod.abs(w2), axis=1) if scaling else None
+        alpha = nd_mod.mean(nd_mod.abs(w2), axis=1) \
+            if layer._kwargs["scaling"] else None
         if alpha is None and bias is not None:
             alpha = nd_mod.ones((1,))
         return wp, alpha, bias

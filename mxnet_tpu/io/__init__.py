@@ -516,6 +516,10 @@ class LibSVMIter(DataIter):
         self._rows = self._parse(data_libsvm, want_label=True)
         self._labels_ext = None
         if label_libsvm:
+            if not self._label_shape:
+                raise MXNetError(
+                    "LibSVMIter: label_libsvm requires label_shape (the "
+                    "dense label dimension to densify indices into)")
             self._labels_ext = self._parse(label_libsvm, want_label=False)
             if len(self._labels_ext) != len(self._rows):
                 raise MXNetError(

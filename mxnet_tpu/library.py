@@ -35,6 +35,7 @@ from .base import MXNetError
 __all__ = ["load", "loaded_libraries"]
 
 _LOADED = {}
+_HANDLES = []      # keep native CDLLs alive without polluting _LOADED
 
 
 def loaded_libraries():
@@ -129,6 +130,5 @@ def _load_native(path):
                      f"(lib_api.h-style dynamic registration)")(
             make_fn(i, op_name))
         names.append(op_name)
-    # keep the CDLL alive for the process lifetime
-    _LOADED[path + "#handle"] = lib
+    _HANDLES.append(lib)     # keep the CDLL alive for process lifetime
     return names

@@ -334,6 +334,12 @@ def _flash_attention(q, k, v, block_size=512, causal=False, sm_scale=None):
         try:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention as _pallas_fa)
+            if q.ndim == 3:
+                # the Pallas kernel wants [B, H, S, D]; 3D graphs (e.g.
+                # FuseAttention pattern-1 rewrites) ride as H=1
+                out = _pallas_fa(q[:, None], k[:, None], v[:, None],
+                                 causal=causal, sm_scale=scale)
+                return out[:, 0]
             return _pallas_fa(q, k, v, causal=causal, sm_scale=scale)
         except Exception as e:
             # a silent fallback would hide a perf cliff on hardware:

@@ -115,9 +115,10 @@ def fuse_attention(sym: Symbol) -> Symbol:
     #12: 'keep a pass hook for Pallas-fused attention'). Two patterns:
 
     1. ``batch_dot(softmax(batch_dot(q, k, transpose_b=True) [*/ scale],
-       axis=-1), v)`` -> ``_contrib_flash_attention(q*, k, v)`` with any
-       explicit scale folded into q (the flash op applies d^-0.5
-       internally).
+       axis=-1), v)`` -> ``_contrib_flash_attention(q, k, v,
+       sm_scale=scale)`` — the graph's explicit scale (1.0 when it had
+       none) passes through sm_scale verbatim, overriding the op's
+       d^-0.5 default, so the rewrite is exact for any scale.
     2. The reference's fused transformer pair
        ``_contrib_interleaved_matmul_selfatt_valatt(qkv,
        softmax(_contrib_interleaved_matmul_selfatt_qk(qkv, heads)))``

@@ -43,10 +43,15 @@ def main():
             t0 = time.perf_counter()
             for _ in range(args.iters):
                 fn()
-            out.wait_to_read()
+                out.wait_to_read()       # block on THIS iteration's work
             return nbytes * args.iters / (time.perf_counter() - t0) / 1e9
 
-        push = timed(lambda: kv.push(key, val))
+        def push_synced():
+            kv.push(key, val)
+            kv.pull(key, out=out)        # pull-after-push forces the
+                                         # reduce to completion
+
+        push = timed(push_synced)
         pull = timed(lambda: kv.pull(key, out=out))
         pushpull = timed(lambda: kv.pushpull(key, val, out=out))
         print(f"{n:>12d} {push:>10.2f} {pull:>10.2f} {pushpull:>14.2f}")

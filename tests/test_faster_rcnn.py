@@ -1,0 +1,66 @@
+"""Faster R-CNN family end-to-end (driver config #5; ref: the
+reference's example/rcnn pipeline over proposal.cc + roi_align.cc)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon.model_zoo.faster_rcnn import (FasterRCNNLoss,
+                                                   faster_rcnn_resnet,
+                                                   rpn_anchors)
+
+
+def _setup():
+    np.random.seed(0)
+    net = faster_rcnn_resnet(classes=3, rpn_pre_nms_top_n=200,
+                             rpn_post_nms_top_n=32)
+    net.initialize(mx.init.Xavier())
+    H = W = 128
+    x = np.random.rand(2, 3, H, W).astype(np.float32)
+    im_info = np.array([[H, W, 1.0]] * 2, np.float32)
+    gt = np.full((2, 2, 5), -1.0, np.float32)
+    gt[0, 0] = [0, 16, 16, 80, 96]
+    gt[1, 0] = [2, 40, 32, 120, 100]
+    return net, x, im_info, gt, H
+
+
+def test_forward_shapes_and_roi_validity():
+    net, x, im_info, gt, H = _setup()
+    rois, cls_logits, deltas, rpn_raw, rpn_bbox = net(
+        nd.array(x), nd.array(im_info))
+    assert rois.shape == (2 * 32, 5)
+    assert cls_logits.shape == (64, 4) and deltas.shape == (64, 4)
+    r = rois.asnumpy()
+    valid = r[r[:, 0] >= 0]
+    assert len(valid) > 0
+    # valid rois live inside the image
+    assert (valid[:, 1:] >= -1e-3).all() and (valid[:, 1:] <= H).all()
+    # batch indices are 0/1
+    assert set(np.unique(valid[:, 0])) <= {0.0, 1.0}
+
+
+def test_training_loss_decreases():
+    net, x, im_info, gt, H = _setup()
+    loss_fn = FasterRCNNLoss(net)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+    losses = []
+    for _ in range(15):
+        with autograd.record():
+            outs = net(nd.array(x), nd.array(im_info))
+            loss = loss_fn(outs, nd.array(gt), (H, H))
+        loss.backward()
+        tr.step(2)
+        losses.append(float(loss.asscalar()))
+    assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0], losses
+
+
+def test_rpn_anchors_match_proposal_generation():
+    # same generator as the Proposal op: center of cell (stride-1)/2
+    anc = rpn_anchors(2, 3, feature_stride=16, scales=(8.0,),
+                      ratios=(1.0,))
+    assert anc.shape == (6, 4)
+    c = (16 - 1) / 2.0
+    np.testing.assert_allclose(anc[0], [c - 64, c - 64, c + 64, c + 64])
+    # second cell shifts by one stride in x
+    np.testing.assert_allclose(anc[1] - anc[0], [16, 0, 16, 0])
