@@ -55,24 +55,29 @@ class MultiHeadAttention(HybridBlock):
         h = self._num_heads
         d = self._units // h
         qkv = self.qkv(x)                                  # (B, S, 3C)
-        qkv = F.reshape(qkv, (b, s, 3, h, d))
-        qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))       # (3, B, H, S, D)
-        q, k, v = qkv[0], qkv[1], qkv[2]
-        if self._seq_parallel:
-            # seq_parallel=True/'ring' → ring attention; 'ulysses' → the
-            # all-to-all head-scatter variant (better when heads ≥ shards)
-            if self._seq_parallel == "ulysses":
-                out = F.contrib.ulysses_attention(q, k, v,
-                                                  causal=self._causal)
-            else:
-                out = F.contrib.ring_attention(q, k, v,
-                                               causal=self._causal)
-        else:
+        if not self._seq_parallel:
+            # single-program path: attention straight off the fused QKV in
+            # (B, S, H, D) einsum layout — no permute copies (the
+            # (3,B,H,S,D) chain cost ~6 GB/step, docs/perf_notes.md)
             blk = min(self._block, s)
             while s % blk:
                 blk -= 1
-            out = F.contrib.flash_attention(q, k, v, block_size=blk,
-                                            causal=self._causal)
+            out = F.contrib.fused_self_attention(
+                qkv, heads=h, causal=self._causal, block_size=blk)
+            out = self.proj(out)
+            if self.dropout is not None:
+                out = self.dropout(out)
+            return out
+        qkv = F.reshape(qkv, (b, s, 3, h, d))
+        qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))       # (3, B, H, S, D)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        # seq_parallel=True/'ring' → ring attention; 'ulysses' → the
+        # all-to-all head-scatter variant (better when heads ≥ shards)
+        if self._seq_parallel == "ulysses":
+            out = F.contrib.ulysses_attention(q, k, v,
+                                              causal=self._causal)
+        else:
+            out = F.contrib.ring_attention(q, k, v, causal=self._causal)
         out = F.transpose(out, axes=(0, 2, 1, 3))          # (B, S, H, D)
         out = F.reshape(out, (b, s, self._units))
         out = self.proj(out)

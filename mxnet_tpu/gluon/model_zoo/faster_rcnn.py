@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from ...base import MXNetError
 from .. import nn
 from ..block import HybridBlock
 from ..loss import Loss
@@ -28,8 +27,9 @@ __all__ = ["FasterRCNN", "FasterRCNNLoss", "rpn_anchors",
 def rpn_anchors(height, width, feature_stride=16,
                 scales=(8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0)):
     """All RPN anchors for an (height, width) feature map, PIXEL corner
-    coords (A*H*W, 4) — the same generation as the Proposal op
-    (ref: proposal.cc GenerateAnchors), exposed for target assignment."""
+    coords (A*H*W, 4) — bit-identical to the Proposal op's generation
+    (ref: proposal.cc GenerateAnchors, legacy (w-1)/2 extents), so loss
+    targets and proposal decode see the SAME anchors."""
     base = []
     c = (feature_stride - 1) / 2.0
     base_size = float(feature_stride)
@@ -38,8 +38,9 @@ def rpn_anchors(height, width, feature_stride=16,
         ws = np.sqrt(size)
         hs = ws * r
         for s in scales:
-            w2, h2 = ws * s / 2.0, hs * s / 2.0
-            base.append([c - w2, c - h2, c + w2, c + h2])
+            bw, bh = ws * s, hs * s
+            base.append([c - (bw - 1) / 2, c - (bh - 1) / 2,
+                         c + (bw - 1) / 2, c + (bh - 1) / 2])
     base = np.asarray(base, np.float32)                    # (A, 4)
     sx = np.arange(width, dtype=np.float32) * feature_stride
     sy = np.arange(height, dtype=np.float32) * feature_stride
@@ -132,10 +133,10 @@ class FasterRCNNLoss(Loss):
     y1] in PIXELS, padded with cls=-1.
     """
 
-    def __init__(self, model, rpn_batch_frac=1.0, weight=None,
-                 batch_axis=0, **kwargs):
+    def __init__(self, model, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._m = model
+        self._anchor_cache = {}
 
     def hybrid_forward(self, F, outputs, gt_label, im_shape):
         rois, cls_logits, bbox_deltas, rpn_raw, rpn_bbox = outputs
@@ -144,10 +145,15 @@ class FasterRCNNLoss(Loss):
         a = len(self._m._scales) * len(self._m._ratios)
 
         # ---- RPN targets: anchors vs gt (class-agnostic objectness)
-        anchors = rpn_anchors(fh, fw, self._m._stride, self._m._scales,
-                              self._m._ratios)
+        key = (fh, fw, ih, iw)
+        if key not in self._anchor_cache:
+            anchors = rpn_anchors(fh, fw, self._m._stride,
+                                  self._m._scales, self._m._ratios)
+            norm = np.array([iw, ih, iw, ih], np.float32)
+            self._anchor_cache[key] = (anchors,
+                                       F.array((anchors / norm)[None]))
+        anchors, anc_norm = self._anchor_cache[key]
         norm = np.array([iw, ih, iw, ih], np.float32)
-        anc_norm = F.array((anchors / norm)[None])          # (1, A, 4)
         gt = gt_label.asnumpy() if hasattr(gt_label, "asnumpy") else \
             np.asarray(gt_label)
         gt_obj = gt.copy()
@@ -155,9 +161,13 @@ class FasterRCNNLoss(Loss):
         gt_obj[..., 1:5] = gt_obj[..., 1:5] / norm
         # dummy cls_preds (N, A, 2) just threads through the matcher
         dummy = F.zeros((n, anchors.shape[0], 2))
+        # variances (1,1,1,1): the Proposal op decodes RAW deltas
+        # (NonLinearTransformInv has no variance factor), so the targets
+        # the RPN regresses toward must be unscaled
         rpn_loc_t, rpn_loc_m, rpn_cls_t = F.contrib.MultiBoxTarget(
             anc_norm, F.array(gt_obj), dummy,
-            overlap_threshold=0.7, negative_mining_ratio=3.0)
+            overlap_threshold=0.7, negative_mining_ratio=3.0,
+            variances=(1.0, 1.0, 1.0, 1.0))
         # rpn_raw (N, 2A, H, W): per-anchor pair logits → (N, A*H*W, 2)
         rpn_logits = F.transpose(
             F.reshape(rpn_raw, (n, 2, a, fh * fw)), axes=(0, 3, 2, 1))
@@ -169,9 +179,11 @@ class FasterRCNNLoss(Loss):
         mask = (cls_t >= 0)
         rpn_cls_loss = -F.sum(picked * mask) / F.broadcast_maximum(
             F.sum(mask), F.ones((1,)))
+        # Proposal reads bbox channels ANCHOR-major (channel c = a*4 +
+        # coord, transpose(1,2,0).reshape(-1,4)); flatten identically so
+        # the loss trains the layout the decoder consumes
         rpn_bbox_flat = F.reshape(F.transpose(
-            F.reshape(rpn_bbox, (n, 4, a, fh * fw)),
-            axes=(0, 3, 2, 1)), (n, -1))
+            rpn_bbox, axes=(0, 2, 3, 1)), (n, -1))
         rpn_loc_loss = F.sum(
             F.smooth_l1((rpn_bbox_flat - rpn_loc_t) * rpn_loc_m,
                         scalar=3.0)) / F.broadcast_maximum(

@@ -1023,3 +1023,44 @@ def _xnor_convolution(x, wp, *rest, kernel=None, num_filter=None,
     if len(rest) > 1:
         y = y + rest[1]
     return y.reshape(n, oh, ow, num_filter).transpose(0, 3, 1, 2)
+
+
+@register("_contrib_fused_self_attention", num_inputs=1,
+          params=[OpParam("heads", int, None, required=True),
+                  OpParam("causal", bool, False),
+                  OpParam("block_size", int, 512)],
+          doc="Self-attention straight off the fused QKV projection "
+              "(B, S, 3C), q-major column blocks. Short sequences compute "
+              "softmax(QK^T)V with einsums over the (B, S, H, D) layout — "
+              "no data-movement transposes, XLA folds the head split into "
+              "the matmuls (measured: the (3,B,H,S,D) permute chain cost "
+              "~6 GB/step of layout copies in BERT, docs/perf_notes.md). "
+              "Long sequences route to the streaming flash path.")
+def _fused_self_attention(qkv, heads=None, causal=False, block_size=512):
+    b, s, c3 = qkv.shape
+    c = c3 // 3
+    d = c // heads
+    q = qkv[:, :, :c].reshape(b, s, heads, d)
+    k = qkv[:, :, c:2 * c].reshape(b, s, heads, d)
+    v = qkv[:, :, 2 * c:].reshape(b, s, heads, d)
+    if s <= 1024:
+        from .tensor import shifted_expsum
+        scale = float(d) ** -0.5
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            qi = jnp.arange(s)[:, None]
+            ki = jnp.arange(s)[None, :]
+            scores = jnp.where(qi >= ki, scores,
+                               jnp.finfo(scores.dtype).min)
+        _, shifted, se32 = shifted_expsum(scores, axis=-1)
+        att = (jnp.exp(shifted).astype(jnp.float32)
+               / se32).astype(q.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+        return out.reshape(b, s, c)
+    # long-sequence streaming path wants [B, H, S, D]
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    out = _flash_attention(qh, kh, vh, block_size=block_size,
+                           causal=causal)
+    return out.transpose(0, 2, 1, 3).reshape(b, s, c)

@@ -42,9 +42,9 @@ def test_training_loss_decreases():
     net, x, im_info, gt, H = _setup()
     loss_fn = FasterRCNNLoss(net)
     tr = gluon.Trainer(net.collect_params(), "adam",
-                       {"learning_rate": 1e-3})
+                       {"learning_rate": 5e-4})
     losses = []
-    for _ in range(15):
+    for _ in range(40):
         with autograd.record():
             outs = net(nd.array(x), nd.array(im_info))
             loss = loss_fn(outs, nd.array(gt), (H, H))
@@ -52,15 +52,64 @@ def test_training_loss_decreases():
         tr.step(2)
         losses.append(float(loss.asscalar()))
     assert np.isfinite(losses[-1])
-    assert losses[-1] < losses[0], losses
+    # proposals are nonstationary early on (RPN shifts them as it
+    # learns), so compare best-of-tail against the start
+    assert min(losses[-5:]) < 0.7 * losses[0], losses
 
 
 def test_rpn_anchors_match_proposal_generation():
-    # same generator as the Proposal op: center of cell (stride-1)/2
+    # same generator as the Proposal op: center (stride-1)/2, legacy
+    # (w-1)/2 extents
     anc = rpn_anchors(2, 3, feature_stride=16, scales=(8.0,),
                       ratios=(1.0,))
     assert anc.shape == (6, 4)
     c = (16 - 1) / 2.0
-    np.testing.assert_allclose(anc[0], [c - 64, c - 64, c + 64, c + 64])
+    np.testing.assert_allclose(
+        anc[0], [c - 63.5, c - 63.5, c + 63.5, c + 63.5])
     # second cell shifts by one stride in x
     np.testing.assert_allclose(anc[1] - anc[0], [16, 0, 16, 0])
+
+
+def test_rpn_layout_roundtrips_through_proposal():
+    """Encode gt deltas the way FasterRCNNLoss trains them (anchor-major
+    channels, variance-free) and check the Proposal op decodes back the
+    gt box — the integration contract between loss and decoder."""
+    stride, scales, ratios = 16, (4.0, 8.0), (1.0,)
+    A = len(scales) * len(ratios)
+    fh = fw = 8
+    anchors = rpn_anchors(fh, fw, stride, scales, ratios)  # (hw*A, 4)
+    gt_box = np.array([24.0, 40.0, 88.0, 104.0], np.float32)
+    # pick the anchor with best IoU; compute its legacy-decode deltas
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + 0.5 * (aw - 1)
+    acy = anchors[:, 1] + 0.5 * (ah - 1)
+    gw, gh = gt_box[2] - gt_box[0] + 1, gt_box[3] - gt_box[1] + 1
+    gcx, gcy = gt_box[0] + 0.5 * (gw - 1), gt_box[1] + 0.5 * (gh - 1)
+    ious = []
+    for a_ in anchors:
+        ix0, iy0 = max(a_[0], gt_box[0]), max(a_[1], gt_box[1])
+        ix1, iy1 = min(a_[2], gt_box[2]), min(a_[3], gt_box[3])
+        inter = max(ix1 - ix0, 0) * max(iy1 - iy0, 0)
+        ua = ((a_[2] - a_[0]) * (a_[3] - a_[1])
+              + (gt_box[2] - gt_box[0]) * (gt_box[3] - gt_box[1])
+              - inter)
+        ious.append(inter / ua)
+    best = int(np.argmax(ious))
+    t = np.array([(gcx - acx[best]) / aw[best],
+                  (gcy - acy[best]) / ah[best],
+                  np.log(gw / aw[best]), np.log(gh / ah[best])],
+                 np.float32)
+    cell, a_idx = divmod(best, A)
+    y, x = divmod(cell, fw)
+    cls_prob = np.zeros((1, 2 * A, fh, fw), np.float32)
+    cls_prob[0, A + a_idx, y, x] = 1.0          # fg block, best anchor
+    bbox = np.zeros((1, 4 * A, fh, fw), np.float32)
+    bbox[0, a_idx * 4:a_idx * 4 + 4, y, x] = t  # anchor-major channels
+    im_info = np.array([[128.0, 128.0, 1.0]], np.float32)
+    rois = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox), nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=5, rpn_min_size=1,
+        scales=scales, ratios=ratios,
+        feature_stride=stride).asnumpy()
+    np.testing.assert_allclose(rois[0, 1:], gt_box, atol=0.6)

@@ -211,3 +211,45 @@ def test_env_subgraph_backend_hook(monkeypatch):
     np.testing.assert_allclose(res, 2 * np.tanh(np.ones((2, 3))),
                                rtol=1e-6)
     assert len(exe._symbol._topo()) < len(out._topo())
+
+
+def test_bucketing_many_buckets_memory_sharing():
+    """Sockeye-style 20+ buckets (round-1 weak spot #9): parameters must
+    be shared across every bucket executor (one storage, like the
+    reference's shared_exec memory pool), and cycling through all buckets
+    must train without unbounded per-bucket state growth."""
+    def sym_gen(seq_len):
+        data = sym.var("data")                      # (N, seq_len, 4)
+        flat = sym.reshape(data, (-1, 4))           # merge batch x seq
+        fc = sym.FullyConnected(flat, num_hidden=6, name="fc",
+                                flatten=False)
+        out = sym.SoftmaxOutput(fc, name="softmax", multi_output=False)
+        return out, ("data",), ("softmax_label",)
+
+    buckets = list(range(4, 28))                   # 24 buckets
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=max(buckets))
+
+    def batch_for(L):
+        return io.DataBatch(
+            data=[mx.nd.random.normal(shape=(2, L, 4))],
+            label=[mx.nd.zeros((2 * L,))], bucket_key=L,
+            provide_data=[io.DataDesc("data", (2, L, 4))],
+            provide_label=[io.DataDesc("softmax_label", (2 * L,))])
+
+    first = batch_for(max(buckets))
+    mod.bind(first.provide_data, first.provide_label)
+    mod.init_params()
+    mod.init_optimizer()
+    for L in buckets:
+        b = batch_for(L)
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+    # every bucket executor must reference the SAME parameter storage as
+    # the default bucket (weights updated once, visible everywhere)
+    default_mod = mod._buckets[mod._default_bucket_key]
+    w_default = default_mod.get_params()[0]["fc_weight"]
+    for key, m in mod._buckets.items():
+        w = m.get_params()[0]["fc_weight"]
+        np.testing.assert_array_equal(w.asnumpy(), w_default.asnumpy())
+    assert len(mod._buckets) == len(buckets)
