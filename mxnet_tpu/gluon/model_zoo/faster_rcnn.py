@@ -144,20 +144,30 @@ class FasterRCNNLoss(Loss):
         ih, iw = float(im_shape[0]), float(im_shape[1])
         a = len(self._m._scales) * len(self._m._ratios)
 
-        # ---- RPN targets: anchors vs gt (class-agnostic objectness)
+        # ---- RPN targets: anchors vs gt (class-agnostic objectness).
+        # Corners are extended by +1 before normalizing: MultiBoxTarget
+        # encodes with corner widths (x2-x0) while the Proposal op
+        # decodes with the legacy +1 widths — with BOTH anchors and gt
+        # extended, the matcher's encoding becomes the exact inverse of
+        # the decode (the +0.5 center shifts cancel). Cache is bounded:
+        # keyed by feature shape, a handful of entries per model.
         key = (fh, fw, ih, iw)
         if key not in self._anchor_cache:
+            if len(self._anchor_cache) >= 16:
+                self._anchor_cache.pop(next(iter(self._anchor_cache)))
             anchors = rpn_anchors(fh, fw, self._m._stride,
                                   self._m._scales, self._m._ratios)
             norm = np.array([iw, ih, iw, ih], np.float32)
+            ext = anchors + np.array([0, 0, 1, 1], np.float32)
             self._anchor_cache[key] = (anchors,
-                                       F.array((anchors / norm)[None]))
+                                       F.array((ext / norm)[None]))
         anchors, anc_norm = self._anchor_cache[key]
         norm = np.array([iw, ih, iw, ih], np.float32)
         gt = gt_label.asnumpy() if hasattr(gt_label, "asnumpy") else \
             np.asarray(gt_label)
         gt_obj = gt.copy()
         gt_obj[..., 0] = np.where(gt_obj[..., 0] >= 0, 0.0, -1.0)
+        gt_obj[..., 3:5] += 1.0                 # legacy +1 extents
         gt_obj[..., 1:5] = gt_obj[..., 1:5] / norm
         # dummy cls_preds (N, A, 2) just threads through the matcher
         dummy = F.zeros((n, anchors.shape[0], 2))

@@ -585,19 +585,23 @@ def _rnn_gate_count(mode):
 
 def _rnn_unpack(params, mode, num_layers, input_size, state_size, bidirectional,
                 projection_size=None):
-    """Slice the flat param vector into per-layer (Wx, Wh, bx, bh) in the
-    reference's layout: all weights first (layer-major, i2h then h2h,
-    directions interleaved), then all biases."""
+    """Slice the flat param vector into per-layer (Wx, Wh, bx, bh[, Wr])
+    in the reference's layout: all weights first (layer-major, i2h then
+    h2h then the LSTMP projection when present, directions interleaved),
+    then all biases (ref: rnn-inl.h GetRnnParamSize incl. LSTMP)."""
     g = _rnn_gate_count(mode)
     d = 2 if bidirectional else 1
-    layers = []
+    proj = projection_size
+    h_out = proj if proj else state_size      # recurrent/output width
     off = 0
     sizes = []
     for layer in range(num_layers):
-        in_sz = input_size if layer == 0 else state_size * d
+        in_sz = input_size if layer == 0 else h_out * d
         for _dir in range(d):
             sizes.append(("wx", g * state_size, in_sz))
-            sizes.append(("wh", g * state_size, state_size))
+            sizes.append(("wh", g * state_size, h_out))
+            if proj:
+                sizes.append(("wr", proj, state_size))
     mats = []
     for kind, r, c in sizes:
         mats.append(params[off:off + r * c].reshape(r, c))
@@ -610,17 +614,21 @@ def _rnn_unpack(params, mode, num_layers, input_size, state_size, bidirectional,
     out = []
     mi = 0
     bi = 0
+    per_dir = 3 if proj else 2
     for layer in range(num_layers):
         dirs = []
         for _dir in range(d):
-            wx, wh = mats[mi], mats[mi + 1]; mi += 2
+            wx, wh = mats[mi], mats[mi + 1]
+            wr = mats[mi + 2] if proj else None
+            mi += per_dir
             bx, bh = biases[bi], biases[bi + 1]; bi += 2
-            dirs.append((wx, wh, bx, bh))
+            dirs.append((wx, wh, bx, bh, wr))
         out.append(dirs)
     return out
 
 
-def _rnn_cell_step(mode, carry, x_t, wx, wh, bx, bh, state_size):
+def _rnn_cell_step(mode, carry, x_t, wx, wh, bx, bh, state_size,
+                   wr=None):
     if mode == "lstm":
         h, c = carry
         gates = x_t @ wx.T + bx + h @ wh.T + bh
@@ -629,6 +637,8 @@ def _rnn_cell_step(mode, carry, x_t, wx, wh, bx, bh, state_size):
         g = jnp.tanh(g)
         c = f * c + i * g
         h = o * jnp.tanh(c)
+        if wr is not None:           # LSTMP: project the hidden state
+            h = h @ wr.T
         return (h, c), h
     if mode == "gru":
         h = carry[0]
@@ -647,14 +657,29 @@ def _rnn_cell_step(mode, carry, x_t, wx, wh, bx, bh, state_size):
     return (h,), h
 
 
-def _rnn_layer_scan(mode, x, h0, c0, weights, state_size, reverse=False):
-    wx, wh, bx, bh = weights
+def _rnn_layer_scan(mode, x, h0, c0, weights, state_size, reverse=False,
+                    seq_len=None):
+    wx, wh, bx, bh, wr = weights
     carry0 = (h0, c0) if mode == "lstm" else (h0,)
+    T = x.shape[0]
 
-    def step(carry, x_t):
-        return _rnn_cell_step(mode, carry, x_t, wx, wh, bx, bh, state_size)
+    def step(carry, inp):
+        x_t, t = inp
+        new_carry, y = _rnn_cell_step(mode, carry, x_t, wx, wh, bx, bh,
+                                      state_size, wr=wr)
+        if seq_len is not None:
+            # cuDNN varlen semantics: beyond a sequence's length the
+            # state holds and outputs are zero (ref: rnn.cc
+            # use_sequence_length; works for the reverse direction too —
+            # the held initial state enters at t = len-1)
+            valid = (t < seq_len)[:, None]
+            new_carry = tuple(jnp.where(valid, nc, oc)
+                              for nc, oc in zip(new_carry, carry))
+            y = jnp.where(valid, y, jnp.zeros_like(y))
+        return new_carry, y
 
-    carry, ys = lax.scan(step, carry0, x, reverse=reverse)
+    carry, ys = lax.scan(step, carry0,
+                         (x, jnp.arange(T)), reverse=reverse)
     return carry, ys
 
 
@@ -681,16 +706,19 @@ def _rnn_outputs(params):
 def _rnn(data, params, state, *rest, rng=None, state_size=None, num_layers=None,
          mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
          projection_size=None, use_sequence_length=False, training=False):
-    if projection_size is not None:
-        raise MXNetError("RNN: projection_size not supported yet")
+    if projection_size is not None and mode != "lstm":
+        raise MXNetError("RNN: projection_size is an LSTM(P) feature")
+    rest = list(rest)
+    state_cell = rest.pop(0) if (mode == "lstm" and rest) else None
+    seq_len = None
     if use_sequence_length:
-        raise MXNetError("RNN: use_sequence_length not supported yet — mask "
-                         "inputs with SequenceMask and select final states "
-                         "with SequenceLast instead")
-    state_cell = rest[0] if (mode == "lstm" and rest) else None
+        if not rest:
+            raise MXNetError("RNN: use_sequence_length=True needs a "
+                             "sequence_length input (N,)")
+        seq_len = rest.pop(0).astype(jnp.int32)
     d = 2 if bidirectional else 1
     layers = _rnn_unpack(params, mode, num_layers, data.shape[-1], state_size,
-                         bidirectional)
+                         bidirectional, projection_size=projection_size)
     x = data
     hs, cs = [], []
     for li, dirs in enumerate(layers):
@@ -700,7 +728,7 @@ def _rnn(data, params, state, *rest, rng=None, state_size=None, num_layers=None,
             h0 = state[idx]
             c0 = state_cell[idx] if state_cell is not None else None
             carry, ys = _rnn_layer_scan(mode, x, h0, c0, weights, state_size,
-                                        reverse=(di == 1))
+                                        reverse=(di == 1), seq_len=seq_len)
             if di == 1:
                 pass  # lax.scan(reverse=True) already emits outputs in orig order
             outs.append(ys)

@@ -423,7 +423,13 @@ def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
     if ret_typ == "indices":
         return idx
     if ret_typ == "mask":
-        raise MXNetError("topk ret_typ='mask' not supported yet")
+        # 1 at the top-k positions, 0 elsewhere, in the INPUT dtype
+        # (reference: `dtype` governs only the indices output)
+        mask = jnp.put_along_axis(
+            jnp.zeros(xm.shape, x.dtype),
+            jnp.moveaxis(idx, axis, -1).astype(jnp.int32),
+            jnp.ones((), x.dtype), axis=-1, inplace=False)
+        return jnp.moveaxis(mask, -1, axis)
     return vals, idx  # 'both' returns [values, indices]
 
 

@@ -96,10 +96,19 @@ def test_rpn_layout_roundtrips_through_proposal():
               - inter)
         ious.append(inter / ua)
     best = int(np.argmax(ious))
-    t = np.array([(gcx - acx[best]) / aw[best],
-                  (gcy - acy[best]) / ah[best],
-                  np.log(gw / aw[best]), np.log(gh / ah[best])],
-                 np.float32)
+    # encode THROUGH the same matcher the loss uses (extended +1 corners,
+    # variances 1) so this tests the full loss->decode contract
+    norm = np.array([128.0, 128.0, 128.0, 128.0], np.float32)
+    ext = anchors + np.array([0, 0, 1, 1], np.float32)
+    gt_row = np.array([[[0.0, gt_box[0], gt_box[1],
+                         gt_box[2] + 1, gt_box[3] + 1]]], np.float32)
+    gt_row[..., 1:5] /= norm
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        nd.array((ext / norm)[None]), nd.array(gt_row),
+        nd.array(np.zeros((1, len(anchors), 2), np.float32)),
+        overlap_threshold=0.7, negative_mining_ratio=-1.0,
+        variances=(1.0, 1.0, 1.0, 1.0))
+    t = loc_t.asnumpy().reshape(-1, 4)[best]
     cell, a_idx = divmod(best, A)
     y, x = divmod(cell, fw)
     cls_prob = np.zeros((1, 2 * A, fh, fw), np.float32)

@@ -132,3 +132,57 @@ def test_bidirectional_cell_unroll():
     x = mx.nd.random.normal(shape=(2, 3, 4))
     out, states = bi.unroll(3, x, layout="NTC")
     assert out.shape == (2, 3, 10)
+
+
+def test_rnn_use_sequence_length():
+    # cuDNN varlen semantics: outputs zero past each length, final state
+    # is the state at len-1 (ref: rnn.cc use_sequence_length)
+    T, N, C, H = 6, 3, 4, 5
+    rng = np.random.RandomState(0)
+    data = rng.randn(T, N, C).astype(np.float32)
+    g = 4
+    params = (rng.randn(g * H * C + g * H * H + 2 * g * H)
+              .astype(np.float32) * 0.1)
+    h0 = np.zeros((1, N, H), np.float32)
+    c0 = np.zeros((1, N, H), np.float32)
+    seq_len = np.array([6, 3, 1], np.float32)
+    out, hy, cy = mx.nd.RNN(
+        mx.nd.array(data), mx.nd.array(params), mx.nd.array(h0),
+        mx.nd.array(c0), mx.nd.array(seq_len), state_size=H,
+        num_layers=1, mode="lstm", state_outputs=True,
+        use_sequence_length=True)
+    o = out.asnumpy()
+    assert np.all(o[3:, 1] == 0) and np.all(o[1:, 2] == 0)
+    ref, hy_f, cy_f = mx.nd.RNN(
+        mx.nd.array(data[:3, 1:2]), mx.nd.array(params),
+        mx.nd.array(h0[:, 1:2]), mx.nd.array(c0[:, 1:2]), state_size=H,
+        num_layers=1, mode="lstm", state_outputs=True)
+    np.testing.assert_allclose(o[:3, 1], ref.asnumpy()[:, 0], atol=1e-5)
+    np.testing.assert_allclose(hy.asnumpy()[0, 1], hy_f.asnumpy()[0, 0],
+                               atol=1e-5)
+    np.testing.assert_allclose(cy.asnumpy()[0, 1], cy_f.asnumpy()[0, 0],
+                               atol=1e-5)
+
+
+def test_rnn_lstm_projection():
+    # LSTMP (ref: rnn-inl.h projection_size): hidden projected H -> P
+    T, N, C, H, P = 6, 3, 4, 5, 3
+    rng = np.random.RandomState(1)
+    data = rng.randn(T, N, C).astype(np.float32)
+    g = 4
+    params = (rng.randn(g * H * C + g * H * P + P * H + 2 * g * H)
+              .astype(np.float32) * 0.1)
+    h0 = np.zeros((1, N, P), np.float32)
+    c0 = np.zeros((1, N, H), np.float32)
+    out = mx.nd.RNN(mx.nd.array(data), mx.nd.array(params),
+                    mx.nd.array(h0), mx.nd.array(c0), state_size=H,
+                    num_layers=1, mode="lstm", projection_size=P)
+    assert out.shape == (T, N, P)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_topk_mask():
+    x = np.array([[3., 1., 4., 1., 5.], [2., 7., 1., 8., 2.]],
+                 np.float32)
+    m = mx.nd.topk(mx.nd.array(x), k=2, ret_typ="mask").asnumpy()
+    np.testing.assert_array_equal(m, [[0, 0, 1, 0, 1], [0, 1, 0, 1, 0]])
