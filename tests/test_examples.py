@@ -39,3 +39,9 @@ def test_train_ssd_smoke():
     out = _run("train_ssd.py", "--steps", "2", "--batch-size", "2",
                "--data-shape", "64")
     assert "detections" in out
+
+
+def test_train_faster_rcnn_smoke():
+    out = _run("train_faster_rcnn.py", "--steps", "2",
+               "--image-size", "96", timeout=280)
+    assert "done" in out
