@@ -336,16 +336,31 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
     return out, mean.astype(moving_mean.dtype), var.astype(moving_var.dtype)
 
 
+def _moments_acc(x, axes):
+    """Centered two-pass moments with accumulation in at least fp32
+    (fp64 stays fp64): safe for |mean| >> std inputs — the raw one-pass
+    E[x^2]-E[x]^2 form cancels catastrophically there, and bf16
+    accumulation (x's own dtype) loses the variance of wide rows.
+    BatchNorm keeps its one-pass form because its running mean provides
+    a stable shift (see _batch_norm)."""
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(acc)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    return mean, var
+
+
 @register("LayerNorm", num_inputs=3,
           params=[OpParam("axis", int, -1), OpParam("eps", float, 1e-5),
                   OpParam("output_mean_var", bool, False)],
           doc="ref: src/operator/nn/layer_norm.cc")
+
 def _layer_norm(x, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
-    out = (x - mean) * lax.rsqrt(var + eps)
+    mean, var = _moments_acc(x, axis)
+    inv = lax.rsqrt(var + eps)
     bshape = [1] * x.ndim
     bshape[axis % x.ndim] = x.shape[axis % x.ndim]
+    out = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
     return out * gamma.reshape(bshape) + beta.reshape(bshape)
 
 
@@ -357,9 +372,9 @@ def _group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
     g = num_groups
     xg = x.reshape((n, g, c // g) + x.shape[2:])
     axes = tuple(range(2, xg.ndim))
-    mean = jnp.mean(xg, axis=axes, keepdims=True)
-    var = jnp.var(xg, axis=axes, keepdims=True)
-    xg = (xg - mean) * lax.rsqrt(var + eps)
+    mean, var = _moments_acc(xg, axes)
+    xg = (xg - mean.astype(xg.dtype)) \
+        * lax.rsqrt(var + eps).astype(xg.dtype)
     out = xg.reshape(x.shape)
     bshape = (1, c) + (1,) * (x.ndim - 2)
     return out * gamma.reshape(bshape) + beta.reshape(bshape)
@@ -369,9 +384,9 @@ def _group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
           doc="ref: src/operator/instance_norm.cc")
 def _instance_norm(x, gamma, beta, eps=1e-3):
     axes = tuple(range(2, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    out = (x - mean) * lax.rsqrt(var + eps)
+    mean, var = _moments_acc(x, axes)
+    out = (x - mean.astype(x.dtype)) \
+        * lax.rsqrt(var + eps).astype(x.dtype)
     bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
     return out * gamma.reshape(bshape) + beta.reshape(bshape)
 
