@@ -319,3 +319,24 @@ def test_activation_variants():
     assert_almost_equal(elu, [np.expm1(-1), 0, 1], rtol=1e-4)
     gelu = nd.LeakyReLU(x, act_type="gelu")
     assert abs(gelu.asnumpy()[2] - 0.8413) < 1e-3
+
+
+def test_norm_layers_large_mean_precision():
+    # moments must accumulate in >= fp32 and stay cancellation-safe for
+    # |mean| >> std inputs (the raw one-pass E[x^2]-E[x]^2 fails this)
+    rng = np.random.RandomState(0)
+    x = (rng.randn(32, 8) * 0.01 + 1000).astype(np.float32)
+    g = np.ones(8, np.float32)
+    b = np.zeros(8, np.float32)
+    o = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g),
+                        mx.nd.array(b)).asnumpy()
+    assert 0.5 < o.std() < 2.0, o.std()
+    x4 = (rng.randn(2, 4, 5, 5) * 0.1 + 1000).astype(np.float32)
+    g4 = np.ones(4, np.float32)
+    b4 = np.zeros(4, np.float32)
+    og = mx.nd.GroupNorm(mx.nd.array(x4), mx.nd.array(g4),
+                         mx.nd.array(b4), num_groups=2).asnumpy()
+    assert 0.5 < og.std() < 2.0, og.std()
+    oi = mx.nd.InstanceNorm(mx.nd.array(x4), mx.nd.array(g4),
+                            mx.nd.array(b4)).asnumpy()
+    assert 0.5 < oi.std() < 2.0, oi.std()
