@@ -97,14 +97,55 @@ class RawRecordIter:
         return self.__next__()
 
 
+def decode_scaling(tmpdir, n_images, hw, batch, threads_list):
+    """Host-only decode+augment scaling curve vs preprocess_threads —
+    the reference's parser→augmenter thread pipeline knob
+    (src/io/iter_image_recordio_2.cc). No device involved: measures the
+    iterator's own throughput."""
+    from mxnet_tpu import io as mio
+    rec_path, idx_path = make_packs(tmpdir, n_images, hw, "jpeg")
+    base = None
+    print(f"decode scaling (jpeg {hw[0]}x{hw[1]}, {n_images} imgs, "
+          f"host cores={os.cpu_count()}):")
+    for t in threads_list:
+        it = mio.ImageRecordIter(
+            path_imgrec=rec_path, path_imgidx=idx_path,
+            data_shape=(3,) + hw, batch_size=batch, shuffle=True,
+            rand_crop=True, rand_mirror=True, preprocess_threads=t,
+            mean_r=127.5, mean_g=127.5, mean_b=127.5,
+            std_r=127.5, std_g=127.5, std_b=127.5)
+        for trial in range(2):                  # 2nd pass = warm page cache
+            it.reset()
+            n = 0
+            t0 = time.perf_counter()
+            for b in it:
+                n += b.data[0].shape[0]
+            dt = time.perf_counter() - t0
+        ips = n / dt
+        if t == threads_list[0]:
+            base = ips
+        print(f"  preprocess_threads={t}: {ips:8.1f} img/s "
+              f"({ips / base:.2f}x vs {threads_list[0]} thread)")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--n-images", type=int, default=None)
     ap.add_argument("--format", choices=["jpeg", "raw", "both"],
                     default="both")
+    ap.add_argument("--decode-scaling", action="store_true",
+                    help="host-only preprocess_threads scaling curve")
+    ap.add_argument("--threads", type=int, nargs="+", default=[1, 2, 4, 8])
     ap.add_argument("--tmpdir", default="/tmp/mxtpu_bench_data")
     args = ap.parse_args()
+
+    if args.decode_scaling:
+        batch = args.batch or 64
+        n_images = args.n_images or 1024
+        decode_scaling(args.tmpdir, n_images, (224, 224), batch,
+                       args.threads)
+        return
 
     import jax
 

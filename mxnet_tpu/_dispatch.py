@@ -18,7 +18,30 @@ from . import _rng, engine
 from .base import MXNetError
 from .ops.registry import Operator, get as get_op
 
-__all__ = ["invoke"]
+__all__ = ["invoke", "set_amp_cast_hook"]
+
+# Per-op AMP cast policy (ref: the amp_cast pairs the reference's graph
+# pass inserts from its fp16 allow/deny lists, python/mxnet/contrib/amp/
+# lists/symbol_fp16.py). Installed by contrib.amp.init when op lists are
+# given; called with (op_name, datas, params) and returns the input arrays
+# recast per policy. Runs on eager arrays and on tracers alike, so the
+# policy applies inside hybridized/jitted programs too.
+_amp_cast_hook = None
+_amp_epoch = 0      # bumped on every policy change: jit caches key on it
+
+
+def set_amp_cast_hook(fn):
+    global _amp_cast_hook, _amp_epoch
+    _amp_cast_hook = fn
+    _amp_epoch += 1
+
+
+def amp_epoch():
+    """Monotonic counter of AMP-policy changes. Compiled-program caches
+    (HybridBlock._cached_fns, ShardedTrainer) include it in their keys so
+    installing/clearing a per-op cast policy retraces instead of silently
+    running the stale program."""
+    return _amp_epoch
 
 
 def _tracked(arr) -> bool:
@@ -78,6 +101,9 @@ def invoke(op, inputs: Sequence, kwargs: dict, out=None):
         else:
             import jax.numpy as jnp
             datas.append(jnp.asarray(x))
+
+    if _amp_cast_hook is not None:
+        datas = _amp_cast_hook(op.name, datas, params)
 
     n_out = op.num_outputs(params) if callable(op.num_outputs) else op.num_outputs
 

@@ -19,15 +19,76 @@ import numpy as np
 
 from ...base import MXNetError
 
-__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+__all__ = ["init", "reset", "init_trainer", "scale_loss", "unscale",
            "convert_hybrid_block", "DynamicLossScaler", "amp_dtype"]
 
-_state = {"initialized": False, "dtype": None}
+_state = {"initialized": False, "dtype": None, "lists": None}
+
+# Ops that stay fp32 regardless of the blanket compute dtype when the
+# per-op policy is active — the reference's FP32_FUNCS core (reductions,
+# losses, norms, exp/log families; ref: amp/lists/symbol_fp16.py
+# FP32_FUNCS). The policy only engages when init() receives op lists;
+# the default TPU path remains the single cast at the step boundary.
+_DEFAULT_FP32_OPS = (
+    "softmax", "log_softmax", "SoftmaxOutput", "SoftmaxActivation",
+    "norm", "mean", "sum", "exp", "log", "log2", "log10", "expm1",
+    "log1p", "erf", "erfinv", "logsumexp", "smooth_l1", "MakeLoss",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput",
+)
+
+
+class _OpCastPolicy:
+    """Dispatch-level realization of the reference's amp_cast graph pass
+    (ref: python/mxnet/contrib/amp/amp.py _get_fun_to_wrap +
+    lists/symbol_fp16.py): inputs of listed ops are recast on the way in.
+    Works on eager arrays and tracers (so it holds inside jit programs)."""
+
+    def __init__(self, target_dtype, target_precision_ops,
+                 conditional_fp32_ops, fp32_ops):
+        import jax.numpy as jnp
+        self._target = jnp.dtype(target_dtype)
+        self._target_ops = frozenset(target_precision_ops or ())
+        self._fp32_ops = frozenset(fp32_ops or ()) | \
+            frozenset(_DEFAULT_FP32_OPS)
+        # [(op_name, param_name, [values])] → {op: [(param, {values})]}
+        cond = {}
+        for op_name, param, values in (conditional_fp32_ops or ()):
+            vals = values if isinstance(values, (list, tuple, set)) \
+                else [values]
+            cond.setdefault(op_name, []).append((param, set(vals)))
+        self._conditional = cond
+
+    def _cast_all(self, datas, dtype):
+        import jax.numpy as jnp
+        return [d.astype(dtype)
+                if hasattr(d, "dtype") and jnp.issubdtype(d.dtype,
+                                                          jnp.floating)
+                and d.dtype != dtype else d
+                for d in datas]
+
+    def __call__(self, op_name, datas, params):
+        import jax.numpy as jnp
+        if op_name in self._fp32_ops:
+            return self._cast_all(datas, jnp.float32)
+        for param, vals in self._conditional.get(op_name, ()):
+            if str(params.get(param)) in vals or params.get(param) in vals:
+                return self._cast_all(datas, jnp.float32)
+        if op_name in self._target_ops:
+            return self._cast_all(datas, self._target)
+        return datas
 
 
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
-    """ref: amp.init — enable mixed precision process-wide."""
+    """ref: amp.init — enable mixed precision process-wide.
+
+    Without op lists, AMP is one cast at the compiled-step boundary (the
+    idiomatic TPU form — XLA keeps fp32 accumulation where it matters).
+    With any of ``target_precision_ops`` / ``conditional_fp32_ops`` /
+    ``fp32_ops`` given, a per-op cast policy engages at dispatch: listed
+    ops force their floating inputs to the listed precision, mirroring
+    the reference's allow/deny-list graph pass."""
     target_dtype = str(np.dtype(target_dtype)) if target_dtype != "bfloat16" \
         else "bfloat16"
     if target_dtype not in ("float16", "bfloat16"):
@@ -35,6 +96,29 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
                          "(bfloat16 recommended on TPU)")
     _state["initialized"] = True
     _state["dtype"] = target_dtype
+    from ... import _dispatch
+    if target_precision_ops or conditional_fp32_ops or fp32_ops:
+        from ...ops.registry import get as get_op
+        for name in list(target_precision_ops or []) + \
+                [c[0] for c in (conditional_fp32_ops or [])] + \
+                list(fp32_ops or []):
+            get_op(name)     # unknown op names fail loudly, not silently
+        policy = _OpCastPolicy(target_dtype, target_precision_ops,
+                               conditional_fp32_ops, fp32_ops)
+        _state["lists"] = policy
+        _dispatch.set_amp_cast_hook(policy)
+    else:
+        # re-init without lists must drop any previously installed policy
+        # (a stale hook would keep casting to the OLD target dtype)
+        _state["lists"] = None
+        _dispatch.set_amp_cast_hook(None)
+
+
+def reset():
+    """Disable AMP (test helper; the reference has no uninit)."""
+    from ... import _dispatch
+    _state.update(initialized=False, dtype=None, lists=None)
+    _dispatch.set_amp_cast_hook(None)
 
 
 def amp_dtype():
@@ -54,14 +138,18 @@ class DynamicLossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
+        """One fused device-side finiteness reduction over every gradient
+        of every replica, one host sync total — not a per-parameter
+        download (the tunnel costs ~90 ms per round-trip)."""
+        import jax.numpy as jnp
+        ok = None
         for p in params:
-            g = p._grad[0] if getattr(p, "_grad", None) else None
-            if g is None:
-                continue
-            a = g.asnumpy()
-            if not np.isfinite(a).all():
-                return True
-        return False
+            for g in (getattr(p, "_grad", None) or ()):
+                if g is None:
+                    continue
+                fin = jnp.all(jnp.isfinite(g._data.astype(jnp.float32)))
+                ok = fin if ok is None else jnp.logical_and(ok, fin)
+        return False if ok is None else not bool(np.asarray(ok))
 
     def update_scale(self, overflow):
         if overflow:

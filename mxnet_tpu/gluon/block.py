@@ -422,7 +422,8 @@ class HybridBlock(Block):
         if ctx is None:
             ctx = current_context()
         training = autograd.is_training()
-        key = (training, len(args), str(ctx))
+        from .. import _dispatch
+        key = (training, len(args), str(ctx), _dispatch.amp_epoch())
         jitted = self._cached_fns.get(key)
         if jitted is None:
             jitted = self._build_fn(training, len(args), ctx)

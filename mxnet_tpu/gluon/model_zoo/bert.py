@@ -51,23 +51,22 @@ class MultiHeadAttention(HybridBlock):
 
     def hybrid_forward(self, F, x, mask=None):
         # x: (B, S, C)
-        b, s, c = x.shape
         h = self._num_heads
         d = self._units // h
         qkv = self.qkv(x)                                  # (B, S, 3C)
         if not self._seq_parallel:
             # single-program path: attention straight off the fused QKV in
             # (B, S, H, D) einsum layout — no permute copies (the
-            # (3,B,H,S,D) chain cost ~6 GB/step, docs/perf_notes.md)
-            blk = min(self._block, s)
-            while s % blk:
-                blk -= 1
+            # (3,B,H,S,D) chain cost ~6 GB/step, docs/perf_notes.md).
+            # Shape-free (the op clamps block_size to the concrete S at
+            # trace time) so the block exports symbolically.
             out = F.contrib.fused_self_attention(
-                qkv, heads=h, causal=self._causal, block_size=blk)
+                qkv, heads=h, causal=self._causal, block_size=self._block)
             out = self.proj(out)
             if self.dropout is not None:
                 out = self.dropout(out)
             return out
+        b, s, c = x.shape
         qkv = F.reshape(qkv, (b, s, 3, h, d))
         qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))       # (3, B, H, S, D)
         q, k, v = qkv[0], qkv[1], qkv[2]
@@ -198,12 +197,14 @@ class BERTModel(HybridBlock):
 
     def hybrid_forward(self, F, inputs, token_types=None,
                        masked_positions=None, position_weight=None):
-        b, s = inputs.shape[0], inputs.shape[1]
         x = self.word_embed(inputs)
         if token_types is not None:
             x = x + self.token_type_embed(token_types)
-        pos = F.slice(position_weight, begin=(0, 0), end=(s, None))
-        x = x + F.expand_dims(pos, axis=0)
+        # shape-free position add (exports symbolically): slice the
+        # (1, max_len, U) table along the sequence axis like x (B, S, U)
+        pos = F.slice_like(F.expand_dims(position_weight, axis=0), x,
+                           axes=(1,))
+        x = F.broadcast_add(x, pos)
         x = self.embed_layer_norm(x)
         if self.embed_dropout is not None:
             x = self.embed_dropout(x)
@@ -218,10 +219,13 @@ class BERTModel(HybridBlock):
                 outputs.append(self.classifier(pooled))
         if self._use_decoder:
             if masked_positions is not None:
-                # per-row gather: picked[b, m] = seq_out[b, pos[b, m]]
-                m = masked_positions.shape[1]
-                batch_idx = F.broadcast_to(
-                    F.reshape(F.arange(0, b), (b, 1)), (b, m))
+                # per-row gather: picked[b, m] = seq_out[b, pos[b, m]];
+                # batch indices built shape-free via arange_like so the
+                # masked path also exports symbolically
+                batch_idx = F.broadcast_like(
+                    F.reshape(F.arange_like(masked_positions, axis=0),
+                              (-1, 1)),
+                    masked_positions)
                 idx = F.stack(batch_idx, masked_positions, axis=0)
                 picked = F.gather_nd(seq_out, idx)
                 outputs.append(self.decoder(picked))

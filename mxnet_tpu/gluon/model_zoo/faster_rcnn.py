@@ -14,8 +14,10 @@ masked out of the loss.
 """
 from __future__ import annotations
 
+import jax
 import numpy as np
 
+from ...base import MXNetError
 from .. import nn
 from ..block import HybridBlock
 from ..loss import Loss
@@ -140,6 +142,19 @@ class FasterRCNNLoss(Loss):
 
     def hybrid_forward(self, F, outputs, gt_label, im_shape):
         rois, cls_logits, bbox_deltas, rpn_raw, rpn_bbox = outputs
+        # Guard BEFORE any concretization (float(im_shape), .shape unpack):
+        # under hybridize()/ShardedTrainer every input is a tracer and the
+        # host-side matching below cannot run — fail with the documented
+        # error, not a JAX concretization error.
+        if any(isinstance(getattr(a, "_data", a), jax.core.Tracer)
+               for a in (gt_label, rois, rpn_raw, im_shape)):
+            raise MXNetError(
+                "FasterRCNNLoss is eager-only: per-image proposal↔gt "
+                "matching runs host-side (asnumpy + Python loop, like the "
+                "reference's MXProposalTarget custom op). Do not "
+                "hybridize() it or wrap it in ShardedTrainer; train with "
+                "the eager loop in examples/train_faster_rcnn.py "
+                "(docs/divergences.md #12)")
         n, _, fh, fw = rpn_raw.shape
         ih, iw = float(im_shape[0]), float(im_shape[1])
         a = len(self._m._scales) * len(self._m._ratios)

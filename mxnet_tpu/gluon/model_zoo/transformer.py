@@ -37,19 +37,10 @@ class CrossAttention(HybridBlock):
             self.dropout = nn.Dropout(dropout) if dropout else None
 
     def hybrid_forward(self, F, x, mem):
-        b, sq, _ = x.shape
-        sk = mem.shape[1]
-        h = self._heads
-        d = self._units // h
-        q = F.reshape(self.q_proj(x), (b, sq, h, d))
-        q = F.transpose(q, axes=(0, 2, 1, 3))
-        kv = F.reshape(self.kv_proj(mem), (b, sk, 2, h, d))
-        kv = F.transpose(kv, axes=(2, 0, 3, 1, 4))
-        k, v = kv[0], kv[1]
-        blk = sk
-        out = F.contrib.flash_attention(q, k, v, block_size=blk)
-        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
-                        (b, sq, self._units))
+        # shape-free (exports symbolically): the fused op splits heads and
+        # K/V internally off the concrete trace shapes
+        out = F.contrib.fused_cross_attention(
+            self.q_proj(x), self.kv_proj(mem), heads=self._heads)
         out = self.proj(out)
         if self.dropout is not None:
             out = self.dropout(out)
@@ -160,25 +151,29 @@ class TransformerModel(HybridBlock):
                                               prefix="dec_")
             self.output = nn.Dense(tgt_vocab, flatten=False, prefix="out_")
             self.dropout = nn.Dropout(dropout) if dropout else None
-        self._pos = _positions(max_length, units)
+            # sinusoidal table as a Constant parameter: exports with the
+            # model and keeps the embed path shape-free (slice_like)
+            self.pos_weight = self.params.get_constant(
+                "pos_embed", _positions(max_length, units))
 
-    def _embed(self, F, tokens, embed):
+    def _embed(self, F, tokens, embed, pos_weight):
         x = embed(tokens) * math.sqrt(self._units)
-        s = tokens.shape[1]
-        from ... import ndarray as nd
-        pos = nd.array(self._pos[:s])
-        x = x + F.expand_dims(pos, axis=0)
+        pos = F.slice_like(F.expand_dims(pos_weight, axis=0), x, axes=(1,))
+        x = F.broadcast_add(x, pos)
         if self.dropout is not None:
             x = self.dropout(x)
         return x
 
     def encode(self, src):
         from ... import ndarray as F
-        return self.encoder(self._embed(F, src, self.src_embed))
+        return self.encoder(self._embed(F, src, self.src_embed,
+                                        self.pos_weight.data()))
 
-    def hybrid_forward(self, F, src, tgt):
-        mem = self.encoder(self._embed(F, src, self.src_embed))
-        dec = self.decoder(self._embed(F, tgt, self.tgt_embed), mem)
+    def hybrid_forward(self, F, src, tgt, pos_weight=None):
+        pos = pos_weight if pos_weight is not None else \
+            self.pos_weight.data()
+        mem = self.encoder(self._embed(F, src, self.src_embed, pos))
+        dec = self.decoder(self._embed(F, tgt, self.tgt_embed, pos), mem)
         return self.output(dec)
 
     def translate(self, src, bos_id=1, eos_id=2, max_steps=None):
@@ -192,7 +187,8 @@ class TransformerModel(HybridBlock):
         finished = onp.zeros(b, bool)
         for _ in range(max_steps):
             tgt = nd.array(tokens)
-            dec = self.decoder(self._embed(nd, tgt, self.tgt_embed), mem)
+            dec = self.decoder(self._embed(nd, tgt, self.tgt_embed,
+                                           self.pos_weight.data()), mem)
             logits = self.output(dec)
             nxt = logits.asnumpy()[:, -1].argmax(axis=-1)
             nxt = onp.where(finished, eos_id, nxt)

@@ -109,9 +109,28 @@ class Trainer:
         """rescale by 1/batch_size, allreduce, update (ref: Trainer.step)."""
         self._init_kvstore()
         self._check_grads()
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            from ..contrib.amp import amp_dtype
+            if amp_dtype() != "float16":
+                # bf16 has fp32 exponent range: scale overflow cannot
+                # trigger — skip the per-step finiteness sync entirely
+                scaler = None
+        if scaler is not None:
+            # fp16 AMP: a non-finite gradient means the loss scale
+            # overflowed — skip this update and halve the scale
+            # (ref: amp.py DynamicLossScaler + the trainer patch
+            # amp.init_trainer installs). The scale change only affects
+            # the NEXT scale_loss; this step's grads carry the old scale.
+            overflow = scaler.has_overflow(self._params)
+            if overflow:
+                scaler.update_scale(True)
+                return
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        if scaler is not None:
+            scaler.update_scale(False)
 
     def allreduce_grads(self):
         self._init_kvstore()

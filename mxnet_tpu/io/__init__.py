@@ -12,7 +12,7 @@ import os
 import queue
 import struct
 import threading
-from collections import OrderedDict, namedtuple
+from collections import OrderedDict, deque, namedtuple
 
 import numpy as np
 
@@ -365,6 +365,14 @@ class ImageRecordIter(DataIter):
     Supported params mirror the reference's hot subset: path_imgrec/
     path_imgidx, data_shape (C,H,W), batch_size, shuffle, rand_crop,
     rand_mirror, resize, mean_{r,g,b}, std_{r,g,b}, scale.
+
+    ``preprocess_threads`` sizes the decode+augment thread pool — the
+    analog of the reference's parser→augmenter worker threads. Raw record
+    reads stay serial (cheap, preserves order); JPEG decode and
+    augmentation (cv2 — releases the GIL) run on the pool with up to
+    ``2 * preprocess_threads + batch_size`` records in flight, results
+    collected in submission order so the output stream is deterministic.
+    ``preprocess_threads <= 1`` keeps the fully serial path.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size,
@@ -392,7 +400,12 @@ class ImageRecordIter(DataIter):
                              dtype=np.float32).reshape(3, 1, 1)
         self._scale = scale
         self._label_width = label_width
+        self._seed = seed
         self._rng = np.random.RandomState(seed)
+        self._threads = int(preprocess_threads)
+        self._pool = None
+        self._pending = None
+        self._record_counter = 0
         self.reset()
 
     @property
@@ -406,6 +419,16 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape)]
 
     def reset(self):
+        if self._pending:
+            for fut in self._pending:
+                fut.cancel()
+        self._pending = deque()
+        self._record_counter = 0
+        # epoch counter folds into the per-record augment seed so each
+        # epoch draws fresh crops/mirrors (position-keyed seeding alone
+        # would replay epoch 1's augmentations forever)
+        self._epoch = getattr(self, "_epoch", -1) + 1
+        self._exhausted = False
         if self._keys is not None:
             self._order = list(self._keys)
             if self._shuffle:
@@ -414,8 +437,8 @@ class ImageRecordIter(DataIter):
         else:
             self._rec.reset()
 
-    def _next_record(self):
-        from .. import recordio
+    def _next_raw(self):
+        """Serial record fetch — raw packed bytes, decode deferred."""
         if self._keys is not None:
             if self._pos >= len(self._order):
                 return None
@@ -423,12 +446,21 @@ class ImageRecordIter(DataIter):
             self._pos += 1
         else:
             s = self._rec.read()
-            if s is None:
-                return None
-        header, img = recordio.unpack_img(s, iscolor=1)
-        return header.label, img
+        return s
 
-    def _augment(self, img):
+    def _decode_augment(self, s, record_idx):
+        """Worker body: unpack + JPEG decode + augment one record.
+        Augmentation randomness is derived from (seed, record index) so the
+        stream is reproducible regardless of pool size or thread timing."""
+        from .. import recordio
+        header, img = recordio.unpack_img(s, iscolor=1)
+        rng = np.random.RandomState(
+            ((self._seed * 1000003 + self._epoch) * 1000003 + record_idx)
+            & 0x7FFFFFFF) \
+            if (self._rand_crop or self._rand_mirror) else None
+        return header.label, self._augment(img, rng)
+
+    def _augment(self, img, rng):
         import cv2
         c, h, w = self._data_shape
         if self._resize > 0:
@@ -441,26 +473,58 @@ class ImageRecordIter(DataIter):
             img = cv2.resize(img, (max(w, iw), max(h, ih)))
             ih, iw = img.shape[:2]
         if self._rand_crop:
-            y = self._rng.randint(0, ih - h + 1)
-            x = self._rng.randint(0, iw - w + 1)
+            y = rng.randint(0, ih - h + 1)
+            x = rng.randint(0, iw - w + 1)
         else:
             y, x = (ih - h) // 2, (iw - w) // 2
         img = img[y:y + h, x:x + w]
-        if self._rand_mirror and self._rng.rand() < 0.5:
+        if self._rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1]
         img = img[:, :, ::-1]  # BGR (cv2) → RGB, like the reference
         chw = img.transpose(2, 0, 1).astype(np.float32)
         chw = (chw - self._mean) / self._std * self._scale
         return chw
 
+    def _fill_pending(self):
+        """Keep the decode pool fed: submit raw records until the in-flight
+        window (2×threads + batch) is full or the pack is exhausted."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._threads,
+                thread_name_prefix="mx-imgrec-decode")
+        window = 2 * self._threads + self.batch_size
+        while not self._exhausted and len(self._pending) < window:
+            s = self._next_raw()
+            if s is None:
+                self._exhausted = True
+                break
+            self._pending.append(self._pool.submit(
+                self._decode_augment, s, self._record_counter))
+            self._record_counter += 1
+
+    def _next_decoded(self):
+        """(label, augmented CHW image) in record order, or None at end."""
+        if self._threads <= 1:
+            s = self._next_raw()
+            if s is None:
+                return None
+            idx = self._record_counter
+            self._record_counter += 1
+            return self._decode_augment(s, idx)
+        self._fill_pending()
+        if not self._pending:
+            return None
+        return self._pending.popleft().result()
+
     def next(self):
         datas, labels = [], []
         while len(datas) < self.batch_size:
-            rec = self._next_record()
+            rec = self._next_decoded()
             if rec is None:
                 break
             label, img = rec
-            datas.append(self._augment(img))
+            datas.append(img)
             vals = np.asarray(label, dtype=np.float32).reshape(-1)
             # pad ragged label rows (variable object counts in detection
             # packs) to label_width so the batch stacks

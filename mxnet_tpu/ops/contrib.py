@@ -1057,10 +1057,49 @@ def _fused_self_attention(qkv, heads=None, causal=False, block_size=512):
                / se32).astype(q.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", att, v)
         return out.reshape(b, s, c)
-    # long-sequence streaming path wants [B, H, S, D]
+    # long-sequence streaming path wants [B, H, S, D]; clamp the block to
+    # a divisor of s here (shapes are concrete at trace time) so callers
+    # stay shape-free — required for symbolic export of attention blocks
+    blk = min(block_size, s)
+    while s % blk:
+        blk -= 1
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
-    out = _flash_attention(qh, kh, vh, block_size=block_size,
+    out = _flash_attention(qh, kh, vh, block_size=blk,
                            causal=causal)
     return out.transpose(0, 2, 1, 3).reshape(b, s, c)
+
+
+@register("_contrib_fused_cross_attention", num_inputs=2,
+          params=[OpParam("heads", int, None, required=True),
+                  OpParam("block_size", int, 512)],
+          doc="Cross-attention off fused projections: q (B, Sq, C) "
+              "attends over kv (B, Sk, 2C) — the decoder→encoder shape "
+              "of the NMT transformer. Same (B, S, H, D) einsum layout "
+              "and fp32-accumulated softmax as "
+              "_contrib_fused_self_attention; shape-free for callers so "
+              "decoder blocks export symbolically.")
+def _fused_cross_attention(q_in, kv, heads=None, block_size=512):
+    b, sq, c = q_in.shape
+    sk = kv.shape[1]
+    d = c // heads
+    q = q_in.reshape(b, sq, heads, d)
+    k = kv[:, :, :c].reshape(b, sk, heads, d)
+    v = kv[:, :, c:].reshape(b, sk, heads, d)
+    if sk <= 1024:
+        from .tensor import shifted_expsum
+        scale = float(d) ** -0.5
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        _, shifted, se32 = shifted_expsum(scores, axis=-1)
+        att = (jnp.exp(shifted).astype(jnp.float32)
+               / se32).astype(q.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+        return out.reshape(b, sq, c)
+    blk = min(block_size, sk)
+    while sk % blk:
+        blk -= 1
+    out = _flash_attention(q.transpose(0, 2, 1, 3),
+                           k.transpose(0, 2, 1, 3),
+                           v.transpose(0, 2, 1, 3), block_size=blk)
+    return out.transpose(0, 2, 1, 3).reshape(b, sq, c)

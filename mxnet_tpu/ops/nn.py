@@ -312,10 +312,20 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
         # keeps the op HBM-minimal under bf16 AMP, where the step is
         # bandwidth-bound (see docs/perf_notes.md). The raw E[x^2]-E[x]^2
         # form cancels catastrophically when |mean| >> std, so both moments
-        # are taken about the (stop-gradient) running mean: once stats are
-        # warm the shift ~equals the batch mean and the subtraction is
-        # exact; cold-start equals the unshifted form (flax's behavior).
-        c = lax.stop_gradient(moving_mean.astype(jnp.float32))
+        # are taken about a shift c that is always near the batch mean: the
+        # per-channel mean of up to 4 EVENLY SPACED slices along the leading
+        # reduced axis (~4/N of a full pass). Because c is an average of
+        # actual batch samples, (mean-c)² ≤ N·var (inter-sample deviations
+        # are part of the batch variance), so the one-pass subtraction
+        # loses at most ~log2(N) bits — bounded at every step including
+        # cold start, and robust to one unrepresentative sample (the
+        # round-2 advisor measured std 158 instead of 1 at mean=1e4 when
+        # the shift was the zero-initialized running mean).
+        red0 = axes[0]
+        n0 = x.shape[red0]
+        take = jnp.arange(min(4, n0)) * max(1, n0 // min(4, n0))
+        c = lax.stop_gradient(jnp.mean(
+            jnp.take(x, take, axis=red0).astype(jnp.float32), axis=axes))
         cb = c.reshape(bshape)
         xc = x.astype(jnp.float32) - cb
         mean_c = jnp.mean(xc, axis=axes)

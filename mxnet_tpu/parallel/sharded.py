@@ -189,7 +189,8 @@ class ShardedTrainer:
 
     def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
                  mesh: Mesh = None, param_rules=None, batch_axis=0,
-                 donate=True, compute_dtype=None):
+                 donate=True, compute_dtype=None, remat=None,
+                 master_dtype=None):
         from .. import optimizer as opt_mod
         self._block = block
         self._loss = loss_fn
@@ -206,6 +207,37 @@ class ShardedTrainer:
             compute_dtype = amp_dtype()
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype is not None else None)
+        # remat: rematerialization policy for the forward pass — the
+        # `jax.checkpoint` HBM↔FLOPs trade (MXNET_BACKWARD_DO_MIRROR is the
+        # reference's analog, ref: src/executor/graph_executor.cc mirror
+        # path). None keeps XLA's default saved-activation schedule;
+        # "full" saves nothing (recompute the whole forward in backward);
+        # "dots" saves matmul/conv outputs and recomputes elementwise chains;
+        # a callable is passed through as a jax.checkpoint policy.
+        if remat in (None, "full"):
+            self._remat_policy = remat
+        elif remat == "dots":
+            self._remat_policy = jax.checkpoint_policies.dots_saveable
+        elif remat == "dots_no_batch":
+            self._remat_policy = \
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif callable(remat):
+            self._remat_policy = remat
+        else:
+            raise MXNetError(f"unknown remat policy {remat!r}; expected "
+                             "None, 'full', 'dots', 'dots_no_batch' or a "
+                             "jax.checkpoint policy callable")
+        # master_dtype: storage dtype of weights + optimizer state. Default
+        # fp32 masters (the reference's multi-precision mp_* scheme);
+        # "bfloat16" halves parameter/state HBM traffic at the cost of
+        # update precision — the update math itself stays fp32-internal
+        # (ops/optimizer_op.py casts per-kernel).
+        self._master_dtype = (jnp.dtype(master_dtype)
+                              if master_dtype is not None else None)
+        if self._compute_dtype is None and self._master_dtype is not None:
+            # low-precision storage without a compute dtype would feed
+            # bf16 weights to fp32 inputs — compute in the master dtype
+            self._compute_dtype = self._master_dtype
         self._mesh = mesh
         self._param_rules = [(re.compile(pat), spec)
                              for pat, spec in (param_rules or [])]
@@ -263,8 +295,12 @@ class ShardedTrainer:
         self._aux_specs = [self._param_spec(p) for p in aux]
         # move parameter + aux arrays onto the mesh with their target layout;
         # the NDArray handles now hold globally-sharded jax.Arrays
+        mdt = self._master_dtype
         for p, spec in zip(trainable, self._tr_specs):
-            p._data[0]._rebind(self._shard(p._data[0]._data, spec))
+            w = p._data[0]._data
+            if mdt is not None and jnp.issubdtype(w.dtype, jnp.floating):
+                w = w.astype(mdt)
+            p._data[0]._rebind(self._shard(w, spec))
         for p, spec in zip(aux, self._aux_specs):
             p._data[0]._rebind(self._shard(p._data[0]._data, spec))
         # optimizer state, sharded like its weight
@@ -320,6 +356,11 @@ class ShardedTrainer:
                 loss_val = jnp.mean(loss_nd._data.astype(jnp.float32))
                 return loss_val, (outs, aux_new)
 
+            if self._remat_policy is not None:
+                loss_of = jax.checkpoint(
+                    loss_of,
+                    policy=(None if self._remat_policy == "full"
+                            else self._remat_policy))
             (loss_val, (outs, aux_new)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(list(tr))
             aux_new = [a.astype(a0.dtype) for a, a0 in zip(aux_new, aux)]
@@ -360,6 +401,7 @@ class ShardedTrainer:
         Returns the (replicated) scalar loss as an NDArray."""
         args = batch[:-1]
         self._prepare(args)
+        self._maybe_invalidate_amp()
         if self._step_fn is None:
             self._step_fn = self._build_step(len(args))
         batch_datas = [self._shard_batch_arg(b) for b in batch]
@@ -387,6 +429,17 @@ class ShardedTrainer:
                              for o in outs]
         return nd.NDArray(loss_val, _skip_device_put=True)
 
+    def _maybe_invalidate_amp(self):
+        """Retrace compiled programs when the per-op AMP cast policy
+        changes (amp.init with op lists / amp.reset) — a stale program
+        would silently keep or miss the casts."""
+        from .. import _dispatch
+        if getattr(self, "_amp_epoch", None) != _dispatch.amp_epoch():
+            self._step_fn = None
+            self._eval_fn = None
+            self._multi_fns = {}
+            self._amp_epoch = _dispatch.amp_epoch()
+
     def run_steps(self, *batch, num_steps=8):
         """Run ``num_steps`` train steps as ONE compiled program
         (``lax.scan`` over the step body). Amortizes host-dispatch latency
@@ -396,6 +449,7 @@ class ShardedTrainer:
         inner step; returns the last step's loss."""
         args = batch[:-1]
         self._prepare(args)
+        self._maybe_invalidate_amp()
         if self._step_fn is None:
             self._step_fn = self._build_step(len(args))
         key = f"multi{num_steps}"
@@ -447,6 +501,7 @@ class ShardedTrainer:
         """Forward + loss under one compiled program (no update)."""
         args = batch[:-1]
         self._prepare(args)
+        self._maybe_invalidate_amp()
         if self._eval_fn is None:
             block, loss_block = self._block, self._loss
 

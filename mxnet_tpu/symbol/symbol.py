@@ -599,6 +599,9 @@ def _create(opname, input_syms, kwargs, name=None):
         if opname == "RNN" and attrs.get("mode") != "lstm" and \
                 "state_cell" in want:
             want.remove("state_cell")
+        if opname == "LeakyReLU" and "gamma" in want and \
+                str(attrs.get("act_type", "leaky")) != "prelu":
+            want.remove("gamma")    # only prelu carries a learned slope
         while len(syms) < len(want):
             syms.append(_auto_var(f"{name}_{want[len(syms)]}"))
         input_syms = syms

@@ -6,16 +6,20 @@
 // reference's amalgamation/mobile builds serve).
 //
 // Supported ops: Convolution, FullyConnected, BatchNorm (inference),
-// Activation, Pooling, Flatten, Reshape, elemwise_add/mul,
-// broadcast_add/mul, Concat, softmax, log_softmax, Dropout (identity),
-// LeakyReLU — the exported-model op set of the model zoo's image
-// classifiers (LeNet/MLP/ResNet/VGG).
+// Activation, Pooling, Flatten, Reshape, elemwise/broadcast
+// add/mul/sub/div, scalar ops, Concat, softmax, log_softmax, Dropout
+// (identity), LeakyReLU (leaky/elu/gelu), Embedding, LayerNorm,
+// fused self/cross attention, transpose, batch_dot, slice/slice_like,
+// expand_dims, squeeze — the exported-model op sets of the model zoo's
+// image classifiers (LeNet/MLP/ResNet/VGG) AND the transformer family
+// (BERT encoder, Sockeye-style NMT transformer).
 //
 // Build: part of libmxtpu.so (see Makefile). C ABI mirrors the
 // reference's signatures.
 
 #include <algorithm>
 #include <cctype>
+#include <climits>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -387,6 +391,260 @@ static void softmax_rows(Tensor& t) {
   }
 }
 
+// ---- transformer-family kernels (exported BERT / NMT graphs) --------------
+
+static void embedding(const Tensor& idx, const Tensor& w, Tensor& out) {
+  long V = w.shape[0], U = w.shape[1];
+  out.shape = idx.shape;
+  out.shape.push_back(U);
+  out.alloc();
+  for (long i = 0; i < idx.size(); ++i) {
+    long row = (long)std::lround(idx.data[i]);
+    if (row < 0 || row >= V)
+      throw std::runtime_error("Embedding index out of range");
+    std::memcpy(&out.data[i * U], &w.data[row * U], U * sizeof(float));
+  }
+}
+
+static void layernorm(const Tensor& x, const Tensor& gamma,
+                      const Tensor& beta, double eps, long axis, Tensor& out) {
+  long nd = (long)x.shape.size();
+  if (axis < 0) axis += nd;
+  if (axis != nd - 1)
+    throw std::runtime_error("LayerNorm: only last-axis supported");
+  long C = x.shape.back();
+  long rows = x.size() / C;
+  out.shape = x.shape;
+  out.alloc();
+  for (long r = 0; r < rows; ++r) {
+    const float* px = &x.data[r * C];
+    float* po = &out.data[r * C];
+    double m = 0, v = 0;
+    for (long c = 0; c < C; ++c) m += px[c];
+    m /= C;
+    for (long c = 0; c < C; ++c) { double d = px[c] - m; v += d * d; }
+    v /= C;
+    float inv = 1.f / std::sqrt((float)v + (float)eps);
+    for (long c = 0; c < C; ++c)
+      po[c] = (float)((px[c] - m) * inv) * gamma.data[c] + beta.data[c];
+  }
+}
+
+// softmax over the last axis of a (rows, C) view of scores
+static void softmax_inplace(float* p, long C) {
+  float m = *std::max_element(p, p + C);
+  double s = 0;
+  for (long c = 0; c < C; ++c) { p[c] = std::exp(p[c] - m); s += p[c]; }
+  for (long c = 0; c < C; ++c) p[c] = (float)(p[c] / s);
+}
+
+// q (B,Sq,H,D) laid flat out of proj rows; generic core shared by the fused
+// self/cross attention ops (ref: the Python ops' einsum formulation,
+// mxnet_tpu/ops/contrib.py _fused_self_attention/_fused_cross_attention)
+static void attention_core(const float* q, const float* k, const float* v,
+                           long B, long Sq, long Sk, long H, long D,
+                           bool causal, float* outp) {
+  float scale = 1.f / std::sqrt((float)D);
+  std::vector<float> row(Sk);
+  for (long b = 0; b < B; ++b)
+    for (long h = 0; h < H; ++h)
+      for (long i = 0; i < Sq; ++i) {
+        const float* qi = &q[((b * Sq + i) * H + h) * D];
+        for (long j = 0; j < Sk; ++j) {
+          if (causal && j > i) { row[j] = -1e30f; continue; }
+          const float* kj = &k[((b * Sk + j) * H + h) * D];
+          float acc = 0;
+          for (long d = 0; d < D; ++d) acc += qi[d] * kj[d];
+          row[j] = acc * scale;
+        }
+        softmax_inplace(row.data(), Sk);
+        float* oi = &outp[((b * Sq + i) * H + h) * D];
+        for (long d = 0; d < D; ++d) oi[d] = 0.f;
+        for (long j = 0; j < Sk; ++j) {
+          const float* vj = &v[((b * Sk + j) * H + h) * D];
+          float a = row[j];
+          for (long d = 0; d < D; ++d) oi[d] += a * vj[d];
+        }
+      }
+}
+
+static void self_attention(const Tensor& qkv, long heads, bool causal,
+                           Tensor& out) {
+  long B = qkv.shape[0], S = qkv.shape[1], C = qkv.shape[2] / 3;
+  long D = C / heads;
+  // split (B,S,3C) rows into contiguous q/k/v in (B,S,H,D) flat layout
+  std::vector<float> q(B * S * C), k(B * S * C), v(B * S * C);
+  for (long r = 0; r < B * S; ++r) {
+    const float* src = &qkv.data[r * 3 * C];
+    std::memcpy(&q[r * C], src, C * sizeof(float));
+    std::memcpy(&k[r * C], src + C, C * sizeof(float));
+    std::memcpy(&v[r * C], src + 2 * C, C * sizeof(float));
+  }
+  out.shape = {B, S, C};
+  out.alloc();
+  attention_core(q.data(), k.data(), v.data(), B, S, S, heads, D, causal,
+                 out.data.data());
+}
+
+static void cross_attention(const Tensor& qt, const Tensor& kv, long heads,
+                            Tensor& out) {
+  long B = qt.shape[0], Sq = qt.shape[1], C = qt.shape[2];
+  long Sk = kv.shape[1], D = C / heads;
+  std::vector<float> k(B * Sk * C), v(B * Sk * C);
+  for (long r = 0; r < B * Sk; ++r) {
+    const float* src = &kv.data[r * 2 * C];
+    std::memcpy(&k[r * C], src, C * sizeof(float));
+    std::memcpy(&v[r * C], src + C, C * sizeof(float));
+  }
+  out.shape = {B, Sq, C};
+  out.alloc();
+  attention_core(qt.data.data(), k.data(), v.data(), B, Sq, Sk, heads, D,
+                 false, out.data.data());
+}
+
+static void transpose_nd(const Tensor& x, const std::vector<long>& axes,
+                         Tensor& out) {
+  long nd = (long)x.shape.size();
+  std::vector<long> ax = axes;
+  if (ax.empty())
+    for (long i = nd - 1; i >= 0; --i) ax.push_back(i);
+  out.shape.resize(nd);
+  for (long i = 0; i < nd; ++i) out.shape[i] = x.shape[ax[i]];
+  out.alloc();
+  std::vector<long> xstride(nd, 1), ostride(nd, 1);
+  for (long i = nd - 2; i >= 0; --i)
+    xstride[i] = xstride[i + 1] * x.shape[i + 1];
+  for (long i = nd - 2; i >= 0; --i)
+    ostride[i] = ostride[i + 1] * out.shape[i + 1];
+  std::vector<long> oidx(nd, 0);
+  for (long o = 0; o < out.size(); ++o) {
+    long rem = o, xoff = 0;
+    for (long i = 0; i < nd; ++i) {
+      long c = rem / ostride[i];
+      rem %= ostride[i];
+      xoff += c * xstride[ax[i]];
+    }
+    out.data[o] = x.data[xoff];
+  }
+}
+
+static void batch_dot(const Tensor& a, const Tensor& b, bool ta, bool tb,
+                      Tensor& out) {
+  // (B.., M, K) x (B.., K, N); leading batch dims must match
+  long nd = (long)a.shape.size();
+  long M = ta ? a.shape[nd - 1] : a.shape[nd - 2];
+  long K = ta ? a.shape[nd - 2] : a.shape[nd - 1];
+  long N = tb ? b.shape[nd - 2] : b.shape[nd - 1];
+  long batch = 1;
+  for (long i = 0; i < nd - 2; ++i) batch *= a.shape[i];
+  out.shape.assign(a.shape.begin(), a.shape.end() - 2);
+  out.shape.push_back(M);
+  out.shape.push_back(N);
+  out.alloc();
+  long as = M * K, bs = K * N;
+  for (long g = 0; g < batch; ++g)
+    for (long m = 0; m < M; ++m)
+      for (long n2 = 0; n2 < N; ++n2) {
+        float acc = 0;
+        for (long kk = 0; kk < K; ++kk) {
+          float av = ta ? a.data[g * as + kk * M + m]
+                        : a.data[g * as + m * K + kk];
+          float bv = tb ? b.data[g * bs + n2 * K + kk]
+                        : b.data[g * bs + kk * N + n2];
+          acc += av * bv;
+        }
+        out.data[(g * M + m) * N + n2] = acc;
+      }
+}
+
+// numpy-style broadcast binary: op 0 add, 1 mul, 2 sub, 3 div
+static void broadcast_binary(const Tensor& a, const Tensor& b, int op,
+                             Tensor& out) {
+  long nd = (long)std::max(a.shape.size(), b.shape.size());
+  std::vector<long> sa(nd, 1), sb(nd, 1);
+  std::copy(a.shape.begin(), a.shape.end(),
+            sa.begin() + (nd - a.shape.size()));
+  std::copy(b.shape.begin(), b.shape.end(),
+            sb.begin() + (nd - b.shape.size()));
+  out.shape.resize(nd);
+  for (long i = 0; i < nd; ++i) {
+    if (sa[i] != sb[i] && sa[i] != 1 && sb[i] != 1)
+      throw std::runtime_error("broadcast shape mismatch");
+    out.shape[i] = std::max(sa[i], sb[i]);
+  }
+  out.alloc();
+  std::vector<long> so(nd, 1), ca(nd, 1), cb(nd, 1);
+  for (long i = nd - 2; i >= 0; --i) {
+    ca[i] = ca[i + 1] * sa[i + 1];
+    cb[i] = cb[i + 1] * sb[i + 1];
+    so[i] = so[i + 1] * out.shape[i + 1];
+  }
+  for (long o = 0; o < out.size(); ++o) {
+    long rem = o, ia = 0, ib = 0;
+    for (long i = 0; i < nd; ++i) {
+      long c = rem / so[i];
+      rem %= so[i];
+      ia += (sa[i] == 1 ? 0 : c) * ca[i];
+      ib += (sb[i] == 1 ? 0 : c) * cb[i];
+    }
+    float x = a.data[ia], y = b.data[ib];
+    out.data[o] = op == 0 ? x + y : op == 1 ? x * y
+                  : op == 2 ? x - y : x / y;
+  }
+}
+
+// tuple parser that keeps None entries as LONG_MIN sentinels (for slice)
+static const long kNone = LONG_MIN;
+static std::vector<long> parse_tuple_opt(const std::string& s) {
+  std::vector<long> out;
+  long cur = 0;
+  bool in_num = false, neg = false;
+  for (size_t i = 0; i < s.size(); ++i) {
+    char c = s[i];
+    if (c == 'N') { out.push_back(kNone); }
+    else if (std::isdigit((unsigned char)c)) {
+      cur = cur * 10 + (c - '0');
+      in_num = true;
+    } else if (c == '-') {
+      neg = true;
+    } else if (in_num) {
+      out.push_back(neg ? -cur : cur);
+      cur = 0; in_num = false; neg = false;
+    }
+  }
+  if (in_num) out.push_back(neg ? -cur : cur);
+  return out;
+}
+
+static void slice_ranges(const Tensor& x, const std::vector<long>& begin,
+                         const std::vector<long>& end, Tensor& out) {
+  long nd = (long)x.shape.size();
+  std::vector<long> b(nd, 0), e(x.shape);
+  for (size_t i = 0; i < begin.size() && (long)i < nd; ++i) {
+    if (begin[i] != kNone)
+      b[i] = begin[i] < 0 ? begin[i] + x.shape[i] : begin[i];
+    if (i < end.size() && end[i] != kNone)
+      e[i] = end[i] < 0 ? end[i] + x.shape[i] : std::min(end[i], x.shape[i]);
+  }
+  out.shape.resize(nd);
+  for (long i = 0; i < nd; ++i) out.shape[i] = e[i] - b[i];
+  out.alloc();
+  std::vector<long> xs(nd, 1), os(nd, 1);
+  for (long i = nd - 2; i >= 0; --i) {
+    xs[i] = xs[i + 1] * x.shape[i + 1];
+    os[i] = os[i + 1] * out.shape[i + 1];
+  }
+  for (long o = 0; o < out.size(); ++o) {
+    long rem = o, xoff = 0;
+    for (long i = 0; i < nd; ++i) {
+      long c = rem / os[i];
+      rem %= os[i];
+      xoff += (c + b[i]) * xs[i];
+    }
+    out.data[o] = x.data[xoff];
+  }
+}
+
 // ---------------------------------------------------------------------------
 // the graph executor
 // ---------------------------------------------------------------------------
@@ -503,9 +761,14 @@ struct Predictor {
         out = in(n, 0);
         float slope = (float)parse_float(a("slope"), 0.25);
         std::string act = a("act_type");
-        if (!act.empty() && act != "leaky")
-          throw std::runtime_error("LeakyReLU act_type " + act);
-        for (float& v : out.data) v = v > 0 ? v : slope * v;
+        if (act.empty()) act = "leaky";
+        for (float& v : out.data) {
+          if (act == "leaky") v = v > 0 ? v : slope * v;
+          else if (act == "elu") v = v > 0 ? v : slope * std::expm1(v);
+          else if (act == "gelu")   // exact erf form, like jax.nn.gelu
+            v = 0.5f * v * (1.f + std::erf(v * 0.70710678f));
+          else throw std::runtime_error("LeakyReLU act_type " + act);
+        }
       } else if (n.op == "Pooling") {
         auto kernel = a("kernel").empty() ? std::vector<long>{1, 1}
                                           : parse_tuple(a("kernel"));
@@ -536,17 +799,93 @@ struct Predictor {
         if (infer >= 0) shape[infer] = out.size() / known;
         out.shape.assign(shape.begin(), shape.end());
       } else if (n.op == "elemwise_add" || n.op == "broadcast_add" ||
-                 n.op == "elemwise_mul" || n.op == "broadcast_mul") {
-        const Tensor& lhs = in(n, 0);
-        const Tensor& rhs = in(n, 1);
-        if (lhs.size() != rhs.size())
-          throw std::runtime_error("broadcast in " + n.op +
-                                   " beyond same-shape unsupported");
-        out = lhs;
-        bool mul = n.op.find("mul") != std::string::npos;
-        for (long i = 0; i < out.size(); ++i)
-          out.data[i] = mul ? out.data[i] * rhs.data[i]
-                            : out.data[i] + rhs.data[i];
+                 n.op == "elemwise_mul" || n.op == "broadcast_mul" ||
+                 n.op == "elemwise_sub" || n.op == "broadcast_sub" ||
+                 n.op == "elemwise_div" || n.op == "broadcast_div") {
+        int kind = n.op.find("add") != std::string::npos ? 0
+                   : n.op.find("mul") != std::string::npos ? 1
+                   : n.op.find("sub") != std::string::npos ? 2 : 3;
+        broadcast_binary(in(n, 0), in(n, 1), kind, out);
+      } else if (n.op == "_mul_scalar" || n.op == "_plus_scalar" ||
+                 n.op == "_minus_scalar" || n.op == "_rminus_scalar" ||
+                 n.op == "_div_scalar" || n.op == "_rdiv_scalar") {
+        out = in(n, 0);
+        float s = (float)parse_float(a("scalar"), 0.0);
+        for (float& v : out.data) {
+          if (n.op == "_mul_scalar") v *= s;
+          else if (n.op == "_plus_scalar") v += s;
+          else if (n.op == "_minus_scalar") v -= s;
+          else if (n.op == "_rminus_scalar") v = s - v;
+          else if (n.op == "_div_scalar") v /= s;
+          else v = s / v;
+        }
+      } else if (n.op == "Embedding") {
+        embedding(in(n, 0), in(n, 1), out);
+      } else if (n.op == "LayerNorm") {
+        layernorm(in(n, 0), in(n, 1), in(n, 2),
+                  parse_float(a("eps"), 1e-5), parse_int(a("axis"), -1),
+                  out);
+      } else if (n.op == "_contrib_fused_self_attention") {
+        self_attention(in(n, 0), parse_int(a("heads"), 1),
+                       parse_bool(a("causal"), false), out);
+      } else if (n.op == "_contrib_fused_cross_attention") {
+        cross_attention(in(n, 0), in(n, 1), parse_int(a("heads"), 1), out);
+      } else if (n.op == "expand_dims") {
+        out = in(n, 0);
+        long ax = parse_int(a("axis"), 0);
+        if (ax < 0) ax += (long)out.shape.size() + 1;
+        out.shape.insert(out.shape.begin() + ax, 1);
+      } else if (n.op == "squeeze") {
+        out = in(n, 0);
+        std::string axs = a("axis");
+        if (axs.empty() || axs == "None") {
+          std::vector<long> ns;
+          for (long s : out.shape) if (s != 1) ns.push_back(s);
+          if (ns.empty()) ns.push_back(1);
+          out.shape = ns;
+        } else {
+          auto axes = parse_tuple(axs);
+          std::vector<bool> drop(out.shape.size(), false);
+          for (long ax : axes)
+            drop[ax < 0 ? ax + (long)out.shape.size() : ax] = true;
+          std::vector<long> ns;
+          for (size_t i = 0; i < out.shape.size(); ++i)
+            if (!drop[i]) ns.push_back(out.shape[i]);
+          if (ns.empty()) ns.push_back(1);
+          out.shape = ns;
+        }
+      } else if (n.op == "slice") {
+        for (long st : parse_tuple_opt(a("step")))
+          if (st != kNone && st != 1)
+            throw std::runtime_error("slice: non-unit step unsupported");
+        slice_ranges(in(n, 0), parse_tuple_opt(a("begin")),
+                     parse_tuple_opt(a("end")), out);
+      } else if (n.op == "slice_like") {
+        const Tensor& x = in(n, 0);
+        const Tensor& like = in(n, 1);
+        std::vector<long> begin(x.shape.size(), 0);
+        std::vector<long> end(x.shape.begin(), x.shape.end());
+        std::string axs = a("axes");
+        if (axs.empty() || axs == "None") {
+          for (size_t i = 0; i < x.shape.size() && i < like.shape.size();
+               ++i)
+            end[i] = like.shape[i];
+        } else {
+          for (long ax : parse_tuple(axs)) {
+            if (ax < 0) ax += (long)x.shape.size();
+            end[ax] = like.shape[ax];
+          }
+        }
+        slice_ranges(x, begin, end, out);
+      } else if (n.op == "transpose") {
+        out.shape.clear();
+        transpose_nd(in(n, 0), a("axes").empty() ? std::vector<long>{}
+                                                 : parse_tuple(a("axes")),
+                     out);
+      } else if (n.op == "batch_dot") {
+        batch_dot(in(n, 0), in(n, 1),
+                  parse_bool(a("transpose_a"), false),
+                  parse_bool(a("transpose_b"), false), out);
       } else if (n.op == "Concat") {
         long dim = parse_int(a("dim"), 1);
         const Tensor& first = in(n, 0);
@@ -570,6 +909,10 @@ struct Predictor {
         }
       } else if (n.op == "softmax" || n.op == "SoftmaxOutput") {
         out = in(n, 0);
+        long ax = parse_int(a("axis"), -1);
+        long nd2 = (long)out.shape.size();
+        if (ax != -1 && ax != nd2 - 1)
+          throw std::runtime_error("softmax: only last-axis supported");
         softmax_rows(out);
       } else if (n.op == "log_softmax") {
         out = in(n, 0);
@@ -577,6 +920,12 @@ struct Predictor {
         for (float& v : out.data) v = std::log(std::max(v, 1e-30f));
       } else if (n.op == "Dropout" || n.op == "identity") {
         out = in(n, 0);
+      } else if (n.op == "_group") {
+        // multi-output head grouping: pass every input through
+        std::vector<Tensor> vals;
+        for (size_t i = 0; i < n.inputs.size(); ++i) vals.push_back(in(n, i));
+        values[id] = std::move(vals);
+        continue;
       } else {
         throw std::runtime_error("predict: unsupported op " + n.op +
                                  " (node " + n.name + ")");

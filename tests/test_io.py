@@ -147,6 +147,41 @@ def test_image_record_iter(tmp_path):
     assert batch.label[0].shape == (4,)
 
 
+def test_image_record_iter_threaded_matches_serial(tmp_path):
+    """preprocess_threads must change throughput, never the stream: the
+    pooled decode path yields identical batches in identical order to the
+    serial path (deterministic per-record augmentation seeding), for any
+    pool size, across reset()."""
+    rec = str(tmp_path / "imgs.rec")
+    idx = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(7)
+    for i in range(13):
+        img = (rng.rand(48, 40, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    w.close()
+
+    def batches(threads):
+        it = io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                                data_shape=(3, 32, 32), batch_size=4,
+                                shuffle=True, rand_crop=True,
+                                rand_mirror=True, seed=3,
+                                preprocess_threads=threads)
+        out = [(b.data[0].asnumpy(), b.label[0].asnumpy()) for b in it]
+        it.reset()       # second epoch exercises pending-future cleanup
+        out += [(b.data[0].asnumpy(), b.label[0].asnumpy()) for b in it]
+        return out
+
+    serial = batches(1)
+    for threads in (2, 5):
+        pooled = batches(threads)
+        assert len(pooled) == len(serial)
+        for (da, la), (db, lb) in zip(serial, pooled):
+            np.testing.assert_array_equal(la, lb)
+            np.testing.assert_array_equal(da, db)
+
+
 def test_prefetching_iter():
     data = np.random.randn(20, 3).astype(np.float32)
     inner = io.NDArrayIter(data, np.arange(20), batch_size=5)

@@ -340,3 +340,39 @@ def test_norm_layers_large_mean_precision():
     oi = mx.nd.InstanceNorm(mx.nd.array(x4), mx.nd.array(g4),
                             mx.nd.array(b4)).asnumpy()
     assert 0.5 < oi.std() < 2.0, oi.std()
+
+
+def test_batch_norm_large_mean_cold_start():
+    """Round-2 advisor finding: training-mode BN with ZERO (cold) running
+    stats on |mean|>>std input must still normalize (the running-mean
+    shift form measured output std 158 instead of 1 at mean=1e4)."""
+    rng = np.random.RandomState(1)
+    x = (rng.randn(16, 4, 6, 6) + 1e4).astype(np.float32)
+    g = np.ones(4, np.float32)
+    b = np.zeros(4, np.float32)
+    zeros = np.zeros(4, np.float32)     # cold moving_mean / moving_var
+    with mx.autograd.record(train_mode=True):
+        out, bmean, bvar = mx.nd.BatchNorm(
+            mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+            mx.nd.array(zeros), mx.nd.array(zeros),
+            fix_gamma=False, output_mean_var=True)
+    o = out.asnumpy()
+    assert 0.9 < o.std() < 1.1, o.std()
+    np.testing.assert_allclose(bmean.asnumpy(),
+                               x.mean(axis=(0, 2, 3)), rtol=1e-5)
+    np.testing.assert_allclose(bvar.asnumpy(),
+                               x.var(axis=(0, 2, 3)), rtol=1e-2, atol=1e-3)
+    # adversarial shift case: sample 0 is a blank (zero) frame while the
+    # rest of the batch sits at 1e4 — a data-derived shift taken from
+    # sample 0 alone would be ~1e4 off the batch mean; the spread-slice
+    # shift + (mean-c)^2 <= N*var bound must keep the variance sane
+    x2 = (rng.randn(16, 4, 6, 6) * 0.01 + 1e4).astype(np.float32)
+    x2[0] = 0.0
+    with mx.autograd.record(train_mode=True):
+        out2, bm2, bv2 = mx.nd.BatchNorm(
+            mx.nd.array(x2), mx.nd.array(g), mx.nd.array(b),
+            mx.nd.array(zeros), mx.nd.array(zeros),
+            fix_gamma=False, output_mean_var=True)
+    np.testing.assert_allclose(bv2.asnumpy(), x2.var(axis=(0, 2, 3)),
+                               rtol=1e-3)
+    assert np.isfinite(out2.asnumpy()).all()
