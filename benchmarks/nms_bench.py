@@ -46,8 +46,6 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    import mxnet_tpu as mx
-    from mxnet_tpu import nd
 
     rng = np.random.RandomState(0)
     n, b = args.n, args.batch

@@ -47,7 +47,6 @@ def main():
 
     import jax
     import jax.numpy as jnp
-    import mxnet_tpu as mx
     from mxnet_tpu import gluon, parallel
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.parallel import functional_apply

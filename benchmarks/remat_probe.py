@@ -28,7 +28,6 @@ import numpy as np
 
 def measure(config_name, batch, on_tpu, **trainer_kw):
     import jax
-    import mxnet_tpu as mx
     from mxnet_tpu import gluon, parallel
     from mxnet_tpu.gluon.model_zoo import vision
 
@@ -39,9 +38,14 @@ def measure(config_name, batch, on_tpu, **trainer_kw):
         net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
         mesh=mesh, compute_dtype="bfloat16" if on_tpu else None, **trainer_kw)
-    x = np.random.randn(batch, 3, 224 if on_tpu else 32,
-                        224 if on_tpu else 32).astype(np.float32)
-    y = np.random.randint(0, 1000, (batch,))
+    x_host = np.random.randn(batch, 3, 224 if on_tpu else 32,
+                             224 if on_tpu else 32).astype(np.float32)
+    y_host = np.random.randint(0, 1000, (batch,))
+    # stage the batch on device ONCE (like bench.py): re-uploading per
+    # dispatch would gate the measurement on the ~6 MB/s tunnel link
+    trainer._prepare((x_host,))
+    x = trainer._shard(x_host, trainer._batch_spec(4))
+    y = trainer._shard(y_host, trainer._batch_spec(1))
 
     # bench.py's methodology: N back-to-back ASYNC dispatches of a k-step
     # scanned program, ONE hard sync at the end (dispatch latency overlaps

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Self-contained lint tier (ref: ci/docker/runtime_functions.sh
+sanity_check — the reference runs cpplint/pylint there). No third-party
+linters are baked into this image, so this is a dependency-free
+pylint-lite over the AST:
+
+  E1  syntax error (file does not compile)
+  W1  unused import
+  W2  bare ``except:``
+  W3  mutable default argument (list/dict/set literal)
+  W4  f-string with no placeholders
+  W5  trailing whitespace / tab indentation
+  W6  line longer than 100 columns
+
+Usage: python ci/lint.py [paths...]   (default: mxnet_tpu tools examples
+benchmarks tests bench.py __graft_entry__.py)
+Exit code 1 on any finding — wired as the first CI tier.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+DEFAULT_PATHS = ["mxnet_tpu", "tools", "examples", "benchmarks", "tests",
+                 "ci", "bench.py", "__graft_entry__.py"]
+MAX_LINE = 100
+
+
+def iter_py(paths):
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+class ImportTracker(ast.NodeVisitor):
+    """Collect imported names and every referenced name. Imports inside
+    try/except are feature probes (the import IS the use) and
+    ``from __future__`` imports are semantic — neither is flagged."""
+
+    def __init__(self):
+        self.imports = {}       # name -> lineno
+        self.used = set()
+        self._try_depth = 0
+
+    def visit_Try(self, node):
+        self._try_depth += 1
+        self.generic_visit(node)
+        self._try_depth -= 1
+
+    def visit_Import(self, node):
+        if self._try_depth:
+            return
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imports.setdefault(name, node.lineno)
+
+    def visit_ImportFrom(self, node):
+        if self._try_depth or node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports.setdefault(a.asname or a.name, node.lineno)
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def lint_file(path):
+    findings = []
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, "E1", f"syntax error: {e.msg}")]
+
+    lines = src.splitlines()
+    for i, line in enumerate(lines, 1):
+        if line.rstrip() != line.rstrip("\n").rstrip() or \
+                line != line.rstrip():
+            findings.append((path, i, "W5", "trailing whitespace"))
+        if line.startswith("\t") or (line[:1] == " " and "\t" in
+                                     line[:len(line) - len(line.lstrip())]):
+            findings.append((path, i, "W5", "tab indentation"))
+        if len(line) > MAX_LINE:
+            findings.append((path, i, "W6",
+                             f"line too long ({len(line)} > {MAX_LINE})"))
+
+    tracker = ImportTracker()
+    tracker.visit(tree)
+    # names exported via __all__ strings or re-exported in __init__ count
+    exported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" and \
+                        isinstance(node.value, (ast.List, ast.Tuple)):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant):
+                            exported.add(str(elt.value))
+    is_init = os.path.basename(path) == "__init__.py"
+    for name, lineno in tracker.imports.items():
+        if name.startswith("_"):
+            continue
+        if name not in tracker.used and name not in exported and \
+                not is_init:
+            findings.append((path, lineno, "W1", f"unused import {name!r}"))
+
+    _format_specs = {id(n.format_spec) for n in ast.walk(tree)
+                     if isinstance(n, ast.FormattedValue)
+                     and n.format_spec is not None}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append((path, node.lineno, "W2", "bare except:"))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    findings.append((path, d.lineno, "W3",
+                                     "mutable default argument"))
+        if isinstance(node, ast.JoinedStr):
+            # skip format-spec JoinedStrs nested inside FormattedValue
+            # (e.g. the ':8.1f' in f"{x:8.1f}" parses as a JoinedStr)
+            if id(node) in _format_specs:
+                continue
+            if not any(isinstance(v, ast.FormattedValue)
+                       for v in node.values):
+                findings.append((path, node.lineno, "W4",
+                                 "f-string without placeholders"))
+    return findings
+
+
+def main():
+    paths = sys.argv[1:] or DEFAULT_PATHS
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.chdir(repo)
+    all_findings = []
+    n_files = 0
+    for path in iter_py(paths):
+        n_files += 1
+        all_findings.extend(lint_file(path))
+    # standard `# noqa` suppression on the flagged line
+    def _suppressed(path, line):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src_lines = f.read().splitlines()
+            return line >= 1 and line <= len(src_lines) and \
+                "# noqa" in src_lines[line - 1]
+        except OSError:
+            return False
+
+    all_findings = [f for f in all_findings
+                    if not _suppressed(f[0], f[1])]
+    for path, line, code, msg in all_findings:
+        print(f"{path}:{line}: {code} {msg}")
+    print(f"lint: {n_files} files, {len(all_findings)} findings")
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

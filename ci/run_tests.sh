@@ -1,10 +1,15 @@
 #!/usr/bin/env bash
 # CI entry point (ref: ci/docker/runtime_functions.sh — the executable
-# spec of the reference's test matrix). Reproduces the conftest mesh
-# setup explicitly so the suite also runs under environments whose site
-# hooks pre-pin a JAX platform.
+# spec of the reference's test matrix). Tiered like the reference's
+# sanity_check / unittest / nightly split:
 #
-# Usage: ci/run_tests.sh [pytest args...]
+#   ci/run_tests.sh sanity          lint only (ci/lint.py, dependency-free)
+#   ci/run_tests.sh fast            lint + the quick unit tier
+#   ci/run_tests.sh [full]          lint + the whole suite (default)
+#   ci/run_tests.sh full -k expr    extra args go to pytest
+#
+# Reproduces the conftest mesh setup explicitly so the suite also runs
+# under environments whose site hooks pre-pin a JAX platform.
 set -euo pipefail
 
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -17,4 +22,30 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 export PYTHONPATH="$REPO"
 
 cd "$REPO"
-python -m pytest tests/ -q "$@"
+
+TIER="full"
+case "${1:-}" in
+  sanity|fast|full) TIER="$1"; shift ;;
+esac
+
+echo "== tier: sanity (lint) =="
+python ci/lint.py
+
+if [ "$TIER" = "sanity" ]; then
+  exit 0
+fi
+
+# quick unit tier: core ndarray/op/autograd/gluon/io surface, no
+# model-zoo or multi-process tests (ref: runtime_functions.sh unittest
+# vs nightly split)
+FAST_TESTS=(tests/test_ndarray.py tests/test_operator.py
+            tests/test_autograd.py tests/test_io.py tests/test_gluon.py
+            tests/test_aux.py tests/test_numpy_ns.py)
+
+if [ "$TIER" = "fast" ]; then
+  echo "== tier: fast =="
+  exec python -m pytest "${FAST_TESTS[@]}" -q "$@"
+fi
+
+echo "== tier: full =="
+exec python -m pytest tests/ -q "$@"

@@ -16,7 +16,7 @@ import jax
 
 from . import _rng, engine
 from .base import MXNetError
-from .ops.registry import Operator, get as get_op
+from .ops.registry import get as get_op
 
 __all__ = ["invoke", "set_amp_cast_hook"]
 

@@ -9,12 +9,40 @@ instead (see gluon.block), which is the TPU-idiomatic path.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 
 import jax
 
+
+def _default_impl():
+    """PRNG bit-generator implementation.
+
+    threefry (JAX's default) is counter-based and fully reproducible but
+    costs real MXU time to generate big masks — measured 32 ms of a
+    131 ms BERT-base step (24%!) just making dropout masks
+    (docs/perf_notes.md round 3). On TPU the default here is ``rbg``
+    (XLA's hardware RngBitGenerator): same stateless key-threading
+    semantics, ~free mask generation. Override with MXNET_PRNG_IMPL=
+    threefry2x32|rbg (e.g. for bit-exact cross-platform repro); CPU
+    keeps threefry so test suites stay deterministic."""
+    impl = os.environ.get("MXNET_PRNG_IMPL")
+    if impl:
+        return impl
+    try:
+        if jax.default_backend() == "tpu":
+            return "rbg"
+    except RuntimeError:
+        pass
+    return "threefry2x32"
+
+
+def _make_key(seed_val):
+    return jax.random.key(int(seed_val), impl=_default_impl())
+
+
 _lock = threading.Lock()
-_key = jax.random.PRNGKey(0)
+_key = _make_key(0)
 _trace = threading.local()
 
 
@@ -22,7 +50,7 @@ def seed(seed_state: int):
     """ref: mx.random.seed — reseed the global generator."""
     global _key
     with _lock:
-        _key = jax.random.PRNGKey(int(seed_state))
+        _key = _make_key(int(seed_state))
 
 
 def next_key():

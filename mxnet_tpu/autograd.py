@@ -318,7 +318,7 @@ def _backward_create_graph(heads, head_grads, leaf_filter):
     """Tape walk with NDArray cotangents under recording → leaf grads that
     are themselves differentiable (ref: Imperative::Backward with
     create_graph=True)."""
-    from .ndarray import NDArray, zeros as nd_zeros
+    from .ndarray import NDArray
 
     cotangents = {}
     leaf_accum = {}

@@ -9,7 +9,6 @@ first-class Context so scripts port by swapping ``ctx=mx.tpu()``.
 from __future__ import annotations
 
 import threading
-from typing import Optional
 
 import jax
 

@@ -21,7 +21,6 @@ the block to **one jitted XLA program** via ``jax.jit`` — the mapping SURVEY
 """
 from __future__ import annotations
 
-import json
 import re
 import threading
 from collections import OrderedDict

@@ -7,10 +7,9 @@ from __future__ import annotations
 
 import numpy as np
 
-from ....base import MXNetError
 from .... import ndarray as nd
 from ...block import Block, HybridBlock
-from ...nn import HybridSequential, Sequential
+from ...nn import Sequential
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",

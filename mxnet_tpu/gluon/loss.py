@@ -3,7 +3,6 @@
 Every loss is a HybridBlock so it fuses into the jitted training step."""
 from __future__ import annotations
 
-import numpy as np
 
 from ..base import MXNetError
 from .block import HybridBlock

@@ -10,7 +10,6 @@ XLA ops on the MXU.
 """
 from __future__ import annotations
 
-import math
 
 from ...base import MXNetError
 from .. import nn

@@ -14,7 +14,6 @@ import math
 
 import numpy as np
 
-from ...base import MXNetError
 from .. import nn
 from ..block import HybridBlock
 from .bert import MultiHeadAttention, PositionwiseFFN

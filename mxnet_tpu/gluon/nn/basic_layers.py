@@ -195,13 +195,27 @@ class BatchNorm(HybridBlock):
             fix_gamma=not self._scale,
             use_global_stats=self._use_global_stats)
         if autograd.is_training() and not self._use_global_stats:
+            import jax.numpy as jnp
             m = self._momentum
+            # cold-start: stats exactly at init (mean 0, var 1) adopt the
+            # first batch's statistics outright instead of momentum-mixing
+            # with the arbitrary init — so the op's running-mean moment
+            # shift (ops/nn.py _batch_norm) is near the true mean from
+            # step 2 on even for |mean|>>std inputs (torch's
+            # num_batches_tracked warmup has the same effect). Tiny,
+            # per-channel-vector-only compute; data-dependent via where
+            # so it traces into jitted steps.
+            cold = jnp.logical_and(jnp.all(running_mean._data == 0),
+                                   jnp.all(running_var._data == 1))
+            new_mean = jnp.where(
+                cold, mean._data,
+                running_mean._data * m + mean._data * (1 - m))
+            new_var = jnp.where(
+                cold, var._data,
+                running_var._data * m + var._data * (1 - m))
             running_mean._rebind(
-                (running_mean._data * m + mean._data * (1 - m))
-                .astype(running_mean._data.dtype))
-            running_var._rebind(
-                (running_var._data * m + var._data * (1 - m))
-                .astype(running_var._data.dtype))
+                new_mean.astype(running_mean._data.dtype))
+            running_var._rebind(new_var.astype(running_var._data.dtype))
         return out
 
     def __repr__(self):

@@ -9,6 +9,8 @@ eager/compatibility path and is exactly what the reference's API promises.
 """
 from __future__ import annotations
 
+import numpy as np
+
 from .. import kvstore as kvs
 from .. import optimizer as opt
 from ..base import MXNetError
@@ -122,7 +124,18 @@ class Trainer:
             # (ref: amp.py DynamicLossScaler + the trainer patch
             # amp.init_trainer installs). The scale change only affects
             # the NEXT scale_loss; this step's grads carry the old scale.
+            # Multi-host: the decision must be GLOBAL — an early return on
+            # one host while peers enter the allreduce would hang the
+            # collective (and diverge loss scales), so OR the flag across
+            # processes first.
             overflow = scaler.has_overflow(self._params)
+            import jax
+            if jax.process_count() > 1:
+                import jax.numpy as jnp
+                from jax.experimental import multihost_utils
+                flags = multihost_utils.process_allgather(
+                    jnp.asarray([overflow]))
+                overflow = bool(np.asarray(flags).any())
             if overflow:
                 scaler.update_scale(True)
                 return

@@ -5,7 +5,6 @@ import numpy as np
 
 from .. import ndarray as nd
 from ..base import MXNetError
-from ..context import Context
 
 __all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
            "download"]

@@ -142,12 +142,6 @@ class KVStore:
                         f"kvstore.push key {k}: mixed dense and "
                         f"row_sparse values in one push are not "
                         f"supported — convert with tostype()")
-                if self.num_workers > 1:
-                    raise MXNetError(
-                        "row_sparse push on a multi-host kvstore is not "
-                        "supported: the cross-host (DCN) reduce only "
-                        "covers dense values — push dense gradients "
-                        "(tostype('default')) for distributed training")
                 # row-sparse push: aggregate the devices' touched rows
                 # (ref: kvstore_dist.h row_sparse push path)
                 import numpy as np
@@ -157,6 +151,12 @@ class KVStore:
                     [np.asarray(v.data) for v in vlist])
                 rs = dedupe_rows(_RowSparseCT(rows, data,
                                               vlist[0].shape))
+                if self.num_workers > 1:
+                    # cross-host sparse reduce (ref: kvstore_dist.h sparse
+                    # push/pull over ps-lite): allgather the touched rows
+                    # + values over DCN, then segment-sum duplicates —
+                    # only touched rows ride the wire, not the table
+                    rs = self._allgather_row_sparse(rs)
                 if self._updater is not None:
                     self._updater(k, rs, self._store[k])
                 else:
@@ -264,6 +264,37 @@ class KVStore:
         # over the global device mesh (SURVEY §5.8 TPU-native equivalent)
         from .parallel import allreduce_across_processes
         return allreduce_across_processes(arr)
+
+    def _allgather_row_sparse(self, rs):
+        """Sparse DCN reduce: every process contributes its (rows, vals),
+        padded to the max row count so the allgather is same-shape, then
+        the union is dedupe-summed. The dense table never crosses DCN —
+        the point of the reference's sparse PS push (kvstore_dist.h)."""
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        from .ndarray.sparse import _RowSparseCT, dedupe_rows
+        rows = np.asarray(rs.indices, dtype=np.int64)
+        vals = np.asarray(rs.data)
+        counts = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray([rows.shape[0]], dtype=jnp.int32)))
+        m = int(counts.max())
+        if m == 0:
+            return rs
+        rows_p = np.full((m,), -1, np.int64)
+        rows_p[:rows.shape[0]] = rows
+        vals_p = np.zeros((m,) + vals.shape[1:], vals.dtype)
+        vals_p[:rows.shape[0]] = vals
+        all_rows = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray(rows_p)))
+        all_vals = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray(vals_p)))
+        flat_rows = all_rows.reshape(-1)
+        keep = flat_rows >= 0
+        return dedupe_rows(_RowSparseCT(
+            flat_rows[keep],
+            all_vals.reshape((-1,) + vals.shape[1:])[keep], rs.shape))
 
     def barrier(self):
         """ref: KVStore::Barrier (ps-lite barrier)."""

@@ -5,7 +5,6 @@ from __future__ import annotations
 import logging
 import time
 
-from .. import io as mxio
 from .. import metric as metric_mod
 from ..base import MXNetError
 

@@ -15,7 +15,7 @@ import logging
 from .. import initializer as init_mod
 from .. import optimizer as opt_mod
 from ..base import MXNetError
-from ..context import Context, current_context
+from ..context import current_context
 from .base_module import BaseModule
 
 __all__ = ["Module"]

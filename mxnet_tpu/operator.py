@@ -84,7 +84,6 @@ def get(reg_name):
 def _custom_impl(op_type, datas, kwargs):
     """Build the pure_callback + custom_vjp computation for one call."""
     import jax
-    import jax.numpy as jnp
 
     prop = get(op_type)(**kwargs)
     in_shapes = [tuple(d.shape) for d in datas]

@@ -1057,16 +1057,14 @@ def _fused_self_attention(qkv, heads=None, causal=False, block_size=512):
                / se32).astype(q.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", att, v)
         return out.reshape(b, s, c)
-    # long-sequence streaming path wants [B, H, S, D]; clamp the block to
-    # a divisor of s here (shapes are concrete at trace time) so callers
-    # stay shape-free — required for symbolic export of attention blocks
-    blk = min(block_size, s)
-    while s % blk:
-        blk -= 1
+    # long-sequence streaming path wants [B, H, S, D]; the downstream
+    # kernels clamp block_size to a divisor of S themselves
+    # (blockwise_attention), so callers stay shape-free — required for
+    # symbolic export of attention blocks
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
-    out = _flash_attention(qh, kh, vh, block_size=blk,
+    out = _flash_attention(qh, kh, vh, block_size=block_size,
                            causal=causal)
     return out.transpose(0, 2, 1, 3).reshape(b, s, c)
 
@@ -1096,10 +1094,7 @@ def _fused_cross_attention(q_in, kv, heads=None, block_size=512):
                / se32).astype(q.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", att, v)
         return out.reshape(b, sq, c)
-    blk = min(block_size, sk)
-    while sk % blk:
-        blk -= 1
     out = _flash_attention(q.transpose(0, 2, 1, 3),
                            k.transpose(0, 2, 1, 3),
-                           v.transpose(0, 2, 1, 3), block_size=blk)
+                           v.transpose(0, 2, 1, 3), block_size=block_size)
     return out.transpose(0, 2, 1, 3).reshape(b, sq, c)

@@ -311,27 +311,29 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
         # instead of jnp.var's mean-then-centered-moments passes — this
         # keeps the op HBM-minimal under bf16 AMP, where the step is
         # bandwidth-bound (see docs/perf_notes.md). The raw E[x^2]-E[x]^2
-        # form cancels catastrophically when |mean| >> std, so both moments
-        # are taken about a shift c that is always near the batch mean: the
-        # per-channel mean of up to 4 EVENLY SPACED slices along the leading
-        # reduced axis (~4/N of a full pass). Because c is an average of
-        # actual batch samples, (mean-c)² ≤ N·var (inter-sample deviations
-        # are part of the batch variance), so the one-pass subtraction
-        # loses at most ~log2(N) bits — bounded at every step including
-        # cold start, and robust to one unrepresentative sample (the
-        # round-2 advisor measured std 158 instead of 1 at mean=1e4 when
-        # the shift was the zero-initialized running mean).
-        red0 = axes[0]
-        n0 = x.shape[red0]
-        take = jnp.arange(min(4, n0)) * max(1, n0 // min(4, n0))
-        c = lax.stop_gradient(jnp.mean(
-            jnp.take(x, take, axis=red0).astype(jnp.float32), axis=axes))
+        # form cancels catastrophically when |mean| >> std, so moments are
+        # shifted by the running mean — the only shift that is FREE: any
+        # same-pass data-derived shift (measured round 3: even one element
+        # per channel) breaks XLA's reduce+normalize fusion and costs
+        # 11-25% of RN50 throughput, and a lax.cond exact-recompute branch
+        # fails to compile inside the differentiated scanned step. Safety
+        # instead comes from two sides: (a) the gluon layer adopts the
+        # first batch's stats outright at cold start (basic_layers.py), so
+        # the shift is within O(std) of the true mean from step 2 on; (b)
+        # in-op, channels where cancellation provably destroyed var
+        # ((mean-c)² > 4095·var ⇒ >12 bits lost) fall back to e2 = the
+        # second moment about c — a bounded, already-computed normalizer
+        # (output std ≤ 1) instead of rsqrt(garbage) (the round-2 advisor
+        # measured output std 158 at mean=1e4 on zero-init stats).
+        c = lax.stop_gradient(moving_mean.astype(jnp.float32))
         cb = c.reshape(bshape)
         xc = x.astype(jnp.float32) - cb
         mean_c = jnp.mean(xc, axis=axes)
-        var = jnp.maximum(
-            jnp.mean(jnp.square(xc), axis=axes) - jnp.square(mean_c), 0.0)
+        e2 = jnp.mean(jnp.square(xc), axis=axes)
+        var_raw = jnp.maximum(e2 - jnp.square(mean_c), 0.0)
         mean = mean_c + c
+        suspicious = e2 > 4096.0 * jnp.maximum(var_raw, 1e-30)
+        var = jnp.where(suspicious, e2, var_raw)
     else:
         mean = moving_mean.astype(jnp.float32)
         var = moving_var.astype(jnp.float32)

@@ -13,7 +13,6 @@ All ops mirror the reference's semantics: `rescale_grad`, `clip_gradient`,
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax
 
 from .registry import OpParam, register
 

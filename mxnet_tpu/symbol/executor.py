@@ -9,7 +9,6 @@ backward graph the reference builds with nnvm::pass::Gradient.
 from __future__ import annotations
 
 import jax
-import numpy as np
 
 from .. import _rng
 from ..base import MXNetError

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import json
 import threading
-from collections import OrderedDict
 
 import jax
 import numpy as np

@@ -1,7 +1,5 @@
 """Autograd semantics (ref test: tests/python/unittest/test_autograd.py)."""
-import numpy as np
 
-import mxnet_tpu as mx
 from mxnet_tpu import autograd, nd
 from mxnet_tpu.test_utils import assert_almost_equal
 

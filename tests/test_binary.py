@@ -2,7 +2,6 @@
 layers, and that a binary MLP actually trains (the BMXNet paper's core
 claim, shrunk)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon

@@ -1,0 +1,141 @@
+"""Convergence-threshold gates (ref: tests/python/train/test_mlp.py,
+test_conv.py — the reference's trainer tier asserts FINAL ACCURACY above a
+threshold, SURVEY §4). Round-2 verdict #6: a wrong-but-running model must
+FAIL the suite — these tests gate on the number, not on "training ran".
+
+Data is the same learnable synthetic MNIST stand-in the examples use
+(class-keyed quadrant brightening): separable enough that a correct
+optimizer/loss/model reaches ≥97% train accuracy in a few epochs on CPU,
+and any sign/scaling regression in the loss, gradients, or updates
+lands far below the gate.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def synthetic_mnist(n=1024, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.25
+    y = rng.randint(0, 10, n).astype(np.float32)
+    # learnable structure: class c brightens a distinct 7x7 tile
+    for i in range(n):
+        c = int(y[i])
+        r, col = divmod(c, 4)
+        x[i, 0, r * 7:(r + 1) * 7, col * 7:(col + 1) * 7] += 0.75
+    return x, y
+
+
+def _train_accuracy(net, x, y, epochs, batch_size=128, lr=0.05,
+                    optimizer="sgd", hybridize=True):
+    mx.random.seed(0)
+    net.initialize(mx.init.Xavier())
+    if hybridize:
+        net.hybridize()
+    params = {"learning_rate": lr}
+    if optimizer == "sgd":
+        params["momentum"] = 0.9
+    trainer = gluon.Trainer(net.collect_params(), optimizer, params)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    n = x.shape[0]
+    for _ in range(epochs):
+        for i in range(0, n - batch_size + 1, batch_size):
+            xb = nd.array(x[i:i + batch_size])
+            yb = nd.array(y[i:i + batch_size])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(batch_size)
+    correct = 0
+    for i in range(0, n, 256):
+        out = net(nd.array(x[i:i + 256])).asnumpy()
+        correct += (out.argmax(1) == y[i:i + 256]).sum()
+    return correct / n
+
+
+def test_mlp_converges_to_97pct():
+    """ref: tests/python/train/test_mlp.py — MLP accuracy gate."""
+    x, y = synthetic_mnist()
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Flatten(),
+            gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    acc = _train_accuracy(net, x, y, epochs=6, lr=0.1)
+    assert acc >= 0.97, f"MLP train accuracy {acc:.3f} below the 0.97 gate"
+
+
+def test_lenet_converges_to_97pct():
+    """ref: tests/python/train/test_conv.py — LeNet accuracy gate
+    (driver config #1's correctness criterion, BASELINE.md)."""
+    x, y = synthetic_mnist()
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 5, activation="relu"),
+            gluon.nn.MaxPool2D(2, 2),
+            gluon.nn.Conv2D(32, 5, activation="relu"),
+            gluon.nn.MaxPool2D(2, 2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(10))
+    acc = _train_accuracy(net, x, y, epochs=4, lr=0.05)
+    assert acc >= 0.97, \
+        f"LeNet train accuracy {acc:.3f} below the 0.97 gate"
+
+
+def test_module_fit_converges():
+    """The symbolic Module.fit path reaches the same gate (both worlds
+    must train correctly, not just run — ref: Module.fit score())."""
+    from mxnet_tpu import io, sym
+    x, y = synthetic_mnist(n=512)
+    data = sym.var("data")
+    f = sym.Flatten(data)
+    fc1 = sym.Activation(sym.FullyConnected(f, num_hidden=64),
+                         act_type="relu")
+    fc2 = sym.FullyConnected(fc1, num_hidden=10)
+    net = sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    it = io.NDArrayIter(x, y, batch_size=128, shuffle=True)
+    mx.random.seed(0)
+    mod.fit(it, num_epoch=8,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc")
+    it_eval = io.NDArrayIter(x, y, batch_size=128)
+    score = dict(mod.score(it_eval, ["acc"]))
+    assert score["accuracy"] >= 0.95, \
+        f"Module.fit accuracy {score['accuracy']:.3f} below the 0.95 gate"
+
+
+def test_wrong_loss_fails_the_gate():
+    """Meta-test: the gate actually catches a broken training setup — a
+    sign-flipped loss (ascending gradient) must land far below 0.97."""
+    x, y = synthetic_mnist(n=512)
+
+    class NegCE(gluon.loss.Loss):
+        def __init__(self):
+            super().__init__(None, 0)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, pred, label):
+            return -self._ce(pred, label)
+
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Flatten(), gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = NegCE()
+    for i in range(0, 512 - 127, 128):
+        xb, yb = nd.array(x[i:i + 128]), nd.array(y[i:i + 128])
+        with autograd.record():
+            loss = loss_fn(net(xb), yb)
+        loss.backward()
+        trainer.step(128)
+    out = net(nd.array(x)).asnumpy()
+    acc = (out.argmax(1) == y).mean()
+    assert acc < 0.97, "sign-flipped loss should not pass the gate"

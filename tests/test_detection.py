@@ -1,7 +1,6 @@
 """Detection path tests: MultiBox ops + SSD model (driver config #5;
 ref: tests/python/unittest/test_contrib_operator.py multibox tests)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import gluon

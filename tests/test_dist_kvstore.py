@@ -6,7 +6,6 @@ import os
 import subprocess
 import sys
 
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -30,6 +29,29 @@ out = mx.nd.zeros((4,))
 kv.pull(3, out=out)
 expect = np.full(4, 3.0)                 # 1 + 2 summed across workers
 np.testing.assert_allclose(out.asnumpy(), expect)
+
+# row_sparse push over DCN (round-2 verdict #8): workers touch
+# overlapping row sets; the sparse allgather-reduce must sum overlaps
+# and union the rest, without shipping the dense table
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+shape = (6, 3)
+kv.init("emb", mx.nd.zeros(shape))
+if rank == 0:
+    rows = np.array([0, 2], np.int64)         # worker 0 touches rows 0,2
+else:
+    rows = np.array([2, 5], np.int64)         # worker 1 touches rows 2,5
+vals = np.full((2, 3), float(rank + 1), np.float32)
+kv.push("emb", RowSparseNDArray(vals, rows, shape))
+dense = mx.nd.zeros(shape)
+kv.pull("emb", out=dense)
+want = np.zeros(shape, np.float32)
+want[0] = 1.0
+want[2] = 3.0                                  # overlap: 1 + 2
+want[5] = 2.0
+np.testing.assert_allclose(dense.asnumpy(), want)
+picked = kv.row_sparse_pull("emb", row_ids=mx.nd.array([2, 5]))
+np.testing.assert_allclose(np.asarray(picked.data),
+                           want[[2, 5]])
 print(f"rank {rank} OK")
 """
 

@@ -1,12 +1,21 @@
 """Examples must keep running end-to-end (the reference's example/ scripts
-are exercised by CI the same way — SURVEY §2.7 runtime_functions.sh)."""
+are exercised by CI the same way — SURVEY §2.7 runtime_functions.sh), and
+the training ones must hit NUMERIC floors — round-2 verdict #6: parsing
+the printed accuracy, not just the string, so a wrong-but-running model
+fails."""
 import os
+import re
 import subprocess
 import sys
 
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parse_metric(out, pattern):
+    m = re.search(pattern, out)
+    assert m, f"metric {pattern!r} not printed:\n{out}"
+    return float(m.group(1))
 
 
 def _run(script, *args, timeout=280):
@@ -23,16 +32,24 @@ def _run(script, *args, timeout=280):
 
 def test_train_mnist_gluon(tmp_path):
     # explicit empty data dir pins the synthetic fallback (hermetic: never
-    # trains on a host's real MNIST download)
-    out = _run("train_mnist.py", "--epochs", "1", "--batch-size", "256",
+    # trains on a host's real MNIST download); the printed accuracy is
+    # parsed and gated — 3 epochs on the separable synthetic set must
+    # clear 0.9 (a broken loss/optimizer lands near 0.1)
+    out = _run("train_mnist.py", "--epochs", "3", "--batch-size", "256",
                "--data-dir", str(tmp_path))
-    assert "final accuracy" in out
+    acc = _parse_metric(out, r"final accuracy:\s*([0-9.]+)")
+    assert acc >= 0.9, f"MNIST example accuracy {acc} below 0.9 floor"
 
 
-def test_train_nmt_smoke():
-    out = _run("train_nmt.py", "--steps", "3", "--units", "32",
-               "--batch-size", "4", "--num-layers", "1")
-    assert "greedy-decode token accuracy" in out
+def test_train_nmt_token_accuracy_floor():
+    # reversal-task NMT: vocab 16 / seq 6 reaches ~1.0 greedy-decode
+    # token accuracy in 300 steps (calibrated; chance is ~0.08) — the
+    # 0.6 floor fails any wrong loss/teacher-forcing/decode regression
+    out = _run("train_nmt.py", "--steps", "300", "--units", "32",
+               "--batch-size", "32", "--num-layers", "1",
+               "--vocab", "16", "--seq-len", "6")
+    acc = _parse_metric(out, r"greedy-decode token accuracy:\s*([0-9.]+)")
+    assert acc >= 0.6, f"NMT token accuracy {acc} below 0.6 floor"
 
 
 def test_train_ssd_smoke():

@@ -2,7 +2,6 @@
 SURVEY §3.5): gluon model → -symbol.json + .params → SymbolBlock → same
 outputs."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import gluon

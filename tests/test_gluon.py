@@ -97,11 +97,19 @@ def test_batchnorm_train_vs_eval():
     o = out.asnumpy()
     assert abs(o.mean()) < 1e-2
     assert abs(o.std() - 1) < 1e-1
-    # running stats moved toward batch stats
+    # running stats moved toward batch stats (cold start ADOPTS the
+    # first batch's stats outright — see gluon BatchNorm cold-start note)
     assert not np.allclose(bn.running_mean.data().asnumpy(), 0)
-    # eval mode uses running stats (different result)
-    out_eval = bn(x).asnumpy()
-    assert not np.allclose(o, out_eval)
+    np.testing.assert_allclose(bn.running_mean.data().asnumpy(),
+                               x.asnumpy().mean(axis=(0, 2, 3)),
+                               rtol=1e-5)
+    # second step momentum-mixes; eval then uses blended running stats,
+    # which differ from any single batch's normalization
+    x2 = nd.array(np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 - 3)
+    with autograd.record():
+        o2 = bn(x2).asnumpy()
+    out_eval = bn(x2).asnumpy()
+    assert not np.allclose(o2, out_eval)
 
 
 def test_dropout_train_eval():

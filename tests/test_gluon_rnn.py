@@ -1,10 +1,9 @@
 """gluon.rnn tests (ref: tests/python/unittest/test_gluon_rnn.py):
 cell/layer shapes, fused-vs-cell consistency, bidirectional, autograd."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import autograd, gluon
+from mxnet_tpu import autograd
 from mxnet_tpu.gluon import rnn
 
 

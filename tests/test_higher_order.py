@@ -2,7 +2,6 @@
 — the reference supports partial 2nd order; here create_graph replays
 pullbacks under recording so grad-of-grad sees full primal dependence)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd

@@ -1,15 +1,13 @@
 """Data & I/O tests (ref: tests/python/unittest/test_io.py,
 test_recordio.py, test_gluon_data.py)."""
-import os
 import struct
 
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import gluon, io, recordio
+from mxnet_tpu import io, recordio
 from mxnet_tpu.gluon.data import ArrayDataset, BatchSampler, DataLoader, \
-    RandomSampler, SequentialSampler, SimpleDataset
+    SequentialSampler, SimpleDataset
 from mxnet_tpu.gluon.data.vision import transforms
 
 

@@ -1,7 +1,6 @@
 """Misc parity: AttrScope, NameManager/Prefix, gradient compression,
 BucketingModule+RNN bucketing end-to-end (Sockeye path, SURVEY §3.3)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import io, sym

@@ -3,7 +3,6 @@ dmlc-core recordio tests — here driven from Python via ctypes)."""
 import struct
 import threading
 
-import numpy as np
 import pytest
 
 from mxnet_tpu import _native, recordio

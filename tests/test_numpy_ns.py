@@ -2,7 +2,6 @@
 test_numpy_op.py / test_numpy_ndarray.py, shrunk to the semantics that
 matter: numpy-identical results + autograd through the np namespace)."""
 import numpy as onp
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd

@@ -126,6 +126,102 @@ def test_quantize_model_symbol_level_conv():
     assert not any(k.endswith("conv0_weight_quantized") for k in qarg2)
 
 
+def _resnet_block_net(classes=8):
+    """Two residual blocks (conv-BN-relu ×2 + identity add), the int8
+    subgraph-depth shape (ref: mkldnn int8 fused residual subgraphs)."""
+
+    class Residual(gluon.HybridBlock):
+        def __init__(self, ch, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.c1 = gluon.nn.Conv2D(ch, 3, padding=1, use_bias=False)
+                self.b1 = gluon.nn.BatchNorm()
+                self.c2 = gluon.nn.Conv2D(ch, 3, padding=1, use_bias=False)
+                self.b2 = gluon.nn.BatchNorm()
+
+        def hybrid_forward(self, F, x):
+            y = F.Activation(self.b1(self.c1(x)), act_type="relu")
+            y = self.b2(self.c2(y))
+            return F.Activation(x + y, act_type="relu")
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, use_bias=False),
+            gluon.nn.BatchNorm(), gluon.nn.Activation("relu"),
+            Residual(16), Residual(16),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+            gluon.nn.Dense(classes))
+    return net
+
+
+def test_int8_chains_stay_int8_through_residual_blocks():
+    """Round-2 verdict #9: <=1 quantize/dequantize pair per residual
+    block — BN folds into convs and pool/relu/add run on int8, so the
+    chain never round-trips to fp32 between layers."""
+    net = _resnet_block_net()
+    net.initialize()
+    x = np.random.randn(2, 3, 16, 16).astype(np.float32)
+    # warm BN stats so folding has non-degenerate running statistics
+    for _ in range(3):
+        with autograd.record():
+            net(nd.array(np.random.randn(8, 3, 16, 16)
+                         .astype(np.float32)))
+    net.hybridize()
+    want = net(nd.array(x)).asnumpy()
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        net.export(f"{td}/n")
+        from mxnet_tpu.model import load_checkpoint
+        sym, arg_params, aux_params = load_checkpoint(f"{td}/n", 0)
+    qsym, qarg, qaux = q.quantize_model(
+        sym, arg_params, aux_params, data_names=["data"],
+        calib_mode="naive", calib_data=[x])
+    ops = [n.op for n in qsym._topo() if n.op]
+    n_quant = sum(o == "_contrib_quantize_v2" for o in ops)
+    n_dequant = sum(o == "_contrib_dequantize" for o in ops)
+    n_res_blocks = 2
+    # whole 5-conv trunk: ONE entry quantize; ONE dequantize at the
+    # trunk exit (global pool -> Dense head requantizes internally)
+    assert n_quant <= 1 + n_res_blocks, (n_quant, ops)
+    assert n_dequant <= 1 + n_res_blocks, (n_dequant, ops)
+    assert "BatchNorm" not in ops, "BN must fold into the convolutions"
+    assert "_contrib_quantized_elemwise_add" in ops
+    assert "_contrib_quantized_act" in ops
+    # accuracy parity on the quantized graph
+    data = [n for n in qsym.list_arguments() if n not in qarg][0]
+    ex = qsym.bind(mx.cpu(), dict({data: nd.array(x)}, **qarg),
+                   aux_states=qaux)
+    got = ex.forward()[0].asnumpy()
+    rel = np.abs(got - want).max() / max(np.abs(want).max(), 1e-6)
+    assert rel < 0.15, f"int8 chain output off by {rel:.3f}"
+
+
+def test_fold_batchnorm_exact():
+    """BN folding alone (no quantization) must be numerically exact."""
+    net = _resnet_block_net()
+    net.initialize()
+    for _ in range(3):
+        with autograd.record():
+            net(nd.array(np.random.randn(8, 3, 16, 16)
+                         .astype(np.float32)))
+    net.hybridize()
+    x = np.random.randn(2, 3, 16, 16).astype(np.float32)
+    want = net(nd.array(x)).asnumpy()
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        net.export(f"{td}/n")
+        from mxnet_tpu.model import load_checkpoint
+        sym, arg_params, aux_params = load_checkpoint(f"{td}/n", 0)
+    fsym, fargs, faux = q.fold_batchnorm(sym, arg_params, aux_params)
+    assert not any(n.op == "BatchNorm" for n in fsym._topo())
+    data = [n for n in fsym.list_arguments() if n not in fargs][0]
+    ex = fsym.bind(mx.cpu(),
+                   dict({data: nd.array(x)},
+                        **{k: nd.array(v) for k, v in fargs.items()}),
+                   aux_states={k: nd.array(v) for k, v in faux.items()})
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
 def test_quantize_model_rejects_other_dtypes():
     import tempfile
     net, X, _ = _train_mlp()

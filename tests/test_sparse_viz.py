@@ -1,7 +1,6 @@
 """Sparse storage + visualization tests (ref: tests/python/unittest/
 test_sparse_ndarray.py shrunk to the supported surface)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import sym

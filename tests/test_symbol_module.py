@@ -1,7 +1,6 @@
 """Symbol & Module tests (ref: tests/python/unittest/test_symbol.py,
 test_module.py, tests/python/train/test_mlp.py)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import io, sym

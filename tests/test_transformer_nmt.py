@@ -1,7 +1,6 @@
 """Transformer NMT tests (driver config #4: Sockeye-style seq2seq —
 a tiny copy task must be learnable)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon
