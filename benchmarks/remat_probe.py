@@ -41,11 +41,11 @@ def measure(config_name, batch, on_tpu, **trainer_kw):
     x_host = np.random.randn(batch, 3, 224 if on_tpu else 32,
                              224 if on_tpu else 32).astype(np.float32)
     y_host = np.random.randint(0, 1000, (batch,))
-    # stage the batch on device ONCE (like bench.py): re-uploading per
-    # dispatch would gate the measurement on the ~6 MB/s tunnel link
+    # stage the batch on device ONCE: re-uploading per dispatch would
+    # gate the measurement on the ~6 MB/s tunnel link
     trainer._prepare((x_host,))
-    x = trainer._shard(x_host, trainer._batch_spec(4))
-    y = trainer._shard(y_host, trainer._batch_spec(1))
+    x = trainer._shard_batch_arg(x_host)
+    y = trainer._shard_batch_arg(y_host)
 
     # bench.py's methodology: N back-to-back ASYNC dispatches of a k-step
     # scanned program, ONE hard sync at the end (dispatch latency overlaps

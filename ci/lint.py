@@ -86,8 +86,7 @@ def lint_file(path):
 
     lines = src.splitlines()
     for i, line in enumerate(lines, 1):
-        if line.rstrip() != line.rstrip("\n").rstrip() or \
-                line != line.rstrip():
+        if line != line.rstrip():
             findings.append((path, i, "W5", "trailing whitespace"))
         if line.startswith("\t") or (line[:1] == " " and "\t" in
                                      line[:len(line) - len(line.lstrip())]):
@@ -136,7 +135,9 @@ def lint_file(path):
                        for v in node.values):
                 findings.append((path, node.lineno, "W4",
                                  "f-string without placeholders"))
-    return findings
+    # `# noqa` suppression, checked here while the lines are in memory
+    return [f for f in findings
+            if not (1 <= f[1] <= len(lines) and "# noqa" in lines[f[1] - 1])]
 
 
 def main():
@@ -148,18 +149,6 @@ def main():
     for path in iter_py(paths):
         n_files += 1
         all_findings.extend(lint_file(path))
-    # standard `# noqa` suppression on the flagged line
-    def _suppressed(path, line):
-        try:
-            with open(path, encoding="utf-8") as f:
-                src_lines = f.read().splitlines()
-            return line >= 1 and line <= len(src_lines) and \
-                "# noqa" in src_lines[line - 1]
-        except OSError:
-            return False
-
-    all_findings = [f for f in all_findings
-                    if not _suppressed(f[0], f[1])]
     for path, line, code, msg in all_findings:
         print(f"{path}:{line}: {code} {msg}")
     print(f"lint: {n_files} files, {len(all_findings)} findings")

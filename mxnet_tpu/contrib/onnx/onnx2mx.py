@@ -134,7 +134,9 @@ def _matmul(node, ins, attrs, ctx):
             ins[0], ctx.sym(wname),
             num_hidden=int(ctx.params[wname].shape[0]), no_bias=True,
             flatten=False, name=node.get("name") or None)
-    return sym.dot(*ins, name=node.get("name") or None)
+    # general case: ONNX MatMul is numpy-matmul (batched over leading
+    # dims) — batch_dot here is jnp.matmul, the exact semantics
+    return sym.batch_dot(*ins, name=node.get("name") or None)
 
 
 @onnx2mx("BatchNormalization")
@@ -290,6 +292,120 @@ def _dropout(node, ins, attrs, ctx):
 @onnx2mx("Identity")
 def _identity(node, ins, attrs, ctx):
     return ins[0]
+
+
+@onnx2mx("Cast")
+def _cast(node, ins, attrs, ctx):
+    _DT = {1: "float32", 6: "int32", 7: "int64", 9: "bool",
+           10: "float16", 11: "float64", 16: "bfloat16"}
+    to = _DT.get(int(attrs.get("to", 1)))
+    if to is None:
+        raise MXNetError(f"ONNX import: Cast to {attrs.get('to')} "
+                         f"unsupported")
+    return _sym_mod().cast(ins[0], dtype=to,
+                           name=node.get("name") or None)
+
+
+@onnx2mx("Gather")
+def _gather(node, ins, attrs, ctx):
+    return _sym_mod().take(ins[0], ins[1],
+                           axis=int(attrs.get("axis", 0)),
+                           name=node.get("name") or None)
+
+
+@onnx2mx("LayerNormalization")
+def _layer_normalization(node, ins, attrs, ctx):
+    return _sym_mod().LayerNorm(
+        ins[0], ins[1], ins[2], axis=int(attrs.get("axis", -1)),
+        eps=float(attrs.get("epsilon", 1e-5)),
+        name=node.get("name") or None)
+
+
+def _axes_arg(node, ins, attrs, ctx, input_idx):
+    """opset-13 moved Unsqueeze/Squeeze axes from attr to input."""
+    if len(node["inputs"]) > input_idx and node["inputs"][input_idx]:
+        return [int(a) for a in
+                np.asarray(ctx.const_value(
+                    node["inputs"][input_idx])).ravel()]
+    a = attrs.get("axes")
+    return None if a is None else [int(v) for v in a]
+
+
+@onnx2mx("Unsqueeze")
+def _unsqueeze(node, ins, attrs, ctx):
+    axes = _axes_arg(node, ins, attrs, ctx, 1)
+    s = ins[0]
+    for ax in sorted(axes):
+        s = _sym_mod().expand_dims(s, axis=ax)
+    return s
+
+
+@onnx2mx("Squeeze")
+def _squeeze(node, ins, attrs, ctx):
+    axes = _axes_arg(node, ins, attrs, ctx, 1)
+    return _sym_mod().squeeze(
+        ins[0], axis=tuple(axes) if axes is not None else None,
+        name=node.get("name") or None)
+
+
+@onnx2mx("Slice")
+def _slice(node, ins, attrs, ctx):
+    names = node["inputs"]
+    if len(names) >= 3:           # opset-10+: starts/ends[/axes] inputs
+        starts = [int(v) for v in
+                  np.asarray(ctx.const_value(names[1])).ravel()]
+        ends = [int(v) for v in
+                np.asarray(ctx.const_value(names[2])).ravel()]
+        axes = ([int(v) for v in
+                 np.asarray(ctx.const_value(names[3])).ravel()]
+                if len(names) > 3 and names[3]
+                else list(range(len(starts))))
+        if len(names) > 4 and names[4]:
+            steps = [int(v) for v in
+                     np.asarray(ctx.const_value(names[4])).ravel()]
+            if any(s != 1 for s in steps):
+                # strided slice: representable when axes are the leading
+                # dims in order (the form our exporter emits)
+                if list(axes) != list(range(len(axes))):
+                    raise MXNetError("ONNX import: strided Slice over "
+                                     "non-leading axes unsupported")
+                big = np.iinfo(np.int64).max
+                return _sym_mod().slice(
+                    ins[0], begin=tuple(starts),
+                    end=tuple(None if e >= big // 2 else e for e in ends),
+                    step=tuple(steps), name=node.get("name") or None)
+    else:                          # opset-1 attrs form
+        starts = [int(v) for v in attrs.get("starts", [])]
+        ends = [int(v) for v in attrs.get("ends", [])]
+        axes = [int(v) for v in
+                attrs.get("axes", range(len(starts)))]
+    big = np.iinfo(np.int64).max
+    s = ins[0]
+    for ax, b, e in zip(axes, starts, ends):
+        s = _sym_mod().slice_axis(s, axis=ax, begin=b,
+                                  end=None if e >= big // 2 else e)
+    return s
+
+
+@onnx2mx("Split")
+def _split(node, ins, attrs, ctx):
+    names = node["inputs"]
+    axis = int(attrs.get("axis", 0))
+    if len(names) > 1 and names[1]:
+        sizes = [int(v) for v in
+                 np.asarray(ctx.const_value(names[1])).ravel()]
+    elif attrs.get("split"):
+        sizes = [int(v) for v in attrs["split"]]
+    else:
+        raise MXNetError("ONNX import: Split without sizes needs the "
+                         "output count — unsupported")
+    outs = []
+    off = 0
+    for sz in sizes:
+        outs.append(_sym_mod().slice_axis(ins[0], axis=axis, begin=off,
+                                          end=off + sz))
+        off += sz
+    return outs
 
 
 @onnx2mx("Constant")

@@ -183,7 +183,6 @@ def fold_batchnorm(sym, arg_params, aux_params, eps_default=1e-3):
             return Symbol(s._node, s._index)
         return new_of[id(s._node)][s._index]
 
-    dropped_params = set()
     for node in topo:
         if node.op is None or node.op == "_group":
             continue
@@ -226,7 +225,6 @@ def fold_batchnorm(sym, arg_params, aux_params, eps_default=1e-3):
             folded_b = wname + "_bnfold_bias"   # collision-proof vs folded_w
             arg_np[folded_w] = w_new.astype(w.dtype)
             arg_np[folded_b] = b_new.astype(np.float32)
-            dropped_params.update([g_name, b_name, m_name, v_name])
             from ..symbol.symbol import var as _var
             plain = {k: v for k, v in src.attrs.items()
                      if not k.startswith("__")}
@@ -327,17 +325,41 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                                     []).append(node)
     _ADD_OPS = ("elemwise_add", "_plus", "broadcast_add")
 
+    def _int8_capable_producer(n2):
+        """One-level check: will node n2 plausibly produce int8?"""
+        return ((n2.op in _QUANTIZABLE and not _is_excluded(n2.name))
+                or n2.op in _INT8_STRUCTURAL
+                or (n2.op == "Pooling"
+                    and n2.attrs.get("pool_type", "max") in ("max", "avg"))
+                or n2.op == "relu"
+                or (n2.op == "Activation"
+                    and n2.attrs.get("act_type") == "relu"))
+
     def _keeps_int8(node, out_idx=0):
         """True if at least one consumer of this output consumes int8."""
         for c in consumer_ops.get((id(node), out_idx), ()):
             if c.op in _QUANTIZABLE and not _is_excluded(c.name) \
                     and c.inputs[0]._node is node:
                 return True
-            if c.op in _INT8_STRUCTURAL or c.op == "Pooling" \
-                    or c.op == "Concat" or c.op in _ADD_OPS \
+            if c.op in _INT8_STRUCTURAL \
+                    or (c.op == "Pooling"
+                        and c.attrs.get("pool_type", "max") in
+                        ("max", "avg")) \
                     or c.op == "relu" \
                     or (c.op == "Activation"
                         and c.attrs.get("act_type") == "relu"):
+                return True
+            if c.op in _ADD_OPS and len(c.inputs) == 2:
+                # only worth emitting int8 if the add's OTHER side will
+                # be int8 too — otherwise the add runs fp32 and the
+                # requantize round-trip just loses precision
+                other = c.inputs[1]._node if c.inputs[0]._node is node \
+                    else c.inputs[0]._node
+                if _int8_capable_producer(other):
+                    return True
+            if c.op == "Concat" and all(
+                    _int8_capable_producer(s._node) or s._node is node
+                    for s in c.inputs):
                 return True
         return False
 
@@ -440,7 +462,8 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             continue
         # int8-transparent consumers: stay int8 when the input is int8
         pair0 = mapped_int8(node.inputs[0]) if node.inputs else None
-        if pair0 is not None and node.op == "Pooling":
+        if pair0 is not None and node.op == "Pooling" \
+                and node.attrs.get("pool_type", "max") in ("max", "avg"):
             q, sc = pair0
             out = _create(
                 "_contrib_quantized_pooling", [q, sc],

@@ -210,9 +210,19 @@ class BatchNorm(HybridBlock):
             new_mean = jnp.where(
                 cold, mean._data,
                 running_mean._data * m + mean._data * (1 - m))
+            # the op's var output is its bounded e2 fallback (~mean²,
+            # NOT the batch variance) on channels where the cold-start
+            # shift cancelled — recognizable as mean² >> var. Never let
+            # that poison the running stats (measured: adopting it put
+            # running_var at ~1e8 and broke eval for ~100 steps); those
+            # channels keep their previous running_var until the shift
+            # warms (step 2, since new_mean adopts the exact batch mean).
+            susp = jnp.square(mean._data) > 4096.0 * jnp.maximum(
+                var._data.astype(mean._data.dtype), 1e-30)
             new_var = jnp.where(
-                cold, var._data,
-                running_var._data * m + var._data * (1 - m))
+                susp, running_var._data,
+                jnp.where(cold, var._data,
+                          running_var._data * m + var._data * (1 - m)))
             running_mean._rebind(
                 new_mean.astype(running_mean._data.dtype))
             running_var._rebind(new_var.astype(running_var._data.dtype))

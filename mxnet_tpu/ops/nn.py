@@ -333,14 +333,20 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
         var_raw = jnp.maximum(e2 - jnp.square(mean_c), 0.0)
         mean = mean_c + c
         suspicious = e2 > 4096.0 * jnp.maximum(var_raw, 1e-30)
-        var = jnp.where(suspicious, e2, var_raw)
+        # normalize with the bounded fallback, but REPORT var_raw: the
+        # layer detects the cancelled case as mean² >> reported var and
+        # refuses to put it into the running stats (reporting e2 would
+        # defeat that test — e2 ≈ mean² exactly when suspicious)
+        var_norm = jnp.where(suspicious, e2, var_raw)
+        var = var_raw
     else:
         mean = moving_mean.astype(jnp.float32)
         var = moving_var.astype(jnp.float32)
+        var_norm = var
     # fold (mean, var, gamma, beta) into per-channel scale/offset in fp32,
     # cast once to the compute dtype: the normalize pass over x is then a
     # single fused multiply-add in x's dtype (no fp32 upcast of the tensor)
-    inv = lax.rsqrt(var + eps)
+    inv = lax.rsqrt(var_norm + eps)
     scale = inv * gamma.astype(jnp.float32)
     offset = beta.astype(jnp.float32) - mean * scale
     out = x * scale.astype(x.dtype).reshape(bshape) \

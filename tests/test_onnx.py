@@ -102,6 +102,71 @@ def test_resnet18_roundtrip_and_gluon_import(tmp_path):
     np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
 
 
+def test_bert_onnx_roundtrip(tmp_path):
+    """Transformer-family ONNX coverage (round-3 roadmap): full tiny BERT
+    (embeddings, fused self-attention decomposed to Split/MatMul/Softmax,
+    LayerNormalization, gelu-as-Erf, pooler, MLM head) exports and
+    imports back numerically intact."""
+    from mxnet_tpu.gluon.model_zoo import bert
+    from mxnet_tpu.model import load_checkpoint
+    net = bert.BERTModel(num_layers=2, units=32, hidden_size=64,
+                         num_heads=4, max_length=64, vocab_size=97,
+                         use_pooler=True, use_decoder=True,
+                         use_classifier=False, dropout=0.0)
+    net.initialize(mx.init.Normal(0.1))
+    net.hybridize()
+    toks = np.random.RandomState(0).randint(0, 97, (2, 12)) \
+        .astype(np.float32)
+    want = [o.asnumpy() for o in net(nd.array(toks))]
+    net.export(str(tmp_path / "bert"))
+    sym, args, aux = load_checkpoint(str(tmp_path / "bert"), 0)
+    path = mxonnx.export_model(
+        sym, dict(args, **aux), input_shape=[(2, 12)],
+        onnx_file_path=str(tmp_path / "bert.onnx"))
+    sym2, args2, aux2 = mxonnx.import_model(path)
+    data = [n for n in sym2.list_arguments() if n not in args2][0]
+    ex = sym2.bind(mx.cpu(),
+                   dict({data: nd.array(toks)},
+                        **{k: nd.array(v) for k, v in args2.items()}),
+                   aux_states={k: nd.array(v) for k, v in aux2.items()})
+    got = [o.asnumpy() for o in ex.forward()]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=2e-4, atol=2e-4)
+
+
+def test_nmt_transformer_onnx_roundtrip(tmp_path):
+    """Encoder-decoder NMT transformer through ONNX: two data inputs,
+    causal self-attention (static mask initializer), cross attention,
+    slice_like position tables (static Slice via shape inference)."""
+    from mxnet_tpu.gluon.model_zoo import transformer
+    from mxnet_tpu.model import load_checkpoint
+    net = transformer.TransformerModel(
+        src_vocab=53, tgt_vocab=61, num_layers=2, units=32,
+        hidden_size=64, num_heads=4, max_length=40, dropout=0.0)
+    net.initialize(mx.init.Normal(0.1))
+    net.hybridize()
+    rng = np.random.RandomState(1)
+    feed = {"data0": rng.randint(1, 53, (2, 9)).astype(np.float32),
+            "data1": rng.randint(1, 61, (2, 7)).astype(np.float32)}
+    want = net(nd.array(feed["data0"]), nd.array(feed["data1"])).asnumpy()
+    net.export(str(tmp_path / "nmt"))
+    sym, args, aux = load_checkpoint(str(tmp_path / "nmt"), 0)
+    data_names = [n for n in sym.list_arguments()
+                  if n not in args and n not in aux]
+    path = mxonnx.export_model(
+        sym, dict(args, **aux),
+        input_shape=[feed[n].shape for n in data_names],
+        onnx_file_path=str(tmp_path / "nmt.onnx"))
+    sym2, args2, aux2 = mxonnx.import_model(path)
+    ex = sym2.bind(mx.cpu(),
+                   dict({k: nd.array(v) for k, v in feed.items()},
+                        **{k: nd.array(v) for k, v in args2.items()}),
+                   aux_states={k: nd.array(v) for k, v in aux2.items()})
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
 def test_export_unsupported_op_message(tmp_path):
     s = mx.sym.var("a")
     out = mx.sym.topk(s, k=2)

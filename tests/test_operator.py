@@ -362,10 +362,19 @@ def test_batch_norm_large_mean_cold_start():
     # cold-start adoption: moving stats == first batch stats exactly
     np.testing.assert_allclose(bn.running_mean.data().asnumpy(),
                                x.mean(axis=(0, 2, 3)), rtol=1e-5)
+    # the e2 fallback variance must NOT poison the running stats
+    # (review repro: adopting it put running_var at ~1e8 and eval std
+    # at 1e-4): running_var keeps its init scale on suspicious channels
+    assert bn.running_var.data().asnumpy().max() < 1e3, \
+        bn.running_var.data().asnumpy().max()
     with autograd.record(train_mode=True):
         out2 = bn(nd.array(x)).asnumpy()
     assert 0.9 < out2.std() < 1.1, \
         f"warm-shift normalization wrong: std {out2.std()}"
+    # eval mode right after warmup normalizes sanely too
+    out_eval = bn(nd.array(x)).asnumpy()
+    assert 0.5 < out_eval.std() < 2.0, \
+        f"eval-mode normalization broken: std {out_eval.std()}"
     # op level: the batch-mean OUTPUT is exact even at cold start (the
     # shift cancels analytically in the mean), and var never explodes
     zeros = np.zeros(4, np.float32)
