@@ -1,0 +1,78 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capability
+surface of Apache MXNet 1.x (reference: yanghaojin/incubator-mxnet).
+
+Built from scratch on JAX/XLA (+Pallas for custom kernels): XLA replaces the
+reference's ThreadedEngine/mshadow/cuDNN stack, ``hybridize()`` lowers Gluon
+blocks to jitted XLA computations (the reference's CachedOp), and the KVStore
+facade maps onto ``jax.lax.psum`` over a device mesh. See SURVEY.md for the
+full reference analysis and design-mapping table.
+
+Usage mirrors the reference::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+
+    x = nd.ones((2, 3), ctx=mx.tpu())
+    with autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# Multi-host: when launched by tools/launch.py (MXTPU_* env protocol), the
+# coordination service must be joined BEFORE any jax backend touch — do it
+# at package import, the earliest point we control (the kvstore would be
+# too late: importing this package already initializes devices).
+import os as _os
+
+if _os.environ.get("MXTPU_COORD_ADDR"):
+    import jax as _jax
+    try:
+        _jax.distributed.initialize(
+            coordinator_address=_os.environ["MXTPU_COORD_ADDR"],
+            num_processes=int(_os.environ["MXTPU_NUM_PROC"]),
+            process_id=int(_os.environ["MXTPU_PROC_ID"]))
+    except RuntimeError:
+        pass          # already joined (re-import / interactive)
+
+from .base import MXNetError
+from .context import (Context, cpu, cpu_pinned, cpu_shared, current_context,
+                      gpu, gpu_memory_info, num_gpus, num_tpus, tpu)
+from . import engine
+from . import library
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import kvstore
+from . import kvstore as kv
+from . import gluon
+from . import parallel
+from . import recordio
+from . import io
+from . import image
+from . import symbol
+from . import symbol as sym
+from . import model
+from . import module
+from . import module as mod
+from . import callback
+from . import profiler
+from . import contrib
+from . import numpy as np
+from . import numpy_extension as npx
+from . import visualization
+from . import visualization as viz
+from . import test_utils
+from . import operator
+from . import runtime
+from . import attribute
+from .attribute import AttrScope
+from . import name

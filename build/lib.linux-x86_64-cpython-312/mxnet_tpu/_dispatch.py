@@ -1,0 +1,185 @@
+"""Eager op dispatch.
+
+TPU-native equivalent of the reference's imperative invoke path
+(ref: src/c_api/c_api_ndarray.cc MXImperativeInvokeEx ->
+src/imperative/imperative.cc Imperative::Invoke): coerce hyperparameters,
+run the op's pure jax function (asynchronously dispatched by PjRt — the
+ThreadedEngine's job happens inside the runtime), and, if autograd is
+recording, capture the ``jax.vjp`` pullback on the tape
+(ref: Imperative::RecordOp).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from . import _rng, engine
+from .base import MXNetError
+from .ops.registry import get as get_op
+
+__all__ = ["invoke", "set_amp_cast_hook"]
+
+# Per-op AMP cast policy (ref: the amp_cast pairs the reference's graph
+# pass inserts from its fp16 allow/deny lists, python/mxnet/contrib/amp/
+# lists/symbol_fp16.py). Installed by contrib.amp.init when op lists are
+# given; called with (op_name, datas, params) and returns the input arrays
+# recast per policy. Runs on eager arrays and on tracers alike, so the
+# policy applies inside hybridized/jitted programs too.
+_amp_cast_hook = None
+_amp_epoch = 0      # bumped on every policy change: jit caches key on it
+
+
+def set_amp_cast_hook(fn):
+    global _amp_cast_hook, _amp_epoch
+    _amp_cast_hook = fn
+    _amp_epoch += 1
+
+
+def amp_epoch():
+    """Monotonic counter of AMP-policy changes. Compiled-program caches
+    (HybridBlock._cached_fns, ShardedTrainer) include it in their keys so
+    installing/clearing a per-op cast policy retraces instead of silently
+    running the stale program."""
+    return _amp_epoch
+
+
+def _tracked(arr) -> bool:
+    return (getattr(arr, "_tape_node", None) is not None
+            or getattr(arr, "_grad", None) is not None)
+
+
+def _as_context(value):
+    """Accept Context objects or 'tpu' / 'tpu(0)' strings."""
+    from .context import Context
+    if isinstance(value, Context):
+        return value
+    if isinstance(value, str):
+        if "(" in value:
+            kind, _, rest = value.partition("(")
+            return Context(kind, int(rest.rstrip(")")))
+        return Context(value, 0)
+    raise MXNetError(f"invalid ctx argument: {value!r}")
+
+
+def _tape_wiring(inputs, datas):
+    """Per-input tape graph wiring: (parents, fwd_inputs) where each
+    parent is (TapeNode | None, out_index, leaf_NDArray | None)."""
+    from .ndarray import NDArray
+    parents = []
+    fwd_inputs = []
+    for x, d in zip(inputs, datas):
+        if isinstance(x, NDArray) and getattr(x, "_grad", None) is not None:
+            parents.append((None, 0, x))            # leaf
+        elif isinstance(x, NDArray) and \
+                getattr(x, "_tape_node", None) is not None:
+            parents.append((x._tape_node, x._tape_out_idx, None))
+        else:
+            parents.append((None, 0, None))         # constant
+        fwd_inputs.append(x if isinstance(x, NDArray) else d)
+    return parents, fwd_inputs
+
+
+def invoke(op, inputs: Sequence, kwargs: dict, out=None):
+    """Run operator `op` on NDArray `inputs`; returns NDArray or list."""
+    from .autograd import TapeNode, is_recording, is_training
+    from .ndarray import NDArray
+
+    if isinstance(op, str):
+        op = get_op(op)
+    params = op.coerce_params(kwargs)
+    call_kwargs = dict(params)
+    if op.needs_rng:
+        call_kwargs["rng"] = _rng.next_key()
+    if op.needs_mode and "training" not in call_kwargs:
+        call_kwargs["training"] = is_training()
+
+    datas = []
+    for x in inputs:
+        if isinstance(x, NDArray):
+            datas.append(x._data)
+        else:
+            import jax.numpy as jnp
+            datas.append(jnp.asarray(x))
+
+    if _amp_cast_hook is not None:
+        datas = _amp_cast_hook(op.name, datas, params)
+
+    n_out = op.num_outputs(params) if callable(op.num_outputs) else op.num_outputs
+
+    recording = (is_recording() and op.differentiable
+                 and any(_tracked(x) for x in inputs if isinstance(x, NDArray)))
+
+    if recording and op.name == "Embedding" \
+            and call_kwargs.get("sparse_grad") \
+            and not isinstance(datas[0], jax.core.Tracer):
+        # eager sparse-grad path: the weight cotangent is emitted as a
+        # row-sparse (rows=batch indices, values=output cotangent) instead
+        # of a dense scatter over the full table (ref: indexing_op.cc
+        # SparseEmbeddingOpBackwardRspImpl). Under jit tracing (hybridize/
+        # ShardedTrainer) the dense path below applies — XLA fuses the
+        # scatter there anyway.
+        from .ndarray.sparse import _RowSparseCT
+        out_data = op.fn(*datas, **call_kwargs)
+        idx_data, w_data = datas[0], datas[1]
+        w_shape = tuple(w_data.shape)
+
+        def sparse_vjp(ct):
+            import numpy as _np
+            import jax.numpy as jnp
+            rows = jnp.reshape(idx_data, (-1,)).astype(jnp.int32)
+            vals = jnp.reshape(ct, (rows.shape[0], w_shape[1]))
+            idx_ct = _np.zeros(idx_data.shape, dtype=jax.dtypes.float0)
+            return (idx_ct, _RowSparseCT(rows, vals, w_shape))
+
+        outs = [out_data]
+        avals = [jax.ShapeDtypeStruct(out_data.shape, out_data.dtype)]
+        parents, fwd_inputs = _tape_wiring(inputs, datas)
+        node = TapeNode(sparse_vjp, parents, avals, fwd_fn=op.fn,
+                        fwd_kwargs=call_kwargs, fwd_inputs=fwd_inputs)
+    elif recording:
+        fn = lambda *arrays: op.fn(*arrays, **call_kwargs)
+        out_data, vjp_fn = jax.vjp(fn, *datas)
+        outs = list(out_data) if isinstance(out_data, tuple) else [out_data]
+        avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+        parents, fwd_inputs = _tape_wiring(inputs, datas)
+        node = TapeNode(vjp_fn, parents, avals, fwd_fn=op.fn,
+                        fwd_kwargs=call_kwargs, fwd_inputs=fwd_inputs)
+    else:
+        out_data = op.fn(*datas, **call_kwargs)
+        outs = list(out_data) if isinstance(out_data, tuple) else [out_data]
+        node = None
+
+    explicit_ctx = _as_context(params.get("ctx")) if params.get("ctx") else None
+    ctx = explicit_ctx
+    if ctx is None:
+        for x in inputs:
+            if isinstance(x, NDArray):
+                ctx = x.ctx
+                break
+    if ctx is None:
+        from .context import current_context
+        ctx = current_context()
+
+    engine.on_op_done(outs[0])
+
+    results = []
+    for i, o in enumerate(outs):
+        # explicit ctx (creation ops): commit the output to that device
+        nd = NDArray(o, ctx=ctx, _skip_device_put=explicit_ctx is None)
+        if node is not None:
+            nd._tape_node = node
+            nd._tape_out_idx = i
+        results.append(nd)
+
+    if out is not None:
+        targets = out if isinstance(out, (list, tuple)) else [out]
+        for tgt, res in zip(targets, results):
+            tgt._rebind(res._data)
+            tgt._tape_node = getattr(res, "_tape_node", None)
+            tgt._tape_out_idx = getattr(res, "_tape_out_idx", 0)
+        return out
+
+    if n_out == 1 or len(results) == 1:
+        return results[0]
+    return results

@@ -1,0 +1,420 @@
+"""Autograd: define-by-run automatic differentiation.
+
+API-compatible with the reference's ``mxnet.autograd`` (ref:
+python/mxnet/autograd.py — record/pause/train_mode/predict_mode/backward/grad,
+backed by Imperative::RecordOp / Imperative::Backward in
+src/imperative/imperative.cc). The TPU-native mechanism is different and
+simpler: while recording, every dispatched op runs through ``jax.vjp``, whose
+returned pullback is stored on a tape node; ``backward()`` walks the tape in
+reverse topological order pushing cotangents through the stored pullbacks.
+XLA still sees whole fused programs when models are hybridized, because a
+hybridized block records ONE tape node for its entire jitted forward.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "get_symbol"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+class _RecordingScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._old
+
+
+def record(train_mode: bool = True):
+    """Scope that turns on recording (and, by default, training mode)."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    """Scope that turns off recording (ref: autograd.pause)."""
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+class TapeNode:
+    """One recorded op: holds the jax.vjp pullback and the graph wiring.
+
+    For higher-order gradients the node can also carry the forward recipe
+    (``fwd_fn``/``fwd_kwargs``/``fwd_inputs``): ``create_graph`` backward
+    re-derives the pullback from it under recording, so grad-of-grad sees
+    the full dependence on the primals (the stored ``vjp_fn`` closure holds
+    them as constants and is only used by the fast first-order path)."""
+    __slots__ = ("vjp_fn", "parents", "out_avals", "n_outputs", "grad_buffers",
+                 "pending", "fwd_fn", "fwd_kwargs", "fwd_inputs",
+                 "__weakref__")
+
+    def __init__(self, vjp_fn, parents, out_avals, fwd_fn=None,
+                 fwd_kwargs=None, fwd_inputs=None):
+        self.vjp_fn = vjp_fn
+        # parents[i] corresponds to the i-th primal input of the vjp:
+        # each entry is (TapeNode | None, out_index, leaf_NDArray | None)
+        self.parents = parents
+        self.out_avals = out_avals      # list of jax.ShapeDtypeStruct
+        self.n_outputs = len(out_avals)
+        self.fwd_fn = fwd_fn
+        self.fwd_kwargs = fwd_kwargs or {}
+        self.fwd_inputs = fwd_inputs    # list of NDArray | jax.Array
+
+
+def _zeros_for(aval):
+    import jax.numpy as jnp
+    if jax.dtypes.issubdtype(aval.dtype, jax.numpy.inexact):
+        return jnp.zeros(aval.shape, aval.dtype)
+    # integer/bool outputs get symbolic-zero cotangents
+    return _np.zeros(aval.shape, dtype=jax.dtypes.float0)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """ref: autograd.mark_variables — attach grad buffers to leaves."""
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g
+        var._grad_req = req
+        var._tape_node = None          # marking detaches from any prior graph
+        var._tape_out_idx = 0
+
+
+def _toposort(roots: List[TapeNode]):
+    order = []
+    seen = set()
+    stack = [(r, False) for r in roots]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for parent, _idx, _leaf in node.parents:
+            if parent is not None and id(parent) not in seen:
+                stack.append((parent, False))
+    return order  # children appear after parents; reverse for backward
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             _leaf_filter=None):
+    """Compute gradients of `heads` w.r.t. all marked leaves
+    (ref: MXAutogradBackwardEx -> Imperative::Backward).
+
+    ``_leaf_filter``: internal — a set of leaf ids to restrict deposits to
+    (used by :func:`grad` so it never touches other arrays' ``.grad``)."""
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # seed cotangents per tape node; leaf grads accumulate here during the
+    # pass and are deposited once at the end (grad_req governs cross-pass
+    # behavior, matching the reference)
+    cotangents = {}   # id(node) -> list per output
+    leaf_accum = {}   # id(leaf NDArray) -> (leaf, accumulated grad)
+    roots = []
+    for h, hg in zip(heads, head_grads):
+        node = getattr(h, "_tape_node", None)
+        if node is None:
+            if getattr(h, "_grad", None) is not None:
+                g = jnp.ones(h.shape, h._data.dtype) if hg is None else hg._data
+                _accum_leaf(leaf_accum, h, g)
+            continue
+        roots.append(node)
+        ct = cotangents.setdefault(
+            id(node), [_zeros_for(a) for a in node.out_avals])
+        seed = jnp.ones(h.shape, h._data.dtype) if hg is None else hg._data
+        idx = h._tape_out_idx
+        if isinstance(ct[idx], _np.ndarray) and ct[idx].dtype == jax.dtypes.float0:
+            pass  # non-differentiable head: nothing to do
+        else:
+            ct[idx] = ct[idx] + seed
+    if not roots:
+        if not any(getattr(h, "_grad", None) is not None for h in heads):
+            raise MXNetError("backward: no recorded graph reaches these heads "
+                             "(did you call attach_grad() and compute inside "
+                             "autograd.record()?)")
+        return
+
+    order = _toposort(roots)
+    for node in reversed(order):
+        ct = cotangents.get(id(node))
+        if ct is None:
+            continue
+        if node.vjp_fn is None:
+            raise MXNetError("backward: graph was already freed by a previous "
+                             "backward pass; pass retain_graph=True to keep it")
+        ct_arg = tuple(ct) if node.n_outputs > 1 else ct[0]
+        in_cts = node.vjp_fn(ct_arg)
+        for (parent, out_idx, leaf), g in zip(node.parents, in_cts):
+            if isinstance(g, _np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            if leaf is not None:
+                if _leaf_filter is None or id(leaf) in _leaf_filter:
+                    _accum_leaf(leaf_accum, leaf, g)
+            elif parent is not None:
+                pct = cotangents.setdefault(
+                    id(parent), [_zeros_for(a) for a in parent.out_avals])
+                prev = pct[out_idx]
+                if isinstance(prev, _np.ndarray) and prev.dtype == jax.dtypes.float0:
+                    continue
+                from .ndarray.sparse import _RowSparseCT
+                if isinstance(g, _RowSparseCT):
+                    g = g.todense()   # sparse stays sparse only to leaves
+                pct[out_idx] = prev + g
+        if not retain_graph:
+            cotangents.pop(id(node), None)
+
+    if not retain_graph:
+        # free the recorded graph (ref: Imperative::Backward releases the
+        # tape unless retain_graph): drop pullback closures so forward
+        # residuals/activations aren't pinned by retained outputs
+        for node in order:
+            node.vjp_fn = None
+            node.parents = []
+
+    for leaf, g in leaf_accum.values():
+        _deposit_leaf(leaf, g)
+
+
+def _accum_leaf(leaf_accum, leaf, g):
+    from .ndarray.sparse import _RowSparseCT
+    key = id(leaf)
+    if key not in leaf_accum:
+        leaf_accum[key] = (leaf, g)
+        return
+    prev = leaf_accum[key][1]
+    if isinstance(prev, _RowSparseCT) and isinstance(g, _RowSparseCT):
+        import jax.numpy as jnp
+        merged = _RowSparseCT(jnp.concatenate([prev.rows, g.rows]),
+                              jnp.concatenate([prev.values, g.values]),
+                              prev.shape)
+        leaf_accum[key] = (leaf, merged)
+    elif isinstance(prev, _RowSparseCT) or isinstance(g, _RowSparseCT):
+        dense_p = prev.todense() if isinstance(prev, _RowSparseCT) else prev
+        dense_g = g.todense() if isinstance(g, _RowSparseCT) else g
+        leaf_accum[key] = (leaf, dense_p + dense_g)
+    else:
+        leaf_accum[key] = (leaf, prev + g)
+
+
+def _deposit_leaf(leaf, g):
+    from .ndarray.sparse import _RowSparseCT, dedupe_rows
+    req = getattr(leaf, "_grad_req", "write")
+    if req == "null" or leaf._grad is None:
+        return
+    if isinstance(g, _RowSparseCT):
+        rs = dedupe_rows(g)
+        if req == "add":
+            prev = getattr(leaf._grad, "_sparse", None)
+            if prev is not None:
+                import numpy as np
+                merged = _RowSparseCT(
+                    np.concatenate([prev.indices, rs.indices]),
+                    np.concatenate([prev.data, rs.data]), rs.shape)
+                rs = dedupe_rows(merged)
+            elif not getattr(leaf._grad, "_zeroed", False):
+                # dense buffer holds prior dense grads; fold them in
+                rs = None
+        if rs is not None:
+            leaf._grad._sparse = rs
+            leaf._grad._sparse_used = False
+            leaf._grad._zeroed = False
+            return
+        g = g.todense()
+    prev_rs = getattr(leaf._grad, "_sparse", None)
+    if prev_rs is not None and req == "add":
+        # a dense add-deposit must fold the retained sparse view in (the
+        # dense buffer under it is still zeros), not discard it
+        import jax.numpy as jnp
+        g = g + jnp.asarray(prev_rs.asnumpy(), dtype=g.dtype)
+    leaf._grad._sparse = None      # dense deposit invalidates sparse view
+    leaf._grad._zeroed = False
+    g = g.astype(leaf._grad._data.dtype)
+    if req == "add":
+        leaf._grad._rebind(leaf._grad._data + g)
+    else:
+        leaf._grad._rebind(g)
+
+
+def _replay_vjp(node, ct_nds):
+    """Recompute the node's pullback from the forward recipe with BOTH
+    primals and cotangents as recorded inputs — the create_graph backward
+    step (differentiating through jax.vjp is jax-native)."""
+    from .numpy import _call
+    from .ndarray import NDArray
+    fn, kwargs = node.fwd_fn, node.fwd_kwargs
+    n_in = len(node.fwd_inputs)
+    n_out = node.n_outputs
+
+    def replay(*vals):
+        xs, cts = vals[:n_in], vals[n_in:]
+        _, vjp = jax.vjp(lambda *a: fn(*a, **kwargs), *xs)
+        res = tuple(vjp(tuple(cts) if n_out > 1 else cts[0]))
+        return res[0] if len(res) == 1 else res
+
+    out = _call(replay, *node.fwd_inputs, *ct_nds)
+    return out if isinstance(out, tuple) else (out,)
+
+
+def _backward_create_graph(heads, head_grads, leaf_filter):
+    """Tape walk with NDArray cotangents under recording → leaf grads that
+    are themselves differentiable (ref: Imperative::Backward with
+    create_graph=True)."""
+    from .ndarray import NDArray
+
+    cotangents = {}
+    leaf_accum = {}
+    roots = []
+    for h, hg in zip(heads, head_grads):
+        node = getattr(h, "_tape_node", None)
+        seed = hg if hg is not None else \
+            NDArray(jax.numpy.ones(h.shape, h._data.dtype),
+                    _skip_device_put=True)
+        if node is None:
+            if getattr(h, "_grad", None) is not None:
+                _accum_leaf(leaf_accum, h, seed)
+            continue
+        roots.append(node)
+        ct = cotangents.setdefault(
+            id(node), [None] * node.n_outputs)
+        idx = h._tape_out_idx
+        ct[idx] = seed if ct[idx] is None else ct[idx] + seed
+    if not roots and not leaf_accum:
+        raise MXNetError("backward: no recorded graph reaches these heads")
+
+    order = _toposort(roots)
+    for node in reversed(order):
+        ct = cotangents.get(id(node))
+        if ct is None:
+            continue
+        if node.fwd_fn is None:
+            raise MXNetError(
+                "create_graph backward needs the forward recipe on every "
+                "tape node; this graph contains a node recorded without "
+                "one (custom Function?)")
+        ct_full = [c if c is not None else
+                   NDArray(jax.numpy.zeros(a.shape, a.dtype),
+                           _skip_device_put=True)
+                   for c, a in zip(ct, node.out_avals)]
+        in_cts = _replay_vjp(node, ct_full)
+        for (parent, out_idx, leaf), g in zip(node.parents, in_cts):
+            if not isinstance(g, NDArray):
+                continue
+            if leaf is not None:
+                if leaf_filter is None or id(leaf) in leaf_filter:
+                    _accum_leaf(leaf_accum, leaf, g)
+            elif parent is not None:
+                pct = cotangents.setdefault(
+                    id(parent), [None] * parent.n_outputs)
+                pct[out_idx] = g if pct[out_idx] is None else \
+                    pct[out_idx] + g
+    return leaf_accum
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """ref: autograd.grad — returns grads instead of writing .grad.
+    ``create_graph=True`` returns differentiable gradients (higher-order
+    autograd via pullback replay)."""
+    from .ndarray import NDArray
+    if create_graph:
+        if isinstance(heads, NDArray):
+            heads = [heads]
+        if head_grads is None:
+            head_grads = [None] * len(heads)
+        elif not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+        single = isinstance(variables, NDArray)
+        var_list = [variables] if single else list(variables)
+        with record(train_mode):
+            leaf_accum = _backward_create_graph(
+                heads, head_grads, {id(v) for v in var_list})
+        out = []
+        for v in var_list:
+            if id(v) in leaf_accum:
+                out.append(leaf_accum[id(v)][1])
+            else:
+                out.append(NDArray(jax.numpy.zeros(v.shape, v._data.dtype),
+                                   _skip_device_put=True))
+        return out[0] if single else out
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "write"))
+             for v in variables]
+    from .ndarray import zeros
+    for v in variables:
+        v._grad = zeros(v.shape, dtype=v.dtype, ctx=v.ctx)
+        v._grad_req = "add"
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
+                 _leaf_filter={id(v) for v in variables})
+        out = [v._grad for v in variables]
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._grad, v._grad_req = g, r
+    return out[0] if single else out
+
+
+def get_symbol(x):
+    raise MXNetError("autograd.get_symbol is not supported: the TPU build "
+                     "records jax pullbacks, not NNVM nodes; use "
+                     "HybridBlock.export for graph capture")

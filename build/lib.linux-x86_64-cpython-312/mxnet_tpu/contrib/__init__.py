@@ -1,0 +1,7 @@
+"""``mx.contrib`` (ref: python/mxnet/contrib/__init__.py): amp, plus
+stubs that document intentional TPU divergences."""
+from . import amp
+from . import onnx
+from . import quantization
+
+__all__ = ["amp", "onnx", "quantization"]

@@ -1,0 +1,114 @@
+"""ONNX import/export (ref: python/mxnet/contrib/onnx/).
+
+Fully self-contained: the protobuf wire codec in ``proto.py`` reads and
+writes real ``.onnx`` bytes without the ``onnx`` pip (unavailable in this
+environment), so exported files interoperate with the official
+onnx/onnxruntime stack and standard ONNX files import. Conversion is a
+pure data transform over the dict-proto representation — see
+``mx2onnx.export_graph`` / ``onnx2mx.import_graph`` — with open converter
+registries like the reference's ``@mx_op.register`` pattern.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ...base import MXNetError
+from . import mx2onnx, onnx2mx, proto
+from .mx2onnx import export_graph
+from .onnx2mx import import_graph
+
+__all__ = ["export_model", "import_model", "get_model_metadata",
+           "export_graph", "import_graph", "mx2onnx", "onnx2mx", "proto"]
+
+
+def _load_sym_params(sym, params):
+    from ... import symbol as sym_mod
+    from ...ndarray import NDArray
+    if isinstance(sym, str):
+        sym = sym_mod.load(sym)
+    if isinstance(params, str):
+        from ...ndarray import load as nd_load
+        params = nd_load(params)      # keys keep their arg:/aux: prefixes
+    out = {}
+    for k, v in (params or {}).items():
+        out[k] = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+    return sym, out
+
+
+def export_model(sym, params, input_shape=None, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False,
+                 opset_version=13):
+    """Export a Symbol (or a '-symbol.json' path) + params (dict or a
+    '.params' path) to a real ONNX file. Returns the file path.
+    (ref: contrib/onnx/mx2onnx/export_model.py export_model)"""
+    sym, params = _load_sym_params(sym, params)
+    if input_shape is not None and input_shape and \
+            not isinstance(input_shape[0], (tuple, list)):
+        input_shape = [input_shape]
+    in_types = None
+    if input_type is not None:
+        if not isinstance(input_type, (list, tuple)):
+            input_type = [input_type] * len(input_shape or [()])
+        in_types = [np.dtype(t).name for t in input_type]
+    model = export_graph(sym, params, in_shapes=input_shape,
+                         in_types=in_types)
+    model["opset"] = opset_version
+    buf = proto.encode_model(model)
+    with open(onnx_file_path, "wb") as f:
+        f.write(buf)
+    if verbose:
+        g = model["graph"]
+        print(f"ONNX export: {len(g['nodes'])} nodes, "
+              f"{len(g['initializers'])} initializers -> {onnx_file_path}")
+    return onnx_file_path
+
+
+def import_model(model_file):
+    """ONNX file (or dict-proto) -> (sym, arg_params, aux_params) with
+    NDArray params (ref: contrib/onnx/onnx2mx/import_model.py)."""
+    from ...ndarray import array
+    if isinstance(model_file, dict):
+        model = model_file
+    else:
+        with open(model_file, "rb") as f:
+            model = proto.decode_model(f.read())
+    sym, arg_np, aux_np = import_graph(model)
+    arg_params = {k: array(v) for k, v in arg_np.items()}
+    aux_params = {k: array(v) for k, v in aux_np.items()}
+    return sym, arg_params, aux_params
+
+
+def import_to_gluon(model_file, ctx=None):
+    """ONNX file -> SymbolBlock ready for inference
+    (ref: contrib/onnx/onnx2mx/import_to_gluon.py)."""
+    from ...gluon import SymbolBlock
+    from ... import symbol as sym_mod
+    sym, arg_params, aux_params = import_model(model_file)
+    data_names = [n for n in sym.list_arguments() if n not in arg_params]
+    inputs = [sym_mod.var(n) for n in data_names]
+    from ...context import cpu, current_context
+    ctx = ctx if ctx is not None else current_context()
+    net = SymbolBlock(sym, inputs)
+    params = net.collect_params()
+    for name, arr in list(arg_params.items()) + list(aux_params.items()):
+        if name in params:
+            params[name]._load_init(arr, ctx)
+    return net
+
+
+def get_model_metadata(model_file):
+    """Input/output names+shapes of an ONNX file — parsed with the
+    built-in codec, no onnx pip needed."""
+    with open(model_file, "rb") as f:
+        model = proto.decode_model(f.read())
+    g = model["graph"]
+    init_names = {t["name"] for t in g.get("initializers", [])}
+    return {
+        "input_tensor_data": [(i["name"], tuple(i.get("shape", ())))
+                              for i in g["inputs"]
+                              if i["name"] not in init_names],
+        "output_tensor_data": [(o["name"], tuple(o.get("shape", ())))
+                               for o in g["outputs"]],
+    }

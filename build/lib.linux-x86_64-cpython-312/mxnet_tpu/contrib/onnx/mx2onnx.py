@@ -1,0 +1,599 @@
+"""Symbol-DAG -> ONNX graph conversion (ref: python/mxnet/contrib/onnx/
+mx2onnx/_op_translations.py). Each MX op converter returns a list of ONNX
+node dicts; the registry is open (@mx2onnx) so new ops slot in the same
+way the reference's @mx_op.register does."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+
+_EXPORTERS = {}
+
+
+def mx2onnx(op_name):
+    def deco(fn):
+        _EXPORTERS[op_name] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Per-export state: tensor naming, generated initializers."""
+
+    def __init__(self, params):
+        self.params = params
+        self.extra_initializers = []
+        self.renames = {}        # identity-folded tensors (Dropout, etc.)
+        self.shape_of = {}       # tensor name -> static shape (if inferred)
+        self._uid = 0
+
+    def tname(self, sym):
+        node = sym._node
+        if node.op is None:
+            name = node.name
+        elif node.num_outputs == 1:
+            name = node.name
+        else:
+            name = f"{node.name}_out{sym._index}"
+        return self.renames.get(name, name)
+
+    def out_name(self, node, index=0):
+        if node.num_outputs == 1:
+            return node.name
+        return f"{node.name}_out{index}"
+
+    def add_initializer(self, hint, arr):
+        self._uid += 1
+        name = f"_{hint}_{self._uid}"
+        self.extra_initializers.append(
+            {"name": name, "data": np.asarray(arr)})
+        return name
+
+
+def _pads(pad):
+    pad = tuple(pad or ())
+    return list(pad) + list(pad)          # symmetric begin+end
+
+
+@mx2onnx("Convolution")
+def _conv(node, ins, out, attrs, ctx):
+    onnx_attrs = {"kernel_shape": list(attrs["kernel"]),
+                  "strides": list(attrs.get("stride") or
+                                  (1,) * len(attrs["kernel"])),
+                  "dilations": list(attrs.get("dilate") or
+                                    (1,) * len(attrs["kernel"])),
+                  "pads": _pads(attrs.get("pad") or
+                                (0,) * len(attrs["kernel"])),
+                  "group": int(attrs.get("num_group") or 1)}
+    return [{"op_type": "Conv", "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": onnx_attrs}]
+
+
+@mx2onnx("FullyConnected")
+def _fc(node, ins, out, attrs, ctx):
+    nodes = []
+    data = ins[0]
+    if attrs.get("flatten", True):
+        flat = f"{node.name}_flat"
+        nodes.append({"op_type": "Flatten", "name": flat, "inputs": [data],
+                      "outputs": [flat], "attrs": {"axis": 1}})
+        data = flat
+        gemm_in = [data, ins[1]] + (ins[2:]
+                                    if not attrs.get("no_bias") else [])
+        nodes.append({"op_type": "Gemm", "name": node.name,
+                      "inputs": gemm_in, "outputs": [out],
+                      "attrs": {"alpha": 1.0, "beta": 1.0, "transA": 0,
+                                "transB": 1}})
+        return nodes
+    # flatten=False keeps leading dims (possibly rank>2): ONNX Gemm is
+    # 2-D-only, so emit MatMul(x, W^T) [+ Add bias] — imports back as
+    # FullyConnected(flatten=False) via the MatMul importer
+    if ins[1] in ctx.params:
+        wt = ctx.add_initializer(
+            f"{ins[1]}_T",
+            np.ascontiguousarray(np.asarray(ctx.params[ins[1]]).T))
+    else:
+        wt = f"{node.name}_wT"
+        nodes.append({"op_type": "Transpose", "name": wt,
+                      "inputs": [ins[1]], "outputs": [wt],
+                      "attrs": {"perm": [1, 0]}})
+    mm_out = out if attrs.get("no_bias") else f"{node.name}_mm"
+    nodes.append({"op_type": "MatMul", "name": f"{node.name}_mm",
+                  "inputs": [data, wt], "outputs": [mm_out], "attrs": {}})
+    if not attrs.get("no_bias"):
+        nodes.append({"op_type": "Add", "name": node.name,
+                      "inputs": [mm_out, ins[2]], "outputs": [out],
+                      "attrs": {}})
+    return nodes
+
+
+@mx2onnx("BatchNorm")
+def _bn(node, ins, out, attrs, ctx):
+    if attrs.get("fix_gamma"):
+        gname = ins[1]
+        if gname in ctx.params:
+            ins = list(ins)
+            ins[1] = ctx.add_initializer(
+                "ones", np.ones_like(np.asarray(ctx.params[gname])))
+    return [{"op_type": "BatchNormalization", "name": node.name,
+             "inputs": list(ins), "outputs": [out],
+             "attrs": {"epsilon": float(attrs.get("eps", 1e-3)),
+                       "momentum": float(attrs.get("momentum", 0.9))}}]
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@mx2onnx("Activation")
+def _act(node, ins, out, attrs, ctx):
+    act = attrs.get("act_type", "relu")
+    if act not in _ACT:
+        raise MXNetError(f"ONNX export: unsupported activation {act}")
+    return [{"op_type": _ACT[act], "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": {}}]
+
+
+for _mx, _onnx in [("relu", "Relu"), ("sigmoid", "Sigmoid"),
+                   ("tanh", "Tanh"), ("exp", "Exp"), ("log", "Log"),
+                   ("sqrt", "Sqrt"), ("abs", "Abs"), ("negative", "Neg"),
+                   ("erf", "Erf"), ("floor", "Floor"), ("ceil", "Ceil")]:
+    def _make_unary(onnx_type):
+        def conv(node, ins, out, attrs, ctx):
+            return [{"op_type": onnx_type, "name": node.name,
+                     "inputs": ins, "outputs": [out], "attrs": {}}]
+        return conv
+    _EXPORTERS[_mx] = _make_unary(_onnx)
+
+
+@mx2onnx("Pooling")
+def _pool(node, ins, out, attrs, ctx):
+    ptype = attrs.get("pool_type", "max")
+    if ptype not in ("max", "avg"):
+        raise MXNetError(f"ONNX export: unsupported pool_type {ptype}")
+    if attrs.get("global_pool"):
+        op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        return [{"op_type": op, "name": node.name, "inputs": ins,
+                 "outputs": [out], "attrs": {}}]
+    kernel = attrs["kernel"]
+    onnx_attrs = {"kernel_shape": list(kernel),
+                  "strides": list(attrs.get("stride") or (1,) * len(kernel)),
+                  "pads": _pads(attrs.get("pad") or (0,) * len(kernel)),
+                  "ceil_mode": int(attrs.get("pooling_convention",
+                                             "valid") == "full")}
+    if ptype == "avg":
+        onnx_attrs["count_include_pad"] = int(
+            bool(attrs.get("count_include_pad", True)))
+    op = "MaxPool" if ptype == "max" else "AveragePool"
+    return [{"op_type": op, "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": onnx_attrs}]
+
+
+@mx2onnx("Flatten")
+def _flatten(node, ins, out, attrs, ctx):
+    return [{"op_type": "Flatten", "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": {"axis": 1}}]
+
+
+for _mx, _onnx in [("elemwise_add", "Add"), ("broadcast_add", "Add"),
+                   ("elemwise_sub", "Sub"), ("broadcast_sub", "Sub"),
+                   ("elemwise_mul", "Mul"), ("broadcast_mul", "Mul"),
+                   ("elemwise_div", "Div"), ("broadcast_div", "Div"),
+                   ("broadcast_maximum", "Max"),
+                   ("broadcast_minimum", "Min")]:
+    def _make_binary(onnx_type):
+        def conv(node, ins, out, attrs, ctx):
+            return [{"op_type": onnx_type, "name": node.name,
+                     "inputs": ins, "outputs": [out], "attrs": {}}]
+        return conv
+    _EXPORTERS[_mx] = _make_binary(_onnx)
+
+
+@mx2onnx("softmax")
+def _softmax(node, ins, out, attrs, ctx):
+    return [{"op_type": "Softmax", "name": node.name, "inputs": ins[:1],
+             "outputs": [out], "attrs": {"axis": int(attrs.get("axis",
+                                                               -1))}}]
+
+
+@mx2onnx("log_softmax")
+def _logsoftmax(node, ins, out, attrs, ctx):
+    return [{"op_type": "LogSoftmax", "name": node.name, "inputs": ins[:1],
+             "outputs": [out], "attrs": {"axis": int(attrs.get("axis",
+                                                               -1))}}]
+
+
+@mx2onnx("SoftmaxOutput")
+def _softmax_output(node, ins, out, attrs, ctx):
+    # inference export: drop the label input (ref: mx2onnx softmax_output)
+    return [{"op_type": "Softmax", "name": node.name, "inputs": ins[:1],
+             "outputs": [out], "attrs": {"axis": -1}}]
+
+
+@mx2onnx("Dropout")
+def _dropout(node, ins, out, attrs, ctx):
+    ctx.renames[out] = ctx.renames.get(ins[0], ins[0])   # inference no-op
+    return []
+
+
+@mx2onnx("identity")
+def _identity(node, ins, out, attrs, ctx):
+    ctx.renames[out] = ctx.renames.get(ins[0], ins[0])
+    return []
+
+
+@mx2onnx("reshape")
+def _reshape(node, ins, out, attrs, ctx):
+    shape = tuple(attrs.get("shape") or ())
+    if any(s in (-2, -3, -4) for s in shape):
+        raise MXNetError("ONNX export: reshape special codes -2/-3/-4 have "
+                         "no ONNX equivalent")
+    shape_name = ctx.add_initializer("shape",
+                                     np.asarray(shape, dtype=np.int64))
+    return [{"op_type": "Reshape", "name": node.name,
+             "inputs": [ins[0], shape_name], "outputs": [out], "attrs": {}}]
+
+
+@mx2onnx("transpose")
+def _transpose(node, ins, out, attrs, ctx):
+    return [{"op_type": "Transpose", "name": node.name, "inputs": ins,
+             "outputs": [out],
+             "attrs": {"perm": list(attrs.get("axes") or [])}}]
+
+
+@mx2onnx("Concat")
+def _concat(node, ins, out, attrs, ctx):
+    return [{"op_type": "Concat", "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": {"axis": int(attrs.get("dim", 1))}}]
+
+
+@mx2onnx("clip")
+def _clip(node, ins, out, attrs, ctx):
+    lo = ctx.add_initializer("min", np.float32(attrs.get("a_min")))
+    hi = ctx.add_initializer("max", np.float32(attrs.get("a_max")))
+    return [{"op_type": "Clip", "name": node.name,
+             "inputs": [ins[0], lo, hi], "outputs": [out], "attrs": {}}]
+
+
+@mx2onnx("LeakyReLU")
+def _leaky(node, ins, out, attrs, ctx):
+    act = attrs.get("act_type", "leaky")
+    if act == "leaky":
+        return [{"op_type": "LeakyRelu", "name": node.name,
+                 "inputs": ins[:1], "outputs": [out],
+                 "attrs": {"alpha": float(attrs.get("slope", 0.25))}}]
+    if act == "elu":
+        return [{"op_type": "Elu", "name": node.name, "inputs": ins[:1],
+                 "outputs": [out],
+                 "attrs": {"alpha": float(attrs.get("slope", 0.25))}}]
+    if act == "prelu":
+        return [{"op_type": "PRelu", "name": node.name, "inputs": ins[:2],
+                 "outputs": [out], "attrs": {}}]
+    if act == "gelu":
+        # exact erf form, decomposed for broad opset compatibility:
+        # 0.5 * x * (1 + erf(x / sqrt(2)))
+        n = node.name
+        inv_sqrt2 = ctx.add_initializer("inv_sqrt2",
+                                        np.float32(0.7071067811865476))
+        half = ctx.add_initializer("half", np.float32(0.5))
+        one = ctx.add_initializer("one", np.float32(1.0))
+        return [
+            {"op_type": "Mul", "name": f"{n}_scale",
+             "inputs": [ins[0], inv_sqrt2], "outputs": [f"{n}_scaled"],
+             "attrs": {}},
+            {"op_type": "Erf", "name": f"{n}_erf",
+             "inputs": [f"{n}_scaled"], "outputs": [f"{n}_erfv"],
+             "attrs": {}},
+            {"op_type": "Add", "name": f"{n}_add1",
+             "inputs": [f"{n}_erfv", one], "outputs": [f"{n}_1perf"],
+             "attrs": {}},
+            {"op_type": "Mul", "name": f"{n}_mulx",
+             "inputs": [ins[0], f"{n}_1perf"], "outputs": [f"{n}_xe"],
+             "attrs": {}},
+            {"op_type": "Mul", "name": n,
+             "inputs": [f"{n}_xe", half], "outputs": [out], "attrs": {}},
+        ]
+    raise MXNetError(f"ONNX export: LeakyReLU act_type {act} unsupported")
+
+
+@mx2onnx("Embedding")
+def _embedding(node, ins, out, attrs, ctx):
+    # Gather(weight, int64(indices)) — the indices arrive float in MXNet
+    idx64 = f"{node.name}_idx64"
+    return [
+        {"op_type": "Cast", "name": f"{node.name}_cast",
+         "inputs": [ins[0]], "outputs": [idx64],
+         "attrs": {"to": 7}},                      # 7 = INT64
+        {"op_type": "Gather", "name": node.name,
+         "inputs": [ins[1], idx64], "outputs": [out],
+         "attrs": {"axis": 0}},
+    ]
+
+
+@mx2onnx("LayerNorm")
+def _layer_norm(node, ins, out, attrs, ctx):
+    # opset-17 LayerNormalization (x, scale, bias)
+    return [{"op_type": "LayerNormalization", "name": node.name,
+             "inputs": ins[:3], "outputs": [out],
+             "attrs": {"axis": int(attrs.get("axis", -1)),
+                       "epsilon": float(attrs.get("eps", 1e-5))}}]
+
+
+for _mx, _onnx, _rev in [("_mul_scalar", "Mul", False),
+                         ("_plus_scalar", "Add", False),
+                         ("_minus_scalar", "Sub", False),
+                         ("_rminus_scalar", "Sub", True),
+                         ("_div_scalar", "Div", False),
+                         ("_rdiv_scalar", "Div", True)]:
+    def _make_scalar(onnx_type, reverse):
+        def conv(node, ins, out, attrs, ctx):
+            s = ctx.add_initializer(
+                "scalar", np.float32(attrs.get("scalar", 0.0)))
+            inputs = [s, ins[0]] if reverse else [ins[0], s]
+            return [{"op_type": onnx_type, "name": node.name,
+                     "inputs": inputs, "outputs": [out], "attrs": {}}]
+        return conv
+    _EXPORTERS[_mx] = _make_scalar(_onnx, _rev)
+
+
+@mx2onnx("expand_dims")
+def _expand_dims(node, ins, out, attrs, ctx):
+    axes = ctx.add_initializer(
+        "axes", np.asarray([int(attrs.get("axis", 0))], np.int64))
+    return [{"op_type": "Unsqueeze", "name": node.name,
+             "inputs": [ins[0], axes], "outputs": [out], "attrs": {}}]
+
+
+@mx2onnx("squeeze")
+def _squeeze(node, ins, out, attrs, ctx):
+    axis = attrs.get("axis")
+    inputs = [ins[0]]
+    if axis is not None:
+        axes = axis if isinstance(axis, (tuple, list)) else [int(axis)]
+        inputs.append(ctx.add_initializer(
+            "axes", np.asarray(list(axes), np.int64)))
+    return [{"op_type": "Squeeze", "name": node.name, "inputs": inputs,
+             "outputs": [out], "attrs": {}}]
+
+
+_INT_MAX = np.iinfo(np.int64).max
+
+
+@mx2onnx("slice")
+def _slice(node, ins, out, attrs, ctx):
+    begin = list(attrs.get("begin") or ())
+    end = list(attrs.get("end") or ())
+    step = list(attrs.get("step") or ())
+    starts = [0 if b is None else int(b) for b in begin]
+    ends = [_INT_MAX if e is None else int(e) for e in end]
+    axes = list(range(len(starts)))
+    steps = [1 if (i >= len(step) or step[i] is None) else int(step[i])
+             for i in range(len(starts))]
+    return [{"op_type": "Slice", "name": node.name,
+             "inputs": [ins[0],
+                        ctx.add_initializer(
+                            "starts", np.asarray(starts, np.int64)),
+                        ctx.add_initializer(
+                            "ends", np.asarray(ends, np.int64)),
+                        ctx.add_initializer(
+                            "axes", np.asarray(axes, np.int64)),
+                        ctx.add_initializer(
+                            "steps", np.asarray(steps, np.int64))],
+             "outputs": [out], "attrs": {}}]
+
+
+@mx2onnx("slice_like")
+def _slice_like(node, ins, out, attrs, ctx):
+    like_shape = ctx.shape_of.get(ins[1])
+    if like_shape is None:
+        raise MXNetError(
+            "ONNX export: slice_like needs shape inference — pass "
+            "in_shapes to export (the 'like' tensor's static shape "
+            "becomes the Slice ends)")
+    axes = attrs.get("axes")
+    x_rank = len(ctx.shape_of.get(ins[0], like_shape))
+    if axes is None:
+        axes = list(range(min(x_rank, len(like_shape))))
+    else:
+        axes = [int(a) % x_rank
+                for a in (axes if isinstance(axes, (tuple, list))
+                          else [axes])]
+    starts = [0] * len(axes)
+    ends = [int(like_shape[a]) for a in axes]
+    return [{"op_type": "Slice", "name": node.name,
+             "inputs": [ins[0],
+                        ctx.add_initializer(
+                            "starts", np.asarray(starts, np.int64)),
+                        ctx.add_initializer(
+                            "ends", np.asarray(ends, np.int64)),
+                        ctx.add_initializer(
+                            "axes", np.asarray(axes, np.int64))],
+             "outputs": [out], "attrs": {}}]
+
+
+def _attention_core_nodes(n, ctx, q_name, k_name, v_name, B, Sq, Sk, H, D,
+                          causal, out):
+    """Shared ONNX attention decomposition: q/k/v are (B,S,C)-shaped
+    tensor names; emits reshape→transpose→MatMul→Softmax→MatMul→merge."""
+    C = H * D
+    nodes = []
+
+    def reshape_t(tag, src, S, perm):
+        shp = ctx.add_initializer(
+            "shape", np.asarray([B, S, H, D], np.int64))
+        nodes.append({"op_type": "Reshape", "name": f"{n}_{tag}r",
+                      "inputs": [src, shp], "outputs": [f"{n}_{tag}r"],
+                      "attrs": {}})
+        nodes.append({"op_type": "Transpose", "name": f"{n}_{tag}t",
+                      "inputs": [f"{n}_{tag}r"], "outputs": [f"{n}_{tag}t"],
+                      "attrs": {"perm": list(perm)}})
+        return f"{n}_{tag}t"
+
+    qt = reshape_t("q", q_name, Sq, (0, 2, 1, 3))      # (B,H,Sq,D)
+    kt = reshape_t("k", k_name, Sk, (0, 2, 3, 1))      # (B,H,D,Sk)
+    vt = reshape_t("v", v_name, Sk, (0, 2, 1, 3))      # (B,H,Sk,D)
+    nodes.append({"op_type": "MatMul", "name": f"{n}_qk",
+                  "inputs": [qt, kt], "outputs": [f"{n}_scores"],
+                  "attrs": {}})
+    scale = ctx.add_initializer("scale", np.float32(D ** -0.5))
+    nodes.append({"op_type": "Mul", "name": f"{n}_scl",
+                  "inputs": [f"{n}_scores", scale],
+                  "outputs": [f"{n}_scaled"], "attrs": {}})
+    probs_in = f"{n}_scaled"
+    if causal:
+        mask = np.triu(np.full((Sq, Sk), -1e9, np.float32), k=1)
+        mname = ctx.add_initializer("causal_mask",
+                                    mask.reshape(1, 1, Sq, Sk))
+        nodes.append({"op_type": "Add", "name": f"{n}_mask",
+                      "inputs": [probs_in, mname],
+                      "outputs": [f"{n}_masked"], "attrs": {}})
+        probs_in = f"{n}_masked"
+    nodes.append({"op_type": "Softmax", "name": f"{n}_sm",
+                  "inputs": [probs_in], "outputs": [f"{n}_probs"],
+                  "attrs": {"axis": -1}})
+    nodes.append({"op_type": "MatMul", "name": f"{n}_av",
+                  "inputs": [f"{n}_probs", vt], "outputs": [f"{n}_ctxv"],
+                  "attrs": {}})
+    nodes.append({"op_type": "Transpose", "name": f"{n}_ot",
+                  "inputs": [f"{n}_ctxv"], "outputs": [f"{n}_otv"],
+                  "attrs": {"perm": [0, 2, 1, 3]}})
+    oshp = ctx.add_initializer("shape", np.asarray([B, Sq, C], np.int64))
+    nodes.append({"op_type": "Reshape", "name": n,
+                  "inputs": [f"{n}_otv", oshp], "outputs": [out],
+                  "attrs": {}})
+    return nodes
+
+
+@mx2onnx("_contrib_fused_self_attention")
+def _fused_self_attention(node, ins, out, attrs, ctx):
+    shape = ctx.shape_of.get(ins[0])
+    if shape is None:
+        raise MXNetError("ONNX export: fused_self_attention needs shape "
+                         "inference — pass in_shapes to export")
+    B, S, C3 = shape
+    C = C3 // 3
+    H = int(attrs["heads"])
+    D = C // H
+    n = node.name
+    # Split (B,S,3C) into q/k/v along the last axis (opset-13 sizes input)
+    sizes = ctx.add_initializer("split",
+                                np.asarray([C, C, C], np.int64))
+    nodes = [{"op_type": "Split", "name": f"{n}_split",
+              "inputs": [ins[0], sizes],
+              "outputs": [f"{n}_q", f"{n}_k", f"{n}_v"],
+              "attrs": {"axis": 2}}]
+    nodes += _attention_core_nodes(
+        n, ctx, f"{n}_q", f"{n}_k", f"{n}_v", B, S, S, H, D,
+        bool(attrs.get("causal")), out)
+    return nodes
+
+
+@mx2onnx("_contrib_fused_cross_attention")
+def _fused_cross_attention(node, ins, out, attrs, ctx):
+    qshape = ctx.shape_of.get(ins[0])
+    kvshape = ctx.shape_of.get(ins[1])
+    if qshape is None or kvshape is None:
+        raise MXNetError("ONNX export: fused_cross_attention needs shape "
+                         "inference — pass in_shapes to export")
+    B, Sq, C = qshape
+    Sk = kvshape[1]
+    H = int(attrs["heads"])
+    D = C // H
+    n = node.name
+    sizes = ctx.add_initializer("split", np.asarray([C, C], np.int64))
+    nodes = [{"op_type": "Split", "name": f"{n}_split",
+              "inputs": [ins[1], sizes],
+              "outputs": [f"{n}_k", f"{n}_v"], "attrs": {"axis": 2}}]
+    nodes += _attention_core_nodes(n, ctx, ins[0], f"{n}_k", f"{n}_v",
+                                   B, Sq, Sk, H, D, False, out)
+    return nodes
+
+
+@mx2onnx("mean")
+def _mean(node, ins, out, attrs, ctx):
+    axes = attrs.get("axis")
+    a = {"keepdims": int(bool(attrs.get("keepdims", False)))}
+    if axes is not None:
+        a["axes"] = list(axes) if isinstance(axes, (tuple, list)) \
+            else [int(axes)]
+    return [{"op_type": "ReduceMean", "name": node.name, "inputs": ins,
+             "outputs": [out], "attrs": a}]
+
+
+def export_graph(sym, params, in_shapes=None, in_types=None,
+                 graph_name="mxnet_tpu"):
+    """Symbol + params -> dict-proto model (pure data transform, no I/O).
+
+    ``params``: {name: array} — "arg:"/"aux:" prefixes accepted.
+    ``in_shapes``/``in_types``: per data input, in list_arguments order.
+    """
+    params = {(k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k):
+              np.asarray(getattr(v, "asnumpy", lambda: v)())
+              for k, v in (params or {}).items()}
+    ctx = _Ctx(params)
+    topo = sym._topo()
+    out_syms = sym._output_symbols() if hasattr(sym, "_output_symbols") \
+        else [sym]
+
+    # static shape map for shape-dependent converters (slice_like, the
+    # fused attention decompositions): infer every internal tensor's
+    # shape from the declared input shapes + param shapes
+    ctx.shape_of = {}
+    if in_shapes:
+        kw = {k: tuple(v.shape) for k, v in params.items()}
+        i_data = 0
+        for node in topo:
+            if node.op is None and node.name not in params:
+                if i_data < len(in_shapes):
+                    kw[node.name] = tuple(in_shapes[i_data])
+                i_data += 1
+        try:
+            internals = sym.get_internals()
+            _, out_shp, _ = internals.infer_shape(**kw)
+            for s, shp in zip(internals, out_shp):
+                if shp is not None:
+                    ctx.shape_of[ctx.tname(s)] = tuple(shp)
+        except Exception:
+            pass      # shape-dependent converters will raise with advice
+
+    data_inputs = []
+    initializers = [{"name": k, "data": v} for k, v in params.items()]
+    nodes = []
+    n_data = 0
+    for node in topo:
+        if node.op is None:
+            if node.name not in params:
+                shape = tuple(in_shapes[n_data]) if in_shapes else None
+                dtype = (in_types[n_data] if in_types else "float32")
+                data_inputs.append({"name": node.name,
+                                    "dtype": str(np.dtype(dtype)),
+                                    "shape": shape})
+                n_data += 1
+            continue
+        if node.op == "_group":
+            continue
+        conv = _EXPORTERS.get(node.op)
+        if conv is None:
+            raise MXNetError(
+                f"ONNX export: no converter for op {node.op!r} "
+                f"(node {node.name!r}); register one with "
+                f"@mxnet_tpu.contrib.onnx.mx2onnx.mx2onnx")
+        ins = [ctx.tname(s) for s in node.inputs]
+        out = ctx.out_name(node)
+        nodes.extend(conv(node, ins, out, dict(node.attrs), ctx))
+    initializers.extend(ctx.extra_initializers)
+
+    outputs = []
+    for s in out_syms:
+        nm = ctx.tname(s)
+        outputs.append({"name": nm, "dtype": "float32", "shape": None})
+    used = set()
+    for n in nodes:
+        used.update(n["inputs"])
+    used.update(o["name"] for o in outputs)
+    initializers = [t for t in initializers if t["name"] in used]
+    return {"ir_version": 8, "opset": 17, "producer_name": "mxnet_tpu",
+            "graph": {"name": graph_name, "nodes": nodes,
+                      "initializers": initializers,
+                      "inputs": data_inputs, "outputs": outputs}}

@@ -1,0 +1,464 @@
+"""ONNX graph -> Symbol conversion (ref: python/mxnet/contrib/onnx/
+onnx2mx/_op_translations.py). Returns (sym, arg_params, aux_params) like
+the reference's import_model; the importer registry is open (@onnx2mx)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+
+_IMPORTERS = {}
+
+
+def onnx2mx(op_type):
+    def deco(fn):
+        _IMPORTERS[op_type] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    def __init__(self, use_count=None):
+        self.tensors = {}       # tensor name -> Symbol
+        self.params = {}        # param name -> np.ndarray
+        self.aux_names = set()
+        self.use_count = use_count or {}
+
+    def sym(self, name):
+        if name not in self.tensors:
+            raise MXNetError(f"ONNX import: undefined tensor {name!r} "
+                             f"(graph not topologically ordered?)")
+        return self.tensors[name]
+
+    def const_value(self, name):
+        """The numpy value behind an initializer input (e.g. Reshape's
+        shape). Non-destructive: initializers the rebuilt graph no longer
+        references are filtered out at the end of import_graph."""
+        if name not in self.params:
+            raise MXNetError(
+                f"ONNX import: input {name!r} must be a constant "
+                f"initializer for this op")
+        return self.params[name]
+
+    def transform_param(self, name, fn):
+        """Apply a value transform (transpose/scale) to an initializer.
+        A shared initializer (used by several nodes) is copied under a
+        fresh name so other consumers see the original value; returns the
+        name to reference."""
+        if self.use_count.get(name, 1) > 1:
+            new = name
+            i = 1
+            while new in self.params:
+                new = f"{name}__t{i}"
+                i += 1
+            self.params[new] = fn(self.params[name])
+            from ...symbol import var
+            self.tensors[new] = var(new)
+            return new
+        self.params[name] = fn(self.params[name])
+        return name
+
+
+def _sym_mod():
+    from ... import symbol
+    return symbol
+
+
+def _sympair(pads, op):
+    pads = list(pads or [])
+    if not pads:
+        return None
+    half = len(pads) // 2
+    begin, end = pads[:half], pads[half:]
+    if begin != end:
+        raise MXNetError(f"ONNX import: asymmetric pads {pads} not "
+                         f"supported for {op}")
+    return tuple(begin)
+
+
+@onnx2mx("Conv")
+def _conv(node, ins, attrs, ctx):
+    sym = _sym_mod()
+    wname = node["inputs"][1]
+    if wname not in ctx.params:
+        raise MXNetError("ONNX import: Conv weight must be an initializer")
+    wshape = ctx.params[wname].shape
+    kernel = tuple(attrs.get("kernel_shape") or wshape[2:])
+    return sym.Convolution(
+        *ins, kernel=kernel,
+        stride=tuple(attrs.get("strides") or (1,) * len(kernel)),
+        dilate=tuple(attrs.get("dilations") or (1,) * len(kernel)),
+        pad=_sympair(attrs.get("pads"), "Conv") or (0,) * len(kernel),
+        num_filter=int(wshape[0]),
+        num_group=int(attrs.get("group", 1)),
+        no_bias=len(ins) < 3, name=node.get("name") or None)
+
+
+@onnx2mx("Gemm")
+def _gemm(node, ins, attrs, ctx):
+    sym = _sym_mod()
+    if int(attrs.get("transA", 0)):
+        raise MXNetError("ONNX import: Gemm transA=1 unsupported")
+    wname = node["inputs"][1]
+    if wname not in ctx.params:
+        raise MXNetError("ONNX import: Gemm B must be an initializer")
+    alpha = float(attrs.get("alpha", 1.0))
+    trans_b = int(attrs.get("transB", 0))
+    if not trans_b or alpha != 1.0:
+        wname = ctx.transform_param(
+            wname, lambda w: (w if trans_b
+                              else np.ascontiguousarray(w.T)) * alpha)
+    w = ctx.params[wname]
+    beta = float(attrs.get("beta", 1.0))
+    bias = []
+    if len(node["inputs"]) > 2 and node["inputs"][2]:
+        # C omitted via empty-string input name is legal ONNX
+        bname = node["inputs"][2]
+        if beta != 1.0 and bname in ctx.params:
+            bname = ctx.transform_param(bname, lambda b: b * beta)
+        bias = [ctx.sym(bname)]
+    return sym.FullyConnected(ins[0], ctx.sym(wname), *bias,
+                              num_hidden=int(w.shape[0]),
+                              no_bias=not bias, flatten=True,
+                              name=node.get("name") or None)
+
+
+@onnx2mx("MatMul")
+def _matmul(node, ins, attrs, ctx):
+    sym = _sym_mod()
+    wname = node["inputs"][1]
+    if wname in ctx.params and ctx.params[wname].ndim == 2:
+        wname = ctx.transform_param(
+            wname, lambda w: np.ascontiguousarray(w.T))
+        return sym.FullyConnected(
+            ins[0], ctx.sym(wname),
+            num_hidden=int(ctx.params[wname].shape[0]), no_bias=True,
+            flatten=False, name=node.get("name") or None)
+    # general case: ONNX MatMul is numpy-matmul (batched over leading
+    # dims) — batch_dot here is jnp.matmul, the exact semantics
+    return sym.batch_dot(*ins, name=node.get("name") or None)
+
+
+@onnx2mx("BatchNormalization")
+def _bn(node, ins, attrs, ctx):
+    sym = _sym_mod()
+    for nm in node["inputs"][3:5]:
+        ctx.aux_names.add(nm)
+    return sym.BatchNorm(*ins, eps=float(attrs.get("epsilon", 1e-5)),
+                         momentum=float(attrs.get("momentum", 0.9)),
+                         fix_gamma=False, use_global_stats=False,
+                         name=node.get("name") or None)
+
+
+for _onnx, _act in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
+                    ("Tanh", "tanh"), ("Softplus", "softrelu"),
+                    ("Softsign", "softsign")]:
+    def _make_act(act_type):
+        def conv(node, ins, attrs, ctx):
+            return _sym_mod().Activation(ins[0], act_type=act_type,
+                                         name=node.get("name") or None)
+        return conv
+    _IMPORTERS[_onnx] = _make_act(_act)
+
+for _onnx, _mx in [("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"),
+                   ("Abs", "abs"), ("Neg", "negative"), ("Erf", "erf"),
+                   ("Floor", "floor"), ("Ceil", "ceil")]:
+    def _make_unary(mx_name):
+        def conv(node, ins, attrs, ctx):
+            return getattr(_sym_mod(), mx_name)(
+                ins[0], name=node.get("name") or None)
+        return conv
+    _IMPORTERS[_onnx] = _make_unary(_mx)
+
+for _onnx, _mx in [("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+                   ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
+                   ("Max", "broadcast_maximum"),
+                   ("Min", "broadcast_minimum")]:
+    def _make_binary(mx_name):
+        def conv(node, ins, attrs, ctx):
+            return getattr(_sym_mod(), mx_name)(
+                ins[0], ins[1], name=node.get("name") or None)
+        return conv
+    _IMPORTERS[_onnx] = _make_binary(_mx)
+
+
+def _pool(node, ins, attrs, ctx, ptype, global_pool):
+    sym = _sym_mod()
+    if global_pool:
+        return sym.Pooling(ins[0], kernel=(1, 1), pool_type=ptype,
+                           global_pool=True,
+                           name=node.get("name") or None)
+    kernel = tuple(attrs["kernel_shape"])
+    return sym.Pooling(
+        ins[0], kernel=kernel, pool_type=ptype,
+        stride=tuple(attrs.get("strides") or (1,) * len(kernel)),
+        pad=_sympair(attrs.get("pads"), "Pool") or (0,) * len(kernel),
+        pooling_convention="full" if int(attrs.get("ceil_mode", 0))
+        else "valid",
+        count_include_pad=bool(attrs.get("count_include_pad", 0)),
+        name=node.get("name") or None)
+
+
+_IMPORTERS["MaxPool"] = lambda n, i, a, c: _pool(n, i, a, c, "max", False)
+_IMPORTERS["AveragePool"] = lambda n, i, a, c: _pool(n, i, a, c, "avg",
+                                                     False)
+_IMPORTERS["GlobalMaxPool"] = lambda n, i, a, c: _pool(n, i, a, c, "max",
+                                                       True)
+_IMPORTERS["GlobalAveragePool"] = lambda n, i, a, c: _pool(n, i, a, c,
+                                                           "avg", True)
+
+
+@onnx2mx("Flatten")
+def _flatten(node, ins, attrs, ctx):
+    axis = int(attrs.get("axis", 1))
+    if axis != 1:
+        raise MXNetError(f"ONNX import: Flatten axis={axis} unsupported")
+    return _sym_mod().Flatten(ins[0], name=node.get("name") or None)
+
+
+@onnx2mx("Softmax")
+def _softmax(node, ins, attrs, ctx):
+    return _sym_mod().softmax(ins[0], axis=int(attrs.get("axis", -1)),
+                              name=node.get("name") or None)
+
+
+@onnx2mx("LogSoftmax")
+def _log_softmax(node, ins, attrs, ctx):
+    return _sym_mod().log_softmax(ins[0], axis=int(attrs.get("axis", -1)),
+                                  name=node.get("name") or None)
+
+
+@onnx2mx("Reshape")
+def _reshape(node, ins, attrs, ctx):
+    shape = tuple(int(s) for s in ctx.const_value(node["inputs"][1]))
+    return _sym_mod().reshape(ins[0], shape=shape,
+                              name=node.get("name") or None)
+
+
+@onnx2mx("Transpose")
+def _transpose(node, ins, attrs, ctx):
+    return _sym_mod().transpose(ins[0],
+                                axes=tuple(attrs.get("perm") or ()),
+                                name=node.get("name") or None)
+
+
+@onnx2mx("Concat")
+def _concat(node, ins, attrs, ctx):
+    return _sym_mod().Concat(*ins, dim=int(attrs.get("axis", 1)),
+                             name=node.get("name") or None)
+
+
+@onnx2mx("Clip")
+def _clip(node, ins, attrs, ctx):
+    lo = attrs.get("min")
+    hi = attrs.get("max")
+    if lo is None and len(node["inputs"]) > 1 and node["inputs"][1]:
+        lo = float(ctx.const_value(node["inputs"][1]))
+    if hi is None and len(node["inputs"]) > 2 and node["inputs"][2]:
+        hi = float(ctx.const_value(node["inputs"][2]))
+    # ONNX spec: absent bound means unbounded on that side
+    lo = float(lo) if lo is not None else float(np.finfo(np.float32).min)
+    hi = float(hi) if hi is not None else float(np.finfo(np.float32).max)
+    return _sym_mod().clip(ins[0], a_min=lo, a_max=hi,
+                           name=node.get("name") or None)
+
+
+@onnx2mx("LeakyRelu")
+def _leaky(node, ins, attrs, ctx):
+    return _sym_mod().LeakyReLU(ins[0], act_type="leaky",
+                                slope=float(attrs.get("alpha", 0.01)),
+                                name=node.get("name") or None)
+
+
+@onnx2mx("Elu")
+def _elu(node, ins, attrs, ctx):
+    return _sym_mod().LeakyReLU(ins[0], act_type="elu",
+                                slope=float(attrs.get("alpha", 1.0)),
+                                name=node.get("name") or None)
+
+
+@onnx2mx("ReduceMean")
+def _reduce_mean(node, ins, attrs, ctx):
+    return _sym_mod().mean(ins[0], axis=tuple(attrs.get("axes") or ()),
+                           keepdims=bool(attrs.get("keepdims", 1)),
+                           name=node.get("name") or None)
+
+
+@onnx2mx("Dropout")
+def _dropout(node, ins, attrs, ctx):
+    return ins[0]                 # inference identity
+
+
+@onnx2mx("Identity")
+def _identity(node, ins, attrs, ctx):
+    return ins[0]
+
+
+@onnx2mx("Cast")
+def _cast(node, ins, attrs, ctx):
+    _DT = {1: "float32", 6: "int32", 7: "int64", 9: "bool",
+           10: "float16", 11: "float64", 16: "bfloat16"}
+    to = _DT.get(int(attrs.get("to", 1)))
+    if to is None:
+        raise MXNetError(f"ONNX import: Cast to {attrs.get('to')} "
+                         f"unsupported")
+    return _sym_mod().cast(ins[0], dtype=to,
+                           name=node.get("name") or None)
+
+
+@onnx2mx("Gather")
+def _gather(node, ins, attrs, ctx):
+    return _sym_mod().take(ins[0], ins[1],
+                           axis=int(attrs.get("axis", 0)),
+                           name=node.get("name") or None)
+
+
+@onnx2mx("LayerNormalization")
+def _layer_normalization(node, ins, attrs, ctx):
+    return _sym_mod().LayerNorm(
+        ins[0], ins[1], ins[2], axis=int(attrs.get("axis", -1)),
+        eps=float(attrs.get("epsilon", 1e-5)),
+        name=node.get("name") or None)
+
+
+def _axes_arg(node, ins, attrs, ctx, input_idx):
+    """opset-13 moved Unsqueeze/Squeeze axes from attr to input."""
+    if len(node["inputs"]) > input_idx and node["inputs"][input_idx]:
+        return [int(a) for a in
+                np.asarray(ctx.const_value(
+                    node["inputs"][input_idx])).ravel()]
+    a = attrs.get("axes")
+    return None if a is None else [int(v) for v in a]
+
+
+@onnx2mx("Unsqueeze")
+def _unsqueeze(node, ins, attrs, ctx):
+    axes = _axes_arg(node, ins, attrs, ctx, 1)
+    s = ins[0]
+    for ax in sorted(axes):
+        s = _sym_mod().expand_dims(s, axis=ax)
+    return s
+
+
+@onnx2mx("Squeeze")
+def _squeeze(node, ins, attrs, ctx):
+    axes = _axes_arg(node, ins, attrs, ctx, 1)
+    return _sym_mod().squeeze(
+        ins[0], axis=tuple(axes) if axes is not None else None,
+        name=node.get("name") or None)
+
+
+@onnx2mx("Slice")
+def _slice(node, ins, attrs, ctx):
+    names = node["inputs"]
+    if len(names) >= 3:           # opset-10+: starts/ends[/axes] inputs
+        starts = [int(v) for v in
+                  np.asarray(ctx.const_value(names[1])).ravel()]
+        ends = [int(v) for v in
+                np.asarray(ctx.const_value(names[2])).ravel()]
+        axes = ([int(v) for v in
+                 np.asarray(ctx.const_value(names[3])).ravel()]
+                if len(names) > 3 and names[3]
+                else list(range(len(starts))))
+        if len(names) > 4 and names[4]:
+            steps = [int(v) for v in
+                     np.asarray(ctx.const_value(names[4])).ravel()]
+            if any(s != 1 for s in steps):
+                # strided slice: representable when axes are the leading
+                # dims in order (the form our exporter emits)
+                if list(axes) != list(range(len(axes))):
+                    raise MXNetError("ONNX import: strided Slice over "
+                                     "non-leading axes unsupported")
+                big = np.iinfo(np.int64).max
+                return _sym_mod().slice(
+                    ins[0], begin=tuple(starts),
+                    end=tuple(None if e >= big // 2 else e for e in ends),
+                    step=tuple(steps), name=node.get("name") or None)
+    else:                          # opset-1 attrs form
+        starts = [int(v) for v in attrs.get("starts", [])]
+        ends = [int(v) for v in attrs.get("ends", [])]
+        axes = [int(v) for v in
+                attrs.get("axes", range(len(starts)))]
+    big = np.iinfo(np.int64).max
+    s = ins[0]
+    for ax, b, e in zip(axes, starts, ends):
+        s = _sym_mod().slice_axis(s, axis=ax, begin=b,
+                                  end=None if e >= big // 2 else e)
+    return s
+
+
+@onnx2mx("Split")
+def _split(node, ins, attrs, ctx):
+    names = node["inputs"]
+    axis = int(attrs.get("axis", 0))
+    if len(names) > 1 and names[1]:
+        sizes = [int(v) for v in
+                 np.asarray(ctx.const_value(names[1])).ravel()]
+    elif attrs.get("split"):
+        sizes = [int(v) for v in attrs["split"]]
+    else:
+        raise MXNetError("ONNX import: Split without sizes needs the "
+                         "output count — unsupported")
+    outs = []
+    off = 0
+    for sz in sizes:
+        outs.append(_sym_mod().slice_axis(ins[0], axis=axis, begin=off,
+                                          end=off + sz))
+        off += sz
+    return outs
+
+
+@onnx2mx("Constant")
+def _constant(node, ins, attrs, ctx):
+    val = attrs.get("value")
+    if val is None:
+        raise MXNetError("ONNX import: Constant without value")
+    name = node["outputs"][0]
+    ctx.params[name] = np.asarray(val)
+    return _sym_mod().var(name)
+
+
+def import_graph(model):
+    """dict-proto model -> (sym, arg_params {name: np}, aux_params)."""
+    from ...symbol import Group, var
+    g = model["graph"]
+    use_count = {}
+    for node in g["nodes"]:
+        for n in node["inputs"]:
+            use_count[n] = use_count.get(n, 0) + 1
+    ctx = _Ctx(use_count)
+    for t in g.get("initializers", []):
+        ctx.params[t["name"]] = np.asarray(t["data"])
+        ctx.tensors[t["name"]] = var(t["name"])
+    for vi in g["inputs"]:
+        if vi["name"] not in ctx.tensors:
+            ctx.tensors[vi["name"]] = var(vi["name"])
+    for node in g["nodes"]:
+        imp = _IMPORTERS.get(node["op_type"])
+        if imp is None:
+            raise MXNetError(
+                f"ONNX import: no converter for op_type "
+                f"{node['op_type']!r} (node {node.get('name')!r}); "
+                f"register one with "
+                f"@mxnet_tpu.contrib.onnx.onnx2mx.onnx2mx")
+        ins = [ctx.sym(n) for n in node["inputs"] if n]
+        out_syms = imp(node, ins, node.get("attrs", {}), ctx)
+        outs = node["outputs"]
+        if not isinstance(out_syms, (list, tuple)):
+            out_syms = [out_syms]
+        for nm, s in zip(outs, out_syms):
+            ctx.tensors[nm] = s
+    out_names = [o["name"] for o in g["outputs"]]
+    outs = [ctx.sym(n) for n in out_names]
+    sym = outs[0] if len(outs) == 1 else Group(outs)
+    # split params by BN-aux slots; keep only tensors the rebuilt graph
+    # still references (constant-only inputs like Reshape shapes drop out
+    # here naturally — they never become graph variables)
+    ref_args = set(sym.list_arguments())
+    ref_aux = set(sym.list_auxiliary_states())
+    arg_params = {k: v for k, v in ctx.params.items()
+                  if k in ref_args and k not in ctx.aux_names}
+    aux_params = {k: v for k, v in ctx.params.items()
+                  if k in ref_aux or (k in ctx.aux_names
+                                      and k in ref_aux | ref_args)}
+    return sym, arg_params, aux_params

@@ -1,0 +1,390 @@
+"""Self-contained ONNX protobuf wire codec.
+
+The environment has no ``onnx`` pip and no network, but the ONNX file
+format is just protobuf wire encoding of a stable, documented schema
+(onnx/onnx.proto). This module encodes/decodes the subset of that schema
+the converters use — ModelProto / GraphProto / NodeProto / AttributeProto /
+TensorProto / ValueInfoProto — directly to/from bytes, so ``export_model``
+writes real ``.onnx`` files that the official ``onnx``/onnxruntime stack
+can load, and ``import_model`` reads files they produce. No third-party
+dependency involved (ref: python/mxnet/contrib/onnx/ requires the onnx
+pip for the same job).
+
+The in-memory representation is plain dicts/lists ("dict-proto"):
+
+    model = {"ir_version": 8, "opset": 13, "producer_name": "mxnet_tpu",
+             "graph": {"name": str,
+                       "inputs":  [{"name", "dtype", "shape"}],
+                       "outputs": [{"name", "dtype", "shape"}],
+                       "initializers": [{"name", "data": np.ndarray}],
+                       "nodes": [{"op_type", "name", "inputs": [str],
+                                  "outputs": [str], "attrs": {...}}]}}
+
+Attr values may be int, float, str, bytes, list[int], list[float],
+or np.ndarray (encoded as a TensorProto attribute).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ...base import MXNetError
+
+# ONNX TensorProto.DataType enum (onnx.proto) <-> numpy
+DTYPE_TO_ONNX = {
+    np.dtype("float32"): 1, np.dtype("uint8"): 2, np.dtype("int8"): 3,
+    np.dtype("uint16"): 4, np.dtype("int16"): 5, np.dtype("int32"): 6,
+    np.dtype("int64"): 7, np.dtype("bool"): 9, np.dtype("float16"): 10,
+    np.dtype("float64"): 11, np.dtype("uint32"): 12, np.dtype("uint64"): 13,
+}
+ONNX_TO_DTYPE = {v: k for k, v in DTYPE_TO_ONNX.items()}
+ONNX_TO_DTYPE[16] = np.dtype("float32")  # bfloat16 tensors load as fp32
+
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+def _varint(n):
+    n &= 0xFFFFFFFFFFFFFFFF
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _key(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _ld(field, payload):                      # length-delimited
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def _vint(field, value):                      # varint field (int64 semantics)
+    return _key(field, 0) + _varint(int(value))
+
+
+def _vstr(field, s):
+    return _ld(field, s.encode() if isinstance(s, str) else s)
+
+
+def _vfloat(field, f):                        # 32-bit float field
+    return _key(field, 5) + struct.pack("<f", float(f))
+
+
+def _read_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _signed(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _fields(buf):
+    """Iterate (field_number, wire_type, value) over a message payload."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise MXNetError(f"unsupported protobuf wire type {wire}")
+        yield field, wire, v
+
+
+def _packed_varints(payload):
+    out, pos = [], 0
+    while pos < len(payload):
+        v, pos = _read_varint(payload, pos)
+        out.append(_signed(v))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# encoding
+# ---------------------------------------------------------------------------
+def _enc_tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in DTYPE_TO_ONNX:
+        raise MXNetError(f"ONNX export: unsupported dtype {arr.dtype}")
+    out = b"".join(_vint(1, d) for d in arr.shape)
+    out += _vint(2, DTYPE_TO_ONNX[arr.dtype])
+    out += _vstr(8, name)
+    out += _ld(9, arr.tobytes())              # raw_data, little-endian
+    return out
+
+
+def _enc_attr(name, value):
+    out = _vstr(1, name)
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, float):
+        out += _vfloat(2, value) + _vint(20, 1)           # FLOAT
+    elif isinstance(value, int):
+        out += _vint(3, value) + _vint(20, 2)             # INT
+    elif isinstance(value, (str, bytes)):
+        out += _vstr(4, value) + _vint(20, 3)             # STRING
+    elif isinstance(value, np.ndarray):
+        out += _ld(5, _enc_tensor(name + "_t", value)) + _vint(20, 4)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            out += b"".join(_key(7, 5) + struct.pack("<f", v)
+                            for v in value) + _vint(20, 6)   # FLOATS
+        else:
+            out += b"".join(_vint(8, int(v)) for v in value) \
+                + _vint(20, 7)                               # INTS
+    else:
+        raise MXNetError(f"ONNX export: bad attribute {name}={value!r}")
+    return out
+
+
+def _enc_value_info(vi):
+    tensor_type = _vint(1, DTYPE_TO_ONNX[np.dtype(vi.get("dtype",
+                                                         "float32"))])
+    shape = vi.get("shape")
+    if shape is not None:
+        # absent shape field = unknown rank (ONNX semantics), encoded as
+        # shape=None; shape=() is a genuine rank-0 scalar and gets an
+        # empty TensorShapeProto
+        shape_msg = b"".join(
+            _ld(1, _vint(1, d) if isinstance(d, int) and d > 0
+                else _vstr(2, str(d or "?")))
+            for d in shape)
+        tensor_type += _ld(2, shape_msg)
+    return _vstr(1, vi["name"]) + _ld(2, _ld(1, tensor_type))
+
+
+def _enc_node(node):
+    out = b"".join(_vstr(1, i) for i in node["inputs"])
+    out += b"".join(_vstr(2, o) for o in node["outputs"])
+    out += _vstr(3, node.get("name", node["outputs"][0]))
+    out += _vstr(4, node["op_type"])
+    out += b"".join(_ld(5, _enc_attr(k, v))
+                    for k, v in sorted(node.get("attrs", {}).items()))
+    return out
+
+
+def encode_model(model):
+    """dict-proto -> ONNX ModelProto bytes."""
+    g = model["graph"]
+    graph = b"".join(_ld(1, _enc_node(n)) for n in g["nodes"])
+    graph += _vstr(2, g.get("name", "mxnet_tpu"))
+    graph += b"".join(_ld(5, _enc_tensor(t["name"], np.asarray(t["data"])))
+                      for t in g.get("initializers", []))
+    graph += b"".join(_ld(11, _enc_value_info(v)) for v in g["inputs"])
+    graph += b"".join(_ld(12, _enc_value_info(v)) for v in g["outputs"])
+    out = _vint(1, model.get("ir_version", 8))
+    out += _vstr(2, model.get("producer_name", "mxnet_tpu"))
+    out += _ld(8, _vstr(1, "") + _vint(2, model.get("opset", 13)))
+    out += _ld(7, graph)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# decoding
+# ---------------------------------------------------------------------------
+def _dec_tensor(buf):
+    dims, dtype, raw, name = [], 1, None, ""
+    float_data, int64_data, int32_data, double_data = [], [], [], []
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            dims.extend(_packed_varints(v) if wire == 2 else [_signed(v)])
+        elif field == 2:
+            dtype = v
+        elif field == 4:
+            float_data.extend(
+                struct.unpack(f"<{len(v)//4}f", v) if wire == 2
+                else struct.unpack("<f", v))
+        elif field == 5:
+            int32_data.extend(_packed_varints(v) if wire == 2
+                              else [_signed(v)])
+        elif field == 7:
+            int64_data.extend(_packed_varints(v) if wire == 2
+                              else [_signed(v)])
+        elif field == 8:
+            name = v.decode()
+        elif field == 9:
+            raw = v
+        elif field == 10:
+            double_data.extend(
+                struct.unpack(f"<{len(v)//8}d", v) if wire == 2
+                else struct.unpack("<d", v))
+    np_dtype = ONNX_TO_DTYPE.get(dtype)
+    if np_dtype is None:
+        raise MXNetError(f"ONNX import: unsupported tensor dtype {dtype}")
+    if raw is not None:
+        if dtype == 16:   # bfloat16 raw: widen to fp32
+            u = np.frombuffer(raw, dtype=np.uint16).astype(np.uint32) << 16
+            arr = u.view(np.float32)
+        else:
+            arr = np.frombuffer(raw, dtype=np_dtype)
+    elif float_data:
+        arr = np.asarray(float_data, np.float32)
+    elif double_data:
+        arr = np.asarray(double_data, np.float64)
+    elif int64_data:
+        arr = np.asarray(int64_data, np.int64)
+    elif int32_data:
+        arr = np.asarray(int32_data, np.int32)
+    else:
+        arr = np.zeros(0, np_dtype)
+    return {"name": name, "data": arr.astype(np_dtype, copy=False)
+            .reshape(dims)}
+
+
+def _dec_attr(buf):
+    name, atype = "", None
+    val = {}
+    ints, floats, strs = [], [], []
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            name = v.decode()
+        elif field == 2:
+            val["f"] = struct.unpack("<f", v)[0]
+        elif field == 3:
+            val["i"] = _signed(v)
+        elif field == 4:
+            val["s"] = v
+        elif field == 5:
+            val["t"] = _dec_tensor(v)["data"]
+        elif field == 7:
+            floats.extend(struct.unpack(f"<{len(v)//4}f", v) if wire == 2
+                          else struct.unpack("<f", v))
+        elif field == 8:
+            ints.extend(_packed_varints(v) if wire == 2 else [_signed(v)])
+        elif field == 20:
+            atype = v
+    if atype == 1:
+        return name, val.get("f", 0.0)
+    if atype == 2:
+        return name, val.get("i", 0)
+    if atype == 3:
+        s = val.get("s", b"")
+        try:
+            return name, s.decode()
+        except UnicodeDecodeError:
+            return name, s
+    if atype == 4:
+        return name, val.get("t")
+    if atype == 6:
+        return name, list(floats)
+    if atype == 7:
+        return name, list(ints)
+    # untyped (some exporters omit type when value fields disambiguate)
+    if "f" in val:
+        return name, val["f"]
+    if "i" in val:
+        return name, val["i"]
+    if floats:
+        return name, list(floats)
+    if ints:
+        return name, list(ints)
+    if "s" in val:
+        return name, val["s"].decode()
+    return name, None
+
+
+def _dec_node(buf):
+    node = {"inputs": [], "outputs": [], "attrs": {}, "op_type": "",
+            "name": ""}
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            node["inputs"].append(v.decode())
+        elif field == 2:
+            node["outputs"].append(v.decode())
+        elif field == 3:
+            node["name"] = v.decode()
+        elif field == 4:
+            node["op_type"] = v.decode()
+        elif field == 5:
+            k, val = _dec_attr(v)
+            node["attrs"][k] = val
+    return node
+
+
+def _dec_value_info(buf):
+    out = {"name": "", "dtype": "float32", "shape": ()}
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            out["name"] = v.decode()
+        elif field == 2:                        # TypeProto
+            for f2, w2, v2 in _fields(v):
+                if f2 != 1:                     # tensor_type only
+                    continue
+                for f3, w3, v3 in _fields(v2):
+                    if f3 == 1:
+                        out["dtype"] = str(ONNX_TO_DTYPE.get(v3,
+                                                             "float32"))
+                    elif f3 == 2:               # TensorShapeProto
+                        dims = []
+                        for f4, w4, v4 in _fields(v3):
+                            if f4 != 1:
+                                continue
+                            dim = 0
+                            for f5, w5, v5 in _fields(v4):
+                                if f5 == 1:
+                                    dim = _signed(v5)
+                                elif f5 == 2:
+                                    dim = 0     # symbolic dim -> unknown
+                            dims.append(dim)
+                        out["shape"] = tuple(dims)
+    return out
+
+
+def _dec_graph(buf):
+    g = {"name": "", "nodes": [], "initializers": [], "inputs": [],
+         "outputs": []}
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            g["nodes"].append(_dec_node(v))
+        elif field == 2:
+            g["name"] = v.decode()
+        elif field == 5:
+            g["initializers"].append(_dec_tensor(v))
+        elif field == 11:
+            g["inputs"].append(_dec_value_info(v))
+        elif field == 12:
+            g["outputs"].append(_dec_value_info(v))
+    return g
+
+
+def decode_model(buf):
+    """ONNX ModelProto bytes -> dict-proto."""
+    model = {"ir_version": 0, "opset": 0, "producer_name": "",
+             "graph": None}
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            model["ir_version"] = _signed(v)
+        elif field == 2:
+            model["producer_name"] = v.decode()
+        elif field == 7:
+            model["graph"] = _dec_graph(v)
+        elif field == 8:
+            for f2, w2, v2 in _fields(v):
+                if f2 == 2:
+                    model["opset"] = max(model["opset"], _signed(v2))
+    if model["graph"] is None:
+        raise MXNetError("ONNX import: no graph in model file")
+    return model

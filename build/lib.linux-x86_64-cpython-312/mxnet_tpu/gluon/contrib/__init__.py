@@ -1,0 +1,5 @@
+"""gluon.contrib (ref: python/mxnet/gluon/contrib/__init__.py)."""
+from . import nn
+from .nn import Concurrent, HybridConcurrent, Identity
+
+__all__ = ["nn", "Concurrent", "HybridConcurrent", "Identity"]

@@ -1,0 +1,46 @@
+"""gluon.contrib.nn (ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn import HybridSequential, Sequential, SyncBatchNorm
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SyncBatchNorm"]
+
+
+class HybridConcurrent(HybridSequential):
+    """Parallel children concatenated on ``axis``
+    (ref: contrib/nn HybridConcurrent — Inception-style branches)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+    def forward(self, x):
+        from ... import nn as _nn  # noqa: F401
+        from .... import ndarray as F
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class Concurrent(Sequential):
+    """Eager variant (ref: contrib/nn Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as F
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """ref: contrib/nn Identity."""
+
+    def hybrid_forward(self, F, x):
+        return x

@@ -1,0 +1,5 @@
+"""gluon.data (ref: python/mxnet/gluon/data/__init__.py)."""
+from . import vision
+from .dataloader import *   # noqa: F401,F403
+from .dataset import *      # noqa: F401,F403
+from .sampler import *      # noqa: F401,F403

@@ -1,0 +1,3 @@
+"""Vision data (ref: python/mxnet/gluon/data/vision/__init__.py)."""
+from . import transforms
+from .datasets import *     # noqa: F401,F403

@@ -1,0 +1,224 @@
+"""Vision datasets (ref: python/mxnet/gluon/data/vision/datasets.py).
+
+Download is unavailable in this environment (zero egress): every dataset
+reads the standard files from a local ``root`` directory and raises a clear
+error when absent.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ....base import MXNetError
+from ... import nn  # noqa: F401  (parity import)
+from .. import dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        _, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        dt = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32,
+              13: np.float32, 14: np.float64}[dtype_code]
+        return np.frombuffer(f.read(), dtype=dt).reshape(shape)
+
+
+class _DownloadedDataset(dataset.Dataset):
+    def __init__(self, root, transform):
+        self._root = os.path.expanduser(root)
+        self._transform = transform
+        self._data = None
+        self._label = None
+        if not os.path.isdir(self._root):
+            raise MXNetError(
+                f"dataset root {self._root} does not exist; downloads are "
+                f"disabled in this environment — place the standard files "
+                f"there manually")
+        self._get_data()
+
+    def __getitem__(self, idx):
+        from ... import ndarray as _nd_unused  # noqa: F401
+        from .... import ndarray as nd
+        x = nd.array(self._data[idx])
+        y = self._label[idx]
+        if self._transform is not None:
+            return self._transform(x, y)
+        return x, y
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """ref: datasets.py MNIST — reads train-images-idx3-ubyte(.gz) etc."""
+
+    _files = {True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+              False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")}
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        img_name, lbl_name = self._files[self._train]
+        img_path = os.path.join(self._root, img_name)
+        lbl_path = os.path.join(self._root, lbl_name)
+        for p in (img_path, lbl_path):
+            if not os.path.exists(p) and not os.path.exists(p + ".gz"):
+                raise MXNetError(f"missing MNIST file {p}(.gz)")
+        img_path = img_path if os.path.exists(img_path) else img_path + ".gz"
+        lbl_path = lbl_path if os.path.exists(lbl_path) else lbl_path + ".gz"
+        images = _read_idx(img_path)
+        self._data = images.reshape(images.shape[0], images.shape[1],
+                                    images.shape[2], 1)
+        self._label = _read_idx(lbl_path).astype(np.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root=root, train=train, transform=transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """ref: datasets.py CIFAR10 — reads the python-pickle batches or the
+    binary .bin format."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _batches(self):
+        if self._train:
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _get_data(self):
+        # accept either the pickled python version or raw .bin files
+        pickle_dir = os.path.join(self._root, "cifar-10-batches-py")
+        bin_dir = os.path.join(self._root, "cifar-10-batches-bin")
+        tar = os.path.join(self._root, "cifar-10-python.tar.gz")
+        if not os.path.isdir(pickle_dir) and os.path.exists(tar):
+            with tarfile.open(tar) as tf:
+                tf.extractall(self._root)
+        datas, labels = [], []
+        if os.path.isdir(pickle_dir):
+            for name in self._batches():
+                with open(os.path.join(pickle_dir, name), "rb") as f:
+                    entry = pickle.load(f, encoding="latin1")
+                datas.append(np.asarray(entry["data"], dtype=np.uint8)
+                             .reshape(-1, 3, 32, 32))
+                labels.append(np.asarray(entry["labels"], dtype=np.int32))
+        elif os.path.isdir(bin_dir):
+            names = [f"{b}.bin" for b in self._batches()]
+            for name in names:
+                raw = np.fromfile(os.path.join(bin_dir, name),
+                                  dtype=np.uint8).reshape(-1, 3073)
+                labels.append(raw[:, 0].astype(np.int32))
+                datas.append(raw[:, 1:].reshape(-1, 3, 32, 32))
+        else:
+            raise MXNetError(f"no CIFAR-10 files found under {self._root}")
+        self._data = np.concatenate(datas).transpose(0, 2, 3, 1)
+        self._label = np.concatenate(labels)
+
+
+class CIFAR100(_DownloadedDataset):
+    """ref: datasets.py CIFAR100."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._train = train
+        self._fine = fine_label
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        pickle_dir = os.path.join(self._root, "cifar-100-python")
+        name = "train" if self._train else "test"
+        path = os.path.join(pickle_dir, name)
+        if not os.path.exists(path):
+            raise MXNetError(f"no CIFAR-100 files found under {self._root}")
+        with open(path, "rb") as f:
+            entry = pickle.load(f, encoding="latin1")
+        self._data = np.asarray(entry["data"], dtype=np.uint8) \
+            .reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        key = "fine_labels" if self._fine else "coarse_labels"
+        self._label = np.asarray(entry[key], dtype=np.int32)
+
+
+class ImageRecordDataset(dataset.RecordFileDataset):
+    """ref: datasets.py ImageRecordDataset — .rec of packed images."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import recordio
+        from .... import ndarray as nd
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack_img(record, self._flag)
+        x = nd.array(img)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(x, label)
+        return x, label
+
+
+class ImageFolderDataset(dataset.Dataset):
+    """ref: datasets.py ImageFolderDataset — root/class_x/xxx.jpg layout."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        import cv2
+        from .... import ndarray as nd
+        path, label = self.items[idx]
+        img = cv2.imread(path, cv2.IMREAD_COLOR if self._flag
+                         else cv2.IMREAD_GRAYSCALE)
+        if img is None:
+            raise MXNetError(f"failed to read image {path}")
+        if self._flag:
+            img = img[:, :, ::-1].copy()  # BGR→RGB
+        x = nd.array(img)
+        if self._transform is not None:
+            return self._transform(x, label)
+        return x, label
+
+    def __len__(self):
+        return len(self.items)

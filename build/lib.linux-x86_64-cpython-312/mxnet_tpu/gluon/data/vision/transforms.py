@@ -1,0 +1,267 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py).
+
+Transforms are Blocks (same as the reference) so they compose into
+``Compose`` chains and run on host numpy/jnp before batching.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .... import ndarray as nd
+from ...block import Block, HybridBlock
+from ...nn import Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "CropResize"]
+
+
+class Compose(Sequential):
+    """ref: transforms.py Compose — chain of transforms."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+    def forward(self, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (ref: ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        x = x.astype("float32") / 255.0
+        if x.ndim == 3:
+            return F.transpose(x, axes=(2, 0, 1))
+        return F.transpose(x, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std per channel on CHW input (ref: Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = np.asarray(self._mean, dtype=np.float32).reshape(-1, 1, 1)
+        std = np.asarray(self._std, dtype=np.float32).reshape(-1, 1, 1)
+        return (x - nd.array(mean, ctx=x.ctx)) / nd.array(std, ctx=x.ctx)
+
+
+def _resize_hwc(x, w, h, interp=1):
+    import cv2
+    arr = x.asnumpy() if isinstance(x, nd.NDArray) else np.asarray(x)
+    out = cv2.resize(arr, (w, h), interpolation=interp)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out)
+
+
+class Resize(Block):
+    """Resize HWC image (ref: transforms.py Resize; cv2 backend like the
+    reference's src/io/image_aug_default.cc)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        h, w = x.shape[:2]
+        if isinstance(self._size, (list, tuple)):
+            new_w, new_h = self._size
+        elif self._keep:
+            short = min(h, w)
+            scale = self._size / short
+            new_w, new_h = int(round(w * scale)), int(round(h * scale))
+        else:
+            new_w = new_h = self._size
+        return _resize_hwc(x, new_w, new_h, self._interp)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (list, tuple)) else (size, size)
+        self._interp = interpolation
+
+    def forward(self, x):
+        cw, ch = self._size
+        h, w = x.shape[:2]
+        if h < ch or w < cw:
+            x = _resize_hwc(x, max(cw, w), max(ch, h), self._interp)
+            h, w = x.shape[:2]
+        y0, x0 = (h - ch) // 2, (w - cw) // 2
+        return x[y0:y0 + ch, x0:x0 + cw]
+
+
+class CropResize(Block):
+    def __init__(self, x, y, width, height, interpolation=1):
+        super().__init__()
+        self._x, self._y, self._w, self._h = x, y, width, height
+        self._interp = interpolation
+
+    def forward(self, img):
+        out = img[self._y:self._y + self._h, self._x:self._x + self._w]
+        return out
+
+
+class RandomResizedCrop(Block):
+    """Random area/aspect crop resized to size (ref: RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (list, tuple)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        h, w = x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            aspect = np.random.uniform(*self._ratio)
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                x0 = np.random.randint(0, w - cw + 1)
+                y0 = np.random.randint(0, h - ch + 1)
+                crop = x[y0:y0 + ch, x0:x0 + cw]
+                return _resize_hwc(crop, self._size[0], self._size[1],
+                                   self._interp)
+        return CenterCrop(self._size, self._interp)(x)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return nd.array(x.asnumpy()[:, ::-1].copy())
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return nd.array(x.asnumpy()[::-1].copy())
+        return x
+
+
+class RandomBrightness(Block):
+    """ref: transforms.py RandomBrightness — scale by U[max(0,1-b), 1+b]."""
+
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        f = np.random.uniform(max(0, 1 - self._b), 1 + self._b)
+        return (x.astype("float32") * f)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        f = np.random.uniform(max(0, 1 - self._c), 1 + self._c)
+        x = x.astype("float32")
+        arr = x.asnumpy()
+        gray = arr.mean()
+        return nd.array(gray + (arr - gray) * f)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        f = np.random.uniform(max(0, 1 - self._s), 1 + self._s)
+        arr = x.astype("float32").asnumpy()
+        gray = arr.mean(axis=-1, keepdims=True)
+        return nd.array(gray + (arr - gray) * f)
+
+
+class RandomHue(Block):
+    """Approximate hue jitter by channel rotation mixing (the reference
+    uses the HSV transform; this keeps the augmentation cheap and
+    dependency-free)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        t = np.random.uniform(-self._h, self._h) * np.pi
+        arr = x.astype("float32").asnumpy()
+        u, w = np.cos(t), np.sin(t)
+        m = np.array([[0.299, 0.587, 0.114]] * 3)
+        rot = m + u * (np.eye(3) - m) + w * np.array(
+            [[0.0, -0.577, 0.577], [0.577, 0.0, -0.577],
+             [-0.577, 0.577, 0.0]])
+        return nd.array(arr @ rot.T.astype(np.float32))
+
+
+class RandomColorJitter(Block):
+    """ref: transforms.py RandomColorJitter — compose the four jitters in
+    random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (ref: transforms.py
+    RandomLighting)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], dtype=np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.814],
+                        [-0.5836, -0.6948, 0.4203]], dtype=np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = np.random.normal(0, self._alpha, 3).astype(np.float32)
+        noise = (self._eigvec * a * self._eigval).sum(axis=1)
+        return x.astype("float32") + nd.array(noise)
+
+
+__all__ += ["RandomBrightness", "RandomContrast", "RandomSaturation",
+            "RandomHue", "RandomColorJitter", "RandomLighting"]

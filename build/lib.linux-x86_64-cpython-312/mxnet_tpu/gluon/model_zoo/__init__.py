@@ -1,0 +1,6 @@
+"""Model zoo (ref: python/mxnet/gluon/model_zoo/__init__.py; bert adds
+GluonNLP-parity language models)."""
+from . import bert, ssd, transformer, vision
+from .vision import get_model
+
+__all__ = ["vision", "bert", "get_model"]

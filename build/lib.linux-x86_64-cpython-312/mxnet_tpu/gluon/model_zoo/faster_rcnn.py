@@ -1,0 +1,265 @@
+"""Faster R-CNN (driver config #5's second family; ref ecosystem:
+gluoncv model_zoo faster_rcnn + the reference's example/rcnn pipeline:
+src/operator/contrib/proposal.cc, roi_align.cc; rcnn/core targets).
+
+TPU-first composition out of the contrib op set that already exists:
+anchors + RPN head → ``F.contrib.Proposal`` (decode/filter/NMS, static
+shapes, vmapped) → ``F.contrib.ROIAlign`` over fixed-topN RoIs → the
+box head. Target assignment for BOTH stages reuses the tested
+``F.contrib.MultiBoxTarget`` matcher (IoU matching + variance-encoded
+box regression — the same math the reference's rcnn sample_rois /
+assign_anchor do, SSD-style batched instead of per-image loops).
+Everything is static-shape: padded proposals carry batch_idx -1 and are
+masked out of the loss.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ...base import MXNetError
+from .. import nn
+from ..block import HybridBlock
+from ..loss import Loss
+
+__all__ = ["FasterRCNN", "FasterRCNNLoss", "rpn_anchors",
+           "faster_rcnn_resnet"]
+
+
+def rpn_anchors(height, width, feature_stride=16,
+                scales=(8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0)):
+    """All RPN anchors for an (height, width) feature map, PIXEL corner
+    coords (A*H*W, 4) — bit-identical to the Proposal op's generation
+    (ref: proposal.cc GenerateAnchors, legacy (w-1)/2 extents), so loss
+    targets and proposal decode see the SAME anchors."""
+    base = []
+    c = (feature_stride - 1) / 2.0
+    base_size = float(feature_stride)
+    for r in ratios:
+        size = base_size * base_size / r
+        ws = np.sqrt(size)
+        hs = ws * r
+        for s in scales:
+            bw, bh = ws * s, hs * s
+            base.append([c - (bw - 1) / 2, c - (bh - 1) / 2,
+                         c + (bw - 1) / 2, c + (bh - 1) / 2])
+    base = np.asarray(base, np.float32)                    # (A, 4)
+    sx = np.arange(width, dtype=np.float32) * feature_stride
+    sy = np.arange(height, dtype=np.float32) * feature_stride
+    shift = np.stack(np.meshgrid(sx, sy), axis=-1).reshape(-1, 2)
+    shifts = np.concatenate([shift, shift], axis=1)        # (H*W, 4)
+    all_anchors = (shifts[:, None, :] + base[None, :, :])
+    return all_anchors.reshape(-1, 4)
+
+
+class RPNHead(HybridBlock):
+    """3x3 conv + twin 1x1 heads (ref: rcnn symbol rpn_conv/rpn_cls)."""
+
+    def __init__(self, num_anchors, channels=256, **kwargs):
+        super().__init__(**kwargs)
+        self._a = num_anchors
+        with self.name_scope():
+            self.conv = nn.Conv2D(channels, 3, padding=1,
+                                  activation="relu")
+            self.cls = nn.Conv2D(2 * num_anchors, 1)
+            self.bbox = nn.Conv2D(4 * num_anchors, 1)
+
+    def hybrid_forward(self, F, x):
+        t = self.conv(x)
+        raw = self.cls(t)                    # (N, 2A, H, W)
+        n, _, h, w = raw.shape
+        # softmax over the bg/fg pair per anchor (reference reshapes to
+        # (N, 2, A*H, W) and softmaxes the channel pair)
+        prob = F.softmax(F.reshape(raw, (n, 2, -1)), axis=1)
+        prob = F.reshape(prob, (n, 2 * self._a, h, w))
+        return raw, prob, self.bbox(t)
+
+
+class FasterRCNN(HybridBlock):
+    """Two-stage detector over a feature backbone.
+
+    forward(x, im_info) → (rois (N*topN, 5), cls_logits (N*topN, C+1),
+    bbox_deltas (N*topN, 4), rpn_cls_raw, rpn_bbox_pred). Padded RoIs
+    have batch_idx -1.
+    """
+
+    def __init__(self, features, classes, feature_stride=16,
+                 scales=(8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+                 roi_size=(7, 7), rpn_pre_nms_top_n=400,
+                 rpn_post_nms_top_n=64, rpn_min_size=4,
+                 head_units=256, **kwargs):
+        super().__init__(**kwargs)
+        self._classes = classes
+        self._stride = feature_stride
+        self._scales = tuple(float(s) for s in scales)
+        self._ratios = tuple(float(r) for r in ratios)
+        self._roi_size = tuple(roi_size)
+        self._pre = rpn_pre_nms_top_n
+        self._post = rpn_post_nms_top_n
+        self._min_size = rpn_min_size
+        a = len(scales) * len(ratios)
+        with self.name_scope():
+            self.features = features
+            self.rpn = RPNHead(a, prefix="rpn_")
+            self.head1 = nn.Dense(head_units, activation="relu",
+                                  prefix="head1_")
+            self.head2 = nn.Dense(head_units, activation="relu",
+                                  prefix="head2_")
+            self.cls_pred = nn.Dense(classes + 1, prefix="cls_")
+            self.bbox_pred = nn.Dense(4, prefix="bbox_")
+
+    def hybrid_forward(self, F, x, im_info):
+        feat = self.features(x)
+        rpn_raw, rpn_prob, rpn_bbox = self.rpn(feat)
+        rois = F.contrib.Proposal(
+            rpn_prob, rpn_bbox, im_info,
+            rpn_pre_nms_top_n=self._pre, rpn_post_nms_top_n=self._post,
+            rpn_min_size=self._min_size, scales=self._scales,
+            ratios=self._ratios, feature_stride=self._stride)
+        rois = F.stop_gradient(rois)     # proposals are fixed boxes
+        pooled = F.contrib.ROIAlign(
+            feat, rois, pooled_size=self._roi_size,
+            spatial_scale=1.0 / self._stride)
+        flat = F.Flatten(pooled)
+        h = self.head2(self.head1(flat))
+        return (rois, self.cls_pred(h), self.bbox_pred(h),
+                rpn_raw, rpn_bbox)
+
+
+class FasterRCNNLoss(Loss):
+    """Joint RPN + RCNN loss (ref: rcnn multi-task loss — rpn softmax CE +
+    rpn smooth-L1 + rcnn softmax CE + rcnn smooth-L1).
+
+    ``forward(outputs, gt_label, im_shape)`` where outputs is
+    FasterRCNN's tuple and gt_label is (N, M, 5) rows [cls, x0, y0, x1,
+    y1] in PIXELS, padded with cls=-1.
+    """
+
+    def __init__(self, model, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._m = model
+        self._anchor_cache = {}
+
+    def hybrid_forward(self, F, outputs, gt_label, im_shape):
+        rois, cls_logits, bbox_deltas, rpn_raw, rpn_bbox = outputs
+        # Guard BEFORE any concretization (float(im_shape), .shape unpack):
+        # under hybridize()/ShardedTrainer every input is a tracer and the
+        # host-side matching below cannot run — fail with the documented
+        # error, not a JAX concretization error.
+        if any(isinstance(getattr(a, "_data", a), jax.core.Tracer)
+               for a in (gt_label, rois, rpn_raw, im_shape)):
+            raise MXNetError(
+                "FasterRCNNLoss is eager-only: per-image proposal↔gt "
+                "matching runs host-side (asnumpy + Python loop, like the "
+                "reference's MXProposalTarget custom op). Do not "
+                "hybridize() it or wrap it in ShardedTrainer; train with "
+                "the eager loop in examples/train_faster_rcnn.py "
+                "(docs/divergences.md #12)")
+        n, _, fh, fw = rpn_raw.shape
+        ih, iw = float(im_shape[0]), float(im_shape[1])
+        a = len(self._m._scales) * len(self._m._ratios)
+
+        # ---- RPN targets: anchors vs gt (class-agnostic objectness).
+        # Corners are extended by +1 before normalizing: MultiBoxTarget
+        # encodes with corner widths (x2-x0) while the Proposal op
+        # decodes with the legacy +1 widths — with BOTH anchors and gt
+        # extended, the matcher's encoding becomes the exact inverse of
+        # the decode (the +0.5 center shifts cancel). Cache is bounded:
+        # keyed by feature shape, a handful of entries per model.
+        key = (fh, fw, ih, iw)
+        if key not in self._anchor_cache:
+            if len(self._anchor_cache) >= 16:
+                self._anchor_cache.pop(next(iter(self._anchor_cache)))
+            anchors = rpn_anchors(fh, fw, self._m._stride,
+                                  self._m._scales, self._m._ratios)
+            norm = np.array([iw, ih, iw, ih], np.float32)
+            ext = anchors + np.array([0, 0, 1, 1], np.float32)
+            self._anchor_cache[key] = (anchors,
+                                       F.array((ext / norm)[None]))
+        anchors, anc_norm = self._anchor_cache[key]
+        norm = np.array([iw, ih, iw, ih], np.float32)
+        gt = gt_label.asnumpy() if hasattr(gt_label, "asnumpy") else \
+            np.asarray(gt_label)
+        gt_obj = gt.copy()
+        gt_obj[..., 0] = np.where(gt_obj[..., 0] >= 0, 0.0, -1.0)
+        gt_obj[..., 3:5] += 1.0                 # legacy +1 extents
+        gt_obj[..., 1:5] = gt_obj[..., 1:5] / norm
+        # dummy cls_preds (N, A, 2) just threads through the matcher
+        dummy = F.zeros((n, anchors.shape[0], 2))
+        # variances (1,1,1,1): the Proposal op decodes RAW deltas
+        # (NonLinearTransformInv has no variance factor), so the targets
+        # the RPN regresses toward must be unscaled
+        rpn_loc_t, rpn_loc_m, rpn_cls_t = F.contrib.MultiBoxTarget(
+            anc_norm, F.array(gt_obj), dummy,
+            overlap_threshold=0.7, negative_mining_ratio=3.0,
+            variances=(1.0, 1.0, 1.0, 1.0))
+        # rpn_raw (N, 2A, H, W): per-anchor pair logits → (N, A*H*W, 2)
+        rpn_logits = F.transpose(
+            F.reshape(rpn_raw, (n, 2, a, fh * fw)), axes=(0, 3, 2, 1))
+        rpn_logits = F.reshape(rpn_logits, (n, -1, 2))
+        # MultiBoxTarget anchor order is (H*W, A); match it
+        cls_t = rpn_cls_t
+        ce = F.log_softmax(rpn_logits, axis=-1)
+        picked = F.pick(ce, F.relu(cls_t), axis=-1)
+        mask = (cls_t >= 0)
+        rpn_cls_loss = -F.sum(picked * mask) / F.broadcast_maximum(
+            F.sum(mask), F.ones((1,)))
+        # Proposal reads bbox channels ANCHOR-major (channel c = a*4 +
+        # coord, transpose(1,2,0).reshape(-1,4)); flatten identically so
+        # the loss trains the layout the decoder consumes
+        rpn_bbox_flat = F.reshape(F.transpose(
+            rpn_bbox, axes=(0, 2, 3, 1)), (n, -1))
+        rpn_loc_loss = F.sum(
+            F.smooth_l1((rpn_bbox_flat - rpn_loc_t) * rpn_loc_m,
+                        scalar=3.0)) / F.broadcast_maximum(
+            F.sum(rpn_loc_m) / 4.0, F.ones((1,)))
+
+        # ---- RCNN targets: proposals vs gt (per-class)
+        rois_np = rois.asnumpy() if hasattr(rois, "asnumpy") else \
+            np.asarray(rois)
+        per = rois_np.reshape(n, -1, 5)
+        cls_losses = []
+        box_losses = []
+        topn = per.shape[1]
+        roi_norm = per[..., 1:5] / norm
+        gt_n = gt.copy()
+        gt_n[..., 1:5] = gt_n[..., 1:5] / norm
+        logits = F.reshape(cls_logits, (n, topn, -1))
+        deltas = F.reshape(bbox_deltas, (n, topn, 4))
+        for i in range(n):
+            valid_rois = per[i, :, 0] >= 0
+            anc = F.array(roi_norm[i][None])
+            dummy2 = F.zeros((1, topn, self._m._classes + 1))
+            loc_t, loc_m, cls_t2 = F.contrib.MultiBoxTarget(
+                anc, F.array(gt_n[i][None]), dummy2,
+                overlap_threshold=0.5, negative_mining_ratio=-1.0)
+            ce2 = F.log_softmax(logits[i], axis=-1)
+            valid = F.array(valid_rois.astype(np.float32))
+            cls_sel = F.pick(ce2, F.broadcast_maximum(cls_t2[0], F.zeros((1,))),
+                             axis=-1)
+            cls_losses.append(-F.sum(cls_sel * valid)
+                              / F.broadcast_maximum(F.sum(valid), F.ones((1,))))
+            lm = F.reshape(loc_m[0], (topn, 4)) * F.reshape(valid,
+                                                            (topn, 1))
+            lt = F.reshape(loc_t[0], (topn, 4))
+            box_losses.append(F.sum(F.smooth_l1(
+                (deltas[i] - lt) * lm, scalar=1.0)) / F.broadcast_maximum(
+                F.sum(lm) / 4.0, F.ones((1,))))
+        rcnn_cls_loss = sum(cls_losses) / n
+        rcnn_box_loss = sum(box_losses) / n
+        return (rpn_cls_loss + rpn_loc_loss + rcnn_cls_loss
+                + rcnn_box_loss)
+
+
+def faster_rcnn_resnet(classes=20, **kwargs):
+    """Small ResNet-backboned Faster R-CNN (thumbnail backbone truncated
+    before global pooling; stride 16 at stage 3)."""
+    from .vision import resnet18_v1
+    backbone = resnet18_v1(classes=10)
+    feat = nn.HybridSequential(prefix="backbone_")
+    # features: [conv, bn, relu?, stages...]; keep through stage 3
+    children = list(backbone.features._children.values())
+    with feat.name_scope():
+        for layer in children[:-2]:        # drop last stage + global pool
+            feat.add(layer)
+    return FasterRCNN(feat, classes, **kwargs)

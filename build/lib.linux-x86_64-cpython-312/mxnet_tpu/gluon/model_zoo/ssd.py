@@ -1,0 +1,167 @@
+"""SSD detection family (GluonCV parity: gluoncv.model_zoo.ssd — the
+reference ecosystem's SSD-512 config, driver config #5).
+
+TPU-first design notes (SURVEY §7 hard-parts #2): every stage is static
+shape — anchors are compile-time constants per feature map, target
+assignment (MultiBoxTarget) and NMS (MultiBoxDetection) are vmapped
+fixed-size kernels with -1 padding instead of dynamic filtering, so the
+whole train/infer step jits cleanly.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from .. import nn
+from ..block import HybridBlock
+from ..loss import Loss, SoftmaxCrossEntropyLoss
+
+__all__ = ["SSD", "SSDMultiBoxLoss", "get_ssd", "ssd_512_resnet18_v1",
+           "ssd_300_resnet18_v1"]
+
+
+def _conv_block(channels, stride=1):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(channels, 3, strides=stride, padding=1,
+                      use_bias=False))
+    out.add(nn.BatchNorm())
+    out.add(nn.Activation("relu"))
+    return out
+
+
+class _DownSample(HybridBlock):
+    """Feature-map downscaler between detection scales."""
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential()
+        self.body.add(_conv_block(channels))
+        self.body.add(_conv_block(channels))
+        self.body.add(nn.MaxPool2D(2))
+
+    def hybrid_forward(self, F, x):
+        return self.body(x)
+
+
+class SSD(HybridBlock):
+    """Multi-scale single-shot detector.
+
+    forward(x) → (anchors (1, A, 4), cls_preds (N, A, C+1),
+    box_preds (N, A*4)); training targets come from
+    F.contrib.MultiBoxTarget, inference from F.contrib.MultiBoxDetection
+    (ref ecosystem: gluoncv ssd.py SSD.forward).
+    """
+
+    def __init__(self, features, classes, sizes, ratios, num_scales=None,
+                 scale_channels=128, **kwargs):
+        super().__init__(**kwargs)
+        num_scales = num_scales or len(sizes)
+        if not (len(sizes) == len(ratios) == num_scales):
+            raise MXNetError("sizes/ratios must have one entry per scale")
+        self._num_classes = classes
+        self._sizes = sizes
+        self._ratios = ratios
+        self._num_scales = num_scales
+        with self.name_scope():
+            self.features = features
+            self.scale_blocks = nn.HybridSequential(prefix="scales_")
+            self.cls_preds = nn.HybridSequential(prefix="cls_")
+            self.box_preds = nn.HybridSequential(prefix="box_")
+            with self.scale_blocks.name_scope():
+                for i in range(num_scales - 1):
+                    self.scale_blocks.add(_DownSample(scale_channels))
+            for i in range(num_scales):
+                a = len(sizes[i]) + len(ratios[i]) - 1
+                with self.cls_preds.name_scope():
+                    self.cls_preds.add(nn.Conv2D(a * (classes + 1), 3,
+                                                 padding=1))
+                with self.box_preds.name_scope():
+                    self.box_preds.add(nn.Conv2D(a * 4, 3, padding=1))
+
+    def hybrid_forward(self, F, x):
+        feats = self.features(x)
+        anchors, cls_preds, box_preds = [], [], []
+        cls_blocks = list(self.cls_preds._children.values())
+        box_blocks = list(self.box_preds._children.values())
+        scale_blocks = list(self.scale_blocks._children.values())
+        for i in range(self._num_scales):
+            anchors.append(F.contrib.MultiBoxPrior(
+                feats, sizes=self._sizes[i], ratios=self._ratios[i]))
+            cp = cls_blocks[i](feats)
+            bp = box_blocks[i](feats)
+            n = cp.shape[0]
+            cls_preds.append(F.reshape(
+                F.transpose(cp, axes=(0, 2, 3, 1)),
+                (n, -1, self._num_classes + 1)))
+            box_preds.append(F.reshape(
+                F.transpose(bp, axes=(0, 2, 3, 1)), (n, -1)))
+            if i < self._num_scales - 1:
+                feats = scale_blocks[i](feats)
+        return (F.concat(*anchors, dim=1),
+                F.concat(*cls_preds, dim=1),
+                F.concat(*box_preds, dim=1))
+
+
+class SSDMultiBoxLoss(Loss):
+    """cls cross-entropy + smooth-L1 localization
+    (ref ecosystem: gluoncv SSDMultiBoxLoss; reference ops:
+    MultiBoxTarget + SoftmaxOutput + smooth_l1)."""
+
+    def __init__(self, lambd=1.0, **kwargs):
+        super().__init__(None, 0, **kwargs)
+        self._lambd = lambd
+        self._ce = SoftmaxCrossEntropyLoss()
+
+    def hybrid_forward(self, F, cls_preds, box_preds, cls_targets,
+                       box_targets, box_masks):
+        n = cls_preds.shape[0]
+        c = cls_preds.shape[-1]
+        valid = (cls_targets >= 0).astype(cls_preds.dtype)
+        cls_loss = self._ce(F.reshape(cls_preds, (-1, c)),
+                            F.reshape(F.broadcast_maximum(
+                                cls_targets,
+                                F.zeros_like(cls_targets)), (-1,)))
+        cls_loss = F.reshape(cls_loss, (n, -1)) * valid
+        cls_loss = cls_loss.sum(axis=1) / F.broadcast_maximum(
+            valid.sum(axis=1), F.ones_like(valid.sum(axis=1)))
+        box_l = F.smooth_l1((box_preds - box_targets) * box_masks,
+                            scalar=1.0)
+        box_loss = F.reshape(box_l, (n, -1)).sum(axis=1) / F.broadcast_maximum(
+            F.reshape(box_masks, (n, -1)).sum(axis=1),
+            F.ones((n,)))
+        return cls_loss + self._lambd * box_loss
+
+
+def _resnet_features(num_layers, thumbnail):
+    from .vision.resnet import get_resnet
+    net = get_resnet(1, num_layers, thumbnail=thumbnail)
+    feats = nn.HybridSequential()
+    # everything up to (excluding) global pool
+    children = list(net.features._children.values())[:-1]
+    for block in children:
+        feats.add(block)
+    return feats
+
+
+_DEFAULT_SIZES = [[0.2, 0.272], [0.37, 0.447], [0.54, 0.619],
+                  [0.71, 0.79], [0.88, 0.961]]
+_DEFAULT_RATIOS = [[1.0, 2.0, 0.5]] * 5
+
+
+def get_ssd(base="resnet18_v1", classes=20, data_shape=512,
+            num_scales=5, pretrained_base=False, thumbnail=False,
+            **kwargs):
+    if not base.startswith("resnet"):
+        raise MXNetError("get_ssd supports resnet bases in this build")
+    num_layers = int(base.split("_")[0].replace("resnet", ""))
+    features = _resnet_features(num_layers, thumbnail)
+    return SSD(features, classes, _DEFAULT_SIZES[:num_scales],
+               _DEFAULT_RATIOS[:num_scales], num_scales=num_scales,
+               **kwargs)
+
+
+def ssd_512_resnet18_v1(classes=20, **kwargs):
+    return get_ssd("resnet18_v1", classes=classes, data_shape=512, **kwargs)
+
+
+def ssd_300_resnet18_v1(classes=20, **kwargs):
+    return get_ssd("resnet18_v1", classes=classes, data_shape=300,
+                   num_scales=4, **kwargs)

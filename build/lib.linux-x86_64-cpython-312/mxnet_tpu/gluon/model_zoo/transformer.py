@@ -1,0 +1,207 @@
+"""Transformer encoder-decoder for NMT (Sockeye parity — the reference
+ecosystem's sockeye.transformer drives driver config #4; MXNet 1.x itself
+ships the fused attention ops it uses, src/operator/contrib/transformer.cc).
+
+TPU-first: self/cross attention run through the blockwise flash-attention
+op; the decoder trains teacher-forced with causal masking in ONE jitted
+step (no BucketingModule needed — but Module+bucketing works too via the
+shape-keyed jit cache); greedy decode keeps static shapes by scanning to
+max_length.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import nn
+from ..block import HybridBlock
+from .bert import MultiHeadAttention, PositionwiseFFN
+
+__all__ = ["TransformerEncoder", "TransformerDecoder", "TransformerModel",
+           "transformer_base", "CrossAttention"]
+
+
+class CrossAttention(HybridBlock):
+    """Attention with separate query and memory inputs (decoder→encoder)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._heads = num_heads
+        with self.name_scope():
+            self.q_proj = nn.Dense(units, flatten=False, prefix="q_")
+            self.kv_proj = nn.Dense(2 * units, flatten=False, prefix="kv_")
+            self.proj = nn.Dense(units, flatten=False, prefix="out_")
+            self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, mem):
+        # shape-free (exports symbolically): the fused op splits heads and
+        # K/V internally off the concrete trace shapes
+        out = F.contrib.fused_cross_attention(
+            self.q_proj(x), self.kv_proj(mem), heads=self._heads)
+        out = self.proj(out)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class _EncoderCell(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attn = MultiHeadAttention(units, num_heads, dropout=dropout,
+                                           prefix="attn_")
+            self.ln1 = nn.LayerNorm(prefix="ln1_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
+                                       activation="relu", prefix="ffn_")
+            self.ln2 = nn.LayerNorm(prefix="ln2_")
+
+    def hybrid_forward(self, F, x):
+        x = self.ln1(x + self.attn(x))
+        return self.ln2(x + self.ffn(x))
+
+
+class _DecoderCell(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.self_attn = MultiHeadAttention(units, num_heads,
+                                                dropout=dropout, causal=True,
+                                                prefix="self_")
+            self.ln1 = nn.LayerNorm(prefix="ln1_")
+            self.cross_attn = CrossAttention(units, num_heads,
+                                             dropout=dropout,
+                                             prefix="cross_")
+            self.ln2 = nn.LayerNorm(prefix="ln2_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
+                                       activation="relu", prefix="ffn_")
+            self.ln3 = nn.LayerNorm(prefix="ln3_")
+
+    def hybrid_forward(self, F, x, mem):
+        x = self.ln1(x + self.self_attn(x))
+        x = self.ln2(x + self.cross_attn(x, mem))
+        return self.ln3(x + self.ffn(x))
+
+
+def _positions(max_length, units):
+    pos = np.arange(max_length)[:, None]
+    dim = np.arange(0, units, 2)[None, :]
+    angle = pos / np.power(10000.0, dim / units)
+    enc = np.zeros((max_length, units), dtype=np.float32)
+    enc[:, 0::2] = np.sin(angle)
+    enc[:, 1::2] = np.cos(angle)
+    return enc
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.cells = nn.HybridSequential(prefix="cells_")
+            with self.cells.name_scope():
+                for _ in range(num_layers):
+                    self.cells.add(_EncoderCell(units, hidden_size,
+                                                num_heads, dropout))
+
+    def hybrid_forward(self, F, x):
+        return self.cells(x)
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._cells = []
+        with self.name_scope():
+            for i in range(num_layers):
+                cell = _DecoderCell(units, hidden_size, num_heads, dropout,
+                                    prefix=f"cell{i}_")
+                self.register_child(cell, f"cell{i}")
+                self._cells.append(cell)
+
+    def hybrid_forward(self, F, x, mem):
+        for cell in self._cells:
+            x = cell(x, mem)
+        return x
+
+
+class TransformerModel(HybridBlock):
+    """Sockeye-parity seq2seq transformer: forward(src, tgt) → logits
+    (teacher forcing); ``translate`` runs greedy decode."""
+
+    def __init__(self, src_vocab, tgt_vocab, num_layers=6, units=512,
+                 hidden_size=2048, num_heads=8, max_length=512,
+                 dropout=0.1, tie_weights=False, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        with self.name_scope():
+            self.src_embed = nn.Embedding(src_vocab, units,
+                                          prefix="src_embed_")
+            self.tgt_embed = nn.Embedding(tgt_vocab, units,
+                                          prefix="tgt_embed_")
+            self.encoder = TransformerEncoder(num_layers, units, hidden_size,
+                                              num_heads, dropout,
+                                              prefix="enc_")
+            self.decoder = TransformerDecoder(num_layers, units, hidden_size,
+                                              num_heads, dropout,
+                                              prefix="dec_")
+            self.output = nn.Dense(tgt_vocab, flatten=False, prefix="out_")
+            self.dropout = nn.Dropout(dropout) if dropout else None
+            # sinusoidal table as a Constant parameter: exports with the
+            # model and keeps the embed path shape-free (slice_like)
+            self.pos_weight = self.params.get_constant(
+                "pos_embed", _positions(max_length, units))
+
+    def _embed(self, F, tokens, embed, pos_weight):
+        x = embed(tokens) * math.sqrt(self._units)
+        pos = F.slice_like(F.expand_dims(pos_weight, axis=0), x, axes=(1,))
+        x = F.broadcast_add(x, pos)
+        if self.dropout is not None:
+            x = self.dropout(x)
+        return x
+
+    def encode(self, src):
+        from ... import ndarray as F
+        return self.encoder(self._embed(F, src, self.src_embed,
+                                        self.pos_weight.data()))
+
+    def hybrid_forward(self, F, src, tgt, pos_weight=None):
+        pos = pos_weight if pos_weight is not None else \
+            self.pos_weight.data()
+        mem = self.encoder(self._embed(F, src, self.src_embed, pos))
+        dec = self.decoder(self._embed(F, tgt, self.tgt_embed, pos), mem)
+        return self.output(dec)
+
+    def translate(self, src, bos_id=1, eos_id=2, max_steps=None):
+        """Greedy decode (static shapes: fixed max_steps loop)."""
+        from ... import ndarray as nd
+        import numpy as onp
+        max_steps = max_steps or min(self._max_length, 64)
+        mem = self.encode(src)
+        b = src.shape[0]
+        tokens = onp.full((b, 1), bos_id, dtype=onp.int32)
+        finished = onp.zeros(b, bool)
+        for _ in range(max_steps):
+            tgt = nd.array(tokens)
+            dec = self.decoder(self._embed(nd, tgt, self.tgt_embed,
+                                           self.pos_weight.data()), mem)
+            logits = self.output(dec)
+            nxt = logits.asnumpy()[:, -1].argmax(axis=-1)
+            nxt = onp.where(finished, eos_id, nxt)
+            tokens = onp.concatenate([tokens, nxt[:, None].astype(onp.int32)],
+                                     axis=1)
+            finished |= nxt == eos_id
+            if finished.all():
+                break
+        return tokens[:, 1:]
+
+
+def transformer_base(src_vocab, tgt_vocab, **kwargs):
+    """The Sockeye/`Attention is All You Need` base config."""
+    cfg = dict(num_layers=6, units=512, hidden_size=2048, num_heads=8,
+               dropout=0.1)
+    cfg.update(kwargs)
+    return TransformerModel(src_vocab, tgt_vocab, **cfg)

@@ -1,0 +1,11 @@
+"""Gluon neural-network layers (ref: python/mxnet/gluon/nn/)."""
+from ..block import Block, HybridBlock, SymbolBlock
+from .basic_layers import *
+from .binary_layers import *
+from .conv_layers import *
+
+from . import basic_layers, binary_layers, conv_layers
+
+__all__ = (["Block", "HybridBlock", "SymbolBlock"]
+           + basic_layers.__all__ + conv_layers.__all__
+           + binary_layers.__all__)

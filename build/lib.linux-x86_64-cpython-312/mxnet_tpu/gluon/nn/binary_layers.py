@@ -1,0 +1,158 @@
+"""Binary (1-bit) network layers — the BMXNet fork's Gluon surface
+(SURVEY §2 #23: yanghaojin/BMXNet adds QDense/QConv2D/QActivation on top of
+upstream; smd_hpi binary-ops line).
+
+TPU design: sign() binarization with straight-through gradients (det_sign
+/ approx_sign ops); the binary GEMM runs as a ±1 bf16 matmul on the MXU —
+on TPU that IS the fast path (no integer XNOR-popcount unit outruns the
+systolic array), with XNOR-Net alpha scaling preserved so accuracy math
+matches BMXNet.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["QActivation", "QDense", "QConv2D", "pack_binary_weights"]
+
+
+class QActivation(HybridBlock):
+    """BMXNet QActivation: 1-bit sign (or k-bit uniform) activation."""
+
+    def __init__(self, act_bit=1, backward_only=False, **kwargs):
+        super().__init__(**kwargs)
+        self._act_bit = act_bit
+        self._backward_only = backward_only
+
+    def hybrid_forward(self, F, x):
+        return F.QActivation(x, act_bit=self._act_bit,
+                             backward_only=self._backward_only)
+
+
+class QDense(HybridBlock):
+    """BMXNet QFullyConnected as a Gluon layer: binary weights (and by
+    default binary inputs) with alpha scaling."""
+
+    def __init__(self, units, act_bit=1, use_bias=False, in_units=0,
+                 binarize_input=True, scaling=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        if act_bit != 1:
+            raise MXNetError("QDense supports act_bit=1 (sign) — use "
+                             "QActivation for k-bit activations")
+        self._units = units
+        self._binarize_input = binarize_input
+        self._scaling = scaling
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units),
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+
+    def infer_shape(self, x):
+        import numpy as np
+        self.weight._set_shape((self._units,
+                                int(np.prod(x.shape[1:]))))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        args = [x, weight] + ([bias] if bias is not None else [])
+        return F.QFullyConnected(*args, num_hidden=self._units,
+                                 no_bias=bias is None,
+                                 binarize_input=self._binarize_input,
+                                 scaling=self._scaling)
+
+
+class QConv2D(HybridBlock):
+    """BMXNet QConvolution as a Gluon layer."""
+
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, act_bit=1, use_bias=False,
+                 in_channels=0, binarize_input=True, scaling=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        if act_bit != 1:
+            raise MXNetError("QConv2D supports act_bit=1 (sign)")
+
+        def pair(v):
+            return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+        self._channels = channels
+        self._kwargs = dict(kernel=pair(kernel_size), stride=pair(strides),
+                            pad=pair(padding), dilate=pair(dilation),
+                            num_group=groups, num_filter=channels,
+                            binarize_input=binarize_input, scaling=scaling)
+        self._groups = groups
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight",
+                shape=(channels, in_channels // groups if in_channels
+                       else 0) + pair(kernel_size),
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+
+    def infer_shape(self, x):
+        self.weight._set_shape(
+            (self._channels, x.shape[1] // self._groups)
+            + self._kwargs["kernel"])
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        args = [x, weight] + ([bias] if bias is not None else [])
+        return F.QConvolution(*args, no_bias=bias is None, **self._kwargs)
+
+
+def pack_binary_weights(layer):
+    """Pre-pack a trained QDense/QConv2D layer's weights for XNOR-popcount
+    inference (32x weight compression — the BMXNet deployment flow, where
+    binary_word-packed models ship to mobile). Returns:
+
+    - QDense:  (w_packed uint32 [units, W32], alpha or None,
+                bias or None)
+    - QConv2D: (w_packed uint32 [channels, W32] over C*kh*kw,
+                alpha or None, bias or None)
+
+    Use with ``nd.contrib.xnor_fully_connected`` /
+    ``nd.contrib.xnor_convolution`` — pass alpha and bias positionally in
+    that order (alpha may be a ones-scalar when the layer has
+    scaling=False but a bias); outputs then equal the layer's own forward
+    for sign-binarized inputs (tests/test_binary.py). Caveat for padded
+    convolutions: the float-simulation layer zero-pads (border taps
+    contribute 0) while the packed path pads with +1 like BMXNet's
+    binary algebra — border outputs differ between the two by design.
+    """
+    from ... import ndarray as nd_mod
+    w = layer.weight.data()
+    bias = layer.bias.data() if getattr(layer, "bias", None) is not None \
+        else None
+    if isinstance(layer, QDense):
+        wp = nd_mod.contrib.binary_pack(w)
+        alpha = nd_mod.mean(nd_mod.abs(w)) if layer._scaling else None
+        if alpha is None and bias is not None:
+            alpha = nd_mod.ones((1,))   # keep the positional slots aligned
+        return wp, alpha, bias
+    if isinstance(layer, QConv2D):
+        if layer._kwargs["num_group"] != 1 or \
+                tuple(layer._kwargs["dilate"]) != (1, 1):
+            raise MXNetError(
+                "pack_binary_weights: xnor_convolution supports only "
+                "groups=1, dilation=1 — this layer's packed inference "
+                "would be silently wrong")
+        w2 = w.reshape((w.shape[0], -1))
+        wp = nd_mod.contrib.binary_pack(w2)
+        alpha = nd_mod.mean(nd_mod.abs(w2), axis=1) \
+            if layer._kwargs["scaling"] else None
+        if alpha is None and bias is not None:
+            alpha = nd_mod.ones((1,))
+        return wp, alpha, bias
+    raise MXNetError(f"pack_binary_weights: unsupported layer "
+                     f"{type(layer).__name__}")

@@ -1,0 +1,379 @@
+"""Recurrent cells (ref: python/mxnet/gluon/rnn/rnn_cell.py).
+
+Unfused, step-at-a-time cells for custom decoding loops; ``unroll`` builds
+the time loop in Python (traced once under hybridize, so XLA still sees a
+static graph — the reference's explicit-unroll semantics). The fused layers
+in rnn_layer.py are the ``lax.scan`` fast path.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from .. import nn
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    """ref: rnn_cell.py RecurrentCell."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """ref: RecurrentCell.begin_state — zero (or custom) initial states."""
+        from ... import ndarray as F
+        if func is None:
+            func = F.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape=shape, **info, **kwargs)
+                          if "shape" in func.__code__.co_varnames
+                          else func(shape, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """ref: RecurrentCell.unroll."""
+        from ... import ndarray as F
+        self.reset()
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        else:
+            batch = inputs.shape[layout.find("N")]
+            seq = [F.squeeze(s, axis=axis) for s in
+                   F.split(inputs, num_outputs=length, axis=axis)]
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch)
+        outputs = []
+        all_states = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+            all_states.append(states)
+        if valid_length is not None:
+            stacked = F.stack(*outputs, axis=axis)
+            outputs = F.SequenceMask(stacked, sequence_length=valid_length,
+                                     use_sequence_length=True, axis=axis)
+            # final states: last valid step per sequence
+            states = [F.SequenceLast(F.stack(*[s[i] for s in all_states],
+                                             axis=0),
+                                     sequence_length=valid_length,
+                                     use_sequence_length=True, axis=0)
+                      for i in range(len(states))]
+            if merge_outputs is False:
+                outputs = [F.squeeze(s, axis=axis) for s in
+                           F.split(outputs, num_outputs=length, axis=axis)]
+            return outputs, states
+        if merge_outputs is None or merge_outputs:
+            outputs = F.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return super().forward(inputs, states)
+
+
+class HybridRecurrentCell(RecurrentCell):
+    pass
+
+
+class RNNCell(HybridRecurrentCell):
+    """Elman RNN cell (ref: rnn_cell.py RNNCell)."""
+
+    def __init__(self, hidden_size, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._set_shape((self._hidden_size, x.shape[-1]))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(HybridRecurrentCell):
+    """ref: rnn_cell.py LSTMCell — gates in i,f,g,o order."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._set_shape((4 * self._hidden_size, x.shape[-1]))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        in_gate, forget_gate, in_trans, out_gate = F.split(
+            gates, num_outputs=4, axis=-1)
+        in_gate = F.Activation(in_gate, act_type="sigmoid")
+        forget_gate = F.Activation(forget_gate, act_type="sigmoid")
+        in_trans = F.Activation(in_trans, act_type="tanh")
+        out_gate = F.Activation(out_gate, act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        next_h = out_gate * F.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(HybridRecurrentCell):
+    """ref: rnn_cell.py GRUCell — r,z,n gate order (cuDNN layout)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._set_shape((3 * self._hidden_size, x.shape[-1]))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=-1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=-1)
+        reset = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        trans = F.Activation(i2h_n + reset * h2h_n, act_type="tanh")
+        next_h = (1 - update) * trans + update * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells applied in order per step (ref: SequentialRNNCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            cell_states = states[pos:pos + n]
+            pos += n
+            inputs, new_states = cell(inputs, cell_states)
+            next_states.extend(new_states)
+        return inputs, next_states
+
+
+class DropoutCell(HybridRecurrentCell):
+    """ref: rnn_cell.py DropoutCell."""
+
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._dropout = nn.Dropout(rate)
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        return self._dropout(inputs), states
+
+
+class ZoneoutCell(HybridRecurrentCell):
+    """Zoneout regularization wrapper (ref: rnn_cell.py ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        from ... import autograd
+        from ... import ndarray as F
+        out, next_states = self.base_cell(inputs, states)
+        if autograd.is_training():
+            def mask(rate, new, old):
+                keep = F.random.bernoulli(1 - rate, shape=new.shape,
+                                          ctx=new.ctx, dtype=new.dtype)
+                return keep * new + (1 - keep) * old
+            prev = self._prev_output
+            if prev is None:
+                prev = F.zeros(out.shape, ctx=out.ctx, dtype=out.dtype)
+            if self._zo:
+                out = mask(self._zo, out, prev)
+            if self._zs:
+                next_states = [mask(self._zs, ns, s)
+                               for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(HybridRecurrentCell):
+    """Adds the input to the cell output (ref: rnn_cell.py ResidualCell)."""
+
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """Forward + backward cells over a full sequence; only usable through
+    ``unroll`` (ref: rnn_cell.py BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return (self.l_cell.state_info(batch_size)
+                + self.r_cell.state_info(batch_size))
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped — use unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+        self.reset()
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            seq = [F.squeeze(s, axis=axis) for s in
+                   F.split(inputs, num_outputs=length, axis=axis)]
+        else:
+            seq = list(inputs)
+        batch = seq[0].shape[0]
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch)
+        n_l = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, seq, states[:n_l], layout="NTC" if axis else "TNC",
+            merge_outputs=False, valid_length=valid_length)
+        r_out, r_states = self.r_cell.unroll(
+            length, list(reversed(seq)), states[n_l:],
+            layout="NTC" if axis else "TNC", merge_outputs=False,
+            valid_length=None if valid_length is None else valid_length)
+        r_out = list(reversed(r_out))
+        outputs = [F.concat(l, r, dim=-1) for l, r in zip(l_out, r_out)]
+        if merge_outputs is None or merge_outputs:
+            outputs = F.stack(*outputs, axis=axis)
+        return outputs, l_states + r_states

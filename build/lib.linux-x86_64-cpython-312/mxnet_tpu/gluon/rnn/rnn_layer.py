@@ -1,0 +1,156 @@
+"""Fused recurrent layers (ref: python/mxnet/gluon/rnn/rnn_layer.py).
+
+Parameters are stored per-layer/per-direction with the reference's names
+(``l0_i2h_weight``, ``r0_h2h_bias``, …) so checkpoints port; the forward
+packs them into the cuDNN-layout flat vector and calls the fused ``RNN`` op
+(ops/nn.py — ``lax.scan`` over time, ref: src/operator/rnn.cc).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode, gates,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout!r}; must be TNC or NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        self._gates = gates
+        ng, ni, nh = gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in (["l", "r"] if bidirectional else ["l"]):
+                    self._register_param(
+                        f"{j}{i}_i2h_weight",
+                        (ng * nh, ni if i == 0 else nh * self._dir),
+                        i2h_weight_initializer)
+                    self._register_param(f"{j}{i}_h2h_weight", (ng * nh, nh),
+                                         h2h_weight_initializer)
+                    self._register_param(f"{j}{i}_i2h_bias", (ng * nh,),
+                                         i2h_bias_initializer)
+                    self._register_param(f"{j}{i}_h2h_bias", (ng * nh,),
+                                         h2h_bias_initializer)
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        self._reg_params[name] = p
+        setattr(self, name, p)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def infer_shape(self, x, *args):
+        ni = x.shape[2] if self._layout == "TNC" else x.shape[-1]
+        ng, nh = self._gates, self._hidden_size
+        for j in (["l", "r"] if self._dir == 2 else ["l"]):
+            getattr(self, f"{j}0_i2h_weight")._set_shape((ng * nh, ni))
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        if func is None:
+            func = F.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            info = dict(info)
+            shape = info.pop("shape")
+            states.append(func(shape, **kwargs))
+        return states
+
+    def __call__(self, inputs, states=None, **kwargs):
+        if states is None:
+            batch = inputs.shape[self._layout.find("N")]
+            states = self.begin_state(batch, ctx=inputs.ctx,
+                                      dtype=inputs.dtype)
+            skip_states = True
+        else:
+            skip_states = False
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        out = super().__call__(inputs, list(states))
+        if skip_states:
+            return out[0]
+        return out
+
+    def hybrid_forward(self, F, inputs, states, **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, 0, 1)
+        # pack cuDNN-layout flat vector: weights (layer-major, dir
+        # interleaved, i2h then h2h), then biases in the same order
+        dirs = ["l", "r"] if self._dir == 2 else ["l"]
+        pieces = []
+        for i in range(self._num_layers):
+            for j in dirs:
+                pieces.append(F.reshape(params[f"{j}{i}_i2h_weight"], (-1,)))
+                pieces.append(F.reshape(params[f"{j}{i}_h2h_weight"], (-1,)))
+        for i in range(self._num_layers):
+            for j in dirs:
+                pieces.append(params[f"{j}{i}_i2h_bias"])
+                pieces.append(params[f"{j}{i}_h2h_bias"])
+        flat = F.concat(*pieces, dim=0)
+        rnn_args = [inputs, flat] + list(states)
+        outs = F.RNN(*rnn_args, state_size=self._hidden_size,
+                     num_layers=self._num_layers, mode=self._mode,
+                     bidirectional=self._dir == 2, p=self._dropout,
+                     state_outputs=True)
+        out = outs[0]
+        if self._layout == "NTC":
+            out = F.swapaxes(out, 0, 1)
+        return out, list(outs[1:])
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (ref: rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        self._activation = activation
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         "rnn_relu" if activation == "relu" else "rnn_tanh",
+                         1, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (ref: rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", 4, **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape}, {"shape": shape}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (ref: rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", 3, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]

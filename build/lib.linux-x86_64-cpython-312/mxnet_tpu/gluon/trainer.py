@@ -1,0 +1,223 @@
+"""Gluon Trainer (ref: python/mxnet/gluon/trainer.py).
+
+Bridges Parameters ↔ KVStore ↔ Optimizer: ``step(batch_size)`` does the
+gradient allreduce (if multi-replica / multi-host) then the optimizer update,
+mirroring the reference's ``_allreduce_grads`` + ``_update`` flow
+(SURVEY §3.2). The TPU fast path — gradients reduced by ``psum`` *inside*
+the jitted step over ICI — lives in mxnet_tpu.parallel; this Trainer is the
+eager/compatibility path and is exactly what the reference's API promises.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import kvstore as kvs
+from .. import optimizer as opt
+from ..base import MXNetError
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)) or hasattr(params, "values"):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("Trainer expects a ParameterDict or list of "
+                             "Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise MXNetError(f"invalid parameter {param!r}")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        self._contains_sparse = False
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._optimizer_applied_on_kv = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params and set(optimizer_params) - {"rescale_grad"}:
+                raise MXNetError("optimizer_params must be None when "
+                                 "optimizer is an Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if self._kv_initialized:
+            return
+        if self._kvstore_type is None or self._kvstore_type is False:
+            self._kvstore = None
+        else:
+            kv = self._kvstore_type if isinstance(self._kvstore_type,
+                                                  kvs.KVStore) else \
+                kvs.create(self._kvstore_type)
+            multi_replica = any(len(p.list_ctx()) > 1 for p in self._params
+                                if p.grad_req != "null")
+            multi_host = kv.num_workers > 1
+            if not multi_replica and not multi_host and \
+                    not self._update_on_kvstore:
+                kv = None  # single device, single host: pure local update
+            self._kvstore = kv
+            if kv is not None:
+                update_on_kv = self._update_on_kvstore
+                if update_on_kv is None:
+                    update_on_kv = kv.type.startswith("dist")
+                if self._compression_params:
+                    kv.set_gradient_compression(self._compression_params)
+                for i, param in enumerate(self._params):
+                    if param.grad_req != "null":
+                        kv.init(i, param.data(param.list_ctx()[0]))
+                if update_on_kv:
+                    kv.set_optimizer(self._optimizer)
+                    self._optimizer_applied_on_kv = True
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _check_grads(self):
+        for param in self._params:
+            if param.grad_req != "null" and param._grad is None:
+                raise MXNetError(
+                    f"parameter {param.name} has no gradient buffer — run "
+                    f"forward inside autograd.record() and call backward() "
+                    f"before step()")
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """rescale by 1/batch_size, allreduce, update (ref: Trainer.step)."""
+        self._init_kvstore()
+        self._check_grads()
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            from ..contrib.amp import amp_dtype
+            if amp_dtype() != "float16":
+                # bf16 has fp32 exponent range: scale overflow cannot
+                # trigger — skip the per-step finiteness sync entirely
+                scaler = None
+        if scaler is not None:
+            # fp16 AMP: a non-finite gradient means the loss scale
+            # overflowed — skip this update and halve the scale
+            # (ref: amp.py DynamicLossScaler + the trainer patch
+            # amp.init_trainer installs). The scale change only affects
+            # the NEXT scale_loss; this step's grads carry the old scale.
+            # Multi-host: the decision must be GLOBAL — an early return on
+            # one host while peers enter the allreduce would hang the
+            # collective (and diverge loss scales), so OR the flag across
+            # processes first.
+            overflow = scaler.has_overflow(self._params)
+            import jax
+            if jax.process_count() > 1:
+                import jax.numpy as jnp
+                from jax.experimental import multihost_utils
+                flags = multihost_utils.process_allgather(
+                    jnp.asarray([overflow]))
+                overflow = bool(np.asarray(flags).any())
+            if overflow:
+                scaler.update_scale(True)
+                return
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+        if scaler is not None:
+            scaler.update_scale(False)
+
+    def allreduce_grads(self):
+        self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if getattr(param, "_grad_stype", "default") == "row_sparse" \
+                    and any(getattr(g, "_sparse", None) is not None
+                            for g in param.list_grad()):
+                raise MXNetError(
+                    f"parameter {param.name}: row-sparse gradients with a "
+                    f"reducing kvstore (multi-replica / update_on_kvstore) "
+                    f"are not supported — use kvstore=None (single device) "
+                    f"or dense gradients; the dense buffer here would push "
+                    f"stale zeros")
+            grads = param.list_grad()
+            if self._optimizer_applied_on_kv:
+                self._kvstore.push(i, grads)
+                self._kvstore.pull(i, out=param.list_data())
+            else:
+                self._kvstore.push(i, grads)
+                self._kvstore.pull(i, out=grads)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._init_kvstore()
+        self._check_grads()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._optimizer_applied_on_kv:
+            return  # weights were updated on the kvstore and pulled back
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            for upd, arr, grad in zip(
+                    self._updaters * len(param.list_data()),
+                    param.list_data(), param.list_grad()):
+                g = grad
+                if getattr(param, "_grad_stype", "default") \
+                        == "row_sparse":
+                    rs = getattr(grad, "_sparse", None)
+                    if rs is not None and \
+                            not getattr(grad, "_sparse_used", False):
+                        g = rs    # touched-rows-only update. The view
+                        # stays readable (param.grad()) but is marked
+                        # consumed so a step without a fresh backward
+                        # doesn't re-apply it (the dense path's stale
+                        # grad is the zero buffer).
+                        grad._sparse_used = True
+                    elif rs is not None:
+                        continue  # stale sparse grad: nothing new to apply
+                upd(i, g, arr)
+
+    def save_states(self, fname):
+        """ref: Trainer.save_states — optimizer/updater state checkpoint."""
+        self._init_kvstore()
+        if self._optimizer_applied_on_kv:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        self._init_kvstore()
+        if self._optimizer_applied_on_kv:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                self._updaters[0].set_states(f.read())
+            self._optimizer = self._updaters[0].optimizer

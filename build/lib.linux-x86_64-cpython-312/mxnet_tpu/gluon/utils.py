@@ -1,0 +1,87 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split one batch along ``batch_axis`` into ``num_slice`` pieces
+    (ref: gluon/utils.py split_data). On TPU, prefer a sharded batch on a
+    Mesh (mxnet_tpu.parallel) over per-device slices — this exists for
+    script compatibility."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"batch size {size} not divisible by {num_slice} slices; pass "
+            f"even_split=False")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(nd.slice_axis(data, axis=batch_axis, begin=begin,
+                                    end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch and load each slice onto one context
+    (ref: gluon/utils.py split_and_load)."""
+    if not isinstance(data, nd.NDArray):
+        data = nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [piece.as_in_context(ctx) for piece, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so their joint L2 norm ≤ max_norm
+    (ref: gluon/utils.py clip_global_norm)."""
+    from ..ndarray.sparse import RowSparseNDArray
+    if not arrays:
+        raise MXNetError("clip_global_norm: empty array list")
+    total = 0.0
+    for arr in arrays:
+        if isinstance(arr, RowSparseNDArray):
+            # row-sparse grads: only stored rows contribute (ref:
+            # gluon/utils.py supports row_sparse grad clipping)
+            total += float(np.sum(np.square(arr.data)))
+        else:
+            total += float(nd.sum(nd.square(arr.reshape(-1))).asscalar())
+    norm = float(np.sqrt(total))
+    if check_isfinite and not np.isfinite(norm):
+        return norm
+    scale = max_norm / (norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            if isinstance(arr, RowSparseNDArray):
+                arr.data = arr.data * np.asarray(scale, arr.data.dtype)
+            else:
+                arr *= scale
+    return norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise MXNetError("download() requires network access, which this "
+                     "environment does not provide; place files locally and "
+                     "load them directly")

@@ -1,0 +1,208 @@
+"""``mx.image`` — image decode & augmentation
+(ref: python/mxnet/image/image.py; cv2 backend matches the reference's
+src/io/image_aug_default.cc OpenCV augmenters)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize", "HorizontalFlipAug",
+           "CastAug", "ColorNormalizeAug", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "CenterCropAug", "CreateAugmenter", "Augmenter"]
+
+
+def _cv2():
+    import cv2
+    return cv2
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """ref: image.py imdecode (cv2 path)."""
+    cv2 = _cv2()
+    img = cv2.imdecode(np.frombuffer(bytes(buf), dtype=np.uint8),
+                       cv2.IMREAD_COLOR if flag else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("imdecode failed")
+    if flag and to_rgb:
+        img = img[:, :, ::-1]
+    if img.ndim == 2:
+        img = img[:, :, None]
+    arr = nd.array(np.ascontiguousarray(img))
+    if out is not None:
+        out._rebind(arr._data)
+        return out
+    return arr
+
+
+def imread(filename, flag=1, to_rgb=True):
+    cv2 = _cv2()
+    img = cv2.imread(filename, cv2.IMREAD_COLOR if flag
+                     else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError(f"imread failed for {filename}")
+    if flag and to_rgb:
+        img = img[:, :, ::-1]
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(np.ascontiguousarray(img))
+
+
+def imresize(src, w, h, interp=1):
+    cv2 = _cv2()
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    out = cv2.resize(arr, (w, h), interpolation=interp)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out)
+
+
+def resize_short(src, size, interp=1):
+    """Resize so the short side equals size (ref: image.py resize_short)."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    out = nd.array(src.asnumpy()[y0:y0 + h, x0:x0 + w])
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=1):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = np.random.randint(0, w - new_w + 1)
+    y0 = np.random.randint(0, h - new_h + 1)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=1):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype("float32") if isinstance(src, nd.NDArray) else \
+        nd.array(src, dtype="float32")
+    out = src - (mean if isinstance(mean, nd.NDArray) else nd.array(mean))
+    if std is not None:
+        out = out / (std if isinstance(std, nd.NDArray) else nd.array(std))
+    return out
+
+
+class Augmenter:
+    """ref: image.py Augmenter."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            return nd.array(src.asnumpy()[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=list(np.ravel(mean)), std=list(np.ravel(std)))
+        self.mean = nd.array(mean)
+        self.std = nd.array(std) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """ref: image.py CreateAugmenter — the common aug pipeline factory."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and np.any(np.asarray(mean)):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist

@@ -1,0 +1,265 @@
+"""Weight initializers (ref: python/mxnet/initializer.py).
+
+Same registry + name-pattern dispatch design as the reference: an
+``Initializer`` is called with an ``InitDesc`` (parameter name + attrs) and
+fills an NDArray; `_init_weight/_init_bias/_init_gamma/...` dispatch by the
+parameter-name suffix exactly like the reference's ``__call__``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+
+__all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Constant", "Zero",
+           "One", "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias",
+           "Mixed", "register", "create"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if isinstance(name, str):
+        if name.lower() not in _REGISTRY:
+            raise MXNetError(f"unknown initializer {name!r}")
+        return _REGISTRY[name.lower()](**kwargs)
+    raise MXNetError(f"cannot create initializer from {name!r}")
+
+
+class InitDesc(str):
+    """Parameter name + attrs handed to initializers (ref: InitDesc)."""
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer with the reference's name-suffix dispatch."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(desc)
+        init = desc.attrs.get("__init__", "")
+        if init:
+            create(init)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- default fills ------------------------------------------------------
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, desc, arr):
+        self._set(arr, np.zeros(arr.shape))
+
+    def _init_gamma(self, desc, arr):
+        self._set(arr, np.ones(arr.shape))
+
+    def _init_beta(self, desc, arr):
+        self._set(arr, np.zeros(arr.shape))
+
+    def _init_zero(self, desc, arr):
+        self._set(arr, np.zeros(arr.shape))
+
+    def _init_one(self, desc, arr):
+        self._set(arr, np.ones(arr.shape))
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    @staticmethod
+    def _set(arr, value):
+        arr._rebind(nd.array(value, dtype=arr.dtype)._data)
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) — the reference's default (scale=0.07)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, np.random.uniform(-self.scale, self.scale, arr.shape))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, np.random.normal(0, self.sigma, arr.shape))
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        val = self.value
+        if isinstance(val, nd.NDArray):
+            self._set(arr, val.asnumpy())
+        else:
+            self._set(arr, np.full(arr.shape, val))
+
+    _init_default = _init_weight
+
+
+@register
+class Zero(Constant):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+@register
+class One(Constant):
+    def __init__(self):
+        super().__init__(1.0)
+
+
+# the reference accepts 'zeros'/'ones' spellings (mx.init.Zero aliases)
+_REGISTRY["zeros"] = Zero
+_REGISTRY["ones"] = One
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init (ref: initializer.py Xavier) — default for conv nets."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(f"Xavier requires >=2D weight, got {shape} "
+                             f"for {desc}")
+        if len(shape) > 2:
+            hw_scale = float(np.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            self._set(arr, np.random.uniform(-scale, scale, shape))
+        else:
+            self._set(arr, np.random.normal(0, scale, shape))
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He init for PReLU nets (ref: initializer.py MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1, 1, (nout, nin))
+        else:
+            tmp = np.random.normal(0, 1, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        self._set(arr, (self.scale * q).reshape(arr.shape))
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (ref: initializer.py Bilinear)."""
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype=np.float32)
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (ref: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = np.zeros(arr.shape)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias  # i, f, g, o order
+        self._set(arr, b)
+
+    _init_bias = _init_weight
+
+
+class Mixed:
+    """Pattern->initializer dispatch (ref: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must pair up")
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, desc, arr):
+        for prog, init in self.map:
+            if prog.search(str(desc)):
+                init(desc, arr)
+                return
+        raise MXNetError(f"no initializer pattern matches {desc}")

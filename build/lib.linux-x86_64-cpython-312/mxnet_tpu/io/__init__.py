@@ -1,0 +1,661 @@
+"""``mx.io`` — data iterators (ref: python/mxnet/io/io.py, src/io/).
+
+The reference's C++ iterator pipeline (parser → augmenter → batcher →
+prefetcher, src/io/iter_image_recordio_2.cc) maps to Python iterators with a
+background prefetch thread staging batches while the TPU step runs — the
+double-buffering that hides input latency under compute (SURVEY §2.5 #34).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import queue
+import struct
+import threading
+from collections import OrderedDict, deque, namedtuple
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """ref: io.py DataDesc — name/shape/dtype/layout of one input."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    """ref: io.py DataBatch."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """ref: io.py DataIter — the iterator protocol all trainers consume."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """ref: io.py _init_data — normalize array/list/dict to [(name, array)]."""
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (np.ndarray, nd.NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if len(data) == 1:
+            data = OrderedDict([(default_name, data[0])])
+        else:
+            data = OrderedDict([(f"_{i}_{default_name}", d)
+                                for i, d in enumerate(data)])
+    if not isinstance(data, dict):
+        raise MXNetError("data must be array, list of arrays, or dict")
+    return [(k, v if isinstance(v, np.ndarray) else v.asnumpy())
+            for k, v in data.items()]
+
+
+class NDArrayIter(DataIter):
+    """Batches over in-memory arrays (ref: io.py NDArrayIter): shuffle,
+    last_batch_handle pad/discard/roll_over."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        for k, v in self.data + self.label:
+            if v.shape[0] != self.num_data:
+                raise MXNetError(f"{k}: all arrays must share dim 0")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self._order = np.arange(self.num_data)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self._order)
+        # roll_over: keep leftover rows at the front of the next epoch
+        if self.last_batch_handle == "roll_over" and \
+                getattr(self, "_leftover", None) is not None:
+            self._order = np.concatenate([self._leftover, self._order])
+            self._leftover = None
+        self._cursor = 0
+
+    def iter_next(self):
+        return self._cursor < self.num_batches * self.batch_size and \
+            self._cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            if self.last_batch_handle == "roll_over":
+                start = (self.num_data // self.batch_size) * self.batch_size
+                if start < self.num_data:
+                    self._leftover = self._order[start:]
+            raise StopIteration
+        start = self._cursor
+        stop = min(start + self.batch_size, self.num_data)
+        idx = self._order[start:stop]
+        pad = 0
+        if stop - start < self.batch_size:  # pad from the beginning
+            pad = self.batch_size - (stop - start)
+            idx = np.concatenate([idx, self._order[:pad]])
+        self._cursor += self.batch_size
+        data = [nd.array(v[idx]) for _, v in self.data]
+        label = [nd.array(v[idx]) for _, v in self.label]
+        return DataBatch(data=data, label=label, pad=pad, index=idx,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def getpad(self):
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (ref: ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        return self.cur < self.size
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (ref: io.py PrefetchingIter /
+    src/io/iter_prefetcher.h): the host prepares batch N+1 while the device
+    runs batch N."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if isinstance(iters, (list, tuple)):
+            if len(iters) != 1:
+                raise MXNetError("multi-iter PrefetchingIter is not "
+                                 "supported; compose datasets instead")
+            iters = iters[0]
+        super().__init__(iters.batch_size)
+        self.iter = iters
+        self._depth = prefetch_depth
+        self._queue = None
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self._depth)
+
+        def worker():
+            try:
+                for batch in self.iter:
+                    self._queue.put(batch)
+            finally:
+                self._queue.put(None)
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._thread is not None and self._thread.is_alive():
+            while self._queue.get() is not None:
+                pass
+            self._thread.join()
+        self.iter.reset()
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    def iter_next(self):
+        raise MXNetError("PrefetchingIter supports only next()/iteration")
+
+
+class CSVIter(DataIter):
+    """ref: src/io/iter_csv.cc — streams batches out of CSV files."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32,
+                          ndmin=2).reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2).reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[0])
+        else:
+            label = np.zeros((data.shape[0],), dtype=np.float32)
+        self._inner = NDArrayIter(data, label, batch_size=batch_size,
+                                  last_batch_handle="pad"
+                                  if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+def _read_idx_file(path):
+    """MNIST idx format (also handles .gz)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        dt = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32,
+              13: np.float32, 14: np.float64}[dtype_code]
+        return np.frombuffer(f.read(), dtype=dt).reshape(shape)
+
+
+class MNISTIter(DataIter):
+    """ref: src/io/iter_mnist.cc — reads the raw MNIST ubyte files."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=0, **kwargs):
+        super().__init__(batch_size)
+        imgs = _read_idx_file(image).astype(np.float32) / 255.0
+        lbls = _read_idx_file(label).astype(np.float32)
+        if flat:
+            imgs = imgs.reshape(imgs.shape[0], -1)
+        else:
+            imgs = imgs.reshape(imgs.shape[0], 1, imgs.shape[1],
+                                imgs.shape[2])
+        self._inner = NDArrayIter(imgs, lbls, batch_size=batch_size,
+                                  shuffle=shuffle,
+                                  last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class ImageRecordIter(DataIter):
+    """ref: src/io/iter_image_recordio_2.cc ImageRecordIter — multithreaded
+    decode+augment over an indexed RecordIO pack, with prefetch.
+
+    Supported params mirror the reference's hot subset: path_imgrec/
+    path_imgidx, data_shape (C,H,W), batch_size, shuffle, rand_crop,
+    rand_mirror, resize, mean_{r,g,b}, std_{r,g,b}, scale.
+
+    ``preprocess_threads`` sizes the decode+augment thread pool — the
+    analog of the reference's parser→augmenter worker threads. Raw record
+    reads stay serial (cheap, preserves order); JPEG decode and
+    augmentation (cv2 — releases the GIL) run on the pool with up to
+    ``2 * preprocess_threads + batch_size`` records in flight, results
+    collected in submission order so the output stream is deterministic.
+    ``preprocess_threads <= 1`` keeps the fully serial path.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, rand_crop=False,
+                 rand_mirror=False, resize=-1, mean_r=0.0, mean_g=0.0,
+                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                 label_width=1, preprocess_threads=4, seed=0, **kwargs):
+        super().__init__(batch_size)
+        from .. import recordio
+        self._data_shape = tuple(data_shape)
+        if path_imgidx and os.path.exists(path_imgidx):
+            self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec,
+                                                   "r")
+            self._keys = list(self._rec.keys)
+        else:
+            self._rec = recordio.MXRecordIO(path_imgrec, "r")
+            self._keys = None
+        self._shuffle = shuffle
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._resize = resize
+        self._mean = np.array([mean_r, mean_g, mean_b],
+                              dtype=np.float32).reshape(3, 1, 1)
+        self._std = np.array([std_r, std_g, std_b],
+                             dtype=np.float32).reshape(3, 1, 1)
+        self._scale = scale
+        self._label_width = label_width
+        self._seed = seed
+        self._rng = np.random.RandomState(seed)
+        self._threads = int(preprocess_threads)
+        self._pool = None
+        self._pending = None
+        self._record_counter = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self._label_width == 1 else \
+            (self.batch_size, self._label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        if self._pending:
+            for fut in self._pending:
+                fut.cancel()
+        self._pending = deque()
+        self._record_counter = 0
+        # epoch counter folds into the per-record augment seed so each
+        # epoch draws fresh crops/mirrors (position-keyed seeding alone
+        # would replay epoch 1's augmentations forever)
+        self._epoch = getattr(self, "_epoch", -1) + 1
+        self._exhausted = False
+        if self._keys is not None:
+            self._order = list(self._keys)
+            if self._shuffle:
+                self._rng.shuffle(self._order)
+            self._pos = 0
+        else:
+            self._rec.reset()
+
+    def _next_raw(self):
+        """Serial record fetch — raw packed bytes, decode deferred."""
+        if self._keys is not None:
+            if self._pos >= len(self._order):
+                return None
+            s = self._rec.read_idx(self._order[self._pos])
+            self._pos += 1
+        else:
+            s = self._rec.read()
+        return s
+
+    def _decode_augment(self, s, record_idx):
+        """Worker body: unpack + JPEG decode + augment one record.
+        Augmentation randomness is derived from (seed, record index) so the
+        stream is reproducible regardless of pool size or thread timing."""
+        from .. import recordio
+        header, img = recordio.unpack_img(s, iscolor=1)
+        rng = np.random.RandomState(
+            ((self._seed * 1000003 + self._epoch) * 1000003 + record_idx)
+            & 0x7FFFFFFF) \
+            if (self._rand_crop or self._rand_mirror) else None
+        return header.label, self._augment(img, rng)
+
+    def _augment(self, img, rng):
+        import cv2
+        c, h, w = self._data_shape
+        if self._resize > 0:
+            short = min(img.shape[:2])
+            ratio = self._resize / short
+            img = cv2.resize(img, (int(round(img.shape[1] * ratio)),
+                                   int(round(img.shape[0] * ratio))))
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            img = cv2.resize(img, (max(w, iw), max(h, ih)))
+            ih, iw = img.shape[:2]
+        if self._rand_crop:
+            y = rng.randint(0, ih - h + 1)
+            x = rng.randint(0, iw - w + 1)
+        else:
+            y, x = (ih - h) // 2, (iw - w) // 2
+        img = img[y:y + h, x:x + w]
+        if self._rand_mirror and rng.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img[:, :, ::-1]  # BGR (cv2) → RGB, like the reference
+        chw = img.transpose(2, 0, 1).astype(np.float32)
+        chw = (chw - self._mean) / self._std * self._scale
+        return chw
+
+    def _fill_pending(self):
+        """Keep the decode pool fed: submit raw records until the in-flight
+        window (2×threads + batch) is full or the pack is exhausted."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._threads,
+                thread_name_prefix="mx-imgrec-decode")
+        window = 2 * self._threads + self.batch_size
+        while not self._exhausted and len(self._pending) < window:
+            s = self._next_raw()
+            if s is None:
+                self._exhausted = True
+                break
+            self._pending.append(self._pool.submit(
+                self._decode_augment, s, self._record_counter))
+            self._record_counter += 1
+
+    def _next_decoded(self):
+        """(label, augmented CHW image) in record order, or None at end."""
+        if self._threads <= 1:
+            s = self._next_raw()
+            if s is None:
+                return None
+            idx = self._record_counter
+            self._record_counter += 1
+            return self._decode_augment(s, idx)
+        self._fill_pending()
+        if not self._pending:
+            return None
+        return self._pending.popleft().result()
+
+    def next(self):
+        datas, labels = [], []
+        while len(datas) < self.batch_size:
+            rec = self._next_decoded()
+            if rec is None:
+                break
+            label, img = rec
+            datas.append(img)
+            vals = np.asarray(label, dtype=np.float32).reshape(-1)
+            # pad ragged label rows (variable object counts in detection
+            # packs) to label_width so the batch stacks
+            row = np.full(self._label_width,
+                          getattr(self, "_pad_value", 0.0), np.float32)
+            n = min(len(vals), self._label_width)
+            row[:n] = vals[:n]
+            labels.append(row)
+        if not datas:
+            raise StopIteration
+        pad = self.batch_size - len(datas)
+        while len(datas) < self.batch_size:
+            datas.append(datas[-1])
+            labels.append(labels[-1])
+        label_arr = np.stack(labels)
+        if self._label_width == 1:
+            label_arr = label_arr.reshape(-1)
+        return DataBatch(data=[nd.array(np.stack(datas))],
+                         label=[nd.array(label_arr)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection variant (ref: src/io/iter_image_det_recordio.cc): labels
+    are variable-length [header_width, obj_width, cls, x0, y0, x1, y1, ...]
+    padded to label_width per image; this build reads the same packs with
+    label_width = label_pad_width boxes."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 label_pad_width=35, label_pad_value=-1.0, **kwargs):
+        kwargs.setdefault("label_width", label_pad_width)
+        super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
+        self._pad_value = label_pad_value
+
+__all__.append("ImageDetRecordIter")
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text-format iterator (ref: src/io/iter_libsvm.cc LibSVMIter):
+    lines of ``label idx:val idx:val ...`` (indices 0-based like the
+    reference's default). Data batches are CSRNDArray (the reference
+    yields csr storage); labels are dense. Optional ``label_libsvm``
+    holds multi-dim labels in the same format."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = tuple(data_shape) if not isinstance(
+            data_shape, int) else (data_shape,)
+        self._label_shape = (tuple(label_shape) if not isinstance(
+            label_shape, int) else (label_shape,)) if label_shape else None
+        self._rows = self._parse(data_libsvm, want_label=True)
+        self._labels_ext = None
+        if label_libsvm:
+            if not self._label_shape:
+                raise MXNetError(
+                    "LibSVMIter: label_libsvm requires label_shape (the "
+                    "dense label dimension to densify indices into)")
+            self._labels_ext = self._parse(label_libsvm, want_label=False)
+            if len(self._labels_ext) != len(self._rows):
+                raise MXNetError(
+                    f"LibSVMIter: label file has {len(self._labels_ext)} "
+                    f"rows, data file {len(self._rows)}")
+        self._pos = 0
+
+    @staticmethod
+    def _parse(path, want_label):
+        rows = []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                if want_label:
+                    label = float(parts[0])
+                    feats = parts[1:]
+                else:
+                    label = None
+                    feats = parts
+                idx, val = [], []
+                for tok in feats:
+                    i, v = tok.split(":")
+                    idx.append(int(i))
+                    val.append(float(v))
+                rows.append((label, idx, val))
+        return rows
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data",
+                         (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        if self._label_shape:
+            return [DataDesc("softmax_label",
+                             (self.batch_size,) + self._label_shape)]
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._pos = 0
+
+    def next(self):
+        from ..ndarray.sparse import CSRNDArray
+        if self._pos + self.batch_size > len(self._rows):
+            raise StopIteration
+        dim = self._data_shape[0]
+        data, indices, indptr = [], [], [0]
+        labels = []
+        for j in range(self.batch_size):
+            row = self._pos + j
+            label, idx, val = self._rows[row]
+            indices.extend(idx)
+            data.extend(val)
+            indptr.append(len(indices))
+            if self._labels_ext is not None:
+                # separate label file: each row is idx:val pairs densified
+                # over label_shape (ref: iter_libsvm.cc label_libsvm)
+                ldim = self._label_shape[0] if self._label_shape else 1
+                lrow = np.zeros(ldim, np.float32)
+                _, lidx, lval = self._labels_ext[row]
+                lrow[np.asarray(lidx, np.int64)] = lval
+                labels.append(lrow if ldim > 1 else float(lrow[0]))
+            else:
+                labels.append(label if label is not None else 0.0)
+        self._pos += self.batch_size
+        csr = CSRNDArray(np.asarray(data, np.float32),
+                         np.asarray(indices, np.int64),
+                         np.asarray(indptr, np.int64),
+                         (self.batch_size, dim))
+        return DataBatch(data=[csr],
+                         label=[nd.array(np.asarray(labels, np.float32))])
+__all__.append("LibSVMIter")

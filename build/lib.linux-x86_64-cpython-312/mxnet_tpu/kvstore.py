@@ -1,0 +1,314 @@
+"""KVStore — the parameter-synchronization facade.
+
+TPU-native re-design of the reference's key→value store
+(ref: include/mxnet/kvstore.h KVStore::Create; src/kvstore/kvstore_local.h,
+comm.h CommDevice, kvstore_nccl.h, kvstore_dist.h). Mapping (SURVEY §5.8):
+
+- ``local``/``device``/``nccl``: single-process aggregation. The reference
+  reduces gradients across GPU replicas with P2P copies or NCCL rings; here
+  replica arrays live on one process and XLA's ``psum`` handles the *sharded*
+  fast path (mxnet_tpu.parallel.Trainer runs it inside the jitted step over
+  ICI). This facade keeps the push/pull API for script compatibility.
+- ``dist_sync``/``dist_device_sync``: multi-host data parallel. The reference
+  uses a ZMQ parameter server (ps-lite); the TPU path is
+  ``jax.distributed.initialize`` + GSPMD collectives over DCN. Server-side
+  optimizer semantics are preserved (``set_optimizer`` installs an updater
+  applied at push time — exactly the reference's DataHandleEx flow).
+- ``dist_async`` (fully asynchronous PS) has NO TPU analog and raises — the
+  documented intentional divergence (SURVEY §2.4 #27).
+"""
+from __future__ import annotations
+
+import pickle
+
+from . import ndarray as nd
+from . import optimizer as opt
+from .base import MXNetError
+
+__all__ = ["KVStore", "create"]
+
+
+def create(name="local"):
+    """ref: mx.kv.create(type)."""
+    return KVStore(name)
+
+
+_dist_initialized = False
+
+
+def _ensure_distributed():
+    """Join the multi-host job described by the launcher env
+    (tools/launch.py sets MXTPU_COORD_ADDR/NUM_PROC/PROC_ID): the JAX
+    coordination service replaces the ps-lite scheduler (SURVEY §5.8).
+    No-op in single-process runs."""
+    global _dist_initialized
+    import os
+    if _dist_initialized:
+        return
+    addr = os.environ.get("MXTPU_COORD_ADDR")
+    if not addr:
+        return
+    import jax
+    try:
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(os.environ["MXTPU_NUM_PROC"]),
+            process_id=int(os.environ["MXTPU_PROC_ID"]))
+    except RuntimeError:
+        pass       # already joined at package import (mxnet_tpu/__init__)
+    _dist_initialized = True
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        kv_type = kv_type.lower()
+        known = ("local", "local_allreduce_cpu", "local_allreduce_device",
+                 "device", "nccl", "dist_sync", "dist_device_sync", "dist",
+                 "horovod", "p3", "dist_sync_device")
+        if kv_type == "dist_async":
+            raise MXNetError(
+                "kvstore 'dist_async' (asynchronous parameter server) has no "
+                "TPU analog: XLA collectives are bulk-synchronous. Use "
+                "'dist_sync' (sync data parallel over DCN). This divergence "
+                "is documented in SURVEY §2.4 #27.")
+        if kv_type not in known:
+            raise MXNetError(f"unknown kvstore type {kv_type!r}")
+        self._type = kv_type
+        if kv_type.startswith("dist"):
+            _ensure_distributed()
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._states = {}
+        self._compression = None
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """Worker rank (ref: KVStore::get_rank). Multi-host: process index."""
+        if self._type.startswith("dist"):
+            import jax
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self):
+        if self._type.startswith("dist"):
+            import jax
+            return jax.process_count()
+        return 1
+
+    # -- core API ------------------------------------------------------------
+    def _norm_keys(self, key):
+        single = not isinstance(key, (list, tuple))
+        keys = [key] if single else list(key)
+        return single, [str(k) for k in keys]
+
+    def _norm_vals(self, value, n):
+        from .ndarray.sparse import BaseSparseNDArray
+        kinds = (nd.NDArray, BaseSparseNDArray)
+        if isinstance(value, kinds):
+            return [[value]] * 1 if n == 1 else [[value]]
+        if n == 1 and isinstance(value, (list, tuple)) and \
+                all(isinstance(v, kinds) for v in value):
+            return [list(value)]
+        return [v if isinstance(v, (list, tuple)) else [v] for v in value]
+
+    def init(self, key, value):
+        """ref: KVStore::Init — register initial weights."""
+        single, keys = self._norm_keys(key)
+        vals = self._norm_vals(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                continue
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate gradients into the store; if an optimizer is installed
+        the update is applied here (the reference's server-side update)."""
+        from .ndarray.sparse import RowSparseNDArray, _RowSparseCT, \
+            dedupe_rows
+        single, keys = self._norm_keys(key)
+        vals = self._norm_vals(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError(f"kvstore: key {k} was not init()ed")
+            if any(isinstance(v, RowSparseNDArray) for v in vlist):
+                if not all(isinstance(v, RowSparseNDArray) for v in vlist):
+                    raise MXNetError(
+                        f"kvstore.push key {k}: mixed dense and "
+                        f"row_sparse values in one push are not "
+                        f"supported — convert with tostype()")
+                # row-sparse push: aggregate the devices' touched rows
+                # (ref: kvstore_dist.h row_sparse push path)
+                import numpy as np
+                rows = np.concatenate(
+                    [np.asarray(v.indices) for v in vlist])
+                data = np.concatenate(
+                    [np.asarray(v.data) for v in vlist])
+                rs = dedupe_rows(_RowSparseCT(rows, data,
+                                              vlist[0].shape))
+                if self.num_workers > 1:
+                    # cross-host sparse reduce (ref: kvstore_dist.h sparse
+                    # push/pull over ps-lite): allgather the touched rows
+                    # + values over DCN, then segment-sum duplicates —
+                    # only touched rows ride the wire, not the table
+                    rs = self._allgather_row_sparse(rs)
+                if self._updater is not None:
+                    self._updater(k, rs, self._store[k])
+                else:
+                    # same replace semantics as the dense push: the store
+                    # holds the latest pushed value on the touched rows
+                    dst = self._store[k]
+                    dst._rebind(dst._data.at[np.asarray(rs.indices)].set(
+                        np.asarray(rs.data)))
+                continue
+            agg = vlist[0]
+            for v in vlist[1:]:
+                agg = agg + v.as_in_context(agg.ctx)
+            if self._compression is not None:
+                agg = nd.NDArray(
+                    self._compression.compress(k, agg._data),
+                    ctx=agg.ctx, _skip_device_put=True)
+            agg = self._allreduce_dcn(agg)
+            if self._updater is not None:
+                self._updater(k, agg, self._store[k])
+            else:
+                self._store[k]._rebind(agg.as_in_context(
+                    self._store[k].ctx)._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """ref: KVStore::Pull — broadcast current values into `out`."""
+        if out is None:
+            raise MXNetError("kvstore.pull requires out=")
+        single, keys = self._norm_keys(key)
+        outs = self._norm_vals(out, len(keys))
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"kvstore: key {k} was not init()ed")
+            src = self._store[k]
+            for o in olist:
+                o._rebind(src.as_in_context(o.ctx)._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (ref: KVStore::PushPull, the 1.6+ API)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull ONLY the requested rows (ref: KVStore::PullRowSparse /
+        kvstore_dist.h PullRowSparseImpl). With ``row_ids`` given,
+        returns RowSparseNDArray(s) of those rows; without, falls back
+        to a dense pull."""
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        import numpy as np
+
+        from .ndarray.sparse import RowSparseNDArray
+        single, keys = self._norm_keys(key)
+        if isinstance(row_ids, (list, tuple)) and len(row_ids) == len(keys):
+            rid_list = list(row_ids)
+        else:
+            # one row_ids set broadcast to every key
+            rid_list = [row_ids] * len(keys)
+        results = []
+        for k, rids in zip(keys, rid_list):
+            if k not in self._store:
+                raise MXNetError(f"kvstore: key {k} was not init()ed")
+            rids_np = np.unique(np.asarray(
+                rids.asnumpy() if isinstance(rids, nd.NDArray) else rids,
+                dtype=np.int64))
+            src = self._store[k]
+            rows = np.asarray(src._data)[rids_np]
+            results.append(RowSparseNDArray(rows, rids_np, src.shape))
+        if out is not None:
+            raise MXNetError("row_sparse_pull with row_ids returns the "
+                             "rows; out= is not supported on this build")
+        return results[0] if single else results
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    # -- optimizer on the store (ref: kv.set_optimizer → server pickle) ------
+    def set_optimizer(self, optimizer):
+        # round-trip through pickle like the reference ships it to servers —
+        # catches unpicklable optimizers early and proves ckpt-ability
+        self._optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._updater = opt.get_updater(self._optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """ref: kv.set_gradient_compression({'type': '2bit',
+        'threshold': t}) — 2-bit quantization + error feedback around the
+        cross-worker reduce."""
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression(**compression_params)
+
+    # -- multi-host ----------------------------------------------------------
+    def _allreduce_dcn(self, arr):
+        """dist_*: sum across worker processes over DCN. Single-process runs
+        (including the driver's virtual mesh) are the identity."""
+        if not self._type.startswith("dist"):
+            return arr
+        import jax
+        if jax.process_count() == 1:
+            return arr
+        # cross-process eager all-reduce: route through a tiny pjit'ed psum
+        # over the global device mesh (SURVEY §5.8 TPU-native equivalent)
+        from .parallel import allreduce_across_processes
+        return allreduce_across_processes(arr)
+
+    def _allgather_row_sparse(self, rs):
+        """Sparse DCN reduce: every process contributes its (rows, vals),
+        padded to the max row count so the allgather is same-shape, then
+        the union is dedupe-summed. The dense table never crosses DCN —
+        the point of the reference's sparse PS push (kvstore_dist.h)."""
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        from .ndarray.sparse import _RowSparseCT, dedupe_rows
+        rows = np.asarray(rs.indices, dtype=np.int64)
+        vals = np.asarray(rs.data)
+        counts = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray([rows.shape[0]], dtype=jnp.int32)))
+        m = int(counts.max())
+        if m == 0:
+            return rs
+        rows_p = np.full((m,), -1, np.int64)
+        rows_p[:rows.shape[0]] = rows
+        vals_p = np.zeros((m,) + vals.shape[1:], vals.dtype)
+        vals_p[:rows.shape[0]] = vals
+        all_rows = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray(rows_p)))
+        all_vals = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray(vals_p)))
+        flat_rows = all_rows.reshape(-1)
+        keep = flat_rows >= 0
+        return dedupe_rows(_RowSparseCT(
+            flat_rows[keep],
+            all_vals.reshape((-1,) + vals.shape[1:])[keep], rs.shape))
+
+    def barrier(self):
+        """ref: KVStore::Barrier (ps-lite barrier)."""
+        nd.waitall()
+
+    # -- checkpointing of optimizer state (ref: kv.save/load_optimizer_states)
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer installed on this kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer installed on this kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())

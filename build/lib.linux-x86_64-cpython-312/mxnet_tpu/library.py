@@ -1,0 +1,134 @@
+"""Dynamic op-library loading (ref: include/mxnet/lib_api.h MXLoadLib —
+the reference's header-only plugin ABI that registers CustomOp/CustomPass
+from an external .so at runtime; SURVEY §2 #6).
+
+Two plugin formats:
+
+- **Python plugin** (``.py``): executed as a module; it calls
+  ``mxnet_tpu.ops.register`` (or ``mx.operator.register``) itself. The
+  open-registry equivalent of lib_api.h's REGISTER_OP, with full access
+  to jnp/lax/Pallas.
+- **Native plugin** (``.so``): a C shared library exporting the flat ABI
+  below, loaded with ctypes; each exported op becomes a registered
+  operator whose compute runs through ``jax.pure_callback`` (host
+  callback — the same engine-integration point as mx.operator.CustomOp):
+
+      int         mxtpu_plugin_op_count(void);
+      const char* mxtpu_plugin_op_name(int i);
+      // y[0..n) = f(x[0..n)); same-shape unary contract
+      int         mxtpu_plugin_op_compute(int i, const float* x,
+                                          float* y, long n);
+
+  (The reference's lib_api.h is likewise a C ABI over flat tensors; the
+  same-shape unary contract covers the elementwise custom kernels its
+  examples ship. Richer signatures belong in Python plugins.)
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["load", "loaded_libraries"]
+
+_LOADED = {}
+_HANDLES = []      # keep native CDLLs alive without polluting _LOADED
+
+
+def loaded_libraries():
+    return dict(_LOADED)
+
+
+def load(path, verbose=True):
+    """Load an op library (.py or .so) and register its operators
+    (ref: mx.library.load -> MXLoadLib)."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise MXNetError(f"library.load: {path} does not exist")
+    if path in _LOADED:
+        return _LOADED[path]
+    if path.endswith(".py"):
+        names = _load_python(path)
+    elif path.endswith((".so", ".dylib")):
+        names = _load_native(path)
+    else:
+        raise MXNetError(f"library.load: {path}: expected a .py or .so "
+                         f"op library")
+    # regenerate the nd/sym wrapper namespaces so the new ops appear
+    # (the reference's MXLoadLib similarly re-lists atomic symbol
+    # creators after loading)
+    from . import ndarray as _nd_ns
+    from . import symbol as _sym_ns
+    _nd_ns._expose()
+    _sym_ns._expose()
+    _LOADED[path] = names
+    if verbose:
+        print(f"loaded library {os.path.basename(path)}: "
+              f"registered {names}")
+    return names
+
+
+def _load_python(path):
+    import importlib.util
+
+    from .ops import registry
+    before = set(registry.list_ops())
+    spec = importlib.util.spec_from_file_location(
+        f"mxtpu_plugin_{os.path.basename(path)[:-3]}", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return sorted(set(registry.list_ops()) - before)
+
+
+def _load_native(path):
+    import jax
+
+    from .ops.registry import register
+    lib = ctypes.CDLL(path)
+    try:
+        lib.mxtpu_plugin_op_count.restype = ctypes.c_int
+        lib.mxtpu_plugin_op_name.restype = ctypes.c_char_p
+        lib.mxtpu_plugin_op_name.argtypes = [ctypes.c_int]
+        lib.mxtpu_plugin_op_compute.restype = ctypes.c_int
+        lib.mxtpu_plugin_op_compute.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_long]
+        n_ops = lib.mxtpu_plugin_op_count()
+    except AttributeError as e:
+        raise MXNetError(
+            f"library.load: {path} does not export the mxtpu_plugin_* "
+            f"ABI (see mxnet_tpu/library.py docstring)") from e
+
+    names = []
+    for i in range(n_ops):
+        op_name = lib.mxtpu_plugin_op_name(i).decode()
+
+        def make_fn(idx, nm):
+            def host_compute(x):
+                x = np.ascontiguousarray(x, dtype=np.float32)
+                y = np.empty_like(x)
+                rc = lib.mxtpu_plugin_op_compute(
+                    idx, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    x.size)
+                if rc != 0:
+                    raise MXNetError(f"plugin op {nm} failed rc={rc}")
+                return y
+
+            def fn(x):
+                return jax.pure_callback(
+                    host_compute,
+                    jax.ShapeDtypeStruct(x.shape, np.float32),
+                    x, vmap_method="sequential")
+            return fn
+
+        register(op_name, differentiable=False,
+                 doc=f"plugin op from {os.path.basename(path)} "
+                     f"(lib_api.h-style dynamic registration)")(
+            make_fn(i, op_name))
+        names.append(op_name)
+    _HANDLES.append(lib)     # keep the CDLL alive for process lifetime
+    return names

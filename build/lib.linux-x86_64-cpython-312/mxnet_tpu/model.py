@@ -1,0 +1,45 @@
+"""``mx.model`` — checkpoint helpers (ref: python/mxnet/model.py).
+
+Format parity: ``prefix-symbol.json`` (graph) + ``prefix-%04d.params``
+(NDArray dict with arg:/aux: prefixes), the same pair every reference-era
+deployment pipeline consumes (SURVEY §5.4).
+"""
+from __future__ import annotations
+
+from . import ndarray as nd
+from .base import MXNetError
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """ref: model.py save_checkpoint."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_params(prefix, epoch):
+    """ref: model.py load_params → (arg_params, aux_params)."""
+    loaded = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        kind, _, name = k.partition(":")
+        if kind == "arg":
+            arg_params[name] = v
+        elif kind == "aux":
+            aux_params[name] = v
+        else:
+            raise MXNetError(f"invalid param key {k!r} (want arg:/aux:)")
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """ref: model.py load_checkpoint → (symbol, arg_params, aux_params)."""
+    from . import symbol as sym_mod
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

@@ -1,0 +1,6 @@
+"""Module API (ref: python/mxnet/module/__init__.py)."""
+from .base_module import BaseModule
+from .bucketing_module import BucketingModule
+from .module import Module
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

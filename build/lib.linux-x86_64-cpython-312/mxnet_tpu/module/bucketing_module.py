@@ -1,0 +1,131 @@
+"""BucketingModule — per-sequence-length executors
+(ref: python/mxnet/module/bucketing_module.py).
+
+The reference binds one GraphExecutor per bucket, sharing memory with the
+largest bucket (``shared_exec``). Here each bucket is a shape-keyed compiled
+program — XLA's compilation cache is the memory-sharing analog (SURVEY §2.2
+#11: "bucketing ≡ per-shape jit cache") — and parameters are shared by
+construction since every bucket executor binds the same arrays.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        if default_bucket_key is None:
+            raise MXNetError("default_bucket_key is required")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._bind_kwargs = {}
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    def _gen_module(self, bucket_key):
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(sym, data_names=data_names, label_names=label_names,
+                      logger=self.logger, context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self._bind_kwargs = dict(for_training=for_training,
+                                 inputs_need_grad=inputs_need_grad,
+                                 grad_req=grad_req)
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, **self._bind_kwargs)
+        self._buckets[self._default_bucket_key] = module
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """ref: BucketingModule.switch_bucket — bind a new bucket sharing
+        parameters with the default bucket."""
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes, **self._bind_kwargs)
+            default = self._buckets[self._default_bucket_key]
+            # share parameter arrays with the default bucket (the
+            # reference's shared_exec memory sharing)
+            for name in module._param_names:
+                if name in default._exec.arg_dict and \
+                        default._exec.arg_dict[name].shape == \
+                        module._exec.arg_dict[name].shape:
+                    module._exec.arg_dict[name] = \
+                        default._exec.arg_dict[name]
+                    if name in default._exec.grad_dict:
+                        module._exec.grad_dict[name] = \
+                            default._exec.grad_dict[name]
+            for name in module._aux_names:
+                if name in default._exec.aux_dict and \
+                        default._exec.aux_dict[name].shape == \
+                        module._exec.aux_dict[name].shape:
+                    module._exec.aux_dict[name] = \
+                        default._exec.aux_dict[name]
+            module.params_initialized = True
+            module._updater = default._updater
+            module._optimizer = default._optimizer
+            module.optimizer_initialized = default.optimizer_initialized
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, *args, **kwargs):
+        self._buckets[self._default_bucket_key].init_params(*args, **kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, *args, **kwargs):
+        default = self._buckets[self._default_bucket_key]
+        default.init_optimizer(*args, **kwargs)
+        for key, mod in self._buckets.items():
+            if key != self._default_bucket_key:
+                mod._updater = default._updater
+                mod._optimizer = default._optimizer
+                mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    def get_params(self):
+        return self._buckets[self._default_bucket_key].get_params()
+
+    def forward(self, data_batch, is_train=None):
+        key = data_batch.bucket_key
+        if key is None:
+            key = self._curr_bucket_key
+        if key != self._curr_bucket_key or key not in self._buckets:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)

@@ -1,0 +1,153 @@
+"""``mx.nd`` — the imperative operator namespace.
+
+Like the reference, this namespace is **generated at import time from the op
+registry** (ref: python/mxnet/ndarray/register.py, which synthesizes wrappers
+from MXSymbolListAtomicSymbolCreators): every registered operator gets a
+Python wrapper whose signature/docstring come from its OpParam spec, grouped
+into the same sub-namespaces the reference has (``nd.random``, ``nd.linalg``,
+``nd.contrib``, ``nd._internal``).
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import jax
+import numpy as _np
+
+from .. import _dispatch
+from ..ops import registry as _registry
+from .ndarray import (NDArray, arange, array, concat, empty, eye, full,
+                      imdecode, linspace, load, moveaxis, onehot_encode, ones,
+                      save, stack, waitall, zeros)
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "eye", "linspace", "concat", "stack", "save", "load", "waitall",
+           "random", "linalg", "contrib", "op", "_internal", "zeros_like",
+           "ones_like", "moveaxis", "onehot_encode"]
+
+_ARRAYLIKE = (NDArray, _np.ndarray, jax.Array, list)
+
+
+def _make_wrapper(opname: str, op: _registry.Operator):
+    param_order = [p.name for p in op.params]
+
+    def wrapper(*args, out=None, name=None, **kwargs):
+        args = list(args)
+        if op.num_inputs == 0:
+            inputs = []
+        elif op.num_inputs == -1:
+            inputs = []
+            while args and isinstance(args[0], _ARRAYLIKE):
+                inputs.append(args.pop(0))
+        else:
+            inputs, args = args[:op.num_inputs], args[op.num_inputs:]
+        # remaining positionals map onto declared params in order
+        for val, pname in zip(args, param_order):
+            if pname in kwargs:
+                raise TypeError(f"{opname}: got multiple values for {pname!r}")
+            kwargs[pname] = val
+        if len(args) > len(param_order):
+            raise TypeError(f"{opname}: too many positional arguments")
+        return _dispatch.invoke(op, inputs, kwargs, out=out)
+
+    wrapper.__name__ = opname
+    wrapper.__qualname__ = opname
+    wrapper.__doc__ = op.signature_doc()
+    return wrapper
+
+
+def _new_module(name: str) -> types.ModuleType:
+    mod = types.ModuleType(f"{__name__}.{name}")
+    sys.modules[mod.__name__] = mod
+    return mod
+
+
+random = _new_module("random")
+linalg = _new_module("linalg")
+contrib = _new_module("contrib")
+op = _new_module("op")
+_internal = _new_module("_internal")
+
+_this = sys.modules[__name__]
+
+
+def _expose():
+    for opname in _registry.list_ops():
+        operator = _registry.get(opname)
+        fn = _make_wrapper(opname, operator)
+        if opname.startswith("_contrib_"):
+            setattr(contrib, opname[len("_contrib_"):], fn)
+        elif opname.startswith("_random_"):
+            setattr(random, opname[len("_random_"):], fn)
+        elif opname.startswith("_sample_"):
+            setattr(random, opname[1:], fn)      # nd.random.sample_uniform
+            setattr(_this, opname[1:], fn)       # nd.sample_uniform (parity)
+        elif opname.startswith("_linalg_"):
+            setattr(linalg, opname[len("_linalg_"):], fn)
+        elif opname.startswith("_"):
+            setattr(_internal, opname, fn)
+        else:
+            if opname in ("BilinearResize2D", "AdaptiveAvgPooling2D", "ROIAlign",
+                          "MultiBoxPrior", "box_iou", "box_nms"):
+                setattr(contrib, opname, fn)
+            else:
+                if not hasattr(_this, opname):
+                    setattr(_this, opname, fn)
+                setattr(op, opname, fn)
+        # NDArray convenience methods (the reference generates these too)
+        if (operator.num_inputs in (1, 2) and opname[0].isalpha()
+                and opname[0].islower() and not hasattr(NDArray, opname)):
+            setattr(NDArray, opname, _as_method(fn))
+
+
+def _as_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    method.__doc__ = fn.__doc__
+    return method
+
+
+_expose()
+
+# `_shuffle` is exposed as nd.random.shuffle in the reference
+from . import sparse                      # noqa: E402
+from .sparse import (CSRNDArray, RowSparseNDArray, csr_matrix,  # noqa: E402
+                     row_sparse_array)
+
+
+def _nd_tostype(self, stype):
+    """ref: NDArray.tostype — convert between storage types."""
+    if stype == "default":
+        return self
+    if stype == "csr":
+        return sparse.csr_matrix(self)
+    if stype == "row_sparse":
+        return sparse.row_sparse_array(self)
+    raise ValueError(f"unknown storage type {stype!r}")
+
+
+NDArray.tostype = _nd_tostype
+
+random.shuffle = getattr(_internal, "_shuffle")
+random.bernoulli = _make_wrapper("_random_bernoulli",
+                                 _registry.get("_random_bernoulli"))
+random.multinomial = getattr(random, "sample_multinomial", None) or \
+    _make_wrapper("_sample_multinomial", _registry.get("_sample_multinomial"))
+
+# dtype-preserving aliases the reference exposes at top level
+zeros_like = getattr(_this, "zeros_like")
+ones_like = getattr(_this, "ones_like")
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None):
+    """nd.dot — explicit def so positional flags work (ref: tensor/dot.cc)."""
+    return _dispatch.invoke("dot", [lhs, rhs],
+                            dict(transpose_a=transpose_a,
+                                 transpose_b=transpose_b), out=out)
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    return _dispatch.invoke("SliceChannel", [data],
+                            dict(num_outputs=num_outputs, axis=axis,
+                                 squeeze_axis=squeeze_axis))

@@ -1,0 +1,583 @@
+"""NDArray — the framework's tensor type.
+
+TPU-native re-design of the reference's async, ref-counted tensor
+(ref: include/mxnet/ndarray.h NDArray; src/ndarray/ndarray.cc). Design
+mapping (SURVEY §7 translation table):
+
+- asynchronous evaluation: native to JAX/PjRt — ops return before compute
+  finishes; ``wait_to_read`` = ``block_until_ready``;
+- mutability: the *API* stays mutable (``x += 1``, ``x[:] = v``, ``out=``),
+  implemented by rebinding the handle to a new immutable ``jax.Array``
+  (in-jit mutation uses buffer donation instead);
+- engine var-dependencies: data-flow ordering is tracked by the runtime, so
+  there is nothing to declare;
+- views (``Slice/At``) are copy-on-read, NOT write-through aliases — a
+  documented divergence from the reference (SURVEY §7 "hard parts" #1).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import _dispatch, engine
+from ..base import MXNetError, _as_np_dtype, mx_real_t
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "eye", "linspace", "concat", "stack", "save", "load", "waitall",
+           "moveaxis", "onehot_encode", "imdecode"]
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_node",
+                 "_tape_out_idx", "_sparse", "_sparse_used", "_zeroed",
+                 "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None,
+                 _skip_device_put: bool = False):
+        ctx = ctx if ctx is not None else current_context()
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array) or dtype is not None:
+            data = jnp.asarray(data, dtype=_as_np_dtype(dtype) if dtype else None)
+        if not _skip_device_put:
+            data = jax.device_put(data, ctx.jax_device)
+        self._data = data
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "write"
+        self._tape_node = None
+        self._tape_out_idx = 0
+
+    # -- core properties ----------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def ctx(self) -> Context:
+        return self._ctx
+
+    context = ctx
+
+    @property
+    def stype(self):
+        return "default"   # sparse storage types are not implemented yet
+
+    @property
+    def grad(self):
+        # a row-sparse deposit (Embedding sparse_grad backward) lives on
+        # the buffer as `_sparse`; surface it so raw-autograd users never
+        # read the stale dense buffer
+        if self._grad is not None:
+            rs = getattr(self._grad, "_sparse", None)
+            if rs is not None:
+                return rs
+        return self._grad
+
+    @property
+    def T(self):
+        return _invoke1("transpose", self)
+
+    @property
+    def handle(self):
+        return self._data  # the "C handle" is the jax.Array itself
+
+    def _rebind(self, new_data):
+        """Point this handle at new contents — the mutation mechanism."""
+        self._data = new_data
+
+    # -- sync / host transfer ----------------------------------------------
+    def wait_to_read(self):
+        """ref: NDArray::WaitToRead."""
+        jax.block_until_ready(self._data)
+
+    def wait_to_write(self):
+        jax.block_until_ready(self._data)
+
+    def asnumpy(self) -> np.ndarray:
+        arr = np.asarray(jax.device_get(self._data))
+        return arr
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def astype(self, dtype, copy=True):
+        return _invoke1("Cast", self, dtype=np.dtype(_as_np_dtype(dtype)).name)
+
+    def copy(self):
+        return NDArray(self._data, ctx=self._ctx, _skip_device_put=True)
+
+    def copyto(self, other):
+        """ref: NDArray::CopyFromTo / mx.nd.NDArray.copyto."""
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        other._rebind(jax.device_put(self._data, other.ctx.jax_device)
+                      .astype(other._data.dtype))
+        return other
+
+    def as_in_context(self, ctx: Context):
+        if ctx == self._ctx:
+            return self
+        out = NDArray(jax.device_put(self._data, ctx.jax_device), ctx=ctx,
+                      _skip_device_put=True)
+        out._tape_node = self._tape_node
+        out._tape_out_idx = self._tape_out_idx
+        return out
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage types not supported yet")
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """ref: python/mxnet/ndarray/ndarray.py attach_grad — marks this array
+        as a differentiation leaf (detaches it from any recorded graph)."""
+        self._grad = zeros(self.shape, dtype=self.dtype, ctx=self._ctx)
+        self._grad._zeroed = True     # fresh buffer: sparse add-deposits
+        self._grad_req = grad_req     # may stay sparse
+        self._tape_node = None
+        self._tape_out_idx = 0
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx, _skip_device_put=True)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- shape ops as methods ------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape"):
+            shape = tuple(kwargs["shape"])
+        return _invoke1("Reshape", self, shape=shape,
+                        reverse=kwargs.get("reverse", False))
+
+    def reshape_like(self, other):
+        return _invoke1("Reshape", self, shape=other.shape)
+
+    def broadcast_to(self, shape):
+        return _invoke1("broadcast_to", self, shape=shape)
+
+    def broadcast_like(self, other):
+        return _dispatch.invoke("broadcast_like", [self, other], {})
+
+    def expand_dims(self, axis):
+        return _invoke1("expand_dims", self, axis=axis)
+
+    def flatten(self):
+        return _invoke1("Flatten", self)
+
+    def squeeze(self, axis=None):
+        return _invoke1("squeeze", self, axis=axis)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _invoke1("transpose", self, axes=axes or None)
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke1("SwapAxis", self, dim1=dim1, dim2=dim2)
+
+    def flip(self, axis):
+        return _invoke1("reverse", self, axis=axis)
+
+    def slice(self, begin, end, step=None):
+        return _invoke1("slice", self, begin=begin, end=end, step=step)
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke1("slice_axis", self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _dispatch.invoke("take", [self, indices], dict(axis=axis, mode=mode))
+
+    def one_hot(self, depth, **kw):
+        return _invoke1("one_hot", self, depth=depth, **kw)
+
+    def pad(self, mode="constant", pad_width=None, constant_value=0.0):
+        return _invoke1("Pad", self, mode=mode, pad_width=pad_width,
+                        constant_value=constant_value)
+
+    def clip(self, a_min=None, a_max=None):
+        return _invoke1("clip", self, a_min=a_min, a_max=a_max)
+
+    def tile(self, reps):
+        return _invoke1("tile", self, reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        return _invoke1("repeat", self, repeats=repeats, axis=axis)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _invoke1("SliceChannel", self, num_outputs=num_outputs,
+                        axis=axis, squeeze_axis=squeeze_axis)
+
+    # -- python protocol -----------------------------------------------------
+    def __repr__(self):
+        return f"\n{self.asnumpy()}\n<NDArray {self.shape} @{self._ctx}>"
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple "
+                             "elements is ambiguous")
+        return bool(self.asscalar())
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __getitem__(self, key):
+        # indexing under autograd routes through a recorded op so grads flow
+        from ..autograd import is_recording
+        idx = _convert_index(key)
+        if is_recording() and (self._tape_node is not None or self._grad is not None):
+            return _dispatch.invoke(_getitem_op(idx), [self], {})
+        return NDArray(self._data[idx], ctx=self._ctx, _skip_device_put=True)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        idx = _convert_index(key)
+        self._rebind(self._data.at[idx].set(jnp.asarray(value, dtype=self._data.dtype)))
+
+    # arithmetic -------------------------------------------------------------
+    def __add__(self, other):
+        return _binary(self, other, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _binary(self, other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _invoke1("_rminus_scalar", self, scalar=float(other))
+
+    def __mul__(self, other):
+        return _binary(self, other, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _binary(self, other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _invoke1("_rdiv_scalar", self, scalar=float(other))
+
+    def __mod__(self, other):
+        return _binary(self, other, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, other):
+        return _invoke1("_rmod_scalar", self, scalar=float(other))
+
+    def __pow__(self, other):
+        return _binary(self, other, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, other):
+        return _invoke1("_rpower_scalar", self, scalar=float(other))
+
+    def __neg__(self):
+        return _invoke1("negative", self)
+
+    def __abs__(self):
+        return _invoke1("abs", self)
+
+    def __eq__(self, other):
+        return _binary(self, other, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        return _binary(self, other, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, other):
+        return _binary(self, other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return _binary(self, other, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return _binary(self, other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return _binary(self, other, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: rebind the handle (ref: engine write-var mutation)
+    def __iadd__(self, other):
+        res = self.__add__(other)
+        self._rebind(res._data)
+        self._tape_node, self._tape_out_idx = res._tape_node, res._tape_out_idx
+        return self
+
+    def __isub__(self, other):
+        res = self.__sub__(other)
+        self._rebind(res._data)
+        self._tape_node, self._tape_out_idx = res._tape_node, res._tape_out_idx
+        return self
+
+    def __imul__(self, other):
+        res = self.__mul__(other)
+        self._rebind(res._data)
+        self._tape_node, self._tape_out_idx = res._tape_node, res._tape_out_idx
+        return self
+
+    def __itruediv__(self, other):
+        res = self.__truediv__(other)
+        self._rebind(res._data)
+        self._tape_node, self._tape_out_idx = res._tape_node, res._tape_out_idx
+        return self
+
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "ctx": str(self._ctx)}
+
+    def __setstate__(self, state):
+        ctx = Context(state["ctx"].split("(")[0],
+                      int(state["ctx"].split("(")[1].rstrip(")")))
+        self._data = jnp.asarray(state["data"])
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "write"
+        self._tape_node = None
+        self._tape_out_idx = 0
+
+
+def _invoke1(op, x, **kwargs):
+    return _dispatch.invoke(op, [x], kwargs)
+
+
+def _binary(lhs, rhs, broadcast_op, scalar_op):
+    if isinstance(rhs, NDArray):
+        return _dispatch.invoke(broadcast_op, [lhs, rhs], {})
+    return _dispatch.invoke(scalar_op, [lhs], {"scalar": float(rhs)})
+
+
+def _convert_index(key):
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+    return key
+
+
+def _getitem_op(idx):
+    """A one-off differentiable gather op for recorded indexing."""
+    from ..ops.registry import Operator
+    return Operator(name="_getitem", fn=lambda x: x[idx], num_inputs=1)
+
+
+# ---------------------------------------------------------------------------
+# creation functions (ref: python/mxnet/ndarray/ndarray.py + utils)
+# ---------------------------------------------------------------------------
+def array(source_array, ctx=None, dtype=None) -> NDArray:
+    """ref: mx.nd.array — dtype defaults to the source's dtype for ndarray
+    inputs, float32 otherwise (list/scalar inputs)."""
+    if isinstance(source_array, NDArray):
+        src = source_array.asnumpy()
+    elif isinstance(source_array, (np.ndarray, jax.Array)):
+        src = np.asarray(source_array)
+    else:
+        src = np.asarray(source_array)
+        if dtype is None:
+            dtype = mx_real_t
+    if dtype is None and src.dtype == np.float64:
+        dtype = mx_real_t   # reference defaults to float32
+    return NDArray(src, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.zeros(shape, dtype=_as_np_dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.ones(shape, dtype=_as_np_dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.full(shape, val, dtype=_as_np_dtype(dtype)), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    out = jnp.arange(start, stop, step, dtype=_as_np_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
+    return NDArray(jnp.eye(N, M or N, k=k, dtype=_as_np_dtype(dtype)), ctx=ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None) -> NDArray:
+    return NDArray(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                dtype=_as_np_dtype(dtype)), ctx=ctx)
+
+
+def moveaxis(tensor, source, destination) -> NDArray:
+    return _dispatch.invoke("moveaxis", [tensor],
+                            {"source": source, "destination": destination})
+
+
+def concat(*args, dim=1):
+    return _dispatch.invoke("Concat", list(args), {"dim": dim})
+
+
+def stack(*args, axis=0):
+    return _dispatch.invoke("stack", list(args), {"axis": axis})
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = _invoke1("one_hot", indices, depth=depth)
+    out._rebind(res._data)
+    return out
+
+
+def imdecode(buf, **kwargs):
+    raise MXNetError("nd.imdecode requires the image module; use "
+                     "mxnet_tpu.image.imdecode")
+
+
+def waitall():
+    engine.waitall()
+
+
+# ---------------------------------------------------------------------------
+# save / load — the `.params` container (ref: src/ndarray/ndarray.cc
+# NDArray::Save/Load via MXNDArraySave). Binary layout follows the reference's
+# documented structure (list magic + per-array magic, shape, context, dtype);
+# byte-level parity with real reference files must be re-verified when the
+# reference mount is populated (SURVEY provenance warning).
+# ---------------------------------------------------------------------------
+_LIST_MAGIC = 0x112          # kMXAPINDArrayListMagic
+_ND_MAGIC = 0xF993FAC9       # NDArray binary magic (v2)
+
+_DTYPE_CODE = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+               "int32": 4, "int8": 5, "int64": 6, "bool": 7, "bfloat16": 12}
+_CODE_DTYPE = {v: k for k, v in _DTYPE_CODE.items()}
+
+
+def save(fname: str, data):
+    """Save NDArrays (list or str->NDArray dict) to a .params file."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = []
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for arr in arrays:
+            _write_ndarray(f, arr)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def _write_ndarray(f, arr: NDArray):
+    np_arr = arr.asnumpy()
+    f.write(struct.pack("<I", _ND_MAGIC))
+    f.write(struct.pack("<I", len(np_arr.shape)))
+    for s in np_arr.shape:
+        f.write(struct.pack("<q", s))
+    f.write(struct.pack("<ii", arr.ctx.device_typeid, arr.ctx.device_id))
+    dt = np.dtype(np_arr.dtype).name
+    f.write(struct.pack("<i", _DTYPE_CODE.get(dt, 0)))
+    if dt == "bfloat16":
+        np_arr = np_arr.view(np.uint16)
+    f.write(np_arr.tobytes())
+
+
+def load(fname: str):
+    """Load a .params file -> list or dict of NDArrays."""
+    with open(fname, "rb") as f:
+        magic, _res = struct.unpack("<QQ", f.read(16))
+        if magic != _LIST_MAGIC:
+            raise MXNetError(f"{fname}: bad magic {magic:#x} — not an NDArray "
+                             "save file")
+        (count,) = struct.unpack("<Q", f.read(8))
+        arrays = [_read_ndarray(f) for _ in range(count)]
+        (n_names,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(n_names):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def _read_ndarray(f) -> NDArray:
+    (magic,) = struct.unpack("<I", f.read(4))
+    if magic != _ND_MAGIC:
+        raise MXNetError("corrupt NDArray entry")
+    (ndim,) = struct.unpack("<I", f.read(4))
+    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+    dev_type, dev_id = struct.unpack("<ii", f.read(8))
+    (dtype_code,) = struct.unpack("<i", f.read(4))
+    dt = _CODE_DTYPE.get(dtype_code, "float32")
+    count = int(np.prod(shape)) if ndim else 1
+    if dt == "bfloat16":
+        import ml_dtypes
+        raw = np.frombuffer(f.read(count * 2), dtype=np.uint16)
+        np_arr = raw.view(ml_dtypes.bfloat16).reshape(shape)
+    else:
+        npdt = np.dtype(dt)
+        np_arr = np.frombuffer(f.read(count * npdt.itemsize),
+                               dtype=npdt).reshape(shape)
+    return NDArray(np_arr)

@@ -1,0 +1,253 @@
+"""``mx.np`` — the NumPy-semantics array namespace.
+
+ref: python/mxnet/numpy/ (the 1.6+ `_np_*` op family, SURVEY §2 #16). The
+reference re-implements NumPy semantics (zero-dim shapes, broadcasting,
+dtype rules) as ~50k LoC of C++ kernels; on TPU **jnp already is that
+namespace**, so every function here is the jnp implementation wrapped with
+NDArray boxing + autograd-tape capture — same API, compiled by XLA,
+differentiable under ``autograd.record()``.
+"""
+from __future__ import annotations
+
+import builtins
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import _rng, autograd, engine
+from ..base import MXNetError, _as_np_dtype
+from ..context import current_context
+from ..ndarray import NDArray
+
+__all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
+           "eye", "linspace"]
+
+ndarray = NDArray
+# dtype aliases (mx.np.float32 etc.)
+float16 = onp.float16
+float32 = onp.float32
+float64 = onp.float64
+int8 = onp.int8
+int32 = onp.int32
+int64 = onp.int64
+uint8 = onp.uint8
+bool_ = onp.bool_
+pi = onp.pi
+inf = onp.inf
+nan = onp.nan
+newaxis = None
+
+
+def _unbox(x):
+    if isinstance(x, NDArray):
+        return x._data
+    return x
+
+
+def _tracked(x):
+    return isinstance(x, NDArray) and (x._tape_node is not None
+                                       or x._grad is not None)
+
+
+def _call(fn, *args, **kwargs):
+    """Generic tape-aware dispatch of a jnp function over NDArray args —
+    the mx.np analog of _dispatch.invoke (ref: MXImperativeInvokeEx)."""
+    nd_inputs = [a for a in args if isinstance(a, NDArray)]
+    datas = tuple(_unbox(a) for a in args)
+    # builtins.any: the generated mx.np.any wrapper shadows the builtin
+    # inside this module
+    recording = autograd.is_recording() and builtins.any(
+        _tracked(a) for a in nd_inputs)
+    if recording:
+        pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+
+        def wrapped(*tracked_datas):
+            full = list(datas)
+            for i, d in zip(pos, tracked_datas):
+                full[i] = d
+            return fn(*full, **kwargs)
+        out_data, vjp_fn = jax.vjp(wrapped,
+                                   *[datas[i] for i in pos])
+        outs = list(out_data) if isinstance(out_data, tuple) else [out_data]
+        avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+        parents = []
+        for a in nd_inputs:
+            if a._grad is not None:
+                parents.append((None, 0, a))
+            elif a._tape_node is not None:
+                parents.append((a._tape_node, a._tape_out_idx, None))
+            else:
+                parents.append((None, 0, None))
+        node = autograd.TapeNode(vjp_fn, parents, avals, fwd_fn=wrapped,
+                                 fwd_inputs=list(nd_inputs))
+    else:
+        out_data = fn(*datas, **kwargs)
+        outs = list(out_data) if isinstance(out_data, tuple) else [out_data]
+        node = None
+    ctx = nd_inputs[0].ctx if nd_inputs else current_context()
+    results = []
+    for i, o in enumerate(outs):
+        if not isinstance(o, jax.Array):
+            results.append(o)
+            continue
+        arr = NDArray(o, ctx=ctx, _skip_device_put=True)
+        if node is not None:
+            arr._tape_node = node
+            arr._tape_out_idx = i
+        results.append(arr)
+    if len(results) == 1:
+        return results[0]
+    return tuple(results)
+
+
+def _make(name, fn):
+    def wrapper(*args, **kwargs):
+        if "dtype" in kwargs and kwargs["dtype"] is not None:
+            kwargs["dtype"] = _as_np_dtype(kwargs["dtype"])
+        if "ctx" in kwargs:       # creation ops accept ctx= like the ref
+            kwargs.pop("ctx")
+        return _call(fn, *args, **kwargs)
+    wrapper.__name__ = name
+    wrapper.__doc__ = (fn.__doc__ or "").split("\n\n")[0] + \
+        f"\n\n(numpy-semantics; jnp.{name} under the hood)"
+    return wrapper
+
+
+# every jnp function exported here keeps exact NumPy semantics
+_FUNCS = [
+    # creation
+    "zeros", "ones", "empty", "full", "arange", "eye", "identity",
+    "linspace", "logspace", "meshgrid", "tril", "triu",
+    "zeros_like", "ones_like", "full_like", "empty_like",
+    # manipulation
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "concatenate", "stack", "vstack", "hstack", "dstack", "column_stack",
+    "split", "array_split", "hsplit", "vsplit", "dsplit", "tile", "repeat",
+    "flip", "fliplr", "flipud", "roll", "rot90", "expand_dims", "squeeze",
+    "broadcast_to", "broadcast_arrays", "atleast_1d", "atleast_2d",
+    "atleast_3d", "pad", "append", "delete", "insert", "unique",
+    # math
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "mod", "remainder", "power", "float_power", "negative", "positive",
+    "absolute", "abs", "fabs", "sign", "rint", "exp", "expm1", "exp2",
+    "log", "log2", "log10", "log1p", "sqrt", "cbrt", "square", "reciprocal",
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "arctan2", "sinh",
+    "cosh", "tanh", "arcsinh", "arccosh", "arctanh", "degrees", "radians",
+    "deg2rad", "rad2deg", "hypot", "maximum", "minimum", "fmax", "fmin",
+    "clip", "floor", "ceil", "trunc", "around", "round",
+    "nan_to_num", "interp", "heaviside", "gcd", "lcm", "ldexp",
+    # ("fix" omitted: deprecated in jnp; numpy parity via trunc)
+    # reductions
+    "sum", "prod", "cumsum", "cumprod", "max", "min", "amax", "amin",
+    "nanmax", "nanmin", "nansum", "nanprod", "mean", "std", "var",
+    "median", "average", "nanmean", "nanstd", "nanvar", "ptp",
+    "percentile", "quantile", "count_nonzero",
+    # linalg-ish / products
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum",
+    "kron", "cross", "trace", "diagonal", "diag", "diagflat",
+    # comparison / logic
+    "equal", "not_equal", "less", "less_equal", "greater", "greater_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "isfinite",
+    "isinf", "isnan", "isneginf", "isposinf", "isclose", "allclose",
+    "array_equal", "where", "all", "any",
+    # sorting / searching / counting
+    "sort", "argsort", "argmax", "argmin", "nanargmax", "nanargmin",
+    "searchsorted", "partition", "argpartition", "nonzero", "flatnonzero",
+    "bincount", "digitize", "histogram", "take", "take_along_axis",
+    "choose", "compress", "extract", "indices", "unravel_index",
+    "ravel_multi_index", "tril_indices", "triu_indices",
+    # bit ops
+    "bitwise_and", "bitwise_or", "bitwise_xor", "invert", "left_shift",
+    "right_shift",
+    # misc
+    "copysign", "signbit", "frexp", "modf", "divmod", "gradient", "diff",
+    "ediff1d", "trapz", "convolve", "correlate", "real", "imag", "conj",
+    "angle", "iscomplexobj", "isrealobj", "shape", "size", "ndim",
+    "result_type", "can_cast", "promote_types", "vander", "i0", "sinc",
+]
+
+_this = sys.modules[__name__]
+for _name in _FUNCS:
+    if hasattr(jnp, _name) and not hasattr(_this, _name):
+        setattr(_this, _name, _make(_name, getattr(jnp, _name)))
+        __all__.append(_name)
+
+
+def array(obj, dtype=None, ctx=None):
+    """mx.np.array — accepts nested lists/numpy/NDArray."""
+    if isinstance(obj, NDArray):
+        obj = obj._data
+    return NDArray(jnp.asarray(obj, dtype=_as_np_dtype(dtype)
+                               if dtype else None), ctx=ctx)
+
+
+asarray = array
+
+
+# linalg sub-namespace
+linalg = types.ModuleType(f"{__name__}.linalg")
+sys.modules[linalg.__name__] = linalg
+for _name in ["norm", "inv", "det", "slogdet", "cholesky", "qr", "svd",
+              "eig", "eigh", "eigvals", "eigvalsh", "solve", "lstsq",
+              "matrix_rank", "matrix_power", "pinv", "tensorsolve",
+              "tensorinv", "multi_dot"]:
+    if hasattr(jnp.linalg, _name):
+        setattr(linalg, _name, _make(_name, getattr(jnp.linalg, _name)))
+
+# fft sub-namespace
+fft = types.ModuleType(f"{__name__}.fft")
+sys.modules[fft.__name__] = fft
+for _name in ["fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "fftn",
+              "ifftn", "fftfreq", "rfftfreq", "fftshift", "ifftshift"]:
+    if hasattr(jnp.fft, _name):
+        setattr(fft, _name, _make(_name, getattr(jnp.fft, _name)))
+
+
+# random sub-namespace: stateful-API facade over jax.random (the eager key
+# chain in _rng threads the state, ref: mx.np.random)
+random = types.ModuleType(f"{__name__}.random")
+sys.modules[random.__name__] = random
+
+
+def _np_random(name, sampler):
+    def wrapper(*args, size=None, dtype=None, ctx=None, **kwargs):
+        key = _rng.next_key()
+        shape = size if size is not None else ()
+        if isinstance(shape, int):
+            shape = (shape,)
+        out = sampler(key, shape, *args, **kwargs)
+        if dtype is not None:
+            out = out.astype(_as_np_dtype(dtype))
+        return NDArray(out, _skip_device_put=True)
+    wrapper.__name__ = name
+    return wrapper
+
+
+random.uniform = _np_random(
+    "uniform", lambda key, shape, low=0.0, high=1.0:
+    jax.random.uniform(key, shape, minval=low, maxval=high))
+random.normal = _np_random(
+    "normal", lambda key, shape, loc=0.0, scale=1.0:
+    jax.random.normal(key, shape) * scale + loc)
+random.randint = _np_random(
+    "randint", lambda key, shape, low, high=None:
+    jax.random.randint(key, shape, low if high is not None else 0,
+                       high if high is not None else low))
+random.rand = lambda *shape: random.uniform(size=shape)
+random.randn = lambda *shape: random.normal(size=shape)
+random.choice = _np_random(
+    "choice", lambda key, shape, a, replace=True, p=None:
+    jax.random.choice(key, a if not isinstance(a, NDArray) else a._data,
+                      shape, replace=replace,
+                      p=None if p is None else _unbox(p)))
+random.shuffle = lambda x: x._rebind(
+    jax.random.permutation(_rng.next_key(), x._data))
+random.permutation = _np_random(
+    "permutation", lambda key, shape, x:
+    jax.random.permutation(key, x if not isinstance(x, NDArray)
+                           else x._data))
+random.seed = lambda s: __import__(
+    "mxnet_tpu.random", fromlist=["seed"]).seed(s)

@@ -1,0 +1,122 @@
+"""``mx.npx`` — NumPy-extension namespace (ref: python/mxnet/
+numpy_extension/ + the `_npx_*` ops): neural-net operators with NumPy
+calling conventions, plus the np-mode switches."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import ndarray as nd
+from ..numpy import _call
+from ..base import MXNetError
+
+__all__ = ["set_np", "reset_np", "is_np_array", "softmax", "log_softmax",
+           "relu", "sigmoid", "gelu", "leaky_relu", "batch_norm",
+           "layer_norm", "fully_connected", "convolution", "pooling",
+           "one_hot", "pick", "topk", "embedding", "dropout", "seed"]
+
+_np_mode = {"array": False, "shape": False}
+
+
+def set_np(shape=True, array=True):
+    """ref: npx.set_np — enables numpy semantics globally. The TPU build's
+    nd namespace is already numpy-semantics (jnp), so this toggles only the
+    bookkeeping flag for script parity."""
+    _np_mode["array"] = array
+    _np_mode["shape"] = shape
+
+
+def reset_np():
+    set_np(False, False)
+    _np_mode["array"] = False
+    _np_mode["shape"] = False
+
+
+def is_np_array():
+    return _np_mode["array"]
+
+
+def softmax(x, axis=-1):
+    return _call(jax.nn.softmax, x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return _call(jax.nn.log_softmax, x, axis=axis)
+
+
+def relu(x):
+    return _call(jax.nn.relu, x)
+
+
+def sigmoid(x):
+    return _call(jax.nn.sigmoid, x)
+
+
+def gelu(x):
+    return _call(jax.nn.gelu, x)
+
+
+def leaky_relu(x, slope=0.01):
+    return _call(lambda a: jax.nn.leaky_relu(a, slope), x)
+
+
+def one_hot(x, depth, on_value=1.0, off_value=0.0, dtype=None):
+    return _call(lambda a: jax.nn.one_hot(a.astype(jnp.int32), depth) *
+                 (on_value - off_value) + off_value, x)
+
+
+def pick(data, index, axis=-1, keepdims=False):
+    return nd.pick(data, index, axis=axis, keepdims=keepdims)
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+    return nd.topk(data, k=k, axis=axis, ret_typ=ret_typ,
+                   is_ascend=is_ascend)
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None):
+    return nd.Embedding(data, weight,
+                        input_dim=input_dim or weight.shape[0],
+                        output_dim=output_dim or weight.shape[1])
+
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    args = [x, weight] + ([] if bias is None else [bias])
+    return nd.FullyConnected(*args,
+                             num_hidden=num_hidden or weight.shape[0],
+                             no_bias=bias is None or no_bias,
+                             flatten=flatten)
+
+
+def convolution(data, weight, bias=None, **kwargs):
+    args = [data, weight] + ([] if bias is None else [bias])
+    if bias is None:
+        kwargs.setdefault("no_bias", True)
+    return nd.Convolution(*args, **kwargs)
+
+
+def pooling(data, **kwargs):
+    return nd.Pooling(data, **kwargs)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-3,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1):
+    return nd.BatchNorm(x, gamma, beta, running_mean, running_var, eps=eps,
+                        momentum=momentum, fix_gamma=fix_gamma,
+                        use_global_stats=use_global_stats,
+                        output_mean_var=output_mean_var, axis=axis)
+
+
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    return nd.LayerNorm(x, gamma, beta, axis=axis, eps=eps)
+
+
+def dropout(x, p=0.5, **kwargs):
+    return nd.Dropout(x, p=p, **kwargs)
+
+
+def seed(s):
+    from .. import random as _random
+    _random.seed(s)

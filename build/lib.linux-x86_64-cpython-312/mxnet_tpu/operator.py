@@ -1,0 +1,160 @@
+"""``mx.operator`` — Python custom operators
+(ref: python/mxnet/operator.py CustomOp/CustomOpProp +
+src/operator/custom/custom.cc).
+
+The reference runs user Python forward/backward on a dedicated engine
+thread with GIL juggling; the TPU translation is ``jax.pure_callback``:
+the custom op becomes a host callback embedded in the XLA program, with a
+``jax.custom_vjp`` wiring the user's ``backward`` as the pullback — so
+custom ops compose with autograd, jit, and hybridize like any registry op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """User op base (ref: operator.py CustomOp): override forward/backward
+    working on numpy arrays via ``in_data``/``out_data`` lists and
+    ``self.assign``."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        if req in ("write", "inplace", None):
+            dst[...] = src
+        elif req == "add":
+            dst[...] += src
+        elif req == "null":
+            pass
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Shape/type metadata provider (ref: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """ref: mx.operator.register — class decorator for CustomOpProp."""
+    def deco(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get(reg_name):
+    if reg_name not in _CUSTOM_REGISTRY:
+        raise MXNetError(f"custom op {reg_name!r} is not registered; known: "
+                         f"{sorted(_CUSTOM_REGISTRY)}")
+    return _CUSTOM_REGISTRY[reg_name]
+
+
+def _custom_impl(op_type, datas, kwargs):
+    """Build the pure_callback + custom_vjp computation for one call."""
+    import jax
+
+    prop = get(op_type)(**kwargs)
+    in_shapes = [tuple(d.shape) for d in datas]
+    in_types = [d.dtype for d in datas]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    _, out_types, _ = prop.infer_type(in_types)
+    out_shapes = [tuple(s) for s in out_shapes]
+    operator = prop.create_operator(None, in_shapes, in_types)
+    n_in, n_out = len(in_shapes), len(out_shapes)
+    out_struct = tuple(jax.ShapeDtypeStruct(s, t)
+                       for s, t in zip(out_shapes, out_types))
+    in_struct = tuple(jax.ShapeDtypeStruct(s, t)
+                      for s, t in zip(in_shapes, in_types))
+
+    def host_forward(*arrs):
+        ins = [np.asarray(a) for a in arrs]
+        outs = [np.zeros(s, t) for s, t in zip(out_shapes, out_types)]
+        operator.forward(is_train=True, req=["write"] * n_out,
+                         in_data=ins, out_data=outs, aux=[])
+        return tuple(outs)
+
+    def host_backward(*arrs):
+        ogs = [np.asarray(a) for a in arrs[:n_out]]
+        ins = [np.asarray(a) for a in arrs[n_out:n_out + n_in]]
+        outs = [np.asarray(a) for a in arrs[n_out + n_in:]]
+        igs = [np.zeros(s, t) for s, t in zip(in_shapes, in_types)]
+        operator.backward(req=["write"] * n_in, out_grad=ogs, in_data=ins,
+                          out_data=outs, in_grad=igs, aux=[])
+        return tuple(igs)
+
+    @jax.custom_vjp
+    def core(*xs):
+        return jax.pure_callback(host_forward, out_struct, *xs)
+
+    def fwd(*xs):
+        outs = jax.pure_callback(host_forward, out_struct, *xs)
+        return outs, (xs, outs)
+
+    def bwd(res, gs):
+        xs, outs = res
+        if not isinstance(gs, tuple):
+            gs = (gs,)
+        igs = jax.pure_callback(host_backward, in_struct,
+                                *(tuple(gs) + tuple(xs) + tuple(outs)))
+        return tuple(igs)
+
+    core.defvjp(fwd, bwd)
+    out = core(*datas)
+    return out if n_out > 1 else out[0]
+
+
+def _register_custom_dispatch():
+    """Expose ``mx.nd.Custom(*inputs, op_type=...)`` (ref: the reference
+    generates `Custom` from src/operator/custom/custom.cc)."""
+    from .ops import registry as _reg
+    from .ops.registry import OpParam, register as reg_op
+
+    @reg_op("Custom", num_inputs=-1,
+            params=[OpParam("op_type", str, None, required=True)],
+            doc="Run a registered Python CustomOp "
+                "(ref: src/operator/custom/custom.cc; executes as a host "
+                "callback inside the XLA program)")
+    def _custom(*datas, op_type=None, **kwargs):
+        return _custom_impl(op_type, list(datas), kwargs)
+
+    _reg.get("Custom").allow_unknown_params = True
+    # the nd namespace was generated before this module imported — attach
+    # the wrapper now (the reference regenerates on MXCustomOpRegister too)
+    from . import ndarray as _nd_ns
+    _nd_ns.Custom = _nd_ns._make_wrapper("Custom", _reg.get("Custom"))
+    setattr(_nd_ns.op, "Custom", _nd_ns.Custom)
+
+
+_register_custom_dispatch()

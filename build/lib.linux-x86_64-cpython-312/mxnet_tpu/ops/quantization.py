@@ -1,0 +1,243 @@
+"""INT8 quantized inference ops (ref: src/operator/quantization/ —
+quantize_v2, dequantize, quantized_conv, quantized_fully_connected,
+quantized_pooling).
+
+Design divergence from the reference (documented in docs/divergences.md):
+the reference threads (min, max) range pairs through every quantized op;
+here quantized tensors travel with a *scale* (fp32, per-tensor for
+activations, per-output-channel for weights) and the integer compute is a
+real int8 ``lax.dot_general`` / ``lax.conv_general_dilated`` with
+``preferred_element_type=int32`` — the MXU's native int8 path on TPU.
+Symmetric (zero-point-free) quantization, matching the reference's
+``quantized_dtype='int8'`` mode.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import OpParam, register
+
+
+def _symmetric_scale(amax):
+    return jnp.maximum(amax, 1e-12) / 127.0
+
+
+def quantize_array(x, amax=None, channel_axis=None):
+    """fp -> (int8, fp32 scale). Per-tensor, or per-channel along
+    ``channel_axis`` (weights)."""
+    x = jnp.asarray(x)
+    if amax is None:
+        if channel_axis is None:
+            amax = jnp.max(jnp.abs(x))
+        else:
+            axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+            amax = jnp.max(jnp.abs(x), axis=axes)
+    scale = _symmetric_scale(jnp.asarray(amax, jnp.float32))
+    if channel_axis is None:
+        q = x / scale
+    else:
+        bshape = [1] * x.ndim
+        bshape[channel_axis] = -1
+        q = x / scale.reshape(bshape)
+    q = jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@register("_contrib_quantize_v2", num_outputs=2,
+          params=[OpParam("min_calib_range", float, None),
+                  OpParam("max_calib_range", float, None)],
+          differentiable=False,
+          doc="fp32 -> (int8, scale). With calib ranges: static scale "
+              "(ref: quantization/quantize_v2.cc); without: dynamic "
+              "per-batch amax.")
+def _quantize_v2(x, min_calib_range=None, max_calib_range=None):
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = max(abs(float(min_calib_range)),
+                   abs(float(max_calib_range)))
+    else:
+        amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    return quantize_array(x.astype(jnp.float32), amax=amax)
+
+
+@register("_contrib_dequantize", num_inputs=2, differentiable=False,
+          doc="(int8, scale) -> fp32 (ref: quantization/dequantize.cc)")
+def _dequantize(q, scale):
+    return q.astype(jnp.float32) * scale
+
+
+def _requantize(y, min_calib_range=None, max_calib_range=None):
+    """fp32 -> (int8, scale): static scale from output calib ranges when
+    given, else dynamic per-batch amax (ref: quantization/requantize.cc)."""
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = max(abs(float(min_calib_range)), abs(float(max_calib_range)))
+    else:
+        amax = jnp.max(jnp.abs(y))
+    return quantize_array(y, amax=amax)
+
+
+def _n_out_from_type(params):
+    return 2 if params.get("out_type") == "int8" else 1
+
+
+@register("_contrib_quantized_fully_connected", num_inputs=-1,
+          num_outputs=_n_out_from_type,
+          params=[OpParam("num_hidden", int, None, required=True),
+                  OpParam("no_bias", bool, False),
+                  OpParam("flatten", bool, True),
+                  OpParam("out_type", str, "float32"),
+                  OpParam("min_calib_range", float, None),
+                  OpParam("max_calib_range", float, None)],
+          differentiable=False,
+          doc="int8 x int8 -> int32 GEMM, rescaled to fp32 — or, with "
+              "out_type='int8', requantized to (int8, scale) so chains "
+              "stay int8 (ref: quantization/quantized_fully_connected.cc "
+              "+ the mkldnn int8 subgraph fusion). Inputs: x_q int8, "
+              "w_q int8 [num_hidden, K], x_scale, w_scale [num_hidden], "
+              "(bias fp32)")
+def _quantized_fc(xq, wq, x_scale, w_scale, *bias, num_hidden=None,
+                  no_bias=False, flatten=True, out_type="float32",
+                  min_calib_range=None, max_calib_range=None):
+    if flatten:
+        xq = xq.reshape(xq.shape[0], -1)
+    y32 = lax.dot_general(xq, wq, (((xq.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    y = y32.astype(jnp.float32) * (x_scale * w_scale)
+    if not no_bias and bias:
+        y = y + bias[0]
+    if out_type == "int8":
+        return _requantize(y, min_calib_range, max_calib_range)
+    return y
+
+
+@register("_contrib_quantized_conv", num_inputs=-1,
+          num_outputs=_n_out_from_type,
+          params=[OpParam("kernel", tuple, None, required=True),
+                  OpParam("stride", tuple, None),
+                  OpParam("dilate", tuple, None),
+                  OpParam("pad", tuple, None),
+                  OpParam("num_filter", int, None, required=True),
+                  OpParam("num_group", int, 1),
+                  OpParam("no_bias", bool, False),
+                  OpParam("layout", str, None),
+                  OpParam("out_type", str, "float32"),
+                  OpParam("min_calib_range", float, None),
+                  OpParam("max_calib_range", float, None)],
+          differentiable=False,
+          doc="int8 conv accumulated in int32, rescaled to fp32 — or, "
+              "with out_type='int8', requantized to (int8, scale) so "
+              "residual chains stay int8 (ref: quantization/"
+              "quantized_conv.cc + mkldnn int8 subgraphs). Inputs: x_q, "
+              "w_q, x_scale, w_scale [num_filter], (bias fp32)")
+def _quantized_conv(xq, wq, x_scale, w_scale, *bias, kernel=None,
+                    stride=None, dilate=None, pad=None, num_filter=None,
+                    num_group=1, no_bias=False, layout=None,
+                    out_type="float32", min_calib_range=None,
+                    max_calib_range=None):
+    nd_ = len(kernel)
+    stride = tuple(stride or (1,) * nd_)
+    dilate = tuple(dilate or (1,) * nd_)
+    pad = tuple(pad or (0,) * nd_)
+    dims = {3: ("NCW", "OIW", "NCW"), 4: ("NCHW", "OIHW", "NCHW"),
+            5: ("NCDHW", "OIDHW", "NCDHW")}[xq.ndim]
+    dn = lax.conv_dimension_numbers(xq.shape, wq.shape, dims)
+    y32 = lax.conv_general_dilated(
+        xq, wq, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    bshape = (1, -1) + (1,) * nd_
+    y = y32.astype(jnp.float32) * (x_scale
+                                   * w_scale.reshape(bshape))
+    if not no_bias and bias:
+        y = y + bias[0].reshape(bshape)
+    if out_type == "int8":
+        return _requantize(y, min_calib_range, max_calib_range)
+    return y
+
+
+@register("_contrib_quantized_elemwise_add", num_inputs=4, num_outputs=2,
+          differentiable=False,
+          doc="(a_q, a_scale, b_q, b_scale) -> (int8, scale): the "
+              "residual add of an int8 chain. Output scale a_s + b_s is "
+              "clip-free by construction (|sum| <= 127(a_s+b_s)); one "
+              "int16 add + rescale, no fp32 tensor materialized "
+              "(ref: mkldnn quantized_elemwise_add)")
+def _quantized_elemwise_add(aq, a_scale, bq, b_scale):
+    out_scale = a_scale + b_scale
+    af = aq.astype(jnp.float32) * (a_scale / out_scale)
+    bf = bq.astype(jnp.float32) * (b_scale / out_scale)
+    q = jnp.clip(jnp.round(af + bf), -127, 127).astype(jnp.int8)
+    return q, out_scale
+
+
+@register("_contrib_quantized_act", num_inputs=2, num_outputs=2,
+          params=[OpParam("act_type", str, "relu")],
+          differentiable=False,
+          doc="ReLU directly on int8 (symmetric zero point: max(q, 0)); "
+              "scale passes through (ref: mkldnn int8 conv+relu fusion)")
+def _quantized_act(xq, scale, act_type="relu"):
+    if act_type != "relu":
+        raise MXNetError(f"quantized_act supports relu only, "
+                         f"got {act_type!r}")
+    return jnp.maximum(xq, jnp.int8(0)), scale
+
+
+@register("_contrib_quantized_concat", num_inputs=-1, num_outputs=2,
+          params=[OpParam("num_args", int, None, required=True),
+                  OpParam("dim", int, 1)],
+          differentiable=False,
+          doc="Concat int8 tensors: (q1..qn, s1..sn) -> (int8, scale). "
+              "Common scale = max(s_i); inputs requantized onto it "
+              "(ref: quantization/quantized_concat.cc)")
+def _quantized_concat(*args, num_args=None, dim=1):
+    qs, scales = args[:num_args], args[num_args:]
+    out_scale = scales[0]
+    for s in scales[1:]:
+        out_scale = jnp.maximum(out_scale, s)
+    parts = []
+    for q, s in zip(qs, scales):
+        ratio = s / out_scale
+        parts.append(jnp.clip(jnp.round(q.astype(jnp.float32) * ratio),
+                              -127, 127).astype(jnp.int8))
+    return jnp.concatenate(parts, axis=dim), out_scale
+
+
+@register("_contrib_quantized_pooling", num_inputs=2, num_outputs=2,
+          params=[OpParam("kernel", tuple, ()),
+                  OpParam("pool_type", str, "max"),
+                  OpParam("global_pool", bool, False),
+                  OpParam("stride", tuple, None),
+                  OpParam("pad", tuple, None),
+                  OpParam("pooling_convention", str, "valid")],
+          differentiable=False,
+          doc="Pooling directly on int8 data; scale passes through "
+              "(ref: quantization/quantized_pooling.cc)")
+def _quantized_pooling(xq, scale, kernel=(), pool_type="max",
+                       global_pool=False, stride=None, pad=None,
+                       pooling_convention="valid"):
+    nd_ = xq.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, xq.ndim))
+        if pool_type == "max":
+            return jnp.max(xq, axis=axes, keepdims=True), scale
+        s = jnp.mean(xq.astype(jnp.int32), axis=axes, keepdims=True)
+        return jnp.clip(jnp.round(s), -127, 127).astype(jnp.int8), scale
+    stride = tuple(stride or (1,) * nd_)
+    pad = tuple(pad or (0,) * nd_)
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + stride
+    pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if pool_type == "max":
+        out = lax.reduce_window(xq, jnp.int8(-128), lax.max, window,
+                                strides, pads)
+        return out, scale
+    if pool_type != "avg":
+        raise MXNetError(f"quantized_pooling: pool_type {pool_type!r}")
+    s = lax.reduce_window(xq.astype(jnp.int32), jnp.int32(0), lax.add,
+                          window, strides, pads)
+    import numpy as _np
+    denom = int(_np.prod(kernel))
+    out = jnp.clip(jnp.round(s / denom), -127, 127).astype(jnp.int8)
+    return out, scale

@@ -1,0 +1,125 @@
+"""Random samplers.
+
+TPU-native equivalent of ``src/operator/random/`` (sample_op.cc,
+multisample_op.cc). The reference draws from per-device stateful RNG resources
+(ref: src/resource.cc kRandom); here every sampler takes an explicit JAX PRNG
+key threaded by the dispatch layer — stateless, reproducible, shard-friendly.
+
+Two families, like the reference:
+- ``_random_*``: fixed distribution params, shape kwarg (creation-style).
+- ``_sample_*``: per-element distribution params given as input arrays.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import _as_np_dtype
+from .registry import OpParam, register
+
+
+def _shape_dtype_params():
+    # ctx passes through uncoerced; the dispatch layer honors it for output
+    # placement (ref: sample ops take a Context in the reference too)
+    return [OpParam("shape", tuple, None), OpParam("dtype", str, "float32"),
+            OpParam("ctx", None, None)]
+
+
+def _creation(name, draw, extra_params, doc=""):
+    params = extra_params + _shape_dtype_params()
+
+    def impl(rng=None, shape=None, dtype="float32", ctx=None, **kw):
+        shape = tuple(shape) if shape is not None else (1,)
+        return draw(rng, shape, _as_np_dtype(dtype), **kw)
+
+    register(name, num_inputs=0, params=params, differentiable=False,
+             needs_rng=True, doc=doc or f"{name} sampler "
+             "(ref: src/operator/random/sample_op.cc)")(impl)
+
+
+_creation("_random_uniform",
+          lambda rng, shape, dtype, low=0.0, high=1.0:
+          jax.random.uniform(rng, shape, dtype=jnp.float32,
+                             minval=low, maxval=high).astype(dtype),
+          [OpParam("low", float, 0.0), OpParam("high", float, 1.0)],
+          doc="Uniform[low, high) (ref: sample_op.cc _random_uniform)")
+
+_creation("_random_normal",
+          lambda rng, shape, dtype, loc=0.0, scale=1.0:
+          (jax.random.normal(rng, shape) * scale + loc).astype(dtype),
+          [OpParam("loc", float, 0.0), OpParam("scale", float, 1.0)],
+          doc="Normal(loc, scale) (ref: sample_op.cc _random_normal)")
+
+_creation("_random_gamma",
+          lambda rng, shape, dtype, alpha=1.0, beta=1.0:
+          (jax.random.gamma(rng, alpha, shape) * beta).astype(dtype),
+          [OpParam("alpha", float, 1.0), OpParam("beta", float, 1.0)])
+
+_creation("_random_exponential",
+          lambda rng, shape, dtype, lam=1.0:
+          (jax.random.exponential(rng, shape) / lam).astype(dtype),
+          [OpParam("lam", float, 1.0)])
+
+_creation("_random_poisson",
+          lambda rng, shape, dtype, lam=1.0:
+          jax.random.poisson(rng, lam, shape).astype(dtype),
+          [OpParam("lam", float, 1.0)])
+
+_creation("_random_randint",
+          lambda rng, shape, dtype, low=0, high=1:
+          jax.random.randint(rng, shape, int(low), int(high)).astype(dtype),
+          [OpParam("low", int, 0), OpParam("high", int, 1)])
+
+
+@register("_sample_uniform", num_inputs=2, needs_rng=True, differentiable=False,
+          params=[OpParam("shape", tuple, None), OpParam("dtype", str, "float32")],
+          doc="Per-element uniform (ref: src/operator/random/multisample_op.cc)")
+def _sample_uniform(low, high, rng=None, shape=None, dtype="float32"):
+    extra = tuple(shape) if shape else ()
+    out_shape = low.shape + extra
+    u = jax.random.uniform(rng, out_shape)
+    low_b = low.reshape(low.shape + (1,) * len(extra))
+    high_b = high.reshape(high.shape + (1,) * len(extra))
+    return (low_b + u * (high_b - low_b)).astype(_as_np_dtype(dtype))
+
+
+@register("_sample_normal", num_inputs=2, needs_rng=True, differentiable=False,
+          params=[OpParam("shape", tuple, None), OpParam("dtype", str, "float32")],
+          doc="Per-element normal (ref: multisample_op.cc)")
+def _sample_normal(mu, sigma, rng=None, shape=None, dtype="float32"):
+    extra = tuple(shape) if shape else ()
+    out_shape = mu.shape + extra
+    z = jax.random.normal(rng, out_shape)
+    mu_b = mu.reshape(mu.shape + (1,) * len(extra))
+    sigma_b = sigma.reshape(sigma.shape + (1,) * len(extra))
+    return (mu_b + z * sigma_b).astype(_as_np_dtype(dtype))
+
+
+@register("_sample_multinomial", num_inputs=1, needs_rng=True, differentiable=False,
+          params=[OpParam("shape", tuple, None), OpParam("get_prob", bool, False),
+                  OpParam("dtype", str, "int32")],
+          doc="Categorical sampling from probability rows "
+              "(ref: src/operator/random/sample_multinomial_op.cc)")
+def _sample_multinomial(probs, rng=None, shape=None, get_prob=False, dtype="int32"):
+    n = int(shape[0]) if shape else 1
+    logits = jnp.log(jnp.maximum(probs, 1e-37))
+    samples = jax.random.categorical(rng, logits, axis=-1,
+                                     shape=(n,) + probs.shape[:-1])
+    samples = jnp.moveaxis(samples, 0, -1)
+    if not shape:
+        samples = samples[..., 0]
+    return samples.astype(_as_np_dtype(dtype))
+
+
+@register("_shuffle", needs_rng=True, differentiable=False,
+          doc="Shuffle along first axis (ref: src/operator/random/shuffle_op.cc)")
+def _shuffle(x, rng=None):
+    return jax.random.permutation(rng, x, axis=0)
+
+
+@register("_random_bernoulli", needs_rng=True, differentiable=False, num_inputs=0,
+          params=[OpParam("p", float, 0.5)] + _shape_dtype_params(),
+          doc="Bernoulli(p)")
+def _bernoulli(rng=None, p=0.5, shape=None, dtype="float32", ctx=None):
+    return jax.random.bernoulli(rng, p, tuple(shape or (1,))).astype(
+        _as_np_dtype(dtype))

@@ -1,0 +1,159 @@
+"""Typed operator registry.
+
+Replaces two reference mechanisms with one TPU-native one:
+
+- the NNVM op registry (``nnvm::Op`` with FCompute/FInferShape/FInferType
+  attributes, ref: include/mxnet/op_attr_types.h): here an ``Operator`` holds a
+  pure jax function; shape/dtype inference falls out of ``jax.eval_shape`` so
+  no per-op inference rules are needed;
+- ``dmlc::Parameter`` CRTP hyperparameter structs (ref:
+  3rdparty/dmlc-core/include/dmlc/parameter.h), whose introspection the
+  reference uses to code-generate Python signatures/docstrings (SURVEY §5.6
+  calls this load-bearing): here ``OpParam`` rows serve the same role and
+  drive wrapper generation for both ``mx.nd`` and ``mx.sym``.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..base import MXNetError
+
+__all__ = ["OpParam", "Operator", "register", "alias", "get", "list_ops"]
+
+_REGISTRY: Dict[str, "Operator"] = {}
+
+
+@dataclass
+class OpParam:
+    """One hyperparameter of an op (dmlc::Parameter field analog)."""
+    name: str
+    type: Any = None            # python type or callable coercer
+    default: Any = None
+    required: bool = False
+    doc: str = ""
+
+    def coerce(self, value):
+        if value is None:
+            return None
+        typ = self.type
+        if typ is None or isinstance(value, bool) and typ is bool:
+            return value
+        if typ is tuple:
+            return _as_tuple(value)
+        if typ is bool:
+            if isinstance(value, str):
+                return value.lower() in ("1", "true", "yes")
+            return bool(value)
+        if typ in (int, float, str):
+            return typ(value)
+        if callable(typ):
+            return typ(value)
+        return value
+
+
+def _as_tuple(value):
+    """Accept tuples, lists, ints, and the reference's string shapes '(2, 2)'."""
+    if isinstance(value, str):
+        value = ast.literal_eval(value)
+    if isinstance(value, (int,)):
+        return (value,)
+    return tuple(value)
+
+
+@dataclass
+class Operator:
+    """A registered operator: a pure function on jax arrays.
+
+    ``fn(*arrays, **params) -> array | tuple`` must be jax-traceable
+    (no data-dependent Python control flow), which makes every op usable
+    eagerly (mx.nd), under jit (hybridize/CachedOp), and in symbolic graphs
+    (mx.sym) from a single definition.
+    """
+    name: str
+    fn: Callable
+    num_inputs: int = 1          # -1 = variadic
+    num_outputs: int = 1
+    params: List[OpParam] = field(default_factory=list)
+    doc: str = ""
+    differentiable: bool = True
+    aliases: List[str] = field(default_factory=list)
+    ref: str = ""                # reference file/symbol this op mirrors
+    needs_rng: bool = False      # dispatch passes a PRNG key as `rng=` kwarg
+                                 # (replaces the reference's ResourceRequest::kRandom)
+    needs_mode: bool = False     # dispatch passes `training=` from autograd state
+    allow_unknown_params: bool = False   # Custom op forwards user kwargs
+
+    def coerce_params(self, kwargs: dict) -> dict:
+        spec = {p.name: p for p in self.params}
+        out = {}
+        for key, val in kwargs.items():
+            if key in spec:
+                out[key] = spec[key].coerce(val)
+            elif self.allow_unknown_params:
+                out[key] = val
+            else:
+                # tolerate unknown kwargs the way generated wrappers do not:
+                # raise, to catch typos early
+                raise MXNetError(f"op {self.name!r}: unknown parameter {key!r}. "
+                                 f"Known: {sorted(spec)}")
+        for p in self.params:
+            if p.required and p.name not in out:
+                raise MXNetError(f"op {self.name!r}: missing required "
+                                 f"parameter {p.name!r}")
+            if p.name not in out:
+                out[p.name] = p.default
+        return out
+
+    def signature_doc(self) -> str:
+        lines = [self.doc or self.name, "", "Parameters", "----------"]
+        for p in self.params:
+            typename = getattr(p.type, "__name__", str(p.type))
+            dflt = "required" if p.required else f"default={p.default!r}"
+            lines.append(f"{p.name} : {typename}, {dflt}")
+            if p.doc:
+                lines.append(f"    {p.doc}")
+        if self.ref:
+            lines += ["", f"Reference: {self.ref}"]
+        return "\n".join(lines)
+
+
+def register(name: str, *, num_inputs: int = 1, num_outputs: int = 1,
+             params: Optional[Sequence[OpParam]] = None, doc: str = "",
+             differentiable: bool = True, aliases: Sequence[str] = (),
+             ref: str = "", needs_rng: bool = False, needs_mode: bool = False):
+    """Decorator registering ``fn`` as operator ``name``."""
+    def deco(fn):
+        op = Operator(name=name, fn=fn, num_inputs=num_inputs,
+                      num_outputs=num_outputs, params=list(params or []),
+                      doc=doc or (fn.__doc__ or ""), differentiable=differentiable,
+                      aliases=list(aliases), ref=ref,
+                      needs_rng=needs_rng, needs_mode=needs_mode)
+        if name in _REGISTRY:
+            raise MXNetError(f"duplicate op registration: {name}")
+        _REGISTRY[name] = op
+        for a in op.aliases:
+            _REGISTRY[a] = op
+        return fn
+    return deco
+
+
+def alias(existing: str, *names: str):
+    op = get(existing)
+    for n in names:
+        _REGISTRY[n] = op
+        op.aliases.append(n)
+
+
+def get(name: str) -> Operator:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise MXNetError(f"operator {name!r} is not registered "
+                         f"({len(_REGISTRY)} ops known)") from None
+
+
+def list_ops() -> List[str]:
+    """ref: MXListAllOpNames — drives wrapper generation."""
+    return sorted(set(_REGISTRY))

@@ -1,0 +1,71 @@
+"""Sequence ops (ref: src/operator/sequence_mask.cc, sequence_last.cc,
+sequence_reverse.cc) — variable-length handling used by the RNN/NMT stack
+(SURVEY §5.7). Data layout follows the reference: time-major (T, N, ...) by
+default, `axis` selects the time axis."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import OpParam, register
+
+
+def _len_mask(x, seq_len, axis):
+    """(T, N, ...) bool mask of valid steps along `axis` given lengths (N,)."""
+    T = x.shape[axis]
+    steps = jnp.arange(T)
+    mask = steps[:, None] < seq_len[None, :].astype(jnp.int32)  # (T, N)
+    if axis == 1:
+        mask = mask.T
+    extra = x.ndim - 2
+    return mask.reshape(mask.shape + (1,) * extra)
+
+
+@register("SequenceMask", num_inputs=-1,
+          params=[OpParam("use_sequence_length", bool, False),
+                  OpParam("value", float, 0.0),
+                  OpParam("axis", int, 0)],
+          doc="Zero/fill steps beyond each sequence's length "
+              "(ref: src/operator/sequence_mask.cc)")
+def _sequence_mask(data, *rest, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length:
+        return data
+    seq_len = rest[0]
+    mask = _len_mask(data, seq_len, axis)
+    return jnp.where(mask, data, jnp.full_like(data, value))
+
+
+@register("SequenceLast", num_inputs=-1,
+          params=[OpParam("use_sequence_length", bool, False),
+                  OpParam("axis", int, 0)],
+          doc="Select the last valid step per sequence "
+              "(ref: src/operator/sequence_last.cc)")
+def _sequence_last(data, *rest, use_sequence_length=False, axis=0):
+    if not use_sequence_length:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    seq_len = rest[0].astype(jnp.int32) - 1
+    if axis == 0:
+        gathered = jnp.take_along_axis(
+            data, seq_len.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)
+        return jnp.squeeze(gathered, axis=0)
+    gathered = jnp.take_along_axis(
+        data, seq_len.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)
+    return jnp.squeeze(gathered, axis=1)
+
+
+@register("SequenceReverse", num_inputs=-1,
+          params=[OpParam("use_sequence_length", bool, False),
+                  OpParam("axis", int, 0)],
+          doc="Reverse each sequence up to its length "
+              "(ref: src/operator/sequence_reverse.cc)")
+def _sequence_reverse(data, *rest, use_sequence_length=False, axis=0):
+    assert axis == 0, "SequenceReverse supports time-major (axis=0) only"
+    if not use_sequence_length:
+        return jnp.flip(data, axis=0)
+    seq_len = rest[0].astype(jnp.int32)
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]                      # (T, 1)
+    src = jnp.where(steps < seq_len[None, :], seq_len[None, :] - 1 - steps, steps)
+    src = src.reshape((T, data.shape[1]) + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, src, axis=0)

@@ -1,0 +1,546 @@
+"""Tensor ops: reductions, shape manipulation, indexing, ordering, linalg.
+
+TPU-native equivalent of the reference's ``src/operator/tensor/`` (broadcast
+reduce ops, matrix ops, indexing, ordering) — each a jnp/lax composition,
+shape-static so XLA can tile onto the MXU/VPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import OpParam, register
+
+# ---------------------------------------------------------------------------
+# reductions (ref: src/operator/tensor/broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _reduce(fn, diff=True, name=None, extra=None, doc=""):
+    params = [
+        OpParam("axis", tuple, None, doc="axis/axes to reduce over"),
+        OpParam("keepdims", bool, False),
+        OpParam("exclude", bool, False, doc="reduce over all axes EXCEPT `axis`"),
+    ] + (extra or [])
+
+    def impl(x, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, x.ndim, exclude)
+        return fn(x, axis=ax, keepdims=keepdims)
+
+    register(name, params=params, differentiable=diff,
+             doc=doc or f"{name} reduction (ref: broadcast_reduce_op_value.cc)")(impl)
+
+
+_reduce(jnp.sum, name="sum", doc="Sum over axes")
+_reduce(jnp.mean, name="mean", doc="Mean over axes")
+_reduce(jnp.prod, name="prod", doc="Product over axes")
+_reduce(jnp.max, name="max", doc="Max over axes")
+_reduce(jnp.min, name="min", doc="Min over axes")
+_reduce(jnp.nansum, name="nansum")
+_reduce(jnp.nanprod, name="nanprod")
+
+
+@register("argmax", differentiable=False,
+          params=[OpParam("axis", int, None), OpParam("keepdims", bool, False)],
+          doc="Index of max along axis (ref: broadcast_reduce_op_index.cc)")
+def _argmax(x, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)  # reference returns float indices
+
+
+@register("argmin", differentiable=False,
+          params=[OpParam("axis", int, None), OpParam("keepdims", bool, False)])
+def _argmin(x, axis=None, keepdims=False):
+    out = jnp.argmin(x, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("norm",
+          params=[OpParam("ord", int, 2), OpParam("axis", tuple, None),
+                  OpParam("keepdims", bool, False)],
+          doc="L-p norm (ref: src/operator/tensor/broadcast_reduce_norm_value.cc)")
+def _norm(x, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis, x.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation (ref: src/operator/tensor/matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("Reshape", aliases=["reshape"],
+          params=[OpParam("shape", tuple, None, required=True),
+                  OpParam("reverse", bool, False)],
+          doc="Reshape with the reference's special codes 0,-1,-2,-3,-4 "
+              "(ref: matrix_op.cc Reshape)")
+def _reshape(x, shape=None, reverse=False):
+    src = list(x.shape)
+    if reverse:
+        src = src[::-1]
+        shape = tuple(shape)[::-1]
+    out = []
+    i = 0  # index into src
+    j = 0
+    shape = list(shape)
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:          # copy dim
+            out.append(src[i]); i += 1
+        elif s == -1:       # infer
+            out.append(-1); i += 1
+        elif s == -2:       # copy all remaining
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:       # merge two dims
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:       # split dim into next two numbers
+            a, b = shape[j + 1], shape[j + 2]
+            d = src[i]
+            if a == -1:
+                a = d // b
+            if b == -1:
+                b = d // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(int(s)); i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(x, tuple(out))
+
+
+@register("transpose", params=[OpParam("axes", tuple, None)],
+          doc="Permute axes (ref: matrix_op.cc transpose)")
+def _transpose(x, axes=None):
+    return jnp.transpose(x, axes)
+
+
+@register("SwapAxis", aliases=["swapaxes"],
+          params=[OpParam("dim1", int, 0), OpParam("dim2", int, 0)],
+          doc="ref: src/operator/swapaxis.cc")
+def _swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("moveaxis", params=[OpParam("source", tuple, None, required=True),
+                              OpParam("destination", tuple, None, required=True)])
+def _moveaxis(x, source=None, destination=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register("expand_dims", params=[OpParam("axis", int, 0, required=True)])
+def _expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze", params=[OpParam("axis", tuple, None)])
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+@register("Flatten", aliases=["flatten"],
+          doc="Collapse all but first axis (ref: matrix_op.cc Flatten)")
+def _flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("reverse", aliases=["flip"], params=[OpParam("axis", tuple, None, required=True)])
+def _reverse(x, axis=None):
+    return jnp.flip(x, axis)
+
+
+@register("tile", params=[OpParam("reps", tuple, None, required=True)])
+def _tile(x, reps=None):
+    return jnp.tile(x, reps)
+
+
+@register("repeat", params=[OpParam("repeats", int, 1, required=True),
+                            OpParam("axis", int, None)])
+def _repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("Pad", aliases=["pad"],
+          params=[OpParam("mode", str, "constant"),
+                  OpParam("pad_width", tuple, None, required=True),
+                  OpParam("constant_value", float, 0.0)],
+          doc="ref: src/operator/pad.cc — pad_width is the reference's flat "
+              "2-per-axis tuple")
+def _pad(x, mode="constant", pad_width=None, constant_value=0.0):
+    pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1])) for i in range(x.ndim)]
+    if mode == "constant":
+        return jnp.pad(x, pw, constant_values=constant_value)
+    jmode = {"edge": "edge", "reflect": "reflect"}[mode]
+    return jnp.pad(x, pw, mode=jmode)
+
+
+@register("clip", params=[OpParam("a_min", float, None, required=True),
+                          OpParam("a_max", float, None, required=True)])
+def _clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("broadcast_to", params=[OpParam("shape", tuple, None, required=True)])
+def _broadcast_to(x, shape=None):
+    shape = tuple(x.shape[i] if s == 0 else int(s) for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register("broadcast_like", num_inputs=2)
+def _broadcast_like(x, like):
+    return jnp.broadcast_to(x, like.shape)
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"],
+          params=[OpParam("axis", tuple, ()), OpParam("size", tuple, ())])
+def _broadcast_axis(x, axis=(), size=()):
+    shape = list(x.shape)
+    for a, s in zip(axis, size):
+        shape[a] = int(s)
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register("slice", params=[OpParam("begin", tuple, None, required=True),
+                           OpParam("end", tuple, None, required=True),
+                           OpParam("step", tuple, None)],
+          doc="ref: matrix_op.cc slice — begin/end entries may be None")
+def _slice(x, begin=None, end=None, step=None):
+    step = step or (1,) * len(begin)
+    idx = tuple(slice(b, e, s if s else 1) for b, e, s in zip(begin, end, step))
+    return x[idx]
+
+
+@register("slice_axis", params=[OpParam("axis", int, 0, required=True),
+                                OpParam("begin", int, 0, required=True),
+                                OpParam("end", int, None, required=True)])
+def _slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like", num_inputs=2, params=[OpParam("axes", tuple, None)])
+def _slice_like(x, like, axes=None):
+    axes = axes if axes is not None else tuple(range(x.ndim))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, like.shape[a])
+    return x[tuple(idx)]
+
+
+def shifted_expsum(x, axis=-1):
+    """Shared numerically-stable exp-sum core: returns
+    ``(m, shifted, se32)`` where ``m = stop_grad(max(x))``,
+    ``shifted = x - m`` (input dtype, elementwise — fuses into consumers)
+    and ``se32 = sum(exp(shifted))`` accumulated in fp32 without
+    materializing an fp32 tensor of x's shape. One definition backs
+    log_softmax, logsumexp and the short-sequence attention softmax so
+    their numerics stay consistent."""
+    acc = jnp.promote_types(x.dtype, jnp.float32)   # fp64 in stays fp64
+    m = jax.lax.stop_gradient(jnp.max(x, axis=axis, keepdims=True))
+    shifted = x - m
+    se32 = jnp.sum(jnp.exp(shifted).astype(acc), axis=axis,
+                   keepdims=True)
+    return m, shifted, se32
+
+
+@register("logsumexp",
+          params=[OpParam("axis", int, -1), OpParam("keepdims", bool, False)],
+          doc="Numerically-stable log-sum-exp with fp32 accumulation; "
+              "gradient is softmax in the input dtype. Backs the fused "
+              "sparse softmax-CE loss path (no [.., C] log-prob tensor is "
+              "materialized; the reference fuses equivalently in "
+              "src/operator/softmax_output.cc)")
+def _logsumexp(x, axis=-1, keepdims=False):
+    m, _, se32 = shifted_expsum(x, axis=axis)
+    out = m.astype(se32.dtype) + jnp.log(se32)
+    return out if keepdims else jnp.squeeze(out, axis)
+
+
+@register("take", num_inputs=2,
+          params=[OpParam("axis", int, 0), OpParam("mode", str, "clip")],
+          doc="Gather rows by index (ref: src/operator/tensor/indexing_op.cc Take)")
+def _take(a, indices, axis=0, mode="clip"):
+    jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
+
+
+@register("pick", num_inputs=2,
+          params=[OpParam("axis", int, -1), OpParam("keepdims", bool, False),
+                  OpParam("mode", str, "clip")],
+          doc="Pick one element per row by index (ref: indexing_op.cc pick)")
+def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    index = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
+    picked = jnp.take_along_axis(x, jnp.expand_dims(index, axis), axis=axis)
+    return picked if keepdims else jnp.squeeze(picked, axis)
+
+
+@register("gather_nd", num_inputs=2,
+          doc="ref: indexing_op.cc gather_nd — indices shape (M, ...) leads")
+def _gather_nd(data, indices):
+    indices = indices.astype(jnp.int32)
+    m = indices.shape[0]
+    idx = tuple(indices[i] for i in range(m))
+    return data[idx]
+
+
+@register("scatter_nd", num_inputs=2,
+          params=[OpParam("shape", tuple, None, required=True)],
+          doc="ref: indexing_op.cc scatter_nd")
+def _scatter_nd(data, indices, shape=None):
+    indices = indices.astype(jnp.int32)
+    out = jnp.zeros(shape, dtype=data.dtype)
+    idx = tuple(indices[i] for i in range(indices.shape[0]))
+    return out.at[idx].set(data)
+
+
+@register("one_hot",
+          params=[OpParam("depth", int, None, required=True),
+                  OpParam("on_value", float, 1.0), OpParam("off_value", float, 0.0),
+                  OpParam("dtype", str, "float32")],
+          differentiable=False, doc="ref: indexing_op.cc one_hot")
+def _one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import _as_np_dtype
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    out = oh * (on_value - off_value) + off_value
+    return out.astype(_as_np_dtype(dtype))
+
+
+@register("where", num_inputs=3,
+          doc="Elementwise select (ref: src/operator/tensor/control_flow_op.cc)")
+def _where(cond, x, y):
+    return jnp.where(cond != 0, x, y)
+
+
+@register("Concat", aliases=["concat"], num_inputs=-1,
+          params=[OpParam("dim", int, 1), OpParam("num_args", int, None)],
+          doc="ref: src/operator/nn/concat.cc")
+def _concat(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack", num_inputs=-1,
+          params=[OpParam("axis", int, 0), OpParam("num_args", int, None)])
+def _stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+def _split_outputs(params):
+    return int(params.get("num_outputs", 1))
+
+
+@register("SliceChannel", aliases=["split"], num_outputs=_split_outputs,
+          params=[OpParam("num_outputs", int, 1, required=True),
+                  OpParam("axis", int, 1),
+                  OpParam("squeeze_axis", bool, False)],
+          doc="Split along axis into equal parts (ref: src/operator/slice_channel.cc)")
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("space_to_depth", params=[OpParam("block_size", int, 1, required=True)])
+def _space_to_depth(x, block_size=1):
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("depth_to_space", params=[OpParam("block_size", int, 1, required=True)])
+def _depth_to_space(x, block_size=1):
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+# ---------------------------------------------------------------------------
+# ordering (ref: src/operator/tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("sort", params=[OpParam("axis", int, -1), OpParam("is_ascend", bool, True)])
+def _sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False,
+          params=[OpParam("axis", int, -1), OpParam("is_ascend", bool, True),
+                  OpParam("dtype", str, "float32")])
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import _as_np_dtype
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(_as_np_dtype(dtype))
+
+
+def _topk_outputs(params):
+    return 2 if params.get("ret_typ", "indices") == "both" else 1
+
+
+@register("topk", num_outputs=_topk_outputs, differentiable=False,
+          params=[OpParam("axis", int, -1), OpParam("k", int, 1),
+                  OpParam("ret_typ", str, "indices"),
+                  OpParam("is_ascend", bool, False),
+                  OpParam("dtype", str, "float32")],
+          doc="ref: ordering_op.cc topk")
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import _as_np_dtype
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(_as_np_dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "mask":
+        # 1 at the top-k positions, 0 elsewhere, in the INPUT dtype
+        # (reference: `dtype` governs only the indices output)
+        mask = jnp.put_along_axis(
+            jnp.zeros(xm.shape, x.dtype),
+            jnp.moveaxis(idx, axis, -1).astype(jnp.int32),
+            jnp.ones((), x.dtype), axis=-1, inplace=False)
+        return jnp.moveaxis(mask, -1, axis)
+    return vals, idx  # 'both' returns [values, indices]
+
+
+# ---------------------------------------------------------------------------
+# linalg (ref: src/operator/tensor/dot.cc, la_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("dot", num_inputs=2,
+          params=[OpParam("transpose_a", bool, False),
+                  OpParam("transpose_b", bool, False)],
+          doc="Matrix/tensor product onto the MXU "
+              "(ref: src/operator/tensor/dot-inl.h DotForward_)")
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # reference semantics: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot", num_inputs=2,
+          params=[OpParam("transpose_a", bool, False),
+                  OpParam("transpose_b", bool, False)],
+          doc="Batched matmul (ref: dot-inl.h BatchDotForward_)")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("_linalg_gemm2", aliases=["linalg_gemm2"], num_inputs=2,
+          params=[OpParam("transpose_a", bool, False),
+                  OpParam("transpose_b", bool, False),
+                  OpParam("alpha", float, 1.0)],
+          doc="ref: src/operator/tensor/la_op.cc linalg_gemm2")
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", aliases=["linalg_potrf"],
+          doc="Cholesky factor (ref: la_op.cc linalg_potrf)")
+def _potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("_linalg_trsm", aliases=["linalg_trsm"], num_inputs=2,
+          params=[OpParam("transpose", bool, False),
+                  OpParam("rightside", bool, False),
+                  OpParam("lower", bool, True),
+                  OpParam("alpha", float, 1.0)],
+          doc="Triangular solve (ref: la_op.cc linalg_trsm)")
+def _trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    import jax.scipy.linalg as jsl
+    if rightside:
+        # solve X A = alpha B  <=>  A^T X^T = alpha B^T
+        sol = jsl.solve_triangular(jnp.swapaxes(a, -1, -2), jnp.swapaxes(b, -1, -2) * alpha,
+                                   lower=not lower, trans=1 if transpose else 0)
+        return jnp.swapaxes(sol, -1, -2)
+    return jsl.solve_triangular(a, b * alpha, lower=lower, trans=1 if transpose else 0)
+
+
+@register("_linalg_syrk", aliases=["linalg_syrk"],
+          params=[OpParam("transpose", bool, False), OpParam("alpha", float, 1.0)],
+          doc="Symmetric rank-k update (ref: la_op.cc linalg_syrk)")
+def _syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("_linalg_inverse", aliases=["linalg_inverse"],
+          doc="ref: la_op.cc linalg_inverse")
+def _inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register("_linalg_det", aliases=["linalg_det"], doc="ref: la_op.cc linalg_det")
+def _det(a):
+    return jnp.linalg.det(a)
+
+
+@register("khatri_rao", num_inputs=-1,
+          doc="Row-wise Khatri-Rao product (ref: src/operator/contrib/krprod.cc)")
+def _khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, :, None] * m[:, None, :]).reshape(out.shape[0], -1)
+    return out
+
+
+@register("diag", params=[OpParam("k", int, 0)])
+def _diag(x, k=0):
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+
+
+@register("embedding_like_dot", num_inputs=2, doc="helper: a @ b.T")
+def _dot_t(a, b):
+    return jnp.matmul(a, jnp.swapaxes(b, -1, -2))
+
+
+@register("reshape_like", num_inputs=2,
+          doc="Reshape lhs to rhs's shape (ref: src/operator/tensor/"
+              "elemwise_unary_op_basic.cc reshape_like)")
+def _reshape_like(lhs, rhs):
+    return lhs.reshape(rhs.shape)

@@ -1,0 +1,8 @@
+"""Optimizers (ref: python/mxnet/optimizer/optimizer.py)."""
+from .optimizer import (SGD, NAG, Adam, AdamW, LAMB, RMSProp, AdaGrad, FTRL,
+                        Signum, SGLD, Optimizer, Updater, create, register,
+                        get_updater)
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "RMSProp",
+           "AdaGrad", "FTRL", "Signum", "SGLD", "Updater", "create",
+           "register", "get_updater"]

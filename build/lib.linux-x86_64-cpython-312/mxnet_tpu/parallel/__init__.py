@@ -1,0 +1,20 @@
+"""mxnet_tpu.parallel — meshes, shardings, and the single-program SPMD
+training path (net-new TPU capability; see SURVEY §2.4 #32 and §5.8: the
+reference's KVStore/executor-group data parallelism plus the parallelisms
+MXNet 1.x never had, expressed as GSPMD shardings on one device mesh)."""
+from .mesh import (Mesh, NamedSharding, PartitionSpec, current_mesh,
+                   data_parallel_spec, default_mesh, make_mesh, replicated,
+                   use_mesh)
+from .moe import moe_apply
+from .pipeline import pipeline_apply
+from .ring_attention import (attention_reference, blockwise_attention,
+                             ring_attention, ulysses_attention)
+from .sharded import (ShardedTrainer, allreduce_across_processes,
+                      functional_apply)
+
+__all__ = ["Mesh", "NamedSharding", "PartitionSpec", "current_mesh",
+           "data_parallel_spec", "default_mesh", "make_mesh", "replicated",
+           "use_mesh", "ShardedTrainer", "allreduce_across_processes",
+           "functional_apply", "ring_attention", "blockwise_attention",
+           "ulysses_attention", "attention_reference", "pipeline_apply",
+           "moe_apply"]

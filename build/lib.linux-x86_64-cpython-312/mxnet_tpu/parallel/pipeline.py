@@ -1,0 +1,92 @@
+"""Pipeline parallelism over a ``pipe`` mesh axis (net-new capability:
+MXNet 1.x has no pipeline schedule — SURVEY §2.4 #32 marks PP absent; the
+reference's closest tool is hand `ctx_group` placement).
+
+Design (GPipe-style, TPU-idiomatic):
+- every pipeline stage runs the SAME traced computation with its own
+  parameter shard (stage params stacked on a leading axis sharded over
+  ``pipe``) — SPMD-friendly: one program, P devices;
+- microbatches stream through a static tick loop; activations hop to the
+  next stage via ``lax.ppermute`` (one ICI neighbor hop per tick);
+- the schedule is differentiable end-to-end: jax transposes the ppermute
+  chain, so backward is the reverse pipeline automatically — no hand-rolled
+  1F1B bookkeeping;
+- bubbles: (P-1) ticks of the M+P-1 total, the standard GPipe cost; use
+  microbatches ≥ 4×P to amortize.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..base import MXNetError
+
+try:
+    from jax import shard_map
+except ImportError:                      # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
+                   axis_name="pipe", num_microbatches=None):
+    """Run ``x`` through P pipeline stages.
+
+    stage_fn(params_i, x) -> y        same signature for every stage
+    stage_params: pytree whose leaves are stacked (P, ...) — stage i's
+        slice feeds device i (sharded over ``axis_name``)
+    x: (B, ...) global batch; split into ``num_microbatches`` chunks
+        (default: pipeline depth).
+
+    Returns the (B, ...) output of the final stage, replicated.
+    """
+    from .mesh import current_mesh
+    mesh = mesh or current_mesh()
+    if axis_name not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {axis_name!r}")
+    p_size = mesh.shape[axis_name]
+    m = num_microbatches or p_size
+    b = x.shape[0]
+    if b % m:
+        raise MXNetError(f"batch {b} not divisible by {m} microbatches")
+    micro = x.reshape((m, b // m) + x.shape[1:])
+
+    param_spec = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stage_params)
+    perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+    def body(params_local, micro_all):
+        # params_local leaves: (1, ...) — this device's stage
+        params_i = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        d = lax.axis_index(axis_name)
+        is_first = d == 0
+        is_last = d == p_size - 1
+        micro_bs = micro_all.shape[1]
+
+        def stage_step(cur, t):
+            # device 0 injects microbatch t (if any); others take the
+            # activation that just arrived
+            inj_idx = jnp.clip(t, 0, m - 1)
+            injected = micro_all[inj_idx]
+            inp = jnp.where(is_first, injected.astype(cur.dtype), cur)
+            y = stage_fn(params_i, inp)
+            nxt = lax.ppermute(y, axis_name, perm)
+            return nxt, y
+
+        # probe output shape of one stage application
+        cur0 = jnp.zeros_like(stage_fn(params_i, micro_all[0]))
+        _, ys = lax.scan(stage_step, cur0, jnp.arange(m + p_size - 1))
+        # microbatch j exits the last stage at tick j + (P-1)
+        outs = ys[p_size - 1:]
+        outs = jnp.where(is_last, outs, jnp.zeros_like(outs))
+        outs = lax.psum(outs, axis_name)       # broadcast from last stage
+        return outs.reshape((m * micro_bs,) + outs.shape[2:])
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(param_spec, P()),
+        out_specs=P())
+    return fn(stage_params, micro)

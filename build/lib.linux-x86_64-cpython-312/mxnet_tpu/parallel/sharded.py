@@ -1,0 +1,547 @@
+"""The TPU fast path: ONE jitted, GSPMD-sharded train step.
+
+The reference's training step is five engine-queued phases — forward,
+backward, kvstore push (gradient reduce), pull, fused optimizer update
+(SURVEY §3.2/§3.3: CachedOp::Forward, Imperative::Backward,
+KVStoreDist::PushImpl via src/kvstore/comm.h CommDevice reduce,
+src/operator/optimizer_op.cc fused updates). Overlap between them emerges
+from the ThreadedEngine's var-dependency scheduling.
+
+On TPU the idiomatic design compiles the WHOLE region into a single XLA
+program over a device mesh:
+
+- the batch is sharded on the ``data`` mesh axis; the loss is a global mean,
+  so XLA *derives* the gradient all-reduce (psum over ICI) from sharding
+  propagation — no explicit collective calls, and the latency-hiding
+  scheduler overlaps it with backward compute (subsuming the reference's
+  P3 priority scheduling, src/kvstore/p3store_dist.h);
+- parameters can be tensor-parallel sharded by regex rules (PartitionSpec on
+  the ``model`` axis) — a capability the reference only approximates with
+  hand ``ctx_group`` placement (example/model-parallel/);
+- optimizer state lives sharded exactly like its parameter; the update runs
+  in the same program with donated buffers (true in-place, like the
+  reference's mutating ``sgd_mom_update``);
+- learning rate and step count enter as *traced scalars* so LR schedules
+  never retrace the program.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .. import _rng, autograd
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..context import current_context
+from ..gluon.block import Block
+from ..ops import optimizer_op as _ops
+from .mesh import current_mesh
+
+__all__ = ["ShardedTrainer", "functional_apply",
+           "allreduce_across_processes"]
+
+
+def functional_apply(block, key, tr_datas, aux_datas, input_datas,
+                     training=True):
+    """Run a Gluon block as a pure function of its parameter arrays.
+
+    This is the bridge between the mutable Gluon world and functional XLA:
+    parameter handles are temporarily rebound to the traced arrays, the block
+    runs eagerly (every op dispatches to jnp on tracers), and the handles are
+    restored. Returns (out_datas, out_treedef, aux_new_datas); auxiliary
+    state (BatchNorm running stats) is captured from the rebound handles —
+    mutation hoisted into explicit outputs.
+    """
+    trainable, aux = block._param_split()
+    ctx = current_context()
+    saved = []
+    temps = {}
+    for param, data in list(zip(trainable, tr_datas)) + \
+            list(zip(aux, aux_datas)):
+        saved.append((param, param._data))
+        arr = nd.NDArray(data, ctx=ctx, _skip_device_put=True)
+        temps[id(param)] = arr
+        param._data = [arr] * len(param._ctx_list or [ctx])
+    try:
+        with _rng.trace_key(key), autograd.pause(train_mode=training):
+            out = Block.__call__(block, *[
+                nd.NDArray(d, ctx=ctx, _skip_device_put=True)
+                if not isinstance(d, nd.NDArray) else d
+                for d in input_datas])
+        out_flat, treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, nd.NDArray))
+        out_datas = [o._data if isinstance(o, nd.NDArray) else o
+                     for o in out_flat]
+        aux_new = [temps[id(p)]._data for p in aux]
+    finally:
+        for param, data in saved:
+            param._data = data
+    return out_datas, treedef, aux_new
+
+
+# ---------------------------------------------------------------------------
+# Functional optimizer rules: state init + traced-step update per Optimizer
+# class. These reuse the SAME fused update kernels as the eager path
+# (ops/optimizer_op.py, ref: src/operator/optimizer_op.cc) but thread the
+# step count t as a traced value so Adam bias correction / schedules never
+# bake into the compiled program.
+# ---------------------------------------------------------------------------
+
+def _zeros_like(w):
+    return jnp.zeros(w.shape, w.dtype)
+
+
+def _opt_init_state(opt, w):
+    name = type(opt).__name__
+    if name in ("SGD", "NAG", "Signum"):
+        mom = getattr(opt, "momentum", 0.0)
+        return (_zeros_like(w),) if mom != 0.0 else ()
+    if name in ("Adam", "AdamW", "LAMB", "FTRL"):
+        return (_zeros_like(w), _zeros_like(w))
+    if name in ("RMSProp", "AdaGrad"):
+        return (_zeros_like(w),)
+    if name == "SGLD":
+        return ()
+    raise MXNetError(
+        f"ShardedTrainer has no functional rule for optimizer "
+        f"{name!r}; use the eager gluon.Trainer for it")
+
+
+def _opt_apply(opt, w, g, state, lr, t, wd, rescale, clip):
+    """One traced parameter update; returns (new_w, new_state)."""
+    name = type(opt).__name__
+    kw = dict(lr=lr, wd=wd, rescale_grad=rescale, clip_gradient=clip)
+    if name in ("SGD", "NAG"):
+        if not state:
+            return _ops._sgd_update(w, g, **kw), ()
+        fn = _ops._sgd_mom_update if name == "SGD" else _ops._nag_mom_update
+        w2, m2 = fn(w, g, state[0], momentum=opt.momentum, **kw)
+        return w2, (m2,)
+    if name == "Adam":
+        corr = jnp.sqrt(1 - opt.beta2 ** t) / (1 - opt.beta1 ** t)
+        w2, m2, v2 = _ops._adam_update(
+            w, g, state[0], state[1], beta1=opt.beta1, beta2=opt.beta2,
+            epsilon=opt.epsilon, lr=lr * corr, wd=wd, rescale_grad=rescale,
+            clip_gradient=clip)
+        return w2, (m2, v2)
+    if name == "AdamW":
+        corr = jnp.sqrt(1 - opt.beta2 ** t) / (1 - opt.beta1 ** t)
+        w2, m2, v2 = _ops._adamw_update(
+            w, g, state[0], state[1], beta1=opt.beta1, beta2=opt.beta2,
+            epsilon=opt.epsilon, lr=lr * corr, wd=wd, rescale_grad=rescale,
+            clip_gradient=clip)
+        return w2, (m2, v2)
+    if name == "LAMB":
+        gp, m2, v2 = _ops._lamb_phase1(
+            w, g, state[0], state[1], beta1=opt.beta1, beta2=opt.beta2,
+            epsilon=opt.epsilon, t=t, bias_correction=opt.bias_correction,
+            wd=wd, rescale_grad=rescale, clip_gradient=clip)
+        r1 = jnp.linalg.norm(w.astype(jnp.float32))
+        r2 = jnp.linalg.norm(gp)
+        w2 = _ops._lamb_phase2(
+            w, gp, r1, r2, lr=lr,
+            lower_bound=opt.lower_bound if opt.lower_bound else -1.0,
+            upper_bound=opt.upper_bound if opt.upper_bound else -1.0)
+        return w2, (m2, v2)
+    if name == "RMSProp":
+        w2, n2 = _ops._rmsprop_update(w, g, state[0], gamma1=opt.gamma1,
+                                      epsilon=opt.epsilon, **kw)
+        return w2, (n2,)
+    if name == "AdaGrad":
+        w2, h2 = _ops._adagrad_update(w, g, state[0],
+                                      epsilon=opt.float_stable_eps, **kw)
+        return w2, (h2,)
+    if name == "FTRL":
+        w2, z2, n2 = _ops._ftrl_update(w, g, state[0], state[1],
+                                       lamda1=opt.lamda1, beta=opt.beta, **kw)
+        return w2, (z2, n2)
+    if name == "Signum":
+        if not state:
+            return _ops._signsgd_update(w, g, **kw), ()
+        g32 = g.astype(jnp.float32) * rescale
+        g32 = jnp.where(clip > 0, jnp.clip(g32, -clip, clip), g32)
+        m2 = state[0] * opt.momentum - g32 * (1 - opt.momentum)
+        w2 = w * (1 - lr * opt.wd_lh) + jnp.sign(m2) * lr
+        return w2.astype(w.dtype), (m2,)
+    raise MXNetError(f"no functional update for {name}")
+
+
+class ShardedTrainer:
+    """Gluon-level driver for the single-program SPMD step.
+
+    Drop-in upgrade of ``gluon.Trainer`` for mesh execution::
+
+        mesh = parallel.make_mesh({"data": 4, "model": 2})
+        trainer = parallel.ShardedTrainer(net, loss_fn, "sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            mesh=mesh,
+            param_rules=[(r".*dense\\d+_weight", PartitionSpec(None, "model"))])
+        loss = trainer.step(x, y)          # one fused XLA program
+
+    The reference analog is Trainer.step's allreduce+update flow
+    (ref: python/mxnet/gluon/trainer.py _allreduce_grads/_update) — here both
+    happen inside the compiled program, overlapped by XLA's scheduler.
+    """
+
+    def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
+                 mesh: Mesh = None, param_rules=None, batch_axis=0,
+                 donate=True, compute_dtype=None, remat=None,
+                 master_dtype=None):
+        from .. import optimizer as opt_mod
+        self._block = block
+        self._loss = loss_fn
+        optimizer_params = optimizer_params or {}
+        self._optimizer = (optimizer if isinstance(optimizer, opt_mod.Optimizer)
+                           else opt_mod.create(optimizer, **optimizer_params))
+        # compute_dtype="bfloat16": forward/backward in bf16 on the MXU with
+        # fp32 master weights — the reference's multi-precision (`mp_*`)
+        # scheme (ref: src/operator/optimizer_op.cc mp_sgd_update) fused
+        # into the step; the optimizer update stays fp32. When unset, the
+        # process-wide AMP dtype applies (contrib.amp.init).
+        if compute_dtype is None:
+            from ..contrib.amp import amp_dtype
+            compute_dtype = amp_dtype()
+        self._compute_dtype = (jnp.dtype(compute_dtype)
+                               if compute_dtype is not None else None)
+        # remat: rematerialization policy for the forward pass — the
+        # `jax.checkpoint` HBM↔FLOPs trade (MXNET_BACKWARD_DO_MIRROR is the
+        # reference's analog, ref: src/executor/graph_executor.cc mirror
+        # path). None keeps XLA's default saved-activation schedule;
+        # "full" saves nothing (recompute the whole forward in backward);
+        # "dots" saves matmul/conv outputs and recomputes elementwise chains;
+        # a callable is passed through as a jax.checkpoint policy.
+        if remat in (None, "full"):
+            self._remat_policy = remat
+        elif remat == "dots":
+            self._remat_policy = jax.checkpoint_policies.dots_saveable
+        elif remat == "dots_no_batch":
+            self._remat_policy = \
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif callable(remat):
+            self._remat_policy = remat
+        else:
+            raise MXNetError(f"unknown remat policy {remat!r}; expected "
+                             "None, 'full', 'dots', 'dots_no_batch' or a "
+                             "jax.checkpoint policy callable")
+        # master_dtype: storage dtype of weights + optimizer state. Default
+        # fp32 masters (the reference's multi-precision mp_* scheme);
+        # "bfloat16" halves parameter/state HBM traffic at the cost of
+        # update precision — the update math itself stays fp32-internal
+        # (ops/optimizer_op.py casts per-kernel).
+        self._master_dtype = (jnp.dtype(master_dtype)
+                              if master_dtype is not None else None)
+        if self._compute_dtype is None and self._master_dtype is not None:
+            # low-precision storage without a compute dtype would feed
+            # bf16 weights to fp32 inputs — compute in the master dtype
+            self._compute_dtype = self._master_dtype
+        self._mesh = mesh
+        self._param_rules = [(re.compile(pat), spec)
+                             for pat, spec in (param_rules or [])]
+        self._batch_axis = batch_axis
+        self._donate = donate
+        self._prepared = False
+        self._num_update = self._optimizer.begin_num_update
+        self._step_fn = None
+        self._eval_fn = None
+        self._out_treedef = None
+
+    # -- sharding layout -----------------------------------------------------
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = current_mesh()
+        return self._mesh
+
+    def _param_spec(self, param):
+        for pat, spec in self._param_rules:
+            if pat.match(param.name):
+                return spec
+        return PartitionSpec()   # replicated (pure data parallel)
+
+    def _batch_spec(self, ndim):
+        spec = [None] * ndim
+        if "data" in self.mesh.axis_names:
+            spec[self._batch_axis] = "data"
+        return PartitionSpec(*spec)
+
+    def _shard(self, data, spec):
+        return jax.device_put(data, NamedSharding(self.mesh, spec))
+
+    def _shard_batch_arg(self, b):
+        """Batch arg → data-sharded device array. Already-placed jax.Arrays
+        pass through (device_put with an identical sharding is a no-op), so
+        a prefetching input pipeline avoids re-uploads."""
+        data = b._data if isinstance(b, nd.NDArray) else b
+        if not isinstance(data, jax.Array):
+            data = np.asarray(data)
+        return self._shard(data, self._batch_spec(np.ndim(data)))
+
+    # -- setup ---------------------------------------------------------------
+    def _prepare(self, args):
+        if self._prepared:
+            return
+        from .mesh import use_mesh
+        with use_mesh(self.mesh):   # deferred-init pass may hit mesh ops
+            self._block._ensure_ready(tuple(
+                a if isinstance(a, nd.NDArray) else nd.array(a)
+                for a in args))
+        trainable, aux = self._block._param_split()
+        self._trainable, self._aux = trainable, aux
+        self._tr_specs = [self._param_spec(p) for p in trainable]
+        self._aux_specs = [self._param_spec(p) for p in aux]
+        # move parameter + aux arrays onto the mesh with their target layout;
+        # the NDArray handles now hold globally-sharded jax.Arrays
+        mdt = self._master_dtype
+        for p, spec in zip(trainable, self._tr_specs):
+            w = p._data[0]._data
+            if mdt is not None and jnp.issubdtype(w.dtype, jnp.floating):
+                w = w.astype(mdt)
+            p._data[0]._rebind(self._shard(w, spec))
+        for p, spec in zip(aux, self._aux_specs):
+            p._data[0]._rebind(self._shard(p._data[0]._data, spec))
+        # optimizer state, sharded like its weight
+        self._states = []
+        for p, spec in zip(trainable, self._tr_specs):
+            state = _opt_init_state(self._optimizer, p._data[0]._data)
+            self._states.append(tuple(self._shard(s, spec) for s in state))
+        self._prepared = True
+
+    # -- the compiled step ---------------------------------------------------
+    def _build_step(self, n_inputs):
+        block, loss_block, opt = self._block, self._loss, self._optimizer
+        wds = [opt._get_wd(i) for i in range(len(self._trainable))]
+        lr_mults = [opt._get_lr(i) / max(opt.learning_rate, 1e-30)
+                    for i in range(len(self._trainable))]
+        clip = opt.clip_gradient if opt.clip_gradient is not None else -1.0
+
+        cdt = self._compute_dtype
+
+        def step(tr, aux, states, key, lr, t, rescale, *batch):
+            inputs, label = batch[:-1], batch[-1]
+
+            def loss_of(tr_):
+                if cdt is not None:
+                    tr_ = [w.astype(cdt) if jnp.issubdtype(w.dtype,
+                                                           jnp.floating)
+                           else w for w in tr_]
+                    inputs_c = [i.astype(cdt) if jnp.issubdtype(
+                        jnp.asarray(i).dtype, jnp.floating) else i
+                        for i in inputs]
+                else:
+                    inputs_c = inputs
+                outs, treedef, aux_new = functional_apply(
+                    block, key, tr_, aux, inputs_c, training=True)
+                self._out_treedef = treedef
+                # loss math in fp32 by default; a loss that does its own
+                # fp32-accumulated reductions (amp_safe, e.g. the fused
+                # sparse softmax-CE) takes compute-dtype outputs directly —
+                # for a [tokens, vocab] MLM head the blanket fp32 cast
+                # alone materializes GBs of HBM traffic per step
+                if getattr(loss_block, "amp_safe", False):
+                    out_nds = [nd.NDArray(o, _skip_device_put=True)
+                               for o in outs]
+                else:
+                    out_nds = [nd.NDArray(
+                        o.astype(jnp.float32) if jnp.issubdtype(
+                            o.dtype, jnp.floating) else o,
+                        _skip_device_put=True) for o in outs]
+                label_nd = nd.NDArray(label, _skip_device_put=True)
+                with autograd.pause(train_mode=True):
+                    loss_nd = loss_block(out_nds[0] if len(out_nds) == 1
+                                         else out_nds, label_nd)
+                loss_val = jnp.mean(loss_nd._data.astype(jnp.float32))
+                return loss_val, (outs, aux_new)
+
+            if self._remat_policy is not None:
+                loss_of = jax.checkpoint(
+                    loss_of,
+                    policy=(None if self._remat_policy == "full"
+                            else self._remat_policy))
+            (loss_val, (outs, aux_new)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(tr))
+            aux_new = [a.astype(a0.dtype) for a, a0 in zip(aux_new, aux)]
+            new_tr, new_states = [], []
+            for i, (w, g, s) in enumerate(zip(tr, grads, states)):
+                w2, s2 = _opt_apply(opt, w, g, s, lr * lr_mults[i], t,
+                                    wds[i], rescale, clip)
+                new_tr.append(w2)
+                new_states.append(s2)
+            return new_tr, aux_new, new_states, loss_val, tuple(outs)
+
+        mesh = self.mesh
+        ns = lambda spec: NamedSharding(mesh, spec)
+        rep = ns(PartitionSpec())
+        in_shardings = (
+            [ns(s) for s in self._tr_specs],
+            [ns(s) for s in self._aux_specs],
+            [tuple(ns(s) for _ in st)
+             for s, st in zip(self._tr_specs, self._states)],
+            rep, rep, rep, rep,
+        ) + tuple(jax.tree_util.tree_map(
+            lambda _: None, tuple(range(n_inputs + 1))))  # batch: auto
+        out_shardings = (
+            [ns(s) for s in self._tr_specs],
+            [ns(s) for s in self._aux_specs],
+            [tuple(ns(s) for _ in st)
+             for s, st in zip(self._tr_specs, self._states)],
+            rep, None,
+        )
+        donate = (0, 2) if self._donate else ()
+        self._raw_step = step
+        self._shardings = (in_shardings, out_shardings, donate)
+        return jax.jit(step, in_shardings=in_shardings,
+                       out_shardings=out_shardings, donate_argnums=donate)
+
+    def step(self, *batch):
+        """Run one fused train step; last positional arg is the label.
+        Returns the (replicated) scalar loss as an NDArray."""
+        args = batch[:-1]
+        self._prepare(args)
+        self._maybe_invalidate_amp()
+        if self._step_fn is None:
+            self._step_fn = self._build_step(len(args))
+        batch_datas = [self._shard_batch_arg(b) for b in batch]
+        self._num_update += 1
+        t = self._num_update
+        self._optimizer.num_update = t
+        lr = self._optimizer.learning_rate
+        if self._optimizer.lr_scheduler is not None:
+            lr = self._optimizer.lr_scheduler(t)
+        rescale = self._optimizer.rescale_grad
+        tr = [p._data[0]._data for p in self._trainable]
+        aux = [p._data[0]._data for p in self._aux]
+        from .mesh import use_mesh
+        with use_mesh(self.mesh):   # mesh-aware ops (ring attention) trace
+            new_tr, aux_new, new_states, loss_val, outs = self._step_fn(
+                tr, aux, self._states, _rng.next_key(),
+                jnp.float32(lr), jnp.float32(t), jnp.float32(rescale),
+                *batch_datas)
+        for p, w in zip(self._trainable, new_tr):
+            p._data[0]._rebind(w)
+        for p, a in zip(self._aux, aux_new):
+            p._data[0]._rebind(a)
+        self._states = new_states
+        self.last_outputs = [nd.NDArray(o, _skip_device_put=True)
+                             for o in outs]
+        return nd.NDArray(loss_val, _skip_device_put=True)
+
+    def _maybe_invalidate_amp(self):
+        """Retrace compiled programs when the per-op AMP cast policy
+        changes (amp.init with op lists / amp.reset) — a stale program
+        would silently keep or miss the casts."""
+        from .. import _dispatch
+        if getattr(self, "_amp_epoch", None) != _dispatch.amp_epoch():
+            self._step_fn = None
+            self._eval_fn = None
+            self._multi_fns = {}
+            self._amp_epoch = _dispatch.amp_epoch()
+
+    def run_steps(self, *batch, num_steps=8):
+        """Run ``num_steps`` train steps as ONE compiled program
+        (``lax.scan`` over the step body). Amortizes host-dispatch latency
+        — the TPU analog of the reference's engine keeping a deep async
+        queue ahead of the Python loop (SURVEY §3.2: "the loop
+        synchronizes only at metric.update"). The batch is reused each
+        inner step; returns the last step's loss."""
+        args = batch[:-1]
+        self._prepare(args)
+        self._maybe_invalidate_amp()
+        if self._step_fn is None:
+            self._step_fn = self._build_step(len(args))
+        key = f"multi{num_steps}"
+        if not hasattr(self, "_multi_fns"):
+            self._multi_fns = {}
+        if key not in self._multi_fns:
+            raw = self._raw_step
+            in_sh, out_sh, donate = self._shardings
+
+            def multi(tr, aux, states, rng, lr, t, rescale, *b):
+                def body(carry, i):
+                    tr_, aux_, states_, t_ = carry
+                    k = jax.random.fold_in(rng, i)
+                    ntr, naux, nst, loss, _ = raw(tr_, aux_, states_, k,
+                                                  lr, t_, rescale, *b)
+                    return (ntr, naux, nst, t_ + 1.0), loss
+
+                (tr, aux, states, _), losses = jax.lax.scan(
+                    body, (tr, aux, states, t), jnp.arange(num_steps))
+                return tr, aux, states, losses[-1]
+
+            self._multi_fns[key] = jax.jit(
+                multi, in_shardings=in_sh,
+                out_shardings=out_sh[:3] + (out_sh[3],),
+                donate_argnums=donate)
+        batch_datas = [self._shard_batch_arg(b) for b in batch]
+        t = self._num_update + 1
+        self._num_update += num_steps
+        self._optimizer.num_update = self._num_update
+        lr = self._optimizer.learning_rate
+        if self._optimizer.lr_scheduler is not None:
+            lr = self._optimizer.lr_scheduler(t)
+        tr = [p._data[0]._data for p in self._trainable]
+        aux = [p._data[0]._data for p in self._aux]
+        from .mesh import use_mesh
+        with use_mesh(self.mesh):
+            new_tr, aux_new, new_states, loss_val = self._multi_fns[key](
+                tr, aux, self._states, _rng.next_key(), jnp.float32(lr),
+                jnp.float32(t),
+                jnp.float32(self._optimizer.rescale_grad), *batch_datas)
+        for p, w in zip(self._trainable, new_tr):
+            p._data[0]._rebind(w)
+        for p, a in zip(self._aux, aux_new):
+            p._data[0]._rebind(a)
+        self._states = new_states
+        return nd.NDArray(loss_val, _skip_device_put=True)
+
+    def evaluate(self, *batch):
+        """Forward + loss under one compiled program (no update)."""
+        args = batch[:-1]
+        self._prepare(args)
+        self._maybe_invalidate_amp()
+        if self._eval_fn is None:
+            block, loss_block = self._block, self._loss
+
+            def eval_step(tr, aux, key, *b):
+                inputs, label = b[:-1], b[-1]
+                outs, _, _ = functional_apply(block, key, tr, aux, inputs,
+                                              training=False)
+                out_nds = [nd.NDArray(o, _skip_device_put=True) for o in outs]
+                label_nd = nd.NDArray(label, _skip_device_put=True)
+                with autograd.pause(train_mode=False):
+                    loss_nd = loss_block(out_nds[0] if len(out_nds) == 1
+                                         else out_nds, label_nd)
+                return jnp.mean(loss_nd._data.astype(jnp.float32)), \
+                    tuple(outs)
+            self._eval_fn = jax.jit(eval_step)
+        batch_datas = [self._shard_batch_arg(b) for b in batch]
+        tr = [p._data[0]._data for p in self._trainable]
+        aux = [p._data[0]._data for p in self._aux]
+        loss_val, outs = self._eval_fn(tr, aux, _rng.next_key(),
+                                       *batch_datas)
+        self.last_outputs = [nd.NDArray(o, _skip_device_put=True)
+                             for o in outs]
+        return nd.NDArray(loss_val, _skip_device_put=True)
+
+    # -- parity helpers ------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+
+def allreduce_across_processes(arr):
+    """Eager sum over worker processes — the kvstore ``dist_sync`` reduce
+    (ref: src/kvstore/kvstore_dist.h PushImpl aggregate). Rides DCN via the
+    JAX coordination service; identity in single-process runs."""
+    import jax
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(arr._data)
+    return nd.NDArray(jnp.sum(gathered, axis=0), ctx=arr.ctx)

@@ -1,0 +1,121 @@
+"""``mx.profiler`` — profiling facade (ref: python/mxnet/profiler.py over
+src/profiler/profiler.cc).
+
+The reference's profiler instruments the engine's op execution and writes
+chrome://tracing JSON (SURVEY §5.1). On TPU the equivalent truth source is
+the XLA/JAX profiler (xplane traces viewable in TensorBoard/Perfetto,
+including per-op device timing), so this facade drives ``jax.profiler``
+under the reference's API: ``set_config`` + ``set_state('run'/'stop')``,
+scoped ``Marker``/``scope`` (→ ``jax.profiler.TraceAnnotation`` so Gluon
+block names appear on device traces), and ``dumps()`` for a host-side
+aggregate table.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+
+from .base import MXNetError
+
+__all__ = ["set_config", "set_state", "state", "dumps", "dump", "pause",
+           "resume", "Marker", "scope"]
+
+_config = {"filename": "profile.json", "profile_all": False,
+           "profile_symbolic": True, "profile_imperative": True,
+           "profile_memory": True, "profile_api": True,
+           "aggregate_stats": False}
+_state = "stop"
+_trace_dir = None
+_agg = defaultdict(lambda: [0, 0.0])    # name -> [count, total_sec]
+
+
+def set_config(**kwargs):
+    """ref: profiler.py set_config(filename=..., profile_all=...)."""
+    _config.update(kwargs)
+
+
+def set_state(state_name="stop", profile_process="worker"):
+    """'run' starts a JAX profiler trace; 'stop' ends it. The trace
+    directory derives from the configured filename."""
+    global _state, _trace_dir
+    import jax
+    if state_name == _state:
+        return
+    if state_name == "run":
+        base = _config.get("filename", "profile.json")
+        _trace_dir = os.path.splitext(base)[0] + "_trace"
+        os.makedirs(_trace_dir, exist_ok=True)
+        jax.profiler.start_trace(_trace_dir)
+        _state = "run"
+    elif state_name == "stop":
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError:
+            pass
+        _state = "stop"
+    else:
+        raise MXNetError(f"invalid profiler state {state_name!r}")
+
+
+def state():
+    return _state
+
+
+def pause(profile_process="worker"):
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def dump(finished=True, profile_process="worker"):
+    """Finish the trace (the xplane files under <filename>_trace are the
+    chrome-trace analog; open with TensorBoard's profile plugin)."""
+    set_state("stop")
+
+
+def dumps(reset=False, format="table"):
+    """Host-side aggregate of Marker/scope timings (the reference's
+    aggregate_stats table, ref: src/profiler/aggregate_stats.cc)."""
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    for name, (count, total) in sorted(_agg.items()):
+        avg = total / count * 1e3 if count else 0.0
+        lines.append(f"{name:<40}{count:>8}{total * 1e3:>12.3f}{avg:>12.3f}")
+    if reset:
+        _agg.clear()
+    return "\n".join(lines)
+
+
+class Marker:
+    """Scoped annotation: host-side aggregate timing + device-trace
+    annotation (ref: profiler.py Marker / mx.profiler.scope)."""
+
+    def __init__(self, name, scope_name="<unk>"):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def __enter__(self):
+        import jax
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        entry = _agg[self.name]
+        entry[0] += 1
+        entry[1] += dt
+        self._ann.__exit__(*exc)
+
+    # one-shot API parity (ref: Marker.mark)
+    def mark(self, scope_name="process"):
+        entry = _agg[self.name]
+        entry[0] += 1
+
+
+def scope(name="<unk>:"):
+    return Marker(name)

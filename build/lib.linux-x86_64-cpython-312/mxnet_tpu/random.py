@@ -1,0 +1,37 @@
+"""``mx.random`` — top-level random API (ref: python/mxnet/random.py).
+
+Forwards to the generated ``nd.random`` namespace; ``seed`` reseeds the
+global eager PRNG (stateless JAX keys under the hood, see _rng.py).
+"""
+from __future__ import annotations
+
+from ._rng import seed as _seed_jax
+from .ndarray import random as _ndrandom
+
+
+def seed(seed_state):
+    """ref: mx.random.seed — seeds every generator the framework draws
+    from: the JAX key chain (nd.random ops) AND the numpy global RNG
+    (weight initializers sample through numpy on the host, matching the
+    reference where MXRandomSeed seeds all engines)."""
+    import numpy as _np
+    _seed_jax(seed_state)
+    _np.random.seed(int(seed_state) % (2 ** 32))
+
+uniform = _ndrandom.uniform
+normal = _ndrandom.normal
+
+
+def randn(*shape, loc=0.0, scale=1.0, **kwargs):
+    """ref: mx.nd.random.randn(*shape) — positional args are the shape."""
+    return _ndrandom.normal(loc=loc, scale=scale, shape=shape or (1,), **kwargs)
+gamma = _ndrandom.gamma
+exponential = _ndrandom.exponential
+poisson = _ndrandom.poisson
+randint = _ndrandom.randint
+multinomial = _ndrandom.multinomial
+shuffle = _ndrandom.shuffle
+bernoulli = _ndrandom.bernoulli
+
+__all__ = ["seed", "uniform", "normal", "randn", "gamma", "exponential",
+           "poisson", "randint", "multinomial", "shuffle", "bernoulli"]

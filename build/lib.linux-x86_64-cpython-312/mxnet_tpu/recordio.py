@@ -1,0 +1,289 @@
+"""RecordIO — the reference's packed binary record format, byte-compatible.
+
+ref: 3rdparty/dmlc-core/include/dmlc/recordio.h (kMagic, record framing),
+3rdparty/dmlc-core/src/recordio.cc (RecordIOWriter::WriteRecord splitting),
+python/mxnet/recordio.py (MXRecordIO, MXIndexedRecordIO, IRHeader,
+pack/unpack/pack_img/unpack_img).
+
+Framing: every record is ``[magic:u32][lrec:u32][payload][pad to 4B]`` where
+``lrec`` packs cflag (upper 3 bits) + length (lower 29). Payloads containing
+the magic u32 at 4-byte alignment are split into parts (cflag 1=begin,
+2=middle, 3=end); the reader re-joins them re-inserting the magic. Files
+written here are readable by the reference tooling and vice versa.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+_magic_bytes = struct.pack("<I", _kMagic)
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return lrec >> 29, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (ref: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.fid = None
+        self.open()
+
+    def open(self):
+        self._native = None
+        if self.flag == "w":
+            self.writable = True
+            self._native = self._try_native_writer()
+            self.fid = None if self._native else open(self.uri, "wb")
+        elif self.flag == "r":
+            self.writable = False
+            self._native = self._try_native_reader()
+            self.fid = None if self._native else open(self.uri, "rb")
+        else:
+            raise MXNetError(f"invalid flag {self.flag!r} (use 'r' or 'w')")
+
+    def _try_native_reader(self):
+        """Prefer the C++ reader (native/recordio.cc) — same byte format,
+        no Python framing overhead."""
+        try:
+            from ._native import NativeReader
+            return NativeReader(self.uri)
+        except Exception:
+            return None
+
+    def _try_native_writer(self):
+        try:
+            from ._native import NativeWriter
+            return NativeWriter(self.uri)
+        except Exception:
+            return None
+
+    def close(self):
+        if getattr(self, "_native", None) is not None:
+            self._native.close()
+            self._native = None
+        if self.fid is not None:
+            self.fid.close()
+            self.fid = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Pickling (e.g. into DataLoader workers) reopens by path."""
+        d = dict(self.__dict__)
+        d["fid"] = None
+        d["_native"] = None
+        if self.writable:
+            raise MXNetError("cannot pickle a writable MXRecordIO")
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        if self._native is not None and self.writable:
+            return self._native.tell()
+        return self.fid.tell()
+
+    def write(self, buf):
+        """ref: RecordIOWriter::WriteRecord — split payload at aligned
+        occurrences of the magic."""
+        if not self.writable:
+            raise MXNetError("recordio not opened for writing")
+        if isinstance(buf, str):
+            buf = buf.encode("utf-8")
+        buf = bytes(buf)
+        if self._native is not None:
+            self._native.write(buf)
+            return
+        # find 4-byte-aligned magic occurrences
+        splits = []
+        for off in range(0, len(buf) - 3, 4):
+            if buf[off:off + 4] == _magic_bytes:
+                splits.append(off)
+        parts = []
+        start = 0
+        for off in splits:
+            parts.append(buf[start:off])
+            start = off + 4
+        parts.append(buf[start:])
+        n = len(parts)
+        for i, part in enumerate(parts):
+            if n == 1:
+                cflag = 0
+            elif i == 0:
+                cflag = 1
+            elif i == n - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            self.fid.write(_magic_bytes)
+            self.fid.write(struct.pack("<I", _encode_lrec(cflag, len(part))))
+            self.fid.write(part)
+            pad = (4 - len(part) % 4) % 4
+            if pad:
+                self.fid.write(b"\x00" * pad)
+
+    def _read_one_part(self):
+        head = self.fid.read(8)
+        if len(head) < 8:
+            return None, None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _kMagic:
+            raise MXNetError(f"recordio: bad magic {magic:#x} in {self.uri}")
+        cflag, length = _decode_lrec(lrec)
+        data = self.fid.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fid.read(pad)
+        return cflag, data
+
+    def read(self):
+        """Next record payload, or None at EOF (ref: MXRecordIO.read)."""
+        if self.writable:
+            raise MXNetError("recordio not opened for reading")
+        if self._native is not None:
+            return self._native.read()
+        cflag, data = self._read_one_part()
+        if cflag is None:
+            return None
+        if cflag == 0:
+            return data
+        if cflag != 1:
+            raise MXNetError("recordio: stream does not start at a record "
+                             "boundary")
+        parts = [data]
+        while True:
+            cflag, data = self._read_one_part()
+            if cflag is None:
+                raise MXNetError("recordio: truncated multi-part record")
+            parts.append(data)
+            if cflag == 3:
+                break
+        return _magic_bytes.join(parts)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with a ``key\\tpos`` index for random access
+    (ref: recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.exists(self.idx_path):
+            with open(self.idx_path) as f:
+                for line in f:
+                    line = line.strip().split("\t")
+                    if len(line) != 2:
+                        continue
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        is_open = self.fid is not None or \
+            getattr(self, "_native", None) is not None
+        if is_open and self.writable:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek(self, idx):
+        pos = self.idx[idx]
+        if self._native is not None:
+            self._native.seek(pos)
+        else:
+            self.fid.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# -- header packing (ref: recordio.py IRHeader/pack/unpack) ------------------
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """ref: recordio.py pack — header + raw bytes."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        head = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                           header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        head = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+        head += label.tobytes()
+    return head + (s if isinstance(s, bytes) else bytes(s))
+
+
+def unpack(s):
+    """ref: recordio.py unpack → (IRHeader, payload)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """ref: recordio.py pack_img — encode image (cv2) then pack."""
+    import cv2
+    ret, buf = cv2.imencode(
+        img_fmt, img,
+        [cv2.IMWRITE_JPEG_QUALITY, quality] if img_fmt in (".jpg", ".jpeg")
+        else [])
+    if not ret:
+        raise MXNetError(f"failed to encode image as {img_fmt}")
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    """ref: recordio.py unpack_img → (IRHeader, ndarray image)."""
+    import cv2
+    header, s = unpack(s)
+    img = cv2.imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+    return header, img

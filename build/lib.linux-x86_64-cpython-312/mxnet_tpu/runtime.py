@@ -1,0 +1,68 @@
+"""``mx.runtime`` — build/runtime feature detection
+(ref: python/mxnet/runtime.py Features/feature_list over libinfo.cc)."""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _detect():
+    import jax
+    feats = {}
+    platforms = {d.platform for d in jax.devices()}
+    # "axon" is the TPU tunnel platform name in this environment
+    feats["TPU"] = bool(platforms & {"tpu", "axon"})
+    feats["CUDA"] = bool(platforms & {"gpu", "cuda"})
+    feats["CPU"] = True
+    feats["BLAS_OPEN"] = True              # via XLA's host backend
+    feats["F16C"] = True                   # bf16/fp16 via XLA
+    try:
+        import cv2  # noqa: F401
+        feats["OPENCV"] = True
+    except ImportError:
+        feats["OPENCV"] = False
+    try:
+        from . import _native
+        feats["NATIVE_IO"] = _native.get_lib() is not None
+    except Exception:
+        feats["NATIVE_IO"] = False
+    feats["DIST_KVSTORE"] = True           # jax.distributed path
+    try:
+        from jax.experimental.pallas.ops.tpu import flash_attention  # noqa
+        feats["PALLAS_FLASH_ATTENTION"] = True
+    except ImportError:
+        feats["PALLAS_FLASH_ATTENTION"] = False
+    try:
+        import onnx  # noqa: F401
+        feats["ONNX"] = True
+    except ImportError:
+        feats["ONNX"] = False
+    feats["INT8_QUANTIZATION"] = False     # calibration only this round
+    return feats
+
+
+class Features(dict):
+    """ref: runtime.Features — dict of Feature with is_enabled()."""
+
+    def __init__(self):
+        super().__init__({name: Feature(name, on)
+                          for name, on in _detect().items()})
+
+    def is_enabled(self, name):
+        name = name.upper()
+        return name in self and self[name].enabled
+
+    def __repr__(self):
+        return "[" + ", ".join(repr(f) for f in self.values()) + "]"
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,109 @@
+"""``mx.sym`` — the symbolic operator namespace.
+
+Generated from the same op registry as ``mx.nd`` (the reference generates
+both from MXSymbolListAtomicSymbolCreators; ref:
+python/mxnet/symbol/register.py), so every operator composes lazily into a
+Symbol graph with identical semantics to its eager twin.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+from ..ops import registry as _registry
+from .executor import Executor
+from .symbol import (Group, Symbol, Variable, arange, load, load_json, ones,
+                     var, zeros)
+from . import passes
+from .passes import apply_pass, list_passes, register_pass
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones", "arange", "Executor", "eval_symbol",
+           "passes", "apply_pass", "register_pass", "list_passes"]
+
+
+def _make_wrapper(opname, op):
+    param_order = [p.name for p in op.params]
+
+    def wrapper(*args, name=None, attr=None, **kwargs):
+        from .symbol import _create
+        args = list(args)
+        inputs = []
+        while args and isinstance(args[0], Symbol):
+            inputs.append(args.pop(0))
+        # named-input kwargs (data=..., weight=...) like the reference
+        from .symbol import _OP_INPUTS
+        names, _ = _OP_INPUTS.get(opname, (["data"], 0))
+        if not inputs:
+            present = [n for n in names if n in kwargs]
+            if present:
+                for n in names:
+                    if n in kwargs:
+                        inputs.append(kwargs.pop(n))
+                    else:
+                        break
+        for val, pname in zip(args, param_order):
+            kwargs[pname] = val
+        return _create(opname, inputs, kwargs, name=name)
+
+    wrapper.__name__ = opname
+    wrapper.__doc__ = op.signature_doc()
+    return wrapper
+
+
+def _new_module(name):
+    mod = types.ModuleType(f"{__name__}.{name}")
+    sys.modules[mod.__name__] = mod
+    return mod
+
+
+random = _new_module("random")
+linalg = _new_module("linalg")
+contrib = _new_module("contrib")
+op = _new_module("op")
+_internal = _new_module("_internal")
+
+_this = sys.modules[__name__]
+
+
+def _expose():
+    for opname in _registry.list_ops():
+        operator = _registry.get(opname)
+        fn = _make_wrapper(opname, operator)
+        if opname.startswith("_contrib_"):
+            setattr(contrib, opname[len("_contrib_"):], fn)
+        elif opname.startswith("_random_"):
+            setattr(random, opname[len("_random_"):], fn)
+        elif opname.startswith("_sample_"):
+            setattr(random, opname[1:], fn)
+        elif opname.startswith("_linalg_"):
+            setattr(linalg, opname[len("_linalg_"):], fn)
+        elif opname.startswith("_"):
+            setattr(_internal, opname, fn)
+        else:
+            if opname in ("BilinearResize2D", "AdaptiveAvgPooling2D",
+                          "ROIAlign", "MultiBoxPrior", "box_iou", "box_nms"):
+                setattr(contrib, opname, fn)
+            else:
+                if not hasattr(_this, opname):
+                    setattr(_this, opname, fn)
+                setattr(op, opname, fn)
+
+
+_expose()
+
+
+def eval_symbol(outputs, inputs, args, params):
+    """Execute a symbol for SymbolBlock.forward: bind ``inputs`` (Symbols)
+    to ``args`` (NDArrays) and parameter variables to ``params``."""
+    from .. import ndarray as nd
+    values = {}
+    for sym, arr in zip(inputs, args):
+        values[sym.name] = arr._data if isinstance(arr, nd.NDArray) \
+            else nd.array(arr)._data
+    for name, p in params.items():
+        values[name] = p.data()._data
+    run = outputs._make_eval_fn(training=False)
+    outs, _ = run(values)
+    res = [nd.NDArray(o, _skip_device_put=True) for o in outs]
+    return res[0] if len(res) == 1 else res

@@ -1,0 +1,135 @@
+"""Executor — the bound, compiled form of a Symbol.
+
+ref: src/executor/graph_executor.cc GraphExecutor (Bind/SimpleBind,
+Forward/Backward, memory planning passes). Here binding compiles the DAG to
+one jitted XLA program per (train/infer) mode; XLA's buffer assignment IS
+the PlanMemory pass, its fusion the op bulking, and jax.vjp supplies the
+backward graph the reference builds with nnvm::pass::Gradient.
+"""
+from __future__ import annotations
+
+import jax
+
+from .. import _rng
+from ..base import MXNetError
+from ..context import current_context
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        from .. import ndarray as nd
+        from .passes import apply_env_passes
+        symbol = apply_env_passes(symbol)   # MXNET_SUBGRAPH_BACKEND hook
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.arg_dict = dict(args)
+        self.aux_dict = dict(aux_states or {})
+        arg_names = symbol.list_arguments()
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(arg_names, grad_req))
+        self._grad_req = grad_req
+        if args_grad is None:
+            args_grad = {n: nd.zeros(self.arg_dict[n].shape, ctx=self._ctx)
+                         for n in arg_names
+                         if grad_req.get(n, "null") != "null"
+                         and n in self.arg_dict}
+        self.grad_dict = dict(args_grad)
+        self.outputs = []
+        self._fns = {}
+        self._vjp = None
+        self._fwd_values = None
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n]
+                for n in self._symbol.list_auxiliary_states()]
+
+    def forward(self, is_train=False, **kwargs):
+        """ref: Executor::Forward — optionally override inputs by name."""
+        from .. import ndarray as nd
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"executor has no argument {k!r}")
+            self.arg_dict[k]._rebind(
+                v._data if isinstance(v, nd.NDArray)
+                else nd.array(v)._data)
+        values = {k: v._data for k, v in self.arg_dict.items()}
+        values.update({k: v._data for k, v in self.aux_dict.items()})
+        run = self._symbol._make_eval_fn(training=is_train)
+
+        grad_names = [n for n in self._symbol.list_arguments()
+                      if self._grad_req.get(n, "null") != "null"]
+        if is_train and grad_names:
+            others = {k: v for k, v in values.items() if k not in grad_names}
+
+            def fn(grad_values):
+                outs, aux_updates = run({**others, **grad_values})
+                return outs, aux_updates
+            grad_values = {n: values[n] for n in grad_names}
+            outs, vjp_fn, aux_updates = jax.vjp(fn, grad_values,
+                                                has_aux=True)
+            self._vjp = (vjp_fn, grad_names)
+        else:
+            outs, aux_updates = run(values)
+            self._vjp = None
+        for name, val in aux_updates.items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._rebind(val)
+        self.outputs = [nd.NDArray(o, ctx=self._ctx, _skip_device_put=True)
+                        for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """ref: Executor::Backward — accumulate per grad_req."""
+        from .. import ndarray as nd
+        import jax.numpy as jnp
+        if self._vjp is None:
+            raise MXNetError("backward() requires forward(is_train=True)")
+        vjp_fn, grad_names = self._vjp
+        if out_grads is None:
+            cts = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cts = [g._data if isinstance(g, nd.NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        grads = vjp_fn(cts)[0]
+        for name in grad_names:
+            req = self._grad_req.get(name, "write")
+            if name not in self.grad_dict:
+                self.grad_dict[name] = nd.zeros(self.arg_dict[name].shape,
+                                                ctx=self._ctx)
+            g = grads[name]
+            if req == "add":
+                self.grad_dict[name]._rebind(self.grad_dict[name]._data + g)
+            else:
+                self.grad_dict[name]._rebind(g)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """ref: Executor::CopyParamsFrom."""
+        from .. import ndarray as nd
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._rebind(nd.array(v)._data)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown argument {k!r}")
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._rebind(nd.array(v)._data)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {k!r}")

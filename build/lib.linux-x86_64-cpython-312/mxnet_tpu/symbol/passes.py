@@ -1,0 +1,238 @@
+"""Graph passes — the NNVM pass machinery + subgraph-hook analog
+(ref: nnvm::ApplyPass / src/operator/subgraph/ SubgraphProperty,
+env MXNET_SUBGRAPH_BACKEND; SURVEY §2.2 #12).
+
+XLA already does the heavy rewriting (fusion, layout, CSE *within* a
+compiled program); these passes operate on the Symbol DAG *before* bind,
+where graph-level decisions live — dedup of repeated subgraphs across the
+Python-built DAG, pattern substitutions toward custom kernels, etc.
+Custom backends register passes and are selected with
+``MXNET_SUBGRAPH_BACKEND=<name>[,<name>…]`` exactly like the reference's
+subgraph-backend hook.
+"""
+from __future__ import annotations
+
+import warnings
+
+from ..base import MXNetError, getenv
+from ..ops import registry as _registry
+from .symbol import Symbol, _Node
+
+__all__ = ["register_pass", "apply_pass", "apply_env_passes", "list_passes"]
+
+_PASSES = {}
+
+
+def register_pass(name):
+    """Decorator: register ``fn(Symbol) -> Symbol`` as a named pass."""
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+    return deco
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+def apply_pass(sym: Symbol, name: str) -> Symbol:
+    """ref: nnvm::ApplyPass."""
+    if name not in _PASSES:
+        raise MXNetError(f"unknown graph pass {name!r}; "
+                         f"known: {list_passes()}")
+    return _PASSES[name](sym)
+
+
+def apply_env_passes(sym: Symbol) -> Symbol:
+    """Apply the passes selected by MXNET_SUBGRAPH_BACKEND (comma list) —
+    the reference's subgraph-backend activation point (bind time)."""
+    backends = getenv("MXNET_SUBGRAPH_BACKEND", "")
+    for name in filter(None, (b.strip() for b in backends.split(","))):
+        if name in _PASSES:
+            sym = _PASSES[name](sym)
+        else:                  # lenient like the reference, but visible
+            warnings.warn(f"MXNET_SUBGRAPH_BACKEND: unknown pass {name!r} "
+                          f"ignored (known: {list_passes()})")
+    return sym
+
+
+@register_pass("CSE")
+def common_subexpression_elimination(sym: Symbol) -> Symbol:
+    """Merge structurally identical nodes (same op, same attrs, same
+    inputs) so duplicated Python-built subgraphs compile & execute once
+    (ref: nnvm pass 'CommonSubexprElim' era; XLA CSEs *within* a program,
+    this dedups at the graph level so shared work is traced once)."""
+    canon = {}      # signature -> canonical _Node
+    rebuilt = {}    # id(old node) -> new _Node
+
+    def key_of(node, new_inputs):
+        # op node signature: names intentionally excluded — structurally
+        # identical ops are the same computation regardless of name
+        attrs = tuple(sorted((k, str(v)) for k, v in node.attrs.items()))
+        ins = tuple((id(s._node), s._index) for s in new_inputs)
+        return (node.op, attrs, ins)
+
+    def _mergeable(node):
+        if node.op is None or node.op == "_group":
+            return False
+        try:
+            op = _registry.get(node.op)
+        except MXNetError:
+            return False
+        # stochastic ops draw a fresh PRNG key per node — merging them
+        # would collapse independent random draws into one shared draw
+        return not op.needs_rng
+
+    def rebuild(node):
+        if id(node) in rebuilt:
+            return rebuilt[id(node)]
+        new_inputs = [Symbol(rebuild(s._node), s._index)
+                      for s in node.inputs]
+        # variables unify by NAME (two auto-created `fc_weight` vars are
+        # one argument — binding is name-keyed); ops unify structurally
+        if node.op is None:
+            sig = ("var", node.name)
+        elif _mergeable(node):
+            sig = key_of(node, new_inputs)
+        else:
+            sig = ("unique", id(node))
+        if sig in canon:
+            new = canon[sig]
+        else:
+            new = _Node(node.op, node.name, new_inputs, dict(node.attrs),
+                        num_outputs=node.num_outputs)
+            canon[sig] = new
+        rebuilt[id(node)] = new
+        return new
+
+    return Symbol(rebuild(sym._node), sym._index)
+
+
+@register_pass("FuseAttention")
+def fuse_attention(sym: Symbol) -> Symbol:
+    """Rewrite full-attention subgraphs to the fused flash-attention op at
+    bind time — the stated purpose of keeping the subgraph hook (SURVEY §2
+    #12: 'keep a pass hook for Pallas-fused attention'). Two patterns:
+
+    1. ``batch_dot(softmax(batch_dot(q, k, transpose_b=True) [*/ scale],
+       axis=-1), v)`` -> ``_contrib_flash_attention(q, k, v,
+       sm_scale=scale)`` — the graph's explicit scale (1.0 when it had
+       none) passes through sm_scale verbatim, overriding the op's
+       d^-0.5 default, so the rewrite is exact for any scale.
+    2. The reference's fused transformer pair
+       ``_contrib_interleaved_matmul_selfatt_valatt(qkv,
+       softmax(_contrib_interleaved_matmul_selfatt_qk(qkv, heads)))``
+       -> reshape/transpose + flash + inverse reshape (one compiled
+       attention kernel instead of two matmuls with a materialized
+       [B*H, S, S] score tensor).
+
+    Activate with ``MXNET_SUBGRAPH_BACKEND=FuseAttention`` like the
+    reference's subgraph backends.
+    """
+    from .symbol import _create
+
+    rebuilt = {}
+
+    def is_softmax_lastdim(node):
+        # a temperature or length attr changes the math / applies masking:
+        # those softmaxes must NOT be rewritten away
+        return node.op in ("softmax", "Softmax") and \
+            int(node.attrs.get("axis", -1)) in (-1,) and \
+            not node.attrs.get("temperature") and \
+            node.attrs.get("length") is None
+
+    def match_pattern1(node):
+        """outer batch_dot(att, v): returns (q, k, v, scale) or None."""
+        if node.op != "batch_dot" or node.attrs.get("transpose_a") or \
+                node.attrs.get("transpose_b"):
+            return None
+        att, v = node.inputs
+        an = att._node
+        if not is_softmax_lastdim(an):
+            return None
+        scores = an.inputs[0]._node
+        scale = 1.0
+        if scores.op == "_mul_scalar":
+            scale = float(scores.attrs.get("scalar", 1.0))
+            scores = scores.inputs[0]._node
+        elif scores.op == "_div_scalar":
+            scale = 1.0 / float(scores.attrs.get("scalar", 1.0))
+            scores = scores.inputs[0]._node
+        if scores.op != "batch_dot" or scores.attrs.get("transpose_a") \
+                or not scores.attrs.get("transpose_b"):
+            return None
+        q, k = scores.inputs
+        return q, k, v, scale
+
+    def match_pattern2(node):
+        """valatt(qkv, softmax(qk(qkv))): returns (qkv, heads) or None."""
+        if node.op != "_contrib_interleaved_matmul_selfatt_valatt":
+            return None
+        qkv, att = node.inputs
+        an = att._node
+        if not is_softmax_lastdim(an):
+            return None
+        qk = an.inputs[0]._node
+        if qk.op != "_contrib_interleaved_matmul_selfatt_qk":
+            return None
+        if qk.inputs[0]._node is not qkv._node:
+            return None
+        return qkv, int(qk.attrs["heads"])
+
+    def rebuild(node):
+        if id(node) in rebuilt:
+            return rebuilt[id(node)]
+        m1 = match_pattern1(node) if node.op else None
+        m2 = match_pattern2(node) if node.op else None
+        if m1 is not None:
+            q, k, v, scale = m1
+            qn = Symbol(rebuild(q._node), q._index)
+            kn = Symbol(rebuild(k._node), k._index)
+            vn = Symbol(rebuild(v._node), v._index)
+            # the graph's explicit scale (or 1.0 when it had none) passes
+            # through sm_scale verbatim — exact rewrite, no shape needed
+            new = _create("_contrib_flash_attention", [qn, kn, vn],
+                          {"sm_scale": scale}, name=node.name + "_flash")
+            rebuilt[id(node)] = new._node
+            return new._node
+        if m2 is not None:
+            qkv, heads = m2
+            qkvn = Symbol(rebuild(qkv._node), qkv._index)
+            h = heads
+            # interleaved layout: (T, N, 3E) decomposes per head as
+            # (T, N, H, 3, D) — see _interleaved_qk's reshape. Slice
+            # q/k/v on the '3' axis, go to (N, H, T, D) for flash, and
+            # invert afterwards.
+            r1 = _create("reshape", [qkvn], {"shape": (0, 0, -4, h, -1)},
+                         name=node.name + "_qh")       # (T, N, H, 3D)
+            r2 = _create("reshape", [r1],
+                         {"shape": (0, 0, 0, -4, 3, -1)},
+                         name=node.name + "_q3")       # (T, N, H, 3, D)
+            outs = []
+            for i, nm in enumerate(("q", "k", "v")):
+                sl = _create("slice_axis", [r2],
+                             {"axis": 3, "begin": i, "end": i + 1},
+                             name=f"{node.name}_{nm}sl")  # (T,N,H,1,D)
+                sq = _create("reshape", [sl], {"shape": (0, 0, 0, -1)},
+                             name=f"{node.name}_{nm}sq")  # (T, N, H, D)
+                tr = _create("transpose", [sq],
+                             {"axes": (1, 2, 0, 3)},
+                             name=f"{node.name}_{nm}t")   # (N, H, T, D)
+                outs.append(tr)
+            fa = _create("_contrib_flash_attention", outs, {},
+                         name=node.name + "_flash")
+            # (N, H, T, D) -> (T, N, E)
+            back = _create("transpose", [fa], {"axes": (2, 0, 1, 3)},
+                           name=node.name + "_bt")
+            out = _create("reshape", [back], {"shape": (0, 0, -3)},
+                          name=node.name + "_merge")
+            rebuilt[id(node)] = out._node
+            return out._node
+        new_inputs = [Symbol(rebuild(s._node), s._index)
+                      for s in node.inputs]
+        new = _Node(node.op, node.name, new_inputs, dict(node.attrs),
+                    num_outputs=node.num_outputs)
+        rebuilt[id(node)] = new
+        return new
+
+    return Symbol(rebuild(sym._node), sym._index)

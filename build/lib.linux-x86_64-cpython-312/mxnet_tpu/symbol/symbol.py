@@ -1,0 +1,665 @@
+"""Symbol — the lazy graph-composition API (TF1-style world).
+
+TPU-native re-design of the reference's symbolic layer
+(ref: python/mxnet/symbol/symbol.py Symbol; nnvm::Symbol/Graph under
+src/c_api/c_api_symbolic.cc). Design:
+
+- a Symbol is an output slot of a small immutable node (op name, input
+  symbols, hyperparameters) — the same DAG the reference builds via NNVM;
+- executing/binding compiles the DAG into ONE jitted XLA program (the
+  GraphExecutor's memory planning, op fusion and scheduling are XLA's job —
+  SURVEY §2.2 #11 translation row);
+- ``infer_shape``/``infer_type`` run ``jax.eval_shape`` over the traced
+  program: no per-op inference rules, yet partial inference works because
+  tracing is abstract (no FLOPs run);
+- auto-created parameter variables follow the reference's naming exactly
+  (``fullyconnected0_weight`` …) so `list_arguments` orders match and
+  checkpoints interoperate.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import jax
+import numpy as np
+
+from .. import _rng
+from ..base import MXNetError, _as_np_dtype
+from ..context import current_context
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones", "arange"]
+
+# input-slot names + aux split per layer op (the reference records these in
+# each op's FListInputNames/FMutateInputs; ref: src/operator/nn/*.cc)
+_OP_INPUTS = {
+    "FullyConnected": (["data", "weight", "bias"], 0),
+    "Convolution": (["data", "weight", "bias"], 0),
+    "Deconvolution": (["data", "weight", "bias"], 0),
+    "BatchNorm": (["data", "gamma", "beta", "moving_mean", "moving_var"], 2),
+    "LayerNorm": (["data", "gamma", "beta"], 0),
+    "GroupNorm": (["data", "gamma", "beta"], 0),
+    "InstanceNorm": (["data", "gamma", "beta"], 0),
+    "Embedding": (["data", "weight"], 0),
+    "_contrib_DeformableConvolution": (
+        ["data", "offset", "weight", "bias"], 0),
+    "_contrib_ModulatedDeformableConvolution": (
+        ["data", "offset", "mask", "weight", "bias"], 0),
+    "RNN": (["data", "parameters", "state", "state_cell"], 0),
+    "LeakyReLU": (["data", "gamma"], 0),
+    "SoftmaxOutput": (["data", "label"], 0),
+    "LinearRegressionOutput": (["data", "label"], 0),
+    "MAERegressionOutput": (["data", "label"], 0),
+    "LogisticRegressionOutput": (["data", "label"], 0),
+}
+# params that suppress trailing inputs (no_bias ⇒ drop bias)
+_SUPPRESS = {"no_bias": "bias"}
+
+
+def _infer_param_shapes(opname, attrs, data_shape):
+    """Backward shape rules: parameter shapes implied by the data shape —
+    what each reference op's InferShape does (ref: src/operator/nn/
+    fully_connected.cc FullyConnectedShape, convolution.cc ConvolutionShape,
+    batch_norm.cc BatchNormShape, rnn.cc RNNShape, …)."""
+    out = {}
+    if data_shape is None:
+        return out
+    d = tuple(data_shape)
+    if opname == "FullyConnected":
+        flatten = attrs.get("flatten", True)
+        in_dim = int(np.prod(d[1:])) if flatten else d[-1]
+        out["weight"] = (attrs["num_hidden"], in_dim)
+        out["bias"] = (attrs["num_hidden"],)
+    elif opname == "Convolution":
+        kernel = tuple(attrs["kernel"])
+        ng = attrs.get("num_group", 1) or 1
+        out["weight"] = (attrs["num_filter"], d[1] // ng) + kernel
+        out["bias"] = (attrs["num_filter"],)
+    elif opname == "Deconvolution":
+        kernel = tuple(attrs["kernel"])
+        ng = attrs.get("num_group", 1) or 1
+        out["weight"] = (d[1], attrs["num_filter"] // ng) + kernel
+        out["bias"] = (attrs["num_filter"],)
+    elif opname == "BatchNorm":
+        c = d[attrs.get("axis", 1)]
+        for s in ("gamma", "beta", "moving_mean", "moving_var"):
+            out[s] = (c,)
+    elif opname == "LayerNorm":
+        c = d[attrs.get("axis", -1)]
+        out["gamma"] = (c,)
+        out["beta"] = (c,)
+    elif opname in ("GroupNorm", "InstanceNorm"):
+        out["gamma"] = (d[1],)
+        out["beta"] = (d[1],)
+    elif opname == "Embedding":
+        out["weight"] = (attrs["input_dim"], attrs["output_dim"])
+    elif opname == "SoftmaxOutput":
+        if attrs.get("multi_output"):
+            out["label"] = (d[0],) + d[2:]
+        else:
+            out["label"] = (d[0],)
+    elif opname.endswith("RegressionOutput"):
+        out["label"] = d
+    elif opname == "LeakyReLU" and attrs.get("act_type") == "prelu":
+        out["gamma"] = (d[1],)
+    elif opname == "RNN":
+        h = attrs["state_size"]
+        nl = attrs["num_layers"]
+        ndir = 2 if attrs.get("bidirectional") else 1
+        g = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[
+            attrs.get("mode", "lstm")]
+        size = 0
+        for layer in range(nl):
+            in_sz = d[-1] if layer == 0 else h * ndir
+            size += ndir * (g * h * in_sz + g * h * h + 2 * g * h)
+        out["parameters"] = (size,)
+        out["state"] = (nl * ndir, d[1], h)
+        out["state_cell"] = (nl * ndir, d[1], h)
+    return out
+
+_name_lock = threading.Lock()
+
+
+class _NameManager:
+    _counts = {}
+
+    @classmethod
+    def next_name(cls, hint):
+        with _name_lock:
+            idx = cls._counts.get(hint, 0)
+            cls._counts[hint] = idx + 1
+        return f"{hint}{idx}"
+
+
+class _Node:
+    __slots__ = ("op", "name", "inputs", "attrs", "num_outputs")
+
+    def __init__(self, op, name, inputs, attrs, num_outputs=1):
+        self.op = op              # None for variables
+        self.name = name
+        self.inputs = inputs      # list[Symbol]
+        self.attrs = attrs        # coerced op params
+        self.num_outputs = num_outputs
+
+
+class Symbol:
+    """One output of a graph node (ref: symbol.py Symbol)."""
+
+    def __init__(self, node, index=0):
+        self._node = node
+        self._index = index
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def name(self):
+        n = self._node
+        if n.num_outputs > 1 and n.op is not None:
+            return f"{n.name}_output{self._index}"
+        return n.name
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    def attr(self, key):
+        return self._node.attrs.get(key)
+
+    def list_attr(self):
+        return {k: str(v) for k, v in self._node.attrs.items()}
+
+    # -- graph walks ---------------------------------------------------------
+    def _topo(self):
+        """Topological order of nodes reachable from this output."""
+        seen = {}
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for s in node.inputs:
+                visit(s._node)
+            order.append(node)
+        visit(self._node)
+        return order
+
+    def list_arguments(self):
+        """Variable names in topo order, aux excluded (ref: list_arguments)."""
+        args = []
+        aux = set(self.list_auxiliary_states())
+        for node in self._topo():
+            if node.op is None and node.name not in aux:
+                args.append(node.name)
+        return args
+
+    def list_auxiliary_states(self):
+        """ref: list_auxiliary_states — inputs mutated by the op (BatchNorm
+        running stats), recognized by input-slot position."""
+        aux = []
+        for node in self._topo():
+            if node.op is None:
+                continue
+            names, n_aux = _OP_INPUTS.get(node.op, (None, 0))
+            if n_aux:
+                for s in node.inputs[len(names) - n_aux:]:
+                    if s._node.op is None and s._node.name not in aux:
+                        aux.append(s._node.name)
+        return aux
+
+    def _output_name(self):
+        n = self._node
+        if n.op is None:
+            return n.name
+        if n.num_outputs > 1:
+            return f"{n.name}_output{self._index}"
+        return f"{n.name}_output"
+
+    def list_outputs(self):
+        n = self._node
+        if n.op == "_group":
+            return [s._output_name() for s in n.inputs]
+        return [Symbol(n, i)._output_name() for i in range(n.num_outputs)] \
+            if n.op is not None else [n.name]
+
+    def get_internals(self):
+        """ref: Symbol.get_internals — every node output as a Group."""
+        outs = []
+        for node in self._topo():
+            for i in range(node.num_outputs):
+                outs.append(Symbol(node, i))
+        return Group(outs)
+
+    def __iter__(self):
+        """Iterate over this node's outputs (lets ``a, b = F.split(...)``
+        style unpacking work identically to the nd namespace)."""
+        if self._node.op == "_group":
+            return iter(self._node.inputs)
+        return (Symbol(self._node, i)
+                for i in range(self._node.num_outputs))
+
+    def __len__(self):
+        if self._node.op == "_group":
+            return len(self._node.inputs)
+        return self._node.num_outputs
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            for i, name in enumerate(self.list_outputs()):
+                if name == index:
+                    index = i
+                    break
+            else:
+                raise MXNetError(f"no output named {index!r}")
+        if self._node.op == "_group":
+            return self._node.inputs[index]
+        return Symbol(self._node, index)
+
+    # -- evaluation ----------------------------------------------------------
+    def _output_symbols(self):
+        if self._node.op == "_group":
+            return list(self._node.inputs)
+        return [self]
+
+    def _make_eval_fn(self, training=False):
+        """Compile the DAG into fn(var_dict) -> (outputs, aux_updates)."""
+        out_syms = self._output_symbols()
+
+        def run(values):
+            cache = {}
+            aux_updates = {}
+
+            def compute(node):
+                if id(node) in cache:
+                    return cache[id(node)]
+                if node.op is None:
+                    try:
+                        res = [values[node.name]]
+                    except KeyError:
+                        raise MXNetError(
+                            f"symbol variable {node.name!r} was not bound")
+                elif node.op == "_group":
+                    res = [compute(s._node)[s._index] for s in node.inputs]
+                else:
+                    op = _registry.get(node.op)
+                    arrays = [compute(s._node)[s._index]
+                              for s in node.inputs]
+                    kwargs = {k: v for k, v in node.attrs.items()
+                              if not k.startswith("__")}
+                    if op.needs_rng:
+                        kwargs["rng"] = _rng.next_key()
+                    if op.needs_mode:
+                        kwargs["training"] = training
+                    out = op.fn(*arrays, **kwargs)
+                    res = list(out) if isinstance(out, tuple) else [out]
+                    # BatchNorm running-stat EMA: outputs 1/2 are the batch
+                    # mean/var; in training they update the moving_* aux
+                    # vars (ref: src/operator/nn/batch_norm.cc Forward)
+                    if node.op == "BatchNorm" and training and \
+                            not node.attrs.get("use_global_stats"):
+                        mom = node.attrs.get("momentum", 0.9)
+                        for s, stat in ((node.inputs[3], res[1]),
+                                        (node.inputs[4], res[2])):
+                            if s._node.op is None:
+                                old = values[s._node.name]
+                                aux_updates[s._node.name] = \
+                                    mom * old + (1 - mom) * stat
+                cache[id(node)] = res
+                return res
+            outs = [compute(s._node)[s._index] for s in out_syms]
+            return outs, aux_updates
+        return run
+
+    def eval(self, ctx=None, **kwargs):
+        """ref: Symbol.eval — eager evaluation with named inputs."""
+        from .. import ndarray as nd
+        values = {k: (v._data if isinstance(v, nd.NDArray)
+                      else np.asarray(v)) for k, v in kwargs.items()}
+        outs, _ = self._make_eval_fn(training=False)(values)
+        return [nd.NDArray(o, _skip_device_put=True) for o in outs]
+
+    # -- inference -----------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """ref: Symbol.infer_shape → (arg_shapes, out_shapes, aux_shapes).
+        Unknown arguments are inferred where possible by abstract tracing
+        with placeholder dims; None for those that cannot be."""
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items()
+                      if v is not None})
+        # partial inference: walk the graph propagating shapes via
+        # jax.eval_shape node by node
+        shapes = dict(known)
+        dtypes = {}
+
+        def node_shape(node):
+            if node.op is None:
+                if node.name in shapes:
+                    return [jax.ShapeDtypeStruct(shapes[node.name],
+                                                 np.float32)]
+                # try declared shape attr (var(shape=...))
+                shp = node.attrs.get("__shape__")
+                if shp:
+                    shapes[node.name] = tuple(shp)
+                    return [jax.ShapeDtypeStruct(tuple(shp), np.float32)]
+                return None
+            if node.op == "_group":
+                rs = [cached_node_shape(s._node) for s in node.inputs]
+                if any(r is None for r in rs):
+                    return None
+                return [r[s._index] for r, s in zip(rs, node.inputs)]
+            # backward parameter-shape rules: data shape ⇒ weight shapes
+            if node.inputs:
+                data_r = cached_node_shape(node.inputs[0]._node)
+                data_shape = tuple(data_r[node.inputs[0]._index].shape) \
+                    if data_r is not None else None
+                rules = _infer_param_shapes(node.op, node.attrs, data_shape)
+                names, _ = _OP_INPUTS.get(node.op, (None, 0))
+                if rules and names:
+                    for slot, s in zip(names, node.inputs):
+                        if s._node.op is None and \
+                                s._node.name not in shapes and \
+                                slot in rules:
+                            shapes[s._node.name] = rules[slot]
+            in_shapes = []
+            for s in node.inputs:
+                r = cached_node_shape(s._node)
+                if r is None:
+                    return None
+                in_shapes.append(r[s._index])
+            op = _registry.get(node.op)
+            kwargs2 = {k: v for k, v in node.attrs.items()
+                       if not k.startswith("__")}
+            if op.needs_rng:
+                kwargs2["rng"] = jax.ShapeDtypeStruct((2,), np.uint32)
+            if op.needs_mode:
+                kwargs2["training"] = False
+
+            def fn(*arrs):
+                kk = dict(kwargs2)
+                if op.needs_rng:
+                    kk["rng"] = jax.random.PRNGKey(0)
+                out = op.fn(*arrs, **kk)
+                return out
+            try:
+                out = jax.eval_shape(fn, *in_shapes)
+            except Exception:
+                return None
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            return outs
+
+        memo = {}
+
+        def cached_node_shape(node):
+            if node.op is None:     # vars re-read `shapes` (rules fill it)
+                return node_shape(node)
+            if id(node) not in memo:
+                memo[id(node)] = node_shape(node)
+            return memo[id(node)]
+
+        # layer-op parameter inference (deferred shapes): walk nodes; when a
+        # layer op's data shape is known but its weights are variables with
+        # unknown shape, try candidate shapes via the op's shape rule — the
+        # reference does this in each op's InferShape. Here we instead derive
+        # them from the op registry's eval when possible; if not, leave None.
+        out_shapes = []
+        res = cached_node_shape(self._node)
+        if res is not None:
+            if self._node.op == "_group":
+                out_shapes = [tuple(r.shape) for r in res]
+            else:
+                out_shapes = [tuple(res[s._index].shape)
+                              for s in self._output_symbols()]
+        else:
+            out_shapes = None
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        aux_shapes = [shapes.get(n) for n in aux_names]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        return ([np.float32] * len(arg_names), [np.float32],
+                [np.float32] * len(self.list_auxiliary_states()))
+
+    # -- serialization (ref: Symbol.tojson / save) ---------------------------
+    def tojson(self):
+        nodes = []
+        index = {}
+        topo = self._topo()
+        for node in topo:
+            index[id(node)] = len(nodes)
+            entry = {
+                "op": "null" if node.op is None else node.op,
+                "name": node.name,
+                "inputs": [[index[id(s._node)], s._index, 0]
+                           for s in node.inputs],
+            }
+            attrs = {k: str(v) for k, v in node.attrs.items()
+                     if v is not None}
+            if attrs:
+                entry["attrs"] = attrs
+            nodes.append(entry)
+        arg_nodes = [i for i, n in enumerate(topo) if n.op is None]
+        heads = [[index[id(s._node)], s._index, 0]
+                 for s in self._output_symbols()]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10700]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding (ref: simple_bind/bind → GraphExecutor) ---------------------
+    def simple_bind(self, ctx=None, grad_req="write", **kwargs):
+        from .executor import Executor
+        from .. import ndarray as nd
+        ctx = ctx or current_context()
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if shape is None:
+                raise MXNetError(f"simple_bind: could not infer shape of "
+                                 f"{name!r}; pass it explicitly")
+            args[name] = nd.zeros(shape, ctx=ctx)
+        aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if shape is None:
+                raise MXNetError(f"simple_bind: could not infer shape of "
+                                 f"aux {name!r}")
+            aux[name] = nd.zeros(shape, ctx=ctx)
+        return Executor(self, ctx, args, grad_req=grad_req, aux_states=aux)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, shared_exec=None):
+        from .executor import Executor
+        arg_names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(self.list_auxiliary_states(), aux_states))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        return Executor(self, ctx, args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states or {})
+
+    # -- operators -----------------------------------------------------------
+    def _binop(self, other, opname, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _create(opname, [a, b], {})
+        scalar_op = {"elemwise_add": "_plus_scalar",
+                     "elemwise_sub": "_rminus_scalar" if reverse
+                     else "_minus_scalar",
+                     "elemwise_mul": "_mul_scalar",
+                     "elemwise_div": "_rdiv_scalar" if reverse
+                     else "_div_scalar",
+                     "_power": "_rpower_scalar" if reverse
+                     else "_power_scalar"}[opname]
+        return _create(scalar_op, [self], {"scalar": float(other)})
+
+    def __add__(self, other):
+        return self._binop(other, "elemwise_add")
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "elemwise_sub")
+
+    def __rsub__(self, other):
+        return self._binop(other, "elemwise_sub", reverse=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "elemwise_mul")
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "elemwise_div")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "elemwise_div", reverse=True)
+
+    def __pow__(self, other):
+        return self._binop(other, "_power")
+
+    def __neg__(self):
+        return self._binop(-1.0, "elemwise_mul")
+
+
+def _auto_var(name, attrs=None):
+    return Symbol(_Node(None, name, [], attrs or {}))
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """ref: symbol.py var/Variable."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if dtype is not None:
+        attrs["__dtype__"] = _as_np_dtype(dtype)
+    if init is not None:
+        attrs["__init__"] = init
+    return _auto_var(name, attrs)
+
+
+Variable = var
+
+
+def Group(symbols):
+    """ref: symbol.py Group — multi-output symbol."""
+    symbols = list(symbols)
+    if not symbols:
+        raise MXNetError("Group needs at least one symbol")
+    node = _Node("_group", _NameManager.next_name("group"), symbols, {},
+                 num_outputs=len(symbols))
+    return Symbol(node)
+
+
+def _num_outputs_of(op, attrs):
+    n = op.num_outputs
+    return n(attrs) if callable(n) else n
+
+
+def _create(opname, input_syms, kwargs, name=None):
+    """Create an op node (the generated mx.sym.<op> wrappers call this)."""
+    from .. import attribute as _attr_mod
+    from .. import name as _name_mod
+    op = _registry.get(opname)
+    attrs = op.coerce_params(kwargs)
+    hint = opname.lower().lstrip("_")
+    scoped = _name_mod.current()
+    if name is None and type(scoped) is not _name_mod.NameManager:
+        name = scoped.get(None, hint)       # Prefix or custom manager
+    name = name or _NameManager.next_name(hint)
+    # scoped attrs (ctx_group & friends, ref: AttrScope.get)
+    scope_attrs = _attr_mod.current().get()
+    for k, v in scope_attrs.items():
+        attrs.setdefault(f"__{k}__" if not k.startswith("__") else k, v)
+    # auto-create missing parameter variables with reference naming
+    names, n_aux = _OP_INPUTS.get(opname, (None, 0))
+    if names is not None:
+        syms = list(input_syms)
+        want = list(names)
+        for pkey, drop in _SUPPRESS.items():
+            if attrs.get(pkey) and drop in want:
+                want.remove(drop)
+        if opname == "RNN" and attrs.get("mode") != "lstm" and \
+                "state_cell" in want:
+            want.remove("state_cell")
+        if opname == "LeakyReLU" and "gamma" in want and \
+                str(attrs.get("act_type", "leaky")) != "prelu":
+            want.remove("gamma")    # only prelu carries a learned slope
+        while len(syms) < len(want):
+            syms.append(_auto_var(f"{name}_{want[len(syms)]}"))
+        input_syms = syms
+    n_out = _num_outputs_of(op, attrs)
+    # declared outputs only; aux-update extras are consumed by the executor
+    node = _Node(opname, name, list(input_syms), attrs, num_outputs=n_out)
+    return Symbol(node)
+
+
+# -- creation helpers mirroring mx.sym namespace -----------------------------
+def zeros(shape, dtype=None, **kwargs):
+    return _create("_zeros", [], {"shape": shape, "dtype": dtype or "float32"})
+
+
+def ones(shape, dtype=None, **kwargs):
+    return _create("_ones", [], {"shape": shape, "dtype": dtype or "float32"})
+
+
+def arange(start, stop=None, step=1.0, **kwargs):
+    return _create("_arange", [], {"start": start, "stop": stop,
+                                   "step": step})
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from the serialized graph (ref: sym.load_json)."""
+    graph = json.loads(json_str)
+    nodes = graph["nodes"]
+    built = []
+    for entry in nodes:
+        inputs = [Symbol(built[i], oi) for i, oi, _ in entry.get("inputs", [])]
+        if entry["op"] == "null":
+            attrs = entry.get("attrs", {})
+            parsed = {}
+            for k, v in attrs.items():
+                if k == "__shape__":
+                    import ast
+                    parsed[k] = tuple(ast.literal_eval(v))
+                else:
+                    parsed[k] = v
+            node = _Node(None, entry["name"], [], parsed)
+        elif entry["op"] == "_group":
+            node = _Node("_group", entry["name"], inputs, {},
+                         num_outputs=len(inputs))
+        else:
+            op = _registry.get(entry["op"])
+            raw = entry.get("attrs", {})
+            extra = {k: v for k, v in raw.items() if k.startswith("__")}
+            attrs = op.coerce_params({k: v for k, v in raw.items()
+                                      if not k.startswith("__")})
+            attrs.update(extra)
+            node = _Node(entry["op"], entry["name"], inputs, attrs,
+                         num_outputs=_num_outputs_of(op, attrs))
+        built.append(node)
+    heads = graph["heads"]
+    if len(heads) == 1:
+        return Symbol(built[heads[0][0]], heads[0][1])
+    return Group([Symbol(built[i], oi) for i, oi, _ in heads])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

@@ -1,0 +1,4 @@
+"""Command-line tools (ref: tools/ — im2rec, launch, parse_log), installed
+as console scripts (mx-im2rec / mx-launch / mx-parse-log) by the package
+metadata; thin wrappers in the repo-root tools/ keep the reference's
+`python tools/launch.py ...` invocation working."""

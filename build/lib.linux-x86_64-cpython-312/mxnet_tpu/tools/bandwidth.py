@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""kvstore bandwidth measurement (ref: tools/bandwidth/measure.py —
+the reference's kvstore perf tool). Measures push/pull/pushpull rates for
+a ladder of tensor sizes on the selected kvstore type.
+
+Usage: mx-bandwidth [--kv-type device] [--sizes 1e5 1e6 1e7] [--iters 10]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="kvstore push/pull bandwidth "
+                    "(ref: tools/bandwidth/measure.py)")
+    parser.add_argument("--kv-type", default="device")
+    parser.add_argument("--sizes", type=float, nargs="+",
+                        default=[1e5, 1e6, 1e7])
+    parser.add_argument("--iters", type=int, default=10)
+    args = parser.parse_args()
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create(args.kv_type)
+    print(f"kvstore type={kv.type} workers={kv.num_workers}")
+    print(f"{'size':>12} {'push GB/s':>10} {'pull GB/s':>10} "
+          f"{'pushpull GB/s':>14}")
+    for size in args.sizes:
+        n = int(size)
+        key = f"bw{n}"
+        val = nd.array(np.random.randn(n).astype(np.float32))
+        out = nd.zeros((n,))
+        kv.init(key, val)
+        nbytes = n * 4
+
+        def timed(fn):
+            fn()                         # warm
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                fn()
+                out.wait_to_read()       # block on THIS iteration's work
+            return nbytes * args.iters / (time.perf_counter() - t0) / 1e9
+
+        def push_synced():
+            kv.push(key, val)
+            kv._store[key].wait_to_read()   # block on the reduce itself
+                                            # (no pull bytes credited)
+
+        push = timed(push_synced)
+        pull = timed(lambda: kv.pull(key, out=out))
+        pushpull = timed(lambda: kv.pushpull(key, val, out=out))
+        print(f"{n:>12d} {push:>10.2f} {pull:>10.2f} {pushpull:>14.2f}")
+
+
+if __name__ == "__main__":
+    main()

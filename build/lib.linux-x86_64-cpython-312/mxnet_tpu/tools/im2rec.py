@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""im2rec — pack an image dataset into RecordIO (ref: tools/im2rec.py).
+
+Two modes, same CLI shape as the reference:
+  --list: generate a .lst file (index \\t label \\t relpath) from a folder
+  default: pack images named by a .lst into prefix.rec (+ .idx)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+
+def list_image(root, recursive, exts):
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            line = [i.strip() for i in line.strip().split("\t")]
+            if len(line) < 3:
+                continue
+            yield (int(line[0]), line[-1], [float(i) for i in line[1:-1]])
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    chunk_size = (n + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        suffix = f"_{i}" if args.chunks > 1 else ""
+        sep = int(len(chunk) * args.train_ratio)
+        sep_test = int(len(chunk) * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + suffix + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + suffix + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + suffix + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + suffix + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def image_encode(args, item):
+    from mxnet_tpu import recordio
+
+    fullpath = os.path.join(args.root, item[1])
+    header = recordio.IRHeader(0, item[2] if len(item[2]) > 1
+                               else item[2][0], item[0], 0)
+    if args.pass_through:
+        # raw pack never decodes: keep cv2 optional for this mode
+        with open(fullpath, "rb") as fin:
+            img = fin.read()
+        return recordio.pack(header, img)
+    import cv2
+    img = cv2.imread(fullpath, args.color)
+    if img is None:
+        print(f"imread error: {fullpath}", file=sys.stderr)
+        return None
+    if args.center_crop:
+        if img.shape[0] > img.shape[1]:
+            margin = (img.shape[0] - img.shape[1]) // 2
+            img = img[margin:margin + img.shape[1], :]
+        else:
+            margin = (img.shape[1] - img.shape[0]) // 2
+            img = img[:, margin:margin + img.shape[0]]
+    if args.resize:
+        if img.shape[0] > img.shape[1]:
+            newsize = (args.resize,
+                       img.shape[0] * args.resize // img.shape[1])
+        else:
+            newsize = (img.shape[1] * args.resize // img.shape[0],
+                       args.resize)
+        img = cv2.resize(img, newsize)
+    return recordio.pack_img(header, img, quality=args.quality,
+                             img_fmt=args.encoding)
+
+
+def make_rec(args):
+    from mxnet_tpu import recordio
+    fname = os.path.basename(args.prefix)
+    working_dir = os.path.dirname(os.path.abspath(args.prefix)) or "."
+    for lst_name in sorted(os.listdir(working_dir)):
+        if not (lst_name.startswith(fname) and lst_name.endswith(".lst")):
+            continue
+        lst_path = os.path.join(working_dir, lst_name)
+        base = os.path.splitext(lst_path)[0]
+        record = recordio.MXIndexedRecordIO(base + ".idx", base + ".rec",
+                                            "w")
+        count = 0
+        for item in read_list(lst_path):
+            packed = image_encode(args, item)
+            if packed is None:
+                continue
+            record.write_idx(item[0], packed)
+            count += 1
+            if count % 1000 == 0:
+                print(f"{lst_name}: packed {count} images")
+        record.close()
+        print(f"{base}.rec: {count} images")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO pack "
+                    "(ref: tools/im2rec.py)")
+    parser.add_argument("prefix", help="prefix of .lst/.rec files")
+    parser.add_argument("root", help="root folder of images")
+    cgroup = parser.add_argument_group("list options")
+    cgroup.add_argument("--list", action="store_true")
+    cgroup.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", action="store_true")
+    cgroup.add_argument("--no-shuffle", dest="shuffle",
+                        action="store_false")
+    rgroup = parser.add_argument_group("rec options")
+    rgroup.add_argument("--pass-through", action="store_true")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", action="store_true")
+    rgroup.add_argument("--quality", type=int, default=95)
+    rgroup.add_argument("--encoding", type=str, default=".jpg")
+    rgroup.add_argument("--color", type=int, default=1,
+                        choices=[-1, 0, 1])
+    args = parser.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        make_rec(args)
+
+
+if __name__ == "__main__":
+    main()

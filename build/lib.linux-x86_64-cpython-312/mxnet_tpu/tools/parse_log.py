@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (ref: tools/parse_log.py): extracts
+per-epoch train/validation metrics and Speedometer throughput."""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    rows = {}
+    speed = {}
+    re_metric = re.compile(
+        r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([\d.eE+-]+)")
+    re_speed = re.compile(
+        r"Epoch\[(\d+)\]\s+Batch\s*\[\d+\]\s+Speed:\s*([\d.]+)")
+    re_time = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.]+)")
+    for line in lines:
+        m = re_metric.search(line)
+        if m:
+            epoch, kind, name, val = m.groups()
+            rows.setdefault(int(epoch), {})[f"{kind.lower()}-{name}"] = \
+                float(val)
+        m = re_speed.search(line)
+        if m:
+            speed.setdefault(int(m.group(1)), []).append(float(m.group(2)))
+        m = re_time.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["time"] = \
+                float(m.group(2))
+    for epoch, speeds in speed.items():
+        rows.setdefault(epoch, {})["speed"] = sum(speeds) / len(speeds)
+    return rows
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("logfile", nargs="?", default="-")
+    parser.add_argument("--format", default="markdown",
+                        choices=["markdown", "csv"])
+    args = parser.parse_args()
+    lines = sys.stdin if args.logfile == "-" else open(args.logfile)
+    rows = parse(lines)
+    if not rows:
+        print("no metrics found", file=sys.stderr)
+        return
+    cols = sorted({k for r in rows.values() for k in r})
+    if args.format == "csv":
+        print("epoch," + ",".join(cols))
+        for epoch in sorted(rows):
+            print(f"{epoch}," + ",".join(
+                str(rows[epoch].get(c, "")) for c in cols))
+    else:
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("|" + "---|" * (len(cols) + 1))
+        for epoch in sorted(rows):
+            print(f"| {epoch} | " + " | ".join(
+                f"{rows[epoch][c]:.6g}" if c in rows[epoch] else ""
+                for c in cols) + " |")
+
+
+if __name__ == "__main__":
+    main()

@@ -365,11 +365,15 @@ def _slice(node, ins, out, attrs, ctx):
     begin = list(attrs.get("begin") or ())
     end = list(attrs.get("end") or ())
     step = list(attrs.get("step") or ())
-    starts = [0 if b is None else int(b) for b in begin]
-    ends = [_INT_MAX if e is None else int(e) for e in end]
-    axes = list(range(len(starts)))
     steps = [1 if (i >= len(step) or step[i] is None) else int(step[i])
-             for i in range(len(starts))]
+             for i in range(len(begin))]
+    # ONNX Slice default bounds flip for negative steps: start clamps to
+    # dim-1 via INT64_MAX, and end INT64_MIN means "through index 0"
+    starts = [(_INT_MAX if steps[i] < 0 else 0) if b is None else int(b)
+              for i, b in enumerate(begin)]
+    ends = [(-_INT_MAX - 1 if steps[i] < 0 else _INT_MAX)
+            if e is None else int(e) for i, e in enumerate(end)]
+    axes = list(range(len(starts)))
     return [{"op_type": "Slice", "name": node.name,
              "inputs": [ins[0],
                         ctx.add_initializer(

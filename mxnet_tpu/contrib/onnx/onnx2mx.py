@@ -315,8 +315,22 @@ def _gather(node, ins, attrs, ctx):
 
 @onnx2mx("LayerNormalization")
 def _layer_normalization(node, ins, attrs, ctx):
+    if len(ins) > 2:
+        beta = ins[2]
+    else:
+        # bias B is optional in ONNX: synthesize zeros shaped like scale
+        sname = node["inputs"][1]
+        if sname not in ctx.params:
+            raise MXNetError("ONNX import: no-bias LayerNormalization "
+                             "needs Scale as an initializer to size the "
+                             "zero bias")
+        bname = f"{node.get('name') or sname}_zero_bias"
+        ctx.params[bname] = np.zeros_like(np.asarray(ctx.params[sname]))
+        from ...symbol import var
+        ctx.tensors[bname] = var(bname)
+        beta = ctx.tensors[bname]
     return _sym_mod().LayerNorm(
-        ins[0], ins[1], ins[2], axis=int(attrs.get("axis", -1)),
+        ins[0], ins[1], beta, axis=int(attrs.get("axis", -1)),
         eps=float(attrs.get("epsilon", 1e-5)),
         name=node.get("name") or None)
 
@@ -371,8 +385,11 @@ def _slice(node, ins, attrs, ctx):
                                      "non-leading axes unsupported")
                 big = np.iinfo(np.int64).max
                 return _sym_mod().slice(
-                    ins[0], begin=tuple(starts),
-                    end=tuple(None if e >= big // 2 else e for e in ends),
+                    ins[0],
+                    begin=tuple(None if abs(b) >= big // 2 else b
+                                for b in starts),
+                    end=tuple(None if abs(e) >= big // 2 else e
+                              for e in ends),
                     step=tuple(steps), name=node.get("name") or None)
     else:                          # opset-1 attrs form
         starts = [int(v) for v in attrs.get("starts", [])]

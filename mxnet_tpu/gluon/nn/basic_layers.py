@@ -210,17 +210,22 @@ class BatchNorm(HybridBlock):
             new_mean = jnp.where(
                 cold, mean._data,
                 running_mean._data * m + mean._data * (1 - m))
-            # the op's var output is its bounded e2 fallback (~mean²,
-            # NOT the batch variance) on channels where the cold-start
-            # shift cancelled — recognizable as mean² >> var. Never let
-            # that poison the running stats (measured: adopting it put
-            # running_var at ~1e8 and broke eval for ~100 steps); those
-            # channels keep their previous running_var until the shift
-            # warms (step 2, since new_mean adopts the exact batch mean).
-            susp = jnp.square(mean._data) > 4096.0 * jnp.maximum(
-                var._data.astype(mean._data.dtype), 1e-30)
+            # At COLD start the op's reported batch var can be destroyed
+            # by cancellation (the zero-init shift; ops/nn.py) — and
+            # adopting it outright would poison eval for many steps. The
+            # cancellation test mean² >> var is only meaningful while the
+            # shift is the init value, so it gates the COLD adoption
+            # alone: suspicious channels keep the init var for one step
+            # (the shift warms at step 2 via new_mean, after which the
+            # op's var is sound and momentum-mixes normally — gating warm
+            # steps on this data property would freeze running_var
+            # forever for any |mean|/std > 64 channel).
+            susp_cold = jnp.logical_and(
+                cold,
+                jnp.square(mean._data) > 4096.0 * jnp.maximum(
+                    var._data.astype(mean._data.dtype), 1e-30))
             new_var = jnp.where(
-                susp, running_var._data,
+                susp_cold, running_var._data,
                 jnp.where(cold, var._data,
                           running_var._data * m + var._data * (1 - m)))
             running_mean._rebind(

@@ -375,6 +375,20 @@ def test_batch_norm_large_mean_cold_start():
     out_eval = bn(nd.array(x)).asnumpy()
     assert 0.5 < out_eval.std() < 2.0, \
         f"eval-mode normalization broken: std {out_eval.std()}"
+    # large-mean AND std != 1 (review repro): running_var must WARM to
+    # the true batch variance over steps, not freeze at its init value
+    bn2 = gluon.nn.BatchNorm(in_channels=4)
+    bn2.initialize()
+    x2 = (rng.randn(16, 4, 6, 6) * 10 + 1000).astype(np.float32)
+    for _ in range(30):
+        with autograd.record(train_mode=True):
+            bn2(nd.array(x2))
+    rv = bn2.running_var.data().asnumpy()
+    true_var = x2.var(axis=(0, 2, 3))
+    assert np.all(rv > 0.5 * true_var), (rv, true_var)
+    out_eval2 = bn2(nd.array(x2)).asnumpy()
+    assert 0.5 < out_eval2.std() < 2.0, \
+        f"eval std after warm training: {out_eval2.std()}"
     # op level: the batch-mean OUTPUT is exact even at cold start (the
     # shift cancels analytically in the mean), and var never explodes
     zeros = np.zeros(4, np.float32)
