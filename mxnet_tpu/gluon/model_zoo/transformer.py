@@ -175,28 +175,88 @@ class TransformerModel(HybridBlock):
         dec = self.decoder(self._embed(F, tgt, self.tgt_embed, pos), mem)
         return self.output(dec)
 
-    def translate(self, src, bos_id=1, eos_id=2, max_steps=None):
-        """Greedy decode (static shapes: fixed max_steps loop)."""
+    def translate(self, src, bos_id=1, eos_id=2, max_steps=None,
+                  beam_size=1, length_penalty=1.0):
+        """Greedy (``beam_size=1``) or beam-search decode (the Sockeye
+        inference mode, ref ecosystem: sockeye.beam_search). Host-driven
+        loop over eager decoder calls with static shapes per step;
+        ``length_penalty`` is the standard (5+len)^a/(5+1)^a GNMT
+        normalization exponent applied at candidate ranking."""
         from ... import ndarray as nd
         import numpy as onp
         max_steps = max_steps or min(self._max_length, 64)
         mem = self.encode(src)
         b = src.shape[0]
-        tokens = onp.full((b, 1), bos_id, dtype=onp.int32)
-        finished = onp.zeros(b, bool)
-        for _ in range(max_steps):
+        if beam_size <= 1:
+            tokens = onp.full((b, 1), bos_id, dtype=onp.int32)
+            finished = onp.zeros(b, bool)
+            for _ in range(max_steps):
+                tgt = nd.array(tokens)
+                dec = self.decoder(self._embed(nd, tgt, self.tgt_embed,
+                                               self.pos_weight.data()),
+                                   mem)
+                logits = self.output(dec)
+                nxt = logits.asnumpy()[:, -1].argmax(axis=-1)
+                nxt = onp.where(finished, eos_id, nxt)
+                tokens = onp.concatenate(
+                    [tokens, nxt[:, None].astype(onp.int32)], axis=1)
+                finished |= nxt == eos_id
+                if finished.all():
+                    break
+            return tokens[:, 1:]
+
+        # beam search: expand memory to (B*K, Sk, C), track per-beam
+        # cumulative log-probs; finished beams only extend with EOS at
+        # zero added score
+        k = int(beam_size)
+        mem_k = mem.repeat(k, axis=0)       # on-device beam expansion
+        tokens = onp.full((b * k, 1), bos_id, dtype=onp.int32)
+        scores = onp.full((b, k), -onp.inf, onp.float64)
+        scores[:, 0] = 0.0                    # first step: only beam 0 live
+        finished = onp.zeros((b, k), bool)
+
+        def lp(length):
+            return ((5.0 + length) ** length_penalty) / \
+                (6.0 ** length_penalty)
+
+        for step in range(max_steps):
             tgt = nd.array(tokens)
             dec = self.decoder(self._embed(nd, tgt, self.tgt_embed,
-                                           self.pos_weight.data()), mem)
-            logits = self.output(dec)
-            nxt = logits.asnumpy()[:, -1].argmax(axis=-1)
-            nxt = onp.where(finished, eos_id, nxt)
-            tokens = onp.concatenate([tokens, nxt[:, None].astype(onp.int32)],
-                                     axis=1)
-            finished |= nxt == eos_id
+                                           self.pos_weight.data()), mem_k)
+            logp = nd.log_softmax(self.output(dec),
+                                  axis=-1).asnumpy()[:, -1]   # (B*K, V)
+            v = logp.shape[-1]
+            logp = logp.reshape(b, k, v)
+            # finished beams: only EOS continuation, at no added cost
+            fin_row = onp.full((v,), -onp.inf)
+            fin_row[eos_id] = 0.0
+            logp = onp.where(finished[:, :, None], fin_row[None, None],
+                             logp)
+            cand = scores[:, :, None] + logp               # (B, K, V)
+            flat = cand.reshape(b, k * v)
+            top = onp.argpartition(-flat, k, axis=1)[:, :k]
+            beam_idx, tok_idx = top // v, top % v
+            scores = onp.take_along_axis(flat, top, axis=1)
+            # reorder histories and append the chosen tokens
+            rows = (onp.arange(b)[:, None] * k + beam_idx).reshape(-1)
+            tokens = onp.concatenate(
+                [tokens[rows],
+                 tok_idx.reshape(-1, 1).astype(onp.int32)], axis=1)
+            finished = onp.take_along_axis(finished, beam_idx, axis=1) \
+                | (tok_idx == eos_id)
             if finished.all():
                 break
-        return tokens[:, 1:]
+        # pick the best beam per sentence under the length penalty;
+        # length = tokens up to and including the first EOS (full length
+        # when no EOS was emitted — argmin alone conflates the two)
+        gen = tokens.reshape(b, k, -1)[:, :, 1:]
+        has_eos = (gen == eos_id).any(axis=2)
+        first_eos = (gen == eos_id).argmax(axis=2)
+        lengths = onp.where(has_eos, first_eos + 1, gen.shape[2])
+        normed = scores / lp(onp.maximum(lengths, 1))
+        best = normed.argmax(axis=1)
+        out = tokens.reshape(b, k, -1)[onp.arange(b), best, 1:]
+        return out
 
 
 def transformer_base(src_vocab, tgt_vocab, **kwargs):
