@@ -39,6 +39,10 @@ def main():
     p.add_argument("--units", type=int, default=128)
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--label-smoothing", type=float, default=0.0,
+                   help="Sockeye-style smoothed CE (e.g. 0.1)")
+    p.add_argument("--beam", type=int, default=1,
+                   help="beam size for the sample decode (1 = greedy)")
     args = p.parse_args()
 
     net = transformer.TransformerModel(
@@ -49,7 +53,8 @@ def main():
     net.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": args.lr})
-    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(
+        label_smoothing=args.label_smoothing)
     rng = np.random.RandomState(0)
     for step in range(args.steps):
         src, tgt_in, tgt = make_batch(rng, args.batch_size, args.seq_len,
@@ -63,12 +68,18 @@ def main():
         if step % 50 == 0:
             logging.info("Batch [%d]\tloss=%.4f", step,
                          float(loss.asnumpy().mean()))
-    # sample decode
+    # sample decode (greedy by default; --beam K runs beam search)
     src, _, tgt = make_batch(rng, 2, args.seq_len, args.vocab)
     out = net.translate(mx.nd.array(src), bos_id=BOS, eos_id=EOS,
-                        max_steps=args.seq_len)
+                        max_steps=args.seq_len, beam_size=args.beam)
     acc = float((out[:, :args.seq_len] == tgt[:, :out.shape[1]]).mean())
-    logging.info("greedy-decode token accuracy: %.3f", acc)
+    mode = "greedy" if args.beam <= 1 else f"beam-{args.beam}"
+    # test_examples.py parses the "greedy-decode" line; keep it for the
+    # default mode and label beam runs by their actual mode
+    if args.beam <= 1:
+        logging.info("greedy-decode token accuracy: %.3f", acc)
+    else:
+        logging.info("%s decode token accuracy: %.3f", mode, acc)
 
 
 if __name__ == "__main__":

@@ -112,11 +112,20 @@ class SoftmaxCrossEntropyLoss(Loss):
     """
 
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
-                 weight=None, batch_axis=0, **kwargs):
+                 weight=None, batch_axis=0, label_smoothing=0.0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._axis = axis
         self._sparse_label = sparse_label
         self._from_logits = from_logits
+        # Sockeye-style smoothed CE (ref ecosystem: sockeye.loss
+        # CrossEntropyLoss(label_smoothing=...)): target mass (1-eps) on
+        # the label, eps spread uniformly. Fused into the sparse path as
+        # lse - (1-eps)·pred[y] - eps·mean(pred) — still no [.., C]
+        # log-prob materialization.
+        self._smoothing = float(label_smoothing)
+        if self._smoothing and not sparse_label:
+            raise MXNetError("label_smoothing requires sparse_label=True "
+                             "(smooth dense label distributions yourself)")
 
     @property
     def amp_safe(self):
@@ -136,13 +145,26 @@ class SoftmaxCrossEntropyLoss(Loss):
             # the same way (ref: src/operator/softmax_output.cc backward).
             lse = F.logsumexp(pred, axis=self._axis, keepdims=True)
             picked = F.pick(pred, label, axis=self._axis, keepdims=True)
-            loss = lse - F.cast(picked, "float32")
+            target = F.cast(picked, "float32")
+            if self._smoothing:
+                eps = self._smoothing
+                # mean accumulates in fp32 (amp_safe contract: bf16 AMP
+                # feeds reduced-precision logits straight in; XLA fuses
+                # the cast into the reduction, nothing materializes)
+                target = target * (1.0 - eps) + F.mean(
+                    F.cast(pred, "float32"), axis=self._axis,
+                    keepdims=True) * eps
+            loss = lse - target
             loss = _apply_weighting(F, loss, self._weight, sample_weight)
             return self._mean_over_nonbatch(F, loss)
         if not self._from_logits:
             pred = F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
             loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            if self._smoothing:
+                eps = self._smoothing
+                loss = loss * (1.0 - eps) - F.mean(
+                    pred, axis=self._axis, keepdims=True) * eps
         else:
             label = _reshape_like(F, label, pred)
             loss = -F.sum(pred * label, axis=self._axis, keepdims=True)

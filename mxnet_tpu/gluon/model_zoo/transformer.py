@@ -179,9 +179,11 @@ class TransformerModel(HybridBlock):
                   beam_size=1, length_penalty=1.0):
         """Greedy (``beam_size=1``) or beam-search decode (the Sockeye
         inference mode, ref ecosystem: sockeye.beam_search). Host-driven
-        loop over eager decoder calls with static shapes per step;
-        ``length_penalty`` is the standard (5+len)^a/(5+1)^a GNMT
-        normalization exponent applied at candidate ranking."""
+        loop over eager decoder calls with static shapes per step.
+        ``length_penalty`` is the (5+len)^a/(5+1)^a GNMT normalization
+        exponent, applied at the FINAL best-hypothesis selection only —
+        per-step pruning compares raw cumulative log-probs (a documented
+        simplification vs Sockeye's normalized in-search ranking)."""
         from ... import ndarray as nd
         import numpy as onp
         max_steps = max_steps or min(self._max_length, 64)
@@ -223,8 +225,12 @@ class TransformerModel(HybridBlock):
             tgt = nd.array(tokens)
             dec = self.decoder(self._embed(nd, tgt, self.tgt_embed,
                                            self.pos_weight.data()), mem_k)
-            logp = nd.log_softmax(self.output(dec),
-                                  axis=-1).asnumpy()[:, -1]   # (B*K, V)
+            # last timestep only, sliced ON DEVICE: projecting and
+            # log-softmaxing all t positions then shipping (B*K, t, V)
+            # to host would be O(T²V) transfer for an O(TV) need
+            dec_last = nd.slice_axis(dec, axis=1, begin=-1, end=None)
+            logp = nd.log_softmax(self.output(dec_last),
+                                  axis=-1).asnumpy()[:, 0]    # (B*K, V)
             v = logp.shape[-1]
             logp = logp.reshape(b, k, v)
             # finished beams: only EOS continuation, at no added cost

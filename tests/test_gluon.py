@@ -240,6 +240,34 @@ def test_losses_against_torch():
     assert np.allclose(bce_mx, bce_th, atol=1e-5)
 
 
+def test_label_smoothing_ce_against_torch():
+    """Sockeye-style smoothed CE: the fused lse-based form must equal
+    torch's cross_entropy(label_smoothing=eps) exactly."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as TF
+    np.random.seed(4)
+    pred = np.random.randn(6, 5).astype(np.float32)
+    label = np.random.randint(0, 5, (6,))
+    for eps in (0.1, 0.3):
+        l_mx = gluon.loss.SoftmaxCrossEntropyLoss(label_smoothing=eps)(
+            nd.array(pred), nd.array(label)).asnumpy()
+        l_th = TF.cross_entropy(torch.tensor(pred), torch.tensor(label),
+                                reduction="none",
+                                label_smoothing=eps).numpy()
+        assert np.allclose(l_mx, l_th, atol=1e-5), (eps, l_mx, l_th)
+    # from_logits path agrees with the fused path
+    logp = pred - np.log(np.exp(pred).sum(1, keepdims=True))
+    l_fl = gluon.loss.SoftmaxCrossEntropyLoss(
+        label_smoothing=0.1, from_logits=True)(
+        nd.array(logp), nd.array(label)).asnumpy()
+    l_fused = gluon.loss.SoftmaxCrossEntropyLoss(label_smoothing=0.1)(
+        nd.array(pred), nd.array(label)).asnumpy()
+    assert np.allclose(l_fl, l_fused, atol=1e-5)
+    with pytest.raises(Exception):
+        gluon.loss.SoftmaxCrossEntropyLoss(label_smoothing=0.1,
+                                           sparse_label=False)
+
+
 def test_ctc_loss_against_torch():
     torch = pytest.importorskip("torch")
     import torch.nn.functional as TF

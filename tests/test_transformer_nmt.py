@@ -94,9 +94,11 @@ def test_beam_search_translate():
         trainer.step(B * S)
     src = rng.randint(3, V, (4, S))
     greedy = net.translate(mx.nd.array(src), max_steps=S)
-    # beam_size=2 exercises the BEAM branch (k=1 would just re-run the
-    # greedy code path — comparing those is tautological); on a trained
-    # model its top beam must be at least as good as greedy
+    # beam_size=1 dispatches to the greedy path — assert that contract
+    beam1 = net.translate(mx.nd.array(src), max_steps=S, beam_size=1)
+    np.testing.assert_array_equal(greedy, beam1)
+    # beam_size=2 exercises the BEAM branch proper; on a trained model
+    # its top beam must be at least as good as greedy
     beam2 = net.translate(mx.nd.array(src), max_steps=S, beam_size=2)
     beam4 = net.translate(mx.nd.array(src), max_steps=S, beam_size=4)
     assert beam4.shape[0] == 4 and beam4.shape[1] <= S
