@@ -37,6 +37,22 @@ if _os.environ.get("MXTPU_COORD_ADDR"):
     except RuntimeError:
         pass          # already joined (re-import / interactive)
 
+# fp32 means fp32: JAX's DEFAULT matmul precision lowers fp32 matmul
+# inputs to single-pass bf16 multiplies on TPU (~1e-2 relative error —
+# measured FAILing the CPU-oracle parity sweep, benchmarks/hw_parity.py),
+# while the reference's fp32 GEMMs are true fp32 (cuBLAS). HIGHEST
+# restores fp32 accumulation for fp32 inputs and does not touch the bf16
+# AMP fast paths (their operands are already bf16). Override with
+# MXNET_MATMUL_PRECISION=default|high|highest.
+import jax as _jax_cfg
+
+_prec = _os.environ.get("MXNET_MATMUL_PRECISION") or "highest"
+if _prec not in ("default", "high", "highest"):
+    raise ImportError(
+        f"MXNET_MATMUL_PRECISION={_prec!r} is invalid: expected "
+        f"'default', 'high' or 'highest'")
+_jax_cfg.config.update("jax_default_matmul_precision", _prec)
+
 from .base import MXNetError
 from .context import (Context, cpu, cpu_pinned, cpu_shared, current_context,
                       gpu, gpu_memory_info, num_gpus, num_tpus, tpu)

@@ -197,8 +197,10 @@ class TransformerModel(HybridBlock):
                 dec = self.decoder(self._embed(nd, tgt, self.tgt_embed,
                                                self.pos_weight.data()),
                                    mem)
-                logits = self.output(dec)
-                nxt = logits.asnumpy()[:, -1].argmax(axis=-1)
+                # project + transfer the LAST step only (same O(T·V)
+                # fix as the beam branch)
+                dec_last = nd.slice_axis(dec, axis=1, begin=-1, end=None)
+                nxt = self.output(dec_last).asnumpy()[:, 0].argmax(axis=-1)
                 nxt = onp.where(finished, eos_id, nxt)
                 tokens = onp.concatenate(
                     [tokens, nxt[:, None].astype(onp.int32)], axis=1)
