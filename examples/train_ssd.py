@@ -68,8 +68,9 @@ def main():
         if step % 20 == 0:
             logging.info("Batch [%d]\tloss=%.4f", step,
                          float(loss.asnumpy().mean()))
-    # inference path
-    x, _ = synthetic_batch(rng, 2, args.data_shape, args.num_classes)
+    # inference + VOC07 mAP scoring (gluoncv-parity evaluation)
+    x, labels = synthetic_batch(rng, 2, args.data_shape,
+                                args.num_classes)
     anchors, cls_preds, box_preds = net(mx.nd.array(x))
     probs = mx.nd.softmax(cls_preds, axis=-1)
     probs = mx.nd.transpose(probs, axes=(0, 2, 1))
@@ -78,6 +79,10 @@ def main():
     rows = det.asnumpy()[0]
     kept = rows[rows[:, 0] >= 0]
     logging.info("detections (top 3): %s", kept[:3])
+    metric = mx.metric.VOC07MApMetric(iou_thresh=0.5)
+    metric.update(mx.nd.array(labels), det)
+    name, value = metric.get()
+    logging.info("%s: %.4f", name, value)
 
 
 if __name__ == "__main__":

@@ -67,6 +67,11 @@ from . import initializer as init
 from . import optimizer
 from . import lr_scheduler
 from . import metric
+from . import metric_det
+# detection mAP lives beside the classification metrics (the reference
+# ecosystem ships it in gluoncv.utils.metrics; one registry here)
+metric.VOCMApMetric = metric_det.VOCMApMetric
+metric.VOC07MApMetric = metric_det.VOC07MApMetric
 from . import kvstore
 from . import kvstore as kv
 from . import gluon

@@ -13,7 +13,9 @@ from .base import MXNetError
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
            "Perplexity", "PearsonCorrelation", "Loss", "Torch", "Caffe",
-           "CustomMetric", "create", "register", "np_metric"]
+           "CustomMetric", "create", "register", "np_metric",
+           # attached by the package init from metric_det (detection mAP)
+           "VOCMApMetric", "VOC07MApMetric"]
 
 _REGISTRY = {}
 

@@ -1,0 +1,123 @@
+"""VOC mAP metrics (ref ecosystem: gluoncv.utils.metrics.voc_detection —
+the evaluation half of the SSD/Faster-RCNN configs). AP values asserted
+against hand-computed precision/recall integrals."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.metric_det import VOC07MApMetric, VOCMApMetric
+
+
+def _boxes():
+    # one image, one class: 2 ground truths, 3 ranked detections.
+    # det order by score: hit, miss, hit ->
+    #   rank1 TP (p=1, r=0.5), rank2 FP (p=.5), rank3 TP (p=2/3, r=1.0)
+    label = np.array([[0, 0, 0, 10, 10, 0],
+                      [0, 20, 20, 30, 30, 0]], np.float32)
+    pred = np.array([
+        [0, 0.9, 0, 0, 10, 10],       # TP (IoU 1.0)
+        [0, 0.8, 50, 50, 60, 60],     # FP (no overlap)
+        [0, 0.7, 21, 21, 30, 30],     # TP (IoU ~0.8 with gt2)
+    ], np.float32)
+    return label, pred
+
+
+def test_voc_map_all_points():
+    m = VOCMApMetric(iou_thresh=0.5)
+    label, pred = _boxes()
+    m.update([label], [pred])
+    name, value = m.get()
+    # all-points AP: envelope p(r<=0.5)=1.0, p(0.5<r<=1.0)=2/3
+    want = 0.5 * 1.0 + 0.5 * (2.0 / 3.0)
+    assert name == "mAP"
+    assert abs(value - want) < 1e-6, (value, want)
+
+
+def test_voc07_11point():
+    m = VOC07MApMetric(iou_thresh=0.5)
+    label, pred = _boxes()
+    m.update([label], [pred])
+    _, value = m.get()
+    # 11-point: max precision at r>=t is 1.0 for t in {0,.1..,.5} (6 pts)
+    # and 2/3 for t in {.6,...,1.0} (5 pts)
+    want = (6 * 1.0 + 5 * (2.0 / 3.0)) / 11.0
+    assert abs(value - want) < 1e-6, (value, want)
+
+
+def test_voc_map_multiclass_and_registry():
+    m = mx.metric.create("voc07mapmetric",
+                         class_names=["cat", "dog"])
+    label = np.array([[0, 0, 0, 10, 10, 0],
+                      [1, 20, 20, 30, 30, 0]], np.float32)
+    pred = np.array([
+        [0, 0.9, 0, 0, 10, 10],       # cat TP
+        [1, 0.8, 40, 40, 50, 50],     # dog FP
+    ], np.float32)
+    m.update([label], [pred])
+    names, values = m.get()
+    per = dict(zip(names, values))
+    assert abs(per["cat"] - 1.0) < 1e-6
+    assert per["dog"] == 0.0
+    assert abs(per["mAP"] - 0.5) < 1e-6
+
+
+def test_voc_map_difficult_and_duplicates():
+    m = VOCMApMetric(iou_thresh=0.5)
+    # difficult GT: matching it is neither TP nor FP; duplicate match of
+    # an already-taken GT counts FP (VOC protocol)
+    label = np.array([[0, 0, 0, 10, 10, 1],        # difficult
+                      [0, 20, 20, 30, 30, 0]], np.float32)
+    pred = np.array([
+        [0, 0.9, 0, 0, 10, 10],       # matches difficult: ignored
+        [0, 0.8, 20, 20, 30, 30],     # TP
+        [0, 0.7, 20, 20, 30, 30],     # duplicate -> FP
+    ], np.float32)
+    m.update([label], [pred])
+    _, value = m.get()
+    # npos=1 (difficult excluded); ranked: ignored, TP (p=1, r=1), FP
+    assert abs(value - 1.0) < 1e-6, value
+
+
+def test_voc_map_duplicates_on_difficult_ignored():
+    """VOC devkit protocol: EVERY detection matching a difficult GT is
+    ignored (not just the first), and difficult GTs are never 'taken'."""
+    m = VOCMApMetric(iou_thresh=0.5)
+    label = np.array([[0, 0, 0, 10, 10, 1],        # difficult
+                      [0, 20, 20, 30, 30, 0]], np.float32)
+    pred = np.array([
+        [0, 0.9, 0, 0, 10, 10],       # matches difficult: ignored
+        [0, 0.85, 0, 0, 10, 10],      # ALSO matches difficult: ignored
+        [0, 0.8, 20, 20, 30, 30],     # TP on the real GT
+    ], np.float32)
+    m.update([label], [pred])
+    _, value = m.get()
+    assert abs(value - 1.0) < 1e-6, value
+
+
+def test_voc_map_prediction_only_class_excluded():
+    """A class with zero (non-difficult) ground truths has undefined AP
+    and must not drag the mean down (gluoncv nanmean semantics)."""
+    m = VOCMApMetric(iou_thresh=0.5)
+    label = np.array([[0, 0, 0, 10, 10, 0]], np.float32)
+    pred = np.array([
+        [0, 0.9, 0, 0, 10, 10],       # class 0 TP
+        [3, 0.8, 50, 50, 60, 60],     # spurious class-3 detection
+    ], np.float32)
+    m.update([label], [pred])
+    _, value = m.get()
+    assert abs(value - 1.0) < 1e-6, value
+
+
+def test_voc_map_batched_ndarray_inputs():
+    m = VOCMApMetric()
+    label, pred = _boxes()
+    # batch dim + NDArray inputs + padding rows (cls = -1)
+    pad_l = np.full((1, 1, 6), -1, np.float32)
+    pad_p = np.full((1, 1, 6), -1, np.float32)
+    lb = np.concatenate([label[None], pad_l], axis=1)
+    pb = np.concatenate([pred[None], pad_p], axis=1)
+    m.update(mx.nd.array(lb), mx.nd.array(pb))
+    _, v1 = m.get()
+    m2 = VOCMApMetric()
+    m2.update([label], [pred])
+    _, v2 = m2.get()
+    assert abs(v1 - v2) < 1e-9
