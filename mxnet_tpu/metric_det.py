@@ -121,8 +121,13 @@ class VOCMApMetric(EvalMetric):
     def get(self):
         aps = []
         names = []
-        for c in sorted(self._npos):
-            npos = self._npos[c]
+        # report every configured class (gluoncv parity): names absent
+        # from all updates still get a row (NaN — undefined AP)
+        all_classes = set(self._npos)
+        if self._class_names:
+            all_classes |= set(range(len(self._class_names)))
+        for c in sorted(all_classes):
+            npos = self._npos.get(c, 0)
             recs = self._records.get(c, [])
             if npos == 0:
                 # prediction-only / all-difficult class: AP undefined —

@@ -136,7 +136,11 @@ def check_numeric_gradient(fn, inputs, rtol=1e-2, atol=1e-3, eps=1e-3):
     analytic = [x.grad.asnumpy() for x in inputs]
 
     def run(xs):
-        with autograd.pause():
+        # numeric pass must evaluate in the SAME mode the analytic pass
+        # recorded under (train): pause() alone flips mode-dependent ops
+        # (training BatchNorm) to inference and the comparison is then
+        # between two different functions
+        with autograd.pause(train_mode=True):
             out2 = fn(*xs)
         return out2 if isinstance(out2, NDArray) else out2[0] + sum(out2[1:], 0 * out2[0])
 

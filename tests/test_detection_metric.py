@@ -58,6 +58,13 @@ def test_voc_map_multiclass_and_registry():
     assert abs(per["cat"] - 1.0) < 1e-6
     assert per["dog"] == 0.0
     assert abs(per["mAP"] - 0.5) < 1e-6
+    # every configured class gets a row even if never observed
+    m2 = mx.metric.VOCMApMetric(class_names=["cat", "dog", "bird"])
+    m2.update([label], [pred])
+    names2, values2 = m2.get()
+    per2 = dict(zip(names2, values2))
+    assert "bird" in per2 and np.isnan(per2["bird"])
+    assert abs(per2["mAP"] - 0.5) < 1e-6   # NaN excluded from the mean
 
 
 def test_voc_map_difficult_and_duplicates():

@@ -91,6 +91,85 @@ CASES = {
             x, mx.nd.array([[0, 2, 1, 2, 0]]),
             mx.nd.array([[1, -1, 1, 1, -1]]), out_dim=3),
         [_rand(2, 5)]),
+    # round-3 breadth: norms, attention, conv variants, indexing,
+    # elemwise families — one case per backward code path
+    "group_norm": (
+        lambda x, g, b: mx.nd.GroupNorm(x, g, b, num_groups=2),
+        [_rand(2, 4, 3, 3), _rand(4, seed=20) + 1.0, _rand(4, seed=21)]),
+    "instance_norm": (
+        lambda x, g, b: mx.nd.InstanceNorm(x, g, b),
+        [_rand(2, 3, 4, 4), _rand(3, seed=22) + 1.0, _rand(3, seed=23)]),
+    "deconvolution": (
+        lambda x, w: mx.nd.Deconvolution(x, w, kernel=(3, 3),
+                                         num_filter=2, no_bias=True),
+        [_rand(1, 3, 4, 4), _rand(3, 2, 3, 3, seed=24)]),
+    "depthwise_conv": (
+        lambda x, w: mx.nd.Convolution(x, w, kernel=(3, 3), num_filter=4,
+                                       num_group=4, pad=(1, 1),
+                                       no_bias=True),
+        [_rand(1, 4, 5, 5), _rand(4, 1, 3, 3, seed=25)]),
+    "fused_self_attention": (
+        lambda qkv: mx.nd.contrib.fused_self_attention(qkv, heads=2,
+                                                       causal=True),
+        [_rand(1, 4, 12, scale=0.5)]),
+    "fused_cross_attention": (
+        lambda q, kv: mx.nd.contrib.fused_cross_attention(q, kv, heads=2),
+        [_rand(1, 3, 6, scale=0.5), _rand(1, 5, 12, scale=0.5, seed=26)]),
+    "logsumexp": (lambda x: mx.nd.logsumexp(x, axis=-1), [_rand(3, 5)]),
+    "take": (
+        lambda w: mx.nd.take(w, mx.nd.array([0, 2, 1]), axis=0),
+        [_rand(4, 3)]),
+    "gather_nd": (
+        lambda x: mx.nd.gather_nd(x, mx.nd.array([[0, 1], [1, 0]])),
+        [_rand(2, 2, 3)]),
+    "pick": (
+        lambda x: mx.nd.pick(x, mx.nd.array([1, 0, 2]), axis=1),
+        [_rand(3, 4)]),
+    "norm_l2": (lambda x: mx.nd.norm(x, ord=2, axis=1),
+                [_rand(3, 4) + 2.0]),
+    "elemwise_div": (lambda a, b: a / b,
+                     [_rand(3, 4), _rand(3, 4, seed=27) + 3.0]),
+    "power": (lambda a, b: mx.nd.broadcast_power(a, b),
+              [np.abs(_rand(3, 4)) + 0.5, _rand(1, 4, seed=28)]),
+    "log1p": (lambda x: mx.nd.log1p(x), [np.abs(_rand(3, 4)) + 0.1]),
+    "expm1": (lambda x: mx.nd.expm1(x), [_rand(3, 4, scale=0.5)]),
+    "rsqrt": (lambda x: mx.nd.rsqrt(x), [np.abs(_rand(3, 4)) + 0.5]),
+    "elu": (lambda x: mx.nd.LeakyReLU(x, act_type="elu", slope=1.0),
+            [_rand(3, 4) + 0.05]),
+    "selu": (lambda x: mx.nd.LeakyReLU(x, act_type="selu"),
+             [_rand(3, 4) + 0.05]),
+    "prelu": (
+        lambda x, g: mx.nd.LeakyReLU(x, g, act_type="prelu"),
+        [_rand(3, 4) + 0.05, np.abs(_rand(4, seed=29)) * 0.3 + 0.1]),
+    "softsign": (lambda x: mx.nd.Activation(x, act_type="softsign"),
+                 [_rand(3, 4)]),
+    "stack": (lambda a, b: mx.nd.stack(a, b, axis=1),
+              [_rand(2, 3), _rand(2, 3, seed=30)]),
+    "tile": (lambda x: mx.nd.tile(x, reps=(2, 1)), [_rand(2, 3)]),
+    "dot_transpose_b": (
+        lambda a, b: mx.nd.dot(a, b, transpose_b=True),
+        [_rand(3, 4), _rand(2, 4, seed=31)]),
+    "linalg_gemm2": (
+        lambda a, b: mx.nd.linalg_gemm2(a, b, transpose_a=True),
+        [_rand(4, 3), _rand(4, 2, seed=32)]),
+    "sequence_mask": (
+        lambda x: mx.nd.SequenceMask(
+            x, mx.nd.array([1, 3]), use_sequence_length=True,
+            value=0.0),
+        [_rand(3, 2, 4)]),
+    "bilinear_resize": (
+        lambda x: mx.nd.contrib.BilinearResize2D(x, height=6, width=6),
+        [_rand(1, 2, 3, 3)]),
+    "roi_align": (
+        lambda x: mx.nd.contrib.ROIAlign(
+            x, mx.nd.array([[0, 0.31, 0.32, 3.33, 3.34]]),
+            pooled_size=(2, 2), spatial_scale=1.0),
+        [_rand(1, 2, 5, 5)]),
+    "batchnorm_train": (
+        lambda x, g, b: mx.nd.BatchNorm(
+            x, g, b, mx.nd.zeros((3,)), mx.nd.ones((3,)),
+            fix_gamma=False)[0],
+        [_rand(4, 3, 4), _rand(3, seed=33) + 1.0, _rand(3, seed=34)]),
 }
 
 
