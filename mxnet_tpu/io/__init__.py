@@ -458,9 +458,12 @@ class ImageRecordIter(DataIter):
             ((self._seed * 1000003 + self._epoch) * 1000003 + record_idx)
             & 0x7FFFFFFF) \
             if (self._rand_crop or self._rand_mirror) else None
-        return header.label, self._augment(img, rng)
+        chw, mirrored = self._augment(img, rng)
+        return self._transform_label(header.label, mirrored), chw
 
     def _augment(self, img, rng):
+        """Returns (CHW float image, mirrored flag) — the flag lets the
+        detection subclass apply the SAME flip to its box labels."""
         import cv2
         c, h, w = self._data_shape
         if self._resize > 0:
@@ -478,12 +481,18 @@ class ImageRecordIter(DataIter):
         else:
             y, x = (ih - h) // 2, (iw - w) // 2
         img = img[y:y + h, x:x + w]
-        if self._rand_mirror and rng.rand() < 0.5:
+        mirrored = bool(self._rand_mirror and rng.rand() < 0.5)
+        if mirrored:
             img = img[:, ::-1]
         img = img[:, :, ::-1]  # BGR (cv2) → RGB, like the reference
         chw = img.transpose(2, 0, 1).astype(np.float32)
         chw = (chw - self._mean) / self._std * self._scale
-        return chw
+        return chw, mirrored
+
+    def _transform_label(self, label, mirrored):
+        """Classification packs: labels are geometry-free — identity.
+        The detection subclass flips box coordinates with the image."""
+        return label
 
     def _fill_pending(self):
         """Keep the decode pool fed: submit raw records until the in-flight
@@ -556,9 +565,55 @@ class ImageDetRecordIter(ImageRecordIter):
 
     def __init__(self, path_imgrec, data_shape, batch_size,
                  label_pad_width=35, label_pad_value=-1.0, **kwargs):
+        if kwargs.get("rand_crop"):
+            raise MXNetError(
+                "ImageDetRecordIter does not support rand_crop: cropping "
+                "must resample/clip boxes (the reference uses dedicated "
+                "rand_crop_prob/min_object_covered parameters) — crop in "
+                "a custom transform that adjusts the labels")
         kwargs.setdefault("label_width", label_pad_width)
         super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
         self._pad_value = label_pad_value
+
+    def _augment(self, img, rng):
+        """Detection geometry: RESIZE the full frame to data_shape
+        (normalized box coords are invariant under pure resize) — the
+        base class's center-crop would silently invalidate boxes for any
+        size-mismatched pack. Optional mirror flips boxes via
+        _transform_label."""
+        import cv2
+        c, h, w = self._data_shape
+        if img.shape[0] != h or img.shape[1] != w:
+            img = cv2.resize(img, (w, h))
+        mirrored = bool(self._rand_mirror and rng.rand() < 0.5)
+        if mirrored:
+            img = img[:, ::-1]
+        img = img[:, :, ::-1]  # BGR (cv2) → RGB, like the reference
+        chw = img.transpose(2, 0, 1).astype(np.float32)
+        chw = (chw - self._mean) / self._std * self._scale
+        return chw, mirrored
+
+    def _transform_label(self, label, mirrored):
+        """Horizontal flip moves the boxes too: x0' = 1-x1, x1' = 1-x0
+        (normalized corner coords; ref: src/io/image_det_aug_default.cc
+        DefaultImageDetAugmenter mirror handling). Label layout:
+        [header_width, obj_width, <header...>, boxes×obj_width] with box
+        rows [cls, x0, y0, x1, y1, ...]."""
+        if not mirrored:
+            return label
+        lab = np.array(label, dtype=np.float32).ravel()   # owns its data
+        if lab.size < 2:
+            return lab
+        hw = int(lab[0])
+        ow = int(lab[1])
+        if hw < 2 or ow < 5 or lab.size <= hw:
+            return lab             # not the det header layout: untouched
+        n = (lab.size - hw) // ow
+        boxes = lab[hw:hw + n * ow].reshape(n, ow)   # view: mutates lab
+        x0 = boxes[:, 1].copy()
+        boxes[:, 1] = 1.0 - boxes[:, 3]
+        boxes[:, 3] = 1.0 - x0
+        return lab
 
 __all__.append("ImageDetRecordIter")
 

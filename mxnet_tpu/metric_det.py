@@ -44,13 +44,22 @@ class VOCMApMetric(EvalMetric):
 
     def __init__(self, iou_thresh=0.5, class_names=None,
                  name="mAP", use_07_metric=False):
-        self._iou = float(iou_thresh)
+        # scalar -> VOC protocol; a LIST of thresholds averages AP over
+        # them (pass np.arange(0.5, 1.0, 0.05) for the COCO-style
+        # mAP@[.5:.95] headline number)
+        if isinstance(iou_thresh, (list, tuple, np.ndarray)):
+            # dedupe (order-preserving): a repeated threshold would
+            # append to the same (thr, class) record list twice
+            self._ious = list(dict.fromkeys(float(t) for t in iou_thresh))
+        else:
+            self._ious = [float(iou_thresh)]
+        self._iou = self._ious[0]
         self._use07 = use_07_metric
         self._class_names = list(class_names) if class_names else None
         super().__init__(name)
 
     def reset(self):
-        # per class: list of (score, tp) + total positives
+        # per (iou_thresh, class): list of (score, tp); npos per class
         self._records = {}
         self._npos = {}
         self.num_inst = 0
@@ -80,28 +89,30 @@ class VOCMApMetric(EvalMetric):
             gt_diff = difficult[label[:, 0].astype(int) == c]
             dt = pred[pred[:, 0].astype(int) == c]
             self._npos[c] = self._npos.get(c, 0) + int((~gt_diff).sum())
-            self._records.setdefault(c, [])
-            if dt.shape[0] == 0:
-                continue
-            order = np.argsort(-dt[:, 1])
-            dt = dt[order]
-            iou = _iou_matrix(dt[:, 2:6], gt[:, 1:5])
-            taken = np.zeros(gt.shape[0], bool)
-            for i in range(dt.shape[0]):
-                if gt.shape[0] == 0:
-                    self._records[c].append((float(dt[i, 1]), 0))
+            order = np.argsort(-dt[:, 1]) if dt.shape[0] else []
+            dt = dt[order] if dt.shape[0] else dt
+            iou = _iou_matrix(dt[:, 2:6], gt[:, 1:5]) if dt.shape[0] \
+                else None
+            for thr in self._ious:
+                recs = self._records.setdefault((thr, c), [])
+                if dt.shape[0] == 0:
                     continue
-                j = int(iou[i].argmax())
-                if iou[i, j] >= self._iou and gt_diff[j]:
-                    # difficult GT: every matching detection is ignored
-                    # (neither TP nor FP, never "taken" — VOC devkit /
-                    # gluoncv protocol)
-                    continue
-                if iou[i, j] >= self._iou and not taken[j]:
-                    taken[j] = True
-                    self._records[c].append((float(dt[i, 1]), 1))
-                else:
-                    self._records[c].append((float(dt[i, 1]), 0))
+                taken = np.zeros(gt.shape[0], bool)
+                for i in range(dt.shape[0]):
+                    if gt.shape[0] == 0:
+                        recs.append((float(dt[i, 1]), 0))
+                        continue
+                    j = int(iou[i].argmax())
+                    if iou[i, j] >= thr and gt_diff[j]:
+                        # difficult GT: every matching detection is
+                        # ignored (neither TP nor FP, never "taken" —
+                        # VOC devkit / gluoncv protocol)
+                        continue
+                    if iou[i, j] >= thr and not taken[j]:
+                        taken[j] = True
+                        recs.append((float(dt[i, 1]), 1))
+                    else:
+                        recs.append((float(dt[i, 1]), 0))
 
     def _average_precision(self, rec, prec):
         if self._use07:
@@ -128,7 +139,6 @@ class VOCMApMetric(EvalMetric):
             all_classes |= set(range(len(self._class_names)))
         for c in sorted(all_classes):
             npos = self._npos.get(c, 0)
-            recs = self._records.get(c, [])
             if npos == 0:
                 # prediction-only / all-difficult class: AP undefined —
                 # excluded from the mean (gluoncv nanmean semantics)
@@ -136,18 +146,21 @@ class VOCMApMetric(EvalMetric):
                     aps.append(float("nan"))
                     names.append(self._cname(c))
                 continue
-            if not recs:
-                aps.append(0.0)
-                names.append(self._cname(c))
-                continue
-            recs = sorted(recs, key=lambda r: -r[0])
-            tp = np.array([r[1] for r in recs], np.float64)
-            fp = 1.0 - tp
-            tp_c = np.cumsum(tp)
-            fp_c = np.cumsum(fp)
-            rec = tp_c / npos
-            prec = tp_c / np.maximum(tp_c + fp_c, 1e-12)
-            aps.append(self._average_precision(rec, prec))
+            per_thr = []
+            for thr in self._ious:
+                recs = self._records.get((thr, c), [])
+                if not recs:
+                    per_thr.append(0.0)
+                    continue
+                recs = sorted(recs, key=lambda r: -r[0])
+                tp = np.array([r[1] for r in recs], np.float64)
+                fp = 1.0 - tp
+                tp_c = np.cumsum(tp)
+                fp_c = np.cumsum(fp)
+                rec = tp_c / npos
+                prec = tp_c / np.maximum(tp_c + fp_c, 1e-12)
+                per_thr.append(self._average_precision(rec, prec))
+            aps.append(float(np.mean(per_thr)))
             names.append(self._cname(c))
         defined = [a for a in aps if not np.isnan(a)]
         mean_ap = float(np.mean(defined)) if defined else float("nan")

@@ -114,6 +114,22 @@ def test_voc_map_prediction_only_class_excluded():
     assert abs(value - 1.0) < 1e-6, value
 
 
+def test_map_iou_ladder_coco_style():
+    """iou_thresh as a list averages AP over thresholds (the COCO-style
+    mAP@[.5:.95] headline). A detection at IoU ~0.68 with its GT is TP
+    at the thresholds below 0.68 and FP above -> AP = fraction of
+    thresholds it clears."""
+    ladder = [0.5, 0.6, 0.7, 0.8]
+    m = VOCMApMetric(iou_thresh=ladder)
+    label = np.array([[0, 0, 0, 10, 10, 0]], np.float32)
+    # shifted box: inter = 8*8=64? use x-shift 2: inter=8*10=80,
+    # union=2*100-80=120 -> IoU=2/3: clears 0.5 and 0.6 only
+    pred = np.array([[0, 0.9, 2, 0, 12, 10]], np.float32)
+    m.update([label], [pred])
+    _, value = m.get()
+    assert abs(value - 2.0 / 4.0) < 1e-6, value
+
+
 def test_voc_map_batched_ndarray_inputs():
     m = VOCMApMetric()
     label, pred = _boxes()

@@ -180,6 +180,50 @@ def test_image_record_iter_threaded_matches_serial(tmp_path):
             np.testing.assert_array_equal(da, db)
 
 
+def test_image_det_record_iter_mirror_flips_boxes(tmp_path):
+    """Detection mirror must move the BOXES with the image (ref:
+    src/io/image_det_aug_default.cc): a bright patch on the left with a
+    box over it stays covered by its box after a random flip."""
+    rec = str(tmp_path / "det.rec")
+    idx = str(tmp_path / "det.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    n = 12
+    for i in range(n):
+        # size-mismatched pack (48x40): detection resizes the FULL frame
+        # to data_shape — normalized boxes stay valid (a center-crop
+        # would silently invalidate them)
+        img = np.zeros((48, 40, 3), np.uint8)
+        img[12:36, 2:12] = 255         # bright patch on the LEFT
+        # det label: [header_width=2, obj_width=5, cls, x0, y0, x1, y1]
+        label = [2, 5, 0.0, 2 / 40, 12 / 48, 12 / 40, 36 / 48]
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, img_fmt=".png"))
+    w.close()
+    it = io.ImageDetRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 32, 32),
+        batch_size=n, rand_mirror=True, seed=5, label_pad_width=7)
+    batch = it.next()
+    data = batch.data[0].asnumpy()
+    labels = batch.label[0].asnumpy()
+    flipped_any = False
+    for img, lab in zip(data, labels):
+        x0, x1 = lab[3], lab[5]
+        assert 0.0 <= x0 < x1 <= 1.0
+        # the bright patch's horizontal center must sit inside the box
+        cols = np.where(img.sum(axis=(0, 1)) > 0)[0]
+        cx = cols.mean() / 32.0
+        assert x0 <= cx <= x1, (x0, cx, x1)
+        if x0 > 0.5:
+            flipped_any = True
+    assert flipped_any, "seeded mirror should flip some of 12 images"
+    # rand_crop is rejected for detection packs (boxes would go stale)
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="rand_crop"):
+        io.ImageDetRecordIter(path_imgrec=rec, path_imgidx=idx,
+                              data_shape=(3, 32, 32), batch_size=2,
+                              rand_crop=True)
+
+
 def test_prefetching_iter():
     data = np.random.randn(20, 3).astype(np.float32)
     inner = io.NDArrayIter(data, np.arange(20), batch_size=5)
