@@ -129,6 +129,38 @@ def main():
     check("train-step loss", loss.asnumpy(), want_loss,
           rtol=1e-3, atol=1e-4)
 
+    # backward parity: autograd gradients vs hand-derived numpy math
+    # (the reference's GPU tier checks both directions — SURVEY §4)
+    xg = nd.array(x)
+    wg = nd.array(w)
+    xg.attach_grad()
+    wg.attach_grad()
+    ct = rng.randn(32, 128).astype(np.float32)
+    with autograd.record():
+        o = mx.nd.FullyConnected(xg, wg, nd.array(b), num_hidden=128)
+        lo = (o * nd.array(ct)).sum()
+    lo.backward()
+    check("FC dL/dx", xg.grad.asnumpy(), ct @ w, rtol=1e-3, atol=1e-4)
+    check("FC dL/dw", wg.grad.asnumpy(), ct.T @ x, rtol=1e-3, atol=1e-4)
+
+    xcg = nd.array(xc)
+    xcg.attach_grad()
+    ctc = rng.randn(4, 12, 14, 14).astype(np.float32)
+    with autograd.record():
+        oc = mx.nd.Convolution(xcg, nd.array(wc), kernel=(3, 3),
+                               num_filter=12, no_bias=True)
+        lc = (oc * nd.array(ctc)).sum()
+    lc.backward()
+    # numpy dL/dx: full-correlation of cotangent with flipped kernels
+    pad_ct = np.zeros((4, 12, 18, 18), np.float32)
+    pad_ct[:, :, 2:16, 2:16] = ctc
+    wflip = wc[:, :, ::-1, ::-1]
+    win_ct = np.lib.stride_tricks.sliding_window_view(
+        pad_ct, (3, 3), axis=(2, 3))
+    want_dx = np.einsum("nohwij,ocij->nchw", win_ct, wflip)
+    check("conv dL/dx", xcg.grad.asnumpy(), want_dx,
+          rtol=1e-3, atol=1e-4)
+
     n_fail = sum(not ok for _, ok, _ in results)
     print(f"hw_parity: {len(results) - n_fail}/{len(results)} ops match "
           f"the CPU oracle on {platform}")

@@ -481,6 +481,11 @@ class ImageRecordIter(DataIter):
         else:
             y, x = (ih - h) // 2, (iw - w) // 2
         img = img[y:y + h, x:x + w]
+        return self._finalize(img, rng)
+
+    def _finalize(self, img, rng):
+        """Shared augment tail: mirror draw, BGR→RGB, CHW, normalize —
+        one definition for the classification and detection paths."""
         mirrored = bool(self._rand_mirror and rng.rand() < 0.5)
         if mirrored:
             img = img[:, ::-1]
@@ -585,13 +590,7 @@ class ImageDetRecordIter(ImageRecordIter):
         c, h, w = self._data_shape
         if img.shape[0] != h or img.shape[1] != w:
             img = cv2.resize(img, (w, h))
-        mirrored = bool(self._rand_mirror and rng.rand() < 0.5)
-        if mirrored:
-            img = img[:, ::-1]
-        img = img[:, :, ::-1]  # BGR (cv2) → RGB, like the reference
-        chw = img.transpose(2, 0, 1).astype(np.float32)
-        chw = (chw - self._mean) / self._std * self._scale
-        return chw, mirrored
+        return self._finalize(img, rng)
 
     def _transform_label(self, label, mirrored):
         """Horizontal flip moves the boxes too: x0' = 1-x1, x1' = 1-x0

@@ -53,7 +53,6 @@ class VOCMApMetric(EvalMetric):
             self._ious = list(dict.fromkeys(float(t) for t in iou_thresh))
         else:
             self._ious = [float(iou_thresh)]
-        self._iou = self._ious[0]
         self._use07 = use_07_metric
         self._class_names = list(class_names) if class_names else None
         super().__init__(name)
@@ -93,6 +92,10 @@ class VOCMApMetric(EvalMetric):
             dt = dt[order] if dt.shape[0] else dt
             iou = _iou_matrix(dt[:, 2:6], gt[:, 1:5]) if dt.shape[0] \
                 else None
+            # threshold-independent best-match per detection, hoisted
+            # out of the ladder loop
+            jbest = iou.argmax(axis=1) if iou is not None and gt.shape[0] \
+                else None
             for thr in self._ious:
                 recs = self._records.setdefault((thr, c), [])
                 if dt.shape[0] == 0:
@@ -102,7 +105,7 @@ class VOCMApMetric(EvalMetric):
                     if gt.shape[0] == 0:
                         recs.append((float(dt[i, 1]), 0))
                         continue
-                    j = int(iou[i].argmax())
+                    j = int(jbest[i])
                     if iou[i, j] >= thr and gt_diff[j]:
                         # difficult GT: every matching detection is
                         # ignored (neither TP nor FP, never "taken" —
