@@ -44,6 +44,9 @@ newaxis = None
 def _unbox(x):
     if isinstance(x, NDArray):
         return x._data
+    if isinstance(x, (list, tuple)):
+        # sequence-of-arrays numpy signatures (concatenate, stack, ...)
+        return [_unbox(e) for e in x]
     return x
 
 
@@ -54,24 +57,46 @@ def _tracked(x):
 
 def _call(fn, *args, **kwargs):
     """Generic tape-aware dispatch of a jnp function over NDArray args —
-    the mx.np analog of _dispatch.invoke (ref: MXImperativeInvokeEx)."""
-    nd_inputs = [a for a in args if isinstance(a, NDArray)]
+    the mx.np analog of _dispatch.invoke (ref: MXImperativeInvokeEx).
+    NDArrays are accepted at top level AND one level inside list/tuple
+    args (the sequence-of-arrays numpy signatures: concatenate, stack,
+    vstack, ...), including on the tape."""
+    # index paths of NDArray args: (i, None) top level, (i, j) in a seq
+    pos = []
+    for i, a in enumerate(args):
+        if isinstance(a, NDArray):
+            pos.append((i, None))
+        elif isinstance(a, (list, tuple)):
+            for j, e in enumerate(a):
+                if isinstance(e, NDArray):
+                    pos.append((i, j))
+    nd_inputs = [args[i] if j is None else args[i][j] for i, j in pos]
     datas = tuple(_unbox(a) for a in args)
+    # kwargs are unboxed too (indices=, condition=, weights= style array
+    # parameters); they enter as CONSTANTS on the tape — numpy kwarg
+    # arrays are index/mask-like and non-differentiable in practice
+    kwargs = {k: _unbox(v) for k, v in kwargs.items()}
     # builtins.any: the generated mx.np.any wrapper shadows the builtin
     # inside this module
     recording = autograd.is_recording() and builtins.any(
         _tracked(a) for a in nd_inputs)
     if recording:
-        pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
-
         def wrapped(*tracked_datas):
-            full = list(datas)
-            for i, d in zip(pos, tracked_datas):
-                full[i] = d
-            return fn(*full, **kwargs)
-        out_data, vjp_fn = jax.vjp(wrapped,
-                                   *[datas[i] for i in pos])
-        outs = list(out_data) if isinstance(out_data, tuple) else [out_data]
+            full = [list(x) if isinstance(x, list) else x for x in datas]
+            for (i, j), d in zip(pos, tracked_datas):
+                if j is None:
+                    full[i] = d
+                else:
+                    full[i][j] = d
+            out = fn(*full, **kwargs)
+            # list outputs (split family) normalize to tuple so the vjp
+            # output pytree matches the tuple cotangents at backward
+            return tuple(out) if isinstance(out, list) else out
+        out_data, vjp_fn = jax.vjp(
+            wrapped, *[datas[i] if j is None else datas[i][j]
+                       for i, j in pos])
+        outs = list(out_data) if isinstance(out_data, (tuple, list)) \
+            else [out_data]
         avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
         parents = []
         for a in nd_inputs:
@@ -85,7 +110,8 @@ def _call(fn, *args, **kwargs):
                                  fwd_inputs=list(nd_inputs))
     else:
         out_data = fn(*datas, **kwargs)
-        outs = list(out_data) if isinstance(out_data, tuple) else [out_data]
+        outs = list(out_data) if isinstance(out_data, (tuple, list)) \
+            else [out_data]
         node = None
     ctx = nd_inputs[0].ctx if nd_inputs else current_context()
     results = []

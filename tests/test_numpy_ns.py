@@ -21,6 +21,156 @@ def test_np_basic_functions_match_numpy():
                                 x.mean(axis=0, keepdims=True), rtol=1e-6)
 
 
+def _r(*shape, seed=0, pos=False, scale=1.0):
+    x = onp.random.RandomState(seed).randn(*shape).astype(onp.float32)
+    x = x * scale
+    return onp.abs(x) + 0.5 if pos else x
+
+
+# systematic numpy-parity sweep (ref: test_numpy_op.py breadth): each row
+# is (callable on mx.np + onp given numpy inputs, inputs). The same
+# lambda body runs against both namespaces — any signature or semantics
+# drift fails the row.
+_SWEEP = {
+    "log": (lambda np_, x: np_.log(x), [_r(3, 4, pos=True)]),
+    "sqrt": (lambda np_, x: np_.sqrt(x), [_r(3, 4, pos=True)]),
+    "square": (lambda np_, x: np_.square(x), [_r(3, 4)]),
+    "cbrt": (lambda np_, x: np_.cbrt(x), [_r(3, 4, pos=True)]),
+    "reciprocal": (lambda np_, x: np_.reciprocal(x),
+                   [_r(3, 4, pos=True)]),
+    "sin_cos": (lambda np_, x: np_.sin(x) + np_.cos(x), [_r(3, 4)]),
+    "arctan2": (lambda np_, a, b: np_.arctan2(a, b),
+                [_r(3, 4), _r(3, 4, seed=1, pos=True)]),
+    "hypot": (lambda np_, a, b: np_.hypot(a, b),
+              [_r(3, 4), _r(3, 4, seed=2)]),
+    "maximum": (lambda np_, a, b: np_.maximum(a, b),
+                [_r(3, 4), _r(3, 4, seed=3)]),
+    "clip": (lambda np_, x: np_.clip(x, -0.5, 0.5), [_r(3, 4)]),
+    "rint": (lambda np_, x: np_.rint(x), [_r(3, 4, scale=3.0)]),
+    "trunc": (lambda np_, x: np_.trunc(x), [_r(3, 4, scale=3.0)]),
+    "prod": (lambda np_, x: np_.prod(x, axis=1),
+             [_r(3, 4, pos=True)]),
+    "cumsum": (lambda np_, x: np_.cumsum(x, axis=1), [_r(3, 4)]),
+    "std_var": (lambda np_, x: np_.std(x, axis=0) + np_.var(x, axis=0),
+                [_r(5, 4)]),
+    "argmax_argmin": (
+        lambda np_, x: np_.argmax(x, axis=1) + np_.argmin(x, axis=1),
+        [_r(3, 4)]),
+    "sort": (lambda np_, x: np_.sort(x, axis=-1), [_r(3, 4)]),
+    "argsort": (lambda np_, x: np_.argsort(x, axis=-1), [_r(3, 4)]),
+    "where": (lambda np_, a, b: np_.where(a > 0, a, b),
+              [_r(3, 4), _r(3, 4, seed=4)]),
+    "concatenate": (
+        lambda np_, a, b: np_.concatenate([a, b], axis=1),
+        [_r(2, 3), _r(2, 4, seed=5)]),
+    "stack": (
+        lambda np_, a, b: np_.stack([a, b], axis=0),
+        [_r(2, 3), _r(2, 3, seed=6)]),
+    "split": (
+        lambda np_, x: np_.split(x, 2, 1)[0] + np_.split(x, 2, 1)[1],
+        [_r(3, 4)]),
+    "take_kwarg": (
+        lambda np_, x: np_.take(x, onp.array([0, 2]), axis=1)
+        if np_ is onp else np_.take(x, np_.array([0, 2]), axis=1),
+        [_r(3, 4)]),
+    "transpose_swap": (
+        lambda np_, x: np_.swapaxes(np_.transpose(x), 0, 1),
+        [_r(3, 4)]),
+    "expand_squeeze": (
+        lambda np_, x: np_.squeeze(np_.expand_dims(x, 1), 1),
+        [_r(3, 4)]),
+    "tile_repeat": (lambda np_, x: np_.tile(x, (2, 1)), [_r(2, 3)]),
+    "flip": (lambda np_, x: np_.flip(x, axis=1), [_r(3, 4)]),
+    "roll": (lambda np_, x: np_.roll(x, 2, axis=1), [_r(3, 4)]),
+    "dot_tensordot": (
+        lambda np_, a, b: np_.tensordot(a, b, axes=([1], [0])),
+        [_r(3, 4), _r(4, 2, seed=7)]),
+    "outer_inner": (lambda np_, a, b: np_.outer(a, b),
+                    [_r(3), _r(4, seed=8)]),
+    "trace_diag": (
+        lambda np_, x: np_.trace(x) + np_.sum(np_.diag(x)),
+        [_r(4, 4)]),
+    "tril_triu": (lambda np_, x: np_.tril(x) + np_.triu(x, 1),
+                  [_r(4, 4)]),
+    "eye_full": (
+        lambda np_, x: x + np_.eye(4, dtype=onp.float32), [_r(4, 4)]),
+    "linspace": (
+        lambda np_, x: x + np_.linspace(
+            0.0, 1.0, 4, dtype=onp.float32), [_r(3, 4)]),
+    "isnan_isinf": (
+        lambda np_, x: np_.isnan(x).astype(onp.float32)
+        + np_.isinf(x).astype(onp.float32), [_r(3, 4)]),
+    "logical": (
+        lambda np_, a, b: np_.logical_and(a > 0, b > 0)
+        .astype(onp.float32), [_r(3, 4), _r(3, 4, seed=9)]),
+    "power_mod": (lambda np_, a, b: np_.power(a, 2.0) + np_.mod(b, 2.0),
+                  [_r(3, 4, pos=True), _r(3, 4, seed=10, pos=True)]),
+    "minmax_reduce": (
+        lambda np_, x: np_.max(x, axis=0) - np_.min(x, axis=1,
+                                                    keepdims=False)[:3],
+        [_r(4, 3)]),
+    "ravel_reshape": (
+        lambda np_, x: np_.reshape(np_.ravel(x), (4, 3)), [_r(3, 4)]),
+    "atleast_broadcast_to": (
+        lambda np_, x: np_.broadcast_to(x, (2, 3, 4)), [_r(3, 4)]),
+}
+
+
+import pytest
+
+
+@pytest.mark.parametrize("name", sorted(_SWEEP))
+def test_np_parity_sweep(name):
+    fn, inputs = _SWEEP[name]
+    want = fn(onp, *inputs)
+    got = fn(mx.np, *[mx.np.array(x) for x in inputs])
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    onp.testing.assert_allclose(got, onp.asarray(want), rtol=1e-5,
+                                atol=1e-6, err_msg=name)
+
+
+def test_np_split_boxed_and_differentiable():
+    """List-RETURNING ops (split family) box every part as NDArray and
+    work on the tape."""
+    x = mx.np.array(onp.arange(12, dtype=onp.float32).reshape(3, 4))
+    parts = mx.np.split(x, 2, 1)
+    assert all(hasattr(p, "asnumpy") for p in parts)
+    onp.testing.assert_allclose(parts[1].asnumpy(),
+                                onp.arange(12).reshape(3, 4)[:, 2:])
+    x.attach_grad()
+    with autograd.record():
+        a, b = mx.np.split(x, 2, 1)
+        loss = (a * 2).sum() + (b * 3).sum()
+    loss.backward()
+    want = onp.concatenate([onp.full((3, 2), 2.0),
+                            onp.full((3, 2), 3.0)], axis=1)
+    onp.testing.assert_allclose(x.grad.asnumpy(), want)
+
+
+def test_np_kwarg_array_args_unboxed():
+    """Array-valued keyword args (indices=, condition=) are unboxed."""
+    x = mx.np.array(onp.arange(12, dtype=onp.float32).reshape(3, 4))
+    got = mx.np.take(x, indices=mx.np.array([0, 2]), axis=1)
+    onp.testing.assert_allclose(
+        got.asnumpy(), onp.arange(12).reshape(3, 4)[:, [0, 2]])
+
+
+def test_np_concatenate_gradient_through_sequence_args():
+    """Tape support for sequence-of-arrays signatures: gradients flow to
+    every NDArray inside the list argument."""
+    a = mx.np.array(onp.ones((2, 3), onp.float32))
+    b = mx.np.array(onp.full((2, 3), 2.0, onp.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = mx.np.concatenate([a, b], axis=1)
+        loss = (out * out).sum()
+    loss.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), 2 * onp.ones((2, 3)))
+    onp.testing.assert_allclose(b.grad.asnumpy(),
+                                4 * onp.ones((2, 3)))
+
+
 def test_np_zero_dim_and_broadcasting():
     """The semantics the reference built mx.np for: 0-d arrays, numpy
     broadcasting, integer dtypes."""
