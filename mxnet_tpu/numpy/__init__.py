@@ -61,7 +61,10 @@ def _call(fn, *args, **kwargs):
     NDArrays are accepted at top level AND one level inside list/tuple
     args (the sequence-of-arrays numpy signatures: concatenate, stack,
     vstack, ...), including on the tape."""
-    # index paths of NDArray args: (i, None) top level, (i, j) in a seq
+    # index paths of NDArray args: (loc, j) with loc an int positional
+    # index or a str kwarg key; j indexes one sequence level (or None).
+    # Kwarg arrays participate in the tape exactly like positional ones
+    # (np.average's weights= IS differentiable).
     pos = []
     for i, a in enumerate(args):
         if isinstance(a, NDArray):
@@ -70,12 +73,22 @@ def _call(fn, *args, **kwargs):
             for j, e in enumerate(a):
                 if isinstance(e, NDArray):
                     pos.append((i, j))
-    nd_inputs = [args[i] if j is None else args[i][j] for i, j in pos]
+    for k, v in kwargs.items():
+        if isinstance(v, NDArray):
+            pos.append((k, None))
+        elif isinstance(v, (list, tuple)):
+            for j, e in enumerate(v):
+                if isinstance(e, NDArray):
+                    pos.append((k, j))
+
+    def _at(container_args, container_kwargs, loc, j):
+        src = container_kwargs[loc] if isinstance(loc, str) \
+            else container_args[loc]
+        return src if j is None else src[j]
+
+    nd_inputs = [_at(args, kwargs, loc, j) for loc, j in pos]
     datas = tuple(_unbox(a) for a in args)
-    # kwargs are unboxed too (indices=, condition=, weights= style array
-    # parameters); they enter as CONSTANTS on the tape — numpy kwarg
-    # arrays are index/mask-like and non-differentiable in practice
-    kwargs = {k: _unbox(v) for k, v in kwargs.items()}
+    kwdatas = {k: _unbox(v) for k, v in kwargs.items()}
     # builtins.any: the generated mx.np.any wrapper shadows the builtin
     # inside this module
     recording = autograd.is_recording() and builtins.any(
@@ -83,18 +96,20 @@ def _call(fn, *args, **kwargs):
     if recording:
         def wrapped(*tracked_datas):
             full = [list(x) if isinstance(x, list) else x for x in datas]
-            for (i, j), d in zip(pos, tracked_datas):
+            fkw = {k: (list(v) if isinstance(v, list) else v)
+                   for k, v in kwdatas.items()}
+            for (loc, j), d in zip(pos, tracked_datas):
+                tgt = fkw if isinstance(loc, str) else full
                 if j is None:
-                    full[i] = d
+                    tgt[loc] = d
                 else:
-                    full[i][j] = d
-            out = fn(*full, **kwargs)
+                    tgt[loc][j] = d
+            out = fn(*full, **fkw)
             # list outputs (split family) normalize to tuple so the vjp
             # output pytree matches the tuple cotangents at backward
             return tuple(out) if isinstance(out, list) else out
         out_data, vjp_fn = jax.vjp(
-            wrapped, *[datas[i] if j is None else datas[i][j]
-                       for i, j in pos])
+            wrapped, *[_at(datas, kwdatas, loc, j) for loc, j in pos])
         outs = list(out_data) if isinstance(out_data, (tuple, list)) \
             else [out_data]
         avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
@@ -109,7 +124,7 @@ def _call(fn, *args, **kwargs):
         node = autograd.TapeNode(vjp_fn, parents, avals, fwd_fn=wrapped,
                                  fwd_inputs=list(nd_inputs))
     else:
-        out_data = fn(*datas, **kwargs)
+        out_data = fn(*datas, **kwdatas)
         outs = list(out_data) if isinstance(out_data, (tuple, list)) \
             else [out_data]
         node = None

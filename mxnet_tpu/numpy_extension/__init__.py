@@ -120,3 +120,62 @@ def dropout(x, p=0.5, **kwargs):
 def seed(s):
     from .. import random as _random
     _random.seed(s)
+
+
+# the rest of the reference's most-used `_npx_*` family: thin adapters
+# over the registry ops (same numerics / autograd as mx.nd)
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    return nd.batch_dot(a, b, transpose_a=transpose_a,
+                        transpose_b=transpose_b)
+
+
+def gather_nd(data, indices):
+    return nd.gather_nd(data, indices)
+
+
+def reshape_like(lhs, rhs):
+    return nd.reshape_like(lhs, rhs)
+
+
+def broadcast_like(lhs, rhs):
+    return nd.broadcast_like(lhs, rhs)
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    return nd.arange_like(data, start=start, step=step, axis=axis)
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    # the flag is authoritative (reference semantics): with
+    # use_sequence_length=False the data passes through unmasked even if
+    # a sequence_length tensor was supplied
+    args = [data] + ([sequence_length]
+                     if use_sequence_length and sequence_length is not None
+                     else [])
+    return nd.SequenceMask(*args, use_sequence_length=bool(args[1:]),
+                           value=value, axis=axis)
+
+
+def smooth_l1(data, scalar=1.0):
+    return nd.smooth_l1(data, scalar=scalar)
+
+
+def slice(data, begin, end, step=None):        # noqa: A001 (ref name)
+    kwargs = {"begin": begin, "end": end}
+    if step is not None:
+        kwargs["step"] = step
+    return nd.slice(data, **kwargs)
+
+
+def slice_like(data, shape_like, axes=None):
+    return nd.slice_like(data, shape_like, axes=axes)
+
+
+def waitall():
+    nd.waitall()
+
+
+__all__ += ["batch_dot", "gather_nd", "reshape_like", "broadcast_like",
+            "arange_like", "sequence_mask", "smooth_l1", "slice",
+            "slice_like", "waitall"]

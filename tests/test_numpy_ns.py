@@ -2,6 +2,7 @@
 test_numpy_op.py / test_numpy_ndarray.py, shrunk to the semantics that
 matter: numpy-identical results + autograd through the np namespace)."""
 import numpy as onp
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd
@@ -116,9 +117,6 @@ _SWEEP = {
 }
 
 
-import pytest
-
-
 @pytest.mark.parametrize("name", sorted(_SWEEP))
 def test_np_parity_sweep(name):
     fn, inputs = _SWEEP[name]
@@ -153,6 +151,52 @@ def test_np_kwarg_array_args_unboxed():
     got = mx.np.take(x, indices=mx.np.array([0, 2]), axis=1)
     onp.testing.assert_allclose(
         got.asnumpy(), onp.arange(12).reshape(3, 4)[:, [0, 2]])
+
+
+def test_np_kwarg_array_gradient():
+    """Tracked kwarg arrays are ON the tape (np.average's weights= is
+    differentiable) — including when ONLY the kwarg array is tracked."""
+    x_np = onp.array([1.0, 2.0, 3.0, 4.0], onp.float32)
+    w = mx.np.array(onp.full(4, 0.25, onp.float32))
+    w.attach_grad()
+    with autograd.record():
+        out = mx.np.average(mx.np.array(x_np), weights=w)
+        loss = out * out
+    loss.backward()
+    # d/dw_i of (sum(w x)/sum(w))^2 at uniform w: 2*avg*(x_i - avg)
+    avg = x_np.mean()
+    want = 2 * avg * (x_np - avg)
+    onp.testing.assert_allclose(w.grad.asnumpy(), want, rtol=1e-5)
+
+
+def test_npx_extension_breadth():
+    """npx adapters over the registry ops (ref: the `_npx_*` family)."""
+    from mxnet_tpu import npx
+    x = mx.np.array(onp.arange(24, dtype=onp.float32).reshape(2, 3, 4))
+    assert npx.batch_dot(
+        x, mx.np.array(onp.ones((2, 4, 2), onp.float32))).shape == (2, 3, 2)
+    onp.testing.assert_allclose(
+        npx.gather_nd(x, mx.nd.array([[0, 1], [1, 2]])).asnumpy(),
+        onp.arange(24).reshape(2, 3, 4)[[0, 1], [1, 2]])
+    assert npx.reshape_like(
+        x, mx.np.array(onp.zeros((6, 4)))).shape == (6, 4)
+    assert npx.slice(x, begin=(0, 1), end=(2, 3)).shape == (2, 2, 4)
+    masked = npx.sequence_mask(x, mx.nd.array([1, 2]),
+                               use_sequence_length=True, axis=1).asnumpy()
+    assert masked.shape == (2, 3, 4)
+    assert (masked[0, 1:] == 0).all() and (masked[1, 2:] == 0).all()
+    # the flag is authoritative: False passes data through unmasked
+    onp.testing.assert_allclose(
+        npx.sequence_mask(x, mx.nd.array([1, 2]),
+                          use_sequence_length=False, axis=1).asnumpy(),
+        x.asnumpy())
+    onp.testing.assert_allclose(npx.arange_like(x, axis=1).asnumpy(),
+                                [0, 1, 2])
+    onp.testing.assert_allclose(
+        npx.smooth_l1(mx.np.array(onp.array([0.5, 2.0],
+                                            onp.float32))).asnumpy(),
+        [0.125, 1.5])
+    npx.waitall()
 
 
 def test_np_concatenate_gradient_through_sequence_args():
