@@ -149,11 +149,13 @@ def sequence_mask(data, sequence_length=None, use_sequence_length=False,
                   value=0.0, axis=0):
     # the flag is authoritative (reference semantics): with
     # use_sequence_length=False the data passes through unmasked even if
-    # a sequence_length tensor was supplied
-    args = [data] + ([sequence_length]
-                     if use_sequence_length and sequence_length is not None
-                     else [])
-    return nd.SequenceMask(*args, use_sequence_length=bool(args[1:]),
+    # a sequence_length tensor was supplied; with it True the lengths
+    # are REQUIRED (silent pass-through would corrupt attention/losses)
+    if use_sequence_length and sequence_length is None:
+        raise MXNetError("sequence_mask: use_sequence_length=True "
+                         "requires a sequence_length tensor")
+    args = [data] + ([sequence_length] if use_sequence_length else [])
+    return nd.SequenceMask(*args, use_sequence_length=use_sequence_length,
                            value=value, axis=axis)
 
 

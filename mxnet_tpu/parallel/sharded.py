@@ -95,15 +95,37 @@ def _zeros_like(w):
     return jnp.zeros(w.shape, w.dtype)
 
 
+def _state_spec(weight_spec, entry):
+    """State entries shard like their weight; scalar entries (Nadam's
+    schedule product) are replicated. ONE rule for placement and the jit
+    in/out shardings — divergence between those produces opaque XLA
+    sharding mismatches."""
+    return weight_spec if getattr(entry, "ndim", 0) else PartitionSpec()
+
+
 def _opt_init_state(opt, w):
     name = type(opt).__name__
     if name in ("SGD", "NAG", "Signum"):
         mom = getattr(opt, "momentum", 0.0)
         return (_zeros_like(w),) if mom != 0.0 else ()
-    if name in ("Adam", "AdamW", "LAMB", "FTRL"):
-        return (_zeros_like(w), _zeros_like(w))
+    if name in ("Adam", "AdamW", "LAMB", "FTRL", "AdaDelta", "Nadam"):
+        state = (_zeros_like(w), _zeros_like(w))
+        if name == "Nadam":
+            # Nadam's momentum-schedule running product is carried as a
+            # scalar state entry (no closed form over a traced t)
+            state = state + (jnp.ones((), jnp.float32),)
+        return state
     if name in ("RMSProp", "AdaGrad"):
         return (_zeros_like(w),)
+    if name == "DCASGD":
+        # a real COPY: weights and states are donated separately — the
+        # same underlying buffer in both would be donated twice
+        prev = jnp.array(w, copy=True)
+        if getattr(opt, "momentum", 0.0) != 0.0:
+            return (_zeros_like(w), prev)
+        return (prev,)
+    if name == "FTML":
+        return (_zeros_like(w), _zeros_like(w), _zeros_like(w))
     if name == "SGLD":
         return ()
     raise MXNetError(
@@ -167,6 +189,63 @@ def _opt_apply(opt, w, g, state, lr, t, wd, rescale, clip):
         m2 = state[0] * opt.momentum - g32 * (1 - opt.momentum)
         w2 = w * (1 - lr * opt.wd_lh) + jnp.sign(m2) * lr
         return w2.astype(w.dtype), (m2,)
+
+    def _g32():
+        gg = g.astype(jnp.float32) * rescale
+        gg = jnp.where(clip > 0, jnp.clip(gg, -clip, clip), gg)
+        return gg + wd * w.astype(jnp.float32)
+
+    if name == "AdaDelta":
+        acc_g, acc_d = state
+        gg = _g32()
+        acc_g2 = opt.rho * acc_g + (1 - opt.rho) * gg * gg
+        delta = jnp.sqrt(acc_d + opt.epsilon) / \
+            jnp.sqrt(acc_g2 + opt.epsilon) * gg
+        acc_d2 = opt.rho * acc_d + (1 - opt.rho) * delta * delta
+        return (w.astype(jnp.float32) - delta).astype(w.dtype), \
+            (acc_g2, acc_d2)
+    if name == "Nadam":
+        # note: the eager reference updates its m_schedule product once
+        # per update() CALL (i.e. per parameter per step — an upstream
+        # quirk); this functional rule keeps the schedule per-parameter,
+        # the form the Nadam paper intends. Trajectories differ at the
+        # 1e-4 level over a few steps.
+        mean, var, msched = state
+        gg = _g32()
+        d = opt.schedule_decay
+        mom_t = opt.beta1 * (1 - 0.5 * 0.96 ** (t * d))
+        mom_t1 = opt.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * d))
+        msched2 = msched * mom_t
+        msched_next = msched2 * mom_t1
+        m2 = opt.beta1 * mean + (1 - opt.beta1) * gg
+        v2 = opt.beta2 * var + (1 - opt.beta2) * gg * gg
+        g_p = gg / (1 - msched2)
+        m_p = m2 / (1 - msched_next)
+        v_p = v2 / (1 - opt.beta2 ** t)
+        m_bar = (1 - mom_t) * g_p + mom_t1 * m_p
+        w2 = w.astype(jnp.float32) - lr * m_bar / (jnp.sqrt(v_p)
+                                                   + opt.epsilon)
+        return w2.astype(w.dtype), (m2, v2, msched2)
+    if name == "DCASGD":
+        gg = g.astype(jnp.float32) * rescale
+        gg = jnp.where(clip > 0, jnp.clip(gg, -clip, clip), gg)
+        prev = state[-1]
+        w32 = w.astype(jnp.float32)
+        comp = gg + wd * w32 + opt.lamda * gg * gg * (w32 - prev)
+        if len(state) == 1:
+            return (w32 - lr * comp).astype(w.dtype), (w32,)
+        m2 = opt.momentum * state[0] - lr * comp
+        return (w32 + m2).astype(w.dtype), (m2, w32)
+    if name == "FTML":
+        dst, vst, zst = state
+        gg = _g32()
+        v2 = opt.beta2 * vst + (1 - opt.beta2) * gg * gg
+        d2 = (1 - opt.beta1 ** t) / lr * (
+            jnp.sqrt(v2 / (1 - opt.beta2 ** t)) + opt.epsilon)
+        sigma = d2 - opt.beta1 * dst
+        z2 = opt.beta1 * zst + (1 - opt.beta1) * gg - sigma * \
+            w.astype(jnp.float32)
+        return (-z2 / d2).astype(w.dtype), (d2, v2, z2)
     raise MXNetError(f"no functional update for {name}")
 
 
@@ -303,11 +382,13 @@ class ShardedTrainer:
             p._data[0]._rebind(self._shard(w, spec))
         for p, spec in zip(aux, self._aux_specs):
             p._data[0]._rebind(self._shard(p._data[0]._data, spec))
-        # optimizer state, sharded like its weight
+        # optimizer state, sharded like its weight (scalar state entries
+        # — e.g. Nadam's momentum-schedule product — are replicated)
         self._states = []
         for p, spec in zip(trainable, self._tr_specs):
             state = _opt_init_state(self._optimizer, p._data[0]._data)
-            self._states.append(tuple(self._shard(s, spec) for s in state))
+            self._states.append(tuple(
+                self._shard(s, _state_spec(spec, s)) for s in state))
         self._prepared = True
 
     # -- the compiled step ---------------------------------------------------
@@ -378,7 +459,7 @@ class ShardedTrainer:
         in_shardings = (
             [ns(s) for s in self._tr_specs],
             [ns(s) for s in self._aux_specs],
-            [tuple(ns(s) for _ in st)
+            [tuple(ns(_state_spec(s, e)) for e in st)
              for s, st in zip(self._tr_specs, self._states)],
             rep, rep, rep, rep,
         ) + tuple(jax.tree_util.tree_map(
@@ -386,7 +467,7 @@ class ShardedTrainer:
         out_shardings = (
             [ns(s) for s in self._tr_specs],
             [ns(s) for s in self._aux_specs],
-            [tuple(ns(s) for _ in st)
+            [tuple(ns(_state_spec(s, e)) for e in st)
              for s, st in zip(self._tr_specs, self._states)],
             rep, None,
         )

@@ -190,6 +190,9 @@ def test_npx_extension_breadth():
         npx.sequence_mask(x, mx.nd.array([1, 2]),
                           use_sequence_length=False, axis=1).asnumpy(),
         x.asnumpy())
+    # True without lengths must fail loudly, not silently skip masking
+    with pytest.raises(Exception, match="sequence_length"):
+        npx.sequence_mask(x, use_sequence_length=True, axis=1)
     onp.testing.assert_allclose(npx.arange_like(x, axis=1).asnumpy(),
                                 [0, 1, 2])
     onp.testing.assert_allclose(
