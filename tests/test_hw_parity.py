@@ -1,0 +1,16 @@
+"""The hardware-parity sweep doubles as a CI self-check: on CPU the
+"device" and the oracle share a backend, so this validates the sweep's
+own oracles (numpy formulas, shapes, tolerances) — the TPU run
+(`benchmarks/hw_parity.py` on the chip) then measures real divergence
+against known-good math. Ref: SURVEY §4's check_consistency tier."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))        # repo root: benchmarks/ is not
+                                        # an installed package
+import benchmarks.hw_parity as hw
+
+
+def test_parity_sweep_oracles_self_consistent():
+    assert hw.main() == 0
