@@ -190,24 +190,50 @@ class TransformerModel(HybridBlock):
         mem = self.encode(src)
         b = src.shape[0]
         if beam_size <= 1:
-            tokens = onp.full((b, 1), bos_id, dtype=onp.int32)
-            finished = onp.zeros(b, bool)
-            for _ in range(max_steps):
-                tgt = nd.array(tokens)
-                dec = self.decoder(self._embed(nd, tgt, self.tgt_embed,
-                                               self.pos_weight.data()),
-                                   mem)
-                # project + transfer the LAST step only (same O(T·V)
-                # fix as the beam branch)
-                dec_last = nd.slice_axis(dec, axis=1, begin=-1, end=None)
-                nxt = self.output(dec_last).asnumpy()[:, 0].argmax(axis=-1)
-                nxt = onp.where(finished, eos_id, nxt)
-                tokens = onp.concatenate(
-                    [tokens, nxt[:, None].astype(onp.int32)], axis=1)
-                finished |= nxt == eos_id
-                if finished.all():
-                    break
-            return tokens[:, 1:]
+            # greedy decode as a contrib.while_loop over a FIXED (B, L)
+            # token buffer (ref: the control-flow op rewrite directed by
+            # src/operator/control_flow.cc parity): every step runs the
+            # decoder at ONE static shape — a single XLA program instead
+            # of max_steps growing-prefix compilations — and the causal
+            # decoder mask makes position `step` independent of the
+            # padding beyond it. Early exit when every row emitted EOS
+            # is the loop condition, like the reference's imperative path.
+            # the fixed buffer is embedded whole every step, so it must
+            # fit the positional table: cap the decode length at
+            # max_length rows (the growing-prefix loop hit the same
+            # ceiling one token later)
+            length = min(max_steps + 1, self._max_length)
+            max_steps = length - 1
+            tokens0 = nd.concat(
+                nd.full((b, 1), bos_id, dtype="int32"),
+                nd.zeros((b, max_steps), dtype="int32"), dim=1)
+            step0 = nd.zeros((1,))
+            finished0 = nd.zeros((b,))
+
+            def decode_cond(step, tokens, finished):
+                return (step < max_steps) * (finished.sum() < b)
+
+            def decode_step(step, tokens, finished):
+                dec = self.decoder(
+                    self._embed(nd, tokens, self.tgt_embed,
+                                self.pos_weight.data()), mem)
+                # project only the current position (O(V) not O(L·V))
+                dec_t = nd.take(dec, step.astype("int32"), axis=1)
+                logits = self.output(dec_t)              # (B, 1, V)
+                nxt = logits.reshape(b, -1).argmax(axis=-1)
+                nxt = nd.where(finished, nd.full((b,), eos_id), nxt)
+                col = nd.one_hot(step.astype("int32") + 1, depth=length)
+                tokens = (tokens * (1 - col) +
+                          nd.broadcast_mul(nxt.reshape(b, 1), col)) \
+                    .astype("int32")
+                finished = nd.broadcast_maximum(
+                    finished, (nxt == eos_id).astype("float32"))
+                return [], [step + 1, tokens, finished]
+
+            _, (steps, tokens, _fin) = nd.contrib.while_loop(
+                decode_cond, decode_step, [step0, tokens0, finished0],
+                max_iterations=max_steps)
+            return tokens.asnumpy()[:, 1:1 + int(steps.asnumpy()[0])]
 
         # beam search: expand memory to (B*K, Sk, C), track per-beam
         # cumulative log-probs; finished beams only extend with EOS at

@@ -129,6 +129,14 @@ def _nd_tostype(self, stype):
 
 NDArray.tostype = _nd_tostype
 
+# control-flow ops take Python callables, so they bypass the registry
+# (ref: python/mxnet/ndarray/contrib.py foreach/while_loop/cond)
+from ..ops import control_flow as _control_flow  # noqa: E402
+
+contrib.foreach = _control_flow.foreach
+contrib.while_loop = _control_flow.while_loop
+contrib.cond = _control_flow.cond
+
 random.shuffle = getattr(_internal, "_shuffle")
 random.bernoulli = _make_wrapper("_random_bernoulli",
                                  _registry.get("_random_bernoulli"))

@@ -1098,3 +1098,142 @@ def _fused_cross_attention(q_in, kv, heads=None, block_size=512):
                            k.transpose(0, 2, 1, 3),
                            v.transpose(0, 2, 1, 3), block_size=block_size)
     return out.transpose(0, 2, 1, 3).reshape(b, sq, c)
+
+
+# ---------------------------------------------------------------------------
+# FFT (ref: src/operator/contrib/fft.cc, ifft.cc)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_fft", aliases=["fft"],
+          params=[OpParam("compute_size", int, 128)],
+          doc="1-D FFT over the last axis; real input (..., d) -> "
+              "interleaved real/imag output (..., 2*d), matching the "
+              "reference's cuFFT wire format "
+              "(ref: src/operator/contrib/fft.cc). compute_size (the "
+              "reference's batching knob for cuFFT plans) is accepted "
+              "and ignored — XLA plans the whole batch at once.")
+def _fft(x, compute_size=128):
+    spec = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)
+    return out.reshape(x.shape[:-1] + (2 * x.shape[-1],)).astype(jnp.float32)
+
+
+@register("_contrib_ifft", aliases=["ifft"],
+          params=[OpParam("compute_size", int, 128)],
+          doc="Inverse of _contrib_fft: interleaved (..., 2*d) -> real "
+              "(..., d). Like the reference (cuFFT CUFFT_INVERSE), the "
+              "output is UNNORMALIZED: ifft(fft(x)) == d * x "
+              "(ref: src/operator/contrib/ifft.cc).")
+def _ifft(x, compute_size=128):
+    d = x.shape[-1] // 2
+    pairs = x.reshape(x.shape[:-1] + (d, 2)).astype(jnp.float32)
+    spec = lax.complex(pairs[..., 0], pairs[..., 1])
+    # unnormalized inverse = conj(fft(conj(spec))); jnp.fft.ifft divides
+    # by d, so scale back up to match the reference wire format
+    return (jnp.fft.ifft(spec, axis=-1).real * d).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Spatial sampling trio (ref: src/operator/{grid_generator,
+# bilinear_sampler, spatial_transformer}.cc). All three share one
+# bilinear-gather core, the same machinery ROIAlign/DeformableConv use,
+# but with the reference's zero-padding boundary (outside samples read 0)
+# instead of border clamping.
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample_zero_pad(img, xf, yf):
+    """Sample img (C, H, W) at float pixel coords xf/yf (...,) with
+    bilinear interpolation and zero padding outside; differentiable in
+    img and coords. Vectorized: one advanced-indexing gather per corner,
+    which XLA lowers to a single gather + FMA chain per corner (VPU
+    work), the TPU-native shape of the reference's per-pixel CUDA loop."""
+    h, w = img.shape[1], img.shape[2]
+    x0 = jnp.floor(xf)
+    y0 = jnp.floor(yf)
+    wx = xf - x0
+    wy = yf - y0
+
+    def corner(yi, xi, wgt):
+        inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        vals = img[:, yc, xc]                     # (C, ...)
+        return jnp.where(inb[None], vals * wgt[None], 0.0)
+
+    return (corner(y0, x0, (1 - wy) * (1 - wx))
+            + corner(y0 + 1, x0, wy * (1 - wx))
+            + corner(y0, x0 + 1, (1 - wy) * wx)
+            + corner(y0 + 1, x0 + 1, wy * wx))
+
+
+@register("BilinearSampler", num_inputs=2,
+          params=[OpParam("cudnn_off", bool, False)],
+          doc="Sample data (B, C, H, W) at grid (B, 2, Ho, Wo) of "
+              "normalized [-1, 1] (x, y) coords; zero padding outside "
+              "(ref: src/operator/bilinear_sampler.cc). x maps to "
+              "(x+1)*(W-1)/2 like the reference.")
+def _bilinear_sampler(data, grid, cudnn_off=False):
+    h, w = data.shape[2], data.shape[3]
+
+    def one(img, g):
+        xf = (g[0] + 1.0) * (w - 1.0) / 2.0
+        yf = (g[1] + 1.0) * (h - 1.0) / 2.0
+        return _bilinear_sample_zero_pad(img, xf, yf)
+
+    return jax.vmap(one)(data, grid)
+
+
+@register("GridGenerator", num_inputs=1,
+          params=[OpParam("transform_type", str, "affine", required=True),
+                  OpParam("target_shape", tuple, (0, 0))],
+          doc="Generate BilinearSampler grids "
+              "(ref: src/operator/grid_generator.cc). 'affine': data "
+              "(B, 6) 2x3 matrices over a normalized [-1, 1] target "
+              "grid -> (B, 2, H, W). 'warp': data = pixel flow "
+              "(B, 2, H, W) added to the identity grid, normalized.")
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    if transform_type == "affine":
+        hh, ww = int(target_shape[0]), int(target_shape[1])
+        b = data.shape[0]
+        ys = jnp.linspace(-1.0, 1.0, hh) if hh > 1 else jnp.zeros((1,))
+        xs = jnp.linspace(-1.0, 1.0, ww) if ww > 1 else jnp.zeros((1,))
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        src = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, H*W)
+        theta = data.reshape(b, 2, 3).astype(jnp.float32)
+        grid = jnp.einsum("bij,jk->bik", theta, src)             # (B, 2, H*W)
+        return grid.reshape(b, 2, hh, ww).astype(data.dtype)
+    if transform_type == "warp":
+        b, _, hh, ww = data.shape
+        base_x, base_y = jnp.meshgrid(jnp.arange(ww, dtype=jnp.float32),
+                                      jnp.arange(hh, dtype=jnp.float32),
+                                      indexing="xy")
+        x = data[:, 0] + base_x
+        y = data[:, 1] + base_y
+        xn = x * (2.0 / max(ww - 1, 1)) - 1.0
+        yn = y * (2.0 / max(hh - 1, 1)) - 1.0
+        return jnp.stack([xn, yn], axis=1).astype(data.dtype)
+    raise MXNetError(f"GridGenerator: unknown transform_type {transform_type!r}")
+
+
+@register("SpatialTransformer", num_inputs=2,
+          params=[OpParam("transform_type", str, "affine", required=True),
+                  OpParam("sampler_type", str, "bilinear", required=True),
+                  OpParam("target_shape", tuple, (0, 0)),
+                  OpParam("cudnn_off", bool, False)],
+          doc="Affine spatial transformer = GridGenerator('affine') + "
+              "BilinearSampler, fused in one traced graph so XLA shares "
+              "the grid across channels "
+              "(ref: src/operator/spatial_transformer.cc).")
+def _spatial_transformer(data, loc, transform_type="affine",
+                         sampler_type="bilinear", target_shape=(0, 0),
+                         cudnn_off=False):
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports transform_type='affine'"
+                         " sampler_type='bilinear' (like the reference)")
+    hh, ww = int(target_shape[0]), int(target_shape[1])
+    if hh <= 0 or ww <= 0:
+        hh, ww = data.shape[2], data.shape[3]
+    grid = _grid_generator(loc, transform_type="affine",
+                           target_shape=(hh, ww))
+    return _bilinear_sampler(data, grid)

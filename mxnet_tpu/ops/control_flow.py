@@ -1,0 +1,224 @@
+"""Control-flow operators: foreach / while_loop / cond
+(ref: src/operator/control_flow.cc, python/mxnet/ndarray/contrib.py
+foreach/while_loop/cond — added in MXNet 1.5).
+
+The reference's imperative versions run the body as a Python loop (each
+step's ops recorded on the autograd tape individually) and only the
+symbolic versions build a fused subgraph. The TPU build keeps exactly
+that split:
+
+- **eager NDArrays**: Python loop — tape-per-step, identical semantics
+  to the reference's imperative path;
+- **traced NDArrays** (inside ``hybridize()``/``jax.jit``/``vmap``):
+  a single ``lax.scan`` — the natural XLA lowering, differentiated by
+  the enclosing trace as one unit.
+
+``cond``'s traced path evaluates BOTH branches and selects
+(``jnp.where``) instead of ``lax.cond``: on TPU, XLA predicates small
+branches anyway, and ``lax.cond`` fails to compile inside differentiated
+scanned train steps on some TPU runtimes (documented divergence;
+override with MXNET_COND_IMPL=lax_cond).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _is_traced(arrays) -> bool:
+    return any(isinstance(getattr(a, "_data", a), jax.core.Tracer)
+               for a in arrays)
+
+
+def _wrap(data):
+    from ..ndarray import NDArray
+    return NDArray(data, _skip_device_put=True)
+
+
+def _datas(arrs):
+    return [a._data for a in arrs]
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Scan ``body`` over axis 0 of ``data``
+    (ref: python/mxnet/ndarray/contrib.py foreach).
+
+    body(data_slice, states) -> (outputs, new_states); returns
+    (outputs stacked along a new axis 0, final states). ``data`` may be
+    one NDArray or a list scanned in lockstep; ``init_states`` likewise.
+    """
+    from .. import autograd
+    from ..ndarray import NDArray
+
+    data_list = _as_list(data)
+    states = _as_list(init_states)
+    single_data = not isinstance(data, (list, tuple))
+    single_state = not isinstance(init_states, (list, tuple))
+    if not data_list:
+        raise MXNetError("foreach: data must hold at least one array")
+    length = data_list[0].shape[0]
+    for d in data_list:
+        if d.shape[0] != length:
+            raise MXNetError("foreach: all data arrays must share axis-0 "
+                             f"length, got {d.shape[0]} != {length}")
+
+    body_single_out = [True]
+
+    if _is_traced(data_list + states):
+        # single fused scan under the enclosing jit/vjp trace
+        def step(carry, xs):
+            sts = [_wrap(c) for c in carry]
+            xs_nd = [_wrap(x) for x in xs]
+            outs, new_sts = body(xs_nd[0] if single_data else xs_nd,
+                                 sts[0] if single_state else sts)
+            body_single_out[0] = not isinstance(outs, (list, tuple))
+            outs, new_sts = _as_list(outs), _as_list(new_sts)
+            return (tuple(s._data for s in new_sts),
+                    tuple(o._data for o in outs))
+
+        final, stacked = lax.scan(step, tuple(_datas(states)),
+                                  tuple(_datas(data_list)))
+        out_nd = [_wrap(o) for o in stacked]
+        st_nd = [_wrap(s) for s in final]
+    else:
+        # imperative: Python loop, ops tape-recorded step by step
+        from .. import ndarray as nd
+        out_steps = None
+        for i in range(length):
+            slices = [d.slice_axis(axis=0, begin=i, end=i + 1)
+                      .reshape(d.shape[1:]) for d in data_list]
+            outs, states = body(slices[0] if single_data else slices,
+                                states[0] if single_state else states)
+            body_single_out[0] = not isinstance(outs, (list, tuple))
+            outs, states = _as_list(outs), _as_list(states)
+            if out_steps is None:
+                out_steps = [[] for _ in outs]
+            for acc, o in zip(out_steps, outs):
+                acc.append(o)
+        out_nd = [nd.stack(*acc, axis=0) for acc in (out_steps or [])]
+        st_nd = [s if isinstance(s, NDArray) else nd.array(s)
+                 for s in states]
+
+    outs_r = out_nd[0] if (body_single_out[0] and len(out_nd) == 1) \
+        else out_nd
+    sts_r = st_nd[0] if (single_state and len(st_nd) == 1) else st_nd
+    return outs_r, sts_r
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None,
+               name="while_loop"):
+    """Run ``func`` while ``cond`` holds, at most ``max_iterations`` times
+    (ref: python/mxnet/ndarray/contrib.py while_loop).
+
+    cond(*loop_vars) -> scalar; func(*loop_vars) -> (step_outputs,
+    new_loop_vars). Returns (outputs stacked with axis-0 length
+    ``max_iterations`` — rows past the executed steps are zeros, the
+    reference's padding convention — and the final loop_vars).
+    """
+    from ..ndarray import NDArray
+
+    lvs = _as_list(loop_vars)
+    single = not isinstance(loop_vars, (list, tuple))
+
+    if _is_traced(lvs):
+        if max_iterations is None:
+            raise MXNetError("while_loop: max_iterations is required when "
+                             "traced (static shapes under XLA; the "
+                             "reference's symbolic mode requires it too)")
+
+        def step(carry, _):
+            done, cur = carry
+            cur_nd = [_wrap(c) for c in cur]
+            keep = jnp.logical_and(
+                jnp.logical_not(done),
+                jnp.reshape(cond(*cur_nd)._data, ()).astype(bool))
+            outs, new = func(*cur_nd)
+            outs, new = _as_list(outs), _as_list(new)
+            sel = tuple(jnp.where(keep, n._data, c)
+                        for n, c in zip(new, cur))
+            masked = tuple(jnp.where(keep, o._data,
+                                     jnp.zeros_like(o._data))
+                           for o in outs)
+            return (jnp.logical_not(keep) | done, sel), masked
+
+        (_, final), stacked = lax.scan(
+            step, (jnp.bool_(False), tuple(_datas(lvs))),
+            None, length=int(max_iterations))
+        out_nd = [_wrap(o) for o in stacked]
+        st_nd = [_wrap(s) for s in final]
+    else:
+        from .. import ndarray as nd
+        steps = 0
+        out_steps = None
+        out_shapes = None
+        while (max_iterations is None or steps < max_iterations) and \
+                bool(cond(*lvs).asnumpy()):
+            outs, lvs = func(*lvs)
+            outs, lvs = _as_list(outs), _as_list(lvs)
+            if out_steps is None:
+                out_steps = [[] for _ in outs]
+                out_shapes = [o.shape for o in outs]
+            for acc, o in zip(out_steps, outs):
+                acc.append(o)
+            steps += 1
+        if out_steps is None:
+            # zero executed steps: shapes come from abstractly tracing func
+            abstract = jax.eval_shape(
+                lambda *ds: tuple(o._data for o in
+                                  _as_list(func(*[_wrap(d) for d in ds])[0])),
+                *_datas(lvs))
+            out_shapes = [a.shape for a in abstract]
+            out_steps = [[] for _ in out_shapes]
+        pad_to = max_iterations if max_iterations is not None else steps
+        out_nd = []
+        for acc, shp in zip(out_steps, out_shapes):
+            rows = acc + [nd.zeros(shp)] * (pad_to - len(acc))
+            out_nd.append(nd.stack(*rows, axis=0) if rows
+                          else nd.zeros((0,) + shp))
+        st_nd = list(lvs)
+
+    outs_r = out_nd[0] if len(out_nd) == 1 else out_nd
+    sts_r = st_nd[0] if (single and len(st_nd) == 1) else st_nd
+    return outs_r, sts_r
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """Branch on a scalar predicate
+    (ref: python/mxnet/ndarray/contrib.py cond). ``then_func``/
+    ``else_func`` are thunks returning an NDArray or list of NDArrays
+    with matching shapes."""
+    pred_data = getattr(pred, "_data", pred)
+    if isinstance(pred_data, jax.core.Tracer):
+        then_out = _as_list(then_func())
+        else_out = _as_list(else_func())
+        if len(then_out) != len(else_out):
+            raise MXNetError("cond: branches must return the same number "
+                             "of outputs")
+        p = jnp.reshape(pred_data, ()).astype(bool)
+        if os.environ.get("MXNET_COND_IMPL") == "lax_cond":
+            outs = lax.cond(p,
+                            lambda: tuple(o._data for o in then_out),
+                            lambda: tuple(o._data for o in else_out))
+        else:
+            # predication: evaluate both branches, select — see module
+            # docstring for why this is the TPU default
+            outs = tuple(jnp.where(p, t._data, e._data)
+                         for t, e in zip(then_out, else_out))
+        res = [_wrap(o) for o in outs]
+        return res[0] if len(res) == 1 else res
+    taken = then_func if bool(jnp.reshape(pred_data, ())) else else_func
+    out = taken()
+    return out

@@ -123,3 +123,106 @@ def _shuffle(x, rng=None):
 def _bernoulli(rng=None, p=0.5, shape=None, dtype="float32", ctx=None):
     return jax.random.bernoulli(rng, p, tuple(shape or (1,))).astype(
         _as_np_dtype(dtype))
+
+
+_creation("_random_negative_binomial",
+          lambda rng, shape, dtype, k=1, p=1.0:
+          jax.random.poisson(
+              rng, jax.random.gamma(jax.random.fold_in(rng, 1), float(k),
+                                    shape) * ((1.0 - p) / max(p, 1e-12)),
+              shape).astype(dtype),
+          [OpParam("k", int, 1), OpParam("p", float, 1.0)],
+          doc="NegativeBinomial(k, p) via the gamma-Poisson mixture "
+              "(ref: sample_op.cc _random_negative_binomial)")
+
+_creation("_random_generalized_negative_binomial",
+          lambda rng, shape, dtype, mu=1.0, alpha=1.0:
+          jax.random.poisson(
+              rng,
+              jax.random.gamma(jax.random.fold_in(rng, 1),
+                               1.0 / max(alpha, 1e-12), shape)
+              * (mu * alpha) if alpha > 1e-12
+              else jnp.full(shape, mu),
+              shape).astype(dtype),
+          [OpParam("mu", float, 1.0), OpParam("alpha", float, 1.0)],
+          doc="GeneralizedNegativeBinomial(mu, alpha): mean mu, dispersion "
+              "alpha; alpha->0 degenerates to Poisson(mu) "
+              "(ref: sample_op.cc _random_generalized_negative_binomial)")
+
+
+def _per_elem(name, draw, doc, int_out=False):
+    """Per-element samplers (ref: src/operator/random/multisample_op.cc):
+    each output row draws from the distribution parameterized by the
+    matching element(s) of the input array(s); a trailing ``shape``
+    kwarg appends extra draw dims. One vectorized primitive draw — no
+    per-element loop (TPU-native shape of the reference's kernels)."""
+    n_in = draw.__code__.co_argcount - 2          # params before rng/shape
+
+    def impl(*args, rng=None, shape=None, dtype=None, **_):
+        extra = tuple(shape) if shape else ()
+        out_shape = args[0].shape + extra
+        bargs = [a.reshape(a.shape + (1,) * len(extra)).astype(jnp.float32)
+                 for a in args]
+        out = draw(*bargs, rng, out_shape)
+        dt = _as_np_dtype(dtype or ("int32" if int_out else "float32"))
+        return out.astype(dt)
+
+    register(name, num_inputs=n_in, needs_rng=True, differentiable=False,
+             params=[OpParam("shape", tuple, None),
+                     OpParam("dtype", str, None)],
+             doc=doc)(impl)
+
+
+_per_elem("_sample_gamma",
+          lambda alpha, beta, rng, s:
+          jax.random.gamma(rng, jnp.broadcast_to(alpha, s)) * beta,
+          "Per-element Gamma(alpha, beta) (ref: multisample_op.cc)")
+
+_per_elem("_sample_exponential",
+          lambda lam, rng, s: jax.random.exponential(rng, s) / lam,
+          "Per-element Exponential(lam) (ref: multisample_op.cc)")
+
+_per_elem("_sample_poisson",
+          lambda lam, rng, s:
+          jax.random.poisson(rng, jnp.broadcast_to(lam, s), s),
+          "Per-element Poisson(lam) (ref: multisample_op.cc)", int_out=False)
+
+_per_elem("_sample_negative_binomial",
+          lambda k, p, rng, s: jax.random.poisson(
+              rng,
+              jax.random.gamma(jax.random.fold_in(rng, 1),
+                               jnp.broadcast_to(jnp.maximum(k, 1e-6), s))
+              * ((1.0 - p) / jnp.maximum(p, 1e-12)), s),
+          "Per-element NegativeBinomial(k, p), gamma-Poisson mixture "
+          "(ref: multisample_op.cc)")
+
+_per_elem("_sample_generalized_negative_binomial",
+          lambda mu, alpha, rng, s: jax.random.poisson(
+              rng,
+              jnp.where(
+                  alpha > 1e-12,
+                  jax.random.gamma(
+                      jax.random.fold_in(rng, 1),
+                      jnp.broadcast_to(1.0 / jnp.maximum(alpha, 1e-12), s))
+                  * (mu * alpha),
+                  jnp.broadcast_to(mu, s)), s),
+          "Per-element GeneralizedNegativeBinomial(mu, alpha) "
+          "(ref: multisample_op.cc)")
+
+
+@register("_sample_dirichlet", num_inputs=1, needs_rng=True,
+          differentiable=False,
+          params=[OpParam("shape", tuple, None),
+                  OpParam("dtype", str, "float32")],
+          doc="Dirichlet(alpha) over the last axis of alpha (..., K): "
+              "normalized per-element gamma draws. Extra ``shape`` dims "
+              "are inserted before the K axis like the reference's "
+              "multisample convention (np.random.dirichlet analog).")
+def _sample_dirichlet(alpha, rng=None, shape=None, dtype="float32"):
+    extra = tuple(shape) if shape else ()
+    out_shape = alpha.shape[:-1] + extra + alpha.shape[-1:]
+    a = alpha.reshape(alpha.shape[:-1] + (1,) * len(extra)
+                      + alpha.shape[-1:]).astype(jnp.float32)
+    g = jax.random.gamma(rng, jnp.broadcast_to(a, out_shape))
+    return (g / jnp.sum(g, axis=-1, keepdims=True)).astype(
+        _as_np_dtype(dtype))

@@ -518,6 +518,86 @@ def _det(a):
     return jnp.linalg.det(a)
 
 
+@register("_linalg_slogdet", aliases=["linalg_slogdet"], num_outputs=2,
+          doc="Sign and log-abs-determinant (ref: la_op.cc linalg_slogdet)")
+def _slogdet(a):
+    sign, logabs = jnp.linalg.slogdet(a)
+    return sign, logabs
+
+
+@register("_linalg_trmm", aliases=["linalg_trmm"], num_inputs=2,
+          params=[OpParam("transpose", bool, False),
+                  OpParam("rightside", bool, False),
+                  OpParam("lower", bool, True),
+                  OpParam("alpha", float, 1.0)],
+          doc="Triangular matrix multiply: alpha * op(tri(A)) @ B, or "
+              "B @ op(tri(A)) when rightside — one masked matmul on the "
+              "MXU instead of BLAS trmm (ref: la_op.cc linalg_trmm)")
+def _trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b)
+    return alpha * out
+
+
+@register("_linalg_makediag", aliases=["linalg_makediag"],
+          params=[OpParam("offset", int, 0)],
+          doc="Vector (..., n) -> matrix (..., n+|o|, n+|o|) with the "
+              "vector on diagonal `offset` (ref: la_op.cc linalg_makediag)")
+def _makediag(a, offset=0):
+    import numpy as _np
+    n = a.shape[-1]
+    m = n + abs(offset)
+    rows = _np.arange(n) + (abs(offset) if offset < 0 else 0)
+    cols = _np.arange(n) + (offset if offset > 0 else 0)
+    out = jnp.zeros(a.shape[:-1] + (m, m), dtype=a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+@register("_linalg_extractdiag", aliases=["linalg_extractdiag"],
+          params=[OpParam("offset", int, 0)],
+          doc="Matrix (..., n, n) -> diagonal `offset` as a vector "
+              "(ref: la_op.cc linalg_extractdiag)")
+def _extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_maketrian", aliases=["linalg_maketrian"],
+          params=[OpParam("offset", int, 0), OpParam("lower", bool, True)],
+          doc="Packed vector -> triangular matrix (row-major packing of "
+              "the triangle like the reference; ref: la_op.cc "
+              "linalg_maketrian)")
+def _maketrian(a, offset=0, lower=True):
+    import numpy as _np
+    m = a.shape[-1]
+    # triangle with k rows holds k*(k+1)/2 entries; solve for k
+    k = int((_np.sqrt(8 * m + 1) - 1) // 2)
+    n = k + abs(offset)
+    # the reference keys the triangle on the SIGN of offset and consults
+    # `lower` only at offset == 0 (ref: la_op.cc CopyTrians)
+    if offset < 0 or (offset == 0 and lower):
+        rows, cols = _np.tril_indices(n, offset)
+    else:
+        rows, cols = _np.triu_indices(n, offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+@register("_linalg_extracttrian", aliases=["linalg_extracttrian"],
+          params=[OpParam("offset", int, 0), OpParam("lower", bool, True)],
+          doc="Triangular part of (..., n, n) packed row-major into a "
+              "vector (ref: la_op.cc linalg_extracttrian)")
+def _extracttrian(a, offset=0, lower=True):
+    import numpy as _np
+    n = a.shape[-1]
+    if offset < 0 or (offset == 0 and lower):
+        rows, cols = _np.tril_indices(n, offset)
+    else:
+        rows, cols = _np.triu_indices(n, offset)
+    return a[..., rows, cols]
+
+
 @register("khatri_rao", num_inputs=-1,
           doc="Row-wise Khatri-Rao product (ref: src/operator/contrib/krprod.cc)")
 def _khatri_rao(*mats):

@@ -92,6 +92,16 @@ def _expose():
 
 _expose()
 
+# control-flow ops take Python callables — they bypass the registry
+# (ref: python/mxnet/symbol/contrib.py foreach/while_loop/cond)
+from .control_flow import foreach as _cf_foreach  # noqa: E402
+from .control_flow import while_loop as _cf_while_loop  # noqa: E402
+from .control_flow import cond as _cf_cond  # noqa: E402
+
+contrib.foreach = _cf_foreach
+contrib.while_loop = _cf_while_loop
+contrib.cond = _cf_cond
+
 
 def eval_symbol(outputs, inputs, args, params):
     """Execute a symbol for SymbolBlock.forward: bind ``inputs`` (Symbols)

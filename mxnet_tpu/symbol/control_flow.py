@@ -1,0 +1,278 @@
+"""Symbolic control-flow operators — subgraph nodes over lax primitives
+(ref: src/operator/control_flow.cc _foreach/_while_loop/_cond;
+python/mxnet/symbol/contrib.py foreach/while_loop/cond).
+
+The reference stores the body as an NNVM subgraph attribute on a special
+node and executes it with a subgraph executor per iteration. Here the
+node's attrs hold a sub-``Symbol``; execution compiles the subgraph's
+eval function into ``lax.scan`` (foreach, while_loop with a done-mask)
+or a both-branches ``jnp.where`` select (cond — XLA predicates small
+branches on TPU anyway, and lax.cond does not compile inside
+differentiated scans on some TPU runtimes).
+
+Free variables of the body subgraph (the user's weight symbols) become
+ordinary inputs of the control-flow node, so ``list_arguments``/binding
+see them exactly like any other op input.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+CONTROL_FLOW_OPS = {"_foreach", "_while_loop", "_cond"}
+
+__all__ = ["foreach", "while_loop", "cond", "CONTROL_FLOW_OPS",
+           "control_flow_fn"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _sym_mod():
+    from . import symbol as S
+    return S
+
+
+def _free_variables(graph, exclude_names):
+    """Leaf variable nodes of ``graph`` not in exclude_names, topo order."""
+    out = []
+    for node in graph._topo():
+        if node.op is None and node.name not in exclude_names:
+            out.append(node)
+    return out
+
+
+def foreach(body, data, init_states, name=None):
+    """Symbolic scan (ref: symbol/contrib.py foreach). ``body`` receives
+    placeholder symbols for one data slice and the states and must return
+    (outputs, new_states) of symbols."""
+    S = _sym_mod()
+    name = name or S._NameManager.next_name("foreach")
+    data_list = _as_list(data)
+    states = _as_list(init_states)
+    single_data = not isinstance(data, (list, tuple))
+    single_state = not isinstance(init_states, (list, tuple))
+
+    data_vars = [S.var(f"{name}_data{i}") for i in range(len(data_list))]
+    state_vars = [S.var(f"{name}_state{i}") for i in range(len(states))]
+    outs, new_states = body(data_vars[0] if single_data else data_vars,
+                            state_vars[0] if single_state else state_vars)
+    single_out = not isinstance(outs, (list, tuple))
+    outs, new_states = _as_list(outs), _as_list(new_states)
+    if len(new_states) != len(states):
+        raise MXNetError(f"foreach: body returned {len(new_states)} states "
+                         f"for {len(states)} init_states")
+    subgraph = S.Group(outs + new_states)
+    ph_names = {v.name for v in data_vars + state_vars}
+    closure_nodes = _free_variables(subgraph, ph_names)
+
+    node = S._Node("_foreach", name,
+                   list(data_list) + list(states) +
+                   [S.Symbol(n) for n in closure_nodes],
+                   {"__subgraph__": subgraph,
+                    "__data_vars__": [v.name for v in data_vars],
+                    "__state_vars__": [v.name for v in state_vars],
+                    "__closure_vars__": [n.name for n in closure_nodes],
+                    "__num_outputs__": len(outs)},
+                   num_outputs=len(outs) + len(new_states))
+    out_syms = [S.Symbol(node, i) for i in range(len(outs))]
+    st_syms = [S.Symbol(node, len(outs) + i) for i in range(len(new_states))]
+    outs_r = out_syms[0] if (single_out and len(out_syms) == 1) else out_syms
+    sts_r = st_syms[0] if (single_state and len(st_syms) == 1) else st_syms
+    return outs_r, sts_r
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name=None):
+    """Symbolic bounded while (ref: symbol/contrib.py while_loop).
+    Outputs are stacked to axis-0 length ``max_iterations``; rows past
+    the executed steps are zeros (the reference's padding)."""
+    S = _sym_mod()
+    if max_iterations is None:
+        raise MXNetError("while_loop: max_iterations is required "
+                         "(static shapes; the reference requires it too)")
+    name = name or S._NameManager.next_name("while_loop")
+    lvs = _as_list(loop_vars)
+    single = not isinstance(loop_vars, (list, tuple))
+
+    lv_vars = [S.var(f"{name}_loopvar{i}") for i in range(len(lvs))]
+    cond_out = cond(*lv_vars)
+    outs, new_lvs = func(*lv_vars)
+    single_out = not isinstance(outs, (list, tuple))
+    outs, new_lvs = _as_list(outs), _as_list(new_lvs)
+    if len(new_lvs) != len(lvs):
+        raise MXNetError(f"while_loop: func returned {len(new_lvs)} loop "
+                         f"vars for {len(lvs)}")
+    body_graph = S.Group(outs + new_lvs)
+    ph_names = {v.name for v in lv_vars}
+    closure_nodes = _free_variables(S.Group([cond_out] + outs + new_lvs),
+                                    ph_names)
+    node = S._Node("_while_loop", name,
+                   list(lvs) + [S.Symbol(n) for n in closure_nodes],
+                   {"__cond_graph__": cond_out,
+                    "__body_graph__": body_graph,
+                    "__loop_vars__": [v.name for v in lv_vars],
+                    "__closure_vars__": [n.name for n in closure_nodes],
+                    "__num_outputs__": len(outs),
+                    "__max_iterations__": int(max_iterations)},
+                   num_outputs=len(outs) + len(new_lvs))
+    out_syms = [S.Symbol(node, i) for i in range(len(outs))]
+    st_syms = [S.Symbol(node, len(outs) + i) for i in range(len(new_lvs))]
+    outs_r = out_syms[0] if (single_out and len(out_syms) == 1) else out_syms
+    sts_r = st_syms[0] if (single and len(st_syms) == 1) else st_syms
+    return outs_r, sts_r
+
+
+def cond(pred, then_func, else_func, name=None):
+    """Symbolic branch (ref: symbol/contrib.py cond): ``pred`` is a
+    scalar Symbol; the thunks return same-shaped symbols."""
+    S = _sym_mod()
+    name = name or S._NameManager.next_name("cond")
+    then_out = _as_list(then_func())
+    else_out = _as_list(else_func())
+    single_out = len(then_out) == 1
+    if len(then_out) != len(else_out):
+        raise MXNetError("cond: branches must return the same number of "
+                         "outputs")
+    then_graph = S.Group(then_out)
+    else_graph = S.Group(else_out)
+    closure_nodes = _free_variables(S.Group(then_out + else_out), set())
+    node = S._Node("_cond", name,
+                   [pred] + [S.Symbol(n) for n in closure_nodes],
+                   {"__then_graph__": then_graph,
+                    "__else_graph__": else_graph,
+                    "__closure_vars__": [n.name for n in closure_nodes],
+                    "__num_outputs__": len(then_out)},
+                   num_outputs=len(then_out))
+    outs = [S.Symbol(node, i) for i in range(len(then_out))]
+    return outs[0] if single_out else outs
+
+
+# ---------------------------------------------------------------------------
+# execution — shared by Symbol._make_eval_fn (real arrays) and
+# Symbol.infer_shape (jax.eval_shape over the same function)
+# ---------------------------------------------------------------------------
+
+def control_flow_fn(node, training):
+    """Pure jax function ``fn(*input_arrays) -> tuple(outputs)`` for a
+    control-flow node. Aux-state updates inside scanned subgraphs
+    (BatchNorm EMA in a loop body) are dropped — a documented divergence;
+    hoist the norm out of the loop or use use_global_stats."""
+    a = node.attrs
+    if node.op == "_foreach":
+        sub_run = a["__subgraph__"]._make_eval_fn(training=training)
+        d_names, s_names = a["__data_vars__"], a["__state_vars__"]
+        c_names = a["__closure_vars__"]
+        n_out = a["__num_outputs__"]
+
+        def fn(*arrays):
+            nd_, ns_ = len(d_names), len(s_names)
+            datas = arrays[:nd_]
+            init = tuple(arrays[nd_:nd_ + ns_])
+            closure = dict(zip(c_names, arrays[nd_ + ns_:]))
+
+            def step(carry, xs):
+                vals = dict(closure)
+                vals.update(zip(d_names, xs))
+                vals.update(zip(s_names, carry))
+                outs, _aux = sub_run(vals)
+                return tuple(outs[n_out:]), tuple(outs[:n_out])
+
+            final, stacked = lax.scan(step, init, tuple(datas))
+            return tuple(stacked) + tuple(final)
+        return fn
+
+    if node.op == "_while_loop":
+        cond_run = a["__cond_graph__"]._make_eval_fn(training=training)
+        body_run = a["__body_graph__"]._make_eval_fn(training=training)
+        lv_names, c_names = a["__loop_vars__"], a["__closure_vars__"]
+        n_out = a["__num_outputs__"]
+        max_it = a["__max_iterations__"]
+
+        def fn(*arrays):
+            nlv = len(lv_names)
+            init = tuple(arrays[:nlv])
+            closure = dict(zip(c_names, arrays[nlv:]))
+
+            def step(carry, _):
+                done, cur = carry
+                vals = dict(closure)
+                vals.update(zip(lv_names, cur))
+                (c,), _ = cond_run(vals)
+                keep = jnp.logical_and(jnp.logical_not(done),
+                                       jnp.reshape(c, ()).astype(bool))
+                outs, _aux = body_run(vals)
+                new = tuple(jnp.where(keep, n, o)
+                            for n, o in zip(outs[n_out:], cur))
+                masked = tuple(jnp.where(keep, o, jnp.zeros_like(o))
+                               for o in outs[:n_out])
+                return (jnp.logical_not(keep) | done, new), masked
+
+            (_, final), stacked = lax.scan(
+                step, (jnp.bool_(False), init), None, length=max_it)
+            return tuple(stacked) + tuple(final)
+        return fn
+
+    if node.op == "_cond":
+        then_run = a["__then_graph__"]._make_eval_fn(training=training)
+        else_run = a["__else_graph__"]._make_eval_fn(training=training)
+        c_names = a["__closure_vars__"]
+
+        def fn(pred, *arrays):
+            vals = dict(zip(c_names, arrays))
+            t_outs, _ = then_run(vals)
+            e_outs, _ = else_run(vals)
+            p = jnp.reshape(pred, ()).astype(bool)
+            return tuple(jnp.where(p, t, e)
+                         for t, e in zip(t_outs, e_outs))
+        return fn
+
+    raise MXNetError(f"not a control-flow node: {node.op}")
+
+
+# -- serialization -----------------------------------------------------------
+
+_GRAPH_KEYS = ("__subgraph__", "__cond_graph__", "__body_graph__",
+               "__then_graph__", "__else_graph__")
+_LIST_KEYS = ("__data_vars__", "__state_vars__", "__loop_vars__",
+              "__closure_vars__")
+_INT_KEYS = ("__num_outputs__", "__max_iterations__")
+
+
+def serialize_attrs(attrs):
+    """attrs -> json-safe strings (called from Symbol.tojson)."""
+    out = {}
+    for k, v in attrs.items():
+        out[k] = v.tojson() if k in _GRAPH_KEYS else str(v)
+    return out
+
+
+def deserialize_attrs(raw, op):
+    """Rebuild live attrs from loaded json strings."""
+    import ast
+
+    from . import symbol as S
+    attrs = {}
+    for k, v in raw.items():
+        if k in _GRAPH_KEYS:
+            attrs[k] = S.load_json(v)
+        elif k in _LIST_KEYS:
+            attrs[k] = list(ast.literal_eval(v))
+        elif k in _INT_KEYS:
+            attrs[k] = int(v)
+        else:
+            attrs[k] = v
+    return attrs
+
+
+def num_outputs_of_node(op, attrs):
+    if op == "_foreach":
+        return attrs["__num_outputs__"] + len(attrs["__state_vars__"])
+    if op == "_while_loop":
+        return attrs["__num_outputs__"] + len(attrs["__loop_vars__"])
+    return attrs["__num_outputs__"]

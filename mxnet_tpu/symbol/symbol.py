@@ -28,6 +28,7 @@ from .. import _rng
 from ..base import MXNetError, _as_np_dtype
 from ..context import current_context
 from ..ops import registry as _registry
+from . import control_flow as _cflow
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
            "zeros", "ones", "arange"]
@@ -280,6 +281,11 @@ class Symbol:
                             f"symbol variable {node.name!r} was not bound")
                 elif node.op == "_group":
                     res = [compute(s._node)[s._index] for s in node.inputs]
+                elif node.op in _cflow.CONTROL_FLOW_OPS:
+                    arrays = [compute(s._node)[s._index]
+                              for s in node.inputs]
+                    res = list(_cflow.control_flow_fn(node, training)
+                               (*arrays))
                 else:
                     op = _registry.get(node.op)
                     arrays = [compute(s._node)[s._index]
@@ -353,6 +359,19 @@ class Symbol:
                 if any(r is None for r in rs):
                     return None
                 return [r[s._index] for r, s in zip(rs, node.inputs)]
+            if node.op in _cflow.CONTROL_FLOW_OPS:
+                in_shapes2 = []
+                for s in node.inputs:
+                    r = cached_node_shape(s._node)
+                    if r is None:
+                        return None
+                    in_shapes2.append(r[s._index])
+                try:
+                    out = jax.eval_shape(
+                        _cflow.control_flow_fn(node, False), *in_shapes2)
+                except Exception:
+                    return None
+                return list(out)
             # backward parameter-shape rules: data shape ⇒ weight shapes
             if node.inputs:
                 data_r = cached_node_shape(node.inputs[0]._node)
@@ -439,8 +458,11 @@ class Symbol:
                 "inputs": [[index[id(s._node)], s._index, 0]
                            for s in node.inputs],
             }
-            attrs = {k: str(v) for k, v in node.attrs.items()
-                     if v is not None}
+            if node.op in _cflow.CONTROL_FLOW_OPS:
+                attrs = _cflow.serialize_attrs(node.attrs)
+            else:
+                attrs = {k: str(v) for k, v in node.attrs.items()
+                         if v is not None}
             if attrs:
                 entry["attrs"] = attrs
             nodes.append(entry)
@@ -531,6 +553,38 @@ class Symbol:
 
     def __neg__(self):
         return self._binop(-1.0, "elemwise_mul")
+
+    # comparisons build graph nodes like NDArray's (ref: symbol.py
+    # __eq__ et al. delegate to broadcast_* / *_scalar ops)
+    def _cmpop(self, other, broadcast_name, scalar_name):
+        if isinstance(other, Symbol):
+            return _create(broadcast_name, [self, other], {})
+        return _create(scalar_name, [self], {"scalar": float(other)})
+
+    def __eq__(self, other):
+        return self._cmpop(other, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        return self._cmpop(other, "broadcast_not_equal",
+                           "_not_equal_scalar")
+
+    # __eq__ builds a graph node, so identity hashing must be kept:
+    # Symbols live in dicts/sets throughout the composer
+    __hash__ = object.__hash__
+
+    def __lt__(self, other):
+        return self._cmpop(other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._cmpop(other, "broadcast_lesser_equal",
+                           "_lesser_equal_scalar")
+
+    def __gt__(self, other):
+        return self._cmpop(other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._cmpop(other, "broadcast_greater_equal",
+                           "_greater_equal_scalar")
 
 
 def _auto_var(name, attrs=None):
@@ -644,6 +698,12 @@ def load_json(json_str):
         elif entry["op"] == "_group":
             node = _Node("_group", entry["name"], inputs, {},
                          num_outputs=len(inputs))
+        elif entry["op"] in _cflow.CONTROL_FLOW_OPS:
+            attrs = _cflow.deserialize_attrs(entry.get("attrs", {}),
+                                             entry["op"])
+            node = _Node(entry["op"], entry["name"], inputs, attrs,
+                         num_outputs=_cflow.num_outputs_of_node(
+                             entry["op"], attrs))
         else:
             op = _registry.get(entry["op"])
             raw = entry.get("attrs", {})

@@ -1,0 +1,268 @@
+"""Control-flow op tests (ref: tests/python/unittest/test_contrib_control_flow.py
+— foreach-vs-unrolled parity, while_loop semantics, cond, and the
+symbolic/hybridized paths)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+import mxnet_tpu.symbol as sym
+
+
+def _rand(*shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale) \
+        .astype(np.float32)
+
+
+def test_foreach_vs_unrolled_rnn_forward_and_grad():
+    """An elman cell scanned with foreach must match the hand-unrolled
+    loop in outputs AND gradients (the reference's core foreach test)."""
+    T, B, I, H = 5, 2, 3, 4
+    x_np = _rand(T, B, I, seed=1, scale=0.5)
+    wx_np = _rand(I, H, seed=2, scale=0.5)
+    wh_np = _rand(H, H, seed=3, scale=0.5)
+
+    def run(use_foreach):
+        x = nd.array(x_np)
+        wx, wh = nd.array(wx_np), nd.array(wh_np)
+        wx.attach_grad(), wh.attach_grad()
+        h0 = nd.zeros((B, H))
+
+        def cell(xt, h):
+            return nd.tanh(nd.dot(xt, wx) + nd.dot(h, wh))
+
+        with autograd.record():
+            if use_foreach:
+                outs, hT = nd.contrib.foreach(
+                    lambda xt, h: (cell(xt, h), cell(xt, h)), x, h0)
+            else:
+                h = h0
+                steps = []
+                for t in range(T):
+                    h = cell(x.slice_axis(axis=0, begin=t, end=t + 1)
+                             .reshape(B, I), h)
+                    steps.append(h)
+                outs, hT = nd.stack(*steps, axis=0), h
+            loss = (outs.sum() + hT.sum())
+        loss.backward()
+        return (outs.asnumpy(), hT.asnumpy(),
+                wx.grad.asnumpy(), wh.grad.asnumpy())
+
+    ref = run(False)
+    got = run(True)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6)
+
+
+def test_foreach_multiple_data_and_states():
+    xs = nd.array(_rand(4, 3, seed=4))
+    ys = nd.array(_rand(4, 3, seed=5))
+    s1, s2 = nd.zeros((3,)), nd.ones((3,))
+    outs, states = nd.contrib.foreach(
+        lambda data, sts: ([data[0] + sts[0], data[1] * sts[1]],
+                           [sts[0] + data[0], sts[1]]),
+        [xs, ys], [s1, s2])
+    assert len(outs) == 2 and len(states) == 2
+    np.testing.assert_allclose(states[0].asnumpy(),
+                               xs.asnumpy().sum(0), rtol=1e-6)
+    np.testing.assert_allclose(outs[1].asnumpy(), ys.asnumpy(), rtol=1e-6)
+
+
+def test_foreach_inside_hybridized_block():
+    """Traced path: foreach lowers to ONE lax.scan inside the jitted
+    program; gradients flow through the enclosing trace."""
+    class ScanNet(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.proj = gluon.nn.Dense(4, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            h0 = F.zeros((2, 4))
+            outs, hT = F.contrib.foreach(
+                lambda xt, h: (self.proj(xt) + h, self.proj(xt) + h),
+                x, h0)
+            return outs + hT.reshape(1, 2, 4)
+
+    x = nd.array(_rand(5, 2, 3, seed=6))
+    net_e = ScanNet()
+    net_e.initialize()
+    out_eager = net_e(x)
+    net_e.hybridize()
+    out_jit = net_e(x)
+    np.testing.assert_allclose(out_jit.asnumpy(), out_eager.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    with autograd.record():
+        loss = net_e(x).sum()
+    loss.backward()
+    g = net_e.proj.weight.grad()
+    assert np.isfinite(g.asnumpy()).all() and abs(g.asnumpy()).sum() > 0
+
+
+def test_while_loop_eager_semantics():
+    outs, (i_f, acc_f) = nd.contrib.while_loop(
+        lambda i, a: i < 5,
+        lambda i, a: ([i * 2], [i + 1, a + i]),
+        [nd.array([0.0]), nd.array([0.0])], max_iterations=8)
+    assert float(i_f.asnumpy()) == 5
+    assert float(acc_f.asnumpy()) == 10        # 0+1+2+3+4
+    # padded to max_iterations with zeros (reference convention)
+    assert outs.shape == (8, 1)
+    assert outs.asnumpy()[:5, 0].tolist() == [0, 2, 4, 6, 8]
+    assert abs(outs.asnumpy()[5:]).max() == 0
+
+
+def test_while_loop_traced_matches_eager():
+    def program(i0):
+        outs, (i_f, a_f) = nd.contrib.while_loop(
+            lambda i, a: i < 4,
+            lambda i, a: ([a + i], [i + 1, a + i * i]),
+            [i0, nd.zeros((1,))], max_iterations=6)
+        return outs, i_f, a_f
+
+    eager = [x.asnumpy() for x in program(nd.array([0.0]))]
+
+    class WL(gluon.HybridBlock):
+        def hybrid_forward(self, F, i0):
+            outs, (i_f, a_f) = F.contrib.while_loop(
+                lambda i, a: i < 4,
+                lambda i, a: ([a + i], [i + 1, a + i * i]),
+                [i0, F.zeros((1,))], max_iterations=6)
+            return outs, i_f, a_f
+
+    net = WL()
+    net.hybridize()
+    traced = [x.asnumpy() for x in net(nd.array([0.0]))]
+    for e, t in zip(eager, traced):
+        np.testing.assert_allclose(t, e, rtol=1e-6)
+
+
+def test_while_loop_zero_iterations():
+    outs, (i_f,) = nd.contrib.while_loop(
+        lambda i: i < 0, lambda i: ([i * 3], [i + 1]),
+        [nd.array([7.0])], max_iterations=4)
+    assert float(i_f.asnumpy()) == 7
+    assert outs.shape == (4, 1) and abs(outs.asnumpy()).max() == 0
+
+
+def test_while_loop_beam_decode():
+    """Greedy/beam-style decode as a while_loop: argmax chain over a toy
+    transition matrix with EOS early exit — the control-flow shape of
+    the NMT decoder (which now runs on this op, see
+    gluon/model_zoo/transformer.py translate)."""
+    V, L = 6, 8
+    eos = 0
+    trans = nd.array(_rand(V, V, seed=7))
+
+    def cond(step, toks, fin):
+        return (step < L) * (fin.sum() < 1)
+
+    def body(step, toks, fin):
+        cur = nd.take(toks, step.astype("int32"), axis=0)  # (1,) token
+        logits = nd.take(trans, cur.astype("int32"), axis=0)
+        nxt = logits.reshape(1, V).argmax(axis=-1)
+        col = nd.one_hot(step.astype("int32") + 1, depth=L + 1)
+        toks = (toks.reshape(1, L + 1) * (1 - col)
+                + nd.broadcast_mul(nxt.reshape(1, 1), col)) \
+            .reshape(L + 1).astype("int32")
+        fin = nd.broadcast_maximum(fin, (nxt == eos).astype("float32"))
+        return [], [step + 1, toks, fin]
+
+    toks0 = nd.zeros((L + 1,), dtype="int32") + 2
+    _, (steps, toks, fin) = nd.contrib.while_loop(
+        cond, body, [nd.zeros((1,)), toks0, nd.zeros((1,))],
+        max_iterations=L)
+    # python oracle
+    t = np.full((L + 1,), 2, np.int64)
+    s, f = 0, False
+    while s < L and not f:
+        nxt = trans.asnumpy()[t[s]].argmax()
+        t[s + 1] = nxt
+        f = nxt == eos
+        s += 1
+    np.testing.assert_array_equal(toks.asnumpy(), t)
+    assert int(steps.asnumpy()[0]) == s
+
+
+def test_cond_eager_and_traced():
+    a, b = nd.array([2.0]), nd.array([5.0])
+    hi = nd.contrib.cond((a > b).reshape(()), lambda: a, lambda: b)
+    assert float(hi.asnumpy()) == 5.0
+
+    class CondNet(gluon.HybridBlock):
+        def hybrid_forward(self, F, x, y):
+            return F.contrib.cond((x.sum() > y.sum()).reshape(()),
+                                  lambda: x * 2, lambda: y * 3)
+
+    net = CondNet()
+    net.hybridize()
+    out = net(a, b)
+    np.testing.assert_allclose(out.asnumpy(), [15.0])
+    out2 = net(nd.array([9.0]), b)
+    np.testing.assert_allclose(out2.asnumpy(), [18.0])
+
+
+def test_sym_foreach_bind_grad_and_json():
+    """Symbolic foreach: executes under the graph executor, infers
+    shapes, survives tojson/load_json, and produces gradients."""
+    d = sym.var("d")
+    s = sym.var("s")
+    w = sym.var("w")
+    outs, states = sym.contrib.foreach(
+        lambda x, st: (sym.tanh(x * w + st), sym.tanh(x * w + st)), d, s)
+    net = sym.sum(states)       # scalar objective over final state
+
+    d_np = _rand(4, 3, seed=8, scale=0.5)
+    w_np = _rand(3, seed=9, scale=0.5)
+    args = {"d": nd.array(d_np), "s": nd.zeros((3,)),
+            "w": nd.array(w_np)}
+    grads = {k: nd.zeros(v.shape) for k, v in args.items()}
+    exe = net.bind(mx.cpu(), args, args_grad=grads)
+    exe.forward(is_train=True)
+    exe.backward()
+
+    # oracle: eager tape over the same scan
+    dd, ww = nd.array(d_np), nd.array(w_np)
+    dd.attach_grad(), ww.attach_grad()
+    with autograd.record():
+        o2, s2 = nd.contrib.foreach(
+            lambda x, st: (nd.tanh(x * ww + st), nd.tanh(x * ww + st)),
+            dd, nd.zeros((3,)))
+        loss = s2.sum()
+    loss.backward()
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(),
+                               loss.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(grads["w"].asnumpy(), ww.grad.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(grads["d"].asnumpy(), dd.grad.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+    # shape inference + json round trip
+    g = sym.Group([outs, states])
+    _, out_shapes, _ = g.infer_shape(d=(4, 3), s=(3,), w=(3,))
+    assert out_shapes == [(4, 3), (3,)]
+    g2 = sym.load_json(g.tojson())
+    r1 = g.eval(**args)
+    r2 = g2.eval(**args)
+    for x, y in zip(r1, r2):
+        np.testing.assert_allclose(y.asnumpy(), x.asnumpy(), rtol=1e-6)
+
+
+def test_sym_while_loop_and_cond():
+    i = sym.var("i")
+    outs, fin = sym.contrib.while_loop(
+        lambda x: x < 5, lambda x: (x * 2, x + 1), i, max_iterations=8)
+    gg = sym.Group([outs, fin])
+    r = gg.eval(i=nd.array([0.0]))
+    assert float(r[1].asnumpy()) == 5
+    assert r[0].asnumpy()[:5, 0].tolist() == [0, 2, 4, 6, 8]
+    _, shapes, _ = gg.infer_shape(i=(1,))
+    assert shapes == [(8, 1), (1,)]
+
+    c = sym.contrib.cond(sym.var("p"), lambda: i + 1, lambda: i - 1)
+    assert float(c.eval(p=nd.array([1.0]), i=nd.array([3.0]))[0]
+                 .asnumpy()) == 4.0
+    assert float(c.eval(p=nd.array([0.0]), i=nd.array([3.0]))[0]
+                 .asnumpy()) == 2.0
+    c2 = sym.load_json(c.tojson())
+    assert float(c2.eval(p=nd.array([1.0]), i=nd.array([3.0]))[0]
+                 .asnumpy()) == 4.0

@@ -1,0 +1,154 @@
+"""Distribution tests for the sampler op families
+(ref: tests/python/unittest/test_random.py — the reference checks
+moments of each `_random_*`/`_sample_*` distribution against the
+analytic mean/variance; same method here, tolerances scaled to n).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+N = 4000
+
+
+def _moments(arr):
+    a = arr.asnumpy().astype(np.float64)
+    return a.mean(), a.var()
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    mx.random.seed(42)
+
+
+def test_random_negative_binomial_moments():
+    k, p = 5, 0.4
+    x = mx.nd.random.negative_binomial(k=k, p=p, shape=(N,))
+    mean, var = _moments(x)
+    # NB(k, p): mean k(1-p)/p, var k(1-p)/p^2
+    assert abs(mean - k * (1 - p) / p) < 0.4
+    assert abs(var - k * (1 - p) / p ** 2) < 2.5
+    assert float(x.min().asnumpy()) >= 0
+
+
+def test_random_generalized_negative_binomial_moments():
+    mu, alpha = 3.0, 0.5
+    x = mx.nd.random.generalized_negative_binomial(mu=mu, alpha=alpha,
+                                                   shape=(N,))
+    mean, var = _moments(x)
+    assert abs(mean - mu) < 0.3
+    # var = mu + alpha * mu^2
+    assert abs(var - (mu + alpha * mu * mu)) < 1.5
+
+
+@pytest.mark.parametrize("dist,params,expect_mean,expect_var,tol", [
+    ("sample_gamma", (np.full((N,), 3.0, np.float32),
+                      np.full((N,), 2.0, np.float32)), 6.0, 12.0, 0.6),
+    ("sample_exponential", (np.full((N,), 4.0, np.float32),), 0.25,
+     1 / 16.0, 0.05),
+    ("sample_poisson", (np.full((N,), 5.0, np.float32),), 5.0, 5.0, 0.5),
+    ("sample_negative_binomial", (np.full((N,), 5.0, np.float32),
+                                  np.full((N,), 0.4, np.float32)),
+     7.5, 18.75, 1.5),
+    ("sample_generalized_negative_binomial",
+     (np.full((N,), 3.0, np.float32), np.full((N,), 0.5, np.float32)),
+     3.0, 7.5, 1.0),
+])
+def test_sample_family_moments(dist, params, expect_mean, expect_var, tol):
+    fn = getattr(mx.nd, dist)
+    out = fn(*[mx.nd.array(p) for p in params])
+    assert out.shape == params[0].shape
+    mean, var = _moments(out)
+    assert abs(mean - expect_mean) < tol, (dist, mean)
+    assert abs(var - expect_var) < max(6 * tol, 0.12 * expect_var), (dist, var)
+
+
+def test_sample_family_per_element_params():
+    """Each output element draws from ITS row's parameters — the defining
+    property of the per-element family (ref: multisample_op.cc)."""
+    lam = mx.nd.array(np.array([0.5, 50.0], np.float32))
+    draws = mx.nd.sample_poisson(lam, shape=(2000,))
+    assert draws.shape == (2, 2000)
+    m = draws.asnumpy().mean(axis=1)
+    assert abs(m[0] - 0.5) < 0.2 and abs(m[1] - 50.0) < 2.0
+    # gamma with per-row alpha
+    alpha = mx.nd.array(np.array([1.0, 20.0], np.float32))
+    beta = mx.nd.array(np.array([1.0, 1.0], np.float32))
+    g = mx.nd.sample_gamma(alpha, beta, shape=(2000,))
+    gm = g.asnumpy().mean(axis=1)
+    assert abs(gm[0] - 1.0) < 0.25 and abs(gm[1] - 20.0) < 2.0
+
+
+def test_sample_dirichlet():
+    alpha = mx.nd.array(np.array([[1.0, 2.0, 3.0],
+                                  [10.0, 10.0, 10.0]], np.float32))
+    d = mx.nd.sample_dirichlet(alpha, shape=(500,))
+    assert d.shape == (2, 500, 3)
+    a = d.asnumpy()
+    np.testing.assert_allclose(a.sum(-1), 1.0, atol=1e-5)
+    assert (a >= 0).all()
+    # E[x_i] = alpha_i / sum(alpha)
+    np.testing.assert_allclose(a[0].mean(0), [1 / 6, 2 / 6, 3 / 6],
+                               atol=0.06)
+    np.testing.assert_allclose(a[1].mean(0), [1 / 3, 1 / 3, 1 / 3],
+                               atol=0.03)
+
+
+def test_samplers_under_jit_and_seed_reproducibility():
+    """Samplers draw through the dispatch-threaded PRNG: reseeding
+    reproduces the stream (the reference's @with_seed contract)."""
+    mx.random.seed(7)
+    a = mx.nd.sample_gamma(mx.nd.array([2.0]), mx.nd.array([1.0]),
+                           shape=(8,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.nd.sample_gamma(mx.nd.array([2.0]), mx.nd.array([1.0]),
+                           shape=(8,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_fft_matches_numpy():
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    f = mx.nd.contrib.fft(mx.nd.array(x)).asnumpy()
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], ref.real, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(f[:, 1::2], ref.imag, rtol=1e-4, atol=1e-4)
+    # reference wire format: ifft(fft(x)) == d * x (cuFFT unnormalized)
+    r = mx.nd.contrib.ifft(mx.nd.array(f)).asnumpy()
+    np.testing.assert_allclose(r, 16 * x, rtol=1e-4, atol=1e-3)
+
+
+def test_bilinear_sampler_matches_torch_grid_sample():
+    """BilinearSampler vs torch.nn.functional.grid_sample (zero padding,
+    align_corners=True) — an independent oracle for the sampling
+    convention (ref: src/operator/bilinear_sampler.cc docstring example)."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(3)
+    data = rng.randn(2, 3, 6, 5).astype(np.float32)
+    grid = (rng.rand(2, 2, 4, 4).astype(np.float32) * 2.2 - 1.1)
+    out = mx.nd.BilinearSampler(mx.nd.array(data),
+                                mx.nd.array(grid)).asnumpy()
+    tgrid = torch.from_numpy(np.moveaxis(grid, 1, -1))   # (B, Ho, Wo, 2)
+    tout = torch.nn.functional.grid_sample(
+        torch.from_numpy(data), tgrid, mode="bilinear",
+        padding_mode="zeros", align_corners=True).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_identity_and_zoom():
+    d = mx.nd.array(np.random.RandomState(1).randn(2, 3, 5, 5)
+                    .astype(np.float32))
+    ident = mx.nd.array(np.tile([1, 0, 0, 0, 1, 0], (2, 1))
+                        .astype(np.float32))
+    out = mx.nd.SpatialTransformer(d, ident, transform_type="affine",
+                                   sampler_type="bilinear",
+                                   target_shape=(5, 5))
+    np.testing.assert_allclose(out.asnumpy(), d.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+    # 2x zoom-in samples the central half
+    zoom = mx.nd.array(np.tile([0.5, 0, 0, 0, 0.5, 0], (2, 1))
+                       .astype(np.float32))
+    out2 = mx.nd.SpatialTransformer(d, zoom, transform_type="affine",
+                                    sampler_type="bilinear",
+                                    target_shape=(5, 5))
+    center = out2.asnumpy()[:, :, 2, 2]
+    np.testing.assert_allclose(center, d.asnumpy()[:, :, 2, 2], atol=1e-5)

@@ -252,3 +252,47 @@ def test_bucketing_many_buckets_memory_sharing():
         w = m.get_params()[0]["fc_weight"]
         np.testing.assert_array_equal(w.asnumpy(), w_default.asnumpy())
     assert len(mod._buckets) == len(buckets)
+
+
+def test_sequential_module():
+    """SequentialModule chains bound executors, threading outputs into the
+    next module's data and gradients back (ref:
+    python/mxnet/module/sequential_module.py; reference test:
+    tests/python/unittest/test_module.py test_module_layout-adjacent)."""
+    rng = np.random.RandomState(2)
+    n, d = 400, 10
+    w_true = rng.randn(d, 4)
+    x = rng.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1).astype(np.float32)
+    train = io.NDArrayIter(x, y, batch_size=40, shuffle=True)
+
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=32, name="sfc1")
+    net1 = sym.Activation(fc1, act_type="relu", name="srelu1")
+
+    data2 = sym.var("data")
+    fc2 = sym.FullyConnected(data2, num_hidden=4, name="sfc2")
+    net2 = sym.SoftmaxOutput(fc2, name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, label_names=[])) \
+       .add(mx.mod.Module(net2), take_labels=True, auto_wiring=True)
+    seq.bind(train.provide_data, train.provide_label)
+    seq.init_params(initializer=mx.initializer.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5,
+                                         "momentum": 0.9})
+    metric = mx.metric.create("acc")
+    for _epoch in range(12):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+            seq.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.9, f"SequentialModule failed to learn: {metric.get()}"
+    # params gather across children; outputs come from the tail module
+    arg, _ = seq.get_params()
+    assert "sfc1_weight" in arg and "sfc2_weight" in arg
+    assert seq.get_outputs()[0].shape == (40, 4)
