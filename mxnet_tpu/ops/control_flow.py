@@ -163,31 +163,34 @@ def while_loop(cond, func, loop_vars, max_iterations=None,
         from .. import ndarray as nd
         steps = 0
         out_steps = None
-        out_shapes = None
+        out_avals = None
         while (max_iterations is None or steps < max_iterations) and \
                 bool(cond(*lvs).asnumpy()):
             outs, lvs = func(*lvs)
             outs, lvs = _as_list(outs), _as_list(lvs)
             if out_steps is None:
                 out_steps = [[] for _ in outs]
-                out_shapes = [o.shape for o in outs]
+                out_avals = [(o.shape, o.dtype) for o in outs]
             for acc, o in zip(out_steps, outs):
                 acc.append(o)
             steps += 1
         if out_steps is None:
-            # zero executed steps: shapes come from abstractly tracing func
+            # zero executed steps: shapes/dtypes come from abstractly
+            # tracing func
             abstract = jax.eval_shape(
                 lambda *ds: tuple(o._data for o in
                                   _as_list(func(*[_wrap(d) for d in ds])[0])),
                 *_datas(lvs))
-            out_shapes = [a.shape for a in abstract]
-            out_steps = [[] for _ in out_shapes]
+            out_avals = [(a.shape, a.dtype) for a in abstract]
+            out_steps = [[] for _ in out_avals]
         pad_to = max_iterations if max_iterations is not None else steps
         out_nd = []
-        for acc, shp in zip(out_steps, out_shapes):
-            rows = acc + [nd.zeros(shp)] * (pad_to - len(acc))
+        for acc, (shp, dt) in zip(out_steps, out_avals):
+            # pad in the OUTPUT dtype — the traced path masks with
+            # zeros_like, so eager must not promote int outputs to fp32
+            rows = acc + [nd.zeros(shp, dtype=dt)] * (pad_to - len(acc))
             out_nd.append(nd.stack(*rows, axis=0) if rows
-                          else nd.zeros((0,) + shp))
+                          else nd.zeros((0,) + shp, dtype=dt))
         st_nd = list(lvs)
 
     outs_r = out_nd[0] if len(out_nd) == 1 else out_nd

@@ -5,8 +5,8 @@ MXNet 1.x never had, expressed as GSPMD shardings on one device mesh)."""
 from .mesh import (Mesh, NamedSharding, PartitionSpec, current_mesh,
                    data_parallel_spec, default_mesh, make_mesh, replicated,
                    use_mesh)
-from .moe import moe_apply
-from .pipeline import pipeline_apply
+from .moe import moe_apply, moe_apply_topk
+from .pipeline import pipeline_apply, pipeline_schedule_info
 from .ring_attention import (attention_reference, blockwise_attention,
                              ring_attention, ulysses_attention)
 from .sharded import (ShardedTrainer, allreduce_across_processes,
@@ -16,5 +16,5 @@ __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "current_mesh",
            "data_parallel_spec", "default_mesh", "make_mesh", "replicated",
            "use_mesh", "ShardedTrainer", "allreduce_across_processes",
            "functional_apply", "ring_attention", "blockwise_attention",
-           "ulysses_attention", "attention_reference", "pipeline_apply",
-           "moe_apply"]
+           "ulysses_attention", "attention_reference", "pipeline_apply", "pipeline_schedule_info",
+           "moe_apply", "moe_apply_topk"]

@@ -2,17 +2,26 @@
 MXNet 1.x has no pipeline schedule — SURVEY §2.4 #32 marks PP absent; the
 reference's closest tool is hand `ctx_group` placement).
 
-Design (GPipe-style, TPU-idiomatic):
-- every pipeline stage runs the SAME traced computation with its own
-  parameter shard (stage params stacked on a leading axis sharded over
-  ``pipe``) — SPMD-friendly: one program, P devices;
+Design (TPU-idiomatic SPMD):
+- L = v*P layers live on P devices; device d owns layers {d, P+d, ...}
+  (params stacked on a leading layer axis, sharded over ``pipe``);
 - microbatches stream through a static tick loop; activations hop to the
-  next stage via ``lax.ppermute`` (one ICI neighbor hop per tick);
-- the schedule is differentiable end-to-end: jax transposes the ppermute
-  chain, so backward is the reverse pipeline automatically — no hand-rolled
-  1F1B bookkeeping;
-- bubbles: (P-1) ticks of the M+P-1 total, the standard GPipe cost; use
-  microbatches ≥ 4×P to amortize.
+  next stage with ``lax.ppermute`` (one ICI neighbor hop per tick) and
+  wrap around the ring v times — the **interleaved/circular schedule**
+  (Megatron-LM's interleaved 1F1B shape): with v virtual stages per
+  device the bubble shrinks from GPipe's (P-1)·v layer-times to (P-1),
+  i.e. fraction (P-1)/(v·m+P-1);
+- ``v=1`` degenerates to plain GPipe;
+- heterogeneous ends: optional ``embed_fn`` runs on the injection edge
+  (stage 0) and ``head_fn`` on the exit edge (last stage), so a real
+  model (embedding → N blocks → head) maps without padding tricks. Both
+  are evaluated redundantly on every device (their cost is O(1%) of the
+  blocks in a transformer) and selected by device index — predication
+  instead of per-device branching, the XLA-friendly choice;
+- the whole schedule is differentiable end-to-end: jax transposes the
+  ppermute chain, so backward is the reverse pipeline automatically —
+  activation stashing falls out of the scan's saved residuals instead of
+  hand-rolled 1F1B bookkeeping.
 """
 from __future__ import annotations
 
@@ -28,65 +37,133 @@ try:
 except ImportError:                      # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_schedule_info"]
+
+
+def pipeline_schedule_info(n_stages, num_microbatches, num_virtual=1):
+    """Static schedule accounting: total ticks, busy ticks per device,
+    and the bubble fraction (P-1)/(v*m+P-1). One "tick" costs one layer
+    application (GPipe packs v layers per tick into each of its m+P-1
+    ticks, so its bubble is v*(P-1) layer-times — same formula with the
+    tick cost scaled)."""
+    p, m, v = int(n_stages), int(num_microbatches), int(num_virtual)
+    ticks = v * m + p - 1
+    busy = v * m
+    return {"ticks": ticks, "busy": busy,
+            "bubble_fraction": (p - 1) / ticks}
 
 
 def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
-                   axis_name="pipe", num_microbatches=None):
-    """Run ``x`` through P pipeline stages.
+                   axis_name="pipe", num_microbatches=None,
+                   num_virtual_stages=1, embed_fn=None, embed_params=None,
+                   head_fn=None, head_params=None):
+    """Run ``x`` through L = num_virtual_stages * P pipeline layers.
 
-    stage_fn(params_i, x) -> y        same signature for every stage
-    stage_params: pytree whose leaves are stacked (P, ...) — stage i's
-        slice feeds device i (sharded over ``axis_name``)
-    x: (B, ...) global batch; split into ``num_microbatches`` chunks
-        (default: pipeline depth).
+    stage_fn(params_l, h) -> h'       same signature for every layer;
+        activations must share one shape (they ride one ppermute ring)
+    stage_params: pytree, leaves stacked (L, ...) — layer l lives on
+        device l % P (virtual pass l // P)
+    x: (B, ...) global batch, split into ``num_microbatches`` chunks
+        (default: P; interleaving needs m >= P)
+    embed_fn(embed_params, micro) -> h   optional stage-0 prologue (e.g.
+        token embedding); applied to each microbatch as it enters
+    head_fn(head_params, outs) -> y      optional last-stage epilogue
+        (e.g. vocab projection); applied batched to the collected
+        pipeline outputs
 
-    Returns the (B, ...) output of the final stage, replicated.
+    Returns the (B, ...) output of the final stage (after head_fn if
+    given), replicated across the axis.
     """
     from .mesh import current_mesh
     mesh = mesh or current_mesh()
     if axis_name not in mesh.axis_names:
         raise MXNetError(f"mesh has no axis {axis_name!r}")
-    p_size = mesh.shape[axis_name]
-    m = num_microbatches or p_size
+    p_size = int(mesh.shape[axis_name])
+    v = int(num_virtual_stages)
+    m = int(num_microbatches or p_size)
     b = x.shape[0]
     if b % m:
         raise MXNetError(f"batch {b} not divisible by {m} microbatches")
+    if v > 1 and m < p_size:
+        raise MXNetError(f"interleaved schedule needs microbatches >= "
+                         f"pipeline depth ({m} < {p_size}): the wrapped "
+                         f"activation of pass p must be back before its "
+                         f"re-injection tick")
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    if leaves and leaves[0].shape[0] != v * p_size:
+        raise MXNetError(f"stage_params leading dim "
+                         f"{leaves[0].shape[0]} != num_virtual_stages * "
+                         f"pipe axis = {v * p_size}")
     micro = x.reshape((m, b // m) + x.shape[1:])
+    ticks = v * m + p_size - 1
 
+    # (L, ...) -> (v, P, ...): pass-major split, P axis sharded
+    stage_params = jax.tree_util.tree_map(
+        lambda a: a.reshape((v, p_size) + a.shape[1:]), stage_params)
     param_spec = jax.tree_util.tree_map(
-        lambda _: P(axis_name), stage_params)
+        lambda _: P(None, axis_name), stage_params)
+    rep = jax.tree_util.tree_map(lambda _: P(), (embed_params,
+                                                 head_params))
     perm = [(i, (i + 1) % p_size) for i in range(p_size)]
 
-    def body(params_local, micro_all):
-        # params_local leaves: (1, ...) — this device's stage
-        params_i = jax.tree_util.tree_map(lambda a: a[0], params_local)
+    def body(params_local, e_params, h_params, micro_all):
+        # params_local leaves: (v, 1, ...) — this device's layer stack
         d = lax.axis_index(axis_name)
         is_first = d == 0
         is_last = d == p_size - 1
         micro_bs = micro_all.shape[1]
 
-        def stage_step(cur, t):
-            # device 0 injects microbatch t (if any); others take the
-            # activation that just arrived
-            inj_idx = jnp.clip(t, 0, m - 1)
-            injected = micro_all[inj_idx]
-            inp = jnp.where(is_first, injected.astype(cur.dtype), cur)
-            y = stage_fn(params_i, inp)
-            nxt = lax.ppermute(y, axis_name, perm)
-            return nxt, y
+        def inject(t, wrap_buf):
+            """Input for the unit device 0 starts at tick t: microbatch
+            t%m, pass t//m — a fresh (embedded) microbatch on pass 0, a
+            wrapped activation afterwards."""
+            i0 = jnp.mod(t, m)
+            fresh = micro_all[i0]
+            if embed_fn is not None:
+                fresh = embed_fn(e_params, fresh)
+            wrapped = jnp.take(wrap_buf, i0, axis=0)
+            return jnp.where(t // m > 0, wrapped,
+                             fresh.astype(wrapped.dtype))
 
-        # probe output shape of one stage application
-        cur0 = jnp.zeros_like(stage_fn(params_i, micro_all[0]))
-        _, ys = lax.scan(stage_step, cur0, jnp.arange(m + p_size - 1))
-        # microbatch j exits the last stage at tick j + (P-1)
-        outs = ys[p_size - 1:]
+        def tick(carry, t):
+            wrap_buf, cur = carry
+            inp = jnp.where(is_first, inject(t, wrap_buf), cur)
+            # unit on this device: u = t - d; its virtual pass picks the
+            # layer params (device d, pass p -> layer p*P + d)
+            p_u = jnp.clip((t - d) // m, 0, v - 1)
+            params_u = jax.tree_util.tree_map(
+                lambda a: jnp.take(a, p_u, axis=0)[0], params_local)
+            y = stage_fn(params_u, inp)
+            nxt = lax.ppermute(y, axis_name, perm)
+            # what device 0 just received from device P-1 is unit
+            # t-(P-1) finishing a pass: stash it for re-injection
+            wrapped_i = jnp.mod(t - (p_size - 1), m)
+            wrap_buf = lax.dynamic_update_index_in_dim(
+                wrap_buf, nxt, wrapped_i, axis=0)
+            return (wrap_buf, nxt), y
+
+        probe_params = jax.tree_util.tree_map(lambda a: a[0, 0],
+                                              params_local)
+        probe_in = micro_all[0] if embed_fn is None else \
+            embed_fn(e_params, micro_all[0])
+        act0 = jnp.zeros_like(stage_fn(probe_params, probe_in))
+        # broadcast act0 in so the buffer carries the same varying-axis
+        # type as the ppermute outputs that update it (shard_map vma)
+        wrap0 = jnp.zeros((m,) + act0.shape, act0.dtype) + act0
+        _, ys = lax.scan(tick, (wrap0, act0), jnp.arange(ticks))
+        # microbatch i exits its LAST pass on device P-1 at tick
+        # (v-1)*m + i + (P-1)
+        outs = ys[(v - 1) * m + p_size - 1:]
+        if head_fn is not None:
+            outs = head_fn(h_params,
+                           outs.reshape((m * micro_bs,) + outs.shape[2:]))
+            outs = outs.reshape((m, micro_bs) + outs.shape[1:])
         outs = jnp.where(is_last, outs, jnp.zeros_like(outs))
         outs = lax.psum(outs, axis_name)       # broadcast from last stage
         return outs.reshape((m * micro_bs,) + outs.shape[2:])
 
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(param_spec, P()),
+        in_specs=(param_spec, rep[0], rep[1], P()),
         out_specs=P())
-    return fn(stage_params, micro)
+    return fn(stage_params, embed_params, head_params, micro)

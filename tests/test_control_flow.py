@@ -266,3 +266,12 @@ def test_sym_while_loop_and_cond():
     c2 = sym.load_json(c.tojson())
     assert float(c2.eval(p=nd.array([1.0]), i=nd.array([3.0]))[0]
                  .asnumpy()) == 4.0
+
+
+def test_while_loop_eager_padding_preserves_dtype():
+    """Padding rows must keep the step outputs' dtype (int token ids
+    stay int on BOTH the eager and traced paths)."""
+    outs, _ = nd.contrib.while_loop(
+        lambda i: i < 3, lambda i: ([i.astype("int32")], [i + 1]),
+        [nd.array([0.0])], max_iterations=5)
+    assert outs.dtype == np.int32
