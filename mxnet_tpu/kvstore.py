@@ -236,9 +236,15 @@ class KVStore:
 
     # -- optimizer on the store (ref: kv.set_optimizer → server pickle) ------
     def set_optimizer(self, optimizer):
-        # round-trip through pickle like the reference ships it to servers —
-        # catches unpicklable optimizers early and proves ckpt-ability
-        self._optimizer = pickle.loads(pickle.dumps(optimizer))
+        # the reference pickles the optimizer to ship it to SERVER
+        # processes (ref: kvstore.py set_optimizer -> _send_command_to_
+        # servers); keep that as a shippability check, but hold the LIVE
+        # object: this store's updater runs in-process, so Trainer.step's
+        # rescale_grad/learning-rate mutations must reach it (the
+        # reference's in-process 'device' mode shares the object the
+        # same way)
+        pickle.dumps(optimizer)
+        self._optimizer = optimizer
         self._updater = opt.get_updater(self._optimizer)
 
     def _set_updater(self, updater):

@@ -97,8 +97,10 @@ def moe_apply_topk(expert_fn, expert_params, gate_logits, x, k=2,
 
     Returns (y, aux_loss, stats):
       y        (B, D_out) — combined expert outputs (dropped tokens: 0)
-      aux_loss scalar — E * Σ_e load_e · mean_prob_e (Switch §2.2),
-               1.0 at perfect balance; add ~0.01·aux_loss to the loss
+      aux_loss scalar — E * Σ_e load_e · mean_prob_e (Switch §2.2);
+               load counts all k choices, so perfect balance gives k
+               (1.0 for top-1, 2.0 for the default top-2); add
+               ~0.01·aux_loss to the loss
       stats    dict: 'dropped' — global fraction of (token, slot) pairs
                that overflowed capacity
     """

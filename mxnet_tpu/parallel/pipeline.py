@@ -113,14 +113,18 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
         is_last = d == p_size - 1
         micro_bs = micro_all.shape[1]
 
+        # embed once, before the scan: inject() reads the pre-embedded
+        # buffer so the (possibly expensive) lookup runs m times, not
+        # P*(v*m+P-1) times
+        embedded = micro_all if embed_fn is None else \
+            jax.vmap(lambda mb: embed_fn(e_params, mb))(micro_all)
+
         def inject(t, wrap_buf):
             """Input for the unit device 0 starts at tick t: microbatch
             t%m, pass t//m — a fresh (embedded) microbatch on pass 0, a
             wrapped activation afterwards."""
             i0 = jnp.mod(t, m)
-            fresh = micro_all[i0]
-            if embed_fn is not None:
-                fresh = embed_fn(e_params, fresh)
+            fresh = embedded[i0]
             wrapped = jnp.take(wrap_buf, i0, axis=0)
             return jnp.where(t // m > 0, wrapped,
                              fresh.astype(wrapped.dtype))
@@ -144,9 +148,7 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
 
         probe_params = jax.tree_util.tree_map(lambda a: a[0, 0],
                                               params_local)
-        probe_in = micro_all[0] if embed_fn is None else \
-            embed_fn(e_params, micro_all[0])
-        act0 = jnp.zeros_like(stage_fn(probe_params, probe_in))
+        act0 = jnp.zeros_like(stage_fn(probe_params, embedded[0]))
         # broadcast act0 in so the buffer carries the same varying-axis
         # type as the ppermute outputs that update it (shard_map vma)
         wrap0 = jnp.zeros((m,) + act0.shape, act0.dtype) + act0
