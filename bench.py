@@ -35,10 +35,14 @@ def main():
     net = vision.resnet50_v1()
     net.initialize()
     mesh = parallel.make_mesh({"data": len(jax.devices())})
+    # bf16 master weights+momentum: −0.6 GB/step of optimizer traffic on
+    # an HBM-bound step (+1.9%, docs/perf_notes.md round 3); convergence-
+    # gated against fp32 masters in tests/test_convergence.py
     trainer = parallel.ShardedTrainer(
         net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
-        mesh=mesh, compute_dtype="bfloat16" if on_tpu else None)
+        mesh=mesh, compute_dtype="bfloat16" if on_tpu else None,
+        master_dtype="bfloat16" if on_tpu else None)
 
     x_host = np.random.randn(batch, 3, 224, 224).astype(np.float32)
     y_host = np.random.randint(0, 1000, (batch,))

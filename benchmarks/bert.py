@@ -49,23 +49,23 @@ def main():
             self.inner = inner
 
         def hybrid_forward(self, F, tokens):
+            # keep the logits 3-D (B, S, V): the CE loss picks/reduces over
+            # the last axis in place — flattening to (B*S, V) forced XLA to
+            # relayout the 1 GB logits tensor (copy.1217, 2 GB of HBM
+            # traffic, docs/perf_notes.md round 4)
             _, mlm = self.inner(tokens)
-            return F.reshape(mlm, (-1, vocab))
+            return mlm
 
-    class FlatCE(gluon.loss.Loss):
-        amp_safe = property(lambda self: self._ce.amp_safe)
-
-        def __init__(self):
-            super().__init__(None, 0)
-            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
-
-        def hybrid_forward(self, F, pred, label):
-            return self._ce(pred, F.reshape(label, (-1,)))
-
+    # bf16 master weights + adam moments: adam state is 3×fp32 tensors of
+    # param size — on a 110 M-param model that is ~2.6 GB/step of optimizer
+    # traffic, +10.5% measured when halved (perf_notes round 4); conver-
+    # gence-gated against fp32 masters in tests/test_convergence.py
     mesh = parallel.make_mesh({"data": len(jax.devices())})
     trainer = parallel.ShardedTrainer(
-        MLMWrapper(net), FlatCE(), "adam", {"learning_rate": 1e-4},
-        mesh=mesh, compute_dtype="bfloat16" if on_tpu else None)
+        MLMWrapper(net), gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-4},
+        mesh=mesh, compute_dtype="bfloat16" if on_tpu else None,
+        master_dtype="bfloat16" if on_tpu else None)
 
     toks = np.random.randint(0, vocab, (batch, seq))
     trainer.run_steps(toks, toks, num_steps=k).wait_to_read()

@@ -29,7 +29,8 @@ import time
 import numpy as np
 
 
-def measure(name, batch, seq, vocab, on_tpu, remat=None, dropout=0.1):
+def measure(name, batch, seq, vocab, on_tpu, remat=None, dropout=0.1,
+            master_dtype=None, flatten=True):
     import jax
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, parallel
@@ -53,7 +54,7 @@ def measure(name, batch, seq, vocab, on_tpu, remat=None, dropout=0.1):
 
         def hybrid_forward(self, F, tokens):
             _, mlm = self.inner(tokens)
-            return F.reshape(mlm, (-1, vocab))
+            return F.reshape(mlm, (-1, vocab)) if flatten else mlm
 
     class FlatCE(gluon.loss.Loss):
         amp_safe = property(lambda self: self._ce.amp_safe)
@@ -63,13 +64,15 @@ def measure(name, batch, seq, vocab, on_tpu, remat=None, dropout=0.1):
             self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
 
         def hybrid_forward(self, F, pred, label):
-            return self._ce(pred, F.reshape(label, (-1,)))
+            if flatten:
+                label = F.reshape(label, (-1,))
+            return self._ce(pred, label)
 
     mesh = parallel.make_mesh({"data": len(jax.devices())})
     trainer = parallel.ShardedTrainer(
         MLMWrapper(net), FlatCE(), "adam", {"learning_rate": 1e-4},
         mesh=mesh, compute_dtype="bfloat16" if on_tpu else None,
-        remat=remat)
+        remat=remat, master_dtype=master_dtype)
     toks = np.random.randint(0, min(vocab, 30000), (batch, seq))
 
     k = 8 if on_tpu else 2
@@ -108,6 +111,11 @@ def main():
         "seq_pack": dict(batch=B // 2, seq=2 * S, vocab=V),
         "remat_dots": dict(batch=B, seq=S, vocab=V, remat="dots"),
         "no_dropout": dict(batch=B, seq=S, vocab=V, dropout=0.0),
+        "bf16_master": dict(batch=B, seq=S, vocab=V,
+                            master_dtype="bfloat16"),
+        "loss3d": dict(batch=B, seq=S, vocab=V, flatten=False),
+        "bf16m_loss3d": dict(batch=B, seq=S, vocab=V, flatten=False,
+                             master_dtype="bfloat16"),
     }
     names = args.configs or list(matrix)
     print(f"platform={jax.devices()[0].platform}", flush=True)

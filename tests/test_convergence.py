@@ -109,6 +109,60 @@ def test_module_fit_converges():
         f"Module.fit accuracy {score['accuracy']:.3f} below the 0.95 gate"
 
 
+def _sharded_train_accuracy(x, y, optimizer, params, master_dtype,
+                            epochs=6):
+    from mxnet_tpu import parallel
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Flatten(),
+            gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh({"data": -1})
+    tr = parallel.ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 optimizer, dict(params), mesh=mesh,
+                                 master_dtype=master_dtype)
+    n, bs = x.shape[0], 128
+    for _ in range(epochs):
+        for i in range(0, n - bs + 1, bs):
+            tr.step(x[i:i + bs], y[i:i + bs])
+    # pull the trained (mesh-sharded) params back to the default device
+    # for a plain eager evaluation pass
+    for p in net.collect_params().values():
+        p.set_data(nd.array(np.asarray(p.data().asnumpy(),
+                                       dtype=np.float32)))
+    out = net(nd.array(x)).asnumpy()
+    return (out.argmax(1) == y).mean()
+
+
+def test_bf16_master_sgd_converges():
+    """bf16 master weights + momentum (the bench.py throughput config,
+    docs/perf_notes.md round 4) must clear the SAME accuracy gate as fp32
+    masters — storage dtype is a perf knob, not a correctness trade."""
+    x, y = synthetic_mnist(n=512)
+    acc32 = _sharded_train_accuracy(
+        x, y, "sgd", {"learning_rate": 0.1, "momentum": 0.9}, None)
+    acc16 = _sharded_train_accuracy(
+        x, y, "sgd", {"learning_rate": 0.1, "momentum": 0.9}, "bfloat16")
+    assert acc32 >= 0.97, f"fp32-master control fell to {acc32:.3f}"
+    assert acc16 >= 0.97, \
+        f"bf16-master accuracy {acc16:.3f} below the fp32 gate ({acc32:.3f})"
+
+
+def test_bf16_master_adam_converges():
+    """Adam with bf16 m/v/params (benchmarks/bert.py's config) clears the
+    same gate — guards the BERT headline number's validity."""
+    x, y = synthetic_mnist(n=512)
+    acc32 = _sharded_train_accuracy(
+        x, y, "adam", {"learning_rate": 1e-3}, None, epochs=8)
+    acc16 = _sharded_train_accuracy(
+        x, y, "adam", {"learning_rate": 1e-3}, "bfloat16", epochs=8)
+    assert acc32 >= 0.97, f"fp32-master control fell to {acc32:.3f}"
+    assert acc16 >= 0.97, \
+        f"bf16-master accuracy {acc16:.3f} below the fp32 gate ({acc32:.3f})"
+
+
 def test_wrong_loss_fails_the_gate():
     """Meta-test: the gate actually catches a broken training setup — a
     sign-flipped loss (ascending gradient) must land far below 0.97."""
