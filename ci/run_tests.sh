@@ -5,6 +5,9 @@
 #
 #   ci/run_tests.sh sanity          lint only (ci/lint.py, dependency-free)
 #   ci/run_tests.sh fast            lint + the quick unit tier
+#   ci/run_tests.sh sanitize        native runtime under ASAN/UBSAN + TSAN
+#                                   (ref: runtime_functions.sh sanitizer
+#                                   builds — SURVEY §5.2)
 #   ci/run_tests.sh [full]          lint + the whole suite (default)
 #   ci/run_tests.sh full -k expr    extra args go to pytest
 #
@@ -25,8 +28,25 @@ cd "$REPO"
 
 TIER="full"
 case "${1:-}" in
-  sanity|fast|full) TIER="$1"; shift ;;
+  sanity|fast|full|sanitize) TIER="$1"; shift ;;
 esac
+
+if [ "$TIER" = "sanitize" ]; then
+  echo "== tier: sanitize (native ASAN/UBSAN + TSAN) =="
+  cd native
+  CXX="${CXX:-g++}"
+  COMMON="-O1 -g -std=c++17 -fno-omit-frame-pointer -pthread"
+  SRCS="test_sanitize.cc engine.cc recordio.cc predict.cc"
+  WORK="$(mktemp -d)"          # run-scoped: concurrent CI jobs don't collide
+  trap 'rm -rf "$WORK"' EXIT
+  "$CXX" $COMMON -fsanitize=address,undefined -fno-sanitize-recover=all \
+      -o "$WORK/asan" $SRCS
+  ASAN_OPTIONS=detect_leaks=1 "$WORK/asan" "$WORK/roundtrip.rec"
+  "$CXX" $COMMON -fsanitize=thread -o "$WORK/tsan" $SRCS
+  TSAN_OPTIONS=halt_on_error=1 "$WORK/tsan" "$WORK/roundtrip.rec"
+  echo "sanitize tier PASS"
+  exit 0
+fi
 
 echo "== tier: sanity (lint) =="
 python ci/lint.py

@@ -24,23 +24,17 @@ from mxnet_tpu.gluon.model_zoo import bert
 
 
 class MLMWrapper(gluon.HybridBlock):
-    def __init__(self, inner, vocab):
+    """Keeps the logits 3-D (B, S, V): the CE loss reduces over the last
+    axis in place — flattening forced a logits relayout on TPU
+    (docs/perf_notes.md round 4)."""
+
+    def __init__(self, inner):
         super().__init__()
         self.inner = inner
-        self._vocab = vocab
 
     def hybrid_forward(self, F, tokens):
         seq, mlm = self.inner(tokens)
-        return F.reshape(mlm, (-1, self._vocab))
-
-
-class FlatCE(gluon.loss.Loss):
-    def __init__(self):
-        super().__init__(None, 0)
-        self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
-
-    def hybrid_forward(self, F, pred, label):
-        return self._ce(pred, F.reshape(label, (-1,)))
+        return mlm
 
 
 def main():
@@ -52,6 +46,11 @@ def main():
     p.add_argument("--seq-length", type=int, default=128)
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--num-layers", type=int, default=None,
+                   help="override the config (tiny CI runs)")
+    p.add_argument("--units", type=int, default=None)
+    p.add_argument("--num-heads", type=int, default=None)
+    p.add_argument("--hidden-size", type=int, default=None)
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="size of the seq mesh axis (ring attention)")
     p.add_argument("--bf16", action="store_true", default=True)
@@ -65,14 +64,19 @@ def main():
         axes["seq"] = args.seq_parallel
     mesh = parallel.make_mesh(axes)
 
+    overrides = {k: v for k, v in dict(
+        num_layers=args.num_layers, units=args.units,
+        num_heads=args.num_heads, hidden_size=args.hidden_size).items()
+        if v is not None}
     net = bert.get_bert_model(
         args.model, vocab_size=args.vocab_size,
         max_length=max(512, args.seq_length),
         use_pooler=False, use_classifier=False,
-        seq_parallel=args.seq_parallel > 1)
+        seq_parallel=args.seq_parallel > 1, **overrides)
     net.initialize(mx.init.Normal(0.02))
     trainer = parallel.ShardedTrainer(
-        MLMWrapper(net, args.vocab_size), FlatCE(), "adam",
+        MLMWrapper(net),
+        gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
         optimizer_params={"learning_rate": args.lr},
         mesh=mesh, compute_dtype="bfloat16" if args.bf16 else None)
 
@@ -89,6 +93,7 @@ def main():
             logging.info("Batch [%d]\tmlm_loss=%.4f", step,
                          loss.asscalar())
     dt = time.time() - tic
+    logging.info("final mlm_loss=%.4f", loss.asscalar())
     logging.info("Speed: %.2f samples/sec (%d chips, seq=%d)",
                  seen / dt, n_dev, args.seq_length)
 

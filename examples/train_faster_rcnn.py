@@ -38,6 +38,7 @@ def main():
                              rpn_pre_nms_top_n=200,
                              rpn_post_nms_top_n=32)
     net.initialize(mx.init.Xavier())
+    net.hybridize()   # loss matching is in-graph since round 4
     loss_fn = FasterRCNNLoss(net)
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": args.lr})

@@ -79,6 +79,7 @@ def main():
     rows = det.asnumpy()[0]
     kept = rows[rows[:, 0] >= 0]
     logging.info("detections (top 3): %s", kept[:3])
+    logging.info("final loss=%.4f", float(loss.asnumpy().mean()))
     metric = mx.metric.VOC07MApMetric(iou_thresh=0.5)
     metric.update(mx.nd.array(labels), det)
     name, value = metric.get()

@@ -142,21 +142,20 @@ class FasterRCNNLoss(Loss):
 
     def hybrid_forward(self, F, outputs, gt_label, im_shape):
         rois, cls_logits, bbox_deltas, rpn_raw, rpn_bbox = outputs
-        # Guard BEFORE any concretization (float(im_shape), .shape unpack):
-        # under hybridize()/ShardedTrainer every input is a tracer and the
-        # host-side matching below cannot run — fail with the documented
-        # error, not a JAX concretization error.
-        if any(isinstance(getattr(a, "_data", a), jax.core.Tracer)
-               for a in (gt_label, rois, rpn_raw, im_shape)):
-            raise MXNetError(
-                "FasterRCNNLoss is eager-only: per-image proposal↔gt "
-                "matching runs host-side (asnumpy + Python loop, like the "
-                "reference's MXProposalTarget custom op). Do not "
-                "hybridize() it or wrap it in ShardedTrainer; train with "
-                "the eager loop in examples/train_faster_rcnn.py "
-                "(docs/divergences.md #12)")
+        # im_shape must be STATIC (a plain (h, w) tuple): it sizes the
+        # anchor constants. Everything downstream is F ops — the loss
+        # traces under hybridize()/jit (round-4: divergence #12 closed;
+        # the reference runs this matching in the MXProposalTarget C++ op,
+        # src/operator/contrib/proposal_target.cc).
         n, _, fh, fw = rpn_raw.shape
-        ih, iw = float(im_shape[0]), float(im_shape[1])
+        try:
+            ih, iw = float(im_shape[0]), float(im_shape[1])
+        except (TypeError, jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            raise MXNetError(
+                "FasterRCNNLoss: pass im_shape as a static (h, w) tuple "
+                "— it parameterizes the anchor grid, which must be a "
+                "trace-time constant") from None
         a = len(self._m._scales) * len(self._m._ratios)
 
         # ---- RPN targets: anchors vs gt (class-agnostic objectness).
@@ -172,25 +171,30 @@ class FasterRCNNLoss(Loss):
                 self._anchor_cache.pop(next(iter(self._anchor_cache)))
             anchors = rpn_anchors(fh, fw, self._m._stride,
                                   self._m._scales, self._m._ratios)
-            norm = np.array([iw, ih, iw, ih], np.float32)
+            norm_np = np.array([iw, ih, iw, ih], np.float32)
             ext = anchors + np.array([0, 0, 1, 1], np.float32)
-            self._anchor_cache[key] = (anchors,
-                                       F.array((ext / norm)[None]))
-        anchors, anc_norm = self._anchor_cache[key]
-        norm = np.array([iw, ih, iw, ih], np.float32)
-        gt = gt_label.asnumpy() if hasattr(gt_label, "asnumpy") else \
-            np.asarray(gt_label)
-        gt_obj = gt.copy()
-        gt_obj[..., 0] = np.where(gt_obj[..., 0] >= 0, 0.0, -1.0)
-        gt_obj[..., 3:5] += 1.0                 # legacy +1 extents
-        gt_obj[..., 1:5] = gt_obj[..., 1:5] / norm
+            # cache device arrays: eager steps reuse them without a
+            # re-upload; under jit they embed as constants
+            self._anchor_cache[key] = (anchors.shape[0],
+                                       F.array((ext / norm_np)[None]),
+                                       F.array(norm_np))
+        num_anchors, anc_norm, norm = self._anchor_cache[key]
+        # gt preprocessing in-graph: objectness labels (0 for every real
+        # box, -1 padding), legacy +1 extents, pixel → normalized coords
+        gt_cls = F.slice_axis(gt_label, axis=-1, begin=0, end=1)
+        gt_box = F.slice_axis(gt_label, axis=-1, begin=1, end=5)
+        obj_cls = F.where(gt_cls >= 0, F.zeros_like(gt_cls),
+                          -F.ones_like(gt_cls))
+        ext_box = F.broadcast_add(
+            gt_box, F.array(np.array([0, 0, 1, 1], np.float32)))
+        gt_obj = F.concat(obj_cls, F.broadcast_div(ext_box, norm), dim=-1)
         # dummy cls_preds (N, A, 2) just threads through the matcher
-        dummy = F.zeros((n, anchors.shape[0], 2))
+        dummy = F.zeros((n, num_anchors, 2))
         # variances (1,1,1,1): the Proposal op decodes RAW deltas
         # (NonLinearTransformInv has no variance factor), so the targets
         # the RPN regresses toward must be unscaled
         rpn_loc_t, rpn_loc_m, rpn_cls_t = F.contrib.MultiBoxTarget(
-            anc_norm, F.array(gt_obj), dummy,
+            anc_norm, gt_obj, dummy,
             overlap_threshold=0.7, negative_mining_ratio=3.0,
             variances=(1.0, 1.0, 1.0, 1.0))
         # rpn_raw (N, 2A, H, W): per-anchor pair logits → (N, A*H*W, 2)
@@ -214,39 +218,36 @@ class FasterRCNNLoss(Loss):
                         scalar=3.0)) / F.broadcast_maximum(
             F.sum(rpn_loc_m) / 4.0, F.ones((1,)))
 
-        # ---- RCNN targets: proposals vs gt (per-class)
-        rois_np = rois.asnumpy() if hasattr(rois, "asnumpy") else \
-            np.asarray(rois)
-        per = rois_np.reshape(n, -1, 5)
-        cls_losses = []
-        box_losses = []
+        # ---- RCNN targets: proposals vs gt (per-class), fully in-graph —
+        # per-image anchor sets via the batched MultiBoxTarget extension
+        # (vmapped over rois AND gt; replaces the round-3 host loop)
+        per = F.reshape(rois, (n, -1, 5))
         topn = per.shape[1]
-        roi_norm = per[..., 1:5] / norm
-        gt_n = gt.copy()
-        gt_n[..., 1:5] = gt_n[..., 1:5] / norm
+        valid = F.cast(F.slice_axis(per, axis=-1, begin=0, end=1) >= 0,
+                       "float32")
+        valid = F.reshape(valid, (n, topn))               # (N, topn)
+        roi_norm = F.broadcast_div(
+            F.slice_axis(per, axis=-1, begin=1, end=5), norm)
+        gt_n = F.concat(gt_cls, F.broadcast_div(gt_box, norm), dim=-1)
         logits = F.reshape(cls_logits, (n, topn, -1))
         deltas = F.reshape(bbox_deltas, (n, topn, 4))
-        for i in range(n):
-            valid_rois = per[i, :, 0] >= 0
-            anc = F.array(roi_norm[i][None])
-            dummy2 = F.zeros((1, topn, self._m._classes + 1))
-            loc_t, loc_m, cls_t2 = F.contrib.MultiBoxTarget(
-                anc, F.array(gt_n[i][None]), dummy2,
-                overlap_threshold=0.5, negative_mining_ratio=-1.0)
-            ce2 = F.log_softmax(logits[i], axis=-1)
-            valid = F.array(valid_rois.astype(np.float32))
-            cls_sel = F.pick(ce2, F.broadcast_maximum(cls_t2[0], F.zeros((1,))),
-                             axis=-1)
-            cls_losses.append(-F.sum(cls_sel * valid)
-                              / F.broadcast_maximum(F.sum(valid), F.ones((1,))))
-            lm = F.reshape(loc_m[0], (topn, 4)) * F.reshape(valid,
-                                                            (topn, 1))
-            lt = F.reshape(loc_t[0], (topn, 4))
-            box_losses.append(F.sum(F.smooth_l1(
-                (deltas[i] - lt) * lm, scalar=1.0)) / F.broadcast_maximum(
-                F.sum(lm) / 4.0, F.ones((1,))))
-        rcnn_cls_loss = sum(cls_losses) / n
-        rcnn_box_loss = sum(box_losses) / n
+        dummy2 = F.zeros((n, topn, self._m._classes + 1))
+        loc_t, loc_m, cls_t2 = F.contrib.MultiBoxTarget(
+            roi_norm, gt_n, dummy2,
+            overlap_threshold=0.5, negative_mining_ratio=-1.0)
+        ce2 = F.log_softmax(logits, axis=-1)              # (N, topn, C+1)
+        cls_sel = F.pick(ce2, F.broadcast_maximum(
+            cls_t2, F.zeros((1, 1))), axis=-1)            # (N, topn)
+        nvalid = F.broadcast_maximum(F.sum(valid, axis=1), F.ones((1,)))
+        rcnn_cls_loss = F.mean(-F.sum(cls_sel * valid, axis=1) / nvalid)
+        lm = F.reshape(loc_m, (n, topn, 4)) * F.reshape(valid,
+                                                        (n, topn, 1))
+        lt = F.reshape(loc_t, (n, topn, 4))
+        box_num = F.broadcast_maximum(
+            F.sum(F.reshape(lm, (n, -1)), axis=1) / 4.0, F.ones((1,)))
+        rcnn_box_loss = F.mean(F.sum(F.reshape(
+            F.smooth_l1((deltas - lt) * lm, scalar=1.0),
+            (n, -1)), axis=1) / box_num)
         return (rpn_cls_loss + rpn_loc_loss + rcnn_cls_loss
                 + rcnn_box_loss)
 

@@ -392,19 +392,22 @@ def _ring_attention_op(q, k, v, axis_name="seq", causal=False,
               "(loc_target, loc_mask, cls_target). Static shapes, vmapped "
               "over the batch (ref: src/operator/contrib/"
               "multibox_target.cc). gt label rows are [cls, x0, y0, x1, "
-              "y1], padded with cls=-1.")
+              "y1], padded with cls=-1. TPU extension over the reference: "
+              "anchors may be (N, A, 4) — one anchor set PER IMAGE (the "
+              "Faster R-CNN proposal↔gt matching case, ref: "
+              "src/operator/contrib/proposal_target.cc) — vmapped over "
+              "both, so the whole assignment stays in-graph.")
 def _multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
                      ignore_label=-1.0, negative_mining_ratio=-1.0,
                      negative_mining_thresh=0.5, minimum_negative_samples=0,
                      variances=(0.1, 0.1, 0.2, 0.2)):
-    anc = anchors.reshape(-1, 4)                      # (A, 4) corner
-    acx = (anc[:, 0] + anc[:, 2]) / 2
-    acy = (anc[:, 1] + anc[:, 3]) / 2
-    aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-12)
-    ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-12)
-    A = anc.shape[0]
-
-    def one(label, cls_pred):
+    def one(anc, label, cls_pred):
+        anc = anc.reshape(-1, 4)                      # (A, 4) corner
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-12)
+        ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-12)
+        A = anc.shape[0]
         gt_cls = label[:, 0]
         gt_box = label[:, 1:5]
         valid = gt_cls >= 0                           # (M,)
@@ -451,7 +454,14 @@ def _multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
             loc_t.dtype)
         return (loc_t.reshape(-1), loc_m.reshape(-1), cls_t)
 
-    loc_t, loc_m, cls_t = jax.vmap(one)(labels, cls_preds)
+    if anchors.ndim == 3 and anchors.shape[0] == labels.shape[0] \
+            and anchors.shape[0] > 1:
+        # per-image anchor sets (proposals): vmap over anchors too
+        loc_t, loc_m, cls_t = jax.vmap(one)(anchors, labels, cls_preds)
+    else:
+        anc0 = anchors.reshape(-1, 4)
+        loc_t, loc_m, cls_t = jax.vmap(
+            lambda lb, cp: one(anc0, lb, cp))(labels, cls_preds)
     return loc_t, loc_m, cls_t
 
 

@@ -52,13 +52,48 @@ def test_train_nmt_token_accuracy_floor():
     assert acc >= 0.6, f"NMT token accuracy {acc} below 0.6 floor"
 
 
-def test_train_ssd_smoke():
-    out = _run("train_ssd.py", "--steps", "2", "--batch-size", "2",
-               "--data-shape", "64")
-    assert "detections" in out
+def test_train_ssd_map_floor():
+    # round-4 verdict #10: every driver-config example carries a numeric
+    # gate. 60 steps on the painted-box synthetic set reach mAP 1.0
+    # (calibrated); 0.6 fails any matcher/loss/decoder regression while
+    # staying far from flakiness
+    out = _run("train_ssd.py", "--steps", "60", "--batch-size", "8",
+               "--data-shape", "64", timeout=420)
+    val = _parse_metric(out, r"mAP:\s*([0-9.]+)")
+    assert val >= 0.6, f"SSD example mAP {val} below 0.6 floor"
+    final_loss = _parse_metric(out, r"final loss=([0-9.]+)")
+    assert final_loss < 2.5, f"SSD final loss {final_loss} above 2.5"
 
 
-def test_train_faster_rcnn_smoke():
-    out = _run("train_faster_rcnn.py", "--steps", "2",
-               "--image-size", "96", timeout=280)
-    assert "done" in out
+def test_train_faster_rcnn_loss_decreases():
+    # joint RPN+RCNN loss on the painted-box synthetic batch: ~16.9 →
+    # ~6-9 in 30 steps (calibrated; proposals are nonstationary so gate
+    # on best-of-tail vs start)
+    out = _run("train_faster_rcnn.py", "--steps", "30",
+               "--image-size", "96", timeout=420)
+    losses = [float(v) for v in re.findall(r"loss\s+([0-9.]+)", out)]
+    assert len(losses) >= 3, out
+    assert min(losses[1:]) < 0.7 * losses[0], losses
+
+
+def test_pretrain_bert_mlm_loss_floor():
+    # tiny BERT memorizes the fixed synthetic batch: mlm_loss ~0.014 in
+    # 150 steps (calibrated; ln(512) ≈ 6.2 at init)
+    out = _run("pretrain_bert.py", "--vocab-size", "512",
+               "--batch-size", "16", "--seq-length", "32",
+               "--num-layers", "2", "--units", "64", "--num-heads", "4",
+               "--hidden-size", "128", "--steps", "150", "--lr", "3e-3",
+               "--no-bf16", timeout=280)
+    final = _parse_metric(out, r"final mlm_loss=([0-9.]+)")
+    assert final < 0.5, f"BERT example mlm loss {final} above 0.5 floor"
+
+
+def test_train_imagenet_memorizes():
+    # resnet18 on one fixed synthetic batch: loss → ~0 in 60 steps
+    # (calibrated) — gates the ShardedTrainer + vision-zoo + SGD path
+    out = _run("train_imagenet.py", "--network", "resnet18_v1",
+               "--batch-size", "16", "--num-classes", "10",
+               "--image-shape", "3,32,32", "--steps-per-epoch", "60",
+               "--epochs", "1", "--lr", "0.05", "--no-bf16", timeout=420)
+    final = _parse_metric(out, r"final loss=([0-9.]+)")
+    assert final < 0.5, f"imagenet example loss {final} above 0.5 floor"
