@@ -18,9 +18,6 @@ step) + the top 15 individual HLO fusions by bytes.
 from __future__ import annotations
 
 import argparse
-import csv
-import glob
-import io
 import os
 import sys
 import tempfile
@@ -103,25 +100,12 @@ def main():
     with jax.profiler.trace(tracedir):
         np.asarray(trainer.run_steps(*batch, num_steps=k).asnumpy())
 
-    xplanes = glob.glob(os.path.join(
-        tracedir, "**", "*.xplane.pb"), recursive=True)
-    if not xplanes:
-        print("no xplane captured", file=sys.stderr)
-        sys.exit(1)
-
-    import json
-
-    from xprof.convert import raw_to_tool_data
-
-    data, _ = raw_to_tool_data.xspace_to_tool_data(
-        xplanes, "hlo_stats", {})
-    j = json.loads(data if isinstance(data, str) else data.decode())
-    cols = [c["label"] for c in j["cols"]]
-    idx = {label: i for i, label in enumerate(cols)}
+    # same xprof hlo_stats pipeline mx.profiler.device_stats uses
+    from mxnet_tpu.profiler import _parse_hlo_stats
+    rows = _parse_hlo_stats(tracedir)
 
     def field(row, label, default=0.0):
-        cell = row["c"][idx[label]]
-        v = cell.get("v") if cell else None
+        v = row.get(label)
         if v in (None, ""):
             return default
         try:
@@ -132,7 +116,7 @@ def main():
     total_time = 0.0
     cats = {}
     tops = []
-    for r in j["rows"]:
+    for r in rows:
         name = field(r, "HLO op name", "")
         cat = field(r, "HLO op category", "") or "uncategorized"
         t = field(r, "Total self time (us)")

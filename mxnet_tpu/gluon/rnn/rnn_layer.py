@@ -81,12 +81,14 @@ class _RNNLayer(HybridBlock):
             skip_states = False
         if not isinstance(states, (list, tuple)):
             states = [states]
-        out = super().__call__(inputs, list(states))
+        # states unpack to separate positional args: the cached-op jit
+        # boundary handles NDArray args, not python lists of them
+        out = super().__call__(inputs, *states)
         if skip_states:
             return out[0]
         return out
 
-    def hybrid_forward(self, F, inputs, states, **params):
+    def hybrid_forward(self, F, inputs, *states, **params):
         if self._layout == "NTC":
             inputs = F.swapaxes(inputs, 0, 1)
         # pack cuDNN-layout flat vector: weights (layer-major, dir

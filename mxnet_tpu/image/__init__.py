@@ -9,9 +9,14 @@ from .. import ndarray as nd
 from ..base import MXNetError
 
 __all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
-           "random_crop", "center_crop", "color_normalize", "HorizontalFlipAug",
+           "random_crop", "center_crop", "random_size_crop", "scale_down",
+           "color_normalize", "HorizontalFlipAug",
            "CastAug", "ColorNormalizeAug", "ResizeAug", "ForceResizeAug",
-           "RandomCropAug", "CenterCropAug", "CreateAugmenter", "Augmenter"]
+           "RandomCropAug", "CenterCropAug", "RandomSizedCropAug",
+           "SequentialAug", "RandomOrderAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "HueJitterAug",
+           "ColorJitterAug", "LightingAug", "RandomGrayAug",
+           "CreateAugmenter", "Augmenter", "ImageIter"]
 
 
 def _cv2():
@@ -94,6 +99,40 @@ def center_crop(src, size, interp=1):
     return out, (x0, y0, new_w, new_h)
 
 
+def scale_down(src_size, size):
+    """Scale the crop size down to fit in src (ref: image.py
+    scale_down — keeps aspect)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def random_size_crop(src, size, area, ratio, interp=1):
+    """Random crop with area ∈ area·src_area and aspect ∈ ratio, resized
+    to ``size`` (ref: image.py random_size_crop — the inception-style
+    training crop)."""
+    h, w = src.shape[:2]
+    src_area = h * w
+    if not isinstance(area, (list, tuple)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = np.random.uniform(*area) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(np.random.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = np.random.randint(0, w - new_w + 1)
+            y0 = np.random.randint(0, h - new_h + 1)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)   # fallback (reference behavior)
+
+
 def color_normalize(src, mean, std=None):
     src = src.astype("float32") if isinstance(src, nd.NDArray) else \
         nd.array(src, dtype="float32")
@@ -173,6 +212,160 @@ class CastAug(Augmenter):
         return src.astype(self.typ)
 
 
+class RandomSizedCropAug(Augmenter):
+    """ref: image.py RandomSizedCropAug (inception-style area+ratio
+    jittered crop)."""
+
+    def __init__(self, size, area, ratio, interp=1):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class SequentialAug(Augmenter):
+    """ref: image.py SequentialAug — apply a list in order."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """ref: image.py RandomOrderAug — apply a list in random order."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for i in np.random.permutation(len(self.ts)):
+            src = self.ts[i](src)
+        return src
+
+
+def _as_f32(src):
+    return src.astype("float32") if src.dtype != np.float32 else src
+
+
+class BrightnessJitterAug(Augmenter):
+    """ref: image.py BrightnessJitterAug — scale by U(1−b, 1+b)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.brightness, self.brightness)
+        return _as_f32(src) * alpha
+
+
+_GRAY = np.array([0.299, 0.587, 0.114], np.float32)   # ITU-R BT.601
+
+
+class ContrastJitterAug(Augmenter):
+    """ref: image.py ContrastJitterAug — blend with the mean gray."""
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.contrast, self.contrast)
+        x = _as_f32(src).asnumpy()
+        gray = (x * _GRAY).sum(axis=2).mean()
+        return nd.array(x * alpha + gray * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    """ref: image.py SaturationJitterAug — blend with per-pixel gray."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.saturation, self.saturation)
+        x = _as_f32(src).asnumpy()
+        gray = (x * _GRAY).sum(axis=2, keepdims=True)
+        return nd.array(x * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """ref: image.py HueJitterAug — rotate hue in YIQ space."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self._tyiq = np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], np.float32)
+        self._ityiq = np.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      np.float32)
+        t = self._ityiq @ bt @ self._tyiq
+        return nd.array(_as_f32(src).asnumpy() @ t.T)
+
+
+class ColorJitterAug(RandomOrderAug):
+    """ref: image.py ColorJitterAug — brightness/contrast/saturation in
+    random order."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """ref: image.py LightingAug — AlexNet-style PCA lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return _as_f32(src) + nd.array(rgb.astype(np.float32))
+
+
+class RandomGrayAug(Augmenter):
+    """ref: image.py RandomGrayAug — grayscale with probability p."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            x = _as_f32(src).asnumpy()
+            gray = (x * _GRAY).sum(axis=2, keepdims=True)
+            return nd.array(np.broadcast_to(gray, x.shape).copy())
+        return src
+
+
 class ColorNormalizeAug(Augmenter):
     def __init__(self, mean, std):
         super().__init__(mean=list(np.ravel(mean)), std=list(np.ravel(std)))
@@ -187,18 +380,36 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
                     rand_gray=0, inter_method=2):
-    """ref: image.py CreateAugmenter — the common aug pipeline factory."""
+    """ref: image.py CreateAugmenter — the common aug pipeline factory,
+    full parameter parity (crop/resize, mirror, color jitter, PCA
+    lighting, random gray, normalize)."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:          # implies random crop (reference semantics)
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
@@ -206,3 +417,119 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if mean is not None and np.any(np.asarray(mean)):
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist
+
+
+class ImageIter:
+    """ref: image.py ImageIter — python-level batching iterator over raw
+    image files (an ``imglist`` of [label, path] rows or a ``.lst`` file
+    + ``path_root``), running the Augmenter pipeline per image and
+    yielding NCHW ``DataBatch``es. The RecordIO-backed fast path is
+    ``io.ImageRecordIter``; this is the flexible-file-layout sibling."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imglist=None, path_root="", imglist=None,
+                 shuffle=False, aug_list=None, last_batch_handle="pad",
+                 data_name="data", label_name="softmax_label", **kwargs):
+        from ..io import DataBatch, DataDesc
+        if kwargs:
+            raise MXNetError(
+                f"ImageIter: unsupported arguments {sorted(kwargs)} — "
+                "pass augmentations explicitly via aug_list="
+                "CreateAugmenter(...)")
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(
+                f"last_batch_handle must be pad/discard/roll_over, got "
+                f"{last_batch_handle!r}")
+        if len(data_shape) != 3:
+            raise MXNetError("data_shape must be (C, H, W)")
+        self._last_batch = last_batch_handle
+        self._DataBatch = DataBatch
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        entries = []
+        if imglist is not None:
+            for row in imglist:
+                label, path = row[:-1], row[-1]
+                entries.append((np.array(label, np.float32).ravel(), path))
+        elif path_imglist:
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    # .lst rows: index \t label... \t relpath
+                    label = np.array([float(v) for v in parts[1:-1]],
+                                     np.float32)
+                    import os as _os
+                    entries.append((label, _os.path.join(path_root,
+                                                         parts[-1])))
+        else:
+            raise MXNetError("ImageIter needs imglist or path_imglist")
+        if not entries:
+            raise MXNetError("ImageIter: empty image list")
+        self._entries = entries
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size, label_width)
+                                       if label_width > 1
+                                       else (batch_size,))]
+        self._leftover = []             # roll_over carry across resets
+        self.reset()
+
+    def reset(self):
+        order = np.arange(len(self._entries))
+        if self._shuffle:
+            np.random.shuffle(order)
+        # pending indices this epoch; roll_over prepends last epoch's tail
+        self._pending = self._leftover + order.tolist()
+        self._leftover = []
+
+    def __iter__(self):
+        return self
+
+    def _read_one(self, idx):
+        label, path = self._entries[idx]
+        img = imread(path)
+        for aug in self.auglist:
+            img = aug(img)
+        arr = img.asnumpy() if isinstance(img, nd.NDArray) else \
+            np.asarray(img)
+        chw = np.transpose(arr.astype(np.float32), (2, 0, 1))
+        if chw.shape != self.data_shape:
+            raise MXNetError(
+                f"augmented image shape {chw.shape} != data_shape "
+                f"{self.data_shape} for {path}")
+        return chw, label
+
+    def next(self):
+        remaining = len(self._pending)
+        if remaining == 0:
+            raise StopIteration
+        if remaining < self.batch_size:
+            if self._last_batch == "discard":
+                self._pending = []
+                raise StopIteration
+            if self._last_batch == "roll_over":
+                # keep the tail for after the next reset()
+                self._leftover, self._pending = self._pending, []
+                raise StopIteration
+        data = np.zeros((self.batch_size,) + self.data_shape, np.float32)
+        labels = np.zeros((self.batch_size, self.label_width), np.float32)
+        filled = 0
+        while filled < self.batch_size and self._pending:
+            chw, label = self._read_one(self._pending.pop(0))
+            data[filled] = chw
+            labels[filled, :len(label)] = label[:self.label_width]
+            filled += 1
+        lab = labels[:, 0] if self.label_width == 1 else labels
+        return self._DataBatch(data=[nd.array(data)],
+                               label=[nd.array(lab)],
+                               pad=self.batch_size - filled)
+
+    def __next__(self):
+        return self.next()

@@ -88,6 +88,16 @@ def test_pretrain_bert_mlm_loss_floor():
     assert final < 0.5, f"BERT example mlm loss {final} above 0.5 floor"
 
 
+def test_train_word_lm_perplexity_floor():
+    # deterministic bigram-chain grammar (vocab 50, chance ppl 50):
+    # the 2-layer LSTM reaches ppl ~1.01 in 8 epochs (calibrated) — a 5.0
+    # gate fails any RNN/embedding/BPTT regression
+    out = _run("train_word_lm.py", "--epochs", "8", "--tokens", "20000",
+               "--lr", "5e-3", timeout=280)
+    ppl = _parse_metric(out, r"final perplexity=([0-9.]+)")
+    assert ppl < 5.0, f"word-LM perplexity {ppl} above the 5.0 gate"
+
+
 def test_train_imagenet_memorizes():
     # resnet18 on one fixed synthetic batch: loss → ~0 in 60 steps
     # (calibrated) — gates the ShardedTrainer + vision-zoo + SGD path

@@ -3,6 +3,7 @@ test_recordio.py, test_gluon_data.py)."""
 import struct
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import io, recordio
@@ -308,3 +309,71 @@ def test_image_augmenter_pipeline():
         img = aug(img)
     assert img.shape == (32, 32, 3)
     assert img.dtype == np.float32
+
+
+def test_image_jitter_augmenters():
+    """Round-4: full CreateAugmenter parameter parity (ref: image.py —
+    color jitter, hue, PCA lighting, random gray, random-sized crop)."""
+    np.random.seed(0)
+    auglist = mx.image.CreateAugmenter(
+        (3, 24, 24), resize=28, rand_resize=True, rand_mirror=True,
+        brightness=0.3, contrast=0.3, saturation=0.3, hue=0.1,
+        pca_noise=0.1, rand_gray=0.5, mean=True, std=True)
+    kinds = {a.__class__.__name__ for a in auglist}
+    assert {"RandomSizedCropAug", "ColorJitterAug", "HueJitterAug",
+            "LightingAug", "RandomGrayAug"} <= kinds
+    img = mx.nd.array((np.random.rand(40, 52, 3) * 255).astype(np.uint8))
+    for aug in auglist:
+        img = aug(img)
+    assert img.shape == (24, 24, 3) and img.dtype == np.float32
+    assert np.isfinite(img.asnumpy()).all()
+    # jitters keep gray images gray and preserve value ranges loosely
+    gray_in = mx.nd.array(np.full((8, 8, 3), 128.0, np.float32))
+    hue = mx.image.HueJitterAug(0.2)(gray_in).asnumpy()
+    np.testing.assert_allclose(hue, 128.0, rtol=0.05)
+    sat = mx.image.SaturationJitterAug(0.9)(gray_in).asnumpy()
+    np.testing.assert_allclose(sat, 128.0, rtol=1e-4)
+
+
+def test_image_iter_lst_roundtrip(tmp_path):
+    """ImageIter reads a .lst + path_root layout, runs the aug pipeline,
+    yields NCHW batches with pad semantics (ref: image.py ImageIter)."""
+    import cv2
+    root = tmp_path / "imgs"
+    root.mkdir()
+    rows = []
+    for i in range(5):
+        img = np.full((40, 40, 3), i * 10, np.uint8)
+        cv2.imwrite(str(root / f"im{i}.png"), img)
+        rows.append(f"{i}\t{float(i % 3)}\tim{i}.png")
+    lst = tmp_path / "data.lst"
+    lst.write_text("\n".join(rows) + "\n")
+    it = mx.image.ImageIter(
+        batch_size=2, data_shape=(3, 32, 32),
+        path_imglist=str(lst), path_root=str(root),
+        aug_list=mx.image.CreateAugmenter((3, 32, 32)))
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 3, 32, 32)
+    assert batches[-1].pad == 1                 # 5 images, batch 2
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert labels[:5].tolist() == [0.0, 1.0, 2.0, 0.0, 1.0]
+    it.reset()
+    assert len(list(it)) == 3                   # reset() restarts cleanly
+
+    # last_batch_handle semantics (ref: image.py ImageIter)
+    def make(handle):
+        return mx.image.ImageIter(
+            batch_size=2, data_shape=(3, 32, 32),
+            path_imglist=str(lst), path_root=str(root),
+            aug_list=mx.image.CreateAugmenter((3, 32, 32)),
+            last_batch_handle=handle)
+    assert len(list(make("discard"))) == 2      # partial batch dropped
+    ro = make("roll_over")
+    assert len(list(ro)) == 2                   # tail carried, not emitted
+    ro.reset()
+    assert len(list(ro)) == 3                   # 1 carried + 5 = 3 batches
+    with pytest.raises(mx.base.MXNetError):
+        mx.image.ImageIter(batch_size=2, data_shape=(3, 32, 32),
+                           path_imglist=str(lst), path_root=str(root),
+                           rand_crop=True)      # unknown kwarg must raise

@@ -317,3 +317,62 @@ def test_op_mode_consistency(key):
     sym_out = out_sym.eval(**feeds)[0]
     np.testing.assert_allclose(_as_np(sym_out), ref, rtol=1e-5,
                                atol=1e-6, err_msg=f"{key}: sym vs eager")
+
+
+# ---------------------------------------------------------------------------
+# sweep 4: GRADIENT mode consistency — d(sum(w*op(x)))/dx under eager
+# autograd vs the hybridized jit trace must match (the reference's
+# check_consistency covers backward the same way; a vjp wired to the
+# wrong primal or a trace-time constant folding bug shows up here)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("key", sorted(BY_KEY), ids=sorted(BY_KEY))
+def test_op_grad_mode_consistency(key):
+    from mxnet_tpu import autograd
+    case = BY_KEY[key]
+    arrays = case.inputs()
+    if any(np.asarray(a).dtype.kind != "f" for a in arrays):
+        pytest.skip("non-float inputs")
+
+    weight = None
+
+    def grads(hybridize):
+        nonlocal weight
+        net = _Wrap(case.build, len(arrays))
+        if hybridize:
+            net.hybridize()
+        xs = [nd.array(a) for a in arrays]
+        for x in xs:
+            x.attach_grad()
+        with autograd.record():
+            out = net(*xs)
+            if isinstance(out, (list, tuple)):
+                out = out[0]
+            if np.asarray(out.asnumpy()).dtype.kind != "f":
+                pytest.skip("non-float output")
+            if weight is None:
+                weight = np.random.RandomState(
+                    zlib.crc32(key.encode()) % 99991).rand(
+                        *out.shape).astype(np.float32) + 0.5
+            loss = nd.sum(out * nd.array(weight))
+        try:
+            loss.backward()
+        except mx.base.MXNetError as e:
+            if "no recorded graph" in str(e):
+                # index/constant-valued outputs (argmax, topk indices,
+                # ones_like, comparisons) never join the tape
+                pytest.skip("output disconnected from inputs")
+            raise
+        return [x.grad.asnumpy() if x.grad is not None else None
+                for x in xs]
+
+    eager = grads(False)
+    jit = grads(True)
+    assert len(eager) == len(jit)
+    for i, (ge, gj) in enumerate(zip(eager, jit)):
+        if ge is None or gj is None:
+            assert ge is None and gj is None, f"{key} input {i}"
+            continue
+        np.testing.assert_allclose(
+            gj, ge, rtol=1e-5, atol=1e-6,
+            err_msg=f"{key}: jit vs eager grad of input {i}")
