@@ -3,6 +3,8 @@
 src/io/image_aug_default.cc OpenCV augmenters)."""
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from .. import ndarray as nd
@@ -462,9 +464,8 @@ class ImageIter:
                     # .lst rows: index \t label... \t relpath
                     label = np.array([float(v) for v in parts[1:-1]],
                                      np.float32)
-                    import os as _os
-                    entries.append((label, _os.path.join(path_root,
-                                                         parts[-1])))
+                    entries.append((label, os.path.join(path_root,
+                                                        parts[-1])))
         else:
             raise MXNetError("ImageIter needs imglist or path_imglist")
         if not entries:
@@ -485,8 +486,10 @@ class ImageIter:
         order = np.arange(len(self._entries))
         if self._shuffle:
             np.random.shuffle(order)
-        # pending indices this epoch; roll_over prepends last epoch's tail
+        # pending indices this epoch; roll_over prepends last epoch's
+        # tail. Consumed via a cursor (pop(0) would be O(N^2) per epoch)
         self._pending = self._leftover + order.tolist()
+        self._cursor = 0
         self._leftover = []
 
     def __iter__(self):
@@ -507,22 +510,24 @@ class ImageIter:
         return chw, label
 
     def next(self):
-        remaining = len(self._pending)
-        if remaining == 0:
+        remaining = len(self._pending) - self._cursor
+        if remaining <= 0:
             raise StopIteration
         if remaining < self.batch_size:
             if self._last_batch == "discard":
-                self._pending = []
+                self._cursor = len(self._pending)
                 raise StopIteration
             if self._last_batch == "roll_over":
                 # keep the tail for after the next reset()
-                self._leftover, self._pending = self._pending, []
+                self._leftover = self._pending[self._cursor:]
+                self._cursor = len(self._pending)
                 raise StopIteration
         data = np.zeros((self.batch_size,) + self.data_shape, np.float32)
         labels = np.zeros((self.batch_size, self.label_width), np.float32)
         filled = 0
-        while filled < self.batch_size and self._pending:
-            chw, label = self._read_one(self._pending.pop(0))
+        while filled < self.batch_size and self._cursor < len(self._pending):
+            chw, label = self._read_one(self._pending[self._cursor])
+            self._cursor += 1
             data[filled] = chw
             labels[filled, :len(label)] = label[:self.label_width]
             filled += 1

@@ -109,6 +109,7 @@ def _as_method(fn):
 
 
 _expose()
+_registry.install_binary_helpers(_this)
 
 # `_shuffle` is exposed as nd.random.shuffle in the reference
 from . import sparse                      # noqa: E402

@@ -134,7 +134,10 @@ _BINARY = {
     "broadcast_logical_or": (_cmp(jnp.logical_or), False, ["_logical_or"]),
     "broadcast_logical_xor": (_cmp(jnp.logical_xor), False, ["_logical_xor"]),
     "arctan2": (jnp.arctan2, True, ["_arctan2"]),
-    "ldexp": (jnp.ldexp, True, ["_ldexp"]),
+    # reference ldexp is lhs*2^rhs over FLOAT arrays (jnp.ldexp wants an
+    # integer exponent, so spell it out)
+    "ldexp": (lambda a, b: a * jnp.power(2.0, b).astype(
+        jnp.result_type(a, b)), True, ["_ldexp"]),
 }
 
 for _name, (_fn, _diff, _aliases) in _BINARY.items():
@@ -170,6 +173,8 @@ _SCALAR = {
     "_logical_and_scalar": (lambda x, s: jnp.logical_and(x, s).astype(x.dtype), False),
     "_logical_or_scalar": (lambda x, s: jnp.logical_or(x, s).astype(x.dtype), False),
     "_logical_xor_scalar": (lambda x, s: jnp.logical_xor(x, s).astype(x.dtype), False),
+    "_hypot_scalar": (lambda x, s: jnp.hypot(x, jnp.asarray(
+        s, dtype=x.dtype)), True),
 }
 
 for _name, (_fn, _diff) in _SCALAR.items():

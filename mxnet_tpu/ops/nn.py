@@ -9,6 +9,8 @@ reference's Resource-managed RNG states, ref: src/resource.cc).
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -279,7 +281,9 @@ def _softmin(x, axis=-1):
 def _softmax_activation(x, mode="instance"):
     if mode == "channel":
         return jax.nn.softmax(x, axis=1)
-    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+    # explicit product, not -1 (ambiguous on zero-size inputs)
+    return jax.nn.softmax(x.reshape(x.shape[0], math.prod(x.shape[1:])),
+                          axis=-1).reshape(x.shape)
 
 
 # ---------------------------------------------------------------------------

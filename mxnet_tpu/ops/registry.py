@@ -157,3 +157,86 @@ def get(name: str) -> Operator:
 def list_ops() -> List[str]:
     """ref: MXListAllOpNames — drives wrapper generation."""
     return sorted(set(_REGISTRY))
+
+
+# ---------------------------------------------------------------------------
+# Public scalar-or-array binary helpers. The reference's python layer
+# (ref: python/mxnet/ndarray/ndarray.py maximum/minimum/power/equal/...)
+# defines these ABOVE the generated op wrappers: array⊕array dispatches
+# to the broadcast op, array⊕scalar to the _*_scalar op, scalar⊕array to
+# the reflected scalar op, scalar⊕scalar to plain python. Installed into
+# both the nd and sym namespaces by their _expose() calls.
+# ---------------------------------------------------------------------------
+PUBLIC_BINARY_HELPERS = {
+    # public name: (array op, scalar op, reflected scalar op, py fallback)
+    "add": ("broadcast_add", "_plus_scalar", "_plus_scalar",
+            lambda a, b: a + b),
+    "subtract": ("broadcast_sub", "_minus_scalar", "_rminus_scalar",
+                 lambda a, b: a - b),
+    "multiply": ("broadcast_mul", "_mul_scalar", "_mul_scalar",
+                 lambda a, b: a * b),
+    "divide": ("broadcast_div", "_div_scalar", "_rdiv_scalar",
+               lambda a, b: a / b),
+    "modulo": ("broadcast_mod", "_mod_scalar", "_rmod_scalar",
+               lambda a, b: a % b),
+    "power": ("broadcast_power", "_power_scalar", "_rpower_scalar",
+              lambda a, b: a ** b),
+    "maximum": ("broadcast_maximum", "_maximum_scalar", "_maximum_scalar",
+                max),
+    "minimum": ("broadcast_minimum", "_minimum_scalar", "_minimum_scalar",
+                min),
+    "equal": ("broadcast_equal", "_equal_scalar", "_equal_scalar",
+              lambda a, b: float(a == b)),
+    "not_equal": ("broadcast_not_equal", "_not_equal_scalar",
+                  "_not_equal_scalar", lambda a, b: float(a != b)),
+    "greater": ("broadcast_greater", "_greater_scalar", "_lesser_scalar",
+                lambda a, b: float(a > b)),
+    "greater_equal": ("broadcast_greater_equal", "_greater_equal_scalar",
+                      "_lesser_equal_scalar", lambda a, b: float(a >= b)),
+    "lesser": ("broadcast_lesser", "_lesser_scalar", "_greater_scalar",
+               lambda a, b: float(a < b)),
+    "lesser_equal": ("broadcast_lesser_equal", "_lesser_equal_scalar",
+                     "_greater_equal_scalar", lambda a, b: float(a <= b)),
+    "logical_and": ("broadcast_logical_and", "_logical_and_scalar",
+                    "_logical_and_scalar",
+                    lambda a, b: float(bool(a) and bool(b))),
+    "logical_or": ("broadcast_logical_or", "_logical_or_scalar",
+                   "_logical_or_scalar",
+                   lambda a, b: float(bool(a) or bool(b))),
+    "logical_xor": ("broadcast_logical_xor", "_logical_xor_scalar",
+                    "_logical_xor_scalar",
+                    lambda a, b: float(bool(a) != bool(b))),
+    "hypot": ("broadcast_hypot", "_hypot_scalar", "_hypot_scalar",
+              lambda a, b: (a * a + b * b) ** 0.5),
+}
+
+
+def install_binary_helpers(module):
+    """Install the public scalar-or-array binary helpers onto a generated
+    namespace (nd or sym). ``module`` must already carry the broadcast
+    ops and an ``_internal`` submodule with the scalar ops."""
+    internal = module._internal
+
+    def make(pub, array_name, scalar_name, rscalar_name, py_fallback):
+        arr_fn = getattr(module, array_name)
+        sc_fn = getattr(internal, scalar_name)
+        rsc_fn = getattr(internal, rscalar_name)
+
+        def helper(lhs, rhs):
+            lhs_scalar = isinstance(lhs, (int, float, bool))
+            rhs_scalar = isinstance(rhs, (int, float, bool))
+            if not lhs_scalar and not rhs_scalar:
+                return arr_fn(lhs, rhs)
+            if not lhs_scalar:
+                return sc_fn(lhs, scalar=float(rhs))
+            if not rhs_scalar:
+                return rsc_fn(rhs, scalar=float(lhs))
+            return py_fallback(lhs, rhs)
+        helper.__name__ = pub
+        helper.__doc__ = (f"Scalar-or-array {pub} (ref: python/mxnet/"
+                          f"ndarray/ndarray.py {pub})")
+        return helper
+
+    for pub, (a, s, r, py) in PUBLIC_BINARY_HELPERS.items():
+        if not hasattr(module, pub):
+            setattr(module, pub, make(pub, a, s, r, py))

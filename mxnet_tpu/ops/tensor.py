@@ -6,6 +6,8 @@ shape-static so XLA can tile onto the MXU/VPU.
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -158,7 +160,9 @@ def _squeeze(x, axis=None):
 @register("Flatten", aliases=["flatten"],
           doc="Collapse all but first axis (ref: matrix_op.cc Flatten)")
 def _flatten(x):
-    return jnp.reshape(x, (x.shape[0], -1))
+    # explicit product, not -1: a zero-size leading axis makes -1
+    # ambiguous (jnp raises ZeroDivisionError)
+    return jnp.reshape(x, (x.shape[0], math.prod(x.shape[1:])))
 
 
 @register("reverse", aliases=["flip"], params=[OpParam("axis", tuple, None, required=True)])

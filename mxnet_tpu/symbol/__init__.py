@@ -91,6 +91,7 @@ def _expose():
 
 
 _expose()
+_registry.install_binary_helpers(_this)
 
 # control-flow ops take Python callables — they bypass the registry
 # (ref: python/mxnet/symbol/contrib.py foreach/while_loop/cond)

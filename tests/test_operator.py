@@ -400,3 +400,35 @@ def test_batch_norm_large_mean_cold_start():
     np.testing.assert_allclose(bmean.asnumpy(),
                                x.mean(axis=(0, 2, 3)), rtol=1e-5)
     assert np.isfinite(bvar.asnumpy()).all()
+
+
+def test_public_binary_helpers_dispatch():
+    """Round-4: the python-layer scalar-or-array binary helpers (ref:
+    python/mxnet/ndarray/ndarray.py maximum/minimum/power/equal/...) —
+    array⊕array → broadcast op, array⊕scalar → _*_scalar, scalar⊕array →
+    reflected scalar op, scalar⊕scalar → plain python."""
+    import mxnet_tpu.symbol as sym
+    a = mx.nd.array(np.array([[0.2, 0.8], [1.5, -0.3]], np.float32))
+    b = mx.nd.array(np.array([[1.0, 0.5], [0.5, 0.5]], np.float32))
+    np.testing.assert_allclose(mx.nd.maximum(a, b).asnumpy(),
+                               np.maximum(a.asnumpy(), b.asnumpy()))
+    np.testing.assert_allclose(mx.nd.maximum(a, 0.5).asnumpy(),
+                               np.maximum(a.asnumpy(), 0.5))
+    np.testing.assert_allclose(mx.nd.minimum(0.5, a).asnumpy(),
+                               np.minimum(0.5, a.asnumpy()))
+    assert mx.nd.maximum(2, 3) == 3
+    # non-commutative reflected forms
+    np.testing.assert_allclose(mx.nd.power(2.0, a).asnumpy(),
+                               2.0 ** a.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(mx.nd.greater(1.0, a).asnumpy(),
+                               (1.0 > a.asnumpy()).astype(np.float32))
+    np.testing.assert_allclose(mx.nd.modulo(0.7, b).asnumpy(),
+                               np.mod(0.7, b.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.hypot(a, 0.5).asnumpy(),
+        np.hypot(a.asnumpy(), 0.5), rtol=1e-6)
+    # the same helpers exist on the symbol namespace and trace
+    x = sym.var("x")
+    out = sym.maximum(x, 0.25)
+    got = out.eval(x=a)[0].asnumpy()
+    np.testing.assert_allclose(got, np.maximum(a.asnumpy(), 0.25))

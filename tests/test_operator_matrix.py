@@ -177,6 +177,135 @@ CASES = [c for c in [
     Case("linalg_gemm2",
          lambda F, a, b: F.linalg_gemm2(a, b, transpose_a=True),
          [(4, 3), (4, 2)], edge_shapes=False),
+    # ---- round-4 widening: the mechanical registry tail ------------------
+    # unary transcendental / rounding
+    Case("arccosh", lambda F, x: F.arccosh(x + 1.5), [(3, 4)],
+         positive=True, int_ok=False),
+    _u("arcsinh"), _u("degrees"), _u("radians"),
+    _u("rcbrt", positive=True, int_ok=False),
+    _u("rint"), _u("fix"),
+    Case("erfinv", lambda F, x: F.erfinv(x * 0.9), [(3, 4)], unit=True,
+         int_ok=False),
+    _u("isfinite"), _u("isnan"), _u("isinf"), _u("logical_not"),
+    _u("identity"), _u("stop_gradient"), _u("softmin"),
+    Case("copy", lambda F, x: F._internal._copy(x), [(3, 4)]),
+    Case("SoftmaxActivation", lambda F, x: F.SoftmaxActivation(x),
+         [(3, 4)], int_ok=False),
+    # shape / layout
+    _u("flatten"),
+    Case("squeeze", lambda F, x: F.squeeze(x, axis=1), [(3, 1, 4)],
+         edge_shapes=False),
+    Case("swapaxes", lambda F, x: F.swapaxes(x, 1, 2), [(2, 3, 4)]),
+    Case("moveaxis", lambda F, x: F.moveaxis(x, 0, 2), [(2, 3, 4)]),
+    Case("reverse", lambda F, x: F.reverse(x, axis=1), [(3, 4)]),
+    Case("diag", lambda F, x: F.diag(x), [(4, 4)]),
+    Case("depth_to_space", lambda F, x: F.depth_to_space(x, block_size=2),
+         [(1, 8, 3, 3)], edge_shapes=False),
+    Case("space_to_depth", lambda F, x: F.space_to_depth(x, block_size=2),
+         [(1, 2, 4, 4)], edge_shapes=False),
+    Case("broadcast_to", lambda F, x: F.broadcast_to(x, shape=(3, 4)),
+         [(1, 4)], edge_shapes=False),
+    Case("broadcast_axis",
+         lambda F, x: F.broadcast_axis(x, axis=1, size=3), [(2, 1, 4)],
+         edge_shapes=False),
+    Case("Pad",
+         lambda F, x: F.Pad(x, mode="constant",
+                            pad_width=(0, 0, 0, 0, 1, 1, 2, 2)),
+         [(1, 2, 3, 3)], edge_shapes=False),
+    Case("shape_array", lambda F, x: F.shape_array(x), [(3, 4)],
+         dtypes=("float32",), edge_shapes=False),
+    Case("size_array", lambda F, x: F.size_array(x), [(3, 4)],
+         dtypes=("float32",), edge_shapes=False),
+    Case("reshape_like", lambda F, a, b: F.reshape_like(a, b),
+         [(2, 6), (3, 4)], edge_shapes=False),
+    Case("slice_like", lambda F, a, b: F.slice_like(a, b),
+         [(4, 5), (2, 3)], edge_shapes=False),
+    Case("broadcast_like", lambda F, a, b: F.broadcast_like(a, b),
+         [(1, 4), (3, 4)], edge_shapes=False),
+    Case("arange_like", lambda F, x: F.arange_like(x, axis=0), [(5, 2)]),
+    Case("SliceChannel",
+         lambda F, x: F.SliceChannel(x, num_outputs=2, axis=1)[1],
+         [(2, 4, 3)], edge_shapes=False),
+    Case("UpSampling",
+         lambda F, x: F.UpSampling(x, scale=2, sample_type="nearest"),
+         [(1, 2, 3, 3)], edge_shapes=False),
+    # sequence family (T, N, ...)
+    Case("SequenceReverse", lambda F, x: F.SequenceReverse(x),
+         [(3, 2, 4)], edge_shapes=False),
+    Case("SequenceLast", lambda F, x: F.SequenceLast(x), [(3, 2, 4)],
+         edge_shapes=False),
+    # binary elemwise + comparisons + logicals
+    _b("broadcast_mod", positive=True),
+    _b("broadcast_greater_equal"), _b("broadcast_lesser_equal"),
+    _b("broadcast_logical_and"), _b("broadcast_logical_or"),
+    _b("broadcast_logical_xor"),
+    Case("arctan2", lambda F, a, b: F.arctan2(a, b),
+         [(3, 4), (3, 4)], int_ok=False),
+    Case("hypot", lambda F, a, b: F.hypot(a, b), [(3, 4), (3, 4)],
+         int_ok=False),
+    Case("ldexp", lambda F, a, b: F.ldexp(a, b), [(3, 4), (3, 4)],
+         int_ok=False),
+    Case("maximum", lambda F, a, b: F.maximum(a, b), [(3, 4), (3, 4)]),
+    Case("minimum", lambda F, a, b: F.minimum(a, b), [(3, 4), (3, 4)]),
+    Case("modulo", lambda F, a, b: F.modulo(a, b), [(3, 4), (3, 4)],
+         positive=True),
+    Case("power", lambda F, a, b: F.power(a, b), [(3, 4), (3, 4)],
+         positive=True),
+    Case("elemwise_add", lambda F, a, b: F.elemwise_add(a, b),
+         [(3, 4), (3, 4)]),
+    Case("elemwise_sub", lambda F, a, b: F.elemwise_sub(a, b),
+         [(3, 4), (3, 4)]),
+    Case("elemwise_mul", lambda F, a, b: F.elemwise_mul(a, b),
+         [(3, 4), (3, 4)]),
+    Case("elemwise_div", lambda F, a, b: F.elemwise_div(a, b),
+         [(3, 4), (3, 4)], positive=True),
+    Case("logical_and", lambda F, a, b: F.logical_and(a, b),
+         [(3, 4), (3, 4)]),
+    Case("logical_or", lambda F, a, b: F.logical_or(a, b),
+         [(3, 4), (3, 4)]),
+    Case("logical_xor", lambda F, a, b: F.logical_xor(a, b),
+         [(3, 4), (3, 4)]),
+    Case("equal", lambda F, a, b: F.equal(a, b), [(3, 4), (3, 4)]),
+    Case("not_equal", lambda F, a, b: F.not_equal(a, b),
+         [(3, 4), (3, 4)]),
+    Case("greater", lambda F, a, b: F.greater(a, b), [(3, 4), (3, 4)]),
+    Case("lesser", lambda F, a, b: F.lesser(a, b), [(3, 4), (3, 4)]),
+    Case("add_n", lambda F, a, b, c: F.add_n(a, b, c),
+         [(3, 4), (3, 4), (3, 4)]),
+    # scalar variants (the generated _scalar registry surface)
+    Case("plus_scalar", lambda F, x: F._internal._plus_scalar(x, scalar=1.5),
+         [(3, 4)]),
+    Case("rminus_scalar",
+         lambda F, x: F._internal._rminus_scalar(x, scalar=1.5),
+         [(3, 4)]),
+    Case("rdiv_scalar",
+         lambda F, x: F._internal._rdiv_scalar(x, scalar=2.0), [(3, 4)],
+         positive=True),
+    Case("rpower_scalar",
+         lambda F, x: F._internal._rpower_scalar(x, scalar=2.0), [(3, 4)],
+         int_ok=False),
+    Case("maximum_scalar",
+         lambda F, x: F.maximum(x, 0.25), [(3, 4)]),
+    Case("mod_scalar", lambda F, x: F._internal._mod_scalar(x, scalar=0.7),
+         [(3, 4)], positive=True),
+    Case("greater_scalar", lambda F, x: F.greater(x, 0.5),
+         [(3, 4)]),
+    # nan-aware reductions
+    _r("nansum"), _r("nanprod"),
+    # misc
+    Case("box_iou", lambda F, a, b: F.contrib.box_iou(a, b, format="corner"),
+         [(3, 4), (2, 4)], unit=True, edge_shapes=False),
+    Case("khatri_rao", lambda F, a, b: F.khatri_rao(a, b),
+         [(3, 2), (3, 4)], edge_shapes=False),
+    Case("scatter_nd",
+         lambda F, x: F.scatter_nd(x, _const(F, [[0, 2], [1, 0]]),
+                                   shape=(3, 4)),
+         [(2,)], edge_shapes=False),
+    Case("diag_offset", lambda F, x: F.diag(x, k=1), [(4, 4)]),
+    Case("RMSNorm", lambda F, x, g: F.RMSNorm(x, g, axis=-1),
+         [(3, 6), (6,)], edge_shapes=False, int_ok=False),
+    Case("div_sqrt_dim", lambda F, x: F.div_sqrt_dim(x), [(3, 4)],
+         int_ok=False),
 ] if c is not None]
 
 BY_KEY = {c.key: c for c in CASES}
@@ -190,7 +319,8 @@ def _const(F, values):
     return nd.array(np.asarray(values, dtype=np.float32))
 
 
-_SYM_SKIP = {"take", "one_hot", "gather_nd", "pick", "SequenceMask"}
+_SYM_SKIP = {"take", "one_hot", "gather_nd", "pick", "SequenceMask",
+             "scatter_nd", "box_iou"}
 
 
 def _run_eager(case, arrays):
