@@ -173,8 +173,7 @@ _SCALAR = {
     "_logical_and_scalar": (lambda x, s: jnp.logical_and(x, s).astype(x.dtype), False),
     "_logical_or_scalar": (lambda x, s: jnp.logical_or(x, s).astype(x.dtype), False),
     "_logical_xor_scalar": (lambda x, s: jnp.logical_xor(x, s).astype(x.dtype), False),
-    "_hypot_scalar": (lambda x, s: jnp.hypot(x, jnp.asarray(
-        s, dtype=x.dtype)), True),
+    "_hypot_scalar": (lambda x, s: jnp.hypot(x, s), True),
 }
 
 for _name, (_fn, _diff) in _SCALAR.items():

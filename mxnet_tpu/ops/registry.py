@@ -18,6 +18,8 @@ import ast
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+import numpy as _np
+
 from ..base import MXNetError
 
 __all__ = ["OpParam", "Operator", "register", "alias", "get", "list_ops"]
@@ -223,8 +225,11 @@ def install_binary_helpers(module):
         rsc_fn = getattr(internal, rscalar_name)
 
         def helper(lhs, rhs):
-            lhs_scalar = isinstance(lhs, (int, float, bool))
-            rhs_scalar = isinstance(rhs, (int, float, bool))
+            # numeric_types parity: numpy scalars (arr.max(), np.float32)
+            # count as scalars, like the reference's numeric_types
+            scalar_types = (int, float, bool, _np.generic)
+            lhs_scalar = isinstance(lhs, scalar_types)
+            rhs_scalar = isinstance(rhs, scalar_types)
             if not lhs_scalar and not rhs_scalar:
                 return arr_fn(lhs, rhs)
             if not lhs_scalar:
