@@ -31,11 +31,8 @@ def _measure(trainer, batch, per_step, unit, name, k, dispatches=4,
     # stage the batch on device once (bench.py's staged-batch protocol —
     # steady-state steps must not pay the tunnel's ~6 MB/s host->device
     # link; a production input pipeline double-buffers these transfers)
-    args = batch[:-1]
-    trainer._prepare(args)
-    batch = tuple(
-        trainer._shard(b, trainer._batch_spec(np.asarray(b).ndim))
-        for b in batch)
+    trainer._prepare(batch[:-1])
+    batch = tuple(trainer._shard_batch_arg(b) for b in batch)
     np.asarray(trainer.run_steps(*batch, num_steps=k).asnumpy())
     best = None
     for _ in range(windows):
@@ -45,7 +42,8 @@ def _measure(trainer, batch, per_step, unit, name, k, dispatches=4,
         np.asarray(loss.asnumpy())
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
-    rate = per_step * dispatches * k / best
+    import jax
+    rate = per_step * dispatches * k / best / len(jax.devices())
     print(json.dumps({"metric": name, "value": round(rate, 1),
                       "unit": unit,
                       "ms_per_step": round(best / dispatches / k * 1e3,
@@ -76,20 +74,11 @@ def bench_nmt(on_tpu):
         def hybrid_forward(self, F, src, tgt):
             return self.inner(src, tgt)       # (B, T, V) logits
 
-    class ShiftedCE(gluon.loss.Loss):
-        amp_safe = property(lambda self: self._ce.amp_safe)
-
-        def __init__(self):
-            super().__init__(None, 0)
-            self._ce = gluon.loss.SoftmaxCrossEntropyLoss(
-                label_smoothing=0.1)
-
-        def hybrid_forward(self, F, pred, label):
-            return self._ce(pred, label)
-
     mesh = parallel.make_mesh({"data": len(jax.devices())})
     trainer = parallel.ShardedTrainer(
-        Seq2SeqWrapper(net), ShiftedCE(), "adam", {"learning_rate": 1e-4},
+        Seq2SeqWrapper(net),
+        gluon.loss.SoftmaxCrossEntropyLoss(label_smoothing=0.1),
+        "adam", {"learning_rate": 1e-4},
         mesh=mesh, compute_dtype="bfloat16" if on_tpu else None,
         master_dtype="bfloat16" if on_tpu else None)
     rng = np.random.RandomState(0)
@@ -109,7 +98,9 @@ def bench_ssd(on_tpu):
     batch = 32 if on_tpu else 2
     shape = 512 if on_tpu else 64
     classes = 20
-    net = ssd_zoo.get_ssd("resnet18_v1", classes=classes, num_scales=3,
+    # the NAMED zoo config: ssd_512_resnet18_v1 is 5-scale
+    net = ssd_zoo.get_ssd("resnet18_v1", classes=classes,
+                          num_scales=5 if on_tpu else 3,
                           thumbnail=not on_tpu)
     net.initialize(mx.init.Xavier())
     loss_fn = ssd_zoo.SSDMultiBoxLoss()

@@ -1,0 +1,154 @@
+// C++ inference API over the C predict ABI — the cpp-package analog
+// (ref: cpp-package/include/mxnet-cpp + the reference's predict-cpp
+// example): RAII Predictor with exceptions, std::vector I/O, move
+// semantics. Header-only; link against libmxtpu.so.
+//
+//   mxnet_tpu::Predictor p("model-symbol.json", "model-0000.params",
+//                          {{"data", {8, 784}}});
+//   p.set_input("data", batch);         // std::vector<float>
+//   p.forward();
+//   std::vector<float> out = p.get_output(0);
+#pragma once
+
+#include <cstdint>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 unsigned num_input_nodes, const char** input_keys,
+                 const unsigned* input_shape_indptr,
+                 const unsigned* input_shape_data, void** out);
+int MXPredSetInput(void* handle, const char* key, const float* data,
+                   unsigned size);
+int MXPredForward(void* handle);
+int MXPredGetOutputShape(void* handle, unsigned index, long* shape,
+                         unsigned* ndim);
+int MXPredGetOutput(void* handle, unsigned index, float* data,
+                    unsigned size);
+int MXPredFree(void* handle);
+const char* MXPredGetLastError(void);
+}
+
+namespace mxnet_tpu {
+
+class Error : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+namespace detail {
+inline void check(int rc, const char* what) {
+  if (rc != 0) {
+    const char* msg = MXPredGetLastError();
+    throw Error(std::string(what) + ": " +
+                (msg && msg[0] ? msg : "unknown error"));
+  }
+}
+
+inline std::string read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw Error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+}  // namespace detail
+
+class Predictor {
+ public:
+  using Shape = std::vector<unsigned>;
+
+  // Load from exported files (net.export(prefix) writes
+  // prefix-symbol.json + prefix-0000.params).
+  Predictor(const std::string& symbol_path, const std::string& param_path,
+            const std::vector<std::pair<std::string, Shape>>& inputs)
+      : Predictor(detail::read_file(symbol_path),
+                  detail::read_file(param_path), inputs, true) {}
+
+  // Load from in-memory buffers.
+  Predictor(const std::string& symbol_json, const std::string& params,
+            const std::vector<std::pair<std::string, Shape>>& inputs,
+            bool /*from_memory*/)
+  {
+    std::vector<const char*> keys;
+    std::vector<unsigned> indptr{0};
+    std::vector<unsigned> dims;
+    for (const auto& kv : inputs) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<unsigned>(dims.size()));
+    }
+    detail::check(
+        MXPredCreate(symbol_json.c_str(), params.data(),
+                     static_cast<int>(params.size()), /*dev_type=*/1,
+                     /*dev_id=*/0,
+                     static_cast<unsigned>(inputs.size()),
+                     keys.empty() ? nullptr : keys.data(),
+                     indptr.data(), dims.empty() ? nullptr : dims.data(),
+                     &handle_),
+        "MXPredCreate");
+  }
+
+  Predictor(Predictor&& other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  Predictor& operator=(Predictor&& other) noexcept {
+    if (this != &other) {
+      reset();
+      handle_ = other.handle_;
+      other.handle_ = nullptr;
+    }
+    return *this;
+  }
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  ~Predictor() { reset(); }
+
+  void set_input(const std::string& key, const std::vector<float>& data) {
+    detail::check(MXPredSetInput(handle_, key.c_str(), data.data(),
+                                 static_cast<unsigned>(data.size())),
+                  "MXPredSetInput");
+  }
+
+  void forward() { detail::check(MXPredForward(handle_), "MXPredForward"); }
+
+  std::vector<long> output_shape(unsigned index = 0) const {
+    unsigned ndim = 0;   // query ndim first (the ABI allows nullptr)
+    detail::check(MXPredGetOutputShape(handle_, index, nullptr, &ndim),
+                  "MXPredGetOutputShape");
+    std::vector<long> shape(ndim);
+    if (ndim)
+      detail::check(MXPredGetOutputShape(handle_, index, shape.data(),
+                                         &ndim),
+                    "MXPredGetOutputShape");
+    return shape;
+  }
+
+  std::vector<float> get_output(unsigned index = 0) const {
+    auto shape = output_shape(index);
+    std::size_t n = 1;
+    for (long d : shape) n *= static_cast<std::size_t>(d);
+    std::vector<float> out(n);
+    detail::check(MXPredGetOutput(handle_, index, out.data(),
+                                  static_cast<unsigned>(n)),
+                  "MXPredGetOutput");
+    return out;
+  }
+
+ private:
+  void reset() {
+    if (handle_) {
+      MXPredFree(handle_);
+      handle_ = nullptr;
+    }
+  }
+  void* handle_ = nullptr;
+};
+
+}  // namespace mxnet_tpu

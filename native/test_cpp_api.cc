@@ -1,0 +1,59 @@
+// C++ client of the RAII inference API (native/mxnet_tpu.hpp — the
+// cpp-package analog). Loads an exported model, classifies a raw float
+// batch, prints argmax per row; also exercises move semantics and the
+// exception error path. Built and run by tests/test_predict_api.py.
+#include <cstdio>
+#include <cstdlib>
+#include <utility>
+
+#include "mxnet_tpu.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 6) {
+    std::fprintf(stderr,
+                 "usage: %s sym.json model.params input.f32 batch dim\n",
+                 argv[0]);
+    return 2;
+  }
+  const unsigned batch = static_cast<unsigned>(std::atoi(argv[4]));
+  const unsigned dim = static_cast<unsigned>(std::atoi(argv[5]));
+
+  // exception path: malformed model must throw, not crash
+  try {
+    mxnet_tpu::Predictor bad("{not json", "junk",
+                             {{"data", {1u, dim}}}, true);
+    std::fprintf(stderr, "malformed model did not throw\n");
+    return 1;
+  } catch (const mxnet_tpu::Error&) {
+  }
+
+  mxnet_tpu::Predictor built(argv[1], argv[2], {{"data", {batch, dim}}});
+  mxnet_tpu::Predictor p(std::move(built));   // move ctor keeps handle
+
+  std::vector<float> input(static_cast<std::size_t>(batch) * dim);
+  {
+    std::FILE* f = std::fopen(argv[3], "rb");
+    if (!f || std::fread(input.data(), sizeof(float), input.size(), f)
+                  != input.size()) {
+      std::fprintf(stderr, "cannot read %s\n", argv[3]);
+      return 1;
+    }
+    std::fclose(f);
+  }
+  p.set_input("data", input);
+  p.forward();
+  const auto shape = p.output_shape(0);
+  if (shape.size() != 2 || shape[0] != static_cast<long>(batch)) {
+    std::fprintf(stderr, "unexpected output shape\n");
+    return 1;
+  }
+  const auto out = p.get_output(0);
+  const long classes = shape[1];
+  for (unsigned b = 0; b < batch; ++b) {
+    long best = 0;
+    for (long c = 1; c < classes; ++c)
+      if (out[b * classes + c] > out[b * classes + best]) best = c;
+    std::printf("%ld\n", best);
+  }
+  return 0;
+}

@@ -208,3 +208,36 @@ def test_c_client_end_to_end(native_lib, tmp_path):
         check=True, capture_output=True, text=True)
     got = np.array([int(v) for v in out.stdout.split()])
     np.testing.assert_array_equal(got, want)
+
+
+def test_cpp_client_end_to_end(native_lib, tmp_path):
+    """The C++ RAII API (native/mxnet_tpu.hpp, the cpp-package analog)
+    serves an exported model bit-identically to Python: build the C++
+    client, run it, compare argmax rows; the client also asserts the
+    exception error path and move semantics internally."""
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = np.random.rand(8, 784).astype(np.float32)
+    want = net(nd.array(x)).asnumpy().argmax(1)
+    prefix = str(tmp_path / "mlp")
+    net.export(prefix)
+    x.tofile(str(tmp_path / "in.f32"))
+    exe = str(tmp_path / "client_cpp")
+    native_dir = os.path.join(REPO, "native")
+    subprocess.run(
+        [cxx, "-std=c++17", "-o", exe,
+         os.path.join(native_dir, "test_cpp_api.cc"),
+         f"-I{native_dir}", f"-L{native_dir}", "-lmxtpu",
+         f"-Wl,-rpath,{native_dir}"],
+        check=True, capture_output=True)
+    out = subprocess.run(
+        [exe, f"{prefix}-symbol.json", f"{prefix}-0000.params",
+         str(tmp_path / "in.f32"), "8", "784"],
+        check=True, capture_output=True, text=True)
+    got = np.array([int(v) for v in out.stdout.split()])
+    np.testing.assert_array_equal(got, want)
