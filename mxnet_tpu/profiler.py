@@ -71,9 +71,26 @@ def resume(profile_process="worker"):
 
 
 def dump(finished=True, profile_process="worker"):
-    """Finish the trace (the xplane files under <filename>_trace are the
-    chrome-trace analog; open with TensorBoard's profile plugin)."""
+    """Finish the trace and write the configured ``filename`` as
+    chrome://tracing JSON (ref: profiler.cc DumpProfile — the reference
+    writes profile.json in the same format; here it is converted from
+    the captured xplane with xprof's trace_viewer tool). The raw xplane
+    stays under <filename>_trace for TensorBoard."""
     set_state("stop")
+    if not _trace_dir:
+        return
+    import json as _json
+    try:
+        from xprof.convert import raw_to_tool_data
+        data, _ = raw_to_tool_data.xspace_to_tool_data(
+            [_latest_xplane(_trace_dir)], "trace_viewer", {})
+        if isinstance(data, bytes):
+            data = data.decode()
+        _json.loads(data)       # must be valid chrome-trace JSON
+    except Exception:           # conversion unavailable: keep raw xplane
+        return
+    with open(_config.get("filename", "profile.json"), "w") as f:
+        f.write(data)
 
 
 def dumps(reset=False, format="table"):
@@ -88,12 +105,9 @@ def dumps(reset=False, format="table"):
     return "\n".join(lines)
 
 
-def _parse_tool_stats(trace_dir, tool="hlo_stats"):
-    """Parse the newest xplane capture under ``trace_dir`` with one of
-    xprof's converters (the exact pipeline the TensorBoard profile
-    plugin runs). Returns a list of per-op dicts."""
+def _latest_xplane(trace_dir):
+    """Newest xplane capture under ``trace_dir``."""
     import glob
-    import json
 
     xplanes = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                         recursive=True)
@@ -101,14 +115,22 @@ def _parse_tool_stats(trace_dir, tool="hlo_stats"):
         raise MXNetError(f"no xplane capture under {trace_dir!r}; run "
                          "set_state('run') … set_state('stop') around "
                          "device work first")
-    xplanes.sort(key=os.path.getmtime)
+    return max(xplanes, key=os.path.getmtime)
+
+
+def _parse_tool_stats(trace_dir, tool="hlo_stats"):
+    """Parse the newest xplane capture under ``trace_dir`` with one of
+    xprof's converters (the exact pipeline the TensorBoard profile
+    plugin runs). Returns a list of per-op dicts."""
+    import json
+
+    xplane = _latest_xplane(trace_dir)
     try:
         from xprof.convert import raw_to_tool_data
     except ImportError as e:                          # pragma: no cover
         raise MXNetError("device_stats needs the xprof package "
                          "(tensorboard profile plugin)") from e
-    data, _ = raw_to_tool_data.xspace_to_tool_data(
-        [xplanes[-1]], tool, {})
+    data, _ = raw_to_tool_data.xspace_to_tool_data([xplane], tool, {})
     j = json.loads(data if isinstance(data, str) else data.decode())
     if isinstance(j, list):                # framework_op_stats wraps in []
         j = j[0]
@@ -150,16 +172,9 @@ def _parse_xplane_events(trace_dir):
     SELF time (nested child events subtracted stack-wise per line) over
     the device planes, or the XLA runtime line of the host plane when no
     device plane exists (XLA:CPU)."""
-    import glob
-
-    xplanes = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
-                        recursive=True)
-    if not xplanes:
-        raise MXNetError(f"no xplane capture under {trace_dir!r}")
-    xplanes.sort(key=os.path.getmtime)
     pb2 = _load_xplane_pb2()
     space = pb2.XSpace()
-    with open(xplanes[-1], "rb") as f:
+    with open(_latest_xplane(trace_dir), "rb") as f:
         space.ParseFromString(f.read())
     planes = [p for p in space.planes if p.name.startswith("/device:")]
     if not planes:

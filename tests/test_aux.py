@@ -49,6 +49,14 @@ assert "HLO category" in table or "framework op type" in table
 assert "TOTAL" in table and "top" in table
 times = [float(v) for v in re.findall(r"(\d+\.\d+) ms", table)]
 assert times and max(times) > 0.0, table
+# dump() writes the chrome-trace JSON at the configured filename
+# (ref: profiler.cc DumpProfile profile.json format)
+import json, os
+mx.profiler.dump()
+assert os.path.exists(sys.argv[1]), "dump() must write the trace json"
+trace = json.load(open(sys.argv[1]))
+events = trace if isinstance(trace, list) else trace.get("traceEvents", [])
+assert events, "chrome trace must contain events"
 print("DEVICE_STATS_OK")
 """
 
