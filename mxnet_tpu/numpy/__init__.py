@@ -292,3 +292,20 @@ random.permutation = _np_random(
                            else x._data))
 random.seed = lambda s: __import__(
     "mxnet_tpu.random", fromlist=["seed"]).seed(s)
+random.exponential = _np_random(
+    "exponential", lambda key, shape, scale=1.0:
+    jax.random.exponential(key, shape) * scale)
+def _gamma_sampler(key, _size, shape, scale=1.0):
+    # the distribution parameter is NAMED 'shape' in numpy's API, so the
+    # size-derived arg must not collide with it
+    return jax.random.gamma(key, _unbox(shape), _size or None) * scale
+
+
+random.gamma = _np_random("gamma", _gamma_sampler)
+random.beta = _np_random(
+    "beta", lambda key, shape, a, b:
+    jax.random.beta(key, _unbox(a), _unbox(b), shape or None))
+random.dirichlet = _np_random(
+    "dirichlet", lambda key, shape, alpha:
+    jax.random.dirichlet(key, jnp.asarray(_unbox(alpha), jnp.float32),
+                         shape or None))

@@ -60,7 +60,6 @@ def foreach(body, data, init_states, name="foreach"):
     (outputs stacked along a new axis 0, final states). ``data`` may be
     one NDArray or a list scanned in lockstep; ``init_states`` likewise.
     """
-    from .. import autograd
     from ..ndarray import NDArray
 
     data_list = _as_list(data)

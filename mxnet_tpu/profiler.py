@@ -87,7 +87,11 @@ def dump(finished=True, profile_process="worker"):
         if isinstance(data, bytes):
             data = data.decode()
         _json.loads(data)       # must be valid chrome-trace JSON
-    except Exception:           # conversion unavailable: keep raw xplane
+    except Exception as e:      # conversion unavailable: keep raw xplane
+        import logging
+        logging.getLogger(__name__).warning(
+            "profiler.dump(): chrome-trace conversion unavailable (%s); "
+            "raw xplane kept under %s", e, _trace_dir)
         return
     with open(_config.get("filename", "profile.json"), "w") as f:
         f.write(data)

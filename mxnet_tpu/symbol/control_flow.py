@@ -16,7 +16,6 @@ see them exactly like any other op input.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -2,7 +2,6 @@
 — foreach-vs-unrolled parity, while_loop semantics, cond, and the
 symbolic/hybridized paths)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, nd

@@ -152,3 +152,23 @@ def test_spatial_transformer_identity_and_zoom():
                                     target_shape=(5, 5))
     center = out2.asnumpy()[:, :, 2, 2]
     np.testing.assert_allclose(center, d.asnumpy()[:, :, 2, 2], atol=1e-5)
+
+
+def test_np_random_distribution_tail():
+    """mx.np.random exponential/gamma/beta/dirichlet (ref: numpy-compat
+    random namespace) — shapes, moments, and simplex constraint."""
+    mx.np.random.seed(0)
+    n = 4000
+    e = mx.np.random.exponential(2.0, size=(n,)).asnumpy()
+    assert abs(e.mean() - 2.0) < 0.15 and (e >= 0).all()
+    g = mx.np.random.gamma(3.0, 2.0, size=(n,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.4 and (g >= 0).all()   # k*theta
+    b = mx.np.random.beta(2.0, 5.0, size=(n,)).asnumpy()
+    assert abs(b.mean() - 2.0 / 7.0) < 0.03
+    assert (b >= 0).all() and (b <= 1).all()
+    d = mx.np.random.dirichlet(np.array([1.0, 2.0, 3.0]), size=(n,))
+    d = d.asnumpy()
+    assert d.shape == (n, 3)
+    np.testing.assert_allclose(d.sum(-1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(d.mean(0), [1 / 6, 2 / 6, 3 / 6],
+                               atol=0.03)
