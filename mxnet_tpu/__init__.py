@@ -94,6 +94,8 @@ from . import visualization as viz
 from . import test_utils
 from . import operator
 from . import runtime
+from . import util
+from . import rnn
 from . import attribute
 from .attribute import AttrScope
 from . import name

@@ -1,5 +1,6 @@
 """gluon.contrib (ref: python/mxnet/gluon/contrib/__init__.py)."""
 from . import nn
+from . import estimator
 from .nn import Concurrent, HybridConcurrent, Identity
 
-__all__ = ["nn", "Concurrent", "HybridConcurrent", "Identity"]
+__all__ = ["nn", "estimator", "Concurrent", "HybridConcurrent", "Identity"]

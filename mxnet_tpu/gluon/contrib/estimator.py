@@ -1,0 +1,249 @@
+"""``gluon.contrib.estimator`` — the high-level fit API (ref:
+python/mxnet/gluon/contrib/estimator/estimator.py + event_handler.py):
+``Estimator(net, loss, train_metrics, trainer).fit(train_data, val_data,
+epochs)`` with the reference's event-handler protocol (TrainBegin /
+EpochBegin / BatchBegin / BatchEnd / EpochEnd / TrainEnd) and its stock
+handlers (logging, checkpoint, early stopping).
+
+TPU-first: the step itself is the same autograd.record + Trainer.step
+fused program every other trainer here uses; hybridize the net and each
+bucket shape compiles once.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from ... import metric as _metric
+from ...base import MXNetError
+from ..trainer import Trainer
+from .. import loss as gloss
+from ..utils import split_and_load  # noqa: F401  (re-export parity)
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin",
+           "EpochEnd", "BatchBegin", "BatchEnd", "LoggingHandler",
+           "CheckpointHandler", "EarlyStoppingHandler", "StopTraining"]
+
+
+class StopTraining(Exception):
+    """Raised by a handler to stop fit() (ref: event_handler.py)."""
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class LoggingHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
+    """Periodic metric logging (ref: event_handler.py LoggingHandler)."""
+
+    def __init__(self, log_interval=50):
+        self.log_interval = log_interval
+        self._batches = 0
+        self._tic = None
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self._tic = time.time()
+        logging.info("Training begin")
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._batches += 1
+        if self.log_interval and self._batches % self.log_interval == 0:
+            msg = " ".join(f"{n}={v:.4f}" for n, v in
+                           (m.get() for m in estimator.train_metrics))
+            logging.info("[batch %d] %s", self._batches, msg)
+
+    def epoch_end(self, estimator, epoch=None, **kwargs):
+        msg = " ".join(f"{n}={v:.4f}" for n, v in
+                       (m.get() for m in estimator.train_metrics))
+        val = " ".join(f"val_{n}={v:.4f}" for n, v in
+                       (m.get() for m in estimator.val_metrics))
+        logging.info("Epoch[%s] %s %s", epoch, msg, val)
+
+    def train_end(self, estimator, *args, **kwargs):
+        logging.info("Training end (%.1fs)", time.time() - self._tic)
+
+
+class CheckpointHandler(EpochEnd, TrainEnd):
+    """Save params each epoch, track the best by a monitored metric
+    (ref: event_handler.py CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="min", save_best=False):
+        import os
+        os.makedirs(model_dir, exist_ok=True)
+        self.prefix = os.path.join(model_dir, model_prefix)
+        self.monitor = monitor
+        self.save_best = save_best
+        if mode not in ("min", "max"):
+            raise MXNetError(f"mode must be min/max, got {mode!r}")
+        self._sign = 1.0 if mode == "min" else -1.0
+        self._best = None
+
+    def epoch_end(self, estimator, epoch=None, **kwargs):
+        estimator.net.save_parameters(
+            f"{self.prefix}-epoch{epoch}.params")
+        if self.save_best and self.monitor is not None:
+            name, value = self.monitor.get()
+            score = self._sign * value
+            if self._best is None or score < self._best:
+                self._best = score
+                estimator.net.save_parameters(f"{self.prefix}-best.params")
+
+    def train_end(self, estimator, *args, **kwargs):
+        estimator.net.save_parameters(f"{self.prefix}-final.params")
+
+
+class EarlyStoppingHandler(EpochEnd):
+    """Stop when the monitored metric stops improving (ref:
+    event_handler.py EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, mode="min", patience=3, min_delta=0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self._sign = 1.0 if mode == "min" else -1.0
+        self._best = None
+        self._bad = 0
+
+    def epoch_end(self, estimator, epoch=None, **kwargs):
+        import math
+        name, value = self.monitor.get()
+        if isinstance(value, float) and math.isnan(value):
+            # monitor never updated (e.g. no val_data): no signal — do
+            # not count it as "no improvement"
+            logging.warning("EarlyStoppingHandler: monitor %r is NaN "
+                            "(was it ever updated?); skipping", name)
+            return
+        score = self._sign * value
+        if self._best is None or score < self._best - self.min_delta:
+            self._best = score
+            self._bad = 0
+        else:
+            self._bad += 1
+            if self._bad > self.patience:
+                raise StopTraining(
+                    f"{name} stopped improving for {self._bad} epochs")
+
+
+def _as_metrics(metrics):
+    if metrics is None:
+        return []
+    if isinstance(metrics, _metric.EvalMetric):
+        metrics = [metrics]
+    return list(metrics)
+
+
+class Estimator:
+    """High-level train loop (ref: estimator.py Estimator): one batch =
+    record → loss → backward → Trainer.step; metrics update per batch;
+    handlers observe the reference's event points."""
+
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 val_metrics=None, val_loss=None):
+        self.net = net
+        if not isinstance(loss, gloss.Loss):
+            raise MXNetError("loss must be a gluon Loss")
+        self.loss = loss
+        self.val_loss = val_loss or loss
+        self.train_metrics = _as_metrics(train_metrics) or \
+            [_metric.Accuracy()]
+        self.val_metrics = _as_metrics(val_metrics) or \
+            [m.__class__() for m in self.train_metrics]
+        # validation loss is a first-class metric (the reference reports
+        # it and early-stops on it); evaluate() feeds it from val_loss
+        self._val_loss_metric = _metric.Loss(name="loss")
+        self.val_metrics.append(self._val_loss_metric)
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3})
+
+    # -- internals ---------------------------------------------------------
+    def _call(self, handlers, event, *args, **kwargs):
+        for h in handlers:
+            fn = getattr(h, event, None)
+            if fn is not None:
+                fn(self, *args, **kwargs)
+
+    def _batch(self, batch):
+        data, label = batch.data[0], batch.label[0]
+        from ... import autograd
+        with autograd.record():
+            out = self.net(data)
+            loss = self.loss(out, label)
+        loss.backward()
+        self.trainer.step(data.shape[0])
+        for m in self.train_metrics:
+            m.update([label], [out])
+        return loss
+
+    def evaluate(self, val_data, metrics=None):
+        """ref: estimator.py evaluate — run val_data through the net,
+        update ``metrics`` (default: self.val_metrics)."""
+        metrics = _as_metrics(metrics) or self.val_metrics
+        for m in metrics:
+            m.reset()
+        val_data.reset()
+        for batch in val_data:
+            out = self.net(batch.data[0])
+            loss = self.val_loss(out, batch.label[0])
+            for m in metrics:
+                if m is self._val_loss_metric:
+                    m.update(None, [loss])
+                else:
+                    m.update([batch.label[0]], [out])
+        return [m.get() for m in metrics]
+
+    def fit(self, train_data, val_data=None, epochs=1,
+            event_handlers=None, batches=None):
+        """ref: estimator.py fit(train_data, val_data, epochs) —
+        ``batches`` caps steps per epoch (the reference's ``batches``
+        argument for partial epochs)."""
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler())
+        self._call(handlers, "train_begin")
+        try:
+            for epoch in range(epochs):
+                for m in self.train_metrics:
+                    m.reset()
+                train_data.reset()
+                self._call(handlers, "epoch_begin", epoch=epoch)
+                for i, batch in enumerate(train_data):
+                    if batches is not None and i >= batches:
+                        break
+                    self._call(handlers, "batch_begin", batch=batch)
+                    loss = self._batch(batch)
+                    self._call(handlers, "batch_end", batch=batch,
+                               loss=loss)
+                if val_data is not None:
+                    self.evaluate(val_data)
+                self._call(handlers, "epoch_end", epoch=epoch)
+        except StopTraining as e:
+            logging.info("Stop training: %s", e)
+        self._call(handlers, "train_end")
+        return self

@@ -286,6 +286,15 @@ def _take(a, indices, axis=0, mode="clip"):
     return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
 
 
+@register("batch_take", num_inputs=2,
+          doc="out[i] = a[i, indices[i]] — one element per leading-axis "
+              "row (ref: src/operator/tensor/indexing_op.cc batch_take); "
+              "pick with a fixed last axis")
+def _batch_take(a, indices):
+    return _pick(a.reshape(-1, a.shape[-1]),
+                 indices.reshape(-1), axis=-1)
+
+
 @register("pick", num_inputs=2,
           params=[OpParam("axis", int, -1), OpParam("keepdims", bool, False),
                   OpParam("mode", str, "clip")],

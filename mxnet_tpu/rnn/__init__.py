@@ -1,0 +1,146 @@
+"""``mx.rnn`` — the legacy (pre-Gluon) RNN API surface (ref:
+python/mxnet/rnn/: rnn_cell.py, io.py BucketSentenceIter,
+rnn.py save/load_rnn_checkpoint).
+
+The cell classes are the SAME objects as ``gluon.rnn``'s — the reference
+deprecated this module in favor of Gluon and kept the cells
+behavior-identical; here one implementation serves both names (cells are
+HybridBlocks, so ``unroll`` composes in eager, hybridized, and symbolic
+programs alike). ``BucketSentenceIter`` is the bucketing data iterator
+the Module-API RNN examples train from (pairs with
+``mx.mod.BucketingModule``).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..gluon.rnn import (BidirectionalCell, DropoutCell, GRUCell,
+                         LSTMCell, RecurrentCell, ResidualCell, RNNCell,
+                         SequentialRNNCell, ZoneoutCell)
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+           "BidirectionalCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "RecurrentCell", "BucketSentenceIter",
+           "encode_sentences"]
+
+BaseRNNCell = RecurrentCell   # the reference's base-class name
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1,
+                     invalid_key="\n", start_label=0, unknown_token=None):
+    """Map tokenized sentences to integer ids, growing ``vocab``
+    (ref: rnn/io.py encode_sentences)."""
+    idx = start_label
+    if vocab is None:
+        vocab = {invalid_key: invalid_label}
+        new_vocab = True
+    else:
+        new_vocab = False
+        if vocab:
+            idx = max(max(vocab.values()) + 1, idx)
+    res = []
+    for sent in sentences:
+        coded = []
+        for word in sent:
+            if word not in vocab:
+                if not new_vocab:
+                    if unknown_token is None:
+                        raise MXNetError(f"unknown token {word!r} with "
+                                         "a frozen vocab")
+                    word = unknown_token
+                    if word not in vocab:
+                        vocab[word] = idx
+                        idx += 1
+                else:
+                    vocab[word] = idx
+                    idx += 1
+            coded.append(vocab[word])
+        res.append(coded)
+    return res, vocab
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketing iterator over variable-length encoded sentences
+    (ref: rnn/io.py BucketSentenceIter): each sentence lands in the
+    smallest bucket that fits, batches come from one bucket at a time
+    with ``bucket_key`` set so BucketingModule picks the right-shaped
+    program."""
+
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 layout="NT"):
+        super().__init__(batch_size)
+        if buckets is None:
+            lens = np.bincount([len(s) for s in sentences])
+            buckets = [i for i, n in enumerate(lens)
+                       if n >= batch_size] or [max(len(s)
+                                                  for s in sentences)]
+        buckets = sorted(buckets)
+        self.data = [[] for _ in buckets]
+        ndiscard = 0
+        for sent in sentences:
+            buck = np.searchsorted(buckets, len(sent))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
+            buff[:len(sent)] = sent
+            self.data[buck].append(buff)
+        self.data = [np.asarray(x, dtype=dtype) for x in self.data]
+        if ndiscard:
+            import logging
+            logging.warning("discarded %d sentences longer than the "
+                            "largest bucket", ndiscard)
+        self.buckets = buckets
+        self.invalid_label = invalid_label
+        self.data_name = data_name
+        self.label_name = label_name
+        self.layout = layout
+        self.default_bucket_key = max(buckets)
+        shape = ((batch_size, self.default_bucket_key)
+                 if layout == "NT" else (self.default_bucket_key,
+                                         batch_size))
+        self.provide_data = [DataDesc(data_name, shape)]
+        self.provide_label = [DataDesc(label_name, shape)]
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend((i, j) for j in
+                            range(0, len(buck) - self.batch_size + 1,
+                                  self.batch_size))
+        _pyrandom.shuffle(self.idx)
+        for buck in self.data:
+            np.random.shuffle(buck)
+        # next-token labels derive AFTER the shuffle so rows stay aligned
+        self.label = []
+        for buck in self.data:
+            lab = np.empty_like(buck)
+            if buck.size:
+                lab[:, :-1] = buck[:, 1:]
+                lab[:, -1] = self.invalid_label
+            self.label.append(lab)
+
+    def next(self):
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        data = self.data[i][j:j + self.batch_size]
+        label = self.label[i][j:j + self.batch_size]
+        if self.layout == "TN":
+            data, label = data.T, label.T
+        return DataBatch(data=[nd.array(data)], label=[nd.array(label)],
+                         bucket_key=self.buckets[i], pad=0,
+                         provide_data=[DataDesc(self.data_name,
+                                                data.shape)],
+                         provide_label=[DataDesc(self.label_name,
+                                                 label.shape)])

@@ -1,0 +1,122 @@
+"""Round-4 API-parity tail: gluon.contrib.estimator, the legacy mx.rnn
+module, mx.util, nd.batch_take (ref: python/mxnet/gluon/contrib/
+estimator/, python/mxnet/rnn/, python/mxnet/util.py,
+src/operator/tensor/indexing_op.cc batch_take)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, io, nd
+from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                               EarlyStoppingHandler,
+                                               Estimator)
+
+
+def test_batch_take():
+    a = nd.array(np.arange(12.0).reshape(3, 4))
+    out = nd.batch_take(a, nd.array(np.array([0, 2, 3])))
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 6.0, 11.0])
+
+
+def test_util_np_array_scope():
+    assert not mx.util.is_np_array()
+    with mx.util.np_array():
+        assert mx.util.is_np_array()
+    assert not mx.util.is_np_array()
+
+    @mx.util.use_np
+    def inner():
+        return mx.util.is_np_array()
+    assert inner() and not mx.util.is_np_array()
+
+
+def test_rnn_cells_are_gluon_cells():
+    cell = mx.rnn.LSTMCell(8)
+    assert isinstance(cell, gluon.rnn.LSTMCell)
+    cell.initialize()
+    x = [nd.array(np.random.rand(2, 4).astype(np.float32))
+         for _ in range(3)]
+    outs, states = cell.unroll(3, x, layout="TNC", merge_outputs=False)
+    assert len(outs) == 3 and outs[0].shape == (2, 8)
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(0)
+    sents = [list(rng.randint(1, 20, rng.randint(2, 8)))
+             for _ in range(40)]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[4, 8],
+                                   invalid_label=0)
+    total = 0
+    for b in it:
+        assert b.bucket_key in (4, 8)
+        assert b.data[0].shape == (4, b.bucket_key)
+        d = b.data[0].asnumpy()
+        lab = b.label[0].asnumpy()
+        # labels are the next-token shift of data
+        np.testing.assert_array_equal(lab[:, :-1], d[:, 1:])
+        total += 1
+    assert total >= 2
+    it.reset()
+    assert sum(1 for _ in it) == total
+
+
+def test_encode_sentences_vocab():
+    coded, vocab = mx.rnn.encode_sentences([["a", "b"], ["b", "c"]])
+    assert coded == [[0, 1], [1, 2]]
+    with pytest.raises(mx.base.MXNetError):
+        mx.rnn.encode_sentences([["zzz"]], vocab=vocab)
+
+
+def _toy_task(n=256):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 16).astype(np.float32)
+    w = rng.randn(16, 5)
+    y = (x @ w).argmax(1).astype(np.float32)
+    return x, y
+
+
+def test_estimator_fit_and_handlers(tmp_path):
+    x, y = _toy_task()
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    train = io.NDArrayIter(x[:192], y[:192], batch_size=32, shuffle=True)
+    val = io.NDArrayIter(x[192:], y[192:], batch_size=32)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 5e-3}))
+    est.fit(train, val, epochs=12, event_handlers=[
+        CheckpointHandler(str(tmp_path), save_best=True,
+                          monitor=est.val_metrics[0], mode="max")])
+    name, acc = est.train_metrics[0].get()
+    assert name == "accuracy" and acc > 0.7, (name, acc)
+    files = {p.name for p in tmp_path.iterdir()}
+    assert "model-final.params" in files and "model-best.params" in files
+    # the checkpoint loads back
+    net2 = gluon.nn.HybridSequential()
+    net2.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(5))
+    net2.load_parameters(str(tmp_path / "model-final.params"))
+
+
+def test_estimator_early_stopping():
+    x, y = _toy_task(128)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    train = io.NDArrayIter(x, y, batch_size=32)
+    val = io.NDArrayIter(x, y, batch_size=32)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.0}))
+    epochs_seen = []
+
+    class Counter(EarlyStoppingHandler):
+        def epoch_end(self, estimator, epoch=None, **kw):
+            epochs_seen.append(epoch)
+            super().epoch_end(estimator, epoch=epoch, **kw)
+
+    # lr=0: metric never improves after epoch 0 → stops at patience+1
+    est.fit(train, val, epochs=50, event_handlers=[
+        Counter(est.val_metrics[0], mode="max", patience=2)])
+    assert len(epochs_seen) <= 5, epochs_seen
