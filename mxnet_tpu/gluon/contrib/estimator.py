@@ -173,8 +173,11 @@ class Estimator:
         self.val_loss = val_loss or loss
         self.train_metrics = _as_metrics(train_metrics) or \
             [_metric.Accuracy()]
+        import copy
         self.val_metrics = _as_metrics(val_metrics) or \
-            [m.__class__() for m in self.train_metrics]
+            [copy.deepcopy(m) for m in self.train_metrics]
+        for m in self.val_metrics:
+            m.reset()
         # validation loss is a first-class metric (the reference reports
         # it and early-stops on it); evaluate() feeds it from val_loss
         self._val_loss_metric = _metric.Loss(name="loss")
@@ -242,7 +245,20 @@ class Estimator:
                                loss=loss)
                 if val_data is not None:
                     self.evaluate(val_data)
-                self._call(handlers, "epoch_end", epoch=epoch)
+                # every handler's epoch_end runs even when one asks to
+                # stop (the reference's stop_training-flag protocol:
+                # checkpoints/logs of the stopping epoch still happen)
+                stop = None
+                for h in handlers:
+                    fn = getattr(h, "epoch_end", None)
+                    if fn is None:
+                        continue
+                    try:
+                        fn(self, epoch=epoch)
+                    except StopTraining as e:
+                        stop = e
+                if stop is not None:
+                    raise stop
         except StopTraining as e:
             logging.info("Stop training: %s", e)
         self._call(handlers, "train_end")

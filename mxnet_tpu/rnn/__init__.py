@@ -54,8 +54,11 @@ def encode_sentences(sentences, vocab=None, invalid_label=-1,
                                          "a frozen vocab")
                     word = unknown_token
                     if word not in vocab:
-                        vocab[word] = idx
-                        idx += 1
+                        # never grow a frozen vocab: a fresh id would
+                        # land past the embedding the caller sized to it
+                        raise MXNetError(
+                            f"unknown_token {word!r} must already be in "
+                            "the provided vocab")
                 else:
                     vocab[word] = idx
                     idx += 1

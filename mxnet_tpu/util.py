@@ -43,7 +43,17 @@ class np_array:
 
 def use_np(fn):
     """Decorator running ``fn`` under numpy semantics (ref: util.py
-    use_np; the shape/array split collapses here — one flag)."""
+    use_np; the shape/array split collapses here — one flag). Applied
+    to a CLASS, it wraps the methods the reference wraps (__init__,
+    forward, hybrid_forward, __call__) and returns the same class, so
+    isinstance/subclassing keep working."""
+    if isinstance(fn, type):
+        for name in ("__init__", "forward", "hybrid_forward",
+                     "__call__"):
+            meth = fn.__dict__.get(name)
+            if callable(meth):
+                setattr(fn, name, np_array(True)(meth))
+        return fn
     return np_array(True)(fn)
 
 
