@@ -180,3 +180,24 @@ def test_bucketing_word_lm_pipeline():
             mod.update()
         ppls.append(metric.get()[1])
     assert ppls[-1] < 0.5 * ppls[0], ppls
+
+
+def test_use_np_on_class_keeps_class():
+    @mx.util.use_np
+    class Probe(gluon.nn.HybridSequential):
+        pass
+    assert isinstance(Probe, type)
+    assert issubclass(Probe, gluon.nn.HybridSequential)
+    assert isinstance(Probe(), Probe)
+
+
+def test_encode_sentences_frozen_vocab_unknown():
+    coded, vocab = mx.rnn.encode_sentences([["a", "b"]])
+    with pytest.raises(mx.base.MXNetError):
+        # unknown_token must already be IN the frozen vocab
+        mx.rnn.encode_sentences([["x"]], vocab=vocab,
+                                unknown_token="<unk>")
+    vocab["<unk>"] = max(vocab.values()) + 1
+    out, _ = mx.rnn.encode_sentences([["x"]], vocab=vocab,
+                                     unknown_token="<unk>")
+    assert out == [[vocab["<unk>"]]]
