@@ -10,18 +10,84 @@ intrinsic traffic at ~819 GB/s — the binding constraint for RN50-bs256 on
 one v5e; the 50%-MFU arithmetic ceiling is ≈8000 and not binding). On
 non-TPU hosts the number is only a smoke signal.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Wedge-proof by construction (round-5 hardening; docs/perf_notes.md round-4
+pitfall: a degraded tunnel can hang ``jax.devices()`` indefinitely, turning
+a healthy benchmark into a silent rc=124):
+  1. the device is dialed in a throwaway subprocess under a hard deadline,
+     with retries + backoff;
+  2. the benchmark body itself runs in a subprocess under a hard deadline;
+  3. every failure path prints ONE structured JSON line (``error`` field set)
+     instead of hanging, so the driver always records a parseable artifact.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} on success,
+or {"metric", "value": null, ..., "error", "detail"} on a wedged device.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+METRIC = "resnet50_train_images_per_sec_per_chip"
+BASELINE_CEILING = 3550.0  # BASELINE.md governing (HBM-bound) ceiling
 
-def main():
+PROBE_TIMEOUT_S = 150      # first TPU compile dial can take ~40s; 150 is slack
+PROBE_BACKOFF_S = (0, 20, 45)  # len == number of probe attempts
+BENCH_TIMEOUT_S = 840      # well under any driver-side timeout window
+
+
+def _emit(obj: dict) -> None:
+    sys.stdout.flush()
+    print(json.dumps(obj), flush=True)
+
+
+def _diagnostic(error: str, detail: str) -> dict:
+    return {"metric": METRIC, "value": None, "unit": "images/sec/chip",
+            "vs_baseline": None, "error": error, "detail": detail}
+
+
+def _probe_device():
+    """Dial ``jax.devices()`` in a throwaway subprocess under a deadline.
+
+    Returns ``{"platform": ..., "n": ...}`` on success, else ``None`` after
+    all attempts (each attempt's outcome goes to stderr so the driver's tail
+    capture shows *why*, not just rc).
+    """
+    code = ("import jax, json; ds = jax.devices(); "
+            "print(json.dumps({'platform': ds[0].platform, 'n': len(ds)}))")
+    for attempt, backoff in enumerate(PROBE_BACKOFF_S, start=1):
+        if backoff:
+            time.sleep(backoff)
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            print(f"bench: device probe {attempt}/{len(PROBE_BACKOFF_S)} "
+                  f"timed out after {PROBE_TIMEOUT_S}s", file=sys.stderr)
+            continue
+        dt = time.perf_counter() - t0
+        if out.returncode == 0:
+            for line in reversed(out.stdout.splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    info = json.loads(line)
+                    print(f"bench: device probe ok in {dt:.1f}s -> "
+                          f"{info['n']}x {info['platform']}", file=sys.stderr)
+                    return info
+        print(f"bench: device probe {attempt}/{len(PROBE_BACKOFF_S)} failed "
+              f"rc={out.returncode}: {out.stderr.strip()[-300:]}",
+              file=sys.stderr)
+    return None
+
+
+def _run_body():
+    """The actual benchmark (runs in the deadlined child process)."""
     import jax
     from mxnet_tpu import gluon, parallel
     from mxnet_tpu.gluon.model_zoo import vision
@@ -29,7 +95,6 @@ def main():
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     batch = 256 if on_tpu else 8
-    warmup = 3
     steps = 8 if on_tpu else 2
 
     net = vision.resnet50_v1()
@@ -50,7 +115,6 @@ def main():
     # prefetch (SURVEY §2.5 #34 TPU equivalent) keeps steady-state steps free
     # of host→device transfers, which is what we measure here
     trainer._prepare((x_host,))
-    import mxnet_tpu as _mx
     x = trainer._shard(x_host, trainer._batch_spec(4))
     y = trainer._shard(y_host, trainer._batch_spec(1))
 
@@ -73,13 +137,54 @@ def main():
 
     n_chips = len(jax.devices())
     img_per_sec_per_chip = batch * steps * k / best_dt / n_chips
-    baseline_ceiling = 3550.0  # BASELINE.md governing (HBM-bound) ceiling
-    print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip",
+    _emit({
+        "metric": METRIC,
         "value": round(img_per_sec_per_chip, 2),
         "unit": f"images/sec/chip ({platform}, batch={batch})",
-        "vs_baseline": round(img_per_sec_per_chip / baseline_ceiling, 4),
-    }))
+        "vs_baseline": round(img_per_sec_per_chip / BASELINE_CEILING, 4),
+    })
+
+
+def main():
+    if "--body" in sys.argv:
+        return _run_body()
+
+    info = _probe_device()
+    if info is None:
+        _emit(_diagnostic(
+            "device_unreachable",
+            f"jax.devices() did not answer within {PROBE_TIMEOUT_S}s in any "
+            f"of {len(PROBE_BACKOFF_S)} attempts (backoffs "
+            f"{PROBE_BACKOFF_S}s); TPU tunnel wedged — see "
+            "docs/perf_notes.md round-4 pitfall"))
+        return 0
+
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--body"],
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"").decode("utf-8", "replace")
+                if isinstance(e.stderr, bytes) else (e.stderr or ""))[-500:]
+        _emit(_diagnostic(
+            "bench_timeout",
+            f"device probe was healthy ({info['n']}x {info['platform']}) but "
+            f"the benchmark body exceeded {BENCH_TIMEOUT_S}s — tunnel likely "
+            f"degraded mid-run; stderr tail: {tail}"))
+        return 0
+    sys.stderr.write(proc.stderr[-2000:])
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            print(line, flush=True)
+            dt = time.perf_counter() - t0
+            print(f"bench: body finished in {dt:.1f}s", file=sys.stderr)
+            return 0 if proc.returncode == 0 else proc.returncode
+    _emit(_diagnostic(
+        "bench_body_failed",
+        f"rc={proc.returncode}; stderr tail: {proc.stderr[-500:]}"))
+    return 0
 
 
 if __name__ == "__main__":
