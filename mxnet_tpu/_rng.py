@@ -87,3 +87,21 @@ def trace_key(key):
 
 def in_trace() -> bool:
     return bool(getattr(_trace, "stack", None))
+
+
+def get_state():
+    """Snapshot the eager generator: (raw key bits uint32, impl name).
+    Together with ``set_state`` this makes checkpoint/resume bit-exact for
+    every op that draws from the global key (dropout masks, samplers)."""
+    import numpy as np
+    with _lock:
+        return (np.asarray(jax.random.key_data(_key)),
+                str(jax.random.key_impl(_key)))
+
+
+def set_state(data, impl):
+    global _key
+    import jax.numpy as jnp
+    with _lock:
+        _key = jax.random.wrap_key_data(
+            jnp.asarray(data, dtype=jnp.uint32), impl=impl)

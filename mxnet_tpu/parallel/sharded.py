@@ -336,8 +336,14 @@ class ShardedTrainer:
         return self._mesh
 
     def _param_spec(self, param):
+        # rules match the flat parameter name AND the structural path
+        # ('features.3.weight'). Flat names embed process-global counters
+        # (dense0 → dense4 in a second net instance), so a rule written
+        # against them silently stops matching in a rebuilt net — e.g. on
+        # checkpoint resume; structural paths are instance-independent.
+        sname = self._struct_name(param)
         for pat, spec in self._param_rules:
-            if pat.match(param.name):
+            if pat.match(param.name) or pat.match(sname):
                 return spec
         return PartitionSpec()   # replicated (pure data parallel)
 
@@ -606,6 +612,295 @@ class ShardedTrainer:
         self.last_outputs = [nd.NDArray(o, _skip_device_put=True)
                              for o in outs]
         return nd.NDArray(loss_val, _skip_device_put=True)
+
+    # -- checkpoint / resume -------------------------------------------------
+    # The flagship path's checkpoint story (ref: python/mxnet/gluon/
+    # trainer.py save_states/load_states; SURVEY §5.4). Differences forced
+    # by the sharded world: optimizer state lives as GSPMD-sharded
+    # jax.Arrays (possibly bf16 masters), and in a multi-host run no single
+    # process holds every shard. The layout is therefore per-shard-capable:
+    # each process writes only the shards it owns (``<fname>.shard<rank>``)
+    # plus one rank-0 meta file; a single-process run collapses to one
+    # ordinary .params-format file readable by ``nd.load``. Resume is
+    # bit-exact: master weights and state are stored in their storage dtype
+    # (no fp32 round trip), and the global RNG key is part of the state so
+    # dropout masks continue the same stream (tests/test_sharded_checkpoint).
+
+    _CKPT_FORMAT = 1
+
+    def prepare(self, *example_args):
+        """Materialize sharded params + optimizer state without running a
+        step (the resume entry point: prepare, then ``load_checkpoint``)."""
+        self._prepare(example_args)
+
+    def _require_prepared(self, what):
+        if not self._prepared:
+            raise MXNetError(
+                f"ShardedTrainer.{what} needs the sharded state: call "
+                "prepare(*example_args) or run a step first")
+
+    @staticmethod
+    def _gather_host(arr):
+        """Device array -> numpy with exact bytes; gathers non-addressable
+        shards over DCN in multi-host runs (full-file mode only)."""
+        arr = jnp.asarray(arr)
+        if arr.is_fully_addressable:
+            return np.asarray(arr)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+    @staticmethod
+    def _idx_key(idx, shape):
+        """Normalize a shard index (tuple of slices) to a stable string."""
+        parts = []
+        for sl, dim in zip(idx, shape):
+            start, stop, _ = sl.indices(dim)
+            parts.append(f"{start}:{stop}")
+        return ",".join(parts)
+
+    def _struct_name(self, param):
+        """Structural key ('features.0.weight') — instance-independent, so a
+        checkpoint loads into a freshly-constructed net whose auto-generated
+        name prefixes differ (same convention as Block.save_parameters)."""
+        by_id = getattr(self, "_struct_cache", None)
+        if by_id is None:
+            by_id = {}
+            for key, p in self._block._structural_names().items():
+                by_id.setdefault(id(p), key)
+            self._struct_cache = by_id
+        return by_id.get(id(param), param.name)
+
+    def _state_entries(self):
+        """name -> placed jax.Array for every optimizer-state leaf."""
+        out = {}
+        for p, st in zip(self._trainable, self._states):
+            for j, s in enumerate(st):
+                out[f"state:{self._struct_name(p)}:{j}"] = s
+        return out
+
+    def _param_entries(self):
+        out = {}
+        for p in self._trainable:
+            out[f"arg:{self._struct_name(p)}"] = p._data[0]._data
+        for p in self._aux:
+            out[f"aux:{self._struct_name(p)}"] = p._data[0]._data
+        return out
+
+    def _ckpt_meta(self, per_shard):
+        rng_data, rng_impl = _rng.get_state()
+        return {
+            "format": self._CKPT_FORMAT,
+            "optimizer": type(self._optimizer).__name__,
+            "num_update": int(self._num_update),
+            "master_dtype": (str(self._master_dtype)
+                             if self._master_dtype is not None else None),
+            "state_arity": [len(st) for st in self._states],
+            "per_shard": bool(per_shard),
+            "rng_impl": rng_impl,
+            "rng_data": [int(v) for v in np.ravel(rng_data)],
+            "rng_shape": list(rng_data.shape),
+        }
+
+    @staticmethod
+    def _barrier(tag):
+        """Group-wide sync so no process reads a checkpoint another process
+        is still writing (and save_* doesn't return before the set of shard
+        files is complete). No-op single-process."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"mxtpu_ckpt_{tag}")
+
+    def _write_entries(self, fname, entries, meta):
+        """Write placed arrays + meta. Full mode: collective gather on all
+        processes, ONE writer (rank 0 — concurrent writes to a shared path
+        would tear the file). Per-shard mode: rank-0 meta file + one
+        ``.shard<rank>`` file per process with only locally-owned shards
+        (entry key ``<name>|<index>``)."""
+        import json as _json
+        meta_nd = {"__meta__": nd.NDArray(np.frombuffer(
+            _json.dumps(meta).encode("utf-8"), dtype=np.uint8).copy())}
+        if not meta["per_shard"]:
+            full = dict(meta_nd)
+            for name, arr in entries.items():
+                # the gather is collective — every process participates
+                # even though only rank 0 writes
+                host = self._gather_host(arr)
+                if jax.process_index() == 0:
+                    full[name] = nd.NDArray(host, _skip_device_put=True)
+            if jax.process_index() == 0:
+                nd.save(fname, full)
+            self._barrier("save_full")
+            return
+        if jax.process_index() == 0:
+            nd.save(fname, meta_nd)
+        shard_entries = {}
+        for name, arr in entries.items():
+            arr = jnp.asarray(arr)
+            for shard in arr.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                key = f"{name}|{self._idx_key(shard.index, arr.shape)}"
+                if key not in shard_entries:
+                    shard_entries[key] = nd.NDArray(
+                        np.asarray(shard.data), _skip_device_put=True)
+        nd.save(f"{fname}.shard{jax.process_index()}", shard_entries)
+        self._barrier("save_shards")
+
+    def _read_meta(self, fname):
+        import json as _json
+        loaded = nd.load(fname)
+        if "__meta__" not in loaded:
+            raise MXNetError(
+                f"{fname}: not a ShardedTrainer checkpoint (no __meta__ "
+                "entry); eager gluon.Trainer states use Trainer.load_states")
+        meta = _json.loads(bytes(loaded["__meta__"].asnumpy()).decode())
+        if meta.get("format") != self._CKPT_FORMAT:
+            raise MXNetError(f"{fname}: unsupported checkpoint format "
+                             f"{meta.get('format')!r}")
+        return meta, loaded
+
+    def _needed_piece_keys(self):
+        """The (name, idxkey) pairs THIS process's addressable shards need —
+        the filter that keeps per-shard load memory at one host's share of
+        the checkpoint instead of the whole thing."""
+        needed = set()
+        for ents in (self._state_entries(), self._param_entries()):
+            for name, arr in ents.items():
+                arr = jnp.asarray(arr)
+                for shard in arr.addressable_shards:
+                    needed.add((name, self._idx_key(shard.index, arr.shape)))
+        return needed
+
+    def _read_pieces(self, fname):
+        """Collect per-shard entries from the ``.shard*`` files (shared
+        filesystem: any piece may live in any rank's file). Entries whose
+        shards this process doesn't own are dropped as each file is read, so
+        peak host memory is bounded by single-host shard-file sizes, not the
+        global checkpoint."""
+        import glob
+        self._barrier("load_shards")   # writers must be done before we glob
+        needed = self._needed_piece_keys()
+        pieces = {}
+        paths = sorted(glob.glob(f"{fname}.shard*"))
+        if not paths:
+            raise MXNetError(f"{fname}: per-shard checkpoint but no "
+                             f"{fname}.shard* files found")
+        for path in paths:
+            for key, arr in nd.load(path).items():
+                name, idxkey = key.rsplit("|", 1)
+                if (name, idxkey) in needed:
+                    pieces.setdefault(name, {})[idxkey] = arr.asnumpy()
+        return pieces
+
+    def _place_like(self, name, cur, loaded, pieces):
+        """Rebuild one sharded array in ``cur``'s exact layout from either
+        the full-file entries or the per-shard piece map."""
+        cur = jnp.asarray(cur)
+        if pieces is None:
+            if name not in loaded:
+                raise MXNetError(f"checkpoint is missing entry {name!r}")
+            host = loaded[name].asnumpy()
+            if tuple(host.shape) != tuple(cur.shape) or \
+                    jnp.dtype(host.dtype) != cur.dtype:
+                raise MXNetError(
+                    f"checkpoint entry {name!r} is {host.dtype}{host.shape}, "
+                    f"expected {cur.dtype}{tuple(cur.shape)} — architecture "
+                    "or master_dtype mismatch")
+            return jax.device_put(host, cur.sharding)
+        per = pieces.get(name)
+        if per is None:
+            raise MXNetError(f"per-shard checkpoint is missing {name!r}")
+
+        def cb(idx):
+            piece = per.get(self._idx_key(idx, cur.shape))
+            if piece is None:
+                raise MXNetError(
+                    f"{name!r}: no saved piece for shard {idx} — mesh or "
+                    "sharding layout changed since save")
+            if jnp.dtype(piece.dtype) != cur.dtype:
+                raise MXNetError(
+                    f"checkpoint piece {name!r} is {piece.dtype}, expected "
+                    f"{cur.dtype} — master_dtype mismatch")
+            return piece
+        return jax.make_array_from_callback(cur.shape, cur.sharding, cb)
+
+    def save_states(self, fname, per_shard=None):
+        """Checkpoint optimizer state + step count + RNG stream.
+
+        ``per_shard=None`` auto-selects: one plain ``.params``-format file
+        in single-process runs, per-process shard files in multi-host runs.
+        API parity: gluon.Trainer.save_states (ref: python/mxnet/gluon/
+        trainer.py:save_states)."""
+        self._require_prepared("save_states")
+        if per_shard is None:
+            per_shard = jax.process_count() > 1
+        self._write_entries(fname, self._state_entries(),
+                            self._ckpt_meta(per_shard))
+
+    def load_states(self, fname):
+        """Restore what ``save_states`` wrote. The trainer must be prepared
+        with the same architecture, optimizer class, master_dtype and (for
+        per-shard files) mesh layout."""
+        self._require_prepared("load_states")
+        meta, loaded = self._read_meta(fname)
+        if meta["optimizer"] != type(self._optimizer).__name__:
+            raise MXNetError(
+                f"checkpoint was saved with optimizer {meta['optimizer']!r}, "
+                f"trainer has {type(self._optimizer).__name__!r}")
+        want_mdt = (str(self._master_dtype)
+                    if self._master_dtype is not None else None)
+        if meta.get("master_dtype") != want_mdt:
+            raise MXNetError(
+                f"checkpoint was saved with master_dtype="
+                f"{meta.get('master_dtype')!r}, trainer has {want_mdt!r} — "
+                "resume with the same storage dtype (a cast would change "
+                "the training trajectory)")
+        if meta["state_arity"] != [len(st) for st in self._states]:
+            raise MXNetError("checkpoint state arity mismatch — different "
+                             "optimizer config or parameter set")
+        pieces = self._read_pieces(fname) if meta["per_shard"] else None
+        new_states = []
+        for p, st in zip(self._trainable, self._states):
+            new_states.append(tuple(
+                self._place_like(f"state:{self._struct_name(p)}:{j}", s,
+                                 loaded, pieces)
+                for j, s in enumerate(st)))
+        self._states = new_states
+        self._num_update = int(meta["num_update"])
+        self._optimizer.num_update = self._num_update
+        rng_data = np.asarray(meta["rng_data"], dtype=np.uint32).reshape(
+            meta["rng_shape"])
+        _rng.set_state(rng_data, meta["rng_impl"])
+
+    def save_checkpoint(self, prefix, per_shard=None):
+        """Full resumable snapshot: ``<prefix>.params`` (master weights +
+        aux state, exact storage dtype) and ``<prefix>.states`` (optimizer
+        state, step count, RNG). Ref: mx.model checkpoint pair
+        (python/mxnet/model.py save_checkpoint) lifted to sharded state."""
+        self._require_prepared("save_checkpoint")
+        if per_shard is None:
+            per_shard = jax.process_count() > 1
+        self._write_entries(f"{prefix}.params", self._param_entries(),
+                            self._ckpt_meta(per_shard))
+        self.save_states(f"{prefix}.states", per_shard=per_shard)
+
+    def load_checkpoint(self, prefix):
+        """Bit-exact resume of ``save_checkpoint`` output onto a prepared
+        trainer: training continues as if never interrupted
+        (tests/test_sharded_checkpoint.py asserts bitwise equality)."""
+        self._require_prepared("load_checkpoint")
+        meta, loaded = self._read_meta(f"{prefix}.params")
+        pieces = (self._read_pieces(f"{prefix}.params")
+                  if meta["per_shard"] else None)
+        for p in self._trainable:
+            p._data[0]._rebind(self._place_like(
+                f"arg:{self._struct_name(p)}", p._data[0]._data, loaded,
+                pieces))
+        for p in self._aux:
+            p._data[0]._rebind(self._place_like(
+                f"aux:{self._struct_name(p)}", p._data[0]._data, loaded,
+                pieces))
+        self.load_states(f"{prefix}.states")
 
     # -- parity helpers ------------------------------------------------------
     @property

@@ -1,0 +1,163 @@
+"""ShardedTrainer checkpoint/resume: the flagship path must survive a
+restart bit-exactly (VERDICT r4 Missing #2; ref: python/mxnet/gluon/
+trainer.py save_states/load_states + python/mxnet/model.py save_checkpoint,
+lifted to GSPMD-sharded state per SURVEY §5.4).
+
+Protocol: train k steps, save, train m more ("uninterrupted"); then build a
+FRESH net+trainer, load, train the same m steps ("resumed") — every master
+weight, aux buffer and optimizer-state leaf must match bitwise, including
+the dropout RNG stream (the global key is part of the checkpoint)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import PartitionSpec as P
+
+
+def _make_net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Dropout(0.3),
+            gluon.nn.Dense(16))
+    net.initialize()
+    return net
+
+
+def _batches(n, batch=8, dim=12, classes=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(batch, dim).astype(np.float32),
+             rng.randint(0, classes, (batch,)))
+            for _ in range(n)]
+
+
+def _make_trainer(net, mesh, optimizer="sgd", **kw):
+    params = {"sgd": {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+              "adam": {"learning_rate": 1e-3}}[optimizer]
+    # structural-path rule: matches the head Dense in EVERY net instance
+    # (a flat-name rule like ".*dense1_weight" stops matching in a rebuilt
+    # net because the auto-name counter moved — the resume trap)
+    return parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer,
+        optimizer_params=params, mesh=mesh,
+        param_rules=[(r"3\.weight", P("model", None))], **kw)
+
+
+def _snapshot(tr):
+    snap = {}
+    for p in tr._trainable:
+        snap["arg:" + tr._struct_name(p)] = np.asarray(p._data[0]._data)
+    for p in tr._aux:
+        snap["aux:" + tr._struct_name(p)] = np.asarray(p._data[0]._data)
+    for p, st in zip(tr._trainable, tr._states):
+        for j, s in enumerate(st):
+            snap[f"state:{tr._struct_name(p)}:{j}"] = np.asarray(s)
+    return snap
+
+
+def _run_resume(tmp_path, optimizer, per_shard, **trainer_kw):
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    batches = _batches(7)
+    prefix = str(tmp_path / "ck")
+
+    mx.random.seed(7)
+    net_a = _make_net()
+    tr_a = _make_trainer(net_a, mesh, optimizer, **trainer_kw)
+    for x, y in batches[:3]:
+        tr_a.step(x, y)
+    tr_a.save_checkpoint(prefix, per_shard=per_shard)
+    for x, y in batches[3:]:
+        tr_a.step(x, y)
+    want = _snapshot(tr_a)
+
+    mx.random.seed(999)  # resumed run must NOT depend on the ambient seed
+    net_b = _make_net()
+    tr_b = _make_trainer(net_b, mesh, optimizer, **trainer_kw)
+    tr_b.prepare(batches[0][0])
+    tr_b.load_checkpoint(prefix)
+    assert tr_b._num_update == 3
+    # tensor-parallel rule must have applied in BOTH instances — a
+    # replicated fallback would still converge but lose tp (and ULP-diverge)
+    assert any(tuple(s) == ("model", None) for s in tr_a._tr_specs)
+    assert [tuple(s) for s in tr_a._tr_specs] == \
+        [tuple(s) for s in tr_b._tr_specs]
+    for x, y in batches[3:]:
+        tr_b.step(x, y)
+    got = _snapshot(tr_b)
+
+    assert set(want) == set(got)
+    for k in want:
+        assert want[k].dtype == got[k].dtype, k
+        assert np.array_equal(want[k], got[k]), \
+            f"{k}: resumed run diverged from uninterrupted run"
+
+
+def test_resume_bitwise_sgd_momentum(tmp_path):
+    _run_resume(tmp_path, "sgd", per_shard=False)
+
+
+def test_resume_bitwise_adam_bf16_masters(tmp_path):
+    # bf16 master weights + bf16 compute: the bench.py flagship config —
+    # storage dtype must round-trip exactly (no fp32 re-cast on load)
+    _run_resume(tmp_path, "adam", per_shard=False,
+                compute_dtype="bfloat16", master_dtype="bfloat16")
+
+
+def test_resume_bitwise_per_shard_layout(tmp_path):
+    # the multi-host file layout (one .shard<rank> file per process) must
+    # round-trip on a single process too — same bytes, different packing
+    _run_resume(tmp_path, "sgd", per_shard=True)
+
+
+def test_states_only_roundtrip(tmp_path):
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    batches = _batches(4, seed=3)
+    fname = str(tmp_path / "t.states")
+    mx.random.seed(11)
+    net = _make_net()
+    tr = _make_trainer(net, mesh, "adam")
+    for x, y in batches[:2]:
+        tr.step(x, y)
+    before = [np.asarray(s) for st in tr._states for s in st]
+    tr.save_states(fname)
+    for x, y in batches[2:]:
+        tr.step(x, y)
+    tr.load_states(fname)
+    after = [np.asarray(s) for st in tr._states for s in st]
+    assert tr._num_update == 2
+    for b, a in zip(before, after):
+        assert np.array_equal(b, a)
+
+
+def test_checkpoint_error_paths(tmp_path):
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    net = _make_net()
+    tr = _make_trainer(net, mesh, "sgd")
+    with pytest.raises(MXNetError, match="prepare"):
+        tr.save_states(str(tmp_path / "x.states"))
+    batches = _batches(1)
+    tr.prepare(batches[0][0])
+    tr.save_checkpoint(str(tmp_path / "ck"))
+
+    # optimizer-class mismatch must be caught, not silently mis-shaped
+    net2 = _make_net()
+    tr2 = _make_trainer(net2, mesh, "adam")
+    tr2.prepare(batches[0][0])
+    with pytest.raises(MXNetError, match="optimizer"):
+        tr2.load_states(str(tmp_path / "ck.states"))
+
+    # a non-checkpoint .params file is rejected with a clear message
+    mx.nd.save(str(tmp_path / "plain.params"), {"w": mx.nd.ones((2,))})
+    with pytest.raises(MXNetError, match="__meta__"):
+        tr.load_states(str(tmp_path / "plain.params"))
+
+    # master-dtype mismatch: bf16 checkpoint into an fp32 trainer must
+    # error, not silently rebind bf16 arrays (a trajectory change)
+    net3 = _make_net()
+    tr3 = _make_trainer(net3, mesh, "sgd", compute_dtype="bfloat16",
+                        master_dtype="bfloat16")
+    tr3.prepare(batches[0][0])
+    with pytest.raises(MXNetError, match="master_dtype"):
+        tr3.load_states(str(tmp_path / "ck.states"))
