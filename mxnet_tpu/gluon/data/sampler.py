@@ -5,7 +5,8 @@ import numpy as np
 
 from ...base import MXNetError
 
-__all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
+__all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "SplitSampler"]
 
 
 class Sampler:
@@ -39,6 +40,57 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self._length
+
+
+class SplitSampler(Sampler):
+    """Rank-sharded sampler: yields this worker's disjoint part of
+    ``[0, length)`` — the DataLoader-side analog of the iterators'
+    ``num_parts``/``part_index`` distributed read sharding (ref:
+    src/io/iter_image_recordio_2.cc kwargs over dmlc InputSplit; the
+    upstream example-zoo's SplitSampler idiom).
+
+    With ``shuffle=True`` every rank permutes the FULL index space with a
+    common (seed, epoch)-derived generator and then takes its contiguous
+    slice — so each epoch's global order is one shared permutation,
+    partitioned disjointly and exhaustively across ranks. ``num_parts``/
+    ``part_index`` default to the launcher env (MXTPU_NUM_PROC /
+    MXTPU_PROC_ID), so single-process runs see the whole dataset."""
+
+    def __init__(self, length, num_parts=None, part_index=None,
+                 shuffle=False, seed=0):
+        from ...io import _part_bounds, _resolve_part
+        self._length = int(length)
+        self._num_parts, self._part_index = _resolve_part(num_parts,
+                                                          part_index)
+        self._shuffle = shuffle
+        self._seed = int(seed)
+        self._epoch = 0
+        self._bounds = _part_bounds(self._length, self._num_parts,
+                                    self._part_index)
+
+    def set_epoch(self, epoch):
+        """Pin the permutation epoch explicitly (DistributedSampler
+        convention). The auto-increment in ``__iter__`` assumes every rank
+        iterates exactly once per epoch; any rank-asymmetric extra sweep
+        (a batch-count pre-pass, an eval over train data) silently
+        desynchronizes the shared permutation — call ``set_epoch`` at the
+        top of each epoch to make desync impossible."""
+        self._epoch = int(epoch)
+
+    def __iter__(self):
+        if self._shuffle:
+            rng = np.random.RandomState(
+                (self._seed * 1000003 + self._epoch) & 0x7FFFFFFF)
+            order = rng.permutation(self._length)
+            self._epoch += 1
+        else:
+            order = np.arange(self._length)
+        lo, hi = self._bounds
+        return iter(order[lo:hi].tolist())
+
+    def __len__(self):
+        lo, hi = self._bounds
+        return hi - lo
 
 
 class BatchSampler(Sampler):

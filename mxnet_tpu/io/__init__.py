@@ -112,13 +112,43 @@ def _init_data(data, allow_empty, default_name):
             for k, v in data.items()]
 
 
+def _resolve_part(num_parts, part_index):
+    """Distributed read sharding (ref: src/io/iter_image_recordio_2.cc
+    ``num_parts``/``part_index`` kwargs backed by dmlc InputSplit): each
+    worker reads a disjoint part of the input so multi-host data-parallel
+    training never consumes duplicate records. ``None`` wires to the
+    launcher environment (tools/launch.py exports MXTPU_NUM_PROC /
+    MXTPU_PROC_ID), so ``launch.py -n 8 train.py`` shards reads with no
+    code change; single-process runs resolve to (1, 0)."""
+    if num_parts is None:
+        num_parts = int(os.environ.get("MXTPU_NUM_PROC", "1") or 1)
+    if part_index is None:
+        part_index = int(os.environ.get("MXTPU_PROC_ID", "0") or 0)
+    num_parts, part_index = int(num_parts), int(part_index)
+    if num_parts < 1 or not 0 <= part_index < num_parts:
+        raise MXNetError(f"part_index {part_index} out of range for "
+                         f"num_parts {num_parts}")
+    return num_parts, part_index
+
+
+def _part_bounds(n, num_parts, part_index):
+    """Contiguous split [start, stop): every record lands in exactly one
+    part, remainder spread over the first parts (dmlc InputSplit
+    semantics — parts differ in size by at most 1)."""
+    base, rem = divmod(n, num_parts)
+    start = part_index * base + min(part_index, rem)
+    return start, start + base + (1 if part_index < rem else 0)
+
+
 class NDArrayIter(DataIter):
     """Batches over in-memory arrays (ref: io.py NDArrayIter): shuffle,
-    last_batch_handle pad/discard/roll_over."""
+    last_batch_handle pad/discard/roll_over; ``num_parts``/``part_index``
+    restrict the iterator to a contiguous shard for distributed reads."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", num_parts=None,
+                 part_index=None):
         super().__init__(batch_size)
         self.data = _init_data(data, False, data_name)
         self.label = _init_data(label, True, label_name)
@@ -126,6 +156,12 @@ class NDArrayIter(DataIter):
         for k, v in self.data + self.label:
             if v.shape[0] != self.num_data:
                 raise MXNetError(f"{k}: all arrays must share dim 0")
+        num_parts, part_index = _resolve_part(num_parts, part_index)
+        if num_parts > 1:
+            lo, hi = _part_bounds(self.num_data, num_parts, part_index)
+            self.data = [(k, v[lo:hi]) for k, v in self.data]
+            self.label = [(k, v[lo:hi]) for k, v in self.label]
+            self.num_data = hi - lo
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         if last_batch_handle == "discard":
@@ -284,7 +320,8 @@ class CSVIter(DataIter):
     """ref: src/io/iter_csv.cc — streams batches out of CSV files."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
-                 batch_size=1, round_batch=True, **kwargs):
+                 batch_size=1, round_batch=True, num_parts=None,
+                 part_index=None, **kwargs):
         super().__init__(batch_size)
         data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32,
                           ndmin=2).reshape((-1,) + tuple(data_shape))
@@ -298,7 +335,9 @@ class CSVIter(DataIter):
             label = np.zeros((data.shape[0],), dtype=np.float32)
         self._inner = NDArrayIter(data, label, batch_size=batch_size,
                                   last_batch_handle="pad"
-                                  if round_batch else "discard")
+                                  if round_batch else "discard",
+                                  num_parts=num_parts,
+                                  part_index=part_index)
 
     @property
     def provide_data(self):
@@ -330,7 +369,8 @@ class MNISTIter(DataIter):
     """ref: src/io/iter_mnist.cc — reads the raw MNIST ubyte files."""
 
     def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
-                 silent=False, seed=0, **kwargs):
+                 silent=False, seed=0, num_parts=None, part_index=None,
+                 **kwargs):
         super().__init__(batch_size)
         imgs = _read_idx_file(image).astype(np.float32) / 255.0
         lbls = _read_idx_file(label).astype(np.float32)
@@ -341,7 +381,9 @@ class MNISTIter(DataIter):
                                 imgs.shape[2])
         self._inner = NDArrayIter(imgs, lbls, batch_size=batch_size,
                                   shuffle=shuffle,
-                                  last_batch_handle="discard")
+                                  last_batch_handle="discard",
+                                  num_parts=num_parts,
+                                  part_index=part_index)
 
     @property
     def provide_data(self):
@@ -379,14 +421,22 @@ class ImageRecordIter(DataIter):
                  path_imgidx=None, shuffle=False, rand_crop=False,
                  rand_mirror=False, resize=-1, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
-                 label_width=1, preprocess_threads=4, seed=0, **kwargs):
+                 label_width=1, preprocess_threads=4, seed=0,
+                 num_parts=None, part_index=None, **kwargs):
         super().__init__(batch_size)
         from .. import recordio
         self._data_shape = tuple(data_shape)
+        self._num_parts, self._part_index = _resolve_part(num_parts,
+                                                          part_index)
         if path_imgidx and os.path.exists(path_imgidx):
             self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec,
                                                    "r")
             self._keys = list(self._rec.keys)
+            if self._num_parts > 1:
+                # indexed pack: contiguous key range, dmlc InputSplit shape
+                lo, hi = _part_bounds(len(self._keys), self._num_parts,
+                                      self._part_index)
+                self._keys = self._keys[lo:hi]
         else:
             self._rec = recordio.MXRecordIO(path_imgrec, "r")
             self._keys = None
@@ -424,6 +474,7 @@ class ImageRecordIter(DataIter):
                 fut.cancel()
         self._pending = deque()
         self._record_counter = 0
+        self._stream_pos = 0   # global stream position (round-robin split)
         # epoch counter folds into the per-record augment seed so each
         # epoch draws fresh crops/mirrors (position-keyed seeding alone
         # would replay epoch 1's augmentations forever)
@@ -438,14 +489,24 @@ class ImageRecordIter(DataIter):
             self._rec.reset()
 
     def _next_raw(self):
-        """Serial record fetch — raw packed bytes, decode deferred."""
+        """Serial record fetch — raw packed bytes, decode deferred. In an
+        un-indexed pack there is no key range to slice, so distributed
+        sharding falls back to round-robin record assignment (stream
+        position modulo num_parts — still a disjoint, exhaustive split)."""
         if self._keys is not None:
             if self._pos >= len(self._order):
                 return None
             s = self._rec.read_idx(self._order[self._pos])
             self._pos += 1
         else:
-            s = self._rec.read()
+            while True:
+                s = self._rec.read()
+                if s is None or self._num_parts == 1:
+                    break
+                here = self._stream_pos
+                self._stream_pos += 1
+                if here % self._num_parts == self._part_index:
+                    break
         return s
 
     def _decode_augment(self, s, record_idx):
@@ -625,7 +686,8 @@ class LibSVMIter(DataIter):
     holds multi-dim labels in the same format."""
 
     def __init__(self, data_libsvm, data_shape, batch_size,
-                 label_libsvm=None, label_shape=None, **kwargs):
+                 label_libsvm=None, label_shape=None, num_parts=None,
+                 part_index=None, **kwargs):
         super().__init__(batch_size)
         self._data_shape = tuple(data_shape) if not isinstance(
             data_shape, int) else (data_shape,)
@@ -643,6 +705,12 @@ class LibSVMIter(DataIter):
                 raise MXNetError(
                     f"LibSVMIter: label file has {len(self._labels_ext)} "
                     f"rows, data file {len(self._rows)}")
+        num_parts, part_index = _resolve_part(num_parts, part_index)
+        if num_parts > 1:
+            lo, hi = _part_bounds(len(self._rows), num_parts, part_index)
+            self._rows = self._rows[lo:hi]
+            if self._labels_ext is not None:
+                self._labels_ext = self._labels_ext[lo:hi]
         self._pos = 0
 
     @staticmethod

@@ -26,6 +26,7 @@ program over a device mesh:
 """
 from __future__ import annotations
 
+import os
 import re
 
 import jax
@@ -696,6 +697,7 @@ class ShardedTrainer:
                              if self._master_dtype is not None else None),
             "state_arity": [len(st) for st in self._states],
             "per_shard": bool(per_shard),
+            "shard_files": jax.process_count(),
             "rng_impl": rng_impl,
             "rng_data": [int(v) for v in np.ravel(rng_data)],
             "rng_shape": list(rng_data.shape),
@@ -771,21 +773,23 @@ class ShardedTrainer:
                     needed.add((name, self._idx_key(shard.index, arr.shape)))
         return needed
 
-    def _read_pieces(self, fname):
-        """Collect per-shard entries from the ``.shard*`` files (shared
-        filesystem: any piece may live in any rank's file). Entries whose
-        shards this process doesn't own are dropped as each file is read, so
-        peak host memory is bounded by single-host shard-file sizes, not the
-        global checkpoint."""
-        import glob
-        self._barrier("load_shards")   # writers must be done before we glob
+    def _read_pieces(self, fname, n_files):
+        """Collect per-shard entries from exactly the ``.shard0..N-1`` files
+        the saving run wrote (N from the checkpoint meta — globbing would
+        silently mix in stale shard files from an older save with a
+        different process count). Shared filesystem: any piece may live in
+        any rank's file. Entries whose shards this process doesn't own are
+        dropped as each file is read, so peak host memory is bounded by
+        single-host shard-file sizes, not the global checkpoint."""
+        self._barrier("load_shards")   # writers must be done before reading
         needed = self._needed_piece_keys()
         pieces = {}
-        paths = sorted(glob.glob(f"{fname}.shard*"))
-        if not paths:
-            raise MXNetError(f"{fname}: per-shard checkpoint but no "
-                             f"{fname}.shard* files found")
-        for path in paths:
+        for rank in range(n_files):
+            path = f"{fname}.shard{rank}"
+            if not os.path.exists(path):
+                raise MXNetError(
+                    f"per-shard checkpoint incomplete: {path} missing "
+                    f"(meta says {n_files} shard files)")
             for key, arr in nd.load(path).items():
                 name, idxkey = key.rsplit("|", 1)
                 if (name, idxkey) in needed:
@@ -858,7 +862,8 @@ class ShardedTrainer:
         if meta["state_arity"] != [len(st) for st in self._states]:
             raise MXNetError("checkpoint state arity mismatch — different "
                              "optimizer config or parameter set")
-        pieces = self._read_pieces(fname) if meta["per_shard"] else None
+        pieces = (self._read_pieces(fname, int(meta.get("shard_files", 1)))
+                  if meta["per_shard"] else None)
         new_states = []
         for p, st in zip(self._trainable, self._states):
             new_states.append(tuple(
@@ -890,7 +895,8 @@ class ShardedTrainer:
         (tests/test_sharded_checkpoint.py asserts bitwise equality)."""
         self._require_prepared("load_checkpoint")
         meta, loaded = self._read_meta(f"{prefix}.params")
-        pieces = (self._read_pieces(f"{prefix}.params")
+        pieces = (self._read_pieces(f"{prefix}.params",
+                                    int(meta.get("shard_files", 1)))
                   if meta["per_shard"] else None)
         for p in self._trainable:
             p._data[0]._rebind(self._place_like(

