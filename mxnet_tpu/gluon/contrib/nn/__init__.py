@@ -1,10 +1,14 @@
 """gluon.contrib.nn (ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
 from __future__ import annotations
 
+import numpy as np
+
+from ....base import MXNetError
 from ...block import HybridBlock
 from ...nn import HybridSequential, Sequential, SyncBatchNorm
 
-__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SyncBatchNorm"]
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "MoEFFN",
+           "SyncBatchNorm"]
 
 
 class HybridConcurrent(HybridSequential):
@@ -44,3 +48,135 @@ class Identity(HybridBlock):
 
     def hybrid_forward(self, F, x):
         return x
+
+
+class MoEFFN(HybridBlock):
+    """Top-k routed mixture-of-experts FFN as a drop-in Gluon layer
+    (net-new TPU capability — the reference has no MoE layer; routing
+    follows GShard/Switch, SURVEY §2.4 #32 expert-parallel row).
+
+    Drop it where a ``PositionwiseFFN`` would go::
+
+        ffn = gluon.contrib.nn.MoEFFN(units=512, hidden_size=2048,
+                                      num_experts=8, k=2)
+        net = ... ffn(x) ...                      # x: (B, T, units)
+        mesh = parallel.make_mesh({"data": 1, "expert": 8})
+        trainer = parallel.ShardedTrainer(net, loss, "adam", ...,
+            mesh=mesh,
+            param_rules=[(r".*expert_.*", PartitionSpec("expert"))])
+
+    Under a mesh whose ``expert`` axis matches ``num_experts`` the forward
+    dispatches tokens with two ``all_to_all``s and runs ONLY the local
+    expert per device at ``capacity_factor`` buffer size
+    (parallel.moe_apply_topk — per-device compute O(k·tokens/E)); on any
+    other mesh (or eagerly on one device) it falls back to the dense
+    formulation: every expert over every token, gate-weighted — same
+    math except no capacity dropping, so tiny-scale runs are exact.
+
+    Inside a ShardedTrainer step the Switch load-balancing loss is added
+    to the training objective automatically (``aux_loss_weight`` times
+    it; perfect balance ⇒ aux = k). Eager forwards additionally expose
+    the concrete value as ``_last_aux_loss`` for logging — traced steps
+    do NOT update it (a traced value would be a leaked tracer).
+    """
+
+    def __init__(self, units, hidden_size, num_experts, k=2,
+                 capacity_factor=1.5, activation="gelu",
+                 aux_loss_weight=0.01, expert_axis="expert", **kwargs):
+        super().__init__(**kwargs)
+        self._units, self._hidden = int(units), int(hidden_size)
+        self._ne, self._k = int(num_experts), int(k)
+        self._cf = float(capacity_factor)
+        self._act = activation
+        self.aux_loss_weight = float(aux_loss_weight)
+        self._expert_axis = expert_axis
+        self._last_aux_loss = None
+        e, u, h = self._ne, self._units, self._hidden
+        with self.name_scope():
+            self.gate_weight = self.params.get("gate_weight", shape=(e, u))
+            self.expert_w1 = self.params.get("expert_w1", shape=(e, u, h))
+            self.expert_b1 = self.params.get("expert_b1", shape=(e, h),
+                                             init="zeros")
+            self.expert_w2 = self.params.get("expert_w2", shape=(e, h, u))
+            self.expert_b2 = self.params.get("expert_b2", shape=(e, u),
+                                             init="zeros")
+
+    def _activate(self, h):
+        import jax
+        fns = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+               "tanh": jax.numpy.tanh}
+        try:
+            return fns[self._act](h)
+        except KeyError:
+            raise MXNetError(f"MoEFFN: unknown activation {self._act!r}; "
+                             f"one of {sorted(fns)}")
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_b1,
+                       expert_w2, expert_b2):
+        import jax
+        import jax.numpy as jnp
+        from .... import ndarray as nd_mod
+        from ....parallel.mesh import current_mesh
+        from ....parallel.moe import moe_apply_topk
+
+        xd = x._data if isinstance(x, nd_mod.NDArray) else jnp.asarray(x)
+        gw, w1, b1, w2, b2 = (a._data if isinstance(a, nd_mod.NDArray)
+                              else jnp.asarray(a)
+                              for a in (gate_weight, expert_w1, expert_b1,
+                                        expert_w2, expert_b2))
+        shape = xd.shape
+        tok = xd.reshape(-1, shape[-1])
+        gates = tok.astype(jnp.float32) @ gw.astype(jnp.float32).T  # (N, E)
+
+        mesh = current_mesh()
+        n_tok = tok.shape[0]
+        use_a2a = (self._expert_axis in mesh.axis_names
+                   and int(mesh.shape[self._expert_axis]) == self._ne
+                   and n_tok % self._ne == 0)
+        if use_a2a:
+            def expert_fn(params_e, t):
+                ew1, eb1, ew2, eb2 = params_e
+                h = self._activate(t.astype(jnp.float32) @ ew1 + eb1)
+                return h @ ew2 + eb2
+            if not isinstance(xd, jax.core.Tracer):
+                # eager call: stage operands onto the mesh (replicated) so
+                # the shard_map sees mesh-addressable arrays; inside a
+                # ShardedTrainer trace GSPMD handles placement instead
+                from jax.sharding import NamedSharding, PartitionSpec
+                rep = NamedSharding(mesh, PartitionSpec())
+                tok, gates, w1, b1, w2, b2 = (
+                    jax.device_put(a, rep)
+                    for a in (tok, gates, w1, b1, w2, b2))
+            y, aux, _ = moe_apply_topk(
+                expert_fn, (w1, b1, w2, b2), gates, tok, k=self._k,
+                capacity_factor=self._cf, mesh=mesh,
+                axis_name=self._expert_axis)
+            if not isinstance(xd, jax.core.Tracer):
+                # bring the eager result home so downstream single-device
+                # eager math doesn't mix committed device sets
+                y = jax.device_put(np.asarray(y))
+                aux = jax.device_put(np.asarray(aux))
+        else:
+            # dense fallback: every expert over every token, gate-weighted
+            probs = jax.nn.softmax(gates, axis=-1)
+            top_p, top_e = jax.lax.top_k(probs, self._k)
+            if self._k > 1:
+                top_p = top_p / jnp.maximum(
+                    top_p.sum(-1, keepdims=True), 1e-9)
+            onehot = jax.nn.one_hot(top_e, self._ne, dtype=jnp.float32)
+            wgt = (onehot * top_p[..., None]).sum(1)        # (N, E)
+            h = self._activate(jnp.einsum(
+                "nd,edh->neh", tok.astype(jnp.float32), w1) + b1)
+            ye = jnp.einsum("neh,ehd->ned", h, w2) + b2
+            y = ((ye * wgt[..., None]).sum(1)).astype(xd.dtype)
+            load = onehot.sum(1).mean(0)                     # (E,)
+            importance = probs.mean(0)
+            aux = self._ne * jnp.sum(load * importance)
+        # trace channel for ShardedTrainer's objective (read-and-cleared by
+        # _collect_aux_losses so no tracer outlives its trace); the public
+        # _last_aux_loss only ever holds concrete values (eager forwards)
+        self._trace_aux_loss = aux
+        if not isinstance(aux, jax.core.Tracer):
+            self._last_aux_loss = aux
+        y = y.astype(xd.dtype).reshape(shape[:-1] + (self._units,))
+        return nd_mod.NDArray(y, _skip_device_put=True)

@@ -7,6 +7,7 @@ from .mesh import (Mesh, NamedSharding, PartitionSpec, current_mesh,
                    use_mesh)
 from .moe import moe_apply, moe_apply_topk
 from .pipeline import pipeline_apply, pipeline_schedule_info
+from .pipelined import PipelinedTrainer
 from .ring_attention import (attention_reference, blockwise_attention,
                              ring_attention, ulysses_attention)
 from .sharded import (ShardedTrainer, allreduce_across_processes,
@@ -17,4 +18,4 @@ __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "current_mesh",
            "use_mesh", "ShardedTrainer", "allreduce_across_processes",
            "functional_apply", "ring_attention", "blockwise_attention",
            "ulysses_attention", "attention_reference", "pipeline_apply", "pipeline_schedule_info",
-           "moe_apply", "moe_apply_topk"]
+           "moe_apply", "moe_apply_topk", "PipelinedTrainer"]

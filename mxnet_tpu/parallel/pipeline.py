@@ -56,7 +56,8 @@ def pipeline_schedule_info(n_stages, num_microbatches, num_virtual=1):
 def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
                    axis_name="pipe", num_microbatches=None,
                    num_virtual_stages=1, embed_fn=None, embed_params=None,
-                   head_fn=None, head_params=None):
+                   head_fn=None, head_params=None, data_axis=None,
+                   params_are_split=False):
     """Run ``x`` through L = num_virtual_stages * P pipeline layers.
 
     stage_fn(params_l, h) -> h'       same signature for every layer;
@@ -70,9 +71,17 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
     head_fn(head_params, outs) -> y      optional last-stage epilogue
         (e.g. vocab projection); applied batched to the collected
         pipeline outputs
+    data_axis: name of a mesh axis to data-parallel over — each dp rank
+        pipelines its own slice of every microbatch (independent pipe
+        rings per dp shard); None replicates the batch across non-pipe
+        axes (the pre-round-5 behavior)
+    params_are_split: stage_params leaves already carry the (v, P, ...)
+        leading dims (the layout a trainer keeps so optimizer state can
+        shard over ``pipe``); False means flat (L, ...) stacks
 
     Returns the (B, ...) output of the final stage (after head_fn if
-    given), replicated across the axis.
+    given), replicated across the pipe axis (sharded over ``data_axis``
+    when given).
     """
     from .mesh import current_mesh
     mesh = mesh or current_mesh()
@@ -90,16 +99,31 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
                          f"activation of pass p must be back before its "
                          f"re-injection tick")
     leaves = jax.tree_util.tree_leaves(stage_params)
-    if leaves and leaves[0].shape[0] != v * p_size:
+    if params_are_split:
+        if leaves and leaves[0].shape[:2] != (v, p_size):
+            raise MXNetError(f"params_are_split leaves must lead with "
+                             f"(v, P) = ({v}, {p_size}); got "
+                             f"{leaves[0].shape[:2]}")
+    elif leaves and leaves[0].shape[0] != v * p_size:
         raise MXNetError(f"stage_params leading dim "
                          f"{leaves[0].shape[0]} != num_virtual_stages * "
                          f"pipe axis = {v * p_size}")
+    if data_axis is not None:
+        if data_axis not in mesh.axis_names:
+            raise MXNetError(f"mesh has no axis {data_axis!r}")
+        d_size = int(mesh.shape[data_axis])
+        if (b // m) % d_size:
+            raise MXNetError(
+                f"per-microbatch size {b // m} (batch {b} / {m} "
+                f"microbatches) not divisible by data axis "
+                f"{data_axis}={d_size}")
     micro = x.reshape((m, b // m) + x.shape[1:])
     ticks = v * m + p_size - 1
 
-    # (L, ...) -> (v, P, ...): pass-major split, P axis sharded
-    stage_params = jax.tree_util.tree_map(
-        lambda a: a.reshape((v, p_size) + a.shape[1:]), stage_params)
+    if not params_are_split:
+        # (L, ...) -> (v, P, ...): pass-major split, P axis sharded
+        stage_params = jax.tree_util.tree_map(
+            lambda a: a.reshape((v, p_size) + a.shape[1:]), stage_params)
     param_spec = jax.tree_util.tree_map(
         lambda _: P(None, axis_name), stage_params)
     rep = jax.tree_util.tree_map(lambda _: P(), (embed_params,
@@ -162,10 +186,12 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
             outs = outs.reshape((m, micro_bs) + outs.shape[1:])
         outs = jnp.where(is_last, outs, jnp.zeros_like(outs))
         outs = lax.psum(outs, axis_name)       # broadcast from last stage
-        return outs.reshape((m * micro_bs,) + outs.shape[2:])
+        return outs                            # (m, micro_bs_local, ...)
 
+    batch_spec = P(None, data_axis) if data_axis is not None else P()
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(param_spec, rep[0], rep[1], P()),
-        out_specs=P())
-    return fn(stage_params, embed_params, head_params, micro)
+        in_specs=(param_spec, rep[0], rep[1], batch_spec),
+        out_specs=batch_spec)
+    outs = fn(stage_params, embed_params, head_params, micro)
+    return outs.reshape((b,) + outs.shape[2:])

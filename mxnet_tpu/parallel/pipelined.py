@@ -1,0 +1,281 @@
+"""Gluon-level pipeline parallelism: train a real model (embedding → N
+identical blocks → head) with pp × dp sharding WITHOUT hand-writing stage
+closures — the trainer partitions the block list onto the ``pipe`` mesh
+axis itself (VERDICT r4 Weak #4 / SURVEY §7 P7 "exposed as Gluon-level
+options"; the reference's nearest tool is manual ``ctx_group`` placement,
+example/model-parallel-lstm).
+
+Design: the N body blocks must be structurally identical (a transformer
+encoder stack) — their parameters stack into (v, P, ...) leaves, sharded
+over ``pipe``, and ONE functional template block applies every layer
+(pipeline.py's interleaved ppermute schedule). The embedding and head run
+predicated on the edge devices with replicated parameters. Optimizer
+state shards exactly like its weights, so per-device optimizer memory
+scales 1/P for the body — the property Gluon-level pp exists for.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import _rng, autograd
+from .. import ndarray as nd
+from ..base import MXNetError
+from .mesh import NamedSharding, PartitionSpec, use_mesh
+from .pipeline import pipeline_apply
+from .sharded import _opt_apply, _opt_init_state, functional_apply
+
+__all__ = ["PipelinedTrainer"]
+
+
+def _trainable_of(block):
+    trainable, aux = block._param_split()
+    if aux:
+        raise MXNetError(
+            f"PipelinedTrainer: block {type(block).__name__} has auxiliary "
+            "state (BatchNorm running stats); pipeline stages must be "
+            "aux-free (use LayerNorm — the transformer norm — or train "
+            "with ShardedTrainer)")
+    return trainable
+
+
+class PipelinedTrainer:
+    """Pipeline + data parallel Gluon training driver::
+
+        emb  = gluon.nn.Embedding(vocab, d)
+        body = [TransformerLayer(d, heads) for _ in range(8)]
+        head = gluon.nn.Dense(vocab)
+        mesh = parallel.make_mesh({"pipe": 2, "data": 4})
+        tr = parallel.PipelinedTrainer(emb, body, head,
+            gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 1e-3}, mesh=mesh, num_microbatches=4)
+        loss = tr.step(tokens, labels)     # ONE fused XLA program
+
+    The 8 body layers live 4-per-device on the 2-way ``pipe`` axis
+    (interleaved schedule when ``num_virtual_stages > 1``); every dp rank
+    runs its own pipeline ring over its slice of the batch, and gradient
+    all-reduce over ``data`` is derived by GSPMD from the mean loss.
+
+    Restrictions (v1, raised eagerly): body blocks must be structurally
+    identical and aux-free, with matching input/output activation shapes;
+    per-parameter lr/wd multipliers are not applied (the stacked layout
+    has no per-parameter identity). Dropout draws one mask per compiled
+    tick body — fine for training, but bit-parity tests should use
+    dropout=0.
+    """
+
+    def __init__(self, embed, body_blocks, head, loss_fn, optimizer,
+                 optimizer_params=None, mesh=None, num_microbatches=None,
+                 num_virtual_stages=1, pipe_axis="pipe", data_axis="data",
+                 donate=True):
+        from .. import optimizer as opt_mod
+        from .mesh import current_mesh
+        self._embed, self._body, self._head = embed, list(body_blocks), head
+        self._loss = loss_fn
+        optimizer_params = optimizer_params or {}
+        self._optimizer = (optimizer
+                           if isinstance(optimizer, opt_mod.Optimizer)
+                           else opt_mod.create(optimizer, **optimizer_params))
+        self._mesh = mesh or current_mesh()
+        if pipe_axis not in self._mesh.axis_names:
+            raise MXNetError(f"mesh has no axis {pipe_axis!r}")
+        self._pipe_axis, self._data_axis = pipe_axis, data_axis
+        self._p = int(self._mesh.shape[pipe_axis])
+        self._v = int(num_virtual_stages)
+        if len(self._body) != self._v * self._p:
+            raise MXNetError(
+                f"{len(self._body)} body blocks don't tile onto "
+                f"num_virtual_stages * pipe = {self._v} * {self._p}; add "
+                f"blocks or change num_virtual_stages")
+        self._m = num_microbatches
+        self._donate = donate
+        self._prepared = False
+        self._num_update = self._optimizer.begin_num_update
+        self._step_fn = None
+
+    # -- setup ---------------------------------------------------------------
+    def _prepare(self, x_example):
+        if self._prepared:
+            return
+        with use_mesh(self._mesh):
+            h = self._embed(x_example if isinstance(x_example, nd.NDArray)
+                            else nd.array(x_example))
+            body_out = self._body[0](h)
+            if tuple(body_out.shape) != tuple(h.shape):
+                raise MXNetError(
+                    f"body blocks must preserve the activation shape (they "
+                    f"ride one ppermute ring): {tuple(h.shape)} -> "
+                    f"{tuple(body_out.shape)}")
+            for blk in self._body[1:]:
+                blk(h)            # materialize deferred shapes identically
+            self._head(body_out)
+        self._e_params = _trainable_of(self._embed)
+        self._h_params = _trainable_of(self._head)
+        body_params = [_trainable_of(b) for b in self._body]
+        shapes0 = [tuple(p._data[0].shape) for p in body_params[0]]
+        for i, plist in enumerate(body_params):
+            if [tuple(p._data[0].shape) for p in plist] != shapes0:
+                raise MXNetError(
+                    f"body block {i} has a different parameter signature "
+                    "than block 0 — pipeline stages must be structurally "
+                    "identical")
+        rep = NamedSharding(self._mesh, PartitionSpec())
+
+        # stacked body leaves: (v, P, ...), layer l = pass l//P on device l%P
+        # (pipeline.py's pass-major layout), sharded over pipe so weights
+        # AND optimizer state scale 1/P per device
+        def split_spec(_):
+            return PartitionSpec(None, self._pipe_axis)
+        self._b_spec = NamedSharding(self._mesh, split_spec(None))
+        self._b_datas = []
+        for j in range(len(shapes0)):
+            stack = jnp.stack([body_params[i][j]._data[0]._data
+                               for i in range(len(body_params))])
+            stack = stack.reshape((self._v, self._p) + stack.shape[1:])
+            self._b_datas.append(jax.device_put(stack, self._b_spec))
+        for p in self._e_params + self._h_params:
+            p._data[0]._rebind(jax.device_put(p._data[0]._data, rep))
+
+        opt = self._optimizer
+        self._e_states = [tuple(jax.device_put(s, rep)
+                                for s in _opt_init_state(opt, p._data[0]._data))
+                          for p in self._e_params]
+        self._h_states = [tuple(jax.device_put(s, rep)
+                                for s in _opt_init_state(opt, p._data[0]._data))
+                          for p in self._h_params]
+        self._b_states = [tuple(jax.device_put(s, self._b_spec
+                                               if getattr(s, "ndim", 0)
+                                               else rep)
+                                for s in _opt_init_state(opt, w))
+                          for w in self._b_datas]
+        self._prepared = True
+
+    # -- the compiled pp × dp step -------------------------------------------
+    def _build_step(self):
+        embed_blk, body_blk, head_blk = self._embed, self._body[0], self._head
+        loss_block, opt = self._loss, self._optimizer
+        mesh, pipe, data = self._mesh, self._pipe_axis, self._data_axis
+        m, v = self._m, self._v
+        clip = opt.clip_gradient if opt.clip_gradient is not None else -1.0
+        wd = opt.wd
+
+        def step(e_tr, b_tr, h_tr, e_st, b_st, h_st, key, lr, t, rescale,
+                 x, y):
+            def loss_of(groups):
+                e_tr_, b_tr_, h_tr_ = groups
+
+                def embed_fn(ep, mb):
+                    outs, _, _ = functional_apply(
+                        embed_blk, jax.random.fold_in(key, 1), ep, [], [mb])
+                    return outs[0]
+
+                def stage_fn(pl, hact):
+                    outs, _, _ = functional_apply(
+                        body_blk, jax.random.fold_in(key, 2), pl, [], [hact])
+                    return outs[0]
+
+                def head_fn(hp, hs):
+                    outs, _, _ = functional_apply(
+                        head_blk, jax.random.fold_in(key, 3), hp, [], [hs])
+                    return outs[0]
+
+                out = pipeline_apply(
+                    stage_fn, list(b_tr_), x, mesh=mesh, axis_name=pipe,
+                    num_microbatches=m, num_virtual_stages=v,
+                    embed_fn=embed_fn, embed_params=list(e_tr_),
+                    head_fn=head_fn, head_params=list(h_tr_),
+                    data_axis=(data if data in mesh.axis_names else None),
+                    params_are_split=True)
+                out_nd = nd.NDArray(out.astype(jnp.float32),
+                                    _skip_device_put=True)
+                y_nd = nd.NDArray(y, _skip_device_put=True)
+                with autograd.pause(train_mode=True):
+                    loss_nd = loss_block(out_nd, y_nd)
+                return jnp.mean(loss_nd._data.astype(jnp.float32))
+
+            loss_val, grads = jax.value_and_grad(loss_of)(
+                (list(e_tr), list(b_tr), list(h_tr)))
+
+            def upd(ws, gs, sts):
+                new_w, new_s = [], []
+                for w, g, s in zip(ws, gs, sts):
+                    w2, s2 = _opt_apply(opt, w, g, s, lr, t, wd, rescale,
+                                        clip)
+                    new_w.append(w2)
+                    new_s.append(s2)
+                return new_w, new_s
+
+            e2, es2 = upd(e_tr, grads[0], e_st)
+            b2, bs2 = upd(b_tr, grads[1], b_st)
+            h2, hs2 = upd(h_tr, grads[2], h_st)
+            return e2, b2, h2, es2, bs2, hs2, loss_val
+
+        ns = lambda spec: NamedSharding(mesh, spec)
+        rep = ns(PartitionSpec())
+        bsp = self._b_spec
+        st_sh = lambda sts, sh: [tuple(sh if getattr(e, "ndim", 0) else rep
+                                       for e in st) for st in sts]
+        in_sh = ([rep] * len(self._e_params), [bsp] * len(self._b_datas),
+                 [rep] * len(self._h_params),
+                 st_sh(self._e_states, rep), st_sh(self._b_states, bsp),
+                 st_sh(self._h_states, rep),
+                 rep, rep, rep, rep, None, None)
+        out_sh = in_sh[:6] + (rep,)
+        donate = (0, 1, 2, 3, 4, 5) if self._donate else ()
+        return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnums=donate)
+
+    def step(self, x, y):
+        """One fused pp × dp train step; returns the scalar loss."""
+        self._prepare(x)
+        if self._m is None:
+            self._m = self._p
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        xd = x._data if isinstance(x, nd.NDArray) else jnp.asarray(x)
+        yd = y._data if isinstance(y, nd.NDArray) else jnp.asarray(y)
+        self._num_update += 1
+        t = self._num_update
+        self._optimizer.num_update = t
+        lr = self._optimizer.learning_rate
+        if self._optimizer.lr_scheduler is not None:
+            lr = self._optimizer.lr_scheduler(t)
+        e_tr = [p._data[0]._data for p in self._e_params]
+        h_tr = [p._data[0]._data for p in self._h_params]
+        with use_mesh(self._mesh):
+            (e2, b2, h2, es2, bs2, hs2, loss) = self._step_fn(
+                e_tr, self._b_datas, h_tr, self._e_states, self._b_states,
+                self._h_states, _rng.next_key(), jnp.float32(lr),
+                jnp.float32(t), jnp.float32(self._optimizer.rescale_grad),
+                xd, yd)
+        for p, w in zip(self._e_params, e2):
+            p._data[0]._rebind(w)
+        for p, w in zip(self._h_params, h2):
+            p._data[0]._rebind(w)
+        self._b_datas = list(b2)
+        self._e_states, self._b_states, self._h_states = \
+            list(es2), list(bs2), list(hs2)
+        return nd.NDArray(loss, _skip_device_put=True)
+
+    def unstack_to_blocks(self):
+        """Write the stacked body weights back into the individual Gluon
+        blocks (after training, e.g. for save_parameters/export)."""
+        self._require_prepared()
+        for j, stack in enumerate(self._b_datas):
+            flat = np.asarray(stack).reshape(
+                (self._v * self._p,) + stack.shape[2:])
+            for i, blk in enumerate(self._body):
+                plist = _trainable_of(blk)
+                plist[j]._data[0]._rebind(jnp.asarray(flat[i]))
+
+    def _require_prepared(self):
+        if not self._prepared:
+            raise MXNetError("PipelinedTrainer: run a step first")
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)

