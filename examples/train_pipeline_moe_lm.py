@@ -110,7 +110,9 @@ def main():
     ap.add_argument("--units", type=int, default=32)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--pipe", type=int, default=2)
-    ap.add_argument("--data", type=int, default=4)
+    ap.add_argument("--data", type=int, default=None,
+                    help="data-parallel ranks (default: 4 for pp, 2 for "
+                         "moe — both fill the 8-device default mesh)")
     ap.add_argument("--experts", type=int, default=4)
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--batch", type=int, default=32)
@@ -118,6 +120,8 @@ def main():
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--lr", type=float, default=2e-3)
     args = ap.parse_args()
+    if args.data is None:
+        args.data = 4 if args.mode == "pp" else 2
 
     trainer, mesh = run_pp(args) if args.mode == "pp" else run_moe(args)
     print(f"mode={args.mode} mesh={dict(zip(mesh.axis_names, mesh.shape.values()))}")

@@ -17,6 +17,12 @@ import jax
 
 from .registry import OpParam, register
 
+
+def _index_dtype():
+    """int64 (reference parity) when jax x64 is enabled, else int32 —
+    requested explicitly so jax never warns about truncation."""
+    return jnp.int64 if jax.config.x64_enabled else jnp.int32
+
 _f = jnp  # brevity
 
 
@@ -67,7 +73,11 @@ _UNARY = {
     "degrees": (jnp.degrees, True),
     "radians": (jnp.radians, True),
     "logical_not": (lambda x: (x == 0).astype(x.dtype), False),
-    "size_array": (lambda x: jnp.asarray(x.size, dtype=jnp.int64), False),
+    # int64 like the reference when jax x64 is on, else an EXPLICIT int32
+    # request (asking for int64 under default jax emits a truncation
+    # UserWarning per call and silently returns int32 anyway)
+    "size_array": (lambda x: jnp.asarray(x.size, dtype=_index_dtype()),
+                   False),
     "isnan": (jnp.isnan, False),
     "isinf": (jnp.isinf, False),
     "isfinite": (jnp.isfinite, False),
@@ -83,8 +93,9 @@ register("identity", aliases=["_copy"], doc="Identity / copy op "
 register("zeros_like", differentiable=False)(jnp.zeros_like)
 register("ones_like", differentiable=False)(jnp.ones_like)
 register("shape_array", differentiable=False,
-         doc="Returns shape as 1-D int64 array (ref: shape_array op)")(
-    lambda x: jnp.asarray(x.shape, dtype=jnp.int64))
+         doc="Returns shape as a 1-D index-dtype array (int64 under jax "
+             "x64, int32 otherwise; ref: shape_array op)")(
+    lambda x: jnp.asarray(x.shape, dtype=_index_dtype()))
 register("BlockGrad", aliases=["stop_gradient"],
          doc="Stops gradient flow (ref: src/operator/tensor/"
              "elemwise_unary_op_basic.cc BlockGrad)")(jax.lax.stop_gradient)

@@ -37,6 +37,24 @@ def _trainable_of(block):
             "state (BatchNorm running stats); pipeline stages must be "
             "aux-free (use LayerNorm — the transformer norm — or train "
             "with ShardedTrainer)")
+    # MoE layers stash an aux loss for ShardedTrainer's collector; the
+    # pipelined step doesn't collect it (a per-tick tracer inside the
+    # shard_map can't be summed after the fact), so train MoE models with
+    # ShardedTrainer on an expert mesh instead of silently dropping the
+    # load-balancing term here
+    stack, seen = [block], set()
+    while stack:
+        b = stack.pop()
+        if id(b) in seen:
+            continue
+        seen.add(id(b))
+        if getattr(b, "aux_loss_weight", None) is not None:
+            raise MXNetError(
+                f"PipelinedTrainer: {type(b).__name__} carries an "
+                "auxiliary loss (MoE load balancing) that the pipelined "
+                "step would silently drop; use ShardedTrainer with a "
+                "data x expert mesh for MoE models")
+        stack.extend(getattr(b, "_children", {}).values())
     return trainable
 
 
@@ -80,6 +98,14 @@ class PipelinedTrainer:
         self._mesh = mesh or current_mesh()
         if pipe_axis not in self._mesh.axis_names:
             raise MXNetError(f"mesh has no axis {pipe_axis!r}")
+        if data_axis is not None and \
+                data_axis not in self._mesh.axis_names and \
+                data_axis != "data":
+            # an explicitly-requested dp axis that doesn't exist must fail
+            # loudly — silently replicating would waste every dp rank; the
+            # DEFAULT "data" merely degrades to pipe-only (a pure-pp mesh
+            # is legitimate)
+            raise MXNetError(f"mesh has no axis {data_axis!r}")
         self._pipe_axis, self._data_axis = pipe_axis, data_axis
         self._p = int(self._mesh.shape[pipe_axis])
         self._v = int(num_virtual_stages)

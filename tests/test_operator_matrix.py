@@ -381,29 +381,48 @@ def _edge_variants(case):
         variants["zero_size"] = [tuple(0 if i == 0 else d
                                        for i, d in enumerate(s))
                                  for s in base]
+        # every axis zero — the fully-degenerate case (rank preserved, so
+        # axis kwargs in the builders stay valid)
+        variants["zero_all"] = [(0,) * rank for _ in base]
         variants["one_elem"] = [(1,) * rank for _ in base]
         variants["large"] = [tuple(97 if d > 1 else d for d in s)
                              for s in base]
     return variants
 
 
-_SHAPE_PARAMS = [(c.key, variant) for c in CASES
-                 for variant in _edge_variants(c)]
+# each edge variant also runs under bf16 — the production compute dtype of
+# every benchmark config must survive the same shape edges fp32 does (the
+# reference's check_consistency swept fp16 the same way)
+_SHAPE_PARAMS = [(c.key, variant, dt) for c in CASES
+                 for variant in _edge_variants(c)
+                 for dt in (["float32", "bfloat16"]
+                            if "bfloat16" in c.dtypes else ["float32"])]
 
 
-@pytest.mark.parametrize("key,variant", _SHAPE_PARAMS,
-                         ids=[f"{k}-{v}" for k, v in _SHAPE_PARAMS])
-def test_op_shape_edges(key, variant):
+# reducing an EMPTY axis has no identity for these — the contract is a
+# clear error, not an invented value (the reference errors here too:
+# mshadow reduce with no elements)
+_EMPTY_AXIS_ERRORS = {"max", "min", "argmax", "argmin", "logsumexp",
+                      "log_softmax"}
+
+
+@pytest.mark.parametrize("key,variant,dtype", _SHAPE_PARAMS,
+                         ids=[f"{k}-{v}-{d}" for k, v, d in _SHAPE_PARAMS])
+def test_op_shape_edges(key, variant, dtype):
     case = BY_KEY[key]
     shapes = _edge_variants(case)[variant]
-    arrays = case.inputs(shapes=shapes)
+    arrays = case.inputs(shapes=shapes, dtype=dtype)
+    if variant == "zero_all" and key in _EMPTY_AXIS_ERRORS:
+        with pytest.raises(Exception):
+            _run_eager(case, arrays)
+        return
     out = _run_eager(case, arrays)
     got = _as_np(out)
-    if variant == "zero_size":
-        # every input had its leading axis zeroed, so the output must be
-        # empty too — a non-empty result means the op invented data
+    if variant in ("zero_size", "zero_all"):
+        # every input had axes zeroed, so the output must be empty too —
+        # a non-empty result means the op invented data
         assert got.size == 0, \
-            f"{key} zero-size output malformed: {got.shape}"
+            f"{key} {variant} output malformed: {got.shape}"
     else:
         assert np.isfinite(got.astype(np.float64)).all()
 
@@ -506,3 +525,26 @@ def test_op_grad_mode_consistency(key):
         np.testing.assert_allclose(
             gj, ge, rtol=1e-5, atol=1e-6,
             err_msg=f"{key}: jit vs eager grad of input {i}")
+
+
+# ---------------------------------------------------------------------------
+# sweep 5: bf16 jit consistency — the production compute dtype must give
+# the same numbers eager and hybridized (a cast dropped or added only on
+# one path shows up here; the reference swept fp16 through
+# check_consistency the same way)
+# ---------------------------------------------------------------------------
+
+_BF16_KEYS = sorted(c.key for c in CASES if "bfloat16" in c.dtypes)
+
+
+@pytest.mark.parametrize("key", _BF16_KEYS, ids=_BF16_KEYS)
+def test_op_bf16_jit_consistency(key):
+    case = BY_KEY[key]
+    arrays = case.inputs(dtype="bfloat16")
+    ref = _as_np(_run_eager(case, arrays)).astype(np.float32)
+    net = _Wrap(case.build, len(arrays))
+    net.hybridize()
+    jit_out = net(*[nd.array(a) for a in arrays])
+    np.testing.assert_allclose(
+        _as_np(jit_out).astype(np.float32), ref, rtol=2e-2, atol=2e-2,
+        err_msg=f"{key}: bf16 jit vs eager")
