@@ -84,6 +84,8 @@ from . import symbol as sym
 from . import model
 from . import module
 from . import module as mod
+from . import monitor
+from . import monitor as mon
 from . import callback
 from . import profiler
 from . import contrib

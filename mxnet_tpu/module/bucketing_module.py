@@ -91,6 +91,18 @@ class BucketingModule(BaseModule):
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
+        if getattr(self, "_monitor", None) is not None:
+            self._curr_module.install_monitor(self._monitor)
+
+    def install_monitor(self, mon):
+        """ref: BucketingModule.install_monitor — every bucket's executor
+        reports to the same Monitor (new buckets pick it up on switch)."""
+        if not self.binded:
+            from ..base import MXNetError
+            raise MXNetError("call bind before install_monitor")
+        self._monitor = mon
+        for module in self._buckets.values():
+            module.install_monitor(mon)
 
     def init_params(self, *args, **kwargs):
         self._buckets[self._default_bucket_key].init_params(*args, **kwargs)

@@ -163,6 +163,13 @@ class Module(BaseModule):
         self._updater = opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True
 
+    def install_monitor(self, mon):
+        """ref: module.py Module.install_monitor — watch this module's
+        executor with an mx.monitor.Monitor."""
+        if not self.binded:
+            raise MXNetError("call bind before install_monitor")
+        mon.install(self._exec)
+
     # -- execution -----------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         if not self.binded:

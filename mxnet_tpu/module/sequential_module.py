@@ -145,6 +145,14 @@ class SequentialModule(BaseModule):
         self.optimizer_initialized = True
 
     # -- execution -----------------------------------------------------------
+    def install_monitor(self, mon):
+        """ref: SequentialModule.install_monitor — every sub-module's
+        executor reports to the same Monitor."""
+        if not self.binded:
+            raise MXNetError("call bind before install_monitor")
+        for module in self._modules:
+            module.install_monitor(mon)
+
     def forward(self, data_batch, is_train=None):
         from ..io import DataBatch
         if not self.binded:

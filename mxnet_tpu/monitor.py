@@ -1,0 +1,100 @@
+"""mx.monitor — training-time tensor inspection.
+
+ref: python/mxnet/monitor.py Monitor (installed via
+Executor.SetMonitorCallback; fit(monitor=...) wires it through
+module/module.py install_monitor). The engine-callback mechanism doesn't
+exist under XLA — a compiled program has no per-op completion events — so
+this Monitor asks the executor to return pattern-matched intermediates as
+extra program outputs instead (symbol.py _make_eval_fn capture_re), which
+costs output bandwidth only on the batches where ``tic()`` activates it.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import re
+
+from . import ndarray as nd
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collects statistics of pattern-matched intermediate outputs (and,
+    with ``monitor_all``, parameters/auxiliary states) every ``interval``
+    batches::
+
+        mon = mx.monitor.Monitor(10, pattern=".*fc.*")
+        mod.fit(train_iter, num_epoch=2, monitor=mon)
+
+    API parity with the reference Monitor: install/tic/toc/toc_print,
+    ``stat_func`` defaulting to mean absolute value.
+
+    Known divergence: ops INSIDE control-flow subgraphs (foreach /
+    while_loop / cond) are not monitored — their per-iteration values
+    live inside a compiled ``lax.scan`` and cannot come back as extra
+    program outputs without stacking across iterations; the reference's
+    per-op engine callback has no XLA equivalent there.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
+        if stat_func is None:
+            def stat_func(x):          # ref: monitor.py asum_stat
+                return nd.norm(x) / math.sqrt(x.size)
+        self.stat_func = stat_func
+        self.interval = int(interval)
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self._pattern_re = self.re_prog
+        self.sort = sort
+        self.monitor_all = bool(monitor_all)
+        self.logger = logging.getLogger(__name__)
+
+    # -- executor-facing ----------------------------------------------------
+    def install(self, exe):
+        """ref: Monitor.install — register an executor to watch."""
+        exe.install_monitor(self)
+        if exe not in self.exes:
+            self.exes.append(exe)
+
+    def _collect(self, name, array):
+        """Called by the executor with each captured intermediate."""
+        self.queue.append((self.step, name,
+                           nd.NDArray(array, _skip_device_put=True)))
+
+    # -- batch protocol -----------------------------------------------------
+    def tic(self):
+        """Start collecting if this batch is on the interval."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; returns [(step, name, stat NDArray)]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        if self.monitor_all:
+            for exe in self.exes:
+                for name, arr in list(exe.arg_dict.items()) + \
+                        list(exe.aux_dict.items()):
+                    if self.re_prog.match(name):   # same filter as outputs
+                        self.queue.append((self.step, name, arr))
+        res = []
+        queue, self.queue = self.queue, []
+        if self.sort:
+            queue.sort(key=lambda t: t[1])
+        for step, name, arr in queue:
+            res.append((step, name, self.stat_func(arr)))
+        return res
+
+    def toc_print(self):
+        """ref: Monitor.toc_print — log the collected stats."""
+        for step, name, stat in self.toc():
+            val = stat.asnumpy() if hasattr(stat, "asnumpy") else stat
+            self.logger.info("Batch: %7d %30s %s", step, name, str(val))

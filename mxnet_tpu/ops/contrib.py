@@ -676,17 +676,22 @@ def _proposal_outputs(params):
     return 2 if params.get("output_score") else 1
 
 
+# shared by Proposal and MultiProposal — MultiProposal forwards **kwargs
+# into _proposal, so the two registrations must stay in lockstep
+_PROPOSAL_PARAMS = [OpParam("rpn_pre_nms_top_n", int, 6000),
+                    OpParam("rpn_post_nms_top_n", int, 300),
+                    OpParam("threshold", float, 0.7),
+                    OpParam("rpn_min_size", int, 16),
+                    OpParam("scales", tuple, (4.0, 8.0, 16.0, 32.0)),
+                    OpParam("ratios", tuple, (0.5, 1.0, 2.0)),
+                    OpParam("feature_stride", int, 16),
+                    OpParam("output_score", bool, False),
+                    OpParam("iou_loss", bool, False)]
+
+
 @register("_contrib_Proposal", aliases=["Proposal"], num_inputs=3,
           num_outputs=_proposal_outputs,
-          params=[OpParam("rpn_pre_nms_top_n", int, 6000),
-                  OpParam("rpn_post_nms_top_n", int, 300),
-                  OpParam("threshold", float, 0.7),
-                  OpParam("rpn_min_size", int, 16),
-                  OpParam("scales", tuple, (4.0, 8.0, 16.0, 32.0)),
-                  OpParam("ratios", tuple, (0.5, 1.0, 2.0)),
-                  OpParam("feature_stride", int, 16),
-                  OpParam("output_score", bool, False),
-                  OpParam("iou_loss", bool, False)],
+          params=list(_PROPOSAL_PARAMS),
           differentiable=False,
           doc="RPN proposal generation (ref: src/operator/contrib/"
               "proposal.cc): anchors + bbox deltas -> decode, clip, filter "
@@ -780,6 +785,91 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     if output_score:
         return rois, per_img[:, :, 0].reshape(-1, 1)
     return rois
+
+
+@register("_contrib_PSROIPooling", aliases=["PSROIPooling"], num_inputs=2,
+          params=[OpParam("spatial_scale", float, None, required=True),
+                  OpParam("output_dim", int, None, required=True),
+                  OpParam("pooled_size", int, None, required=True),
+                  OpParam("group_size", int, 0)],
+          doc="Position-sensitive ROI pooling (ref: src/operator/contrib/"
+              "psroi_pooling.cc, R-FCN): output channel d, bin (i,j) "
+              "average-pools input channel (d*gs+g_i)*gs+g_j over the "
+              "bin's integer extent. Formulated as separable row/col bin "
+              "masks + ONE einsum per ROI so XLA maps it onto the MXU "
+              "instead of the reference's per-bin CUDA loops.")
+def _psroi_pooling(data, rois, spatial_scale=None, output_dim=None,
+                   pooled_size=None, group_size=0):
+    ph = pw = int(pooled_size)
+    gs = int(group_size) or ph
+    n, c, h, w = data.shape
+    if c != output_dim * gs * gs:
+        raise MXNetError(
+            f"PSROIPooling: data needs output_dim*group_size^2 = "
+            f"{output_dim}*{gs}^2 = {output_dim * gs * gs} channels, "
+            f"got {c}")
+    hs_idx = jnp.arange(h, dtype=jnp.float32)
+    ws_idx = jnp.arange(w, dtype=jnp.float32)
+    ii = jnp.arange(ph, dtype=jnp.float32)
+    jj = jnp.arange(pw, dtype=jnp.float32)
+    # bin (i,j) -> position-sensitive channel group (reference: gh =
+    # floor(i*gs/ph), identity when gs == pooled_size)
+    gh = jnp.clip(jnp.floor(ii * gs / ph), 0, gs - 1).astype(jnp.int32)
+    gw = jnp.clip(jnp.floor(jj * gs / pw), 0, gs - 1).astype(jnp.int32)
+    cidx = ((jnp.arange(int(output_dim))[:, None, None] * gs
+             + gh[None, :, None]) * gs + gw[None, None, :])   # (od, ph, pw)
+
+    def c_round(v):
+        # C round(): half AWAY from zero — jnp.round is half-to-even,
+        # which shifts bins for .5 coordinates (common after 0.5x scales)
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
+    def one_roi(roi):
+        batch_idx = roi[0].astype(jnp.int32)
+        # reference rounds ROI corners to pixels BEFORE scaling and adds 1
+        # to the far edge
+        x1 = c_round(roi[1]) * spatial_scale
+        y1 = c_round(roi[2]) * spatial_scale
+        x2 = c_round(roi[3] + 1.0) * spatial_scale
+        y2 = c_round(roi[4] + 1.0) * spatial_scale
+        bin_h = jnp.maximum(y2 - y1, 0.1) / ph
+        bin_w = jnp.maximum(x2 - x1, 0.1) / pw
+        hstart = jnp.clip(jnp.floor(y1 + ii * bin_h), 0, h)
+        hend = jnp.clip(jnp.ceil(y1 + (ii + 1) * bin_h), 0, h)
+        wstart = jnp.clip(jnp.floor(x1 + jj * bin_w), 0, w)
+        wend = jnp.clip(jnp.ceil(x1 + (jj + 1) * bin_w), 0, w)
+        row = ((hs_idx[None, :] >= hstart[:, None])
+               & (hs_idx[None, :] < hend[:, None]))           # (ph, H)
+        col = ((ws_idx[None, :] >= wstart[:, None])
+               & (ws_idx[None, :] < wend[:, None]))           # (pw, W)
+        img = lax.dynamic_index_in_dim(data, batch_idx, axis=0,
+                                       keepdims=False)
+        sums = jnp.einsum("ih,chw,jw->cij",
+                          row.astype(jnp.float32),
+                          img.astype(jnp.float32),
+                          col.astype(jnp.float32))
+        counts = (row.sum(-1).astype(jnp.float32)[:, None]
+                  * col.sum(-1).astype(jnp.float32)[None, :])  # (ph, pw)
+        avg = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), 0.0)
+        out = avg[cidx,
+                  jnp.arange(ph)[None, :, None],
+                  jnp.arange(pw)[None, None, :]]               # (od, ph, pw)
+        return out.astype(data.dtype)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_MultiProposal", aliases=["MultiProposal"], num_inputs=3,
+          num_outputs=_proposal_outputs,
+          params=list(_PROPOSAL_PARAMS),
+          differentiable=False,
+          doc="Batched RPN proposals (ref: src/operator/contrib/"
+              "multi_proposal.cc — upstream Proposal asserts batch 1 and "
+              "MultiProposal re-implements it per image; this Proposal is "
+              "vmapped over the batch already, so MultiProposal IS "
+              "Proposal here).")
+def _multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    return _proposal(cls_prob, bbox_pred, im_info, **kwargs)
 
 
 # ---------------------------------------------------------------------------

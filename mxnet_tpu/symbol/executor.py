@@ -43,6 +43,13 @@ class Executor:
         self._fns = {}
         self._vjp = None
         self._fwd_values = None
+        self._monitor = None
+
+    def install_monitor(self, monitor):
+        """ref: Executor SetMonitorCallback via python/mxnet/monitor.py
+        Monitor.install — here monitored intermediates come back as extra
+        program outputs instead of engine callbacks."""
+        self._monitor = monitor
 
     @property
     def arg_arrays(self):
@@ -69,7 +76,11 @@ class Executor:
                 else nd.array(v)._data)
         values = {k: v._data for k, v in self.arg_dict.items()}
         values.update({k: v._data for k, v in self.aux_dict.items()})
-        run = self._symbol._make_eval_fn(training=is_train)
+        capture_re = (self._monitor._pattern_re
+                      if self._monitor is not None
+                      and self._monitor.activated else None)
+        run = self._symbol._make_eval_fn(training=is_train,
+                                         capture_re=capture_re)
 
         grad_names = [n for n in self._symbol.list_arguments()
                       if self._grad_req.get(n, "null") != "null"]
@@ -87,6 +98,9 @@ class Executor:
             outs, aux_updates = run(values)
             self._vjp = None
         for name, val in aux_updates.items():
+            if name.startswith("__monitor__:"):
+                self._monitor._collect(name[len("__monitor__:"):], val)
+                continue
             if name in self.aux_dict:
                 self.aux_dict[name]._rebind(val)
         self.outputs = [nd.NDArray(o, ctx=self._ctx, _skip_device_put=True)

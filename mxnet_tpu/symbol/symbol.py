@@ -262,8 +262,11 @@ class Symbol:
             return list(self._node.inputs)
         return [self]
 
-    def _make_eval_fn(self, training=False):
-        """Compile the DAG into fn(var_dict) -> (outputs, aux_updates)."""
+    def _make_eval_fn(self, training=False, capture_re=None):
+        """Compile the DAG into fn(var_dict) -> (outputs, aux_updates).
+        ``capture_re``: compiled regex — matching op outputs (named
+        '<node>_output' like the reference Monitor) are added to
+        aux_updates under '__monitor__:' keys."""
         out_syms = self._output_symbols()
 
         def run(values):
@@ -311,6 +314,17 @@ class Symbol:
                                 aux_updates[s._node.name] = \
                                     mom * old + (1 - mom) * stat
                 cache[id(node)] = res
+                if capture_re is not None and node.op is not None and \
+                        node.op != "_group":
+                    # monitored intermediates ride back as EXTRA outputs
+                    # (reserved-prefix aux entries) — the jit-friendly way
+                    # to observe inside a compiled program; the reference's
+                    # Monitor instead hooks the engine's NDArray callbacks
+                    # (ref: python/mxnet/monitor.py install -> MXExecutor
+                    # SetMonitorCallback)
+                    mon_name = f"{node.name}_output"
+                    if capture_re.match(mon_name):
+                        aux_updates[f"__monitor__:{mon_name}"] = res[0]
                 return res
             outs = [compute(s._node)[s._index] for s in out_syms]
             return outs, aux_updates

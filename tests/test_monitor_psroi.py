@@ -1,0 +1,158 @@
+"""Round-5 parity tail: mx.monitor.Monitor (VERDICT r4 Missing #3 — the
+fit(monitor=) kwarg must DO something) and contrib PSROIPooling /
+MultiProposal (Missing #4)."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, nd
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.base import MXNetError
+
+
+# -- PSROIPooling (ref: src/operator/contrib/psroi_pooling.cc) -------------
+
+def _psroi_numpy(data, rois, spatial_scale, output_dim, pooled_size,
+                 group_size=0):
+    """Direct transcription of the reference kernel's loop."""
+    gs = group_size or pooled_size
+    n_rois = rois.shape[0]
+    _, c, h, w = data.shape
+    out = np.zeros((n_rois, output_dim, pooled_size, pooled_size),
+                   np.float32)
+
+    def c_round(v):        # C round(): half away from zero (not banker's)
+        return np.sign(v) * np.floor(np.abs(v) + 0.5)
+
+    for r in range(n_rois):
+        b = int(rois[r, 0])
+        x1 = c_round(rois[r, 1]) * spatial_scale
+        y1 = c_round(rois[r, 2]) * spatial_scale
+        x2 = c_round(rois[r, 3] + 1.0) * spatial_scale
+        y2 = c_round(rois[r, 4] + 1.0) * spatial_scale
+        bh = max(y2 - y1, 0.1) / pooled_size
+        bw = max(x2 - x1, 0.1) / pooled_size
+        for d in range(output_dim):
+            for i in range(pooled_size):
+                for j in range(pooled_size):
+                    hstart = int(np.clip(np.floor(y1 + i * bh), 0, h))
+                    hend = int(np.clip(np.ceil(y1 + (i + 1) * bh), 0, h))
+                    wstart = int(np.clip(np.floor(x1 + j * bw), 0, w))
+                    wend = int(np.clip(np.ceil(x1 + (j + 1) * bw), 0, w))
+                    gh = min(int(i * gs / pooled_size), gs - 1)
+                    gw = min(int(j * gs / pooled_size), gs - 1)
+                    cin = (d * gs + gh) * gs + gw
+                    patch = data[b, cin, hstart:hend, wstart:wend]
+                    out[r, d, i, j] = patch.mean() if patch.size else 0.0
+    return out
+
+
+def test_psroi_pooling_matches_reference_loop():
+    rng = np.random.RandomState(0)
+    od, gs = 3, 2
+    data = rng.randn(2, od * gs * gs, 10, 12).astype(np.float32)
+    rois = np.array([[0, 2, 2, 18, 20],
+                     [1, 0, 0, 23, 19],
+                     [0, 8, 4, 12, 9],
+                     [1, 0.5, 1.5, 18.5, 17.5]], np.float32)  # .5 corners:
+    # pins C-style half-away-from-zero rounding (banker's would shift bins)
+    got = mx.nd.contrib.PSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=0.5,
+        output_dim=od, pooled_size=2, group_size=gs).asnumpy()
+    want = _psroi_numpy(data, rois, 0.5, od, 2, gs)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_psroi_pooling_grad_and_validation():
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(1)
+    data = nd.array(rng.randn(1, 4 * 49, 14, 14).astype(np.float32))
+    rois = nd.array(np.array([[0, 0, 0, 27, 27]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.PSROIPooling(data, rois, spatial_scale=0.5,
+                                         output_dim=4, pooled_size=7)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (1, 4, 7, 7)
+    g = data.grad.asnumpy()
+    assert np.abs(g).sum() > 0          # gradient reaches the features
+    with pytest.raises(MXNetError, match="channels"):
+        mx.nd.contrib.PSROIPooling(data, rois, spatial_scale=0.5,
+                                   output_dim=5, pooled_size=7)
+
+
+def test_multi_proposal_is_batched_proposal():
+    rng = np.random.RandomState(2)
+    n, a, h, w = 2, 12, 6, 8
+    cls = nd.array(rng.rand(n, 2 * a, h, w).astype(np.float32))
+    bbox = nd.array(rng.randn(n, 4 * a, h, w).astype(np.float32) * 0.1)
+    info = nd.array(np.array([[96, 128, 1.0], [96, 128, 1.0]], np.float32))
+    kw = dict(rpn_pre_nms_top_n=200, rpn_post_nms_top_n=30,
+              feature_stride=16)
+    multi = mx.nd.contrib.MultiProposal(cls, bbox, info, **kw).asnumpy()
+    single = mx.nd.contrib.Proposal(cls, bbox, info, **kw).asnumpy()
+    np.testing.assert_allclose(multi, single)
+    assert multi.shape == (2 * 30, 5)
+
+
+# -- mx.monitor.Monitor -----------------------------------------------------
+
+def _mlp_module():
+    x = sym.var("data")
+    fc1 = sym.FullyConnected(x, num_hidden=8, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    out = sym.SoftmaxOutput(fc2, name="softmax")
+    return mx.mod.Module(out, data_names=["data"],
+                         label_names=["softmax_label"])
+
+
+def test_monitor_collects_matched_intermediates():
+    mod = _mlp_module()
+    batch = io.DataBatch(data=[nd.array(np.random.rand(4, 6))],
+                         label=[nd.array(np.array([0, 1, 2, 3]))])
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mon = mx.monitor.Monitor(interval=1, pattern=".*fc.*", sort=True)
+    mod.install_monitor(mon)
+
+    mon.tic()
+    mod.forward(batch, is_train=True)
+    stats = mon.toc()
+    names = [name for _, name, _ in stats]
+    assert "fc1_output" in names and "fc2_output" in names, names
+    assert all("relu" not in n for n in names)       # pattern filtered
+    for _, name, stat in stats:
+        v = float(np.asarray(stat.asnumpy()))
+        assert np.isfinite(v) and v >= 0
+
+    # interval gating: step 2 (not on interval=2 boundary) collects nothing
+    mon2 = mx.monitor.Monitor(interval=2, pattern=".*")
+    mod.install_monitor(mon2)
+    mon2.tic()                                       # step 0: active
+    mod.forward(batch, is_train=True)
+    assert len(mon2.toc()) > 0
+    mon2.tic()                                       # step 1: inactive
+    mod.forward(batch, is_train=True)
+    assert mon2.toc() == []
+
+
+def test_monitor_through_fit_and_monitor_all(caplog):
+    mod = _mlp_module()
+    data = np.random.rand(8, 6).astype(np.float32)
+    label = np.array([0, 1, 2, 3] * 2, np.float32)
+    it = io.NDArrayIter(data, label, batch_size=4,
+                        label_name="softmax_label")
+    mon = mx.monitor.Monitor(interval=1, pattern=".*fc.*",
+                             monitor_all=True)
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu.monitor"):
+        mod.fit(it, num_epoch=1, monitor=mon,
+                optimizer_params={"learning_rate": 0.01})
+    msgs = [r.message for r in caplog.records if "Batch:" in r.message]
+    assert any("fc1_output" in m for m in msgs), msgs[:5]
+    # monitor_all adds parameters too
+    assert any("fc1_weight" in m for m in msgs), msgs[:5]
