@@ -294,11 +294,15 @@ def _identity(node, ins, attrs, ctx):
     return ins[0]
 
 
+# ONNX TensorProto.DataType code -> dtype name (one table for the Cast
+# importer and the fold path)
+_ONNX_DT = {1: "float32", 6: "int32", 7: "int64", 9: "bool",
+            10: "float16", 11: "float64", 16: "bfloat16"}
+
+
 @onnx2mx("Cast")
 def _cast(node, ins, attrs, ctx):
-    _DT = {1: "float32", 6: "int32", 7: "int64", 9: "bool",
-           10: "float16", 11: "float64", 16: "bfloat16"}
-    to = _DT.get(int(attrs.get("to", 1)))
+    to = _ONNX_DT.get(int(attrs.get("to", 1)))
     if to is None:
         raise MXNetError(f"ONNX import: Cast to {attrs.get('to')} "
                          f"unsupported")
@@ -435,6 +439,139 @@ def _constant(node, ins, attrs, ctx):
     return _sym_mod().var(name)
 
 
+@onnx2mx("Pow")
+def _pow(node, ins, attrs, ctx):
+    return _sym_mod().broadcast_power(ins[0], ins[1],
+                                      name=node.get("name") or None)
+
+
+@onnx2mx("Equal")
+def _equal(node, ins, attrs, ctx):
+    return _sym_mod().broadcast_equal(ins[0], ins[1],
+                                      name=node.get("name") or None)
+
+
+@onnx2mx("Where")
+def _where(node, ins, attrs, ctx):
+    # ops/tensor.py `where` is jnp.where underneath: 3-operand numpy
+    # broadcasting, inf/NaN-safe in the unselected branch (an arithmetic
+    # decomposition like c*a+(1-c)*b would turn 0*inf into NaN — the
+    # standard ConstantOfShape(-inf) mask pattern)
+    return _sym_mod().where(ins[0], ins[1], ins[2],
+                            name=node.get("name") or None)
+
+
+@onnx2mx("ConstantOfShape")
+def _constant_of_shape(node, ins, attrs, ctx):
+    # a constant shape input is folded before this importer runs (see
+    # _FOLDABLE); reaching here means the shape is runtime-computed,
+    # which a static-shape XLA graph cannot express
+    raise MXNetError(
+        "ONNX import: ConstantOfShape with a non-constant shape input "
+        f"(node {node.get('name')!r}) — dynamic output shapes are not "
+        "representable; re-export with do_constant_folding=True")
+
+
+@onnx2mx("Expand")
+def _expand(node, ins, attrs, ctx):
+    shape = tuple(int(v) for v in
+                  np.asarray(ctx.const_value(node["inputs"][1])).ravel())
+    # ONNX Expand = RIGHT-aligned numpy broadcasting (rank may differ in
+    # either direction, target dims of 1 keep the input dim). Multiply by
+    # a ones-constant of the target shape — jnp's broadcasting rules do
+    # the alignment exactly; float32 ones promote integer inputs, an
+    # accepted divergence (integer Expands are shape plumbing and fold).
+    ones_name = node["outputs"][0] + "__expand_ones"
+    ctx.params[ones_name] = np.ones(shape, np.float32)
+    from ...symbol import var
+    ctx.tensors[ones_name] = var(ones_name)
+    return _sym_mod().broadcast_mul(ins[0], ctx.tensors[ones_name],
+                                    name=node.get("name") or None)
+
+
+# ---------------------------------------------------------------------------
+# constant folding: torch exports compute shape/mask helpers with chains of
+# small ops over Constant nodes (expand lowers to Where(Equal(size, -1),
+# onnx_shape, size) etc.). When EVERY input of a node is a known constant,
+# evaluate it with numpy at import time — the graph the executor sees is
+# what do_constant_folding=True would have produced.
+# ---------------------------------------------------------------------------
+
+def _fold_numpy(op, vals, attrs):
+    """Returns the folded numpy value, or None if this op can't fold."""
+    if op == "Mul":
+        return vals[0] * vals[1]
+    if op == "Add":
+        return vals[0] + vals[1]
+    if op == "Sub":
+        return vals[0] - vals[1]
+    if op == "Div":
+        a, b = np.asarray(vals[0]), np.asarray(vals[1])
+        if np.issubdtype(a.dtype, np.integer):
+            # ONNX int Div truncates toward zero; stay in integer math
+            # (a float64 round trip loses exactness beyond 2**53)
+            return (np.sign(a) * np.sign(b)
+                    * (np.abs(a) // np.abs(b))).astype(a.dtype)
+        return a / b
+    if op == "Pow":
+        return np.power(vals[0], vals[1])
+    if op == "Sqrt":
+        return np.sqrt(vals[0])
+    if op == "Neg":
+        return -vals[0]
+    if op == "Equal":
+        return np.equal(vals[0], vals[1])
+    if op == "Where":
+        return np.where(vals[0], vals[1], vals[2])
+    if op == "ConstantOfShape":
+        fill = attrs.get("value")
+        fill = np.asarray(fill).ravel()[0] if fill is not None \
+            else np.float32(0)
+        return np.full(tuple(int(v) for v in np.ravel(vals[0])), fill)
+    if op == "Expand":
+        target = tuple(int(v) for v in np.ravel(vals[1]))
+        out_shape = np.broadcast_shapes(np.asarray(vals[0]).shape, target)
+        return np.broadcast_to(vals[0], out_shape).copy()
+    if op == "Cast":
+        name = _ONNX_DT.get(int(attrs.get("to", 1)))
+        if name is None:
+            return None
+        try:
+            dt = np.dtype(name)
+        except TypeError:                    # bfloat16 needs ml_dtypes
+            import ml_dtypes
+            dt = np.dtype(getattr(ml_dtypes, name))
+        return np.asarray(vals[0]).astype(dt)
+    if op == "Unsqueeze":
+        axes = ([int(v) for v in np.ravel(vals[1])] if len(vals) > 1
+                else [int(v) for v in attrs.get("axes", [])])
+        out = np.asarray(vals[0])
+        for ax in sorted(axes):
+            out = np.expand_dims(out, ax)
+        return out
+    if op == "Squeeze":
+        axes = ([int(v) for v in np.ravel(vals[1])] if len(vals) > 1
+                else [int(v) for v in attrs.get("axes", [])])
+        return np.squeeze(np.asarray(vals[0]),
+                          axis=tuple(axes) if axes else None)
+    if op == "Concat":
+        return np.concatenate(vals, axis=int(attrs.get("axis", 0)))
+    if op == "Gather":
+        return np.take(vals[0], np.asarray(vals[1]).astype(np.int64),
+                       axis=int(attrs.get("axis", 0)))
+    if op == "Reshape":
+        shp = [int(v) for v in np.ravel(vals[1])]
+        src = np.asarray(vals[0])
+        shp = [src.shape[i] if d == 0 else d for i, d in enumerate(shp)]
+        return src.reshape(shp)
+    return None
+
+
+_FOLDABLE = {"Mul", "Add", "Sub", "Div", "Pow", "Sqrt", "Neg", "Equal",
+             "Where", "ConstantOfShape", "Expand", "Cast", "Unsqueeze",
+             "Squeeze", "Concat", "Gather", "Reshape"}
+
+
 def import_graph(model):
     """dict-proto model -> (sym, arg_params {name: np}, aux_params)."""
     from ...symbol import Group, var
@@ -451,11 +588,22 @@ def import_graph(model):
         if vi["name"] not in ctx.tensors:
             ctx.tensors[vi["name"]] = var(vi["name"])
     for node in g["nodes"]:
-        imp = _IMPORTERS.get(node["op_type"])
+        op_type = node["op_type"]
+        in_names = [n for n in node["inputs"] if n]
+        if op_type in _FOLDABLE and \
+                all(n in ctx.params for n in in_names):
+            folded = _fold_numpy(op_type, [ctx.params[n] for n in in_names],
+                                 node.get("attrs", {}))
+            if folded is not None:
+                for nm in node["outputs"]:
+                    ctx.params[nm] = np.asarray(folded)
+                    ctx.tensors[nm] = var(nm)
+                continue
+        imp = _IMPORTERS.get(op_type)
         if imp is None:
             raise MXNetError(
                 f"ONNX import: no converter for op_type "
-                f"{node['op_type']!r} (node {node.get('name')!r}); "
+                f"{op_type!r} (node {node.get('name')!r}); "
                 f"register one with "
                 f"@mxnet_tpu.contrib.onnx.onnx2mx.onnx2mx")
         ins = [ctx.sym(n) for n in node["inputs"] if n]
