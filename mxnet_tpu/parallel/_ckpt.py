@@ -1,0 +1,172 @@
+"""Shared checkpoint file machinery for the sharded trainers
+(ShardedTrainer, PipelinedTrainer — SURVEY §5.4 lifted to GSPMD state).
+
+Layout: a ``.params``-format container (readable by ``nd.load``) with a
+JSON ``__meta__`` entry. Single-process saves write one file; multi-host
+saves write one ``.shard<rank>`` file per process holding only
+locally-owned shards (entry key ``<name>|<index>``), plus a rank-0 meta
+file, with group barriers so no reader sees a half-written set."""
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+
+CKPT_FORMAT = 1
+
+
+def barrier(tag):
+    """Group-wide sync; no-op single-process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"mxtpu_ckpt_{tag}")
+
+
+def gather_host(arr):
+    """Device array -> numpy with exact bytes; gathers non-addressable
+    shards over DCN in multi-host runs (full-file mode only)."""
+    arr = jnp.asarray(arr)
+    if arr.is_fully_addressable:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+def idx_key(idx, shape):
+    """Normalize a shard index (tuple of slices) to a stable string."""
+    parts = []
+    for sl, dim in zip(idx, shape):
+        start, stop, _ = sl.indices(dim)
+        parts.append(f"{start}:{stop}")
+    return ",".join(parts)
+
+
+def write_entries(fname, entries, meta):
+    """Write placed arrays + meta. Full mode: collective gather on all
+    processes, ONE writer (rank 0 — concurrent writes to a shared path
+    would tear the file). Per-shard mode: rank-0 meta file + one
+    ``.shard<rank>`` file per process."""
+    meta_nd = {"__meta__": nd.NDArray(np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8).copy())}
+    if not meta["per_shard"]:
+        full = dict(meta_nd)
+        for name, arr in entries.items():
+            host = gather_host(arr)        # collective: every process
+            if jax.process_index() == 0:
+                full[name] = nd.NDArray(host, _skip_device_put=True)
+        if jax.process_index() == 0:
+            nd.save(fname, full)
+        barrier("save_full")
+        return
+    if jax.process_index() == 0:
+        nd.save(fname, meta_nd)
+    shard_entries = {}
+    for name, arr in entries.items():
+        arr = jnp.asarray(arr)
+        for shard in arr.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            key = f"{name}|{idx_key(shard.index, arr.shape)}"
+            if key not in shard_entries:
+                shard_entries[key] = nd.NDArray(
+                    np.asarray(shard.data), _skip_device_put=True)
+    nd.save(f"{fname}.shard{jax.process_index()}", shard_entries)
+    barrier("save_shards")
+
+
+def read_meta(fname):
+    loaded = nd.load(fname)
+    if "__meta__" not in loaded:
+        raise MXNetError(
+            f"{fname}: not a sharded-trainer checkpoint (no __meta__ "
+            "entry); eager gluon.Trainer states use Trainer.load_states")
+    meta = json.loads(bytes(loaded["__meta__"].asnumpy()).decode())
+    if meta.get("format") != CKPT_FORMAT:
+        raise MXNetError(f"{fname}: unsupported checkpoint format "
+                         f"{meta.get('format')!r}")
+    return meta, loaded
+
+
+def needed_piece_keys(entries):
+    """The (name, idxkey) pairs THIS process's addressable shards need —
+    bounds per-shard load memory to one host's share of the checkpoint."""
+    needed = set()
+    for name, arr in entries.items():
+        arr = jnp.asarray(arr)
+        for shard in arr.addressable_shards:
+            needed.add((name, idx_key(shard.index, arr.shape)))
+    return needed
+
+
+def read_pieces(fname, n_files, needed):
+    """Collect per-shard entries from exactly the ``.shard0..N-1`` files
+    the saving run wrote (N from meta — globbing would mix in stale
+    files from an older save with a different process count)."""
+    barrier("load_shards")     # writers must be done before reading
+    pieces = {}
+    for rank in range(n_files):
+        path = f"{fname}.shard{rank}"
+        if not os.path.exists(path):
+            raise MXNetError(
+                f"per-shard checkpoint incomplete: {path} missing "
+                f"(meta says {n_files} shard files)")
+        for key, arr in nd.load(path).items():
+            name, ik = key.rsplit("|", 1)
+            if (name, ik) in needed:
+                pieces.setdefault(name, {})[ik] = arr.asnumpy()
+    return pieces
+
+
+def place_like(name, cur, loaded, pieces):
+    """Rebuild one sharded array in ``cur``'s exact layout from either the
+    full-file entries or the per-shard piece map (validating shape and
+    dtype either way)."""
+    cur = jnp.asarray(cur)
+    if pieces is None:
+        if name not in loaded:
+            raise MXNetError(f"checkpoint is missing entry {name!r}")
+        host = loaded[name].asnumpy()
+        if tuple(host.shape) != tuple(cur.shape) or \
+                jnp.dtype(host.dtype) != cur.dtype:
+            raise MXNetError(
+                f"checkpoint entry {name!r} is {host.dtype}{host.shape}, "
+                f"expected {cur.dtype}{tuple(cur.shape)} — architecture "
+                "or master_dtype mismatch")
+        return jax.device_put(host, cur.sharding)
+    per = pieces.get(name)
+    if per is None:
+        raise MXNetError(f"per-shard checkpoint is missing {name!r}")
+
+    def cb(idx):
+        piece = per.get(idx_key(idx, cur.shape))
+        if piece is None:
+            raise MXNetError(
+                f"{name!r}: no saved piece for shard {idx} — mesh or "
+                "sharding layout changed since save")
+        if jnp.dtype(piece.dtype) != cur.dtype:
+            raise MXNetError(
+                f"checkpoint piece {name!r} is {piece.dtype}, expected "
+                f"{cur.dtype} — master_dtype mismatch")
+        return piece
+    return jax.make_array_from_callback(cur.shape, cur.sharding, cb)
+
+
+def rng_meta():
+    from .. import _rng
+    data, impl = _rng.get_state()
+    return {"rng_impl": impl,
+            "rng_data": [int(v) for v in np.ravel(data)],
+            "rng_shape": list(data.shape)}
+
+
+def restore_rng(meta):
+    from .. import _rng
+    data = np.asarray(meta["rng_data"], dtype=np.uint32).reshape(
+        meta["rng_shape"])
+    _rng.set_state(data, meta["rng_impl"])

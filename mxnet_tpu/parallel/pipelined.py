@@ -284,6 +284,92 @@ class PipelinedTrainer:
             list(es2), list(bs2), list(hs2)
         return nd.NDArray(loss, _skip_device_put=True)
 
+    # -- checkpoint / resume (same file machinery + guarantees as
+    # ShardedTrainer: bit-exact, per-shard-capable; parallel/_ckpt.py) ------
+    def _ckpt_entries(self):
+        ent = {}
+        for i, p in enumerate(self._e_params):
+            ent[f"arg:embed:{i}"] = p._data[0]._data
+        for j, w in enumerate(self._b_datas):
+            ent[f"arg:body:{j}"] = w
+        for i, p in enumerate(self._h_params):
+            ent[f"arg:head:{i}"] = p._data[0]._data
+        for grp, states in (("embed", self._e_states),
+                            ("body", self._b_states),
+                            ("head", self._h_states)):
+            for i, st in enumerate(states):
+                for k, s in enumerate(st):
+                    ent[f"state:{grp}:{i}:{k}"] = s
+        return ent
+
+    def save_checkpoint(self, prefix, per_shard=None):
+        """Snapshot pipe-sharded body stacks + replicated edge params +
+        optimizer state + step + RNG into ``<prefix>.pstate``."""
+        self._require_prepared()
+        from . import _ckpt
+        if per_shard is None:
+            per_shard = jax.process_count() > 1
+        meta = {
+            "format": _ckpt.CKPT_FORMAT,
+            "kind": "pipelined",
+            "optimizer": type(self._optimizer).__name__,
+            "num_update": int(self._num_update),
+            "pipe": self._p, "virtual": self._v,
+            "per_shard": bool(per_shard),
+            "shard_files": jax.process_count(),
+        }
+        meta.update(_ckpt.rng_meta())
+        _ckpt.write_entries(f"{prefix}.pstate", self._ckpt_entries(), meta)
+
+    def load_checkpoint(self, prefix):
+        """Bit-exact resume onto a prepared trainer with the same blocks,
+        optimizer class and pipe/virtual layout."""
+        self._require_prepared()
+        from . import _ckpt
+        meta, loaded = _ckpt.read_meta(f"{prefix}.pstate")
+        if meta.get("kind") != "pipelined":
+            raise MXNetError(f"{prefix}.pstate is not a PipelinedTrainer "
+                             "checkpoint")
+        if meta["optimizer"] != type(self._optimizer).__name__:
+            raise MXNetError(
+                f"checkpoint optimizer {meta['optimizer']!r} != "
+                f"{type(self._optimizer).__name__!r}")
+        if (meta["pipe"], meta["virtual"]) != (self._p, self._v):
+            raise MXNetError(
+                f"checkpoint pipeline layout pipe={meta['pipe']} "
+                f"v={meta['virtual']} != trainer pipe={self._p} "
+                f"v={self._v}")
+        ents = self._ckpt_entries()
+        pieces = (_ckpt.read_pieces(f"{prefix}.pstate",
+                                    int(meta.get("shard_files", 1)),
+                                    _ckpt.needed_piece_keys(ents))
+                  if meta["per_shard"] else None)
+        place = lambda name: _ckpt.place_like(name, ents[name], loaded,
+                                              pieces)
+        for i, p in enumerate(self._e_params):
+            p._data[0]._rebind(place(f"arg:embed:{i}"))
+        for i, p in enumerate(self._h_params):
+            p._data[0]._rebind(place(f"arg:head:{i}"))
+        self._b_datas = [place(f"arg:body:{j}")
+                         for j in range(len(self._b_datas))]
+        self._e_states = [tuple(place(f"state:embed:{i}:{k}")
+                                for k in range(len(st)))
+                          for i, st in enumerate(self._e_states)]
+        self._b_states = [tuple(place(f"state:body:{i}:{k}")
+                                for k in range(len(st)))
+                          for i, st in enumerate(self._b_states)]
+        self._h_states = [tuple(place(f"state:head:{i}:{k}")
+                                for k in range(len(st)))
+                          for i, st in enumerate(self._h_states)]
+        self._num_update = int(meta["num_update"])
+        self._optimizer.num_update = self._num_update
+        _ckpt.restore_rng(meta)
+
+    def prepare(self, x_example):
+        """Materialize stacked/sharded state without stepping (the resume
+        entry point: prepare, then ``load_checkpoint``)."""
+        self._prepare(x_example)
+
     def unstack_to_blocks(self):
         """Write the stacked body weights back into the individual Gluon
         blocks (after training, e.g. for save_parameters/export)."""

@@ -26,7 +26,6 @@ program over a device mesh:
 """
 from __future__ import annotations
 
-import os
 import re
 
 import jax
@@ -40,6 +39,7 @@ from ..base import MXNetError
 from ..context import current_context
 from ..gluon.block import Block
 from ..ops import optimizer_op as _ops
+from . import _ckpt
 from .mesh import current_mesh
 
 __all__ = ["ShardedTrainer", "functional_apply",
@@ -654,8 +654,6 @@ class ShardedTrainer:
     # (no fp32 round trip), and the global RNG key is part of the state so
     # dropout masks continue the same stream (tests/test_sharded_checkpoint).
 
-    _CKPT_FORMAT = 1
-
     def prepare(self, *example_args):
         """Materialize sharded params + optimizer state without running a
         step (the resume entry point: prepare, then ``load_checkpoint``)."""
@@ -666,25 +664,6 @@ class ShardedTrainer:
             raise MXNetError(
                 f"ShardedTrainer.{what} needs the sharded state: call "
                 "prepare(*example_args) or run a step first")
-
-    @staticmethod
-    def _gather_host(arr):
-        """Device array -> numpy with exact bytes; gathers non-addressable
-        shards over DCN in multi-host runs (full-file mode only)."""
-        arr = jnp.asarray(arr)
-        if arr.is_fully_addressable:
-            return np.asarray(arr)
-        from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
-
-    @staticmethod
-    def _idx_key(idx, shape):
-        """Normalize a shard index (tuple of slices) to a stable string."""
-        parts = []
-        for sl, dim in zip(idx, shape):
-            start, stop, _ = sl.indices(dim)
-            parts.append(f"{start}:{stop}")
-        return ",".join(parts)
 
     def _struct_name(self, param):
         """Structural key ('features.0.weight') — instance-independent, so a
@@ -715,9 +694,8 @@ class ShardedTrainer:
         return out
 
     def _ckpt_meta(self, per_shard):
-        rng_data, rng_impl = _rng.get_state()
-        return {
-            "format": self._CKPT_FORMAT,
+        meta = {
+            "format": _ckpt.CKPT_FORMAT,
             "optimizer": type(self._optimizer).__name__,
             "num_update": int(self._num_update),
             "master_dtype": (str(self._master_dtype)
@@ -725,135 +703,24 @@ class ShardedTrainer:
             "state_arity": [len(st) for st in self._states],
             "per_shard": bool(per_shard),
             "shard_files": jax.process_count(),
-            "rng_impl": rng_impl,
-            "rng_data": [int(v) for v in np.ravel(rng_data)],
-            "rng_shape": list(rng_data.shape),
         }
+        meta.update(_ckpt.rng_meta())
+        return meta
 
-    @staticmethod
-    def _barrier(tag):
-        """Group-wide sync so no process reads a checkpoint another process
-        is still writing (and save_* doesn't return before the set of shard
-        files is complete). No-op single-process."""
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(f"mxtpu_ckpt_{tag}")
-
+    # file machinery shared with PipelinedTrainer — see parallel/_ckpt.py
     def _write_entries(self, fname, entries, meta):
-        """Write placed arrays + meta. Full mode: collective gather on all
-        processes, ONE writer (rank 0 — concurrent writes to a shared path
-        would tear the file). Per-shard mode: rank-0 meta file + one
-        ``.shard<rank>`` file per process with only locally-owned shards
-        (entry key ``<name>|<index>``)."""
-        import json as _json
-        meta_nd = {"__meta__": nd.NDArray(np.frombuffer(
-            _json.dumps(meta).encode("utf-8"), dtype=np.uint8).copy())}
-        if not meta["per_shard"]:
-            full = dict(meta_nd)
-            for name, arr in entries.items():
-                # the gather is collective — every process participates
-                # even though only rank 0 writes
-                host = self._gather_host(arr)
-                if jax.process_index() == 0:
-                    full[name] = nd.NDArray(host, _skip_device_put=True)
-            if jax.process_index() == 0:
-                nd.save(fname, full)
-            self._barrier("save_full")
-            return
-        if jax.process_index() == 0:
-            nd.save(fname, meta_nd)
-        shard_entries = {}
-        for name, arr in entries.items():
-            arr = jnp.asarray(arr)
-            for shard in arr.addressable_shards:
-                if shard.replica_id != 0:
-                    continue
-                key = f"{name}|{self._idx_key(shard.index, arr.shape)}"
-                if key not in shard_entries:
-                    shard_entries[key] = nd.NDArray(
-                        np.asarray(shard.data), _skip_device_put=True)
-        nd.save(f"{fname}.shard{jax.process_index()}", shard_entries)
-        self._barrier("save_shards")
+        _ckpt.write_entries(fname, entries, meta)
 
     def _read_meta(self, fname):
-        import json as _json
-        loaded = nd.load(fname)
-        if "__meta__" not in loaded:
-            raise MXNetError(
-                f"{fname}: not a ShardedTrainer checkpoint (no __meta__ "
-                "entry); eager gluon.Trainer states use Trainer.load_states")
-        meta = _json.loads(bytes(loaded["__meta__"].asnumpy()).decode())
-        if meta.get("format") != self._CKPT_FORMAT:
-            raise MXNetError(f"{fname}: unsupported checkpoint format "
-                             f"{meta.get('format')!r}")
-        return meta, loaded
-
-    def _needed_piece_keys(self):
-        """The (name, idxkey) pairs THIS process's addressable shards need —
-        the filter that keeps per-shard load memory at one host's share of
-        the checkpoint instead of the whole thing."""
-        needed = set()
-        for ents in (self._state_entries(), self._param_entries()):
-            for name, arr in ents.items():
-                arr = jnp.asarray(arr)
-                for shard in arr.addressable_shards:
-                    needed.add((name, self._idx_key(shard.index, arr.shape)))
-        return needed
+        return _ckpt.read_meta(fname)
 
     def _read_pieces(self, fname, n_files):
-        """Collect per-shard entries from exactly the ``.shard0..N-1`` files
-        the saving run wrote (N from the checkpoint meta — globbing would
-        silently mix in stale shard files from an older save with a
-        different process count). Shared filesystem: any piece may live in
-        any rank's file. Entries whose shards this process doesn't own are
-        dropped as each file is read, so peak host memory is bounded by
-        single-host shard-file sizes, not the global checkpoint."""
-        self._barrier("load_shards")   # writers must be done before reading
-        needed = self._needed_piece_keys()
-        pieces = {}
-        for rank in range(n_files):
-            path = f"{fname}.shard{rank}"
-            if not os.path.exists(path):
-                raise MXNetError(
-                    f"per-shard checkpoint incomplete: {path} missing "
-                    f"(meta says {n_files} shard files)")
-            for key, arr in nd.load(path).items():
-                name, idxkey = key.rsplit("|", 1)
-                if (name, idxkey) in needed:
-                    pieces.setdefault(name, {})[idxkey] = arr.asnumpy()
-        return pieces
+        needed = _ckpt.needed_piece_keys(
+            {**self._state_entries(), **self._param_entries()})
+        return _ckpt.read_pieces(fname, n_files, needed)
 
     def _place_like(self, name, cur, loaded, pieces):
-        """Rebuild one sharded array in ``cur``'s exact layout from either
-        the full-file entries or the per-shard piece map."""
-        cur = jnp.asarray(cur)
-        if pieces is None:
-            if name not in loaded:
-                raise MXNetError(f"checkpoint is missing entry {name!r}")
-            host = loaded[name].asnumpy()
-            if tuple(host.shape) != tuple(cur.shape) or \
-                    jnp.dtype(host.dtype) != cur.dtype:
-                raise MXNetError(
-                    f"checkpoint entry {name!r} is {host.dtype}{host.shape}, "
-                    f"expected {cur.dtype}{tuple(cur.shape)} — architecture "
-                    "or master_dtype mismatch")
-            return jax.device_put(host, cur.sharding)
-        per = pieces.get(name)
-        if per is None:
-            raise MXNetError(f"per-shard checkpoint is missing {name!r}")
-
-        def cb(idx):
-            piece = per.get(self._idx_key(idx, cur.shape))
-            if piece is None:
-                raise MXNetError(
-                    f"{name!r}: no saved piece for shard {idx} — mesh or "
-                    "sharding layout changed since save")
-            if jnp.dtype(piece.dtype) != cur.dtype:
-                raise MXNetError(
-                    f"checkpoint piece {name!r} is {piece.dtype}, expected "
-                    f"{cur.dtype} — master_dtype mismatch")
-            return piece
-        return jax.make_array_from_callback(cur.shape, cur.sharding, cb)
+        return _ckpt.place_like(name, cur, loaded, pieces)
 
     def save_states(self, fname, per_shard=None):
         """Checkpoint optimizer state + step count + RNG stream.
@@ -900,9 +767,7 @@ class ShardedTrainer:
         self._states = new_states
         self._num_update = int(meta["num_update"])
         self._optimizer.num_update = self._num_update
-        rng_data = np.asarray(meta["rng_data"], dtype=np.uint32).reshape(
-            meta["rng_shape"])
-        _rng.set_state(rng_data, meta["rng_impl"])
+        _ckpt.restore_rng(meta)
 
     def save_checkpoint(self, prefix, per_shard=None):
         """Full resumable snapshot: ``<prefix>.params`` (master weights +

@@ -1,0 +1,47 @@
+"""The driver-gate contract (VERDICT r4 Weak #1): bench.py must emit ONE
+parseable JSON line under every failure mode — a wedged TPU tunnel must
+never again produce an information-free rc=124."""
+import json
+import subprocess
+import sys
+
+import bench
+
+
+def test_diagnostic_shape():
+    d = bench._diagnostic("device_unreachable", "probe timed out")
+    assert d["metric"] == bench.METRIC
+    assert d["value"] is None and d["vs_baseline"] is None
+    assert d["error"] == "device_unreachable"
+    json.dumps(d)                       # serializable
+
+
+def test_probe_failure_yields_diagnostic_json(monkeypatch, capsys):
+    # make every probe attempt fail instantly (false exits 1)
+    monkeypatch.setattr(bench, "PROBE_BACKOFF_S", (0,))
+    monkeypatch.setattr(sys, "executable", "/bin/false")
+    rc = bench.main()
+    assert rc == 0                      # diagnostics exit clean for the driver
+    line = [l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")][-1]
+    parsed = json.loads(line)
+    assert parsed["error"] == "device_unreachable"
+    assert parsed["metric"] == bench.METRIC
+
+
+def test_probe_timeout_yields_diagnostic_json(monkeypatch, capsys):
+    # a probe that HANGS (sleep) must be cut off by the deadline
+    monkeypatch.setattr(bench, "PROBE_BACKOFF_S", (0,))
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT_S", 1)
+    real_run = subprocess.run
+
+    def fake_run(cmd, **kw):
+        return real_run(["/bin/sh", "-c", "sleep 30"], **kw)
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    rc = bench.main()
+    assert rc == 0
+    out = capsys.readouterr().out
+    parsed = json.loads([l for l in out.splitlines()
+                         if l.startswith("{")][-1])
+    assert parsed["error"] == "device_unreachable"
+    assert "within 1s" in parsed["detail"]   # the patched deadline value
