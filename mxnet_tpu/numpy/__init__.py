@@ -208,6 +208,12 @@ _FUNCS = [
     "ediff1d", "trapz", "convolve", "correlate", "real", "imag", "conj",
     "angle", "iscomplexobj", "isrealobj", "shape", "size", "ndim",
     "result_type", "can_cast", "promote_types", "vander", "i0", "sinc",
+    # round-5 tail: set ops, stats, selection, float-representation
+    "unwrap", "cov", "corrcoef", "union1d", "intersect1d", "setdiff1d",
+    "setxor1d", "isin", "select", "resize", "trim_zeros", "diag_indices",
+    "diag_indices_from", "ix_", "spacing", "nextafter", "fmod",
+    "logaddexp", "logaddexp2", "nancumsum", "nancumprod", "nanmedian",
+    "nanpercentile", "nanquantile",
 ]
 
 _this = sys.modules[__name__]
@@ -215,6 +221,44 @@ for _name in _FUNCS:
     if hasattr(jnp, _name) and not hasattr(_this, _name):
         setattr(_this, _name, _make(_name, getattr(jnp, _name)))
         __all__.append(_name)
+
+
+def _boxing_callback(fn):
+    """Adapt a user callback written against mx.np (NDArray in, NDArray
+    out) to the raw-jnp calling convention jnp's higher-order functions
+    use internally — the tracer must never end up inside an NDArray that
+    escapes the trace."""
+    def adapted(*arrays):
+        out = fn(*[NDArray(a, _skip_device_put=True) for a in arrays])
+        return out._data if isinstance(out, NDArray) else out
+    return adapted
+
+
+def apply_along_axis(func1d, axis, arr, *args, **kwargs):
+    """numpy.apply_along_axis over an mx.np callback (vmapped by jnp)."""
+    fn = _boxing_callback(lambda v: func1d(v, *args, **kwargs))
+    return _call(lambda a: jnp.apply_along_axis(fn, axis, a), arr)
+
+
+def apply_over_axes(func, a, axes):
+    """numpy.apply_over_axes; ``func(arr, axis)`` takes/returns mx.np."""
+    def fn(arr, axis):
+        out = func(NDArray(arr, _skip_device_put=True), axis)
+        return out._data if isinstance(out, NDArray) else out
+    return _call(lambda x: jnp.apply_over_axes(fn, x, axes), a)
+
+
+def piecewise(x, condlist, funclist):
+    """numpy.piecewise; funclist entries may be scalars or mx.np
+    callables."""
+    funclist = [f if not callable(f) else _boxing_callback(f)
+                for f in funclist]
+    return _call(lambda xs, conds: jnp.piecewise(xs, list(conds),
+                                                 funclist),
+                 x, condlist)
+
+
+__all__ += ["apply_along_axis", "apply_over_axes", "piecewise"]
 
 
 def array(obj, dtype=None, ctx=None):

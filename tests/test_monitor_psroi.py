@@ -156,3 +156,30 @@ def test_monitor_through_fit_and_monitor_all(caplog):
     assert any("fc1_output" in m for m in msgs), msgs[:5]
     # monitor_all adds parameters too
     assert any("fc1_weight" in m for m in msgs), msgs[:5]
+
+
+def test_monitor_through_sequential_module():
+    """fit(monitor=) must work on SequentialModule too (the reference
+    forwards install_monitor to every sub-module)."""
+    x = sym.var("data")
+    net1 = sym.FullyConnected(x, num_hidden=8, name="fc1")
+    mod1 = mx.mod.Module(net1, data_names=["data"], label_names=[])
+    x2 = sym.var("fc1_output")
+    net2 = sym.SoftmaxOutput(sym.FullyConnected(x2, num_hidden=4,
+                                                name="fc2"),
+                             name="softmax")
+    mod2 = mx.mod.Module(net2, data_names=["fc1_output"],
+                         label_names=["softmax_label"])
+    seq = mx.mod.SequentialModule()
+    seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    seq.init_params()
+    mon = mx.monitor.Monitor(interval=1, pattern=".*fc.*", sort=True)
+    seq.install_monitor(mon)
+    batch = io.DataBatch(data=[nd.array(np.random.rand(4, 6))],
+                         label=[nd.array(np.array([0, 1, 2, 3]))])
+    mon.tic()
+    seq.forward(batch, is_train=True)
+    names = [n for _, n, _ in mon.toc()]
+    assert "fc1_output" in names and "fc2_output" in names, names

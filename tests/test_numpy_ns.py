@@ -293,3 +293,61 @@ def test_npx_fully_connected_and_norm():
     ln = mx.npx.layer_norm(x, g, b, axis=-1)
     onp.testing.assert_allclose(ln.asnumpy().mean(axis=-1), [0, 0],
                                 atol=1e-6)
+
+
+def test_np_round5_tail():
+    """Round-5 numpy-namespace tail: set ops, stats, selection,
+    float-representation helpers (all jnp-backed, NDArray-wrapped)."""
+    np = mx.np
+    a = np.array([[1.0, 2, 3], [2, 4, 7]])
+    onp.testing.assert_allclose(np.cov(a).asnumpy(),
+                                onp.cov([[1., 2, 3], [2, 4, 7]]), rtol=1e-6)
+    onp.testing.assert_allclose(
+        np.corrcoef(a).asnumpy(), onp.corrcoef([[1., 2, 3], [2, 4, 7]]),
+        rtol=1e-6)
+    assert sorted(np.union1d(np.array([1, 2, 3]),
+                             np.array([2, 5])).asnumpy().tolist()) == \
+        [1, 2, 3, 5]
+    assert np.setdiff1d(np.array([1, 2, 3]),
+                        np.array([2])).asnumpy().tolist() == [1, 3]
+    assert np.isin(np.array([1, 2, 4]),
+                   np.array([2, 4])).asnumpy().tolist() == \
+        [False, True, True]
+    out = np.select([np.array([True, False]), np.array([False, True])],
+                    [np.array([1, 1]), np.array([2, 2])])
+    assert out.asnumpy().tolist() == [1, 2]
+    onp.testing.assert_allclose(
+        np.unwrap(np.array([0.0, 3.2, 6.3])).asnumpy(),
+        onp.unwrap([0.0, 3.2, 6.3]), rtol=1e-6)
+    assert float(np.fmod(np.array([5.0]), np.array([3.0]))
+                 .asnumpy()[0]) == 2.0
+    assert float(np.nanmedian(np.array([1.0, float("nan"), 3.0]))
+                 .asnumpy()) == 2.0
+    assert float(np.logaddexp(np.array([0.0]),
+                              np.array([0.0])).asnumpy()[0]) == \
+        pytest.approx(onp.logaddexp(0.0, 0.0))
+    # gradients flow through the wrapped functions (tape-aware)
+    x = mx.np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = np.logaddexp(x, x).sum()
+    y.backward()
+    assert x.grad.asnumpy().shape == (3,)
+
+
+def test_np_callback_functions_compose_with_mx_np():
+    """apply_along_axis/apply_over_axes/piecewise accept callbacks written
+    against mx.np itself (boxed in, unboxed out — a raw wrapper would leak
+    vmap tracers into NDArrays)."""
+    np = mx.np
+    a = np.array([[1.0, 2, 3], [4, 5, 6]])
+    out = np.apply_along_axis(lambda v: np.sum(v), 1, a)
+    assert out.asnumpy().tolist() == [6.0, 15.0]
+    out2 = np.apply_over_axes(lambda arr, ax: np.sum(arr, axis=ax,
+                                                     keepdims=True),
+                              a, [0])
+    assert out2.asnumpy().ravel().tolist() == [5.0, 7.0, 9.0]
+    x = np.array([-2.0, -1.0, 1.0, 2.0])
+    out3 = np.piecewise(x, [x < 0, x >= 0],
+                        [lambda v: -v, lambda v: np.multiply(v, 10.0)])
+    assert out3.asnumpy().tolist() == [2.0, 1.0, 10.0, 20.0]
