@@ -43,7 +43,18 @@ def main():
     p.add_argument("--no-bf16", dest="bf16", action="store_false")
     p.add_argument("--model-parallel", type=int, default=1,
                    help="tensor-parallel mesh axis size")
+    p.add_argument("--checkpoint", default=None,
+                   help="prefix for periodic ShardedTrainer checkpoints "
+                        "(bit-exact resume incl. optimizer state + RNG)")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="epochs between checkpoints (>= 1)")
+    p.add_argument("--resume", action="store_true",
+                   help="load <prefix>.params/.states before training "
+                        "(keep --steps-per-epoch identical to the saved "
+                        "run: the resume epoch derives from it)")
     args = p.parse_args()
+    if args.checkpoint and args.checkpoint_every < 1:
+        p.error("--checkpoint-every must be >= 1")
 
     import jax
     shape = tuple(int(s) for s in args.image_shape.split(","))
@@ -80,7 +91,19 @@ def main():
         x = rng.randn(args.batch_size, *shape).astype(np.float32)
         y = rng.randint(0, args.num_classes, (args.batch_size,))
 
-    for epoch in range(args.epochs):
+    start_epoch = 0
+    if args.resume:
+        if not args.checkpoint:
+            p.error("--resume needs --checkpoint <prefix>")
+        example = (x if data is None else
+                   np.zeros((args.batch_size,) + shape, np.float32))
+        trainer.prepare(example)
+        trainer.load_checkpoint(args.checkpoint)
+        start_epoch = trainer.num_update // args.steps_per_epoch
+        logging.info("resumed from %s at update %d (epoch %d)",
+                     args.checkpoint, trainer.num_update, start_epoch)
+
+    for epoch in range(start_epoch, args.epochs):
         tic = time.time()
         seen = 0
         if data is not None:
@@ -103,6 +126,10 @@ def main():
         logging.info("Epoch[%d] final loss=%.4f", epoch, loss.asscalar())
         logging.info("Epoch[%d] Speed: %.2f samples/sec (%d chips)",
                      epoch, seen / dt, n_dev)
+        if args.checkpoint and (epoch + 1) % args.checkpoint_every == 0:
+            trainer.save_checkpoint(args.checkpoint)
+            logging.info("checkpointed to %s.{params,states}",
+                         args.checkpoint)
 
 
 if __name__ == "__main__":

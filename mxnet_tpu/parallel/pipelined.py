@@ -178,41 +178,53 @@ class PipelinedTrainer:
         self._prepared = True
 
     # -- the compiled pp × dp step -------------------------------------------
-    def _build_step(self):
+    def _make_forward(self, training):
+        """ONE pipeline-forward closure shared by step() and evaluate() —
+        the schedule, key folding and sharding must never drift between
+        the trained model and the evaluated one."""
         embed_blk, body_blk, head_blk = self._embed, self._body[0], self._head
-        loss_block, opt = self._loss, self._optimizer
         mesh, pipe, data = self._mesh, self._pipe_axis, self._data_axis
         m, v = self._m, self._v
+
+        def forward(e_tr, b_tr, h_tr, key, xb):
+            def embed_fn(ep, mb):
+                outs, _, _ = functional_apply(
+                    embed_blk, jax.random.fold_in(key, 1), ep, [], [mb],
+                    training=training)
+                return outs[0]
+
+            def stage_fn(pl, hact):
+                outs, _, _ = functional_apply(
+                    body_blk, jax.random.fold_in(key, 2), pl, [], [hact],
+                    training=training)
+                return outs[0]
+
+            def head_fn(hp, hs):
+                outs, _, _ = functional_apply(
+                    head_blk, jax.random.fold_in(key, 3), hp, [], [hs],
+                    training=training)
+                return outs[0]
+
+            return pipeline_apply(
+                stage_fn, list(b_tr), xb, mesh=mesh, axis_name=pipe,
+                num_microbatches=m, num_virtual_stages=v,
+                embed_fn=embed_fn, embed_params=list(e_tr),
+                head_fn=head_fn, head_params=list(h_tr),
+                data_axis=(data if data in mesh.axis_names else None),
+                params_are_split=True)
+        return forward
+
+    def _build_step(self):
+        loss_block, opt = self._loss, self._optimizer
         clip = opt.clip_gradient if opt.clip_gradient is not None else -1.0
         wd = opt.wd
+        fwd = self._make_forward(training=True)
 
         def step(e_tr, b_tr, h_tr, e_st, b_st, h_st, key, lr, t, rescale,
                  x, y):
             def loss_of(groups):
                 e_tr_, b_tr_, h_tr_ = groups
-
-                def embed_fn(ep, mb):
-                    outs, _, _ = functional_apply(
-                        embed_blk, jax.random.fold_in(key, 1), ep, [], [mb])
-                    return outs[0]
-
-                def stage_fn(pl, hact):
-                    outs, _, _ = functional_apply(
-                        body_blk, jax.random.fold_in(key, 2), pl, [], [hact])
-                    return outs[0]
-
-                def head_fn(hp, hs):
-                    outs, _, _ = functional_apply(
-                        head_blk, jax.random.fold_in(key, 3), hp, [], [hs])
-                    return outs[0]
-
-                out = pipeline_apply(
-                    stage_fn, list(b_tr_), x, mesh=mesh, axis_name=pipe,
-                    num_microbatches=m, num_virtual_stages=v,
-                    embed_fn=embed_fn, embed_params=list(e_tr_),
-                    head_fn=head_fn, head_params=list(h_tr_),
-                    data_axis=(data if data in mesh.axis_names else None),
-                    params_are_split=True)
+                out = fwd(e_tr_, b_tr_, h_tr_, key, x)
                 out_nd = nd.NDArray(out.astype(jnp.float32),
                                     _skip_device_put=True)
                 y_nd = nd.NDArray(y, _skip_device_put=True)
@@ -237,7 +249,7 @@ class PipelinedTrainer:
             h2, hs2 = upd(h_tr, grads[2], h_st)
             return e2, b2, h2, es2, bs2, hs2, loss_val
 
-        ns = lambda spec: NamedSharding(mesh, spec)
+        ns = lambda spec: NamedSharding(self._mesh, spec)
         rep = ns(PartitionSpec())
         bsp = self._b_spec
         st_sh = lambda sts, sh: [tuple(sh if getattr(e, "ndim", 0) else rep
@@ -282,6 +294,42 @@ class PipelinedTrainer:
         self._b_datas = list(b2)
         self._e_states, self._b_states, self._h_states = \
             list(es2), list(bs2), list(hs2)
+        return nd.NDArray(loss, _skip_device_put=True)
+
+    def evaluate(self, x, y):
+        """Forward + loss through the pipeline, no update (ShardedTrainer
+        .evaluate parity). Runs the SAME schedule as step() in inference
+        mode (dropout off) under a FIXED key — evaluation is RNG-neutral:
+        it never advances the global stream, so interleaving eval with
+        training cannot change the training trajectory."""
+        self._prepare(x)
+        if self._m is None:
+            self._m = self._p
+        if getattr(self, "_eval_fn", None) is None:
+            loss_block = self._loss
+            fwd = self._make_forward(training=False)
+
+            def eval_step(e_tr, b_tr, h_tr, key, xb, yb):
+                out = fwd(e_tr, b_tr, h_tr, key, xb)
+                out_nd = nd.NDArray(out.astype(jnp.float32),
+                                    _skip_device_put=True)
+                y_nd = nd.NDArray(yb, _skip_device_put=True)
+                with autograd.pause(train_mode=False):
+                    loss_nd = loss_block(out_nd, y_nd)
+                return jnp.mean(loss_nd._data.astype(jnp.float32))
+
+            self._eval_fn = jax.jit(eval_step)
+        xd = x._data if isinstance(x, nd.NDArray) else jnp.asarray(x)
+        yd = y._data if isinstance(y, nd.NDArray) else jnp.asarray(y)
+        # params are mesh-committed; the batch must live on the same
+        # device set or the unsharded jit refuses the mix
+        rep = NamedSharding(self._mesh, PartitionSpec())
+        xd, yd = jax.device_put(xd, rep), jax.device_put(yd, rep)
+        e_tr = [p._data[0]._data for p in self._e_params]
+        h_tr = [p._data[0]._data for p in self._h_params]
+        with use_mesh(self._mesh):
+            loss = self._eval_fn(e_tr, self._b_datas, h_tr,
+                                 jax.random.PRNGKey(0), xd, yd)
         return nd.NDArray(loss, _skip_device_put=True)
 
     # -- checkpoint / resume (same file machinery + guarantees as
@@ -384,6 +432,11 @@ class PipelinedTrainer:
     def _require_prepared(self):
         if not self._prepared:
             raise MXNetError("PipelinedTrainer: run a step first")
+
+    @property
+    def num_update(self):
+        """Completed optimizer updates (restored by load_checkpoint)."""
+        return self._num_update
 
     @property
     def learning_rate(self):

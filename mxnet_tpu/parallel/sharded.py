@@ -802,6 +802,12 @@ class ShardedTrainer:
 
     # -- parity helpers ------------------------------------------------------
     @property
+    def num_update(self):
+        """Completed optimizer updates (restored by load_checkpoint) —
+        the public step counter resume logic should read."""
+        return self._num_update
+
+    @property
     def learning_rate(self):
         return self._optimizer.learning_rate
 

@@ -189,3 +189,29 @@ def test_pipelined_checkpoint_resume_bitwise(tmp_path):
         "sgd", learning_rate=0.1)
     with pytest.raises(MXNetError, match="optimizer"):
         tr_d.load_checkpoint(prefix)
+
+
+def test_pipelined_evaluate_matches_sequential_forward():
+    emb, body, head = _build(seed=13)
+    mesh = parallel.make_mesh({"pipe": 2, "data": 4})
+    tr = parallel.PipelinedTrainer(
+        emb, body, head, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh, num_microbatches=4,
+        num_virtual_stages=2)
+    x, y = _batches(1, seed=6)[0]
+    # sequential eager reference FIRST — prepare() re-commits the block
+    # params onto the mesh, after which eager forwards can't run
+    h = emb(mx.nd.array(x))
+    for blk in body:
+        h = blk(h)
+    logits = head(h)
+    ref = float(gluon.loss.SoftmaxCrossEntropyLoss()(
+        logits, mx.nd.array(y)).mean().asscalar())
+    ev = float(tr.evaluate(x, y).asscalar())
+    assert abs(ev - ref) < 1e-4, (ev, ref)
+    # evaluate must not advance the step counter or weights
+    before = [np.asarray(w).copy() for w in tr._b_datas]
+    tr.evaluate(x, y)
+    assert tr._num_update == 0
+    for a, b in zip(before, tr._b_datas):
+        assert np.array_equal(a, np.asarray(b))
