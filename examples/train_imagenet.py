@@ -91,6 +91,7 @@ def main():
         x = rng.randn(args.batch_size, *shape).astype(np.float32)
         y = rng.randint(0, args.num_classes, (args.batch_size,))
 
+    import json
     start_epoch = 0
     if args.resume:
         if not args.checkpoint:
@@ -99,9 +100,23 @@ def main():
                    np.zeros((args.batch_size,) + shape, np.float32))
         trainer.prepare(example)
         trainer.load_checkpoint(args.checkpoint)
-        start_epoch = trainer.num_update // args.steps_per_epoch
+        # epoch count comes from the progress sidecar, NOT from
+        # num_update // steps_per_epoch: a real-data epoch can end early
+        # (iterator exhaustion), which would under-count completed epochs
+        try:
+            with open(args.checkpoint + ".progress") as f:
+                start_epoch = json.load(f)["epoch"]
+        except FileNotFoundError:
+            start_epoch = trainer.num_update // args.steps_per_epoch
         logging.info("resumed from %s at update %d (epoch %d)",
                      args.checkpoint, trainer.num_update, start_epoch)
+
+    def save(epoch):
+        trainer.save_checkpoint(args.checkpoint)
+        with open(args.checkpoint + ".progress", "w") as f:
+            json.dump({"epoch": epoch + 1}, f)
+        logging.info("checkpointed to %s.{params,states} (epoch %d done)",
+                     args.checkpoint, epoch)
 
     for epoch in range(start_epoch, args.epochs):
         tic = time.time()
@@ -126,10 +141,9 @@ def main():
         logging.info("Epoch[%d] final loss=%.4f", epoch, loss.asscalar())
         logging.info("Epoch[%d] Speed: %.2f samples/sec (%d chips)",
                      epoch, seen / dt, n_dev)
-        if args.checkpoint and (epoch + 1) % args.checkpoint_every == 0:
-            trainer.save_checkpoint(args.checkpoint)
-            logging.info("checkpointed to %s.{params,states}",
-                         args.checkpoint)
+        if args.checkpoint and ((epoch + 1) % args.checkpoint_every == 0
+                                or epoch + 1 == args.epochs):
+            save(epoch)   # always checkpoint the final epoch too
 
 
 if __name__ == "__main__":

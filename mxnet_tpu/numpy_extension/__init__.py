@@ -181,3 +181,83 @@ def waitall():
 __all__ += ["batch_dot", "gather_nd", "reshape_like", "broadcast_like",
             "arange_like", "sequence_mask", "smooth_l1", "slice",
             "slice_like", "waitall"]
+
+
+# round-5 tail: the remaining commonly-scripted `_npx_*` entry points —
+# same thin-adapter idiom (registry ops carry numerics + autograd)
+def activation(data, act_type="relu"):
+    return nd.Activation(data, act_type=act_type)
+
+
+def cast(data, dtype):
+    return nd.cast(data, dtype=dtype)
+
+
+def erf(data):
+    return nd.erf(data)
+
+
+def erfinv(data):
+    return nd.erfinv(data)
+
+
+def gamma(data):
+    return nd.gamma(data)
+
+
+def gammaln(data):
+    return nd.gammaln(data)
+
+
+def deconvolution(data, weight, bias=None, **kwargs):
+    args = [data, weight] + ([bias] if bias is not None else [])
+    # the op's registered default is no_bias=True — an explicit bias must
+    # flip it or it would be silently ignored
+    kwargs.setdefault("no_bias", bias is None)
+    return nd.Deconvolution(*args, **kwargs)
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, **kwargs):
+    args = [data, label]
+    if data_lengths is not None:
+        args.append(data_lengths)
+        kwargs.setdefault("use_data_lengths", True)
+    if label_lengths is not None:
+        args.append(label_lengths)
+        kwargs.setdefault("use_label_lengths", True)
+    return nd.CTCLoss(*args, **kwargs)
+
+
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    return nd.GroupNorm(data, gamma, beta, num_groups=num_groups, eps=eps)
+
+
+def instance_norm(data, gamma, beta, eps=1e-3):
+    # default eps matches the op's (and the reference's) 1e-3
+    return nd.InstanceNorm(data, gamma, beta, eps=eps)
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    return nd.contrib.box_nms(
+        data, overlap_thresh=overlap_thresh, valid_thresh=valid_thresh,
+        topk=topk, coord_start=coord_start, score_index=score_index,
+        id_index=id_index, force_suppress=force_suppress,
+        in_format=in_format, out_format=out_format)
+
+
+def rnn(data, parameters, state, state_cell=None, sequence_length=None,
+        mode="lstm", state_size=None, num_layers=1, **kwargs):
+    args = [data, parameters, state] + \
+        ([state_cell] if state_cell is not None else [])
+    if sequence_length is not None:
+        args.append(sequence_length)
+        kwargs.setdefault("use_sequence_length", True)
+    return nd.RNN(*args, mode=mode, state_size=state_size,
+                  num_layers=num_layers, **kwargs)
+
+
+__all__ += ["activation", "cast", "erf", "erfinv", "gamma", "gammaln",
+            "deconvolution", "ctc_loss", "group_norm", "instance_norm",
+            "box_nms", "rnn"]

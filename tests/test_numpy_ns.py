@@ -351,3 +351,59 @@ def test_np_callback_functions_compose_with_mx_np():
     out3 = np.piecewise(x, [x < 0, x >= 0],
                         [lambda v: -v, lambda v: np.multiply(v, 10.0)])
     assert out3.asnumpy().tolist() == [2.0, 1.0, 10.0, 20.0]
+
+
+def test_npx_round5_tail():
+    """npx thin-adapter tail: activation/cast/erf/deconv/norms/nms/rnn."""
+    npx, nd = mx.npx, mx.nd
+    x = nd.array(onp.random.RandomState(0).randn(2, 3, 8, 8)
+                 .astype(onp.float32))
+    assert npx.activation(x, "relu").shape == x.shape
+    assert npx.cast(x, "float16").dtype == onp.float16
+    assert float(npx.erf(nd.array([0.0])).asnumpy()[0]) == 0.0
+    assert abs(float(npx.erfinv(npx.erf(nd.array([0.5])))
+                     .asnumpy()[0]) - 0.5) < 1e-5
+    g = nd.array(onp.ones(3, onp.float32))
+    b = nd.array(onp.zeros(3, onp.float32))
+    gn = npx.group_norm(x, g, b, num_groups=3)
+    assert gn.shape == x.shape
+    assert abs(float(gn.asnumpy().mean())) < 1e-5     # normalized
+    assert npx.instance_norm(x, g, b).shape == x.shape
+    w = nd.array(onp.random.RandomState(1).randn(3, 2, 3, 3)
+                 .astype(onp.float32) * 0.1)
+    y = npx.deconvolution(x, w, kernel=(3, 3), num_filter=2)
+    assert y.shape[1] == 2
+    boxes = nd.array(onp.array(
+        [[[0, 0.9, 0, 0, 10, 10], [1, 0.8, 1, 1, 11, 11]]], onp.float32))
+    out = npx.box_nms(boxes, overlap_thresh=0.5)
+    assert out.shape == boxes.shape
+
+
+def test_npx_deconv_bias_and_varlen_rnn():
+    """Review-pinned adapter contracts: an explicit deconv bias must be
+    APPLIED (the op default is no_bias=True), and npx.rnn reaches the
+    variable-length path."""
+    npx, nd = mx.npx, mx.nd
+    x = nd.array(onp.random.RandomState(0).randn(1, 3, 5, 5)
+                 .astype(onp.float32))
+    w = nd.array(onp.random.RandomState(1).randn(3, 2, 3, 3)
+                 .astype(onp.float32) * 0.1)
+    b = nd.array(onp.array([10.0, -10.0], onp.float32))
+    y0 = npx.deconvolution(x, w, kernel=(3, 3), num_filter=2)
+    yb = npx.deconvolution(x, w, b, kernel=(3, 3), num_filter=2)
+    diff = (yb - y0).asnumpy()
+    onp.testing.assert_allclose(diff[0, 0], 10.0, rtol=1e-5)
+    onp.testing.assert_allclose(diff[0, 1], -10.0, rtol=1e-5)
+
+    T, B, I, H = 4, 2, 3, 5
+    data = nd.array(onp.random.RandomState(2).randn(T, B, I)
+                    .astype(onp.float32))
+    n_params = 4 * H * (I + H + 2)
+    params = nd.array(onp.random.RandomState(3).randn(n_params)
+                      .astype(onp.float32) * 0.1)
+    state = nd.zeros((1, B, H))
+    cell = nd.zeros((1, B, H))
+    seq_len = nd.array(onp.array([2, 4], onp.int32))
+    out = npx.rnn(data, params, state, cell, sequence_length=seq_len,
+                  mode="lstm", state_size=H, num_layers=1)
+    assert out.shape == (T, B, H)
