@@ -9,7 +9,6 @@ python/mxnet/contrib/onnx/onnx2mx/import_model.py [H]."""
 import os
 
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.contrib import onnx as mxonnx
