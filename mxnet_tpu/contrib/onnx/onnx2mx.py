@@ -306,6 +306,9 @@ def _cast(node, ins, attrs, ctx):
     if to is None:
         raise MXNetError(f"ONNX import: Cast to {attrs.get('to')} "
                          f"unsupported")
+    # 64-bit requests under default jax resolve at EXECUTION time in the
+    # shared Cast op (ops/elemwise.py _effective_dtype) — nothing baked
+    # into the imported graph, and x64 runs keep true int64/float64
     return _sym_mod().cast(ins[0], dtype=to,
                            name=node.get("name") or None)
 

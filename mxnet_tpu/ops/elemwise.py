@@ -101,12 +101,23 @@ register("BlockGrad", aliases=["stop_gradient"],
              "elemwise_unary_op_basic.cc BlockGrad)")(jax.lax.stop_gradient)
 
 
+def _effective_dtype(dtype):
+    """Resolve a requested dtype to what THIS runtime can hold: under
+    default jax (x64 off) 64-bit requests already come back 32-bit —
+    asking explicitly avoids the per-call truncation UserWarning and
+    tracks the live x64 state (covers nd.cast/npx.cast/ONNX Cast alike)."""
+    if not jax.config.x64_enabled:
+        return {"int64": "int32", "uint64": "uint32",
+                "float64": "float32"}.get(str(dtype), dtype)
+    return dtype
+
+
 @register("Cast", aliases=["cast"],
           params=[OpParam("dtype", str, "float32", doc="target dtype")],
           doc="Casts to a new dtype (ref: elemwise_unary_op_basic.cc Cast)")
 def _cast(x, dtype="float32"):
     from ..base import _as_np_dtype
-    return x.astype(_as_np_dtype(dtype))
+    return x.astype(_as_np_dtype(_effective_dtype(dtype)))
 
 
 @register("amp_cast", params=[OpParam("dtype", str, "float32")],

@@ -102,8 +102,8 @@ def test_while_loop_eager_semantics():
         lambda i, a: i < 5,
         lambda i, a: ([i * 2], [i + 1, a + i]),
         [nd.array([0.0]), nd.array([0.0])], max_iterations=8)
-    assert float(i_f.asnumpy()) == 5
-    assert float(acc_f.asnumpy()) == 10        # 0+1+2+3+4
+    assert float(i_f.asnumpy()[0]) == 5
+    assert float(acc_f.asnumpy()[0]) == 10        # 0+1+2+3+4
     # padded to max_iterations with zeros (reference convention)
     assert outs.shape == (8, 1)
     assert outs.asnumpy()[:5, 0].tolist() == [0, 2, 4, 6, 8]
@@ -139,7 +139,7 @@ def test_while_loop_zero_iterations():
     outs, (i_f,) = nd.contrib.while_loop(
         lambda i: i < 0, lambda i: ([i * 3], [i + 1]),
         [nd.array([7.0])], max_iterations=4)
-    assert float(i_f.asnumpy()) == 7
+    assert float(i_f.asnumpy()[0]) == 7
     assert outs.shape == (4, 1) and abs(outs.asnumpy()).max() == 0
 
 
@@ -185,7 +185,7 @@ def test_while_loop_beam_decode():
 def test_cond_eager_and_traced():
     a, b = nd.array([2.0]), nd.array([5.0])
     hi = nd.contrib.cond((a > b).reshape(()), lambda: a, lambda: b)
-    assert float(hi.asnumpy()) == 5.0
+    assert float(hi.asnumpy()[0]) == 5.0
 
     class CondNet(gluon.HybridBlock):
         def hybrid_forward(self, F, x, y):
@@ -252,19 +252,19 @@ def test_sym_while_loop_and_cond():
         lambda x: x < 5, lambda x: (x * 2, x + 1), i, max_iterations=8)
     gg = sym.Group([outs, fin])
     r = gg.eval(i=nd.array([0.0]))
-    assert float(r[1].asnumpy()) == 5
+    assert float(r[1].asnumpy()[0]) == 5
     assert r[0].asnumpy()[:5, 0].tolist() == [0, 2, 4, 6, 8]
     _, shapes, _ = gg.infer_shape(i=(1,))
     assert shapes == [(8, 1), (1,)]
 
     c = sym.contrib.cond(sym.var("p"), lambda: i + 1, lambda: i - 1)
     assert float(c.eval(p=nd.array([1.0]), i=nd.array([3.0]))[0]
-                 .asnumpy()) == 4.0
+                 .asnumpy()[0]) == 4.0
     assert float(c.eval(p=nd.array([0.0]), i=nd.array([3.0]))[0]
-                 .asnumpy()) == 2.0
+                 .asnumpy()[0]) == 2.0
     c2 = sym.load_json(c.tojson())
     assert float(c2.eval(p=nd.array([1.0]), i=nd.array([3.0]))[0]
-                 .asnumpy()) == 4.0
+                 .asnumpy()[0]) == 4.0
 
 
 def test_while_loop_eager_padding_preserves_dtype():
