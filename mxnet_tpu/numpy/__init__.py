@@ -353,3 +353,105 @@ random.dirichlet = _np_random(
     "dirichlet", lambda key, shape, alpha:
     jax.random.dirichlet(key, jnp.asarray(_unbox(alpha), jnp.float32),
                          shape or None))
+
+# round-5 distribution tail — inverse-CDF / mixture forms over the jax
+# primitives, numpy-exact parameterizations (support and conventions per
+# numpy.random: pareto is Lomax, geometric counts trials >= 1, power is
+# U^(1/a) on [0,1])
+_EPS = 1e-12
+
+
+def _u01(key, shape):
+    # open interval (0, 1): log(U) and 1/U stay finite
+    return jnp.clip(jax.random.uniform(key, shape), _EPS, 1.0 - _EPS)
+
+
+random.gumbel = _np_random(
+    "gumbel", lambda key, shape, loc=0.0, scale=1.0:
+    jax.random.gumbel(key, shape) * scale + loc)
+random.laplace = _np_random(
+    "laplace", lambda key, shape, loc=0.0, scale=1.0:
+    jax.random.laplace(key, shape) * scale + loc)
+random.logistic = _np_random(
+    "logistic", lambda key, shape, loc=0.0, scale=1.0:
+    jax.random.logistic(key, shape) * scale + loc)
+random.lognormal = _np_random(
+    "lognormal", lambda key, shape, mean=0.0, sigma=1.0:
+    jnp.exp(jax.random.normal(key, shape) * sigma + mean))
+random.poisson = _np_random(
+    "poisson", lambda key, shape, lam=1.0:
+    jax.random.poisson(key, _unbox(lam), shape or None))
+def _eff_int():
+    return jnp.int64 if jax.config.x64_enabled else jnp.int32
+
+
+random.chisquare = _np_random(
+    "chisquare", lambda key, shape, df:
+    2.0 * jax.random.gamma(key, jnp.asarray(_unbox(df), jnp.float32) / 2.0,
+                           shape or None))
+random.f = _np_random(
+    "f", lambda key, shape, dfnum, dfden:
+    (jax.random.chisquare(key, _unbox(dfnum), shape=shape or None)
+     / jnp.asarray(_unbox(dfnum), jnp.float32))
+    / (jax.random.chisquare(jax.random.fold_in(key, 1), _unbox(dfden),
+                            shape=shape or None)
+       / jnp.asarray(_unbox(dfden), jnp.float32)))
+random.geometric = _np_random(
+    "geometric", lambda key, shape, p:
+    (jnp.floor(jnp.log(_u01(key, shape))
+               / jnp.log1p(-jnp.clip(_unbox(p), _EPS, 1.0 - _EPS))) + 1.0)
+    .astype(_eff_int()))
+random.pareto = _np_random(
+    "pareto", lambda key, shape, a:
+    jnp.power(_u01(key, shape), -1.0 / jnp.asarray(_unbox(a),
+                                                   jnp.float32)) - 1.0)
+random.power = _np_random(
+    "power", lambda key, shape, a:
+    jnp.power(_u01(key, shape), 1.0 / jnp.asarray(_unbox(a),
+                                                  jnp.float32)))
+random.rayleigh = _np_random(
+    "rayleigh", lambda key, shape, scale=1.0:
+    scale * jnp.sqrt(-2.0 * jnp.log(_u01(key, shape))))
+random.weibull = _np_random(
+    "weibull", lambda key, shape, a:
+    jnp.power(-jnp.log(_u01(key, shape)),
+              1.0 / jnp.asarray(_unbox(a), jnp.float32)))
+random.binomial = _np_random(
+    "binomial", lambda key, shape, n, p:
+    jax.random.binomial(key, _unbox(n), jnp.clip(_unbox(p), 0.0, 1.0),
+                        shape=shape or None))
+random.negative_binomial = _np_random(
+    "negative_binomial", lambda key, shape, n, p:
+    jax.random.poisson(
+        jax.random.fold_in(key, 1),
+        jax.random.gamma(key, jnp.asarray(_unbox(n), jnp.float32),
+                         shape or None)
+        * ((1.0 - jnp.asarray(_unbox(p), jnp.float32))
+           / jnp.maximum(jnp.asarray(_unbox(p), jnp.float32), _EPS))))
+random.multivariate_normal = _np_random(
+    "multivariate_normal", lambda key, shape, mean, cov:
+    jax.random.multivariate_normal(
+        key, jnp.asarray(_unbox(mean), jnp.float32),
+        jnp.asarray(_unbox(cov), jnp.float32), shape or None))
+
+
+def _multinomial(n, pvals, size=None):
+    """numpy.random.multinomial: counts over one draw of n trials.
+    Counting is a scatter-add over the categorical draws — O(size*k)
+    output memory, not the O(size*n*k) a one-hot sum would take."""
+    key = _rng.next_key()
+    p = jnp.asarray(_unbox(pvals), jnp.float32)
+    shape = (size,) if isinstance(size, int) else tuple(size or ())
+    k = p.shape[-1]
+    draws = jax.random.categorical(key, jnp.log(jnp.maximum(p, _EPS)),
+                                   shape=shape + (int(n),))
+    flat = draws.reshape(-1, int(n))
+
+    def count_row(row):
+        return jnp.zeros((k,), _eff_int()).at[row].add(1)
+
+    counts = jax.vmap(count_row)(flat).reshape(shape + (k,))
+    return NDArray(counts, _skip_device_put=True)
+
+
+random.multinomial = _multinomial

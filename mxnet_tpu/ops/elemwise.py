@@ -105,10 +105,15 @@ def _effective_dtype(dtype):
     """Resolve a requested dtype to what THIS runtime can hold: under
     default jax (x64 off) 64-bit requests already come back 32-bit —
     asking explicitly avoids the per-call truncation UserWarning and
-    tracks the live x64 state (covers nd.cast/npx.cast/ONNX Cast alike)."""
+    tracks the live x64 state (covers nd.cast/npx.cast/ONNX Cast alike).
+    Matching runs on the NORMALIZED name so alias spellings ('double',
+    np.int64) resolve too."""
     if not jax.config.x64_enabled:
+        from ..base import _as_np_dtype
+        import numpy as _np
+        name = _np.dtype(_as_np_dtype(dtype)).name
         return {"int64": "int32", "uint64": "uint32",
-                "float64": "float32"}.get(str(dtype), dtype)
+                "float64": "float32"}.get(name, dtype)
     return dtype
 
 

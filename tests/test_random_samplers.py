@@ -172,3 +172,65 @@ def test_np_random_distribution_tail():
     np.testing.assert_allclose(d.sum(-1), 1.0, atol=1e-5)
     np.testing.assert_allclose(d.mean(0), [1 / 6, 2 / 6, 3 / 6],
                                atol=0.03)
+
+
+def test_np_random_distribution_tail_moments():
+    """Round-5 np.random tail: every new distribution matches its
+    theoretical mean/variance (numpy parameterizations: pareto = Lomax,
+    geometric counts trials >= 1, power = U^(1/a))."""
+    import numpy as onp
+    r = mx.np.random
+    mx.random.seed(3)
+    N = 30000
+
+    def stats(name, arr, mean, var):
+        a = arr.asnumpy()
+        assert abs(a.mean() - mean) < max(0.08 * abs(mean), 0.05), \
+            (name, a.mean(), mean)
+        assert abs(a.var() - var) < max(0.15 * var, 0.1), \
+            (name, a.var(), var)
+
+    stats("gumbel", r.gumbel(0.0, 1.0, size=N), 0.5772, onp.pi ** 2 / 6)
+    stats("laplace", r.laplace(1.0, 2.0, size=N), 1.0, 8.0)
+    stats("logistic", r.logistic(0.0, 1.0, size=N), 0.0, onp.pi ** 2 / 3)
+    stats("lognormal", r.lognormal(0.0, 0.5, size=N),
+          onp.exp(0.125), (onp.exp(0.25) - 1) * onp.exp(0.25))
+    stats("poisson", r.poisson(4.0, size=N), 4.0, 4.0)
+    stats("chisquare", r.chisquare(3.0, size=(N,)), 3.0, 6.0)
+    stats("geometric", r.geometric(0.3, size=(N,)), 1 / 0.3, 0.7 / 0.09)
+    stats("pareto", r.pareto(4.0, size=(N,)), 1 / 3, 4 / 18)
+    stats("power", r.power(3.0, size=(N,)), 0.75, 3 / 80)
+    stats("rayleigh", r.rayleigh(2.0, size=N),
+          2 * onp.sqrt(onp.pi / 2), (4 - onp.pi) * 2)
+    stats("weibull", r.weibull(2.0, size=(N,)), 0.8862, 1 - onp.pi / 4)
+    stats("binomial", r.binomial(10, 0.3, size=N), 3.0, 2.1)
+    stats("negative_binomial", r.negative_binomial(5, 0.5, size=(N,)),
+          5.0, 10.0)
+    f = r.f(5.0, 20.0, size=(N,)).asnumpy()
+    assert abs(f.mean() - 20 / 18) < 0.1
+    mvn = r.multivariate_normal([1.0, -1.0],
+                                [[1.0, 0.5], [0.5, 2.0]], size=N).asnumpy()
+    assert mvn.shape == (N, 2)
+    cov = onp.cov(mvn.T)
+    assert abs(cov[0, 1] - 0.5) < 0.1
+    mn = r.multinomial(100, [0.2, 0.3, 0.5], size=4).asnumpy()
+    assert mn.shape == (4, 3) and (mn.sum(1) == 100).all()
+
+
+def test_np_random_tail_array_params_and_int_dtypes():
+    """Review-pinned contracts: array distribution parameters broadcast
+    with size omitted (numpy semantics), geometric returns ints, and
+    'double'-spelled casts stay warning-free."""
+    import warnings
+    import numpy as onp
+    r = mx.np.random
+    mx.random.seed(9)
+    assert r.chisquare(mx.np.array([1.0, 2.0])).shape == (2,)
+    assert r.negative_binomial(mx.np.array([5.0, 3.0]), 0.5).shape == (2,)
+    assert r.f(mx.np.array([5.0, 7.0]), 20.0).shape == (2,)
+    g = r.geometric(0.3, size=(8,)).asnumpy()
+    assert g.dtype.kind == "i" and (g >= 1).all()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = mx.nd.cast(mx.nd.ones((2,)), dtype="double")
+    assert out.dtype == onp.float32  # x64 off: effective dtype
