@@ -387,8 +387,7 @@ def _eff_int():
 
 random.chisquare = _np_random(
     "chisquare", lambda key, shape, df:
-    2.0 * jax.random.gamma(key, jnp.asarray(_unbox(df), jnp.float32) / 2.0,
-                           shape or None))
+    jax.random.chisquare(key, _unbox(df), shape=shape or None))
 random.f = _np_random(
     "f", lambda key, shape, dfnum, dfden:
     (jax.random.chisquare(key, _unbox(dfnum), shape=shape or None)
@@ -419,7 +418,7 @@ random.weibull = _np_random(
 random.binomial = _np_random(
     "binomial", lambda key, shape, n, p:
     jax.random.binomial(key, _unbox(n), jnp.clip(_unbox(p), 0.0, 1.0),
-                        shape=shape or None))
+                        shape=shape or None).astype(_eff_int()))
 random.negative_binomial = _np_random(
     "negative_binomial", lambda key, shape, n, p:
     jax.random.poisson(

@@ -261,8 +261,28 @@ class PipelinedTrainer:
                  rep, rep, rep, rep, None, None)
         out_sh = in_sh[:6] + (rep,)
         donate = (0, 1, 2, 3, 4, 5) if self._donate else ()
+        self._raw_step = step
+        self._sharding_cfg = (in_sh, out_sh, donate)
         return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=donate)
+
+    def _lr_at(self, t):
+        if self._optimizer.lr_scheduler is not None:
+            return float(self._optimizer.lr_scheduler(t))
+        return float(self._optimizer.learning_rate)
+
+    def _apply_results(self, results):
+        """Shared dispatch tail for step/run_steps: rebind updated
+        params + state, return the loss."""
+        e2, b2, h2, es2, bs2, hs2, loss = results
+        for p, w in zip(self._e_params, e2):
+            p._data[0]._rebind(w)
+        for p, w in zip(self._h_params, h2):
+            p._data[0]._rebind(w)
+        self._b_datas = list(b2)
+        self._e_states, self._b_states, self._h_states = \
+            list(es2), list(bs2), list(hs2)
+        return nd.NDArray(loss, _skip_device_put=True)
 
     def step(self, x, y):
         """One fused pp × dp train step; returns the scalar loss."""
@@ -276,25 +296,71 @@ class PipelinedTrainer:
         self._num_update += 1
         t = self._num_update
         self._optimizer.num_update = t
-        lr = self._optimizer.learning_rate
-        if self._optimizer.lr_scheduler is not None:
-            lr = self._optimizer.lr_scheduler(t)
         e_tr = [p._data[0]._data for p in self._e_params]
         h_tr = [p._data[0]._data for p in self._h_params]
         with use_mesh(self._mesh):
-            (e2, b2, h2, es2, bs2, hs2, loss) = self._step_fn(
+            results = self._step_fn(
                 e_tr, self._b_datas, h_tr, self._e_states, self._b_states,
-                self._h_states, _rng.next_key(), jnp.float32(lr),
+                self._h_states, _rng.next_key(),
+                jnp.float32(self._lr_at(t)),
                 jnp.float32(t), jnp.float32(self._optimizer.rescale_grad),
                 xd, yd)
-        for p, w in zip(self._e_params, e2):
-            p._data[0]._rebind(w)
-        for p, w in zip(self._h_params, h2):
-            p._data[0]._rebind(w)
-        self._b_datas = list(b2)
-        self._e_states, self._b_states, self._h_states = \
-            list(es2), list(bs2), list(hs2)
-        return nd.NDArray(loss, _skip_device_put=True)
+        return self._apply_results(results)
+
+    def run_steps(self, x, y, num_steps=8):
+        """Run ``num_steps`` train steps as ONE compiled program
+        (``lax.scan`` over the step body, batch reused each inner step) —
+        ShardedTrainer.run_steps parity: host/tunnel dispatch latency is
+        amortized across the scan instead of paid per step. Returns the
+        last step's loss."""
+        self._prepare(x)
+        if self._m is None:
+            self._m = self._p
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        key = f"multi{num_steps}"
+        if not hasattr(self, "_multi_fns"):
+            self._multi_fns = {}
+        if key not in self._multi_fns:
+            raw = self._raw_step
+            in_sh, out_sh, donate = self._sharding_cfg
+
+            def multi(e_tr, b_tr, h_tr, e_st, b_st, h_st, rng, lrs, t,
+                      rescale, x, y):
+                # lrs: (num_steps,) — the scheduler is evaluated on the
+                # host for EVERY inner step, so a warmup/cosine schedule
+                # sees the same lr sequence as num_steps step() calls
+                def body(carry, i):
+                    e, b, h, es, bs, hs, t_ = carry
+                    k = jax.random.fold_in(rng, i)
+                    e2, b2, h2, es2, bs2, hs2, loss = raw(
+                        e, b, h, es, bs, hs, k, lrs[i], t_, rescale, x, y)
+                    return (e2, b2, h2, es2, bs2, hs2, t_ + 1.0), loss
+
+                carry, losses = jax.lax.scan(
+                    body, (e_tr, b_tr, h_tr, e_st, b_st, h_st, t),
+                    jnp.arange(num_steps))
+                return carry[:6] + (losses[-1],)
+
+            self._multi_fns[key] = jax.jit(
+                multi, in_shardings=in_sh, out_shardings=out_sh,
+                donate_argnums=donate)
+        xd = x._data if isinstance(x, nd.NDArray) else jnp.asarray(x)
+        yd = y._data if isinstance(y, nd.NDArray) else jnp.asarray(y)
+        t = self._num_update + 1
+        self._num_update += num_steps
+        self._optimizer.num_update = self._num_update
+        lrs = jnp.asarray([self._lr_at(t + i) for i in range(num_steps)],
+                          jnp.float32)
+        e_tr = [p._data[0]._data for p in self._e_params]
+        h_tr = [p._data[0]._data for p in self._h_params]
+        with use_mesh(self._mesh):
+            results = self._multi_fns[key](
+                e_tr, self._b_datas, h_tr, self._e_states, self._b_states,
+                self._h_states, _rng.next_key(), lrs,
+                jnp.float32(t), jnp.float32(self._optimizer.rescale_grad),
+                xd, yd)
+        return self._apply_results(results)
 
     def evaluate(self, x, y):
         """Forward + loss through the pipeline, no update (ShardedTrainer

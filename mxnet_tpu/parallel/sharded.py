@@ -574,12 +574,14 @@ class ShardedTrainer:
             raw = self._raw_step
             in_sh, out_sh, donate = self._shardings
 
-            def multi(tr, aux, states, rng, lr, t, rescale, *b):
+            def multi(tr, aux, states, rng, lrs, t, rescale, *b):
+                # lrs: (num_steps,) host-evaluated schedule — each inner
+                # step sees the SAME lr a separate step() call would
                 def body(carry, i):
                     tr_, aux_, states_, t_ = carry
                     k = jax.random.fold_in(rng, i)
                     ntr, naux, nst, loss, _ = raw(tr_, aux_, states_, k,
-                                                  lr, t_, rescale, *b)
+                                                  lrs[i], t_, rescale, *b)
                     return (ntr, naux, nst, t_ + 1.0), loss
 
                 (tr, aux, states, _), losses = jax.lax.scan(
@@ -594,15 +596,17 @@ class ShardedTrainer:
         t = self._num_update + 1
         self._num_update += num_steps
         self._optimizer.num_update = self._num_update
-        lr = self._optimizer.learning_rate
-        if self._optimizer.lr_scheduler is not None:
-            lr = self._optimizer.lr_scheduler(t)
+        sched = self._optimizer.lr_scheduler
+        lrs = jnp.asarray(
+            [float(sched(t + i)) if sched is not None
+             else float(self._optimizer.learning_rate)
+             for i in range(num_steps)], jnp.float32)
         tr = [p._data[0]._data for p in self._trainable]
         aux = [p._data[0]._data for p in self._aux]
         from .mesh import use_mesh
         with use_mesh(self.mesh):
             new_tr, aux_new, new_states, loss_val = self._multi_fns[key](
-                tr, aux, self._states, _rng.next_key(), jnp.float32(lr),
+                tr, aux, self._states, _rng.next_key(), lrs,
                 jnp.float32(t),
                 jnp.float32(self._optimizer.rescale_grad), *batch_datas)
         for p, w in zip(self._trainable, new_tr):

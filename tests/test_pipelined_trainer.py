@@ -215,3 +215,62 @@ def test_pipelined_evaluate_matches_sequential_forward():
     assert tr._num_update == 0
     for a, b in zip(before, tr._b_datas):
         assert np.array_equal(a, np.asarray(b))
+
+
+def test_pipelined_run_steps_matches_stepping():
+    """k scanned steps (one program) must track k individual step() calls
+    on the same reused batch — the dispatch-amortization path can't
+    change the math."""
+    x, y = _batches(1, seed=11)[0]
+    mesh = parallel.make_mesh({"pipe": 2, "data": 4})
+
+    def build():
+        emb, body, head = _build(seed=31)
+        return parallel.PipelinedTrainer(
+            emb, body, head, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.05, "momentum": 0.9}, mesh=mesh,
+            num_microbatches=4, num_virtual_stages=2)
+
+    tr_a = build()
+    for _ in range(4):
+        loss_a = tr_a.step(x, y)
+    tr_b = build()
+    loss_b = tr_b.run_steps(x, y, num_steps=4)
+    assert tr_b.num_update == 4
+    # same math modulo scan-vs-loop fp reassociation and per-step RNG
+    # keys (dropout=0 here, so keys are moot)
+    np.testing.assert_allclose(float(loss_b.asscalar()),
+                               float(loss_a.asscalar()), rtol=1e-4)
+    wa = {k: np.asarray(v) for k, v in tr_a._ckpt_entries().items()}
+    wb = {k: np.asarray(v) for k, v in tr_b._ckpt_entries().items()}
+    for k in wa:
+        np.testing.assert_allclose(wa[k], wb[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=k)
+
+
+def test_run_steps_respects_lr_schedule():
+    """The scanned multi-step path must apply the scheduler's per-step lr
+    (a frozen first-step lr would silently change warmup math)."""
+    from mxnet_tpu import lr_scheduler
+    x, y = _batches(1, seed=12)[0]
+    mesh = parallel.make_mesh({"pipe": 2, "data": 4})
+
+    def build():
+        emb, body, head = _build(seed=41)
+        opt = __import__("mxnet_tpu").optimizer.create(
+            "sgd", learning_rate=0.1,
+            lr_scheduler=lr_scheduler.FactorScheduler(step=2, factor=0.5))
+        return parallel.PipelinedTrainer(
+            emb, body, head, gluon.loss.SoftmaxCrossEntropyLoss(), opt,
+            mesh=mesh, num_microbatches=4, num_virtual_stages=2)
+
+    tr_a = build()
+    for _ in range(4):
+        tr_a.step(x, y)
+    tr_b = build()
+    tr_b.run_steps(x, y, num_steps=4)
+    wa = {k: np.asarray(v) for k, v in tr_a._ckpt_entries().items()}
+    wb = {k: np.asarray(v) for k, v in tr_b._ckpt_entries().items()}
+    for k in wa:
+        np.testing.assert_allclose(wa[k], wb[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=k)
