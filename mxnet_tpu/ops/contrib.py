@@ -1337,3 +1337,84 @@ def _spatial_transformer(data, loc, transform_type="affine",
     grid = _grid_generator(loc, transform_type="affine",
                            target_shape=(hh, ww))
     return _bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# round-5 contrib stragglers: the small parity ops reference scripts touch
+# ---------------------------------------------------------------------------
+
+@register("_contrib_quadratic", aliases=["quadratic"],
+          params=[OpParam("a", float, 0.0), OpParam("b", float, 0.0),
+                  OpParam("c", float, 0.0)],
+          doc="a*x^2 + b*x + c — the reference's custom-op tutorial op "
+              "(ref: src/operator/contrib/quadratic_op.cc)")
+def _quadratic(x, a=0.0, b=0.0, c=0.0):
+    return a * x * x + b * x + c
+
+
+@register("_contrib_allclose", aliases=["allclose"], num_inputs=2,
+          params=[OpParam("rtol", float, 1e-5), OpParam("atol", float, 1e-8),
+                  OpParam("equal_nan", bool, False)],
+          differentiable=False,
+          doc="Elementwise closeness reduced to one scalar (ref: "
+              "src/operator/contrib/allclose_op.cc)")
+def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32)
+
+
+@register("_contrib_index_copy", aliases=["index_copy"], num_inputs=3,
+          doc="Copy rows of new_tensor into old_tensor at index (ref: "
+              "src/operator/contrib/index_copy.cc); functional on TPU — "
+              "returns the updated array instead of mutating")
+def _index_copy(old, index, new):
+    if not isinstance(index, jax.core.Tracer):
+        idx = jnp.asarray(index)
+        n = old.shape[0]
+        if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= n):
+            raise MXNetError(
+                f"index_copy: index out of range for dim-0 size {n} "
+                f"(got min {int(idx.min())}, max {int(idx.max())}) — the "
+                "reference validates bounds; a silent scatter-drop would "
+                "leave rows un-copied")
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_boolean_mask", aliases=["boolean_mask"], num_inputs=2,
+          params=[OpParam("axis", int, 0)], differentiable=False,
+          doc="Select rows where mask != 0 (ref: src/operator/contrib/"
+              "boolean_mask.cc). DATA-DEPENDENT output shape: eager-only "
+              "(a jit trace would need static shapes — use `where` with a "
+              "neutral fill, or SequenceMask, inside compiled code)")
+def _boolean_mask(data, mask, axis=0):
+    if isinstance(data, jax.core.Tracer) or isinstance(mask,
+                                                       jax.core.Tracer):
+        raise MXNetError(
+            "boolean_mask has a data-dependent output shape and cannot "
+            "run inside jit/hybridize; use where/SequenceMask there")
+    import numpy as _onp
+    mask_np = _onp.asarray(mask)
+    if mask_np.shape[0] != data.shape[axis]:
+        raise MXNetError(
+            f"boolean_mask: mask length {mask_np.shape[0]} != data axis "
+            f"{axis} size {data.shape[axis]}")
+    keep = _onp.nonzero(mask_np != 0)[0]
+    return jnp.take(data, jnp.asarray(keep, jnp.int32), axis=axis)
+
+
+@register("_contrib_BatchNormWithReLU", aliases=["BatchNormWithReLU"],
+          num_inputs=5, num_outputs=3, needs_mode=True,
+          params=[OpParam("eps", float, 1e-3),
+                  OpParam("momentum", float, 0.9),
+                  OpParam("fix_gamma", bool, True),
+                  OpParam("use_global_stats", bool, False),
+                  OpParam("output_mean_var", bool, False),
+                  OpParam("axis", int, 1),
+                  OpParam("cudnn_off", bool, False)],
+          doc="BatchNorm with fused ReLU epilogue (ref: src/operator/nn/"
+              "batch_norm_relu.cc); XLA fuses the max into the normalize")
+def _batch_norm_with_relu(x, gamma, beta, moving_mean, moving_var, **kw):
+    from .nn import _batch_norm
+    out, mean, var = _batch_norm(x, gamma, beta, moving_mean, moving_var,
+                                 **kw)
+    return jnp.maximum(out, 0.0).astype(out.dtype), mean, var

@@ -267,9 +267,8 @@ class PipelinedTrainer:
                        donate_argnums=donate)
 
     def _lr_at(self, t):
-        if self._optimizer.lr_scheduler is not None:
-            return float(self._optimizer.lr_scheduler(t))
-        return float(self._optimizer.learning_rate)
+        from .sharded import _lr_at
+        return _lr_at(self._optimizer, t)
 
     def _apply_results(self, results):
         """Shared dispatch tail for step/run_steps: rebind updated
@@ -350,8 +349,8 @@ class PipelinedTrainer:
         t = self._num_update + 1
         self._num_update += num_steps
         self._optimizer.num_update = self._num_update
-        lrs = jnp.asarray([self._lr_at(t + i) for i in range(num_steps)],
-                          jnp.float32)
+        from .sharded import _lr_sequence
+        lrs = _lr_sequence(self._optimizer, t, num_steps)
         e_tr = [p._data[0]._data for p in self._e_params]
         h_tr = [p._data[0]._data for p in self._h_params]
         with use_mesh(self._mesh):

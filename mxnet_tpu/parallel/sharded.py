@@ -92,6 +92,23 @@ def functional_apply(block, key, tr_datas, aux_datas, input_datas,
 # bake into the compiled program.
 # ---------------------------------------------------------------------------
 
+def _lr_at(optimizer, t):
+    """The lr a single update at step t sees (scheduler-aware) — ONE
+    resolution rule shared by both trainers' step and scanned run_steps
+    paths."""
+    if optimizer.lr_scheduler is not None:
+        return float(optimizer.lr_scheduler(t))
+    return float(optimizer.learning_rate)
+
+
+def _lr_sequence(optimizer, t, num_steps):
+    """Host-evaluated per-step lr array for a scanned multi-step program:
+    each inner step must see the SAME lr a separate step() call would
+    (a frozen first-step lr silently changes warmup/decay math)."""
+    return jnp.asarray([_lr_at(optimizer, t + i) for i in range(num_steps)],
+                       jnp.float32)
+
+
 def _zeros_like(w):
     return jnp.zeros(w.shape, w.dtype)
 
@@ -596,11 +613,7 @@ class ShardedTrainer:
         t = self._num_update + 1
         self._num_update += num_steps
         self._optimizer.num_update = self._num_update
-        sched = self._optimizer.lr_scheduler
-        lrs = jnp.asarray(
-            [float(sched(t + i)) if sched is not None
-             else float(self._optimizer.learning_rate)
-             for i in range(num_steps)], jnp.float32)
+        lrs = _lr_sequence(self._optimizer, t, num_steps)
         tr = [p._data[0]._data for p in self._trainable]
         aux = [p._data[0]._data for p in self._aux]
         from .mesh import use_mesh

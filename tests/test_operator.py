@@ -432,3 +432,67 @@ def test_public_binary_helpers_dispatch():
     out = sym.maximum(x, 0.25)
     got = out.eval(x=a)[0].asnumpy()
     np.testing.assert_allclose(got, np.maximum(a.asnumpy(), 0.25))
+
+
+def test_contrib_straggler_ops_round5():
+    """quadratic/allclose/index_copy/boolean_mask/BatchNormWithReLU
+    (ref: src/operator/contrib/{quadratic_op,allclose_op,index_copy,
+    boolean_mask}.cc, src/operator/nn/batch_norm_relu.cc)."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    x = nd.array(np.array([1.0, 2.0, -1.0], np.float32))
+    np.testing.assert_allclose(
+        mx.nd.contrib.quadratic(x, a=1.0, b=2.0, c=3.0).asnumpy(),
+        [6.0, 11.0, 2.0])
+    assert float(mx.nd.contrib.allclose(x, x).asnumpy()) == 1.0
+    assert float(mx.nd.contrib.allclose(x, x + 1.0).asnumpy()) == 0.0
+
+    old = nd.array(np.zeros((4, 2), np.float32))
+    new = nd.array(np.ones((2, 2), np.float32) * 7)
+    idx = nd.array(np.array([1, 3], np.int32))
+    out = mx.nd.contrib.index_copy(old, idx, new).asnumpy()
+    assert out[1, 0] == 7 and out[3, 1] == 7 and out[0, 0] == 0
+    assert old.asnumpy()[1, 0] == 0          # functional: input untouched
+
+    d = nd.array(np.arange(8).reshape(4, 2).astype(np.float32))
+    m = nd.array(np.array([1, 0, 1, 0], np.float32))
+    bm = mx.nd.contrib.boolean_mask(d, m).asnumpy()
+    np.testing.assert_allclose(bm, [[0, 1], [4, 5]])
+    # inside jit the data-dependent shape must error clearly
+    from mxnet_tpu import gluon
+
+    class BM(gluon.HybridBlock):
+        def hybrid_forward(self, F, data, mask):
+            return F.contrib.boolean_mask(data, mask)
+
+    net = BM()
+    net.hybridize()
+    with pytest.raises(MXNetError, match="jit"):
+        net(d, m)
+
+    g, b = nd.ones((3,)), nd.zeros((3,))
+    rm, rv = nd.zeros((3,)), nd.ones((3,))
+    xx = nd.array(np.random.RandomState(0).randn(2, 3, 4, 4)
+                  .astype(np.float32))
+    bnr = mx.nd.contrib.BatchNormWithReLU(xx, g, b, rm, rv)
+    out0 = (bnr[0] if isinstance(bnr, list) else bnr).asnumpy()
+    ref = (mx.nd.BatchNorm(xx, g, b, rm, rv)[0]
+           if isinstance(mx.nd.BatchNorm(xx, g, b, rm, rv), list)
+           else mx.nd.BatchNorm(xx, g, b, rm, rv)).asnumpy()
+    np.testing.assert_allclose(out0, np.maximum(ref, 0.0), rtol=1e-6)
+
+
+def test_contrib_straggler_validation_round5():
+    """Bounds/shape validation the reference performs must error, not
+    silently drop (review-pinned)."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    old = nd.array(np.zeros((4, 2), np.float32))
+    new = nd.array(np.ones((1, 2), np.float32))
+    with pytest.raises(MXNetError, match="out of range"):
+        mx.nd.contrib.index_copy(old, nd.array(np.array([9], np.int32)),
+                                 new)
+    d = nd.array(np.arange(8).reshape(4, 2).astype(np.float32))
+    with pytest.raises(MXNetError, match="mask length"):
+        mx.nd.contrib.boolean_mask(
+            d, nd.array(np.array([1, 0, 1, 0, 1, 1], np.float32)))

@@ -522,3 +522,37 @@ def test_pipeline_heterogeneous_oracle():
                          head_fn=lambda p, hh: hh @ p, head_params=head)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_run_steps_respects_lr_schedule():
+    """The scanned multi-step path must apply the scheduler's per-step lr
+    (regression: a frozen first-step lr changes warmup/decay math)."""
+    import numpy as np
+    from mxnet_tpu import lr_scheduler
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 6).astype(np.float32)
+    y = rng.randint(0, 4, (16,))
+    mesh = parallel.make_mesh({"data": 8})
+
+    def build():
+        mx.random.seed(17)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(4))
+        net.initialize()
+        opt = mx.optimizer.create(
+            "sgd", learning_rate=0.2, momentum=0.9,
+            lr_scheduler=lr_scheduler.FactorScheduler(step=2, factor=0.5))
+        return net, parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), opt, mesh=mesh)
+
+    net_a, tr_a = build()
+    for _ in range(4):
+        tr_a.step(x, y)
+    wa = [np.asarray(p._data[0]._data) for p in tr_a._trainable]
+
+    net_b, tr_b = build()
+    tr_b.run_steps(x, y, num_steps=4)
+    wb = [np.asarray(p._data[0]._data) for p in tr_b._trainable]
+    for a, b in zip(wa, wb):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
