@@ -1394,6 +1394,10 @@ def _boolean_mask(data, mask, axis=0):
             "run inside jit/hybridize; use where/SequenceMask there")
     import numpy as _onp
     mask_np = _onp.asarray(mask)
+    if mask_np.ndim != 1:
+        raise MXNetError(
+            f"boolean_mask: mask must be 1-D, got shape {mask_np.shape} "
+            "(a 2-D mask would index one row per nonzero ELEMENT)")
     if mask_np.shape[0] != data.shape[axis]:
         raise MXNetError(
             f"boolean_mask: mask length {mask_np.shape[0]} != data axis "

@@ -540,9 +540,7 @@ class ShardedTrainer:
         self._num_update += 1
         t = self._num_update
         self._optimizer.num_update = t
-        lr = self._optimizer.learning_rate
-        if self._optimizer.lr_scheduler is not None:
-            lr = self._optimizer.lr_scheduler(t)
+        lr = _lr_at(self._optimizer, t)
         rescale = self._optimizer.rescale_grad
         tr = [p._data[0]._data for p in self._trainable]
         aux = [p._data[0]._data for p in self._aux]

@@ -107,3 +107,23 @@ def test_train_imagenet_memorizes():
                "--epochs", "1", "--lr", "0.05", "--no-bf16", timeout=420)
     final = _parse_metric(out, r"final loss=([0-9.]+)")
     assert final < 0.5, f"imagenet example loss {final} above 0.5 floor"
+
+
+def test_train_dcgan_matches_data_statistics():
+    """DCGAN (adversarial family, ref: example/gan/dcgan.py): after a
+    short run the generator's pixel-mean map must approach the data's
+    radial structure (GAN losses oscillate, so the gate is on sample
+    statistics), and both players must still be in the game (neither
+    loss collapsed to 0)."""
+    out = _run("train_dcgan.py", "--steps", "150")
+    # anchor to the FINAL summary line — the per-step logs also contain
+    # d_loss/g_loss and re.search would read step 0 otherwise
+    l1 = _parse_metric(out, r"pixel-mean-map L1\s*([0-9.]+)")
+    d_loss = _parse_metric(
+        out, r"pixel-mean-map L1\s*[0-9.]+\s+d_loss\s*([0-9.]+)")
+    g_loss = _parse_metric(
+        out, r"pixel-mean-map L1\s*[0-9.]+\s+d_loss\s*[0-9.]+\s*"
+             r"g_loss\s*([0-9.]+)")
+    assert l1 < 0.12, f"generated stats L1 {l1} too far from data"
+    assert d_loss > 0.05, "discriminator collapsed (training broken)"
+    assert g_loss > 0.05, "generator loss collapsed (D gave up)"
