@@ -37,7 +37,9 @@ BASELINE_CEILING = 3550.0  # BASELINE.md governing (HBM-bound) ceiling
 
 PROBE_TIMEOUT_S = 150      # first TPU compile dial can take ~40s; 150 is slack
 PROBE_BACKOFF_S = (0, 20, 45)  # len == number of probe attempts
-BENCH_TIMEOUT_S = 840      # well under any driver-side timeout window
+BENCH_TIMEOUT_S = 840      # TPU body takes ~60s; 840 is deep slack
+BENCH_TIMEOUT_CPU_S = 1500  # CPU smoke body measured 632-699s; the
+                            # driver's own window is >= 30 min (r4 tail)
 
 
 def _emit(obj: dict) -> None:
@@ -159,18 +161,20 @@ def main():
             "docs/perf_notes.md round-4 pitfall"))
         return 0
 
+    body_deadline = (BENCH_TIMEOUT_S if info["platform"] in ("tpu", "axon")
+                     else BENCH_TIMEOUT_CPU_S)
     t0 = time.perf_counter()
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--body"],
-            capture_output=True, text=True, timeout=BENCH_TIMEOUT_S)
+            capture_output=True, text=True, timeout=body_deadline)
     except subprocess.TimeoutExpired as e:
         tail = ((e.stderr or b"").decode("utf-8", "replace")
                 if isinstance(e.stderr, bytes) else (e.stderr or ""))[-500:]
         _emit(_diagnostic(
             "bench_timeout",
             f"device probe was healthy ({info['n']}x {info['platform']}) but "
-            f"the benchmark body exceeded {BENCH_TIMEOUT_S}s — tunnel likely "
+            f"the benchmark body exceeded {body_deadline}s — tunnel likely "
             f"degraded mid-run; stderr tail: {tail}"))
         return 0
     sys.stderr.write(proc.stderr[-2000:])
