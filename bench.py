@@ -17,7 +17,12 @@ a healthy benchmark into a silent rc=124):
      with retries + backoff;
   2. the benchmark body itself runs in a subprocess under a hard deadline;
   3. every failure path prints ONE structured JSON line (``error`` field set)
-     instead of hanging, so the driver always records a parseable artifact.
+     instead of hanging, so the driver always records a parseable artifact;
+  4. (round 6) the probe runs through ``mxnet_tpu.diagnostics.guard`` — the
+     one sanctioned backend-dial path — and a journal SIGTERM finalizer
+     emits a ``bench_killed`` diagnostic line carrying the last-known phase
+     if the driver's outer kill lands first, so even an rc:124 artifact is
+     attributable (docs/diagnostics.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} on success,
 or {"metric", "value": null, ..., "error", "detail"} on a wedged device.
@@ -52,48 +57,53 @@ def _diagnostic(error: str, detail: str) -> dict:
             "vs_baseline": None, "error": error, "detail": detail}
 
 
-def _probe_device():
-    """Dial ``jax.devices()`` in a throwaway subprocess under a deadline.
+def _probe_deadline() -> float:
+    # ONE resolver for the knob (guard.probe_deadline_s): a malformed
+    # MXNET_TPU_PROBE_DEADLINE falls back to the default there instead
+    # of crashing before any structured artifact is emitted
+    from mxnet_tpu.diagnostics import guard
+    if "MXNET_TPU_PROBE_DEADLINE" in os.environ:
+        return guard.probe_deadline_s(None)
+    return float(PROBE_TIMEOUT_S)
 
-    Returns ``{"platform": ..., "n": ...}`` on success, else ``None`` after
-    all attempts (each attempt's outcome goes to stderr so the driver's tail
-    capture shows *why*, not just rc).
+
+def _probe_device():
+    """Dial ``jax.devices()`` in a throwaway subprocess under a deadline,
+    via the diagnostics guard (mxnet_tpu/diagnostics/guard.py — the one
+    sanctioned backend-dial path; per-attempt outcomes are journaled to
+    stderr so the driver's tail capture shows *why*, not just rc).
+
+    Returns ``{"platform": ..., "n": ...}`` on success, else ``None``
+    after all attempts. Malformed child stdout (a dying tunnel truncating
+    a write) is a failed attempt, never an exception — the
+    one-structured-line contract survives it (ADVICE r5 low).
     """
-    code = ("import jax, json; ds = jax.devices(); "
-            "print(json.dumps({'platform': ds[0].platform, 'n': len(ds)}))")
-    for attempt, backoff in enumerate(PROBE_BACKOFF_S, start=1):
-        if backoff:
-            time.sleep(backoff)
-        t0 = time.perf_counter()
-        try:
-            out = subprocess.run([sys.executable, "-c", code],
-                                 capture_output=True, text=True,
-                                 timeout=PROBE_TIMEOUT_S)
-        except subprocess.TimeoutExpired:
-            print(f"bench: device probe {attempt}/{len(PROBE_BACKOFF_S)} "
-                  f"timed out after {PROBE_TIMEOUT_S}s", file=sys.stderr)
-            continue
-        dt = time.perf_counter() - t0
-        if out.returncode == 0:
-            for line in reversed(out.stdout.splitlines()):
-                line = line.strip()
-                if line.startswith("{"):
-                    info = json.loads(line)
-                    print(f"bench: device probe ok in {dt:.1f}s -> "
-                          f"{info['n']}x {info['platform']}", file=sys.stderr)
-                    return info
-        print(f"bench: device probe {attempt}/{len(PROBE_BACKOFF_S)} failed "
-              f"rc={out.returncode}: {out.stderr.strip()[-300:]}",
-              file=sys.stderr)
-    return None
+    from mxnet_tpu.diagnostics import guard
+    try:
+        info = guard.probe_backend(deadline_s=_probe_deadline(),
+                                   backoff_s=PROBE_BACKOFF_S)
+    except guard.DeviceUnreachable as e:
+        print(f"bench: {e}", file=sys.stderr)
+        return None
+    print(f"bench: device probe ok in {info['probe_s']}s -> "
+          f"{info['n']}x {info['platform']}", file=sys.stderr)
+    return info
 
 
 def _run_body():
     """The actual benchmark (runs in the deadlined child process)."""
     import jax
     from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.diagnostics import Watchdog, get_journal
     from mxnet_tpu.gluon.model_zoo import vision
 
+    # heartbeats to stderr (the parent relays its tail on timeout): a
+    # mid-run tunnel degradation then shows phase + RSS + a stall dump.
+    # stall_s=600: a healthy CPU-smoke compile is quiet for ~10 min, so
+    # the dump must only fire when the 840s/1500s body deadline is near
+    j = get_journal()
+    Watchdog(journal=j, stall_s=600).start()
+    j.set_phase("body_setup")
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     batch = 256 if on_tpu else 8
@@ -127,7 +137,9 @@ def _run_body():
     # individually compute-honest per BASELINE.md's protocol).
     k = 10 if on_tpu else 2
     windows = 3 if on_tpu else 1
+    j.set_phase("body_compile_warm")
     trainer.run_steps(x, y, num_steps=k).wait_to_read()     # compile+warm
+    j.set_phase("body_measure")
     best_dt = None
     for _ in range(windows):
         t0 = time.perf_counter()
@@ -151,19 +163,46 @@ def main():
     if "--body" in sys.argv:
         return _run_body()
 
-    info = _probe_device()
+    # journaled breadcrumbs + SIGTERM finalizer: if the driver's outer
+    # kill lands mid-run, the artifact still carries a parseable JSON
+    # line with the last-known phase instead of a silent rc:124
+    from mxnet_tpu.diagnostics import get_journal
+    j = get_journal()
+    j.install_handlers(final_cb=lambda: _emit(_diagnostic(
+        "bench_killed",
+        f"killed at phase {j.last_phase!r} before completion (outer "
+        "deadline or signal); see stderr journal for breadcrumbs")))
+    try:
+        return _main_guarded(j)
+    except Exception as e:
+        # a plain Python crash must not masquerade as "killed by the
+        # outer deadline": journal it, emit an honest crash diagnostic,
+        # and re-raise so the traceback still reaches stderr
+        j.crash(e)
+        _emit(_diagnostic(
+            "bench_crashed",
+            f"{type(e).__name__}: {e} (at phase {j.last_phase!r})"))
+        j.mark_clean()
+        raise
+
+
+def _main_guarded(j):
+    with j.phase("bench_probe"):
+        info = _probe_device()
     if info is None:
         _emit(_diagnostic(
             "device_unreachable",
-            f"jax.devices() did not answer within {PROBE_TIMEOUT_S}s in any "
-            f"of {len(PROBE_BACKOFF_S)} attempts (backoffs "
+            f"jax.devices() did not answer within {_probe_deadline():g}s "
+            f"in any of {len(PROBE_BACKOFF_S)} attempts (backoffs "
             f"{PROBE_BACKOFF_S}s); TPU tunnel wedged — see "
             "docs/perf_notes.md round-4 pitfall"))
+        j.mark_clean()
         return 0
 
     body_deadline = (BENCH_TIMEOUT_S if info["platform"] in ("tpu", "axon")
                      else BENCH_TIMEOUT_CPU_S)
     t0 = time.perf_counter()
+    j.set_phase("bench_body")
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--body"],
@@ -176,7 +215,9 @@ def main():
             f"device probe was healthy ({info['n']}x {info['platform']}) but "
             f"the benchmark body exceeded {body_deadline}s — tunnel likely "
             f"degraded mid-run; stderr tail: {tail}"))
+        j.mark_clean()
         return 0
+    j.set_phase("bench_report")
     sys.stderr.write(proc.stderr[-2000:])
     for line in reversed(proc.stdout.splitlines()):
         line = line.strip()
@@ -184,10 +225,12 @@ def main():
             print(line, flush=True)
             dt = time.perf_counter() - t0
             print(f"bench: body finished in {dt:.1f}s", file=sys.stderr)
+            j.mark_clean()
             return 0 if proc.returncode == 0 else proc.returncode
     _emit(_diagnostic(
         "bench_body_failed",
         f"rc={proc.returncode}; stderr tail: {proc.stderr[-500:]}"))
+    j.mark_clean()
     return 0
 
 

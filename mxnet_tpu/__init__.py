@@ -96,6 +96,7 @@ from . import visualization as viz
 from . import test_utils
 from . import operator
 from . import runtime
+from . import diagnostics
 from . import util
 from . import rnn
 from . import attribute

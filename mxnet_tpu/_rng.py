@@ -38,12 +38,32 @@ def _default_impl():
 
 
 def _make_key(seed_val):
+    # every key creation is a backend touch (array on device) — route it
+    # through the diagnostics guard so the dial is journaled and a wedged
+    # tunnel leaves a breadcrumb instead of a silent hang
+    from .diagnostics import guard
+    guard.ensure_backend(tag="rng-global-key")
     return jax.random.key(int(seed_val), impl=_default_impl())
 
 
 _lock = threading.Lock()
-_key = _make_key(0)
+# LAZY by contract: created on first seed()/key use. Nothing at module
+# scope may call jax.default_backend()/jax.random.key — an import-time
+# key here dialed the backend on `import mxnet_tpu` and wedged every
+# tunnel-pinned process before any wedge-proofing could run (the root
+# cause of the round-4/5 RED multichip gates, VERDICT r5; the reference
+# builds RNG states lazily in src/resource.cc's ResourceManager).
+# tests/test_diagnostics.py pins this with an import-hermeticity test.
+_key = None
 _trace = threading.local()
+
+
+def _ensure_key_locked():
+    """Create the global key on first use (caller holds ``_lock``)."""
+    global _key
+    if _key is None:
+        _key = _make_key(0)
+    return _key
 
 
 def seed(seed_state: int):
@@ -67,7 +87,7 @@ def next_key():
         return jax.random.fold_in(entry[0], entry[1])
     global _key
     with _lock:
-        _key, sub = jax.random.split(_key)
+        _key, sub = jax.random.split(_ensure_key_locked())
     return sub
 
 
@@ -95,13 +115,16 @@ def get_state():
     every op that draws from the global key (dropout masks, samplers)."""
     import numpy as np
     with _lock:
-        return (np.asarray(jax.random.key_data(_key)),
-                str(jax.random.key_impl(_key)))
+        key = _ensure_key_locked()
+        return (np.asarray(jax.random.key_data(key)),
+                str(jax.random.key_impl(key)))
 
 
 def set_state(data, impl):
     global _key
     import jax.numpy as jnp
+    from .diagnostics import guard
+    guard.ensure_backend(tag="rng-set-state")
     with _lock:
         _key = jax.random.wrap_key_data(
             jnp.asarray(data, dtype=jnp.uint32), impl=impl)

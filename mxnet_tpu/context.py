@@ -173,6 +173,10 @@ def num_tpus() -> int:
 
 def gpu_memory_info(device_id: int = 0):
     """(free, total) bytes, ref: mx.context.gpu_memory_info."""
+    # device-memory queries dial the backend; guard them so the touch is
+    # journaled (docs/diagnostics.md)
+    from .diagnostics import guard
+    guard.ensure_backend(tag="device-memory-info")
     dev = _resolve_device("gpu", device_id)
     stats = getattr(dev, "memory_stats", lambda: None)()
     if stats:

@@ -1,0 +1,33 @@
+"""``mx.diagnostics`` — runtime health subsystem.
+
+Born from two consecutive driver gates going RED with information-free
+``rc:124`` artifacts (VERDICT r5): the runtime could neither refuse a
+wedged backend nor say where a process died. Four parts:
+
+- :mod:`.guard` — the ONE sanctioned path to backend init:
+  ``ensure_backend()`` / ``probe_backend()`` with hard deadlines and a
+  structured :class:`DeviceUnreachable` instead of a hang. Every device
+  touch in the package routes through it (the reference's analog:
+  resources are built lazily by ``src/resource.cc`` ResourceManager,
+  never at library load).
+- :mod:`.journal` — append-only JSONL breadcrumbs (phases, timers,
+  crashes) with SIGTERM/atexit finalizers, so every killed process
+  leaves a last-known phase.
+- :mod:`.watchdog` — daemon heartbeats (phase, wall, RSS) + all-thread
+  faulthandler dumps when progress stalls.
+- ``python -m mxnet_tpu.diagnostics probe|doctor`` — one-command
+  environment health report for drivers and CI.
+
+Import-light by contract: importing this package touches neither jax
+nor the rest of mxnet_tpu. See docs/diagnostics.md.
+"""
+from __future__ import annotations
+
+from .guard import (DeviceUnreachable, backend_dialed, ensure_backend,
+                    probe_backend)
+from .journal import Journal, get_journal, reset_journal
+from .watchdog import Watchdog
+
+__all__ = ["DeviceUnreachable", "Journal", "Watchdog", "backend_dialed",
+           "ensure_backend", "get_journal", "probe_backend",
+           "reset_journal"]

@@ -1,0 +1,167 @@
+"""Health CLI: ``python -m mxnet_tpu.diagnostics probe|doctor``.
+
+One-command hermetic environment report for drivers and CI. Both
+commands print exactly ONE JSON line on stdout (the artifact contract);
+human-readable detail goes to stderr.
+
+``probe``   — dial the backend in a throwaway subprocess under a hard
+              deadline (``--deadline``, default MXNET_TPU_PROBE_DEADLINE
+              or 150 s). rc 0 = reachable, 1 = unreachable.
+``doctor``  — full report: import-time audit (``-X importtime`` in a
+              subprocess; the import must complete WITHOUT backend init
+              — the round-5 wedge was exactly an import-time dial),
+              backend probe, device/mesh shape, relevant env vars.
+              rc 0 = healthy, 1 = backend unreachable, 2 = the package
+              itself cannot be imported hermetically.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+from . import guard
+
+_ENV_KEYS = ("JAX_PLATFORMS", "XLA_FLAGS", "MXNET_TPU_PROBE_DEADLINE",
+             "MXNET_TPU_JOURNAL", "MXNET_TPU_HEARTBEAT_S",
+             "MXNET_TPU_STALL_S", "MXNET_PRNG_IMPL",
+             "MXNET_MATMUL_PRECISION", "MXNET_ENGINE_TYPE",
+             "MXTPU_COORD_ADDR", "MXTPU_NUM_PROC", "MXTPU_PROC_ID")
+
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def _env_report() -> dict:
+    env = {k: os.environ[k] for k in _ENV_KEYS if k in os.environ}
+    hook = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+            if ".axon_site" in p]
+    if hook:
+        env["pythonpath_site_hook"] = hook
+    return env
+
+
+def _import_audit(deadline_s: float) -> dict:
+    """Import the package in a child with ``-X importtime`` and report
+    wall time + the slowest modules. The child runs with the CURRENT env
+    — if the import dials the backend under a wedged tunnel, the child
+    times out and the report says so instead of this process hanging."""
+    t0 = time.perf_counter()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-X", "importtime", "-c",
+             "import mxnet_tpu"],
+            capture_output=True, text=True, timeout=deadline_s)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": "import_timeout",
+                "detail": f"import mxnet_tpu exceeded {deadline_s:g}s — "
+                          "something dials the backend at import time"}
+    dt = time.perf_counter() - t0
+    if out.returncode != 0:
+        return {"ok": False, "error": "import_failed",
+                "rc": out.returncode,
+                "stderr_tail": out.stderr.strip()[-500:]}
+    total_us, slowest = 0, []
+    for line in out.stderr.splitlines():
+        # "import time: self [us] | cumulative | imported package"
+        parts = line.split("|")
+        if len(parts) != 3 or "import time:" not in parts[0]:
+            continue
+        try:
+            self_us = int(parts[0].split(":", 1)[1].strip())
+            cum_us = int(parts[1].strip())
+        except ValueError:
+            continue
+        total_us += self_us
+        name = parts[2].rstrip()
+        # top-level imports only: the name field is " <module>" with two
+        # MORE leading spaces per nesting level, so any extra space after
+        # the first marks a nested import
+        if not name[1:].startswith(" "):
+            slowest.append((cum_us, name.strip()))
+    slowest.sort(reverse=True)
+    return {"ok": True, "import_s": round(dt, 2),
+            "import_self_total_s": round(total_us / 1e6, 2),
+            "slowest_toplevel": [
+                {"module": n, "cumulative_s": round(us / 1e6, 2)}
+                for us, n in slowest[:5]]}
+
+
+def cmd_probe(args) -> int:
+    try:
+        info = guard.probe_backend(deadline_s=args.deadline,
+                                   backoff_s=(0.0,) * args.attempts)
+    except guard.DeviceUnreachable as e:
+        _emit({"ok": False, **e.to_dict()})
+        return 1
+    _emit({"ok": True, **info})
+    return 0
+
+
+def cmd_doctor(args) -> int:
+    deadline = guard.probe_deadline_s(args.deadline)
+    report = {"python": sys.version.split()[0],
+              "pid": os.getpid(),
+              "env": _env_report()}
+    print(f"doctor: import audit (deadline {deadline:g}s) ...",
+          file=sys.stderr)
+    report["import_audit"] = _import_audit(deadline)
+    print(f"doctor: backend probe (deadline {deadline:g}s) ...",
+          file=sys.stderr)
+    try:
+        info = guard.probe_backend(deadline_s=deadline)
+        report["backend"] = {"ok": True, **info}
+        flags = os.environ.get("XLA_FLAGS", "")
+        report["mesh"] = {
+            "devices": info["n"],
+            "platform": info["platform"],
+            "processes": info.get("process_count", 1),
+            "forced_host_device_count":
+                "xla_force_host_platform_device_count" in flags}
+    except guard.DeviceUnreachable as e:
+        report["backend"] = {"ok": False, **e.to_dict()}
+    imp, dev = report["import_audit"]["ok"], report["backend"]["ok"]
+    report["healthy"] = bool(imp and dev)
+    _emit(report)
+    if imp:
+        print(f"doctor: import OK in "
+              f"{report['import_audit']['import_s']}s", file=sys.stderr)
+    else:
+        print(f"doctor: IMPORT BROKEN: {report['import_audit']}",
+              file=sys.stderr)
+    if dev:
+        print(f"doctor: backend OK: {report['backend']['n']}x "
+              f"{report['backend']['platform']} in "
+              f"{report['backend']['probe_s']}s", file=sys.stderr)
+    else:
+        print("doctor: BACKEND UNREACHABLE: "
+              f"{report['backend']['detail']}", file=sys.stderr)
+    return 0 if report["healthy"] else (2 if not imp else 1)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.diagnostics",
+        description="runtime health checks (see docs/diagnostics.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("probe", help="subprocess backend dial under a "
+                                     "deadline; ONE JSON line on stdout")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="seconds per attempt (default "
+                        "MXNET_TPU_PROBE_DEADLINE or 150)")
+    p.add_argument("--attempts", type=int, default=1)
+    p.set_defaults(fn=cmd_probe)
+    d = sub.add_parser("doctor", help="hermetic environment report: "
+                                      "import audit + probe + env")
+    d.add_argument("--deadline", type=float, default=None)
+    d.set_defaults(fn=cmd_doctor)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

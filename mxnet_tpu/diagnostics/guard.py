@@ -1,0 +1,223 @@
+"""Device-dial guard — the ONE sanctioned path to JAX backend init.
+
+Anything that touches the backend (``jax.devices()``, first array
+creation, profiler start) can hang *indefinitely* when the TPU tunnel is
+wedged (docs/perf_notes.md round-4 pitfall; the proven cause of two
+consecutive information-free ``rc:124`` driver gates, VERDICT r5). The
+reference never dials devices at library load — per-device resources are
+built lazily by ``src/resource.cc``'s ResourceManager — and this module
+is the TPU-native equivalent choke point:
+
+- ``probe_backend()`` dials ``jax.devices()`` in a THROWAWAY subprocess
+  under a hard deadline, with retries + backoff; a wedged tunnel costs a
+  bounded wait and a structured :class:`DeviceUnreachable`, never a hang
+  of the calling process.
+- ``ensure_backend()`` is the in-process dial: journal breadcrumbs
+  bracket the touch and a deadline timer dumps all-thread tracebacks if
+  the dial stalls, so even an unkillable C-level hang leaves an
+  attributable artifact. Optionally runs ``probe_backend()`` first so
+  the caller finds out the tunnel is wedged without wedging itself.
+
+Import-light by contract: jax is imported lazily inside functions, so
+``import mxnet_tpu.diagnostics`` can run in processes that must never
+risk a backend touch.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from .journal import get_journal
+
+__all__ = ["DeviceUnreachable", "probe_backend", "ensure_backend",
+           "backend_dialed", "probe_deadline_s"]
+
+DEFAULT_PROBE_DEADLINE_S = 150.0   # first TPU compile dial can take ~40s
+DEFAULT_BACKOFF_S = (0.0,)         # one attempt unless the caller opts in
+
+_PROBE_CODE = (
+    "import json, sys\n"
+    "import jax\n"
+    "ds = jax.devices()\n"
+    "print(json.dumps({'platform': ds[0].platform, 'n': len(ds),\n"
+    "                  'kinds': sorted({d.device_kind for d in ds}),\n"
+    "                  'process_index': jax.process_index(),\n"
+    "                  'process_count': jax.process_count()}))\n"
+)
+
+
+def probe_deadline_s(deadline_s=None) -> float:
+    """Resolve the probe deadline: explicit arg, else
+    ``MXNET_TPU_PROBE_DEADLINE`` (seconds), else 150."""
+    if deadline_s is not None:
+        return float(deadline_s)
+    env = os.environ.get("MXNET_TPU_PROBE_DEADLINE")
+    try:
+        return float(env) if env else DEFAULT_PROBE_DEADLINE_S
+    except ValueError:
+        return DEFAULT_PROBE_DEADLINE_S
+
+
+class DeviceUnreachable(RuntimeError):
+    """The backend did not answer within the deadline. Carries a
+    machine-readable record (``to_dict()``) so callers can emit it on
+    their one-structured-line artifact contract instead of dying with an
+    information-free timeout."""
+
+    def __init__(self, detail: str, deadline_s: float, attempts: int,
+                 stderr_tail: str = ""):
+        super().__init__(detail)
+        self.detail = detail
+        self.deadline_s = float(deadline_s)
+        self.attempts = int(attempts)
+        self.stderr_tail = stderr_tail[-500:]
+
+    def to_dict(self) -> dict:
+        return {"error": "device_unreachable", "detail": self.detail,
+                "deadline_s": self.deadline_s, "attempts": self.attempts,
+                "stderr_tail": self.stderr_tail}
+
+
+def _parse_info_line(stdout: str):
+    """Last parseable probe-info line of a probe child's stdout, or None.
+    Malformed child output (a library spraying text or JSON-shaped logs
+    onto stdout, a truncated write from a dying tunnel) must degrade to
+    a structured failure, never an exception or a bogus success
+    (ADVICE r5 low, bench.py:81) — so the dict must carry the probe's
+    required keys before it counts."""
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                info = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(info, dict) and "platform" in info \
+                    and "n" in info:
+                return info
+    return None
+
+
+def probe_backend(deadline_s=None, backoff_s=None, env=None,
+                  _code=None) -> dict:
+    """Dial ``jax.devices()`` in a throwaway subprocess under a hard
+    deadline. Returns ``{"platform", "n", "kinds", "process_index",
+    "process_count", "probe_s"}`` on success; raises
+    :class:`DeviceUnreachable` after all attempts.
+
+    ``backoff_s`` is a tuple of pre-attempt sleeps — its length is the
+    attempt count (bench.py uses ``(0, 20, 45)``). Each attempt's outcome
+    is journaled, so a driver's stderr tail shows *why*, not just rc.
+    """
+    deadline_s = probe_deadline_s(deadline_s)
+    backoff_s = tuple(backoff_s) if backoff_s is not None else \
+        DEFAULT_BACKOFF_S
+    code = _code or _PROBE_CODE
+    j = get_journal()
+    last_err = ""
+    for attempt, backoff in enumerate(backoff_s, start=1):
+        if backoff:
+            time.sleep(backoff)
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True, env=env,
+                                 timeout=deadline_s)
+        except subprocess.TimeoutExpired:
+            last_err = (f"probe attempt {attempt}/{len(backoff_s)} timed "
+                        f"out after {deadline_s:g}s")
+            j.event("probe_timeout", attempt=attempt,
+                    deadline_s=deadline_s)
+            continue
+        dt = time.perf_counter() - t0
+        if out.returncode == 0:
+            info = _parse_info_line(out.stdout)
+            if info is not None:
+                info["probe_s"] = round(dt, 1)
+                j.event("probe_ok", attempt=attempt, **info)
+                return info
+            last_err = (f"probe attempt {attempt}/{len(backoff_s)}: rc=0 "
+                        f"but no parseable JSON on stdout")
+        else:
+            last_err = (f"probe attempt {attempt}/{len(backoff_s)} failed "
+                        f"rc={out.returncode}")
+        j.event("probe_failed", attempt=attempt, rc=out.returncode,
+                stderr_tail=out.stderr.strip()[-300:])
+    raise DeviceUnreachable(
+        f"jax.devices() did not answer within {deadline_s:g}s in any of "
+        f"{len(backoff_s)} attempt(s) (backoffs {backoff_s}s); last: "
+        f"{last_err}", deadline_s, len(backoff_s), last_err)
+
+
+_dial_lock = threading.RLock()
+_backend_info: dict | None = None
+
+
+def backend_dialed() -> bool:
+    """True once :func:`ensure_backend` has completed in this process."""
+    return _backend_info is not None
+
+
+def ensure_backend(deadline_s=None, probe_in_subprocess=False,
+                   tag=None) -> dict:
+    """Initialize (or confirm) the JAX backend through the guarded path.
+
+    - Cached: after the first success this returns immediately, so
+      routing hot paths (the RNG global key, profiler start) through it
+      costs one dict lookup.
+    - ``probe_in_subprocess=True``: run :func:`probe_backend` first — a
+      wedged tunnel raises :class:`DeviceUnreachable` from the throwaway
+      child instead of wedging THIS process. Use it anywhere a hang is
+      worse than a ~2-5s subprocess jax import (driver gates, CLIs).
+    - The in-process dial is bracketed by journal breadcrumbs, and a
+      deadline timer dumps all-thread faulthandler tracebacks into the
+      journal if the dial stalls — an rc:124 artifact then carries
+      ``backend_dial`` as the last-known phase plus the hung stack.
+
+    Returns ``{"platform", "n", "dial_s", ...}``.
+    """
+    global _backend_info
+    if _backend_info is not None:
+        return _backend_info
+    with _dial_lock:
+        if _backend_info is not None:
+            return _backend_info
+        deadline = probe_deadline_s(deadline_s)
+        j = get_journal()
+        if probe_in_subprocess:
+            probe_backend(deadline_s=deadline)       # raises if unreachable
+        stalled = threading.Event()
+
+        def _on_stall():
+            stalled.set()
+            from .watchdog import _all_thread_tracebacks
+            j.event("backend_dial_stall", tag=tag, deadline_s=deadline,
+                    tracebacks=_all_thread_tracebacks())
+
+        timer = threading.Timer(deadline, _on_stall)
+        timer.daemon = True
+        with j.phase("backend_dial"):
+            j.event("backend_dial_begin", tag=tag, deadline_s=deadline)
+            timer.start()
+            t0 = time.perf_counter()
+            try:
+                import jax
+                devices = jax.devices()
+                info = {"platform": devices[0].platform, "n": len(devices),
+                        "dial_s": round(time.perf_counter() - t0, 1)}
+            finally:
+                timer.cancel()
+            if stalled.is_set():
+                j.event("backend_dial_recovered", tag=tag)
+            j.event("backend_ok", tag=tag, **info)
+        _backend_info = info
+        return info
+
+
+def _reset_for_tests() -> None:
+    global _backend_info
+    _backend_info = None

@@ -57,11 +57,24 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
                    axis_name="pipe", num_microbatches=None,
                    num_virtual_stages=1, embed_fn=None, embed_params=None,
                    head_fn=None, head_params=None, data_axis=None,
-                   params_are_split=False):
+                   params_are_split=False, stage_ctx=False):
     """Run ``x`` through L = num_virtual_stages * P pipeline layers.
 
     stage_fn(params_l, h) -> h'       same signature for every layer;
         activations must share one shape (they ride one ppermute ring)
+    stage_ctx: when True, stage_fn is instead called as
+        ``stage_fn(params_l, h, ctx)`` with ``ctx = {"layer": <traced
+        int, virtual pass * P + device = the layer index>, "tick":
+        <traced int, schedule tick>, "shard": <traced int, data-axis
+        shard index; 0 when data_axis is None>}`` INSIDE the scan body.
+        Fold all three into any RNG key the stage consumes: (layer,
+        tick) uniquely identifies one (layer, microbatch) application
+        and ``shard`` separates the dp ranks' slices, so dropout masks
+        are independent across stages, microbatches AND data shards
+        instead of one mask reused everywhere (ADVICE r5 medium).
+        ``shard`` must stay 0 when data_axis is None — the batch is
+        replicated there and per-device keys would desync the
+        replicated computation
     stage_params: pytree, leaves stacked (L, ...) — layer l lives on
         device l % P (virtual pass l // P)
     x: (B, ...) global batch, split into ``num_microbatches`` chunks
@@ -133,6 +146,10 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
     def body(params_local, e_params, h_params, micro_all):
         # params_local leaves: (v, 1, ...) — this device's layer stack
         d = lax.axis_index(axis_name)
+        # dp shard identity for stage_ctx keys; MUST be 0 when the batch
+        # is replicated (no data_axis) or per-device masks would desync
+        # the replicated computation
+        shard = lax.axis_index(data_axis) if data_axis is not None else 0
         is_first = d == 0
         is_last = d == p_size - 1
         micro_bs = micro_all.shape[1]
@@ -161,7 +178,12 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
             p_u = jnp.clip((t - d) // m, 0, v - 1)
             params_u = jax.tree_util.tree_map(
                 lambda a: jnp.take(a, p_u, axis=0)[0], params_local)
-            y = stage_fn(params_u, inp)
+            if stage_ctx:
+                y = stage_fn(params_u, inp,
+                             {"layer": p_u * p_size + d, "tick": t,
+                              "shard": shard})
+            else:
+                y = stage_fn(params_u, inp)
             nxt = lax.ppermute(y, axis_name, perm)
             # what device 0 just received from device P-1 is unit
             # t-(P-1) finishing a pass: stash it for re-injection
@@ -172,7 +194,10 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh = None,
 
         probe_params = jax.tree_util.tree_map(lambda a: a[0, 0],
                                               params_local)
-        act0 = jnp.zeros_like(stage_fn(probe_params, embedded[0]))
+        probe = (stage_fn(probe_params, embedded[0],
+                          {"layer": 0, "tick": 0, "shard": 0})
+                 if stage_ctx else stage_fn(probe_params, embedded[0]))
+        act0 = jnp.zeros_like(probe)
         # broadcast act0 in so the buffer carries the same varying-axis
         # type as the ppermute outputs that update it (shard_map vma)
         wrap0 = jnp.zeros((m,) + act0.shape, act0.dtype) + act0

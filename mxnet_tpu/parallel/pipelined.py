@@ -78,9 +78,13 @@ class PipelinedTrainer:
     Restrictions (v1, raised eagerly): body blocks must be structurally
     identical and aux-free, with matching input/output activation shapes;
     per-parameter lr/wd multipliers are not applied (the stacked layout
-    has no per-parameter identity). Dropout draws one mask per compiled
-    tick body — fine for training, but bit-parity tests should use
-    dropout=0.
+    has no per-parameter identity). Dropout masks are independent per
+    (layer, microbatch, dp shard) — the scan body folds layer identity,
+    the schedule tick and the data-axis index into the key — but the
+    draw ORDER differs from the
+    sequential dp-only model, so bit-parity tests against ShardedTrainer
+    should use dropout=0 (mode-off parity via ``evaluate`` holds at any
+    dropout rate).
     """
 
     def __init__(self, embed, body_blocks, head, loss_fn, optimizer,
@@ -193,10 +197,19 @@ class PipelinedTrainer:
                     training=training)
                 return outs[0]
 
-            def stage_fn(pl, hact):
+            def stage_fn(pl, hact, ctx):
+                # fold layer identity, schedule tick AND dp shard into
+                # the key: (layer, tick) names one (layer, microbatch)
+                # application and shard separates the dp ranks' slices,
+                # so every stage/microbatch/shard draws an independent
+                # dropout mask — one shared mask silently correlates
+                # regularization (ADVICE r5 medium)
+                k = jax.random.fold_in(jax.random.fold_in(
+                    jax.random.fold_in(jax.random.fold_in(key, 2),
+                                       ctx["layer"]), ctx["tick"]),
+                    ctx["shard"])
                 outs, _, _ = functional_apply(
-                    body_blk, jax.random.fold_in(key, 2), pl, [], [hact],
-                    training=training)
+                    body_blk, k, pl, [], [hact], training=training)
                 return outs[0]
 
             def head_fn(hp, hs):
@@ -211,7 +224,7 @@ class PipelinedTrainer:
                 embed_fn=embed_fn, embed_params=list(e_tr),
                 head_fn=head_fn, head_params=list(h_tr),
                 data_axis=(data if data in mesh.axis_names else None),
-                params_are_split=True)
+                params_are_split=True, stage_ctx=True)
         return forward
 
     def _build_step(self):

@@ -43,6 +43,11 @@ def set_state(state_name="stop", profile_process="worker"):
     if state_name == _state:
         return
     if state_name == "run":
+        # starting a device trace is a backend touch: route it through
+        # the diagnostics guard so a wedged tunnel leaves a journaled
+        # breadcrumb instead of hanging the profiler silently
+        from .diagnostics import guard
+        guard.ensure_backend(tag="profiler-start-trace")
         base = _config.get("filename", "profile.json")
         _trace_dir = os.path.splitext(base)[0] + "_trace"
         os.makedirs(_trace_dir, exist_ok=True)
