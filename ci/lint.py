@@ -1,158 +1,47 @@
 #!/usr/bin/env python
-"""Self-contained lint tier (ref: ci/docker/runtime_functions.sh
-sanity_check — the reference runs cpplint/pylint there). No third-party
-linters are baked into this image, so this is a dependency-free
-pylint-lite over the AST:
+"""Thin shim over the graftlint framework (``mxnet_tpu/analysis/``).
 
-  E1  syntax error (file does not compile)
-  W1  unused import
-  W2  bare ``except:``
-  W3  mutable default argument (list/dict/set literal)
-  W4  f-string with no placeholders
-  W5  trailing whitespace / tab indentation
-  W6  line longer than 100 columns
+The seed shipped this file as a self-contained dependency-free
+pylint-lite (W1-W6). Those rules now live in
+``mxnet_tpu/analysis/rules_generic.py`` on the same walker, suppression
+syntax, and baseline as the JAX-hazard G-rules — this entry point is
+kept so ``python ci/lint.py [paths...]`` and every script that calls it
+keep working unchanged.
 
-Usage: python ci/lint.py [paths...]   (default: mxnet_tpu tools examples
-benchmarks tests bench.py __graft_entry__.py)
-Exit code 1 on any finding — wired as the first CI tier.
+Dependency-free by construction: the analysis package is loaded BY PATH
+under a private name, so ``mxnet_tpu/__init__.py`` (which imports jax
+and the whole runtime) never executes. The linter therefore still runs
+— and still reports E1 — when the runtime package itself is broken or
+jax is absent, which is exactly when a lint tier earns its keep. CI
+tier-0 uses this entry point; ``python -m mxnet_tpu.analysis`` is the
+convenience form for developers with a working checkout.
+
+Full CLI (formats, baseline regeneration, rule filtering):
+``python ci/lint.py --help``; rule catalog in docs/static_analysis.md.
 """
-from __future__ import annotations
-
-import ast
+import importlib.util
 import os
 import sys
 
-DEFAULT_PATHS = ["mxnet_tpu", "tools", "examples", "benchmarks", "tests",
-                 "ci", "bench.py", "__graft_entry__.py"]
-MAX_LINE = 100
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def iter_py(paths):
-    for p in paths:
-        if os.path.isfile(p) and p.endswith(".py"):
-            yield p
-        elif os.path.isdir(p):
-            for root, _dirs, files in os.walk(p):
-                for f in sorted(files):
-                    if f.endswith(".py"):
-                        yield os.path.join(root, f)
-
-
-class ImportTracker(ast.NodeVisitor):
-    """Collect imported names and every referenced name. Imports inside
-    try/except are feature probes (the import IS the use) and
-    ``from __future__`` imports are semantic — neither is flagged."""
-
-    def __init__(self):
-        self.imports = {}       # name -> lineno
-        self.used = set()
-        self._try_depth = 0
-
-    def visit_Try(self, node):
-        self._try_depth += 1
-        self.generic_visit(node)
-        self._try_depth -= 1
-
-    def visit_Import(self, node):
-        if self._try_depth:
-            return
-        for a in node.names:
-            name = (a.asname or a.name).split(".")[0]
-            self.imports.setdefault(name, node.lineno)
-
-    def visit_ImportFrom(self, node):
-        if self._try_depth or node.module == "__future__":
-            return
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imports.setdefault(a.asname or a.name, node.lineno)
-
-    def visit_Name(self, node):
-        self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-
-def lint_file(path):
-    findings = []
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, "E1", f"syntax error: {e.msg}")]
-
-    lines = src.splitlines()
-    for i, line in enumerate(lines, 1):
-        if line != line.rstrip():
-            findings.append((path, i, "W5", "trailing whitespace"))
-        if line.startswith("\t") or (line[:1] == " " and "\t" in
-                                     line[:len(line) - len(line.lstrip())]):
-            findings.append((path, i, "W5", "tab indentation"))
-        if len(line) > MAX_LINE:
-            findings.append((path, i, "W6",
-                             f"line too long ({len(line)} > {MAX_LINE})"))
-
-    tracker = ImportTracker()
-    tracker.visit(tree)
-    # names exported via __all__ strings or re-exported in __init__ count
-    exported = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "__all__" and \
-                        isinstance(node.value, (ast.List, ast.Tuple)):
-                    for elt in node.value.elts:
-                        if isinstance(elt, ast.Constant):
-                            exported.add(str(elt.value))
-    is_init = os.path.basename(path) == "__init__.py"
-    for name, lineno in tracker.imports.items():
-        if name.startswith("_"):
-            continue
-        if name not in tracker.used and name not in exported and \
-                not is_init:
-            findings.append((path, lineno, "W1", f"unused import {name!r}"))
-
-    _format_specs = {id(n.format_spec) for n in ast.walk(tree)
-                     if isinstance(n, ast.FormattedValue)
-                     and n.format_spec is not None}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            findings.append((path, node.lineno, "W2", "bare except:"))
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for d in node.args.defaults + node.args.kw_defaults:
-                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                    findings.append((path, d.lineno, "W3",
-                                     "mutable default argument"))
-        if isinstance(node, ast.JoinedStr):
-            # skip format-spec JoinedStrs nested inside FormattedValue
-            # (e.g. the ':8.1f' in f"{x:8.1f}" parses as a JoinedStr)
-            if id(node) in _format_specs:
-                continue
-            if not any(isinstance(v, ast.FormattedValue)
-                       for v in node.values):
-                findings.append((path, node.lineno, "W4",
-                                 "f-string without placeholders"))
-    # `# noqa` suppression, checked here while the lines are in memory
-    return [f for f in findings
-            if not (1 <= f[1] <= len(lines) and "# noqa" in lines[f[1] - 1])]
+def _load_graftlint():
+    """Import mxnet_tpu/analysis as a standalone package (no parent
+    package execution, no jax)."""
+    pkg_dir = os.path.join(REPO, "mxnet_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "_graftlint", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_graftlint"] = mod
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def main():
-    paths = sys.argv[1:] or DEFAULT_PATHS
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    os.chdir(repo)
-    all_findings = []
-    n_files = 0
-    for path in iter_py(paths):
-        n_files += 1
-        all_findings.extend(lint_file(path))
-    for path, line, code, msg in all_findings:
-        print(f"{path}:{line}: {code} {msg}")
-    print(f"lint: {n_files} files, {len(all_findings)} findings")
-    return 1 if all_findings else 0
+    os.chdir(REPO)
+    return _load_graftlint().main(sys.argv[1:])
 
 
 if __name__ == "__main__":

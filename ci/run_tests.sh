@@ -3,8 +3,13 @@
 # spec of the reference's test matrix). Tiered like the reference's
 # sanity_check / unittest / nightly split:
 #
-#   ci/run_tests.sh sanity          lint only (ci/lint.py, dependency-free)
-#   ci/run_tests.sh fast            lint + the quick unit tier
+#   ci/run_tests.sh sanity          tier-0 static analysis only (graftlint:
+#                                   ci/lint.py path-loads mxnet_tpu/analysis
+#                                   without executing the runtime package —
+#                                   JAX-hazard G-rules + generic W-rules,
+#                                   new-vs-baseline gated; still runs when
+#                                   the runtime or jax itself is broken)
+#   ci/run_tests.sh fast            tier-0 + the quick unit tier
 #   ci/run_tests.sh sanitize        native runtime under ASAN/UBSAN + TSAN
 #                                   (ref: runtime_functions.sh sanitizer
 #                                   builds — SURVEY §5.2)
@@ -48,7 +53,7 @@ if [ "$TIER" = "sanitize" ]; then
   exit 0
 fi
 
-echo "== tier: sanity (lint) =="
+echo "== tier 0: graftlint static analysis (docs/static_analysis.md) =="
 python ci/lint.py
 
 if [ "$TIER" = "sanity" ]; then

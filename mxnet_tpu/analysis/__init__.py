@@ -1,0 +1,21 @@
+"""graftlint — the repo's AST-based static-analysis tier.
+
+One framework behind both the generic hygiene rules (``W*``, the old
+``ci/lint.py`` tier) and the project-specific JAX-hazard rules (``G*``:
+import-time backend dials, PRNG discipline, host syncs in traced code,
+undeadlined subprocesses, silent device-failure swallows). See
+``docs/static_analysis.md`` for the rule catalog and workflow; the
+runtime half of the same defense lives in ``mxnet_tpu/diagnostics``.
+
+CLI: ``python -m mxnet_tpu.analysis [paths] [--format=text|json|sarif]
+[--write-baseline] [--rules=...]``.
+"""
+from .core import (Finding, Rule, FileContext, all_rules, load_rules,
+                   lint_file, run, DEFAULT_PATHS, DEFAULT_EXCLUDES)
+from .baseline import load_baseline, partition, write_baseline
+from .cli import main, repo_root
+
+__all__ = ["Finding", "Rule", "FileContext", "all_rules", "load_rules",
+           "lint_file", "run", "DEFAULT_PATHS", "DEFAULT_EXCLUDES",
+           "load_baseline", "partition", "write_baseline", "main",
+           "repo_root"]

@@ -1,0 +1,87 @@
+"""Findings baseline — pre-existing findings don't block CI, new ones do.
+
+``ci/lint_baseline.json`` commits the accepted debt: each entry pins one
+finding by a *content* fingerprint (file + rule + normalized flagged-line
+text), so unrelated edits that shift line numbers don't invalidate it,
+while touching the flagged line itself re-opens the finding. Identical
+lines in one file share a fingerprint; the baseline therefore matches by
+count (two identical accepted findings = two entries).
+
+Workflow: ``python -m mxnet_tpu.analysis --write-baseline`` regenerates
+the file from the current findings, preserving the ``justification``
+strings of entries that persist. Entries whose finding disappeared are
+dropped automatically — the baseline only ever shrinks by fixing code.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+
+__all__ = ["load_baseline", "partition", "write_baseline",
+           "DEFAULT_BASELINE"]
+
+DEFAULT_BASELINE = os.path.join("ci", "lint_baseline.json")
+
+
+def load_baseline(path):
+    """Return the entry list (possibly empty) from a baseline file.
+    Raises ValueError (not a raw JSONDecodeError) on a malformed file
+    so the CLI can turn it into a usage error with a recovery hint."""
+    if not path or not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        try:
+            data = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"baseline {path} is not valid JSON ({e}); regenerate it "
+                f"with --write-baseline") from e
+    entries = data.get("entries", []) if isinstance(data, dict) else None
+    if not isinstance(entries, list):
+        raise ValueError(
+            f"baseline {path} is not a graftlint baseline object; "
+            f"regenerate it with --write-baseline")
+    return list(entries)
+
+
+def partition(findings, entries):
+    """Split findings into (new, baselined) against the entry list.
+    Matching is by fingerprint with multiset counting; the excess
+    occurrences (later in file order) are the new ones."""
+    budget = collections.Counter(e.get("fingerprint") for e in entries)
+    new, baselined = [], []
+    for f in findings:
+        if budget[f.fingerprint] > 0:
+            budget[f.fingerprint] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    return new, baselined
+
+
+def write_baseline(path, findings, keep_justifications=True):
+    """Regenerate the baseline from the current findings. Justifications
+    of surviving fingerprints carry over; fresh entries get an empty
+    string for a human to fill in."""
+    old_just = {}
+    if keep_justifications:
+        try:
+            entries = load_baseline(path)
+        except ValueError:
+            entries = []    # regenerating anyway: a broken file self-heals
+        for e in entries:
+            if e.get("justification"):
+                old_just.setdefault(e["fingerprint"], e["justification"])
+    entries = [{"rule": f.code, "path": f.path, "line": f.line,
+                "fingerprint": f.fingerprint,
+                "message": f.message,
+                "justification": old_just.get(f.fingerprint, "")}
+               for f in findings]
+    payload = {"tool": "graftlint", "version": 1, "entries": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    return entries

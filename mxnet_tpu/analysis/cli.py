@@ -1,0 +1,127 @@
+"""graftlint CLI — ``python -m mxnet_tpu.analysis``.
+
+Exit codes: 0 = no new findings (baselined debt allowed), 1 = new
+findings (or any finding with ``--no-baseline``), 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import baseline as _baseline
+from . import core, emitters
+
+__all__ = ["main", "repo_root"]
+
+
+def repo_root() -> str:
+    """The repo checkout this package lives in (two levels above the
+    package directory) — the anchor for default paths and the baseline."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="graftlint: JAX-hazard + generic static analysis "
+                    "(see docs/static_analysis.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint, repo-relative "
+                        "(default: the whole repo surface)")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text", help="output format (default: text)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline file (default: ci/lint_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding as new (audit mode)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate the baseline from current findings "
+                        "(preserves surviving justifications) and exit 0")
+    p.add_argument("--rules", default=None, metavar="CODES",
+                   help="comma-separated rule codes to run "
+                        "(e.g. W1,W2,G1); default: all")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print baselined findings (text format)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    root = repo_root()
+
+    registry = core.load_rules()
+    if args.list_rules:
+        for rule in registry.values():
+            print(f"{rule.code:4} {rule.severity:8} {rule.name}")
+            print(f"     {rule.doc}")
+        return 0
+
+    rules = list(registry.values())
+    if args.rules:
+        wanted = [c.strip() for c in args.rules.split(",") if c.strip()]
+        unknown = [c for c in wanted if c not in registry]
+        if unknown:
+            print(f"unknown rule code(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [registry[c] for c in wanted]
+
+    if args.write_baseline and (args.paths or args.rules) \
+            and not args.baseline:
+        # a narrowed scan regenerating the COMMITTED baseline would
+        # silently drop every out-of-scope entry
+        print("--write-baseline with paths/--rules would clobber the "
+              "default baseline with a partial scan; pass an explicit "
+              "--baseline FILE or run unfiltered", file=sys.stderr)
+        return 2
+
+    if args.paths:
+        # EVERY named path must resolve — a typo'd path among valid
+        # ones must not read as a clean pass
+        miss = core.missing_paths(args.paths, root=root)
+        if miss:
+            print(f"no .py files found under: {' '.join(miss)}",
+                  file=sys.stderr)
+            return 2
+    findings, n_files = core.run(args.paths or None, rules=rules, root=root)
+    if n_files == 0:
+        # the default scan finding nothing means repo_root() is not a
+        # checkout (e.g. an installed wheel) — not a clean pass
+        print(f"no .py files found under {root} — not a repo checkout?",
+              file=sys.stderr)
+        return 2
+
+    # a relative --baseline resolves against the repo root, like the scan
+    # paths and the default baseline — never against the process cwd
+    bl_path = args.baseline or _baseline.DEFAULT_BASELINE
+    if not os.path.isabs(bl_path):
+        bl_path = os.path.join(root, bl_path)
+    if args.write_baseline:
+        entries = _baseline.write_baseline(bl_path, findings)
+        print(f"graftlint: wrote {len(entries)} entries to "
+              f"{os.path.relpath(bl_path, root)}")
+        return 0
+
+    try:
+        entries = [] if args.no_baseline else \
+            _baseline.load_baseline(bl_path)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    new, baselined = _baseline.partition(findings, entries)
+
+    if args.format == "text":
+        emitters.emit_text(new, baselined, n_files, sys.stdout,
+                           verbose_baselined=args.show_baselined)
+    elif args.format == "json":
+        emitters.dump_json(emitters.to_json(new, baselined, n_files),
+                           sys.stdout)
+    else:
+        emitters.dump_json(emitters.to_sarif(new, baselined,
+                                             list(registry.values())),
+                           sys.stdout)
+    return 1 if new else 0

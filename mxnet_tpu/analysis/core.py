@@ -1,0 +1,351 @@
+"""graftlint core — the one AST walker behind every static-analysis tier.
+
+The seed's ``ci/lint.py`` was a flat list of generic checks; the round-4/5
+wedge (a module-scope backend dial in ``_rng.py``) proved that the hazards
+that actually cost benchmark windows are *semantic* and project-specific.
+This module is the shared substrate: file iteration, import-alias
+resolution, a rule registry keyed by code (``G*`` JAX-hazard rules,
+``W*``/``E*`` generic rules), and one suppression syntax.
+
+Dependency-free by the same contract as the old lint tier: stdlib only,
+and importable without touching jax (rules reason about *source*, never
+the runtime).
+
+Suppressions (one syntax for every rule)::
+
+    x = jax.devices()   # graftlint: disable=G4 reason for the exception
+    # graftlint: disable=G1,G2 applies to the NEXT line when alone
+    y = probe()
+
+Legacy ``# noqa`` (any code, that line only) is still honored so the
+pre-framework annotations keep working; new code should use the
+``graftlint`` form, which is per-code and carries a reason.
+
+A file whose first lines contain ``# graftlint: scope=library`` is held
+to library-code rules (G2/G4) even outside ``mxnet_tpu/`` — the hook the
+rule fixtures under ``tests/data/graftlint/`` use.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+
+__all__ = ["Finding", "Rule", "FileContext", "register", "all_rules",
+           "load_rules", "lint_file", "run", "iter_py",
+           "DEFAULT_PATHS", "DEFAULT_EXCLUDES"]
+
+# same surface the old lint tier scanned, plus setup.py
+DEFAULT_PATHS = ["mxnet_tpu", "tools", "examples", "benchmarks", "tests",
+                 "ci", "bench.py", "__graft_entry__.py", "setup.py"]
+# seeded-violation fixtures must never count against the repo
+DEFAULT_EXCLUDES = ("tests/data",)
+
+_DISABLE_RE = re.compile(
+    r"#\s*graftlint:\s*disable="
+    r"([A-Za-z0-9]+(?:\s*,\s*[A-Za-z0-9]+)*)(?:\s+(?P<reason>.*))?")
+_SCOPE_RE = re.compile(r"#\s*graftlint:\s*scope=library\b")
+_ALL = "__all_codes__"
+
+
+@dataclass
+class Finding:
+    """One diagnostic: a rule code anchored to a repo-relative line."""
+    path: str
+    line: int
+    code: str
+    message: str
+    severity: str = "warning"
+    fingerprint: str = ""
+
+    def sort_key(self):
+        return (self.path, self.line, self.code, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+class Rule:
+    """Base class: subclasses set ``code``/``name``/``severity``/``doc``
+    and yield :class:`Finding` from ``check(ctx)``. ``doc`` is the rule
+    catalog entry (docs/static_analysis.md + SARIF rule metadata)."""
+
+    code = ""
+    name = ""
+    severity = "warning"
+    doc = ""
+
+    def check(self, ctx: "FileContext"):
+        raise NotImplementedError
+
+    def finding(self, ctx, line, message) -> Finding:
+        return Finding(ctx.path, line, self.code, message, self.severity)
+
+
+_RULES: dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and index the rule by its code."""
+    inst = cls()
+    if not inst.code or inst.code in _RULES:
+        # not an assert: must survive python -O, or a duplicate code
+        # silently shadows an existing rule
+        raise ValueError(f"duplicate or empty rule code: {inst.code!r}")
+    _RULES[inst.code] = inst
+    return cls
+
+
+def load_rules() -> dict[str, Rule]:
+    """Import the rule modules (idempotent) and return the registry."""
+    from . import rules_generic, rules_jax   # noqa  (registration side effect)
+    return dict(sorted(_RULES.items()))
+
+
+def all_rules() -> list[Rule]:
+    return list(load_rules().values())
+
+
+def _dotted_parts(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+class FileContext:
+    """Per-file analysis state shared by every rule: source, AST, and the
+    import-alias map that lets rules resolve ``jnp.asarray`` →
+    ``jax.numpy.asarray`` without executing anything."""
+
+    def __init__(self, path: str, src: str, tree: ast.AST):
+        self.path = path.replace(os.sep, "/")
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = tree
+        self.aliases = self._import_aliases(tree)
+        head = "\n".join(self.lines[:5])
+        self._library = (self.path.startswith("mxnet_tpu/")
+                         or bool(_SCOPE_RE.search(head)))
+
+    @staticmethod
+    def _import_aliases(tree) -> dict[str, str]:
+        aliases = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        aliases[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        aliases.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and not node.level:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def is_library(self) -> bool:
+        """True for framework code held to the stricter G2/G4 scope."""
+        return self._library
+
+    def resolve(self, node) -> str | None:
+        """Dotted name of a Name/Attribute with the import aliases
+        expanded, e.g. ``jr.split`` → ``jax.random.split``. None for
+        anything not a plain dotted chain."""
+        parts = _dotted_parts(node)
+        if not parts:
+            return None
+        expansion = self.aliases.get(parts[0])
+        if expansion:
+            parts = expansion.split(".") + parts[1:]
+        return ".".join(parts)
+
+    def resolve_call(self, call: ast.Call) -> str | None:
+        return self.resolve(call.func)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def _suppressions(lines) -> dict[int, set[str]]:
+    """line -> set of suppressed codes (``_ALL`` = every code).
+
+    Tokenize-based: only REAL comments count, so a string literal that
+    merely quotes the suppression syntax (help text, error messages)
+    never masks a co-located finding. Falls back to a plain line scan
+    only if tokenization fails (it shouldn't: callers parsed the file)."""
+    sup: dict[int, set[str]] = {}
+
+    def apply(i, text):
+        m = _DISABLE_RE.search(text)
+        if m:
+            codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+            # a comment-only disable line covers the next line
+            line = lines[i - 1] if 1 <= i <= len(lines) else ""
+            target = i + 1 if line.strip().startswith("#") else i
+            sup.setdefault(target, set()).update(codes)
+        if "# noqa" in text:                     # legacy, that line only
+            sup.setdefault(i, set()).add(_ALL)
+
+    src = "\n".join(lines) + "\n"
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                apply(tok.start[0], tok.string)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, line in enumerate(lines, 1):
+            apply(i, line)
+    return sup
+
+
+def _fingerprint(path: str, code: str, line_text: str) -> str:
+    """Content-based identity for baseline matching: stable across
+    unrelated edits that only shift line numbers."""
+    norm = "".join(line_text.split())
+    raw = f"{path}|{code}|{norm}".encode("utf-8", "replace")
+    return hashlib.sha1(raw).hexdigest()[:12]
+
+
+def lint_file(path: str, rules=None, root: str | None = None):
+    """Run every rule over one file; returns suppression-filtered,
+    fingerprinted, sorted findings."""
+    rules = rules if rules is not None else all_rules()
+    rel = path
+    if root:
+        ap = os.path.abspath(path)
+        aroot = os.path.abspath(root)
+        if ap.startswith(aroot + os.sep):
+            rel = os.path.relpath(ap, aroot)
+    rel = rel.replace(os.sep, "/")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        lines = src.splitlines()
+        ln = e.lineno or 0
+        text = lines[ln - 1] if 1 <= ln <= len(lines) else ""
+        f = Finding(rel, ln, "E1", f"syntax error: {e.msg}", "error")
+        # fingerprinted like every finding — a baselined E1 in one file
+        # must never absorb a fresh syntax error in another
+        f.fingerprint = _fingerprint(rel, "E1", text)
+        return [f]
+    ctx = FileContext(rel, src, tree)
+    findings = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    if not findings:
+        return []       # clean file: skip the suppression/span passes
+    sup = _suppressions(ctx.lines)
+    # a disable anywhere on a multi-line SIMPLE statement covers the
+    # whole statement — the natural comment spot is the closing line,
+    # while findings anchor to the opening one
+    spans = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.stmt):
+            continue
+        body = getattr(n, "body", None)
+        cases = getattr(n, "cases", None)
+        if isinstance(body, list) and body:
+            # compound statement: span only its multi-line HEADER (the
+            # test/subject up to the first inner line), never the body
+            end = body[0].lineno - 1
+        elif cases:
+            end = cases[0].pattern.lineno - 1   # match_case has no lineno
+        else:
+            end = getattr(n, "end_lineno", n.lineno)
+        if end > n.lineno:
+            spans.append((n.lineno, end))
+
+    def codes_for(line):
+        codes = set(sup.get(line, ()))
+        for s, e in spans:
+            if s <= line <= e:
+                for ln in range(s, e + 1):
+                    if ln != line:
+                        # legacy `# noqa` (_ALL) stays line-only by
+                        # contract; graftlint codes cover the statement
+                        codes |= sup.get(ln, set()) - {_ALL}
+        return codes
+
+    out = []
+    for f in findings:
+        codes = codes_for(f.line)
+        if f.code in codes or _ALL in codes:
+            continue
+        f.fingerprint = _fingerprint(f.path, f.code, ctx.line_text(f.line))
+        out.append(f)
+    out.sort(key=Finding.sort_key)
+    return out
+
+
+def iter_py(paths, excludes=DEFAULT_EXCLUDES, root="."):
+    """Yield .py files under ``paths`` (relative to ``root``) exactly
+    once each (overlapping paths dedup). ``excludes`` prefixes are
+    skipped during directory walks — but a path the caller names that
+    is *itself* at/under an exclude is an explicit opt-in and scans
+    fully (how the fixture tests lint the fixture corpus)."""
+
+    def excluded(rel):
+        rel = rel.replace(os.sep, "/")
+        return any(rel == e or rel.startswith(e + "/") for e in excludes)
+
+    seen = set()
+
+    def fresh(fp):
+        ap = os.path.abspath(fp)
+        if ap in seen:
+            return False
+        seen.add(ap)
+        return True
+
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            if fresh(full):
+                yield full
+        elif os.path.isdir(full):
+            opted_in = excluded(os.path.relpath(full, root))
+            for dirpath, _dirs, files in os.walk(full):
+                for fname in sorted(files):
+                    if not fname.endswith(".py"):
+                        continue
+                    fp = os.path.join(dirpath, fname)
+                    if opted_in or not excluded(os.path.relpath(fp, root)):
+                        if fresh(fp):
+                            yield fp
+
+
+def missing_paths(paths, excludes=DEFAULT_EXCLUDES, root="."):
+    """The subset of ``paths`` yielding no .py file at all — a typo'd
+    path in a scan list must not read as a clean pass."""
+    return [p for p in paths
+            if next(iter_py([p], excludes=excludes, root=root), None)
+            is None]
+
+
+def run(paths=None, rules=None, excludes=DEFAULT_EXCLUDES, root="."):
+    """Lint ``paths`` (default: the repo surface). Returns
+    ``(findings, n_files)``. See :func:`iter_py` for how excludes
+    interact with explicitly named paths."""
+    paths = paths or DEFAULT_PATHS
+    rules = rules if rules is not None else all_rules()
+    findings, n_files = [], 0
+    for fp in iter_py(paths, excludes=excludes, root=root):
+        n_files += 1
+        findings.extend(lint_file(fp, rules=rules, root=root))
+    findings.sort(key=Finding.sort_key)
+    return findings, n_files

@@ -1,0 +1,89 @@
+"""Output emitters: human text, machine JSON, and SARIF 2.1.0 (the
+interchange format code-review UIs ingest — GitHub code scanning,
+VS Code SARIF viewer)."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["emit_text", "to_json", "to_sarif", "dump_json"]
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning", "note": "note"}
+
+
+def emit_text(new, baselined, n_files, stream, verbose_baselined=False):
+    """The classic ``path:line: CODE message`` listing plus a summary
+    line. Only NEW findings print by default — baselined debt is a
+    count, not noise."""
+    for f in new:
+        stream.write(f.render() + "\n")
+    if verbose_baselined:
+        for f in baselined:
+            stream.write(f.render() + "  [baselined]\n")
+    stream.write(
+        f"graftlint: {n_files} files, {len(new) + len(baselined)} findings "
+        f"({len(new)} new, {len(baselined)} baselined)\n")
+
+
+def _finding_dict(f):
+    return {"path": f.path, "line": f.line, "rule": f.code,
+            "severity": f.severity, "message": f.message,
+            "fingerprint": f.fingerprint}
+
+
+def to_json(new, baselined, n_files):
+    return {
+        "tool": "graftlint",
+        "files": n_files,
+        "new": [_finding_dict(f) for f in new],
+        "baselined": [_finding_dict(f) for f in baselined],
+    }
+
+
+def to_sarif(new, baselined, rules):
+    """Minimal-but-valid SARIF 2.1.0 run. Baselined findings ride along
+    with ``baselineState: unchanged`` so viewers can filter them; new
+    ones carry ``baselineState: new``."""
+
+    def result(f, state):
+        return {
+            "ruleId": f.code,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "baselineState": state,
+            "partialFingerprints": {"graftlint/v1": f.fingerprint},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1)},
+                }
+            }],
+        }
+
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "fullName": "graftlint (rule catalog: "
+                            "docs/static_analysis.md)",
+                "rules": [{
+                    "id": r.code,
+                    "name": r.name,
+                    "defaultConfiguration":
+                        {"level": _SARIF_LEVEL.get(r.severity, "warning")},
+                    "shortDescription": {"text": r.name},
+                    "fullDescription": {"text": r.doc},
+                } for r in rules],
+            }},
+            "results": [result(f, "new") for f in new] +
+                       [result(f, "unchanged") for f in baselined],
+        }],
+    }
+
+
+def dump_json(obj, stream):
+    json.dump(obj, stream, indent=2)
+    stream.write("\n")

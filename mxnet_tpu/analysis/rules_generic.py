@@ -1,0 +1,174 @@
+"""Generic Python hygiene rules — the old ``ci/lint.py`` W-tier, ported
+onto the graftlint framework so there is one walker, one suppression
+syntax, and one baseline for both the generic and the JAX-hazard tiers.
+
+Semantics are kept bit-compatible with the seed's lint so the repo stays
+clean through the refactor: imports inside ``try`` are feature probes
+(the import IS the use), ``__init__.py`` re-exports don't count as
+unused, ``__all__`` strings count as uses.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Rule, register
+
+MAX_LINE = 100
+
+
+@register
+class SyntaxErrorRule(Rule):
+    """E1 is emitted by the runner (a file that does not parse runs no
+    other rule); registered here so it has catalog + SARIF metadata."""
+
+    code = "E1"
+    name = "syntax-error"
+    severity = "error"
+    doc = "File does not compile under the current Python."
+
+    def check(self, ctx):
+        return ()
+
+
+class _ImportTracker(ast.NodeVisitor):
+    """Imported names vs referenced names (see module docstring for the
+    deliberate exemptions)."""
+
+    def __init__(self):
+        self.imports = {}       # name -> lineno
+        self.used = set()
+        self._try_depth = 0
+
+    def visit_Try(self, node):
+        self._try_depth += 1
+        self.generic_visit(node)
+        self._try_depth -= 1
+
+    def visit_Import(self, node):
+        if self._try_depth:
+            return
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imports.setdefault(name, node.lineno)
+
+    def visit_ImportFrom(self, node):
+        if self._try_depth or node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports.setdefault(a.asname or a.name, node.lineno)
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+@register
+class UnusedImport(Rule):
+    code = "W1"
+    name = "unused-import"
+    doc = ("Imported name never referenced. Imports inside try/except "
+           "(feature probes), `__all__`-exported names, `_`-prefixed "
+           "names, and `__init__.py` re-exports are exempt.")
+
+    def check(self, ctx):
+        if os.path.basename(ctx.path) == "__init__.py":
+            return
+        tracker = _ImportTracker()
+        tracker.visit(ctx.tree)
+        exported = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__" and \
+                            isinstance(node.value, (ast.List, ast.Tuple)):
+                        for elt in node.value.elts:
+                            if isinstance(elt, ast.Constant):
+                                exported.add(str(elt.value))
+        for name, lineno in tracker.imports.items():
+            if name.startswith("_"):
+                continue
+            if name not in tracker.used and name not in exported:
+                yield self.finding(ctx, lineno, f"unused import {name!r}")
+
+
+@register
+class BareExcept(Rule):
+    code = "W2"
+    name = "bare-except"
+    doc = "`except:` with no exception type catches SystemExit/KeyboardInterrupt."
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield self.finding(ctx, node.lineno, "bare except:")
+
+
+@register
+class MutableDefault(Rule):
+    code = "W3"
+    name = "mutable-default-argument"
+    doc = "list/dict/set literal default is shared across calls."
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in node.args.defaults + node.args.kw_defaults:
+                    if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                        yield self.finding(ctx, d.lineno,
+                                           "mutable default argument")
+
+
+@register
+class PointlessFString(Rule):
+    code = "W4"
+    name = "f-string-without-placeholders"
+    doc = "f-string with no {placeholders} — the prefix is a no-op."
+
+    def check(self, ctx):
+        format_specs = {id(n.format_spec) for n in ast.walk(ctx.tree)
+                        if isinstance(n, ast.FormattedValue)
+                        and n.format_spec is not None}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.JoinedStr):
+                # skip format-spec JoinedStrs nested inside FormattedValue
+                # (e.g. the ':8.1f' in f"{x:8.1f}" parses as a JoinedStr)
+                if id(node) in format_specs:
+                    continue
+                if not any(isinstance(v, ast.FormattedValue)
+                           for v in node.values):
+                    yield self.finding(ctx, node.lineno,
+                                       "f-string without placeholders")
+
+
+@register
+class Whitespace(Rule):
+    code = "W5"
+    name = "whitespace"
+    doc = "Trailing whitespace or tab indentation."
+
+    def check(self, ctx):
+        for i, line in enumerate(ctx.lines, 1):
+            if line != line.rstrip():
+                yield self.finding(ctx, i, "trailing whitespace")
+            if line.startswith("\t") or (
+                    line[:1] == " " and
+                    "\t" in line[:len(line) - len(line.lstrip())]):
+                yield self.finding(ctx, i, "tab indentation")
+
+
+@register
+class LineLength(Rule):
+    code = "W6"
+    name = "line-too-long"
+    doc = f"Line longer than {MAX_LINE} columns."
+
+    def check(self, ctx):
+        for i, line in enumerate(ctx.lines, 1):
+            if len(line) > MAX_LINE:
+                yield self.finding(
+                    ctx, i, f"line too long ({len(line)} > {MAX_LINE})")

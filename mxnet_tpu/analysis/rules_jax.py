@@ -1,0 +1,585 @@
+"""JAX-hazard rules (G-codes) — project-specific semantics grounded in
+defects this repo actually shipped:
+
+- G1: the round-4/5 wedge class itself — ``_rng.py`` dialed the backend
+  at module scope, so ``import mxnet_tpu`` in a tunnel-pinned process
+  hung before any wedge-proofing could run (VERDICT r5).
+- G4/G6: ``engine.waitall`` probed devices directly and swallowed every
+  failure silently (the anti-pattern the diagnostics journal exists to
+  kill).
+- G5: the PR-1 deadline lesson — every undeadlined subprocess is a
+  future rc:124 with no artifact.
+
+Each rule resolves names through the file's import aliases
+(``jnp.asarray`` → ``jax.numpy.asarray``); none of them import jax.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, register
+
+# calls that initialize (or require) a live backend client — including
+# jax.numpy array CREATION: the first concrete array is a backend touch
+# (guard.py's docstring names it), so a module-scope jnp constant wedges
+# importers exactly like a module-scope jax.devices()
+BACKEND_DIAL = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.device_put", "jax.device_get",
+    "jax.default_backend", "jax.process_index", "jax.process_count",
+    "jax.block_until_ready", "jax.random.PRNGKey", "jax.random.key",
+} | {"jax.numpy." + f for f in (
+    "array", "asarray", "zeros", "ones", "full", "empty", "arange",
+    "linspace", "eye", "identity", "zeros_like", "ones_like",
+    "full_like")}
+
+DEVICE_PROBES = {"jax.devices", "jax.local_devices"}
+
+KEY_MAKERS = {"jax.random.PRNGKey", "jax.random.key"}
+
+# jax.random draws that consume a key (split/fold_in deliberately absent)
+SAMPLERS = {
+    "uniform", "normal", "bernoulli", "bits", "randint", "permutation",
+    "shuffle", "categorical", "gamma", "beta", "exponential", "poisson",
+    "truncated_normal", "gumbel", "laplace", "cauchy", "choice",
+    "dirichlet", "multivariate_normal", "rademacher", "t", "logistic",
+}
+
+JIT_WRAPPERS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+PARTIALS = {"functools.partial", "partial"}
+
+# (callable, indices of function-valued args) for traced-body detection
+TRACED_ARG_CALLS = {
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.lax.associative_scan": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+}
+
+HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+HOST_SYNC_CALLS = {"numpy.asarray", "numpy.array", "jax.device_get",
+                   "jax.block_until_ready"}
+
+
+def _is_main_guard(test) -> bool:
+    """True for the ``__name__ == "__main__"`` comparison (either
+    operand order) — that body runs as a script, never at import."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Eq)):
+        return False
+    operands = [test.left] + test.comparators
+    names = {o.id for o in operands if isinstance(o, ast.Name)}
+    consts = {o.value for o in operands if isinstance(o, ast.Constant)}
+    return "__name__" in names and "__main__" in consts
+
+
+def _walk_import_time(tree):
+    """Yield (node, import_time) for the whole module: a node is
+    import-time iff no function/lambda/genexp body (or ``__main__``
+    guard) encloses it. Decorators, default argument values, class
+    bodies — and annotations, unless ``from __future__ import
+    annotations`` defers them — DO run at import."""
+    out = []
+    lazy_annotations = any(
+        isinstance(n, ast.ImportFrom) and n.module == "__future__"
+        and any(a.name == "annotations" for a in n.names)
+        for n in tree.body)
+
+    def visit_annotation(ann, import_time):
+        if ann is not None and not lazy_annotations:
+            visit(ann, import_time)
+
+    def visit(node, import_time):
+        out.append((node, import_time))
+        if isinstance(node, ast.If) and _is_main_guard(node.test):
+            visit(node.test, import_time)
+            for child in node.body:
+                visit(child, False)
+            for child in node.orelse:
+                visit(child, import_time)
+            return
+        if isinstance(node, ast.AnnAssign):
+            visit_annotation(node.annotation, import_time)
+            visit(node.target, import_time)
+            if node.value is not None:
+                visit(node.value, import_time)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                visit(d, import_time)
+            for d in node.args.defaults:
+                visit(d, import_time)
+            for d in node.args.kw_defaults:
+                if d is not None:
+                    visit(d, import_time)
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + [a.vararg, a.kwarg]):
+                if arg is not None:
+                    visit_annotation(arg.annotation, import_time)
+            visit_annotation(node.returns, import_time)
+            for child in node.body:
+                visit(child, False)
+            return
+        if isinstance(node, ast.GeneratorExp):
+            # building a genexp evaluates ONLY the first iterable; the
+            # body is deferred until iteration
+            visit(node.generators[0].iter, import_time)
+            for i, gen in enumerate(node.generators):
+                visit(gen.target, False)
+                if i > 0:
+                    visit(gen.iter, False)
+                for cond in gen.ifs:
+                    visit(cond, False)
+            visit(node.elt, False)
+            return
+        if isinstance(node, ast.Lambda):
+            # lambda DEFAULTS evaluate when the expression does (maybe
+            # at import); only the body is deferred
+            for d in node.args.defaults:
+                visit(d, import_time)
+            for d in node.args.kw_defaults:
+                if d is not None:
+                    visit(d, import_time)
+            visit(node.body, False)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, import_time)
+
+    visit(tree, True)
+    return out
+
+
+@register
+class ModuleScopeBackendDial(Rule):
+    code = "G1"
+    name = "module-scope-backend-dial"
+    severity = "error"
+    doc = ("Backend-dialing call (jax.devices/device_put/PRNGKey/...) "
+           "reachable at import time — module scope, class body, "
+           "decorator, or default argument. An import-time dial hangs "
+           "every process that imports the module when the TPU tunnel "
+           "is wedged (the round-4/5 rc:124 root cause). Defer the "
+           "touch into a function and route it through "
+           "mxnet_tpu.diagnostics.guard.")
+
+    def check(self, ctx):
+        for node, import_time in _walk_import_time(ctx.tree):
+            if not import_time or not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve_call(node)
+            if name in BACKEND_DIAL:
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"module-scope backend dial: {name}() runs at import "
+                    f"time; defer it into a function (guarded by "
+                    f"diagnostics.guard)")
+
+
+@register
+class PrngDiscipline(Rule):
+    code = "G2"
+    name = "prng-discipline"
+    doc = ("Library code must not bake constant PRNG keys "
+           "(jax.random.PRNGKey(0) gives every caller the same stream "
+           "and dials the backend wherever it runs), and must not feed "
+           "the same key to two draws without an intervening "
+           "split/fold_in (identical randomness — the correlated-"
+           "dropout-mask class fixed in PR 1). Scope: mxnet_tpu/ "
+           "library code.")
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and ctx.resolve_call(node) in KEY_MAKERS \
+                    and ((node.args
+                          and isinstance(node.args[0], ast.Constant))
+                         or any(kw.arg == "seed"
+                                and isinstance(kw.value, ast.Constant)
+                                for kw in node.keywords)):
+                yield self.finding(
+                    ctx, node.lineno,
+                    "constant PRNG key in library code: every caller "
+                    "draws the identical stream (thread a key in, or use "
+                    "_rng.next_key())")
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_reuse(ctx, fn)
+
+    def _check_reuse(self, ctx, fn):
+        out = []
+        self._scan_block(ctx, fn.body, set(), out)
+        return out
+
+    def _scan_block(self, ctx, stmts, drawn, out):
+        """Key-lifetime scan, branch-aware: mutually exclusive branches
+        each fork the drawn-set (one draw per if/else arm is NOT reuse);
+        afterwards the union flows on (a draw in any arm plus a later
+        draw of the same key IS)."""
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                self._apply_events(ctx, [stmt.test], drawn, out)
+                forks = []
+                for block in (stmt.body, stmt.orelse):
+                    d = set(drawn)
+                    self._scan_block(ctx, block, d, out)
+                    # a terminating arm (guard clause) never rejoins the
+                    # fall-through flow — its draws don't leak forward
+                    if not self._terminates(block):
+                        forks.append(d)
+                drawn.update(*forks)
+            elif isinstance(stmt, ast.Try):
+                self._scan_block(ctx, stmt.body, drawn, out)
+                # handlers and the else-block are mutually exclusive
+                # alternatives after the body
+                base = set(drawn)
+                forks = []
+                blocks = [h.body for h in stmt.handlers]
+                if stmt.orelse:
+                    blocks.append(stmt.orelse)
+                for block in blocks:
+                    d = set(base)
+                    self._scan_block(ctx, block, d, out)
+                    if not self._terminates(block):
+                        forks.append(d)
+                drawn.update(*forks)
+                self._scan_block(ctx, stmt.finalbody, drawn, out)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._apply_events(ctx, [stmt.iter], drawn, out)
+                # the loop target rebinds EVERY iteration — a fresh key
+                # per pass (`for k in jax.random.split(key, n):`)
+                targets = [sub.id for sub in ast.walk(stmt.target)
+                           if isinstance(sub, ast.Name)]
+                self._scan_loop_body(ctx, stmt.body, drawn, out,
+                                     refresh=targets)
+                self._scan_block(ctx, stmt.orelse, drawn, out)
+            elif isinstance(stmt, ast.While):
+                self._apply_events(ctx, [stmt.test], drawn, out)
+                self._scan_loop_body(ctx, stmt.body, drawn, out)
+                self._scan_block(ctx, stmt.orelse, drawn, out)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._apply_events(
+                    ctx, [i.context_expr for i in stmt.items], drawn, out)
+                for item in stmt.items:     # `as key:` rebinds
+                    if item.optional_vars is not None:
+                        for sub in ast.walk(item.optional_vars):
+                            if isinstance(sub, ast.Name):
+                                drawn.discard(sub.id)
+                self._scan_block(ctx, stmt.body, drawn, out)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                pass            # separate scope, scanned on its own
+            elif isinstance(stmt, ast.Match):
+                # match arms are mutually exclusive, like if/else
+                self._apply_events(ctx, [stmt.subject], drawn, out)
+                forks = []
+                for case in stmt.cases:
+                    d = set(drawn)
+                    self._scan_block(ctx, case.body, d, out)
+                    if not self._terminates(case.body):
+                        forks.append(d)
+                drawn.update(*forks)
+            else:
+                self._apply_events(ctx, [stmt], drawn, out)
+
+    @staticmethod
+    def _terminates(stmts) -> bool:
+        """True when a block's flow cannot rejoin the statement after
+        its parent (guard clauses: return/raise/break/continue last)."""
+        return bool(stmts) and isinstance(
+            stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+    def _scan_loop_body(self, ctx, stmts, drawn, out, refresh=()):
+        """Loop bodies run repeatedly: a second pass seeded with the
+        first pass's drawn-set catches a same-key draw repeated across
+        iterations (the correlated-mask-per-tick class from PR 1) while
+        a per-iteration split/fold_in still clears it. ``refresh``
+        names (the for-loop target) rebind before every pass."""
+        for var in refresh:
+            drawn.discard(var)
+        self._scan_block(ctx, stmts, drawn, out)
+        for var in refresh:
+            drawn.discard(var)
+        second = []
+        self._scan_block(ctx, stmts, drawn, second)
+        seen = {(f.line, f.message) for f in out}
+        out.extend(f for f in second if (f.line, f.message) not in seen)
+
+    def _apply_events(self, ctx, nodes, drawn, out):
+        for node in nodes:
+            self._apply_node(ctx, node, drawn, out)
+
+    def _apply_node(self, ctx, node, drawn, out):
+        """Fold one node's draw/refresh events into the drawn-set in
+        evaluation order, forking at expression-level branches (IfExp,
+        short-circuiting BoolOp) exactly like _scan_block forks at
+        statement-level if/match. Nested defs/lambdas are own scopes."""
+        if node is None or isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.IfExp):
+            self._apply_node(ctx, node.test, drawn, out)
+            forks = []
+            for arm in (node.body, node.orelse):
+                d = set(drawn)
+                self._apply_node(ctx, arm, d, out)
+                forks.append(d)
+            drawn.update(*forks)
+            return
+        if isinstance(node, ast.BoolOp):
+            # operands after the first may be short-circuited away
+            self._apply_node(ctx, node.values[0], drawn, out)
+            forks = []
+            for v in node.values[1:]:
+                d = set(drawn)
+                self._apply_node(ctx, v, d, out)
+                forks.append(d)
+            drawn.update(*forks)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.NamedExpr,
+                             ast.AnnAssign)):
+            # value evaluates first; binding the targets then REFRESHES
+            # them (k, sub = split(k) never reads stale state); walrus
+            # and annotated rebinds count too
+            if isinstance(node, ast.AnnAssign) and node.value is None:
+                return              # bare annotation: nothing binds
+            self._apply_node(ctx, node.value, drawn, out)
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        drawn.discard(sub.id)
+            return
+        if isinstance(node, ast.Call):
+            for child in ast.iter_child_nodes(node):
+                self._apply_node(ctx, child, drawn, out)
+            name = ctx.resolve_call(node) or ""
+            if name.startswith("jax.random.") and \
+                    name.rsplit(".", 1)[-1] in SAMPLERS and \
+                    node.args and isinstance(node.args[0], ast.Name):
+                # a refresh happens only when the split/fold_in RESULT is
+                # bound (the Assign-target discard) — `split(key)` with
+                # the result dropped does not freshen `key`
+                var = node.args[0].id
+                if var in drawn:
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"PRNG key {var!r} fed to a second draw with no "
+                        f"split/fold_in between — identical random bits"))
+                else:
+                    drawn.add(var)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._apply_node(ctx, child, drawn, out)
+
+
+def _static_under_trace(arg) -> bool:
+    """True when the expression reads tracer METADATA (.shape/.ndim/
+    .size/.dtype, len()) — static Python values during tracing, so
+    int()/float() over them is trace-safe, not a host sync."""
+    for n in ast.walk(arg):
+        if isinstance(n, ast.Attribute) and n.attr in (
+                "shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "len":
+            return True
+    return False
+
+
+def _traced_functions(ctx):
+    """FunctionDef/Lambda nodes whose bodies run under trace: jit/pjit-
+    decorated defs, plus functions handed to lax control-flow combinators
+    (scan/while/cond/...) by name or inline lambda."""
+    by_name = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    traced = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = ctx.resolve(target)
+                if name in JIT_WRAPPERS:
+                    traced.append(node)
+                elif isinstance(dec, ast.Call) and name in PARTIALS and \
+                        any(ctx.resolve(a) in JIT_WRAPPERS
+                            for a in dec.args):
+                    traced.append(node)
+        elif isinstance(node, ast.Call):
+            name = ctx.resolve_call(node)
+            arg_idx = ()
+            if name in TRACED_ARG_CALLS:
+                arg_idx = TRACED_ARG_CALLS[name]
+            elif name in JIT_WRAPPERS:
+                arg_idx = (0,)
+            for i in arg_idx:
+                if i < len(node.args):
+                    a = node.args[i]
+                    if isinstance(a, ast.Name):
+                        traced.extend(by_name.get(a.id, ()))
+                    elif isinstance(a, ast.Lambda):
+                        traced.append(a)
+    return traced
+
+
+@register
+class HostSyncInTracedCode(Rule):
+    code = "G3"
+    name = "host-sync-in-traced-code"
+    severity = "error"
+    doc = ("Host synchronization (.item()/.tolist()/float()/np.asarray/"
+           "block_until_ready) inside jit/pjit-decorated functions or "
+           "lax.scan/while/cond bodies. Under trace these either fail "
+           "(ConcretizationTypeError) or silently force a device→host "
+           "round trip per step, serializing the TPU pipeline.")
+
+    def check(self, ctx):
+        seen = set()
+        for fn in _traced_functions(ctx):
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            # nested defs/lambdas are separate scopes (pure_callback
+            # host helpers legitimately sync); a nested fn that IS
+            # traced (e.g. named in lax.scan) is collected above
+            stack = list(body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue
+                stack.extend(ast.iter_child_nodes(node))
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                hit = self._host_sync_hit(ctx, node)
+                if hit:
+                    seen.add(id(node))
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"host sync {hit} inside traced code — fails or "
+                        f"forces a device round trip under jit/scan")
+
+    @staticmethod
+    def _host_sync_hit(ctx, node):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in HOST_SYNC_ATTRS:
+            return f".{func.attr}()"
+        name = ctx.resolve(func)
+        if name in HOST_SYNC_CALLS:
+            return f"{name}()"
+        if isinstance(func, ast.Name) and func.id in ("float", "int") \
+                and len(node.args) == 1 \
+                and not isinstance(node.args[0], ast.Constant) \
+                and not _static_under_trace(node.args[0]):
+            return f"{func.id}()"
+        return None
+
+
+@register
+class UnguardedDeviceProbe(Rule):
+    code = "G4"
+    name = "unguarded-device-probe"
+    severity = "error"
+    doc = ("Direct jax.devices()/jax.local_devices() in library code. "
+           "A wedged tunnel hangs the caller indefinitely; "
+           "diagnostics.guard.devices() / ensure_backend() is the one "
+           "sanctioned dial (journaled, deadline-guarded, cached). "
+           "Scope: mxnet_tpu/ library code.")
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    ctx.resolve_call(node) in DEVICE_PROBES:
+                yield self.finding(
+                    ctx, node.lineno,
+                    "direct device probe in library code — use "
+                    "diagnostics.guard.devices() (deadline-guarded, "
+                    "journaled) instead of jax.devices()")
+
+
+@register
+class UndeadlinedSubprocess(Rule):
+    code = "G5"
+    name = "subprocess-without-timeout"
+    doc = ("Blocking subprocess call (run/call/check_call/check_output) "
+           "without timeout=. A child that dials a wedged backend hangs "
+           "the parent for the driver's whole window — every such wait "
+           "needs a deadline (the PR-1 lesson; guard.probe_backend is "
+           "the model).")
+
+    BLOCKING = {"subprocess.run", "subprocess.call",
+                "subprocess.check_call", "subprocess.check_output"}
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve_call(node)
+            if name not in self.BLOCKING:
+                continue
+            kw_names = {kw.arg for kw in node.keywords}
+            if "timeout" in kw_names or None in kw_names:  # **kwargs: unknown
+                continue
+            yield self.finding(
+                ctx, node.lineno,
+                f"{name}() without timeout= — an undeadlined child "
+                f"hang becomes an information-free rc:124")
+
+
+@register
+class SilentDeviceExceptionSwallow(Rule):
+    code = "G6"
+    name = "silent-device-exception-swallow"
+    doc = ("`except Exception: pass` (or bare) around backend-touching "
+           "code. A dead device path that vanishes silently is "
+           "undebuggable — journal it via diagnostics.journal (the "
+           "engine.waitall lesson) or narrow the catch.")
+
+    BROAD = {"Exception", "BaseException"}
+
+    def _touches_device(self, ctx, try_node):
+        # only the PROTECTED code counts (body + else) — a jax call in a
+        # sibling handler doesn't make an unrelated handler a G6
+        for top in list(try_node.body) + list(try_node.orelse):
+            for node in ast.walk(top):
+                if isinstance(node, ast.Call):
+                    name = ctx.resolve_call(node) or ""
+                    if name.startswith("jax."):
+                        return True
+                    func = node.func
+                    if isinstance(func, ast.Attribute) and func.attr in (
+                            "block_until_ready", "device_put", "devices"):
+                        return True
+        return False
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                t = handler.type
+                broad = t is None or \
+                    (isinstance(t, ast.Name) and t.id in self.BROAD) or \
+                    (isinstance(t, ast.Tuple)
+                     and any(isinstance(e, ast.Name) and e.id in self.BROAD
+                             for e in t.elts))
+                swallows = len(handler.body) == 1 and (
+                    isinstance(handler.body[0], ast.Pass)
+                    or (isinstance(handler.body[0], ast.Expr)
+                        and isinstance(handler.body[0].value, ast.Constant)))
+                if broad and swallows and self._touches_device(ctx, node):
+                    yield self.finding(
+                        ctx, handler.lineno,
+                        "device/runtime failure swallowed silently — "
+                        "journal it (diagnostics.journal) or narrow the "
+                        "except")

@@ -91,8 +91,9 @@ def _platform_devices(platform: str):
     """Process-LOCAL devices of a platform: a Context must resolve to an
     addressable device — in multi-process jobs jax.devices() lists the
     whole job's devices but only local ones accept transfers."""
+    from .diagnostics import guard
     try:
-        return [d for d in jax.local_devices()
+        return [d for d in guard.devices(local=True)
                 if d.platform == platform]
     except RuntimeError:
         return []
@@ -108,7 +109,9 @@ def _accelerator_devices():
     detect 'is an accelerator' rather than string-match 'tpu' exclusively.
     """
     if "accel" not in _ACCEL_CACHE:
-        devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+        from .diagnostics import guard
+        devs = [d for d in guard.devices(local=True)
+                if d.platform != "cpu"]
         _ACCEL_CACHE["accel"] = devs
     return _ACCEL_CACHE["accel"]
 
@@ -117,7 +120,8 @@ def _resolve_device(device_type: str, device_id: int) -> jax.Device:
     if device_type in ("cpu", "cpu_pinned", "cpu_shared"):
         devs = _platform_devices("cpu")
         if not devs:  # default backend is CPU-less? fall back to any device
-            devs = jax.local_devices()
+            from .diagnostics import guard
+            devs = guard.devices(local=True)
         return devs[min(device_id, len(devs) - 1)]
     if device_type == "tpu":
         devs = _platform_devices("tpu") or _accelerator_devices()

@@ -23,11 +23,11 @@ nor the rest of mxnet_tpu. See docs/diagnostics.md.
 """
 from __future__ import annotations
 
-from .guard import (DeviceUnreachable, backend_dialed, ensure_backend,
-                    probe_backend)
+from .guard import (DeviceUnreachable, backend_dialed, devices,
+                    ensure_backend, probe_backend)
 from .journal import Journal, get_journal, reset_journal
 from .watchdog import Watchdog
 
 __all__ = ["DeviceUnreachable", "Journal", "Watchdog", "backend_dialed",
-           "ensure_backend", "get_journal", "probe_backend",
+           "devices", "ensure_backend", "get_journal", "probe_backend",
            "reset_journal"]

@@ -34,7 +34,7 @@ import time
 from .journal import get_journal
 
 __all__ = ["DeviceUnreachable", "probe_backend", "ensure_backend",
-           "backend_dialed", "probe_deadline_s"]
+           "backend_dialed", "devices", "probe_deadline_s"]
 
 DEFAULT_PROBE_DEADLINE_S = 150.0   # first TPU compile dial can take ~40s
 DEFAULT_BACKOFF_S = (0.0,)         # one attempt unless the caller opts in
@@ -206,8 +206,8 @@ def ensure_backend(deadline_s=None, probe_in_subprocess=False,
             t0 = time.perf_counter()
             try:
                 import jax
-                devices = jax.devices()
-                info = {"platform": devices[0].platform, "n": len(devices),
+                devs = jax.devices()   # graftlint: disable=G4 this IS the guard
+                info = {"platform": devs[0].platform, "n": len(devs),
                         "dial_s": round(time.perf_counter() - t0, 1)}
             finally:
                 timer.cancel()
@@ -216,6 +216,20 @@ def ensure_backend(deadline_s=None, probe_in_subprocess=False,
             j.event("backend_ok", tag=tag, **info)
         _backend_info = info
         return info
+
+
+def devices(local: bool = False):
+    """The sanctioned live device list — what static rule G4 points
+    every direct ``jax.devices()`` call site at. The first call pays one
+    guarded dial (:func:`ensure_backend`: journaled, deadline-timed);
+    afterwards the probe is a cached-client lookup. ``local=True``
+    returns only this process's addressable devices (in multi-host jobs
+    ``jax.devices()`` lists the whole job's)."""
+    ensure_backend(tag="device-list")
+    import jax
+    if local:
+        return jax.local_devices()  # graftlint: disable=G4 sanctioned accessor
+    return jax.devices()            # graftlint: disable=G4 sanctioned accessor
 
 
 def _reset_for_tests() -> None:

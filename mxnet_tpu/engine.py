@@ -55,13 +55,24 @@ def on_op_done(out_data):
 def waitall():
     """Barrier on all outstanding async work
     (ref: Engine::WaitForAll / mx.nd.waitall)."""
+    from .diagnostics import guard
+    from .diagnostics.journal import get_journal
     try:
-        for dev in jax.devices():
+        for dev in guard.devices():
             # synchronize per device; effective barrier is blocking on all
             # live arrays, which JAX exposes per-array. A cheap global barrier:
             jax.device_put(0, dev).block_until_ready()
-    except Exception:
-        pass
+    except Exception as exc:
+        # a torn-down or unreachable backend (or a partially-finalized
+        # jax during interpreter shutdown) must not crash a barrier on a
+        # teardown path — but the failure must leave a breadcrumb
+        # (G6: journaled, not silently swallowed)
+        try:
+            get_journal().event("waitall_failed", error=type(exc).__name__,
+                                detail=str(exc)[:300])
+        except Exception:
+            pass    # journal unusable at teardown (sink gone, stderr
+            # finalized): a barrier must never crash shutdown
 
 
 @contextlib.contextmanager

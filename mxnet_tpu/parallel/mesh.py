@@ -20,7 +20,6 @@ from __future__ import annotations
 import math
 from contextlib import contextmanager
 
-import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -43,7 +42,8 @@ def make_mesh(axes=None, devices=None) -> Mesh:
     ref: python/mxnet/module/executor_group.py DataParallelExecutorGroup).
     """
     if devices is None:
-        devices = jax.devices()
+        from ..diagnostics import guard
+        devices = guard.devices()
     n = len(devices)
     if axes is None:
         axes = {"data": n}

@@ -406,8 +406,12 @@ class PipelinedTrainer:
         e_tr = [p._data[0]._data for p in self._e_params]
         h_tr = [p._data[0]._data for p in self._h_params]
         with use_mesh(self._mesh):
-            loss = self._eval_fn(e_tr, self._b_datas, h_tr,
-                                 jax.random.PRNGKey(0), xd, yd)
+            # eval runs dropout-off under a FIXED key by design (see the
+            # docstring above): RNG-neutral, never advances any stream
+            loss = self._eval_fn(
+                e_tr, self._b_datas, h_tr,
+                jax.random.PRNGKey(0),  # graftlint: disable=G2 RNG-neutral eval
+                xd, yd)
         return nd.NDArray(loss, _skip_device_put=True)
 
     # -- checkpoint / resume (same file machinery + guarantees as

@@ -15,9 +15,9 @@ class Feature:
 
 
 def _detect():
-    import jax
+    from .diagnostics import guard
     feats = {}
-    platforms = {d.platform for d in jax.devices()}
+    platforms = {d.platform for d in guard.devices()}
     # "axon" is the TPU tunnel platform name in this environment
     feats["TPU"] = bool(platforms & {"tpu", "axon"})
     feats["CUDA"] = bool(platforms & {"gpu", "cuda"})

@@ -408,19 +408,24 @@ class Symbol:
             op = _registry.get(node.op)
             kwargs2 = {k: v for k, v in node.attrs.items()
                        if not k.startswith("__")}
-            if op.needs_rng:
-                kwargs2["rng"] = jax.ShapeDtypeStruct((2,), np.uint32)
             if op.needs_mode:
                 kwargs2["training"] = False
+            # the key rides as an ABSTRACT eval_shape argument (legacy
+            # uint32[2] layout): a concrete PRNGKey here would dial the
+            # backend during shape inference — the G1/G2 import-wedge
+            # class, and infer_shape must stay backend-free
+            key_arg = (jax.ShapeDtypeStruct((2,), np.uint32),) \
+                if op.needs_rng else ()
 
             def fn(*arrs):
                 kk = dict(kwargs2)
                 if op.needs_rng:
-                    kk["rng"] = jax.random.PRNGKey(0)
+                    kk["rng"] = arrs[0]
+                    arrs = arrs[1:]
                 out = op.fn(*arrs, **kk)
                 return out
             try:
-                out = jax.eval_shape(fn, *in_shapes)
+                out = jax.eval_shape(fn, *key_arg, *in_shapes)
             except Exception:
                 return None
             outs = list(out) if isinstance(out, (tuple, list)) else [out]

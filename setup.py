@@ -36,7 +36,7 @@ class build_py_with_native(build_py):
         cmd = [gxx, "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
                "-o", out] + srcs
         print("building native runtime:", " ".join(cmd))
-        subprocess.run(cmd, check=True)
+        subprocess.run(cmd, check=True, timeout=600)
 
 
 class _BinaryDistribution(Distribution):

@@ -1,0 +1,14 @@
+"""G1 fixture: with ``from __future__ import annotations`` (the repo's
+house style) annotations are strings — they never evaluate, so a dial
+in an annotation must NOT flag, while real module-scope dials still
+do. Parsed only, never imported."""
+from __future__ import annotations
+
+import jax
+
+ANNOTATED: jax.devices() = None
+DIAL = jax.devices()                                # expect: G1
+
+
+def f(x: jax.device_count() = 1) -> jax.devices():
+    return x

@@ -1,0 +1,50 @@
+"""G1 fixture: module-scope backend dial — the exact round-4/5 wedge
+class (``_rng.py`` created a PRNGKey at import). Never imported by
+tests; only parsed. Excluded from the repo scan via tests/data."""
+import jax
+import jax.numpy as jnp
+
+DEVICES = jax.devices()                             # expect: G1
+KEY = jax.random.PRNGKey(0)                         # expect: G1
+SCALE = jnp.ones(8)                                 # expect: G1
+TWIN = jax.devices()   # graftlint: disable=G1 fixture twin, must not flag
+
+
+class Config:
+    # class bodies execute at import time too
+    n_dev = jax.device_count()                      # expect: G1
+
+
+def runtime_dial(n=3):
+    # inside a function body: NOT import-time, must not flag
+    return jax.devices()[:n]
+
+
+def default_arg_dial(devs=jax.devices()):           # expect: G1
+    # default argument values evaluate at import time
+    return devs
+
+
+# lambda defaults evaluate when the lambda expression is built — import
+# time here (the body, by contrast, is deferred)
+probe = lambda devs=jax.devices(): devs             # expect: G1
+deferred = lambda: jax.devices()
+
+
+# a genexp body is deferred until iteration — but its FIRST iterable
+# evaluates eagerly when the expression is built
+LAZY = (d.platform for d in jax.devices())          # expect: G1
+DEFERRED = (jax.devices() for _ in range(2))
+
+
+def annotated(n: jax.device_count() = 1):           # expect: G1
+    # without `from __future__ import annotations`, parameter
+    # annotations evaluate at def time (= import time)
+    return n
+
+
+if __name__ == "__main__":
+    # script body, never runs at import: must not flag
+    print(jax.devices())
+else:
+    IMPORTED_DIAL = jax.devices()                   # expect: G1

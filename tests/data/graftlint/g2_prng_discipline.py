@@ -1,0 +1,155 @@
+# graftlint: scope=library
+"""G2 fixture: PRNG discipline in library code (constant keys, key
+reuse without split/fold_in). Parsed only, never imported."""
+import jax
+import jax.random as jr
+
+
+def constant_key(shape):
+    key = jax.random.PRNGKey(0)                     # expect: G2
+    return jax.random.uniform(key, shape)
+
+
+def constant_key_keyword(shape):
+    key = jax.random.PRNGKey(seed=3)                # expect: G2
+    return jax.random.uniform(key, shape)
+
+
+def split_result_dropped(key, shape):
+    # split whose result is never bound does NOT freshen `key`
+    a = jax.random.normal(key, shape)
+    jax.random.split(key, 2)
+    b = jax.random.normal(key, shape)               # expect: G2
+    return a + b
+
+
+def constant_key_twin(shape):
+    key = jax.random.PRNGKey(1)  # graftlint: disable=G2 fixture twin
+    return jax.random.uniform(key, shape)
+
+
+def reuse(key, shape):
+    a = jax.random.normal(key, shape)
+    b = jax.random.normal(key, shape)               # expect: G2
+    return a + b
+
+
+def reuse_via_alias(key, shape):
+    a = jr.uniform(key, shape)
+    b = jr.uniform(key, shape)                      # expect: G2
+    return a + b
+
+
+def split_between(key, shape):
+    # refreshed key between draws: must not flag
+    a = jax.random.normal(key, shape)
+    key = jax.random.fold_in(key, 1)
+    b = jax.random.normal(key, shape)
+    return a + b
+
+
+def split_two(key, shape):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.normal(k2, shape)
+    return a + b
+
+
+def exclusive_branches(key, shape, training):
+    # one draw per if/else arm: only one executes — must not flag
+    if training:
+        return jax.random.bernoulli(key, 0.5, shape)
+    else:
+        return jax.random.normal(key, shape)
+
+
+def branch_then_reuse(key, shape, training):
+    if training:
+        a = jax.random.normal(key, shape)
+    else:
+        a = jax.random.uniform(key, shape)
+    b = jax.random.normal(key, shape)               # expect: G2
+    return a + b
+
+
+def walrus_refresh(key, shape):
+    # a walrus rebind refreshes the key: must not flag
+    a = jax.random.normal(key, shape)
+    if (key := jax.random.fold_in(key, 1)) is not None:
+        a = a + jax.random.normal(key, shape)
+    return a
+
+
+def guard_clause(key, shape, training):
+    # the early return never rejoins the fall-through: must not flag
+    if training:
+        return jax.random.bernoulli(key, 0.5, shape)
+    return jax.random.normal(key, shape)
+
+
+def exclusive_handlers(key, shape, fn):
+    # at most one except arm runs: must not flag
+    try:
+        return fn()
+    except ValueError:
+        return jax.random.normal(key, shape)
+    except TypeError:
+        return jax.random.uniform(key, shape)
+
+
+def loop_reuse(key, shape, n):
+    out = []
+    for _ in range(n):
+        # same key every iteration: identical bits per tick
+        out.append(jax.random.normal(key, shape))   # expect: G2
+    return out
+
+
+def loop_fold(key, shape, n):
+    # per-iteration fold_in refreshes the key: must not flag
+    out = []
+    for i in range(n):
+        key = jax.random.fold_in(key, i)
+        out.append(jax.random.normal(key, shape))
+    return out
+
+
+def loop_split_target(key, shape, n):
+    # the canonical idiom: the loop target binds a FRESH key per
+    # iteration — must not flag
+    out = []
+    for k in jax.random.split(key, n):
+        out.append(jax.random.normal(k, shape))
+    return out
+
+
+def exclusive_match_arms(key, shape, mode):
+    # match arms are exclusive, like if/else: must not flag
+    match mode:
+        case "normal":
+            return jax.random.normal(key, shape)
+        case _:
+            return jax.random.uniform(key, shape)
+
+
+def exclusive_ternary(key, shape, training):
+    # conditional-expression arms are exclusive too: must not flag
+    return (jax.random.normal(key, shape) if training
+            else jax.random.uniform(key, shape))
+
+
+def ternary_then_reuse(key, shape, training):
+    a = (jax.random.normal(key, shape) if training
+         else jax.random.uniform(key, shape))
+    b = jax.random.normal(key, shape)               # expect: G2
+    return a + b
+
+
+def match_then_reuse(key, shape, mode):
+    match mode:
+        case "normal":
+            a = jax.random.normal(key, shape)
+        case _:
+            a = jax.random.uniform(key, shape)
+    b = jax.random.bernoulli(key, 0.5, shape)       # expect: G2
+    return a + b

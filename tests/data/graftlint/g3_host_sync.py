@@ -1,0 +1,60 @@
+"""G3 fixture: host synchronization inside traced code (jit-decorated
+functions and lax.scan bodies). Parsed only, never imported."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def jitted_item(x):
+    return x.sum().item()                           # expect: G3
+
+
+@partial(jax.jit, static_argnums=0)
+def jitted_float(n, x):
+    return float(x[0]) + n                          # expect: G3
+
+
+def scan_body(carry, x):
+    host = np.asarray(x)                            # expect: G3
+    return carry + x, host
+
+
+def run_scan(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)
+
+
+@jax.jit
+def jitted_twin(x):
+    return x.sum().item()  # graftlint: disable=G3 fixture twin
+
+
+@jax.jit
+def shape_metadata(x):
+    # .shape/.ndim/len() are static Python values under trace:
+    # int()/float() over them is trace-safe, must not flag
+    n = int(x.shape[0])
+    d = float(x.ndim)
+    m = int(len(x))
+    return x.reshape(n, -1) * d * m
+
+
+@jax.jit
+def with_host_callback(x):
+    # a nested def is its own (host) scope — pure_callback helpers
+    # legitimately sync and must not flag
+    def host_fn(v):
+        return np.asarray(v).item()
+
+    return jax.pure_callback(host_fn, jax.ShapeDtypeStruct((), x.dtype), x)
+
+
+def eager_host(x):
+    # not traced: float()/item() here are fine
+    return float(x.sum().item())
+
+
+def eager_asarray(x):
+    return jnp.asarray(np.asarray(x))

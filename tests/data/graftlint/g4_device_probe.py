@@ -1,0 +1,23 @@
+# graftlint: scope=library
+"""G4 fixture: unguarded runtime device probe in library code (the
+engine.waitall / runtime._detect / mesh default-path class). Parsed
+only, never imported."""
+import jax
+
+
+def pick(n):
+    return jax.devices()[:n]                        # expect: G4
+
+
+def pick_local():
+    return jax.local_devices()                      # expect: G4
+
+
+def sanctioned():
+    return jax.devices()  # graftlint: disable=G4 fixture twin
+
+
+def guarded():
+    # the pattern the rule points at — no direct probe here
+    from mxnet_tpu.diagnostics import guard
+    return guard.devices()

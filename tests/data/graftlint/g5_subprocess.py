@@ -1,0 +1,33 @@
+"""G5 fixture: blocking subprocess calls without a deadline (the PR-1
+lesson: an undeadlined child that dials a wedged backend is an
+information-free rc:124). Parsed only, never executed."""
+import subprocess
+import sys
+
+
+def undeadlined(cmd):
+    return subprocess.run(cmd, capture_output=True)  # expect: G5
+
+
+def undeadlined_output():
+    return subprocess.check_output([sys.executable, "-V"])  # expect: G5
+
+
+def deadlined(cmd):
+    return subprocess.run(cmd, capture_output=True, timeout=60)
+
+
+def forwarded(cmd, **kw):
+    # timeout may ride in **kw — unknowable statically, must not flag
+    return subprocess.run(cmd, **kw)
+
+
+def suppressed(cmd):
+    return subprocess.call(cmd)  # graftlint: disable=G5 fixture twin
+
+
+def suppressed_multiline(cmd):
+    # disable on the CLOSING line covers the whole statement
+    return subprocess.run(
+        cmd,
+        capture_output=True)  # graftlint: disable=G5 fixture twin

@@ -1,0 +1,62 @@
+"""G6 fixture: silent exception swallow on a device/runtime path (the
+engine.waitall defect: a dead barrier that vanished without a trace).
+Parsed only, never imported."""
+import jax
+
+
+def swallow(x):
+    try:
+        jax.block_until_ready(x)
+    except Exception:                               # expect: G6
+        pass
+    return x
+
+
+def swallow_bare(x):
+    try:
+        jax.device_put(0)
+    except:                                         # expect: G6
+        pass
+
+
+def swallow_tuple(x):
+    try:
+        jax.device_put(0)
+    except (Exception, ValueError):                 # expect: G6
+        pass
+
+
+def journaled(x, journal):
+    # the sanctioned shape: narrow catch + breadcrumb
+    try:
+        jax.block_until_ready(x)
+    except RuntimeError as exc:
+        journal.event("sync_failed", detail=str(exc)[:200])
+    return x
+
+
+def host_only():
+    # no backend touch in the try: broad-swallow is W-territory, not G6
+    try:
+        return int("nope")
+    except Exception:
+        pass
+
+
+def device_only_in_sibling_handler(path):
+    # the PROTECTED code touches no device; the jax call lives in a
+    # sibling handler — must not flag
+    try:
+        return open(path).read()
+    except OSError:
+        jax.debug.print("read failed")
+    except Exception:
+        pass
+
+
+def suppressed(x):
+    try:
+        jax.block_until_ready(x)
+    except Exception:  # graftlint: disable=G6 fixture twin
+        pass
+    return x

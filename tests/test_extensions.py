@@ -99,7 +99,7 @@ class TestLibraryLoad:
         subprocess.run(
             ["g++", "-O2", "-shared", "-fPIC", "-o", str(so),
              os.path.join(REPO, "native", "example_plugin.cc")],
-            check=True, capture_output=True)
+            check=True, capture_output=True, timeout=600)
         names = mx.library.load(str(so), verbose=False)
         assert names == ["plugin_gelu_tanh", "plugin_mish"]
         x = np.random.randn(4, 5).astype(np.float32)
@@ -128,7 +128,7 @@ def test_launcher_auto_restart(tmp_path):
          "-n", "2", "--launcher", "local", "--max-restarts", "2",
          "--heartbeat-interval", "0.2",
          sys.executable, str(script), str(tmp_path / "m")],
-        capture_output=True, text=True,
+        capture_output=True, text=True, timeout=600,
         env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"})
     assert r.returncode == 0, r.stderr[-500:]
     assert "restarting job" in r.stderr

@@ -1,0 +1,452 @@
+"""graftlint static-analysis tier (mxnet_tpu/analysis/): every G-rule
+against its seeded-violation fixture (flag at the right line, disabled
+twin stays silent), the W-rule port, suppression + baseline semantics,
+the emitters, the ci/lint.py shim, the repo's own cleanliness modulo
+the committed baseline, and the runtime fixes the analyzer drove
+(backend-free shape inference, journaled waitall)."""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.analysis import core
+from mxnet_tpu.analysis import baseline as bl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "graftlint")
+G_FIXTURES = sorted(f for f in os.listdir(FIXTURES) if f.endswith(".py"))
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z]\d+)")
+
+
+def _rules(codes):
+    registry = core.load_rules()
+    return [registry[c] for c in codes]
+
+
+def _g_rules():
+    return _rules([c for c in core.load_rules() if c.startswith("G")])
+
+
+def _expected(path):
+    with open(path, encoding="utf-8") as f:
+        return {(i, m.group(1))
+                for i, line in enumerate(f, 1)
+                for m in [_EXPECT_RE.search(line)] if m}
+
+
+# -- the G-rules against their seeded fixtures -------------------------------
+
+@pytest.mark.parametrize("fname", G_FIXTURES)
+def test_g_rule_fixture_flags_exact_lines(fname):
+    """Each seeded violation is flagged at its exact line; the
+    `# graftlint: disable=` twin and the clean variants are silent."""
+    path = os.path.join(FIXTURES, fname)
+    got = {(f.line, f.code)
+           for f in core.lint_file(path, rules=_g_rules(), root=REPO)}
+    want = _expected(path)
+    assert want, f"fixture {fname} has no # expect: markers"
+    assert got == want
+
+
+def test_g1_was_invisible_to_the_legacy_w_tier():
+    """The acceptance-criteria case: a module-scope jax.devices() that
+    the seed's ci/lint.py (W-rules only) let through is a G1 error for
+    the framework."""
+    path = os.path.join(FIXTURES, "g1_module_dial.py")
+    legacy = core.lint_file(
+        path, rules=_rules(["W1", "W2", "W3", "W4", "W5", "W6"]),
+        root=REPO)
+    assert legacy == [], "old tier should see nothing wrong here"
+    modern = core.lint_file(path, rules=_g_rules(), root=REPO)
+    assert any(f.code == "G1" and "jax.devices" in f.message
+               for f in modern)
+
+
+# -- generic tier port -------------------------------------------------------
+
+def test_w_rules_ported_bitcompatible(tmp_path):
+    src = (
+        "import os\n"                                # W1 unused
+        "import sys  # noqa\n"                       # legacy suppression
+        "def f(x=[]):\n"                             # W3
+        "    try:\n"
+        "        return x\n"
+        "    except:\n"                              # W2
+        "        pass\n"
+        "s = f''\n"                                  # W4
+        "t = 'trailing '   \n"                       # W5
+        "u = '" + "x" * 101 + "'\n"                  # W6
+    )
+    p = tmp_path / "bad.py"
+    p.write_text(src)
+    codes = sorted({f.code for f in core.lint_file(
+        str(p), rules=_rules(["W1", "W2", "W3", "W4", "W5", "W6"]))})
+    assert codes == ["W1", "W2", "W3", "W4", "W5", "W6"]
+    lines = {f.code: f.line for f in core.lint_file(
+        str(p), rules=_rules(["W1", "W2"]))}
+    assert lines == {"W1": 1, "W2": 6}               # sys import: noqa'd
+
+
+def test_syntax_error_is_e1(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    (f,) = core.lint_file(str(p))
+    assert f.code == "E1" and f.severity == "error"
+
+
+def test_baselined_e1_in_one_file_never_masks_another(tmp_path):
+    """E1 findings carry real (path-keyed) fingerprints: accepting a
+    syntax error in file A must not absorb a fresh one in file B."""
+    a, b = tmp_path / "a.py", tmp_path / "b.py"
+    a.write_text("def f(:\n")
+    b.write_text("x = 1\n")
+    blp = str(tmp_path / "base.json")
+    scan = lambda: core.run([str(a), str(b)], root=str(tmp_path))[0]
+    bl.write_baseline(blp, scan())
+    a.write_text("x = 1\n")                         # A fixed...
+    b.write_text("def g(:\n")                       # ...B freshly broken
+    new, based = bl.partition(scan(), bl.load_baseline(blp))
+    assert based == []
+    assert len(new) == 1 and new[0].path == "b.py" and new[0].code == "E1"
+
+
+# -- suppression syntax ------------------------------------------------------
+
+def test_suppression_same_line_next_line_and_codes(tmp_path):
+    src = (
+        "import jax\n"
+        "A = jax.devices()\n"
+        "B = jax.devices()  # graftlint: disable=G1 justified here\n"
+        "# graftlint: disable=G1 standalone comment covers next line\n"
+        "C = jax.devices()\n"
+        "D = jax.devices()  # graftlint: disable=G4 wrong code: no effect\n"
+        "E = jax.devices()  # graftlint: disable=G4, G1 spaced list works\n"
+    )
+    p = tmp_path / "sup.py"
+    p.write_text(src)
+    lines = [f.line for f in core.lint_file(str(p), rules=_rules(["G1"]))]
+    assert lines == [2, 6]
+
+
+def test_suppression_on_multiline_statement_continuation(tmp_path):
+    """Findings anchor to a statement's first line; the natural comment
+    spot is the closing line — a disable anywhere on a multi-line
+    simple statement covers it."""
+    src = (
+        "import subprocess\n"
+        "r = subprocess.run(\n"
+        "    ['x'],\n"
+        "    capture_output=True)  # graftlint: disable=G5 deadline upstream\n"
+        "q = subprocess.run(\n"
+        "    ['y'])\n"
+    )
+    p = tmp_path / "ml.py"
+    p.write_text(src)
+    lines = [f.line for f in core.lint_file(str(p), rules=_rules(["G5"]))]
+    assert lines == [5]
+
+
+def test_suppression_on_compound_statement_header(tmp_path):
+    """A disable on the closing line of a multi-line compound HEADER
+    (if/while test) reaches the finding anchored at the opening line —
+    but never leaks into the body."""
+    src = (
+        "import subprocess\n"
+        "def f():\n"
+        "    if subprocess.run(\n"
+        "            ['x']).returncode:  # graftlint: disable=G5 probed\n"
+        "        subprocess.run(['y'])\n"
+    )
+    p = tmp_path / "ch.py"
+    p.write_text(src)
+    lines = [f.line for f in core.lint_file(str(p), rules=_rules(["G5"]))]
+    assert lines == [5]
+
+
+def test_legacy_noqa_stays_line_only_on_multiline_statements(tmp_path):
+    """`# noqa` suppresses every code but ONLY its own line — it must
+    not ride the statement-span union onto the opening line."""
+    src = (
+        "import subprocess\n"
+        "r = subprocess.run(\n"
+        "    ['x'])  # noqa\n"
+    )
+    p = tmp_path / "nq.py"
+    p.write_text(src)
+    lines = [f.line for f in core.lint_file(str(p), rules=_rules(["G5"]))]
+    assert lines == [2]
+
+
+def test_suppression_syntax_inside_string_literal_is_inert(tmp_path):
+    """Only REAL comments suppress: a string that merely quotes the
+    syntax (help text) must not mask a co-located finding."""
+    src = (
+        "import subprocess\n"
+        'HELP = "add # graftlint: disable=G5 why"; '
+        "r = subprocess.run(['x'])\n"
+    )
+    p = tmp_path / "s.py"
+    p.write_text(src)
+    lines = [f.line for f in core.lint_file(str(p), rules=_rules(["G5"]))]
+    assert lines == [2]
+
+
+def test_suppression_span_does_not_leak_across_match_arms(tmp_path):
+    """match is a compound statement: a disable inside one case arm
+    must not suppress findings in sibling arms."""
+    src = (
+        "import subprocess\n"
+        "def f(x):\n"
+        "    match x:\n"
+        "        case 1:\n"
+        "            subprocess.run(['a'])  # graftlint: disable=G5 ok\n"
+        "        case _:\n"
+        "            subprocess.run(['b'])\n"
+    )
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    lines = [f.line for f in core.lint_file(str(p), rules=_rules(["G5"]))]
+    assert lines == [7]
+
+
+def test_cli_nonexistent_path_is_an_error():
+    out = _cli(["mxnet_tpu/enigne.py"])             # typo'd path
+    assert out.returncode == 2
+    assert "no .py files" in out.stderr
+    # a typo among valid paths must not pass as clean either, and the
+    # message names only the missing one
+    out = _cli(["mxnet_tpu/engine.py", "mxnet_tpu/enigne.py"])
+    assert out.returncode == 2
+    assert "mxnet_tpu/enigne.py" in out.stderr
+    assert "mxnet_tpu/engine.py" not in out.stderr
+
+
+def test_overlapping_paths_dedup_and_walk_excludes():
+    """A dir plus a file inside it lints each file once (a duplicate
+    finding would spuriously exceed the baseline budget); walking a
+    PARENT of an excluded dir keeps the exclusion, while naming the
+    excluded dir itself opts in."""
+    fixture_dir = "tests/data/graftlint"
+    one = core.run([fixture_dir], rules=_g_rules(), root=REPO)
+    both = core.run([fixture_dir, fixture_dir + "/g5_subprocess.py"],
+                    rules=_g_rules(), root=REPO)
+    assert [f.sort_key() for f in both[0]] == [f.sort_key() for f in one[0]]
+    assert one[0], "opt-in scan of the excluded fixture dir must lint it"
+    parent, _ = core.run(["tests"], rules=_g_rules(), root=REPO)
+    assert not any(f.path.startswith("tests/data/") for f in parent)
+
+
+# -- baseline ----------------------------------------------------------------
+
+def test_baseline_partition_and_justification_roundtrip(tmp_path):
+    path = os.path.join(FIXTURES, "g5_subprocess.py")
+    findings = core.lint_file(path, rules=_g_rules(), root=REPO)
+    assert findings
+    blp = str(tmp_path / "base.json")
+    entries = bl.write_baseline(blp, findings)
+    assert len(entries) == len(findings)
+    new, based = bl.partition(findings, bl.load_baseline(blp))
+    assert new == [] and len(based) == len(findings)
+    # a human-edited justification survives regeneration
+    data = json.load(open(blp))
+    data["entries"][0]["justification"] = "accepted: fixture debt"
+    json.dump(data, open(blp, "w"))
+    bl.write_baseline(blp, findings)
+    assert json.load(open(blp))["entries"][0]["justification"] == \
+        "accepted: fixture debt"
+
+
+def test_baseline_is_content_keyed_not_line_keyed(tmp_path):
+    """Shifting a finding down by unrelated edits must not re-open it;
+    new findings must not be absorbed by it."""
+    p = tmp_path / "mod.py"
+    p.write_text("import subprocess\n"
+                 "r = subprocess.run(['x'])\n")
+    blp = str(tmp_path / "b.json")
+    bl.write_baseline(blp, core.lint_file(str(p), rules=_rules(["G5"])))
+    # unrelated edit above: same content, new line number -> still matched
+    p.write_text("import subprocess\n"
+                 "# a comment pushing things down\n\n"
+                 "r = subprocess.run(['x'])\n")
+    new, based = bl.partition(core.lint_file(str(p), rules=_rules(["G5"])),
+                              bl.load_baseline(blp))
+    assert new == [] and len(based) == 1
+    # a second, different undeadlined call IS new
+    p.write_text("import subprocess\n"
+                 "r = subprocess.run(['x'])\n"
+                 "q = subprocess.check_output(['y'])\n")
+    new, based = bl.partition(core.lint_file(str(p), rules=_rules(["G5"])),
+                              bl.load_baseline(blp))
+    assert len(based) == 1 and len(new) == 1
+    assert new[0].message.startswith("subprocess.check_output")
+
+
+# -- CLI / emitters / shim ---------------------------------------------------
+
+def _cli(args, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis"] + args,
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, **kw)
+
+
+def test_self_run_repo_is_clean_modulo_baseline():
+    """The acceptance criterion: the analyzer exits 0 on the repo with
+    the committed baseline (tests/data fixtures excluded by default)."""
+    out = _cli([])
+    assert out.returncode == 0, out.stdout + out.stderr[-500:]
+    assert "0 new" in out.stdout
+
+
+def test_cli_json_and_sarif_emitters():
+    rel = "tests/data/graftlint/g4_device_probe.py"
+    out = _cli(["--format=json", "--no-baseline", rel])
+    assert out.returncode == 1
+    data = json.loads(out.stdout)
+    assert data["tool"] == "graftlint" and data["files"] == 1
+    assert {f["rule"] for f in data["new"]} == {"G4"}
+    out = _cli(["--format=sarif", "--no-baseline", rel])
+    assert out.returncode == 1
+    sarif = json.loads(out.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"G1", "G2", "G3", "G4", "G5", "G6", "W1", "E1"} <= rule_ids
+    res = run["results"]
+    assert res and all(r["ruleId"] == "G4" for r in res)
+    assert res[0]["baselineState"] == "new"
+    loc = res[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == rel
+    assert loc["region"]["startLine"] > 0
+
+
+def test_cli_write_baseline_flow(tmp_path):
+    rel = "tests/data/graftlint/g6_silent_swallow.py"
+    blp = str(tmp_path / "b.json")
+    out = _cli(["--write-baseline", "--baseline", blp, rel])
+    assert out.returncode == 0, out.stderr[-500:]
+    out = _cli(["--baseline", blp, rel])
+    assert out.returncode == 0, out.stdout
+    assert "0 new" in out.stdout
+
+
+def test_malformed_baseline_is_a_usage_error_and_self_heals(tmp_path):
+    blp = str(tmp_path / "b.json")
+    with open(blp, "w") as f:
+        f.write("<<<<<<< HEAD merge junk")
+    rel = "tests/data/graftlint/g4_device_probe.py"
+    out = _cli(["--baseline", blp, rel])
+    assert out.returncode == 2 and "not valid JSON" in out.stderr
+    # valid JSON but the wrong shape is equally a usage error
+    with open(blp, "w") as f:
+        f.write("[1, 2]")
+    out = _cli(["--baseline", blp, rel])
+    assert out.returncode == 2 and "regenerate" in out.stderr
+    # --write-baseline regenerates past the broken file
+    out = _cli(["--write-baseline", "--baseline", blp, rel])
+    assert out.returncode == 0, out.stderr[-300:]
+    out = _cli(["--baseline", blp, rel])
+    assert out.returncode == 0
+
+
+def test_cli_rules_filter_and_errors():
+    out = _cli(["--rules", "G9"])
+    assert out.returncode == 2 and "unknown rule" in out.stderr
+    out = _cli(["--list-rules"])
+    assert out.returncode == 0
+    for code in ["G1", "G2", "G3", "G4", "G5", "G6",
+                 "E1", "W1", "W2", "W3", "W4", "W5", "W6"]:
+        assert code in out.stdout
+
+
+def test_cli_write_baseline_refuses_partial_scan_of_default():
+    """A narrowed scan must not clobber the committed baseline (it
+    would drop every out-of-scope entry); an explicit --baseline FILE
+    opts into a scoped file."""
+    out = _cli(["--write-baseline", "mxnet_tpu/engine.py"])
+    assert out.returncode == 2 and "clobber" in out.stderr
+    out = _cli(["--write-baseline", "--rules", "G5"])
+    assert out.returncode == 2
+
+
+def test_ci_lint_shim_is_standalone(tmp_path):
+    """The shim must lint WITHOUT executing mxnet_tpu/__init__ (no jax,
+    no runtime import) — so tier-0 still reports findings when the
+    runtime package itself is un-importable. Proven by running it with
+    jax poisoned out of existence."""
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None          # any jax import explodes\n"
+        "sys.modules['mxnet_tpu'] = None    # any runtime import explodes\n"
+        "sys.argv = ['lint.py', 'tests/data/graftlint/g1_module_dial.py',\n"
+        "            '--no-baseline']\n"
+        "import runpy\n"
+        "rc = 0\n"
+        "try:\n"
+        "    runpy.run_path('ci/lint.py', run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    rc = e.code\n"
+        "assert rc == 1, f'expected findings exit, got {rc}'\n"
+        "print('STANDALONE_OK')\n")
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "STANDALONE_OK" in out.stdout
+
+
+def test_ci_lint_shim_still_works():
+    """`python ci/lint.py` keeps its contract: exit 0 on the clean repo
+    (checked by test_self_run via the same engine) and exit 1 with the
+    finding listed when pointed at a violation."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "ci", "lint.py"),
+         "tests/data/graftlint/g1_module_dial.py", "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 1
+    assert "G1" in out.stdout and "module-scope backend dial" \
+        in out.stdout
+
+
+# -- the runtime fixes the analyzer drove ------------------------------------
+
+def test_infer_shape_never_makes_a_concrete_key(monkeypatch):
+    """symbol shape inference on an rng-consuming op must not construct
+    a concrete PRNGKey (a backend dial inside eval_shape — the G1/G2
+    finding fixed this PR): the key rides as an abstract argument."""
+    import jax
+    from mxnet_tpu import sym
+    calls = []
+    orig = jax.random.PRNGKey
+    monkeypatch.setattr(jax.random, "PRNGKey",
+                        lambda *a, **k: (calls.append(a), orig(*a, **k))[1])
+    out = sym.Dropout(sym.var("data"), p=0.5, mode="always")
+    _args, out_shapes, _aux = out.infer_shape(data=(4, 8))
+    assert out_shapes == [(4, 8)]
+    assert not calls, "shape inference dialed a concrete PRNG key"
+
+
+def test_waitall_journals_instead_of_swallowing(monkeypatch, tmp_path):
+    """The G6 fix: a dead backend during waitall leaves a structured
+    breadcrumb and does not raise (narrow catch + journal, replacing
+    `except Exception: pass`)."""
+    from mxnet_tpu import engine
+    from mxnet_tpu.diagnostics import guard, journal
+
+    def boom(local=False):
+        raise RuntimeError("backend torn down")
+
+    monkeypatch.setattr(guard, "devices", boom)
+    journal.reset_journal(str(tmp_path / "j.jsonl"))
+    try:
+        engine.waitall()                   # must not raise
+    finally:
+        journal.reset_journal()
+    recs = [json.loads(l) for l in open(tmp_path / "j.jsonl")]
+    (rec,) = [r for r in recs if r["kind"] == "waitall_failed"]
+    assert rec["error"] == "RuntimeError"
+    assert "torn down" in rec["detail"]

@@ -201,11 +201,11 @@ def test_c_client_end_to_end(native_lib, tmp_path):
     subprocess.run(
         [cc, "-o", exe, os.path.join(native_dir, "test_predict.c"),
          f"-L{native_dir}", "-lmxtpu", f"-Wl,-rpath,{native_dir}"],
-        check=True, capture_output=True)
+        check=True, capture_output=True, timeout=600)
     out = subprocess.run(
         [exe, f"{prefix}-symbol.json", f"{prefix}-0000.params",
          str(tmp_path / "in.f32"), "8"],
-        check=True, capture_output=True, text=True)
+        check=True, capture_output=True, text=True, timeout=600)
     got = np.array([int(v) for v in out.stdout.split()])
     np.testing.assert_array_equal(got, want)
 
@@ -234,10 +234,10 @@ def test_cpp_client_end_to_end(native_lib, tmp_path):
          os.path.join(native_dir, "test_cpp_api.cc"),
          f"-I{native_dir}", f"-L{native_dir}", "-lmxtpu",
          f"-Wl,-rpath,{native_dir}"],
-        check=True, capture_output=True)
+        check=True, capture_output=True, timeout=600)
     out = subprocess.run(
         [exe, f"{prefix}-symbol.json", f"{prefix}-0000.params",
          str(tmp_path / "in.f32"), "8", "784"],
-        check=True, capture_output=True, text=True)
+        check=True, capture_output=True, text=True, timeout=600)
     got = np.array([int(v) for v in out.stdout.split()])
     np.testing.assert_array_equal(got, want)

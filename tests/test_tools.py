@@ -25,14 +25,14 @@ def test_im2rec_list_and_pack(tmp_path):
     r = subprocess.run([sys.executable, os.path.join(REPO, "tools",
                                                      "im2rec.py"),
                         "--list", "--recursive", prefix, root],
-                       capture_output=True, env=env, text=True)
+                       capture_output=True, env=env, text=True, timeout=600)
     assert r.returncode == 0, r.stderr
     lst = open(prefix + ".lst").read().strip().splitlines()
     assert len(lst) == 6
     r = subprocess.run([sys.executable, os.path.join(REPO, "tools",
                                                      "im2rec.py"),
                         "--encoding", ".png", prefix, root],
-                       capture_output=True, env=env, text=True)
+                       capture_output=True, env=env, text=True, timeout=600)
     assert r.returncode == 0, r.stderr
     assert os.path.exists(prefix + ".rec")
     assert os.path.exists(prefix + ".idx")
@@ -57,7 +57,7 @@ def test_launch_local_env(tmp_path):
                         os.path.join(REPO, "tools", "launch.py"),
                         "-n", "3", "--launcher", "local",
                         sys.executable, str(script)],
-                       capture_output=True, text=True)
+                       capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr
     ranks = set()
     for i in range(3):
@@ -80,7 +80,7 @@ def test_parse_log(tmp_path):
     r = subprocess.run([sys.executable,
                         os.path.join(REPO, "tools", "parse_log.py"),
                         str(log), "--format", "csv"],
-                       capture_output=True, text=True)
+                       capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr
     lines = r.stdout.strip().splitlines()
     assert lines[0].startswith("epoch,")
