@@ -60,6 +60,12 @@ if [ "$TIER" = "sanity" ]; then
   exit 0
 fi
 
+# chaos smoke: a fast crash-matrix subset (kill the checkpoint writer at
+# key phases, prove old-or-new recovery) so a torn-file regression fails
+# in seconds, before the unit tiers spend minutes (docs/checkpointing.md)
+echo "== tier 0.5: chaos smoke (crash-matrix subset) =="
+python -m pytest tests/test_crash_matrix.py -q -k smoke -p no:cacheprovider
+
 # quick unit tier: core ndarray/op/autograd/gluon/io surface, no
 # model-zoo or multi-process tests (ref: runtime_functions.sh unittest
 # vs nightly split)

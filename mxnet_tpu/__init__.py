@@ -97,6 +97,8 @@ from . import test_utils
 from . import operator
 from . import runtime
 from . import diagnostics
+from . import resilience
+from . import testing
 from . import util
 from . import rnn
 from . import attribute

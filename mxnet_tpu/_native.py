@@ -48,11 +48,14 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
+        # stale iff older than ANY source (a predict.cc-only edit must
+        # rebuild too — comparing just one file shipped a stale .so)
+        srcs = [os.path.join(_native_dir, f)
+                for f in ("recordio.cc", "engine.cc", "predict.cc")]
+        srcs = [s for s in srcs if os.path.exists(s)]
         if not os.path.exists(_lib_path) or (
-                os.path.exists(os.path.join(_native_dir, "recordio.cc"))
-                and os.path.getmtime(_lib_path)
-                < os.path.getmtime(os.path.join(_native_dir,
-                                                "recordio.cc"))):
+                srcs and os.path.getmtime(_lib_path)
+                < max(os.path.getmtime(s) for s in srcs)):
             if not _build() and not os.path.exists(_lib_path):
                 return None
         try:

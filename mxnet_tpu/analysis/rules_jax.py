@@ -16,6 +16,7 @@ Each rule resolves names through the file's import aliases
 from __future__ import annotations
 
 import ast
+import re
 
 from .core import Rule, register
 
@@ -534,6 +535,89 @@ class UndeadlinedSubprocess(Rule):
                 ctx, node.lineno,
                 f"{name}() without timeout= — an undeadlined child "
                 f"hang becomes an information-free rc:124")
+
+
+ARTIFACT_SUFFIXES = (".params", ".states", ".pstate", ".json", ".onnx")
+_SAVE_FN_RE = re.compile(r"save|checkpoint|export|dump", re.IGNORECASE)
+
+
+def _functions_with_calls(tree):
+    """Yield (call_node, enclosing_function_name_or_None) for every Call
+    in the module (innermost function wins)."""
+    out = []
+
+    def visit(node, fn_name):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_name = node.name
+        if isinstance(node, ast.Call):
+            out.append((node, fn_name))
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn_name)
+
+    visit(tree, None)
+    return out
+
+
+@register
+class NonAtomicDurableWrite(Rule):
+    code = "G7"
+    name = "non-atomic-durable-write"
+    doc = ("Durable artifact (.params/.states/.json/...) opened with a "
+           "direct open(path, 'w'/'wb') in library code: a preemption "
+           "mid-write leaves a torn file the loader misparses (the "
+           "crash class docs/checkpointing.md exists for). Route the "
+           "write through mxnet_tpu.resilience.atomic.atomic_write "
+           "(tmp + fsync + os.replace). Flagged on artifact-suffix "
+           "evidence in the path expression, or a bare path variable "
+           "inside a save/checkpoint/export/dump-named function. "
+           "Scope: mxnet_tpu/ library code.")
+
+    @staticmethod
+    def _write_mode(node) -> bool:
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        return (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and mode.value.startswith("w"))
+
+    @staticmethod
+    def _suffix_evidence(path_arg):
+        for sub in ast.walk(path_arg):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                if sub.value.endswith(ARTIFACT_SUFFIXES):
+                    return sub.value
+        return None
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        for node, fn_name in _functions_with_calls(ctx.tree):
+            if ctx.resolve_call(node) not in ("open", "io.open"):
+                continue
+            if not node.args or not self._write_mode(node):
+                continue
+            path_arg = node.args[0]
+            suffix = self._suffix_evidence(path_arg)
+            named_save = (isinstance(path_arg, (ast.Name, ast.Attribute))
+                          and fn_name is not None
+                          and _SAVE_FN_RE.search(fn_name))
+            if suffix:
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"direct write to durable artifact ({suffix!r}) — a "
+                    "crash mid-write leaves a torn file; use "
+                    "resilience.atomic.atomic_write")
+            elif named_save:
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"open(..., 'w') inside {fn_name}(): checkpoint-"
+                    "shaped writers must be atomic — use "
+                    "resilience.atomic.atomic_write (tmp + fsync + "
+                    "os.replace)")
 
 
 @register

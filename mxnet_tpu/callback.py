@@ -47,14 +47,21 @@ class Speedometer:
             self.tic = time.time()
 
 
-def do_checkpoint(prefix, period=1):
-    """Epoch-end checkpointing callback (ref: callback.py do_checkpoint)."""
+def do_checkpoint(prefix, period=1, keep_last=None):
+    """Epoch-end checkpointing callback (ref: callback.py do_checkpoint).
+
+    Saves ride the atomic path (tmp + fsync + rename — a preemption
+    mid-save leaves the previous epoch intact), the prefix directory is
+    created if missing, and ``keep_last=k`` prunes all but the newest k
+    epochs (``.params`` + ``.states``) after each save."""
     from . import model
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
             model.save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            if keep_last:
+                model.gc_checkpoints(prefix, keep_last)
     return _callback
 
 

@@ -56,7 +56,8 @@ def export_model(sym, params, input_shape=None, input_type=None,
                          in_types=in_types)
     model["opset"] = opset_version
     buf = proto.encode_model(model)
-    with open(onnx_file_path, "wb") as f:
+    from ...resilience.atomic import atomic_write
+    with atomic_write(onnx_file_path, "wb") as f:
         f.write(buf)
     if verbose:
         g = model["graph"]

@@ -102,11 +102,21 @@ def cmd_probe(args) -> int:
     return 0
 
 
+def _checkpoint_report(root: str) -> dict:
+    """Manifest-validity summary of a commit-protocol checkpoint root
+    (resilience.commit is stdlib-only: this works even when jax or the
+    runtime package is broken)."""
+    from ..resilience import commit
+    return commit.doctor_report(root)
+
+
 def cmd_doctor(args) -> int:
     deadline = guard.probe_deadline_s(args.deadline)
     report = {"python": sys.version.split()[0],
               "pid": os.getpid(),
               "env": _env_report()}
+    if args.ckpt_dir:
+        report["checkpoint"] = _checkpoint_report(args.ckpt_dir)
     print(f"doctor: import audit (deadline {deadline:g}s) ...",
           file=sys.stderr)
     report["import_audit"] = _import_audit(deadline)
@@ -140,6 +150,18 @@ def cmd_doctor(args) -> int:
     else:
         print("doctor: BACKEND UNREACHABLE: "
               f"{report['backend']['detail']}", file=sys.stderr)
+    ck = report.get("checkpoint")
+    if ck is not None:
+        if ck.get("newest_step") is None:
+            print(f"doctor: checkpoint root {ck['root']}: no committed "
+                  "steps", file=sys.stderr)
+        elif ck.get("newest_valid"):
+            print(f"doctor: checkpoint OK: step {ck['newest_step']} "
+                  "manifest + CRCs valid", file=sys.stderr)
+        else:
+            print(f"doctor: checkpoint step {ck['newest_step']} INVALID "
+                  f"({ck.get('newest_error')}); restorable: "
+                  f"{ck.get('restorable_step')}", file=sys.stderr)
     return 0 if report["healthy"] else (2 if not imp else 1)
 
 
@@ -158,6 +180,10 @@ def main(argv=None) -> int:
     d = sub.add_parser("doctor", help="hermetic environment report: "
                                       "import audit + probe + env")
     d.add_argument("--deadline", type=float, default=None)
+    d.add_argument("--ckpt-dir", default=os.environ.get("MXNET_TPU_CKPT_DIR"),
+                   help="commit-protocol checkpoint root: report the "
+                        "latest step's manifest validity and the newest "
+                        "restorable step (default MXNET_TPU_CKPT_DIR)")
     d.set_defaults(fn=cmd_doctor)
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -209,7 +209,8 @@ class Trainer:
         if self._optimizer_applied_on_kv:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
-            with open(fname, "wb") as f:
+            from ..resilience.atomic import atomic_write
+            with atomic_write(fname, "wb") as f:
                 f.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):

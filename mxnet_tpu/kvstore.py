@@ -49,13 +49,30 @@ def _ensure_distributed():
     if not addr:
         return
     import jax
-    try:
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=int(os.environ["MXTPU_NUM_PROC"]),
-            process_id=int(os.environ["MXTPU_PROC_ID"]))
-    except RuntimeError:
-        pass       # already joined at package import (mxnet_tpu/__init__)
+    from .resilience.retry import retry_call
+
+    def _join():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=int(os.environ["MXTPU_NUM_PROC"]),
+                process_id=int(os.environ["MXTPU_PROC_ID"]))
+        except RuntimeError as e:
+            # ONLY the already-joined double-init is benign (package
+            # import joins first; jax words it "should only be called
+            # once" / "already initialized" across versions). Connect
+            # and deadline failures surface as XlaRuntimeError — also a
+            # RuntimeError — and must NOT be mistaken for success:
+            # re-raise into the retry loop.
+            msg = str(e).lower()
+            if "already" in msg or "only be called once" in msg:
+                return
+            raise
+
+    # the coordinator may still be restarting after a preemption:
+    # transient connect failures get a bounded, journaled backoff
+    retry_call(_join, retry_on=(OSError, ConnectionError, RuntimeError),
+               what="jax.distributed.initialize")
     _dist_initialized = True
 
 
@@ -310,7 +327,8 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("no optimizer installed on this kvstore")
-        with open(fname, "wb") as f:
+        from .resilience.atomic import atomic_write
+        with atomic_write(fname, "wb") as f:
             f.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):

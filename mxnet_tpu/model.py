@@ -3,18 +3,40 @@
 Format parity: ``prefix-symbol.json`` (graph) + ``prefix-%04d.params``
 (NDArray dict with arg:/aux: prefixes), the same pair every reference-era
 deployment pipeline consumes (SURVEY §5.4).
+
+Crash consistency (docs/checkpointing.md): both files are written
+through ``resilience.atomic`` (tmp + fsync + rename), the ``.params``
+container carries CRC32s, and the resume path
+(:func:`load_latest_params` / ``module.fit(resume=True)``) walks epochs
+newest-first, *validating* each candidate and journaling a
+``ckpt_fallback`` record when a torn/corrupt file is skipped — a
+preempted save can cost at most one checkpoint interval, never the run.
 """
 from __future__ import annotations
 
+import contextlib
+import os
+import re
+
 from . import ndarray as nd
 from .base import MXNetError
+from .diagnostics.journal import get_journal
 
-__all__ = ["save_checkpoint", "load_checkpoint", "load_params"]
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params",
+           "list_checkpoint_epochs", "load_latest_params",
+           "gc_checkpoints"]
+
+_EPOCH_RE_T = r"^%s-(\d{4,})\.params$"
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
-    """ref: model.py save_checkpoint."""
+    """ref: model.py save_checkpoint. Atomic, and the prefix's directory
+    is created if missing (a checkpoint callback must not crash the run
+    because the output dir wasn't pre-made)."""
+    d = os.path.dirname(prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json")
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
@@ -43,3 +65,54 @@ def load_checkpoint(prefix, epoch):
     symbol = sym_mod.load(f"{prefix}-symbol.json")
     arg_params, aux_params = load_params(prefix, epoch)
     return symbol, arg_params, aux_params
+
+
+def list_checkpoint_epochs(prefix):
+    """Epoch numbers of every ``prefix-NNNN.params`` on disk, ascending."""
+    d, base = os.path.split(prefix)
+    pat = re.compile(_EPOCH_RE_T % re.escape(base))
+    try:
+        names = os.listdir(d or ".")
+    except OSError:
+        return []
+    return sorted(int(m.group(1)) for n in names
+                  for m in [pat.match(n)] if m)
+
+
+def load_latest_params(prefix):
+    """The newest epoch checkpoint that actually loads —
+    ``(arg_params, aux_params, epoch)`` — or None when none exists.
+
+    A torn or corrupt candidate (CRC/truncation MXNetError from
+    ``nd.load``) is skipped with a journaled ``ckpt_fallback`` record
+    and the next-newest tried: resume never dies on — and never
+    silently trains from — a bad file."""
+    for epoch in reversed(list_checkpoint_epochs(prefix)):
+        try:
+            arg_params, aux_params = load_params(prefix, epoch)
+            return arg_params, aux_params, epoch
+        except MXNetError as e:
+            get_journal().event(
+                "ckpt_fallback", prefix=prefix, epoch=epoch,
+                file=f"{prefix}-{epoch:04d}.params",
+                error=type(e).__name__, detail=str(e)[:300])
+    return None
+
+
+def gc_checkpoints(prefix, keep_last):
+    """Keep-last-k retention over ``prefix-NNNN.params`` (+ their
+    ``.states`` companions) and sweep crashed-writer tmp litter next to
+    the prefix. The symbol file is shared across epochs and kept."""
+    if not keep_last or keep_last < 1:
+        return []
+    removed = []
+    for epoch in list_checkpoint_epochs(prefix)[:-keep_last]:
+        for suffix in (".params", ".states"):
+            path = f"{prefix}-{epoch:04d}{suffix}"
+            with contextlib.suppress(OSError):
+                os.remove(path)
+                removed.append(path)
+    from .resilience.atomic import sweep_tmp
+    d, base = os.path.split(prefix)
+    sweep_tmp(d or ".", prefix=base)
+    return removed

@@ -108,11 +108,51 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
+            monitor=None, sparse_row_id_fn=None,
+            checkpoint_prefix=None, checkpoint_period=1, keep_last=None,
+            resume=False):
         """The reference's canonical symbolic training loop
-        (ref: base_module.py BaseModule.fit, SURVEY §3.3)."""
+        (ref: base_module.py BaseModule.fit, SURVEY §3.3).
+
+        Crash consistency (docs/checkpointing.md): with
+        ``checkpoint_prefix`` set, fit installs an atomic epoch-end
+        checkpoint (``keep_last``-bounded retention) and a SIGTERM
+        preemption watch — a preemption saves one checkpoint at the
+        next batch boundary, journals ``preempt_checkpoint``, and
+        returns. ``resume=True`` restarts from the newest *valid*
+        checkpoint under the prefix, skipping torn/corrupt files with a
+        journaled ``ckpt_fallback`` (a fresh start when none exists)."""
+        from ..diagnostics.journal import get_journal
         if num_epoch is None:
             raise MXNetError("fit() requires num_epoch")
+        watch = None
+        if resume and not checkpoint_prefix:
+            raise MXNetError("fit(resume=True) needs checkpoint_prefix=")
+        if checkpoint_prefix:
+            from .. import callback as callback_mod
+            from ..resilience import preempt
+            cbs = list(_as_list(epoch_end_callback or []))
+            cbs.append(callback_mod.do_checkpoint(
+                checkpoint_prefix, checkpoint_period, keep_last=keep_last))
+            epoch_end_callback = cbs
+            # re-arm: a SIGTERM consumed by a previous fit() in this
+            # process must not mute preemption handling for this run
+            # (a live unconsumed signal stays latched)
+            watch = preempt.install()
+            watch.rearm()
+        if resume:
+            from .. import model
+            found = model.load_latest_params(checkpoint_prefix)
+            if found is not None:
+                arg_params, aux_params, begin_epoch = found
+                force_init = True
+                get_journal().event("resume", prefix=checkpoint_prefix,
+                                    epoch=begin_epoch)
+                self.logger.info("fit(resume=True): resuming from epoch "
+                                 "%d of %s", begin_epoch, checkpoint_prefix)
+            else:
+                get_journal().event("resume_fresh",
+                                    prefix=checkpoint_prefix)
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -131,37 +171,64 @@ class BaseModule:
         if monitor is not None:
             self.install_monitor(monitor)
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            train_data.reset()
-            for nbatch, data_batch in enumerate(train_data):
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                if monitor is not None:
-                    monitor.toc_print()
-                self.update_metric(eval_metric, data_batch.label)
-                if batch_end_callback is not None:
-                    for cb in _as_list(batch_end_callback):
-                        cb(_BatchEndParam(epoch, nbatch, eval_metric,
-                                          locals()))
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - tic)
-            if epoch_end_callback is not None:
-                arg_params, aux_params = self.get_params()
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_params, aux_params)
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                train_data.reset()
+                for nbatch, data_batch in enumerate(train_data):
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    if monitor is not None:
+                        monitor.toc_print()
+                    self.update_metric(eval_metric, data_batch.label)
+                    if batch_end_callback is not None:
+                        for cb in _as_list(batch_end_callback):
+                            cb(_BatchEndParam(epoch, nbatch, eval_metric,
+                                              locals()))
+                    if watch is not None and watch.consume():
+                        # preemption: save at this step boundary and
+                        # stop. Saving with the CURRENT epoch number
+                        # means resume re-runs this (partial) epoch —
+                        # conservative, never skips data.
+                        arg_p, aux_p = self.get_params()
+                        from .. import model
+                        model.save_checkpoint(checkpoint_prefix, epoch,
+                                              self.symbol, arg_p, aux_p)
+                        get_journal().event(
+                            "preempt_checkpoint",
+                            prefix=checkpoint_prefix,
+                            epoch=epoch, nbatch=nbatch)
+                        self.logger.warning(
+                            "SIGTERM: checkpoint saved at epoch %d batch "
+                            "%d (%s); stopping fit", epoch, nbatch,
+                            checkpoint_prefix)
+                        return
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - tic)
+                if epoch_end_callback is not None:
+                    arg_params, aux_params = self.get_params()
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_params, aux_params)
+                if eval_data is not None:
+                    res = self.score(
+                        eval_data, validation_metric,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+        finally:
+            if watch is not None:
+                # nothing polls the watch after fit: restore the
+                # displaced SIGTERM disposition (else the process would
+                # silently ignore termination forever)
+                watch.uninstall()
 
     @property
     def symbol(self):

@@ -214,7 +214,8 @@ class Module(BaseModule):
         model.save_checkpoint(prefix, epoch, self._symbol, arg_params,
                               aux_params)
         if save_optimizer_states:
-            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+            from ..resilience.atomic import atomic_write
+            with atomic_write(f"{prefix}-{epoch:04d}.states", "wb") as f:
                 f.write(self._updater.get_states(dump_optimizer=True))
 
     @staticmethod

@@ -16,7 +16,9 @@ mapping (SURVEY §7 translation table):
 """
 from __future__ import annotations
 
+import os
 import struct
+import zlib
 from typing import Optional
 
 import jax
@@ -495,12 +497,23 @@ def waitall():
 # ---------------------------------------------------------------------------
 # save / load — the `.params` container (ref: src/ndarray/ndarray.cc
 # NDArray::Save/Load via MXNDArraySave). Binary layout follows the reference's
-# documented structure (list magic + per-array magic, shape, context, dtype);
-# byte-level parity with real reference files must be re-verified when the
-# reference mount is populated (SURVEY provenance warning).
+# documented structure (list magic + per-array magic, shape, context, dtype).
+#
+# Crash consistency (docs/checkpointing.md): the writer goes through
+# resilience.atomic (tmp + fsync + os.replace — a reader can never see a
+# torn file) and stamps the format-flag word in the header with
+# _FMT_CRC: each array entry is followed by its CRC32 and the file ends
+# with a <body-length, footer-magic> footer, so load() proves integrity
+# up front. Reference-era files (flag word 0) still load, minus the
+# checksum proof. Every read is bounds-checked: truncation or corruption
+# raises a structured MXNetError, never struct.error or silent garbage.
 # ---------------------------------------------------------------------------
 _LIST_MAGIC = 0x112          # kMXAPINDArrayListMagic
 _ND_MAGIC = 0xF993FAC9       # NDArray binary magic (v2)
+_FOOTER_MAGIC = 0x4D585450_43524333   # "MXTP CRC3"
+_FMT_LEGACY, _FMT_CRC = 0, 1
+# footer: <Q body_len> <I names_crc> <I reserved> <Q footer_magic>
+_FOOTER_BYTES = 24
 
 _DTYPE_CODE = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
                "int32": 4, "int8": 5, "int64": 6, "bool": 7, "bfloat16": 12}
@@ -508,7 +521,12 @@ _CODE_DTYPE = {v: k for k, v in _DTYPE_CODE.items()}
 
 
 def save(fname: str, data):
-    """Save NDArrays (list or str->NDArray dict) to a .params file."""
+    """Save NDArrays (list or str->NDArray dict) to a .params file.
+
+    Atomic: the bytes land in a same-directory temp file that is
+    fsynced and renamed over ``fname`` — a crash at any point leaves
+    either the previous file or the new one, never a torn mix."""
+    from ..resilience.atomic import atomic_write
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
@@ -517,67 +535,206 @@ def save(fname: str, data):
     else:
         names = []
         arrays = list(data)
-    with open(fname, "wb") as f:
-        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+    with atomic_write(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, _FMT_CRC))
         f.write(struct.pack("<Q", len(arrays)))
         for arr in arrays:
-            _write_ndarray(f, arr)
-        f.write(struct.pack("<Q", len(names)))
+            crc = _write_ndarray(f, arr)
+            f.write(struct.pack("<I", crc))
+        tail = [struct.pack("<Q", len(names))]
         for n in names:
             b = n.encode("utf-8")
-            f.write(struct.pack("<Q", len(b)))
-            f.write(b)
+            tail.append(struct.pack("<Q", len(b)))
+            tail.append(b)
+        tail_bytes = b"".join(tail)
+        f.write(tail_bytes)
+        # f.nbytes: the atomic handle's running byte count = body length
+        f.write(struct.pack("<QIIQ", f.nbytes,
+                            zlib.crc32(tail_bytes) & 0xFFFFFFFF, 0,
+                            _FOOTER_MAGIC))
 
 
-def _write_ndarray(f, arr: NDArray):
+def _write_ndarray(f, arr: NDArray) -> int:
+    """Serialize one array; returns the CRC32 of the entry's bytes."""
     np_arr = arr.asnumpy()
-    f.write(struct.pack("<I", _ND_MAGIC))
-    f.write(struct.pack("<I", len(np_arr.shape)))
+    pieces = [struct.pack("<I", _ND_MAGIC),
+              struct.pack("<I", len(np_arr.shape))]
     for s in np_arr.shape:
-        f.write(struct.pack("<q", s))
-    f.write(struct.pack("<ii", arr.ctx.device_typeid, arr.ctx.device_id))
+        pieces.append(struct.pack("<q", s))
+    pieces.append(struct.pack("<ii", arr.ctx.device_typeid,
+                              arr.ctx.device_id))
     dt = np.dtype(np_arr.dtype).name
-    f.write(struct.pack("<i", _DTYPE_CODE.get(dt, 0)))
+    if dt not in _DTYPE_CODE:
+        # stamping an unknown dtype as float32 would let the CRCs
+        # certify bytes that load() then misdecodes — the silent-garbage
+        # class the strict load path exists to kill; refuse symmetrically
+        raise MXNetError(f"nd.save: dtype {dt!r} has no .params dtype "
+                         f"code (supported: {sorted(_DTYPE_CODE)})")
+    pieces.append(struct.pack("<i", _DTYPE_CODE[dt]))
     if dt == "bfloat16":
         np_arr = np_arr.view(np.uint16)
-    f.write(np_arr.tobytes())
+    pieces.append(np_arr.tobytes())
+    crc = 0
+    for piece in pieces:
+        f.write(piece)
+        crc = zlib.crc32(piece, crc)
+    return crc & 0xFFFFFFFF
+
+
+class _BoundedReader:
+    """Bounds-checked reads over the container body: a short or
+    out-of-bounds read is a structured truncation error (the torn-file
+    class this format exists to catch), never struct.error. Optionally
+    accumulates a CRC over everything read (per-entry verification)."""
+
+    def __init__(self, f, fname, limit):
+        self._f = f
+        self._fname = fname
+        self._limit = limit
+        self._crc = None
+
+    def read(self, n, what):
+        if n < 0 or self._f.tell() + n > self._limit:
+            raise MXNetError(
+                f"{self._fname}: truncated or corrupt .params file — "
+                f"{what} wants {n} bytes but only "
+                f"{max(self._limit - self._f.tell(), 0)} remain (was the "
+                "save interrupted?)")
+        data = self._f.read(n)
+        if len(data) != n:
+            raise MXNetError(
+                f"{self._fname}: truncated .params file — short read "
+                f"({len(data)}/{n} bytes) for {what}")
+        if self._crc is not None:
+            self._crc = zlib.crc32(data, self._crc)
+        return data
+
+    def unpack(self, fmt, what):
+        return struct.unpack(fmt, self.read(struct.calcsize(fmt), what))
+
+    def begin_crc(self):
+        self._crc = 0
+
+    def end_crc(self) -> int:
+        crc, self._crc = self._crc, None
+        return crc & 0xFFFFFFFF
+
+    def tell(self):
+        return self._f.tell()
 
 
 def load(fname: str):
-    """Load a .params file -> list or dict of NDArrays."""
+    """Load a .params file -> list or dict of NDArrays.
+
+    Integrity is verified up front for files written by this package
+    (length footer + per-entry CRC32); any truncation or corruption
+    raises MXNetError naming the defect."""
     with open(fname, "rb") as f:
-        magic, _res = struct.unpack("<QQ", f.read(16))
+        size = os.fstat(f.fileno()).st_size
+        if size < 24:
+            raise MXNetError(f"{fname}: truncated .params file — "
+                             f"{size} bytes is smaller than any header")
+        magic, fmt = struct.unpack("<QQ", f.read(16))
         if magic != _LIST_MAGIC:
-            raise MXNetError(f"{fname}: bad magic {magic:#x} — not an NDArray "
-                             "save file")
-        (count,) = struct.unpack("<Q", f.read(8))
-        arrays = [_read_ndarray(f) for _ in range(count)]
-        (n_names,) = struct.unpack("<Q", f.read(8))
+            raise MXNetError(f"{fname}: bad magic {magic:#x} — not an "
+                             "NDArray save file")
+        names_crc = None
+        if fmt == _FMT_CRC:
+            if size < 16 + _FOOTER_BYTES:
+                raise MXNetError(f"{fname}: truncated .params file — "
+                                 "no room for the integrity footer")
+            limit = size - _FOOTER_BYTES
+            f.seek(limit)
+            body_len, names_crc, _resv, fmagic = struct.unpack(
+                "<QIIQ", f.read(_FOOTER_BYTES))
+            if fmagic != _FOOTER_MAGIC or body_len != limit:
+                raise MXNetError(
+                    f"{fname}: truncated or corrupt .params file — "
+                    "footer missing or inconsistent (the save was "
+                    "interrupted before commit)")
+            f.seek(16)
+        elif fmt == _FMT_LEGACY:
+            limit = size
+        else:
+            raise MXNetError(f"{fname}: unsupported .params format flag "
+                             f"{fmt} — written by a newer version?")
+        verify = fmt == _FMT_CRC
+        r = _BoundedReader(f, fname, limit)
+        (count,) = r.unpack("<Q", "array count")
+        if count > limit:                    # cheap sanity vs corrupt counts
+            raise MXNetError(f"{fname}: corrupt .params file — implausible "
+                             f"array count {count}")
+        arrays = []
+        for i in range(count):
+            arr = _read_ndarray(r, verify, fname, i)
+            arrays.append(arr)
+        if verify:
+            r.begin_crc()
+        (n_names,) = r.unpack("<Q", "name count")
+        if n_names > limit:
+            raise MXNetError(f"{fname}: corrupt .params file — implausible "
+                             f"name count {n_names}")
         names = []
-        for _ in range(n_names):
-            (ln,) = struct.unpack("<Q", f.read(8))
-            names.append(f.read(ln).decode("utf-8"))
+        for i in range(n_names):
+            (ln,) = r.unpack("<Q", f"name {i} length")
+            try:
+                names.append(r.read(ln, f"name {i}").decode("utf-8"))
+            except UnicodeDecodeError as e:
+                raise MXNetError(f"{fname}: corrupt .params file — "
+                                 f"name {i} is not valid UTF-8") from e
+        if verify:
+            if r.end_crc() != names_crc:
+                raise MXNetError(f"{fname}: checksum mismatch in the name "
+                                 "table — the file is corrupt")
+            if r.tell() != limit:
+                raise MXNetError(
+                    f"{fname}: corrupt .params file — "
+                    f"{limit - r.tell()} unexpected trailing bytes")
     if names:
         return dict(zip(names, arrays))
     return arrays
 
 
-def _read_ndarray(f) -> NDArray:
-    (magic,) = struct.unpack("<I", f.read(4))
+def _read_ndarray(r: _BoundedReader, verify: bool, fname: str,
+                  index: int) -> NDArray:
+    what = f"array entry {index}"
+    r.begin_crc()
+    (magic,) = r.unpack("<I", what)
     if magic != _ND_MAGIC:
-        raise MXNetError("corrupt NDArray entry")
-    (ndim,) = struct.unpack("<I", f.read(4))
-    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
-    dev_type, dev_id = struct.unpack("<ii", f.read(8))
-    (dtype_code,) = struct.unpack("<i", f.read(4))
-    dt = _CODE_DTYPE.get(dtype_code, "float32")
+        raise MXNetError(f"{fname}: corrupt NDArray entry {index} "
+                         f"(bad entry magic {magic:#x})")
+    (ndim,) = r.unpack("<I", what)
+    if ndim > 64:
+        raise MXNetError(f"{fname}: corrupt NDArray entry {index} — "
+                         f"implausible rank {ndim}")
+    shape = tuple(r.unpack("<q", what)[0] for _ in range(ndim))
+    if any(s < 0 for s in shape):
+        raise MXNetError(f"{fname}: corrupt NDArray entry {index} — "
+                         f"negative dimension in shape {shape}")
+    _dev_type, _dev_id = r.unpack("<ii", what)
+    (dtype_code,) = r.unpack("<i", what)
+    dt = _CODE_DTYPE.get(dtype_code)
+    if dt is None:
+        raise MXNetError(
+            f"{fname}: unknown dtype code {dtype_code} in entry {index} "
+            "— file from a newer format or corrupt (refusing to guess "
+            "a dtype)")
     count = int(np.prod(shape)) if ndim else 1
     if dt == "bfloat16":
         import ml_dtypes
-        raw = np.frombuffer(f.read(count * 2), dtype=np.uint16)
+        raw = np.frombuffer(r.read(count * 2, what + " data"),
+                            dtype=np.uint16)
         np_arr = raw.view(ml_dtypes.bfloat16).reshape(shape)
     else:
         npdt = np.dtype(dt)
-        np_arr = np.frombuffer(f.read(count * npdt.itemsize),
+        np_arr = np.frombuffer(r.read(count * npdt.itemsize, what + " data"),
                                dtype=npdt).reshape(shape)
+    crc = r.end_crc()
+    if verify:
+        (want,) = r.unpack("<I", what + " checksum")
+        if crc != want:
+            raise MXNetError(
+                f"{fname}: checksum mismatch in entry {index} "
+                f"(stored {want:#010x}, computed {crc:#010x}) — the "
+                "file is corrupt")
     return NDArray(np_arr)

@@ -5,7 +5,16 @@ Layout: a ``.params``-format container (readable by ``nd.load``) with a
 JSON ``__meta__`` entry. Single-process saves write one file; multi-host
 saves write one ``.shard<rank>`` file per process holding only
 locally-owned shards (entry key ``<name>|<index>``), plus a rank-0 meta
-file, with group barriers so no reader sees a half-written set."""
+file, with group barriers so no reader sees a half-written set.
+
+Crash consistency: every file lands via ``nd.save``'s atomic path, and
+the directory-level commit protocol (:func:`commit_checkpoint` /
+:func:`restore_checkpoint`, built on ``resilience.commit``) stages a
+whole multi-file step under ``step-N.tmp/``, publishes it behind a
+rank-0 MANIFEST + rename commit point, maintains a ``latest`` pointer
+and keep-last-k retention, and restores from the newest step that
+passes CRC validation — journaling every corrupt candidate it skips
+(docs/checkpointing.md)."""
 from __future__ import annotations
 
 import json
@@ -17,6 +26,8 @@ import numpy as np
 
 from .. import ndarray as nd
 from ..base import MXNetError
+from ..diagnostics.journal import get_journal
+from ..resilience import commit as _commit
 
 CKPT_FORMAT = 1
 
@@ -170,3 +181,99 @@ def restore_rng(meta):
     data = np.asarray(meta["rng_data"], dtype=np.uint32).reshape(
         meta["rng_shape"])
     _rng.set_state(data, meta["rng_impl"])
+
+
+# -- directory commit protocol (resilience.commit glued to the trainer
+#    save/load callbacks; the crash-matrix tests drive commit directly) -----
+
+CKPT_BASENAME = "ckpt"
+
+
+def _bcast_int(value):
+    """Rank 0's integer, agreed group-wide (identity single-process).
+    Validation choices MUST be made once and shared: per-rank
+    re-validation would both diverge on a corrupt candidate and stream
+    every shard of every candidate through every process (O(world^2)
+    reads of the shared filesystem)."""
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+    return int(np.asarray(multihost_utils.broadcast_one_to_all(
+        np.asarray(int(value), dtype=np.int64))))
+
+
+def commit_checkpoint(root, step, save_cb, keep_last=None):
+    """Commit-protocol save: ``save_cb(prefix)`` stages this process's
+    files (the existing save_checkpoint/save_states writers, untouched)
+    under ``<root>/step-N.tmp/``; after a group barrier rank 0 writes
+    the CRC manifest, publishes the step with one rename, moves the
+    ``latest`` pointer, and applies keep-last-k retention."""
+    step = int(step)
+    already = False
+    if jax.process_index() == 0:
+        try:
+            _commit.validate_step(root, step)
+            already = True       # e.g. restore -> immediate re-checkpoint
+        except ValueError:
+            pass
+    if _bcast_int(already):
+        # same step number = same trainer state (step is the update
+        # count): re-publishing would only re-rename an identical dir
+        get_journal().event("ckpt_skip_existing", root=root, step=step)
+        return step
+    if jax.process_index() == 0:
+        _commit.prepare_stage(root, step)
+    barrier("ckpt_stage")
+    save_cb(os.path.join(_commit.stage_dir(root, step), CKPT_BASENAME))
+    barrier("ckpt_staged")
+    if jax.process_index() == 0:
+        _commit.finalize(root, step, keep_last=keep_last,
+                         meta={"world": jax.process_count()})
+        get_journal().event("ckpt_committed", root=root, step=step)
+    barrier("ckpt_committed")
+    return step
+
+
+_NO_VALID, _PINNED_BAD = -1, -2
+
+
+def restore_checkpoint(root, load_cb, step=None):
+    """Resume from ``root``: with ``step`` pinned, that step must
+    validate; otherwise the newest valid committed step wins, and every
+    corrupt/torn candidate skipped on the way down is journaled as
+    ``ckpt_fallback`` (never a silent skip, never an exception escape
+    for a *recoverable* root).
+
+    CRC validation (which streams every candidate's files) runs on rank
+    0 only; the chosen step is broadcast so the group restores the same
+    step without each process re-reading every shard of every
+    candidate."""
+    def _skip(s, reason):
+        get_journal().event("ckpt_fallback", root=root, step=s,
+                            detail=reason[:300])
+
+    found = _NO_VALID
+    pinned_err = ""
+    if jax.process_index() == 0:
+        if step is not None:
+            try:
+                _commit.validate_step(root, int(step))
+                found = int(step)
+            except ValueError as e:
+                found, pinned_err = _PINNED_BAD, str(e)
+        else:
+            got = _commit.find_restorable(root, on_skip=_skip)
+            if got is not None:
+                found = got[0]
+    found = _bcast_int(found)
+    if found == _PINNED_BAD:
+        raise MXNetError(f"checkpoint step {step} under {root!r} failed "
+                         f"validation: {pinned_err or 'see rank 0'}")
+    if found == _NO_VALID:
+        raise MXNetError(
+            f"no valid committed checkpoint under {root!r} — nothing "
+            "to restore (uncommitted step-*.tmp staging dirs and "
+            "corrupt steps are ignored)")
+    load_cb(os.path.join(_commit.step_dir(root, found), CKPT_BASENAME))
+    get_journal().event("ckpt_restored", root=root, step=found)
+    return found

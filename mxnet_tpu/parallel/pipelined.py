@@ -495,6 +495,32 @@ class PipelinedTrainer:
         self._optimizer.num_update = self._num_update
         _ckpt.restore_rng(meta)
 
+    def checkpoint(self, ckpt_dir, step=None, keep_last=None,
+                   per_shard=None):
+        """Crash-consistent directory checkpoint — same commit protocol
+        as ``ShardedTrainer.checkpoint`` (stage → rank-0 CRC manifest →
+        rename publish → latest pointer → keep-last-k GC). Returns the
+        committed step."""
+        self._require_prepared()
+        from . import _ckpt
+        step = int(self._num_update if step is None else step)
+        return _ckpt.commit_checkpoint(
+            ckpt_dir, step,
+            lambda prefix: self.save_checkpoint(prefix,
+                                                per_shard=per_shard),
+            keep_last=keep_last)
+
+    def restore(self, ckpt_dir, step=None, latest=True):
+        """Resume from the newest valid committed step under
+        ``ckpt_dir`` (corrupt candidates skipped with a journaled
+        ``ckpt_fallback``). Returns the restored step."""
+        self._require_prepared()
+        from . import _ckpt
+        if step is None and not latest:
+            raise MXNetError("restore needs step=N or latest=True")
+        return _ckpt.restore_checkpoint(ckpt_dir, self.load_checkpoint,
+                                        step=step)
+
     def prepare(self, x_example):
         """Materialize stacked/sharded state without stepping (the resume
         entry point: prepare, then ``load_checkpoint``)."""

@@ -815,6 +815,35 @@ class ShardedTrainer:
                 pieces))
         self.load_states(f"{prefix}.states")
 
+    def checkpoint(self, ckpt_dir, step=None, keep_last=None,
+                   per_shard=None):
+        """Crash-consistent directory checkpoint (the commit protocol,
+        docs/checkpointing.md): params + optimizer state staged under
+        ``<ckpt_dir>/step-N.tmp/``, committed behind a rank-0 CRC
+        manifest + rename, ``latest`` pointer moved, keep-last-k
+        retention applied. ``step`` defaults to the trainer's completed
+        update count. Returns the committed step."""
+        self._require_prepared("checkpoint")
+        step = int(self._num_update if step is None else step)
+        return _ckpt.commit_checkpoint(
+            ckpt_dir, step,
+            lambda prefix: self.save_checkpoint(prefix,
+                                                per_shard=per_shard),
+            keep_last=keep_last)
+
+    def restore(self, ckpt_dir, step=None, latest=True):
+        """Resume from the newest *valid* committed step under
+        ``ckpt_dir`` (or a pinned ``step``): a corrupt/torn newest
+        checkpoint is skipped with a journaled ``ckpt_fallback`` and
+        the next-newest intact one restored. The trainer must be
+        prepared (same architecture/optimizer/mesh contract as
+        ``load_checkpoint``). Returns the restored step."""
+        self._require_prepared("restore")
+        if step is None and not latest:
+            raise MXNetError("restore needs step=N or latest=True")
+        return _ckpt.restore_checkpoint(ckpt_dir, self.load_checkpoint,
+                                        step=step)
+
     # -- parity helpers ------------------------------------------------------
     @property
     def num_update(self):

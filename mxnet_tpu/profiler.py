@@ -98,7 +98,8 @@ def dump(finished=True, profile_process="worker"):
             "profiler.dump(): chrome-trace conversion unavailable (%s); "
             "raw xplane kept under %s", e, _trace_dir)
         return
-    with open(_config.get("filename", "profile.json"), "w") as f:
+    from .resilience.atomic import atomic_write
+    with atomic_write(_config.get("filename", "profile.json"), "w") as f:
         f.write(data)
 
 

@@ -1,0 +1,29 @@
+"""``mx.resilience`` — crash-consistency + transient-fault toolkit.
+
+Four parts, all stdlib-only at import (no jax — the same wedge-proof
+contract as ``mx.diagnostics``):
+
+- :mod:`.atomic` — ``atomic_write``: tmp + fsync + ``os.replace``, the
+  one sanctioned path for durable artifacts (graftlint G7 enforces it),
+  with the fault-injection seam the crash-matrix tests drive.
+- :mod:`.commit` — the directory commit protocol for multi-file /
+  multi-host checkpoints: staged shards, a CRC'd MANIFEST behind a
+  single rename commit point, a ``latest`` pointer, keep-last-k GC,
+  and validated newest-first restore.
+- :mod:`.retry` — bounded exponential backoff + jitter for transient
+  filesystem / coordination-service faults, journaled per attempt.
+- :mod:`.preempt` — SIGTERM → checkpoint-at-next-step-boundary.
+
+See docs/checkpointing.md for the format, protocol, and the
+fault-injection cookbook.
+"""
+from __future__ import annotations
+
+from . import atomic, commit, preempt, retry
+from .atomic import atomic_write, fsync_dir, sweep_tmp
+from .commit import find_restorable, validate_step
+from .retry import backoff_delays, retry_call
+
+__all__ = ["atomic", "atomic_write", "backoff_delays", "commit",
+           "find_restorable", "fsync_dir", "preempt", "retry",
+           "retry_call", "sweep_tmp", "validate_step"]

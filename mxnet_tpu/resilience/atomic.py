@@ -1,0 +1,171 @@
+"""Crash-consistent file writes: tmp + flush + fsync + ``os.replace``.
+
+A preemption mid-``nd.save`` used to leave a torn ``.params`` file that
+``load`` would misparse. This module is the one sanctioned write path
+for durable artifacts (graftlint rule G7 flags direct ``open(path,
+"wb")`` writes): the caller streams into a same-directory temp file,
+which is fsynced and atomically renamed over the target, so a reader
+can only ever observe the complete old bytes or the complete new bytes.
+
+Fault-injection seam: :mod:`mxnet_tpu.testing.faults` installs a hook
+via :func:`set_fault_hook`; the hook is consulted at every named phase
+(``open``, ``write`` with a cumulative byte count, ``fsync``,
+``replace``, ``after_replace``, ``dir_fsync`` — plus points other
+modules register through :func:`trip`, e.g. the commit protocol's
+``publish``/``gc``). The crash-matrix tests kill the writer at each
+phase and prove the old-or-new guarantee.
+
+Cleanup policy mirrors real crashes: an ordinary ``Exception`` unlinks
+the temp file (no litter from recoverable errors); a ``BaseException``
+— the harness's ``SimulatedCrash``, KeyboardInterrupt, a real kill —
+leaves the torn temp on disk, exactly like a dead process would, and
+:func:`sweep_tmp` (run by checkpoint GC) collects it later.
+
+Stdlib-only; transient fsync/replace failures ride
+``resilience.retry`` (journaled, bounded).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from ..diagnostics.journal import get_journal
+from .retry import retry_call
+
+__all__ = ["atomic_write", "fsync_dir", "set_fault_hook", "sweep_tmp",
+           "trip"]
+
+_TMP_MARK = ".tmp."
+
+_fault_hook = None
+
+
+def set_fault_hook(hook):
+    """Install (or, with None, remove) the process-wide fault hook;
+    returns the previous hook so tests can nest/restore."""
+    global _fault_hook
+    prev = _fault_hook
+    _fault_hook = hook
+    return prev
+
+
+def trip(point: str, path: str, nbytes: int | None = None,
+         size: int | None = None) -> None:
+    """Consult the fault hook at a named phase (``nbytes`` = bytes
+    already written, ``size`` = bytes about to be written, for the
+    ``write`` point). Library code calls this at its own commit points
+    (e.g. ``commit.publish``) so one hook drives the whole crash
+    matrix; a no-op unless a hook is installed."""
+    if _fault_hook is not None:
+        _fault_hook(point, path=path, nbytes=nbytes, size=size)
+
+
+class _Handle:
+    """File wrapper that counts written bytes and exposes the ``write``
+    fault point (crash-after-N-bytes injection)."""
+
+    def __init__(self, f, path):
+        self._f = f
+        self._path = path
+        self.nbytes = 0
+
+    def write(self, data):
+        trip("write", self._path, nbytes=self.nbytes, size=len(data))
+        n = self._f.write(data)
+        self.nbytes += len(data)
+        return n
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def fsync_dir(path: str) -> None:
+    """Durably record a rename: fsync the parent directory. Failures are
+    journaled, not raised — on filesystems that reject directory fsync
+    (some tmpfs/NFS builds) the rename itself already happened and the
+    save must not be reported as lost."""
+    d = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+
+    def _do_fsync():
+        trip("dir_fsync", d)
+        os.fsync(fd)
+
+    try:
+        retry_call(_do_fsync, what=f"fsync_dir:{d}")
+    except OSError as exc:
+        get_journal().event("fsync_dir_failed", dir=d,
+                            error=type(exc).__name__,
+                            detail=str(exc)[:200])
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_write(path, mode: str = "wb", encoding: str | None = None,
+                 durable: bool = True):
+    """Write ``path`` all-or-nothing: yield a file handle over
+    ``<path>.tmp.<pid>``; on clean exit flush + fsync + ``os.replace``
+    into place (+ parent-directory fsync when ``durable``).
+
+    ``mode`` must be a write mode ('wb', 'w'); text mode takes
+    ``encoding``. The temp lives in the target's directory so the
+    rename never crosses a filesystem boundary."""
+    path = os.fspath(path)
+    tmp = f"{path}{_TMP_MARK}{os.getpid()}"
+    trip("open", tmp)
+    kwargs = {} if "b" in mode else {"encoding": encoding or "utf-8"}
+    f = open(tmp, mode, **kwargs)
+
+    def _do_fsync():
+        trip("fsync", tmp)
+        os.fsync(f.fileno())
+
+    def _do_replace():
+        trip("replace", path)
+        os.replace(tmp, path)
+
+    try:
+        try:
+            yield _Handle(f, tmp)
+            f.flush()
+            if durable:
+                retry_call(_do_fsync, what=f"fsync:{tmp}")
+            else:
+                trip("fsync", tmp)
+        finally:
+            f.close()
+        retry_call(_do_replace, what=f"replace:{path}")
+        trip("after_replace", path)
+        if durable:
+            fsync_dir(path)
+    except Exception:
+        # recoverable failure: don't litter. A BaseException (simulated
+        # or real crash) skips this, leaving the torn tmp like a dead
+        # process would.
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def sweep_tmp(dirpath: str, prefix: str | None = None) -> list[str]:
+    """Remove stale ``*.tmp.<pid>`` litter left by crashed writers in
+    ``dirpath`` (optionally only names starting with ``prefix``).
+    Returns the removed names; missing dir is a no-op."""
+    removed = []
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return removed
+    for name in names:
+        if _TMP_MARK not in name:
+            continue
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        with contextlib.suppress(OSError):
+            os.unlink(os.path.join(dirpath, name))
+            removed.append(name)
+    return removed

@@ -1,0 +1,293 @@
+"""Directory-granular commit protocol for multi-file checkpoints.
+
+The multi-host shard writer used to expose a rank-0 meta file whose
+shard set never finished — a reader could pick up a checkpoint that was
+never completely written. This module gives every multi-file save one
+commit point (the CheckFreq/Gemini discipline: cheap frequent
+checkpoints are only worth taking if recovery can trust them):
+
+Layout under a checkpoint root::
+
+    <root>/step-00000042.tmp/   staging — readers always ignore it
+    <root>/step-00000042/       committed — contains MANIFEST.json
+    <root>/latest               pointer file (a hint; re-validated)
+
+Writer protocol (single writer per root; multi-host ranks share the
+root on a common filesystem and the caller supplies the barrier):
+
+1. rank 0 ``prepare_stage`` (wipes a half-written stage from a crashed
+   attempt at the same step); barrier.
+2. every rank writes its files into the stage dir via ``atomic_write``;
+   barrier.
+3. rank 0 ``finalize``: writes ``MANIFEST.json`` (file list + CRC32 +
+   sizes + step + caller meta) atomically INSIDE the stage, renames the
+   stage to ``step-N/`` (the commit point — a visible step dir always
+   holds a complete manifest), rewrites ``latest``, then GC:
+   keep-last-k committed steps plus stale ``*.tmp`` stages.
+
+Reader protocol: try the ``latest`` hint, then every committed step
+newest-first; a dir whose manifest is missing/corrupt or whose files
+fail CRC is skipped (caller-journaled) and the next-newest tried — so
+restore always lands on the newest checkpoint that is provably intact.
+
+Stdlib-only (no jax, no ndarray): the diagnostics doctor validates
+manifests from contexts where the runtime itself may be broken.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import zlib
+
+from . import atomic
+
+__all__ = ["MANIFEST", "committed_steps", "doctor_report", "file_crc",
+           "finalize", "find_restorable", "gc_steps", "prepare_stage",
+           "read_latest", "read_manifest", "stage_dir", "step_dir",
+           "validate_step", "write_latest", "write_manifest"]
+
+MANIFEST = "MANIFEST.json"
+LATEST = "latest"
+FORMAT = 1
+
+_STEP_RE = re.compile(r"^step-(\d{8})$")
+
+
+def step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step-{int(step):08d}")
+
+
+def stage_dir(root: str, step: int) -> str:
+    return step_dir(root, step) + ".tmp"
+
+
+def prepare_stage(root: str, step: int) -> str:
+    """Create a fresh staging dir for ``step``; a half-written stage
+    from a previous crashed attempt at the same step is wiped."""
+    s = stage_dir(root, step)
+    if os.path.isdir(s):
+        shutil.rmtree(s)
+    os.makedirs(s, exist_ok=True)
+    return s
+
+
+def file_crc(path: str, chunksize: int = 1 << 20):
+    """(crc32, size) of a file's bytes, streamed."""
+    crc, size = 0, 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunksize)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return crc & 0xFFFFFFFF, size
+
+
+def _payload_files(dirpath: str) -> list[str]:
+    """Regular files in a step dir that belong to the checkpoint: the
+    manifest itself and crashed-writer tmp litter don't count."""
+    out = []
+    for name in sorted(os.listdir(dirpath)):
+        if name == MANIFEST or atomic._TMP_MARK in name:
+            continue
+        if os.path.isfile(os.path.join(dirpath, name)):
+            out.append(name)
+    return out
+
+
+def write_manifest(dirpath: str, step: int, meta: dict | None = None):
+    """Checksum every payload file in ``dirpath`` and write the manifest
+    atomically. Returns the manifest document."""
+    files = {}
+    for name in _payload_files(dirpath):
+        crc, size = file_crc(os.path.join(dirpath, name))
+        files[name] = {"crc32": crc, "size": size}
+    if not files:
+        raise ValueError(f"{dirpath}: nothing staged — refusing to "
+                         "commit an empty checkpoint")
+    doc = {"format": FORMAT, "step": int(step), "files": files,
+           "meta": meta or {}}
+    with atomic.atomic_write(os.path.join(dirpath, MANIFEST), "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    return doc
+
+
+def read_manifest(dirpath: str) -> dict:
+    """Parse + schema-check a step dir's manifest. Raises ValueError
+    (with a reason) on anything short of a well-formed document."""
+    path = os.path.join(dirpath, MANIFEST)
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise ValueError(f"no manifest ({e.strerror or e})") from e
+    except ValueError as e:
+        raise ValueError(f"manifest not valid JSON ({e})") from e
+    if not isinstance(doc, dict) or doc.get("format") != FORMAT \
+            or not isinstance(doc.get("files"), dict) \
+            or not isinstance(doc.get("step"), int):
+        raise ValueError("manifest malformed or unsupported format")
+    return doc
+
+
+def validate_step(root: str, step: int) -> dict:
+    """Prove a committed step intact: manifest well-formed, every listed
+    file present with matching size + CRC32, no listed file missing.
+    Returns the manifest; raises ValueError naming the defect."""
+    d = step_dir(root, step)
+    doc = read_manifest(d)
+    if doc["step"] != int(step):
+        raise ValueError(f"manifest step {doc['step']} != dir step {step}")
+    for name, want in doc["files"].items():
+        path = os.path.join(d, name)
+        if not os.path.isfile(path):
+            raise ValueError(f"missing file {name!r}")
+        crc, size = file_crc(path)
+        if size != want.get("size"):
+            raise ValueError(f"{name!r}: size {size} != manifest "
+                             f"{want.get('size')}")
+        if crc != want.get("crc32"):
+            raise ValueError(f"{name!r}: CRC mismatch (torn or corrupt)")
+    return doc
+
+
+def committed_steps(root: str) -> list[int]:
+    """Step numbers of committed dirs (name-matched; ``*.tmp`` staging
+    is invisible by construction), ascending."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    steps = []
+    for name in names:
+        m = _STEP_RE.match(name)
+        if m and os.path.isdir(os.path.join(root, name)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def write_latest(root: str, step: int) -> None:
+    with atomic.atomic_write(os.path.join(root, LATEST), "w") as f:
+        f.write(f"step-{int(step):08d}\n")
+
+
+def read_latest(root: str) -> int | None:
+    """The ``latest`` pointer's step, or None when absent/garbled (the
+    pointer is a hint — a torn pointer must never block restore)."""
+    try:
+        with open(os.path.join(root, LATEST), encoding="utf-8") as f:
+            m = _STEP_RE.match(f.read().strip())
+            return int(m.group(1)) if m else None
+    except OSError:
+        return None
+
+
+def gc_steps(root: str, keep_last: int | None) -> list[int]:
+    """Retention: drop committed steps beyond the newest ``keep_last``
+    and sweep stale staging dirs + tmp litter. Returns removed steps.
+    ``keep_last`` < 2 keeps no fallback behind the newest checkpoint —
+    fine for space-tight runs, but corrupt-latest recovery needs 2+."""
+    atomic.trip("gc", root)
+    removed = []
+    steps = committed_steps(root)
+    if keep_last is not None and keep_last >= 1:
+        for step in steps[:-keep_last]:
+            atomic.trip("gc", step_dir(root, step))
+            shutil.rmtree(step_dir(root, step), ignore_errors=True)
+            removed.append(step)
+    newest = steps[-1] if steps else -1
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return removed
+    for name in names:
+        # staging older than the newest commit can only be a crashed
+        # attempt; the CURRENT step's stage is gone by publish-time
+        if name.endswith(".tmp") and _STEP_RE.match(name[:-4]):
+            if int(_STEP_RE.match(name[:-4]).group(1)) <= newest:
+                atomic.trip("gc", os.path.join(root, name))
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+        elif name.startswith(".trash-"):
+            # a recommit's moved-aside predecessor; by GC time a newer
+            # commit exists, so the safety copy is redundant
+            atomic.trip("gc", os.path.join(root, name))
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+    atomic.sweep_tmp(root)
+    return removed
+
+
+def finalize(root: str, step: int, meta: dict | None = None,
+             keep_last: int | None = None) -> dict:
+    """Rank-0 commit: manifest → publish rename → latest pointer → GC.
+    The rename is the single commit point; every phase before it leaves
+    the previous checkpoint untouched."""
+    stage = stage_dir(root, step)
+    doc = write_manifest(stage, step, meta)
+    dst = step_dir(root, step)
+    trash = None
+    if os.path.isdir(dst):
+        # recommit of the same step: never destroy the only committed
+        # copy before the new one lands — move it aside (invisible to
+        # readers but intact on disk across a crash; swept by the next
+        # GC once a newer commit exists)
+        trash = os.path.join(root, f".trash-{os.path.basename(dst)}"
+                                   f"-{os.getpid()}")
+        if os.path.isdir(trash):
+            shutil.rmtree(trash)
+        os.rename(dst, trash)
+    atomic.trip("publish", dst)
+    os.rename(stage, dst)
+    atomic.fsync_dir(dst)
+    if trash is not None:
+        shutil.rmtree(trash, ignore_errors=True)
+    write_latest(root, step)
+    gc_steps(root, keep_last)
+    return doc
+
+
+def find_restorable(root: str, on_skip=None):
+    """The newest committed step that validates, as ``(step, manifest)``
+    — or None. Walks committed steps newest-first; each invalid
+    candidate is reported through ``on_skip(step, reason)`` so the
+    fallback is never silent.
+
+    Deliberately NOT driven by the ``latest`` pointer: the pointer is
+    written after the publish rename, so a crash between the two leaves
+    it one step stale — ordering by it would resurrect the older
+    checkpoint over a fully-committed newer one. The pointer stays an
+    operator-facing hint (doctor reports it)."""
+    for step in sorted(committed_steps(root), reverse=True):
+        try:
+            return step, validate_step(root, step)
+        except ValueError as e:
+            if on_skip is not None:
+                on_skip(step, str(e))
+    return None
+
+
+def doctor_report(root: str) -> dict:
+    """One-shot health summary of a checkpoint root for the diagnostics
+    doctor CLI: pointer, committed steps, latest-step validity, and the
+    newest step that would actually restore."""
+    steps = committed_steps(root)
+    report = {"root": root, "exists": os.path.isdir(root),
+              "committed_steps": len(steps),
+              "latest_pointer": read_latest(root)}
+    newest = steps[-1] if steps else None
+    report["newest_step"] = newest
+    if newest is not None:
+        try:
+            validate_step(root, newest)
+            report["newest_valid"] = True
+        except ValueError as e:
+            report["newest_valid"] = False
+            report["newest_error"] = str(e)
+    skipped = []
+    found = find_restorable(root, on_skip=lambda s, r: skipped.append(s))
+    report["restorable_step"] = found[0] if found else None
+    if skipped:
+        report["skipped_steps"] = skipped
+    return report

@@ -1,0 +1,91 @@
+"""Bounded retry with exponential backoff + jitter for transient faults.
+
+Preemptible fleets see two transient failure families this module
+absorbs: coordination-service connects that race the coordinator's own
+restart (``kvstore._ensure_distributed``), and checkpoint filesystem
+ops over network mounts that return spurious EIO/ESTALE under load
+(``resilience.atomic``'s fsync/replace). Both recover on a short
+retry far more often than they merit killing a training run.
+
+Contract:
+
+- The delay before retry ``i`` (0-based) is in ``[b_i, b_i*(1+jitter)]``
+  where ``b_i = min(base_s * 2**i, max_s)`` — bounds are asserted by
+  tests/test_resilience.py, so drivers can budget worst-case stalls.
+- Every failed attempt is journaled (``kind: "retry"``) so a flaky
+  filesystem is visible in the crash journal, not silent.
+- Only exceptions in ``retry_on`` are retried; everything else —
+  including BaseException crash stand-ins from the fault-injection
+  harness — propagates immediately.
+
+Stdlib-only (no jax): importable from the same wedge-proof contexts as
+``diagnostics.journal``.
+"""
+from __future__ import annotations
+
+import os
+import random
+import time
+
+from ..diagnostics.journal import get_journal
+
+__all__ = ["backoff_delays", "retry_call"]
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def backoff_delays(retries: int, base_s: float = 0.05, max_s: float = 2.0,
+                   jitter: float = 0.5, rng=None) -> list[float]:
+    """The sleep schedule for ``retries`` retry attempts.
+
+    Delay ``i`` is uniform in ``[b_i, b_i*(1+jitter)]`` with
+    ``b_i = min(base_s * 2**i, max_s)``: exponential growth, a hard
+    per-delay cap, and enough spread that a gang of preempted workers
+    does not hammer a recovering filesystem in lockstep."""
+    draw = rng.random if rng is not None else random.random
+    out = []
+    for i in range(max(0, int(retries))):
+        b = min(base_s * (2.0 ** i), max_s)
+        out.append(b * (1.0 + jitter * draw()) if jitter > 0 else b)
+    return out
+
+
+def retry_call(fn, *args, retries: int | None = None,
+               base_s: float | None = None, max_s: float = 2.0,
+               jitter: float = 0.5, retry_on=(OSError,), what: str = "",
+               rng=None, sleep=time.sleep, **kwargs):
+    """Call ``fn(*args, **kwargs)``; retry transient failures.
+
+    ``retries`` / ``base_s`` default from ``MXNET_TPU_RETRIES`` (2) and
+    ``MXNET_TPU_RETRY_BASE_S`` (0.05 s) so drivers can tune the whole
+    package's patience without code changes. The final failure re-raises
+    the last exception; intermediate ones are journaled."""
+    if retries is None:
+        retries = _env_int("MXNET_TPU_RETRIES", 2)
+    if base_s is None:
+        base_s = _env_float("MXNET_TPU_RETRY_BASE_S", 0.05)
+    delays = backoff_delays(retries, base_s, max_s, jitter, rng)
+    what = what or getattr(fn, "__name__", "call")
+    for attempt, delay in enumerate([*delays, None]):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as exc:
+            if delay is None:
+                raise
+            get_journal().event(
+                "retry", what=what, attempt=attempt + 1,
+                retries=retries, delay_s=round(delay, 4),
+                error=type(exc).__name__, detail=str(exc)[:200])
+            sleep(delay)

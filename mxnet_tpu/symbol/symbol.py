@@ -494,7 +494,8 @@ class Symbol:
                           indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        from ..resilience.atomic import atomic_write
+        with atomic_write(fname, "w") as f:
             f.write(self.tojson())
 
     # -- binding (ref: simple_bind/bind → GraphExecutor) ---------------------

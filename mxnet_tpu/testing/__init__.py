@@ -1,0 +1,9 @@
+"""``mxnet_tpu.testing`` — test-support layers that ship with the
+package (so downstream users can chaos-test their own checkpoint
+integrations, not just ours). Currently: :mod:`.faults`, the
+fault-injection harness behind the crash-matrix tests."""
+from __future__ import annotations
+
+from . import faults
+
+__all__ = ["faults"]

@@ -1,0 +1,161 @@
+"""Fault injection for the checkpoint stack — the chaos layer that
+*proves* crash consistency instead of asserting it.
+
+``resilience.atomic`` consults a process-wide hook at every phase of a
+durable write (``open`` → ``write``@N-bytes → ``fsync`` → ``replace``
+→ ``after_replace`` → ``dir_fsync``) and the commit protocol adds its
+own points (``publish``, ``gc``). This module installs rules on that
+hook:
+
+- :func:`crash` raises :class:`SimulatedCrash` (a ``BaseException``,
+  like ``KeyboardInterrupt``): retry layers must NOT absorb it, and
+  ``atomic_write`` leaves the torn temp file on disk exactly as a
+  killed process would.
+- :func:`io_error` raises :class:`FaultError` (an ``OSError``): the
+  bounded-retry path IS expected to absorb ``times <= retries`` of
+  these.
+- :func:`sigterm` delivers a real SIGTERM to this process — the
+  preemption drill (install ``resilience.preempt`` first!).
+
+Cookbook (docs/checkpointing.md has more)::
+
+    from mxnet_tpu.testing import faults
+    with faults.inject(faults.crash("replace")):
+        with pytest.raises(faults.SimulatedCrash):
+            nd.save(path, new_params)      # killed at the commit edge
+    nd.load(path)                          # still the OLD file, intact
+
+The crash matrix iterates :data:`CRASH_POINTS` ×
+:func:`write_offsets`, killing the writer at every phase and asserting
+a reader always sees the old or the new checkpoint, fully intact.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+
+from ..resilience import atomic
+
+__all__ = ["CRASH_POINTS", "FaultError", "FaultPlan", "FaultRule",
+           "SimulatedCrash", "crash", "inject", "io_error", "sigterm",
+           "write_offsets"]
+
+# every phase of one atomic file write, in order — plus the commit
+# protocol's own points (publish = the step-dir rename commit point)
+CRASH_POINTS = ("open", "write", "fsync", "replace", "after_replace",
+                "dir_fsync", "publish", "gc")
+
+
+class SimulatedCrash(BaseException):
+    """Process-death stand-in. Deliberately NOT an Exception: retry
+    helpers and cleanup paths must let it fly, mirroring a kill."""
+
+    def __init__(self, point, path, nbytes=None):
+        super().__init__(f"simulated crash at {point} ({path}"
+                         + (f", {nbytes}B written)" if nbytes is not None
+                            else ")"))
+        self.point = point
+        self.path = path
+        self.nbytes = nbytes
+
+
+class FaultError(OSError):
+    """Injected transient I/O failure (EIO): the retry path's food."""
+
+    def __init__(self, point, path):
+        super().__init__(5, f"injected I/O error at {point}", path)
+        self.point = point
+
+
+class FaultRule:
+    """One trigger: fire ``exc_factory`` when ``point`` (and optional
+    path substring / cumulative-byte threshold) matches, at most
+    ``times`` times (None = always)."""
+
+    def __init__(self, point, exc_factory, path_part=None,
+                 after_bytes=None, times=None):
+        self.point = point
+        self.exc_factory = exc_factory
+        self.path_part = path_part
+        self.after_bytes = after_bytes
+        self.times = times
+        self.fired = 0
+
+    def matches(self, point, path, nbytes, size):
+        if point != self.point:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.path_part is not None and self.path_part not in (path or ""):
+            return False
+        if self.after_bytes is not None:
+            # fire on the chunk that would carry the file PAST the
+            # threshold (kill granularity is per-write: the file is left
+            # a <= after_bytes prefix, a real truncation shape)
+            if nbytes is None or nbytes + (size or 0) <= self.after_bytes:
+                return False
+        return True
+
+    def fire(self, point, path, nbytes):
+        self.fired += 1
+        raise self.exc_factory(point, path, nbytes)
+
+
+def crash(point, path_part=None, after_bytes=None, times=1) -> FaultRule:
+    """Kill the writer at ``point`` (``after_bytes`` arms the ``write``
+    point once that many bytes hit the temp file)."""
+    if point == "write" and after_bytes is None:
+        after_bytes = 0
+    return FaultRule(point, lambda p, f, n: SimulatedCrash(p, f, n),
+                     path_part=path_part, after_bytes=after_bytes,
+                     times=times)
+
+
+def io_error(point, path_part=None, times=1) -> FaultRule:
+    """Transient EIO at ``point``, ``times`` times then clean."""
+    return FaultRule(point, lambda p, f, n: FaultError(p, f),
+                     path_part=path_part, times=times)
+
+
+class FaultPlan:
+    """The installed hook: first matching rule fires; every firing is
+    recorded in ``log`` for assertions."""
+
+    def __init__(self, *rules):
+        self.rules = list(rules)
+        self.log = []
+
+    def __call__(self, point, path=None, nbytes=None, size=None):
+        for rule in self.rules:
+            if rule.matches(point, path, nbytes, size):
+                self.log.append((point, path, nbytes))
+                rule.fire(point, path, nbytes)
+
+
+@contextlib.contextmanager
+def inject(*rules):
+    """Install a :class:`FaultPlan` for the duration; restores the
+    previous hook (nestable) on exit."""
+    plan = FaultPlan(*rules)
+    prev = atomic.set_fault_hook(plan)
+    try:
+        yield plan
+    finally:
+        atomic.set_fault_hook(prev)
+
+
+def sigterm() -> None:
+    """Deliver a REAL SIGTERM to this process — the preemption drill.
+    Only safe once ``resilience.preempt.install()`` holds the signal;
+    otherwise this kills the interpreter, as in production."""
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def write_offsets(total_bytes: int) -> list[int]:
+    """Representative crash offsets for a payload of ``total_bytes``:
+    before the first byte, inside the header, mid-payload, and just
+    short of the end — the truncation shapes a real kill produces."""
+    probes = {0, 1, min(15, total_bytes), total_bytes // 2,
+              max(total_bytes - 1, 0)}
+    return sorted(p for p in probes if 0 <= p < max(total_bytes, 1))

@@ -178,7 +178,13 @@ struct Tensor {
 
 // ---------------------------------------------------------------------------
 // .params reader (format: ndarray.py save — list magic, ndarray records,
-// then names; names carry arg:/aux: prefixes)
+// then names; names carry arg:/aux: prefixes). Format flag word 1 = the
+// crash-consistent v3 container (docs/checkpointing.md): a CRC32 after
+// every entry and a 24-byte <body_len, names_crc, reserved, magic>
+// footer. This reader checks the footer's structural claim (body length
+// vs buffer size — catches truncation up front) and skips the CRCs
+// (the Python loader owns checksum verification; no zlib dependency
+// here). Flag 0 = the reference-era layout, unchanged.
 // ---------------------------------------------------------------------------
 struct Reader {
   const uint8_t* p;
@@ -194,11 +200,30 @@ struct Reader {
   }
 };
 
+static const uint64_t kParamsFooterMagic = 0x4D58545043524333ULL;
+static const size_t kParamsFooterBytes = 24;
+
 static std::map<std::string, Tensor> load_params(const void* buf, size_t n) {
   Reader r(buf, n);
   uint64_t magic = r.get<uint64_t>();
   if (magic != 0x112) throw std::runtime_error("params: bad list magic");
-  r.get<uint64_t>();  // reserved
+  uint64_t fmt = r.get<uint64_t>();  // 0 = legacy, 1 = CRC + footer
+  if (fmt > 1)
+    throw std::runtime_error("params: unsupported format flag " +
+                             std::to_string(fmt));
+  bool crc = fmt == 1;
+  if (crc) {
+    if (n < 16 + kParamsFooterBytes)
+      throw std::runtime_error("params: truncated (no footer)");
+    const uint8_t* foot = (const uint8_t*)buf + n - kParamsFooterBytes;
+    uint64_t body_len, foot_magic;
+    std::memcpy(&body_len, foot, 8);
+    std::memcpy(&foot_magic, foot + 16, 8);
+    if (foot_magic != kParamsFooterMagic || body_len != n - kParamsFooterBytes)
+      throw std::runtime_error("params: footer missing or inconsistent "
+                               "(interrupted save?)");
+    r.end -= kParamsFooterBytes;  // names stop before the footer
+  }
   uint64_t count = r.get<uint64_t>();
   std::vector<Tensor> arrays(count);
   for (uint64_t i = 0; i < count; ++i) {
@@ -224,6 +249,7 @@ static std::map<std::string, Tensor> load_params(const void* buf, size_t n) {
       throw std::runtime_error("params: unsupported dtype code " +
                                std::to_string(dtype));
     }
+    if (crc) r.get<uint32_t>();  // per-entry CRC32 (verified Python-side)
     arrays[i] = std::move(t);
   }
   uint64_t n_names = r.get<uint64_t>();

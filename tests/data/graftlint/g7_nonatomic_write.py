@@ -1,0 +1,53 @@
+# graftlint: scope=library
+"""G7 fixture: non-atomic durable writes (torn-checkpoint class —
+docs/checkpointing.md). A direct ``open(path, "wb")`` on a .params/
+.json-style artifact, or a bare-path write inside a save/checkpoint/
+export/dump-named function, must route through
+``resilience.atomic.atomic_write``. Parsed only, never executed."""
+
+
+def save_weights(path, blob):
+    with open(path, "wb") as f:  # expect: G7
+        f.write(blob)
+
+
+def write_meta(prefix, text):
+    # suffix evidence inside an f-string constant
+    with open(f"{prefix}-manifest.json", "w") as f:  # expect: G7
+        f.write(text)
+
+
+def dump_profile(path, text):
+    f = open(path, mode="w")  # expect: G7
+    f.write(text)
+    f.close()
+
+
+def save_suppressed(path, blob):
+    # staging path: the caller renames it into place
+    with open(path, "wb") as f:  # graftlint: disable=G7 staged by caller
+        f.write(blob)
+
+
+def append_log(path, text):
+    # append mode is not a durable-artifact rewrite: silent
+    with open(path, "a") as f:
+        f.write(text)
+
+
+def rotate_scratch(path, text):
+    # bare path in a non-save-named function, no suffix evidence: silent
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def load_params(path):
+    # read mode: silent
+    with open("model.params", "rb") as f:
+        return f.read()
+
+
+def save_atomic(path, blob):
+    from mxnet_tpu.resilience.atomic import atomic_write
+    with atomic_write(path, "wb") as f:  # sanctioned path: silent
+        f.write(blob)

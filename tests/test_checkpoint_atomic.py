@@ -1,0 +1,256 @@
+"""The hardened .params container + atomic writer (ISSUE 3 tentpole,
+docs/checkpointing.md): CRC round trips across dtypes (incl. bfloat16),
+structured MXNetError — never struct.error or silent garbage — on every
+truncation/corruption shape, legacy-format compatibility, and the
+single-process crash matrix: kill nd.save at every write phase and
+prove a reader always sees the old or the new file, fully intact."""
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.diagnostics import journal
+from mxnet_tpu.testing import faults
+
+_ND_MAGIC = 0xF993FAC9
+_LIST_MAGIC = 0x112
+
+
+def _params(seed=0):
+    import ml_dtypes
+    rng = np.random.RandomState(seed)
+    return {
+        "w": nd.NDArray(rng.randn(3, 4).astype(np.float32)),
+        "bf": nd.NDArray(rng.randn(5).astype(ml_dtypes.bfloat16)),
+        "i": nd.NDArray(rng.randint(-9, 9, (2, 2)).astype(np.int64)),
+        "m": nd.NDArray((rng.randn(4) > 0)),
+        "scalar": nd.NDArray(np.float64(seed + 0.5)),
+    }
+
+
+def _bits(d):
+    return {k: (str(v.asnumpy().dtype),
+                v.asnumpy().view(np.uint8).tobytes()
+                if v.asnumpy().ndim else v.asnumpy().tobytes())
+            for k, v in d.items()}
+
+
+def test_crc_roundtrip_all_dtypes(tmp_path):
+    """Bit-exact round trip through the CRC format, bfloat16 included
+    (stored as raw uint16 bits, no fp32 detour)."""
+    p = str(tmp_path / "x.params")
+    data = _params()
+    nd.save(p, data)
+    back = nd.load(p)
+    assert _bits(back) == _bits(data)
+
+
+def test_list_roundtrip_and_empty(tmp_path):
+    p = str(tmp_path / "l.params")
+    nd.save(p, [nd.NDArray(np.arange(6, dtype=np.float32))])
+    (arr,) = nd.load(p)
+    assert np.array_equal(arr.asnumpy(), np.arange(6, dtype=np.float32))
+    nd.save(p, {})
+    assert nd.load(p) == []
+
+
+def test_truncation_always_structured_error(tmp_path):
+    """Any prefix of a .params file — header, entry, names, footer —
+    raises MXNetError naming truncation/corruption; struct.error and
+    silent partial loads are format violations."""
+    p = str(tmp_path / "t.params")
+    nd.save(p, _params())
+    raw = open(p, "rb").read()
+    cuts = sorted({0, 1, 8, 15, 16, 17, 24, 40, len(raw) // 3,
+                   len(raw) // 2, len(raw) - 17, len(raw) - 16,
+                   len(raw) - 8, len(raw) - 1})
+    for cut in cuts:
+        with open(p, "wb") as f:
+            f.write(raw[:cut])
+        with pytest.raises(MXNetError):
+            nd.load(p)
+
+
+def test_bitflip_corruption_caught_by_crc(tmp_path):
+    """A single flipped payload byte fails the per-entry CRC — the
+    silent-garbage class the checksums exist for."""
+    p = str(tmp_path / "c.params")
+    nd.save(p, _params())
+    raw = bytearray(open(p, "rb").read())
+    for pos in (30, len(raw) // 2, len(raw) - 40):
+        bad = bytearray(raw)
+        bad[pos] ^= 0xFF
+        with open(p, "wb") as f:
+            f.write(bytes(bad))
+        with pytest.raises(MXNetError):
+            nd.load(p)
+
+
+def _write_legacy(path, arrays, names):
+    """Reference-era layout: no CRCs, no footer, flag word 0."""
+    with open(path, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            f.write(struct.pack("<I", _ND_MAGIC))
+            f.write(struct.pack("<I", a.ndim))
+            for s in a.shape:
+                f.write(struct.pack("<q", s))
+            f.write(struct.pack("<ii", 1, 0))
+            f.write(struct.pack("<i", {"float32": 0, "int64": 6}[
+                a.dtype.name]))
+            f.write(a.tobytes())
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode()
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def test_legacy_format_still_loads(tmp_path):
+    p = str(tmp_path / "leg.params")
+    a = np.arange(8, dtype=np.float32).reshape(2, 4)
+    _write_legacy(p, [a], ["w"])
+    got = nd.load(p)
+    assert np.array_equal(got["w"].asnumpy(), a)
+
+
+def test_legacy_truncation_still_structured(tmp_path):
+    p = str(tmp_path / "leg.params")
+    a = np.arange(8, dtype=np.float32).reshape(2, 4)
+    _write_legacy(p, [a], ["w"])
+    raw = open(p, "rb").read()
+    for cut in (20, 30, len(raw) - 3):
+        with open(p, "wb") as f:
+            f.write(raw[:cut])
+        with pytest.raises(MXNetError):
+            nd.load(p)
+
+
+def test_unknown_dtype_code_rejected(tmp_path):
+    """An unknown dtype code must raise, not decode as float32 garbage
+    (the pre-hardening fallback this PR removes)."""
+    p = str(tmp_path / "dt.params")
+    with open(p, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", 1))
+        f.write(struct.pack("<I", _ND_MAGIC))
+        f.write(struct.pack("<I", 1))
+        f.write(struct.pack("<q", 2))
+        f.write(struct.pack("<ii", 1, 0))
+        f.write(struct.pack("<i", 99))          # no such dtype
+        f.write(b"\x00" * 8)
+        f.write(struct.pack("<Q", 0))
+    with pytest.raises(MXNetError, match="dtype code 99"):
+        nd.load(p)
+
+
+def test_save_rejects_unsupported_dtype(tmp_path):
+    """save() must refuse dtypes with no .params code instead of
+    stamping them float32 — CRC-certified garbage is still garbage."""
+    arr = nd.NDArray(np.arange(3, dtype=np.uint16))
+    if arr.asnumpy().dtype != np.uint16:
+        pytest.skip("backend does not preserve uint16")
+    with pytest.raises(MXNetError, match="no .params dtype code"):
+        nd.save(str(tmp_path / "u.params"), {"x": arr})
+
+
+def test_bad_magic_and_bad_format_flag(tmp_path):
+    p = str(tmp_path / "m.params")
+    with open(p, "wb") as f:
+        f.write(struct.pack("<QQQ", 0xDEAD, 0, 0))
+    with pytest.raises(MXNetError, match="bad magic"):
+        nd.load(p)
+    with open(p, "wb") as f:
+        f.write(struct.pack("<QQQ", _LIST_MAGIC, 7, 0))
+    with pytest.raises(MXNetError, match="format flag"):
+        nd.load(p)
+
+
+# -- the single-process crash matrix -----------------------------------------
+
+def _crash_rules(total_bytes):
+    rules = [faults.crash("open"), faults.crash("fsync"),
+             faults.crash("replace"), faults.crash("after_replace"),
+             faults.crash("dir_fsync")]
+    rules += [faults.crash("write", after_bytes=n)
+              for n in faults.write_offsets(total_bytes)]
+    return rules
+
+
+def test_crash_matrix_old_or_new_every_phase(tmp_path):
+    """Kill nd.save at every phase of the atomic write: the file on disk
+    afterwards is bit-for-bit the old save (phases before the rename)
+    or the new one (after it) — and always loads clean."""
+    p = str(tmp_path / "m.params")
+    old_data, new_data = _params(0), _params(1)
+    nd.save(p, new_data)
+    total = os.path.getsize(p)
+    committed = _bits(new_data)
+    for rule in _crash_rules(total):
+        nd.save(p, old_data)
+        old_raw = open(p, "rb").read()
+        with faults.inject(rule) as plan:
+            with pytest.raises(faults.SimulatedCrash):
+                nd.save(p, new_data)
+        assert plan.log, f"fault at {rule.point} never armed"
+        after = open(p, "rb").read()
+        if rule.point in ("after_replace", "dir_fsync"):
+            assert _bits(nd.load(p)) == committed, rule.point
+        else:
+            assert after == old_raw, f"torn file after {rule.point}"
+        _ = nd.load(p)                       # always parseable
+
+
+def test_crash_with_no_previous_file_leaves_nothing(tmp_path):
+    p = str(tmp_path / "fresh.params")
+    with faults.inject(faults.crash("write", after_bytes=10)):
+        with pytest.raises(faults.SimulatedCrash):
+            nd.save(p, _params())
+    assert not os.path.exists(p)
+    with pytest.raises((MXNetError, OSError)):
+        nd.load(p)
+    nd.save(p, _params())                    # retry over the litter works
+    assert _bits(nd.load(p)) == _bits(_params())
+
+
+def test_transient_io_error_retried_and_journaled(tmp_path):
+    """One injected EIO at the rename is absorbed by the bounded retry
+    (with a journal record); a persistent one surfaces as OSError and
+    cleans its temp file."""
+    jf = str(tmp_path / "j.jsonl")
+    journal.reset_journal(jf)
+    try:
+        p = str(tmp_path / "r.params")
+        with faults.inject(faults.io_error("replace", times=1)):
+            nd.save(p, _params())
+        assert _bits(nd.load(p)) == _bits(_params())
+        recs = [json.loads(line) for line in open(jf)]
+        assert any(r["kind"] == "retry" and "replace" in r["what"]
+                   for r in recs)
+        with faults.inject(faults.io_error("replace", times=99)):
+            with pytest.raises(OSError):
+                nd.save(str(tmp_path / "q.params"), _params())
+        assert not os.path.exists(str(tmp_path / "q.params"))
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.startswith("q.params")]
+    finally:
+        journal.reset_journal()
+
+
+def test_sweep_tmp_collects_crash_litter(tmp_path):
+    p = str(tmp_path / "s.params")
+    nd.save(p, _params())
+    with faults.inject(faults.crash("fsync")):
+        with pytest.raises(faults.SimulatedCrash):
+            nd.save(p, _params(1))
+    litter = [n for n in os.listdir(tmp_path) if ".tmp." in n]
+    assert litter, "a simulated crash must leave the torn tmp, like a kill"
+    from mxnet_tpu.resilience.atomic import sweep_tmp
+    removed = sweep_tmp(str(tmp_path))
+    assert sorted(removed) == sorted(litter)
+    assert _bits(nd.load(p)) == _bits(_params())

@@ -1,0 +1,295 @@
+"""The crash matrix for the directory commit protocol
+(resilience.commit + parallel/_ckpt; docs/checkpointing.md): kill the
+writer at every phase of a simulated 2-rank shard save — staging, each
+rank's shard write (at several byte offsets), manifest write, the
+publish rename, the latest pointer, GC — and prove a reader always
+recovers the previous committed step (or the new one, after the commit
+point), bit-exact and validated. Plus: corrupt-latest fallback with a
+journaled skip, keep-last-k retention, and the trainer-level
+checkpoint/restore(latest) path.
+
+The ``test_smoke_*`` subset is the CI tier-0.5 chaos smoke
+(ci/run_tests.sh): seconds, no trainers, pure file layer."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.diagnostics import journal
+from mxnet_tpu.resilience import commit
+from mxnet_tpu.testing import faults
+
+WORLD = 2
+
+
+def _rank_arrays(step, rank):
+    rng = np.random.RandomState(1000 * step + rank)
+    return {f"w{rank}|0:4": nd.NDArray(rng.randn(4, 3).astype(np.float32)),
+            f"b{rank}|0:4": nd.NDArray(rng.randn(4).astype(np.float32))}
+
+
+def _save_step(root, step, keep_last=3, barrier=lambda tag: None):
+    """The 2-rank commit protocol, ranks played serially in one process
+    (the shared-filesystem model multi-host saves assume). Mirrors
+    _ckpt.commit_checkpoint's phase order exactly."""
+    commit.prepare_stage(root, step)              # rank 0
+    barrier("stage")
+    stage = commit.stage_dir(root, step)
+    for rank in range(WORLD):                     # each rank, its shard
+        nd.save(os.path.join(stage, f"ckpt.shard{rank}"),
+                _rank_arrays(step, rank))
+    barrier("staged")
+    commit.finalize(root, step, keep_last=keep_last,
+                    meta={"world": WORLD})        # rank 0 commit point
+    barrier("committed")
+
+
+def _read_step(root):
+    """What a restoring job would see: newest valid step, all shards
+    loaded through the CRC-verified container."""
+    got = commit.find_restorable(root)
+    if got is None:
+        return None, None
+    step, manifest = got
+    out = {}
+    for name in manifest["files"]:
+        loaded = nd.load(os.path.join(commit.step_dir(root, step), name))
+        out.update({k: v.asnumpy().tobytes() for k, v in loaded.items()})
+    return step, out
+
+
+def _expect(step):
+    out = {}
+    for rank in range(WORLD):
+        out.update({k: v.asnumpy().tobytes()
+                    for k, v in _rank_arrays(step, rank).items()})
+    return out
+
+
+def _shard_nbytes(tmp_path):
+    p = str(tmp_path / "probe.params")
+    nd.save(p, _rank_arrays(7, 0))
+    return os.path.getsize(p)
+
+
+def _matrix_rules(shard_bytes):
+    """One kill per protocol phase; shard writes also at byte offsets."""
+    rules = []
+    for rank in range(WORLD):
+        part = f"ckpt.shard{rank}"
+        rules += [faults.crash("open", path_part=part),
+                  faults.crash("fsync", path_part=part),
+                  faults.crash("replace", path_part=part)]
+        rules += [faults.crash("write", path_part=part, after_bytes=n)
+                  for n in faults.write_offsets(shard_bytes)]
+    rules += [faults.crash("write", path_part=commit.MANIFEST),
+              faults.crash("fsync", path_part=commit.MANIFEST),
+              faults.crash("replace", path_part=commit.MANIFEST),
+              faults.crash("publish"),
+              faults.crash("write", path_part=commit.LATEST),
+              faults.crash("replace", path_part=commit.LATEST),
+              faults.crash("gc")]
+    return rules
+
+
+def test_two_rank_crash_matrix_reader_sees_old_or_new(tmp_path):
+    """The acceptance criterion: for every injected kill point in the
+    2-rank shard commit, a subsequent restore yields a bit-exact OLD or
+    NEW checkpoint — never an exception escape, never partial state."""
+    shard_bytes = _shard_nbytes(tmp_path)
+    for i, rule in enumerate(_matrix_rules(shard_bytes)):
+        root = str(tmp_path / f"root{i}")
+        _save_step(root, 1)                        # committed baseline
+        with faults.inject(rule) as plan:
+            with pytest.raises(faults.SimulatedCrash):
+                _save_step(root, 2)
+        assert plan.log, f"rule {rule.point}/{rule.path_part} never armed"
+        step, got = _read_step(root)
+        # the commit point is the publish rename; the latest pointer and
+        # GC run after it, so those phases legitimately expose step 2
+        if rule.point in ("gc",) or rule.path_part == commit.LATEST:
+            assert step == 2 and got == _expect(2), rule.point
+        else:
+            assert step == 1, (rule.point, rule.path_part, step)
+            assert got == _expect(1), "recovered step 1 is not bit-exact"
+        # and the NEXT save attempt over the crash litter must succeed
+        _save_step(root, 3)
+        step, got = _read_step(root)
+        assert step == 3 and got == _expect(3)
+
+
+def test_smoke_crash_at_publish_and_shard_write(tmp_path):
+    """CI chaos smoke: one pre-commit kill (mid-shard write) and one
+    at the commit edge (publish rename) — old step recovered intact;
+    then a post-commit kill (gc) — new step visible."""
+    root = str(tmp_path / "root")
+    _save_step(root, 1)
+    for rule in (faults.crash("write", path_part="ckpt.shard1",
+                              after_bytes=20),
+                 faults.crash("publish")):
+        with faults.inject(rule):
+            with pytest.raises(faults.SimulatedCrash):
+                _save_step(root, 2)
+        step, got = _read_step(root)
+        assert step == 1 and got == _expect(1), rule.point
+    with faults.inject(faults.crash("gc")):
+        with pytest.raises(faults.SimulatedCrash):
+            _save_step(root, 2)
+    step, got = _read_step(root)
+    assert step == 2 and got == _expect(2)
+
+
+def test_smoke_corrupt_newest_falls_back_to_previous(tmp_path):
+    """CI chaos smoke: a bit-flipped shard in the newest committed step
+    fails manifest CRC validation and restore lands on the previous
+    step."""
+    root = str(tmp_path / "root")
+    _save_step(root, 1)
+    _save_step(root, 2)
+    victim = os.path.join(commit.step_dir(root, 2), "ckpt.shard0")
+    raw = bytearray(open(victim, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(victim, "wb") as f:
+        f.write(bytes(raw))
+    skipped = []
+    got = commit.find_restorable(root, on_skip=lambda s, r:
+                                 skipped.append((s, r)))
+    assert got is not None and got[0] == 1
+    assert skipped and skipped[0][0] == 2 and "CRC" in skipped[0][1]
+    step, data = _read_step(root)
+    assert step == 1 and data == _expect(1)
+
+
+def test_missing_shard_and_manifest_schemas_rejected(tmp_path):
+    root = str(tmp_path / "root")
+    _save_step(root, 1)
+    _save_step(root, 2)
+    os.remove(os.path.join(commit.step_dir(root, 2), "ckpt.shard1"))
+    got = commit.find_restorable(root)
+    assert got is not None and got[0] == 1
+    # garbage manifest in the newest: same fallback
+    _save_step(root, 3)
+    with open(os.path.join(commit.step_dir(root, 3), commit.MANIFEST),
+              "w") as f:
+        f.write("{not json")
+    got = commit.find_restorable(root)
+    assert got is not None and got[0] == 1
+
+
+def test_torn_latest_pointer_never_blocks_restore(tmp_path):
+    root = str(tmp_path / "root")
+    _save_step(root, 1)
+    with open(os.path.join(root, commit.LATEST), "w") as f:
+        f.write("step-garbage")
+    assert commit.read_latest(root) is None
+    step, got = _read_step(root)
+    assert step == 1 and got == _expect(1)
+
+
+def test_gc_keep_last_and_stale_stage_sweep(tmp_path):
+    root = str(tmp_path / "root")
+    for step in (1, 2, 3, 4, 5):
+        _save_step(root, step, keep_last=2)
+    assert commit.committed_steps(root) == [4, 5]
+    # a crashed older attempt's staging dir is swept by the next commit
+    with faults.inject(faults.crash("write", path_part=commit.MANIFEST)):
+        with pytest.raises(faults.SimulatedCrash):
+            _save_step(root, 6)
+    assert os.path.isdir(commit.stage_dir(root, 6))
+    _save_step(root, 7, keep_last=2)
+    assert not os.path.isdir(commit.stage_dir(root, 6))
+    assert commit.committed_steps(root) == [5, 7]
+
+
+def test_empty_stage_refuses_to_commit(tmp_path):
+    root = str(tmp_path / "root")
+    commit.prepare_stage(root, 1)
+    with pytest.raises(ValueError, match="nothing staged"):
+        commit.finalize(root, 1)
+
+
+# -- trainer-level (single-process, real ShardedTrainer) ---------------------
+
+def _make_trainer():
+    from mxnet_tpu import gluon, parallel
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    return parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1})
+
+
+def _snapshot(tr):
+    snap = {}
+    for p in tr._trainable:
+        snap["arg:" + tr._struct_name(p)] = np.asarray(p._data[0]._data)
+    for p, st in zip(tr._trainable, tr._states):
+        for j, s in enumerate(st):
+            snap[f"state:{tr._struct_name(p)}:{j}"] = np.asarray(s)
+    return snap
+
+
+def test_sharded_trainer_restore_latest_with_corrupt_newest(tmp_path):
+    """End-to-end: checkpoint twice via the commit protocol, corrupt
+    the newest step, crash a third attempt mid-manifest; a FRESH
+    trainer's restore() lands bit-exact on the newest intact step with
+    a journaled ckpt_fallback."""
+    jf = str(tmp_path / "j.jsonl")
+    journal.reset_journal(jf)
+    try:
+        root = str(tmp_path / "ck")
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 6).astype(np.float32)
+        y = rng.randint(0, 4, (8,))
+        tr = _make_trainer()
+        for _ in range(2):
+            tr.step(x, y)
+        s1 = tr.checkpoint(root, keep_last=3)
+        want = _snapshot(tr)
+        tr.step(x, y)
+        s2 = tr.checkpoint(root, keep_last=3)
+        assert commit.committed_steps(root) == [s1, s2]
+        # corrupt newest
+        sd = commit.step_dir(root, s2)
+        victim = os.path.join(
+            sd, [n for n in os.listdir(sd) if n.endswith(".params")][0])
+        raw = bytearray(open(victim, "rb").read())
+        raw[60] ^= 0xFF
+        with open(victim, "wb") as f:
+            f.write(bytes(raw))
+        # crash a third checkpoint at the manifest: changes nothing
+        with faults.inject(faults.crash("write",
+                                        path_part=commit.MANIFEST)):
+            with pytest.raises(faults.SimulatedCrash):
+                tr.checkpoint(root, step=99)
+        tr2 = _make_trainer()
+        tr2.prepare(x)
+        got_step = tr2.restore(root)
+        assert got_step == s1
+        got = _snapshot(tr2)
+        assert set(got) == set(want)
+        for k in want:
+            assert np.array_equal(want[k], got[k]), k
+        recs = [json.loads(line) for line in open(jf)]
+        assert any(r["kind"] == "ckpt_fallback" and r["step"] == s2
+                   for r in recs)
+        assert any(r["kind"] == "ckpt_restored" and r["step"] == s1
+                   for r in recs)
+    finally:
+        journal.reset_journal()
+
+
+def test_restore_errors_are_structured(tmp_path):
+    tr = _make_trainer()
+    x = np.zeros((8, 6), np.float32)
+    tr.prepare(x)
+    with pytest.raises(MXNetError, match="no valid committed checkpoint"):
+        tr.restore(str(tmp_path / "nowhere"))
+    with pytest.raises(MXNetError, match="failed validation"):
+        tr.restore(str(tmp_path / "nowhere"), step=4)
+    with pytest.raises(MXNetError, match="step=N or latest"):
+        tr.restore(str(tmp_path / "nowhere"), latest=False)

@@ -358,7 +358,7 @@ def test_cli_rules_filter_and_errors():
     assert out.returncode == 2 and "unknown rule" in out.stderr
     out = _cli(["--list-rules"])
     assert out.returncode == 0
-    for code in ["G1", "G2", "G3", "G4", "G5", "G6",
+    for code in ["G1", "G2", "G3", "G4", "G5", "G6", "G7",
                  "E1", "W1", "W2", "W3", "W4", "W5", "W6"]:
         assert code in out.stdout
 
@@ -428,6 +428,15 @@ def test_infer_shape_never_makes_a_concrete_key(monkeypatch):
     _args, out_shapes, _aux = out.infer_shape(data=(4, 8))
     assert out_shapes == [(4, 8)]
     assert not calls, "shape inference dialed a concrete PRNG key"
+
+
+def test_g7_sanctioned_atomic_path_is_clean():
+    """The rule's point: the atomic writer itself (and the commit
+    protocol built on it) must not trip G7 — only direct artifact
+    writes do. Proven by linting the resilience package explicitly."""
+    findings, n = core.run(["mxnet_tpu/resilience"],
+                           rules=_rules(["G7"]), root=REPO)
+    assert n >= 4 and findings == []
 
 
 def test_waitall_journals_instead_of_swallowing(monkeypatch, tmp_path):

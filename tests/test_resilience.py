@@ -1,0 +1,323 @@
+"""mxnet_tpu.resilience: retry/backoff contract (delay bounds asserted
+against the documented formula), journaled retries, preemption watch
+(real SIGTERM), fit(checkpoint_prefix/resume) including corrupt-latest
+fallback, do_checkpoint retention + prefix-dir creation, and the
+kvstore coordination-service retry."""
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import callback, model, sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.diagnostics import journal
+from mxnet_tpu.resilience import preempt, retry
+from mxnet_tpu.testing import faults
+import mxnet_tpu.io as mio
+
+
+# -- retry / backoff ---------------------------------------------------------
+
+def test_backoff_delay_bounds_and_cap():
+    """Delay i must lie in [b_i, b_i*(1+jitter)], b_i = min(base*2^i,
+    max_s) — the documented bound drivers budget against."""
+    rng = random.Random(42)
+    base_s, max_s, jitter = 0.05, 2.0, 0.5
+    delays = retry.backoff_delays(12, base_s, max_s, jitter, rng=rng)
+    assert len(delays) == 12
+    for i, d in enumerate(delays):
+        b = min(base_s * 2 ** i, max_s)
+        assert b <= d <= b * (1 + jitter), (i, d, b)
+    # the cap engages: late delays never exceed max_s*(1+jitter)
+    assert max(delays) <= max_s * (1 + jitter)
+    # no jitter -> exact schedule
+    assert retry.backoff_delays(3, 0.1, 2.0, jitter=0) == \
+        [0.1, 0.2, 0.4]
+
+
+def test_retry_call_retries_then_succeeds_and_journals(tmp_path):
+    jf = str(tmp_path / "j.jsonl")
+    journal.reset_journal(jf)
+    try:
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError(5, "transient")
+            return "ok"
+
+        slept = []
+        assert retry.retry_call(flaky, retries=4, base_s=0.001,
+                                sleep=slept.append) == "ok"
+        assert len(calls) == 3 and len(slept) == 2
+        recs = [json.loads(line) for line in open(jf)]
+        assert [r["attempt"] for r in recs if r["kind"] == "retry"] == [1, 2]
+    finally:
+        journal.reset_journal()
+
+
+def test_retry_exhaustion_reraises_original():
+    def dead():
+        raise OSError(5, "still dead")
+    with pytest.raises(OSError, match="still dead"):
+        retry.retry_call(dead, retries=2, base_s=0.0, sleep=lambda s: None)
+
+
+def test_retry_never_absorbs_crashes():
+    """SimulatedCrash is a BaseException: the retry layer must let it
+    fly (a kill is not a transient fault)."""
+    def boom():
+        raise faults.SimulatedCrash("write", "x")
+    calls = []
+    with pytest.raises(faults.SimulatedCrash):
+        retry.retry_call(lambda: (calls.append(1), boom()),
+                         retries=5, base_s=0.0, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_retry_env_defaults(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_RETRIES", "0")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise OSError(5, "x")
+    with pytest.raises(OSError):
+        retry.retry_call(flaky, sleep=lambda s: None)
+    assert len(calls) == 1                       # 0 retries honored
+
+
+# -- preemption watch --------------------------------------------------------
+
+def test_preempt_watch_real_sigterm_and_consume_once():
+    watch = preempt.install()
+    watch.clear()
+    assert not watch.requested() and not watch.consume()
+    faults.sigterm()                             # real signal, latched
+    assert watch.requested()
+    assert watch.consume()
+    assert not watch.consume(), "consume must hand the save to ONE caller"
+    assert watch.requested(), "requested() stays observable"
+    watch.clear()
+    assert not watch.requested()
+
+
+# -- module.fit integration --------------------------------------------------
+
+def _net():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _iter(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(16, 6).astype(np.float32)
+    y = rng.randint(0, 4, (16,)).astype(np.float32)
+    return mio.NDArrayIter(x, y, batch_size=8)
+
+
+def test_fit_checkpoints_with_retention_and_created_dir(tmp_path):
+    prefix = str(tmp_path / "made" / "dirs" / "mod")   # doesn't exist yet
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mod.fit(_iter(), num_epoch=4, checkpoint_prefix=prefix, keep_last=2)
+    assert model.list_checkpoint_epochs(prefix) == [3, 4]
+    assert os.path.exists(prefix + "-symbol.json")
+
+
+def test_fit_resume_skips_corrupt_latest_with_journal(tmp_path):
+    jf = str(tmp_path / "j.jsonl")
+    journal.reset_journal(jf)
+    try:
+        prefix = str(tmp_path / "mod")
+        mod = mx.mod.Module(_net(), context=mx.cpu())
+        mod.fit(_iter(), num_epoch=3, checkpoint_prefix=prefix)
+        with open(prefix + "-0003.params", "r+b") as f:
+            f.truncate(40)                       # torn newest
+        mod2 = mx.mod.Module(_net(), context=mx.cpu())
+        mod2.fit(_iter(), num_epoch=5, checkpoint_prefix=prefix,
+                 resume=True)
+        recs = [json.loads(line) for line in open(jf)]
+        assert any(r["kind"] == "ckpt_fallback" and r["epoch"] == 3
+                   for r in recs)
+        assert any(r["kind"] == "resume" and r["epoch"] == 2
+                   for r in recs)
+        # epochs 3..5 re-ran and saved over the torn file
+        assert model.list_checkpoint_epochs(prefix) == [1, 2, 3, 4, 5]
+        arg, aux, epoch = model.load_latest_params(prefix)
+        assert epoch == 5 and "fc_weight" in arg
+    finally:
+        journal.reset_journal()
+
+
+def test_fit_resume_fresh_when_no_checkpoint(tmp_path):
+    jf = str(tmp_path / "j.jsonl")
+    journal.reset_journal(jf)
+    try:
+        prefix = str(tmp_path / "none" / "mod")
+        mod = mx.mod.Module(_net(), context=mx.cpu())
+        mod.fit(_iter(), num_epoch=1, checkpoint_prefix=prefix,
+                resume=True)
+        recs = [json.loads(line) for line in open(jf)]
+        assert any(r["kind"] == "resume_fresh" for r in recs)
+    finally:
+        journal.reset_journal()
+
+
+def test_fit_resume_requires_prefix():
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    with pytest.raises(MXNetError, match="checkpoint_prefix"):
+        mod.fit(_iter(), num_epoch=1, resume=True)
+
+
+def test_fit_preemption_saves_at_step_boundary_and_stops(tmp_path):
+    """The preemption drill: SIGTERM mid-epoch -> one checkpoint at the
+    next batch boundary, a preempt_checkpoint journal record, fit
+    returns; resume then restarts the interrupted epoch."""
+    jf = str(tmp_path / "j.jsonl")
+    journal.reset_journal(jf)
+    try:
+        prefix = str(tmp_path / "p" / "mod")
+        preempt.install().clear()
+        fired = []
+
+        def bomb(param):
+            if not fired:
+                fired.append(1)
+                faults.sigterm()
+        mod = mx.mod.Module(_net(), context=mx.cpu())
+        mod.fit(_iter(), num_epoch=100, checkpoint_prefix=prefix,
+                batch_end_callback=bomb)         # returns early, no kill
+        recs = [json.loads(line) for line in open(jf)]
+        pc = [r for r in recs if r["kind"] == "preempt_checkpoint"]
+        assert len(pc) == 1 and pc[0]["epoch"] == 0
+        assert any(r["kind"] == "preempt_requested" for r in recs)
+        assert model.list_checkpoint_epochs(prefix) == [0]
+        # resume re-runs the interrupted epoch 0
+        preempt.install().clear()
+        mod2 = mx.mod.Module(_net(), context=mx.cpu())
+        mod2.fit(_iter(), num_epoch=2, checkpoint_prefix=prefix,
+                 resume=True)
+        recs = [json.loads(line) for line in open(jf)]
+        assert any(r["kind"] == "resume" and r["epoch"] == 0 for r in recs)
+        assert model.list_checkpoint_epochs(prefix) == [0, 1, 2]
+    finally:
+        preempt.install().clear()
+        journal.reset_journal()
+
+
+def test_fit_rearms_consumed_watch_across_runs(tmp_path):
+    """A SIGTERM consumed by one fit() must not mute preemption
+    handling for the next fit() in the same process — each run's entry
+    re-arms the watch (a live unconsumed signal stays latched)."""
+    jf = str(tmp_path / "j.jsonl")
+    journal.reset_journal(jf)
+    try:
+        preempt.install().clear()
+        for run in (1, 2):
+            fired = []
+
+            def bomb(param):
+                if not fired:
+                    fired.append(1)
+                    faults.sigterm()
+            mod = mx.mod.Module(_net(), context=mx.cpu())
+            mod.fit(_iter(), num_epoch=100,
+                    checkpoint_prefix=str(tmp_path / f"r{run}" / "mod"),
+                    batch_end_callback=bomb)
+            recs = [json.loads(line) for line in open(jf)]
+            saves = [r for r in recs if r["kind"] == "preempt_checkpoint"]
+            assert len(saves) == run, (run, [r["kind"] for r in recs])
+        # and a live UNCONSUMED signal survives rearm: fit must save
+        # immediately even though the SIGTERM predates the loop
+        watch = preempt.install()
+        watch.clear()
+        faults.sigterm()
+        mod = mx.mod.Module(_net(), context=mx.cpu())
+        mod.fit(_iter(), num_epoch=100,
+                checkpoint_prefix=str(tmp_path / "r3" / "mod"))
+        recs = [json.loads(line) for line in open(jf)]
+        assert len([r for r in recs
+                    if r["kind"] == "preempt_checkpoint"]) == 3
+    finally:
+        preempt.install().clear()
+        journal.reset_journal()
+
+
+def test_fit_restores_sigterm_disposition(tmp_path):
+    """After fit returns, nothing polls the watch — SIGTERM must fall
+    back to the displaced disposition, not be silently latched forever
+    (and bound-method identity must not defeat the restore)."""
+    import signal
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mod.fit(_iter(), num_epoch=1,
+            checkpoint_prefix=str(tmp_path / "mod"))
+    after = signal.getsignal(signal.SIGTERM)
+    assert "PreemptionWatch" not in repr(after), after
+
+
+def test_checkpoint_on_preempt_callback(tmp_path):
+    prefix = str(tmp_path / "cb" / "mod")
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    cb = preempt.checkpoint_on_preempt(mod, prefix)
+    preempt.install().clear()
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+
+    class P:
+        epoch, nbatch, eval_metric = 2, 5, None
+    cb(P())                                      # no signal: no save
+    assert model.list_checkpoint_epochs(prefix) == []
+    faults.sigterm()
+    cb(P())
+    assert model.list_checkpoint_epochs(prefix) == [2]
+    cb(P())                                      # consumed: saves once
+    assert model.list_checkpoint_epochs(prefix) == [2]
+    preempt.install().clear()
+
+
+# -- do_checkpoint retention -------------------------------------------------
+
+def test_do_checkpoint_keep_last_and_period(tmp_path):
+    prefix = str(tmp_path / "sub" / "cls")
+    net = _net()
+    arg = {"fc_weight": mx.nd.ones((4, 6)), "fc_bias": mx.nd.zeros((4,))}
+    cb = callback.do_checkpoint(prefix, period=2, keep_last=2)
+    for epoch in range(8):
+        cb(epoch, net, arg, {})
+    # period=2 saved epochs 2,4,6,8; keep_last=2 kept 6,8
+    assert model.list_checkpoint_epochs(prefix) == [6, 8]
+    loaded_arg, _ = model.load_params(prefix, 8)
+    assert np.array_equal(loaded_arg["fc_weight"].asnumpy(),
+                          np.ones((4, 6), np.float32))
+
+
+# -- kvstore coordination retry ---------------------------------------------
+
+def test_ensure_distributed_retries_transient_connect(monkeypatch):
+    import jax
+    from mxnet_tpu import kvstore
+    calls = []
+
+    def flaky_init(**kw):
+        calls.append(kw)
+        if len(calls) < 3:
+            raise ConnectionError("coordinator not up yet")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+    monkeypatch.setenv("MXTPU_COORD_ADDR", "127.0.0.1:1")
+    monkeypatch.setenv("MXTPU_NUM_PROC", "1")
+    monkeypatch.setenv("MXTPU_PROC_ID", "0")
+    monkeypatch.setenv("MXNET_TPU_RETRY_BASE_S", "0.001")
+    monkeypatch.setattr(kvstore, "_dist_initialized", False)
+    try:
+        kvstore._ensure_distributed()
+        assert len(calls) == 3
+        assert kvstore._dist_initialized
+    finally:
+        kvstore._dist_initialized = False
