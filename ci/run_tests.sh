@@ -66,6 +66,12 @@ fi
 echo "== tier 0.5: chaos smoke (crash-matrix subset) =="
 python -m pytest tests/test_crash_matrix.py -q -k smoke -p no:cacheprovider
 
+# serving smoke: spin the dynamic-batching server on a real thread, push
+# 50 mixed requests (incl. an oversized-shape reject), prove bounded
+# compiles + clean shutdown (docs/serving.md); the soak test is `slow`
+echo "== tier 0.5: serving smoke (dynamic batcher) =="
+python -m pytest tests/test_serving.py -q -k smoke -p no:cacheprovider
+
 # quick unit tier: core ndarray/op/autograd/gluon/io surface, no
 # model-zoo or multi-process tests (ref: runtime_functions.sh unittest
 # vs nightly split)
@@ -79,4 +85,6 @@ if [ "$TIER" = "fast" ]; then
 fi
 
 echo "== tier: full =="
-exec python -m pytest tests/ -q "$@"
+# slow-marked tests (soak / subprocess CLIs) stay out of the default
+# budget; append `-m ''` (or `-m slow`) to opt back in — later -m wins
+exec python -m pytest tests/ -q -m "not slow" "$@"

@@ -98,6 +98,7 @@ from . import operator
 from . import runtime
 from . import diagnostics
 from . import resilience
+from . import serving          # lazy package: submodules load on first use
 from . import testing
 from . import util
 from . import rnn

@@ -537,6 +537,137 @@ class UndeadlinedSubprocess(Rule):
                 f"hang becomes an information-free rc:124")
 
 
+QUEUE_MAKERS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue"}
+ALWAYS_UNBOUNDED_MAKERS = {"queue.SimpleQueue"}
+THREAD_MAKERS = {"threading.Thread", "threading.Timer"}
+
+
+@register
+class UnboundedQueueDiscipline(Rule):
+    code = "G8"
+    name = "unbounded-queue"
+    doc = ("Unbounded ``queue.Queue()`` construction, or a blocking "
+           "``.get()``/``.join()`` on a queue/thread without "
+           "``timeout=``, in library code. An unbounded queue turns "
+           "overload into unbounded latency + memory (the serving "
+           "subsystem's admission contract: shed with ServerOverloaded "
+           "instead — docs/serving.md), and an undeadlined get/join is "
+           "the in-process twin of G5's subprocess hang: one wedged "
+           "producer thread and the caller blocks for the driver's "
+           "whole window. ``queue.Queue.join()`` accepts no timeout at "
+           "all — restructure around bounded waits. Scope: mxnet_tpu/ "
+           "library code.")
+
+    @staticmethod
+    def _const_int(node):
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub) \
+                and isinstance(node.operand, ast.Constant) \
+                and isinstance(node.operand.value, int):
+            return -node.operand.value
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        return None
+
+    def _unbounded_construction(self, call):
+        kw = {k.arg: k.value for k in call.keywords}
+        if None in kw:                       # **kwargs: unknown, trust it
+            return False
+        maxsize = call.args[0] if call.args else kw.get("maxsize")
+        if maxsize is None:
+            return True                      # default maxsize=0: unbounded
+        c = self._const_int(maxsize)
+        return c is not None and c <= 0      # explicit 0/negative
+
+    @staticmethod
+    def _receivers(ctx):
+        """Dotted receiver names bound to queue / thread constructions
+        anywhere in the file ('q', 'self._queue', ...)."""
+        queues, threads = set(), set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)) \
+                    and node.value is not None:
+                value, targets = node.value, [node.target]
+            else:
+                continue
+            if not isinstance(value, ast.Call):
+                continue
+            name = ctx.resolve_call(value)
+            if name in QUEUE_MAKERS | ALWAYS_UNBOUNDED_MAKERS:
+                pool = queues
+            elif name in THREAD_MAKERS:
+                pool = threads
+            else:
+                continue
+            for t in targets:
+                dotted = ctx.resolve(t)
+                if dotted:
+                    pool.add(dotted)
+        return queues, threads
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        queues, threads = self._receivers(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve_call(node)
+            if name in ALWAYS_UNBOUNDED_MAKERS:
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"{name}() is unbounded by construction — overload "
+                    "becomes unbounded memory/latency; use a bounded "
+                    "queue.Queue(maxsize=N) and shed on Full")
+                continue
+            if name in QUEUE_MAKERS and self._unbounded_construction(node):
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"unbounded {name}() in library code — pass "
+                    "maxsize=N and shed on queue.Full (the serving "
+                    "admission-control contract)")
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            recv = ctx.resolve(func.value)
+            if recv is None:
+                continue
+            kw_names = {k.arg for k in node.keywords}
+            if None in kw_names:             # **kwargs: unknown
+                continue
+            if func.attr == "get" and recv in queues:
+                if "timeout" in kw_names or len(node.args) >= 2:
+                    continue
+                blk = node.args[0] if node.args else None
+                for k in node.keywords:
+                    if k.arg == "block":
+                        blk = k.value
+                if isinstance(blk, ast.Constant) and blk.value is False:
+                    continue                 # non-blocking get
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"{recv}.get() without timeout= — a wedged producer "
+                    "hangs the consumer for the driver's whole window "
+                    "(the G5 lesson, in-process)")
+            elif func.attr == "join":
+                if recv in queues:
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"{recv}.join(): queue.Queue.join() accepts no "
+                        "timeout — restructure around bounded waits "
+                        "(task counting + Event.wait(timeout=))")
+                elif recv in threads and "timeout" not in kw_names \
+                        and not node.args:
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"{recv}.join() without timeout= — a wedged "
+                        "worker thread hangs shutdown forever; join "
+                        "with a deadline and report the stall")
+
+
 ARTIFACT_SUFFIXES = (".params", ".states", ".pstate", ".json", ".onnx")
 _SAVE_FN_RE = re.compile(r"save|checkpoint|export|dump", re.IGNORECASE)
 

@@ -110,6 +110,14 @@ def _checkpoint_report(root: str) -> dict:
     return commit.doctor_report(root)
 
 
+def _serving_report(path: str) -> dict:
+    """Shed-rate / compile-cache hit-rate / deadline-miss summary of the
+    last serving run's journal records (serving.report is stdlib-only,
+    same contract as the checkpoint report)."""
+    from ..serving import report
+    return report.serving_report(path)
+
+
 def cmd_doctor(args) -> int:
     deadline = guard.probe_deadline_s(args.deadline)
     report = {"python": sys.version.split()[0],
@@ -117,6 +125,8 @@ def cmd_doctor(args) -> int:
               "env": _env_report()}
     if args.ckpt_dir:
         report["checkpoint"] = _checkpoint_report(args.ckpt_dir)
+    if args.serving_journal:
+        report["serving"] = _serving_report(args.serving_journal)
     print(f"doctor: import audit (deadline {deadline:g}s) ...",
           file=sys.stderr)
     report["import_audit"] = _import_audit(deadline)
@@ -150,6 +160,18 @@ def cmd_doctor(args) -> int:
     else:
         print("doctor: BACKEND UNREACHABLE: "
               f"{report['backend']['detail']}", file=sys.stderr)
+    sv = report.get("serving")
+    if sv is not None:
+        if not sv.get("ok"):
+            print(f"doctor: serving journal: {sv.get('error')}",
+                  file=sys.stderr)
+        else:
+            print(f"doctor: serving: {sv['served']} served in "
+                  f"{sv['batches']} batches, shed-rate "
+                  f"{sv['shed_rate']}, cache hit-rate "
+                  f"{sv['cache_hit_rate']} ({sv['compiles']} compiles), "
+                  f"{sv['deadline_miss_total']} deadline misses, "
+                  f"{len(sv['reloads'])} reloads", file=sys.stderr)
     ck = report.get("checkpoint")
     if ck is not None:
         if ck.get("newest_step") is None:
@@ -184,6 +206,11 @@ def main(argv=None) -> int:
                    help="commit-protocol checkpoint root: report the "
                         "latest step's manifest validity and the newest "
                         "restorable step (default MXNET_TPU_CKPT_DIR)")
+    d.add_argument("--serving-journal", default=None, metavar="PATH",
+                   help="JSONL journal from a serving run "
+                        "(MXNET_TPU_JOURNAL=<file>): summarize the last "
+                        "run's shed-rate, compile-cache hit-rate, and "
+                        "deadline-miss count (docs/serving.md)")
     d.set_defaults(fn=cmd_doctor)
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -34,7 +34,51 @@ from ..base import MXNetError, _as_np_dtype
 from ..context import Context, current_context
 from .parameter import (DeferredInitializationError, Parameter, ParameterDict)
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "functional_apply"]
+
+
+def functional_apply(block, key, tr_datas, aux_datas, input_datas,
+                     training=True, ctx=None):
+    """Run a Gluon block as a pure function of its parameter arrays.
+
+    This is the predictor-extraction primitive — the bridge between the
+    mutable Gluon world and functional XLA shared by the sharded/pipelined
+    trainers (``parallel/``) and the serving predictor cache
+    (``serving/cache.py``): parameter handles are temporarily rebound to
+    the traced arrays, the block runs eagerly (every op dispatches to jnp
+    on tracers), and the handles are restored. Returns ``(out_datas,
+    out_treedef, aux_new_datas)``; auxiliary state (BatchNorm running
+    stats) is captured from the rebound handles — mutation hoisted into
+    explicit outputs.
+    """
+    trainable, aux = block._param_split()
+    if ctx is None:
+        ctx = current_context()
+    saved = []
+    temps = {}
+    for param, data in list(zip(trainable, tr_datas)) + \
+            list(zip(aux, aux_datas)):
+        saved.append((param, param._data))
+        arr = nd.NDArray(data, ctx=ctx, _skip_device_put=True)
+        temps[id(param)] = arr
+        param._data = [arr] * len(param._ctx_list or [ctx])
+    try:
+        # trace with recording OFF — a jitted program is differentiated
+        # as one unit from outside, never via the eager tape
+        with _rng.trace_key(key), autograd.pause(train_mode=training):
+            out = Block.__call__(block, *[
+                nd.NDArray(d, ctx=ctx, _skip_device_put=True)
+                if not isinstance(d, nd.NDArray) else d
+                for d in input_datas])
+        out_flat, treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, nd.NDArray))
+        out_datas = [o._data if isinstance(o, nd.NDArray) else o
+                     for o in out_flat]
+        aux_new = [temps[id(p)]._data for p in aux]
+    finally:
+        for param, data in saved:
+            param._data = data
+    return out_datas, treedef, aux_new
 
 _naming = threading.local()
 
@@ -228,6 +272,23 @@ class Block:
         loaded = nd.load(filename)
         if not isinstance(loaded, dict):
             raise MXNetError(f"{filename} is not a parameter dict file")
+        self.load_dict(loaded, ctx=ctx, allow_missing=allow_missing,
+                       ignore_extra=ignore_extra, cast_dtype=cast_dtype,
+                       dtype_source=dtype_source, source=filename)
+
+    def load_dict(self, loaded, ctx=None, allow_missing=False,
+                  ignore_extra=False, cast_dtype=False,
+                  dtype_source="current", source="<param dict>"):
+        """Load parameters from an already-loaded name→NDArray dict (ref:
+        gluon Block.load_dict). The in-memory half of ``load_parameters``
+        — the serving hot-reload path applies checkpoint dicts through
+        here so a swap needs no extra disk round trip. ``arg:``/``aux:``
+        prefixes from ``HybridBlock.export`` artifacts are stripped."""
+        if any(k.partition(":")[0] in ("arg", "aux") and ":" in k
+               for k in loaded):
+            loaded = {k.partition(":")[2] if ":" in k and
+                      k.partition(":")[0] in ("arg", "aux") else k: v
+                      for k, v in loaded.items()}
         params = self._structural_names()
         if ctx is None:
             ctx = [current_context()]
@@ -236,7 +297,7 @@ class Block:
         for key, param in params.items():
             if key not in loaded:
                 if not allow_missing:
-                    raise MXNetError(f"parameter {key} missing from {filename}")
+                    raise MXNetError(f"parameter {key} missing from {source}")
                 continue
             value = loaded[key]
             if cast_dtype and dtype_source == "current" and \
@@ -247,7 +308,7 @@ class Block:
         if not ignore_extra:
             extra = set(loaded) - set(params)
             if extra:
-                raise MXNetError(f"{filename} has extra parameters "
+                raise MXNetError(f"{source} has extra parameters "
                                  f"{sorted(extra)}; pass ignore_extra=True")
 
     save_params = save_parameters          # deprecated aliases kept
@@ -269,6 +330,12 @@ class Block:
         """No-op on plain Blocks; recurses so nested HybridBlocks engage."""
         for child in self._children.values():
             child.hybridize(active, **kwargs)
+
+    def _param_split(self):
+        params = [p for p in self.collect_params().values()]
+        trainable = [p for p in params if p.grad_req != "null"]
+        aux = [p for p in params if p.grad_req == "null"]
+        return trainable, aux
 
     def summary(self, *inputs):
         """Print a per-layer summary (ref: Block.summary), minimal edition."""
@@ -372,43 +439,15 @@ class HybridBlock(Block):
             with autograd.pause():
                 super().__call__(*args)
 
-    def _param_split(self):
-        params = [p for p in self.collect_params().values()]
-        trainable = [p for p in params if p.grad_req != "null"]
-        aux = [p for p in params if p.grad_req == "null"]
-        return trainable, aux
-
     def _build_fn(self, training, n_args, ctx):
         self_block = self
 
         def fn(rng_key, trainable_datas, aux_datas, *input_datas):
-            trainable, aux = self_block._param_split()
-            saved = []
-            temps = {}
-            for param, data in list(zip(trainable, trainable_datas)) + \
-                    list(zip(aux, aux_datas)):
-                saved.append((param, param._data))
-                arr = nd.NDArray(data, ctx=ctx, _skip_device_put=True)
-                temps[id(param)] = arr
-                param._data = [arr] * len(param._ctx_list or [ctx])
-            try:
-                # trace with recording OFF — the jitted program is
-                # differentiated as one unit from outside
-                with _rng.trace_key(rng_key), \
-                        autograd.pause(train_mode=training):
-                    out = Block.__call__(self_block, *[
-                        nd.NDArray(d, ctx=ctx, _skip_device_put=True)
-                        for d in input_datas])
-                out_flat, treedef = jax.tree_util.tree_flatten(
-                    out, is_leaf=lambda x: isinstance(x, nd.NDArray))
-                self_block._out_treedef = treedef
-                out_datas = tuple(o._data if isinstance(o, nd.NDArray) else o
-                                  for o in out_flat)
-                aux_new = tuple(temps[id(p)]._data for p in aux)
-            finally:
-                for param, data in saved:
-                    param._data = data
-            return out_datas + aux_new
+            out_datas, treedef, aux_new = functional_apply(
+                self_block, rng_key, trainable_datas, aux_datas,
+                list(input_datas), training=training, ctx=ctx)
+            self_block._out_treedef = treedef
+            return tuple(out_datas) + tuple(aux_new)
         return jax.jit(fn)
 
     def _call_cached(self, *args):

@@ -76,7 +76,7 @@ class DataLoader:
         batches = list(self._batch_sampler)
         results = [None] * len(batches)
         done = [threading.Event() for _ in batches]
-        task_q = queue.Queue()
+        task_q = queue.Queue(maxsize=max(len(batches), 1))
         for i, b in enumerate(batches):
             task_q.put((i, b))
 

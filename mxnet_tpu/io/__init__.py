@@ -298,16 +298,32 @@ class PrefetchingIter(DataIter):
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
 
+    def _get(self):
+        """Bounded dequeue: re-arm a short timeout while the producer is
+        alive; a worker that died without delivering its sentinel raises
+        a structured error instead of hanging the consumer forever."""
+        while True:
+            try:
+                return self._queue.get(timeout=1.0)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    raise MXNetError(
+                        "prefetch worker died without delivering a batch "
+                        "or its end sentinel") from None
+
     def reset(self):
         if self._thread is not None and self._thread.is_alive():
-            while self._queue.get() is not None:
+            while self._get() is not None:
                 pass
-            self._thread.join()
+            self._thread.join(timeout=30.0)
+            if self._thread.is_alive():
+                raise MXNetError("prefetch worker did not exit within "
+                                 "30s after draining")
         self.iter.reset()
         self._start()
 
     def next(self):
-        batch = self._queue.get()
+        batch = self._get()
         if batch is None:
             raise StopIteration
         return batch

@@ -36,52 +36,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .. import _rng, autograd
 from .. import ndarray as nd
 from ..base import MXNetError
-from ..context import current_context
-from ..gluon.block import Block
+from ..gluon.block import functional_apply  # noqa: F401  (re-export: the
+#   primitive moved to gluon.block so serving/cache.py can share it
+#   without importing the parallel package; trainers keep this name)
 from ..ops import optimizer_op as _ops
 from . import _ckpt
 from .mesh import current_mesh
 
 __all__ = ["ShardedTrainer", "functional_apply",
            "allreduce_across_processes"]
-
-
-def functional_apply(block, key, tr_datas, aux_datas, input_datas,
-                     training=True):
-    """Run a Gluon block as a pure function of its parameter arrays.
-
-    This is the bridge between the mutable Gluon world and functional XLA:
-    parameter handles are temporarily rebound to the traced arrays, the block
-    runs eagerly (every op dispatches to jnp on tracers), and the handles are
-    restored. Returns (out_datas, out_treedef, aux_new_datas); auxiliary
-    state (BatchNorm running stats) is captured from the rebound handles —
-    mutation hoisted into explicit outputs.
-    """
-    trainable, aux = block._param_split()
-    ctx = current_context()
-    saved = []
-    temps = {}
-    for param, data in list(zip(trainable, tr_datas)) + \
-            list(zip(aux, aux_datas)):
-        saved.append((param, param._data))
-        arr = nd.NDArray(data, ctx=ctx, _skip_device_put=True)
-        temps[id(param)] = arr
-        param._data = [arr] * len(param._ctx_list or [ctx])
-    try:
-        with _rng.trace_key(key), autograd.pause(train_mode=training):
-            out = Block.__call__(block, *[
-                nd.NDArray(d, ctx=ctx, _skip_device_put=True)
-                if not isinstance(d, nd.NDArray) else d
-                for d in input_datas])
-        out_flat, treedef = jax.tree_util.tree_flatten(
-            out, is_leaf=lambda x: isinstance(x, nd.NDArray))
-        out_datas = [o._data if isinstance(o, nd.NDArray) else o
-                     for o in out_flat]
-        aux_new = [temps[id(p)]._data for p in aux]
-    finally:
-        for param, data in saved:
-            param._data = data
-    return out_datas, treedef, aux_new
 
 
 # ---------------------------------------------------------------------------

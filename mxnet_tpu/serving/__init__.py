@@ -1,0 +1,66 @@
+"""``mxnet_tpu.serving`` — dynamic-batching inference subsystem.
+
+The paper's core mechanism — Gluon ``HybridBlock.hybridize()`` lowering
+to ONE jitted XLA computation (``CachedOp`` ≡ ``jax.jit``, SURVEY §7) —
+is an inference-serving primitive; this package is the serving story
+around it (docs/serving.md):
+
+- :mod:`.buckets` — the shape lattice that bounds XLA compiles by
+  configuration instead of traffic;
+- :mod:`.batcher` — bounded admission, deadline bookkeeping, micro-batch
+  coalescing (stdlib threads + queues, no server framework);
+- :mod:`.cache` — a bounded LRU of compiled predictors built on
+  ``gluon.block.functional_apply`` (params as runtime args: hot-reload
+  retraces nothing);
+- :mod:`.server` — the worker loop: shed → coalesce → pad → execute →
+  deadline-check, journaled per batch;
+- :mod:`.reload` — newest-valid-committed-step hot-reload over
+  ``resilience.commit`` (a torn checkpoint can never reach a response);
+- :mod:`.report` — stdlib journal summarizer for
+  ``python -m mxnet_tpu.diagnostics doctor --serving-journal``;
+- ``python -m mxnet_tpu.serving bench`` — closed-loop load generator
+  emitting a ``BENCH_serving`` JSON artifact.
+
+Lazy exports (PEP 562): importing the package — or its stdlib-only
+submodules ``buckets``/``batcher``/``report`` — touches neither jax nor
+the runtime, so the doctor can summarize a serving journal while the
+backend is wedged.
+"""
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["BucketGrid", "CompiledPredictor", "DeadlineExceeded",
+           "ParamStore", "PendingResponse", "PredictorCache",
+           "RequestError", "Server", "ServerConfig", "ServerOverloaded",
+           "serving_report"]
+
+_LAZY = {
+    "BucketGrid": ("buckets", "BucketGrid"),
+    "CompiledPredictor": ("cache", "CompiledPredictor"),
+    "DeadlineExceeded": ("batcher", "DeadlineExceeded"),
+    "ParamStore": ("reload", "ParamStore"),
+    "PendingResponse": ("batcher", "PendingResponse"),
+    "PredictorCache": ("cache", "PredictorCache"),
+    "RequestError": ("batcher", "RequestError"),
+    "Server": ("server", "Server"),
+    "ServerConfig": ("server", "ServerConfig"),
+    "ServerOverloaded": ("batcher", "ServerOverloaded"),
+    "serving_report": ("report", "serving_report"),
+}
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    mod = importlib.import_module(f".{mod_name}", __name__)
+    value = getattr(mod, attr)
+    globals()[name] = value          # cache: subsequent lookups are direct
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

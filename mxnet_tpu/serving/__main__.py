@@ -1,0 +1,172 @@
+"""Serving bench CLI: ``python -m mxnet_tpu.serving bench``.
+
+Closed-loop load generator against a small Gluon MLP behind the full
+serving stack (bounded admission, dynamic batching, compiled-predictor
+cache, deadlines).  Each client thread submits a request, waits for the
+response, and immediately submits the next — the closed loop measures
+end-to-end capacity, not queue theatre.
+
+Artifact contract (same as bench.py): exactly ONE JSON line on stdout —
+``{"metric": "serving_requests_per_sec", "value": ...}`` with latency
+percentiles, shed/deadline counters, and the compile-count-vs-grid-bound
+proof — plus the same document written atomically to ``--out``
+(default ``BENCH_serving.json``).  Failures emit a structured error
+line, never a hang: journal breadcrumbs + SIGTERM finalizer ride the
+diagnostics journal exactly like bench.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+METRIC = "serving_requests_per_sec"
+
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def _diagnostic(error: str, detail: str) -> dict:
+    return {"metric": METRIC, "value": None, "unit": "req/s",
+            "error": error, "detail": detail}
+
+
+def _build_model(dim):
+    from ..gluon import nn
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu", in_units=dim))
+        net.add(nn.Dense(8, in_units=32))
+    net.initialize()
+    return net
+
+
+def cmd_bench(args) -> int:
+    import numpy as np
+
+    from ..diagnostics import get_journal
+    from ..metric import LatencySummary
+    from ..resilience.atomic import atomic_write
+    from .server import Server, ServerConfig
+
+    j = get_journal()
+    j.install_handlers(final_cb=lambda: _emit(_diagnostic(
+        "bench_killed", f"killed at phase {j.last_phase!r} before "
+        "completion; see stderr journal for breadcrumbs")))
+    j.set_phase("serving_bench_setup")
+    net = _build_model(args.dim)
+    cfg = ServerConfig(max_batch=args.max_batch, max_queue=args.queue,
+                       window_ms=args.window_ms,
+                       default_deadline_ms=args.deadline_ms)
+    server = Server(net, config=cfg)
+    server.start()
+
+    client_lat = LatencySummary("client_latency_ms")
+    stop_at = time.monotonic() + args.seconds
+    ok = [0] * args.clients
+    shed = [0] * args.clients
+    missed = [0] * args.clients
+    errored = [0] * args.clients
+
+    def client(idx):
+        from .batcher import (DeadlineExceeded, RequestError,
+                              ServerOverloaded)
+        rng = np.random.default_rng(idx)
+        while time.monotonic() < stop_at:
+            x = rng.standard_normal(args.dim).astype(np.float32)
+            t0 = time.perf_counter()
+            try:
+                server.predict(x)
+            except ServerOverloaded:
+                shed[idx] += 1
+                time.sleep(0.002)           # closed-loop backoff
+                continue
+            except DeadlineExceeded:
+                missed[idx] += 1
+                continue
+            except RequestError as e:
+                # predictor failure / stopped server: a dead client
+                # thread must show in the artifact, never silently
+                # deflate req/s
+                errored[idx] += 1
+                print(f"serving bench: client {idx}: {e}",
+                      file=sys.stderr)
+                time.sleep(0.01)
+                continue
+            client_lat.observe((time.perf_counter() - t0) * 1000.0)
+            ok[idx] += 1
+
+    j.set_phase("serving_bench_run")
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.seconds + 30)
+    elapsed = time.monotonic() - t_start
+    j.set_phase("serving_bench_report")
+    server.stop(timeout_s=30)
+
+    stats = server.stats()
+    total_ok = sum(ok)
+    doc = {
+        "metric": METRIC,
+        "value": round(total_ok / elapsed, 2) if elapsed else None,
+        "unit": f"req/s (clients={args.clients}, dim={args.dim}, "
+                f"max_batch={args.max_batch})",
+        "elapsed_s": round(elapsed, 2),
+        "completed": total_ok,
+        "client_shed": sum(shed),
+        "client_deadline_miss": sum(missed),
+        "client_errors": sum(errored),
+        "latency_ms": client_lat.summary(),
+        "server": stats,
+        "compiles": stats["cache"]["misses"],
+        "grid_bound": server.grid.grid_bound(),
+        "compile_bound_ok":
+            stats["cache"]["misses"] <= server.grid.grid_bound(),
+    }
+    if args.out:
+        with atomic_write(args.out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print(f"serving bench: artifact written to {args.out}",
+              file=sys.stderr)
+    _emit(doc)
+    j.mark_clean()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.serving",
+        description="serving subsystem CLI (docs/serving.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    b = sub.add_parser("bench", help="closed-loop load generator; ONE "
+                                     "JSON line on stdout + --out artifact")
+    b.add_argument("--seconds", type=float, default=3.0)
+    b.add_argument("--clients", type=int, default=4)
+    b.add_argument("--dim", type=int, default=16)
+    b.add_argument("--max-batch", type=int, default=8)
+    b.add_argument("--queue", type=int, default=64)
+    b.add_argument("--window-ms", type=float, default=2.0)
+    b.add_argument("--deadline-ms", type=float, default=5000.0)
+    b.add_argument("--out", default="BENCH_serving.json",
+                   help="artifact path ('' disables the file)")
+    b.set_defaults(fn=cmd_bench)
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except Exception as e:              # structured line, never a bare crash
+        from ..diagnostics import get_journal
+        get_journal().crash(e)
+        _emit(_diagnostic("bench_crashed", f"{type(e).__name__}: {e}"))
+        get_journal().mark_clean()
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

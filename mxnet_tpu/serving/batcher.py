@@ -1,0 +1,154 @@
+"""Request plumbing for the dynamic batcher — stdlib-only.
+
+A :class:`Request` is one sample (no batch axis) plus its admission
+timestamp and absolute deadline; completion is a ``threading.Event`` the
+submitting thread waits on through :class:`PendingResponse`.  The worker
+thread groups admitted requests into micro-batches with
+:func:`take_batch`: FIFO within a feature-bucket key, capped at the
+largest batch bucket, leaving differently-bucketed requests pending for
+the next cycle (so one odd-shaped request never pads — or blocks — a
+whole batch of the common shape).
+
+Deadline semantics (docs/serving.md): a deadline is checked twice —
+at dequeue (:func:`drop_expired`; a request that already missed must not
+waste a batch slot) and again post-batch by the server (a result that
+arrives late is an error, not a silently-slow success).  Both misses
+surface as :class:`DeadlineExceeded` on the caller's ``result()``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["DeadlineExceeded", "PendingResponse", "Request", "RequestError",
+           "ServerOverloaded", "drop_expired", "take_batch"]
+
+
+class RequestError(RuntimeError):
+    """Structured per-request failure (bad shape, predictor error)."""
+
+
+class ServerOverloaded(RequestError):
+    """Admission rejected: the bounded queue is full.  Raised to the
+    *submitter* immediately — the explicit load-shed that keeps queue
+    latency bounded instead of letting every client get slower."""
+
+    def __init__(self, depth, limit):
+        super().__init__(f"serving queue full ({depth}/{limit}); request "
+                         "shed — retry with backoff or scale out")
+        self.depth = depth
+        self.limit = limit
+
+
+class DeadlineExceeded(RequestError):
+    """The request's deadline passed before (stage='dequeue') or while
+    (stage='post_batch') it was served."""
+
+    def __init__(self, stage, late_ms):
+        super().__init__(f"deadline exceeded at {stage} "
+                         f"({late_ms:.1f} ms late)")
+        self.stage = stage
+        self.late_ms = late_ms
+
+
+class Request:
+    """One admitted sample and its completion slot."""
+
+    __slots__ = ("payload", "shape", "key", "enq_t", "deadline_ts",
+                 "done", "result", "error", "served_t")
+
+    def __init__(self, payload, shape, key, deadline_s=None, now=None):
+        now = time.monotonic() if now is None else now
+        self.payload = payload
+        self.shape = tuple(shape)            # original feature shape
+        self.key = key                       # bucketed feature shape
+        self.enq_t = now
+        self.deadline_ts = None if deadline_s is None else now + deadline_s
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+        self.served_t = None
+
+    def late_ms(self, now=None) -> float:
+        if self.deadline_ts is None:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        return max(now - self.deadline_ts, 0.0) * 1000.0
+
+    def expired(self, now=None) -> bool:
+        return self.deadline_ts is not None and \
+            (time.monotonic() if now is None else now) > self.deadline_ts
+
+    def set_result(self, value, now=None):
+        self.served_t = time.monotonic() if now is None else now
+        self.result = value
+        self.done.set()
+
+    def set_error(self, exc, now=None):
+        self.served_t = time.monotonic() if now is None else now
+        self.error = exc
+        self.done.set()
+
+
+class PendingResponse:
+    """Caller-side handle: ``result(timeout_s)`` blocks (bounded) until
+    the worker completes the request, then returns the value or raises
+    the request's structured error."""
+
+    def __init__(self, request: Request, default_timeout_s: float = 60.0):
+        self._request = request
+        self._default_timeout_s = default_timeout_s
+
+    def result(self, timeout_s=None):
+        timeout_s = self._default_timeout_s if timeout_s is None \
+            else timeout_s
+        if not self._request.done.wait(timeout=timeout_s):
+            raise RequestError(
+                f"no response within {timeout_s:g}s (server stopped or "
+                "wedged — check the serving journal)")
+        if self._request.error is not None:
+            raise self._request.error
+        return self._request.result
+
+    def done(self) -> bool:
+        return self._request.done.is_set()
+
+    @property
+    def latency_ms(self):
+        if self._request.served_t is None:
+            return None
+        return (self._request.served_t - self._request.enq_t) * 1000.0
+
+
+def drop_expired(pending, on_expired, now=None):
+    """Remove already-expired requests from ``pending`` (in place),
+    reporting each through ``on_expired(request)`` — the dequeue-time
+    half of the deadline contract."""
+    now = time.monotonic() if now is None else now
+    keep = []
+    for req in pending:
+        if req.expired(now):
+            on_expired(req)
+        else:
+            keep.append(req)
+    pending[:] = keep
+    return pending
+
+
+def take_batch(pending, grid):
+    """Pop the next micro-batch off ``pending`` (in place): the oldest
+    request's feature-bucket key selects the batch; same-key requests
+    join in FIFO order up to the largest batch bucket.  Returns
+    ``(batch, batch_bucket, feature_key)`` or ``(None, None, None)``
+    when pending is empty."""
+    if not pending:
+        return None, None, None
+    key = pending[0].key
+    batch, rest = [], []
+    for req in pending:
+        if req.key == key and len(batch) < grid.max_batch:
+            batch.append(req)
+        else:
+            rest.append(req)
+    pending[:] = rest
+    return batch, grid.batch_bucket(len(batch)), key

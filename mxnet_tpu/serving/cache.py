@@ -1,0 +1,127 @@
+"""Compiled-predictor cache — a bounded LRU of jitted executables.
+
+The serving analog of ``CachedOp``: each entry is ONE jitted XLA
+program for one padded shape ``(batch_bucket,) + feature_key``, built
+through :func:`gluon.block.functional_apply` (the same predictor-
+extraction primitive the sharded/pipelined trainers compile through).
+Parameters enter the program as **runtime arguments**, so a hot-reload
+that swaps parameter values retraces nothing — only a novel padded
+shape compiles, and the bucket grid bounds how many of those exist.
+
+The LRU bound makes the executable population bounded even when the
+configured grid is large (a misconfigured 10^3-cell grid must degrade to
+evictions, not to unbounded device-memory growth).  Counters
+(hits/misses/evictions; misses == compiles) feed the per-batch journal
+record and the compile-bound acceptance test.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+import jax
+
+from .. import _rng
+from ..gluon.block import functional_apply
+
+__all__ = ["CompiledPredictor", "PredictorCache"]
+
+
+class CompiledPredictor:
+    """One jitted inference program at one padded shape.
+
+    ``__call__(x_padded)`` fetches the block's *current* parameter
+    arrays (so a between-batches hot-reload is picked up with no
+    recompile), threads a fresh PRNG key, and returns the flat tuple of
+    output device arrays plus the traced output treedef.
+    """
+
+    def __init__(self, block, ctx=None):
+        self._block = block
+        self._ctx = ctx
+        self._treedef = None
+
+        def fn(key, tr_datas, aux_datas, x):
+            outs, treedef, _aux_new = functional_apply(
+                block, key, tr_datas, aux_datas, [x],
+                training=False, ctx=ctx)
+            # inference never writes aux state back (BatchNorm running
+            # stats stay frozen); treedef is captured at trace time
+            self._treedef = treedef
+            return tuple(outs)
+
+        self._jitted = jax.jit(fn)
+
+    def __call__(self, x_padded):
+        trainable, aux = self._block._param_split()
+        tr_datas = [p._data[0]._data for p in trainable]
+        aux_datas = [p._data[0]._data for p in aux]
+        outs = self._jitted(_rng.next_key(), tr_datas, aux_datas, x_padded)
+        return outs, self._treedef
+
+
+class PredictorCache:
+    """Bounded LRU over :class:`CompiledPredictor` entries.
+
+    ``get(key, builder)`` returns ``(entry, hit)``; a miss invokes
+    ``builder()`` (the compile) and may evict the least-recently-used
+    entry.  Dropping an entry releases the jitted closure, so the
+    underlying XLA executable becomes collectable — the cache is the one
+    owner.  Thread-safe, though the serving worker is the only caller in
+    steady state."""
+
+    def __init__(self, max_entries=16):
+        if max_entries < 1:
+            raise ValueError("PredictorCache needs max_entries >= 1")
+        self.max_entries = int(max_entries)
+        self._lru = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.last_build_s = None
+
+    def get(self, key, builder):
+        with self._lock:
+            entry = self._lru.get(key)
+            if entry is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+                return entry, True
+        # build outside the lock: a multi-second XLA compile must not
+        # block a stats() snapshot from another thread
+        t0 = time.perf_counter()
+        entry = builder()
+        build_s = time.perf_counter() - t0
+        with self._lock:
+            raced = self._lru.get(key)
+            if raced is not None:         # concurrent builder won
+                self._lru.move_to_end(key)
+                self.hits += 1
+                return raced, True
+            self.misses += 1
+            self.last_build_s = round(build_s, 4)
+            self._lru[key] = entry
+            while len(self._lru) > self.max_entries:
+                self._lru.popitem(last=False)
+                self.evictions += 1
+        return entry, False
+
+    def __len__(self):
+        with self._lock:
+            return len(self._lru)
+
+    def clear(self):
+        with self._lock:
+            self._lru.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {"entries": len(self._lru),
+                    "max_entries": self.max_entries,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "hit_rate": round(self.hits / total, 4) if total else None,
+                    "last_build_s": self.last_build_s}

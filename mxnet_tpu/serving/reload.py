@@ -1,0 +1,97 @@
+"""Hot-reload source: newest *valid* committed checkpoint step.
+
+A trainer publishes checkpoints through the directory commit protocol
+(``resilience.commit``: stage → CRC manifest → one rename —
+docs/checkpointing.md); the server polls the same root from the other
+side.  :class:`ParamStore` hands the serving worker a parameter dict
+from the newest committed step that passes CRC validation AND loads
+cleanly — a producer SIGTERM'd mid-commit leaves either an invisible
+``step-N.tmp`` stage or a manifest that fails validation, so a torn
+checkpoint can never reach a response.  Every skipped candidate is
+journaled (``ckpt_fallback``), and steps that validated but failed to
+parse are remembered so one bad step can't wedge the poll loop.
+
+The dict is applied between batches by ``Server._maybe_reload`` via
+``Block.load_dict`` — parameters are runtime arguments to the compiled
+predictors (serving/cache.py), so a swap retraces nothing and in-flight
+requests simply ride whichever version their batch started with.
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import MXNetError
+from ..diagnostics.journal import get_journal
+from ..resilience import commit as _commit
+
+__all__ = ["ParamStore"]
+
+
+class ParamStore:
+    """Poll a commit-protocol checkpoint root for fresh parameters.
+
+    ``params_file``: name of the parameter file inside a committed step
+    dir; default picks the first ``*.params`` manifest entry (a
+    ``Block.save_parameters`` or ``HybridBlock.export`` artifact —
+    ``arg:``/``aux:`` prefixes are handled by ``load_dict``).
+    """
+
+    def __init__(self, root, params_file=None):
+        self.root = root
+        self.params_file = params_file
+        self.loaded_step = None
+        self._bad_steps = set()
+
+    def _pick_file(self, step, manifest):
+        if self.params_file is not None:
+            if self.params_file not in manifest["files"]:
+                raise MXNetError(
+                    f"step {step}: manifest has no {self.params_file!r} "
+                    f"(files: {sorted(manifest['files'])})")
+            return self.params_file
+        for name in sorted(manifest["files"]):
+            if name.endswith(".params"):
+                return name
+        raise MXNetError(f"step {step}: no .params file in manifest "
+                         f"(files: {sorted(manifest['files'])})")
+
+    def poll(self):
+        """Return ``(step, name→NDArray dict)`` when a step newer than
+        the loaded one is available and intact, else None.  Corrupt or
+        unparseable candidates are journaled and skipped — never served,
+        never fatal."""
+        from .. import ndarray as nd
+        for step in sorted(_commit.committed_steps(self.root), reverse=True):
+            if self.loaded_step is not None and step <= self.loaded_step:
+                return None          # newest usable is already serving
+            if step in self._bad_steps:
+                continue
+            try:
+                manifest = _commit.validate_step(self.root, step)
+                fname = self._pick_file(step, manifest)
+                loaded = nd.load(
+                    os.path.join(_commit.step_dir(self.root, step), fname))
+                if not isinstance(loaded, dict):
+                    raise MXNetError(f"{fname} is not a parameter dict")
+            except (ValueError, MXNetError, OSError) as e:
+                # ValueError: torn/corrupt per the manifest CRCs;
+                # MXNetError: container-level CRC/truncation from nd.load;
+                # OSError: the step dir raced a trainer's keep-last-k GC
+                # between listing and read — gone is just another skip
+                self._bad_steps.add(step)
+                get_journal().event(
+                    "ckpt_fallback", root=self.root, step=step,
+                    consumer="serving", error=type(e).__name__,
+                    detail=str(e)[:300])
+                continue
+            self.loaded_step = step
+            return step, loaded
+        return None
+
+    def mark_bad(self, step, revert_to=None):
+        """Remember ``step`` as unusable and roll ``loaded_step`` back
+        to ``revert_to`` — the server's hook for a checkpoint that
+        validated but failed to APPLY (architecture drift), keeping the
+        bad-step bookkeeping in one place."""
+        self._bad_steps.add(step)
+        self.loaded_step = revert_to

@@ -1,0 +1,108 @@
+"""Serving-journal summarizer — stdlib-only, consumed by the doctor CLI.
+
+Parses a JSONL diagnostics journal (``MXNET_TPU_JOURNAL=<file>`` during
+a serving run) and reduces the ``serving_*`` records of the LAST run
+(everything after the final ``serving_start``) to the operator signals:
+shed-rate, compile-cache hit-rate, deadline-miss counts, reload history.
+Junk/truncated lines are tolerated — a crashed writer's torn tail must
+not hide the healthy prefix.
+
+Importable from ``python -m mxnet_tpu.diagnostics doctor`` without jax
+(same contract as ``resilience.commit``): import this module directly,
+never through heavy siblings.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["serving_report"]
+
+_KINDS = ("serving_start", "serving_stop", "serving_batch", "serving_shed",
+          "serving_reject", "serving_deadline_miss", "serving_reload",
+          "serving_reload_failed")
+
+
+def _read_records(path):
+    records = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue                 # torn tail of a killed writer
+                if isinstance(rec, dict) and rec.get("kind") in _KINDS:
+                    records.append(rec)
+    except OSError as e:
+        return None, f"cannot read {path}: {e.strerror or e}"
+    return records, None
+
+
+def serving_report(path) -> dict:
+    """Summarize the last serving run's journal records (see module
+    docstring).  Always returns a dict; ``ok`` is False with an
+    ``error`` when the file is unreadable or holds no serving records."""
+    records, err = _read_records(path)
+    if records is None:
+        return {"ok": False, "path": path, "error": err}
+    # last run = records after the final serving_start (if any)
+    for i in range(len(records) - 1, -1, -1):
+        if records[i]["kind"] == "serving_start":
+            records = records[i:]
+            break
+    if not records:
+        return {"ok": False, "path": path,
+                "error": "no serving records in journal"}
+
+    batches = [r for r in records if r["kind"] == "serving_batch"]
+    sheds = sum(1 for r in records if r["kind"] == "serving_shed")
+    rejects = sum(1 for r in records if r["kind"] == "serving_reject")
+    misses = {"dequeue": 0, "post_batch": 0}
+    for r in records:
+        if r["kind"] == "serving_deadline_miss":
+            misses[r.get("stage", "dequeue")] = \
+                misses.get(r.get("stage", "dequeue"), 0) + 1
+    reloads = [r for r in records if r["kind"] == "serving_reload"]
+    reload_failures = sum(1 for r in records
+                          if r["kind"] == "serving_reload_failed")
+
+    # delivered excludes post_batch deadline misses (they are inside
+    # `batch` but got an error response); older records without the
+    # field fall back to the batch size
+    served = sum(int(r.get("delivered", r.get("batch", 0)))
+                 for r in batches)
+    admitted = sum(int(r.get("batch", 0)) for r in batches) + \
+        misses.get("dequeue", 0)
+    offered = admitted + sheds
+    out = {"ok": True, "path": path,
+           "batches": len(batches), "served": served,
+           "shed": sheds, "rejected_shape": rejects,
+           "shed_rate": round(sheds / offered, 4) if offered else None,
+           "deadline_miss": misses,
+           "deadline_miss_total": sum(misses.values()),
+           "reloads": [{"step": r.get("step"),
+                        "prev_step": r.get("prev_step")} for r in reloads],
+           "reload_failures": reload_failures}
+    if batches:
+        last = batches[-1]
+        hits, miss = int(last.get("hits", 0)), int(last.get("misses", 0))
+        out["compiles"] = miss
+        out["cache_hit_rate"] = round(hits / (hits + miss), 4) \
+            if hits + miss else None
+        out["last_batch"] = {
+            k: last.get(k) for k in ("queue_depth", "batch", "bucket",
+                                     "fill", "pad_waste", "params_step",
+                                     "p50_ms", "p95_ms", "p99_ms")}
+        fills = [float(r.get("fill", 0)) for r in batches]
+        out["mean_fill"] = round(sum(fills) / len(fills), 4)
+        waste = [float(r.get("pad_waste", 0)) for r in batches]
+        out["mean_pad_waste"] = round(sum(waste) / len(waste), 4)
+    else:
+        out["compiles"] = 0
+        out["cache_hit_rate"] = None
+    stops = [r for r in records if r["kind"] == "serving_stop"]
+    out["clean_stop"] = bool(stops) and not stops[-1].get("stuck", False)
+    return out

@@ -1,0 +1,420 @@
+"""The serving core: admission → dynamic batching → compiled predictors.
+
+One worker thread owns the device (the reference's single-executor
+discipline, ``native/predict.cc``): callers enqueue single samples into
+a **bounded** queue (admission control — a full queue sheds with
+:class:`~.batcher.ServerOverloaded` instead of growing latency),
+the worker coalesces same-bucket requests under a deadline window, pads
+to the bucket grid, and runs ONE jitted executable per padded shape from
+the bounded :class:`~.cache.PredictorCache`.  Per-request deadlines are
+honored at dequeue and post-batch; transient device errors ride
+``resilience.retry``; parameters hot-reload between batches from the
+newest valid committed checkpoint step (:class:`~.reload.ParamStore`)
+with zero draining.  Every batch journals a structured record
+(``serving_batch``) the diagnostics doctor summarizes.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..base import MXNetError
+from ..diagnostics.journal import get_journal
+from ..metric import LatencySummary
+from ..resilience.retry import retry_call
+from .batcher import (DeadlineExceeded, PendingResponse, Request,
+                      RequestError, ServerOverloaded, drop_expired,
+                      take_batch)
+from .buckets import BucketGrid
+from .cache import CompiledPredictor, PredictorCache
+
+__all__ = ["Server", "ServerConfig"]
+
+_STOP = object()
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class ServerConfig:
+    """Serving knobs (docs/serving.md has the tuning guide; the
+    ``MXNET_TPU_SERVING_*`` env vars set fleet-wide defaults)."""
+
+    max_batch: int = 8                       # largest coalesced batch
+    batch_buckets: tuple | None = None       # default: powers of 2
+    dim_buckets: dict | None = None          # {feature axis: sizes}
+    max_queue: int = field(default_factory=lambda: _env_int(
+        "MXNET_TPU_SERVING_MAX_QUEUE", 128))
+    window_ms: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_SERVING_WINDOW_MS", 5.0))
+    default_deadline_ms: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_SERVING_DEADLINE_MS", 2000.0))
+    cache_entries: int = field(default_factory=lambda: _env_int(
+        "MXNET_TPU_SERVING_CACHE", 16))
+    reload_poll_s: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_SERVING_RELOAD_S", 10.0))
+    idle_poll_s: float = 0.05                # worker wake granularity
+    dtype: str = "float32"                   # request payload dtype
+    pad_value: float = 0.0
+    crop_outputs: bool = True                # unpad outputs that kept dims
+    device_retries: int = 2                  # transient-error retries
+    transient_errors: tuple = (OSError,)     # retried via resilience.retry
+    result_timeout_s: float = 60.0           # PendingResponse default wait
+
+    def summary(self) -> dict:
+        return {"max_batch": self.max_batch, "max_queue": self.max_queue,
+                "window_ms": self.window_ms,
+                "default_deadline_ms": self.default_deadline_ms,
+                "cache_entries": self.cache_entries,
+                "reload_poll_s": self.reload_poll_s, "dtype": self.dtype}
+
+
+class Server:
+    """Dynamic-batching inference server around one Gluon block.
+
+    ``block`` must be initialized (parameters materialized) — pass any
+    ``Block``/``HybridBlock``/``SymbolBlock``; ``Server.from_checkpoint``
+    builds one from a ``model.save_checkpoint`` deployment pair.
+    ``param_store`` (a :class:`~.reload.ParamStore`) enables hot-reload.
+    """
+
+    def __init__(self, block, config=None, param_store=None, ctx=None):
+        self.block = block
+        self.config = config or ServerConfig()
+        cfg = self.config
+        self.grid = BucketGrid(cfg.max_batch, cfg.batch_buckets,
+                               cfg.dim_buckets)
+        self.cache = PredictorCache(cfg.cache_entries)
+        self.param_store = param_store
+        self.latency = LatencySummary("request_latency_ms")
+        self._ctx = ctx
+        self._dtype = np.dtype(cfg.dtype)
+        self._queue = queue.Queue(maxsize=cfg.max_queue)
+        self._worker = None
+        self._stopping = threading.Event()
+        self._lock = threading.Lock()
+        self._params_step = None
+        self._last_reload_check = None
+        self.counters = {"accepted": 0, "served": 0, "shed": 0,
+                         "rejected_shape": 0, "deadline_miss_dequeue": 0,
+                         "deadline_miss_post_batch": 0, "errors": 0,
+                         "reloads": 0, "batches": 0}
+
+    # -- deployment-pair constructor (module/model predict-path reuse) ------
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, input_names=("data",),
+                        config=None, param_store=None, ctx=None):
+        """Serve a ``prefix-symbol.json`` + ``prefix-NNNN.params`` pair
+        (``HybridBlock.export`` / ``model.save_checkpoint`` artifacts)
+        via ``SymbolBlock.imports`` — the reference's deployment
+        contract, behind the same batching front end."""
+        from ..gluon.block import SymbolBlock
+        block = SymbolBlock.imports(
+            f"{prefix}-symbol.json", list(input_names),
+            f"{prefix}-{epoch:04d}.params", ctx=ctx)
+        return cls(block, config=config, param_store=param_store, ctx=ctx)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._worker is not None and self._worker.is_alive():
+            return self
+        self._stopping.clear()
+        # serving_start opens the journal's "last run" window BEFORE the
+        # initial reload so that reload is attributed to this run
+        get_journal().event("serving_start", config=self.config.summary(),
+                            grid=repr(self.grid))
+        self._maybe_reload(force=True)     # begin on the newest valid step
+        self._worker = threading.Thread(
+            target=self._run, name="mxtpu-serving-worker", daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self, timeout_s=30.0, drain=True):
+        """Shut down: with ``drain`` the worker finishes everything
+        admitted before the sentinel; without, pending requests fail
+        with a structured 'server stopped' error.  Bounded join — a
+        wedged device can't hang the caller past ``timeout_s``."""
+        if self._worker is None:
+            return
+        if not drain:
+            self._stopping.set()
+        try:
+            self._queue.put(_STOP, timeout=timeout_s)
+        except queue.Full:
+            self._stopping.set()           # flooded: stop without drain
+        self._worker.join(timeout=timeout_s)
+        stuck = self._worker.is_alive()
+        get_journal().event("serving_stop", drained=bool(drain),
+                            stuck=stuck, **self.stats())
+        if stuck:
+            raise MXNetError(
+                f"serving worker did not stop within {timeout_s:g}s "
+                "(device wedged mid-batch? see the journal)")
+        self._worker = None
+
+    # -- client surface ------------------------------------------------------
+    def submit(self, x, deadline_ms=None) -> PendingResponse:
+        """Admit one sample (NO batch axis).  Raises
+        :class:`RequestError` for a shape outside the bucket grid and
+        :class:`ServerOverloaded` when the bounded queue is full."""
+        payload = np.asarray(x, dtype=self._dtype)
+        key = self.grid.feature_key(payload.shape)
+        if key is None:
+            with self._lock:
+                self.counters["rejected_shape"] += 1
+            get_journal().event("serving_reject", shape=list(payload.shape),
+                                grid=repr(self.grid))
+            raise RequestError(
+                f"request shape {tuple(payload.shape)} exceeds the bucket "
+                f"grid {self.grid!r} — oversized inputs are rejected, "
+                "never compiled")
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        deadline_s = None if deadline_ms is None or deadline_ms <= 0 \
+            else deadline_ms / 1000.0
+        req = Request(payload, payload.shape, key, deadline_s=deadline_s)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            with self._lock:
+                self.counters["shed"] += 1
+            get_journal().event("serving_shed", depth=self._queue.qsize(),
+                                limit=self.config.max_queue)
+            raise ServerOverloaded(self._queue.qsize(),
+                                   self.config.max_queue) from None
+        with self._lock:
+            self.counters["accepted"] += 1
+        return PendingResponse(req, self.config.result_timeout_s)
+
+    def predict(self, x, deadline_ms=None, timeout_s=None):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(x, deadline_ms=deadline_ms).result(timeout_s)
+
+    def stats(self) -> dict:
+        with self._lock:
+            counters = dict(self.counters)
+        return {"queue_depth": self._queue.qsize(),
+                "params_step": self._params_step,
+                "cache": self.cache.stats(),
+                "latency_ms": self.latency.summary(),
+                **counters}
+
+    # -- worker --------------------------------------------------------------
+    def _run(self):
+        j = get_journal()
+        pending, draining = [], False
+        try:
+            while True:
+                if self._stopping.is_set():
+                    break
+                if not pending:
+                    try:
+                        item = self._queue.get(
+                            timeout=self.config.idle_poll_s)
+                    except queue.Empty:
+                        self._maybe_reload()
+                        continue
+                    if item is _STOP:
+                        draining = True
+                        break
+                    pending.append(item)
+                # coalescing window: absorb same-cycle arrivals
+                t_end = time.monotonic() + self.config.window_ms / 1000.0
+                while len(pending) < self.grid.max_batch:
+                    remaining = t_end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        item = self._queue.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    if item is _STOP:
+                        draining = True
+                        break
+                    pending.append(item)
+                self._flush(pending)
+                self._maybe_reload()
+                if draining:
+                    break
+        except BaseException as exc:        # worker must die loudly
+            j.crash(exc, where="serving_worker")
+            raise
+        finally:
+            if draining and not self._stopping.is_set():
+                while True:                 # bounded: queue admits no more
+                    try:
+                        item = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is not _STOP:
+                        pending.append(item)
+                while pending:
+                    self._flush(pending)
+            self._fail_remaining(pending)
+
+    def _flush(self, pending):
+        """Expire, group, and run one micro-batch off ``pending``."""
+        drop_expired(pending, self._on_dequeue_expired)
+        batch, bucket, key = take_batch(pending, self.grid)
+        if batch:
+            self._process(batch, bucket, key)
+
+    def _on_dequeue_expired(self, req):
+        late = req.late_ms()
+        with self._lock:
+            self.counters["deadline_miss_dequeue"] += 1
+        get_journal().event("serving_deadline_miss", stage="dequeue",
+                            late_ms=round(late, 2))
+        req.set_error(DeadlineExceeded("dequeue", late))
+
+    def _fail_remaining(self, pending):
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                pending.append(item)
+        for req in pending:
+            req.set_error(RequestError("server stopped before this "
+                                       "request was served"))
+        pending.clear()
+
+    def _process(self, batch, bucket, key):
+        cfg = self.config
+        n = len(batch)
+        padded = np.full((bucket,) + key, cfg.pad_value, dtype=self._dtype)
+        for i, req in enumerate(batch):
+            padded[(i,) + tuple(slice(0, d) for d in req.shape)] = req.payload
+        cache_key = (bucket, key, self._dtype.str)
+        predictor, hit = self.cache.get(
+            cache_key, lambda: CompiledPredictor(self.block, ctx=self._ctx))
+        t0 = time.perf_counter()
+        try:
+            outs, treedef = retry_call(
+                predictor, padded, retries=cfg.device_retries,
+                retry_on=cfg.transient_errors, what="serving_predict")
+            outs = [np.asarray(o) for o in outs]
+        except Exception as exc:
+            with self._lock:
+                self.counters["errors"] += n
+            get_journal().crash(exc, where="serving_predict",
+                                batch=n, bucket=bucket)
+            err = RequestError(f"predictor failed: "
+                               f"{type(exc).__name__}: {exc}")
+            for req in batch:
+                req.set_error(err)
+            return
+        exec_ms = (time.perf_counter() - t0) * 1000.0
+
+        import jax
+        now = time.monotonic()
+        delivered = 0
+        for i, req in enumerate(batch):
+            if req.expired(now):
+                late = req.late_ms(now)
+                with self._lock:
+                    self.counters["deadline_miss_post_batch"] += 1
+                get_journal().event("serving_deadline_miss",
+                                    stage="post_batch",
+                                    late_ms=round(late, 2))
+                req.set_error(DeadlineExceeded("post_batch", late), now)
+                continue
+            rows = []
+            for o in outs:
+                row = o[i] if o.ndim >= 1 and o.shape[0] == bucket else o
+                if cfg.crop_outputs and row.shape == key \
+                        and req.shape != key:
+                    row = row[tuple(slice(0, d) for d in req.shape)]
+                rows.append(row)
+            result = rows[0] if treedef is None else \
+                jax.tree_util.tree_unflatten(treedef, rows)
+            req.set_result(result, now)
+            delivered += 1
+            self.latency.observe((now - req.enq_t) * 1000.0)
+        with self._lock:
+            self.counters["served"] += delivered
+            self.counters["batches"] += 1
+        lat = self.latency.summary()
+        cache_st = self.cache.stats()      # one snapshot: consistent trio
+        get_journal().event(
+            "serving_batch", queue_depth=self._queue.qsize(), batch=n,
+            delivered=delivered, bucket=bucket, fill=round(n / bucket, 4),
+            pad_waste=BucketGrid.pad_waste(
+                n, bucket, [r.shape for r in batch], key),
+            cache_hit=hit, exec_ms=round(exec_ms, 2),
+            params_step=self._params_step,
+            hits=cache_st["hits"], misses=cache_st["misses"],
+            evictions=cache_st["evictions"],
+            p50_ms=lat["p50"], p95_ms=lat["p95"], p99_ms=lat["p99"])
+
+    # -- hot-reload ----------------------------------------------------------
+    def _check_reloadable(self, loaded):
+        """Shape-check every entry against the live parameters up front
+        (arg:/aux: prefixes normalized like ``load_dict``)."""
+        params = self.block._structural_names()
+        norm = {(k.partition(":")[2] if k.partition(":")[0] in
+                 ("arg", "aux") and ":" in k else k): v
+                for k, v in loaded.items()}
+        for key, param in params.items():
+            if key not in norm:
+                raise MXNetError(f"checkpoint missing parameter {key!r}")
+            got = tuple(norm[key].shape)
+            if param.shape and tuple(param.shape) != got:
+                raise MXNetError(
+                    f"checkpoint parameter {key!r} is {got}, live "
+                    f"parameter is {tuple(param.shape)} — architecture "
+                    "drift; not hot-reloadable")
+
+    def _maybe_reload(self, force=False):
+        store = self.param_store
+        if store is None:
+            return False
+        poll_s = self.config.reload_poll_s
+        if poll_s < 0 and not force:
+            return False
+        now = time.monotonic()
+        if not force and self._last_reload_check is not None and \
+                now - self._last_reload_check < poll_s:
+            return False
+        self._last_reload_check = now
+        got = store.poll()
+        if got is None:
+            return False
+        step, loaded = got
+        prev = self._params_step
+        loaded = {k: v for k, v in loaded.items() if not k.startswith("__")}
+        try:
+            # validate the WHOLE dict against the live parameter shapes
+            # before touching any of them — a validated-but-inapplicable
+            # checkpoint (architecture drift) must never half-apply
+            self._check_reloadable(loaded)
+            self.block.load_dict(loaded, ctx=self._ctx, ignore_extra=True)
+        except MXNetError as e:
+            store.mark_bad(step, revert_to=prev)
+            get_journal().event("serving_reload_failed", step=step,
+                                error=type(e).__name__, detail=str(e)[:300])
+            return False
+        self._params_step = step
+        with self._lock:
+            self.counters["reloads"] += 1
+        get_journal().event("serving_reload", step=step,
+                            n_params=len(loaded), prev_step=prev)
+        return True

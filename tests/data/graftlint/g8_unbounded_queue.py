@@ -1,0 +1,70 @@
+# graftlint: scope=library
+"""G8 fixture: unbounded queues and undeadlined get/join (the in-process
+rc:124 class — docs/serving.md admission contract). Parsed only, never
+executed."""
+import queue
+import threading
+from queue import Queue
+
+
+def make_unbounded():
+    q = queue.Queue()  # expect: G8
+    lifo = queue.LifoQueue(0)  # expect: G8
+    pri = queue.PriorityQueue(maxsize=-1)  # expect: G8
+    simple = queue.SimpleQueue()  # expect: G8
+    aliased = Queue()  # expect: G8
+    return q, lifo, pri, simple, aliased
+
+
+def make_bounded(depth):
+    ok1 = queue.Queue(maxsize=8)
+    ok2 = queue.Queue(depth)          # non-constant: trusted
+    ok3 = queue.PriorityQueue(maxsize=depth)
+    return ok1, ok2, ok3
+
+
+def blocking_consumer():
+    q = queue.Queue(maxsize=4)
+    q.get()  # expect: G8
+    q.join()  # expect: G8
+    t = threading.Thread(target=blocking_consumer)
+    t.join()  # expect: G8
+    return q, t
+
+
+def bounded_consumer():
+    q = queue.Queue(maxsize=4)
+    q.get(timeout=1.0)                # deadlined: silent
+    q.get(True, 5)                    # positional timeout: silent
+    q.get(block=False)                # non-blocking: silent
+    q.get_nowait()                    # non-blocking: silent
+    t = threading.Thread(target=bounded_consumer)
+    t.join(timeout=5)                 # deadlined: silent
+    t.join(5)                         # positional deadline: silent
+    return q, t
+
+
+class Holder:
+    def __init__(self):
+        self._q = queue.Queue()  # expect: G8
+        self._t = threading.Thread(target=self.drain)
+
+    def drain(self):
+        self._q.get()  # expect: G8
+        self._t.join()  # expect: G8
+
+    def drain_bounded(self):
+        self._q.get(timeout=0.5)
+        self._t.join(timeout=0.5)
+
+
+def not_a_queue(mapping, other):
+    mapping.get("key")                # dict.get: silent (untracked recv)
+    other.join()                      # untracked receiver: silent
+
+
+def suppressed():
+    # staging queue is drained synchronously right below
+    q = queue.Queue()  # graftlint: disable=G8 drained before return
+    q.get()  # graftlint: disable=G8 producer completed above
+    return q

@@ -358,7 +358,7 @@ def test_cli_rules_filter_and_errors():
     assert out.returncode == 2 and "unknown rule" in out.stderr
     out = _cli(["--list-rules"])
     assert out.returncode == 0
-    for code in ["G1", "G2", "G3", "G4", "G5", "G6", "G7",
+    for code in ["G1", "G2", "G3", "G4", "G5", "G6", "G7", "G8",
                  "E1", "W1", "W2", "W3", "W4", "W5", "W6"]:
         assert code in out.stdout
 
@@ -437,6 +437,29 @@ def test_g7_sanctioned_atomic_path_is_clean():
     findings, n = core.run(["mxnet_tpu/resilience"],
                            rules=_rules(["G7"]), root=REPO)
     assert n >= 4 and findings == []
+
+
+def test_g8_serving_subsystem_is_clean():
+    """The rule's raison d'etre: the serving subsystem — all stdlib
+    threads + queues — must itself satisfy the bounded-queue /
+    deadlined-wait discipline (bounded admission queue, timeout= on
+    every get, deadlined thread joins)."""
+    findings, n = core.run(["mxnet_tpu/serving"],
+                           rules=_rules(["G8"]), root=REPO)
+    assert n >= 6 and findings == []
+
+
+def test_g8_tracks_receivers_not_names():
+    """dict.get() and untracked .join() receivers stay silent; only
+    names bound to queue/thread constructions are held to the
+    timeout discipline (no false positives on mappings)."""
+    path = os.path.join(FIXTURES, "g8_unbounded_queue.py")
+    got = core.lint_file(path, rules=_rules(["G8"]), root=REPO)
+    flagged_lines = {f.line for f in got}
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            if "dict.get: silent" in line or "untracked receiver" in line:
+                assert i not in flagged_lines, line
 
 
 def test_waitall_journals_instead_of_swallowing(monkeypatch, tmp_path):

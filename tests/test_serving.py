@@ -1,0 +1,702 @@
+"""mxnet_tpu.serving — the dynamic-batching inference subsystem.
+
+Covers the acceptance criteria of the serving story (docs/serving.md):
+bounded compiles under mixed-shape traffic (bucket grid + predictor
+cache), explicit load-shedding under a flooded queue, deadlines honored
+at dequeue and post-batch, transient-device retry, hot-reload from the
+newest *valid* committed checkpoint step with a chaos-injected torn
+checkpoint falling back cleanly (zero corrupted responses), legacy
+flag-0 ``.params`` hot-reload parity, the journal/doctor reporting
+surface, and the stdlib building blocks (BucketGrid, batcher,
+PredictorCache LRU, metric.LatencySummary).
+
+The ``smoke`` tests run in CI tier 0.5 (ci/run_tests.sh); the soak and
+subprocess CLI tests are marked ``slow``.
+"""
+import json
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.diagnostics.journal import reset_journal
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.metric import LatencySummary
+from mxnet_tpu.resilience import commit
+from mxnet_tpu.serving import (BucketGrid, DeadlineExceeded, ParamStore,
+                               PredictorCache, RequestError, Server,
+                               ServerConfig, ServerOverloaded,
+                               serving_report)
+from mxnet_tpu.serving.batcher import Request, drop_expired, take_batch
+from mxnet_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    """Route the process journal to a file for the duration (serving
+    records are asserted against it), restoring stderr after."""
+    path = str(tmp_path / "journal.jsonl")
+    reset_journal(path)
+    try:
+        yield path
+    finally:
+        reset_journal("stderr")
+
+
+def _records(path, kind=None):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+class Scale(HybridBlock):
+    """y = x * w with a scalar weight — shape-agnostic, so one block
+    serves every bucket; padding-exact (pad rows/dims come back as
+    pad * w and are cropped)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.w = self.params.get("w", shape=(1,), init="ones")
+
+    def hybrid_forward(self, F, x, w):
+        return x * w
+
+
+class Gated(HybridBlock):
+    """Blocks its (host-side) trace until the test releases the gate —
+    the deterministic stand-in for a slow compile / slow device."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+
+    def hybrid_forward(self, F, x):
+        self.entered.set()
+        assert self.gate.wait(timeout=60), "test never released the gate"
+        return x * 2.0
+
+
+def _commit_scale(root, step, value, fname="net.params"):
+    stage = commit.prepare_stage(root, step)
+    nd.save(os.path.join(stage, fname),
+            {"w": nd.array(np.asarray([value], np.float32))})
+    return commit.finalize(root, step)
+
+
+# -- stdlib building blocks --------------------------------------------------
+
+def test_bucket_grid_rounding_reject_and_bound():
+    g = BucketGrid(max_batch=8, dim_buckets={0: (4, 8, 12)})
+    assert g.batch_buckets == (1, 2, 4, 8)
+    assert g.batch_bucket(3) == 4 and g.batch_bucket(8) == 8
+    assert g.batch_bucket(9) is None
+    assert g.feature_key((3,)) == (4,)
+    assert g.feature_key((12,)) == (12,)
+    assert g.feature_key((13,)) is None          # oversized: reject
+    assert g.feature_key((5, 7)) == (8, 7)       # axis 1 unbucketed
+    assert g.grid_bound() == 4 * 3
+    waste = BucketGrid.pad_waste(1, 4, [(4,)], (4,))
+    assert waste == 0.75                          # 3 of 4 rows are pad
+
+
+def test_bucket_grid_validation():
+    with pytest.raises(ValueError):
+        BucketGrid(batch_buckets=(0, 2))
+    with pytest.raises(ValueError):
+        BucketGrid(dim_buckets={0: ()})
+
+
+def test_take_batch_groups_by_key_fifo():
+    g = BucketGrid(max_batch=2)
+    reqs = [Request(None, (4,), (4,)), Request(None, (8,), (8,)),
+            Request(None, (3,), (4,)), Request(None, (2,), (4,))]
+    pending = list(reqs)
+    batch, bucket, key = take_batch(pending, g)
+    assert batch == [reqs[0], reqs[2]] and bucket == 2 and key == (4,)
+    assert pending == [reqs[1], reqs[3]]          # FIFO preserved
+    batch, bucket, key = take_batch(pending, g)
+    assert batch == [reqs[1]] and bucket == 1 and key == (8,)
+
+
+def test_drop_expired_reports_and_keeps_order():
+    fresh = Request(None, (4,), (4,), deadline_s=100)
+    stale = Request(None, (4,), (4,), deadline_s=0.0001)
+    time.sleep(0.01)
+    dropped = []
+    pending = [stale, fresh]
+    drop_expired(pending, dropped.append)
+    assert pending == [fresh] and dropped == [stale]
+
+
+def test_latency_summary_exact_and_bounded():
+    s = LatencySummary(reservoir_size=64)
+    for v in range(1, 101):
+        s.observe(float(v))
+    out = s.summary()
+    assert out["count"] == 100 and out["min"] == 1.0 and out["max"] == 100.0
+    assert out["mean"] == 50.5
+    assert len(s._buf) == 64                      # bounded reservoir
+    # exact percentiles when the stream fits the reservoir
+    s2 = LatencySummary(reservoir_size=1000)
+    for v in range(1, 101):
+        s2.observe(float(v))
+    assert s2.percentile(50) == 50.0
+    assert s2.percentile(95) == 95.0
+    assert s2.percentile(99) == 99.0
+    empty = LatencySummary().summary()
+    assert empty["count"] == 0 and empty["p99"] is None
+
+
+def test_predictor_cache_lru_bound_and_counters():
+    cache = PredictorCache(max_entries=2)
+    built = []
+    for key in ("a", "b", "a", "c", "a"):
+        cache.get(key, lambda k=key: built.append(k) or k)
+    st = cache.stats()
+    # a,b,c built once each ('a' stays hot); 'b' evicted by 'c'
+    assert built == ["a", "b", "c"]
+    assert st["misses"] == 3 and st["hits"] == 2 and st["evictions"] == 1
+    assert len(cache) == 2
+
+
+# -- the serving smoke (CI tier 0.5) -----------------------------------------
+
+def test_serving_smoke_50_requests_reject_and_clean_shutdown(journal_file):
+    """50 mixed requests through a real server thread, one oversized-
+    shape reject, compile count within the grid bound, clean drain."""
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    cfg = ServerConfig(max_batch=4, window_ms=2.0, max_queue=64,
+                       dim_buckets={0: (4,)})
+    server = Server(net, config=cfg).start()
+    try:
+        with pytest.raises(RequestError, match="exceeds the bucket grid"):
+            server.submit(np.zeros(9, np.float32))   # oversized: reject
+
+        xs = [np.random.randn(4).astype(np.float32) for _ in range(50)]
+        resps = {}
+
+        def client(lo, hi):
+            for i in range(lo, hi):
+                resps[i] = server.submit(xs[i])
+
+        threads = [threading.Thread(target=client, args=(lo, lo + 10))
+                   for lo in range(0, 50, 10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        for i in range(50):
+            got = np.asarray(resps[i].result(timeout_s=30))
+            np.testing.assert_allclose(got, xs[i] @ w.T + b, atol=1e-5)
+    finally:
+        server.stop(timeout_s=30)
+    st = server.stats()
+    assert st["served"] == 50 and st["rejected_shape"] == 1
+    assert st["cache"]["misses"] <= server.grid.grid_bound() == 3
+    assert not server._worker                     # joined and cleared
+    kinds = {r["kind"] for r in _records(journal_file)}
+    assert {"serving_start", "serving_batch", "serving_reject",
+            "serving_stop"} <= kinds
+
+
+def test_serving_smoke_compile_count_bounded_100_mixed_shapes(journal_file):
+    """The tentpole bound: 100 requests over 12 distinct feature shapes
+    and mixed coalescing — compiles (cache misses) never exceed the
+    bucket-grid size."""
+    net = Scale()
+    net.initialize()
+    cfg = ServerConfig(max_batch=4, window_ms=1.0, max_queue=256,
+                       dim_buckets={0: (4, 8, 12)})
+    server = Server(net, config=cfg).start()
+    try:
+        resps = []
+        for i in range(100):
+            d = (i % 12) + 1
+            x = np.arange(d, dtype=np.float32)
+            resps.append((x, server.submit(x)))
+        for x, r in resps:
+            got = np.asarray(r.result(timeout_s=30))
+            np.testing.assert_allclose(got, x, atol=1e-6)  # w == 1, cropped
+    finally:
+        server.stop(timeout_s=30)
+    st = server.stats()
+    assert st["served"] == 100
+    assert st["cache"]["misses"] <= server.grid.grid_bound() == 9
+    fills = [r["fill"] for r in _records(journal_file, "serving_batch")]
+    assert fills and all(0 < f <= 1 for f in fills)
+
+
+# -- backpressure + deadlines ------------------------------------------------
+
+def test_flooded_queue_sheds_with_server_overloaded(journal_file):
+    """While the device is busy (gated build), the bounded queue fills
+    and the NEXT submit sheds immediately — bounded latency, explicit
+    signal, and the server recovers once the device frees up."""
+    net = Gated()
+    cfg = ServerConfig(max_batch=1, window_ms=1.0, max_queue=4)
+    server = Server(net, config=cfg).start()
+    try:
+        first = server.submit(np.ones(4, np.float32))
+        assert net.entered.wait(timeout=30)       # worker wedged in build
+        backlog = [server.submit(np.ones(4, np.float32))
+                   for _ in range(4)]             # fills the bounded queue
+        with pytest.raises(ServerOverloaded):
+            server.submit(np.ones(4, np.float32))
+        assert server.stats()["shed"] == 1
+    finally:
+        net.gate.set()
+        server.stop(timeout_s=30)
+    for r in [first] + backlog:
+        np.testing.assert_allclose(np.asarray(r.result(timeout_s=30)),
+                                   np.ones(4) * 2.0)
+    shed = _records(journal_file, "serving_shed")
+    assert len(shed) == 1 and shed[0]["limit"] == 4
+
+
+def test_deadline_honored_at_dequeue(journal_file):
+    """A request whose deadline passed while queued is dropped at
+    dequeue — it must not waste a batch slot."""
+    net = Gated()
+    cfg = ServerConfig(max_batch=1, window_ms=1.0, max_queue=8)
+    server = Server(net, config=cfg).start()
+    try:
+        first = server.submit(np.ones(2, np.float32))     # wedges worker
+        assert net.entered.wait(timeout=30)
+        doomed = server.submit(np.ones(2, np.float32), deadline_ms=30)
+        time.sleep(0.1)                                   # deadline passes
+        net.gate.set()
+        np.testing.assert_allclose(np.asarray(first.result(timeout_s=30)),
+                                   np.ones(2) * 2.0)
+        with pytest.raises(DeadlineExceeded) as exc:
+            doomed.result(timeout_s=30)
+        assert exc.value.stage == "dequeue"
+    finally:
+        net.gate.set()
+        server.stop(timeout_s=30)
+    assert server.stats()["deadline_miss_dequeue"] == 1
+    recs = _records(journal_file, "serving_deadline_miss")
+    assert recs and recs[0]["stage"] == "dequeue"
+
+
+def test_deadline_honored_post_batch(journal_file):
+    """A request that was fresh at dequeue but missed its deadline while
+    the batch executed gets a post_batch DeadlineExceeded, not a
+    silently-late success."""
+    net = Gated()
+    cfg = ServerConfig(max_batch=1, window_ms=1.0, max_queue=8)
+    server = Server(net, config=cfg).start()
+    try:
+        resp = server.submit(np.ones(2, np.float32), deadline_ms=80)
+        assert net.entered.wait(timeout=30)       # in-batch, pre-deadline
+        time.sleep(0.2)                           # deadline passes mid-exec
+        net.gate.set()
+        with pytest.raises(DeadlineExceeded) as exc:
+            resp.result(timeout_s=30)
+        assert exc.value.stage == "post_batch"
+    finally:
+        net.gate.set()
+        server.stop(timeout_s=30)
+    assert server.stats()["deadline_miss_post_batch"] == 1
+
+
+def test_transient_device_error_retried_then_fatal_is_structured():
+    """OSError-class predictor failures ride resilience.retry; a
+    non-transient failure fails the batch with a structured error and
+    the server keeps serving."""
+    net = Scale()
+    net.initialize()
+    cfg = ServerConfig(max_batch=2, window_ms=1.0, max_queue=8,
+                       device_retries=2)
+    server = Server(net, config=cfg).start()
+    try:
+        x = np.ones(3, np.float32)
+        np.testing.assert_allclose(np.asarray(server.predict(x)), x)
+
+        key = next(iter(server.cache._lru))
+        real = server.cache._lru[key]
+        calls = {"n": 0}
+
+        class Flaky:
+            def __call__(self, padded):
+                calls["n"] += 1
+                if calls["n"] <= 2:
+                    raise OSError(5, "injected transient EIO")
+                return real(padded)
+        server.cache._lru[key] = Flaky()
+        np.testing.assert_allclose(np.asarray(server.predict(x)), x)
+        assert calls["n"] == 3                    # 2 transient + 1 success
+
+        class Broken:
+            def __call__(self, padded):
+                raise ValueError("not transient")
+        server.cache._lru[key] = Broken()
+        with pytest.raises(RequestError, match="predictor failed"):
+            server.predict(x)
+        server.cache._lru[key] = real             # server still alive
+        np.testing.assert_allclose(np.asarray(server.predict(x)), x)
+    finally:
+        server.stop(timeout_s=30)
+    assert server.stats()["errors"] == 1
+
+
+# -- predictor-cache keying ---------------------------------------------------
+
+def test_cache_keying_same_bucket_reuses_one_executable():
+    """Two requests whose shapes fall in the same bucket must reuse ONE
+    executable — proven via the cache counters."""
+    net = Scale()
+    net.initialize()
+    cfg = ServerConfig(max_batch=1, window_ms=1.0,
+                       dim_buckets={0: (4, 8)})
+    server = Server(net, config=cfg).start()
+    try:
+        a = np.arange(3, dtype=np.float32)
+        b = np.arange(4, dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(server.predict(a)), a)
+        np.testing.assert_allclose(np.asarray(server.predict(b)), b)
+    finally:
+        server.stop(timeout_s=30)
+    st = server.stats()["cache"]
+    assert st["misses"] == 1 and st["hits"] == 1   # one compile, reused
+
+
+# -- hot-reload ---------------------------------------------------------------
+
+def test_hot_reload_mid_traffic_torn_checkpoint_falls_back(tmp_path,
+                                                           journal_file):
+    """The acceptance drill: traffic flows while a producer commits a
+    torn checkpoint (chaos crash at the publish rename — the SIGTERM'd
+    writer shape) and a committed-but-corrupt step; the server stays on
+    the previous valid step with ZERO corrupted responses, then lands on
+    the next valid step without draining."""
+    root = str(tmp_path / "ckpt")
+    _commit_scale(root, 1, 2.0)
+    net = Scale()
+    net.initialize()
+    cfg = ServerConfig(max_batch=4, window_ms=1.0, max_queue=64,
+                       reload_poll_s=0.0)
+    server = Server(net, config=cfg, param_store=ParamStore(root)).start()
+    x = np.ones(4, np.float32)
+    seen, bad, stop = [], [], threading.Event()
+
+    def client():
+        while not stop.is_set():
+            v = float(np.asarray(server.predict(x))[0])
+            seen.append(v)
+            if abs(v - 2.0) > 1e-6 and abs(v - 5.0) > 1e-6:
+                bad.append(v)
+            time.sleep(0.002)
+
+    t = threading.Thread(target=client, daemon=True)
+    try:
+        assert server.stats()["params_step"] == 1
+        t.start()
+        # torn commit: the writer dies at the publish rename
+        with faults.inject(faults.crash("publish")):
+            with pytest.raises(faults.SimulatedCrash):
+                _commit_scale(root, 2, 999.0)
+        # committed-but-corrupt: bytes flipped between manifest and the
+        # publish rename, so the step is NEVER visible in a valid state
+        stage = commit.prepare_stage(root, 3)
+        p = os.path.join(stage, "net.params")
+        nd.save(p, {"w": nd.array(np.asarray([999.0], np.float32))})
+        commit.write_manifest(stage, 3)
+        raw = bytearray(open(p, "rb").read())
+        raw[40] ^= 0xFF
+        with open(p, "wb") as f:
+            f.write(bytes(raw))
+        os.rename(stage, commit.step_dir(root, 3))
+        time.sleep(0.3)
+        assert server.stats()["params_step"] == 1    # held the line
+        _commit_scale(root, 4, 5.0)                  # next valid step
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                server.stats()["params_step"] != 4:
+            time.sleep(0.02)
+        assert server.stats()["params_step"] == 4
+        time.sleep(0.1)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        server.stop(timeout_s=30)
+    assert not bad, f"corrupted responses: {bad[:5]}"
+    assert 2.0 in seen and 5.0 in seen               # both versions served
+    fallbacks = _records(journal_file, "ckpt_fallback")
+    assert {r["step"] for r in fallbacks} == {3}     # step 2 never visible
+    reloads = _records(journal_file, "serving_reload")
+    assert [r["step"] for r in reloads] == [1, 4]
+
+
+def _write_legacy_params(path, name, arr):
+    """Reference-era flag-0 container: no CRCs, no footer (the layout
+    tests/test_checkpoint_atomic.py proves nd.load still accepts)."""
+    from mxnet_tpu.ndarray.ndarray import _LIST_MAGIC, _ND_MAGIC
+    with open(path, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", 1))
+        f.write(struct.pack("<I", _ND_MAGIC))
+        f.write(struct.pack("<I", arr.ndim))
+        for s in arr.shape:
+            f.write(struct.pack("<q", s))
+        f.write(struct.pack("<ii", 1, 0))
+        f.write(struct.pack("<i", 0))                # float32
+        f.write(arr.tobytes())
+        f.write(struct.pack("<Q", 1))
+        b = name.encode()
+        f.write(struct.pack("<Q", len(b)))
+        f.write(b)
+
+
+def test_legacy_flag0_params_hot_reload_identical_to_v3(tmp_path):
+    """A legacy flag-0 .params checkpoint must hot-reload bit-identically
+    to the v3 (CRC) container holding the same weights."""
+    w = np.asarray([7.0], np.float32)
+    x = np.arange(4, dtype=np.float32)
+    outs = {}
+    for fmt in ("v3", "legacy"):
+        root = str(tmp_path / f"root_{fmt}")
+        stage = commit.prepare_stage(root, 1)
+        path = os.path.join(stage, "net.params")
+        if fmt == "v3":
+            nd.save(path, {"w": nd.array(w)})
+        else:
+            _write_legacy_params(path, "w", w)
+        commit.finalize(root, 1)
+        net = Scale()
+        net.initialize()
+        cfg = ServerConfig(max_batch=1, window_ms=1.0, reload_poll_s=0.0)
+        server = Server(net, config=cfg,
+                        param_store=ParamStore(root)).start()
+        try:
+            assert server.stats()["params_step"] == 1
+            outs[fmt] = np.asarray(server.predict(x))
+        finally:
+            server.stop(timeout_s=30)
+    np.testing.assert_array_equal(outs["v3"], outs["legacy"])
+    np.testing.assert_allclose(outs["v3"], x * 7.0)
+
+
+def test_reload_rejects_architecture_drift(tmp_path, journal_file):
+    """A valid checkpoint whose shapes don't match the live block is
+    refused atomically (no half-applied swap) and journaled."""
+    root = str(tmp_path / "ckpt")
+    stage = commit.prepare_stage(root, 1)
+    nd.save(os.path.join(stage, "net.params"),
+            {"w": nd.array(np.zeros((2, 2), np.float32))})
+    commit.finalize(root, 1)
+    net = Scale()
+    net.initialize()
+    cfg = ServerConfig(max_batch=1, window_ms=1.0, reload_poll_s=0.0)
+    server = Server(net, config=cfg, param_store=ParamStore(root)).start()
+    try:
+        assert server.stats()["params_step"] is None
+        x = np.ones(3, np.float32)
+        np.testing.assert_allclose(np.asarray(server.predict(x)), x)
+    finally:
+        server.stop(timeout_s=30)
+    recs = _records(journal_file, "serving_reload_failed")
+    assert recs and recs[0]["step"] == 1
+
+
+# -- reporting surface --------------------------------------------------------
+
+def test_serving_report_summarizes_last_run(tmp_path, journal_file):
+    net = Scale()
+    net.initialize()
+    cfg = ServerConfig(max_batch=2, window_ms=1.0, max_queue=2,
+                       dim_buckets={0: (4,)})
+    server = Server(net, config=cfg).start()
+    try:
+        for _ in range(6):
+            server.predict(np.ones(4, np.float32))
+        with pytest.raises(RequestError):
+            server.submit(np.zeros(9, np.float32))    # reject record
+    finally:
+        server.stop(timeout_s=30)
+    rep = serving_report(journal_file)
+    assert rep["ok"] and rep["served"] == 6
+    assert rep["batches"] >= 1 and rep["shed"] == 0
+    assert rep["shed_rate"] == 0.0
+    assert rep["rejected_shape"] == 1
+    assert rep["compiles"] >= 1
+    assert rep["cache_hit_rate"] is not None
+    assert rep["deadline_miss_total"] == 0
+    assert rep["clean_stop"] is True
+    assert rep["last_batch"]["p50_ms"] is not None
+
+
+def test_serving_report_excludes_post_batch_misses_from_served(tmp_path):
+    """`served` counts delivered responses only: a post_batch deadline
+    miss is inside the batch but got an error, and shed_rate is over
+    everything offered."""
+    path = str(tmp_path / "j.jsonl")
+    recs = [
+        {"kind": "serving_start"},
+        {"kind": "serving_batch", "batch": 3, "delivered": 2,
+         "fill": 0.75, "hits": 1, "misses": 1},
+        {"kind": "serving_deadline_miss", "stage": "post_batch"},
+        {"kind": "serving_deadline_miss", "stage": "dequeue"},
+        {"kind": "serving_shed"},
+        {"kind": "serving_stop", "stuck": False},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    rep = serving_report(path)
+    assert rep["served"] == 2
+    assert rep["deadline_miss"] == {"dequeue": 1, "post_batch": 1}
+    # offered = batch(3) + dequeue miss(1) + shed(1) = 5
+    assert rep["shed_rate"] == round(1 / 5, 4)
+    assert rep["clean_stop"] is True
+
+
+def test_load_dict_handles_bare_arg_aux_named_params():
+    """A parameter literally named 'aux' must survive the arg:/aux:
+    prefix strip when mixed with prefixed keys (the hot-reload
+    no-half-apply contract depends on it)."""
+    class Odd(HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.w = self.params.get("w", shape=(1,), init="ones")
+                self.aux = self.params.get("aux", shape=(1,), init="ones")
+
+        def hybrid_forward(self, F, x, w, aux):
+            return x * w + aux
+
+    net = Odd()
+    net.initialize()
+    net.load_dict({"arg:w": nd.array(np.asarray([4.0], np.float32)),
+                   "aux": nd.array(np.asarray([9.0], np.float32))})
+    assert float(net.w.data().asnumpy()[0]) == 4.0
+    assert float(net.aux.data().asnumpy()[0]) == 9.0
+
+
+def test_serving_report_tolerates_junk_and_missing():
+    assert serving_report("/nonexistent/journal.jsonl")["ok"] is False
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        f.write("not json\n{\"kind\": \"heartbeat\"}\n{tor")
+        path = f.name
+    try:
+        rep = serving_report(path)
+        assert rep["ok"] is False and "no serving records" in rep["error"]
+    finally:
+        os.unlink(path)
+
+
+@pytest.mark.slow
+def test_doctor_cli_serving_journal_section(tmp_path, journal_file):
+    """End-to-end: a serving run's journal summarized by
+    ``python -m mxnet_tpu.diagnostics doctor --serving-journal``."""
+    import subprocess
+    import sys
+    net = Scale()
+    net.initialize()
+    server = Server(net, config=ServerConfig(max_batch=2,
+                                             window_ms=1.0)).start()
+    try:
+        for _ in range(4):
+            server.predict(np.ones(4, np.float32))
+    finally:
+        server.stop(timeout_s=30)
+    reset_journal("stderr")          # release the file for the child
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.diagnostics", "doctor",
+         "--serving-journal", journal_file, "--deadline", "120"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "MXNET_TPU_JOURNAL": "off"})
+    line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    rep = json.loads(line)["serving"]
+    assert rep["ok"] and rep["served"] == 4
+    assert "shed-rate" in out.stderr
+
+
+@pytest.mark.slow
+def test_bench_cli_emits_artifact(tmp_path):
+    """``python -m mxnet_tpu.serving bench`` drives the closed loop and
+    emits the one-JSON-line + BENCH_serving artifact contract."""
+    import subprocess
+    import sys
+    artifact = str(tmp_path / "BENCH_serving.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.serving", "bench",
+         "--seconds", "1", "--clients", "2", "--dim", "8",
+         "--out", artifact],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "MXNET_TPU_JOURNAL": "off"})
+    assert out.returncode == 0, out.stderr[-800:]
+    line = [l for l in out.stdout.splitlines()
+            if l.startswith("{") and '"metric"' in l][-1]
+    doc = json.loads(line)
+    assert doc["metric"] == "serving_requests_per_sec"
+    assert doc["value"] and doc["value"] > 0
+    assert doc["compile_bound_ok"] is True
+    assert doc["latency_ms"]["p99"] is not None
+    with open(artifact, encoding="utf-8") as f:
+        assert json.load(f)["metric"] == "serving_requests_per_sec"
+
+
+@pytest.mark.slow
+def test_serving_soak_sustained_mixed_load(journal_file):
+    """Longer soak: sustained mixed-shape closed-loop traffic; the
+    server neither leaks queue depth nor exceeds the compile bound, and
+    shuts down clean."""
+    net = Scale()
+    net.initialize()
+    cfg = ServerConfig(max_batch=8, window_ms=2.0, max_queue=64,
+                       dim_buckets={0: (4, 8)})
+    server = Server(net, config=cfg).start()
+    stop_at = time.monotonic() + 8.0
+    errors = []
+
+    def client(idx):
+        rng = np.random.default_rng(idx)
+        while time.monotonic() < stop_at:
+            d = int(rng.integers(1, 9))
+            x = rng.standard_normal(d).astype(np.float32)
+            try:
+                got = np.asarray(server.predict(x))
+                np.testing.assert_allclose(got, x, atol=1e-6)
+            except ServerOverloaded:
+                time.sleep(0.005)
+            except Exception as e:        # pragma: no cover - fail loudly
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    server.stop(timeout_s=30)
+    assert not errors, errors[:3]
+    st = server.stats()
+    assert st["served"] > 100
+    assert st["cache"]["misses"] <= server.grid.grid_bound()
+    assert st["queue_depth"] == 0
+    rep = serving_report(journal_file)
+    assert rep["ok"] and rep["clean_stop"]
