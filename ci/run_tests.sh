@@ -72,6 +72,13 @@ python -m pytest tests/test_crash_matrix.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: serving smoke (dynamic batcher) =="
 python -m pytest tests/test_serving.py -q -k smoke -p no:cacheprovider
 
+# guardrail chaos smoke: poison a batch (NaN) -> the fused guard skips
+# the step bitwise and journals it; a persistent-poison divergence drill
+# rolls back bit-exact to the last committed step — the run stays green
+# (docs/guardrails.md)
+echo "== tier 0.5: guardrail chaos smoke (anomaly skip + rollback) =="
+python -m pytest tests/test_guardrails.py -q -k smoke -p no:cacheprovider
+
 # quick unit tier: core ndarray/op/autograd/gluon/io surface, no
 # model-zoo or multi-process tests (ref: runtime_functions.sh unittest
 # vs nightly split)

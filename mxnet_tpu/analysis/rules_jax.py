@@ -751,6 +751,224 @@ class NonAtomicDurableWrite(Rule):
                     "os.replace)")
 
 
+# -- G9: host-synced finiteness checks in training-loop code -----------------
+
+# the modules that sit on the per-step hot path: a host-synced finiteness
+# check here costs a device→host round trip EVERY step (the defect class
+# the fused guard replaced — gluon/utils.py's old per-array asscalar()
+# loop and amp's per-step has_overflow pull)
+TRAINING_PATH_RE = re.compile(
+    r"(^|/)mxnet_tpu/(gluon/(trainer|utils)\.py|module/[^/]+\.py|"
+    r"parallel/[^/]+\.py|contrib/amp/[^/]+\.py|optimizer/[^/]+\.py)$")
+_SCOPE_TRAINING_RE = re.compile(r"#\s*graftlint:\s*scope=training\b")
+
+HOST_FINITENESS = {"numpy.isfinite", "numpy.isnan", "numpy.isinf"}
+DEVICE_FINITENESS = {"jax.numpy.isfinite", "jax.numpy.isnan",
+                     "jax.numpy.isinf"} | HOST_FINITENESS
+# identifiers that smell like per-step training values; float()/.item()/
+# .asscalar() over them in a training module is a per-step host sync
+GUARD_VALUE_RE = re.compile(r"grad|loss|norm|overflow|finite", re.I)
+HOST_PULL_ATTRS = ("item", "asscalar")
+SANCTIONED_FETCH = "host_fetch"     # guardrails.fused.host_fetch
+
+
+@register
+class HostSyncedFinitenessCheck(Rule):
+    code = "G9"
+    name = "host-synced-finiteness-check"
+    doc = ("Per-step host-synced finiteness check in training-loop "
+           "modules: np.isfinite/np.isnan over step values, or "
+           "float()/bool()/.item()/.asscalar() on gradient/loss/norm "
+           "values (including values derived from a device-side "
+           "isfinite). Each one is a device->host round trip per step "
+           "— and on multi-host, a per-rank early return out of a "
+           "collective. Use the fused in-program guard "
+           "(mxnet_tpu.guardrails.fused.guard_stats) and read its step "
+           "outputs through guardrails.fused.host_fetch. Scope: "
+           "training-loop library modules (gluon trainer/utils, "
+           "module/, parallel/, contrib/amp, optimizer/).")
+
+    def _in_scope(self, ctx) -> bool:
+        if TRAINING_PATH_RE.search("/" + ctx.path):
+            return True
+        return bool(_SCOPE_TRAINING_RE.search("\n".join(ctx.lines[:5])))
+
+    @staticmethod
+    def _sanctioned(node) -> bool:
+        """True when the expression routes through the one sanctioned
+        chokepoint (guardrails.fused.host_fetch) — the fetch is the
+        API, not an ad-hoc sync."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == SANCTIONED_FETCH:
+                return True
+        return False
+
+    @classmethod
+    def _assign_pairs(cls, targets, value):
+        """Decompose an assignment into (targets, value) taint units:
+        tuple unpacking propagates element-wise so in
+        `flag, n = jnp.isfinite(g).all(), step` only `flag` is dirtied
+        — tainting `n` too would flag a later benign `int(n)`.
+        Shape-mismatched or starred unpacking falls back to the whole
+        value (conservative)."""
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)) \
+                    and isinstance(value, (ast.Tuple, ast.List)) \
+                    and len(t.elts) == len(value.elts) \
+                    and not any(isinstance(e, ast.Starred)
+                                for e in t.elts):
+                for te, ve in zip(t.elts, value.elts):
+                    yield from cls._assign_pairs([te], ve)
+            else:
+                yield [t], value
+
+    @staticmethod
+    def _scope_map(tree):
+        """node → innermost enclosing function (None = module scope).
+        Name-set analysis must be per-scope: a `norm` blessed inside one
+        function must not exempt a different function's `norm`."""
+        scopes = {}
+
+        def visit(node, scope):
+            for child in ast.iter_child_nodes(node):
+                scopes[child] = scope
+                visit(child,
+                      child if isinstance(child, (ast.FunctionDef,
+                                                  ast.AsyncFunctionDef))
+                      else scope)
+
+        visit(tree, None)
+        return scopes
+
+    def _tainted_names(self, ctx, scopes):
+        """Per-scope name sets from a fixpoint over each scope's
+        assignments — returns ``{scope: (tainted, blessed)}``:
+
+        - **tainted** — assigned (transitively) from expressions
+          containing a finiteness call: `ok = jnp.all(jnp.isfinite(g))`
+          taints `ok`, `flag = ok` taints `flag`;
+        - **blessed** — assigned from expressions routing through the
+          sanctioned chokepoint: `norm = fused.host_fetch(norm_dev)[0]`
+          is already a host value, so a later `np.isfinite(norm)` /
+          `float(norm)` costs no device sync and must NOT be flagged
+          (it is the exact pattern this rule recommends). Blessing wins
+          over taint — `ok, gn = fused.host_fetch(finite, gnorm)`
+          blesses `ok` even though `finite` is tainted."""
+        per_scope: dict = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                pairs = self._assign_pairs(node.targets, node.value)
+            elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)) \
+                    and node.value is not None:
+                pairs = self._assign_pairs([node.target], node.value)
+            else:
+                continue
+            per_scope.setdefault(scopes.get(node), []).extend(pairs)
+        out = {}
+        for scope, assigns in per_scope.items():
+            taint: set[str] = set()
+            blessed: set[str] = set()
+            changed = True
+            while changed:
+                changed = False
+                for targets, value in assigns:
+                    if self._sanctioned(value):
+                        dest = blessed
+                    else:
+                        dirty = False
+                        for sub in ast.walk(value):
+                            if isinstance(sub, ast.Call) \
+                                    and ctx.resolve_call(sub) \
+                                    in DEVICE_FINITENESS:
+                                dirty = True
+                            elif isinstance(sub, ast.Name) \
+                                    and sub.id in taint \
+                                    and sub.id not in blessed:
+                                dirty = True
+                        if not dirty:
+                            continue
+                        dest = taint
+                    for t in targets:
+                        for sub in ast.walk(t):
+                            if isinstance(sub, ast.Name) \
+                                    and sub.id not in dest:
+                                dest.add(sub.id)
+                                changed = True
+            out[scope] = (taint, blessed)
+        return out
+
+    @staticmethod
+    def _matches_guard_value(node, taint) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and (
+                    GUARD_VALUE_RE.search(sub.id) or sub.id in taint):
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and GUARD_VALUE_RE.search(sub.attr):
+                return True
+        return False
+
+    @staticmethod
+    def _all_names_blessed(node, blessed) -> bool:
+        """Every Name in the expression is a host_fetch result (and
+        there is at least one): checking/converting it is host-local."""
+        names = [s.id for s in ast.walk(node)
+                 if isinstance(s, ast.Name)]
+        return bool(names) and all(n in blessed for n in names)
+
+    def check(self, ctx):
+        if not ctx.is_library() or not self._in_scope(ctx):
+            return
+        scopes = self._scope_map(ctx.tree)
+        per_scope = self._tainted_names(ctx, scopes)
+        empty: tuple = (frozenset(), frozenset())
+        # _sanctioned walks the whole call subtree — run it only on
+        # candidates that already matched the cheap name/taint checks,
+        # not on every Call in the file
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            taint, blessed = per_scope.get(scopes.get(node), empty)
+            name = ctx.resolve_call(node)
+            if name in HOST_FINITENESS:
+                if self._sanctioned(node) or (
+                        node.args and all(self._all_names_blessed(a,
+                                                                  blessed)
+                                          for a in node.args)):
+                    continue
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"host {name}() in a training-loop module — a "
+                    "device->host sync per step; fold the check into "
+                    "the compiled step (guardrails.fused.guard_stats)")
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in ("float", "bool",
+                                                          "int") \
+                    and len(node.args) == 1 \
+                    and self._matches_guard_value(node.args[0], taint) \
+                    and not self._all_names_blessed(node.args[0],
+                                                    blessed) \
+                    and not self._sanctioned(node):
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"{func.id}() host-syncs a per-step training value "
+                    "— return it from the compiled step and read it via "
+                    "guardrails.fused.host_fetch")
+            elif isinstance(func, ast.Attribute) \
+                    and func.attr in HOST_PULL_ATTRS \
+                    and self._matches_guard_value(func.value, taint) \
+                    and not self._all_names_blessed(func.value, blessed) \
+                    and not self._sanctioned(node):
+                yield self.finding(
+                    ctx, node.lineno,
+                    f".{func.attr}() host-syncs a per-step training "
+                    "value — use the fused guard's step outputs "
+                    "(guardrails.fused.host_fetch)")
+
+
 @register
 class SilentDeviceExceptionSwallow(Rule):
     code = "G6"

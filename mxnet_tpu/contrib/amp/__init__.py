@@ -128,7 +128,14 @@ def amp_dtype():
 
 class DynamicLossScaler:
     """ref: amp.py DynamicLossScaler — grow scale on stability, halve and
-    skip the step on overflow. bf16 does not need it; kept for fp16."""
+    skip the step on overflow. bf16 does not need it; kept for fp16.
+
+    The overflow signal now rides the fused guardrail flag
+    (docs/guardrails.md): the fused trainers return it as a step output
+    (zero extra host reads) and the eager Trainer checks gradients with
+    its own fused pass on both step() paths. ``has_overflow`` has no
+    in-repo callers anymore — it is kept, on the same fused chokepoint,
+    for external/back-compat callers only."""
 
     def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
                  scale_window=2000, tolerance=0.0):
@@ -139,17 +146,16 @@ class DynamicLossScaler:
 
     def has_overflow(self, params):
         """One fused device-side finiteness reduction over every gradient
-        of every replica, one host sync total — not a per-parameter
-        download (the tunnel costs ~90 ms per round-trip)."""
-        import jax.numpy as jnp
-        ok = None
-        for p in params:
-            for g in (getattr(p, "_grad", None) or ()):
-                if g is None:
-                    continue
-                fin = jnp.all(jnp.isfinite(g._data.astype(jnp.float32)))
-                ok = fin if ok is None else jnp.logical_and(ok, fin)
-        return False if ok is None else not bool(np.asarray(ok))
+        of every replica (guardrails.fused.guard_stats), one host sync
+        total — not a per-parameter download (the tunnel costs ~90 ms
+        per round-trip)."""
+        from ...guardrails import fused
+        grads = [g._data for p in params
+                 for g in (getattr(p, "_grad", None) or ()) if g is not None]
+        if not grads:
+            return False
+        finite, _ = fused.guard_stats(grads)
+        return not fused.host_fetch(finite)[0]
 
     def update_scale(self, overflow):
         if overflow:

@@ -118,6 +118,14 @@ def _serving_report(path: str) -> dict:
     return report.serving_report(path)
 
 
+def _guardrails_report(path: str) -> dict:
+    """Training-anomaly summary of a run's journal: skipped steps,
+    worst consecutive run, divergence rollbacks (guardrails.report is
+    stdlib-only, same contract as the checkpoint report)."""
+    from ..guardrails import report
+    return report.guard_report(path)
+
+
 def cmd_doctor(args) -> int:
     deadline = guard.probe_deadline_s(args.deadline)
     report = {"python": sys.version.split()[0],
@@ -127,6 +135,8 @@ def cmd_doctor(args) -> int:
         report["checkpoint"] = _checkpoint_report(args.ckpt_dir)
     if args.serving_journal:
         report["serving"] = _serving_report(args.serving_journal)
+    if args.journal:
+        report["guardrails"] = _guardrails_report(args.journal)
     print(f"doctor: import audit (deadline {deadline:g}s) ...",
           file=sys.stderr)
     report["import_audit"] = _import_audit(deadline)
@@ -160,6 +170,18 @@ def cmd_doctor(args) -> int:
     else:
         print("doctor: BACKEND UNREACHABLE: "
               f"{report['backend']['detail']}", file=sys.stderr)
+    gr = report.get("guardrails")
+    if gr is not None:
+        if not gr.get("ok"):
+            print(f"doctor: guardrails journal: {gr.get('error')}",
+                  file=sys.stderr)
+        else:
+            print(f"doctor: guardrails: {gr['skipped_steps']} skipped "
+                  f"steps (worst run {gr['worst_consecutive_skips']}), "
+                  f"{gr['loss_spikes']} loss spikes, "
+                  f"{len(gr['rollbacks'])} rollbacks, "
+                  f"{len(gr['diverged_errors'])} diverged",
+                  file=sys.stderr)
     sv = report.get("serving")
     if sv is not None:
         if not sv.get("ok"):
@@ -211,6 +233,12 @@ def main(argv=None) -> int:
                         "(MXNET_TPU_JOURNAL=<file>): summarize the last "
                         "run's shed-rate, compile-cache hit-rate, and "
                         "deadline-miss count (docs/serving.md)")
+    d.add_argument("--journal", default=None, metavar="PATH",
+                   help="JSONL journal from a training run "
+                        "(MXNET_TPU_JOURNAL=<file>): summarize anomaly "
+                        "guardrail records — nonfinite_grad skips, loss "
+                        "spikes, divergence rollbacks "
+                        "(docs/guardrails.md)")
     d.set_defaults(fn=cmd_doctor)
     args = ap.parse_args(argv)
     return args.fn(args)

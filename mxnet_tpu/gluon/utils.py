@@ -41,28 +41,74 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
     return [piece.as_in_context(ctx) for piece, ctx in zip(slices, ctx_list)]
 
 
-def clip_global_norm(arrays, max_norm, check_isfinite=True):
+def clip_global_norm(arrays, max_norm, check_isfinite=True,
+                     global_norm=None):
     """Rescale arrays so their joint L2 norm ≤ max_norm
-    (ref: gluon/utils.py clip_global_norm)."""
+    (ref: gluon/utils.py clip_global_norm).
+
+    Device-side (docs/guardrails.md): the squared-sum reduction is ONE
+    fused device computation — no per-array ``asscalar()`` pull.
+    ``check_isfinite=True`` (the reference default) costs one scalar
+    fetch of that norm — the API returns a float and warns on
+    non-finite — and, since the norm is on the host anyway, arrays are
+    only touched when clipping actually applies (scale < 1): the
+    steady-state unclipped step costs zero array ops.
+    ``check_isfinite=False`` is fully lazy for dense arrays — zero host
+    syncs; the clip factor and the non-finite handling stay device-side
+    (a non-finite norm scales by 1.0 via ``where`` — unclipped — since
+    the caller's guard/skip path owns non-finite steps), the multiply
+    is unconditional (no host branch exists to skip it), and the
+    returned norm is a scalar NDArray. Row-sparse entries are the
+    exception: their ``.data`` is host-resident, so scaling them
+    necessarily fetches the scale once.
+
+    ``global_norm`` feeds an already-computed global norm (e.g. the
+    fused guard's step output) so clipping costs no extra reduction
+    pass over the gradients."""
+    import jax.numpy as jnp
+
+    from ..guardrails import fused
     from ..ndarray.sparse import RowSparseNDArray
     if not arrays:
         raise MXNetError("clip_global_norm: empty array list")
-    total = 0.0
-    for arr in arrays:
-        if isinstance(arr, RowSparseNDArray):
+    if global_norm is not None:
+        norm_dev = jnp.asarray(
+            global_norm._data if isinstance(global_norm, nd.NDArray)
+            else global_norm).astype(jnp.float32)
+    else:
+        total = jnp.zeros((), jnp.float32)
+        for arr in arrays:
             # row-sparse grads: only stored rows contribute (ref:
             # gluon/utils.py supports row_sparse grad clipping)
-            total += float(np.sum(np.square(arr.data)))
-        else:
-            total += float(nd.sum(nd.square(arr.reshape(-1))).asscalar())
-    norm = float(np.sqrt(total))
-    if check_isfinite and not np.isfinite(norm):
+            data = arr.data if isinstance(arr, RowSparseNDArray) \
+                else arr._data
+            d32 = jnp.asarray(data).astype(jnp.float32)
+            total = total + jnp.sum(d32 * d32)
+        norm_dev = jnp.sqrt(total)
+    if not check_isfinite:
+        scale = fused.clip_scale(norm_dev, jnp.float32(max_norm))
+        for arr in arrays:
+            if isinstance(arr, RowSparseNDArray):
+                data = np.asarray(arr.data)
+                arr.data = data * np.asarray(scale).astype(data.dtype)
+            else:
+                arr *= nd.NDArray(scale.astype(arr._data.dtype),
+                                  _skip_device_put=True)
+        return nd.NDArray(norm_dev, _skip_device_put=True)
+    norm = fused.host_fetch(norm_dev)[0]
+    # a host float via the sanctioned chokepoint — G9 blesses it
+    if not np.isfinite(norm):
+        import warnings
+        warnings.warn("clip_global_norm: non-finite gradient norm — "
+                      "arrays left unclipped (enable guardrails to "
+                      "skip-step instead; docs/guardrails.md)")
         return norm
     scale = max_norm / (norm + 1e-8)
     if scale < 1.0:
         for arr in arrays:
             if isinstance(arr, RowSparseNDArray):
-                arr.data = arr.data * np.asarray(scale, arr.data.dtype)
+                data = np.asarray(arr.data)
+                arr.data = data * np.asarray(scale, data.dtype)
             else:
                 arr *= scale
     return norm

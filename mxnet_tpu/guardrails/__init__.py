@@ -1,0 +1,30 @@
+"""Training anomaly guardrails — fused non-finite detection, skip-step
+semantics, and divergence rollback to the last good checkpoint
+(docs/guardrails.md).
+
+Three layers, used by all four training paths (``gluon.Trainer``,
+``module.fit``, ``parallel.ShardedTrainer``, ``parallel
+.PipelinedTrainer``):
+
+1. a **fused finiteness/global-norm guard** computed inside the
+   compiled step (:mod:`.fused`) — one squared-sum all-reduce over the
+   gradients, returning a flag + norm alongside the loss with zero
+   extra host round trips;
+2. **skip-step semantics** — a non-finite flag makes the update a
+   data-flow no-op (``jnp.where``), journaled as a structured
+   ``nonfinite_grad`` record, with the fp16 ``DynamicLossScaler``
+   riding the same flag;
+3. a **divergence monitor + rollback** (:mod:`.monitor`) — bounded
+   anomaly budget; on exhaustion, restore the newest CRC-valid
+   ``resilience.commit`` step with an LR backoff, or raise a
+   structured :class:`TrainingDiverged`.
+
+This package root is import-light (no jax): trainers import
+:mod:`.fused` lazily at trace time.
+"""
+from .monitor import (AnomalyMonitor, GuardConfig, TrainingDiverged,
+                      handle_divergence)
+from .report import guard_report
+
+__all__ = ["AnomalyMonitor", "GuardConfig", "TrainingDiverged",
+           "guard_report", "handle_divergence"]

@@ -1,0 +1,146 @@
+"""Shared host-side guard bookkeeping for the fused trainers.
+
+ShardedTrainer and PipelinedTrainer carry identical guard plumbing —
+per-step scaler/monitor feeding, scanned-window aftermath (including
+the stale-scale run collapse), divergence rollback, and the in-program
+skip counters. One copy lives here; a trainer supplies only what
+genuinely differs: its consumer tag (``_guard_consumer``) and how a
+replicated guard-state scalar is placed on its mesh
+(``_reinit_guard_state``).
+
+Host attributes the mixin expects: ``_scaler``, ``_guard_cfg``,
+``_monitor``, ``_guard_state``, ``_skipped_offset``, ``_optimizer``,
+``_num_update``, and ``restore(ckpt_dir)``.
+"""
+from __future__ import annotations
+
+from . import fused
+from .monitor import handle_divergence, stale_scale_runs
+
+__all__ = ["GuardedTrainerMixin"]
+
+
+class GuardedTrainerMixin:
+    """Guard bookkeeping shared by the fused (jit/pjit) trainers."""
+
+    _guard_consumer = "trainer"
+
+    def _reinit_guard_state(self):
+        """Fresh replicated in-program counters on this trainer's mesh."""
+        raise NotImplementedError
+
+    def _validate_guard_mode(self):
+        """Reject ``mode="deferred"`` + fp16 scaler at construction: the
+        loss scale is a host-side input updated from every step's flag,
+        so per-step fetches would happen regardless (breaking deferred's
+        zero-read contract) while the monitor is never fed (breaking
+        journaling/rollback) — neither promise survives, so fail
+        structurally instead of silently doing neither."""
+        cfg = self._guard_cfg
+        if (cfg is not None and cfg.mode == "deferred"
+                and self._scaler is not None):
+            from ..base import MXNetError
+            raise MXNetError(
+                "GuardConfig(mode='deferred') cannot be combined with "
+                "fp16 dynamic loss scaling — the scale update needs "
+                "every step's flag on the host; use mode='step' "
+                "(docs/guardrails.md)")
+
+    # -- per-step -------------------------------------------------------------
+    def _after_step(self, t, loss, finite, gnorm):
+        """Per-step host half of the guardrails: feed the scaler and the
+        monitor from the step's OWN outputs. One ``host_fetch`` — the
+        same cost as reading the loss for logging. In ``deferred`` mode
+        (and with no guard/scaler at all) this does nothing: skip counts
+        accumulate in-program and ``guard_poll`` reads them on demand."""
+        cfg = self._guard_cfg
+        eager = (self._scaler is not None
+                 or (cfg is not None and cfg.mode == "step"))
+        if not eager:
+            return
+        ok, loss_v, gn = fused.host_fetch(finite, loss, gnorm)
+        if self._scaler is not None:
+            self._scaler.update_scale(not ok)
+        if cfg is not None and cfg.mode == "step":
+            verdict = self._monitor.observe(t, bool(ok), loss=loss_v,
+                                            grad_norm=gn)
+            if verdict == "diverged":
+                self._handle_divergence(t)
+        elif not ok:
+            self._journal_scaler_only_skip(t, loss_v, gn)
+
+    # -- scanned windows ------------------------------------------------------
+    def _after_run_steps(self, start_t, losses, fins, gns):
+        """Window-granular guard bookkeeping for run_steps: one fetch of
+        the per-step (loss, flag, norm) arrays, fed to the scaler and
+        monitor in step order. With an fp16 scaler the scale was FROZEN
+        for the whole scanned window, so a run of consecutive overflows
+        all re-decided under the same stale scale: halve once per run
+        (not once per step — ``scale / 2**num_steps`` would be a
+        spurious collapse) and charge the budget once per run
+        (``AnomalyMonitor.observe_window(collapse_runs=True)``)."""
+        cfg = self._guard_cfg
+        eager = (self._scaler is not None
+                 or (cfg is not None and cfg.mode == "step"))
+        if not eager:
+            return
+        loss_a, fin_a, gn_a = fused.host_fetch(losses, fins, gns)
+        if self._scaler is not None:
+            for f, stale in zip(fin_a, stale_scale_runs(fin_a)):
+                if not stale:
+                    self._scaler.update_scale(not bool(f))
+        if cfg is not None and cfg.mode == "step":
+            verdict, at = self._monitor.observe_window(
+                start_t, fin_a, losses=loss_a, norms=gn_a,
+                collapse_runs=self._scaler is not None)
+            if verdict == "diverged":
+                self._handle_divergence(at)
+        else:
+            for i, f in enumerate(fin_a):
+                if not bool(f):
+                    self._journal_scaler_only_skip(
+                        int(start_t) + i, loss_a[i], gn_a[i])
+
+    def _journal_scaler_only_skip(self, t, loss_v, gn):
+        from .monitor import journal_scaler_only_skip
+        journal_scaler_only_skip(t, gn, loss_v, self._guard_consumer)
+
+    # -- divergence -----------------------------------------------------------
+    def _handle_divergence(self, t):
+        restored = handle_divergence(
+            self._monitor, t,
+            restore_fn=lambda: self.restore(self._guard_cfg.ckpt_root),
+            optimizer=self._optimizer)
+        # restore() rewound params/state/num_update; the in-program skip
+        # counters belong to the abandoned trajectory — bank the total
+        # (skipped_steps stays cumulative) and start fresh counters
+        self._skipped_offset += int(fused.host_fetch(
+            self._guard_state[0])[0])
+        self._guard_state = self._reinit_guard_state()
+        return restored
+
+    # -- counters -------------------------------------------------------------
+    @property
+    def skipped_steps(self):
+        """Total non-finite (skipped) steps so far. Reading syncs on the
+        in-program counter — one fetch, intended for reports (bench.py
+        emits it), not per-step polling."""
+        if self._guard_state is None:
+            return self._skipped_offset
+        return self._skipped_offset + int(
+            fused.host_fetch(self._guard_state[0])[0])
+
+    def guard_poll(self):
+        """Deferred-mode poll: fetch the in-program counters once and
+        return ``(total_skips, consecutive_skips)``. Journals a
+        ``guard_poll`` record so long gaps between polls still leave a
+        breadcrumb trail."""
+        if self._guard_state is None:
+            return (self._skipped_offset, 0)
+        total, consec = fused.host_fetch(*self._guard_state)
+        total = int(total) + self._skipped_offset
+        from ..diagnostics.journal import get_journal
+        get_journal().event("guard_poll", step=int(self._num_update),
+                            total_skips=total, consecutive=int(consec),
+                            consumer=self._guard_consumer)
+        return (total, int(consec))

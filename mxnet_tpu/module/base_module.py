@@ -3,6 +3,8 @@
 from __future__ import annotations
 
 import logging
+import os
+import re
 import time
 
 from .. import metric as metric_mod
@@ -57,6 +59,36 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _grad_datas(self):
+        """Device arrays of the PARAMETER gradient buffers, or None when
+        the concrete module type does not expose them (guardrails then
+        skip the finiteness check rather than guess). Data-input grads
+        (``inputs_need_grad=True``) are excluded: the optimizer never
+        consumes them, so they must not veto the step or inflate the
+        journaled global norm."""
+        exec_ = getattr(self, "_exec", None)
+        if exec_ is None:
+            return None
+        names = getattr(self, "_param_names", None)
+        grads = (exec_.grad_dict.values() if names is None
+                 else (exec_.grad_dict.get(n) for n in names))
+        return [g._data for g in grads if g is not None]
+
+    def _guard_optimizers(self):
+        """Live optimizer object(s) the guard's rollback LR backoff
+        must land on (composite module types override — e.g. a chained
+        SequentialModule has one per inner module)."""
+        opt = getattr(self, "_optimizer", None)
+        return [opt] if opt is not None else []
+
+    def _guard_reinit_updaters(self):
+        """Drop the diverged trajectory's updater state (often
+        saturated moments) while keeping the same optimizer object —
+        the rollback's LR backoff lands on it right after."""
+        opt = getattr(self, "_optimizer", None)
+        if opt is not None:
+            self.init_optimizer(optimizer=opt, force_init=True)
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, reset=True, epoch=0):
         """ref: BaseModule.score."""
@@ -110,7 +142,7 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None,
             checkpoint_prefix=None, checkpoint_period=1, keep_last=None,
-            resume=False):
+            resume=False, guard=None):
         """The reference's canonical symbolic training loop
         (ref: base_module.py BaseModule.fit, SURVEY §3.3).
 
@@ -121,7 +153,16 @@ class BaseModule:
         next batch boundary, journals ``preempt_checkpoint``, and
         returns. ``resume=True`` restarts from the newest *valid*
         checkpoint under the prefix, skipping torn/corrupt files with a
-        journaled ``ckpt_fallback`` (a fresh start when none exists)."""
+        journaled ``ckpt_fallback`` (a fresh start when none exists).
+
+        Anomaly guardrails (docs/guardrails.md): ``guard=True`` (or a
+        :class:`~mxnet_tpu.guardrails.GuardConfig`) checks the batch's
+        gradients with ONE fused device-side finiteness reduction before
+        ``update()`` — a non-finite batch is skipped and journaled
+        (``nonfinite_grad``), never trained on. Past the anomaly budget,
+        fit rolls back to the newest valid checkpoint under
+        ``checkpoint_prefix`` with an LR backoff (bounded retries),
+        else raises :class:`~mxnet_tpu.guardrails.TrainingDiverged`."""
         from ..diagnostics.journal import get_journal
         if num_epoch is None:
             raise MXNetError("fit() requires num_epoch")
@@ -170,6 +211,25 @@ class BaseModule:
             eval_metric = metric_mod.create(eval_metric)
         if monitor is not None:
             self.install_monitor(monitor)
+        anomaly_monitor = None
+        if guard is not None:
+            from ..guardrails.monitor import AnomalyMonitor, GuardConfig
+            guard_cfg = GuardConfig.coerce(guard)
+            if guard_cfg is not None and guard_cfg.mode == "deferred":
+                # same contract as the eager Trainer: fit decides every
+                # batch on the host, deferred cannot hold here
+                raise MXNetError(
+                    "GuardConfig(mode='deferred') needs a fused trainer "
+                    "(parallel.ShardedTrainer / PipelinedTrainer); "
+                    "module.fit checks every batch on the host — use "
+                    "mode='step' (docs/guardrails.md)")
+            if guard_cfg is not None:
+                # fit adapts the config (_guarded_veto points ckpt_root
+                # at checkpoint_prefix on divergence) — copy so a
+                # caller-shared GuardConfig is never mutated
+                anomaly_monitor = AnomalyMonitor(guard_cfg.copy(),
+                                                 consumer="module_fit")
+        global_step = 0
 
         try:
             for epoch in range(begin_epoch, num_epoch):
@@ -180,10 +240,19 @@ class BaseModule:
                     if monitor is not None:
                         monitor.tic()
                     self.forward_backward(data_batch)
-                    self.update()
+                    global_step += 1
+                    vetoed = anomaly_monitor is not None and \
+                        self._guarded_veto(anomaly_monitor, global_step,
+                                           checkpoint_prefix)
+                    if not vetoed:
+                        self.update()
                     if monitor is not None:
                         monitor.toc_print()
-                    self.update_metric(eval_metric, data_batch.label)
+                    if not vetoed:
+                        # a vetoed batch's forward outputs are the
+                        # anomaly (NaN) — one poisoned batch must not
+                        # poison the epoch's running training metric
+                        self.update_metric(eval_metric, data_batch.label)
                     if batch_end_callback is not None:
                         for cb in _as_list(batch_end_callback):
                             cb(_BatchEndParam(epoch, nbatch, eval_metric,
@@ -229,6 +298,87 @@ class BaseModule:
                 # displaced SIGTERM disposition (else the process would
                 # silently ignore termination forever)
                 watch.uninstall()
+
+    def _guarded_veto(self, anomaly_monitor, global_step,
+                      checkpoint_prefix):
+        """Guardrails decision for one fit() batch: True vetoes the
+        update (non-finite gradients — skip-step). Divergence rolls the
+        module back to the newest valid epoch checkpoint with an LR
+        backoff, or raises TrainingDiverged once the budget is spent."""
+        from ..guardrails import fused
+        from ..guardrails.monitor import handle_divergence
+        grads = self._grad_datas()
+        if not grads:
+            if not getattr(self, "_guard_blind_warned", False):
+                # a guard that silently protects nothing is worse than
+                # none — tell the user once per module
+                self._guard_blind_warned = True
+                import warnings
+                warnings.warn(
+                    f"fit(guard=...) on {type(self).__name__}: gradient "
+                    "buffers are not visible (_grad_datas returned "
+                    "nothing), so the anomaly guard cannot check this "
+                    "module's steps (docs/guardrails.md)")
+            return False
+        finite_dev, gnorm_dev = fused.guard_stats(grads)
+        ok, gn = fused.host_fetch(finite_dev, gnorm_dev)
+        verdict = anomaly_monitor.observe(global_step, bool(ok),
+                                          grad_norm=gn)
+        if verdict == "diverged":
+            if checkpoint_prefix and anomaly_monitor.cfg.ckpt_root is None:
+                # fit's checkpoints are epoch files under the prefix —
+                # point the rollback there unless a commit root was
+                # explicitly configured
+                anomaly_monitor.cfg.ckpt_root = checkpoint_prefix
+
+            def restore_fn():
+                from .. import model
+                root = anomaly_monitor.cfg.ckpt_root
+                found = model.load_latest_params(root)
+                if found is None:
+                    # lenient layout sniff (committed dirs are strictly
+                    # step-%08d, but a hand-built or half-migrated root
+                    # deserves the same explanation)
+                    try:
+                        entries = os.listdir(root)
+                    except OSError:
+                        entries = []
+                    looks_like_commit_root = any(
+                        e == "latest" or
+                        (re.match(r"^step-\d+$", e) and
+                         os.path.isdir(os.path.join(root, e)))
+                        for e in entries)
+                    if looks_like_commit_root:
+                        raise MXNetError(
+                            f"ckpt_root {root!r} is a resilience.commit "
+                            "directory, but module.fit rolls back to "
+                            "EPOCH checkpoints (`prefix-NNNN.params` "
+                            "files written under checkpoint_prefix=) — "
+                            "point ckpt_root at an epoch-file prefix, "
+                            "or leave it unset to use "
+                            "checkpoint_prefix; the commit protocol is "
+                            "the fused trainers' checkpoint()/restore() "
+                            "format (docs/guardrails.md)")
+                    raise MXNetError(
+                        f"no loadable checkpoint under {root!r} to roll "
+                        "back to")
+                arg_params, aux_params, ckpt_epoch = found
+                self.set_params(arg_params, aux_params, force_init=True)
+                # epoch checkpoints hold params only — the diverged
+                # trajectory's updater moments (often saturated) must
+                # not survive into the restored world, or the run can
+                # re-diverge immediately and burn the rollback budget.
+                # Re-deriving the updater from the SAME optimizer object
+                # resets its state while keeping the LR-backoff target
+                # (handle_divergence backs off the optimizers after
+                # this returns).
+                self._guard_reinit_updaters()
+                return ckpt_epoch
+
+            handle_divergence(anomaly_monitor, global_step, restore_fn,
+                              optimizer=self._guard_optimizers)
+            return True
+        return not bool(ok)
 
     @property
     def symbol(self):

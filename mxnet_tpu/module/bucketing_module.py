@@ -108,6 +108,17 @@ class BucketingModule(BaseModule):
         self._buckets[self._default_bucket_key].init_params(*args, **kwargs)
         self.params_initialized = True
 
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        """ref: BucketingModule.set_params — applied via the current
+        bucket; buckets share parameter storage by name with the default
+        bucket (switch_bucket), so shared entries update everywhere."""
+        self._curr_module.set_params(arg_params, aux_params,
+                                     allow_missing=allow_missing,
+                                     force_init=force_init,
+                                     allow_extra=allow_extra)
+        self.params_initialized = True
+
     def init_optimizer(self, *args, **kwargs):
         default = self._buckets[self._default_bucket_key]
         default.init_optimizer(*args, **kwargs)
@@ -135,6 +146,32 @@ class BucketingModule(BaseModule):
 
     def update(self):
         self._curr_module.update()
+
+    def _grad_datas(self):
+        # guardrails see the active bucket's executor — the one whose
+        # gradients the next update() would apply
+        if self._curr_module is None:
+            return None
+        return self._curr_module._grad_datas()
+
+    def _guard_optimizers(self):
+        # every bucket shares the default bucket's optimizer object
+        # (init_optimizer/switch_bucket above), so one backoff covers all
+        default = self._buckets.get(self._default_bucket_key) \
+            if self._buckets else None
+        return default._guard_optimizers() if default is not None else []
+
+    def _guard_reinit_updaters(self):
+        default = self._buckets.get(self._default_bucket_key) \
+            if self._buckets else None
+        if default is None:
+            return
+        default._guard_reinit_updaters()
+        for key, mod in self._buckets.items():
+            if mod is not default:
+                # re-share the fresh updater exactly as init_optimizer does
+                mod._updater = default._updater
+                mod._optimizer = default._optimizer
 
     def get_outputs(self, merge_multi_context=True):
         return self._curr_module.get_outputs(merge_multi_context)

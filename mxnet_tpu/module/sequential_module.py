@@ -176,6 +176,33 @@ class SequentialModule(BaseModule):
         for mod in self._modules:
             mod.update()
 
+    def _grad_datas(self):
+        # guardrails see every chained module's gradients: update()
+        # applies them all, so a NaN anywhere must veto the whole step
+        out = []
+        for mod in self._modules:
+            g = mod._grad_datas()
+            if g is None:
+                return None
+            out.extend(g)
+        return out or None
+
+    def _guard_optimizers(self):
+        # chained modules may each own an optimizer (init_optimizer
+        # above creates one per module from a string spec): the rollback
+        # LR backoff must land on every distinct one
+        out, seen = [], set()
+        for mod in self._modules:
+            for opt in mod._guard_optimizers():
+                if id(opt) not in seen:
+                    seen.add(id(opt))
+                    out.append(opt)
+        return out
+
+    def _guard_reinit_updaters(self):
+        for mod in self._modules:
+            mod._guard_reinit_updaters()
+
     def get_outputs(self, merge_multi_context=True):
         return self._modules[-1].get_outputs(merge_multi_context)
 

@@ -22,6 +22,9 @@ import numpy as np
 from .. import _rng, autograd
 from .. import ndarray as nd
 from ..base import MXNetError
+from ..guardrails import fused as _guard
+from ..guardrails.trainer_mixin import GuardedTrainerMixin
+from ..guardrails.monitor import AnomalyMonitor, GuardConfig
 from .mesh import NamedSharding, PartitionSpec, use_mesh
 from .pipeline import pipeline_apply
 from .sharded import _opt_apply, _opt_init_state, functional_apply
@@ -58,7 +61,7 @@ def _trainable_of(block):
     return trainable
 
 
-class PipelinedTrainer:
+class PipelinedTrainer(GuardedTrainerMixin):
     """Pipeline + data parallel Gluon training driver::
 
         emb  = gluon.nn.Embedding(vocab, d)
@@ -87,10 +90,12 @@ class PipelinedTrainer:
     dropout rate).
     """
 
+    _guard_consumer = "pipelined_trainer"
+
     def __init__(self, embed, body_blocks, head, loss_fn, optimizer,
                  optimizer_params=None, mesh=None, num_microbatches=None,
                  num_virtual_stages=1, pipe_axis="pipe", data_axis="data",
-                 donate=True):
+                 donate=True, guard=None):
         from .. import optimizer as opt_mod
         from .mesh import current_mesh
         self._embed, self._body, self._head = embed, list(body_blocks), head
@@ -123,11 +128,39 @@ class PipelinedTrainer:
         self._prepared = False
         self._num_update = self._optimizer.begin_num_update
         self._step_fn = None
+        # anomaly guardrails — same contract as ShardedTrainer (the flag
+        # and norm are in-program outputs of every step); fp16 via
+        # amp.init("float16") rides a DynamicLossScaler on the same flag
+        self._guard_cfg = GuardConfig.coerce(guard)
+        self._monitor = (AnomalyMonitor(self._guard_cfg,
+                                        consumer=self._guard_consumer)
+                         if self._guard_cfg is not None else None)
+        self._scaler = None
+        self._resolve_scaler()
+        self._guard_state = None
+        self._skipped_offset = 0
+
+    def _resolve_scaler(self):
+        """(Re)resolve the fp16 loss scaler from the LIVE amp state —
+        at construction and again at first trace (_prepare). The
+        forward's amp casts resolve at trace time, so a scaler frozen
+        from stale __init__ state would desynchronize from the
+        program's actual dtype: amp.init("float16") between
+        construction and the first step must still get loss scaling."""
+        from ..contrib.amp import amp_dtype
+        if amp_dtype() == "float16":
+            if self._scaler is None:
+                from ..contrib.amp import DynamicLossScaler
+                self._scaler = DynamicLossScaler()
+        else:
+            self._scaler = None
+        self._validate_guard_mode()
 
     # -- setup ---------------------------------------------------------------
     def _prepare(self, x_example):
         if self._prepared:
             return
+        self._resolve_scaler()
         with use_mesh(self._mesh):
             h = self._embed(x_example if isinstance(x_example, nd.NDArray)
                             else nd.array(x_example))
@@ -179,6 +212,7 @@ class PipelinedTrainer:
                                                else rep)
                                 for s in _opt_init_state(opt, w))
                           for w in self._b_datas]
+        self._guard_state = self._reinit_guard_state()
         self._prepared = True
 
     # -- the compiled pp × dp step -------------------------------------------
@@ -233,8 +267,15 @@ class PipelinedTrainer:
         wd = opt.wd
         fwd = self._make_forward(training=True)
 
-        def step(e_tr, b_tr, h_tr, e_st, b_st, h_st, key, lr, t, rescale,
-                 x, y):
+        guard_clip = (self._guard_cfg.clip_norm
+                      if self._guard_cfg is not None else None)
+        # static at trace time: no guard + no fp16 scaler -> apply the
+        # update unconditionally (a silent unjournaled skip would freeze
+        # training invisibly; sharded.py has the same contract)
+        guarded = self._scaler is not None or self._guard_cfg is not None
+
+        def step(e_tr, b_tr, h_tr, e_st, b_st, h_st, gstate, key, lr, t,
+                 rescale, lscale, x, y):
             def loss_of(groups):
                 e_tr_, b_tr_, h_tr_ = groups
                 out = fwd(e_tr_, b_tr_, h_tr_, key, x)
@@ -243,16 +284,30 @@ class PipelinedTrainer:
                 y_nd = nd.NDArray(y, _skip_device_put=True)
                 with autograd.pause(train_mode=True):
                     loss_nd = loss_block(out_nd, y_nd)
-                return jnp.mean(loss_nd._data.astype(jnp.float32))
+                loss_val = jnp.mean(loss_nd._data.astype(jnp.float32))
+                # fp16: grads see the scaled loss; the report stays
+                # unscaled (same contract as ShardedTrainer)
+                return loss_val * lscale, loss_val
 
-            loss_val, grads = jax.value_and_grad(loss_of)(
-                (list(e_tr), list(b_tr), list(h_tr)))
+            (_, loss_val), grads = jax.value_and_grad(
+                loss_of, has_aux=True)((list(e_tr), list(b_tr),
+                                        list(h_tr)))
+            # fused guard over every stage's grads: the flag is agreed
+            # across the whole pipe x data mesh (grads are the derived
+            # psum results), so every rank skips or none does
+            inv = jnp.float32(1.0) / lscale
+            finite, gnorm_scaled = _guard.guard_stats(grads, loss_val)
+            gnorm = gnorm_scaled * inv
+            rescale_all = rescale * inv
+            if guard_clip is not None:
+                rescale_all = rescale_all * _guard.clip_scale(
+                    gnorm * rescale, jnp.float32(guard_clip))
 
             def upd(ws, gs, sts):
                 new_w, new_s = [], []
                 for w, g, s in zip(ws, gs, sts):
-                    w2, s2 = _opt_apply(opt, w, g, s, lr, t, wd, rescale,
-                                        clip)
+                    w2, s2 = _opt_apply(opt, w, g, s, lr, t, wd,
+                                        rescale_all, clip)
                     new_w.append(w2)
                     new_s.append(s2)
                 return new_w, new_s
@@ -260,7 +315,19 @@ class PipelinedTrainer:
             e2, es2 = upd(e_tr, grads[0], e_st)
             b2, bs2 = upd(b_tr, grads[1], b_st)
             h2, hs2 = upd(h_tr, grads[2], h_st)
-            return e2, b2, h2, es2, bs2, hs2, loss_val
+            # skip-step: non-finite -> bitwise no-op for every group
+            if guarded:
+                e2 = _guard.select(finite, e2, list(e_tr))
+                b2 = _guard.select(finite, b2, list(b_tr))
+                h2 = _guard.select(finite, h2, list(h_tr))
+                es2 = _guard.select(finite, es2, list(e_st))
+                bs2 = _guard.select(finite, bs2, list(b_st))
+                hs2 = _guard.select(finite, hs2, list(h_st))
+                gstate2 = _guard.update_guard_state(gstate, finite)
+            else:
+                gstate2 = gstate
+            return (e2, b2, h2, es2, bs2, hs2, gstate2, loss_val,
+                    (finite, gnorm))
 
         ns = lambda spec: NamedSharding(self._mesh, spec)
         rep = ns(PartitionSpec())
@@ -271,8 +338,8 @@ class PipelinedTrainer:
                  [rep] * len(self._h_params),
                  st_sh(self._e_states, rep), st_sh(self._b_states, bsp),
                  st_sh(self._h_states, rep),
-                 rep, rep, rep, rep, None, None)
-        out_sh = in_sh[:6] + (rep,)
+                 (rep, rep), rep, rep, rep, rep, rep, None, None)
+        out_sh = in_sh[:6] + ((rep, rep), rep, (rep, rep))
         donate = (0, 1, 2, 3, 4, 5) if self._donate else ()
         self._raw_step = step
         self._sharding_cfg = (in_sh, out_sh, donate)
@@ -285,8 +352,8 @@ class PipelinedTrainer:
 
     def _apply_results(self, results):
         """Shared dispatch tail for step/run_steps: rebind updated
-        params + state, return the loss."""
-        e2, b2, h2, es2, bs2, hs2, loss = results
+        params + state + guard counters, return the guard outputs."""
+        e2, b2, h2, es2, bs2, hs2, gstate, loss, flag = results
         for p, w in zip(self._e_params, e2):
             p._data[0]._rebind(w)
         for p, w in zip(self._h_params, h2):
@@ -294,7 +361,15 @@ class PipelinedTrainer:
         self._b_datas = list(b2)
         self._e_states, self._b_states, self._h_states = \
             list(es2), list(bs2), list(hs2)
-        return nd.NDArray(loss, _skip_device_put=True)
+        self._guard_state = gstate
+        return loss, flag
+
+    # guard bookkeeping (_after_step/_after_run_steps/_handle_divergence/
+    # skipped_steps/guard_poll) comes from GuardedTrainerMixin
+    def _reinit_guard_state(self):
+        rep = NamedSharding(self._mesh, PartitionSpec())
+        return tuple(jax.device_put(s, rep)
+                     for s in _guard.init_guard_state())
 
     def step(self, x, y):
         """One fused pp × dp train step; returns the scalar loss."""
@@ -308,16 +383,19 @@ class PipelinedTrainer:
         self._num_update += 1
         t = self._num_update
         self._optimizer.num_update = t
+        lscale = self._scaler.loss_scale if self._scaler is not None else 1.0
         e_tr = [p._data[0]._data for p in self._e_params]
         h_tr = [p._data[0]._data for p in self._h_params]
         with use_mesh(self._mesh):
             results = self._step_fn(
                 e_tr, self._b_datas, h_tr, self._e_states, self._b_states,
-                self._h_states, _rng.next_key(),
+                self._h_states, self._guard_state, _rng.next_key(),
                 jnp.float32(self._lr_at(t)),
                 jnp.float32(t), jnp.float32(self._optimizer.rescale_grad),
-                xd, yd)
-        return self._apply_results(results)
+                jnp.float32(lscale), xd, yd)
+        loss, (finite, gnorm) = self._apply_results(results)
+        self._after_step(t, loss, finite, gnorm)
+        return nd.NDArray(loss, _skip_device_put=True)
 
     def run_steps(self, x, y, num_steps=8):
         """Run ``num_steps`` train steps as ONE compiled program
@@ -336,26 +414,30 @@ class PipelinedTrainer:
         if key not in self._multi_fns:
             raw = self._raw_step
             in_sh, out_sh, donate = self._sharding_cfg
+            rep = NamedSharding(self._mesh, PartitionSpec())
 
-            def multi(e_tr, b_tr, h_tr, e_st, b_st, h_st, rng, lrs, t,
-                      rescale, x, y):
+            def multi(e_tr, b_tr, h_tr, e_st, b_st, h_st, gstate, rng,
+                      lrs, t, rescale, lscale, x, y):
                 # lrs: (num_steps,) — the scheduler is evaluated on the
                 # host for EVERY inner step, so a warmup/cosine schedule
                 # sees the same lr sequence as num_steps step() calls
                 def body(carry, i):
-                    e, b, h, es, bs, hs, t_ = carry
+                    e, b, h, es, bs, hs, gs, t_ = carry
                     k = jax.random.fold_in(rng, i)
-                    e2, b2, h2, es2, bs2, hs2, loss = raw(
-                        e, b, h, es, bs, hs, k, lrs[i], t_, rescale, x, y)
-                    return (e2, b2, h2, es2, bs2, hs2, t_ + 1.0), loss
+                    e2, b2, h2, es2, bs2, hs2, gs2, loss, (fin, gn) = raw(
+                        e, b, h, es, bs, hs, gs, k, lrs[i], t_, rescale,
+                        lscale, x, y)
+                    return (e2, b2, h2, es2, bs2, hs2, gs2, t_ + 1.0), \
+                        (loss, fin, gn)
 
-                carry, losses = jax.lax.scan(
-                    body, (e_tr, b_tr, h_tr, e_st, b_st, h_st, t),
+                carry, (losses, fins, gns) = jax.lax.scan(
+                    body, (e_tr, b_tr, h_tr, e_st, b_st, h_st, gstate, t),
                     jnp.arange(num_steps))
-                return carry[:6] + (losses[-1],)
+                return carry[:7] + (losses, fins, gns)
 
             self._multi_fns[key] = jax.jit(
-                multi, in_shardings=in_sh, out_shardings=out_sh,
+                multi, in_shardings=in_sh,
+                out_shardings=out_sh[:7] + (rep, rep, rep),
                 donate_argnums=donate)
         xd = x._data if isinstance(x, nd.NDArray) else jnp.asarray(x)
         yd = y._data if isinstance(y, nd.NDArray) else jnp.asarray(y)
@@ -364,15 +446,20 @@ class PipelinedTrainer:
         self._optimizer.num_update = self._num_update
         from .sharded import _lr_sequence
         lrs = _lr_sequence(self._optimizer, t, num_steps)
+        lscale = self._scaler.loss_scale if self._scaler is not None else 1.0
         e_tr = [p._data[0]._data for p in self._e_params]
         h_tr = [p._data[0]._data for p in self._h_params]
         with use_mesh(self._mesh):
             results = self._multi_fns[key](
                 e_tr, self._b_datas, h_tr, self._e_states, self._b_states,
-                self._h_states, _rng.next_key(), lrs,
+                self._h_states, self._guard_state, _rng.next_key(), lrs,
                 jnp.float32(t), jnp.float32(self._optimizer.rescale_grad),
-                xd, yd)
-        return self._apply_results(results)
+                jnp.float32(lscale), xd, yd)
+        losses, fins, gns = results[7], results[8], results[9]
+        self._apply_results(results[:7] + (losses[-1], (fins[-1],
+                                                        gns[-1])))
+        self._after_run_steps(t, losses, fins, gns)
+        return nd.NDArray(losses[-1], _skip_device_put=True)
 
     def evaluate(self, x, y):
         """Forward + loss through the pipeline, no update (ShardedTrainer

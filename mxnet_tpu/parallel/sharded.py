@@ -39,6 +39,9 @@ from ..base import MXNetError
 from ..gluon.block import functional_apply  # noqa: F401  (re-export: the
 #   primitive moved to gluon.block so serving/cache.py can share it
 #   without importing the parallel package; trainers keep this name)
+from ..guardrails import fused as _guard
+from ..guardrails.monitor import AnomalyMonitor, GuardConfig
+from ..guardrails.trainer_mixin import GuardedTrainerMixin
 from ..ops import optimizer_op as _ops
 from . import _ckpt
 from .mesh import current_mesh
@@ -253,7 +256,7 @@ def _collect_aux_losses(block):
     return total if found else None
 
 
-class ShardedTrainer:
+class ShardedTrainer(GuardedTrainerMixin):
     """Gluon-level driver for the single-program SPMD step.
 
     Drop-in upgrade of ``gluon.Trainer`` for mesh execution::
@@ -270,10 +273,12 @@ class ShardedTrainer:
     happen inside the compiled program, overlapped by XLA's scheduler.
     """
 
+    _guard_consumer = "sharded_trainer"
+
     def __init__(self, block, loss_fn, optimizer, optimizer_params=None,
                  mesh: Mesh = None, param_rules=None, batch_axis=0,
                  donate=True, compute_dtype=None, remat=None,
-                 master_dtype=None):
+                 master_dtype=None, guard=None):
         from .. import optimizer as opt_mod
         self._block = block
         self._loss = loss_fn
@@ -285,6 +290,7 @@ class ShardedTrainer:
         # scheme (ref: src/operator/optimizer_op.cc mp_sgd_update) fused
         # into the step; the optimizer update stays fp32. When unset, the
         # process-wide AMP dtype applies (contrib.amp.init).
+        self._explicit_compute_dtype = compute_dtype is not None
         if compute_dtype is None:
             from ..contrib.amp import amp_dtype
             compute_dtype = amp_dtype()
@@ -331,6 +337,41 @@ class ShardedTrainer:
         self._step_fn = None
         self._eval_fn = None
         self._out_treedef = None
+        # anomaly guardrails (docs/guardrails.md): the fused flag/norm is
+        # computed in-program on EVERY step (the reduction is ~free and
+        # keeps the program signature stable); the config only decides
+        # what the host does with it. fp16 compute always gets a dynamic
+        # loss scaler riding the same flag — the parity the eager
+        # Trainer's DynamicLossScaler promises, without its host sync.
+        self._guard_cfg = GuardConfig.coerce(guard)
+        self._monitor = (AnomalyMonitor(self._guard_cfg,
+                                        consumer=self._guard_consumer)
+                         if self._guard_cfg is not None else None)
+        self._scaler = None
+        self._resolve_scaler()
+        self._guard_state = None
+        self._skipped_offset = 0
+
+    def _resolve_scaler(self):
+        """(Re)resolve the compute dtype + fp16 loss scaler from the
+        LIVE amp state when ``compute_dtype`` wasn't pinned by the
+        caller: ``amp.init("float16")`` after construction retraces the
+        step with fp16 casts (``_maybe_invalidate_amp``), so the scaler
+        — and with it skip-step + scale halving — must follow the
+        program's ACTUAL dtype, not a stale ``__init__`` snapshot
+        (PipelinedTrainer._resolve_scaler is the same contract)."""
+        if not self._explicit_compute_dtype:
+            from ..contrib.amp import amp_dtype
+            cdt = amp_dtype()
+            self._compute_dtype = (jnp.dtype(cdt) if cdt is not None
+                                   else self._master_dtype)
+        if self._compute_dtype == jnp.float16:
+            if self._scaler is None:
+                from ..contrib.amp import DynamicLossScaler
+                self._scaler = DynamicLossScaler()
+        else:
+            self._scaler = None
+        self._validate_guard_mode()
 
     # -- sharding layout -----------------------------------------------------
     @property
@@ -399,6 +440,11 @@ class ShardedTrainer:
             state = _opt_init_state(self._optimizer, p._data[0]._data)
             self._states.append(tuple(
                 self._shard(s, _state_spec(spec, s)) for s in state))
+        # in-program guard counters (total skips, consecutive skips),
+        # replicated — carried through every step/scan for free
+        self._guard_state = tuple(
+            self._shard(s, PartitionSpec())
+            for s in _guard.init_guard_state())
         self._prepared = True
 
     # -- the compiled step ---------------------------------------------------
@@ -408,10 +454,18 @@ class ShardedTrainer:
         lr_mults = [opt._get_lr(i) / max(opt.learning_rate, 1e-30)
                     for i in range(len(self._trainable))]
         clip = opt.clip_gradient if opt.clip_gradient is not None else -1.0
+        guard_clip = (self._guard_cfg.clip_norm
+                      if self._guard_cfg is not None else None)
 
         cdt = self._compute_dtype
+        # static at trace time: with no guard AND no fp16 scaler the
+        # update applies unconditionally (pre-guardrails behavior) — a
+        # silent bitwise skip nobody journals or polls would freeze
+        # training invisibly, which is worse than the NaN surfacing
+        guarded = self._scaler is not None or self._guard_cfg is not None
 
-        def step(tr, aux, states, key, lr, t, rescale, *batch):
+        def step(tr, aux, states, gstate, key, lr, t, rescale, lscale,
+                 *batch):
             inputs, label = batch[:-1], batch[-1]
 
             def loss_of(tr_):
@@ -449,23 +503,53 @@ class ShardedTrainer:
                 if aux_pen is not None:     # MoE load-balancing term
                     loss_val = loss_val + jnp.asarray(aux_pen,
                                                       jnp.float32)
-                return loss_val, (outs, aux_new)
+                # fp16 loss scaling: the gradient sees the SCALED loss
+                # (that is what makes fp16 grads overflow-detectable);
+                # the reported loss stays unscaled. lscale is traced, so
+                # DynamicLossScaler updates never retrace.
+                return loss_val * lscale, (loss_val, outs, aux_new)
 
             if self._remat_policy is not None:
                 loss_of = jax.checkpoint(
                     loss_of,
                     policy=(None if self._remat_policy == "full"
                             else self._remat_policy))
-            (loss_val, (outs, aux_new)), grads = jax.value_and_grad(
+            ((_, (loss_val, outs, aux_new)), grads) = jax.value_and_grad(
                 loss_of, has_aux=True)(list(tr))
             aux_new = [a.astype(a0.dtype) for a, a0 in zip(aux_new, aux)]
+            # fused guard (docs/guardrails.md): ONE squared-sum reduction
+            # over every (scaled) grad doubles as the non-finite flag and
+            # the global norm. Grads here are already psum-reduced by
+            # GSPMD, so the flag is globally agreed — no rank can branch
+            # out of a collective (the skip below is data flow).
+            inv = jnp.float32(1.0) / lscale
+            finite, gnorm_scaled = _guard.guard_stats(grads, loss_val)
+            gnorm = gnorm_scaled * inv
+            rescale_all = rescale * inv
+            if guard_clip is not None:
+                # global-norm clip off the already-computed norm: folded
+                # into rescale_grad, zero extra passes over the grads
+                rescale_all = rescale_all * _guard.clip_scale(
+                    gnorm * rescale, jnp.float32(guard_clip))
             new_tr, new_states = [], []
             for i, (w, g, s) in enumerate(zip(tr, grads, states)):
                 w2, s2 = _opt_apply(opt, w, g, s, lr * lr_mults[i], t,
-                                    wds[i], rescale, clip)
+                                    wds[i], rescale_all, clip)
                 new_tr.append(w2)
                 new_states.append(s2)
-            return new_tr, aux_new, new_states, loss_val, tuple(outs)
+            # skip-step semantics: a non-finite step is a bitwise no-op
+            # for params, optimizer state AND aux state (BatchNorm
+            # running stats) — jnp.where, so it works under jit/pjit/scan
+            if guarded:
+                new_tr = _guard.select(finite, new_tr, list(tr))
+                new_states = _guard.select(finite, new_states,
+                                           list(states))
+                aux_new = _guard.select(finite, aux_new, list(aux))
+                gstate2 = _guard.update_guard_state(gstate, finite)
+            else:
+                gstate2 = gstate
+            return (new_tr, aux_new, new_states, gstate2, loss_val,
+                    (finite, gnorm), tuple(outs))
 
         mesh = self.mesh
         ns = lambda spec: NamedSharding(mesh, spec)
@@ -475,7 +559,8 @@ class ShardedTrainer:
             [ns(s) for s in self._aux_specs],
             [tuple(ns(_state_spec(s, e)) for e in st)
              for s, st in zip(self._tr_specs, self._states)],
-            rep, rep, rep, rep,
+            (rep, rep),                       # guard state
+            rep, rep, rep, rep, rep,
         ) + tuple(jax.tree_util.tree_map(
             lambda _: None, tuple(range(n_inputs + 1))))  # batch: auto
         out_shardings = (
@@ -483,7 +568,8 @@ class ShardedTrainer:
             [ns(s) for s in self._aux_specs],
             [tuple(ns(_state_spec(s, e)) for e in st)
              for s, st in zip(self._tr_specs, self._states)],
-            rep, None,
+            (rep, rep),                       # guard state
+            rep, (rep, rep), None,
         )
         donate = (0, 2) if self._donate else ()
         self._raw_step = step
@@ -505,22 +591,31 @@ class ShardedTrainer:
         self._optimizer.num_update = t
         lr = _lr_at(self._optimizer, t)
         rescale = self._optimizer.rescale_grad
+        lscale = self._scaler.loss_scale if self._scaler is not None else 1.0
         tr = [p._data[0]._data for p in self._trainable]
         aux = [p._data[0]._data for p in self._aux]
         from .mesh import use_mesh
         with use_mesh(self.mesh):   # mesh-aware ops (ring attention) trace
-            new_tr, aux_new, new_states, loss_val, outs = self._step_fn(
-                tr, aux, self._states, _rng.next_key(),
+            (new_tr, aux_new, new_states, gstate, loss_val,
+             (finite, gnorm), outs) = self._step_fn(
+                tr, aux, self._states, self._guard_state, _rng.next_key(),
                 jnp.float32(lr), jnp.float32(t), jnp.float32(rescale),
-                *batch_datas)
+                jnp.float32(lscale), *batch_datas)
         for p, w in zip(self._trainable, new_tr):
             p._data[0]._rebind(w)
         for p, a in zip(self._aux, aux_new):
             p._data[0]._rebind(a)
         self._states = new_states
+        self._guard_state = gstate
         self.last_outputs = [nd.NDArray(o, _skip_device_put=True)
                              for o in outs]
+        self._after_step(t, loss_val, finite, gnorm)
         return nd.NDArray(loss_val, _skip_device_put=True)
+
+    # -- guard bookkeeping: GuardedTrainerMixin (docs/guardrails.md) ----------
+    def _reinit_guard_state(self):
+        return tuple(self._shard(s, PartitionSpec())
+                     for s in _guard.init_guard_state())
 
     def _maybe_invalidate_amp(self):
         """Retrace compiled programs when the per-op AMP cast policy
@@ -532,6 +627,9 @@ class ShardedTrainer:
             self._eval_fn = None
             self._multi_fns = {}
             self._amp_epoch = _dispatch.amp_epoch()
+            # the retraced program's dtype may have changed with it —
+            # BEFORE the rebuild reads _compute_dtype/_scaler
+            self._resolve_scaler()
 
     def run_steps(self, *batch, num_steps=8):
         """Run ``num_steps`` train steps as ONE compiled program
@@ -551,44 +649,57 @@ class ShardedTrainer:
         if key not in self._multi_fns:
             raw = self._raw_step
             in_sh, out_sh, donate = self._shardings
+            rep_sh = out_sh[4]
 
-            def multi(tr, aux, states, rng, lrs, t, rescale, *b):
+            def multi(tr, aux, states, gstate, rng, lrs, t, rescale,
+                      lscale, *b):
                 # lrs: (num_steps,) host-evaluated schedule — each inner
                 # step sees the SAME lr a separate step() call would
                 def body(carry, i):
-                    tr_, aux_, states_, t_ = carry
+                    tr_, aux_, states_, gs_, t_ = carry
                     k = jax.random.fold_in(rng, i)
-                    ntr, naux, nst, loss, _ = raw(tr_, aux_, states_, k,
-                                                  lrs[i], t_, rescale, *b)
-                    return (ntr, naux, nst, t_ + 1.0), loss
+                    ntr, naux, nst, gs2, loss, (fin, gn), _ = raw(
+                        tr_, aux_, states_, gs_, k, lrs[i], t_, rescale,
+                        lscale, *b)
+                    return (ntr, naux, nst, gs2, t_ + 1.0), (loss, fin, gn)
 
-                (tr, aux, states, _), losses = jax.lax.scan(
-                    body, (tr, aux, states, t), jnp.arange(num_steps))
-                return tr, aux, states, losses[-1]
+                (tr, aux, states, gstate, _), (losses, fins, gns) = \
+                    jax.lax.scan(body, (tr, aux, states, gstate, t),
+                                 jnp.arange(num_steps))
+                return tr, aux, states, gstate, losses, fins, gns
 
             self._multi_fns[key] = jax.jit(
                 multi, in_shardings=in_sh,
-                out_shardings=out_sh[:3] + (out_sh[3],),
+                out_shardings=out_sh[:4] + (rep_sh, rep_sh, rep_sh),
                 donate_argnums=donate)
         batch_datas = [self._shard_batch_arg(b) for b in batch]
         t = self._num_update + 1
         self._num_update += num_steps
         self._optimizer.num_update = self._num_update
         lrs = _lr_sequence(self._optimizer, t, num_steps)
+        # fp16 note (docs/guardrails.md): the loss scale is one traced
+        # input for the WHOLE window — overflow inside a scanned window
+        # skips those steps in-program, and the scaler adjusts once per
+        # window from the per-step flags below
+        lscale = self._scaler.loss_scale if self._scaler is not None else 1.0
         tr = [p._data[0]._data for p in self._trainable]
         aux = [p._data[0]._data for p in self._aux]
         from .mesh import use_mesh
         with use_mesh(self.mesh):
-            new_tr, aux_new, new_states, loss_val = self._multi_fns[key](
-                tr, aux, self._states, _rng.next_key(), lrs,
-                jnp.float32(t),
-                jnp.float32(self._optimizer.rescale_grad), *batch_datas)
+            (new_tr, aux_new, new_states, gstate, losses, fins, gns) = \
+                self._multi_fns[key](
+                    tr, aux, self._states, self._guard_state,
+                    _rng.next_key(), lrs, jnp.float32(t),
+                    jnp.float32(self._optimizer.rescale_grad),
+                    jnp.float32(lscale), *batch_datas)
         for p, w in zip(self._trainable, new_tr):
             p._data[0]._rebind(w)
         for p, a in zip(self._aux, aux_new):
             p._data[0]._rebind(a)
         self._states = new_states
-        return nd.NDArray(loss_val, _skip_device_put=True)
+        self._guard_state = gstate
+        self._after_run_steps(t, losses, fins, gns)
+        return nd.NDArray(losses[-1], _skip_device_put=True)
 
     def evaluate(self, *batch):
         """Forward + loss under one compiled program (no update)."""

@@ -38,7 +38,8 @@ import signal
 from ..resilience import atomic
 
 __all__ = ["CRASH_POINTS", "FaultError", "FaultPlan", "FaultRule",
-           "SimulatedCrash", "crash", "inject", "io_error", "sigterm",
+           "PoisonSchedule", "SimulatedCrash", "crash", "inject",
+           "io_error", "poison_batch", "poison_grads", "sigterm",
            "write_offsets"]
 
 # every phase of one atomic file write, in order — plus the commit
@@ -150,6 +151,74 @@ def sigterm() -> None:
     Only safe once ``resilience.preempt.install()`` holds the signal;
     otherwise this kills the interpreter, as in production."""
     os.kill(os.getpid(), signal.SIGTERM)
+
+
+# -- numeric poison (the guardrails chaos layer, docs/guardrails.md) --------
+# Two injection shapes mirror how bad numerics arrive in production:
+#   * poison_batch — a corrupt INPUT (bad record, overflowed feature):
+#     NaN/Inf flows through forward/backward naturally, so the fused
+#     in-program guard is exercised end to end with no program changes;
+#   * poison_grads — a corrupt GRADIENT buffer written directly (the
+#     eager-trainer shape: fp16 overflow lands in the grad arrays).
+# PoisonSchedule drives "poison at step N" / "persistent poison" chaos
+# loops without every test reinventing the step bookkeeping.
+
+def poison_batch(batch, value=float("nan"), index=0):
+    """Copy a host batch with ``flat[index] = value`` (default NaN).
+    The poisoned copy is a new float array — the caller's batch is
+    untouched, so the same test can replay the clean batch after."""
+    import numpy as np
+    out = np.array(batch, copy=True)
+    if not np.issubdtype(out.dtype, np.floating):
+        out = out.astype(np.float32)
+    out.reshape(-1)[index] = value
+    return out
+
+
+def poison_grads(params, value=float("nan"), index=0):
+    """Write ``value`` into one element of the first live gradient
+    buffer (eager gluon Trainer / Module shape). Returns the poisoned
+    parameter's name; raises if nothing has a gradient."""
+    for p in params:
+        if getattr(p, "grad_req", "write") == "null":
+            continue
+        for g in (getattr(p, "_grad", None) or ()):
+            if g is None:
+                continue
+            data = g._data
+            if hasattr(data, "at"):           # jax.Array: functional set
+                g._rebind(data.reshape(-1).at[index].set(value)
+                          .reshape(data.shape))
+            else:                             # numpy fallback
+                flat = data.reshape(-1)
+                flat[index] = value
+            return p.name
+    raise ValueError("poison_grads: no parameter with a gradient buffer")
+
+
+class PoisonSchedule:
+    """Which steps are poisoned: explicit ``at_steps`` and/or every step
+    from ``persistent_from`` on. ``batch(step, x)`` returns the batch to
+    feed — poisoned or clean — and records what it did in ``log``."""
+
+    def __init__(self, at_steps=(), persistent_from=None,
+                 value=float("nan")):
+        self.at_steps = frozenset(int(s) for s in at_steps)
+        self.persistent_from = persistent_from
+        self.value = value
+        self.log = []
+
+    def poisoned(self, step) -> bool:
+        hit = int(step) in self.at_steps or (
+            self.persistent_from is not None
+            and int(step) >= int(self.persistent_from))
+        if hit:
+            self.log.append(int(step))
+        return hit
+
+    def batch(self, step, x):
+        return poison_batch(x, value=self.value) if self.poisoned(step) \
+            else x
 
 
 def write_offsets(total_bytes: int) -> list[int]:

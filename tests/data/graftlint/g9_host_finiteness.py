@@ -1,0 +1,74 @@
+# graftlint: scope=library
+# graftlint: scope=training
+"""G9 fixture: per-step host-synced finiteness checks — the class the
+fused guard replaced (gluon/utils.py's old per-array asscalar() loop,
+amp's per-step has_overflow pull). Parsed only, never executed."""
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu.guardrails import fused
+
+
+def old_clip_global_norm_shape(grads, max_norm):
+    total = 0.0
+    for g in grads:
+        grad_sq = (g * g).sum()
+        total += grad_sq.asscalar()  # expect: G9
+    norm = float(np.sqrt(total))
+    if not np.isfinite(norm):  # expect: G9
+        return norm
+    return max_norm / norm
+
+
+def old_has_overflow_shape(grads):
+    ok = None
+    for g in grads:
+        fin = jnp.all(jnp.isfinite(g))
+        ok = fin if ok is None else jnp.logical_and(ok, fin)
+    return not bool(ok)  # expect: G9
+
+
+def per_step_host_pulls(grad_total, loss_arr):
+    overflow = float(grad_total)  # expect: G9
+    bad = np.isnan(loss_arr)  # expect: G9
+    per_grad_val = grad_total.item()  # expect: G9
+    return overflow, bad, per_grad_val
+
+
+def fused_guard_is_clean(grads, loss):
+    # device-side: the flag/norm stay in-program, selection is data flow
+    finite, gnorm = fused.guard_stats(grads, loss)
+    scaled = [jnp.where(finite, g, jnp.zeros_like(g)) for g in grads]
+    device_fin = jnp.isfinite(gnorm)          # no host pull: silent
+    return scaled, device_fin
+
+
+def sanctioned_fetch_is_clean(finite, gnorm):
+    # the ONE sanctioned chokepoint: a single fetch of step outputs
+    ok, gn = fused.host_fetch(finite, gnorm)
+    norm_f = float(fused.host_fetch(gnorm)[0])
+    return ok, gn, norm_f
+
+
+def fetched_results_are_blessed(finite, gnorm_dev):
+    # the rule's own recommended two-statement shape: host_fetch results
+    # are host values — checking/converting them later costs no sync
+    ok_flag, norm = fused.host_fetch(finite, gnorm_dev)
+    if not np.isfinite(norm):                 # blessed: silent
+        return float(norm), bool(ok_flag)     # blessed: silent
+    still_bad = np.isfinite(gnorm_dev)  # expect: G9
+    return still_bad
+
+
+def tuple_unpack_taints_elementwise(g, num_steps):
+    # only `flag` is tainted by the unpacking — `count` rides along in
+    # the same Assign and must NOT be flagged when host-read later
+    flag, count = jnp.isfinite(g).all(), num_steps
+    steps_done = int(count)                   # benign: no G9
+    overflowed = not bool(flag)  # expect: G9
+    return steps_done, overflowed
+
+
+def suppressed(loss_val):
+    # value was already fetched once at episode end, not per step
+    return np.isfinite(loss_val)  # graftlint: disable=G9 episode-end check

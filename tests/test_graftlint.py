@@ -354,11 +354,11 @@ def test_malformed_baseline_is_a_usage_error_and_self_heals(tmp_path):
 
 
 def test_cli_rules_filter_and_errors():
-    out = _cli(["--rules", "G9"])
+    out = _cli(["--rules", "G99"])
     assert out.returncode == 2 and "unknown rule" in out.stderr
     out = _cli(["--list-rules"])
     assert out.returncode == 0
-    for code in ["G1", "G2", "G3", "G4", "G5", "G6", "G7", "G8",
+    for code in ["G1", "G2", "G3", "G4", "G5", "G6", "G7", "G8", "G9",
                  "E1", "W1", "W2", "W3", "W4", "W5", "W6"]:
         assert code in out.stdout
 

@@ -1,0 +1,1475 @@
+"""Training anomaly guardrails (docs/guardrails.md): the fused
+non-finite guard, skip-step semantics, divergence rollback, and the
+no-new-host-syncs contract — chaos-proven across all four training
+paths (gluon Trainer, module.fit, ShardedTrainer, PipelinedTrainer).
+
+The ``*smoke*`` tests are CI's tier-0.5 guardrail chaos smoke
+(ci/run_tests.sh)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, io, parallel, sym
+from mxnet_tpu.diagnostics import journal
+from mxnet_tpu.guardrails import (AnomalyMonitor, GuardConfig,
+                                  TrainingDiverged, fused, guard_report)
+from mxnet_tpu.testing import faults
+
+
+def _read_journal(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture
+def jfile(tmp_path):
+    """Route the process journal to a file for the test, restore after."""
+    jf = str(tmp_path / "journal.jsonl")
+    journal.reset_journal(jf)
+    try:
+        yield jf
+    finally:
+        journal.reset_journal()
+
+
+def _mlp(classes=4, in_units=8):
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=in_units))
+        net.add(gluon.nn.Dense(classes, in_units=16))
+    net.initialize()
+    return net
+
+
+def _sharded(guard=None, **kw):
+    net = _mlp()
+    mesh = parallel.make_mesh({"data": -1})
+    tr = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=mesh, guard=guard, **kw)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16,))
+    return tr, x, y
+
+
+def _weights(tr):
+    return [np.asarray(p._data[0]._data).copy() for p in tr._trainable]
+
+
+def _states(tr):
+    return [[np.asarray(s).copy() for s in st] for st in tr._states]
+
+
+# -- chaos smoke: skip-step is a bitwise no-op -------------------------------
+
+def test_smoke_sharded_nan_batch_skipped_bitwise(jfile):
+    """A NaN batch at step N is skipped — params, optimizer state and
+    the loss-free trajectory are bit-identical to not having stepped —
+    then training resumes on clean data."""
+    tr, x, y = _sharded(guard=True)
+    tr.step(x, y)
+    w0, s0 = _weights(tr), _states(tr)
+    loss = tr.step(faults.poison_batch(x), y)
+    assert not np.isfinite(loss.asscalar())
+    for a, b in zip(w0, _weights(tr)):
+        np.testing.assert_array_equal(a, b)
+    for sa, sb in zip(s0, _states(tr)):
+        for a, b in zip(sa, sb):
+            np.testing.assert_array_equal(a, b)
+    assert tr.skipped_steps == 1
+    assert np.isfinite(tr.step(x, y).asscalar())
+    recs = [r for r in _read_journal(jfile) if r["kind"] == "nonfinite_grad"]
+    assert len(recs) == 1
+    assert recs[0]["step"] == 2 and recs[0]["consecutive"] == 1
+    assert recs[0]["consumer"] == "sharded_trainer"
+
+
+def test_smoke_sharded_divergence_rollback_bitexact(tmp_path, jfile):
+    """Persistent poison: K consecutive skips raise the divergence
+    verdict; the trainer restores the last committed step bit-exact,
+    backs off the LR, journals divergence_rollback, and resumes; the
+    bounded retry budget then surfaces TrainingDiverged."""
+    root = str(tmp_path / "ckpt")
+    cfg = GuardConfig(max_consecutive_skips=2, max_rollbacks=1,
+                      ckpt_root=root)
+    tr, x, y = _sharded(guard=cfg)
+    for _ in range(3):
+        tr.step(x, y)
+    committed = tr.checkpoint(root)
+    w_commit, s_commit = _weights(tr), _states(tr)
+    xp = faults.poison_batch(x)
+    tr.step(xp, y)
+    tr.step(xp, y)                      # 2nd skip -> rollback
+    for a, b in zip(w_commit, _weights(tr)):
+        np.testing.assert_array_equal(a, b)
+    for sa, sb in zip(s_commit, _states(tr)):
+        for a, b in zip(sa, sb):
+            np.testing.assert_array_equal(a, b)
+    assert tr.num_update == committed == 3
+    assert tr.learning_rate == pytest.approx(0.05)
+    recs = _read_journal(jfile)
+    rb = [r for r in recs if r["kind"] == "divergence_rollback"]
+    assert len(rb) == 1 and rb[0]["restored_step"] == committed
+    assert rb[0]["lr_backoff"] == pytest.approx(0.5)
+    # training resumes clean after the rollback
+    assert np.isfinite(tr.step(x, y).asscalar())
+    # budget spent: the next divergence must surface, not loop
+    with pytest.raises(TrainingDiverged) as ei:
+        tr.step(xp, y)
+        tr.step(xp, y)
+    assert ei.value.rollbacks == 1
+    assert "consecutive non-finite" in str(ei.value)
+
+
+def test_smoke_eager_trainer_skip_and_rollback(tmp_path, jfile):
+    """The eager gluon Trainer path: poisoned grad buffers skip the
+    update (no has_overflow pull involved), and divergence rolls back
+    bit-exact through the Trainer's own commit-protocol checkpoint."""
+    root = str(tmp_path / "ckpt")
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1},
+                       guard=GuardConfig(max_consecutive_skips=2,
+                                         max_rollbacks=1, ckpt_root=root))
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 2))
+    y = mx.nd.array(rng.randn(8, 1))
+
+    def one_step(poison=False):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        if poison:
+            faults.poison_grads(net.collect_params().values())
+        tr.step(8)
+
+    one_step()
+    tr.checkpoint(root)
+    w_commit = net.weight.data().asnumpy().copy()
+    one_step(poison=True)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_commit)
+    assert tr.skipped_steps == 1
+    one_step(poison=True)               # -> rollback
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_commit)
+    assert tr.learning_rate == pytest.approx(0.05)
+    kinds = [r["kind"] for r in _read_journal(jfile)]
+    assert "nonfinite_grad" in kinds and "divergence_rollback" in kinds
+    # rollback budget spent -> TrainingDiverged surfaces
+    with pytest.raises(TrainingDiverged):
+        one_step(poison=True)
+        one_step(poison=True)
+
+
+def _pipelined(tmp_root, guard):
+    d = 8
+    emb = gluon.nn.Dense(d, in_units=d)
+    body = [gluon.nn.Dense(d, in_units=d) for _ in range(2)]
+    head = gluon.nn.Dense(4, in_units=d)
+    for b in [emb] + body + [head]:
+        b.initialize()
+    mesh = parallel.make_mesh({"pipe": 2, "data": 4})
+    tr = parallel.PipelinedTrainer(
+        emb, body, head, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh, num_microbatches=2, guard=guard)
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, d).astype(np.float32)
+    y = rng.randint(0, 4, (8,))
+    return tr, x, y
+
+
+def test_smoke_pipelined_skip_and_rollback(tmp_path, jfile):
+    root = str(tmp_path / "ckpt")
+    cfg = GuardConfig(max_consecutive_skips=2, max_rollbacks=1,
+                      ckpt_root=root)
+    tr, x, y = _pipelined(root, cfg)
+    tr.step(x, y)
+    tr.checkpoint(root)
+    committed = [np.asarray(w).copy() for w in tr._b_datas]
+    xp = faults.poison_batch(x)
+    pre = [np.asarray(w).copy() for w in tr._b_datas]
+    tr.step(xp, y)                      # skip: bitwise no-op
+    for a, b in zip(pre, [np.asarray(w) for w in tr._b_datas]):
+        np.testing.assert_array_equal(a, b)
+    assert tr.skipped_steps == 1
+    tr.step(xp, y)                      # -> rollback to the commit
+    for a, b in zip(committed, [np.asarray(w) for w in tr._b_datas]):
+        np.testing.assert_array_equal(a, b)
+    assert tr.learning_rate == pytest.approx(0.05)
+    assert np.isfinite(tr.step(x, y).asscalar())
+    recs = [r for r in _read_journal(jfile)
+            if r["kind"] == "divergence_rollback"]
+    assert len(recs) == 1 and recs[0]["consumer"] == "pipelined_trainer"
+
+
+def test_module_fit_guard_skips_and_rolls_back(tmp_path, jfile):
+    """module.fit(guard=...): a poisoned batch is journaled and never
+    trained on; persistent poison rolls back to the newest epoch
+    checkpoint and finally raises TrainingDiverged."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(80, 6).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=2, name="fc1"), name="softmax")
+    pref = str(tmp_path / "ckpt")
+
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(io.NDArrayIter(x, y, batch_size=20), num_epoch=2,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            checkpoint_prefix=pref, guard=True)
+
+    xp = x.copy()
+    xp[0, 0] = np.nan                   # one poisoned batch per epoch
+    mod2 = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod2.fit(io.NDArrayIter(xp, y, batch_size=20), num_epoch=1,
+             optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+             guard=True)
+    arg, _ = mod2.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in arg.values())
+    skips = [r for r in _read_journal(jfile)
+             if r["kind"] == "nonfinite_grad"
+             and r["consumer"] == "module_fit"]
+    assert len(skips) == 1
+
+    mod3 = mx.mod.Module(net, data_names=("data",),
+                         label_names=("softmax_label",))
+    with pytest.raises(TrainingDiverged):
+        mod3.fit(io.NDArrayIter(np.full_like(x, np.nan), y, batch_size=20),
+                 num_epoch=3, optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1},
+                 checkpoint_prefix=pref, resume=True,
+                 guard=GuardConfig(max_consecutive_skips=2,
+                                   max_rollbacks=1))
+    recs = _read_journal(jfile)
+    assert any(r["kind"] == "divergence_rollback"
+               and r["consumer"] == "module_fit" for r in recs)
+
+
+# -- multi-host / multi-device agreement -------------------------------------
+
+def test_two_rank_skip_agreement_and_scale_trajectory():
+    """Simulated 2-rank fp16 run, ranks played serially in one process
+    (the crash-matrix convention): only rank 0's LOCAL grads carry a
+    NaN; after the (simulated) allreduce both ranks' fused flags see it
+    — both skip, and the loss-scale trajectories stay identical (the
+    hang/divergence class the old per-rank early return could hit)."""
+    from mxnet_tpu.contrib import amp
+
+    def make_rank():
+        mx.random.seed(3)
+        net = gluon.nn.Dense(1, in_units=4)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, guard=True)
+        tr._amp_loss_scaler = amp.DynamicLossScaler(init_scale=1024)
+        return net, tr
+
+    try:
+        amp.init("float16")
+        ranks = [make_rank(), make_rank()]
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.randn(8, 4))
+        y = mx.nd.array(rng.randn(8, 1))
+        loss_fn = gluon.loss.L2Loss()
+        scales = {0: [], 1: []}
+        for step in range(4):
+            grads = []
+            for i, (net, _) in enumerate(ranks):
+                with autograd.record():
+                    loss = loss_fn(net(x), y)
+                loss.backward()
+                if step == 1 and i == 0:   # only rank 0 sees the NaN
+                    faults.poison_grads(net.collect_params().values())
+                grads.append([g.asnumpy().copy()
+                              for p in net.collect_params().values()
+                              for g in p._grad])
+            # the allreduce: the sum reaches every rank (NaN poisons it)
+            import jax.numpy as jnp
+            summed = [np.add.reduce([g[j] for g in grads])
+                      for j in range(len(grads[0]))]
+            for net, tr in ranks:
+                bufs = [g for p in net.collect_params().values()
+                        for g in p._grad]
+                for buf, val in zip(bufs, summed):
+                    buf._rebind(jnp.asarray(val))
+                tr.step(8)
+            for i, (_, tr) in enumerate(ranks):
+                scales[i].append(tr._amp_loss_scaler.loss_scale)
+        assert scales[0] == scales[1]
+        assert scales[0][1] < scales[0][0]      # the overflow step halved
+        w0, w1 = (net.weight.data().asnumpy() for net, _ in ranks)
+        np.testing.assert_array_equal(w0, w1)
+        assert all(tr.skipped_steps == 1 for _, tr in ranks)
+    finally:
+        amp.reset()
+
+
+def test_trainer_guard_collective_is_rank_uniform(monkeypatch):
+    """Multi-process flag agreement WITHOUT the deadlock class:
+    _fetch_guard's allgather participation never depends on rank-local
+    state (kvstore type, or whether this rank passed a ``loss``) — a
+    rank-dependent decision to enter the collective would wedge the
+    peers that did. A peer's non-finite verdict forces a local skip
+    even though the local grads are clean, and the loss mean is scoped
+    to the ranks that actually sent one (the has-loss slot)."""
+    import jax
+    from jax.experimental import multihost_utils
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None, guard=True)
+    calls = []
+    peer = [1.0, 0.0, 0.0, 5.0]     # peer rank: overflowed, sent no loss
+
+    def fake_allgather(vec):
+        calls.append(np.asarray(vec))
+        return np.stack([np.asarray(vec, np.float32),
+                         np.asarray(peer, np.float32)])
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        fake_allgather)
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 4))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    w0 = np.asarray(net.weight.data()._data).copy()
+    tr.step(4)                  # no loss passed: still participates
+    assert len(calls) == 1
+    np.testing.assert_array_equal(w0, np.asarray(net.weight.data()._data))
+    assert tr.skipped_steps == 1    # peer's flag forced the local skip
+
+    peer[0] = 0.0               # peer finite now, still sends no loss
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(4, loss=loss)       # has-loss slot: mean over senders only
+    assert len(calls) == 2 and calls[-1][2] == 1.0
+    local_loss = float(np.mean(np.asarray(loss._data)))
+    assert tr._monitor._losses[-1] == pytest.approx(local_loss)
+
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.update(4)                # the manual flow rides the same contract
+    assert len(calls) == 3
+
+    peer[:] = [0.0, 7.25, 1.0, 5.0]  # peer sends a loss; this rank not
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(4)                  # no local loss — adopt the senders' mean
+    assert tr._monitor._losses[-1] == pytest.approx(7.25)
+
+
+def test_guard_sees_row_sparse_grads(jfile):
+    """The eager guard checks the gradient AS THE UPDATE CONSUMES IT: a
+    NaN confined to an Embedding's retained row-sparse view (the dense
+    buffer under it is still zeros) must veto the step — guarding the
+    zero buffer would let _update apply the NaN rows silently."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Embedding(20, 4, sparse_grad=True),
+            gluon.nn.Dense(2, flatten=False))
+    net.initialize()
+    net(mx.nd.array(np.zeros((1, 2))))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None, guard=True)
+    tokens = mx.nd.array(np.array([[3, 7], [11, 3]]))
+    with autograd.record():
+        loss = net(tokens).sum()
+    loss.backward()
+    g = net[0].weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    g.data[0, 0] = np.nan           # poison ONLY the sparse view
+    w0 = np.asarray(net[0].weight.data()._data).copy()
+    tr.step(4)
+    np.testing.assert_array_equal(w0,
+                                  np.asarray(net[0].weight.data()._data))
+    assert tr.skipped_steps == 1
+    assert any(r["kind"] == "nonfinite_grad"
+               for r in _read_journal(jfile))
+
+
+def test_fp16_only_skip_is_journaled(jfile):
+    """AMP fp16 WITHOUT a GuardConfig: a skipped overflow step still
+    writes a nonfinite_grad record (scaler_only=True) — doctor's skip
+    accounting must not depend on opting into budgets/rollback."""
+    from mxnet_tpu.contrib import amp
+    rng = np.random.RandomState(0)
+    try:
+        amp.init("float16")
+        net = _mlp()
+        tr = parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            optimizer_params={"learning_rate": 0.1},
+            mesh=parallel.make_mesh({"data": -1}))
+        assert tr._scaler is not None and tr._guard_cfg is None
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randint(0, 4, (16,))
+        tr._scaler.loss_scale = 2.0 ** 40     # force fp16 overflow
+        tr.step(x, y)
+        tr.step(x, y)
+        recs = [r for r in _read_journal(jfile)
+                if r["kind"] == "nonfinite_grad"
+                and r["consumer"] == "sharded_trainer"]
+        assert recs and recs[-1].get("scaler_only") is True
+
+        net2 = gluon.nn.Dense(1, in_units=4)
+        net2.initialize()
+        tr2 = gluon.Trainer(net2.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+        tr2._amp_loss_scaler = amp.DynamicLossScaler(init_scale=1024)
+        xx = mx.nd.array(rng.randn(4, 4))
+        with autograd.record():
+            l = net2(xx).sum()
+        l.backward()
+        faults.poison_grads(net2.collect_params().values())
+        tr2.step(4)
+        assert tr2.skipped_steps == 1
+        recs = [r for r in _read_journal(jfile)
+                if r["kind"] == "nonfinite_grad"
+                and r["consumer"] == "gluon_trainer"]
+        assert recs and recs[-1].get("scaler_only") is True
+    finally:
+        amp.reset()
+
+
+def test_tiny_spike_window_still_arms():
+    """spike_window <= 7 must still arm: the deque can never exceed the
+    window, so the arming gate is capped at it (an uncapped >= 8 gate
+    silently disabled the protection the user configured)."""
+    mon = AnomalyMonitor(GuardConfig(spike_window=4, spike_steps=2,
+                                     spike_factor=10.0))
+    for i in range(4):
+        assert mon.observe(i, True, loss=1.0) == "ok"
+    assert mon.observe(4, True, loss=100.0) == "ok"     # spike run 1
+    assert mon.observe(5, True, loss=100.0) == "diverged"
+    with pytest.raises(mx.MXNetError):
+        GuardConfig(spike_window=0)
+
+
+def test_sharded_multidevice_flag_is_global():
+    """On the 8-device mesh, a NaN confined to ONE data shard's examples
+    must skip the step for every device's shard of the params."""
+    tr, x, y = _sharded(guard=True)
+    tr.step(x, y)
+    w0 = _weights(tr)
+    xp = x.copy()
+    xp[0, 0] = np.inf                   # lands on shard 0 only
+    tr.step(xp, y)
+    for a, b in zip(w0, _weights(tr)):
+        np.testing.assert_array_equal(a, b)
+    assert tr.skipped_steps == 1
+
+
+# -- the no-new-host-syncs contract ------------------------------------------
+
+def test_deferred_mode_zero_device_to_host_transfers():
+    """GuardConfig(mode='deferred'): steps run with device→host
+    transfers DISALLOWED at the jax layer — the guard adds zero host
+    reads; guard_poll() then fetches the in-program counters once."""
+    import jax
+    tr, x, y = _sharded(guard=GuardConfig(mode="deferred"))
+    tr.step(x, y)                       # compile + warm outside the guard
+    xb = [tr._shard_batch_arg(b) for b in (x, y)]
+    with jax.transfer_guard_device_to_host("disallow"):
+        for _ in range(3):
+            tr.step(*xb)
+        tr.step(faults.poison_batch(x), y)
+    total, consec = tr.guard_poll()
+    assert (total, consec) == (1, 1)
+    assert tr.skipped_steps == 1
+
+
+def test_step_mode_single_fetch_single_program(monkeypatch):
+    """Eager ('step') monitoring costs exactly ONE host fetch per step —
+    of the step's own outputs — and the guard lives inside the ONE
+    compiled step program (no secondary jitted guard computation)."""
+    tr, x, y = _sharded(guard=True)
+    tr.step(x, y)                       # build
+    fetches, calls = [], []
+    real_fetch = fused.host_fetch
+    monkeypatch.setattr(fused, "host_fetch",
+                        lambda *a: (fetches.append(len(a)),
+                                    real_fetch(*a))[1])
+    real_fn = tr._step_fn
+    tr._step_fn = lambda *a, **kw: (calls.append(1), real_fn(*a, **kw))[1]
+    for _ in range(3):
+        tr.step(x, y)
+    assert len(calls) == 3              # one program dispatch per step
+    assert len(fetches) == 3            # one host fetch per step
+    assert all(n == 3 for n in fetches)  # (flag, loss, norm) in ONE fetch
+
+
+def test_fp16_finite_path_never_pulls_has_overflow(monkeypatch):
+    """Satellite contract: the eager fp16 path's old per-step
+    has_overflow gradient pull is gone — finite steps ride the fused
+    post-allreduce flag, and scale bookkeeping is unchanged."""
+    from mxnet_tpu.contrib import amp
+    try:
+        amp.init("float16")
+        net = gluon.nn.Dense(1, in_units=2)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        amp.init_trainer(tr)
+        tr._amp_loss_scaler.loss_scale = 128.0
+        scaler = tr._amp_loss_scaler
+        monkeypatch.setattr(
+            scaler, "has_overflow",
+            lambda *a, **k: pytest.fail("per-step has_overflow pull"))
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.randn(8, 2))
+        y = mx.nd.array(rng.randn(8, 1))
+        loss_fn = gluon.loss.L2Loss()
+        w_prev = net.weight.data().asnumpy().copy()
+        for _ in range(3):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+                with amp.scale_loss(loss, tr) as scaled:
+                    scaled.backward()
+            tr.step(8)
+        assert scaler.loss_scale == 128.0       # no overflow, no growth yet
+        assert not np.array_equal(net.weight.data().asnumpy(), w_prev)
+    finally:
+        amp.reset()
+
+
+def test_sharded_fp16_scaler_rides_in_program_flag():
+    """ShardedTrainer fp16 parity: an absurd loss scale overflows fp16
+    grads — the step skips in-program (params bit-identical), the scale
+    halves, and training then converges."""
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    tr = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1},
+        mesh=parallel.make_mesh({"data": -1}), compute_dtype="float16")
+    assert tr._scaler is not None
+    tr._scaler.loss_scale = 2.0 ** 40
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16,))
+    tr.step(x, y)
+    w0 = _weights(tr)
+    s_before = tr._scaler.loss_scale
+    tr.step(x, y)
+    assert tr._scaler.loss_scale == s_before / 2
+    for a, b in zip(w0, _weights(tr)):
+        np.testing.assert_array_equal(a, b)
+    losses = [tr.step(x, y).asscalar() for _ in range(40)]
+    assert np.isfinite(losses[-1]) and losses[-1] < losses[-10]
+    assert tr.skipped_steps >= 1
+
+
+def test_run_steps_threads_guard_through_scan(jfile):
+    """The scanned multi-step program carries the guard state and
+    per-step flags; a poisoned window skips every inner step."""
+    tr, x, y = _sharded(guard=GuardConfig(max_consecutive_skips=10))
+    tr.step(x, y)
+    w0 = _weights(tr)
+    tr.run_steps(faults.poison_batch(x), y, num_steps=4)
+    for a, b in zip(w0, _weights(tr)):
+        np.testing.assert_array_equal(a, b)
+    assert tr.skipped_steps == 4
+    loss = tr.run_steps(x, y, num_steps=4)
+    assert np.isfinite(loss.asscalar())
+    assert tr.skipped_steps == 4
+    recs = [r for r in _read_journal(jfile) if r["kind"] == "nonfinite_grad"]
+    assert len(recs) == 4
+    assert [r["consecutive"] for r in recs] == [1, 2, 3, 4]
+
+
+# -- clip_global_norm: device-side + reused norm -----------------------------
+
+def test_clip_global_norm_numeric_parity():
+    arrays = [mx.nd.ones((2,)) * 3, mx.nd.ones((2,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert isinstance(norm, float)
+    assert norm == pytest.approx(np.sqrt(9 * 2 + 16 * 2), rel=1e-4)
+    total = sum(float(mx.nd.sum(mx.nd.square(a)).asscalar())
+                for a in arrays)
+    assert np.sqrt(total) == pytest.approx(1.0, rel=1e-3)
+
+
+def test_clip_global_norm_lazy_and_reused_norm():
+    """check_isfinite=False is fully lazy (NDArray norm, no float);
+    global_norm= reuses a precomputed norm — same clipped values."""
+    vals = [np.full((3,), 2.0, np.float32), np.full((2,), 1.0, np.float32)]
+    a1 = [mx.nd.array(v) for v in vals]
+    n1 = gluon.utils.clip_global_norm(a1, 1.0, check_isfinite=False)
+    assert isinstance(n1, mx.nd.NDArray)
+    a2 = [mx.nd.array(v) for v in vals]
+    precomputed = float(np.sqrt(sum(float((v * v).sum()) for v in vals)))
+    gluon.utils.clip_global_norm(a2, 1.0, check_isfinite=False,
+                                 global_norm=precomputed)
+    for u, v in zip(a1, a2):
+        np.testing.assert_allclose(u.asnumpy(), v.asnumpy(), rtol=1e-6)
+    assert float(n1.asscalar()) == pytest.approx(precomputed, rel=1e-5)
+
+
+def test_clip_global_norm_nonfinite_left_unclipped():
+    arrays = [mx.nd.array(np.array([np.nan, 1.0], np.float32)),
+              mx.nd.ones((2,))]
+    with pytest.warns(UserWarning, match="non-finite"):
+        norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert not np.isfinite(norm)
+    np.testing.assert_array_equal(arrays[1].asnumpy(),
+                                  np.ones((2,), np.float32))
+
+
+def test_clip_under_norm_is_bit_exact_noop():
+    a = mx.nd.array(np.array([0.1, -0.2], np.float32))
+    before = a.asnumpy().copy()
+    gluon.utils.clip_global_norm([a], 1e6)
+    np.testing.assert_array_equal(a.asnumpy(), before)
+
+
+def test_guard_clip_norm_sharded_matches_manual():
+    """GuardConfig.clip_norm inside the fused step == eager
+    clip-then-update on the same single-parameter problem."""
+    import jax.numpy as jnp
+    w_init = np.array([[0.3, -0.2], [0.1, 0.4]], np.float32)
+    # 8 identical rows (one per device shard): the mean-loss gradient
+    # equals the single-row gradient, keeping the oracle one line
+    x = np.tile(np.array([[1.0, 2.0]], np.float32), (8, 1))
+    y = np.zeros((8,), np.int64)
+
+    def manual():
+        w = w_init.copy()
+        logits = (x[:1] @ w.T)[0]
+        e = np.exp(logits - logits.max())
+        p = e / e.sum()
+        g = np.outer(p - np.array([1.0, 0.0]), x[0])   # CE grad wrt w
+        norm = np.sqrt((g ** 2).sum())
+        scale = min(1.0, 0.01 / (norm + 1e-8))
+        return w - 0.5 * g * scale
+
+    net = gluon.nn.Dense(2, in_units=2, use_bias=False)
+    net.initialize()
+    net.weight.data()._rebind(jnp.asarray(w_init))
+    tr = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.5},
+        mesh=parallel.make_mesh({"data": -1}),
+        guard=GuardConfig(clip_norm=0.01))
+    tr.step(x, y)
+    np.testing.assert_allclose(_weights(tr)[0], manual(), rtol=1e-4)
+
+
+# -- monitor / policy units --------------------------------------------------
+
+def test_monitor_spike_detection_diverges(jfile):
+    mon = AnomalyMonitor(GuardConfig(spike_window=16, spike_factor=10.0,
+                                     spike_steps=3))
+    for i in range(8):
+        assert mon.observe(i, True, loss=1.0 + 0.01 * i) == "ok"
+    assert mon.observe(8, True, loss=50.0) == "ok"
+    assert mon.observe(9, True, loss=60.0) == "ok"
+    assert mon.observe(10, True, loss=70.0) == "diverged"
+    assert "rolling median" in mon.reason
+    assert sum(1 for r in _read_journal(jfile)
+               if r["kind"] == "loss_spike") == 3
+
+
+def test_monitor_spike_recovery_resets_run():
+    mon = AnomalyMonitor(GuardConfig(spike_window=16, spike_factor=10.0,
+                                     spike_steps=3))
+    for i in range(8):
+        mon.observe(i, True, loss=1.0)
+    mon.observe(8, True, loss=50.0)
+    mon.observe(9, True, loss=1.1)      # recovered
+    mon.observe(10, True, loss=55.0)
+    assert mon.observe(11, True, loss=55.0) != "diverged"
+
+
+def test_monitor_skip_budget_and_reset():
+    mon = AnomalyMonitor(GuardConfig(max_consecutive_skips=3))
+    assert mon.observe(1, False) == "skip"
+    assert mon.observe(2, False) == "skip"
+    assert mon.observe(3, True) == "ok"        # run broken
+    assert mon.observe(4, False) == "skip"
+    assert mon.observe(5, False) == "skip"
+    assert mon.observe(6, False) == "diverged"
+    assert mon.total_skips == 5
+    mon.reset_stats()
+    assert mon.consecutive_skips == 0 and mon.reason is None
+    assert mon.total_skips == 5                 # cumulative survives
+
+
+def test_lr_backoff_wraps_scheduler():
+    from mxnet_tpu import lr_scheduler, optimizer as opt_mod
+    from mxnet_tpu.guardrails.monitor import set_cumulative_lr_backoff
+    sched = lr_scheduler.FactorScheduler(step=100, factor=1.0)
+    o = opt_mod.create("sgd", learning_rate=0.2, lr_scheduler=sched)
+    base = o.learning_rate
+    set_cumulative_lr_backoff(o, 0.5)
+    assert o.learning_rate == pytest.approx(base * 0.5)
+    # cumulative semantics: re-targets the wrapper, never compounds on it
+    set_cumulative_lr_backoff(o, 0.25)
+    assert o.learning_rate == pytest.approx(base * 0.25)
+
+    # scheduler-less optimizer: the carried marker makes the call
+    # idempotent and restore-proof (rollback #2 after load_states
+    # replaced the optimizer must not double-apply rollback #1's factor)
+    o2 = opt_mod.create("sgd", learning_rate=0.2)
+    set_cumulative_lr_backoff(o2, 0.5)
+    assert o2.learning_rate == pytest.approx(0.1)
+    set_cumulative_lr_backoff(o2, 0.25)         # carried 0.5 -> 0.25
+    assert o2.learning_rate == pytest.approx(0.05)
+
+
+def test_guard_config_env_defaults(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_GUARD_MAX_SKIPS", "7")
+    monkeypatch.setenv("MXNET_TPU_GUARD_LR_BACKOFF", "0.25")
+    cfg = GuardConfig()
+    assert cfg.max_consecutive_skips == 7
+    assert cfg.lr_backoff == pytest.approx(0.25)
+    with pytest.raises(mx.MXNetError):
+        GuardConfig(mode="nope")
+    with pytest.raises(mx.MXNetError):
+        GuardConfig.coerce("yes")
+    assert GuardConfig.coerce(None) is None
+    assert isinstance(GuardConfig.coerce(True), GuardConfig)
+
+
+def test_rollback_without_root_raises_structured():
+    mon = AnomalyMonitor(GuardConfig(max_consecutive_skips=1))
+    assert mon.observe(5, False) == "diverged"
+    from mxnet_tpu.guardrails import handle_divergence
+    with pytest.raises(TrainingDiverged) as ei:
+        handle_divergence(mon, 5, restore_fn=lambda: 0, optimizer=None)
+    assert ei.value.step == 5 and ei.value.consecutive_skips == 1
+
+
+# -- faults / report / doctor -----------------------------------------------
+
+def test_poison_helpers():
+    x = np.zeros((2, 3), np.float32)
+    xp = faults.poison_batch(x, index=4)
+    assert np.isnan(xp.reshape(-1)[4]) and not np.isnan(x).any()
+    xi = faults.poison_batch(np.zeros((2,), np.int32), value=np.inf)
+    assert np.isinf(xi[0])
+    sched = faults.PoisonSchedule(at_steps=(2,), persistent_from=5)
+    assert [s for s in range(8) if sched.poisoned(s)] == [2, 5, 6, 7]
+    assert sched.log == [2, 5, 6, 7]
+
+
+def test_guard_report_summarizes_journal(tmp_path, jfile):
+    mon = AnomalyMonitor(GuardConfig(max_consecutive_skips=100))
+    for i in range(3):
+        mon.observe(i, False, grad_norm=float("nan"), loss=None)
+    mon.observe(3, True, loss=1.0)
+    journal.get_journal().event("divergence_rollback", step=9,
+                                restored_step=4, reason="test",
+                                lr_backoff=0.5, rollback=1,
+                                consumer="trainer")
+    rep = guard_report(jfile)
+    assert rep["ok"] and rep["skipped_steps"] == 3
+    assert rep["worst_consecutive_skips"] == 3
+    assert rep["rollbacks"][0]["restored_step"] == 4
+    assert rep["skips_by_consumer"] == {"trainer": 3}
+    bad = guard_report(str(tmp_path / "missing.jsonl"))
+    assert not bad["ok"]
+
+
+def test_doctor_journal_wiring(jfile):
+    """The doctor report plumbing (the CLI subprocess run is slow-tier;
+    this checks the report builder the CLI calls)."""
+    from mxnet_tpu.diagnostics.__main__ import _guardrails_report
+    AnomalyMonitor(GuardConfig()).observe(1, False, grad_norm=2.0)
+    rep = _guardrails_report(jfile)
+    assert rep["ok"] and rep["skipped_steps"] == 1
+
+
+# -- review regressions ------------------------------------------------------
+
+def test_guard_false_disables_like_none():
+    assert GuardConfig.coerce(False) is None
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, guard=False)
+    assert tr._guard_cfg is None and tr._monitor is None
+
+
+def test_update_on_kvstore_guard_skips_and_journals(jfile):
+    """guard= must not be silently inert on the update-on-kvstore path:
+    a poisoned grad skips the push (params untouched on the store),
+    counts, and journals; clean steps then update normally."""
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, update_on_kvstore=True,
+                       guard=True)
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 2))
+    y = mx.nd.array(rng.randn(8, 1))
+
+    def one_step(poison=False):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        if poison:
+            faults.poison_grads(net.collect_params().values())
+        tr.step(8)
+
+    one_step()
+    assert tr._optimizer_applied_on_kv
+    w0 = net.weight.data().asnumpy().copy()
+    one_step(poison=True)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+    assert tr.skipped_steps == 1
+    recs = [r for r in _read_journal(jfile)
+            if r["kind"] == "nonfinite_grad"]
+    assert len(recs) == 1 and recs[0]["consumer"] == "gluon_trainer"
+    one_step()
+    assert not np.array_equal(net.weight.data().asnumpy(), w0)
+
+
+def test_run_steps_fp16_stale_scale_window_halves_once(jfile):
+    """The loss scale is frozen for a scanned window, so a whole-window
+    overflow run must halve the scale ONCE (not /2**num_steps) and count
+    ONCE against the consecutive-skip budget — the per-step path would
+    have self-healed after one halving. Follow-on in-window skips are
+    still journaled (stale_scale marker)."""
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    tr = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1},
+        mesh=parallel.make_mesh({"data": -1}), compute_dtype="float16",
+        guard=GuardConfig(max_consecutive_skips=2))
+    tr._scaler.loss_scale = 2.0 ** 40   # every step of the window overflows
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16,))
+    tr.run_steps(x, y, num_steps=4)     # must NOT raise TrainingDiverged
+    assert tr._scaler.loss_scale == 2.0 ** 39       # one halving
+    assert tr._monitor.consecutive_skips == 1       # one budget charge
+    assert tr.skipped_steps == 4                    # in-program truth
+    recs = [r for r in _read_journal(jfile)
+            if r["kind"] == "nonfinite_grad"]
+    assert len(recs) == 4
+    assert sum(1 for r in recs if r.get("stale_scale")) == 3
+    # stale records carry the run's true in-program position, so the
+    # doctor report's worst-consecutive metric sees the 4-step run even
+    # though the budget was charged once
+    assert max(r["consecutive"] for r in recs) == 4
+    assert guard_report(jfile)["worst_consecutive_skips"] == 4
+
+
+def test_fit_does_not_mutate_caller_guard_config(tmp_path, jfile):
+    """fit points the rollback at checkpoint_prefix on its own COPY of
+    the config — the caller's GuardConfig (possibly shared with another
+    trainer) keeps ckpt_root=None."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(80, 6).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=2, name="fc1"), name="softmax")
+    pref = str(tmp_path / "ckpt")
+    cfg = GuardConfig(max_consecutive_skips=2, max_rollbacks=0)
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    with pytest.raises(TrainingDiverged):
+        mod.fit(io.NDArrayIter(np.full_like(x, np.nan), y, batch_size=20),
+                num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                checkpoint_prefix=pref, guard=cfg)
+    assert cfg.ckpt_root is None
+    cfg2 = cfg.copy()
+    cfg2.ckpt_root = "elsewhere"
+    assert cfg.ckpt_root is None and cfg2.lr_backoff == cfg.lr_backoff
+
+
+def test_trainer_restore_rejects_wrong_shape(tmp_path):
+    """A checkpoint entry with the right name but wrong shape must fail
+    the restore up front (set_data's shape check), not resurface as an
+    opaque mid-step error."""
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    fname = str(tmp_path / "bad.params")
+    mx.nd.save(fname, {p.name: (mx.nd.zeros((3, 7)) if "weight" in p.name
+                                else p.data(p.list_ctx()[0]))
+                       for p in tr._params})
+    with pytest.raises(mx.MXNetError, match="shape"):
+        tr._load_params_file(fname)
+
+
+def test_grad_datas_first_replica_only():
+    """Post-allreduce the replicas are identical: the guard norm must
+    count each parameter once, not once per replica (a sqrt(n_ctx)
+    inflation would mis-clip and mis-journal)."""
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    x = mx.nd.ones((4, 2))
+    with autograd.record():
+        loss = loss_fn(net(x), mx.nd.zeros((4, 1)))
+    loss.backward()
+    for p in tr._params:                # simulate 2 identical replicas
+        p._grad = list(p._grad) * 2
+    all_g = tr._grad_datas()
+    one_g = tr._grad_datas(first_replica_only=True)
+    assert len(all_g) == 2 * len(one_g)
+    _, n_all = fused.host_fetch(*fused.guard_stats(all_g))
+    _, n_one = fused.host_fetch(*fused.guard_stats(one_g))
+    assert n_all == pytest.approx(n_one * np.sqrt(2), rel=1e-5)
+
+
+def test_update_on_kvstore_rollback_writes_back_store(tmp_path, jfile):
+    """On the update-on-kvstore path the store holds the MASTER weights:
+    restore() must write the restored params back into it, or the next
+    step's pull silently undoes the rollback with the store's diverged
+    trajectory."""
+    root = str(tmp_path / "ckpt")
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       update_on_kvstore=True,
+                       guard=GuardConfig(max_consecutive_skips=1,
+                                         max_rollbacks=1, ckpt_root=root))
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 2))
+    y = mx.nd.array(rng.randn(8, 1))
+
+    def one_step(poison=False):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        if poison:
+            faults.poison_grads(net.collect_params().values())
+        tr.step(8)
+
+    one_step()
+    tr.checkpoint(root)                 # commit EARLY...
+    w_commit = net.weight.data().asnumpy().copy()
+    for _ in range(3):
+        one_step()                      # ...then let the store advance
+    assert not np.array_equal(net.weight.data().asnumpy(), w_commit)
+    one_step(poison=True)               # -> rollback to the commit
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_commit)
+    # lr=0 makes the next push a store no-op, so the pull exposes the
+    # store's content exactly: stale (pre-rollback) weights would come
+    # back here if restore skipped the writeback
+    tr.set_learning_rate(0.0)
+    one_step()
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_commit)
+
+
+def test_gluon_lr_backoff_compounds_across_rollbacks(tmp_path, jfile):
+    """load_states replaces the optimizer with the checkpoint's pickled
+    copy; the cumulative backoff must survive that (rollback #2 lands at
+    factor**2, not factor)."""
+    root = str(tmp_path / "ckpt")
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       guard=GuardConfig(max_consecutive_skips=1,
+                                         max_rollbacks=2, ckpt_root=root))
+    loss_fn = gluon.loss.L2Loss()
+    x = mx.nd.array(np.ones((4, 2), np.float32))
+    y = mx.nd.array(np.zeros((4, 1), np.float32))
+
+    def poisoned_step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        faults.poison_grads(net.collect_params().values())
+        tr.step(4)
+
+    tr.checkpoint(root)
+    poisoned_step()                     # rollback 1
+    assert tr.learning_rate == pytest.approx(0.05)
+    poisoned_step()                     # rollback 2: compounds past the
+    assert tr.learning_rate == pytest.approx(0.025)  # optimizer reload
+    rbs = [r for r in _read_journal(jfile)
+           if r["kind"] == "divergence_rollback"]
+    assert [r["lr_backoff"] for r in rbs] == [
+        pytest.approx(0.5), pytest.approx(0.25)]
+
+
+def test_fit_commit_root_rejected_with_clear_error(tmp_path, jfile):
+    """module.fit rolls back to EPOCH checkpoints; a ckpt_root pointing
+    at a resilience.commit directory must fail with an explanation, not
+    an opaque 'no loadable checkpoint'."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(40, 6).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=2, name="fc1"), name="softmax")
+    root = str(tmp_path / "commit_root")
+    os.makedirs(os.path.join(root, "step-5"))   # commit-layout marker
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    with pytest.raises(TrainingDiverged, match="resilience.commit"):
+        mod.fit(io.NDArrayIter(np.full_like(x, np.nan), y, batch_size=20),
+                num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                guard=GuardConfig(max_consecutive_skips=1, max_rollbacks=1,
+                                  ckpt_root=root))
+
+
+def test_clip_norm_rejected_on_update_on_kvstore():
+    """GuardConfig.clip_norm cannot be honored when the optimizer runs
+    on the store during push (no reduced-gradient norm exists yet) — it
+    must fail structurally, not silently skip clipping."""
+    from mxnet_tpu.base import MXNetError
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, update_on_kvstore=True,
+                       guard=GuardConfig(clip_norm=1.0))
+    loss_fn = gluon.loss.L2Loss()
+    x = mx.nd.array(np.ones((4, 2), np.float32))
+    y = mx.nd.array(np.zeros((4, 1), np.float32))
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    with pytest.raises(MXNetError, match="update-on-kvstore"):
+        tr.step(4)
+
+
+def test_eager_trainer_loss_spike_divergence(jfile):
+    """step(loss=...) feeds the spike monitor on the eager path: a
+    sustained finite-loss spike (grads finite throughout) must journal
+    loss_spike records and raise TrainingDiverged — without a loss the
+    eager trainer can only see the consecutive-skip budget."""
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.01},
+                       guard=GuardConfig(spike_factor=5.0, spike_window=8,
+                                         spike_steps=2))
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 2))
+    y = mx.nd.array(rng.randn(8, 1))
+
+    def one_step(reported_loss):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        tr.step(8, loss=mx.nd.array(np.array([reported_loss],
+                                             np.float32)))
+
+    with pytest.raises(TrainingDiverged, match="rolling\\s+median"):
+        for i in range(20):
+            one_step(1.0 if i < 10 else 100.0)
+    spikes = [r for r in _read_journal(jfile) if r["kind"] == "loss_spike"]
+    assert len(spikes) == 2 and spikes[-1]["run"] == 2
+
+
+def test_deferred_mode_rejected_with_fp16_scaler():
+    """mode='deferred' + fp16 loss scaling can keep neither promise
+    (per-step fetches happen for the scale, the monitor is never fed) —
+    the combination must fail at construction."""
+    from mxnet_tpu.base import MXNetError
+    net = _mlp()
+    mesh = parallel.make_mesh({"data": -1})
+    with pytest.raises(MXNetError, match="deferred"):
+        parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            optimizer_params={"learning_rate": 0.1}, mesh=mesh,
+            compute_dtype="float16",
+            guard=GuardConfig(mode="deferred"))
+
+
+def test_fit_rollback_resets_updater_state(tmp_path, jfile):
+    """fit's epoch checkpoints hold params only: a divergence rollback
+    must not carry the diverged trajectory's updater moments into the
+    restored world — the updater is re-derived fresh."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(80, 6).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=2, name="fc1"), name="softmax")
+    pref = str(tmp_path / "ckpt")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(io.NDArrayIter(x, y, batch_size=20), num_epoch=1,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            checkpoint_prefix=pref)
+
+    xp = x.copy()
+    xp[40:] = np.nan        # batches 1-2 clean (momentum accumulates),
+    mod2 = mx.mod.Module(   # batches 3-4 poisoned -> rollback -> raise
+        net, data_names=("data",), label_names=("softmax_label",))
+    with pytest.raises(TrainingDiverged):
+        mod2.fit(io.NDArrayIter(xp, y, batch_size=20), num_epoch=2,
+                 optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                 checkpoint_prefix=pref, resume=True,
+                 guard=GuardConfig(max_consecutive_skips=1,
+                                   max_rollbacks=1))
+    recs = _read_journal(jfile)
+    assert any(r["kind"] == "divergence_rollback" for r in recs)
+    # the clean batches populated momentum states; the rollback dropped
+    # them and every post-rollback batch was vetoed, so fresh == empty
+    assert mod2._updater.states == {}
+
+
+@pytest.mark.slow
+def test_doctor_cli_journal_flag(tmp_path):
+    import subprocess
+    import sys
+    jf = str(tmp_path / "j.jsonl")
+    with open(jf, "w") as f:
+        f.write(json.dumps({"kind": "nonfinite_grad", "step": 3,
+                            "consecutive": 1, "consumer": "t"}) + "\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.diagnostics", "doctor",
+         "--journal", jf],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rep["guardrails"]["skipped_steps"] == 1
+
+
+def test_manual_update_flow_is_guarded(jfile):
+    """The documented gradient-accumulation flow (allreduce_grads();
+    update()) must carry the same defense as step(): a poisoned grad
+    skips the update bitwise, counts, and journals."""
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, guard=True)
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 2))
+    y = mx.nd.array(rng.randn(8, 1))
+
+    def one_manual_step(poison=False):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        if poison:
+            faults.poison_grads(net.collect_params().values())
+        tr.allreduce_grads()
+        tr.update(8)
+
+    one_manual_step()
+    w0 = net.weight.data().asnumpy().copy()
+    one_manual_step(poison=True)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+    assert tr.skipped_steps == 1
+    recs = [r for r in _read_journal(jfile)
+            if r["kind"] == "nonfinite_grad"]
+    assert len(recs) == 1 and recs[0]["consumer"] == "gluon_trainer"
+    one_manual_step()
+    assert not np.array_equal(net.weight.data().asnumpy(), w0)
+
+
+def test_manual_flow_guards_kvstore_push(jfile):
+    """Manual flow on update-on-kvstore: the optimizer runs on the
+    store during allreduce_grads()'s push, so the pre-push guard must
+    veto the push there — a NaN push would corrupt the stored params."""
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, update_on_kvstore=True,
+                       guard=True)
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 2))
+    y = mx.nd.array(rng.randn(8, 1))
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    tr.allreduce_grads()
+    tr.update(8)
+    assert tr._optimizer_applied_on_kv
+    w0 = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    faults.poison_grads(net.collect_params().values())
+    tr.allreduce_grads()
+    tr.update(8)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+    assert tr.skipped_steps == 1
+    assert any(r["kind"] == "nonfinite_grad" for r in _read_journal(jfile))
+
+
+def test_fp16_journaled_grad_norm_is_unscaled(jfile):
+    """nonfinite_grad.grad_norm parity across trainer paths: under fp16
+    AMP the eager step's gradients still carry the loss scale, but the
+    journaled norm must be the UNscaled one (the fused trainers divide
+    the scale out in-program) — otherwise the same model journals norms
+    loss_scale x larger on the eager path."""
+    from mxnet_tpu.contrib import amp
+    try:
+        amp.init("float16")
+        net = gluon.nn.Dense(1, in_units=2)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1},
+                           guard=GuardConfig(max_consecutive_skips=100))
+        amp.init_trainer(tr)
+        tr._amp_loss_scaler.loss_scale = 128.0
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.randn(8, 2))
+        y = mx.nd.array(rng.randn(8, 1))
+        loss_fn = gluon.loss.L2Loss()
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+            with amp.scale_loss(loss, tr) as scaled:
+                scaled.backward()
+        # grads are finite (scaled by 128); a NaN loss forces the skip,
+        # so the record carries the finite grad norm
+        scaled_norm = np.sqrt(sum(
+            float(np.sum(np.square(p.grad().asnumpy())))
+            for p in net.collect_params().values()))
+        tr.step(8, loss=mx.nd.array([np.nan]))
+        recs = [r for r in _read_journal(jfile)
+                if r["kind"] == "nonfinite_grad"]
+        assert len(recs) == 1
+        np.testing.assert_allclose(recs[0]["grad_norm"],
+                                   scaled_norm / 128.0, rtol=1e-5)
+    finally:
+        amp.reset()
+
+
+def test_bucketing_module_guard_sees_gradients(jfile):
+    """fit(guard=) must not be blind on BucketingModule: _grad_datas
+    delegates to the active bucket's executor, so a NaN batch is vetoed
+    and journaled (it used to silently return None -> no check at all)."""
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, num_hidden=8, name="fc")
+        return (sym.SoftmaxOutput(fc, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10)
+    batch = io.DataBatch(
+        data=[mx.nd.array(np.full((4, 10), np.nan, np.float32))],
+        label=[mx.nd.zeros((4,))], bucket_key=10,
+        provide_data=[io.DataDesc("data", (4, 10))],
+        provide_label=[io.DataDesc("softmax_label", (4,))])
+    mod.bind(batch.provide_data, batch.provide_label)
+    mod.init_params()
+    mod.init_optimizer()
+    mod.forward_backward(batch)
+    assert mod._grad_datas()
+    mon = AnomalyMonitor(GuardConfig(max_consecutive_skips=100))
+    assert mod._guarded_veto(mon, 0, None) is True
+    assert any(r["kind"] == "nonfinite_grad" for r in _read_journal(jfile))
+
+
+def test_manual_flow_counts_steps_and_checkpoints_unguarded(tmp_path):
+    """The manual flow must advance _step_count with NO guard attached
+    too: checkpoint() defaults its step to the counter, so a stuck
+    counter makes every later checkpoint() hit the already-committed
+    branch and silently stop saving progress."""
+    root = str(tmp_path / "ckpt")
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 2))
+    y = mx.nd.array(rng.randn(8, 1))
+
+    def one_manual_step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        tr.allreduce_grads()
+        tr.update(8)
+
+    one_manual_step()
+    assert tr.checkpoint(root) == 1
+    one_manual_step()
+    one_manual_step()
+    assert tr._step_count == 3
+    assert tr.checkpoint(root) == 3     # a NEW step commits, not a no-op
+
+
+def test_fp16_norm_not_double_unscaled_after_amp_unscale(jfile):
+    """The amp.unscale() manual pattern: grads no longer carry the loss
+    scale when step() runs, so the journaled norm must NOT be divided
+    by the scale again (trainer._scale tracks what the grads carry)."""
+    from mxnet_tpu.contrib import amp
+    try:
+        amp.init("float16")
+        net = gluon.nn.Dense(1, in_units=2)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1},
+                           guard=GuardConfig(max_consecutive_skips=100))
+        amp.init_trainer(tr)
+        tr._amp_loss_scaler.loss_scale = 128.0
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.randn(8, 2))
+        y = mx.nd.array(rng.randn(8, 1))
+        loss_fn = gluon.loss.L2Loss()
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+            with amp.scale_loss(loss, tr) as scaled:
+                scaled.backward()
+        amp.unscale(tr)                  # grads now carry NO scale
+        true_norm = np.sqrt(sum(
+            float(np.sum(np.square(p.grad().asnumpy())))
+            for p in net.collect_params().values()))
+        tr.step(8, loss=mx.nd.array([np.nan]))   # force a skip record
+        recs = [r for r in _read_journal(jfile)
+                if r["kind"] == "nonfinite_grad"]
+        assert len(recs) == 1
+        np.testing.assert_allclose(recs[0]["grad_norm"], true_norm,
+                                   rtol=1e-5)
+    finally:
+        amp.reset()
+
+
+def test_bucketing_module_divergence_rollback_backs_off_lr(tmp_path, jfile):
+    """BucketingModule rollback protocol: divergence must restore the
+    epoch checkpoint, back off the (bucket-shared) optimizer's LR and
+    journal — not crash on a missing _optimizer attribute."""
+    from mxnet_tpu import model
+
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, num_hidden=8, name="fc")
+        return (sym.SoftmaxOutput(fc, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10)
+    batch = io.DataBatch(
+        data=[mx.nd.array(np.full((4, 10), np.nan, np.float32))],
+        label=[mx.nd.zeros((4,))], bucket_key=10,
+        provide_data=[io.DataDesc("data", (4, 10))],
+        provide_label=[io.DataDesc("softmax_label", (4,))])
+    mod.bind(batch.provide_data, batch.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.4})
+    opts = mod._guard_optimizers()
+    assert len(opts) == 1
+    pref = str(tmp_path / "bkt")
+    arg, aux = mod.get_params()
+    model.save_checkpoint(pref, 0, mod.symbol, arg, aux)
+    mon = AnomalyMonitor(GuardConfig(max_consecutive_skips=1,
+                                     max_rollbacks=1, ckpt_root=pref))
+    mod.forward_backward(batch)
+    assert mod._guarded_veto(mon, 1, pref) is True
+    assert mon.rollbacks == 1
+    assert mod._guard_optimizers()[0].learning_rate == pytest.approx(0.2)
+    assert any(r["kind"] == "divergence_rollback"
+               for r in _read_journal(jfile))
+
+
+def test_fit_vetoed_batch_kept_out_of_train_metric(jfile):
+    """One poisoned batch is absorbed by the guard — it must not leak
+    NaN forward outputs into the epoch's running training metric."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(40, 6).astype(np.float32)
+    x[:20] = np.nan                     # exactly the first batch
+    y = (rng.randn(40) > 0).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=2, name="fc1"), name="softmax")
+    from mxnet_tpu import metric as metric_mod
+    m = metric_mod.create("ce")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(io.NDArrayIter(x, y, batch_size=20), num_epoch=1,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            eval_metric=m, guard=GuardConfig(max_consecutive_skips=10))
+    name, val = m.get_name_value()[0]
+    assert np.isfinite(val), (name, val)
+    assert any(r["kind"] == "nonfinite_grad" for r in _read_journal(jfile))
+
+
+def test_unguarded_sharded_lets_nonfinite_surface():
+    """Skip-step is strictly opt-in: with no guard and no scaler a NaN
+    batch must land in the parameters and surface (pre-guardrails
+    behavior) — an unjournaled silent skip would freeze training
+    invisibly."""
+    tr, x, y = _sharded(guard=None)
+    tr.step(x, y)
+    w0 = _weights(tr)
+    loss = tr.step(faults.poison_batch(x), y)
+    assert not np.isfinite(loss.asscalar())
+    assert tr.skipped_steps == 0
+    assert any(not np.isfinite(w).all() for w in _weights(tr))
+
+
+def test_pipelined_scaler_resolves_at_first_trace():
+    """amp.init("float16") AFTER construction but BEFORE the first step
+    must still get a loss scaler: the forward's amp casts resolve at
+    trace time, so the scaler decision re-resolves there too."""
+    from mxnet_tpu.contrib import amp
+    try:
+        tmp = None
+        tr, x, y = _pipelined(tmp, guard=True)
+        assert tr._scaler is None
+        amp.init("float16")
+        tr.step(x, y)
+        assert tr._scaler is not None
+    finally:
+        amp.reset()
+
+
+def test_sharded_scaler_follows_amp_epoch():
+    """ShardedTrainer twin of the live-resolution contract:
+    amp.init("float16") AFTER construction retraces the step with fp16
+    casts (_maybe_invalidate_amp), and the scaler must appear with them
+    — an overflow then skips in-program and halves the scale instead of
+    silently applying NaN grads under a stale __init__ snapshot. An
+    explicitly pinned compute_dtype stays pinned."""
+    from mxnet_tpu.contrib import amp
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16,))
+    try:
+        net = _mlp()
+        tr = parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            optimizer_params={"learning_rate": 0.1},
+            mesh=parallel.make_mesh({"data": -1}), guard=True)
+        assert tr._scaler is None
+        tr.step(x, y)
+        amp.init("float16")
+        tr.step(x, y)
+        assert tr._scaler is not None
+        tr._scaler.loss_scale = 2.0 ** 40     # force an fp16 overflow
+        w0 = _weights(tr)
+        s_before = tr._scaler.loss_scale
+        tr.step(x, y)
+        assert tr._scaler.loss_scale == s_before / 2
+        for a, b in zip(w0, _weights(tr)):
+            np.testing.assert_array_equal(a, b)
+        assert tr.skipped_steps >= 1
+        amp.reset()
+        tr.step(x, y)
+        assert tr._scaler is None             # amp.reset drops it again
+
+        pinned = parallel.ShardedTrainer(
+            _mlp(), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            optimizer_params={"learning_rate": 0.1},
+            mesh=parallel.make_mesh({"data": -1}),
+            compute_dtype="bfloat16")
+        amp.init("float16")
+        pinned.step(x, y)
+        assert pinned._scaler is None         # explicit dtype stays pinned
+    finally:
+        amp.reset()
