@@ -90,6 +90,22 @@ def _probe_device():
     return info
 
 
+def _parse_pallas_flag(argv) -> str | None:
+    """``--pallas {on,off,auto}`` (or ``--pallas=X``): A/B switch for the
+    guarded custom-kernel tier (docs/pallas.md). Returns the mode or
+    None; the caller exports it as MXNET_TPU_PALLAS so the deadlined
+    child body inherits the choice."""
+    for i, arg in enumerate(argv):
+        if arg.startswith("--pallas="):
+            return arg.split("=", 1)[1].strip().lower()
+        if arg == "--pallas":
+            # a trailing flag with no value must be the structured
+            # bad_flag diagnostic, not a silent default-auto A/B leg
+            return (argv[i + 1].strip().lower() if i + 1 < len(argv)
+                    else "")
+    return None
+
+
 def _run_body():
     """The actual benchmark (runs in the deadlined child process)."""
     import jax
@@ -157,11 +173,17 @@ def _run_body():
 
     n_chips = len(jax.devices())
     img_per_sec_per_chip = batch * steps * k / best_dt / n_chips
+    from mxnet_tpu import pallas
     _emit({
         "metric": METRIC,
         "value": round(img_per_sec_per_chip, 2),
         "unit": f"images/sec/chip ({platform}, batch={batch})",
         "vs_baseline": round(img_per_sec_per_chip / BASELINE_CEILING, 4),
+        # per-op kernel-tier provenance (docs/pallas.md): which tier
+        # each custom-kernel dispatch chose while building the measured
+        # program, and why any fallback happened — an A/B number must
+        # say which tier produced it
+        "pallas": {"mode": pallas.mode(), "ops": pallas.tier_provenance()},
         # guardrail accounting (docs/guardrails.md): the fused guard's
         # in-program skip counter, fetched once at report time — a
         # non-zero count means the measured window trained on fewer
@@ -172,6 +194,15 @@ def _run_body():
 
 
 def main():
+    pallas_mode = _parse_pallas_flag(sys.argv)
+    if pallas_mode is not None:
+        if pallas_mode not in ("on", "off", "auto"):
+            _emit(_diagnostic("bad_flag",
+                              f"--pallas must be on|off|auto, got "
+                              f"{pallas_mode!r}"))
+            return 2
+        # env (not set_mode) so the deadlined child body inherits it
+        os.environ["MXNET_TPU_PALLAS"] = pallas_mode
     if "--body" in sys.argv:
         return _run_body()
 
@@ -233,15 +264,27 @@ def _main_guarded(j):
     sys.stderr.write(proc.stderr[-2000:])
     for line in reversed(proc.stdout.splitlines()):
         line = line.strip()
-        if line.startswith("{") and '"metric"' in line:
-            print(line, flush=True)
-            dt = time.perf_counter() - t0
-            print(f"bench: body finished in {dt:.1f}s", file=sys.stderr)
-            j.mark_clean()
-            return 0 if proc.returncode == 0 else proc.returncode
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        # validate before reprinting: a dying tunnel truncating a write
+        # (or a library spraying JSON-shaped logs) must be a skipped
+        # line, never a broken one-structured-JSON-line contract
+        # (ADVICE r5 low, the guard._parse_info_line treatment)
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if not isinstance(parsed, dict) or "metric" not in parsed:
+            continue
+        print(line, flush=True)
+        dt = time.perf_counter() - t0
+        print(f"bench: body finished in {dt:.1f}s", file=sys.stderr)
+        j.mark_clean()
+        return 0 if proc.returncode == 0 else proc.returncode
     _emit(_diagnostic(
         "bench_body_failed",
-        f"rc={proc.returncode}; stderr tail: {proc.stderr[-500:]}"))
+        f"rc={proc.returncode}; no parseable metric line on stdout; "
+        f"stderr tail: {proc.stderr[-500:]}"))
     j.mark_clean()
     return 0
 

@@ -79,6 +79,14 @@ python -m pytest tests/test_serving.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: guardrail chaos smoke (anomaly skip + rollback) =="
 python -m pytest tests/test_guardrails.py -q -k smoke -p no:cacheprovider
 
+# pallas interpret smoke: every registered custom kernel passes its CPU
+# interpret-mode parity gate vs its XLA reference (forward AND custom_vjp
+# gradients), the non-TPU fallback journals its reason, and dropout keys
+# stay independent under the (layer, tick, shard) fold — a numerics
+# regression in the hand-kernel tier fails in seconds (docs/pallas.md)
+echo "== tier 0.5: pallas interpret smoke (kernel parity gate) =="
+python -m pytest tests/test_pallas.py -q -k smoke -p no:cacheprovider
+
 # quick unit tier: core ndarray/op/autograd/gluon/io surface, no
 # model-zoo or multi-process tests (ref: runtime_functions.sh unittest
 # vs nightly split)

@@ -1016,3 +1016,34 @@ class SilentDeviceExceptionSwallow(Rule):
                         "device/runtime failure swallowed silently — "
                         "journal it (diagnostics.journal) or narrow the "
                         "except")
+
+
+@register
+class DirectPallasCall(Rule):
+    code = "G10"
+    name = "direct-pallas-call"
+    severity = "error"
+    doc = ("Direct `pl.pallas_call` in library code outside "
+           "mxnet_tpu/pallas/. A raw kernel bypasses the registry's "
+           "parity gate, backend/shape fallback, and journaled "
+           "provenance (docs/pallas.md) — an unverified kernel can then "
+           "silently change numerics or run on a backend it was never "
+           "tested on. Register it (pallas.register_kernel) and route "
+           "callers through pallas.dispatch. "
+           "Scope: mxnet_tpu/ library code; mxnet_tpu/pallas/ is the "
+           "sanctioned home.")
+
+    PALLAS_CALLS = {"jax.experimental.pallas.pallas_call"}
+
+    def check(self, ctx):
+        if not ctx.is_library() or ctx.path.startswith("mxnet_tpu/pallas/"):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    ctx.resolve_call(node) in self.PALLAS_CALLS:
+                yield self.finding(
+                    ctx, node.lineno,
+                    "raw pl.pallas_call in library code bypasses the "
+                    "kernel tier's parity/fallback guard — register the "
+                    "kernel in mxnet_tpu/pallas/ and dispatch through "
+                    "the registry")

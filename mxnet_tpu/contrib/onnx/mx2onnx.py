@@ -116,10 +116,19 @@ def _bn(node, ins, out, attrs, ctx):
             ins = list(ins)
             ins[1] = ctx.add_initializer(
                 "ones", np.ones_like(np.asarray(ctx.params[gname])))
-    return [{"op_type": "BatchNormalization", "name": node.name,
-             "inputs": list(ins), "outputs": [out],
-             "attrs": {"epsilon": float(attrs.get("eps", 1e-3)),
-                       "momentum": float(attrs.get("momentum", 0.9))}}]
+    act = attrs.get("act_type")
+    if act in ("identity", "None"):     # fused no-op epilogue: plain BN
+        act = None
+    bn_out = f"{node.name}_bn" if act else out
+    nodes = [{"op_type": "BatchNormalization", "name": node.name,
+              "inputs": list(ins), "outputs": [bn_out],
+              "attrs": {"epsilon": float(attrs.get("eps", 1e-3)),
+                        "momentum": float(attrs.get("momentum", 0.9))}}]
+    if act:
+        # fused normalize-epilogue activation (pallas tier) decomposes
+        # back to BN + plain activation for ONNX
+        nodes += _act_chain(f"{node.name}_act", bn_out, out, act, ctx)
+    return nodes
 
 
 _ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
@@ -295,6 +304,65 @@ def _leaky(node, ins, out, attrs, ctx):
              "inputs": [f"{n}_xe", half], "outputs": [out], "attrs": {}},
         ]
     raise MXNetError(f"ONNX export: LeakyReLU act_type {act} unsupported")
+
+
+def _act_chain(name, src, out, act, ctx):
+    """ONNX nodes applying activation ``act`` to tensor ``src`` -> ``out``
+    (the decomposition target for the fused pallas epilogue ops)."""
+    simple = {"relu": "Relu", "tanh": "Tanh", "sigmoid": "Sigmoid"}
+    if act in simple:
+        return [{"op_type": simple[act], "name": name, "inputs": [src],
+                 "outputs": [out], "attrs": {}}]
+    if act == "gelu":
+        # exact erf form: 0.5 * x * (1 + erf(x / sqrt(2)))
+        inv_sqrt2 = ctx.add_initializer("inv_sqrt2",
+                                        np.float32(0.7071067811865476))
+        half = ctx.add_initializer("half", np.float32(0.5))
+        one = ctx.add_initializer("one", np.float32(1.0))
+        return [
+            {"op_type": "Mul", "name": f"{name}_scale",
+             "inputs": [src, inv_sqrt2], "outputs": [f"{name}_scaled"],
+             "attrs": {}},
+            {"op_type": "Erf", "name": f"{name}_erf",
+             "inputs": [f"{name}_scaled"], "outputs": [f"{name}_erfv"],
+             "attrs": {}},
+            {"op_type": "Add", "name": f"{name}_add1",
+             "inputs": [f"{name}_erfv", one], "outputs": [f"{name}_1perf"],
+             "attrs": {}},
+            {"op_type": "Mul", "name": f"{name}_mulx",
+             "inputs": [src, f"{name}_1perf"], "outputs": [f"{name}_xe"],
+             "attrs": {}},
+            {"op_type": "Mul", "name": name,
+             "inputs": [f"{name}_xe", half], "outputs": [out], "attrs": {}},
+        ]
+    raise MXNetError(f"ONNX export: unsupported fused activation {act!r}")
+
+
+@mx2onnx("_contrib_conv_epilogue")
+def _conv_epilogue_onnx(node, ins, out, attrs, ctx):
+    """Fused residual epilogue act(x + res) -> Add + activation."""
+    act = attrs.get("act_type", "relu")
+    if act in (None, "identity"):
+        return [{"op_type": "Add", "name": node.name, "inputs": list(ins),
+                 "outputs": [out], "attrs": {}}]
+    add_out = f"{node.name}_add"
+    nodes = [{"op_type": "Add", "name": f"{node.name}_sum",
+              "inputs": list(ins), "outputs": [add_out], "attrs": {}}]
+    return nodes + _act_chain(node.name, add_out, out, act, ctx)
+
+
+@mx2onnx("_contrib_matmul_epilogue")
+def _matmul_epilogue_onnx(node, ins, out, attrs, ctx):
+    """Fused matmul epilogue dropout(act(y + bias)) -> Add + activation
+    (dropout, like the plain Dropout op, is an inference no-op)."""
+    act = attrs.get("act_type")
+    if act in (None, "identity", "None"):
+        return [{"op_type": "Add", "name": node.name, "inputs": list(ins),
+                 "outputs": [out], "attrs": {}}]
+    add_out = f"{node.name}_add"
+    nodes = [{"op_type": "Add", "name": f"{node.name}_sum",
+              "inputs": list(ins), "outputs": [add_out], "attrs": {}}]
+    return nodes + _act_chain(node.name, add_out, out, act, ctx)
 
 
 @mx2onnx("Embedding")

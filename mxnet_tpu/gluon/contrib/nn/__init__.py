@@ -130,9 +130,33 @@ class MoEFFN(HybridBlock):
 
         mesh = current_mesh()
         n_tok = tok.shape[0]
-        use_a2a = (self._expert_axis in mesh.axis_names
-                   and int(mesh.shape[self._expert_axis]) == self._ne
-                   and n_tok % self._ne == 0)
+        axis_configured = self._expert_axis in mesh.axis_names
+        size_ok = (axis_configured
+                   and int(mesh.shape[self._expert_axis]) == self._ne)
+        tokens_ok = n_tok % self._ne == 0
+        use_a2a = size_ok and tokens_ok
+        if axis_configured and not use_a2a:
+            # the mesh asked for expert parallelism but the a2a path is
+            # rejected: going dense silently would lose expert
+            # parallelism AND change training numerics (no capacity
+            # dropping) with no signal — the misconfiguration class
+            # ADVICE r5 flags and elastic training (ROADMAP items 4/5)
+            # cannot tolerate. Warn loudly; the forward still runs.
+            import warnings
+            if not size_ok:
+                why = (f"mesh axis {self._expert_axis!r} has size "
+                       f"{int(mesh.shape[self._expert_axis])} but "
+                       f"num_experts={self._ne}")
+            else:
+                why = (f"token count {n_tok} is not divisible by "
+                       f"num_experts={self._ne}")
+            warnings.warn(
+                f"MoEFFN: expert-parallel all-to-all path rejected "
+                f"({why}); falling back to the DENSE formulation — "
+                f"O(E·tokens) compute and different numerics (no "
+                f"capacity dropping). Fix the mesh/batch shape, or use "
+                f"a mesh without the {self._expert_axis!r} axis to "
+                f"silence this.", RuntimeWarning, stacklevel=2)
         if use_a2a:
             def expert_fn(params_e, t):
                 ew1, eb1, ew2, eb2 = params_e

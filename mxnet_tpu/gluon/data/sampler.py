@@ -70,11 +70,16 @@ class SplitSampler(Sampler):
 
     def set_epoch(self, epoch):
         """Pin the permutation epoch explicitly (DistributedSampler
-        convention). The auto-increment in ``__iter__`` assumes every rank
-        iterates exactly once per epoch; any rank-asymmetric extra sweep
-        (a batch-count pre-pass, an eval over train data) silently
-        desynchronizes the shared permutation — call ``set_epoch`` at the
-        top of each epoch to make desync impossible."""
+        convention) — call it at the top of each epoch. The permutation
+        seed derives ONLY from this explicitly tracked epoch: ``__iter__``
+        deliberately does NOT auto-advance it, because any
+        rank-asymmetric extra sweep (a batch-count pre-pass, an eval over
+        train data, ``len(list(sampler))``) would silently desynchronize
+        the shared permutation across ranks — duplicated and missing
+        records with no signal (ADVICE r5; the exact divergence class
+        elastic multi-host training cannot tolerate, ROADMAP item 4). A
+        missed ``set_epoch`` now degrades to a repeated-but-consistent
+        order instead of silent cross-rank desync."""
         self._epoch = int(epoch)
 
     def __iter__(self):
@@ -82,7 +87,6 @@ class SplitSampler(Sampler):
             rng = np.random.RandomState(
                 (self._seed * 1000003 + self._epoch) & 0x7FFFFFFF)
             order = rng.permutation(self._length)
-            self._epoch += 1
         else:
             order = np.arange(self._length)
         lo, hi = self._bounds

@@ -85,23 +85,37 @@ class MultiHeadAttention(HybridBlock):
 
 
 class PositionwiseFFN(HybridBlock):
-    """ref ecosystem: gluonnlp PositionwiseFFN (GELU for BERT)."""
+    """ref ecosystem: gluonnlp PositionwiseFFN (GELU for BERT).
+
+    Both halves ride the guarded pallas matmul-epilogue tier
+    (docs/pallas.md): ffn_1's bias+gelu and ffn_2's bias+dropout each run
+    as ONE pass over the matmul output (dropout-in-epilogue — the BERT
+    MFU lever, docs/roadmap.md items 3-4) instead of separate bias /
+    activation / mask ops. Same params, same math; non-fusable
+    activations keep the classic layout."""
 
     def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
                  **kwargs):
         super().__init__(**kwargs)
+        from ..nn.basic_layers import _EPILOGUE_ACTS
+        fused_act = activation if activation in _EPILOGUE_ACTS else None
         with self.name_scope():
-            self.ffn_1 = nn.Dense(hidden_size, flatten=False, prefix="ffn1_")
-            self.activation = nn.GELU() if activation == "gelu" else \
-                nn.Activation(activation)
-            self.ffn_2 = nn.Dense(units, flatten=False, prefix="ffn2_")
-            self.dropout = nn.Dropout(dropout) if dropout else None
+            self.ffn_1 = nn.Dense(hidden_size, flatten=False, prefix="ffn1_",
+                                  activation=fused_act)
+            if fused_act is not None:
+                self.activation = None
+            else:
+                self.activation = nn.GELU() if activation == "gelu" else \
+                    nn.Activation(activation)
+            self.ffn_2 = nn.Dense(units, flatten=False, prefix="ffn2_",
+                                  epilogue_dropout=dropout)
 
     def hybrid_forward(self, F, x):
-        out = self.ffn_2(self.activation(self.ffn_1(x)))
-        if self.dropout is not None:
-            out = self.dropout(out)
-        return out
+        out = self.ffn_1(x)
+        if self.activation is not None:
+            out = self.activation(out)
+        # dropout is folded into ffn_2's epilogue (epilogue_dropout=)
+        return self.ffn_2(out)
 
 
 class TransformerEncoderCell(HybridBlock):

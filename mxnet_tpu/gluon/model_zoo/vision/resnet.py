@@ -32,8 +32,10 @@ class BasicBlockV1(HybridBlock):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
         self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
+        # BN + relu fused into one normalize-epilogue pass (the guarded
+        # pallas conv_epilogue tier, docs/pallas.md; no extra params so
+        # checkpoints stay interchangeable with a BN + Activation pair)
+        self.body.add(nn.BatchNorm(activation="relu"))
         self.body.add(_conv3x3(channels, 1, channels))
         self.body.add(nn.BatchNorm())
         if downsample:
@@ -50,7 +52,9 @@ class BasicBlockV1(HybridBlock):
         x = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
+        # residual add + relu as ONE fused epilogue pass — the stage-3/4
+        # bottleneck epilogue benchmarks/conv_epilogue_probe.py targeted
+        return F.contrib.conv_epilogue(x, residual)
 
 
 class BottleneckV1(HybridBlock):
@@ -62,11 +66,11 @@ class BottleneckV1(HybridBlock):
         self.body = nn.HybridSequential(prefix="")
         self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride,
                                 use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
+        # BN + relu pairs fused into one normalize-epilogue pass each
+        # (pallas conv_epilogue tier, docs/pallas.md)
+        self.body.add(nn.BatchNorm(activation="relu"))
         self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.BatchNorm(activation="relu"))
         self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
                                 use_bias=False))
         self.body.add(nn.BatchNorm())
@@ -84,7 +88,9 @@ class BottleneckV1(HybridBlock):
         x = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
+        # residual add + relu as ONE fused epilogue pass — the stage-3/4
+        # bottleneck epilogue benchmarks/conv_epilogue_probe.py targeted
+        return F.contrib.conv_epilogue(x, residual)
 
 
 class BasicBlockV2(HybridBlock):

@@ -85,16 +85,33 @@ class HybridSequential(HybridBlock):
         return iter(self._children.values())
 
 
+# activations the fused matmul-epilogue kernel handles (docs/pallas.md):
+# Dense routes these through ONE bias+act(+dropout) pass over the matmul
+# output instead of separate FullyConnected-bias / Activation ops. gelu
+# is epilogue-only (the plain Activation op has no gelu mode).
+_EPILOGUE_ACTS = ("relu", "tanh", "sigmoid", "gelu")
+
+
 class Dense(HybridBlock):
-    """y = act(x W^T + b) (ref: nn.Dense → FullyConnected op)."""
+    """y = act(x W^T + b) (ref: nn.Dense → FullyConnected op).
+
+    With ``activation`` in relu/tanh/sigmoid/gelu and a bias, the bias +
+    activation (+ ``epilogue_dropout``) run as one fused epilogue over
+    the matmul output through the guarded ``mxnet_tpu.pallas`` tier —
+    one VMEM pass on TPU, the parity-gated XLA reference elsewhere.
+    ``epilogue_dropout`` folds an inverted dropout (training only) into
+    the same pass — the dropout-in-epilogue lever from
+    docs/roadmap.md items 3-4."""
 
     def __init__(self, units, activation=None, use_bias=True, flatten=True,
                  dtype="float32", weight_initializer=None,
-                 bias_initializer="zeros", in_units=0, **kwargs):
+                 bias_initializer="zeros", in_units=0, epilogue_dropout=0.0,
+                 **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._flatten = flatten
         self._activation = activation
+        self._epilogue_dropout = float(epilogue_dropout)
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=(units, in_units), init=weight_initializer,
@@ -111,14 +128,29 @@ class Dense(HybridBlock):
         self.weight._set_shape((self._units, in_units))
 
     def hybrid_forward(self, F, x, weight, bias=None):
+        fuse = bias is not None and (
+            self._activation in _EPILOGUE_ACTS
+            or (self._activation is None and self._epilogue_dropout > 0))
+        if fuse:
+            out = F.FullyConnected(x, weight, num_hidden=self._units,
+                                   no_bias=True, flatten=self._flatten)
+            return F.contrib.matmul_epilogue(
+                out, bias, act_type=self._activation or "identity",
+                p=self._epilogue_dropout)
         if bias is None:
             out = F.FullyConnected(x, weight, num_hidden=self._units,
                                    no_bias=True, flatten=self._flatten)
         else:
             out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
                                    no_bias=False, flatten=self._flatten)
-        if self._activation is not None:
+        if self._activation == "gelu":
+            # gelu lives on the LeakyReLU op, not Activation (bias-less
+            # Dense can't take the fused-epilogue path above)
+            out = F.LeakyReLU(out, act_type="gelu")
+        elif self._activation is not None:
             out = F.Activation(out, act_type=self._activation)
+        if self._epilogue_dropout > 0:
+            out = F.Dropout(out, p=self._epilogue_dropout)
         return out
 
     def __repr__(self):
@@ -156,7 +188,8 @@ class BatchNorm(HybridBlock):
                  scale=True, use_global_stats=False,
                  beta_initializer="zeros", gamma_initializer="ones",
                  running_mean_initializer="zeros",
-                 running_variance_initializer="ones", in_channels=0, **kwargs):
+                 running_variance_initializer="ones", in_channels=0,
+                 activation=None, **kwargs):
         super().__init__(**kwargs)
         self._axis = axis
         self._momentum = momentum
@@ -164,6 +197,11 @@ class BatchNorm(HybridBlock):
         self._center = center
         self._scale = scale
         self._use_global_stats = use_global_stats
+        # activation fused into the normalize pass (docs/pallas.md):
+        # scale*x+offset and the activation run as one conv-epilogue
+        # kernel pass on TPU; no extra params, so checkpoints are
+        # interchangeable with a BatchNorm + Activation pair
+        self._activation = activation
         with self.name_scope():
             self.gamma = self.params.get(
                 "gamma", shape=(in_channels,), init=gamma_initializer,
@@ -189,11 +227,14 @@ class BatchNorm(HybridBlock):
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         from ... import autograd
+        extra = {}
+        if self._activation is not None:
+            extra["act_type"] = self._activation
         out, mean, var = F.BatchNorm(
             x, gamma, beta, running_mean, running_var,
             eps=self._epsilon, momentum=self._momentum,
-            fix_gamma=not self._scale,
-            use_global_stats=self._use_global_stats)
+            fix_gamma=not self._scale, axis=self._axis,
+            use_global_stats=self._use_global_stats, **extra)
         if autograd.is_training() and not self._use_global_stats:
             import jax.numpy as jnp
             m = self._momentum

@@ -329,8 +329,9 @@ def _flash_attention(q, k, v, block_size=512, causal=False, sm_scale=None):
     # on TPU hardware route to the hand-tiled Pallas kernel (MXU-tiled
     # blocks, VMEM-resident online softmax); the jnp blockwise kernel is
     # the portable fallback and the CPU-test oracle
-    if jax.default_backend() == "tpu" and q.shape[-2] % 128 == 0 and \
-            q.shape[-1] >= 64:
+    from ..pallas import mode as _pallas_mode
+    if jax.default_backend() == "tpu" and _pallas_mode() != "off" and \
+            q.shape[-2] % 128 == 0 and q.shape[-1] >= 64:
         try:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention as _pallas_fa)
@@ -353,6 +354,42 @@ def _flash_attention(q, k, v, block_size=512, causal=False, sm_scale=None):
                     f"jnp blockwise kernel", RuntimeWarning)
     return blockwise_attention(q, k, v, block_size=block_size,
                                causal=causal, scale=scale)
+
+
+@register("_contrib_conv_epilogue", num_inputs=2,
+          params=[OpParam("act_type", str, "relu")],
+          doc="Fused residual epilogue act(x + res) in one VMEM pass — the "
+              "RN50 conv-fusion bandwidth lever (docs/pallas.md; promoted "
+              "from benchmarks/conv_epilogue_probe.py). Dispatches the "
+              "mxnet_tpu.pallas conv_epilogue kernel on TPU; everywhere "
+              "else the parity-gated XLA reference runs (journaled "
+              "fallback), so numerics are identical across tiers within "
+              "the registered tolerance.")
+def _conv_epilogue_contrib(x, res, act_type="relu"):
+    from ..pallas import fused_conv_epilogue
+    return fused_conv_epilogue(x, res=res, act_type=act_type)
+
+
+@register("_contrib_matmul_epilogue", num_inputs=2, needs_rng=True,
+          needs_mode=True,
+          params=[OpParam("act_type", str, None),
+                  OpParam("p", float, 0.0,
+                          doc="inverted-dropout rate folded into the "
+                              "epilogue (training only); mask semantics "
+                              "bit-identical to Dropout"),
+                  OpParam("layer", int, 0),
+                  OpParam("tick", int, 0)],
+          doc="Fused matmul epilogue dropout(act(y + bias)) in one VMEM "
+              "pass over the matmul output — the BERT MFU lever "
+              "(docs/pallas.md, docs/roadmap.md items 3-4). Dropout keys "
+              "follow the PR-1 (layer, tick, shard) fold discipline. "
+              "Dispatches the mxnet_tpu.pallas matmul_epilogue kernel on "
+              "TPU with a parity-gated XLA fallback elsewhere.")
+def _matmul_epilogue_contrib(y, bias, rng=None, act_type=None, p=0.0,
+                             layer=0, tick=0, training=False):
+    from ..pallas import fused_matmul_epilogue
+    return fused_matmul_epilogue(y, bias, act_type=act_type, p=p, rng=rng,
+                                 training=training, layer=layer, tick=tick)
 
 
 @register("_contrib_ring_attention", num_inputs=3,

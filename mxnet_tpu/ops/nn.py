@@ -297,14 +297,22 @@ def _softmax_activation(x, mode="instance"):
                   OpParam("use_global_stats", bool, False),
                   OpParam("output_mean_var", bool, False),
                   OpParam("axis", int, 1),
-                  OpParam("cudnn_off", bool, False)],
+                  OpParam("cudnn_off", bool, False),
+                  OpParam("act_type", str, None,
+                          doc="fuse an activation into the normalize pass "
+                              "(the conv-epilogue lever, docs/pallas.md): "
+                              "the scale*x+offset multiply-add and the "
+                              "activation run as ONE VMEM pass through the "
+                              "mxnet_tpu.pallas conv_epilogue kernel on "
+                              "TPU, with a parity-gated XLA fallback "
+                              "elsewhere")],
           doc="Batch normalization. Inputs: data, gamma, beta, moving_mean, "
               "moving_var. Outputs: (out, batch_mean, batch_var) — like the "
               "reference's three NNVM outputs; running-stat update is done "
               "functionally by the caller (ref: src/operator/nn/batch_norm.cc)")
 def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
                 fix_gamma=True, use_global_stats=False, output_mean_var=False,
-                axis=1, cudnn_off=False, training=False):
+                axis=1, cudnn_off=False, act_type=None, training=False):
     axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
     bshape = [1] * x.ndim
     bshape[axis % x.ndim] = x.shape[axis % x.ndim]
@@ -353,8 +361,17 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
     inv = lax.rsqrt(var_norm + eps)
     scale = inv * gamma.astype(jnp.float32)
     offset = beta.astype(jnp.float32) - mean * scale
-    out = x * scale.astype(x.dtype).reshape(bshape) \
-        + offset.astype(x.dtype).reshape(bshape)
+    if act_type is None:
+        out = x * scale.astype(x.dtype).reshape(bshape) \
+            + offset.astype(x.dtype).reshape(bshape)
+    else:
+        # BN+activation epilogue through the guarded kernel tier: one
+        # VMEM pass on TPU, the numerics-contract XLA reference (same
+        # fp32 fold, journaled fallback) everywhere else
+        from ..pallas import fused_conv_epilogue
+        out = fused_conv_epilogue(
+            x, scale=scale.astype(x.dtype), bias=offset.astype(x.dtype),
+            channel_axis=axis, act_type=act_type)
     return out, mean.astype(moving_mean.dtype), var.astype(moving_var.dtype)
 
 
@@ -459,7 +476,10 @@ def _dropout(x, rng=None, p=0.5, mode="training", axes=(), training=False):
     # (docs/perf_notes.md round 3). Keep-probability granularity is
     # 1/256, immaterial for dropout rates.
     bits = jax.random.bits(rng, tuple(shape), dtype=jnp.uint8)
-    keep = bits >= jnp.uint8(min(255, int(round(p * 256))))
+    # ONE definition of the keep threshold (pallas.keep_threshold): the
+    # fused matmul-epilogue's bit-identical-mask contract depends on it
+    from ..pallas.kernels import keep_threshold
+    keep = bits >= jnp.uint8(keep_threshold(p))
     return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
 
 

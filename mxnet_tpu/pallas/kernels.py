@@ -1,0 +1,542 @@
+"""Seed kernels of the guarded Pallas tier (docs/pallas.md).
+
+Three kernels target the two profiled ceilings docs/perf_notes.md ends on:
+
+- ``conv_epilogue`` — the RN50 lever (conv fusions at ~76% of HBM
+  bandwidth): scale·y + bias + residual + activation in ONE VMEM pass,
+  promoted from ``benchmarks/conv_epilogue_probe.py``'s staged probe into
+  the library, wired behind ``ops/nn.py``'s BatchNorm ``act_type`` path,
+  the resnet-v1 residual epilogue, and ``nd.contrib.conv_epilogue``.
+- ``matmul_epilogue`` — the BERT lever (~56% MFU inside XLA's matmul
+  fusions, dropout-mask traffic measured 24% of a step pre-rbg): bias +
+  activation + inverted dropout applied in one pass over the matmul
+  output, wired behind the Gluon Dense/PositionwiseFFN path. Dropout
+  keys follow the PR-1 ``(layer, tick, shard)`` fold discipline via
+  :func:`dropout_bits`; mask semantics are bit-identical to
+  ``ops/nn.py``'s Dropout (one uint8 per element, keep = bits >= ⌈p·256⌉).
+- ``blockwise_attention`` — the existing long-context online-softmax
+  kernel (parallel/ring_attention.py), routed through the same registry
+  so every custom kernel shares one kill-switch / parity / journal story.
+
+Every kernel registers with its XLA reference and tolerance; gradients of
+the Pallas paths are ``custom_vjp`` with the reference's VJP as the
+backward (rematerialized — the backward is mathematically the reference's,
+so the parity gate bounds the full training step, not just the forward).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import dispatch, register_kernel
+
+__all__ = ["fused_conv_epilogue", "fused_matmul_epilogue", "dropout_bits",
+           "keep_threshold", "EPILOGUE_ACTS"]
+
+
+def _block(n, cap):
+    """Largest divisor of n that is <= cap (the grid must tile n exactly —
+    a floor-divided grid would leave the remainder rows unwritten)."""
+    for b in range(min(cap, n), 0, -1):
+        if n % b == 0:
+            return b
+    return 1
+
+
+def _act_fn(act_type):
+    fns = {
+        None: lambda x: x,
+        "identity": lambda x: x,
+        "relu": lambda x: jnp.maximum(x, 0.0),
+        "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+        "tanh": jnp.tanh,
+        "sigmoid": jax.nn.sigmoid,
+    }
+    try:
+        return fns[act_type]
+    except KeyError:
+        raise MXNetError(f"pallas epilogue: unknown act_type {act_type!r}; "
+                         f"one of {sorted(k for k in fns if k)}") from None
+
+
+EPILOGUE_ACTS = ("identity", "relu", "gelu", "tanh", "sigmoid")
+
+
+def _vec_spec(shape, br, bc):
+    """BlockSpec for a (1, C) column-broadcast or (R, 1) row-broadcast
+    vector riding next to (br, bc) data blocks."""
+    from jax.experimental import pallas as pl
+    if shape[0] == 1:
+        return pl.BlockSpec((1, bc), lambda i, j: (0, j))
+    return pl.BlockSpec((br, 1), lambda i, j: (i, 0))
+
+
+def _check_vec(name, v, y):
+    if v.shape not in ((1, y.shape[1]), (y.shape[0], 1)):
+        return (f"shape:{name}{v.shape}_vs_y{y.shape} (want (1, C) or "
+                f"(R, 1))")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# conv epilogue: act(scale * y + bias [+ res]) in one VMEM pass
+# ---------------------------------------------------------------------------
+def _conv_epilogue_ref(y, scale, bias, res=None, act_type="relu"):
+    """The XLA reference (the semantic contract): fp32 accumulation, cast
+    back to y's dtype — matching the kernel's internal math."""
+    out = (y.astype(jnp.float32) * scale.astype(jnp.float32)
+           + bias.astype(jnp.float32))
+    if res is not None:
+        out = out + res.astype(jnp.float32)
+    return _act_fn(act_type)(out).astype(y.dtype)
+
+
+def _conv_epilogue_call(y, scale, bias, res, act_type, interpret):
+    from jax.experimental import pallas as pl
+    r, c = y.shape
+    br = _block(r, 512)
+    bc = _block(c, 256)
+    act = _act_fn(act_type)
+    data = pl.BlockSpec((br, bc), lambda i, j: (i, j))
+
+    def kernel(y_ref, s_ref, b_ref, *rest):
+        o_ref = rest[-1]
+        out = (y_ref[...].astype(jnp.float32)
+               * s_ref[...].astype(jnp.float32)
+               + b_ref[...].astype(jnp.float32))
+        if len(rest) == 2:
+            out = out + rest[0][...].astype(jnp.float32)
+        o_ref[...] = act(out).astype(o_ref.dtype)
+
+    in_specs = [data, _vec_spec(scale.shape, br, bc),
+                _vec_spec(bias.shape, br, bc)]
+    args = [y, scale, bias]
+    if res is not None:
+        in_specs.append(data)
+        args.append(res)
+    return pl.pallas_call(
+        kernel, grid=(r // br, c // bc), in_specs=in_specs, out_specs=data,
+        out_shape=jax.ShapeDtypeStruct((r, c), y.dtype),
+        interpret=interpret)(*args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ce_res(act_type, interpret, y, scale, bias, res):
+    return _conv_epilogue_call(y, scale, bias, res, act_type, interpret)
+
+
+def _ce_res_fwd(act_type, interpret, y, scale, bias, res):
+    return (_ce_res(act_type, interpret, y, scale, bias, res),
+            (y, scale, bias, res))
+
+
+def _ce_res_bwd(act_type, interpret, saved, g):
+    y, scale, bias, res = saved
+    _, vjp = jax.vjp(
+        lambda a, s, b, r: _conv_epilogue_ref(a, s, b, r,
+                                              act_type=act_type),
+        y, scale, bias, res)
+    return vjp(g)
+
+
+_ce_res.defvjp(_ce_res_fwd, _ce_res_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ce_nores(act_type, interpret, y, scale, bias):
+    return _conv_epilogue_call(y, scale, bias, None, act_type, interpret)
+
+
+def _ce_nores_fwd(act_type, interpret, y, scale, bias):
+    return _ce_nores(act_type, interpret, y, scale, bias), (y, scale, bias)
+
+
+def _ce_nores_bwd(act_type, interpret, saved, g):
+    y, scale, bias = saved
+    _, vjp = jax.vjp(
+        lambda a, s, b: _conv_epilogue_ref(a, s, b, act_type=act_type),
+        y, scale, bias)
+    return vjp(g)
+
+
+_ce_nores.defvjp(_ce_nores_fwd, _ce_nores_bwd)
+
+
+def _conv_epilogue_supports(y, scale, bias, res=None, act_type="relu"):
+    if y.ndim != 2:
+        return f"not_2d:{y.shape}"
+    if y.size == 0:
+        return "empty"
+    if not jnp.issubdtype(y.dtype, jnp.floating):
+        return f"dtype:{y.dtype}"
+    if y.shape[1] < 8:
+        return f"minor_dim_tiny:{y.shape[1]}"
+    for name, v in (("scale", scale), ("bias", bias)):
+        bad = _check_vec(name, v, y)
+        if bad:
+            return bad
+    if scale.shape != bias.shape:
+        return f"shape:scale{scale.shape}_vs_bias{bias.shape}"
+    if res is not None and res.shape != y.shape:
+        return f"shape:res{res.shape}_vs_y{y.shape}"
+    if act_type not in (None,) + EPILOGUE_ACTS:
+        return f"act:{act_type}"
+    return None
+
+
+def _conv_epilogue_example():
+    rng = np.random.RandomState(0)
+    y = jnp.asarray(rng.randn(16, 128), jnp.float32)
+    res = jnp.asarray(rng.randn(16, 128), jnp.float32)
+    col = (jnp.asarray(rng.rand(1, 128) + 0.5, jnp.float32),
+           jnp.asarray(rng.randn(1, 128) * 0.1, jnp.float32))
+    row = (jnp.asarray(rng.rand(16, 1) + 0.5, jnp.float32),
+           jnp.asarray(rng.randn(16, 1) * 0.1, jnp.float32))
+    return [
+        ((y, col[0], col[1], res), {"act_type": "relu"}),
+        ((y, row[0], row[1], None), {"act_type": "relu"}),
+        ((y, col[0], col[1], None), {"act_type": "gelu"}),
+    ]
+
+
+@register_kernel(
+    "conv_epilogue", xla_reference=_conv_epilogue_ref, tolerance=1e-5,
+    backends=("tpu",), supports=_conv_epilogue_supports,
+    example=_conv_epilogue_example,
+    doc="act(scale*y + bias [+ res]) over 2D rows in one VMEM pass — the "
+        "RN50 conv-fusion bandwidth lever (docs/perf_notes.md; promoted "
+        "from benchmarks/conv_epilogue_probe.py). scale/bias broadcast "
+        "as (1, C) columns or (R, 1) rows.")
+def _conv_epilogue_pallas(y, scale, bias, res=None, interpret=False,
+                          act_type="relu"):
+    if res is None:
+        return _ce_nores(act_type, bool(interpret), y, scale, bias)
+    return _ce_res(act_type, bool(interpret), y, scale, bias, res)
+
+
+# ---------------------------------------------------------------------------
+# matmul epilogue: dropout(act(y + bias)) in one pass over the matmul output
+# ---------------------------------------------------------------------------
+def keep_threshold(p):
+    """uint8 keep threshold, bit-identical to ops/nn.py Dropout: one
+    random byte per element, keep where bits >= threshold."""
+    return min(255, int(round(float(p) * 256)))
+
+
+def dropout_bits(key, shape, layer=0, tick=0, shard=0):
+    """Per-call dropout bytes under the PR-1 fold discipline: the
+    (layer, tick, shard) identity folds into the key so every layer,
+    microbatch/scan tick, and shard draws an independent mask from one
+    threaded key (the correlated-mask class fixed in PR 1)."""
+    for v in (layer, tick, shard):
+        key = jax.random.fold_in(key, v)
+    return jax.random.bits(key, tuple(shape), dtype=jnp.uint8)
+
+
+def _matmul_epilogue_ref(y, bias, bits=None, act_type="gelu", p=0.0):
+    out = _act_fn(act_type)(y.astype(jnp.float32)
+                            + bias.astype(jnp.float32))
+    if bits is not None and p > 0:
+        keep = bits >= jnp.uint8(keep_threshold(p))
+        out = jnp.where(keep, out / (1.0 - p), 0.0)
+    return out.astype(y.dtype)
+
+
+def _matmul_epilogue_call(y, bias, bits, act_type, p, interpret):
+    from jax.experimental import pallas as pl
+    r, c = y.shape
+    br = _block(r, 512)
+    bc = _block(c, 256)
+    act = _act_fn(act_type)
+    data = pl.BlockSpec((br, bc), lambda i, j: (i, j))
+    thresh = keep_threshold(p)
+    inv = 1.0 / (1.0 - p) if p < 1.0 else 0.0
+
+    def kernel(y_ref, b_ref, *rest):
+        o_ref = rest[-1]
+        out = act(y_ref[...].astype(jnp.float32)
+                  + b_ref[...].astype(jnp.float32))
+        if len(rest) == 2:
+            keep = rest[0][...] >= jnp.uint8(thresh)
+            out = jnp.where(keep, out * inv, 0.0)
+        o_ref[...] = out.astype(o_ref.dtype)
+
+    in_specs = [data, _vec_spec(bias.shape, br, bc)]
+    args = [y, bias]
+    if bits is not None and p > 0:
+        in_specs.append(data)
+        args.append(bits)
+    return pl.pallas_call(
+        kernel, grid=(r // br, c // bc), in_specs=in_specs, out_specs=data,
+        out_shape=jax.ShapeDtypeStruct((r, c), y.dtype),
+        interpret=interpret)(*args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _me_drop(act_type, p, interpret, y, bias, bits):
+    return _matmul_epilogue_call(y, bias, bits, act_type, p, interpret)
+
+
+def _me_drop_fwd(act_type, p, interpret, y, bias, bits):
+    return _me_drop(act_type, p, interpret, y, bias, bits), (y, bias, bits)
+
+
+def _me_drop_bwd(act_type, p, interpret, saved, g):
+    y, bias, bits = saved
+    _, vjp = jax.vjp(
+        lambda a, b: _matmul_epilogue_ref(a, b, bits, act_type=act_type,
+                                          p=p), y, bias)
+    dy, dbias = vjp(g)
+    # integer primal: cotangent must be float0, not None
+    return dy, dbias, np.zeros(bits.shape, dtype=jax.dtypes.float0)
+
+
+_me_drop.defvjp(_me_drop_fwd, _me_drop_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _me_nodrop(act_type, interpret, y, bias):
+    return _matmul_epilogue_call(y, bias, None, act_type, 0.0, interpret)
+
+
+def _me_nodrop_fwd(act_type, interpret, y, bias):
+    return _me_nodrop(act_type, interpret, y, bias), (y, bias)
+
+
+def _me_nodrop_bwd(act_type, interpret, saved, g):
+    y, bias = saved
+    _, vjp = jax.vjp(
+        lambda a, b: _matmul_epilogue_ref(a, b, act_type=act_type), y, bias)
+    return vjp(g)
+
+
+_me_nodrop.defvjp(_me_nodrop_fwd, _me_nodrop_bwd)
+
+
+def _matmul_epilogue_supports(y, bias, bits=None, act_type="gelu", p=0.0):
+    if y.ndim != 2:
+        return f"not_2d:{y.shape}"
+    if y.size == 0:
+        return "empty"
+    if not jnp.issubdtype(y.dtype, jnp.floating):
+        return f"dtype:{y.dtype}"
+    if y.shape[1] < 8:
+        return f"minor_dim_tiny:{y.shape[1]}"
+    bad = _check_vec("bias", bias, y)
+    if bad:
+        return bad
+    if bits is not None:
+        if bits.shape != y.shape:
+            return f"shape:bits{bits.shape}_vs_y{y.shape}"
+        if bits.dtype != jnp.uint8:
+            return f"dtype:bits_{bits.dtype}"
+    if act_type not in (None,) + EPILOGUE_ACTS:
+        return f"act:{act_type}"
+    if not 0.0 <= float(p) < 1.0:
+        return f"p:{p}"
+    return None
+
+
+def _matmul_epilogue_example():
+    rng = np.random.RandomState(1)
+    y = jnp.asarray(rng.randn(32, 128), jnp.float32)
+    b = jnp.asarray(rng.randn(1, 128) * 0.1, jnp.float32)
+    bits = dropout_bits(  # graftlint: disable=G2 deterministic parity-gate fixture
+        jax.random.key(7), (32, 128), layer=1, tick=2)
+    return [
+        ((y, b, None), {"act_type": "gelu", "p": 0.0}),
+        ((y, b, bits), {"act_type": "gelu", "p": 0.3}),
+        ((y, b, bits), {"act_type": "identity", "p": 0.5}),
+    ]
+
+
+@register_kernel(
+    "matmul_epilogue", xla_reference=_matmul_epilogue_ref, tolerance=1e-5,
+    backends=("tpu",), supports=_matmul_epilogue_supports,
+    example=_matmul_epilogue_example,
+    doc="dropout(act(y + bias)) in one pass over a matmul output — the "
+        "BERT MFU lever (docs/perf_notes.md: dropout-in-epilogue, "
+        "docs/roadmap.md items 3-4). Mask semantics bit-identical to "
+        "ops/nn.py Dropout; bits come from dropout_bits() under the "
+        "PR-1 (layer, tick, shard) fold discipline.")
+def _matmul_epilogue_pallas(y, bias, bits=None, interpret=False,
+                            act_type="gelu", p=0.0):
+    if bits is None or p <= 0:
+        return _me_nodrop(act_type, bool(interpret), y, bias)
+    return _me_drop(act_type, float(p), bool(interpret), y, bias, bits)
+
+
+# ---------------------------------------------------------------------------
+# blockwise attention: the existing online-softmax kernel, same guard story
+# ---------------------------------------------------------------------------
+def _blockwise_ref(q, k, v, block_size=512, causal=False, scale=None,
+                   _chunk=2048):
+    """Dense-attention reference with the query axis chunked: the same
+    math as attention_reference (each chunk sees its exact key prefix,
+    so bottom-right causal alignment is preserved), but the score-matrix
+    footprint is bounded at chunk×S — the kill switch must not turn a
+    long-context run's O(S·block) memory into an O(S²) OOM."""
+    from ..parallel.ring_attention import attention_reference
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    s_q, s_kv = q.shape[-2], k.shape[-2]
+    if s_q <= _chunk:
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+    outs = []
+    for i in range(0, s_q, _chunk):
+        qc = q[..., i:i + _chunk, :]
+        length = qc.shape[-2]
+        if not causal:
+            outs.append(attention_reference(qc, k, v, causal=False,
+                                            scale=scale))
+            continue
+        # bottom-right alignment: global row i+r attends keys
+        # j <= i + r + (s_kv - s_q). Slicing keys to that chunk's max
+        # makes the reference's own (kmax - length) offset land exactly
+        # there; a non-positive kmax means every row's set is empty.
+        kmax = i + length + s_kv - s_q
+        if kmax <= 0:
+            outs.append(jnp.zeros(qc.shape, q.dtype))
+            continue
+        outs.append(attention_reference(
+            qc, k[..., :kmax, :], v[..., :kmax, :], causal=True,
+            scale=scale))
+    return jnp.concatenate(outs, axis=-2)
+
+
+def _blockwise_supports(q, k, v, block_size=512, causal=False, scale=None):
+    if q.shape[-1] != k.shape[-1] or k.shape[:-1] != v.shape[:-1]:
+        return f"shape:q{q.shape}_k{k.shape}_v{v.shape}"
+    if q.size == 0:
+        return "empty"
+    return None
+
+
+def _blockwise_example():
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(2, 2, 64, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 2, 64, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 2, 64, 16), jnp.float32)
+    return [
+        ((q, k, v), {"block_size": 16, "causal": False}),
+        ((q, k, v), {"block_size": 16, "causal": True}),
+    ]
+
+
+@register_kernel(
+    "blockwise_attention", xla_reference=_blockwise_ref, tolerance=2e-4,
+    backends=("tpu", "cpu", "gpu"), supports=_blockwise_supports,
+    example=_blockwise_example,
+    doc="Memory-efficient online-softmax attention over KV blocks "
+        "(parallel/ring_attention.py) — registered so the long-context "
+        "kernel shares the tier's kill-switch, parity gate, and journal "
+        "story. Portable (lax.scan), so every backend is a first-class "
+        "target; the reference materializes the full score matrix.")
+def _blockwise_pallas(q, k, v, interpret=False, block_size=512, causal=False,
+                      scale=None):
+    from ..parallel.ring_attention import _blockwise_impl
+    return _blockwise_impl(q, k, v, block_size=block_size, causal=causal,
+                           scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# N-D wrappers — the surface ops/ and gluon/ wire against
+# ---------------------------------------------------------------------------
+def fused_conv_epilogue(x, scale=None, bias=None, res=None, channel_axis=-1,
+                        act_type="relu", interpret=False):
+    """N-D entry: normalize to the 2D kernel form and dispatch.
+
+    ``scale``/``bias`` are per-channel vectors along ``channel_axis``
+    (or None for a pure residual-add epilogue). Channel-last inputs map
+    to (1, C) column broadcasts; ``channel_axis=1`` (NCHW) maps to
+    (R, 1) row broadcasts over a (N*C, spatial) view — no transpose on
+    either layout. Other axes are moved to the minor position first.
+    """
+    shape = x.shape
+    if x.ndim < 2:
+        # nothing to tile: the reference IS the op
+        s = jnp.ones((1,), x.dtype) if scale is None else scale
+        b = jnp.zeros((1,), x.dtype) if bias is None else bias
+        return _conv_epilogue_ref(x.reshape(1, -1), s.reshape(1, -1),
+                                  b.reshape(1, -1),
+                                  None if res is None
+                                  else res.reshape(1, -1),
+                                  act_type=act_type).reshape(shape)
+    ax = channel_axis % x.ndim
+    moved = False
+    if scale is None and bias is None:
+        # no per-channel vectors: any 2D view works — pick the one with
+        # the widest well-aligned minor dim for lane utilization
+        y2 = _flatten2d(x)
+        r2 = None if res is None else res.reshape(y2.shape)
+        c = y2.shape[1]
+        s2 = jnp.ones((1, c), x.dtype)
+        b2 = jnp.zeros((1, c), x.dtype)
+    elif ax == x.ndim - 1:
+        c = shape[ax]
+        y2 = x.reshape(-1, c)
+        r2 = None if res is None else res.reshape(-1, c)
+        s2 = (jnp.ones((1, c), x.dtype) if scale is None
+              else scale.reshape(1, c))
+        b2 = (jnp.zeros((1, c), x.dtype) if bias is None
+              else bias.reshape(1, c))
+    else:
+        if ax != 1:
+            x = jnp.moveaxis(x, ax, 1)
+            res = None if res is None else jnp.moveaxis(res, ax, 1)
+            shape = x.shape
+            moved = True
+        n, c = shape[0], shape[1]
+        y2 = x.reshape(n * c, -1)
+        r2 = None if res is None else res.reshape(n * c, -1)
+
+        def _rowvec(v, fill):
+            if v is None:
+                return jnp.full((n * c, 1), fill, x.dtype)
+            return jnp.tile(v.reshape(c), n).reshape(n * c, 1)
+
+        s2 = _rowvec(scale, 1)
+        b2 = _rowvec(bias, 0)
+    out = dispatch("conv_epilogue", y2, s2, b2, r2, act_type=act_type,
+                   interpret=interpret)
+    out = out.reshape(shape)
+    if moved:
+        out = jnp.moveaxis(out, 1, ax)
+    return out
+
+
+def _flatten2d(x):
+    """2D view of x maximizing a lane-aligned minor dim: the largest
+    divisor of x.size that is <= 4096 and a multiple of 128, else the
+    natural (…, last) flatten."""
+    total = int(x.size)
+    for c in range(4096, 127, -128):
+        if total % c == 0:
+            return x.reshape(total // c, c)
+    return x.reshape(-1, x.shape[-1])
+
+
+def fused_matmul_epilogue(y, bias, act_type=None, p=0.0, rng=None,
+                          training=False, layer=0, tick=0, shard=0,
+                          interpret=False):
+    """N-D entry for the matmul epilogue: dropout(act(y + bias)) with
+    ``bias`` along the minor axis. Dropout engages only in training with
+    ``p > 0`` and an rng key; bits derive via :func:`dropout_bits` under
+    the (layer, tick, shard) fold discipline."""
+    shape = y.shape
+    c = shape[-1]
+    y2 = y.reshape(-1, c)
+    b2 = (jnp.zeros((1, c), y.dtype) if bias is None
+          else bias.reshape(1, c))
+    bits = None
+    p = float(p)
+    if training and p > 0 and rng is not None:
+        bits = dropout_bits(rng, y2.shape, layer=layer, tick=tick,
+                            shard=shard)
+    out = dispatch("matmul_epilogue", y2, b2, bits, act_type=act_type,
+                   p=p if bits is not None else 0.0, interpret=interpret)
+    return out.reshape(shape)

@@ -1,0 +1,240 @@
+"""Guarded custom-kernel registry — the one gate every hand kernel runs
+through (ROADMAP item 2; docs/pallas.md).
+
+docs/perf_notes.md ends the XLA-level optimization story at two profiled
+ceilings (RN50 conv fusions at ~76% of HBM bandwidth, BERT at ~56% MFU in
+XLA's matmul fusions). Hand Pallas kernels are the named lever — but a hand
+kernel that silently changes numerics, or silently runs an unverified code
+path on a backend it was never tested on, is a worse defect class than the
+ceilings it chases. This registry is the guard:
+
+- every kernel registers as a ``(pallas_impl, xla_reference, tolerance)``
+  triple; the reference is the *semantic contract* and the tolerance is the
+  budget the implementation must meet (enforced by tests/test_pallas.py's
+  interpret-mode parity gate over every registered kernel — a kernel
+  without a passing parity gate cannot ship);
+- dispatch auto-selects the custom path only where it is verified to run
+  (``backends``), the shape is supported (``supports``), and the operator
+  has not been killed (``MXNET_TPU_PALLAS=off``); everything else falls
+  back to the XLA reference — journaled (``pallas_fallback`` records with a
+  reason) and counted, never silent;
+- per-op tier provenance (:func:`tier_provenance`) is a first-class
+  output: ``bench.py --pallas {on,off,auto}`` stamps it into the BENCH
+  artifact so an A/B number always says which tier produced it.
+
+The registry — not any one kernel — is the subsystem's deliverable: future
+hand kernels (int8 GEMMs, MoE dispatch) register here and inherit the
+parity gate, the fallback matrix, and the journal story for free.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["KernelSpec", "register_kernel", "get_kernel", "kernels",
+           "dispatch", "mode", "set_mode", "tier_provenance",
+           "reset_provenance", "MODES"]
+
+MODES = ("auto", "on", "off")
+
+_REGISTRY: Dict[str, "KernelSpec"] = {}
+_lock = threading.Lock()
+_mode_override: Optional[str] = None
+# journal dedupe + provenance: dispatch runs per eager op call (and per
+# trace under jit) — one journal line per (kernel, reason) per process,
+# with full counts kept in the provenance table instead
+_journaled: set = set()
+_prov: Dict[str, Dict] = {}
+
+
+@dataclass
+class KernelSpec:
+    """One guarded custom kernel: the impl, its semantic contract, and the
+    selection gates.
+
+    ``pallas_impl(*args, interpret=False, **params)`` and
+    ``xla_reference(*args, **params)`` share one signature; parity within
+    ``tolerance`` (max abs error on fp32-cast outputs) is enforced by the
+    registration-time test gate over ``example()``'s representative
+    arguments, so registering a kernel without a passing gate fails CI,
+    and the tier can never silently change numerics."""
+
+    name: str
+    pallas_impl: Callable
+    xla_reference: Callable
+    tolerance: float
+    backends: Tuple[str, ...] = ("tpu",)
+    supports: Optional[Callable] = None   # (*args, **params) -> None | reason
+    example: Optional[Callable] = None    # () -> (args, params) for the gate
+    doc: str = ""
+    differentiable: bool = True
+
+
+def register_kernel(name: str, *, xla_reference: Callable, tolerance: float,
+                    backends: Sequence[str] = ("tpu",),
+                    supports: Optional[Callable] = None,
+                    example: Optional[Callable] = None,
+                    doc: str = "", differentiable: bool = True):
+    """Decorator registering ``fn`` as the custom impl of kernel ``name``."""
+    def deco(fn):
+        with _lock:
+            if name in _REGISTRY:
+                raise MXNetError(f"duplicate pallas kernel registration: "
+                                 f"{name!r}")
+            _REGISTRY[name] = KernelSpec(
+                name=name, pallas_impl=fn, xla_reference=xla_reference,
+                tolerance=float(tolerance), backends=tuple(backends),
+                supports=supports, example=example,
+                doc=doc or (fn.__doc__ or ""),
+                differentiable=differentiable)
+        return fn
+    return deco
+
+
+def get_kernel(name: str) -> KernelSpec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise MXNetError(
+            f"pallas kernel {name!r} is not registered "
+            f"({sorted(_REGISTRY)} known)") from None
+
+
+def kernels() -> Dict[str, KernelSpec]:
+    """Snapshot of the registry (name -> spec), for the parity gate."""
+    from . import kernels as _k   # noqa: F401  (registration side effect)
+    with _lock:
+        return dict(sorted(_REGISTRY.items()))
+
+
+# ---------------------------------------------------------------------------
+# mode / backend resolution
+# ---------------------------------------------------------------------------
+def mode() -> str:
+    """Effective tier mode: ``set_mode`` override, else the
+    ``MXNET_TPU_PALLAS`` env knob, else ``auto``. A malformed knob value
+    degrades to ``auto`` (journaled once) — a typo in an env var must
+    never flip a training run onto an unverified path OR kill it."""
+    if _mode_override is not None:
+        return _mode_override
+    raw = os.environ.get("MXNET_TPU_PALLAS", "auto").strip().lower()
+    if raw in MODES:
+        return raw
+    _journal_once("__mode__", f"bad_mode:{raw}",
+                  detail=f"MXNET_TPU_PALLAS={raw!r} not in {MODES}; "
+                         f"using 'auto'")
+    return "auto"
+
+
+def set_mode(value: Optional[str]) -> None:
+    """Process-level override of the env knob (``None`` resets). The
+    bench A/B flag and tests use this; production selection should use
+    the env var so child processes inherit it."""
+    global _mode_override
+    if value is not None and value not in MODES:
+        raise MXNetError(f"pallas mode must be one of {MODES}; "
+                         f"got {value!r}")
+    _mode_override = value
+
+
+def _backend() -> str:
+    """Call-time backend name. ``jax.default_backend()`` here is a
+    call-time dial like ops/contrib.py's — never at import (G1)."""
+    import jax
+    try:
+        return jax.default_backend()
+    except RuntimeError:        # backend not initializable: act like CPU
+        return "cpu"
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+def _journal_once(kernel: str, reason: str, **fields) -> None:
+    key = (kernel, reason)
+    with _lock:
+        if key in _journaled:
+            return
+        _journaled.add(key)
+    from ..diagnostics import get_journal
+    get_journal().event("pallas_fallback", kernel=kernel, reason=reason,
+                        **fields)
+
+
+def _note(kernel: str, tier: str, reason: Optional[str] = None) -> None:
+    with _lock:
+        rec = _prov.setdefault(kernel, {"pallas": 0, "xla": 0,
+                                        "fallback_reasons": {}})
+        rec[tier] += 1
+        if reason:
+            rr = rec["fallback_reasons"]
+            rr[reason] = rr.get(reason, 0) + 1
+
+
+def tier_provenance() -> Dict[str, Dict]:
+    """Per-kernel dispatch accounting since process start (or the last
+    :func:`reset_provenance`): how many times each tier ran and why the
+    XLA tier was chosen. Counts are per *dispatch decision* — once per
+    eager op call, once per trace under jit — which is exactly the
+    provenance a BENCH artifact needs ("which tier compiled into the
+    measured program")."""
+    with _lock:
+        return {k: {"pallas": v["pallas"], "xla": v["xla"],
+                    "fallback_reasons": dict(v["fallback_reasons"])}
+                for k, v in sorted(_prov.items())}
+
+
+def reset_provenance() -> None:
+    with _lock:
+        _prov.clear()
+        _journaled.clear()
+
+
+def dispatch(name: str, *args, interpret: bool = False, **params):
+    """Run kernel ``name``: the custom tier where it is verified to
+    apply, the XLA reference everywhere else.
+
+    Selection order (first hit wins, reason journaled once + counted):
+
+    1. ``mode() == "off"`` — the kill switch beats everything, including
+       ``interpret`` (an operator turning the tier off must get the
+       reference, period).
+    2. ``supports`` rejects the concrete shapes/dtypes — unsupported
+       inputs fall back *before* the backend gate so the reason an
+       operator sees on any host names the real blocker.
+    3. backend not in ``spec.backends`` — unless ``interpret=True``,
+       which runs the custom impl in interpret mode (the CPU parity
+       gate's path; never the default on any backend).
+
+    ``mode() == "on"`` does not force an unsupported kernel onto the
+    hardware — it makes every fallback LOUD (a ``RuntimeWarning`` on top
+    of the journal line), for A/B runs that must not quietly measure the
+    reference tier.
+    """
+    spec = get_kernel(name)
+    m = mode()
+    reason = None
+    if m == "off":
+        reason = "mode_off"
+    if reason is None and spec.supports is not None:
+        reason = spec.supports(*args, **params)
+    if reason is None and not interpret:
+        backend = _backend()
+        if backend not in spec.backends:
+            reason = f"backend:{backend}"
+    if reason is None:
+        _note(name, "pallas")
+        return spec.pallas_impl(*args, interpret=interpret, **params)
+    _note(name, "xla", reason)
+    _journal_once(name, reason, mode=m)
+    if m == "on" and reason != "mode_off":
+        import warnings
+        warnings.warn(
+            f"pallas kernel {name!r} fell back to the XLA reference "
+            f"({reason}) despite MXNET_TPU_PALLAS=on", RuntimeWarning,
+            stacklevel=2)
+    return spec.xla_reference(*args, **params)

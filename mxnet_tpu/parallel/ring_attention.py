@@ -81,7 +81,21 @@ def _online_block(carry, q, k_blk, v_blk, scale, mask=None):
 
 
 def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None):
-    """Memory-efficient attention over KV blocks (inputs [..., S, D])."""
+    """Memory-efficient attention over KV blocks (inputs [..., S, D]).
+
+    Routed through the ``mxnet_tpu.pallas`` kernel registry: the online-
+    softmax kernel is the custom tier (parity-gated against
+    ``attention_reference`` by tests/test_pallas.py), so it shares the
+    tier's kill-switch (``MXNET_TPU_PALLAS=off`` falls back to the dense
+    reference), journaled-fallback, and provenance story with every other
+    hand kernel."""
+    from ..pallas import dispatch
+    return dispatch("blockwise_attention", q, k, v, block_size=block_size,
+                    causal=causal, scale=scale)
+
+
+def _blockwise_impl(q, k, v, block_size=512, causal=False, scale=None):
+    """The kernel body (dispatch target — call blockwise_attention)."""
     d = q.shape[-1]
     s_k = k.shape[-2]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)

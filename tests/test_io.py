@@ -510,7 +510,16 @@ def test_split_sampler():
     epoch1 = [list(s) for s in samplers]
     assert sorted(x for part in epoch1 for x in part) == list(range(n))
     assert sum(len(s) for s in samplers) == n
-    # next epoch reshuffles (and stays a partition)
+    # without set_epoch the order REPEATS (consistent across ranks) —
+    # a rank-asymmetric extra sweep can no longer desync the shared
+    # permutation (ADVICE r5: __iter__ must not auto-advance the epoch)
+    assert [list(s) for s in samplers] == epoch1
+    # an asymmetric extra iteration on one rank leaves the partition
+    # intact for the next pinned epoch
+    list(samplers[0])
+    # explicit set_epoch reshuffles (and stays a partition)
+    for s in samplers:
+        s.set_epoch(1)
     epoch2 = [list(s) for s in samplers]
     assert sorted(x for part in epoch2 for x in part) == list(range(n))
     assert epoch1 != epoch2

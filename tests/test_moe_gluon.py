@@ -110,3 +110,34 @@ def test_moe_ffn_bad_activation():
     ffn.initialize()
     with pytest.raises(MXNetError, match="activation"):
         ffn(mx.nd.ones((4, U)))
+
+
+def test_moe_ffn_rejected_a2a_warns_not_silent():
+    """ADVICE r5: when the configured expert axis EXISTS in the mesh but
+    the a2a path is rejected, the dense fallback must warn — a
+    misconfigured large-scale run losing expert parallelism (and
+    changing numerics: no capacity dropping) must never be silent."""
+    import warnings
+    ffn = _block()
+    rng = np.random.RandomState(3)
+    # axis-size mismatch: expert axis of 2 vs num_experts=4
+    mesh = parallel.make_mesh({"data": 4, "expert": 2})
+    x = mx.nd.array(rng.randn(16, U).astype(np.float32))
+    with parallel.use_mesh(mesh):
+        with pytest.warns(RuntimeWarning, match="size 2.*num_experts=4"):
+            y = ffn(x)
+    assert y.shape == (16, U)
+    # indivisible tokens: 4x1 mesh matches num_experts but 6 tokens % 4 != 0
+    mesh = parallel.make_mesh({"data": 2, "expert": 4})
+    x = mx.nd.array(rng.randn(6, U).astype(np.float32))
+    with parallel.use_mesh(mesh):
+        with pytest.warns(RuntimeWarning, match="not divisible"):
+            y = ffn(x)
+    assert y.shape == (6, U)
+    # no expert axis at all: plain dense use, NO warning
+    mesh = parallel.make_mesh({"data": 8})
+    x = mx.nd.array(rng.randn(16, U).astype(np.float32))
+    with parallel.use_mesh(mesh):
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            ffn(x)

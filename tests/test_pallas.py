@@ -1,0 +1,396 @@
+"""The guarded custom-kernel tier (mxnet_tpu/pallas/, docs/pallas.md):
+interpret-mode parity for EVERY registered kernel vs its XLA reference
+(the registration-time numerics gate), fallback selection (non-TPU
+backend, unsupported shape, env kill-switch — each journaled with a
+reason), gradient parity through the custom_vjp paths, dropout-key
+independence under the PR-1 (layer, tick, shard) fold discipline, and
+the gluon/ops wiring (Dense epilogue, BatchNorm act_type, resnet
+residual epilogue, blockwise-attention routing, bench A/B flag)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd, pallas
+from mxnet_tpu.base import MXNetError
+
+
+@pytest.fixture
+def clean_tier(monkeypatch):
+    """Pristine tier state: auto mode, empty provenance."""
+    monkeypatch.delenv("MXNET_TPU_PALLAS", raising=False)
+    pallas.set_mode(None)
+    pallas.reset_provenance()
+    yield
+    pallas.set_mode(None)
+    pallas.reset_provenance()
+
+
+# -- the registration-time parity gate ---------------------------------------
+
+def _cases():
+    out = []
+    for name, spec in pallas.kernels().items():
+        assert spec.example is not None, \
+            f"kernel {name!r} registered without example() — the parity " \
+            f"gate cannot cover it"
+        for i, (args, params) in enumerate(spec.example()):
+            out.append(pytest.param(name, i, id=f"{name}-{i}"))
+    return out
+
+
+@pytest.mark.parametrize("name,case", _cases())
+def test_parity_gate_smoke(name, case, clean_tier):
+    """EVERY registered kernel passes its CPU interpret-mode parity gate
+    vs the XLA reference within the registered tolerance — the contract
+    that lets the tier claim it can never silently change numerics."""
+    spec = pallas.get_kernel(name)
+    args, params = spec.example()[case]
+    got = np.asarray(spec.pallas_impl(*args, interpret=True, **params),
+                     np.float32)
+    want = np.asarray(spec.xla_reference(*args, **params), np.float32)
+    err = float(np.abs(got - want).max())
+    assert err <= spec.tolerance, \
+        f"{name} case {case}: max err {err} > tolerance {spec.tolerance}"
+
+
+def test_parity_gate_covers_shape_and_dtype(clean_tier):
+    import jax.numpy as jnp
+    for name, spec in pallas.kernels().items():
+        for args, params in spec.example():
+            got = spec.pallas_impl(*args, interpret=True, **params)
+            want = spec.xla_reference(*args, **params)
+            assert got.shape == want.shape
+            assert jnp.result_type(got) == jnp.result_type(want)
+
+
+def test_grads_match_reference_smoke(clean_tier):
+    """The custom_vjp paths (pallas forward, reference VJP backward)
+    agree with differentiating the reference end-to-end — scale/bias
+    vectors included, so BN's gamma/beta gradients are covered."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(3)
+    y = jnp.asarray(rng.randn(16, 128), jnp.float32)
+    s = jnp.asarray(rng.rand(1, 128) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(1, 128) * 0.1, jnp.float32)
+    res = jnp.asarray(rng.randn(16, 128), jnp.float32)
+    spec = pallas.get_kernel("conv_epilogue")
+
+    def loss_p(y, s, b, res):
+        return (spec.pallas_impl(y, s, b, res, interpret=True,
+                                 act_type="relu") ** 2).sum()
+
+    def loss_r(y, s, b, res):
+        return (spec.xla_reference(y, s, b, res, act_type="relu") ** 2).sum()
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2, 3))(y, s, b, res)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2, 3))(y, s, b, res)
+    for a, bb in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-4)
+    # matmul epilogue with dropout folded in
+    mspec = pallas.get_kernel("matmul_epilogue")
+    bits = pallas.dropout_bits(jax.random.key(5), (16, 128))
+    gp = jax.grad(lambda v: (mspec.pallas_impl(
+        v, b, bits, interpret=True, act_type="gelu", p=0.3) ** 2).sum())(y)
+    gr = jax.grad(lambda v: (mspec.xla_reference(
+        v, b, bits, act_type="gelu", p=0.3) ** 2).sum())(y)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                               rtol=1e-4, atol=1e-4)
+
+
+# -- fallback selection (the guard half of the tier) -------------------------
+
+def _journal_records(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_fallback_non_tpu_backend_is_journaled_smoke(clean_tier, tmp_path):
+    """The default CPU path never executes the unverified kernel: the
+    dispatch falls back to the reference and journals why."""
+    import jax.numpy as jnp
+    from mxnet_tpu.diagnostics import reset_journal
+    jpath = str(tmp_path / "journal.jsonl")
+    reset_journal(jpath)
+    try:
+        y = jnp.ones((16, 128))
+        s, b = jnp.ones((1, 128)), jnp.zeros((1, 128))
+        out = pallas.dispatch("conv_epilogue", y, s, b, None,
+                              act_type="relu")
+        assert out.shape == (16, 128)
+    finally:
+        reset_journal(None)
+    prov = pallas.tier_provenance()["conv_epilogue"]
+    assert prov["pallas"] == 0 and prov["xla"] == 1
+    assert prov["fallback_reasons"] == {"backend:cpu": 1}
+    recs = [r for r in _journal_records(jpath)
+            if r["kind"] == "pallas_fallback"]
+    assert len(recs) == 1
+    assert recs[0]["kernel"] == "conv_epilogue"
+    assert recs[0]["reason"] == "backend:cpu"
+    # dedupe: a second identical fallback journals nothing new but counts
+    pallas.dispatch("conv_epilogue", y, s, b, None, act_type="relu")
+    assert pallas.tier_provenance()["conv_epilogue"]["xla"] == 2
+
+
+def test_fallback_unsupported_shape(clean_tier):
+    """supports() rejection falls back with the concrete reason — even
+    when interpret would otherwise force the custom path."""
+    import jax.numpy as jnp
+    y = jnp.ones((4, 2))          # minor dim below the tier's floor
+    s, b = jnp.ones((1, 2)), jnp.zeros((1, 2))
+    out = pallas.dispatch("conv_epilogue", y, s, b, None,
+                          act_type="relu", interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.ones((4, 2)))
+    reasons = pallas.tier_provenance()["conv_epilogue"]["fallback_reasons"]
+    assert any(r.startswith("minor_dim_tiny") for r in reasons)
+    # int input: dtype gate
+    pallas.dispatch("conv_epilogue", jnp.ones((16, 128), jnp.int32),
+                    jnp.ones((1, 128), jnp.int32),
+                    jnp.zeros((1, 128), jnp.int32), None, act_type="relu",
+                    interpret=True)
+    reasons = pallas.tier_provenance()["conv_epilogue"]["fallback_reasons"]
+    assert any(r.startswith("dtype") for r in reasons)
+
+
+def test_kill_switch_env_beats_interpret(clean_tier, monkeypatch):
+    """MXNET_TPU_PALLAS=off is absolute: even a forced interpret dispatch
+    gets the reference."""
+    import jax.numpy as jnp
+    monkeypatch.setenv("MXNET_TPU_PALLAS", "off")
+    y = jnp.ones((16, 128))
+    s, b = jnp.ones((1, 128)), jnp.zeros((1, 128))
+    pallas.dispatch("conv_epilogue", y, s, b, None, act_type="relu",
+                    interpret=True)
+    prov = pallas.tier_provenance()["conv_epilogue"]
+    assert prov["pallas"] == 0
+    assert prov["fallback_reasons"] == {"mode_off": 1}
+
+
+def test_malformed_mode_degrades_to_auto(clean_tier, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_PALLAS", "bogus")
+    assert pallas.mode() == "auto"
+    with pytest.raises(MXNetError):
+        pallas.set_mode("bogus")
+
+
+def test_mode_on_makes_fallback_loud(clean_tier):
+    import jax.numpy as jnp
+    pallas.set_mode("on")
+    y = jnp.ones((16, 128))
+    s, b = jnp.ones((1, 128)), jnp.zeros((1, 128))
+    with pytest.warns(RuntimeWarning, match="fell back"):
+        pallas.dispatch("conv_epilogue", y, s, b, None, act_type="relu")
+
+
+def test_duplicate_registration_rejected(clean_tier):
+    spec = pallas.get_kernel("conv_epilogue")
+    with pytest.raises(MXNetError, match="duplicate"):
+        pallas.register_kernel(
+            "conv_epilogue", xla_reference=spec.xla_reference,
+            tolerance=1.0)(spec.pallas_impl)
+
+
+# -- dropout-key independence (PR-1 fold discipline) -------------------------
+
+def test_dropout_key_independence_smoke(clean_tier):
+    """(layer, tick, shard) fold into the key: any identity change gives
+    an independent mask; the same identity is deterministic."""
+    import jax
+    key = jax.random.key(11)
+    base = np.asarray(pallas.dropout_bits(key, (64, 128)))
+    same = np.asarray(pallas.dropout_bits(key, (64, 128)))
+    np.testing.assert_array_equal(base, same)
+    varied = [np.asarray(pallas.dropout_bits(key, (64, 128), **kw))
+              for kw in ({"layer": 1}, {"tick": 1}, {"shard": 1},
+                         {"layer": 1, "tick": 2, "shard": 3})]
+    for v in varied:
+        frac = float((v != base).mean())
+        assert frac > 0.9          # independent uint8 draws differ a.s.
+    # and through the fused epilogue: different ticks -> different masks
+    import jax.numpy as jnp
+    y = jnp.ones((64, 128))
+    b = jnp.zeros((1, 128))
+    outs = [np.asarray(pallas.fused_matmul_epilogue(
+        y, b, act_type="identity", p=0.5, rng=key, training=True,
+        tick=t, interpret=True)) for t in (0, 1)]
+    assert (outs[0] != outs[1]).mean() > 0.3
+    kept = outs[0] != 0
+    np.testing.assert_allclose(outs[0][kept], 2.0)   # inverted scaling
+
+
+# -- wiring: gluon / ops / model-zoo surfaces --------------------------------
+
+def test_dense_fused_epilogue_matches_unfused(clean_tier):
+    from mxnet_tpu import gluon
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(4, 32).astype(np.float32))
+    fused = gluon.nn.Dense(16, activation="relu", in_units=32)
+    fused.initialize()
+    y = fused(x).asnumpy()
+    w = fused.weight.data().asnumpy()
+    b = fused.bias.data().asnumpy()
+    want = np.maximum(x.asnumpy() @ w.T + b, 0.0)
+    np.testing.assert_allclose(y, want, rtol=1e-5, atol=1e-6)
+    # gelu is epilogue-only (plain Activation has no gelu mode) — new
+    # capability unlocked by the tier
+    import jax
+    g = gluon.nn.Dense(16, activation="gelu", in_units=32)
+    g.initialize()
+    yg = g(x).asnumpy()
+    wantg = np.asarray(jax.nn.gelu(
+        x.asnumpy() @ g.weight.data().asnumpy().T
+        + g.bias.data().asnumpy(), approximate=False))
+    np.testing.assert_allclose(yg, wantg, rtol=1e-5, atol=1e-6)
+
+
+def test_dense_epilogue_dropout_train_eval(clean_tier):
+    from mxnet_tpu import autograd, gluon
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(8, 32).astype(np.float32))
+    net = gluon.nn.Dense(64, activation="relu", in_units=32,
+                         epilogue_dropout=0.5)
+    net.initialize()
+    y_eval = net(x).asnumpy()           # inference: dropout is a no-op
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    np.testing.assert_allclose(
+        y_eval, np.maximum(x.asnumpy() @ w.T + b, 0.0),
+        rtol=1e-5, atol=1e-6)
+    with autograd.record():
+        y_tr = net(x).asnumpy()
+    kept = y_tr != 0
+    # inverted dropout: kept activations are scaled by 1/(1-p)
+    np.testing.assert_allclose(y_tr[kept], (y_eval * 2.0)[kept],
+                               rtol=1e-5, atol=1e-6)
+    assert 0.2 < float(kept.mean()) < 0.9
+
+
+def test_batchnorm_activation_fused_parity(clean_tier):
+    """BatchNorm(activation=...) == BatchNorm() + Activation, train and
+    eval, NCHW (row-broadcast path) and channel-last (col-broadcast)."""
+    from mxnet_tpu import autograd, gluon
+    rng = np.random.RandomState(2)
+    for axis, shape in ((1, (4, 8, 6, 6)), (-1, (4, 6, 8))):
+        x = nd.array(rng.randn(*shape).astype(np.float32))
+        fused = gluon.nn.BatchNorm(axis=axis, activation="relu")
+        plain = gluon.nn.BatchNorm(axis=axis)
+        fused.initialize()
+        plain.initialize()
+        for train in (True, False):
+            if train:
+                with autograd.record():
+                    a = fused(x).asnumpy()
+                with autograd.record():
+                    b = nd.relu(plain(x)).asnumpy()
+            else:
+                a = fused(x).asnumpy()
+                b = nd.relu(plain(x)).asnumpy()
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_contrib_conv_epilogue_matches_add_relu(clean_tier):
+    rng = np.random.RandomState(4)
+    x = nd.array(rng.randn(2, 8, 4, 4).astype(np.float32))
+    r = nd.array(rng.randn(2, 8, 4, 4).astype(np.float32))
+    got = nd.contrib.conv_epilogue(x, r).asnumpy()
+    want = np.maximum(x.asnumpy() + r.asnumpy(), 0.0)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_positionwise_ffn_fused_parity_eval(clean_tier):
+    """The fused FFN (bias+gelu epilogue on ffn_1, bias+dropout epilogue
+    on ffn_2) equals the classic composition in eval mode."""
+    import jax
+    from mxnet_tpu.gluon.model_zoo.bert import PositionwiseFFN
+    ffn = PositionwiseFFN(units=16, hidden_size=32, dropout=0.4)
+    ffn.initialize()
+    assert ffn.ffn_1._activation == "gelu"
+    assert ffn.ffn_2._epilogue_dropout == pytest.approx(0.4)
+    rng = np.random.RandomState(5)
+    x = nd.array(rng.randn(2, 3, 16).astype(np.float32))
+    got = ffn(x).asnumpy()
+    w1 = ffn.ffn_1.weight.data().asnumpy()
+    b1 = ffn.ffn_1.bias.data().asnumpy()
+    w2 = ffn.ffn_2.weight.data().asnumpy()
+    b2 = ffn.ffn_2.bias.data().asnumpy()
+    h = np.asarray(jax.nn.gelu(x.asnumpy() @ w1.T + b1,
+                               approximate=False))
+    want = h @ w2.T + b2
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_blockwise_attention_routes_through_registry(clean_tier,
+                                                     monkeypatch):
+    """The long-context kernel shares the tier's guard story: auto mode
+    runs the online-softmax kernel (a verified backend on CPU), the kill
+    switch falls back to the dense reference."""
+    from mxnet_tpu.parallel.ring_attention import (attention_reference,
+                                                   blockwise_attention)
+    import jax.numpy as jnp
+    rng = np.random.RandomState(6)
+    q = jnp.asarray(rng.randn(2, 2, 32, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 2, 32, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 2, 32, 8), jnp.float32)
+    out = blockwise_attention(q, k, v, block_size=8, causal=True)
+    prov = pallas.tier_provenance()["blockwise_attention"]
+    assert prov["pallas"] == 1          # cpu IS a verified backend here
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    monkeypatch.setenv("MXNET_TPU_PALLAS", "off")
+    out2 = blockwise_attention(q, k, v, block_size=8, causal=True)
+    prov = pallas.tier_provenance()["blockwise_attention"]
+    assert prov["fallback_reasons"].get("mode_off") == 1
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_bench_pallas_flag(clean_tier, monkeypatch, capsys):
+    """bench.py --pallas {on,off,auto}: valid modes export the env knob
+    for the deadlined child; an invalid mode is a structured one-line
+    diagnostic, not a crash."""
+    import importlib
+    bench = importlib.import_module("bench")
+    assert bench._parse_pallas_flag(["bench.py", "--pallas", "off"]) == "off"
+    assert bench._parse_pallas_flag(["bench.py", "--pallas=on"]) == "on"
+    assert bench._parse_pallas_flag(["bench.py"]) is None
+    monkeypatch.setattr("sys.argv", ["bench.py", "--pallas", "sideways"])
+    monkeypatch.delenv("MXNET_TPU_PALLAS", raising=False)
+    rc = bench.main()
+    assert rc == 2
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["error"] == "bad_flag"
+    assert rec["metric"] == bench.METRIC
+    # valid flag exports the knob (parent env -> child inherits)
+    monkeypatch.setattr("sys.argv", ["bench.py", "--pallas", "off",
+                                     "--body"])
+    monkeypatch.setattr(bench, "_run_body", lambda: 0)
+    assert bench.main() == 0
+    assert os.environ["MXNET_TPU_PALLAS"] == "off"
+
+
+def test_blockwise_reference_chunking_is_exact(clean_tier):
+    """The kill-switch fallback for attention chunks its query axis
+    (bounded score-matrix memory) — same math as the unchunked dense
+    reference, bottom-right causal alignment included, s_q != s_kv and
+    empty-row edges covered."""
+    import jax.numpy as jnp
+    from mxnet_tpu.pallas.kernels import _blockwise_ref
+    from mxnet_tpu.parallel.ring_attention import attention_reference
+    rng = np.random.RandomState(7)
+    cases = [(40, 40), (48, 32), (32, 48)]   # square, s_q>s_kv, s_q<s_kv
+    for s_q, s_kv in cases:
+        q = jnp.asarray(rng.randn(2, 2, s_q, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 2, s_kv, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 2, s_kv, 8), jnp.float32)
+        for causal in (False, True):
+            got = _blockwise_ref(q, k, v, causal=causal, _chunk=16)
+            want = attention_reference(q, k, v, causal=causal)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5,
+                err_msg=f"s_q={s_q} s_kv={s_kv} causal={causal}")
