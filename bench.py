@@ -173,7 +173,15 @@ def _run_body():
 
     n_chips = len(jax.devices())
     img_per_sec_per_chip = batch * steps * k / best_dt / n_chips
-    from mxnet_tpu import pallas
+    from mxnet_tpu import observability, pallas
+    # telemetry provenance (docs/observability.md): compile counts/times
+    # and step-phase p50/p95 ride the artifact — the ROADMAP item-2
+    # hardware A/B needs exactly this on the first healthy window
+    obs = observability.snapshot()
+    comp = observability.compile_stats(obs)
+    print(f"bench: compiles={comp['compiles']} "
+          f"total={comp['total_ms']}ms by_site={comp['by_site']}",
+          file=sys.stderr)
     _emit({
         "metric": METRIC,
         "value": round(img_per_sec_per_chip, 2),
@@ -190,6 +198,11 @@ def _run_body():
         # steps than dispatched (and guard overhead is visible in the
         # throughput number either way)
         "skipped_steps": int(trainer.skipped_steps),
+        # observability snapshot: compile counts/times + step-phase
+        # p50/p95 (always-on host metrics; tracing itself stays off
+        # unless MXNET_TPU_TRACE is exported) — `doctor --metrics` on
+        # this artifact reads it back
+        "observability": obs,
     })
 
 

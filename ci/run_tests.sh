@@ -87,6 +87,13 @@ python -m pytest tests/test_guardrails.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: pallas interpret smoke (kernel parity gate) =="
 python -m pytest tests/test_pallas.py -q -k smoke -p no:cacheprovider
 
+# observability smoke: one traced training step + one traced serving
+# request -> the Chrome-trace/Perfetto export and the Prometheus
+# exposition both parse, with compile events and linked request span
+# trees present (docs/observability.md)
+echo "== tier 0.5: observability smoke (trace + exporters) =="
+python -m pytest tests/test_observability.py -q -k smoke -p no:cacheprovider
+
 # quick unit tier: core ndarray/op/autograd/gluon/io surface, no
 # model-zoo or multi-process tests (ref: runtime_functions.sh unittest
 # vs nightly split)

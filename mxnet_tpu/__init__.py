@@ -97,6 +97,7 @@ from . import test_utils
 from . import operator
 from . import runtime
 from . import diagnostics
+from . import observability    # stdlib-only telemetry substrate
 from . import guardrails       # import-light root; fused loads lazily
 from . import resilience
 from . import serving          # lazy package: submodules load on first use

@@ -1047,3 +1047,90 @@ class DirectPallasCall(Rule):
                     "kernel tier's parity/fallback guard — register the "
                     "kernel in mxnet_tpu/pallas/ and dispatch through "
                     "the registry")
+
+
+@register
+class WallclockDuration(Rule):
+    code = "G11"
+    name = "wallclock-duration"
+    severity = "error"
+    doc = ("`time.time()` used in duration arithmetic in library code. "
+           "The wall clock steps under NTP adjustment, so a "
+           "`time.time() - t0` duration can go NEGATIVE (or jump hours) "
+           "mid-run — poisoning journal durations, latency summaries "
+           "and Time-cost logs. Durations must come from "
+           "`time.monotonic()` / `time.perf_counter()`; wall clock is "
+           "only for timestamps (a bare `time.time()` with no "
+           "subtraction is fine). Per-function scope: a name assigned "
+           "from time.time() taints subtractions in the same scope. "
+           "Scope: mxnet_tpu/ library code.")
+
+    WALL = "time.time"
+
+    def _scopes(self, tree):
+        """(scope_body_nodes) per function/module, nested functions
+        excluded from their parent (their taint is their own)."""
+        scopes = [tree]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                scopes.append(node)
+        return scopes
+
+    def _walk_scope(self, scope):
+        """Nodes belonging to this scope only (stop at nested function
+        boundaries)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _is_wall_call(self, ctx, node):
+        return isinstance(node, ast.Call) and \
+            ctx.resolve_call(node) == self.WALL
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        for scope in self._scopes(ctx.tree):
+            # line-ordered taint flow: an assignment from time.time()
+            # taints its name, a later reassignment from anything else
+            # clears it — so rebinding a variable to monotonic doesn't
+            # keep a stale error on correct code
+            events = []     # (lineno, order, kind, payload)
+            for node in self._walk_scope(scope):
+                if isinstance(node, ast.Assign):
+                    wall = self._is_wall_call(ctx, node.value)
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            events.append((node.lineno, 1,
+                                           "taint" if wall else "clear",
+                                           tgt.id))
+                elif isinstance(node, ast.BinOp) and \
+                        isinstance(node.op, ast.Sub):
+                    events.append((node.lineno, 0, "sub", node))
+            tainted = set()
+            for _ln, _order, kind, payload in sorted(
+                    events, key=lambda e: (e[0], e[1])):
+                if kind == "taint":
+                    tainted.add(payload)
+                    continue
+                if kind == "clear":
+                    tainted.discard(payload)
+                    continue
+                node = payload
+                for side in (node.left, node.right):
+                    if self._is_wall_call(ctx, side) or \
+                            (isinstance(side, ast.Name)
+                             and side.id in tainted):
+                        yield self.finding(
+                            ctx, node.lineno,
+                            "duration computed from time.time() — the "
+                            "wall clock steps under NTP; use "
+                            "time.monotonic()/perf_counter() for "
+                            "durations (time.time() is for timestamps "
+                            "only)")
+                        break

@@ -28,7 +28,7 @@ class Speedometer:
         if self.init:
             if count % self.frequent == 0:
                 speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
+                    (time.monotonic() - self.tic)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -41,10 +41,10 @@ class Speedometer:
                     logging.info(
                         "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                         param.epoch, count, speed)
-                self.tic = time.time()
+                self.tic = time.monotonic()
         else:
             self.init = True
-            self.tic = time.time()
+            self.tic = time.monotonic()
 
 
 def do_checkpoint(prefix, period=1, keep_last=None):

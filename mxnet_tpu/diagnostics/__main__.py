@@ -111,19 +111,92 @@ def _checkpoint_report(root: str) -> dict:
 
 
 def _serving_report(path: str) -> dict:
-    """Shed-rate / compile-cache hit-rate / deadline-miss summary of the
-    last serving run's journal records (serving.report is stdlib-only,
-    same contract as the checkpoint report)."""
     from ..serving import report
     return report.serving_report(path)
 
 
 def _guardrails_report(path: str) -> dict:
-    """Training-anomaly summary of a run's journal: skipped steps,
-    worst consecutive run, divergence rollbacks (guardrails.report is
-    stdlib-only, same contract as the checkpoint report)."""
     from ..guardrails import report
     return report.guard_report(path)
+
+
+def _trace_report(path: str) -> dict:
+    from ..observability import report
+    return report.trace_report(path)
+
+
+def _metrics_report(path: str) -> dict:
+    from ..observability import report
+    return report.metrics_report(path)
+
+
+def _summ_checkpoint(ck) -> str:
+    if ck.get("newest_step") is None:
+        return f"checkpoint root {ck['root']}: no committed steps"
+    if ck.get("newest_valid"):
+        return (f"checkpoint OK: step {ck['newest_step']} manifest + "
+                "CRCs valid")
+    return (f"checkpoint step {ck['newest_step']} INVALID "
+            f"({ck.get('newest_error')}); restorable: "
+            f"{ck.get('restorable_step')}")
+
+
+def _summ_serving(sv) -> str:
+    return (f"serving: {sv['served']} served in {sv['batches']} batches, "
+            f"shed-rate {sv['shed_rate']}, cache hit-rate "
+            f"{sv['cache_hit_rate']} ({sv['compiles']} compiles), "
+            f"{sv['deadline_miss_total']} deadline misses, "
+            f"{len(sv['reloads'])} reloads")
+
+
+def _summ_guardrails(gr) -> str:
+    return (f"guardrails: {gr['skipped_steps']} skipped steps (worst run "
+            f"{gr['worst_consecutive_skips']}), {gr['loss_spikes']} loss "
+            f"spikes, {len(gr['rollbacks'])} rollbacks, "
+            f"{len(gr['diverged_errors'])} diverged")
+
+
+def _summ_trace(tr) -> str:
+    top = ", ".join(f"{s['name']}={s['dur_s']}s" for s in tr["slowest"][:3])
+    return (f"trace: {tr['spans']} spans in {tr['traces']} traces; "
+            f"slowest: {top or 'n/a'}")
+
+
+def _summ_metrics(mt) -> str:
+    return (f"metrics: {mt['families']} families, "
+            f"{int(mt.get('compiles_total', 0))} compiles")
+
+
+# One row per report surface: adding a reporter means adding one row
+# here, not editing three code paths (argument registration, report
+# assembly, and the stderr summary all iterate this table).
+# (key, flag, env default, metavar, help, load, summarize)
+_REPORT_TABLE = (
+    ("checkpoint", "--ckpt-dir", "MXNET_TPU_CKPT_DIR", "DIR",
+     "commit-protocol checkpoint root: report the latest step's manifest "
+     "validity and the newest restorable step (default MXNET_TPU_CKPT_DIR)",
+     _checkpoint_report, _summ_checkpoint),
+    ("serving", "--serving-journal", None, "PATH",
+     "JSONL journal from a serving run (MXNET_TPU_JOURNAL=<file>): "
+     "summarize the last run's shed-rate, compile-cache hit-rate, and "
+     "deadline-miss count (docs/serving.md)",
+     _serving_report, _summ_serving),
+    ("guardrails", "--journal", None, "PATH",
+     "JSONL journal from a training run (MXNET_TPU_JOURNAL=<file>): "
+     "summarize anomaly guardrail records - nonfinite_grad skips, loss "
+     "spikes, divergence rollbacks (docs/guardrails.md)",
+     _guardrails_report, _summ_guardrails),
+    ("trace", "--trace", None, "PATH",
+     "JSONL journal from a traced run (MXNET_TPU_TRACE=journal): "
+     "summarize span records - counts, per-name durations, slowest "
+     "spans (docs/observability.md)",
+     _trace_report, _summ_trace),
+    ("metrics", "--metrics", None, "PATH",
+     "metrics snapshot JSON (a BENCH artifact or a raw "
+     "observability.snapshot() dump): summarize compile counts/times "
+     "and step-phase percentiles (docs/observability.md)",
+     _metrics_report, _summ_metrics),
+)
 
 
 def cmd_doctor(args) -> int:
@@ -131,12 +204,10 @@ def cmd_doctor(args) -> int:
     report = {"python": sys.version.split()[0],
               "pid": os.getpid(),
               "env": _env_report()}
-    if args.ckpt_dir:
-        report["checkpoint"] = _checkpoint_report(args.ckpt_dir)
-    if args.serving_journal:
-        report["serving"] = _serving_report(args.serving_journal)
-    if args.journal:
-        report["guardrails"] = _guardrails_report(args.journal)
+    for key, flag, _env, _mv, _help, load, _summ in _REPORT_TABLE:
+        value = getattr(args, flag.lstrip("-").replace("-", "_"))
+        if value:
+            report[key] = load(value)
     print(f"doctor: import audit (deadline {deadline:g}s) ...",
           file=sys.stderr)
     report["import_audit"] = _import_audit(deadline)
@@ -170,42 +241,14 @@ def cmd_doctor(args) -> int:
     else:
         print("doctor: BACKEND UNREACHABLE: "
               f"{report['backend']['detail']}", file=sys.stderr)
-    gr = report.get("guardrails")
-    if gr is not None:
-        if not gr.get("ok"):
-            print(f"doctor: guardrails journal: {gr.get('error')}",
-                  file=sys.stderr)
+    for key, _flag, _env, _mv, _help, _load, summ in _REPORT_TABLE:
+        sec = report.get(key)
+        if sec is None:
+            continue
+        if sec.get("ok") is False:
+            print(f"doctor: {key}: {sec.get('error')}", file=sys.stderr)
         else:
-            print(f"doctor: guardrails: {gr['skipped_steps']} skipped "
-                  f"steps (worst run {gr['worst_consecutive_skips']}), "
-                  f"{gr['loss_spikes']} loss spikes, "
-                  f"{len(gr['rollbacks'])} rollbacks, "
-                  f"{len(gr['diverged_errors'])} diverged",
-                  file=sys.stderr)
-    sv = report.get("serving")
-    if sv is not None:
-        if not sv.get("ok"):
-            print(f"doctor: serving journal: {sv.get('error')}",
-                  file=sys.stderr)
-        else:
-            print(f"doctor: serving: {sv['served']} served in "
-                  f"{sv['batches']} batches, shed-rate "
-                  f"{sv['shed_rate']}, cache hit-rate "
-                  f"{sv['cache_hit_rate']} ({sv['compiles']} compiles), "
-                  f"{sv['deadline_miss_total']} deadline misses, "
-                  f"{len(sv['reloads'])} reloads", file=sys.stderr)
-    ck = report.get("checkpoint")
-    if ck is not None:
-        if ck.get("newest_step") is None:
-            print(f"doctor: checkpoint root {ck['root']}: no committed "
-                  "steps", file=sys.stderr)
-        elif ck.get("newest_valid"):
-            print(f"doctor: checkpoint OK: step {ck['newest_step']} "
-                  "manifest + CRCs valid", file=sys.stderr)
-        else:
-            print(f"doctor: checkpoint step {ck['newest_step']} INVALID "
-                  f"({ck.get('newest_error')}); restorable: "
-                  f"{ck.get('restorable_step')}", file=sys.stderr)
+            print(f"doctor: {summ(sec)}", file=sys.stderr)
     return 0 if report["healthy"] else (2 if not imp else 1)
 
 
@@ -224,21 +267,9 @@ def main(argv=None) -> int:
     d = sub.add_parser("doctor", help="hermetic environment report: "
                                       "import audit + probe + env")
     d.add_argument("--deadline", type=float, default=None)
-    d.add_argument("--ckpt-dir", default=os.environ.get("MXNET_TPU_CKPT_DIR"),
-                   help="commit-protocol checkpoint root: report the "
-                        "latest step's manifest validity and the newest "
-                        "restorable step (default MXNET_TPU_CKPT_DIR)")
-    d.add_argument("--serving-journal", default=None, metavar="PATH",
-                   help="JSONL journal from a serving run "
-                        "(MXNET_TPU_JOURNAL=<file>): summarize the last "
-                        "run's shed-rate, compile-cache hit-rate, and "
-                        "deadline-miss count (docs/serving.md)")
-    d.add_argument("--journal", default=None, metavar="PATH",
-                   help="JSONL journal from a training run "
-                        "(MXNET_TPU_JOURNAL=<file>): summarize anomaly "
-                        "guardrail records — nonfinite_grad skips, loss "
-                        "spikes, divergence rollbacks "
-                        "(docs/guardrails.md)")
+    for _key, flag, env, metavar, help_text, _load, _summ in _REPORT_TABLE:
+        d.add_argument(flag, metavar=metavar, help=help_text,
+                       default=os.environ.get(env) if env else None)
     d.set_defaults(fn=cmd_doctor)
     args = ap.parse_args(argv)
     return args.fn(args)

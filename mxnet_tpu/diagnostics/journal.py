@@ -40,7 +40,20 @@ import threading
 import time
 import traceback
 
-__all__ = ["Journal", "get_journal", "reset_journal"]
+__all__ = ["Journal", "get_journal", "reset_journal",
+           "set_trace_ids_provider"]
+
+# Correlation hook (docs/observability.md): observability.trace registers
+# its current_ids() here so every record written inside an active span
+# carries trace_id/span_id. A provider slot — not an import — because
+# this module must stay import-light; with tracing off the provider
+# returns {} and records stay bit-identical to the pre-trace schema.
+_trace_ids_provider = None
+
+
+def set_trace_ids_provider(fn) -> None:
+    global _trace_ids_provider
+    _trace_ids_provider = fn
 
 
 class Journal:
@@ -55,7 +68,9 @@ class Journal:
         if path not in ("stderr", "off"):
             self._fh = open(path, "a", buffering=1)
         self._lock = threading.RLock()
-        self._t0 = time.time()
+        # up_s must survive NTP steps (G11): wall clock only for the ts
+        # field, monotonic for the uptime duration
+        self._t0_mono = time.monotonic()
         self._phase_stack: list[str] = []
         self._last_phase = "startup"
         # monotonic timestamp of the last non-heartbeat record: the
@@ -70,9 +85,17 @@ class Journal:
     def event(self, kind: str, _heartbeat: bool = False, **fields) -> dict:
         """Write one JSON line, flushed immediately. Returns the record."""
         rec = {"ts": round(time.time(), 3),
-               "up_s": round(time.time() - self._t0, 3),
+               "up_s": round(time.monotonic() - self._t0_mono, 3),
                "kind": kind, "phase": self._last_phase}
         rec.update(fields)
+        if _trace_ids_provider is not None:
+            try:
+                ids = _trace_ids_provider()
+            except Exception:
+                ids = None
+            if ids:
+                for k, v in ids.items():
+                    rec.setdefault(k, v)
         if self._off:
             return rec
         line = json.dumps(rec, default=str)

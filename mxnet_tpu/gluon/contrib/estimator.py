@@ -68,7 +68,7 @@ class LoggingHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
         self._tic = None
 
     def train_begin(self, estimator, *args, **kwargs):
-        self._tic = time.time()
+        self._tic = time.monotonic()
         logging.info("Training begin")
 
     def batch_end(self, estimator, *args, **kwargs):
@@ -86,7 +86,7 @@ class LoggingHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
         logging.info("Epoch[%s] %s %s", epoch, msg, val)
 
     def train_end(self, estimator, *args, **kwargs):
-        logging.info("Training end (%.1fs)", time.time() - self._tic)
+        logging.info("Training end (%.1fs)", time.monotonic() - self._tic)
 
 
 class CheckpointHandler(EpochEnd, TrainEnd):

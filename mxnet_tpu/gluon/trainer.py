@@ -26,6 +26,7 @@ from .. import optimizer as opt
 from ..base import MXNetError
 from ..guardrails.monitor import (AnomalyMonitor, GuardConfig,
                                   handle_divergence)
+from ..observability import instrument as _obs
 from .parameter import Parameter
 
 __all__ = ["Trainer"]
@@ -218,33 +219,42 @@ class Trainer:
             # none). Without this the guard would be silently inert on
             # the kv path: a NaN push corrupts the params on the store.
             self._step_count += 1
-            if (scaler is not None or cfg is not None) \
-                    and not self._prepush_guard_ok(scaler, loss):
-                return
-            self._allreduce_grads()
+            with _obs.trace.span("gluon_trainer.step",
+                                 step=self._step_count, on_kvstore=True):
+                if (scaler is not None or cfg is not None) \
+                        and not self._prepush_guard_ok(scaler, loss):
+                    return
+                with _obs.step_phase("gluon_trainer", "allreduce"):
+                    self._allreduce_grads()
+                if scaler is not None:
+                    scaler.update_scale(False)
+            return
+        self._step_count += 1
+        # telemetry (docs/observability.md): always-on phase summaries
+        # (host clock only), spans under MXNET_TPU_TRACE
+        with _obs.trace.span("gluon_trainer.step", step=self._step_count):
+            with _obs.step_phase("gluon_trainer", "allreduce"):
+                self._allreduce_grads()
+            if scaler is not None or cfg is not None:
+                # the flag must be agreed across processes: a non-dist
+                # kvstore leaves grads rank-local (one rank skipping while
+                # its peers update would silently fork params and
+                # loss-scale trajectories), and a caller-passed loss is
+                # per-rank local either way (a rank-local spike verdict
+                # would roll back one rank alone) — _fetch_guard OR-reduces
+                # unconditionally multi-process
+                with _obs.step_phase("gluon_trainer", "guard_fetch"):
+                    ok, gn, loss_v, gnorm_dev = self._fetch_guard(
+                        self._grad_datas(first_replica_only=self._kvstore
+                                         is not None),
+                        loss)
+                if not self._note_guard_outcome(ok, gn, scaler, loss_v):
+                    return
+                self._apply_guard_clip(gnorm_dev)
+            with _obs.step_phase("gluon_trainer", "update"):
+                self._update(ignore_stale_grad)
             if scaler is not None:
                 scaler.update_scale(False)
-            return
-        self._allreduce_grads()
-        self._step_count += 1
-        if scaler is not None or cfg is not None:
-            # the flag must be agreed across processes: a non-dist
-            # kvstore leaves grads rank-local (one rank skipping while
-            # its peers update would silently fork params and
-            # loss-scale trajectories), and a caller-passed loss is
-            # per-rank local either way (a rank-local spike verdict
-            # would roll back one rank alone) — _fetch_guard OR-reduces
-            # unconditionally multi-process
-            ok, gn, loss_v, gnorm_dev = self._fetch_guard(
-                self._grad_datas(first_replica_only=self._kvstore
-                                 is not None),
-                loss)
-            if not self._note_guard_outcome(ok, gn, scaler, loss_v):
-                return
-            self._apply_guard_clip(gnorm_dev)
-        self._update(ignore_stale_grad)
-        if scaler is not None:
-            scaler.update_scale(False)
 
     def _apply_guard_clip(self, gnorm_dev):
         """Global-norm clip reusing the guard's already-computed device

@@ -6,9 +6,6 @@ Registry + the full EvalMetric family the reference training loops consume
 (SURVEY §3.2)."""
 from __future__ import annotations
 
-import random as _random
-import threading
-
 import numpy as np
 
 from .base import MXNetError
@@ -431,91 +428,10 @@ def np_metric(numpy_feval=None, name=None, allow_extra_outputs=False):
     return deco
 
 
-class LatencySummary:
-    """Streaming latency summary over a bounded reservoir.
-
-    One helper for every site that needs count/mean/p50/p95/p99 over an
-    unbounded stream of observations in bounded memory — the serving
-    batcher, the ``python -m mxnet_tpu.serving bench`` load generator,
-    and tests.  Vitter's algorithm R keeps a uniform sample of the whole
-    stream in ``reservoir_size`` slots, so a long soak neither grows
-    memory nor forgets its early tail; count/mean/min/max are exact.
-
-    Thread-safe (one lock per observe/snapshot): load-generator clients
-    observe from many threads.  Percentiles use the nearest-rank method
-    over the sorted reservoir.  The sampling RNG is seeded
-    deterministically per instance so tests see reproducible summaries;
-    pass ``rng=random.Random()`` for independent streams.
-    """
-
-    def __init__(self, name="latency_ms", reservoir_size=2048, rng=None):
-        if reservoir_size < 1:
-            raise MXNetError("LatencySummary needs reservoir_size >= 1")
-        self.name = str(name)
-        self._cap = int(reservoir_size)
-        self._rng = rng if rng is not None else _random.Random(0xC0FFEE)
-        self._lock = threading.Lock()
-        self.reset()
-
-    def reset(self):
-        with self._lock:
-            self._buf = []
-            self._count = 0
-            self._sum = 0.0
-            self._min = None
-            self._max = None
-
-    def observe(self, value):
-        """Record one observation (any real number, e.g. latency in ms)."""
-        v = float(value)
-        with self._lock:
-            self._count += 1
-            self._sum += v
-            self._min = v if self._min is None else min(self._min, v)
-            self._max = v if self._max is None else max(self._max, v)
-            if len(self._buf) < self._cap:
-                self._buf.append(v)
-            else:
-                # algorithm R: keep each of the n seen so far with p=cap/n
-                j = self._rng.randrange(self._count)
-                if j < self._cap:
-                    self._buf[j] = v
-
-    @property
-    def count(self):
-        return self._count
-
-    def percentile(self, p):
-        """Nearest-rank percentile over the reservoir; None when empty."""
-        with self._lock:
-            buf = sorted(self._buf)
-        if not buf:
-            return None
-        rank = max(int(np.ceil((float(p) / 100.0) * len(buf))) - 1, 0)
-        return buf[min(rank, len(buf) - 1)]
-
-    def summary(self):
-        """One dict: count/mean/min/max + p50/p95/p99 (values rounded to
-        3 decimals; all None when nothing was observed)."""
-        with self._lock:
-            buf = sorted(self._buf)
-            count, total = self._count, self._sum
-            lo, hi = self._min, self._max
-        if not count:
-            return {"count": 0, "mean": None, "min": None, "max": None,
-                    "p50": None, "p95": None, "p99": None}
-
-        def rank(p):
-            r = max(int(np.ceil((p / 100.0) * len(buf))) - 1, 0)
-            return round(buf[min(r, len(buf) - 1)], 3)
-
-        return {"count": count, "mean": round(total / count, 3),
-                "min": round(lo, 3), "max": round(hi, 3),
-                "p50": rank(50), "p95": rank(95), "p99": rank(99)}
-
-    def get(self):
-        """EvalMetric-flavored accessor: (name, mean)."""
-        return self.name, (self._sum / self._count if self._count else None)
+# LatencySummary moved to observability.metrics (the metrics registry's
+# histogram backend — docs/observability.md); re-exported here for
+# compatibility with every existing consumer (serving, bench, tests).
+from .observability.metrics import LatencySummary  # noqa: E402
 
 
 _alias("ce", CrossEntropy)

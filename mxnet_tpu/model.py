@@ -34,14 +34,16 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     """ref: model.py save_checkpoint. Atomic, and the prefix's directory
     is created if missing (a checkpoint callback must not crash the run
     because the output dir wasn't pre-made)."""
-    d = os.path.dirname(prefix)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json")
-    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
-    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+    from .observability import trace as _trace
+    with _trace.span("ckpt_commit", prefix=prefix, epoch=int(epoch)):
+        d = os.path.dirname(prefix)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        if symbol is not None:
+            symbol.save(f"{prefix}-symbol.json")
+        save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+        save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+        nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
 
 
 def load_params(prefix, epoch):

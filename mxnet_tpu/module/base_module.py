@@ -9,6 +9,7 @@ import time
 
 from .. import metric as metric_mod
 from ..base import MXNetError
+from ..observability import instrument as _obs
 
 __all__ = ["BaseModule"]
 
@@ -194,9 +195,14 @@ class BaseModule:
             else:
                 get_journal().event("resume_fresh",
                                     prefix=checkpoint_prefix)
-        self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label,
-                  for_training=True, force_rebind=force_rebind)
+        # bind builds the symbolic executor — the module path's compile
+        # event (counted/timed/traced like the trainers' jit misses)
+        with _obs.maybe_compile_span(
+                not self.binded or force_rebind, "module_bind",
+                shapes=[list(d[1]) for d in train_data.provide_data]):
+            self.bind(data_shapes=train_data.provide_data,
+                      label_shapes=train_data.provide_label,
+                      for_training=True, force_rebind=force_rebind)
         if initializer is None:
             from .. import initializer as init_mod
             initializer = init_mod.Uniform(0.01)
@@ -233,71 +239,108 @@ class BaseModule:
 
         try:
             for epoch in range(begin_epoch, num_epoch):
-                tic = time.time()
+                # monotonic, not wall clock: an NTP step mid-epoch must
+                # not produce a negative Time cost (G11)
+                tic = time.monotonic()
                 eval_metric.reset()
                 train_data.reset()
-                for nbatch, data_batch in enumerate(train_data):
-                    if monitor is not None:
-                        monitor.tic()
-                    self.forward_backward(data_batch)
-                    global_step += 1
-                    vetoed = anomaly_monitor is not None and \
-                        self._guarded_veto(anomaly_monitor, global_step,
-                                           checkpoint_prefix)
-                    if not vetoed:
-                        self.update()
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if not vetoed:
-                        # a vetoed batch's forward outputs are the
-                        # anomaly (NaN) — one poisoned batch must not
-                        # poison the epoch's running training metric
-                        self.update_metric(eval_metric, data_batch.label)
-                    if batch_end_callback is not None:
-                        for cb in _as_list(batch_end_callback):
-                            cb(_BatchEndParam(epoch, nbatch, eval_metric,
-                                              locals()))
-                    if watch is not None and watch.consume():
-                        # preemption: save at this step boundary and
-                        # stop. Saving with the CURRENT epoch number
-                        # means resume re-runs this (partial) epoch —
-                        # conservative, never skips data.
-                        arg_p, aux_p = self.get_params()
-                        from .. import model
-                        model.save_checkpoint(checkpoint_prefix, epoch,
-                                              self.symbol, arg_p, aux_p)
-                        get_journal().event(
-                            "preempt_checkpoint",
-                            prefix=checkpoint_prefix,
-                            epoch=epoch, nbatch=nbatch)
-                        self.logger.warning(
-                            "SIGTERM: checkpoint saved at epoch %d batch "
-                            "%d (%s); stopping fit", epoch, nbatch,
-                            checkpoint_prefix)
+                # the epoch span covers the whole epoch including the
+                # end-of-epoch callbacks — a do_checkpoint commit nests
+                # under the epoch it belongs to
+                with _obs.trace.span("module_fit.epoch", epoch=epoch):
+                    stop = self._fit_epoch(
+                        train_data, eval_metric, epoch, monitor,
+                        anomaly_monitor, checkpoint_prefix,
+                        batch_end_callback, watch, global_step)
+                    global_step = stop[1]
+                    if stop[0]:
                         return
-                for name, val in eval_metric.get_name_value():
-                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
-                                     val)
-                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                                 time.time() - tic)
-                if epoch_end_callback is not None:
-                    arg_params, aux_params = self.get_params()
-                    for cb in _as_list(epoch_end_callback):
-                        cb(epoch, self.symbol, arg_params, aux_params)
-                if eval_data is not None:
-                    res = self.score(
-                        eval_data, validation_metric,
-                        batch_end_callback=eval_batch_end_callback,
-                        epoch=epoch)
-                    for name, val in res:
-                        self.logger.info("Epoch[%d] Validation-%s=%f",
-                                         epoch, name, val)
+                    for name, val in eval_metric.get_name_value():
+                        self.logger.info("Epoch[%d] Train-%s=%f", epoch,
+                                         name, val)
+                    self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                     time.monotonic() - tic)
+                    if epoch_end_callback is not None:
+                        arg_params, aux_params = self.get_params()
+                        for cb in _as_list(epoch_end_callback):
+                            cb(epoch, self.symbol, arg_params, aux_params)
+                    if eval_data is not None:
+                        res = self.score(
+                            eval_data, validation_metric,
+                            batch_end_callback=eval_batch_end_callback,
+                            epoch=epoch)
+                        for name, val in res:
+                            self.logger.info("Epoch[%d] Validation-%s=%f",
+                                             epoch, name, val)
         finally:
             if watch is not None:
                 # nothing polls the watch after fit: restore the
                 # displaced SIGTERM disposition (else the process would
                 # silently ignore termination forever)
                 watch.uninstall()
+
+    def _fit_epoch(self, train_data, eval_metric, epoch, monitor,
+                   anomaly_monitor, checkpoint_prefix, batch_end_callback,
+                   watch, global_step):
+        """One fit() epoch's batch loop, instrumented with the step
+        phases (data_wait / forward_backward / guard_fetch / update —
+        docs/observability.md).  Returns ``(stopped, global_step)``;
+        ``stopped`` is True on a preemption checkpoint."""
+        from ..diagnostics.journal import get_journal
+        batches = enumerate(train_data)
+        while True:
+            with _obs.step_phase("module_fit", "data_wait"):
+                try:
+                    nbatch, data_batch = next(batches)
+                except StopIteration:
+                    break
+            with _obs.trace.span("module_fit.step", epoch=epoch,
+                                 nbatch=nbatch, step=global_step + 1):
+                if monitor is not None:
+                    monitor.tic()
+                with _obs.step_phase("module_fit", "forward_backward"):
+                    self.forward_backward(data_batch)
+                global_step += 1
+                if anomaly_monitor is not None:
+                    with _obs.step_phase("module_fit", "guard_fetch"):
+                        vetoed = self._guarded_veto(
+                            anomaly_monitor, global_step,
+                            checkpoint_prefix)
+                else:
+                    vetoed = False
+                if not vetoed:
+                    with _obs.step_phase("module_fit", "update"):
+                        self.update()
+                if monitor is not None:
+                    monitor.toc_print()
+                if not vetoed:
+                    # a vetoed batch's forward outputs are the
+                    # anomaly (NaN) — one poisoned batch must not
+                    # poison the epoch's running training metric
+                    self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback is not None:
+                    for cb in _as_list(batch_end_callback):
+                        cb(_BatchEndParam(epoch, nbatch, eval_metric,
+                                          locals()))
+                if watch is not None and watch.consume():
+                    # preemption: save at this step boundary and
+                    # stop. Saving with the CURRENT epoch number
+                    # means resume re-runs this (partial) epoch —
+                    # conservative, never skips data.
+                    arg_p, aux_p = self.get_params()
+                    from .. import model
+                    model.save_checkpoint(checkpoint_prefix, epoch,
+                                          self.symbol, arg_p, aux_p)
+                    get_journal().event(
+                        "preempt_checkpoint",
+                        prefix=checkpoint_prefix,
+                        epoch=epoch, nbatch=nbatch)
+                    self.logger.warning(
+                        "SIGTERM: checkpoint saved at epoch %d batch "
+                        "%d (%s); stopping fit", epoch, nbatch,
+                        checkpoint_prefix)
+                    return True, global_step
+        return False, global_step
 
     def _guarded_veto(self, anomaly_monitor, global_step,
                       checkpoint_prefix):

@@ -1,0 +1,83 @@
+"""mxnet_tpu.observability — unified telemetry: span tracing, a metrics
+registry, and exporters (docs/observability.md).
+
+One substrate every subsystem records into:
+
+- :mod:`.trace` — ``span(name, **attrs)`` context managers with
+  process-unique trace/span IDs, cross-thread parent propagation and
+  rank tagging; bounded in-memory ring + optional JSONL journal
+  streaming (``MXNET_TPU_TRACE=off|ring|journal``).  Off-by-default
+  cheap: disabled tracing is one shared no-op and zero device reads.
+- :mod:`.metrics` — counters, gauges and histogram summaries
+  (``LatencySummary`` as the backend) with labeled families and a
+  process-wide default registry; always-on host counters feed the
+  compile/step-phase provenance even with tracing off.
+- :mod:`.export` — Chrome trace-event JSON (Perfetto-loadable) from the
+  ring or a journal file; a stdlib ``/metrics`` HTTP endpoint.
+- :mod:`.report` — stdlib ``doctor --trace`` / ``doctor --metrics``
+  summaries.
+- :mod:`.instrument` — the shared step-phase / compile-span helpers the
+  four trainers, serving and checkpointing use.
+
+Every journal record written inside a span carries ``trace_id``/
+``span_id`` (the provider hook in diagnostics.journal), so the
+historically separate journals — ``serving_batch``, ``nonfinite_grad``,
+``ckpt_fallback``, ``pallas_fallback`` — correlate against one trace.
+
+Stdlib-only: importable (and exportable) while jax or the backend is
+wedged.
+"""
+from __future__ import annotations
+
+from . import export, instrument, metrics, report, trace
+from .export import (chrome_trace_from_journal, export_chrome,
+                     serve_metrics, to_chrome_trace)
+from .metrics import (Counter, Gauge, LatencySummary, MetricsRegistry,
+                      Summary, default_registry, prometheus_text,
+                      reset_metrics)
+from .trace import (SpanContext, Tracer, annotate, configure,
+                    current_context, current_ids, current_span, enabled,
+                    event, get_tracer, reset_tracer, span, start_span)
+
+__all__ = [
+    "Counter", "Gauge", "LatencySummary", "MetricsRegistry", "Summary",
+    "SpanContext", "Tracer", "annotate", "chrome_trace_from_journal",
+    "compile_stats", "configure", "current_context", "current_ids",
+    "current_span", "default_registry", "enabled", "event", "export",
+    "export_chrome", "get_tracer", "instrument", "metrics",
+    "prometheus_text", "report", "reset_metrics", "reset_tracer",
+    "serve_metrics", "snapshot", "span", "start_span", "to_chrome_trace",
+    "trace",
+]
+
+
+def snapshot() -> dict:
+    """One JSON-able telemetry snapshot: the full metrics registry plus
+    tracer accounting — the provenance block ``bench.py`` embeds in
+    BENCH artifacts (``"observability": ...``) and ``doctor --metrics``
+    reads back."""
+    return {"metrics": default_registry().snapshot(),
+            "trace": get_tracer().stats()}
+
+
+def compile_stats(snap=None) -> dict:
+    """Compile accounting out of a snapshot (default: the live
+    registry): total count, total ms, and the per-site split — the
+    one-line summary a bench run prints."""
+    snap = snap if snap is not None else snapshot()
+    metrics_d = snap.get("metrics", snap)
+    counts = (metrics_d.get(instrument.COMPILE_COUNT_METRIC) or
+              {}).get("values") or {}
+    times = (metrics_d.get(instrument.COMPILE_MS_METRIC) or
+             {}).get("values") or {}
+    total_ms = 0.0
+    for v in times.values():
+        if isinstance(v, dict) and v.get("count"):
+            if v.get("sum") is not None:
+                total_ms += v["sum"]
+            else:          # pre-sum snapshot (old BENCH artifact)
+                total_ms += v["count"] * (v.get("mean") or 0.0)
+    return {"compiles": int(sum(float(v) for v in counts.values())),
+            "total_ms": round(total_ms, 1),
+            "by_site": {k.replace("site=", "", 1): int(v)
+                        for k, v in sorted(counts.items())}}

@@ -1,0 +1,61 @@
+"""Trace CLI: ``python -m mxnet_tpu.observability dump|report``.
+
+``dump``    convert a JSONL journal's ``kind="span"`` records (written
+            with ``MXNET_TPU_TRACE=journal``) to Chrome trace-event
+            JSON loadable in Perfetto (ui.perfetto.dev → Open trace).
+``report``  print the stdlib trace summary (``doctor --trace`` body)
+            as one JSON line.
+
+Both read journals only — no jax, usable from a wedged environment.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import export, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.observability",
+        description="trace export/report tools (docs/observability.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    d = sub.add_parser("dump", help="journal span records -> Chrome "
+                                    "trace-event JSON (Perfetto)")
+    d.add_argument("--journal", required=True,
+                   help="JSONL journal path (MXNET_TPU_JOURNAL=<file> + "
+                        "MXNET_TPU_TRACE=journal during the run)")
+    d.add_argument("--out", default=None,
+                   help="output path (default: stdout)")
+    r = sub.add_parser("report", help="summarize journal span records; "
+                                      "ONE JSON line on stdout")
+    r.add_argument("--journal", required=True)
+    args = ap.parse_args(argv)
+
+    if args.cmd == "dump":
+        try:
+            doc = export.chrome_trace_from_journal(args.journal)
+        except OSError as e:
+            print(json.dumps({"ok": False, "error": str(e)}), flush=True)
+            return 1
+        if args.out:
+            from ..resilience.atomic import atomic_write
+            with atomic_write(args.out, "w") as f:
+                json.dump(doc, f)
+            print(json.dumps({"ok": True, "out": args.out,
+                              "events": len(doc["traceEvents"])}),
+                  flush=True)
+        else:
+            json.dump(doc, sys.stdout)
+            sys.stdout.write("\n")
+        return 0
+
+    rep = report.trace_report(args.journal)
+    print(json.dumps(rep), flush=True)
+    return 0 if rep.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Exporters: Chrome trace-event JSON (Perfetto-loadable) and a tiny
+stdlib ``/metrics`` HTTP endpoint.
+
+Chrome trace-event format (the subset Perfetto's JSON importer
+accepts): one complete event (``"ph": "X"``) per finished span with
+microsecond ``ts``/``dur``, ``pid`` = rank, ``tid`` = thread name, and
+the trace/span/parent IDs under ``args`` so the Perfetto query engine
+can reconstruct the tree and join against journal records.
+
+Sources: the live tracer ring (:func:`to_chrome_trace` /
+:func:`export_chrome`) or a diagnostics JSONL journal written with
+``MXNET_TPU_TRACE=journal`` (:func:`chrome_trace_from_journal` — the
+``python -m mxnet_tpu.observability dump`` CLI), so a killed process's
+trace is still recoverable from its journal file.
+
+Stdlib-only.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from . import trace as _trace
+
+__all__ = ["chrome_trace_from_journal", "export_chrome", "serve_metrics",
+           "spans_to_chrome", "to_chrome_trace"]
+
+
+def _chrome_event(d: dict) -> dict:
+    args = dict(d.get("attrs") or {})
+    args["trace_id"] = d.get("trace_id")
+    args["span_id"] = d.get("span_id")
+    if d.get("parent_id"):
+        args["parent_id"] = d["parent_id"]
+    start = float(d.get("start_s") or 0.0)
+    dur = d.get("dur_s")
+    return {"name": str(d.get("name", "?")),
+            "cat": "mxnet_tpu",
+            "ph": "X",
+            "ts": round(start * 1e6, 3),
+            "dur": round(float(dur or 0.0) * 1e6, 3),
+            "pid": int(d.get("rank") or 0),
+            "tid": str(d.get("thread") or "main"),
+            "args": args}
+
+
+def spans_to_chrome(spans) -> dict:
+    """Span dicts (``Span.to_dict`` / journal ``span`` records) → a
+    Chrome trace-event document (``{"traceEvents": [...]}``)."""
+    return {"traceEvents": [_chrome_event(d) for d in spans],
+            "displayTimeUnit": "ms"}
+
+
+def to_chrome_trace(tracer=None) -> dict:
+    """The live tracer ring as a Chrome trace-event document."""
+    tracer = tracer or _trace.get_tracer()
+    return spans_to_chrome(tracer.spans())
+
+
+def export_chrome(path, tracer=None) -> int:
+    """Write the ring to ``path`` as Chrome trace JSON (atomically — a
+    kill mid-export must not leave a torn half-trace that Perfetto
+    rejects); returns the event count."""
+    from ..resilience.atomic import atomic_write
+    doc = to_chrome_trace(tracer)
+    with atomic_write(path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["traceEvents"])
+
+
+def chrome_trace_from_journal(path) -> dict:
+    """Convert a JSONL journal's ``kind="span"`` records to a Chrome
+    trace-event document.  Junk/truncated lines are tolerated (the torn
+    tail of a killed writer must not hide the healthy prefix) — the
+    scan is report.read_span_records, shared with ``doctor --trace``."""
+    from .report import read_span_records
+    return spans_to_chrome(read_span_records(path))
+
+
+# -- /metrics endpoint -------------------------------------------------------
+
+def serve_metrics(render, host="127.0.0.1", port=0):
+    """Start a daemon-thread HTTP server exposing ``GET /metrics``
+    rendered by ``render()`` (Prometheus text).  Returns the
+    ``http.server`` instance — read the bound port from
+    ``httpd.server_address[1]`` (``port=0`` picks a free one), stop with
+    ``httpd.shutdown()``.  Loopback by default: this is an operator
+    scrape target, not a public surface."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            try:
+                body = render().encode("utf-8")
+            except Exception as e:          # scrape must not kill serving
+                self.send_error(500, str(e)[:100])
+                return
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):       # no stderr chatter per scrape
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=httpd.serve_forever,
+                         name="mxtpu-metrics-http", daemon=True)
+    t.start()
+    return httpd

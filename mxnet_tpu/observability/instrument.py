@@ -1,0 +1,93 @@
+"""Shared instrumentation helpers for the hot paths.
+
+The four trainers, the serving predictor cache and the checkpoint
+commit protocol all record the same two shapes of signal:
+
+- **step phases** (data wait / compiled step / guard fetch): a
+  monotonic-timed scope observed into the always-on
+  ``mxnet_tpu_step_phase_ms{trainer,phase}`` summary (host arithmetic
+  only — the per-step cost is two ``perf_counter`` reads and one lock),
+  plus a nested trace span when ``MXNET_TPU_TRACE`` is on;
+- **compile events**: every jit-cache-miss site wraps its build in
+  :func:`compile_span`, so XLA trace/lower/compile time lands in
+  ``mxnet_tpu_xla_compiles_total{site}`` /
+  ``mxnet_tpu_xla_compile_ms{site}`` and, when tracing, as an
+  ``xla_compile`` span with the shapes attached.
+
+Zero-device-read contract: nothing here touches a device value —
+tests/test_observability.py runs the compiled step paths of all four
+trainers under ``jax.transfer_guard_device_to_host("disallow")``.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+from . import trace
+from .metrics import default_registry
+
+__all__ = ["compile_span", "maybe_compile_span", "step_phase",
+           "PHASE_METRIC", "COMPILE_COUNT_METRIC", "COMPILE_MS_METRIC"]
+
+PHASE_METRIC = "mxnet_tpu_step_phase_ms"
+COMPILE_COUNT_METRIC = "mxnet_tpu_xla_compiles_total"
+COMPILE_MS_METRIC = "mxnet_tpu_xla_compile_ms"
+
+
+_phase_cache = None
+
+
+def _phase_summary():
+    # per-registry memo: the family lookup (name validation + registry
+    # lock) would otherwise run four times per training step; the cache
+    # keys on registry identity so reset_metrics() (tests) invalidates
+    global _phase_cache
+    reg = default_registry()
+    cached = _phase_cache
+    if cached is not None and cached[0] is reg:
+        return cached[1]
+    fam = reg.summary(
+        PHASE_METRIC, "per-phase training-step wall time (monotonic), ms",
+        ("trainer", "phase"))
+    _phase_cache = (reg, fam)
+    return fam
+
+
+@contextlib.contextmanager
+def step_phase(trainer, phase, **attrs):
+    """One training-step phase: always observed into the phase summary,
+    traced as ``<trainer>.<phase>`` when tracing is on."""
+    t0 = time.perf_counter()
+    with trace.span(f"{trainer}.{phase}", **attrs):
+        try:
+            yield
+        finally:
+            _phase_summary().labels(trainer=trainer, phase=phase).observe(
+                (time.perf_counter() - t0) * 1000.0)
+
+
+@contextlib.contextmanager
+def compile_span(site, **attrs):
+    """One compile event (jit cache miss / executable build) at
+    ``site``: counted, timed, and traced as ``xla_compile``."""
+    reg = default_registry()
+    t0 = time.perf_counter()
+    with trace.span("xla_compile", site=site, **attrs):
+        try:
+            yield
+        finally:
+            ms = (time.perf_counter() - t0) * 1000.0
+            reg.counter(COMPILE_COUNT_METRIC,
+                        "XLA trace/lower/compile events",
+                        ("site",)).labels(site=site).inc()
+            reg.summary(COMPILE_MS_METRIC, "XLA compile wall time, ms",
+                        ("site",)).labels(site=site).observe(ms)
+
+
+def maybe_compile_span(pending, site, **attrs):
+    """``compile_span`` when ``pending`` (this dispatch includes the
+    compile), else a null context — the first-call pattern at the
+    trainers' jit sites."""
+    if pending:
+        return compile_span(site, **attrs)
+    return contextlib.nullcontext()

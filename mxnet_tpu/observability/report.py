@@ -1,0 +1,137 @@
+"""Stdlib-only trace/metrics summaries (``doctor --trace`` /
+``doctor --metrics``).
+
+``trace_report`` reduces a JSONL journal's ``kind="span"`` records
+(written with ``MXNET_TPU_TRACE=journal``) to the operator signals:
+span/trace counts, per-name duration stats, the slowest spans.
+``metrics_report`` reads a metrics snapshot back out of a JSON file —
+either a raw ``observability.snapshot()`` dump or a BENCH artifact
+carrying one under ``"observability"`` — and summarizes compile
+counts/times and step-phase percentiles.
+
+Same contract as serving/guardrails reports: no jax, junk lines
+tolerated, always returns a dict with ``ok``.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["metrics_report", "read_span_records", "trace_report"]
+
+
+def read_span_records(path) -> list:
+    """``kind="span"`` records of a JSONL journal, junk/torn lines
+    tolerated — THE span scanner, shared with the Perfetto exporter
+    (export.chrome_trace_from_journal) so the doctor report and the
+    dump can never diverge on what counts as a span.  Raises OSError
+    when the file is unreadable."""
+    spans = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue                     # torn tail of a killed writer
+            if isinstance(rec, dict) and rec.get("kind") == "span":
+                spans.append(rec)
+    return spans
+
+
+def _read_spans(path):
+    try:
+        return read_span_records(path), None
+    except OSError as e:
+        return None, f"cannot read {path}: {e.strerror or e}"
+
+
+def trace_report(path) -> dict:
+    """Summarize the ``span`` records of a journal file."""
+    spans, err = _read_spans(path)
+    if spans is None:
+        return {"ok": False, "path": path, "error": err}
+    if not spans:
+        return {"ok": False, "path": path,
+                "error": "no span records in journal (was "
+                         "MXNET_TPU_TRACE=journal set?)"}
+    by_name: dict = {}
+    traces = set()
+    for s in spans:
+        traces.add(s.get("trace_id"))
+        durs = by_name.setdefault(s.get("name", "?"), [])
+        if s.get("dur_s") is not None:
+            durs.append(float(s["dur_s"]))
+
+    def _stats(durs):
+        if not durs:
+            return {"count": 0}
+        ds = sorted(durs)
+        return {"count": len(ds),
+                "total_s": round(sum(ds), 6),
+                "p50_s": round(ds[len(ds) // 2], 6),
+                "max_s": round(ds[-1], 6)}
+
+    slowest = sorted((s for s in spans if s.get("dur_s") is not None),
+                     key=lambda s: -float(s["dur_s"]))[:5]
+    return {"ok": True, "path": path,
+            "spans": len(spans), "traces": len(traces),
+            "by_name": {n: _stats(d) for n, d in sorted(by_name.items())},
+            "slowest": [{"name": s.get("name"),
+                         "dur_s": round(float(s["dur_s"]), 6),
+                         "trace_id": s.get("trace_id")}
+                        for s in slowest]}
+
+
+def metrics_report(path) -> dict:
+    """Summarize a metrics snapshot JSON file (raw ``snapshot()`` dump
+    or a BENCH artifact with an ``observability`` section)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return {"ok": False, "path": path,
+                "error": f"cannot read {path}: {e.strerror or e}"}
+    # whole-file parse first (a pretty-printed snapshot dump), then a
+    # per-line scan (a JSONL artifact stream / one-line-per-record file)
+    doc = None
+    try:
+        parsed = json.loads(text)
+        if isinstance(parsed, dict):
+            doc = parsed
+    except ValueError:
+        pass
+    if doc is None:
+        for candidate in text.splitlines():
+            candidate = candidate.strip()
+            if not candidate.startswith("{"):
+                continue
+            try:
+                parsed = json.loads(candidate)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict):
+                doc = parsed
+                break
+    if doc is None:
+        return {"ok": False, "path": path, "error": "no JSON object found"}
+    obs = doc.get("observability", doc)
+    metrics = obs.get("metrics", obs) if isinstance(obs, dict) else {}
+    if not isinstance(metrics, dict) or not metrics:
+        return {"ok": False, "path": path,
+                "error": "no metrics snapshot in file"}
+    out = {"ok": True, "path": path, "families": len(metrics)}
+    compiles = metrics.get("mxnet_tpu_xla_compiles_total", {})
+    if isinstance(compiles.get("values"), dict):
+        out["compiles"] = {k or "total": v
+                           for k, v in compiles["values"].items()}
+        out["compiles_total"] = sum(
+            float(v) for v in compiles["values"].values())
+    compile_ms = metrics.get("mxnet_tpu_xla_compile_ms", {})
+    if isinstance(compile_ms.get("values"), dict):
+        out["compile_ms"] = compile_ms["values"]
+    phases = metrics.get("mxnet_tpu_step_phase_ms", {})
+    if isinstance(phases.get("values"), dict):
+        out["step_phase_ms"] = phases["values"]
+    return out

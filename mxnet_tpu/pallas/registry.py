@@ -226,10 +226,16 @@ def dispatch(name: str, *args, interpret: bool = False, **params):
         backend = _backend()
         if backend not in spec.backends:
             reason = f"backend:{backend}"
+    # dispatch decisions ride the active trace span (if any): a traced
+    # step's span says which kernel tier compiled into it, and why a
+    # fallback happened (docs/observability.md)
+    from ..observability import trace as _trace
     if reason is None:
         _note(name, "pallas")
+        _trace.annotate(**{f"pallas.{name}": "pallas"})
         return spec.pallas_impl(*args, interpret=interpret, **params)
     _note(name, "xla", reason)
+    _trace.annotate(**{f"pallas.{name}": f"xla:{reason}"})
     _journal_once(name, reason, mode=m)
     if m == "on" and reason != "mode_off":
         import warnings

@@ -25,6 +25,7 @@ from ..base import MXNetError
 from ..guardrails import fused as _guard
 from ..guardrails.trainer_mixin import GuardedTrainerMixin
 from ..guardrails.monitor import AnomalyMonitor, GuardConfig
+from ..observability import instrument as _obs
 from .mesh import NamedSharding, PartitionSpec, use_mesh
 from .pipeline import pipeline_apply
 from .sharded import _opt_apply, _opt_init_state, functional_apply
@@ -376,25 +377,41 @@ class PipelinedTrainer(GuardedTrainerMixin):
         self._prepare(x)
         if self._m is None:
             self._m = self._p
-        if self._step_fn is None:
+        compiling = self._step_fn is None
+        if compiling:
             self._step_fn = self._build_step()
-        xd = x._data if isinstance(x, nd.NDArray) else jnp.asarray(x)
-        yd = y._data if isinstance(y, nd.NDArray) else jnp.asarray(y)
         self._num_update += 1
         t = self._num_update
-        self._optimizer.num_update = t
-        lscale = self._scaler.loss_scale if self._scaler is not None else 1.0
-        e_tr = [p._data[0]._data for p in self._e_params]
-        h_tr = [p._data[0]._data for p in self._h_params]
-        with use_mesh(self._mesh):
-            results = self._step_fn(
-                e_tr, self._b_datas, h_tr, self._e_states, self._b_states,
-                self._h_states, self._guard_state, _rng.next_key(),
-                jnp.float32(self._lr_at(t)),
-                jnp.float32(t), jnp.float32(self._optimizer.rescale_grad),
-                jnp.float32(lscale), xd, yd)
-        loss, (finite, gnorm) = self._apply_results(results)
-        self._after_step(t, loss, finite, gnorm)
+        # telemetry (docs/observability.md): always-on phase summaries
+        # (host clock only), spans under MXNET_TPU_TRACE
+        with _obs.trace.span("pipelined_trainer.step", step=t):
+            with _obs.step_phase("pipelined_trainer", "data_wait"):
+                xd = x._data if isinstance(x, nd.NDArray) \
+                    else jnp.asarray(x)
+                yd = y._data if isinstance(y, nd.NDArray) \
+                    else jnp.asarray(y)
+            self._optimizer.num_update = t
+            lscale = (self._scaler.loss_scale
+                      if self._scaler is not None else 1.0)
+            e_tr = [p._data[0]._data for p in self._e_params]
+            h_tr = [p._data[0]._data for p in self._h_params]
+            cshapes = ([list(map(int, np.shape(v))) for v in (xd, yd)]
+                       if compiling else None)
+            with _obs.step_phase("pipelined_trainer", "compiled_step"), \
+                    _obs.maybe_compile_span(compiling,
+                                            "pipelined_trainer.step",
+                                            shapes=cshapes), \
+                    use_mesh(self._mesh):
+                results = self._step_fn(
+                    e_tr, self._b_datas, h_tr, self._e_states,
+                    self._b_states, self._h_states, self._guard_state,
+                    _rng.next_key(), jnp.float32(self._lr_at(t)),
+                    jnp.float32(t),
+                    jnp.float32(self._optimizer.rescale_grad),
+                    jnp.float32(lscale), xd, yd)
+            loss, (finite, gnorm) = self._apply_results(results)
+            with _obs.step_phase("pipelined_trainer", "guard_fetch"):
+                self._after_step(t, loss, finite, gnorm)
         return nd.NDArray(loss, _skip_device_put=True)
 
     def run_steps(self, x, y, num_steps=8):
@@ -411,7 +428,8 @@ class PipelinedTrainer(GuardedTrainerMixin):
         key = f"multi{num_steps}"
         if not hasattr(self, "_multi_fns"):
             self._multi_fns = {}
-        if key not in self._multi_fns:
+        compiling = key not in self._multi_fns
+        if compiling:
             raw = self._raw_step
             in_sh, out_sh, donate = self._sharding_cfg
             rep = NamedSharding(self._mesh, PartitionSpec())
@@ -439,26 +457,40 @@ class PipelinedTrainer(GuardedTrainerMixin):
                 multi, in_shardings=in_sh,
                 out_shardings=out_sh[:7] + (rep, rep, rep),
                 donate_argnums=donate)
-        xd = x._data if isinstance(x, nd.NDArray) else jnp.asarray(x)
-        yd = y._data if isinstance(y, nd.NDArray) else jnp.asarray(y)
         t = self._num_update + 1
         self._num_update += num_steps
-        self._optimizer.num_update = self._num_update
-        from .sharded import _lr_sequence
-        lrs = _lr_sequence(self._optimizer, t, num_steps)
-        lscale = self._scaler.loss_scale if self._scaler is not None else 1.0
-        e_tr = [p._data[0]._data for p in self._e_params]
-        h_tr = [p._data[0]._data for p in self._h_params]
-        with use_mesh(self._mesh):
-            results = self._multi_fns[key](
-                e_tr, self._b_datas, h_tr, self._e_states, self._b_states,
-                self._h_states, self._guard_state, _rng.next_key(), lrs,
-                jnp.float32(t), jnp.float32(self._optimizer.rescale_grad),
-                jnp.float32(lscale), xd, yd)
-        losses, fins, gns = results[7], results[8], results[9]
-        self._apply_results(results[:7] + (losses[-1], (fins[-1],
-                                                        gns[-1])))
-        self._after_run_steps(t, losses, fins, gns)
+        with _obs.trace.span("pipelined_trainer.run_steps", start_step=t,
+                             num_steps=num_steps):
+            with _obs.step_phase("pipelined_trainer", "data_wait"):
+                xd = x._data if isinstance(x, nd.NDArray) \
+                    else jnp.asarray(x)
+                yd = y._data if isinstance(y, nd.NDArray) \
+                    else jnp.asarray(y)
+            self._optimizer.num_update = self._num_update
+            from .sharded import _lr_sequence
+            lrs = _lr_sequence(self._optimizer, t, num_steps)
+            lscale = (self._scaler.loss_scale
+                      if self._scaler is not None else 1.0)
+            e_tr = [p._data[0]._data for p in self._e_params]
+            h_tr = [p._data[0]._data for p in self._h_params]
+            cshapes = ([list(map(int, np.shape(v))) for v in (xd, yd)]
+                       if compiling else None)
+            with _obs.step_phase("pipelined_trainer", "compiled_step"), \
+                    _obs.maybe_compile_span(
+                        compiling, "pipelined_trainer.run_steps",
+                        num_steps=num_steps, shapes=cshapes), \
+                    use_mesh(self._mesh):
+                results = self._multi_fns[key](
+                    e_tr, self._b_datas, h_tr, self._e_states,
+                    self._b_states, self._h_states, self._guard_state,
+                    _rng.next_key(), lrs, jnp.float32(t),
+                    jnp.float32(self._optimizer.rescale_grad),
+                    jnp.float32(lscale), xd, yd)
+            losses, fins, gns = results[7], results[8], results[9]
+            self._apply_results(results[:7] + (losses[-1], (fins[-1],
+                                                            gns[-1])))
+            with _obs.step_phase("pipelined_trainer", "guard_fetch"):
+                self._after_run_steps(t, losses, fins, gns)
         return nd.NDArray(losses[-1], _skip_device_put=True)
 
     def evaluate(self, x, y):

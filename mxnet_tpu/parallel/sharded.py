@@ -42,6 +42,7 @@ from ..gluon.block import functional_apply  # noqa: F401  (re-export: the
 from ..guardrails import fused as _guard
 from ..guardrails.monitor import AnomalyMonitor, GuardConfig
 from ..guardrails.trainer_mixin import GuardedTrainerMixin
+from ..observability import instrument as _obs
 from ..ops import optimizer_op as _ops
 from . import _ckpt
 from .mesh import current_mesh
@@ -583,33 +584,50 @@ class ShardedTrainer(GuardedTrainerMixin):
         args = batch[:-1]
         self._prepare(args)
         self._maybe_invalidate_amp()
-        if self._step_fn is None:
+        compiling = self._step_fn is None
+        if compiling:
             self._step_fn = self._build_step(len(args))
-        batch_datas = [self._shard_batch_arg(b) for b in batch]
         self._num_update += 1
         t = self._num_update
-        self._optimizer.num_update = t
-        lr = _lr_at(self._optimizer, t)
-        rescale = self._optimizer.rescale_grad
-        lscale = self._scaler.loss_scale if self._scaler is not None else 1.0
-        tr = [p._data[0]._data for p in self._trainable]
-        aux = [p._data[0]._data for p in self._aux]
-        from .mesh import use_mesh
-        with use_mesh(self.mesh):   # mesh-aware ops (ring attention) trace
-            (new_tr, aux_new, new_states, gstate, loss_val,
-             (finite, gnorm), outs) = self._step_fn(
-                tr, aux, self._states, self._guard_state, _rng.next_key(),
-                jnp.float32(lr), jnp.float32(t), jnp.float32(rescale),
-                jnp.float32(lscale), *batch_datas)
-        for p, w in zip(self._trainable, new_tr):
-            p._data[0]._rebind(w)
-        for p, a in zip(self._aux, aux_new):
-            p._data[0]._rebind(a)
-        self._states = new_states
-        self._guard_state = gstate
-        self.last_outputs = [nd.NDArray(o, _skip_device_put=True)
-                             for o in outs]
-        self._after_step(t, loss_val, finite, gnorm)
+        # telemetry (docs/observability.md): phases always feed the
+        # step-phase summary (host perf_counter only); spans are live
+        # only under MXNET_TPU_TRACE — attrs are host scalars, so the
+        # deferred-mode zero-device-read contract is untouched
+        with _obs.trace.span("sharded_trainer.step", step=t):
+            with _obs.step_phase("sharded_trainer", "data_wait"):
+                batch_datas = [self._shard_batch_arg(b) for b in batch]
+            self._optimizer.num_update = t
+            lr = _lr_at(self._optimizer, t)
+            rescale = self._optimizer.rescale_grad
+            lscale = (self._scaler.loss_scale
+                      if self._scaler is not None else 1.0)
+            tr = [p._data[0]._data for p in self._trainable]
+            aux = [p._data[0]._data for p in self._aux]
+            cshapes = ([list(map(int, np.shape(b))) for b in batch]
+                       if compiling else None)
+            from .mesh import use_mesh
+            # mesh-aware ops (ring attention) trace under use_mesh
+            with _obs.step_phase("sharded_trainer", "compiled_step"), \
+                    _obs.maybe_compile_span(compiling,
+                                            "sharded_trainer.step",
+                                            shapes=cshapes), \
+                    use_mesh(self.mesh):
+                (new_tr, aux_new, new_states, gstate, loss_val,
+                 (finite, gnorm), outs) = self._step_fn(
+                    tr, aux, self._states, self._guard_state,
+                    _rng.next_key(), jnp.float32(lr), jnp.float32(t),
+                    jnp.float32(rescale), jnp.float32(lscale),
+                    *batch_datas)
+            for p, w in zip(self._trainable, new_tr):
+                p._data[0]._rebind(w)
+            for p, a in zip(self._aux, aux_new):
+                p._data[0]._rebind(a)
+            self._states = new_states
+            self._guard_state = gstate
+            self.last_outputs = [nd.NDArray(o, _skip_device_put=True)
+                                 for o in outs]
+            with _obs.step_phase("sharded_trainer", "guard_fetch"):
+                self._after_step(t, loss_val, finite, gnorm)
         return nd.NDArray(loss_val, _skip_device_put=True)
 
     # -- guard bookkeeping: GuardedTrainerMixin (docs/guardrails.md) ----------
@@ -646,7 +664,8 @@ class ShardedTrainer(GuardedTrainerMixin):
         key = f"multi{num_steps}"
         if not hasattr(self, "_multi_fns"):
             self._multi_fns = {}
-        if key not in self._multi_fns:
+        compiling = key not in self._multi_fns
+        if compiling:
             raw = self._raw_step
             in_sh, out_sh, donate = self._shardings
             rep_sh = out_sh[4]
@@ -672,33 +691,45 @@ class ShardedTrainer(GuardedTrainerMixin):
                 multi, in_shardings=in_sh,
                 out_shardings=out_sh[:4] + (rep_sh, rep_sh, rep_sh),
                 donate_argnums=donate)
-        batch_datas = [self._shard_batch_arg(b) for b in batch]
         t = self._num_update + 1
         self._num_update += num_steps
-        self._optimizer.num_update = self._num_update
-        lrs = _lr_sequence(self._optimizer, t, num_steps)
-        # fp16 note (docs/guardrails.md): the loss scale is one traced
-        # input for the WHOLE window — overflow inside a scanned window
-        # skips those steps in-program, and the scaler adjusts once per
-        # window from the per-step flags below
-        lscale = self._scaler.loss_scale if self._scaler is not None else 1.0
-        tr = [p._data[0]._data for p in self._trainable]
-        aux = [p._data[0]._data for p in self._aux]
-        from .mesh import use_mesh
-        with use_mesh(self.mesh):
-            (new_tr, aux_new, new_states, gstate, losses, fins, gns) = \
-                self._multi_fns[key](
+        with _obs.trace.span("sharded_trainer.run_steps", start_step=t,
+                             num_steps=num_steps):
+            with _obs.step_phase("sharded_trainer", "data_wait"):
+                batch_datas = [self._shard_batch_arg(b) for b in batch]
+            self._optimizer.num_update = self._num_update
+            lrs = _lr_sequence(self._optimizer, t, num_steps)
+            # fp16 note (docs/guardrails.md): the loss scale is one traced
+            # input for the WHOLE window — overflow inside a scanned window
+            # skips those steps in-program, and the scaler adjusts once per
+            # window from the per-step flags below
+            lscale = (self._scaler.loss_scale
+                      if self._scaler is not None else 1.0)
+            tr = [p._data[0]._data for p in self._trainable]
+            aux = [p._data[0]._data for p in self._aux]
+            cshapes = ([list(map(int, np.shape(b))) for b in batch]
+                       if compiling else None)
+            from .mesh import use_mesh
+            with _obs.step_phase("sharded_trainer", "compiled_step"), \
+                    _obs.maybe_compile_span(compiling,
+                                            "sharded_trainer.run_steps",
+                                            num_steps=num_steps,
+                                            shapes=cshapes), \
+                    use_mesh(self.mesh):
+                (new_tr, aux_new, new_states, gstate, losses, fins,
+                 gns) = self._multi_fns[key](
                     tr, aux, self._states, self._guard_state,
                     _rng.next_key(), lrs, jnp.float32(t),
                     jnp.float32(self._optimizer.rescale_grad),
                     jnp.float32(lscale), *batch_datas)
-        for p, w in zip(self._trainable, new_tr):
-            p._data[0]._rebind(w)
-        for p, a in zip(self._aux, aux_new):
-            p._data[0]._rebind(a)
-        self._states = new_states
-        self._guard_state = gstate
-        self._after_run_steps(t, losses, fins, gns)
+            for p, w in zip(self._trainable, new_tr):
+                p._data[0]._rebind(w)
+            for p, a in zip(self._aux, aux_new):
+                p._data[0]._rebind(a)
+            self._states = new_states
+            self._guard_state = gstate
+            with _obs.step_phase("sharded_trainer", "guard_fetch"):
+                self._after_run_steps(t, losses, fins, gns)
         return nd.NDArray(losses[-1], _skip_device_put=True)
 
     def evaluate(self, *batch):

@@ -224,6 +224,12 @@ def finalize(root: str, step: int, meta: dict | None = None,
     """Rank-0 commit: manifest → publish rename → latest pointer → GC.
     The rename is the single commit point; every phase before it leaves
     the previous checkpoint untouched."""
+    from ..observability import trace as _trace
+    with _trace.span("ckpt_commit", root=root, step=int(step)):
+        return _finalize(root, step, meta, keep_last)
+
+
+def _finalize(root, step, meta, keep_last) -> dict:
     stage = stage_dir(root, step)
     doc = write_manifest(stage, step, meta)
     dst = step_dir(root, step)
@@ -259,12 +265,17 @@ def find_restorable(root: str, on_skip=None):
     it one step stale — ordering by it would resurrect the older
     checkpoint over a fully-committed newer one. The pointer stays an
     operator-facing hint (doctor reports it)."""
-    for step in sorted(committed_steps(root), reverse=True):
-        try:
-            return step, validate_step(root, step)
-        except ValueError as e:
-            if on_skip is not None:
-                on_skip(step, str(e))
+    from ..observability import trace as _trace
+    with _trace.span("ckpt_restore_scan", root=root) as sp:
+        for step in sorted(committed_steps(root), reverse=True):
+            try:
+                doc = validate_step(root, step)
+                sp.set_attrs(restored_step=step)
+                return step, doc
+            except ValueError as e:
+                if on_skip is not None:
+                    on_skip(step, str(e))
+        sp.set_attrs(restored_step=None)
     return None
 
 

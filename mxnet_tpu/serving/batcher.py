@@ -55,7 +55,7 @@ class Request:
     """One admitted sample and its completion slot."""
 
     __slots__ = ("payload", "shape", "key", "enq_t", "deadline_ts",
-                 "done", "result", "error", "served_t")
+                 "done", "result", "error", "served_t", "trace")
 
     def __init__(self, payload, shape, key, deadline_s=None, now=None):
         now = time.monotonic() if now is None else now
@@ -68,6 +68,11 @@ class Request:
         self.result = None
         self.error = None
         self.served_t = None
+        # the request's root span (observability.trace.start_span —
+        # the shared no-op with tracing off), opened at submit and
+        # closed by whichever thread resolves the request; None only
+        # for Requests constructed outside Server.submit
+        self.trace = None
 
     def late_ms(self, now=None) -> float:
         if self.deadline_ts is None:

@@ -90,7 +90,10 @@ class PredictorCache:
                 self.hits += 1
                 return entry, True
         # build outside the lock: a multi-second XLA compile must not
-        # block a stats() snapshot from another thread
+        # block a stats() snapshot from another thread. (The XLA compile
+        # itself happens at the entry's FIRST CALL — the server wraps
+        # that in the timed compile_span; this build is just the trace
+        # closure.)
         t0 = time.perf_counter()
         entry = builder()
         build_s = time.perf_counter() - t0

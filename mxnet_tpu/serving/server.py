@@ -15,6 +15,7 @@ with zero draining.  Every batch journals a structured record
 """
 from __future__ import annotations
 
+import itertools
 import os
 import queue
 import threading
@@ -26,6 +27,8 @@ import numpy as np
 from ..base import MXNetError
 from ..diagnostics.journal import get_journal
 from ..metric import LatencySummary
+from ..observability import instrument as _obs
+from ..observability import trace as _trace
 from ..resilience.retry import retry_call
 from .batcher import (DeadlineExceeded, PendingResponse, Request,
                       RequestError, ServerOverloaded, drop_expired,
@@ -36,6 +39,24 @@ from .cache import CompiledPredictor, PredictorCache
 __all__ = ["Server", "ServerConfig"]
 
 _STOP = object()
+_server_seq = itertools.count()
+
+
+def _req_ids(req) -> dict:
+    """trace_id/span_id of a request's root span for explicit journal
+    correlation (the root is started manually at submit, so the
+    thread-local provider can't see it); {} with tracing off."""
+    sp = req.trace
+    if sp is None or sp.trace_id is None:
+        return {}
+    return {"trace_id": sp.trace_id, "span_id": sp.span_id}
+
+
+def _end_span(req, status):
+    """Close a request's root span (idempotent; None-safe for Requests
+    built outside submit — batcher unit tests)."""
+    if req.trace is not None:
+        req.trace.end(status=status)
 
 
 def _env_int(name, default):
@@ -112,6 +133,11 @@ class Server:
         self._lock = threading.Lock()
         self._params_step = None
         self._last_reload_check = None
+        self._metrics_httpd = None
+        # exposition identity: the serving metric families are process-
+        # wide, so two Servers in one process must not overwrite each
+        # other's samples — each mirrors under its own label value
+        self._metrics_id = f"srv{next(_server_seq)}"
         self.counters = {"accepted": 0, "served": 0, "shed": 0,
                          "rejected_shape": 0, "deadline_miss_dequeue": 0,
                          "deadline_miss_post_batch": 0, "errors": 0,
@@ -160,6 +186,10 @@ class Server:
         except queue.Full:
             self._stopping.set()           # flooded: stop without drain
         self._worker.join(timeout=timeout_s)
+        if self._metrics_httpd is not None:
+            self._metrics_httpd.shutdown()
+            self._metrics_httpd.server_close()   # release the socket too
+            self._metrics_httpd = None
         stuck = self._worker.is_alive()
         get_journal().event("serving_stop", drained=bool(drain),
                             stuck=stuck, **self.stats())
@@ -190,15 +220,30 @@ class Server:
         deadline_s = None if deadline_ms is None or deadline_ms <= 0 \
             else deadline_ms / 1000.0
         req = Request(payload, payload.shape, key, deadline_s=deadline_s)
+        # one linked span tree per request (docs/observability.md):
+        # the root opens here and is closed by whichever thread resolves
+        # the request; the worker's batch span links back via span IDs.
+        # Attr construction is gated on enabled() so the off-is-free
+        # contract holds on the admission hot path (req.trace stays
+        # None — _req_ids/_end_span are None-safe)
+        traced = _trace.enabled()
+        if traced:
+            req.trace = _trace.start_span("serving_request",
+                                          shape=list(payload.shape))
         try:
             self._queue.put_nowait(req)
         except queue.Full:
             with self._lock:
                 self.counters["shed"] += 1
             get_journal().event("serving_shed", depth=self._queue.qsize(),
-                                limit=self.config.max_queue)
+                                limit=self.config.max_queue,
+                                **_req_ids(req))
+            _end_span(req, "shed")
             raise ServerOverloaded(self._queue.qsize(),
                                    self.config.max_queue) from None
+        if traced:
+            _trace.event("enqueue", parent=req.trace,
+                         depth=self._queue.qsize())
         with self._lock:
             self.counters["accepted"] += 1
         return PendingResponse(req, self.config.result_timeout_s)
@@ -215,6 +260,58 @@ class Server:
                 "cache": self.cache.stats(),
                 "latency_ms": self.latency.summary(),
                 **counters}
+
+    # -- metrics exposition (docs/observability.md) --------------------------
+    def metrics_text(self) -> str:
+        """Prometheus text exposition: the serving counters/gauges
+        mirrored into the process default registry at call time, plus
+        everything already there (compile counters, step phases).
+        Mirrors are gauges — the server's own dict stays the source of
+        truth, and a second Server in the same process must not trip a
+        monotonicity check on shared families."""
+        from ..observability import metrics as _m
+        reg = _m.default_registry()
+        st = self.stats()
+        sid = self._metrics_id
+        reg.gauge("mxnet_tpu_serving_queue_depth",
+                  "admission queue depth", ("server",)).labels(
+            server=sid).set(st["queue_depth"])
+        if st["params_step"] is not None:
+            reg.gauge("mxnet_tpu_serving_params_step",
+                      "hot-reloaded checkpoint step currently served",
+                      ("server",)).labels(server=sid).set(
+                st["params_step"])
+        ev = reg.gauge("mxnet_tpu_serving_events",
+                       "serving lifecycle counters (cumulative)",
+                       ("server", "event"))
+        for k in ("accepted", "served", "shed", "rejected_shape",
+                  "deadline_miss_dequeue", "deadline_miss_post_batch",
+                  "errors", "reloads", "batches"):
+            ev.labels(server=sid, event=k).set(st[k])
+        cache = st["cache"]
+        ce = reg.gauge("mxnet_tpu_serving_cache_events",
+                       "compiled-predictor cache counters (cumulative; "
+                       "misses == compiles)", ("server", "event"))
+        for k in ("hits", "misses", "evictions", "entries"):
+            ce.labels(server=sid, event=k).set(cache[k])
+        lat = st["latency_ms"]
+        if lat["count"]:
+            lq = reg.gauge("mxnet_tpu_serving_latency_ms",
+                           "end-to-end request latency percentiles",
+                           ("server", "quantile"))
+            for q in ("p50", "p95", "p99"):
+                lq.labels(server=sid, quantile=q).set(lat[q])
+        return reg.prometheus_text()
+
+    def start_metrics_server(self, host="127.0.0.1", port=0):
+        """Expose ``GET /metrics`` (Prometheus text) on a stdlib daemon
+        HTTP server; returns it (``.server_address[1]`` is the bound
+        port; ``port=0`` picks a free one).  Stopped by ``stop()``."""
+        if self._metrics_httpd is None:
+            from ..observability.export import serve_metrics
+            self._metrics_httpd = serve_metrics(self.metrics_text,
+                                                host=host, port=port)
+        return self._metrics_httpd
 
     # -- worker --------------------------------------------------------------
     def _run(self):
@@ -281,7 +378,8 @@ class Server:
         with self._lock:
             self.counters["deadline_miss_dequeue"] += 1
         get_journal().event("serving_deadline_miss", stage="dequeue",
-                            late_ms=round(late, 2))
+                            late_ms=round(late, 2), **_req_ids(req))
+        _end_span(req, "deadline_miss_dequeue")
         req.set_error(DeadlineExceeded("dequeue", late))
 
     def _fail_remaining(self, pending):
@@ -293,6 +391,7 @@ class Server:
             if item is not _STOP:
                 pending.append(item)
         for req in pending:
+            _end_span(req, "stopped")
             req.set_error(RequestError("server stopped before this "
                                        "request was served"))
         pending.clear()
@@ -300,6 +399,16 @@ class Server:
     def _process(self, batch, bucket, key):
         cfg = self.config
         n = len(batch)
+        # the batch execution is its own trace, linked both ways: the
+        # batch span lists the member request spans, and each request's
+        # "execute" child names the batch span (docs/observability.md)
+        with _trace.span(
+                "serving_batch", batch=n, bucket=bucket, key=list(key),
+                request_spans=[i["span_id"] for r in batch
+                               for i in [_req_ids(r)] if i]) as bsp:
+            self._process_traced(batch, bucket, key, n, cfg, bsp)
+
+    def _process_traced(self, batch, bucket, key, n, cfg, bsp):
         padded = np.full((bucket,) + key, cfg.pad_value, dtype=self._dtype)
         for i, req in enumerate(batch):
             padded[(i,) + tuple(slice(0, d) for d in req.shape)] = req.payload
@@ -308,9 +417,15 @@ class Server:
             cache_key, lambda: CompiledPredictor(self.block, ctx=self._ctx))
         t0 = time.perf_counter()
         try:
-            outs, treedef = retry_call(
-                predictor, padded, retries=cfg.device_retries,
-                retry_on=cfg.transient_errors, what="serving_predict")
+            # a cache miss's first call traces + compiles the padded
+            # shape: the timed compile event for this jit-miss site
+            with _obs.maybe_compile_span(
+                    not hit, "serving_predictor", bucket=bucket,
+                    key=list(key), dtype=self._dtype.str,
+                    includes_execute=True):
+                outs, treedef = retry_call(
+                    predictor, padded, retries=cfg.device_retries,
+                    retry_on=cfg.transient_errors, what="serving_predict")
             outs = [np.asarray(o) for o in outs]
         except Exception as exc:
             with self._lock:
@@ -320,9 +435,11 @@ class Server:
             err = RequestError(f"predictor failed: "
                                f"{type(exc).__name__}: {exc}")
             for req in batch:
+                _end_span(req, "error")
                 req.set_error(err)
             return
-        exec_ms = (time.perf_counter() - t0) * 1000.0
+        t1 = time.perf_counter()
+        exec_ms = (t1 - t0) * 1000.0
 
         import jax
         now = time.monotonic()
@@ -334,7 +451,9 @@ class Server:
                     self.counters["deadline_miss_post_batch"] += 1
                 get_journal().event("serving_deadline_miss",
                                     stage="post_batch",
-                                    late_ms=round(late, 2))
+                                    late_ms=round(late, 2),
+                                    **_req_ids(req))
+                _end_span(req, "deadline_miss_post_batch")
                 req.set_error(DeadlineExceeded("post_batch", late), now)
                 continue
             rows = []
@@ -346,6 +465,13 @@ class Server:
                 rows.append(row)
             result = rows[0] if treedef is None else \
                 jax.tree_util.tree_unflatten(treedef, rows)
+            if req.trace is not None and req.trace.span_id is not None:
+                # the shared execution window, under this request's root
+                _trace.record("execute", parent=req.trace, t0=t0, t1=t1,
+                              batch_span=bsp.span_id, batch=n,
+                              bucket=bucket)
+                _trace.event("respond", parent=req.trace)
+            _end_span(req, "ok")
             req.set_result(result, now)
             delivered += 1
             self.latency.observe((now - req.enq_t) * 1000.0)

@@ -1,0 +1,68 @@
+# graftlint: scope=library
+"""G11 fixture: wall-clock durations (time.time() subtraction) in
+library code — NTP steps make them go negative.  Parsed only, never
+executed."""
+import time
+
+
+def bad_direct(t0):
+    return time.time() - t0  # expect: G11
+
+
+def bad_tainted_name():
+    start = time.time()
+    _work()
+    return time.time() - start  # expect: G11
+
+
+def bad_tainted_right_operand(now_mono):
+    begin = time.time()
+    _work()
+    return now_mono - begin  # expect: G11
+
+
+def good_monotonic():
+    t0 = time.monotonic()
+    _work()
+    return time.monotonic() - t0
+
+
+def good_perf_counter():
+    t0 = time.perf_counter()
+    _work()
+    return time.perf_counter() - t0
+
+
+def good_timestamp_only():
+    # wall clock as a timestamp (no subtraction) is exactly what
+    # time.time() is for
+    return {"ts": round(time.time(), 3)}
+
+
+def good_deadline_arithmetic():
+    # addition/comparison is not a duration
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        _work()
+
+
+def good_rebound_to_monotonic():
+    # a wall-clock name REASSIGNED from a monotonic source is clean —
+    # the taint follows line order, not the whole scope
+    t = time.time()          # timestamp, used as-is
+    _stamp(t)
+    t = time.monotonic()
+    _work()
+    return time.monotonic() - t
+
+
+def _stamp(ts):
+    return ts
+
+
+def suppressed(t0):
+    return time.time() - t0  # graftlint: disable=G11 fixture twin
+
+
+def _work():
+    pass

@@ -1,0 +1,628 @@
+"""Unified telemetry (docs/observability.md): span tracing semantics
+(nesting, thread propagation, ring bounds), the metrics registry and
+its Prometheus exposition, the Chrome-trace/Perfetto export golden
+tests, the journal trace-id correlation, the serving per-request span
+tree, and the disabled-overhead transfer-guard contract across all four
+training paths.
+
+The ``*smoke*`` tests are CI's tier-0.5 observability smoke
+(ci/run_tests.sh): one traced training step + one traced serving
+request, both exporters parsed.
+"""
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, io, observability, parallel, sym
+from mxnet_tpu.diagnostics import journal
+from mxnet_tpu.guardrails import GuardConfig
+from mxnet_tpu.observability import export, instrument, metrics, trace
+from mxnet_tpu.observability.report import metrics_report, trace_report
+from mxnet_tpu.serving import Server, ServerConfig
+from mxnet_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Every test starts from the env default (tracing off) and a clean
+    metrics registry, and leaves no tracer/journal state behind."""
+    trace.reset_tracer()
+    metrics.reset_metrics()
+    yield
+    trace.reset_tracer()
+    metrics.reset_metrics()
+
+
+@pytest.fixture
+def ring():
+    return trace.configure(mode="ring")
+
+
+@pytest.fixture
+def jfile(tmp_path):
+    jf = str(tmp_path / "journal.jsonl")
+    journal.reset_journal(jf)
+    try:
+        yield jf
+    finally:
+        journal.reset_journal()
+
+
+def _read_journal(path):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=8))
+        net.add(gluon.nn.Dense(4, in_units=16))
+    net.initialize()
+    return net
+
+
+def _sharded(guard=None, **kw):
+    net = _mlp()
+    mesh = parallel.make_mesh({"data": -1})
+    tr = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=mesh, guard=guard, **kw)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16,))
+    return tr, x, y
+
+
+# -- span semantics ----------------------------------------------------------
+
+def test_span_nesting_ids_and_ring(ring):
+    with trace.span("outer", a=1) as outer:
+        assert trace.current_span() is outer
+        with trace.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+        trace.event("pt", x=2)
+    assert trace.current_span() is None
+    spans = {s["name"]: s for s in ring.spans()}
+    assert set(spans) == {"outer", "inner", "pt"}
+    assert spans["outer"]["parent_id"] is None
+    assert spans["outer"]["attrs"] == {"a": 1}
+    assert spans["pt"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["pt"]["dur_s"] == 0.0
+    assert all(s["dur_s"] >= 0 for s in spans.values())
+    # two separate roots get distinct trace ids (process-token prefixed)
+    with trace.span("other"):
+        pass
+    other = [s for s in ring.spans() if s["name"] == "other"][0]
+    assert other["trace_id"] != spans["outer"]["trace_id"]
+
+
+def test_ring_is_bounded_and_counts_drops():
+    tr = trace.configure(mode="ring", ring=4)
+    for i in range(10):
+        with trace.span(f"s{i}"):
+            pass
+    assert len(tr.spans()) == 4
+    assert tr.recorded == 10 and tr.dropped == 6
+    assert [s["name"] for s in tr.spans()] == ["s6", "s7", "s8", "s9"]
+
+
+def test_thread_parent_propagation(ring):
+    """contextvars don't cross threads: the capture token does — the
+    serving-worker pattern."""
+    got = {}
+
+    def worker(ctx):
+        with trace.span("child", parent=ctx) as sp:
+            got["trace"] = sp.trace_id
+            got["parent"] = sp.parent_id
+
+    with trace.span("root") as root:
+        ctx = trace.current_context()
+        t = threading.Thread(target=worker, args=(ctx,))
+        t.start()
+        t.join(10)
+    assert got["trace"] == root.trace_id
+    assert got["parent"] == root.span_id
+    child = [s for s in ring.spans() if s["name"] == "child"][0]
+    assert child["thread"] != "MainThread"
+
+
+def test_disabled_tracing_is_inert_noop():
+    assert trace.mode() == "off"
+    sp = trace.span("x", a=1)
+    sp2 = trace.span("y")
+    assert sp is sp2                         # one shared no-op object
+    with sp:
+        assert trace.current_ids() == {}
+        assert trace.annotate(k=1) is False
+    assert trace.get_tracer().recorded == 0
+
+
+def test_bad_trace_mode_degrades_off(monkeypatch, jfile):
+    monkeypatch.setenv("MXNET_TPU_TRACE", "bogus")
+    tr = trace.reset_tracer()
+    assert tr.mode == "off"
+    recs = [r for r in _read_journal(jfile) if r["kind"] == "trace_bad_mode"]
+    assert recs and recs[0]["value"] == "bogus"
+
+
+# -- journal correlation (the satellite: one trace across journals) ----------
+
+def test_journal_records_carry_trace_ids_inside_spans(ring, jfile):
+    j = journal.get_journal()
+    j.event("plain")                         # outside any span
+    with trace.span("scope") as sp:
+        j.event("inside", foo=1)
+        # explicit fields always win over the provider
+        j.event("explicit", trace_id="mine")
+    recs = {r["kind"]: r for r in _read_journal(jfile)}
+    assert "trace_id" not in recs["plain"]   # bit-identical when off-span
+    assert recs["inside"]["trace_id"] == sp.trace_id
+    assert recs["inside"]["span_id"] == sp.span_id
+    assert recs["inside"]["foo"] == 1
+    assert recs["explicit"]["trace_id"] == "mine"
+
+
+def test_guardrail_skip_record_correlates_with_step_trace(ring, jfile):
+    tr, x, y = _sharded(guard=True)
+    tr.step(x, y)
+    tr.step(faults.poison_batch(x), y)
+    skip = [r for r in _read_journal(jfile)
+            if r["kind"] == "nonfinite_grad"][0]
+    assert "trace_id" in skip and "span_id" in skip
+    steps = [s for s in trace.get_tracer().spans()
+             if s["name"] == "sharded_trainer.step"]
+    assert skip["trace_id"] in {s["trace_id"] for s in steps}
+
+
+# -- metrics registry + exposition -------------------------------------------
+
+def test_metrics_registry_families_and_snapshot():
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("req_total", "requests", ("route",))
+    c.labels(route="a").inc()
+    c.labels(route="a").inc(2)
+    c.labels(route="b").inc()
+    g = reg.gauge("depth", "queue depth")
+    g.set(7)
+    s = reg.summary("lat_ms", "latency", ())
+    for v in (1.0, 2.0, 10.0):
+        s.observe(v)
+    snap = reg.snapshot()
+    assert snap["req_total"]["values"] == {"route=a": 3.0, "route=b": 1.0}
+    assert snap["depth"]["values"][""] == 7.0
+    assert snap["lat_ms"]["values"][""]["count"] == 3
+    # idempotent getter; kind mismatch is structural
+    assert reg.counter("req_total", labelnames=("route",)) is c
+    with pytest.raises(Exception, match="already registered"):
+        reg.gauge("req_total")
+    with pytest.raises(Exception, match="takes labels"):
+        c.labels(wrong="x")
+
+
+def test_prometheus_exposition_format():
+    reg = metrics.MetricsRegistry()
+    reg.counter("c_total", "a counter", ("site",)).labels(
+        site='we"ird\\x').inc(5)
+    reg.gauge("g", "a gauge").set(1.5)
+    s = reg.summary("s_ms", "a summary")
+    s.observe(4.0)
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    assert "# TYPE c_total counter" in lines
+    assert "# HELP c_total a counter" in lines
+    assert 'c_total{site="we\\"ird\\\\x"} 5' in lines
+    assert "g 1.5" in lines
+    assert 's_ms{quantile="0.5"} 4' in lines
+    assert "s_ms_sum 4" in lines and "s_ms_count 1" in lines
+    # every non-comment line is `name{labels} value`
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? '
+        r'(NaN|[+-]?Inf|[-+0-9.e]+)$')
+    for ln in lines:
+        if ln and not ln.startswith("#"):
+            assert sample_re.match(ln), ln
+
+
+def test_latency_summary_is_reexported_for_compat():
+    from mxnet_tpu.metric import LatencySummary
+    assert LatencySummary is metrics.LatencySummary
+    ls = LatencySummary(reservoir_size=4)
+    for v in range(100):
+        ls.observe(float(v))
+    assert ls.count == 100
+    assert len(ls._buf) == 4
+    with pytest.raises(mx.MXNetError):
+        LatencySummary(reservoir_size=0)
+
+
+def test_step_phase_metrics_are_always_on_even_with_trace_off():
+    """The bench provenance path: compile counts and step-phase
+    summaries accumulate with tracing disabled."""
+    assert trace.mode() == "off"
+    tr, x, y = _sharded()
+    tr.step(x, y)
+    tr.step(x, y)
+    snap = observability.snapshot()
+    phases = snap["metrics"][instrument.PHASE_METRIC]["values"]
+    key = "trainer=sharded_trainer,phase=compiled_step"
+    assert phases[key]["count"] == 2
+    comp = observability.compile_stats(snap)
+    assert comp["compiles"] == 1
+    assert comp["by_site"] == {"sharded_trainer.step": 1}
+    assert snap["trace"]["recorded"] == 0
+
+
+# -- Perfetto / Chrome-trace export golden -----------------------------------
+
+def _assert_chrome_doc(doc):
+    """The format contract Perfetto's JSON importer needs: a
+    traceEvents list of complete events with name/ph/ts/dur/pid/tid."""
+    assert set(doc) >= {"traceEvents"}
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], str)
+        assert "span_id" in ev["args"] and "trace_id" in ev["args"]
+    json.loads(json.dumps(doc))              # round-trips as pure JSON
+
+
+def _containment(doc, child_name, parent_name):
+    """Child events sit inside their parent's [ts, ts+dur] window."""
+    evs = doc["traceEvents"]
+    by_id = {e["args"]["span_id"]: e for e in evs}
+    checked = 0
+    for e in evs:
+        if e["name"] != child_name:
+            continue
+        parent = by_id.get(e["args"].get("parent_id"))
+        if parent is None or parent["name"] != parent_name:
+            continue
+        eps = 1e3  # 1 ms slack for rounding
+        assert e["ts"] >= parent["ts"] - eps
+        assert e["ts"] + e["dur"] <= parent["ts"] + parent["dur"] + eps
+        checked += 1
+    assert checked > 0, f"no {child_name} under {parent_name}"
+
+
+def test_smoke_traced_training_step_perfetto_export(tmp_path, ring):
+    """Acceptance: a traced training run exports Chrome-trace JSON with
+    compile events, step phases and checkpoint commits as nested
+    spans."""
+    tr, x, y = _sharded(guard=True)
+    tr.step(x, y)
+    tr.step(x, y)
+    tr.checkpoint(str(tmp_path / "ckpt"))
+    out = str(tmp_path / "trace.json")
+    n = export.export_chrome(out)
+    with open(out, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert len(doc["traceEvents"]) == n
+    _assert_chrome_doc(doc)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"sharded_trainer.step", "sharded_trainer.data_wait",
+            "sharded_trainer.compiled_step",
+            "sharded_trainer.guard_fetch", "xla_compile",
+            "ckpt_commit"} <= names
+    _containment(doc, "sharded_trainer.compiled_step",
+                 "sharded_trainer.step")
+    _containment(doc, "xla_compile", "sharded_trainer.compiled_step")
+    # exactly one compile event for two same-shape steps
+    compiles = [e for e in doc["traceEvents"] if e["name"] == "xla_compile"]
+    assert len(compiles) == 1
+    assert compiles[0]["args"]["shapes"] == [[16, 8], [16]]
+
+
+def _fit_mod(tmp_path=None, num_epoch=2, prefix=None):
+    rng = np.random.RandomState(0)
+    x = rng.randn(40, 6).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    it = io.NDArrayIter(x, y, batch_size=10)
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            checkpoint_prefix=prefix)
+    return mod, it
+
+
+def test_traced_module_fit_epoch_perfetto_export(tmp_path, ring):
+    """Acceptance: a traced module.fit epoch exports a Perfetto-valid
+    trace with the epoch/step/phase/compile/checkpoint span tree."""
+    _fit_mod(prefix=str(tmp_path / "ck" / "mlp"))
+    doc = export.to_chrome_trace()
+    _assert_chrome_doc(doc)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"module_fit.epoch", "module_fit.step",
+            "module_fit.forward_backward", "module_fit.update",
+            "module_fit.data_wait", "xla_compile",
+            "ckpt_commit"} <= names
+    _containment(doc, "module_fit.step", "module_fit.epoch")
+    _containment(doc, "module_fit.forward_backward", "module_fit.step")
+    _containment(doc, "ckpt_commit", "module_fit.epoch")
+    # the bind compile is tagged with the module site
+    sites = {e["args"].get("site") for e in doc["traceEvents"]
+             if e["name"] == "xla_compile"}
+    assert "module_bind" in sites
+
+
+def test_chrome_trace_from_journal_roundtrip(tmp_path, jfile):
+    trace.configure(mode="journal")
+    with trace.span("a", k=1):
+        with trace.span("b"):
+            pass
+    doc = export.chrome_trace_from_journal(jfile)
+    _assert_chrome_doc(doc)
+    assert {e["name"] for e in doc["traceEvents"]} == {"a", "b"}
+    # journal mode also keeps the ring populated
+    assert len(trace.get_tracer().spans()) == 2
+
+
+# -- serving: one linked span tree per request --------------------------------
+
+class _Scale(gluon.block.HybridBlock):
+    def __init__(self, k=3.0, **kw):
+        super().__init__(**kw)
+        self.k = k
+
+    def hybrid_forward(self, F, x):
+        return x * self.k
+
+
+def test_smoke_serving_request_linked_span_tree(ring, jfile):
+    """Acceptance: each served request owns one span tree —
+    serving_request root with enqueue/execute/respond children — and
+    the execute child names the shared batch span; the serving_batch
+    journal record carries the batch span's ids."""
+    net = _Scale()
+    net.initialize()
+    srv = Server(net, ServerConfig(max_batch=4, window_ms=2.0)).start()
+    try:
+        outs = [srv.predict(np.ones((3,), np.float32) * i)
+                for i in range(2)]
+    finally:
+        srv.stop()
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(o), np.ones(3) * i * 3.0)
+
+    spans = trace.get_tracer().spans()
+    roots = [s for s in spans if s["name"] == "serving_request"]
+    assert len(roots) == 2
+    batch_ids = {s["span_id"] for s in spans if s["name"] == "serving_batch"}
+    for root in roots:
+        kids = {s["name"]: s for s in spans
+                if s.get("parent_id") == root["span_id"]}
+        assert {"enqueue", "execute", "respond"} <= set(kids)
+        assert all(s["trace_id"] == root["trace_id"]
+                   for s in kids.values())
+        assert kids["execute"]["attrs"]["batch_span"] in batch_ids
+        assert root["attrs"]["status"] == "ok"
+    # batch journal record carries the batch span ids (worker thread)
+    recs = [r for r in _read_journal(jfile) if r["kind"] == "serving_batch"]
+    assert recs and all(r.get("span_id") in batch_ids for r in recs)
+    # and the whole ring exports as a Perfetto-valid doc
+    _assert_chrome_doc(export.to_chrome_trace())
+
+
+def test_serving_shed_record_carries_request_trace(ring, jfile):
+    from mxnet_tpu.serving import ServerOverloaded
+    net = _Scale()
+    net.initialize()
+    srv = Server(net, ServerConfig(max_batch=2, max_queue=1))
+    # not started: the queue fills and the second submit sheds
+    srv.submit(np.ones((3,), np.float32))
+    with pytest.raises(ServerOverloaded):
+        srv.submit(np.ones((3,), np.float32))
+    shed = [r for r in _read_journal(jfile) if r["kind"] == "serving_shed"]
+    sheds = [s for s in trace.get_tracer().spans()
+             if s["name"] == "serving_request"
+             and s["attrs"].get("status") == "shed"]
+    assert shed and sheds
+    assert shed[0]["trace_id"] == sheds[0]["trace_id"]
+    srv._fail_remaining([])                 # drain the queued request
+
+
+# -- Prometheus endpoint on the serving server -------------------------------
+
+def test_server_metrics_text_and_http_endpoint():
+    import http.client
+    net = _Scale()
+    net.initialize()
+    srv = Server(net, ServerConfig(max_batch=4, window_ms=2.0)).start()
+    try:
+        srv.predict(np.ones((3,), np.float32))
+        text = srv.metrics_text()
+        sid = srv._metrics_id
+        assert "# TYPE mxnet_tpu_serving_queue_depth gauge" in text
+        assert (f'mxnet_tpu_serving_events{{server="{sid}",'
+                f'event="served"}} 1') in text
+        assert (f'mxnet_tpu_serving_cache_events{{server="{sid}",'
+                f'event="misses"}} 1') in text
+        # the shared registry rides along: the serving compile is there
+        assert 'mxnet_tpu_xla_compiles_total{site="serving_predictor"} 1' \
+            in text
+        httpd = srv.start_metrics_server(port=0)
+        assert srv.start_metrics_server() is httpd      # idempotent
+        port = httpd.server_address[1]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode("utf-8")
+        assert resp.status == 200
+        assert "text/plain" in resp.getheader("Content-Type")
+        assert "mxnet_tpu_serving_events" in body
+        conn.request("GET", "/nope")
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        srv.stop()
+    assert srv._metrics_httpd is None       # stop() shut the endpoint
+
+
+# -- the disabled-overhead contract ------------------------------------------
+
+def test_trace_off_zero_host_reads_sharded_and_pipelined():
+    """With MXNET_TPU_TRACE=off the instrumented compiled step paths
+    add ZERO device→host transfers: the fused trainers run under
+    transfer_guard(disallow) (the guardrails technique)."""
+    import jax
+    assert trace.mode() == "off"
+    tr, x, y = _sharded(guard=GuardConfig(mode="deferred"))
+    tr.step(x, y)                           # compile + warm
+    xb = [tr._shard_batch_arg(b) for b in (x, y)]
+    with jax.transfer_guard_device_to_host("disallow"):
+        for _ in range(2):
+            tr.step(*xb)
+
+    mesh = parallel.make_mesh({"pipe": 2, "data": 4})
+    emb = gluon.nn.Embedding(16, 8)
+    body = [gluon.nn.Dense(8, in_units=8, flatten=False)
+            for _ in range(2)]
+    head = gluon.nn.Dense(16, in_units=8, flatten=False)
+    for b in (emb, *body, head):
+        b.initialize()
+    ptr = parallel.PipelinedTrainer(
+        emb, body, head, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh, num_microbatches=2)
+    tok = np.arange(32, dtype=np.int32).reshape(8, 4) % 16
+    lab = tok.copy()
+    ptr.step(tok, lab)                      # compile + warm
+    import jax.numpy as jnp
+    tokd, labd = jnp.asarray(tok), jnp.asarray(lab)
+    with jax.transfer_guard_device_to_host("disallow"):
+        for _ in range(2):
+            ptr.step(tokd, labd)
+
+
+def test_trace_off_zero_host_reads_gluon_trainer_and_module():
+    """The eager paths: gluon Trainer.step (no guard/scaler) and the
+    module fit step loop (no metric sync) also add zero transfers."""
+    import jax
+    from mxnet_tpu import autograd
+    assert trace.mode() == "off"
+    net = _mlp()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 8)
+                    .astype(np.float32))
+    y = mx.nd.array(np.random.RandomState(1).randint(0, 4, (8,)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def one_step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        tr.step(batch_size=8)
+
+    one_step()                              # warm every jitted kernel
+    with jax.transfer_guard_device_to_host("disallow"):
+        one_step()
+
+    # module fit's instrumented batch loop (_fit_epoch), metric no-op'd
+    class _NoSync(mx.metric.EvalMetric):
+        def update(self, labels, preds):
+            pass
+
+    mod, it = _fit_mod(num_epoch=1)
+    it.reset()
+    with jax.transfer_guard_device_to_host("disallow"):
+        stopped, steps = mod._fit_epoch(
+            it, _NoSync("nosync"), epoch=1, monitor=None,
+            anomaly_monitor=None, checkpoint_prefix=None,
+            batch_end_callback=None, watch=None, global_step=0)
+    assert not stopped and steps == 4
+
+
+# -- reports + doctor surfaces ------------------------------------------------
+
+def test_trace_report_summarizes_journal(tmp_path, jfile):
+    trace.configure(mode="journal")
+    with trace.span("stepish"):
+        with trace.span("phase"):
+            pass
+    rep = trace_report(jfile)
+    assert rep["ok"] and rep["spans"] == 2 and rep["traces"] == 1
+    assert set(rep["by_name"]) == {"stepish", "phase"}
+    assert rep["slowest"][0]["name"] in ("stepish", "phase")
+    bad = trace_report(str(tmp_path / "missing.jsonl"))
+    assert bad["ok"] is False
+    empty = trace_report(__file__)
+    assert empty["ok"] is False and "no span records" in empty["error"]
+
+
+def test_metrics_report_reads_bench_artifact(tmp_path):
+    tr, x, y = _sharded()
+    tr.step(x, y)
+    artifact = {"metric": "whatever", "value": 1,
+                "observability": observability.snapshot()}
+    p = str(tmp_path / "BENCH_x.json")
+    with open(p, "w", encoding="utf-8") as f:
+        json.dump(artifact, f)
+    rep = metrics_report(p)
+    assert rep["ok"]
+    assert rep["compiles_total"] == 1
+    assert any("sharded_trainer" in k for k in rep["step_phase_ms"])
+    bad = metrics_report(str(tmp_path / "missing.json"))
+    assert bad["ok"] is False
+
+
+def test_doctor_dispatch_table_covers_all_reporters():
+    """The doctor cleanup satellite: one table row per report surface,
+    and the new --trace/--metrics surfaces are rows in it."""
+    from mxnet_tpu.diagnostics import __main__ as dmain
+    keys = [row[0] for row in dmain._REPORT_TABLE]
+    assert keys == ["checkpoint", "serving", "guardrails", "trace",
+                    "metrics"]
+    for _key, flag, _env, _mv, _help, load, summ in dmain._REPORT_TABLE:
+        assert flag.startswith("--") and callable(load) and callable(summ)
+
+
+@pytest.mark.slow
+def test_observability_cli_dump_and_report(tmp_path):
+    import subprocess
+    import sys
+    jf = str(tmp_path / "j.jsonl")
+    out = str(tmp_path / "trace.json")
+    code = ("from mxnet_tpu.observability import trace\n"
+            "with trace.span('cli_root'):\n"
+            "    with trace.span('cli_child'):\n"
+            "        pass\n")
+    env = dict(__import__('os').environ, JAX_PLATFORMS="cpu",
+               MXNET_TPU_JOURNAL=jf, MXNET_TPU_TRACE="journal")
+    r = subprocess.run([sys.executable, "-c", code], env=env, timeout=240)
+    assert r.returncode == 0
+    r = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.observability", "dump",
+         "--journal", jf, "--out", out],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+    with open(out, encoding="utf-8") as f:
+        doc = json.load(f)
+    _assert_chrome_doc(doc)
+    assert {e["name"] for e in doc["traceEvents"]} == {"cli_root",
+                                                       "cli_child"}
+    r = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.observability", "report",
+         "--journal", jf],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["ok"] and rep["spans"] == 2
