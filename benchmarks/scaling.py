@@ -1,20 +1,39 @@
 #!/usr/bin/env python
 """Scaling-efficiency harness (BASELINE.md metric: per-chip throughput at
-8 vs 64 chips, target ≥90%).
+8 vs 64 chips, target ≥90%) — wedge-proof.
 
-Runs the fused SPMD ResNet-50 step at a ladder of data-parallel mesh sizes
-over the available devices and reports per-chip throughput + efficiency
-relative to the smallest mesh. On a real pod slice this measures ICI
-all-reduce overlap; on the CPU-device fallback it validates the harness
-(numbers are not meaningful for the target).
+Same artifact contract as bench.py (the round-5 lesson: a driver gate
+must always receive ONE parseable JSON line, even when the TPU tunnel
+is wedged):
 
-Prints one JSON line per mesh size, then a summary line.
+1. the parent probes the backend through ``diagnostics.guard`` under a
+   hard deadline and emits a structured diagnostic instead of hanging;
+2. the measurement body runs in a deadlined child (``--body``); the
+   parent validates the child's metric line actually parses before
+   reprinting it (a dying tunnel truncating a write must be a skipped
+   line, never a broken contract);
+3. journal breadcrumbs + a SIGTERM finalizer emit a ``killed`` artifact
+   if the outer kill lands first;
+4. ``--artifact PATH`` additionally writes the full result —
+   per-mesh-size throughput ladder, scaling efficiency, **elastic /
+   cohort metadata** (``elastic.elastic_metadata()``: world shape, the
+   MXTPU_* env wiring) and the ``observability.snapshot()`` compile/
+   step-phase provenance — as a ``MULTICHIP_*.json`` document, so the
+   8→64 measurement is one command on the next healthy hardware window
+   (BASELINE.md staged command):
+
+     PYTHONPATH=. python benchmarks/scaling.py --network resnet50_v1 \
+         --sizes 8,64 --artifact MULTICHIP_r06.json
+
+On the CPU-device fallback the harness validates end to end (numbers
+are not meaningful for the target).
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -22,6 +41,28 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+METRIC = "scaling_efficiency"
+BODY_TIMEOUT_S = 1500.0
+BODY_TIMEOUT_CPU_S = 420.0
+
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def _diagnostic(error: str, detail: str) -> dict:
+    return {"metric": METRIC, "value": None, "target": 0.9,
+            "error": error, "detail": detail}
+
+
+def _write_artifact(path, doc) -> None:
+    if not path:
+        return
+    from mxnet_tpu.resilience import atomic
+    with atomic.atomic_write(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(f"scaling: artifact -> {path}", file=sys.stderr)
 
 
 def measure(n_chips, batch_per_chip, steps, warmup, network, classes,
@@ -55,7 +96,7 @@ def measure(n_chips, batch_per_chip, steps, warmup, network, classes,
     return batch * steps / dt / n_chips
 
 
-def main():
+def _parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--network", default="resnet50_v1")
     p.add_argument("--classes", type=int, default=1000)
@@ -68,30 +109,141 @@ def main():
                         "visible devices)")
     p.add_argument("--no-bf16", dest="bf16", action="store_false",
                    default=True)
-    args = p.parse_args()
+    p.add_argument("--artifact", default=None,
+                   help="also write the full result (ladder + elastic/"
+                        "cohort metadata + observability snapshot) to "
+                        "this path, e.g. MULTICHIP_r06.json")
+    p.add_argument("--body", action="store_true",
+                   help=argparse.SUPPRESS)
+    return p.parse_args(argv)
 
+
+def _run_body(args) -> int:
     import jax
+    from mxnet_tpu import elastic, observability
+
     n = len(jax.devices())
     if args.sizes:
-        sizes = [int(s) for s in args.sizes.split(",")]
+        sizes = [int(s) for s in args.sizes.split(",") if s]
+        missing = [s for s in sizes if s > n]
+        if missing:
+            # an explicitly-requested size the hardware can't provide
+            # must fail LOUDLY: silently clamping would let the 8->64
+            # gate "pass" with base==max (a vacuous efficiency of 1.0)
+            _emit(_diagnostic(
+                "insufficient_devices",
+                f"requested mesh sizes {missing} exceed the {n} visible "
+                f"devices — refusing to fake the scaling ladder"))
+            return 1
     else:
         sizes = [s for s in (1, 2, 4, 8, 16, 32, 64) if s <= n]
     results = {}
     for s in sizes:
-        per_chip = measure(s, args.batch_per_chip, args.steps, args.warmup,
-                           args.network, args.classes, args.image,
-                           args.bf16)
+        per_chip = measure(s, args.batch_per_chip, args.steps,
+                           args.warmup, args.network, args.classes,
+                           args.image, args.bf16)
         results[s] = per_chip
         print(json.dumps({"chips": s,
-                          "images_per_sec_per_chip": round(per_chip, 2)}))
+                          "images_per_sec_per_chip": round(per_chip, 2)}),
+              file=sys.stderr, flush=True)
     base = results[sizes[0]]
-    print(json.dumps({
-        "metric": "scaling_efficiency",
-        "base_chips": sizes[0], "max_chips": sizes[-1],
+    obs = observability.snapshot()
+    _emit({
+        "metric": METRIC,
         "value": round(results[sizes[-1]] / base, 4),
         "target": 0.9,
-    }))
+        "base_chips": sizes[0], "max_chips": sizes[-1],
+        "network": args.network, "bf16": bool(args.bf16),
+        "batch_per_chip": args.batch_per_chip,
+        "platform": jax.devices()[0].platform,
+        "ladder": {str(s): round(v, 2) for s, v in results.items()},
+        # cohort/elastic provenance (docs/elastic.md): world shape +
+        # env wiring, so a pod-slice artifact records which cohort ran
+        "elastic": elastic.elastic_metadata(),
+        "observability": obs,
+    })
+    return 0
+
+
+def main() -> int:
+    args = _parse_args()
+    if args.body:
+        return _run_body(args)
+
+    from mxnet_tpu.diagnostics import get_journal, guard
+    j = get_journal()
+
+    def _killed():
+        doc = _diagnostic(
+            "scaling_killed",
+            f"killed at phase {j.last_phase!r} before completion (outer "
+            "deadline or signal); see stderr journal for breadcrumbs")
+        _emit(doc)
+        _write_artifact(args.artifact, doc)
+
+    j.install_handlers(final_cb=_killed)
+    with j.phase("scaling_probe"):
+        try:
+            info = guard.probe_backend()
+        except guard.DeviceUnreachable as e:
+            doc = _diagnostic("device_unreachable", e.to_dict().get(
+                "detail", str(e)))
+            _emit(doc)
+            _write_artifact(args.artifact, doc)
+            j.mark_clean()
+            return 0
+    body_deadline = (BODY_TIMEOUT_S if info["platform"] in ("tpu", "axon")
+                     else BODY_TIMEOUT_CPU_S)
+    j.set_phase("scaling_body")
+    child_args, skip = [], False
+    for a in sys.argv[1:]:
+        if skip:
+            skip = False
+        elif a == "--artifact":
+            skip = True            # the parent owns artifact writing
+        elif not a.startswith("--artifact="):
+            child_args.append(a)
+    child_cmd = [sys.executable, os.path.abspath(__file__),
+                 "--body"] + child_args
+    try:
+        proc = subprocess.run(child_cmd, capture_output=True, text=True,
+                              timeout=body_deadline)
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"").decode("utf-8", "replace")
+                if isinstance(e.stderr, bytes) else (e.stderr or ""))[-500:]
+        doc = _diagnostic(
+            "scaling_timeout",
+            f"probe was healthy ({info['n']}x {info['platform']}) but the "
+            f"body exceeded {body_deadline:g}s; stderr tail: {tail}")
+        _emit(doc)
+        _write_artifact(args.artifact, doc)
+        j.mark_clean()
+        return 0
+    j.set_phase("scaling_report")
+    sys.stderr.write(proc.stderr[-3000:])
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(parsed, dict) or parsed.get("metric") != METRIC:
+            continue
+        print(line, flush=True)
+        _write_artifact(args.artifact, parsed)
+        j.mark_clean()
+        return 0 if proc.returncode == 0 else proc.returncode
+    doc = _diagnostic(
+        "scaling_body_failed",
+        f"rc={proc.returncode}; no parseable metric line on stdout; "
+        f"stderr tail: {proc.stderr[-500:]}")
+    _emit(doc)
+    _write_artifact(args.artifact, doc)
+    j.mark_clean()
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

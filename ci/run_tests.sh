@@ -79,6 +79,15 @@ python -m pytest tests/test_serving.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: guardrail chaos smoke (anomaly skip + rollback) =="
 python -m pytest tests/test_guardrails.py -q -k smoke -p no:cacheprovider
 
+# elastic chaos smoke: a real multi-process CPU cohort loses a rank to
+# SIGTERM mid-run; the survivor detects it within the heartbeat
+# deadline (no hung collective), resizes, restores the newest committed
+# checkpoint RESHARDED onto the survivor mesh, and trains to completion
+# — plus the 2->1/1->2 bit-exact reshard and corrupt-shard fallback
+# (docs/elastic.md)
+echo "== tier 0.5: elastic chaos smoke (rank loss -> resharded resume) =="
+python -m pytest tests/test_elastic.py -q -k smoke -p no:cacheprovider
+
 # pallas interpret smoke: every registered custom kernel passes its CPU
 # interpret-mode parity gate vs its XLA reference (forward AND custom_vjp
 # gradients), the non-TPU fallback journals its reason, and dropout keys

@@ -1134,3 +1134,137 @@ class WallclockDuration(Rule):
                             "durations (time.time() is for timestamps "
                             "only)")
                         break
+
+
+@register
+class RankDependentCollectiveEntry(Rule):
+    code = "G12"
+    name = "rank-dependent-collective-entry"
+    severity = "error"
+    doc = ("Host-level collective entered under a rank-local condition. "
+           "A call like multihost_utils.sync_global_devices / "
+           "process_allgather / broadcast_one_to_all guarded by "
+           "`if jax.process_index() == 0:` (or a name derived from it) "
+           "means SOME ranks enter the collective and others don't — "
+           "the guarded ranks wait forever for peers that never arrive. "
+           "This is the deadlock class elastic training cannot tolerate "
+           "(docs/elastic.md): the PR-5 lesson that a rank-dependent "
+           "decision to enter a collective is itself a deadlock. Make "
+           "entry unconditional and rank-uniform; decide once on one "
+           "rank and share the verdict through a broadcast "
+           "(parallel._ckpt group bcast_int / elastic.broadcast_json). "
+           "World-SIZE conditionals (`if jax.process_count() == 1:`) "
+           "are rank-uniform and fine. Scope: mxnet_tpu/ library code.")
+
+    COLLECTIVES = {
+        "jax.experimental.multihost_utils.sync_global_devices",
+        "jax.experimental.multihost_utils.process_allgather",
+        "jax.experimental.multihost_utils.broadcast_one_to_all",
+        "jax.experimental.multihost_utils.assert_equal",
+    }
+    RANK_SOURCES = {"jax.process_index"}
+
+    def _scopes(self, tree):
+        scopes = [tree]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                scopes.append(node)
+        return scopes
+
+    def _scope_children(self, scope):
+        """Direct body of this scope, stopping at nested functions
+        (each nested scope carries its own taint and guards)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _is_rank_call(self, ctx, node):
+        return isinstance(node, ast.Call) and \
+            ctx.resolve_call(node) in self.RANK_SOURCES
+
+    def _mentions_rank(self, ctx, node, tainted):
+        for sub in ast.walk(node):
+            if self._is_rank_call(ctx, sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in tainted:
+                return True
+        return False
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        for scope in self._scopes(ctx.tree):
+            # pass 1: names assigned from expressions containing a
+            # process_index() call ("rank = jax.process_index()",
+            # "is_main = jax.process_index() == 0")
+            tainted = set()
+            for node in self._scope_children(scope):
+                if isinstance(node, ast.Assign) and any(
+                        self._is_rank_call(ctx, s)
+                        for s in ast.walk(node.value)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            tainted.add(tgt.id)
+            # pass 2: descend tracking whether we are under a
+            # rank-dependent condition; flag collectives there
+            yield from self._descend(ctx, scope, tainted, False)
+
+    def _descend(self, ctx, node, tainted, guarded):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue            # its own scope handles it
+            if isinstance(child, (ast.If, ast.While)):
+                rank_test = self._mentions_rank(ctx, child.test, tainted)
+                yield from self._descend(ctx, child.test, tainted,
+                                         guarded)
+                for part in child.body + child.orelse:
+                    yield from self._walk_stmt(ctx, part, tainted,
+                                               guarded or rank_test)
+                continue
+            if isinstance(child, ast.IfExp):
+                rank_test = self._mentions_rank(ctx, child.test, tainted)
+                yield from self._descend(ctx, child.test, tainted,
+                                         guarded)
+                for part in (child.body, child.orelse):
+                    yield from self._walk_stmt(ctx, part, tainted,
+                                               guarded or rank_test)
+                continue
+            if isinstance(child, ast.BoolOp):
+                # short-circuit entry: `rank == 0 and allgather(...)`
+                seen_rank = False
+                for operand in child.values:
+                    yield from self._walk_stmt(ctx, operand, tainted,
+                                               guarded or seen_rank)
+                    seen_rank = seen_rank or \
+                        self._mentions_rank(ctx, operand, tainted)
+                continue
+            if guarded and isinstance(child, ast.Call) and \
+                    ctx.resolve_call(child) in self.COLLECTIVES:
+                yield self.finding(
+                    ctx, child.lineno,
+                    "collective entered under a rank-dependent "
+                    "condition — guarded ranks wait forever for peers "
+                    "that never arrive; make entry unconditional and "
+                    "share the one-rank decision via a broadcast "
+                    "(docs/elastic.md)")
+                # still descend: nested collectives get their own lines
+            yield from self._descend(ctx, child, tainted, guarded)
+
+    def _walk_stmt(self, ctx, node, tainted, guarded):
+        """Flag a collective at ``node`` itself, then descend."""
+        if guarded and isinstance(node, ast.Call) and \
+                ctx.resolve_call(node) in self.COLLECTIVES:
+            yield self.finding(
+                ctx, node.lineno,
+                "collective entered under a rank-dependent "
+                "condition — guarded ranks wait forever for peers "
+                "that never arrive; make entry unconditional and "
+                "share the one-rank decision via a broadcast "
+                "(docs/elastic.md)")
+        yield from self._descend(ctx, node, tainted, guarded)

@@ -23,6 +23,13 @@ class _Ctx:
         self.params = {}        # param name -> np.ndarray
         self.aux_names = set()
         self.use_count = use_count or {}
+        # names whose value is a TRUE constant: Constant-node outputs and
+        # values folded from them. Graph initializers are deliberately
+        # NOT in here — they are the rebindable arg_params (trained
+        # weights), and folding through them bakes the ORIGINAL weights
+        # into derived constants that a later re-bind silently misses
+        # (ADVICE r5); see import_graph's fold gate.
+        self.const_names = set()
 
     def sym(self, name):
         if name not in self.tensors:
@@ -439,6 +446,7 @@ def _constant(node, ins, attrs, ctx):
         raise MXNetError("ONNX import: Constant without value")
     name = node["outputs"][0]
     ctx.params[name] = np.asarray(val)
+    ctx.const_names.add(name)
     return _sym_mod().var(name)
 
 
@@ -495,9 +503,14 @@ def _expand(node, ins, attrs, ctx):
 # ---------------------------------------------------------------------------
 # constant folding: torch exports compute shape/mask helpers with chains of
 # small ops over Constant nodes (expand lowers to Where(Equal(size, -1),
-# onnx_shape, size) etc.). When EVERY input of a node is a known constant,
-# evaluate it with numpy at import time — the graph the executor sees is
-# what do_constant_folding=True would have produced.
+# onnx_shape, size) etc.). When EVERY input of a node is a TRUE constant —
+# a Constant-node output or a fold product of those, never a graph
+# initializer — evaluate it with numpy at import time: the graph the
+# executor sees is what do_constant_folding=True would have produced.
+# Initializer-rooted chains import as real ops instead: an initializer is
+# a rebindable parameter (sym.eval / rebound arg_params may supply NEW
+# values), and a fold through it would silently keep the import-time
+# weights baked into the derived constant (ADVICE r5).
 # ---------------------------------------------------------------------------
 
 def _fold_numpy(op, vals, attrs):
@@ -594,12 +607,13 @@ def import_graph(model):
         op_type = node["op_type"]
         in_names = [n for n in node["inputs"] if n]
         if op_type in _FOLDABLE and \
-                all(n in ctx.params for n in in_names):
+                all(n in ctx.const_names for n in in_names):
             folded = _fold_numpy(op_type, [ctx.params[n] for n in in_names],
                                  node.get("attrs", {}))
             if folded is not None:
                 for nm in node["outputs"]:
                     ctx.params[nm] = np.asarray(folded)
+                    ctx.const_names.add(nm)
                     ctx.tensors[nm] = var(nm)
                 continue
         imp = _IMPORTERS.get(op_type)

@@ -116,8 +116,14 @@ def _serving_report(path: str) -> dict:
 
 
 def _guardrails_report(path: str) -> dict:
+    from ..elastic import report as elastic_report
     from ..guardrails import report
-    return report.guard_report(path)
+    out = report.guard_report(path)
+    if out.get("ok"):
+        # cohort events ride the same journal: rank losses, resizes,
+        # resharded restores, and their trace linkage (docs/elastic.md)
+        out["elastic"] = elastic_report.elastic_report(path)
+    return out
 
 
 def _trace_report(path: str) -> dict:
@@ -150,10 +156,20 @@ def _summ_serving(sv) -> str:
 
 
 def _summ_guardrails(gr) -> str:
-    return (f"guardrails: {gr['skipped_steps']} skipped steps (worst run "
+    base = (f"guardrails: {gr['skipped_steps']} skipped steps (worst run "
             f"{gr['worst_consecutive_skips']}), {gr['loss_spikes']} loss "
             f"spikes, {len(gr['rollbacks'])} rollbacks, "
             f"{len(gr['diverged_errors'])} diverged")
+    el = gr.get("elastic")
+    if el and el.get("ok") and any(el["counts"].values()):
+        last = el.get("last_resize") or {}
+        base += (f"; elastic: {el['counts']['rank_lost']} rank losses, "
+                 f"{el['counts']['cohort_resize']} resizes"
+                 + (f" (last -> {last.get('members')})"
+                    if last else "")
+                 + f", {el['counts']['reshard_restore']} reshard "
+                   f"restores ({el['correlated_recoveries']} correlated)")
+    return base
 
 
 def _summ_trace(tr) -> str:

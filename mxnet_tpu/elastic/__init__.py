@@ -1,0 +1,77 @@
+"""mxnet_tpu.elastic — elastic multi-host training (docs/elastic.md).
+
+The resilience tier (PR 3) and guardrails (PR 5) made a *fixed* cohort
+crash-safe; this package lets the cohort change shape mid-run. Four
+pieces:
+
+* :mod:`.membership` — heartbeat liveness, the epoch ledger, and
+  deadline-bounded barriers: a dead rank surfaces as a structured
+  :class:`RankLost` instead of a hung collective, and every membership
+  decision is published once by the leader and adopted by all (the
+  rank-uniform contract graftlint G12 enforces statically).
+* :mod:`.collective` — survivor-safe recovery-lane collectives over the
+  shared filesystem (broadcast a decision, mean-reduce a state tree);
+  every wait is deadline-bounded and liveness-checked.
+* :mod:`.reshard` — the topology-free checkpoint reader: assemble the
+  global tree from the N_old shard files a different cohort wrote,
+  prove coverage, and re-place onto the N_new mesh.
+* :mod:`.driver` — the run loop: detect → quiesce → resize → rebuild →
+  resharded restore → resume, bounded retries, one trace span per
+  recovery so ``rank_lost``/``cohort_resize``/``reshard_restore``
+  journal records correlate.
+
+Lazy exports (PEP 562): importing the package — or its stdlib-only
+submodules ``membership``/``report`` — touches no jax, so the doctor
+can summarize cohort events from a journal while the backend is
+wedged.
+"""
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["BarrierTimeout", "Cohort", "CohortConfig", "CohortGroup",
+           "ElasticDriver", "ElasticExhausted", "RankLost",
+           "allreduce_mean", "assemble_entries", "broadcast",
+           "broadcast_json", "elastic_metadata", "elastic_report",
+           "place_global", "read_global_entries", "reshard_report"]
+
+_LAZY = {
+    "BarrierTimeout": ("membership", "BarrierTimeout"),
+    "Cohort": ("membership", "Cohort"),
+    "CohortConfig": ("membership", "CohortConfig"),
+    "RankLost": ("membership", "RankLost"),
+    "allreduce_mean": ("collective", "allreduce_mean"),
+    "broadcast": ("collective", "broadcast"),
+    "broadcast_json": ("collective", "broadcast_json"),
+    "CohortGroup": ("driver", "CohortGroup"),
+    "ElasticDriver": ("driver", "ElasticDriver"),
+    "ElasticExhausted": ("driver", "ElasticExhausted"),
+    "elastic_metadata": ("driver", "elastic_metadata"),
+    "assemble_entries": ("reshard", "assemble_entries"),
+    "place_global": ("reshard", "place_global"),
+    "read_global_entries": ("reshard", "read_global_entries"),
+    "reshard_report": ("reshard", "reshard_report"),
+    "elastic_report": ("report", "elastic_report"),
+}
+
+_SUBMODULES = ("collective", "driver", "membership", "report", "reshard")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    mod = importlib.import_module(f".{mod_name}", __name__)
+    value = getattr(mod, attr)
+    globals()[name] = value          # cache: subsequent lookups are direct
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__) | set(_SUBMODULES))

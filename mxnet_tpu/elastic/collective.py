@@ -1,0 +1,175 @@
+"""Survivor-safe cohort collectives — deadline-bounded, ledger-backed.
+
+The GSPMD data plane's collectives (psum inside the compiled step,
+``multihost_utils`` on the host) are *unbounded* waits: one dead rank
+wedges every peer until an external timeout kills the job. The elastic
+tier cannot use them across cohort boundaries, so the operations the
+control plane itself needs — broadcast a small decision, reduce a
+parameter tree at a sync point — ride the same shared-filesystem ledger
+as membership, with the same contract: every wait has a deadline and
+re-checks liveness, so a dead member surfaces as :class:`RankLost`
+(from :mod:`.membership`), never a hang.
+
+Pattern (one round-trip per op, leader-reduced)::
+
+    coll/e<epoch>-<tag>-<n>/rank-<r>.npz    every member's contribution
+    coll/e<epoch>-<tag>-<n>/result.npz      leader's published result
+
+``<n>`` is the per-(epoch, tag) use counter (SPMD call sequences, as in
+``Cohort.barrier``), so repeated sync points never read a predecessor's
+files. Contribution and result files land via ``nd.save``-grade
+atomicity (``resilience.atomic``), so a reader can only ever see a
+complete payload. These ops move small trees (decisions, periodic
+parameter syncs) over the shared FS; the per-step gradient path stays
+GSPMD/ICI — this is the recovery lane, not the fast lane.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from ..resilience import atomic
+from .membership import BarrierTimeout, RankLost
+
+__all__ = ["allreduce_mean", "broadcast", "broadcast_json"]
+
+
+def _op_dir(cohort, tag):
+    # use counter lives ON the cohort handle (one handle per rank), not
+    # at module scope: two ranks of one test process must not share it
+    epoch = cohort.epoch
+    counts = getattr(cohort, "_coll_counts", None)
+    if counts is None:
+        counts = cohort._coll_counts = {}
+    n = counts.get((epoch, tag), 0) + 1
+    counts[(epoch, tag)] = n
+    d = os.path.join(cohort.root, "coll", f"e{epoch:06d}-{tag}-{n:04d}")
+    os.makedirs(d, exist_ok=True)
+    if n > 2:
+        # GC two-behind: a member only contributes to op n after
+        # completing n-1, and n-1's result only publishes once every
+        # member contributed — so when ANY member starts n, ALL have
+        # finished n-2. Without this, each sync point leaves world+1
+        # full-tree .npz copies on the shared FS forever.
+        shutil.rmtree(os.path.join(
+            cohort.root, "coll", f"e{epoch:06d}-{tag}-{n - 2:04d}"),
+            ignore_errors=True)
+    return d, epoch
+
+
+def _write_npz(path, arrays):
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    with atomic.atomic_write(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def _read_npz(path):
+    with open(path, "rb") as f:
+        data = f.read()
+    with np.load(io.BytesIO(data)) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _wait_for(cohort, path, owner_ranks, epoch, deadline, what):
+    """Poll for ``path``; a dead owner raises RankLost, a live stall
+    raises BarrierTimeout. Never an unbounded wait."""
+    t0 = time.monotonic()
+    while not os.path.exists(path):
+        dead = [r for r in owner_ranks if r != cohort.rank
+                and not cohort._live.alive(r)]
+        if dead:
+            members = cohort.members()
+            raise RankLost(dead, [r for r in members if r not in dead],
+                           epoch, where=what)
+        if time.monotonic() - t0 > deadline:
+            raise BarrierTimeout(what, owner_ranks, deadline)
+        time.sleep(cohort.cfg.poll_s)
+
+
+def allreduce_mean(cohort, tag, arrays, deadline_s=None):
+    """Element-wise mean of ``{name: np.ndarray}`` across the cohort.
+
+    Every member contributes; the leader (lowest member rank) reduces in
+    float64 and publishes; everyone returns the identical result dict
+    (cast back to each input's dtype). Raises :class:`RankLost` if a
+    member dies mid-operation."""
+    members = cohort.ensure_members(where=f"allreduce:{tag}")
+    deadline = deadline_s if deadline_s is not None else \
+        cohort.cfg.barrier_s
+    d, epoch = _op_dir(cohort, tag)
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    _write_npz(os.path.join(d, f"rank-{cohort.rank}.npz"), arrays)
+    result_path = os.path.join(d, "result.npz")
+    leader = min(members)
+    if cohort.rank == leader:
+        acc = None
+        for r in members:
+            p = os.path.join(d, f"rank-{r}.npz")
+            _wait_for(cohort, p, [r], epoch, deadline,
+                      f"allreduce:{tag}:contrib")
+            contrib = _read_npz(p)
+            if set(contrib) != set(arrays):
+                raise MXNetError(
+                    f"allreduce {tag!r}: rank {r} contributed keys "
+                    f"{sorted(contrib)} != {sorted(arrays)} — the cohort "
+                    "diverged structurally")
+            if acc is None:
+                acc = {k: v.astype(np.float64) for k, v in contrib.items()}
+            else:
+                for k, v in contrib.items():
+                    acc[k] += v
+        out = {k: (acc[k] / len(members)).astype(arrays[k].dtype)
+               for k in acc}
+        _write_npz(result_path, out)
+    else:
+        _wait_for(cohort, result_path, [leader], epoch, deadline,
+                  f"allreduce:{tag}:result")
+        out = _read_npz(result_path)
+    return out
+
+
+def broadcast(cohort, tag, arrays=None, deadline_s=None):
+    """Leader's ``{name: np.ndarray}`` adopted by every member. Pass
+    ``arrays`` on the leader; other ranks' argument is ignored."""
+    members = cohort.ensure_members(where=f"broadcast:{tag}")
+    deadline = deadline_s if deadline_s is not None else \
+        cohort.cfg.barrier_s
+    d, epoch = _op_dir(cohort, tag)
+    leader = min(members)
+    result_path = os.path.join(d, "result.npz")
+    if cohort.rank == leader:
+        if arrays is None:
+            raise MXNetError(f"broadcast {tag!r}: leader has no payload")
+        _write_npz(result_path, {k: np.asarray(v)
+                                 for k, v in arrays.items()})
+        return {k: np.asarray(v) for k, v in arrays.items()}
+    _wait_for(cohort, result_path, [leader], epoch, deadline,
+              f"broadcast:{tag}")
+    return _read_npz(result_path)
+
+
+def broadcast_json(cohort, tag, doc=None, deadline_s=None):
+    """Leader's small JSON document adopted by every member — the
+    rank-uniform decision primitive (which step validated, which step to
+    restore): decided once, published once, adopted everywhere."""
+    members = cohort.ensure_members(where=f"bcast_json:{tag}")
+    deadline = deadline_s if deadline_s is not None else \
+        cohort.cfg.barrier_s
+    d, epoch = _op_dir(cohort, tag)
+    leader = min(members)
+    path = os.path.join(d, "doc.json")
+    if cohort.rank == leader:
+        with atomic.atomic_write(path, "w") as f:
+            json.dump(doc, f)
+        return doc
+    _wait_for(cohort, path, [leader], epoch, deadline,
+              f"bcast_json:{tag}")
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)

@@ -1,0 +1,296 @@
+"""The elastic run loop: detect → quiesce → resize → rebuild → restore
+→ resume.
+
+Orchestrates a training cohort through membership changes (docs/
+elastic.md). The shape of one recovery, all under ONE trace span so the
+journal records correlate::
+
+    rank_lost        a member's heartbeat went stale (or a barrier
+                     surfaced RankLost) — evidence, step, epoch
+    cohort_resize    the leader published epoch k+1 (survivors + any
+                     live joiners); every member adopted it
+    elastic_retrace  the survivor rebuilt its trainer/mesh — compiled
+                     programs dropped, never silently reused
+    reshard_restore  the newest committed checkpoint re-placed onto the
+                     new topology (N_old shard files → N_new mesh)
+
+Progress model: work since the last committed checkpoint is lost on a
+resize — the same contract as a preemption (docs/checkpointing.md);
+``checkpoint_every`` bounds the loss window. Recovery attempts are
+bounded by ``MXNET_TPU_ELASTIC_MAX_REBUILDS`` (default 3): a cohort
+that cannot stabilize surfaces a structured error instead of thrashing.
+
+While the driver runs, checkpoint commits/restores are coordinated by
+the cohort (``CohortGroup`` installed into ``parallel._ckpt``): barriers
+are deadline-bounded against the membership ledger, shard files are
+keyed by cohort rank, and the commit manifest records the cohort shape
+— the provenance the resharded reader and ``doctor --journal`` consume.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+from ..diagnostics.journal import get_journal
+from ..observability import trace as _trace
+from ..parallel import _ckpt
+from . import collective
+from .membership import Cohort, RankLost  # noqa: F401  (re-export surface)
+
+__all__ = ["CohortGroup", "ElasticDriver", "ElasticExhausted"]
+
+DEFAULT_MAX_REBUILDS = 3
+
+
+class ElasticExhausted(MXNetError):
+    """The rebuild budget ran out — the cohort kept losing members (or
+    kept timing out) faster than it could stabilize."""
+
+
+class CohortGroup:
+    """Cohort-backed checkpoint group for ``parallel._ckpt.set_group``:
+    rank 0 duties go to the cohort leader, barriers and broadcasts ride
+    the deadline-bounded ledger, and per-shard piece ownership is a
+    round-robin split over the member list (see ``_ckpt.write_entries``)."""
+
+    kind = "cohort"
+
+    def __init__(self, cohort, members=None):
+        self.cohort = cohort
+        self.members = list(members if members is not None
+                            else cohort.members())
+        if cohort.rank not in self.members:
+            raise MXNetError(f"rank {cohort.rank} is not a member of "
+                             f"{self.members}")
+
+    def index(self):
+        return self.members.index(self.cohort.rank)
+
+    def count(self):
+        return len(self.members)
+
+    def barrier(self, tag):
+        self.cohort.barrier(f"ckpt-{tag}", members=self.members)
+
+    def bcast_int(self, value):
+        doc = collective.broadcast_json(self.cohort, "ckpt-int",
+                                        {"v": int(value)})
+        return int(doc["v"])
+
+    def owns_piece(self, position):
+        return position % len(self.members) == self.index()
+
+    def meta(self):
+        return {"world": self.count(), "kind": "cohort",
+                "cohort_epoch": self.cohort.epoch,
+                "cohort_members": list(self.members)}
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    try:
+        return int(v) if v else int(default)
+    except ValueError:
+        return int(default)
+
+
+class ElasticDriver:
+    """Run a sharded/pipelined trainer under an elastic cohort.
+
+    ``build(members)`` constructs a FRESH trainer for the given member
+    list (choose the mesh/data layout for that world there); the driver
+    owns when to call it — at start and after every resize — and always
+    follows a rebuild with a resharded restore of the newest committed
+    step, so a new trainer never trains from reinitialized weights while
+    a checkpoint exists.
+
+    ``data_fn(step, members, index)`` returns the positional batch for
+    ``trainer.step`` — derive the rank's shard from ``members``/``index``
+    so data re-partitions with the cohort.
+    """
+
+    def __init__(self, cohort: Cohort, ckpt_root, build, *,
+                 checkpoint_every=10, keep_last=3, sync_every=None,
+                 max_rebuilds=None, per_shard=None):
+        self.cohort = cohort
+        self.ckpt_root = str(ckpt_root)
+        self.build = build
+        self.checkpoint_every = int(checkpoint_every)
+        self.keep_last = keep_last
+        self.sync_every = sync_every
+        self.per_shard = per_shard
+        self.max_rebuilds = (int(max_rebuilds) if max_rebuilds is not None
+                             else _env_int("MXNET_TPU_ELASTIC_MAX_REBUILDS",
+                                           DEFAULT_MAX_REBUILDS))
+        self.rebuilds = 0
+        self.restored_step = None
+        self._last_committed = None
+        # called as on_restore(trainer, step) after every resharded
+        # restore — the hook a data pipeline uses to rewind to the
+        # restored step (and the chaos tests use to snapshot the
+        # just-restored tree)
+        self.on_restore = None
+
+    # -- cohort-synchronous state sync ---------------------------------------
+    def _entries_host(self, trainer):
+        if hasattr(trainer, "_param_entries"):     # ShardedTrainer
+            ents = {**trainer._param_entries(),
+                    **trainer._state_entries()}
+        else:                                      # PipelinedTrainer
+            ents = trainer._ckpt_entries()
+        return {k: _ckpt.gather_host(v) for k, v in ents.items()}
+
+    def _sync_state(self, trainer, tag):
+        """Average the full param/opt-state tree across the cohort (the
+        recovery-lane collective: deadline-bounded, RankLost-safe). Run
+        before every commit so the cohort's per-rank shard files are
+        slices of ONE agreed tree — and at ``sync_every`` as the
+        local-SGD sync point."""
+        if len(self._members) <= 1:
+            return
+        reduced = collective.allreduce_mean(self.cohort, tag,
+                                            self._entries_host(trainer))
+        trainer._adopt_host_entries(reduced)
+
+    # -- checkpoint / restore under the cohort group -------------------------
+    def _checkpoint(self, trainer):
+        # constant tag: the per-(epoch, tag) use counter disambiguates
+        # repeats, and constant tags keep the ledger's directory count
+        # bounded (step-embedded tags would grow one dir per sync)
+        self._sync_state(trainer, "presync")
+        step = trainer.checkpoint(self.ckpt_root, keep_last=self.keep_last,
+                                  per_shard=self.per_shard)
+        self._last_committed = int(step)
+        return step
+
+    def _has_checkpoint(self):
+        from ..resilience import commit as _commit
+        return bool(_commit.committed_steps(self.ckpt_root))
+
+    def _setup(self, members):
+        """Fresh trainer for ``members``, prepared (sharded state
+        materialized from an example batch) + resharded restore of the
+        newest committed step (when one exists)."""
+        self._members = list(members)
+        trainer = self.build(list(members))
+        if not getattr(trainer, "_prepared", True):
+            batch = self._data_fn(int(trainer.num_update), list(members),
+                                  members.index(self.cohort.rank))
+            trainer.prepare(*batch[:-1])
+        if self._has_checkpoint():
+            self.restored_step = trainer.restore_resharded(self.ckpt_root)
+            self._last_committed = int(self.restored_step)
+            if self.on_restore is not None:
+                self.on_restore(trainer, self.restored_step)
+        return trainer
+
+    # -- recovery ------------------------------------------------------------
+    def _recover(self, trainer, err):
+        """One bounded recovery: journal the loss, resize, rebuild,
+        restore — all under ONE ``elastic_recover`` span so the
+        ``rank_lost``/``cohort_resize``/``reshard_restore`` records
+        correlate by trace id. A FURTHER loss mid-recovery loops here
+        (each attempt spends rebuild budget) instead of escaping."""
+        j = get_journal()
+        while True:
+            self.rebuilds += 1
+            if self.rebuilds > self.max_rebuilds:
+                raise ElasticExhausted(
+                    f"elastic rebuild budget exhausted "
+                    f"({self.max_rebuilds}); last failure: {err}") from err
+            try:
+                with _trace.span("elastic_recover",
+                                 epoch=self.cohort.epoch,
+                                 attempt=self.rebuilds):
+                    j.event("rank_lost",
+                            lost=getattr(err, "lost", []),
+                            survivors=getattr(err, "survivors", []),
+                            epoch=getattr(err, "epoch", self.cohort.epoch),
+                            where=getattr(err, "where", "")
+                            or str(err)[:200],
+                            step=(int(trainer.num_update)
+                                  if trainer is not None else None),
+                            attempt=self.rebuilds)
+                    # quiesce: the doomed trainer (compiled programs
+                    # included) is dropped before the world changes
+                    # under it; the leader publishes the new epoch
+                    trainer = None
+                    members = self.cohort.resize(getattr(err, "lost", []))
+                    _ckpt.set_group(CohortGroup(self.cohort, members))
+                    j.event("elastic_retrace", reason="cohort_resize",
+                            epoch=self.cohort.epoch,
+                            members=list(members))
+                    trainer = self._setup(members)
+                return members, trainer
+            except RankLost as e2:
+                err = e2
+
+    # -- the loop ------------------------------------------------------------
+    def run(self, data_fn, num_steps):
+        """Train to ``num_steps`` optimizer updates, surviving membership
+        changes. Returns the final trainer (its ``num_update`` ==
+        ``num_steps``; a final checkpoint is committed)."""
+        self._data_fn = data_fn
+        self._last_committed = None
+        members = self.cohort.members()
+        prev_group = _ckpt.set_group(CohortGroup(self.cohort, members))
+        trainer = None
+        try:
+            while True:
+                try:
+                    if trainer is None:
+                        trainer = self._setup(members)
+                    step = int(trainer.num_update)
+                    if step >= int(num_steps):
+                        # final commit only when the loop didn't already
+                        # cover this exact state — and INSIDE the try,
+                        # so a rank dying during it still recovers
+                        if self._last_committed != step:
+                            self._checkpoint(trainer)
+                        break
+                    lost = self.cohort.check()
+                    if lost:
+                        raise RankLost(
+                            lost, [r for r in members if r not in lost],
+                            self.cohort.epoch, where="step_poll")
+                    if self.sync_every and step and \
+                            step % int(self.sync_every) == 0:
+                        self._sync_state(trainer, "sync")
+                    batch = data_fn(step, list(members),
+                                    members.index(self.cohort.rank))
+                    trainer.step(*batch)
+                    done = int(trainer.num_update)
+                    if done % self.checkpoint_every == 0 or \
+                            done >= int(num_steps):
+                        self._checkpoint(trainer)
+                except RankLost as e:
+                    members, trainer = self._recover(trainer, e)
+            return trainer
+        finally:
+            _ckpt.set_group(prev_group)
+
+
+def elastic_metadata():
+    """Cohort/elastic provenance block for bench artifacts
+    (benchmarks/scaling.py): the env-wired world plus the installed
+    checkpoint group's shape, if any."""
+    g = _ckpt.group()
+    doc = {"kind": g.kind, "world": int(g.count())}
+    if g.kind == "cohort":
+        doc.update({"epoch": g.cohort.epoch,
+                    "members": list(g.members)})
+    for k in ("MXTPU_NUM_PROC", "MXTPU_PROC_ID"):
+        if os.environ.get(k):
+            doc[k.lower()] = int(os.environ[k])
+    return doc
+
+
+def np_tree_equal(a, b):
+    """Bitwise equality of two {name: np.ndarray} trees (test helper for
+    the restore bit-exactness proofs)."""
+    if set(a) != set(b):
+        return False
+    return all(np.array_equal(np.asarray(a[k]), np.asarray(b[k]))
+               for k in a)

@@ -1,0 +1,496 @@
+"""Cohort membership — heartbeat liveness, epoch ledger, deadline barriers.
+
+The multi-host substrate (``jax.distributed`` + GSPMD collectives) is
+static: a lost rank turns every subsequent collective into an unbounded
+hang, and the only recovery the launcher offers is killing the whole job
+(``tools/launch.py --max-restarts``). This module is the elastic tier's
+control plane: a *file-based* cohort ledger on a filesystem every rank
+shares (the same property the checkpoint commit protocol already
+assumes), giving survivors three things a wedged collective cannot:
+
+1. **liveness** — every rank's daemon heartbeat bumps a monotonic
+   sequence number in ``hb/rank-<r>.json``; an observer declares a rank
+   lost when its *sequence* stops advancing for ``deadline_s`` of the
+   observer's own monotonic clock. No cross-host wall-clock comparison
+   (NTP steps poison those — the G11 lesson), no coordination-service
+   timeout that kills the observer too.
+2. **deadline-bounded barriers** — every wait is a poll loop with a hard
+   deadline that re-checks liveness as it waits: a dead member surfaces
+   as a structured :class:`RankLost` *before* the deadline, never a hang.
+3. **an epoch ledger** — cohort shape is decided ONCE per change, by the
+   leader (lowest surviving rank), as an atomically-published
+   ``epoch/epoch-<k>.json`` record that every member adopts. Membership
+   is therefore rank-uniform by construction — the PR-5 lesson that a
+   rank-local decision about whether to enter a collective is itself a
+   deadlock applies doubly to a decision about who IS in the collective.
+
+Barrier paths embed the epoch, so a rebuilt cohort can never consume a
+dead generation's barrier litter. Import-light: stdlib + the journal +
+``resilience.atomic`` (whose fault hook also makes the ledger writable
+by the chaos harness). No jax — liveness must keep working while the
+data plane is wedged.
+
+Knobs (docs/elastic.md): ``MXNET_TPU_ELASTIC_HEARTBEAT_S`` (default 2),
+``MXNET_TPU_ELASTIC_DEADLINE_S`` (default 20),
+``MXNET_TPU_ELASTIC_BARRIER_S`` (default 120),
+``MXNET_TPU_ELASTIC_POLL_S`` (default 0.05).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+
+from ..base import MXNetError
+from ..diagnostics.journal import get_journal
+from ..resilience import atomic
+
+__all__ = ["BarrierTimeout", "Cohort", "CohortConfig", "RankLost"]
+
+HEARTBEAT_S = 2.0
+DEADLINE_S = 20.0
+BARRIER_S = 120.0
+POLL_S = 0.05
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+class RankLost(MXNetError):
+    """A cohort member stopped heartbeating: raised *instead of* entering
+    (or staying in) a collective wait. Carries the evidence so the
+    elastic driver can resize without re-deriving it."""
+
+    def __init__(self, lost, survivors, epoch, where=""):
+        self.lost = sorted(int(r) for r in lost)
+        self.survivors = sorted(int(r) for r in survivors)
+        self.epoch = int(epoch)
+        self.where = where
+        super().__init__(
+            f"rank(s) {self.lost} lost (epoch {self.epoch}"
+            + (f", at {where}" if where else "")
+            + f"); survivors {self.survivors}")
+
+
+class BarrierTimeout(MXNetError):
+    """A cohort barrier expired with every missing member still
+    heartbeating — a stall, not a death; the caller's retry/abort
+    decision, not a resize trigger."""
+
+    def __init__(self, tag, waiting_for, deadline_s):
+        self.tag = tag
+        self.waiting_for = sorted(int(r) for r in waiting_for)
+        super().__init__(
+            f"cohort barrier {tag!r} expired after {deadline_s:g}s still "
+            f"waiting for live rank(s) {self.waiting_for}")
+
+
+class CohortConfig:
+    """Resolved knobs; explicit arguments beat the environment."""
+
+    def __init__(self, heartbeat_s=None, deadline_s=None, barrier_s=None,
+                 poll_s=None):
+        self.heartbeat_s = (float(heartbeat_s) if heartbeat_s is not None
+                            else _env_float("MXNET_TPU_ELASTIC_HEARTBEAT_S",
+                                            HEARTBEAT_S))
+        self.deadline_s = (float(deadline_s) if deadline_s is not None
+                           else _env_float("MXNET_TPU_ELASTIC_DEADLINE_S",
+                                           DEADLINE_S))
+        self.barrier_s = (float(barrier_s) if barrier_s is not None
+                          else _env_float("MXNET_TPU_ELASTIC_BARRIER_S",
+                                          BARRIER_S))
+        self.poll_s = (float(poll_s) if poll_s is not None
+                       else _env_float("MXNET_TPU_ELASTIC_POLL_S", POLL_S))
+        if self.deadline_s <= self.heartbeat_s:
+            raise MXNetError(
+                f"elastic deadline_s ({self.deadline_s:g}) must exceed "
+                f"heartbeat_s ({self.heartbeat_s:g}) — a deadline inside "
+                "one heartbeat interval declares healthy ranks dead")
+
+
+class _Liveness:
+    """Per-rank (seq, first-seen-monotonic) tracking. A rank is alive
+    while its heartbeat sequence keeps advancing; staleness is measured
+    on the OBSERVER's monotonic clock from the moment the current seq
+    was first observed."""
+
+    def __init__(self, hb_dir, deadline_s):
+        self.hb_dir = hb_dir
+        self.deadline_s = deadline_s
+        self._seen = {}          # rank -> (seq, mono_first_seen)
+
+    def _read(self, rank):
+        try:
+            with open(os.path.join(self.hb_dir, f"rank-{rank}.json"),
+                      encoding="utf-8") as f:
+                doc = json.load(f)
+            return int(doc.get("seq", -1))
+        except (OSError, ValueError):
+            return None
+
+    def observe(self, rank):
+        """Refresh this rank's record; returns its idle seconds (observer
+        clock), or None if it has never heartbeated at all."""
+        seq = self._read(rank)
+        now = time.monotonic()
+        if seq is None:
+            # no file yet: start (or keep) the grace clock so a rank that
+            # never comes up is eventually declared lost, not waited on
+            # forever
+            prev = self._seen.get(rank)
+            if prev is None or prev[0] is not None:
+                self._seen[rank] = (None, now)
+                return 0.0
+            return now - prev[1]
+        prev = self._seen.get(rank)
+        if prev is None or prev[0] != seq:
+            self._seen[rank] = (seq, now)
+            return 0.0
+        return now - prev[1]
+
+    def alive(self, rank) -> bool:
+        idle = self.observe(rank)
+        return idle is not None and idle <= self.deadline_s
+
+
+class Cohort:
+    """One rank's handle on the shared cohort ledger under ``root``.
+
+    Lifecycle::
+
+        cohort = Cohort(root, rank=r, config=cfg).start()
+        members = cohort.form(world)        # epoch 0, all ranks
+        ...
+        lost = cohort.check()               # cheap, non-blocking
+        cohort.barrier("step-100")          # deadline-bounded sync
+        members = cohort.resize(lost)       # leader publishes epoch k+1
+        cohort.stop()
+
+    Every blocking wait is deadline-bounded and converts a dead member
+    into :class:`RankLost`. Membership decisions come only from the
+    epoch ledger, so every member adopts the same cohort shape.
+    """
+
+    def __init__(self, root, rank, config=None, journal=None):
+        self.root = str(root)
+        self.rank = int(rank)
+        self.cfg = config or CohortConfig()
+        self._journal = journal if journal is not None else get_journal()
+        self.hb_dir = os.path.join(self.root, "hb")
+        self.epoch_dir = os.path.join(self.root, "epoch")
+        self.barrier_dir = os.path.join(self.root, "barrier")
+        self.join_dir = os.path.join(self.root, "join")
+        for d in (self.hb_dir, self.epoch_dir, self.barrier_dir,
+                  self.join_dir):
+            os.makedirs(d, exist_ok=True)
+        self._live = _Liveness(self.hb_dir, self.cfg.deadline_s)
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread = None
+        # per-(epoch, tag) use counter: cohort calls are SPMD (every
+        # member runs the same sequence), so the n-th barrier at a tag on
+        # one rank pairs with the n-th on every other — a stale file from
+        # use n-1 can then never satisfy use n
+        self._barrier_counts = {}
+
+    # -- heartbeats ----------------------------------------------------------
+    def _hb_path(self, rank=None):
+        return os.path.join(self.hb_dir,
+                            f"rank-{self.rank if rank is None else rank}"
+                            ".json")
+
+    def beat(self) -> None:
+        """Write one heartbeat now (the daemon calls this on a timer; an
+        rng-less single-threaded test can drive it by hand)."""
+        self._seq += 1
+        doc = {"rank": self.rank, "pid": os.getpid(), "seq": self._seq}
+        try:
+            with atomic.atomic_write(self._hb_path(), "w") as f:
+                json.dump(doc, f)
+        except OSError:
+            pass     # a transient hb write failure must not kill training
+
+    def start(self) -> "Cohort":
+        if self._thread is not None:
+            return self
+        self.beat()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"mxtpu-elastic-hb-{self.rank}")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.cfg.heartbeat_s):
+            self.beat()
+
+    def stop(self, resign=False) -> None:
+        """Stop heartbeating. ``resign=True`` additionally removes the
+        heartbeat file — a graceful leave that peers see as loss at the
+        next liveness check (the resize path is the same either way)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.cfg.heartbeat_s + 1.0)
+            self._thread = None
+        if resign:
+            try:
+                os.unlink(self._hb_path())
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- epoch ledger --------------------------------------------------------
+    def _epoch_path(self, k):
+        return os.path.join(self.epoch_dir, f"epoch-{int(k):06d}.json")
+
+    def read_epoch(self):
+        """(epoch, members) from the newest well-formed epoch record, or
+        (None, None) before formation. A torn/unparsable newest record is
+        skipped (atomic_write makes that near-impossible, but a reader
+        must never wedge on half a ledger)."""
+        try:
+            names = sorted(os.listdir(self.epoch_dir), reverse=True)
+        except OSError:
+            return None, None
+        for name in names:
+            if not name.startswith("epoch-") or not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.epoch_dir, name),
+                          encoding="utf-8") as f:
+                    doc = json.load(f)
+                return int(doc["epoch"]), [int(r) for r in doc["members"]]
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+        return None, None
+
+    def _write_epoch(self, k, members, reason):
+        doc = {"epoch": int(k), "members": sorted(int(r) for r in members),
+               "written_by": self.rank, "reason": reason}
+        with atomic.atomic_write(self._epoch_path(k), "w") as f:
+            json.dump(doc, f)
+        return doc
+
+    def members(self):
+        """Current cohort membership (from the ledger)."""
+        _, members = self.read_epoch()
+        if members is None:
+            raise MXNetError(f"cohort under {self.root!r} not formed yet "
+                             "(no epoch record) — call form()")
+        return members
+
+    @property
+    def epoch(self):
+        k, _ = self.read_epoch()
+        return -1 if k is None else k
+
+    def is_leader(self, members=None):
+        """Leader = the lowest-ranked member I still observe alive
+        (liveness-filtered so a dead rank 0 cannot stall every
+        leadership duty; ties can't happen — ranks are unique)."""
+        members = self.members() if members is None else members
+        alive = [r for r in members if r == self.rank or
+                 self._live.alive(r)]
+        return bool(alive) and min(alive) == self.rank
+
+    def form(self, world, deadline_s=None) -> list:
+        """Form epoch 0 over ranks ``0..world-1``: rank 0 publishes the
+        record, everyone waits for it (deadline-bounded) and barriers so
+        no member races ahead before the cohort exists."""
+        if self.rank == 0 and self.read_epoch()[0] is None:
+            self._write_epoch(0, range(int(world)), "form")
+            self._journal.event("cohort_form", root=self.root,
+                                world=int(world))
+        deadline = deadline_s if deadline_s is not None else \
+            self.cfg.barrier_s
+        t0 = time.monotonic()
+        while self.read_epoch()[0] is None:
+            if time.monotonic() - t0 > deadline:
+                raise BarrierTimeout("form", [0], deadline)
+            time.sleep(self.cfg.poll_s)
+        members = self.members()
+        self.barrier("form", deadline_s=deadline, members=members)
+        return members
+
+    # -- liveness ------------------------------------------------------------
+    def check(self, members=None) -> list:
+        """Non-blocking liveness sweep: the members of the current epoch
+        (minus me) whose heartbeats have gone stale. Cheap enough to run
+        every training step."""
+        members = self.members() if members is None else members
+        return [r for r in members
+                if r != self.rank and not self._live.alive(r)]
+
+    def ensure_members(self, where="") -> list:
+        """Raise :class:`RankLost` if any cohort member is dead — the
+        guard a caller runs BEFORE entering a data-plane collective
+        (graftlint G12's dynamic twin)."""
+        members = self.members()
+        lost = self.check(members)
+        if lost:
+            raise RankLost(lost, [r for r in members if r not in lost],
+                           self.epoch, where=where)
+        return members
+
+    # -- barriers ------------------------------------------------------------
+    def barrier(self, tag, deadline_s=None, members=None) -> None:
+        """Deadline-bounded cohort barrier for the current epoch: every
+        member drops ``barrier/e<k>-<tag>/rank-<r>``; the wait re-checks
+        liveness, so a member dying inside the barrier raises
+        :class:`RankLost` (with survivors) instead of hanging, and a
+        stall past the deadline raises :class:`BarrierTimeout`."""
+        epoch = self.epoch
+        members = self.members() if members is None else members
+        deadline = deadline_s if deadline_s is not None else \
+            self.cfg.barrier_s
+        d = os.path.join(self.barrier_dir, f"e{epoch:06d}-{tag}")
+        os.makedirs(d, exist_ok=True)
+        count = self._barrier_counts.get((epoch, tag), 0) + 1
+        self._barrier_counts[(epoch, tag)] = count
+        my = os.path.join(d, f"rank-{self.rank}")
+        with atomic.atomic_write(my, "w") as f:
+            f.write(str(count))
+
+        def _arrived(r):
+            try:
+                with open(os.path.join(d, f"rank-{r}"),
+                          encoding="utf-8") as f:
+                    return int(f.read().strip()) >= count
+            except (OSError, ValueError):
+                return False
+
+        t0 = time.monotonic()
+        while True:
+            waiting = [r for r in members if not _arrived(r)]
+            if not waiting:
+                return
+            dead = [r for r in waiting if r != self.rank
+                    and not self._live.alive(r)]
+            if dead:
+                raise RankLost(dead, [r for r in members if r not in dead],
+                               epoch, where=f"barrier:{tag}")
+            if time.monotonic() - t0 > deadline:
+                raise BarrierTimeout(tag, waiting, deadline)
+            time.sleep(self.cfg.poll_s)
+
+    # -- resize / join -------------------------------------------------------
+    def pending_joiners(self) -> list:
+        """Ranks with a join request AND a live heartbeat (a join file
+        from a process that died before admission must not be adopted
+        into the new epoch)."""
+        out = []
+        try:
+            names = os.listdir(self.join_dir)
+        except OSError:
+            return out
+        for name in names:
+            if not name.startswith("rank-"):
+                continue
+            try:
+                r = int(name[len("rank-"):].split(".")[0])
+            except ValueError:
+                continue
+            if self._live.alive(r):
+                out.append(r)
+        return sorted(out)
+
+    def resize(self, lost=(), deadline_s=None) -> list:
+        """Publish (leader) or adopt (everyone else) the next epoch:
+        members = current survivors − ``lost`` + live pending joiners.
+        Exactly one writer — the lowest *surviving* rank — so the
+        decision is made once and shared; every member returns the SAME
+        new member list. Admitted joiners' request files are consumed."""
+        old_epoch, old_members = self.read_epoch()
+        if old_members is None:
+            raise MXNetError("resize before form(): no epoch record")
+        lost = set(int(r) for r in lost) | set(self.check(old_members))
+        survivors = [r for r in old_members if r not in lost]
+        if self.rank not in survivors:
+            raise MXNetError(f"rank {self.rank} is not a survivor of "
+                             f"epoch {old_epoch} — rejoin with join()")
+        joiners = [r for r in self.pending_joiners()
+                   if r not in survivors]
+        new_members = sorted(survivors + joiners)
+        if min(survivors) == self.rank:
+            self._write_epoch(old_epoch + 1, new_members, "resize")
+            for r in joiners:
+                try:
+                    os.unlink(os.path.join(self.join_dir, f"rank-{r}"))
+                except OSError:
+                    pass
+            self._sweep_dead_epochs(old_epoch)
+            self._journal.event(
+                "cohort_resize", root=self.root, epoch=old_epoch + 1,
+                old_members=sorted(old_members), members=new_members,
+                lost=sorted(lost), joined=joiners)
+        deadline = deadline_s if deadline_s is not None else \
+            self.cfg.barrier_s
+        t0 = time.monotonic()
+        while True:
+            k, members = self.read_epoch()
+            if k is not None and k > old_epoch:
+                break
+            if time.monotonic() - t0 > deadline:
+                raise BarrierTimeout("resize", [min(survivors)], deadline)
+            time.sleep(self.cfg.poll_s)
+        # sync the SURVIVORS (the SPMD participants of this call) only:
+        # joiners are admitted through the ledger and synchronize at
+        # their join() wait, not here
+        self.barrier("resize", deadline_s=deadline, members=survivors)
+        return members
+
+    def _sweep_dead_epochs(self, newest_dead) -> None:
+        """Leader-side GC at resize: barrier/collective litter of epochs
+        ``<= newest_dead - 1`` can never be read again (the new epoch's
+        paths embed the new k; the just-ended epoch's dirs are left one
+        generation as a race margin). Best-effort — litter must never
+        fail a resize."""
+        for parent in (self.barrier_dir,
+                       os.path.join(self.root, "coll")):
+            try:
+                names = os.listdir(parent)
+            except OSError:
+                continue
+            for name in names:
+                if not name.startswith("e"):
+                    continue
+                try:
+                    k = int(name[1:7])
+                except ValueError:
+                    continue
+                if k < newest_dead:
+                    shutil.rmtree(os.path.join(parent, name),
+                                  ignore_errors=True)
+
+    def join(self, deadline_s=None) -> list:
+        """Scale-up entry for a NEW rank: heartbeat + a join request,
+        then wait (deadline-bounded) for an epoch that includes me —
+        published by the leader at its next resize."""
+        self.start()
+        with atomic.atomic_write(
+                os.path.join(self.join_dir, f"rank-{self.rank}"),
+                "w") as f:
+            f.write(str(os.getpid()))
+        deadline = deadline_s if deadline_s is not None else \
+            self.cfg.barrier_s
+        t0 = time.monotonic()
+        while True:
+            _, members = self.read_epoch()
+            if members is not None and self.rank in members:
+                self._journal.event("cohort_join", root=self.root,
+                                    rank=self.rank, epoch=self.epoch)
+                return members
+            if time.monotonic() - t0 > deadline:
+                raise BarrierTimeout("join", [self.rank], deadline)
+            time.sleep(self.cfg.poll_s)

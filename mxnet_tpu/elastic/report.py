@@ -1,0 +1,76 @@
+"""Stdlib-only elastic journal summary (the ``doctor --journal``
+cohort-events section, docs/elastic.md).
+
+Reads a JSONL diagnostics journal and summarizes the elastic records —
+rank losses, cohort resizes (with the membership trajectory), resharded
+restores, retraces — plus the trace linkage between them: records
+written inside one ``elastic_recover`` span share a ``trace_id``, so
+the report can say "loss of rank 1 at step 6 → epoch 2 (2→1 members) →
+restored step 5 resharded 2→1" as one correlated event. No jax, no
+runtime package: the report must work from a wedged environment (the
+``resilience.commit.doctor_report`` contract)."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["elastic_report"]
+
+_KINDS = ("cohort_form", "cohort_resize", "cohort_join", "rank_lost",
+          "reshard_restore", "elastic_retrace")
+
+
+def elastic_report(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError as e:
+        return {"ok": False, "path": path,
+                "error": f"cannot read journal: {e.strerror or e}"}
+    counts = {k: 0 for k in _KINDS}
+    resizes, restores, losses = [], [], []
+    rollback_traces = set()
+    by_trace = {}
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue                      # torn tail line from a kill
+        if not isinstance(rec, dict):
+            continue
+        kind = rec.get("kind")
+        tid = rec.get("trace_id")
+        if kind == "divergence_rollback" and tid:
+            rollback_traces.add(tid)
+        if kind not in _KINDS:
+            continue
+        counts[kind] += 1
+        if tid:
+            by_trace.setdefault(tid, []).append(kind)
+        if kind == "rank_lost":
+            losses.append({k: rec.get(k) for k in
+                           ("lost", "survivors", "epoch", "step",
+                            "where", "trace_id")})
+        elif kind == "cohort_resize":
+            resizes.append({k: rec.get(k) for k in
+                            ("epoch", "old_members", "members", "lost",
+                             "joined", "trace_id")})
+        elif kind == "reshard_restore":
+            restores.append({k: rec.get(k) for k in
+                             ("step", "n_old", "n_new", "entries",
+                              "bytes", "trace_id")})
+    # a recovery is "correlated" when loss→resize→restore share a trace
+    correlated = sum(
+        1 for kinds in by_trace.values()
+        if "rank_lost" in kinds and "reshard_restore" in kinds)
+    out = {"ok": True, "path": path, "counts": counts,
+           "rank_losses": losses,
+           "resizes": resizes,
+           "reshard_restores": restores,
+           "correlated_recoveries": correlated,
+           "last_resize": resizes[-1] if resizes else None,
+           "rollback_linked": sorted(
+               t for t in by_trace if t in rollback_traces)}
+    return out

@@ -1,0 +1,167 @@
+"""Topology-aware checkpoint reader — restore N_old shard files onto an
+N_new mesh.
+
+``parallel/_ckpt.py``'s fast path is layout-locked: per-shard restore
+demands a saved piece for EXACTLY each shard index the current mesh
+produces (``place_like`` raises "mesh or sharding layout changed since
+save"). That is the right contract for a crash-resume on the same
+topology — and exactly the wrong one after an elastic resize, where the
+survivors' mesh produces different shard indices than the cohort that
+wrote the checkpoint.
+
+This module is the slow-but-shape-free lane:
+
+1. **assemble** — read the meta file plus ALL ``.shard0..N_old-1``
+   files the manifest's recorded shard set names (never a glob — stale
+   files from an older save with a different world would mix in), and
+   paste every piece into a full host array per entry. Each piece's
+   bytes are CRC-verified by the ``.params`` v3 container on load, the
+   file set by the commit manifest before this reader runs. Coverage is
+   proven: missing or overlapping pieces raise a structured error
+   naming the entry — a half-assembled tensor can never be placed.
+2. **place** — re-drop each global array onto the *current* sharding
+   via ``jax.make_array_from_callback``: only the shards this process
+   addresses are materialized on device, for any N_new (scale-down and
+   scale-up alike).
+
+Memory note: assembly materializes one full copy of the tree on the
+host (the price of changing topology); the same-topology fast path
+keeps its one-host-share bound. The elastic driver uses this lane only
+inside a resize.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..diagnostics.journal import get_journal
+from ..parallel import _ckpt
+
+__all__ = ["assemble_entries", "place_global", "read_global_entries",
+           "reshard_report"]
+
+
+def _parse_idx(ik):
+    """``"a:b,c:d"`` -> ((a, b), (c, d)); scalar entries have key ""."""
+    if not ik:
+        return ()
+    out = []
+    for part in ik.split(","):
+        a, b = part.split(":")
+        out.append((int(a), int(b)))
+    return tuple(out)
+
+
+def assemble_entries(pieces):
+    """``{name: {idxkey: np.ndarray}}`` -> ``{name: np.ndarray}`` full
+    host arrays. The global extent of each dim is the max piece stop;
+    coverage must be exact (no gaps, no overlaps)."""
+    out = {}
+    for name, per in pieces.items():
+        parsed = [(_parse_idx(ik), arr) for ik, arr in per.items()]
+        ndim = len(parsed[0][0])
+        if any(len(idx) != ndim for idx, _ in parsed):
+            raise MXNetError(f"reshard: {name!r} pieces disagree on rank")
+        if ndim == 0:
+            out[name] = np.asarray(parsed[0][1]).reshape(())
+            continue
+        shape = tuple(max(stop for idx, _ in parsed
+                          for lo, stop in [idx[d]])
+                      for d in range(ndim))
+        dtype = np.asarray(parsed[0][1]).dtype
+        full = np.empty(shape, dtype)
+        covered = 0
+        for idx, arr in parsed:
+            arr = np.asarray(arr)
+            want = tuple(stop - lo for lo, stop in idx)
+            if tuple(arr.shape) != want:
+                raise MXNetError(
+                    f"reshard: {name!r} piece {idx} is shaped "
+                    f"{tuple(arr.shape)}, index says {want} — torn or "
+                    "mislabeled shard file")
+            if arr.dtype != dtype:
+                raise MXNetError(f"reshard: {name!r} pieces disagree on "
+                                 f"dtype ({arr.dtype} vs {dtype})")
+            full[tuple(slice(lo, stop) for lo, stop in idx)] = arr
+            covered += arr.size
+        if covered != full.size:
+            raise MXNetError(
+                f"reshard: {name!r} pieces cover {covered} of "
+                f"{full.size} elements — the shard set is incomplete "
+                "(or overlapping); refusing a partial tensor")
+        out[name] = full
+    return out
+
+
+def read_global_entries(fname):
+    """(meta, {name: full np.ndarray}) from a sharded-trainer checkpoint
+    file — full-file or per-shard, any writer topology."""
+    from .. import ndarray as nd
+    meta, loaded = _ckpt.read_meta(fname)
+    if not meta["per_shard"]:
+        return meta, {k: v.asnumpy() for k, v in loaded.items()
+                      if k != "__meta__"}
+    n_files = int(meta.get("shard_files", 1))
+    pieces = {}
+    for rank in range(n_files):
+        path = f"{fname}.shard{rank}"
+        if not os.path.exists(path):
+            raise MXNetError(
+                f"reshard: per-shard checkpoint incomplete: {path} "
+                f"missing (meta says {n_files} shard files)")
+        loaded = nd.load(path)
+        if not isinstance(loaded, dict):
+            # an EMPTY shard container (zero-state optimizer, or a
+            # round-robin split that left this rank no pieces) loads as
+            # a list — there is just nothing to collect from it
+            continue
+        for key, arr in loaded.items():
+            name, ik = key.rsplit("|", 1)
+            prev = pieces.setdefault(name, {})
+            if ik not in prev:          # replicas collapse, as on save
+                prev[ik] = arr.asnumpy()
+    return meta, assemble_entries(pieces)
+
+
+def place_global(name, cur, host):
+    """Drop a full host array onto ``cur``'s exact sharding (shape and
+    dtype validated) — only this process's addressable shards touch a
+    device."""
+    cur = jnp.asarray(cur)
+    host = np.asarray(host)
+    if tuple(host.shape) != tuple(cur.shape) or \
+            jnp.dtype(host.dtype) != cur.dtype:
+        raise MXNetError(
+            f"reshard: checkpoint entry {name!r} is "
+            f"{host.dtype}{tuple(host.shape)}, expected "
+            f"{cur.dtype}{tuple(cur.shape)} — architecture or "
+            "master_dtype mismatch")
+    return jax.make_array_from_callback(cur.shape, cur.sharding,
+                                        lambda idx: host[idx])
+
+
+def journal_reshard(root, step, meta, n_new, entries, consumer):
+    """One ``reshard_restore`` record per topology-changing restore —
+    the journal evidence the chaos tests and ``doctor --journal``
+    correlate with ``rank_lost``/``cohort_resize``."""
+    n_old = int(meta.get("shard_files", 1)) if meta.get("per_shard") \
+        else 1
+    get_journal().event(
+        "reshard_restore", root=root, step=int(step), n_old=n_old,
+        n_new=int(n_new), entries=len(entries),
+        bytes=int(sum(np.asarray(v).nbytes for v in entries.values())),
+        consumer=consumer)
+
+
+def reshard_report(fname):
+    """Doctor-grade dry run: what would assemble from this checkpoint
+    file (entry count, shard files, bytes) without touching a device."""
+    meta, entries = read_global_entries(fname)
+    return {"per_shard": bool(meta.get("per_shard")),
+            "shard_files": int(meta.get("shard_files", 1)),
+            "entries": len(entries),
+            "bytes": int(sum(v.nbytes for v in entries.values()))}

@@ -3,8 +3,8 @@ training path (net-new TPU capability; see SURVEY §2.4 #32 and §5.8: the
 reference's KVStore/executor-group data parallelism plus the parallelisms
 MXNet 1.x never had, expressed as GSPMD shardings on one device mesh)."""
 from .mesh import (Mesh, NamedSharding, PartitionSpec, current_mesh,
-                   data_parallel_spec, default_mesh, make_mesh, replicated,
-                   use_mesh)
+                   data_parallel_spec, default_mesh, make_mesh,
+                   mesh_signature, replicated, use_mesh)
 from .moe import moe_apply, moe_apply_topk
 from .pipeline import pipeline_apply, pipeline_schedule_info
 from .pipelined import PipelinedTrainer
@@ -14,7 +14,8 @@ from .sharded import (ShardedTrainer, allreduce_across_processes,
                       functional_apply)
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "current_mesh",
-           "data_parallel_spec", "default_mesh", "make_mesh", "replicated",
+           "data_parallel_spec", "default_mesh", "make_mesh",
+           "mesh_signature", "replicated",
            "use_mesh", "ShardedTrainer", "allreduce_across_processes",
            "functional_apply", "ring_attention", "blockwise_attention",
            "ulysses_attention", "attention_reference", "pipeline_apply", "pipeline_schedule_info",

@@ -32,11 +32,73 @@ from ..resilience import commit as _commit
 CKPT_FORMAT = 1
 
 
+# ---------------------------------------------------------------------------
+# Group abstraction: every cross-process decision in this module (who is
+# rank 0, how many shard files, barrier, agree-on-an-int) goes through
+# ONE pluggable object. The default is the jax.distributed world —
+# existing behavior bit-for-bit. The elastic tier installs a
+# cohort-backed group (mxnet_tpu.elastic.CohortGroup) whose barriers are
+# deadline-bounded against the membership ledger, so a checkpoint commit
+# can never hang on a dead rank (docs/elastic.md).
+# ---------------------------------------------------------------------------
+
+class JaxGroup:
+    """The static jax.distributed world (identity single-process)."""
+
+    kind = "jax"
+
+    def index(self):
+        return jax.process_index()
+
+    def count(self):
+        return jax.process_count()
+
+    def barrier(self, tag):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"mxtpu_ckpt_{tag}")
+
+    def bcast_int(self, value):
+        """Rank 0's integer, agreed group-wide (identity single-process).
+        Validation choices MUST be made once and shared: per-rank
+        re-validation would both diverge on a corrupt candidate and
+        stream every shard of every candidate through every process
+        (O(world^2) reads of the shared filesystem)."""
+        if jax.process_count() == 1:
+            return int(value)
+        from jax.experimental import multihost_utils
+        return int(np.asarray(multihost_utils.broadcast_one_to_all(
+            np.asarray(int(value), dtype=np.int64))))
+
+    def owns_piece(self, position):
+        """jax already partitions pieces by shard addressability — every
+        addressable replica-0 piece is this process's to write."""
+        return True
+
+    def meta(self):
+        return {"world": self.count()}
+
+
+_JAX_GROUP = JaxGroup()
+_group = None
+
+
+def group():
+    return _group if _group is not None else _JAX_GROUP
+
+
+def set_group(g):
+    """Install (or, with None, remove) the process-wide checkpoint
+    group; returns the previous value so drivers can nest/restore."""
+    global _group
+    prev = _group
+    _group = g
+    return prev
+
+
 def barrier(tag):
     """Group-wide sync; no-op single-process."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(f"mxtpu_ckpt_{tag}")
+    group().barrier(tag)
 
 
 def gather_host(arr):
@@ -63,19 +125,20 @@ def write_entries(fname, entries, meta):
     processes, ONE writer (rank 0 — concurrent writes to a shared path
     would tear the file). Per-shard mode: rank-0 meta file + one
     ``.shard<rank>`` file per process."""
+    g = group()
     meta_nd = {"__meta__": nd.NDArray(np.frombuffer(
         json.dumps(meta).encode("utf-8"), dtype=np.uint8).copy())}
     if not meta["per_shard"]:
         full = dict(meta_nd)
         for name, arr in entries.items():
             host = gather_host(arr)        # collective: every process
-            if jax.process_index() == 0:
+            if g.index() == 0:
                 full[name] = nd.NDArray(host, _skip_device_put=True)
-        if jax.process_index() == 0:
+        if g.index() == 0:
             nd.save(fname, full)
         barrier("save_full")
         return
-    if jax.process_index() == 0:
+    if g.index() == 0:
         nd.save(fname, meta_nd)
     shard_entries = {}
     for name, arr in entries.items():
@@ -87,7 +150,15 @@ def write_entries(fname, entries, meta):
             if key not in shard_entries:
                 shard_entries[key] = nd.NDArray(
                     np.asarray(shard.data), _skip_device_put=True)
-    nd.save(f"{fname}.shard{jax.process_index()}", shard_entries)
+    # cohort groups partition piece ownership round-robin over the SAME
+    # sorted key sequence on every member (the cohort replicates the
+    # global tree, so the sequences agree) — shard files stay disjoint
+    # and per-rank write volume stays one share, exactly like the
+    # addressability split in a real multi-host world
+    shard_entries = {k: shard_entries[k]
+                     for i, k in enumerate(sorted(shard_entries))
+                     if g.owns_piece(i)}
+    nd.save(f"{fname}.shard{g.index()}", shard_entries)
     barrier("save_shards")
 
 
@@ -127,7 +198,12 @@ def read_pieces(fname, n_files, needed):
             raise MXNetError(
                 f"per-shard checkpoint incomplete: {path} missing "
                 f"(meta says {n_files} shard files)")
-        for key, arr in nd.load(path).items():
+        loaded = nd.load(path)
+        if not isinstance(loaded, dict):
+            # an EMPTY shard container (zero-state optimizer, or a
+            # piece split that left this rank nothing) loads as a list
+            continue
+        for key, arr in loaded.items():
             name, ik = key.rsplit("|", 1)
             if (name, ik) in needed:
                 pieces.setdefault(name, {})[ik] = arr.asnumpy()
@@ -190,16 +266,9 @@ CKPT_BASENAME = "ckpt"
 
 
 def _bcast_int(value):
-    """Rank 0's integer, agreed group-wide (identity single-process).
-    Validation choices MUST be made once and shared: per-rank
-    re-validation would both diverge on a corrupt candidate and stream
-    every shard of every candidate through every process (O(world^2)
-    reads of the shared filesystem)."""
-    if jax.process_count() == 1:
-        return int(value)
-    from jax.experimental import multihost_utils
-    return int(np.asarray(multihost_utils.broadcast_one_to_all(
-        np.asarray(int(value), dtype=np.int64))))
+    """Rank 0's integer, agreed group-wide — see JaxGroup.bcast_int for
+    why validation choices must be made once and shared."""
+    return group().bcast_int(value)
 
 
 def commit_checkpoint(root, step, save_cb, keep_last=None):
@@ -208,9 +277,10 @@ def commit_checkpoint(root, step, save_cb, keep_last=None):
     under ``<root>/step-N.tmp/``; after a group barrier rank 0 writes
     the CRC manifest, publishes the step with one rename, moves the
     ``latest`` pointer, and applies keep-last-k retention."""
+    g = group()
     step = int(step)
     already = False
-    if jax.process_index() == 0:
+    if g.index() == 0:
         try:
             _commit.validate_step(root, step)
             already = True       # e.g. restore -> immediate re-checkpoint
@@ -221,14 +291,13 @@ def commit_checkpoint(root, step, save_cb, keep_last=None):
         # count): re-publishing would only re-rename an identical dir
         get_journal().event("ckpt_skip_existing", root=root, step=step)
         return step
-    if jax.process_index() == 0:
+    if g.index() == 0:
         _commit.prepare_stage(root, step)
     barrier("ckpt_stage")
     save_cb(os.path.join(_commit.stage_dir(root, step), CKPT_BASENAME))
     barrier("ckpt_staged")
-    if jax.process_index() == 0:
-        _commit.finalize(root, step, keep_last=keep_last,
-                         meta={"world": jax.process_count()})
+    if g.index() == 0:
+        _commit.finalize(root, step, keep_last=keep_last, meta=g.meta())
         get_journal().event("ckpt_committed", root=root, step=step)
     barrier("ckpt_committed")
     return step
@@ -254,7 +323,7 @@ def restore_checkpoint(root, load_cb, step=None):
 
     found = _NO_VALID
     pinned_err = ""
-    if jax.process_index() == 0:
+    if group().index() == 0:
         if step is not None:
             try:
                 _commit.validate_step(root, int(step))

@@ -26,8 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "current_mesh", "default_mesh", "use_mesh",
-           "data_parallel_spec", "replicated", "PartitionSpec",
-           "NamedSharding", "Mesh"]
+           "data_parallel_spec", "mesh_signature", "replicated",
+           "PartitionSpec", "NamedSharding", "Mesh"]
 
 _mesh_stack = []
 
@@ -98,3 +98,12 @@ def data_parallel_spec(mesh: Mesh, ndim: int, batch_axis: int = 0):
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def mesh_signature(mesh: Mesh) -> dict:
+    """JSON-able identity of a mesh — device count + axis sizes — for
+    journal records and checkpoint metadata (the elastic tier logs the
+    before/after shapes of a survivor rebuild, docs/elastic.md)."""
+    return {"devices": int(mesh.devices.size),
+            "axes": {name: int(mesh.shape[name])
+                     for name in mesh.axis_names}}

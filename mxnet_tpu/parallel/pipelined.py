@@ -557,7 +557,7 @@ class PipelinedTrainer(GuardedTrainerMixin):
         self._require_prepared()
         from . import _ckpt
         if per_shard is None:
-            per_shard = jax.process_count() > 1
+            per_shard = _ckpt.group().count() > 1
         meta = {
             "format": _ckpt.CKPT_FORMAT,
             "kind": "pipelined",
@@ -565,7 +565,7 @@ class PipelinedTrainer(GuardedTrainerMixin):
             "num_update": int(self._num_update),
             "pipe": self._p, "virtual": self._v,
             "per_shard": bool(per_shard),
-            "shard_files": jax.process_count(),
+            "shard_files": _ckpt.group().count(),
         }
         meta.update(_ckpt.rng_meta())
         _ckpt.write_entries(f"{prefix}.pstate", self._ckpt_entries(), meta)
@@ -593,23 +593,8 @@ class PipelinedTrainer(GuardedTrainerMixin):
                                     int(meta.get("shard_files", 1)),
                                     _ckpt.needed_piece_keys(ents))
                   if meta["per_shard"] else None)
-        place = lambda name: _ckpt.place_like(name, ents[name], loaded,
-                                              pieces)
-        for i, p in enumerate(self._e_params):
-            p._data[0]._rebind(place(f"arg:embed:{i}"))
-        for i, p in enumerate(self._h_params):
-            p._data[0]._rebind(place(f"arg:head:{i}"))
-        self._b_datas = [place(f"arg:body:{j}")
-                         for j in range(len(self._b_datas))]
-        self._e_states = [tuple(place(f"state:embed:{i}:{k}")
-                                for k in range(len(st)))
-                          for i, st in enumerate(self._e_states)]
-        self._b_states = [tuple(place(f"state:body:{i}:{k}")
-                                for k in range(len(st)))
-                          for i, st in enumerate(self._b_states)]
-        self._h_states = [tuple(place(f"state:head:{i}:{k}")
-                                for k in range(len(st)))
-                          for i, st in enumerate(self._h_states)]
+        self._place_all(lambda name: _ckpt.place_like(
+            name, ents[name], loaded, pieces))
         self._num_update = int(meta["num_update"])
         self._optimizer.num_update = self._num_update
         _ckpt.restore_rng(meta)
@@ -639,6 +624,83 @@ class PipelinedTrainer(GuardedTrainerMixin):
             raise MXNetError("restore needs step=N or latest=True")
         return _ckpt.restore_checkpoint(ckpt_dir, self.load_checkpoint,
                                         step=step)
+
+    def load_checkpoint_resharded(self, prefix):
+        """Topology-aware twin of :meth:`load_checkpoint`
+        (docs/elastic.md): assemble the global stacks from however many
+        shard files the saving cohort wrote and re-place them onto THIS
+        trainer's mesh. The pipe/virtual layout must still match — the
+        stacked body weights embed it structurally; changing it means
+        building a fresh trainer, which this method then restores."""
+        self._require_prepared()
+        from . import _ckpt
+        from ..elastic import reshard as _reshard
+        meta, entries = _reshard.read_global_entries(f"{prefix}.pstate")
+        if meta.get("kind") != "pipelined":
+            raise MXNetError(f"{prefix}.pstate is not a PipelinedTrainer "
+                             "checkpoint")
+        if meta["optimizer"] != type(self._optimizer).__name__:
+            raise MXNetError(
+                f"checkpoint optimizer {meta['optimizer']!r} != "
+                f"{type(self._optimizer).__name__!r}")
+        if (meta["pipe"], meta["virtual"]) != (self._p, self._v):
+            raise MXNetError(
+                f"checkpoint pipeline layout pipe={meta['pipe']} "
+                f"v={meta['virtual']} != trainer pipe={self._p} "
+                f"v={self._v}")
+        ents = self._ckpt_entries()
+
+        def place(name):
+            if name not in entries:
+                raise MXNetError(f"checkpoint is missing entry {name!r}")
+            return _reshard.place_global(name, ents[name], entries[name])
+
+        self._place_all(place)
+        self._num_update = int(meta["num_update"])
+        self._optimizer.num_update = self._num_update
+        _ckpt.restore_rng(meta)
+        _reshard.journal_reshard(prefix, self._num_update, meta,
+                                 _ckpt.group().count(), entries,
+                                 self._guard_consumer)
+
+    def restore_resharded(self, ckpt_dir, step=None):
+        """Newest valid committed step under ``ckpt_dir`` restored onto
+        the current topology, whatever world size wrote it."""
+        self._require_prepared()
+        from . import _ckpt
+        return _ckpt.restore_checkpoint(
+            ckpt_dir, self.load_checkpoint_resharded, step=step)
+
+    def _place_all(self, get):
+        """Rebind every stack leaf through ``get(name)`` — the ONE
+        traversal (``_ckpt_entries`` names) the resharded load and the
+        cohort sync share."""
+        for i, p in enumerate(self._e_params):
+            p._data[0]._rebind(get(f"arg:embed:{i}"))
+        for i, p in enumerate(self._h_params):
+            p._data[0]._rebind(get(f"arg:head:{i}"))
+        self._b_datas = [get(f"arg:body:{j}")
+                         for j in range(len(self._b_datas))]
+        self._e_states = [tuple(get(f"state:embed:{i}:{k}")
+                                for k in range(len(st)))
+                          for i, st in enumerate(self._e_states)]
+        self._b_states = [tuple(get(f"state:body:{i}:{k}")
+                                for k in range(len(st)))
+                          for i, st in enumerate(self._b_states)]
+        self._h_states = [tuple(get(f"state:head:{i}:{k}")
+                                for k in range(len(st)))
+                          for i, st in enumerate(self._h_states)]
+
+    def _adopt_host_entries(self, entries):
+        """Re-place host arrays over the live stacks keeping current
+        shardings — the elastic driver's cohort sync point. Names
+        absent from ``entries`` keep their current value."""
+        from ..elastic import reshard as _reshard
+        ents = self._ckpt_entries()
+        self._place_all(
+            lambda name: (_reshard.place_global(name, ents[name],
+                                                entries[name])
+                          if name in entries else ents[name]))
 
     def prepare(self, x_example):
         """Materialize stacked/sharded state without stepping (the resume

@@ -390,7 +390,11 @@ class ShardedTrainer(GuardedTrainerMixin):
         sname = self._struct_name(param)
         for pat, spec in self._param_rules:
             if pat.match(param.name) or pat.match(sname):
-                return spec
+                # project onto the live mesh: an axis the mesh doesn't
+                # have degrades to replication on that dim, so ONE rule
+                # set serves every cohort shape the elastic driver may
+                # build (docs/elastic.md) instead of raising at prepare
+                return self._spec_on(self.mesh, spec)
         return PartitionSpec()   # replicated (pure data parallel)
 
     def _batch_spec(self, ndim):
@@ -822,7 +826,7 @@ class ShardedTrainer(GuardedTrainerMixin):
                              if self._master_dtype is not None else None),
             "state_arity": [len(st) for st in self._states],
             "per_shard": bool(per_shard),
-            "shard_files": jax.process_count(),
+            "shard_files": _ckpt.group().count(),
         }
         meta.update(_ckpt.rng_meta())
         return meta
@@ -851,16 +855,14 @@ class ShardedTrainer(GuardedTrainerMixin):
         trainer.py:save_states)."""
         self._require_prepared("save_states")
         if per_shard is None:
-            per_shard = jax.process_count() > 1
+            per_shard = _ckpt.group().count() > 1
         self._write_entries(fname, self._state_entries(),
                             self._ckpt_meta(per_shard))
 
-    def load_states(self, fname):
-        """Restore what ``save_states`` wrote. The trainer must be prepared
-        with the same architecture, optimizer class, master_dtype and (for
-        per-shard files) mesh layout."""
-        self._require_prepared("load_states")
-        meta, loaded = self._read_meta(fname)
+    def _check_states_meta(self, meta):
+        """Shared contract checks for a ``.states`` meta (layout-locked
+        and resharded loads alike): optimizer class, master storage
+        dtype, state arity."""
         if meta["optimizer"] != type(self._optimizer).__name__:
             raise MXNetError(
                 f"checkpoint was saved with optimizer {meta['optimizer']!r}, "
@@ -876,6 +878,14 @@ class ShardedTrainer(GuardedTrainerMixin):
         if meta["state_arity"] != [len(st) for st in self._states]:
             raise MXNetError("checkpoint state arity mismatch — different "
                              "optimizer config or parameter set")
+
+    def load_states(self, fname):
+        """Restore what ``save_states`` wrote. The trainer must be prepared
+        with the same architecture, optimizer class, master_dtype and (for
+        per-shard files) mesh layout."""
+        self._require_prepared("load_states")
+        meta, loaded = self._read_meta(fname)
+        self._check_states_meta(meta)
         pieces = (self._read_pieces(fname, int(meta.get("shard_files", 1)))
                   if meta["per_shard"] else None)
         new_states = []
@@ -896,7 +906,7 @@ class ShardedTrainer(GuardedTrainerMixin):
         (python/mxnet/model.py save_checkpoint) lifted to sharded state."""
         self._require_prepared("save_checkpoint")
         if per_shard is None:
-            per_shard = jax.process_count() > 1
+            per_shard = _ckpt.group().count() > 1
         self._write_entries(f"{prefix}.params", self._param_entries(),
                             self._ckpt_meta(per_shard))
         self.save_states(f"{prefix}.states", per_shard=per_shard)
@@ -948,6 +958,126 @@ class ShardedTrainer(GuardedTrainerMixin):
             raise MXNetError("restore needs step=N or latest=True")
         return _ckpt.restore_checkpoint(ckpt_dir, self.load_checkpoint,
                                         step=step)
+
+    # -- elastic: survivor-mesh rebuild + resharded restore ------------------
+    # (docs/elastic.md). Two lanes after a cohort shape change: rebuild
+    # the mesh in place when this process still holds the state, or build
+    # a fresh trainer and pull the newest committed checkpoint back in
+    # through the topology-free reader.
+
+    @staticmethod
+    def _spec_on(mesh, spec):
+        """A PartitionSpec projected onto ``mesh``: axis names the new
+        mesh doesn't have degrade to replication on that dim (the
+        survivor mesh may legitimately have dropped an axis). A dim
+        sharded over SEVERAL axes — ``P(("data", "model"), None)`` —
+        keeps exactly the axes the mesh still has."""
+        out = []
+        for a in spec:
+            if isinstance(a, (tuple, list)):
+                kept = tuple(x for x in a if x in mesh.axis_names)
+                out.append(kept if len(kept) > 1
+                           else (kept[0] if kept else None))
+            else:
+                out.append(a if a is None or a in mesh.axis_names
+                           else None)
+        return PartitionSpec(*out)
+
+    def rebuild_mesh(self, mesh):
+        """Re-place parameters, aux buffers, optimizer state and guard
+        counters onto ``mesh`` and drop every compiled program (new
+        shard counts invalidate the cached executable — the retrace is
+        journaled, never silent). The current arrays must still be
+        readable by this process: after losing a *remote* rank, build a
+        fresh trainer and :meth:`restore_resharded` instead."""
+        self._require_prepared("rebuild_mesh")
+        from ..diagnostics.journal import get_journal
+        old_n = self._mesh.devices.size if self._mesh is not None else 0
+        self._tr_specs = [self._spec_on(mesh, s) for s in self._tr_specs]
+        self._aux_specs = [self._spec_on(mesh, s) for s in self._aux_specs]
+        self._mesh = mesh
+        for p, spec in zip(self._trainable, self._tr_specs):
+            p._data[0]._rebind(
+                self._shard(_ckpt.gather_host(p._data[0]._data), spec))
+        for p, spec in zip(self._aux, self._aux_specs):
+            p._data[0]._rebind(
+                self._shard(_ckpt.gather_host(p._data[0]._data), spec))
+        self._states = [
+            tuple(self._shard(_ckpt.gather_host(s), _state_spec(spec, s))
+                  for s in st)
+            for spec, st in zip(self._tr_specs, self._states)]
+        self._guard_state = tuple(
+            self._shard(_ckpt.gather_host(s), PartitionSpec())
+            for s in self._guard_state)
+        self._step_fn = None
+        self._eval_fn = None
+        self._multi_fns = {}
+        get_journal().event("elastic_retrace", reason="mesh_rebuild",
+                            consumer=self._guard_consumer,
+                            old_devices=int(old_n),
+                            new_devices=int(mesh.devices.size))
+
+    def load_checkpoint_resharded(self, prefix):
+        """Topology-aware twin of :meth:`load_checkpoint`: assemble the
+        global tree from however many shard files the SAVING cohort
+        wrote (meta's recorded shard set, CRC-verified per piece) and
+        re-place it onto THIS trainer's mesh — scale-down and scale-up
+        alike. Bit-exact: same storage dtypes, same RNG stream."""
+        self._require_prepared("load_checkpoint_resharded")
+        from ..elastic import reshard as _reshard
+        meta, entries = _reshard.read_global_entries(f"{prefix}.params")
+        smeta, sentries = _reshard.read_global_entries(f"{prefix}.states")
+        self._check_states_meta(smeta)
+
+        def take(name, cur):
+            src = sentries if name.startswith("state:") else entries
+            if name not in src:
+                raise MXNetError(f"checkpoint is missing entry {name!r}")
+            return _reshard.place_global(name, cur, src[name])
+
+        self._place_all(take)
+        self._num_update = int(smeta["num_update"])
+        self._optimizer.num_update = self._num_update
+        _ckpt.restore_rng(smeta)
+        _reshard.journal_reshard(prefix, self._num_update, meta,
+                                 _ckpt.group().count(),
+                                 {**entries, **sentries},
+                                 self._guard_consumer)
+
+    def restore_resharded(self, ckpt_dir, step=None):
+        """Resume from the newest *valid* committed step under
+        ``ckpt_dir`` onto the CURRENT topology, regardless of how many
+        ranks wrote it (journaled ``ckpt_fallback`` past corrupt steps,
+        ``reshard_restore`` on success). Returns the restored step."""
+        self._require_prepared("restore_resharded")
+        return _ckpt.restore_checkpoint(
+            ckpt_dir, self.load_checkpoint_resharded, step=step)
+
+    def _place_all(self, get):
+        """Rebind every leaf — params, aux, optimizer state — through
+        ``get(name, current_array)`` (the ONE traversal the resharded
+        load and the cohort sync share; names match ``_param_entries``/
+        ``_state_entries``)."""
+        for p in self._trainable:
+            p._data[0]._rebind(get(f"arg:{self._struct_name(p)}",
+                                   p._data[0]._data))
+        for p in self._aux:
+            p._data[0]._rebind(get(f"aux:{self._struct_name(p)}",
+                                   p._data[0]._data))
+        self._states = [
+            tuple(get(f"state:{self._struct_name(p)}:{j}", s)
+                  for j, s in enumerate(st))
+            for p, st in zip(self._trainable, self._states)]
+
+    def _adopt_host_entries(self, entries):
+        """Re-place host arrays over the live tree keeping each leaf's
+        current sharding — the elastic driver's cohort sync point.
+        Names absent from ``entries`` keep their current value."""
+        from ..elastic import reshard as _reshard
+        self._place_all(
+            lambda name, cur: (_reshard.place_global(name, cur,
+                                                     entries[name])
+                               if name in entries else cur))
 
     # -- parity helpers ------------------------------------------------------
     @property

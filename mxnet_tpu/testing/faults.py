@@ -39,8 +39,8 @@ from ..resilience import atomic
 
 __all__ = ["CRASH_POINTS", "FaultError", "FaultPlan", "FaultRule",
            "PoisonSchedule", "SimulatedCrash", "crash", "inject",
-           "io_error", "poison_batch", "poison_grads", "sigterm",
-           "write_offsets"]
+           "io_error", "poison_batch", "poison_grads", "sigkill",
+           "sigterm", "write_offsets"]
 
 # every phase of one atomic file write, in order — plus the commit
 # protocol's own points (publish = the step-dir rename commit point)
@@ -151,6 +151,14 @@ def sigterm() -> None:
     Only safe once ``resilience.preempt.install()`` holds the signal;
     otherwise this kills the interpreter, as in production."""
     os.kill(os.getpid(), signal.SIGTERM)
+
+
+def sigkill() -> None:
+    """SIGKILL this process — the "host vanished" shape: no handlers,
+    no journal breadcrumb, no atexit. The elastic chaos tests kill a
+    cohort rank with this to prove loss detection needs zero
+    cooperation from the dying process (docs/elastic.md)."""
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 # -- numeric poison (the guardrails chaos layer, docs/guardrails.md) --------

@@ -1,0 +1,74 @@
+# graftlint: scope=library
+"""G12 fixture: host collectives entered under rank-local conditions —
+the cross-rank deadlock class (docs/elastic.md). Parsed only, never
+executed."""
+import jax
+from jax.experimental import multihost_utils
+
+
+def bad_direct_rank_guard(x):
+    if jax.process_index() == 0:
+        multihost_utils.sync_global_devices("tag")  # expect: G12
+    return x
+
+
+def bad_tainted_rank_name(x):
+    rank = jax.process_index()
+    if rank == 0:
+        return multihost_utils.process_allgather(x)  # expect: G12
+    return x
+
+
+def bad_else_branch_is_also_rank_dependent(x):
+    if jax.process_index() == 0:
+        y = x
+    else:
+        y = multihost_utils.broadcast_one_to_all(x)  # expect: G12
+    return y
+
+
+def bad_derived_flag(x):
+    is_main = jax.process_index() == 0
+    while is_main:
+        multihost_utils.sync_global_devices("spin")  # expect: G12
+    return x
+
+
+def bad_short_circuit(x):
+    return jax.process_index() == 0 and \
+        multihost_utils.process_allgather(x)  # expect: G12
+
+
+def bad_conditional_expression(x):
+    return (multihost_utils.process_allgather(x)  # expect: G12
+            if jax.process_index() == 0 else x)
+
+
+def good_world_size_guard(x):
+    # process_count is the same on every rank: rank-uniform, fine
+    if jax.process_count() == 1:
+        return x
+    return multihost_utils.process_allgather(x)
+
+
+def good_unconditional_with_rank_local_work(x):
+    # rank-local work under the guard, collective OUTSIDE it — the
+    # commit-protocol shape (parallel/_ckpt.py)
+    if jax.process_index() == 0:
+        x = x + 1
+    multihost_utils.sync_global_devices("staged")
+    return x
+
+
+def good_decide_once_then_broadcast(step):
+    # the sanctioned pattern: one rank decides, everyone broadcasts
+    found = -1
+    if jax.process_index() == 0:
+        found = int(step)
+    return int(multihost_utils.broadcast_one_to_all(found))
+
+
+def suppressed(x):
+    if jax.process_index() == 0:
+        multihost_utils.sync_global_devices("t")  # graftlint: disable=G12 fixture twin
+    return x

@@ -175,6 +175,49 @@ def test_export_unsupported_op_message(tmp_path):
                             onnx_file_path=str(tmp_path / "x.onnx"))
 
 
+def test_import_rebind_after_fold_uses_new_weights():
+    """ADVICE r5 regression: import-time constant folding must not bake
+    trained initializer values into derived constants. A chain rooted at
+    an initializer (Neg(w)) imports as a real op, so re-binding
+    different arg_params changes the output; a chain rooted at true
+    Constant nodes still folds away."""
+    w = np.arange(6, dtype=np.float32).reshape(2, 3)
+    model = {"ir_version": 8, "opset": 13, "graph": {
+        "name": "fold",
+        "inputs": [{"name": "x", "dtype": "float32", "shape": (2, 3)}],
+        "outputs": [{"name": "y", "dtype": "float32", "shape": ()}],
+        "initializers": [{"name": "w", "data": w}],
+        "nodes": [
+            # initializer-rooted chain: must NOT fold (w is rebindable)
+            {"op_type": "Neg", "name": "negw", "inputs": ["w"],
+             "outputs": ["wn"], "attrs": {}},
+            # Constant-rooted chain: still folds to a single constant
+            {"op_type": "Constant", "name": "c2", "inputs": [],
+             "outputs": ["two"],
+             "attrs": {"value": np.array(2.0, np.float32)}},
+            {"op_type": "Neg", "name": "negc", "inputs": ["two"],
+             "outputs": ["ntwo"], "attrs": {}},
+            {"op_type": "Mul", "name": "scale", "inputs": ["x", "ntwo"],
+             "outputs": ["xs"], "attrs": {}},
+            {"op_type": "Add", "name": "add", "inputs": ["xs", "wn"],
+             "outputs": ["y"], "attrs": {}}]}}
+    sym, arg_params, aux_params = mxonnx.import_model(model)
+    # the rebindable weight survives as an argument; the folded constant
+    # chain contributes only its final value
+    assert "w" in sym.list_arguments() and "w" in arg_params
+    x = np.ones((2, 3), np.float32)
+    got = sym.eval(x=nd.array(x), **arg_params)[0].asnumpy()
+    np.testing.assert_allclose(got, x * -2.0 - w, atol=1e-6)
+    # REBIND: swap in different trained weights (the checkpoint-reload
+    # pattern — replace trained entries, keep the rest of arg_params).
+    # Pre-fix, the folded Neg kept -w_original baked in and this
+    # returned the OLD result.
+    w2 = w + 100.0
+    got2 = sym.eval(x=nd.array(x),
+                    **{**arg_params, "w": nd.array(w2)})[0].asnumpy()
+    np.testing.assert_allclose(got2, x * -2.0 - w2, atol=1e-6)
+
+
 def test_import_graph_dict_level():
     w = np.random.randn(4, 3).astype(np.float32)
     b = np.zeros(4, np.float32)
