@@ -72,6 +72,16 @@ python -m pytest tests/test_crash_matrix.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: serving smoke (dynamic batcher) =="
 python -m pytest tests/test_serving.py -q -k smoke -p no:cacheprovider
 
+# pool chaos smoke: 3 REAL replica worker processes behind the
+# health-routed front door under closed-loop load; SIGKILL one ->
+# detection within the heartbeat deadline, retries complete on
+# survivors inside their deadline budget, zero corrupt responses, the
+# respawned replica re-admitted through a half-open breaker probe, and
+# the journal reduction (doctor --serving-journal) tells the story —
+# bounded wall-clock end to end (docs/serving.md failure matrix)
+echo "== tier 0.5: pool chaos smoke (replica SIGKILL -> reroute) =="
+python -m pytest tests/test_serving_pool.py -q -k smoke -p no:cacheprovider
+
 # guardrail chaos smoke: poison a batch (NaN) -> the fused guard skips
 # the step bitwise and journals it; a persistent-poison divergence drill
 # rolls back bit-exact to the last committed step — the run stays green

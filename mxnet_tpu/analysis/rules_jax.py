@@ -1137,6 +1137,118 @@ class WallclockDuration(Rule):
 
 
 @register
+class UnboundedPollLoop(Rule):
+    code = "G13"
+    name = "unbounded-poll-loop"
+    severity = "error"
+    doc = ("`while True:` poll loop containing time.sleep() with no "
+           "deadline/budget check inside the loop, in library code. "
+           "The router/breaker/drain wait-loop hazard class: the "
+           "condition being polled for can simply never come (dead "
+           "replica, wedged worker, stuck flag) and the thread spins "
+           "for the driver's whole window — an information-free rc:124, "
+           "in-process. Bound every poll loop: compare a monotonic "
+           "clock against a deadline inside the loop "
+           "(elastic.membership.Cohort.barrier is the model) or "
+           "restructure onto a bounded condition / Event.wait(timeout=). "
+           "Scope: mxnet_tpu/ library code.")
+
+    CLOCKS = {"time.monotonic", "time.perf_counter", "time.time",
+              "time.monotonic_ns", "time.perf_counter_ns", "time.time_ns"}
+    SLEEP = "time.sleep"
+
+    @staticmethod
+    def _const_true(test) -> bool:
+        return isinstance(test, ast.Constant) and bool(test.value)
+
+    def _scopes(self, tree):
+        scopes = [tree]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                scopes.append(node)
+        return scopes
+
+    def _walk_scope(self, scope):
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _is_clock_call(self, ctx, node) -> bool:
+        return isinstance(node, ast.Call) and \
+            ctx.resolve_call(node) in self.CLOCKS
+
+    def _clock_tainted(self, ctx, scope) -> set:
+        """Names assigned (anywhere in this scope) from an expression
+        containing a monotonic/wall clock call — deadline variables
+        (`deadline = time.monotonic() + x`, `t0 = time.monotonic()`)."""
+        tainted = set()
+        for node in self._walk_scope(scope):
+            if isinstance(node, ast.Assign) and any(
+                    self._is_clock_call(ctx, s)
+                    for s in ast.walk(node.value)):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+        return tainted
+
+    def _loop_bounded(self, ctx, loop, tainted) -> bool:
+        """A loop is budget-bounded when some Compare inside it reads a
+        clock (directly or through a deadline name) — the
+        `if time.monotonic() - t0 > deadline: raise` shape."""
+        for node in self._loop_body(loop):
+            if not isinstance(node, ast.Compare):
+                continue
+            for sub in ast.walk(node):
+                if self._is_clock_call(ctx, sub):
+                    return True
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    return True
+        return False
+
+    def _loop_body(self, loop):
+        """Nodes inside the loop, stopping at nested functions (their
+        sleeps and their budgets are their own)."""
+        stack = list(loop.body) + list(loop.orelse)
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        for scope in self._scopes(ctx.tree):
+            tainted = None          # computed lazily per scope
+            for node in self._walk_scope(scope):
+                if not (isinstance(node, ast.While)
+                        and self._const_true(node.test)):
+                    continue
+                has_sleep = any(
+                    isinstance(sub, ast.Call)
+                    and ctx.resolve_call(sub) == self.SLEEP
+                    for sub in self._loop_body(node))
+                if not has_sleep:
+                    continue
+                if tainted is None:
+                    tainted = self._clock_tainted(ctx, scope)
+                if self._loop_bounded(ctx, node, tainted):
+                    continue
+                yield self.finding(
+                    ctx, node.lineno,
+                    "unbounded poll loop: while True + time.sleep with "
+                    "no deadline/budget check — a condition that never "
+                    "comes wedges this thread forever; compare a "
+                    "monotonic clock against a deadline inside the loop")
+
+
+@register
 class RankDependentCollectiveEntry(Rule):
     code = "G12"
     name = "rank-dependent-collective-entry"

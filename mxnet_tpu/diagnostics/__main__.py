@@ -148,11 +148,19 @@ def _summ_checkpoint(ck) -> str:
 
 
 def _summ_serving(sv) -> str:
-    return (f"serving: {sv['served']} served in {sv['batches']} batches, "
+    base = (f"serving: {sv['served']} served in {sv['batches']} batches, "
             f"shed-rate {sv['shed_rate']}, cache hit-rate "
             f"{sv['cache_hit_rate']} ({sv['compiles']} compiles), "
             f"{sv['deadline_miss_total']} deadline misses, "
             f"{len(sv['reloads'])} reloads")
+    rt = sv.get("router")
+    if rt:
+        base += (f"; pool: {len(rt['replicas_lost'])} replicas lost, "
+                 f"{rt['restarts']} restarts, "
+                 f"{len(rt['readmitted'])} re-admitted, "
+                 f"{rt['retries']} retries, {rt['hedges']} hedges, "
+                 f"{len(rt['breaker_transitions'])} breaker transitions")
+    return base
 
 
 def _summ_guardrails(gr) -> str:

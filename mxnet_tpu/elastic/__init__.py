@@ -30,15 +30,18 @@ from __future__ import annotations
 import importlib
 
 __all__ = ["BarrierTimeout", "Cohort", "CohortConfig", "CohortGroup",
-           "ElasticDriver", "ElasticExhausted", "RankLost",
-           "allreduce_mean", "assemble_entries", "broadcast",
-           "broadcast_json", "elastic_metadata", "elastic_report",
-           "place_global", "read_global_entries", "reshard_report"]
+           "ElasticDriver", "ElasticExhausted", "Heartbeat",
+           "LivenessReader", "RankLost", "allreduce_mean",
+           "assemble_entries", "broadcast", "broadcast_json",
+           "elastic_metadata", "elastic_report", "place_global",
+           "read_global_entries", "reshard_report"]
 
 _LAZY = {
     "BarrierTimeout": ("membership", "BarrierTimeout"),
     "Cohort": ("membership", "Cohort"),
     "CohortConfig": ("membership", "CohortConfig"),
+    "Heartbeat": ("membership", "Heartbeat"),
+    "LivenessReader": ("membership", "LivenessReader"),
     "RankLost": ("membership", "RankLost"),
     "allreduce_mean": ("collective", "allreduce_mean"),
     "broadcast": ("collective", "broadcast"),

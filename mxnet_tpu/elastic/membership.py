@@ -47,7 +47,8 @@ from ..base import MXNetError
 from ..diagnostics.journal import get_journal
 from ..resilience import atomic
 
-__all__ = ["BarrierTimeout", "Cohort", "CohortConfig", "RankLost"]
+__all__ = ["BarrierTimeout", "Cohort", "CohortConfig", "Heartbeat",
+           "LivenessReader", "RankLost"]
 
 HEARTBEAT_S = 2.0
 DEADLINE_S = 20.0
@@ -115,49 +116,174 @@ class CohortConfig:
                 "one heartbeat interval declares healthy ranks dead")
 
 
-class _Liveness:
-    """Per-rank (seq, first-seen-monotonic) tracking. A rank is alive
-    while its heartbeat sequence keeps advancing; staleness is measured
-    on the OBSERVER's monotonic clock from the moment the current seq
-    was first observed."""
+class Heartbeat:
+    """Seq-file heartbeat daemon for ONE member of any cohort-shaped
+    group. Training ranks (:class:`Cohort`) and serving replicas
+    (``serving.pool``) share this writer: bump a monotonic sequence in
+    ``<hb_dir>/<prefix>-<id>.json`` every ``interval_s``, merging the
+    optional ``payload()`` dict into each record — the slot a serving
+    replica's readiness beacon (queue depth, last-batch age, commit
+    step, bound port) rides. Liveness semantics live entirely in
+    :class:`LivenessReader`; the payload is advisory state for whoever
+    reads the ledger. Written via ``resilience.atomic`` (so the chaos
+    harness reaches it — torn-heartbeat injection included) but NOT
+    fsynced: a heartbeat is ephemeral evidence, not durable state. A
+    transient write failure is swallowed — heartbeating must never kill
+    the member it reports on."""
 
-    def __init__(self, hb_dir, deadline_s):
+    def __init__(self, hb_dir, member, interval_s, payload=None,
+                 prefix="rank"):
+        self.hb_dir = str(hb_dir)
+        self.member = member
+        self.interval_s = float(interval_s)
+        self.payload = payload
+        self.prefix = prefix
+        os.makedirs(self.hb_dir, exist_ok=True)
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread = None
+        # beat() is called by the daemon AND by lifecycle code that
+        # wants a state change published immediately (a draining
+        # replica); both stage into the same pid-derived temp file, so
+        # concurrent beats must serialize or they tear each other
+        self._beat_lock = threading.Lock()
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.hb_dir,
+                            f"{self.prefix}-{self.member}.json")
+
+    def beat(self) -> None:
+        """Write one heartbeat now (the daemon calls this on a timer;
+        lifecycle code calls it to publish a payload change at once)."""
+        with self._beat_lock:
+            self._seq += 1
+            doc = {"member": self.member, "pid": os.getpid(),
+                   "seq": self._seq}
+            if self.payload is not None:
+                try:
+                    doc.update(self.payload())
+                except Exception as e:   # liveness must outlive a broken
+                    doc["payload_error"] = type(e).__name__   # provider
+            try:
+                with atomic.atomic_write(self.path, "w",
+                                         durable=False) as f:
+                    json.dump(doc, f)
+            except OSError:
+                pass     # a transient hb write failure must not kill us
+
+    def start(self) -> "Heartbeat":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self.beat()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"mxtpu-hb-{self.prefix}-{self.member}")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+
+    def stop(self, resign=False) -> None:
+        """Stop heartbeating. ``resign=True`` additionally removes the
+        seq file — a graceful leave observers see as loss at their next
+        liveness check."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 1.0)
+            self._thread = None
+        if resign:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+class LivenessReader:
+    """Per-member (seq, first-seen-monotonic) tracking over a directory
+    of :class:`Heartbeat` seq files. A member is alive while its
+    heartbeat sequence keeps advancing; staleness is measured on the
+    OBSERVER's monotonic clock from the moment the current seq was
+    first observed. A torn/unparsable seq file reads as "no heartbeat"
+    — the grace clock runs until a whole record lands, so a wedged or
+    half-written beacon degrades to loss, never to a reader crash."""
+
+    def __init__(self, hb_dir, deadline_s, prefix="rank"):
         self.hb_dir = hb_dir
         self.deadline_s = deadline_s
-        self._seen = {}          # rank -> (seq, mono_first_seen)
+        self.prefix = prefix
+        self._seen = {}          # member -> (seq, mono_first_seen)
+        self._docs = {}          # member -> last well-formed record
 
-    def _read(self, rank):
+    def _read(self, member):
         try:
-            with open(os.path.join(self.hb_dir, f"rank-{rank}.json"),
+            with open(os.path.join(self.hb_dir,
+                                   f"{self.prefix}-{member}.json"),
                       encoding="utf-8") as f:
                 doc = json.load(f)
-            return int(doc.get("seq", -1))
-        except (OSError, ValueError):
+            seq = int(doc.get("seq", -1))
+        except FileNotFoundError:
+            # resigned (graceful leave unlinks the file): there is no
+            # beacon to trust anymore — a stale payload must not keep
+            # advertising a dead member's port/readiness
+            self._docs.pop(member, None)
             return None
+        except (OSError, ValueError):
+            return None      # torn/unreadable: keep the stale payload
+        self._docs[member] = doc
+        return seq
 
-    def observe(self, rank):
-        """Refresh this rank's record; returns its idle seconds (observer
-        clock), or None if it has never heartbeated at all."""
-        seq = self._read(rank)
+    def payload(self, member):
+        """The last well-formed heartbeat record observed for
+        ``member`` (refreshed by :meth:`observe`), or None before one
+        lands — the serving pool reads its readiness beacon here."""
+        return self._docs.get(member)
+
+    def members(self) -> list:
+        """Member ids with a seq file on the ledger (sorted; numeric ids
+        sort numerically)."""
+        out = []
+        try:
+            names = os.listdir(self.hb_dir)
+        except OSError:
+            return out
+        head = f"{self.prefix}-"
+        for name in names:
+            if name.startswith(head) and name.endswith(".json"):
+                raw = name[len(head):-len(".json")]
+                out.append(int(raw) if raw.isdigit() else raw)
+        # numeric ids sort numerically (2 before 10), strings after
+        return sorted(out, key=lambda m: (isinstance(m, str), m))
+
+    def observe(self, member):
+        """Refresh this member's record; returns its idle seconds
+        (observer clock), or None if it has never heartbeated at all."""
+        seq = self._read(member)
         now = time.monotonic()
         if seq is None:
-            # no file yet: start (or keep) the grace clock so a rank that
-            # never comes up is eventually declared lost, not waited on
-            # forever
-            prev = self._seen.get(rank)
+            # no (whole) file yet: start (or keep) the grace clock so a
+            # member that never comes up is eventually declared lost,
+            # not waited on forever
+            prev = self._seen.get(member)
             if prev is None or prev[0] is not None:
-                self._seen[rank] = (None, now)
+                self._seen[member] = (None, now)
                 return 0.0
             return now - prev[1]
-        prev = self._seen.get(rank)
+        prev = self._seen.get(member)
         if prev is None or prev[0] != seq:
-            self._seen[rank] = (seq, now)
+            self._seen[member] = (seq, now)
             return 0.0
         return now - prev[1]
 
-    def alive(self, rank) -> bool:
-        idle = self.observe(rank)
+    def alive(self, member) -> bool:
+        idle = self.observe(member)
         return idle is not None and idle <= self.deadline_s
+
+
+_Liveness = LivenessReader         # pre-generalization internal name
 
 
 class Cohort:
@@ -190,10 +316,11 @@ class Cohort:
         for d in (self.hb_dir, self.epoch_dir, self.barrier_dir,
                   self.join_dir):
             os.makedirs(d, exist_ok=True)
-        self._live = _Liveness(self.hb_dir, self.cfg.deadline_s)
-        self._seq = 0
-        self._stop = threading.Event()
-        self._thread = None
+        self._live = LivenessReader(self.hb_dir, self.cfg.deadline_s)
+        # the generic seq-file writer, with the training-rank payload in
+        # the (serving-pool-shared) heartbeat payload slot
+        self._hb = Heartbeat(self.hb_dir, self.rank, self.cfg.heartbeat_s,
+                             payload=lambda: {"rank": self.rank})
         # per-(epoch, tag) use counter: cohort calls are SPMD (every
         # member runs the same sequence), so the n-th barrier at a tag on
         # one rank pairs with the n-th on every other — a stale file from
@@ -201,48 +328,20 @@ class Cohort:
         self._barrier_counts = {}
 
     # -- heartbeats ----------------------------------------------------------
-    def _hb_path(self, rank=None):
-        return os.path.join(self.hb_dir,
-                            f"rank-{self.rank if rank is None else rank}"
-                            ".json")
-
     def beat(self) -> None:
         """Write one heartbeat now (the daemon calls this on a timer; an
         rng-less single-threaded test can drive it by hand)."""
-        self._seq += 1
-        doc = {"rank": self.rank, "pid": os.getpid(), "seq": self._seq}
-        try:
-            with atomic.atomic_write(self._hb_path(), "w") as f:
-                json.dump(doc, f)
-        except OSError:
-            pass     # a transient hb write failure must not kill training
+        self._hb.beat()
 
     def start(self) -> "Cohort":
-        if self._thread is not None:
-            return self
-        self.beat()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=f"mxtpu-elastic-hb-{self.rank}")
-        self._thread.start()
+        self._hb.start()
         return self
-
-    def _run(self):
-        while not self._stop.wait(self.cfg.heartbeat_s):
-            self.beat()
 
     def stop(self, resign=False) -> None:
         """Stop heartbeating. ``resign=True`` additionally removes the
         heartbeat file — a graceful leave that peers see as loss at the
         next liveness check (the resize path is the same either way)."""
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=self.cfg.heartbeat_s + 1.0)
-            self._thread = None
-        if resign:
-            try:
-                os.unlink(self._hb_path())
-            except OSError:
-                pass
+        self._hb.stop(resign=resign)
 
     def __enter__(self):
         return self.start()

@@ -16,6 +16,13 @@ around it (docs/serving.md):
   deadline-check, journaled per batch;
 - :mod:`.reload` — newest-valid-committed-step hot-reload over
   ``resilience.commit`` (a torn checkpoint can never reach a response);
+- :mod:`.pool` / :mod:`.router` / :mod:`.worker` / :mod:`.wire` — the
+  fault-tolerant replica tier: N Server replicas (in-process or
+  subprocess workers) heartbeating readiness beacons onto an
+  ``elastic.membership`` ledger, behind a health-routed front door
+  with deadline-scoped retries, tail-latency hedging, per-replica
+  circuit breakers, draining restarts, and capacity-floor degradation
+  tiers (docs/serving.md);
 - :mod:`.report` — stdlib journal summarizer for
   ``python -m mxnet_tpu.diagnostics doctor --serving-journal``;
 - ``python -m mxnet_tpu.serving bench`` — closed-loop load generator
@@ -31,21 +38,34 @@ from __future__ import annotations
 import importlib
 
 __all__ = ["BucketGrid", "CompiledPredictor", "DeadlineExceeded",
-           "ParamStore", "PendingResponse", "PredictorCache",
-           "RequestError", "Server", "ServerConfig", "ServerOverloaded",
+           "LocalReplica", "ParamStore", "PendingResponse", "PoolConfig",
+           "PredictorCache", "ProcReplica", "ReplicaPool",
+           "ReplicaUnavailable", "RequestCancelled", "RequestError",
+           "Router", "RouterConfig", "RouterResponse", "Server",
+           "ServerConfig", "ServerOverloaded", "ServerStopped",
            "serving_report"]
 
 _LAZY = {
     "BucketGrid": ("buckets", "BucketGrid"),
     "CompiledPredictor": ("cache", "CompiledPredictor"),
     "DeadlineExceeded": ("batcher", "DeadlineExceeded"),
+    "LocalReplica": ("pool", "LocalReplica"),
     "ParamStore": ("reload", "ParamStore"),
     "PendingResponse": ("batcher", "PendingResponse"),
+    "PoolConfig": ("pool", "PoolConfig"),
     "PredictorCache": ("cache", "PredictorCache"),
+    "ProcReplica": ("pool", "ProcReplica"),
+    "ReplicaPool": ("pool", "ReplicaPool"),
+    "ReplicaUnavailable": ("pool", "ReplicaUnavailable"),
+    "RequestCancelled": ("batcher", "RequestCancelled"),
     "RequestError": ("batcher", "RequestError"),
+    "Router": ("router", "Router"),
+    "RouterConfig": ("router", "RouterConfig"),
+    "RouterResponse": ("router", "RouterResponse"),
     "Server": ("server", "Server"),
     "ServerConfig": ("server", "ServerConfig"),
     "ServerOverloaded": ("batcher", "ServerOverloaded"),
+    "ServerStopped": ("batcher", "ServerStopped"),
     "serving_report": ("report", "serving_report"),
 }
 

@@ -52,6 +52,9 @@ def cmd_bench(args) -> int:
     from ..resilience.atomic import atomic_write
     from .server import Server, ServerConfig
 
+    if args.replicas > 1:
+        return _bench_pool(args)
+
     j = get_journal()
     j.install_handlers(final_cb=lambda: _emit(_diagnostic(
         "bench_killed", f"killed at phase {j.last_phase!r} before "
@@ -140,6 +143,118 @@ def cmd_bench(args) -> int:
     return 0
 
 
+POOL_METRIC = "serving_pool_requests_per_sec"
+
+
+def _bench_pool(args) -> int:
+    """--replicas N: the closed loop runs through the health-routed
+    front door (Router over a ReplicaPool of N in-process replicas),
+    and the artifact carries the router attempt/hedge/breaker counters
+    plus the observability snapshot — BENCH_serving_pool.json."""
+    import tempfile
+
+    import numpy as np
+
+    from ..diagnostics import get_journal
+    from ..metric import LatencySummary
+    from ..observability import snapshot
+    from ..resilience.atomic import atomic_write
+    from .batcher import (DeadlineExceeded, RequestError, ServerOverloaded)
+    from .pool import PoolConfig, ReplicaPool
+    from .router import Router, RouterConfig
+    from .server import Server, ServerConfig
+
+    j = get_journal()
+    j.install_handlers(final_cb=lambda: _emit(
+        {"metric": POOL_METRIC, "value": None, "unit": "req/s",
+         "error": "bench_killed",
+         "detail": f"killed at phase {j.last_phase!r}"}))
+    j.set_phase("serving_pool_bench_setup")
+    scfg = ServerConfig(max_batch=args.max_batch, max_queue=args.queue,
+                        window_ms=args.window_ms,
+                        default_deadline_ms=args.deadline_ms)
+
+    def factory():
+        return Server(_build_model(args.dim), config=scfg)
+
+    root = tempfile.mkdtemp(prefix="mxtpu-pool-bench-")
+    pool = ReplicaPool(root, PoolConfig(heartbeat_s=0.2, deadline_s=1.5))
+    for i in range(args.replicas):
+        pool.add_local(f"r{i}", factory)
+    pool.start()
+    router = Router(pool, RouterConfig(
+        hedge_ms=args.hedge_ms, default_deadline_ms=args.deadline_ms))
+
+    client_lat = LatencySummary("client_latency_ms")
+    stop_at = time.monotonic() + args.seconds
+    ok = [0] * args.clients
+    shed = [0] * args.clients
+    missed = [0] * args.clients
+    errored = [0] * args.clients
+
+    def client(idx):
+        rng = np.random.default_rng(idx)
+        while time.monotonic() < stop_at:
+            x = rng.standard_normal(args.dim).astype(np.float32)
+            t0 = time.perf_counter()
+            try:
+                router.predict(x)
+            except ServerOverloaded:
+                shed[idx] += 1
+                time.sleep(0.002)
+                continue
+            except DeadlineExceeded:
+                missed[idx] += 1
+                continue
+            except RequestError as e:
+                errored[idx] += 1
+                print(f"pool bench: client {idx}: {e}", file=sys.stderr)
+                time.sleep(0.01)
+                continue
+            client_lat.observe((time.perf_counter() - t0) * 1000.0)
+            ok[idx] += 1
+
+    j.set_phase("serving_pool_bench_run")
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.seconds + 30)
+    elapsed = time.monotonic() - t_start
+    j.set_phase("serving_pool_bench_report")
+    router_stats = router.stats()
+    pool_view = [vars(s) for s in pool.view()]   # BEFORE stop: beacons
+    router.stop()                                # resign at shutdown
+    pool.stop()
+
+    total_ok = sum(ok)
+    doc = {
+        "metric": POOL_METRIC,
+        "value": round(total_ok / elapsed, 2) if elapsed else None,
+        "unit": f"req/s (replicas={args.replicas}, "
+                f"clients={args.clients}, dim={args.dim})",
+        "elapsed_s": round(elapsed, 2),
+        "completed": total_ok,
+        "client_shed": sum(shed),
+        "client_deadline_miss": sum(missed),
+        "client_errors": sum(errored),
+        "latency_ms": client_lat.summary(),
+        "router": router_stats,
+        "pool": pool_view,
+        "observability": snapshot(),
+    }
+    out = args.out or ""
+    if out:
+        with atomic_write(out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True, default=str)
+        print(f"pool bench: artifact written to {out}", file=sys.stderr)
+    _emit(doc)
+    j.mark_clean()
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.serving",
@@ -154,10 +269,28 @@ def main(argv=None) -> int:
     b.add_argument("--queue", type=int, default=64)
     b.add_argument("--window-ms", type=float, default=2.0)
     b.add_argument("--deadline-ms", type=float, default=5000.0)
-    b.add_argument("--out", default="BENCH_serving.json",
-                   help="artifact path ('' disables the file)")
+    b.add_argument("--replicas", type=int, default=1,
+                   help="> 1 routes the closed loop through a Router "
+                        "over N in-process replicas and writes the "
+                        "BENCH_serving_pool artifact")
+    b.add_argument("--hedge-ms", type=float, default=0.0,
+                   help="tail-latency hedge delay for --replicas mode "
+                        "(0 = off)")
+    b.add_argument("--out", default=None,
+                   help="artifact path ('' disables; default "
+                        "BENCH_serving.json, or BENCH_serving_pool.json "
+                        "with --replicas > 1)")
     b.set_defaults(fn=cmd_bench)
+    w = sub.add_parser("worker", help="replica worker process behind a "
+                                      "loopback socket (serving/pool.py "
+                                      "spawns these; docs/serving.md)")
+    from .worker import add_worker_args, cmd_worker
+    add_worker_args(w)
+    w.set_defaults(fn=cmd_worker)
     args = ap.parse_args(argv)
+    if getattr(args, "out", None) is None and args.cmd == "bench":
+        args.out = ("BENCH_serving_pool.json" if args.replicas > 1
+                    else "BENCH_serving.json")
     try:
         return args.fn(args)
     except Exception as e:              # structured line, never a bare crash

@@ -20,44 +20,84 @@ from __future__ import annotations
 import threading
 import time
 
-__all__ = ["DeadlineExceeded", "PendingResponse", "Request", "RequestError",
-           "ServerOverloaded", "drop_expired", "take_batch"]
+__all__ = ["DeadlineExceeded", "PendingResponse", "Request",
+           "RequestCancelled", "RequestError", "ServerOverloaded",
+           "ServerStopped", "drop_expired", "take_batch"]
 
 
 class RequestError(RuntimeError):
-    """Structured per-request failure (bad shape, predictor error)."""
+    """Structured per-request failure (bad shape, predictor error).
+
+    ``retryable`` is the replica-pool router's classification hook
+    (serving/router.py): True when the same request may succeed on a
+    DIFFERENT replica (predictor fault, stopped/overloaded server);
+    the shape-reject path overrides it to False on the instance — every
+    replica shares the bucket grid, so retrying is wasted budget."""
+
+    retryable = True
 
 
 class ServerOverloaded(RequestError):
-    """Admission rejected: the bounded queue is full.  Raised to the
+    """Admission rejected: the bounded queue is full — or, with
+    ``tier`` set, a pool-level degradation tier acted (the router's
+    capacity-floor shed names which; docs/serving.md).  Raised to the
     *submitter* immediately — the explicit load-shed that keeps queue
     latency bounded instead of letting every client get slower."""
 
-    def __init__(self, depth, limit):
+    def __init__(self, depth, limit, tier=None):
         super().__init__(f"serving queue full ({depth}/{limit}); request "
-                         "shed — retry with backoff or scale out")
+                         "shed — retry with backoff or scale out"
+                         + (f" [tier: {tier}]" if tier else ""))
         self.depth = depth
         self.limit = limit
+        self.tier = tier
+
+
+class ServerStopped(RequestError):
+    """Admission is closed: ``stop()`` has begun (or finished) on this
+    server.  Raised at ``submit()`` once the server is stopping, and set
+    on any straggler found in the queue after the worker exited — a
+    stop can never turn a request into a silent result-timeout."""
+
+    def __init__(self, detail="server stopped"):
+        super().__init__(f"{detail} — admission closed; submit to "
+                         "another replica or restart the server")
+
+
+class RequestCancelled(RequestError):
+    """The request was cancelled before execution (a hedged attempt
+    whose twin already answered): dropped at dequeue, never spending a
+    batch slot.  Not retryable — the caller already has its result."""
+
+    retryable = False
 
 
 class DeadlineExceeded(RequestError):
     """The request's deadline passed before (stage='dequeue') or while
-    (stage='post_batch') it was served."""
+    (stage='post_batch') it was served; stage='router_budget' means the
+    pool router's retry/hedge budget ran out first (``tier`` names the
+    budget that acted).  Never retryable: the time is gone."""
 
-    def __init__(self, stage, late_ms):
+    retryable = False
+
+    def __init__(self, stage, late_ms, tier=None):
         super().__init__(f"deadline exceeded at {stage} "
-                         f"({late_ms:.1f} ms late)")
+                         f"({late_ms:.1f} ms late)"
+                         + (f" [tier: {tier}]" if tier else ""))
         self.stage = stage
         self.late_ms = late_ms
+        self.tier = tier
 
 
 class Request:
     """One admitted sample and its completion slot."""
 
     __slots__ = ("payload", "shape", "key", "enq_t", "deadline_ts",
-                 "done", "result", "error", "served_t", "trace")
+                 "done", "result", "error", "served_t", "trace",
+                 "cancel", "params_step")
 
-    def __init__(self, payload, shape, key, deadline_s=None, now=None):
+    def __init__(self, payload, shape, key, deadline_s=None, now=None,
+                 cancel=None):
         now = time.monotonic() if now is None else now
         self.payload = payload
         self.shape = tuple(shape)            # original feature shape
@@ -73,6 +113,17 @@ class Request:
         # closed by whichever thread resolves the request; None only
         # for Requests constructed outside Server.submit
         self.trace = None
+        # cooperative cancellation (hedged attempts): a threading.Event
+        # the worker checks at dequeue — set it and the request is
+        # dropped with RequestCancelled instead of spending a batch slot
+        self.cancel = cancel
+        # the checkpoint step whose parameters served this request,
+        # stamped by the worker at batch time (the rolling-reload
+        # version-stamp contract; None = initializer weights)
+        self.params_step = None
+
+    def cancelled(self) -> bool:
+        return self.cancel is not None and self.cancel.is_set()
 
     def late_ms(self, now=None) -> float:
         if self.deadline_ts is None:
@@ -123,6 +174,13 @@ class PendingResponse:
         if self._request.served_t is None:
             return None
         return (self._request.served_t - self._request.enq_t) * 1000.0
+
+    @property
+    def params_step(self):
+        """Checkpoint step whose parameters produced this response
+        (stamped at batch time; None before completion or when the
+        server runs on initializer weights)."""
+        return self._request.params_step
 
 
 def drop_expired(pending, on_expired, now=None):

@@ -1,0 +1,567 @@
+"""Replica pool — N serving replicas behind one health ledger.
+
+The millions-of-users shape (ROADMAP item 1): one `Server` per replica
+— in-process (:class:`LocalReplica`) or its own OS process
+(:class:`ProcReplica`, serving/worker.py) — each heartbeating a
+readiness beacon onto a shared-filesystem ledger via
+``elastic.membership.Heartbeat``, exactly the control plane that
+detects a dead training rank (PR 8).  The pool owns replica LIFECYCLE
+(spawn, drain, restart, rolling reload, auto-respawn); the router
+(serving/router.py) owns per-request placement and robustness, reading
+replica health ONLY through :meth:`ReplicaPool.view` — i.e. only from
+the ledger — so every router thread (and every separate router process
+pointed at the same ledger) derives the same picture.
+
+Failure semantics (docs/serving.md failure matrix):
+
+- a SIGKILLed/wedged replica's heartbeat seq stalls; ``view()`` flips
+  ``alive`` False within the observer-clock deadline (the G11/G12
+  lessons: no cross-host wall clock, no reader-local membership
+  decisions) and the monitor respawns it under a bounded crash-loop
+  budget;
+- ``drain()`` stops admission FIRST (the beacon flips not-ready), then
+  lets the queue empty under a bounded deadline — in-flight work
+  finishes, nothing new lands;
+- ``restart()`` = drain + replace the worker; the fresh worker loads
+  the newest CRC-valid committed step from its ``ParamStore`` root, so
+  a restart is also the upgrade path;
+- ``reload()`` rolls a restart across the fleet, at most ``surge``
+  replicas out of rotation at once — zero shed beyond the surge margin
+  while the router routes around the hole.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..base import MXNetError
+from ..diagnostics.journal import get_journal
+from ..elastic.membership import Heartbeat, LivenessReader
+from .batcher import (DeadlineExceeded, RequestError, ServerOverloaded,
+                      ServerStopped)
+from . import wire
+
+__all__ = ["LocalReplica", "PoolConfig", "ProcReplica", "ReplicaPool",
+           "ReplicaState", "ReplicaUnavailable"]
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class ReplicaUnavailable(RequestError):
+    """The replica could not be reached (connection refused/reset, no
+    port in the beacon yet, torn reply): the transport twin of a dead
+    rank.  Always retryable on a different replica."""
+
+    retryable = True
+
+    def __init__(self, replica, detail):
+        super().__init__(f"replica {replica!r} unavailable: {detail}")
+        self.replica = replica
+
+
+@dataclass
+class PoolConfig:
+    """Replica-pool knobs (docs/serving.md; ``MXNET_TPU_POOL_*`` env
+    vars set fleet-wide defaults)."""
+
+    heartbeat_s: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_POOL_HEARTBEAT_S", 0.5))
+    deadline_s: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_POOL_DEADLINE_S", 3.0))      # hb stall -> replica lost
+    drain_s: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_POOL_DRAIN_S", 20.0))        # bounded drain deadline
+    spawn_s: float = 120.0                      # worker start -> ready
+    surge: int = 1                              # reload() out-of-rotation cap
+    max_respawns: int = 3                       # crash-loop budget/replica
+    monitor_s: float = 0.5                      # auto-respawn poll interval
+    poll_s: float = 0.05
+
+    def __post_init__(self):
+        if self.deadline_s <= self.heartbeat_s:
+            raise MXNetError(
+                f"pool deadline_s ({self.deadline_s:g}) must exceed "
+                f"heartbeat_s ({self.heartbeat_s:g}) — a deadline inside "
+                "one heartbeat interval declares healthy replicas dead")
+        if self.surge < 1:
+            raise MXNetError("pool surge must be >= 1")
+
+
+@dataclass
+class ReplicaState:
+    """One ledger-derived row of :meth:`ReplicaPool.view` — everything
+    the router is allowed to know about a replica."""
+
+    id: str
+    alive: bool
+    ready: bool
+    draining: bool = False
+    queue_depth: int = 0
+    params_step: object = None
+    last_batch_age_s: object = None
+    port: object = None
+    pid: object = None
+    idle_s: float = 0.0
+
+
+def _wait_for(predicate, deadline_s, poll_s=0.05, what="condition"):
+    """Bounded poll: True when ``predicate()`` held before the deadline,
+    else False (callers decide whether that is fatal)."""
+    deadline = time.monotonic() + max(float(deadline_s), 0.0)
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll_s)
+    return bool(predicate())
+
+
+class LocalReplica:
+    """In-process replica: a :class:`~.server.Server` built by
+    ``factory()`` plus its own beacon thread.  The cheap unit for router
+    logic tests and single-process deployments — same ledger contract
+    as a subprocess worker, minus the process isolation."""
+
+    kind = "local"
+
+    def __init__(self, rid, factory, hb_dir, config):
+        self.id = str(rid)
+        self.factory = factory
+        self.cfg = config
+        self.server = None
+        self._draining = False
+        self._hb = Heartbeat(hb_dir, self.id, config.heartbeat_s,
+                             payload=self._beacon, prefix="replica")
+
+    def _beacon(self):
+        srv = self.server
+        if srv is None:
+            return {"ready": False, "draining": self._draining}
+        doc = srv.beacon()
+        doc["draining"] = self._draining
+        doc["ready"] = bool(doc["ready"]) and not self._draining
+        return doc
+
+    def start(self):
+        if self.server is None:
+            self.server = self.factory()
+        self.server.start()
+        self._draining = False
+        self._hb.start()
+        return self
+
+    def predict(self, x, deadline_ms, cancel=None):
+        """One attempt on this replica; returns ``(array, meta)`` or
+        raises a structured serving error."""
+        srv = self.server
+        if srv is None:
+            raise ReplicaUnavailable(self.id, "not started")
+        budget_s = (deadline_ms / 1000.0 if deadline_ms
+                    else srv.config.result_timeout_s)
+        resp = srv.submit(x, deadline_ms=deadline_ms, cancel=cancel)
+        value = resp.result(timeout_s=budget_s + 5.0)
+        return value, {"replica": self.id,
+                       "params_step": resp.params_step}
+
+    def drain(self, deadline_s) -> int:
+        self._draining = True
+        self._hb.beat()                    # publish not-ready immediately
+        srv = self.server
+        if srv is None:
+            return 0
+        _wait_for(lambda: srv.queue_depth() == 0, deadline_s,
+                  self.cfg.poll_s)
+        return srv.queue_depth()
+
+    def restart(self, deadline_s=None):
+        """Replace the server with a fresh ``factory()`` build — which
+        re-reads the newest valid committed step from its ParamStore at
+        ``start()`` (the upgrade path)."""
+        if self.server is not None:
+            self.server.stop(timeout_s=30.0)
+        self.server = self.factory()
+        self.server.start()
+        self._draining = False
+        self._hb.beat()
+
+    def stop(self):
+        if self.server is not None:
+            self.server.stop(timeout_s=30.0)
+        self._hb.stop(resign=True)
+
+    def pid(self):
+        return os.getpid()
+
+
+class ProcReplica:
+    """Subprocess replica: ``python -m mxnet_tpu.serving worker`` with
+    its own device context, queue, cache, and ParamStore — the unit the
+    chaos tests SIGKILL.  Discovery is ledger-only: the worker publishes
+    its bound port in the heartbeat beacon; this handle reads it back
+    through the pool's :class:`LivenessReader` (``port_of``)."""
+
+    kind = "proc"
+
+    def __init__(self, rid, worker_args, hb_dir, config, port_of,
+                 env=None):
+        self.id = str(rid)
+        self.worker_args = dict(worker_args)   # CLI flag -> value
+        self.hb_dir = hb_dir
+        self.cfg = config
+        self.port_of = port_of                 # rid -> beacon port | None
+        self.env = env
+        self.proc = None
+
+    def _argv(self):
+        argv = [sys.executable, "-m", "mxnet_tpu.serving", "worker",
+                "--replica-id", self.id, "--hb-dir", self.hb_dir,
+                "--heartbeat-s", str(self.cfg.heartbeat_s)]
+        for flag, value in sorted(self.worker_args.items()):
+            if value is not None:
+                argv += [flag, str(value)]
+        return argv
+
+    def start(self):
+        if self.proc is not None and self.proc.poll() is None:
+            return self
+        self.proc = subprocess.Popen(self._argv(), env=self.env)
+        get_journal().event("pool_spawn", replica=self.id,
+                            pid=self.proc.pid)
+        return self
+
+    # -- wire client -----------------------------------------------------
+    def _roundtrip(self, header, payload=b"", budget_s=10.0):
+        port = self.port_of(self.id)
+        if port is None:
+            raise ReplicaUnavailable(self.id, "no port in beacon yet")
+        try:
+            with socket.create_connection(
+                    ("127.0.0.1", int(port)),
+                    timeout=min(budget_s, 5.0)) as s:
+                s.settimeout(budget_s + 5.0)
+                wire.send_frame(s, header, payload)
+                return wire.recv_frame(s)
+        except (OSError, wire.WireError) as e:
+            raise ReplicaUnavailable(
+                self.id, f"{type(e).__name__}: {e}") from None
+
+    @staticmethod
+    def _raise_remote(header):
+        name = header.get("error", "RequestError")
+        detail = header.get("detail", "")
+        if name == "DeadlineExceeded":
+            raise DeadlineExceeded(header.get("stage", "remote"),
+                                   float(header.get("late_ms", 0.0)))
+        if name == "ServerOverloaded":
+            raise ServerOverloaded(header.get("depth", -1),
+                                   header.get("limit", -1),
+                                   tier=header.get("tier"))
+        if name == "ServerStopped":
+            raise ServerStopped(detail or "replica stopped")
+        err = RequestError(f"{name}: {detail}")
+        err.retryable = bool(header.get("retryable", True))
+        raise err
+
+    def predict(self, x, deadline_ms, cancel=None):
+        # `cancel` has no remote lever: a losing hedge's reply is simply
+        # discarded by the router (in-process replicas do cancel at
+        # dequeue; docs/serving.md notes the asymmetry)
+        x = np.ascontiguousarray(x)
+        budget_s = deadline_ms / 1000.0 if deadline_ms else 60.0
+        header, payload = self._roundtrip(
+            {"cmd": "predict", "shape": list(x.shape),
+             "dtype": str(x.dtype), "deadline_ms": deadline_ms},
+            x.tobytes(), budget_s=budget_s)
+        if not header.get("ok"):
+            self._raise_remote(header)
+        out = np.frombuffer(payload, dtype=header["dtype"]).reshape(
+            header["shape"])
+        return out, {"replica": self.id,
+                     "params_step": header.get("params_step")}
+
+    def drain(self, deadline_s) -> int:
+        try:
+            header, _ = self._roundtrip(
+                {"cmd": "drain", "deadline_s": deadline_s},
+                budget_s=float(deadline_s) + 5.0)
+        except ReplicaUnavailable:
+            return 0                   # already gone: nothing to drain
+        return int(header.get("residual", 0))
+
+    def restart(self, deadline_s=None):
+        """Stop (graceful ``stop`` frame, then terminate/kill fallback)
+        and spawn a fresh worker — which reads the newest CRC-valid
+        committed step at startup."""
+        proc = self.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                self._roundtrip({"cmd": "stop"}, budget_s=5.0)
+            except ReplicaUnavailable:
+                pass
+            try:
+                proc.wait(timeout=15.0)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10.0)
+        self.proc = None
+        self.start()
+
+    def stop(self):
+        proc = self.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                self._roundtrip({"cmd": "stop"}, budget_s=5.0)
+            except ReplicaUnavailable:
+                pass
+            try:
+                proc.wait(timeout=15.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        self.proc = None
+
+    def kill(self):
+        """SIGKILL the worker — the chaos lever ("host vanished"): no
+        handlers, no drain, no beacon resignation."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+
+    def pid(self):
+        return None if self.proc is None else self.proc.pid
+
+
+class ReplicaPool:
+    """Owns N replicas and the health ledger under ``root/hb``.
+
+    Router-facing surface: :meth:`view` (ledger-derived states) and
+    :attr:`replicas` (id → handle, for dispatch).  Operator surface:
+    ``start/stop``, ``drain``, ``restart``, rolling ``reload``, and the
+    auto-respawn ``monitor``."""
+
+    def __init__(self, root, config=None):
+        self.root = str(root)
+        self.cfg = config or PoolConfig()
+        self.hb_dir = os.path.join(self.root, "hb")
+        os.makedirs(self.hb_dir, exist_ok=True)
+        self.reader = LivenessReader(self.hb_dir, self.cfg.deadline_s,
+                                     prefix="replica")
+        self.replicas: dict = {}
+        self._respawns: dict = {}
+        self._last_respawn: dict = {}      # rid -> monotonic spawn time
+        # short-TTL view cache: the ledger only changes at heartbeat
+        # granularity, so per-request re-reads of N beacon files are
+        # pure I/O waste on the router's hot path; a quarter-heartbeat
+        # snapshot preserves the uniform-view contract
+        self._view_ttl_s = self.cfg.heartbeat_s / 4.0
+        self._view_cache = (None, 0.0)     # (states, monotonic stamp)
+        self._monitor_stop = threading.Event()
+        self._monitor = None
+        self._lock = threading.Lock()      # lifecycle ops serialize
+
+    # -- construction ----------------------------------------------------
+    def add_local(self, rid, factory) -> "ReplicaPool":
+        """Add an in-process replica built by ``factory() -> Server``."""
+        self.replicas[str(rid)] = LocalReplica(rid, factory, self.hb_dir,
+                                               self.cfg)
+        return self
+
+    def add_proc(self, rid, worker_args, env=None) -> "ReplicaPool":
+        """Add a subprocess replica (``worker_args``: CLI flag → value,
+        e.g. ``{"--model": "scale", "--ckpt-root": root}``)."""
+        self.replicas[str(rid)] = ProcReplica(
+            rid, worker_args, self.hb_dir, self.cfg,
+            self._port_of, env=env)
+        return self
+
+    def _port_of(self, rid):
+        self.reader.observe(rid)
+        doc = self.reader.payload(rid)
+        return None if doc is None else doc.get("port")
+
+    # -- the ledger view (the router's ONLY health source) ---------------
+    def view(self) -> list:
+        """One :class:`ReplicaState` per configured replica, derived
+        entirely from the heartbeat ledger — uniform across every
+        reader of the same ledger.  Snapshots are cached for a quarter
+        heartbeat (the ledger's own update granularity); callers must
+        not mutate the returned states."""
+        cached, stamp = self._view_cache
+        now = time.monotonic()
+        if cached is not None and now - stamp < self._view_ttl_s:
+            return cached
+        out = []
+        for rid in self.replicas:
+            idle = self.reader.observe(rid)
+            alive = idle is not None and idle <= self.cfg.deadline_s
+            doc = self.reader.payload(rid) or {}
+            out.append(ReplicaState(
+                id=rid, alive=alive,
+                ready=alive and bool(doc.get("ready")),
+                draining=bool(doc.get("draining")),
+                queue_depth=int(doc.get("queue_depth") or 0),
+                params_step=doc.get("params_step"),
+                last_batch_age_s=doc.get("last_batch_age_s"),
+                port=doc.get("port"), pid=doc.get("pid"),
+                idle_s=round(idle or 0.0, 3)))
+        self._view_cache = (out, now)
+        return out
+
+    def wait_ready(self, rids=None, deadline_s=None) -> bool:
+        rids = set(map(str, rids)) if rids is not None \
+            else set(self.replicas)
+        deadline_s = self.cfg.spawn_s if deadline_s is None else deadline_s
+
+        def _all_ready():
+            return all(s.ready for s in self.view() if s.id in rids)
+
+        return _wait_for(_all_ready, deadline_s, self.cfg.poll_s)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self, wait_ready=True) -> "ReplicaPool":
+        get_journal().event("pool_start", root=self.root,
+                            replicas=sorted(self.replicas),
+                            heartbeat_s=self.cfg.heartbeat_s,
+                            deadline_s=self.cfg.deadline_s)
+        for rep in self.replicas.values():
+            rep.start()
+        if wait_ready and not self.wait_ready():
+            laggards = [s.id for s in self.view() if not s.ready]
+            raise MXNetError(
+                f"replica pool did not become ready within "
+                f"{self.cfg.spawn_s:g}s (not ready: {laggards}) — see "
+                "the journal / worker stderr")
+        return self
+
+    def stop(self) -> None:
+        self.monitor_stop()
+        for rep in self.replicas.values():
+            rep.stop()
+        get_journal().event("pool_stop", root=self.root)
+
+    def drain(self, rid, deadline_s=None) -> int:
+        """Stop admission on one replica (the beacon flips not-ready so
+        the router routes around it), then let its queue empty under a
+        bounded deadline.  Returns the residual depth (0 = clean)."""
+        rid = str(rid)
+        deadline_s = self.cfg.drain_s if deadline_s is None else deadline_s
+        with self._lock:
+            residual = self.replicas[rid].drain(deadline_s)
+        get_journal().event("pool_drain", replica=rid,
+                            deadline_s=deadline_s, residual=residual)
+        return residual
+
+    def restart(self, rid, deadline_s=None, drain=True) -> None:
+        """Draining restart: drain (bounded), replace the worker, wait
+        ready.  The fresh worker loads the newest CRC-valid committed
+        step from its checkpoint root — restart IS the upgrade path."""
+        rid = str(rid)
+        residual = self.drain(rid, deadline_s) if drain else None
+        # an intentional restart resigns the beacon before the fresh
+        # worker's first beat — give the monitor the same startup grace
+        # as its own respawns, or it races this restart with another
+        self._last_respawn[rid] = time.monotonic()
+        with self._lock:
+            self.replicas[rid].restart()
+        ready = self.wait_ready([rid])
+        get_journal().event("pool_restart", replica=rid,
+                            residual=residual, ready=ready)
+        if not ready:
+            raise MXNetError(f"replica {rid!r} did not come back ready "
+                             f"within {self.cfg.spawn_s:g}s after restart")
+
+    def reload(self, surge=None, deadline_s=None) -> dict:
+        """Rolling fleet upgrade: drain + restart every replica, at most
+        ``surge`` out of rotation at a time, each restart landing on the
+        newest CRC-valid committed step at ITS restart moment (a step
+        published mid-roll splits the fleet across exactly the old and
+        the new root — never a torn state).  Returns the post-roll
+        ``{replica: params_step}`` map."""
+        surge = self.cfg.surge if surge is None else max(int(surge), 1)
+        rids = sorted(self.replicas)
+        get_journal().event("pool_reload", phase="begin", surge=surge,
+                            replicas=rids)
+        for i in range(0, len(rids), surge):
+            wave = rids[i:i + surge]
+            for rid in wave:
+                self.restart(rid, deadline_s=deadline_s)
+        steps = {s.id: s.params_step for s in self.view()}
+        get_journal().event("pool_reload", phase="end", steps=steps)
+        return steps
+
+    # -- auto-respawn monitor -------------------------------------------
+    def monitor_start(self, interval_s=None) -> None:
+        """Watch the ledger; a replica whose heartbeat stalls past the
+        deadline is journaled ``replica_lost`` and respawned (bounded by
+        the per-replica crash-loop budget)."""
+        if self._monitor is not None:
+            return
+        interval = self.cfg.monitor_s if interval_s is None else interval_s
+        self._monitor_stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_run, args=(interval,), daemon=True,
+            name="mxtpu-pool-monitor")
+        self._monitor.start()
+
+    def monitor_stop(self) -> None:
+        self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=self.cfg.monitor_s + 5.0)
+            self._monitor = None
+
+    def _monitor_run(self, interval):
+        while not self._monitor_stop.wait(interval):
+            try:
+                self._sweep_dead()
+            except Exception as exc:       # the monitor must outlive one
+                get_journal().crash(exc, where="pool_monitor")
+
+    def _sweep_dead(self):
+        now = time.monotonic()
+        for state in self.view():
+            if state.alive:
+                continue
+            # a just-respawned worker needs its startup window before
+            # its first heartbeat can land — don't double-respawn it
+            t = self._last_respawn.get(state.id)
+            if t is not None and now - t < self.cfg.spawn_s:
+                continue
+            rep = self.replicas[state.id]
+            proc_gone = rep.kind == "proc" and (
+                rep.proc is None or rep.proc.poll() is not None)
+            n = self._respawns.get(state.id, 0)
+            get_journal().event("replica_lost", replica=state.id,
+                                idle_s=state.idle_s, pid=state.pid,
+                                proc_exited=proc_gone, respawns=n)
+            if n >= self.cfg.max_respawns:
+                get_journal().event("replica_respawn_exhausted",
+                                    replica=state.id, respawns=n)
+                self._last_respawn[state.id] = now   # re-log per window
+                continue
+            self._respawns[state.id] = n + 1
+            self._last_respawn[state.id] = now
+            with self._lock:
+                rep.restart()

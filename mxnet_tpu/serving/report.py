@@ -19,7 +19,20 @@ __all__ = ["serving_report"]
 
 _KINDS = ("serving_start", "serving_stop", "serving_batch", "serving_shed",
           "serving_reject", "serving_deadline_miss", "serving_reload",
-          "serving_reload_failed")
+          "serving_reload_failed", "serving_stopped_reject",
+          "serving_cancelled",
+          # the replica-pool tier (serving/pool.py + router.py)
+          "pool_start", "pool_stop", "pool_spawn", "pool_drain",
+          "pool_restart", "pool_reload", "replica_lost",
+          "replica_respawn_exhausted", "router_start", "router_stop",
+          "router_retry", "router_hedge", "router_breaker", "router_shed",
+          "router_budget_exhausted")
+
+_POOL_KINDS = ("pool_start", "pool_stop", "pool_spawn", "pool_drain",
+               "pool_restart", "pool_reload", "replica_lost",
+               "replica_respawn_exhausted", "router_start", "router_stop",
+               "router_retry", "router_hedge", "router_breaker",
+               "router_shed", "router_budget_exhausted")
 
 
 def _read_records(path):
@@ -41,6 +54,38 @@ def _read_records(path):
     return records, None
 
 
+def _last_run_start(records) -> int:
+    """Index where the last run begins (see the caller's comment).
+
+    Known limit: a pool drill that CRASHED (no ``pool_stop``) followed
+    by a solo Server run in the same journal file still anchors at the
+    crashed drill's ``pool_start`` — a healthy pool run is thousands of
+    worker ``serving_batch``/``serving_start`` records with *no* pool-
+    kind records between them, so "a serving_start after the last pool
+    record" cannot distinguish the solo run without misanchoring the
+    healthy fleet case. Use one journal file per run (what every test
+    and the bench do) and the question does not arise."""
+    def last(kind):
+        for i in range(len(records) - 1, -1, -1):
+            if records[i]["kind"] == kind:
+                return i
+        return None
+
+    i_pool = last("pool_start")
+    if i_pool is None:
+        i_start = last("serving_start")
+        return 0 if i_start is None else i_start
+    i_stop = last("pool_stop")
+    if i_stop is not None and i_stop > i_pool:
+        # the pool run closed; a serving_start after the close is a new
+        # solo run and wins the anchor
+        solo = [i for i in range(i_stop + 1, len(records))
+                if records[i]["kind"] == "serving_start"]
+        if solo:
+            return solo[-1]
+    return i_pool
+
+
 def serving_report(path) -> dict:
     """Summarize the last serving run's journal records (see module
     docstring).  Always returns a dict; ``ok`` is False with an
@@ -48,11 +93,13 @@ def serving_report(path) -> dict:
     records, err = _read_records(path)
     if records is None:
         return {"ok": False, "path": path, "error": err}
-    # last run = records after the final serving_start (if any)
-    for i in range(len(records) - 1, -1, -1):
-        if records[i]["kind"] == "serving_start":
-            records = records[i:]
-            break
+    # last run = records after the final pool_start when the pool run is
+    # the LAST run (every worker replica contributes its own
+    # serving_start — slicing at the last of those would hide the rest
+    # of the fleet). A pool run that already closed (pool_stop) followed
+    # by a later solo serving_start is a finished drill: anchor at the
+    # newer solo run instead of resurrecting the stale fleet records.
+    records = records[_last_run_start(records):]
     if not records:
         return {"ok": False, "path": path,
                 "error": "no serving records in journal"}
@@ -105,4 +152,47 @@ def serving_report(path) -> dict:
         out["cache_hit_rate"] = None
     stops = [r for r in records if r["kind"] == "serving_stop"]
     out["clean_stop"] = bool(stops) and not stops[-1].get("stuck", False)
+    router = _router_section(records)
+    if router is not None:
+        out["router"] = router
     return out
+
+
+def _router_section(records) -> dict | None:
+    """Replica-pool/router reduction of the last run: retry/hedge/shed
+    counts, every breaker transition in order, replica losses/restarts
+    and half-open re-admissions — the operator view of one chaos drill
+    (docs/serving.md failure matrix)."""
+    pool = [r for r in records if r["kind"] in _POOL_KINDS]
+    if not pool:
+        return None
+    count = lambda k: sum(1 for r in pool if r["kind"] == k)  # noqa: E731
+    transitions = [
+        {"replica": r.get("replica"), "frm": r.get("frm"),
+         "to": r.get("to"), "reason": r.get("reason"),
+         "trace_id": r.get("trace_id")}
+        for r in pool if r["kind"] == "router_breaker"]
+    sheds: dict = {}
+    for r in pool:
+        if r["kind"] == "router_shed":
+            t = r.get("tier", "unknown")
+            sheds[t] = sheds.get(t, 0) + 1
+    readmitted = sorted({t["replica"] for t in transitions
+                         if t["frm"] == "half_open"
+                         and t["to"] == "closed"})
+    return {
+        "retries": count("router_retry"),
+        "hedges": count("router_hedge"),
+        "budget_exhausted": count("router_budget_exhausted"),
+        "sheds_by_tier": sheds,
+        "breaker_transitions": transitions,
+        "replicas_lost": [
+            {"replica": r.get("replica"), "idle_s": r.get("idle_s")}
+            for r in pool if r["kind"] == "replica_lost"],
+        "restarts": count("pool_restart"),
+        "drains": count("pool_drain"),
+        "reload_rolls": sum(1 for r in pool if r["kind"] == "pool_reload"
+                            and r.get("phase") == "end"),
+        "readmitted": readmitted,
+        "respawn_exhausted": count("replica_respawn_exhausted"),
+    }

@@ -1,0 +1,550 @@
+"""Health-routed front door — placement, retries, hedging, breakers.
+
+The router multiplexes requests across a :class:`~.pool.ReplicaPool`.
+Its placement decision is derived ONLY from the pool's heartbeat ledger
+(:meth:`ReplicaPool.view` — live + ready, least queue depth), so every
+router thread (and any other reader of the same ledger) sees the same
+picture; the only router-local overlay is the per-replica circuit
+breaker, which exists precisely to react FASTER than the heartbeat
+deadline when a replica starts failing requests.
+
+Per-request robustness budget (docs/serving.md):
+
+- **deadline-scoped retries** — a retryable failure (transport error,
+  stopped/overloaded replica, predictor fault) moves to a different
+  replica with ``resilience.retry`` backoff bounds, always inside the
+  request's own deadline; when the budget runs out the caller gets
+  ``DeadlineExceeded(stage="router_budget")`` naming the tier that
+  acted, never a silent hang;
+- **tail-latency hedging** (optional) — if the first attempt hasn't
+  answered after a p99-derived delay, a second attempt starts on a
+  different replica; first response wins, the loser is cancelled at
+  dequeue (in-process replicas) or its reply discarded (subprocess);
+- **circuit breaker per replica** — K consecutive failures or a
+  heartbeat stall opens the breaker (requests stop routing there);
+  after a cooldown it goes half-open and ONE probe request re-admits
+  (success → closed) or re-opens it.  Every transition is journaled
+  (``router_breaker``) with trace correlation;
+- **graceful degradation** — when live capacity falls below the
+  configured floor, the router sheds by admission class (lowest
+  priority first) instead of failing everyone: ``ServerOverloaded``
+  carries the tier that acted.
+
+Metric families (``Router.metrics_text``): ``mxnet_tpu_router_events``
+(attempts/retries/hedges/sheds), ``mxnet_tpu_router_breaker_state`` and
+``mxnet_tpu_router_replica_p99_ms`` per replica.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import queue as _queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..diagnostics.journal import get_journal
+from ..observability import trace as _trace
+from ..observability.metrics import LatencySummary
+from ..resilience import atomic as _atomic
+from ..resilience.retry import backoff_delays
+from .batcher import DeadlineExceeded, RequestError, ServerOverloaded
+
+__all__ = ["Router", "RouterConfig", "RouterResponse"]
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class RouterConfig:
+    """Front-door knobs (docs/serving.md; ``MXNET_TPU_POOL_*`` env vars
+    set fleet-wide defaults)."""
+
+    default_deadline_ms: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_SERVING_DEADLINE_MS", 2000.0))
+    retries: int = field(default_factory=lambda: _env_int(
+        "MXNET_TPU_POOL_RETRIES", 2))
+    retry_base_s: float = 0.02               # resilience.retry bounds
+    retry_max_s: float = 0.5
+    retry_jitter: float = 0.5
+    hedge_ms: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_POOL_HEDGE_MS", 0.0))     # <= 0 disables hedging
+    hedge_p99_factor: float = 1.0            # delay = max(hedge_ms, p99*f)
+    hedge_min_samples: int = 20              # p99 trustworthy after this
+    breaker_k: int = field(default_factory=lambda: _env_int(
+        "MXNET_TPU_POOL_BREAKER_K", 3))
+    breaker_cooldown_s: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_POOL_BREAKER_COOLDOWN_S", 5.0))
+    capacity_floor: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_POOL_CAPACITY_FLOOR", 0.0))   # 0 disables degradation
+
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+_BREAKER_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class _Breaker:
+    """Per-replica failure bookkeeping.  ``closed`` routes normally;
+    ``open`` routes nothing until the cooldown passes; ``half_open``
+    admits exactly ONE probe request whose outcome decides re-admission
+    (success → closed) or another cooldown (failure → open)."""
+
+    __slots__ = ("state", "failures", "opened_t", "probing", "reason")
+
+    def __init__(self):
+        self.state = CLOSED
+        self.failures = 0
+        self.opened_t = None
+        self.probing = False
+        self.reason = None
+
+
+class RouterResponse:
+    """One routed result plus its provenance: which replica answered,
+    which checkpoint step served it (the rolling-reload version stamp),
+    how many attempts it took, and whether a hedge fired."""
+
+    __slots__ = ("value", "replica", "params_step", "attempts", "hedged",
+                 "latency_ms")
+
+    def __init__(self, value, replica, params_step, attempts, hedged,
+                 latency_ms):
+        self.value = value
+        self.replica = replica
+        self.params_step = params_step
+        self.attempts = attempts
+        self.hedged = hedged
+        self.latency_ms = latency_ms
+
+
+class Router:
+    """The front door over one :class:`~.pool.ReplicaPool` (thread-safe;
+    call :meth:`predict` / :meth:`call` from any number of client
+    threads)."""
+
+    def __init__(self, pool, config=None):
+        self.pool = pool
+        self.config = config or RouterConfig()
+        # reentrant: breaker transitions are journaled from inside
+        # counter/placement critical sections
+        self._lock = threading.RLock()
+        self._rr = itertools.count()         # least-loaded tiebreak
+        self._breakers: dict = {}            # rid -> _Breaker
+        self._latency: dict = {}             # rid -> LatencySummary
+        self._attempt_counts: dict = {}      # rid -> attempts routed
+        self.counters = {"requests": 0, "served": 0, "attempts": 0,
+                         "retries": 0, "hedges": 0, "hedge_wins": 0,
+                         "shed": 0, "no_capacity": 0, "failures": 0,
+                         "breaker_opens": 0, "readmissions": 0}
+        get_journal().event(
+            "router_start", replicas=sorted(pool.replicas),
+            retries=self.config.retries, hedge_ms=self.config.hedge_ms,
+            breaker_k=self.config.breaker_k,
+            capacity_floor=self.config.capacity_floor)
+
+    # -- client surface --------------------------------------------------
+    def predict(self, x, deadline_ms=None, priority=0):
+        """Route one sample; returns the result value.  Raises the same
+        structured errors a single Server does, plus the router tiers
+        (``ServerOverloaded(tier=...)``, ``DeadlineExceeded(
+        stage='router_budget')``)."""
+        return self.call(x, deadline_ms=deadline_ms,
+                         priority=priority).value
+
+    def call(self, x, deadline_ms=None, priority=0) -> RouterResponse:
+        cfg = self.config
+        if deadline_ms is None:
+            deadline_ms = cfg.default_deadline_ms
+        deadline_ts = time.monotonic() + deadline_ms / 1000.0
+        x = np.asarray(x)
+        with self._lock:
+            self.counters["requests"] += 1
+        with _trace.span("router_request", priority=priority):
+            return self._call_traced(x, deadline_ms, deadline_ts,
+                                     priority)
+
+    def _call_traced(self, x, deadline_ms, deadline_ts, priority):
+        cfg = self.config
+        t0 = time.monotonic()
+        self._admit(priority)
+        delays = backoff_delays(cfg.retries, cfg.retry_base_s,
+                                cfg.retry_max_s, cfg.retry_jitter)
+        tried: set = set()
+        attempts = 0
+        hedged_any = False
+        last_exc = None
+        for attempt in range(cfg.retries + 1):
+            remaining = deadline_ts - time.monotonic()
+            if remaining <= 0:
+                break
+            state = self._pick(exclude=tried)
+            if state is None and tried:
+                # every untried replica is unroutable: widen back out
+                # rather than fail a retryable request early
+                state = self._pick(exclude=set())
+            if state is None:
+                self._shed("no_capacity", priority)
+            tried.add(state.id)
+            attempts += 1
+            try:
+                value, meta, hedged = self._attempt(
+                    state, x, remaining, attempt)
+            except RequestError as exc:
+                last_exc = exc
+                hedged_any = hedged_any or getattr(exc, "_hedged", False)
+                self._record_failure(getattr(exc, "_replica", state.id),
+                                     exc)
+                if not getattr(exc, "retryable", False) \
+                        or attempt >= cfg.retries:
+                    raise
+                with self._lock:
+                    self.counters["retries"] += 1
+                get_journal().event(
+                    "router_retry", replica=state.id, attempt=attempt + 1,
+                    error=type(exc).__name__, detail=str(exc)[:200])
+                pause = min(delays[attempt],
+                            max(deadline_ts - time.monotonic(), 0.0))
+                if pause > 0:
+                    time.sleep(pause)
+                continue
+            hedged_any = hedged_any or hedged
+            self._record_success(meta["replica"],
+                                 (time.monotonic() - t0) * 1000.0)
+            with self._lock:
+                self.counters["served"] += 1
+            return RouterResponse(
+                value, meta["replica"], meta.get("params_step"),
+                attempts, hedged_any,
+                round((time.monotonic() - t0) * 1000.0, 3))
+        # deadline budget exhausted across retries
+        late_ms = max(time.monotonic() - deadline_ts, 0.0) * 1000.0
+        err = DeadlineExceeded("router_budget", late_ms,
+                               tier="retry_budget")
+        err.__cause__ = last_exc
+        get_journal().event("router_budget_exhausted",
+                            attempts=attempts,
+                            last_error=type(last_exc).__name__
+                            if last_exc else None)
+        raise err
+
+    # -- admission tiers -------------------------------------------------
+    def _shed(self, tier, priority, usable=0, total=None):
+        total = len(self.pool.replicas) if total is None else total
+        key = "no_capacity" if tier == "no_capacity" else "shed"
+        with self._lock:
+            self.counters[key] += 1
+        get_journal().event("router_shed", tier=tier, priority=priority,
+                            usable=usable, total=total)
+        raise ServerOverloaded(usable, total, tier=tier)
+
+    def _admit(self, priority):
+        """Graceful degradation: when live+ready capacity is below the
+        floor, shed lowest-priority first (only priority-0 traffic is
+        admitted) instead of failing every class uniformly."""
+        floor = self.config.capacity_floor
+        if floor <= 0 or priority <= 0:
+            return
+        usable = sum(1 for s in self.pool.view()
+                     if s.alive and s.ready
+                     and self._breaker(s.id).state != OPEN)
+        total = max(len(self.pool.replicas), 1)
+        if usable / total < floor:
+            self._shed("capacity_floor", priority, usable, total)
+
+    # -- placement -------------------------------------------------------
+    def _breaker(self, rid) -> _Breaker:
+        br = self._breakers.get(rid)
+        if br is None:
+            br = self._breakers.setdefault(rid, _Breaker())
+        return br
+
+    def _transition(self, rid, br, to, reason):
+        frm, br.state = br.state, to
+        if to == OPEN:
+            br.opened_t = time.monotonic()
+            br.probing = False
+            with self._lock:
+                self.counters["breaker_opens"] += 1
+        if to == CLOSED:
+            br.failures = 0
+            br.probing = False
+            if frm == HALF_OPEN:
+                with self._lock:
+                    self.counters["readmissions"] += 1
+        br.reason = reason
+        get_journal().event("router_breaker", replica=rid, frm=frm,
+                            to=to, reason=reason, failures=br.failures)
+
+    def _allow(self, rid, alive, ready) -> bool:
+        """Breaker gate for one candidate.  Only a heartbeat STALL opens
+        the breaker here — a merely not-ready replica (draining, mid-
+        restart) is out of rotation without being declared broken.  The
+        half-open probe slot is claimed by ``_pick`` for the replica
+        actually SELECTED, never during candidate enumeration."""
+        br = self._breaker(rid)
+        if br.state == CLOSED:
+            if not alive:
+                self._transition(rid, br, OPEN, "heartbeat_stall")
+                return False
+            return ready
+        if not alive or not ready:
+            return False
+        if br.state == OPEN:
+            if br.opened_t is not None and time.monotonic() - br.opened_t \
+                    >= self.config.breaker_cooldown_s:
+                self._transition(rid, br, HALF_OPEN, "cooldown_elapsed")
+            else:
+                return False
+        # half-open: admissible only while no probe is in flight
+        return not br.probing
+
+    def _pick(self, exclude):
+        """Least-loaded among live + ready + breaker-admitted replicas
+        (queue depth from the ledger; ties rotate round-robin)."""
+        view = self.pool.view()            # ledger file I/O: OUTSIDE the
+        candidates = []                    # lock — a slow shared FS must
+        with self._lock:                   # not stall every router thread
+            for s in view:
+                if s.id in exclude:
+                    continue
+                if not self._allow(s.id, s.alive, s.ready):
+                    continue
+                candidates.append(s)
+        if not candidates:
+            return None
+        depth = min(s.queue_depth for s in candidates)
+        tied = sorted((s for s in candidates if s.queue_depth == depth),
+                      key=lambda s: s.id)
+        pick = tied[next(self._rr) % len(tied)]
+        with self._lock:
+            br = self._breaker(pick.id)
+            if br.state == HALF_OPEN:
+                br.probing = True          # this dispatch IS the probe
+        return pick
+
+    def _record_failure(self, rid, exc):
+        with self._lock:
+            self.counters["failures"] += 1
+        # busy is not broken, and a non-retryable caller error (shape
+        # reject, cancelled hedge) says nothing about replica health;
+        # deadline misses DO count — a replica too slow to answer in
+        # budget is exactly what the breaker should take out of rotation
+        harmless = isinstance(exc, ServerOverloaded) or (
+            not getattr(exc, "retryable", True)
+            and not isinstance(exc, DeadlineExceeded))
+        if harmless:
+            self._release_probe(rid)
+            return
+        br = self._breaker(rid)
+        with self._lock:
+            br.failures += 1
+            if br.state == HALF_OPEN:
+                self._transition(rid, br, OPEN, "probe_failed")
+            elif br.state == CLOSED \
+                    and br.failures >= self.config.breaker_k:
+                self._transition(rid, br, OPEN, "consecutive_failures")
+
+    def _record_success(self, rid, latency_ms):
+        br = self._breaker(rid)
+        with self._lock:
+            if br.state == HALF_OPEN:
+                self._transition(rid, br, CLOSED, "probe_succeeded")
+            else:
+                br.failures = 0
+            lat = self._latency.get(rid)
+            if lat is None:
+                lat = self._latency.setdefault(
+                    rid, LatencySummary(f"router_{rid}_ms"))
+        lat.observe(latency_ms)
+
+    def _release_probe(self, rid):
+        br = self._breaker(rid)
+        with self._lock:
+            if br.state == HALF_OPEN:
+                br.probing = False
+
+    # -- attempts + hedging ----------------------------------------------
+    def _hedge_delay_s(self, rid):
+        cfg = self.config
+        if cfg.hedge_ms <= 0:
+            return None
+        delay_ms = cfg.hedge_ms
+        lat = self._latency.get(rid)
+        if lat is not None and lat.count >= cfg.hedge_min_samples:
+            p99 = lat.percentile(99)
+            if p99 is not None:
+                delay_ms = max(delay_ms, p99 * cfg.hedge_p99_factor)
+        return delay_ms / 1000.0
+
+    def _dispatch(self, state, x, budget_s, cancel):
+        """One attempt on one replica (runs in the caller thread or a
+        hedge thread).  The trip site is the slow-replica chaos seam —
+        path carries the replica id so ``faults.slow_call`` can target
+        one replica."""
+        _atomic.trip("router_attempt", state.id)
+        with self._lock:
+            self.counters["attempts"] += 1
+            self._attempt_counts[state.id] = \
+                self._attempt_counts.get(state.id, 0) + 1
+        replica = self.pool.replicas[state.id]
+        deadline_ms = budget_s * 1000.0
+        with _trace.span("router_attempt", replica=state.id):
+            return replica.predict(x, deadline_ms, cancel=cancel)
+
+    def _attempt(self, state, x, budget_s, attempt_no):
+        """Primary attempt with optional hedging; returns
+        ``(value, meta, hedged)`` or raises the decisive error."""
+        hedge_s = self._hedge_delay_s(state.id)
+        if hedge_s is None or hedge_s >= budget_s:
+            value, meta = self._dispatch(state, x, budget_s, None)
+            return value, meta, False
+
+        results = _queue.Queue(maxsize=4)    # bounded: <= 2 writers
+        cancels = {}
+        ctx = _trace.current_context()
+        t_start = time.monotonic()
+
+        def run(st):
+            # arm threads re-anchor under the request span explicitly
+            # (contextvars don't cross threads; docs/observability.md)
+            arm = _trace.start_span("router_hedge_arm", parent=ctx,
+                                    replica=st.id)
+            try:
+                remaining = budget_s - (time.monotonic() - t_start)
+                v, m = self._dispatch(st, x, max(remaining, 0.01),
+                                      cancels[st.id])
+                results.put_nowait((st, None, v, m))
+                arm.end(status="ok")
+            except BaseException as e:
+                results.put_nowait((st, e, None, None))
+                arm.end(status=type(e).__name__)
+
+        def launch(st):
+            cancels[st.id] = threading.Event()
+            threading.Thread(target=run, args=(st,), daemon=True,
+                             name=f"mxtpu-router-attempt-{st.id}").start()
+
+        launch(state)
+        in_flight = {state.id: state}
+        hedged = False
+        try:
+            first = results.get(timeout=min(hedge_s, budget_s))
+        except _queue.Empty:
+            first = None
+        if first is None:
+            hedge_state = self._pick(exclude=set(in_flight))
+            if hedge_state is not None:
+                hedged = True
+                with self._lock:
+                    self.counters["hedges"] += 1
+                get_journal().event(
+                    "router_hedge", primary=state.id,
+                    hedge=hedge_state.id,
+                    delay_ms=round(hedge_s * 1000.0, 1))
+                launch(hedge_state)
+                in_flight[hedge_state.id] = hedge_state
+        # first response wins; a failed response yields to the survivor
+        last_exc = None
+        while in_flight:
+            if first is None:
+                remaining = budget_s - (time.monotonic() - t_start)
+                if remaining <= 0:
+                    break
+                try:
+                    first = results.get(timeout=remaining)
+                except _queue.Empty:
+                    break
+            st, exc, value, meta = first
+            first = None
+            in_flight.pop(st.id, None)
+            if exc is None:
+                for rid, ev in cancels.items():
+                    if rid != st.id:
+                        ev.set()           # loser cancelled at dequeue
+                for rid in in_flight:
+                    # the loser's result is never consumed — if it held
+                    # its replica's half-open probe slot, free it or the
+                    # replica is silently out of rotation forever
+                    self._release_probe(rid)
+                if hedged and st.id != state.id:
+                    with self._lock:
+                        self.counters["hedge_wins"] += 1
+                return value, meta, hedged
+            last_exc = exc
+            last_exc._replica = st.id
+            if in_flight and isinstance(exc, RequestError):
+                # the loser's failure still feeds its replica's breaker
+                # while the survivor keeps running
+                self._record_failure(st.id, exc)
+        for ev in cancels.values():
+            ev.set()                       # nobody won: recall them all
+        for rid in in_flight:              # unresolved attempts: free any
+            self._release_probe(rid)       # probe slot they were holding
+        if last_exc is not None:
+            last_exc._hedged = hedged
+            raise last_exc
+        late_ms = max((time.monotonic() - t_start) - budget_s, 0) * 1000.0
+        err = DeadlineExceeded("router_wait", late_ms)
+        err._hedged = hedged
+        raise err
+
+    # -- reporting -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            counters = dict(self.counters)
+            attempts = dict(self._attempt_counts)
+        per_replica = {}
+        for rid in self.pool.replicas:
+            br = self._breakers.get(rid)
+            lat = self._latency.get(rid)
+            per_replica[rid] = {
+                "attempts": attempts.get(rid, 0),
+                "breaker": br.state if br else CLOSED,
+                "p99_ms": lat.percentile(99) if lat is not None
+                and lat.count else None}
+        return {**counters, "replicas": per_replica}
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition: the router counters/breaker/latency
+        mirrored into the process default registry at call time (gauge
+        mirrors, same contract as ``Server.metrics_text``)."""
+        from ..observability import metrics as _m
+        reg = _m.default_registry()
+        st = self.stats()
+        ev = reg.gauge("mxnet_tpu_router_events",
+                       "router counters (cumulative)", ("event",))
+        for k, v in st.items():
+            if k != "replicas":
+                ev.labels(event=k).set(v)
+        brg = reg.gauge("mxnet_tpu_router_breaker_state",
+                        "per-replica breaker (0 closed, 1 half-open, "
+                        "2 open)", ("replica",))
+        att = reg.gauge("mxnet_tpu_router_attempts_total",
+                        "attempts routed per replica", ("replica",))
+        p99 = reg.gauge("mxnet_tpu_router_replica_p99_ms",
+                        "per-replica end-to-end p99 as seen by the "
+                        "router", ("replica",))
+        for rid, row in st["replicas"].items():
+            brg.labels(replica=rid).set(_BREAKER_CODE[row["breaker"]])
+            att.labels(replica=rid).set(row["attempts"])
+            if row["p99_ms"] is not None:
+                p99.labels(replica=rid).set(row["p99_ms"])
+        return reg.prometheus_text()
+
+    def stop(self) -> None:
+        get_journal().event("router_stop", **{
+            k: v for k, v in self.stats().items() if k != "replicas"})

@@ -29,10 +29,11 @@ from ..diagnostics.journal import get_journal
 from ..metric import LatencySummary
 from ..observability import instrument as _obs
 from ..observability import trace as _trace
+from ..resilience import atomic as _atomic
 from ..resilience.retry import retry_call
 from .batcher import (DeadlineExceeded, PendingResponse, Request,
-                      RequestError, ServerOverloaded, drop_expired,
-                      take_batch)
+                      RequestCancelled, RequestError, ServerOverloaded,
+                      ServerStopped, drop_expired, take_batch)
 from .buckets import BucketGrid
 from .cache import CompiledPredictor, PredictorCache
 
@@ -131,15 +132,23 @@ class Server:
         self._worker = None
         self._stopping = threading.Event()
         self._lock = threading.Lock()
+        # admission gate: submit's closed-check + enqueue and stop's
+        # close + straggler sweep serialize on this lock, so a request
+        # can never slip into the queue after the final sweep (the
+        # silent-drop race the ServerStopped contract closes)
+        self._admit_lock = threading.Lock()
+        self._closed = False
         self._params_step = None
         self._last_reload_check = None
+        self._last_batch_t = None
         self._metrics_httpd = None
         # exposition identity: the serving metric families are process-
         # wide, so two Servers in one process must not overwrite each
         # other's samples — each mirrors under its own label value
         self._metrics_id = f"srv{next(_server_seq)}"
         self.counters = {"accepted": 0, "served": 0, "shed": 0,
-                         "rejected_shape": 0, "deadline_miss_dequeue": 0,
+                         "rejected_shape": 0, "rejected_stopped": 0,
+                         "cancelled": 0, "deadline_miss_dequeue": 0,
                          "deadline_miss_post_batch": 0, "errors": 0,
                          "reloads": 0, "batches": 0}
 
@@ -162,6 +171,8 @@ class Server:
         if self._worker is not None and self._worker.is_alive():
             return self
         self._stopping.clear()
+        with self._admit_lock:
+            self._closed = False
         # serving_start opens the journal's "last run" window BEFORE the
         # initial reload so that reload is attributed to this run
         get_journal().event("serving_start", config=self.config.summary(),
@@ -175,10 +186,16 @@ class Server:
     def stop(self, timeout_s=30.0, drain=True):
         """Shut down: with ``drain`` the worker finishes everything
         admitted before the sentinel; without, pending requests fail
-        with a structured 'server stopped' error.  Bounded join — a
-        wedged device can't hang the caller past ``timeout_s``."""
+        with a structured :class:`ServerStopped`.  Admission closes
+        FIRST — before the drain deadline starts — so a submit racing
+        this call either lands ahead of the sentinel (and is served or
+        failed structurally) or raises :class:`ServerStopped`; it can
+        never be silently dropped.  Bounded join — a wedged device
+        can't hang the caller past ``timeout_s``."""
         if self._worker is None:
             return
+        with self._admit_lock:
+            self._closed = True
         if not drain:
             self._stopping.set()
         try:
@@ -191,6 +208,12 @@ class Server:
             self._metrics_httpd.server_close()   # release the socket too
             self._metrics_httpd = None
         stuck = self._worker.is_alive()
+        if not stuck:
+            # straggler sweep: anything still queued after the worker
+            # exited (the drain=False path, or a sentinel that couldn't
+            # be enqueued) fails structurally under the admission lock
+            with self._admit_lock:
+                self._fail_remaining([], why="straggler")
         get_journal().event("serving_stop", drained=bool(drain),
                             stuck=stuck, **self.stats())
         if stuck:
@@ -200,10 +223,14 @@ class Server:
         self._worker = None
 
     # -- client surface ------------------------------------------------------
-    def submit(self, x, deadline_ms=None) -> PendingResponse:
+    def submit(self, x, deadline_ms=None, cancel=None) -> PendingResponse:
         """Admit one sample (NO batch axis).  Raises
-        :class:`RequestError` for a shape outside the bucket grid and
-        :class:`ServerOverloaded` when the bounded queue is full."""
+        :class:`RequestError` for a shape outside the bucket grid,
+        :class:`ServerOverloaded` when the bounded queue is full, and
+        :class:`ServerStopped` once ``stop()`` has closed admission.
+        ``cancel`` (a ``threading.Event``) is checked at dequeue — the
+        hedging router sets it on the losing attempt so a request whose
+        twin already answered never spends a batch slot."""
         payload = np.asarray(x, dtype=self._dtype)
         key = self.grid.feature_key(payload.shape)
         if key is None:
@@ -211,15 +238,18 @@ class Server:
                 self.counters["rejected_shape"] += 1
             get_journal().event("serving_reject", shape=list(payload.shape),
                                 grid=repr(self.grid))
-            raise RequestError(
+            err = RequestError(
                 f"request shape {tuple(payload.shape)} exceeds the bucket "
                 f"grid {self.grid!r} — oversized inputs are rejected, "
                 "never compiled")
+            err.retryable = False      # every replica shares the grid
+            raise err
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
         deadline_s = None if deadline_ms is None or deadline_ms <= 0 \
             else deadline_ms / 1000.0
-        req = Request(payload, payload.shape, key, deadline_s=deadline_s)
+        req = Request(payload, payload.shape, key, deadline_s=deadline_s,
+                      cancel=cancel)
         # one linked span tree per request (docs/observability.md):
         # the root opens here and is closed by whichever thread resolves
         # the request; the worker's batch span links back via span IDs.
@@ -231,7 +261,10 @@ class Server:
             req.trace = _trace.start_span("serving_request",
                                           shape=list(payload.shape))
         try:
-            self._queue.put_nowait(req)
+            with self._admit_lock:
+                stopped = self._closed
+                if not stopped:
+                    self._queue.put_nowait(req)
         except queue.Full:
             with self._lock:
                 self.counters["shed"] += 1
@@ -241,6 +274,13 @@ class Server:
             _end_span(req, "shed")
             raise ServerOverloaded(self._queue.qsize(),
                                    self.config.max_queue) from None
+        if stopped:
+            with self._lock:
+                self.counters["rejected_stopped"] += 1
+            get_journal().event("serving_stopped_reject",
+                                stage="admission", **_req_ids(req))
+            _end_span(req, "stopped")
+            raise ServerStopped("server is stopping")
         if traced:
             _trace.event("enqueue", parent=req.trace,
                          depth=self._queue.qsize())
@@ -252,14 +292,34 @@ class Server:
         """Synchronous convenience: submit + wait."""
         return self.submit(x, deadline_ms=deadline_ms).result(timeout_s)
 
+    def queue_depth(self) -> int:
+        """Current admission-queue depth (approximate, lock-free) — the
+        replica pool's drain-wait and readiness beacon read it."""
+        return self._queue.qsize()
+
     def stats(self) -> dict:
         with self._lock:
             counters = dict(self.counters)
-        return {"queue_depth": self._queue.qsize(),
+        t = self._last_batch_t
+        return {"queue_depth": self.queue_depth(),
                 "params_step": self._params_step,
+                "last_batch_age_s": None if t is None
+                else round(time.monotonic() - t, 3),
                 "cache": self.cache.stats(),
                 "latency_ms": self.latency.summary(),
                 **counters}
+
+    def beacon(self) -> dict:
+        """Cheap readiness facts for a replica-pool heartbeat payload
+        (serving/pool.py): no percentile math, no cache lock — safe to
+        call from a beacon thread several times a second."""
+        t = self._last_batch_t
+        alive = self._worker is not None and self._worker.is_alive()
+        return {"queue_depth": self.queue_depth(),
+                "params_step": self._params_step,
+                "last_batch_age_s": None if t is None
+                else round(time.monotonic() - t, 3),
+                "ready": alive and not self._closed}
 
     # -- metrics exposition (docs/observability.md) --------------------------
     def metrics_text(self) -> str:
@@ -285,6 +345,7 @@ class Server:
                        "serving lifecycle counters (cumulative)",
                        ("server", "event"))
         for k in ("accepted", "served", "shed", "rejected_shape",
+                  "rejected_stopped", "cancelled",
                   "deadline_miss_dequeue", "deadline_miss_post_batch",
                   "errors", "reloads", "batches"):
             ev.labels(server=sid, event=k).set(st[k])
@@ -369,9 +430,27 @@ class Server:
     def _flush(self, pending):
         """Expire, group, and run one micro-batch off ``pending``."""
         drop_expired(pending, self._on_dequeue_expired)
+        self._drop_cancelled(pending)
         batch, bucket, key = take_batch(pending, self.grid)
         if batch:
             self._process(batch, bucket, key)
+
+    def _drop_cancelled(self, pending):
+        """The dequeue half of hedging: a request whose cancel event is
+        set (its twin already answered) is resolved with
+        :class:`RequestCancelled` instead of spending a batch slot."""
+        keep = []
+        for req in pending:
+            if req.cancelled():
+                with self._lock:
+                    self.counters["cancelled"] += 1
+                get_journal().event("serving_cancelled", **_req_ids(req))
+                _end_span(req, "cancelled")
+                req.set_error(RequestCancelled(
+                    "cancelled at dequeue (hedged twin already answered)"))
+            else:
+                keep.append(req)
+        pending[:] = keep
 
     def _on_dequeue_expired(self, req):
         late = req.late_ms()
@@ -382,7 +461,7 @@ class Server:
         _end_span(req, "deadline_miss_dequeue")
         req.set_error(DeadlineExceeded("dequeue", late))
 
-    def _fail_remaining(self, pending):
+    def _fail_remaining(self, pending, why="stopped"):
         while True:
             try:
                 item = self._queue.get_nowait()
@@ -391,9 +470,13 @@ class Server:
             if item is not _STOP:
                 pending.append(item)
         for req in pending:
+            with self._lock:
+                self.counters["rejected_stopped"] += 1
+            get_journal().event("serving_stopped_reject", stage=why,
+                                **_req_ids(req))
             _end_span(req, "stopped")
-            req.set_error(RequestError("server stopped before this "
-                                       "request was served"))
+            req.set_error(ServerStopped("server stopped before this "
+                                        "request was served"))
         pending.clear()
 
     def _process(self, batch, bucket, key):
@@ -419,12 +502,19 @@ class Server:
         try:
             # a cache miss's first call traces + compiles the padded
             # shape: the timed compile event for this jit-miss site
+            def _run_predictor(p):
+                # chaos seam: faults.slow_call("serving_predict", ...)
+                # injects device latency here, faults.io_error rides the
+                # same retry path as a real transient device error
+                _atomic.trip("serving_predict", self._metrics_id)
+                return predictor(p)
+
             with _obs.maybe_compile_span(
                     not hit, "serving_predictor", bucket=bucket,
                     key=list(key), dtype=self._dtype.str,
                     includes_execute=True):
                 outs, treedef = retry_call(
-                    predictor, padded, retries=cfg.device_retries,
+                    _run_predictor, padded, retries=cfg.device_retries,
                     retry_on=cfg.transient_errors, what="serving_predict")
             outs = [np.asarray(o) for o in outs]
         except Exception as exc:
@@ -472,9 +562,11 @@ class Server:
                               bucket=bucket)
                 _trace.event("respond", parent=req.trace)
             _end_span(req, "ok")
+            req.params_step = self._params_step    # version stamp
             req.set_result(result, now)
             delivered += 1
             self.latency.observe((now - req.enq_t) * 1000.0)
+        self._last_batch_t = time.monotonic()
         with self._lock:
             self.counters["served"] += delivered
             self.counters["batches"] += 1

@@ -1,0 +1,275 @@
+"""Replica worker — one :class:`~.server.Server` behind a socket front.
+
+``python -m mxnet_tpu.serving worker --replica-id r0 --hb-dir POOL/hb``
+runs a full serving replica as its own PROCESS: its own bounded queue,
+its own PredictorCache, its own hot-reload ``ParamStore`` — the unit the
+replica pool (serving/pool.py) multiplexes and the chaos tests SIGKILL.
+
+Contract with the pool (docs/serving.md):
+
+- the worker binds a loopback TCP socket (``--port 0`` picks a free
+  one) and publishes the bound port in its heartbeat payload — the
+  readiness beacon (``elastic.membership.Heartbeat``) is the ONE
+  discovery channel, so the router's view of a replica is exactly what
+  the ledger says, uniform across router threads;
+- the beacon carries ``ready`` (started, not draining), ``queue_depth``,
+  ``params_step`` (current commit root), ``last_batch_age_s``, ``pid``;
+- requests arrive as wire frames (serving/wire.py); failures map onto
+  the structured serving errors with a ``retryable`` verdict the router
+  honors;
+- ``drain`` closes admission at the front door (beacon flips to
+  not-ready), lets the queue empty under a bounded deadline, and
+  reports the residual; ``stop`` shuts the server down and exits 0.
+
+Chaos seam: ``MXNET_TPU_TESTING_SLOW_PREDICT_S=<s>`` installs a
+``faults.slow_call("serving_predict", s)`` plan at startup — the
+slow-replica shape for hedging/breaker drills, injected in the worker
+process where a real slow device would live.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+
+import numpy as np
+
+from ..diagnostics.journal import get_journal
+from . import wire
+
+__all__ = ["add_worker_args", "cmd_worker"]
+
+
+def _build_block(model: str, dim: int):
+    from ..gluon import nn
+    from ..gluon.block import HybridBlock
+
+    if model == "scale":
+        class Scale(HybridBlock):
+            """y = x * w, scalar weight: shape-agnostic (one program per
+            bucket), padding-exact, and the weight VALUE doubles as the
+            served checkpoint's fingerprint in chaos tests."""
+
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                with self.name_scope():
+                    self.w = self.params.get("w", shape=(1,), init="ones")
+
+            def hybrid_forward(self, F, x, w):
+                return x * w
+
+        net = Scale()
+    elif model == "mlp":
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(32, activation="relu", in_units=dim))
+            net.add(nn.Dense(8, in_units=32))
+    else:
+        raise ValueError(f"unknown worker model {model!r} "
+                         "(scale|mlp)")
+    net.initialize()
+    return net
+
+
+def _error_doc(exc) -> dict:
+    doc = {"ok": False, "error": type(exc).__name__,
+           "retryable": bool(getattr(exc, "retryable", True)),
+           "detail": str(exc)[:300]}
+    for attr in ("stage", "late_ms", "depth", "limit", "tier"):
+        v = getattr(exc, attr, None)
+        if v is not None:
+            doc[attr] = v
+    return doc
+
+
+class _Front:
+    """The socket front door: accept loop + per-connection handlers,
+    every wait bounded (accept timeout, per-socket recv timeouts)."""
+
+    def __init__(self, server, args):
+        self.server = server
+        self.args = args
+        self.stop_evt = threading.Event()
+        self.draining = False
+        self.sock = socket.create_server(("127.0.0.1", args.port))
+        self.port = self.sock.getsockname()[1]
+        self.sock.settimeout(0.25)
+
+    def beacon(self) -> dict:
+        doc = self.server.beacon()
+        doc["port"] = self.port
+        doc["draining"] = self.draining
+        doc["ready"] = bool(doc["ready"]) and not self.draining \
+            and not self.stop_evt.is_set()
+        return doc
+
+    def run(self):
+        while not self.stop_evt.is_set():
+            try:
+                conn, _addr = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+        self.sock.close()
+
+    # -- per-connection --------------------------------------------------
+    def _handle(self, conn):
+        with conn:
+            conn.settimeout(10.0)          # header must arrive promptly
+            try:
+                header, payload = wire.recv_frame(conn)
+            except (OSError, wire.WireError):
+                return                     # peer vanished: nothing to say
+            try:
+                self._dispatch(conn, header, payload)
+            except (OSError, wire.WireError):
+                pass                       # reply path gone: request ends
+            except Exception as exc:       # defect, not traffic: journal
+                get_journal().crash(exc, where="replica_worker")
+                try:
+                    wire.send_frame(conn, _error_doc(exc))
+                except OSError:
+                    pass
+
+    def _dispatch(self, conn, header, payload):
+        from .batcher import RequestError
+        cmd = header.get("cmd")
+        if cmd == "predict":
+            self._predict(conn, header, payload)
+        elif cmd == "drain":
+            self.draining = True
+            deadline = float(header.get("deadline_s", 20.0))
+            residual = _wait_queue_empty(self.server, deadline)
+            wire.send_frame(conn, {"ok": True, "residual": residual})
+        elif cmd == "resume":
+            self.draining = False
+            wire.send_frame(conn, {"ok": True})
+        elif cmd == "stats":
+            st = json.loads(json.dumps(self.server.stats(), default=str))
+            wire.send_frame(conn, {"ok": True, "stats": st})
+        elif cmd == "ping":
+            wire.send_frame(conn, {"ok": True, "pid": os.getpid()})
+        elif cmd == "stop":
+            wire.send_frame(conn, {"ok": True})
+            self.stop_evt.set()
+        else:
+            wire.send_frame(conn, _error_doc(
+                RequestError(f"unknown command {cmd!r}")))
+
+    def _predict(self, conn, header, payload):
+        from .batcher import RequestError, ServerStopped
+        if self.draining or self.stop_evt.is_set():
+            err = ServerStopped("replica draining")
+            wire.send_frame(conn, _error_doc(err))
+            return
+        x = np.frombuffer(payload, dtype=header["dtype"]).reshape(
+            header["shape"])
+        deadline_ms = header.get("deadline_ms")
+        budget_s = (deadline_ms / 1000.0 if deadline_ms
+                    else self.server.config.result_timeout_s)
+        conn.settimeout(budget_s + 10.0)
+        try:
+            resp = self.server.submit(x, deadline_ms=deadline_ms)
+            out = np.asarray(resp.result(timeout_s=budget_s + 5.0))
+        except RequestError as exc:
+            wire.send_frame(conn, _error_doc(exc))
+            return
+        if not isinstance(out, np.ndarray):
+            err = RequestError("replica model returned a non-array tree; "
+                               "the wire protocol ships single arrays")
+            err.retryable = False
+            wire.send_frame(conn, _error_doc(err))
+            return
+        wire.send_frame(
+            conn,
+            {"ok": True, "shape": list(out.shape), "dtype": str(out.dtype),
+             "params_step": resp.params_step},
+            np.ascontiguousarray(out).tobytes())
+
+
+def _wait_queue_empty(server, deadline_s, poll_s=0.02) -> int:
+    """Bounded drain wait: poll until the admission queue is empty or
+    the deadline expires.  Returns the residual depth (0 = clean)."""
+    from .pool import _wait_for
+    _wait_for(lambda: server.queue_depth() == 0, deadline_s, poll_s)
+    return server.queue_depth()
+
+
+def add_worker_args(parser) -> None:
+    parser.add_argument("--replica-id", required=True)
+    parser.add_argument("--hb-dir", required=True,
+                        help="pool heartbeat ledger directory")
+    parser.add_argument("--heartbeat-s", type=float, default=0.5)
+    parser.add_argument("--port", type=int, default=0,
+                        help="0 = ephemeral; the bound port is published "
+                             "in the heartbeat beacon")
+    parser.add_argument("--model", default="scale", help="scale|mlp")
+    parser.add_argument("--dim", type=int, default=16)
+    parser.add_argument("--ckpt-root", default=None,
+                        help="resilience.commit root for hot-reload")
+    parser.add_argument("--max-batch", type=int, default=8)
+    parser.add_argument("--window-ms", type=float, default=2.0)
+    parser.add_argument("--max-queue", type=int, default=64)
+    parser.add_argument("--deadline-ms", type=float, default=2000.0)
+    parser.add_argument("--reload-poll-s", type=float, default=0.5)
+
+
+def cmd_worker(args) -> int:
+    from ..elastic.membership import Heartbeat
+    from .reload import ParamStore
+    from .server import Server, ServerConfig
+
+    j = get_journal()
+    j.set_phase("replica_worker_setup")
+
+    slow_s = os.environ.get("MXNET_TPU_TESTING_SLOW_PREDICT_S")
+    if slow_s:
+        from ..resilience import atomic
+        from ..testing import faults
+        atomic.set_fault_hook(faults.FaultPlan(
+            faults.slow_call("serving_predict", float(slow_s))))
+
+    net = _build_block(args.model, args.dim)
+    cfg = ServerConfig(max_batch=args.max_batch, window_ms=args.window_ms,
+                       max_queue=args.max_queue,
+                       default_deadline_ms=args.deadline_ms,
+                       reload_poll_s=args.reload_poll_s)
+    store = ParamStore(args.ckpt_root) if args.ckpt_root else None
+    server = Server(net, config=cfg, param_store=store).start()
+
+    front = _Front(server, args)
+    hb = Heartbeat(args.hb_dir, args.replica_id, args.heartbeat_s,
+                   payload=front.beacon, prefix="replica").start()
+    j.event("replica_worker_start", replica=args.replica_id,
+            port=front.port, model=args.model, pid=os.getpid())
+
+    # a pool-side terminate (restart fallback) should still drain:
+    # flip the stop event and let the main loop run the clean shutdown
+    signal.signal(signal.SIGTERM,
+                  lambda signum, frame: front.stop_evt.set())
+
+    j.set_phase("replica_worker_serve")
+    try:
+        front.run()
+    finally:
+        j.set_phase("replica_worker_stop")
+        try:
+            server.stop(timeout_s=30.0)
+        finally:
+            hb.stop(resign=True)
+        j.event("replica_worker_stop", replica=args.replica_id)
+    return 0
+
+
+if __name__ == "__main__":          # direct module run (pool uses -m ..serving)
+    ap = argparse.ArgumentParser()
+    add_worker_args(ap)
+    sys.exit(cmd_worker(ap.parse_args()))

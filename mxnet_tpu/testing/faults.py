@@ -34,13 +34,14 @@ from __future__ import annotations
 import contextlib
 import os
 import signal
+import time
 
 from ..resilience import atomic
 
 __all__ = ["CRASH_POINTS", "FaultError", "FaultPlan", "FaultRule",
            "PoisonSchedule", "SimulatedCrash", "crash", "inject",
            "io_error", "poison_batch", "poison_grads", "sigkill",
-           "sigterm", "write_offsets"]
+           "sigterm", "slow_call", "torn_heartbeat", "write_offsets"]
 
 # every phase of one atomic file write, in order — plus the commit
 # protocol's own points (publish = the step-dir rename commit point)
@@ -70,17 +71,19 @@ class FaultError(OSError):
 
 
 class FaultRule:
-    """One trigger: fire ``exc_factory`` when ``point`` (and optional
-    path substring / cumulative-byte threshold) matches, at most
-    ``times`` times (None = always)."""
+    """One trigger: when ``point`` (and optional path substring /
+    cumulative-byte threshold) matches, raise ``exc_factory`` — or,
+    for non-failing faults (injected latency, torn-file surgery), run
+    ``action`` instead. Fires at most ``times`` times (None = always)."""
 
     def __init__(self, point, exc_factory, path_part=None,
-                 after_bytes=None, times=None):
+                 after_bytes=None, times=None, action=None):
         self.point = point
         self.exc_factory = exc_factory
         self.path_part = path_part
         self.after_bytes = after_bytes
         self.times = times
+        self.action = action
         self.fired = 0
 
     def matches(self, point, path, nbytes, size):
@@ -100,6 +103,9 @@ class FaultRule:
 
     def fire(self, point, path, nbytes):
         self.fired += 1
+        if self.exc_factory is None:
+            self.action(point, path, nbytes)
+            return
         raise self.exc_factory(point, path, nbytes)
 
 
@@ -119,6 +125,35 @@ def io_error(point, path_part=None, times=1) -> FaultRule:
                      path_part=path_part, times=times)
 
 
+def slow_call(site, delay_s, path_part=None, times=None) -> FaultRule:
+    """Inject ``delay_s`` of latency at a named trip site (e.g. the
+    server's ``serving_predict`` or the pool router's ``router_attempt``,
+    whose path carries the replica id — ``path_part`` targets one
+    replica). Nothing fails, everything is just late: the slow-replica
+    chaos shape that tail-latency hedging and circuit breakers must
+    route around (docs/serving.md failure matrix)."""
+    return FaultRule(site, None, path_part=path_part, times=times,
+                     action=lambda p, f, n: time.sleep(delay_s))
+
+
+def torn_heartbeat(path_part="hb/", keep_bytes=7, times=1) -> FaultRule:
+    """Tear the next matching heartbeat publish: truncate the staged
+    temp file to ``keep_bytes`` just before the rename lands, so the
+    seq file holds a partial JSON prefix — the shape a non-atomic
+    writer, a full disk, or a dying NFS client produces. Liveness
+    readers must degrade (the member reads as stale until a whole
+    record lands) and never crash (docs/elastic.md)."""
+    def _tear(point, path, nbytes):
+        tmp = f"{path}{atomic._TMP_MARK}{os.getpid()}"
+        try:
+            with open(tmp, "r+b") as f:
+                f.truncate(int(keep_bytes))
+        except OSError:
+            pass                 # no temp staged: nothing to tear
+    return FaultRule("replace", None, path_part=path_part, times=times,
+                     action=_tear)
+
+
 class FaultPlan:
     """The installed hook: first matching rule fires; every firing is
     recorded in ``log`` for assertions."""
@@ -132,6 +167,7 @@ class FaultPlan:
             if rule.matches(point, path, nbytes, size):
                 self.log.append((point, path, nbytes))
                 rule.fire(point, path, nbytes)
+                return
 
 
 @contextlib.contextmanager

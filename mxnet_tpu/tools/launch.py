@@ -54,7 +54,9 @@ def launch_local(args, command):
     import time
     coord = f"127.0.0.1:{args.port}"
     attempts = 0
-    while True:
+    # bounded by the restart budget: the body returns 1 past
+    # --max-restarts, so the condition is the loop's honest contract
+    while attempts <= args.max_restarts:
         procs = [subprocess.Popen(
             command, env=dict(worker_env(r, args.num_workers, coord),
                               MXTPU_RESTART=str(attempts)))
@@ -69,16 +71,15 @@ def launch_local(args, command):
 
         # heartbeat loop: poll liveness; one dead worker fails the job
         # (dist_sync semantics — the reference's dist_sync also cannot
-        # survive a lost worker; recovery = relaunch from checkpoint)
+        # survive a lost worker; recovery = relaunch from checkpoint).
+        # Bounded by child liveness, not a while-True spin (G13): the
+        # loop ends when every worker has exited or the first fails.
         failed = False
-        while True:
+        codes = [None] * len(procs)
+        while any(c is None for c in codes) and not failed:
             time.sleep(args.heartbeat_interval)
             codes = [p.poll() for p in procs]
-            if any(c is not None and c != 0 for c in codes):
-                failed = True
-                break
-            if all(c == 0 for c in codes):
-                break
+            failed = any(c is not None and c != 0 for c in codes)
         if not failed:
             return 0
         for p in procs:
@@ -95,6 +96,7 @@ def launch_local(args, command):
               f"(attempt {attempts}/{args.max_restarts}, scripts resume "
               f"from their checkpoints; MXTPU_RESTART={attempts})",
               file=sys.stderr)
+    return 1         # --max-restarts < 0: nothing was ever launched
 
 
 def launch_ssh(args, command):

@@ -1,0 +1,107 @@
+# graftlint: scope=library
+"""G13 fixture: unbounded while-True poll loops (time.sleep with no
+deadline/budget check inside the loop) — the router/breaker/drain
+wait-loop hazard class.  Parsed only, never executed."""
+import time
+from time import sleep
+
+
+def bad_poll_forever(flag):
+    while True:  # expect: G13
+        if flag():
+            break
+        time.sleep(0.05)
+
+
+def bad_while_one(q):
+    while 1:  # expect: G13
+        sleep(0.1)
+        if q.empty():
+            break
+
+
+def bad_deadline_outside_loop(flag):
+    # the deadline EXISTS but the loop never checks it: still unbounded
+    deadline = time.monotonic() + 5.0
+    _stamp(deadline)
+    while True:  # expect: G13
+        if flag():
+            break
+        time.sleep(0.05)
+
+
+def good_clock_compare_in_loop(flag):
+    deadline = time.monotonic() + 5.0
+    while True:
+        if flag():
+            return True
+        if time.monotonic() > deadline:
+            raise TimeoutError("poll budget exhausted")
+        time.sleep(0.05)
+
+
+def good_elapsed_compare(flag):
+    t0 = time.monotonic()
+    while True:
+        if flag():
+            return True
+        if time.monotonic() - t0 > 5.0:
+            return False
+        time.sleep(0.05)
+
+
+def good_deadline_names_only(flag):
+    deadline = time.monotonic() + 5.0
+    while True:
+        now = time.monotonic()
+        if now > deadline:
+            return False
+        if flag():
+            return True
+        time.sleep(0.05)
+
+
+def good_bounded_condition(flag):
+    # not a while-True: the loop condition itself is the budget
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if flag():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def good_no_sleep(q):
+    # event-driven consumption with bounded gets is not a poll loop
+    while True:
+        item = q.get(timeout=1.0)
+        if item is None:
+            break
+
+
+def good_nested_function_owns_its_sleep(flag):
+    # the sleep lives in a nested function with its own budget story
+    def poll_once():
+        time.sleep(0.05)
+        return flag()
+
+    while True:
+        if poll_once():
+            break
+        if time.monotonic() > _deadline():
+            break
+
+
+def suppressed(flag):
+    while True:  # graftlint: disable=G13 fixture twin
+        if flag():
+            break
+        time.sleep(0.05)
+
+
+def _stamp(ts):
+    return ts
+
+
+def _deadline():
+    return 0.0

@@ -29,8 +29,9 @@ from mxnet_tpu.gluon.block import HybridBlock
 from mxnet_tpu.metric import LatencySummary
 from mxnet_tpu.resilience import commit
 from mxnet_tpu.serving import (BucketGrid, DeadlineExceeded, ParamStore,
-                               PredictorCache, RequestError, Server,
-                               ServerConfig, ServerOverloaded,
+                               PredictorCache, RequestCancelled,
+                               RequestError, Server, ServerConfig,
+                               ServerOverloaded, ServerStopped,
                                serving_report)
 from mxnet_tpu.serving.batcher import Request, drop_expired, take_batch
 from mxnet_tpu.testing import faults
@@ -356,6 +357,99 @@ def test_transient_device_error_retried_then_fatal_is_structured():
     finally:
         server.stop(timeout_s=30)
     assert server.stats()["errors"] == 1
+
+
+def test_stop_closes_admission_and_fails_stragglers_structured(
+        journal_file):
+    """The stop() drain race (docs/serving.md): a submit racing stop()
+    must either be served, shed, or fail with a structured
+    ServerStopped — NEVER ride out the caller's result timeout as a
+    silently dropped request.  Admission closes before the drain
+    deadline starts; stragglers are swept after the worker exits."""
+    net = Scale()
+    net.initialize()
+    cfg = ServerConfig(max_batch=4, window_ms=1.0, max_queue=64)
+    server = Server(net, config=cfg).start()
+    x = np.ones(3, np.float32)
+    server.predict(x)                    # warm: the race window is tight
+    outcomes, bad = [], []
+    go = threading.Event()
+
+    def hammer():
+        go.wait(timeout=10)
+        while True:
+            try:
+                resp = server.submit(x)
+            except ServerStopped:
+                outcomes.append("stopped_at_submit")
+                return
+            except ServerOverloaded:
+                outcomes.append("shed")
+                continue
+            try:
+                # MUST resolve promptly: served or structured error —
+                # a generic result-timeout here is the dropped-request
+                # bug this test pins
+                resp.result(timeout_s=5)
+                outcomes.append("served")
+            except ServerStopped:
+                outcomes.append("stopped_in_queue")
+            except DeadlineExceeded:
+                outcomes.append("deadline")
+            except RequestError as e:
+                bad.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    go.set()
+    time.sleep(0.05)                     # overlap stop() with admission
+    server.stop(timeout_s=30)
+    for t in threads:
+        t.join(timeout=30)
+    assert not bad, f"unstructured outcomes: {bad[:3]}"
+    assert "served" in outcomes
+    assert any(o.startswith("stopped") for o in outcomes)
+    # post-stop: admission stays closed, structurally
+    with pytest.raises(ServerStopped):
+        server.submit(x)
+    assert server.stats()["rejected_stopped"] >= 1
+    recs = _records(journal_file, "serving_stopped_reject")
+    assert recs and all(r["stage"] in ("admission", "straggler", "stopped")
+                        for r in recs)
+    # and a restart reopens admission cleanly
+    server.start()
+    try:
+        np.testing.assert_allclose(np.asarray(server.predict(x)), x)
+    finally:
+        server.stop(timeout_s=30)
+
+
+def test_cancel_event_drops_request_at_dequeue(journal_file):
+    """A request whose cancel event is set before dequeue resolves with
+    RequestCancelled and never spends a batch slot (the hedging loser
+    contract, serving/router.py)."""
+    net = Gated()
+    cfg = ServerConfig(max_batch=1, window_ms=1.0, max_queue=8)
+    server = Server(net, config=cfg).start()
+    try:
+        first = server.submit(np.ones(2, np.float32))  # wedges worker
+        assert net.entered.wait(timeout=30)
+        cancel = threading.Event()
+        loser = server.submit(np.ones(2, np.float32), cancel=cancel)
+        cancel.set()
+        net.gate.set()
+        np.testing.assert_allclose(np.asarray(first.result(timeout_s=30)),
+                                   np.ones(2) * 2.0)
+        with pytest.raises(RequestCancelled):
+            loser.result(timeout_s=30)
+    finally:
+        net.gate.set()
+        server.stop(timeout_s=30)
+    assert server.stats()["cancelled"] == 1
+    assert _records(journal_file, "serving_cancelled")
 
 
 # -- predictor-cache keying ---------------------------------------------------

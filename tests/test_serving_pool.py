@@ -1,0 +1,714 @@
+"""Fault-tolerant replica-pool serving (docs/serving.md failure matrix).
+
+The headline chaos drill (CI tier 0.5, ``-k smoke``): SIGKILL one of
+three REAL replica worker processes under closed-loop load and prove the
+router detects it within the heartbeat deadline, in-flight requests are
+retried on survivors inside their deadline budget, zero corrupt
+responses escape, shed-rate stays under the ceiling, and the respawned
+replica is re-admitted through a half-open breaker probe — every
+transition trace-correlated in the journal and summarized by
+``doctor --serving-journal``.
+
+Around it: router placement/retry/breaker/half-open drills on cheap
+in-process replicas, tail-latency hedging with loser-cancelled-at-
+dequeue, capacity-floor degradation by admission class, the rolling
+``pool.reload()`` version-stamp contract while a new commit root lands
+mid-roll, and the ``slow_call``/``torn_heartbeat`` fault hooks.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.diagnostics.journal import reset_journal
+from mxnet_tpu.elastic.membership import Heartbeat, LivenessReader
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.resilience import commit
+from mxnet_tpu.serving import (ParamStore, PoolConfig, ReplicaPool,
+                               Router, RouterConfig, Server, ServerConfig,
+                               ServerOverloaded, serving_report)
+from mxnet_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    reset_journal(path)
+    try:
+        yield path
+    finally:
+        reset_journal("stderr")
+
+
+def _records(path, kind=None):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+class Scale(HybridBlock):
+    """y = x * w: shape-agnostic, and the weight value IS the served
+    checkpoint's fingerprint (version-stamp assertions ride it)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.w = self.params.get("w", shape=(1,), init="ones")
+
+    def hybrid_forward(self, F, x, w):
+        return x * w
+
+
+def _commit_scale(root, step, value):
+    stage = commit.prepare_stage(root, step)
+    nd.save(os.path.join(stage, "net.params"),
+            {"w": nd.array(np.asarray([value], np.float32))})
+    return commit.finalize(root, step)
+
+
+def _local_pool(root, n=3, ckpt_root=None, heartbeat_s=0.1,
+                deadline_s=0.6, **server_kw):
+    server_kw.setdefault("max_batch", 4)
+    server_kw.setdefault("window_ms", 1.0)
+
+    def factory():
+        net = Scale()
+        net.initialize()
+        store = ParamStore(ckpt_root) if ckpt_root else None
+        return Server(net, config=ServerConfig(**server_kw),
+                      param_store=store)
+
+    pool = ReplicaPool(root, PoolConfig(heartbeat_s=heartbeat_s,
+                                        deadline_s=deadline_s))
+    for i in range(n):
+        pool.add_local(f"r{i}", factory)
+    return pool
+
+
+# -- fault hooks (satellite: testing/faults) ---------------------------------
+
+def test_torn_heartbeat_reader_degrades_then_revives(tmp_path):
+    """A torn (partially written) heartbeat file must read as a stale
+    member — never a reader crash, never a fresh liveness grant — and
+    the next whole beat revives it."""
+    hb = Heartbeat(str(tmp_path), "r0", 0.05,
+                   payload=lambda: {"ready": True}, prefix="replica")
+    rd = LivenessReader(str(tmp_path), deadline_s=0.25, prefix="replica")
+    hb.beat()
+    assert rd.alive("r0") and rd.payload("r0")["ready"] is True
+    with faults.inject(faults.torn_heartbeat(
+            path_part="replica-r0")) as plan:
+        hb.beat()
+    assert plan.log, "torn-heartbeat rule never fired"
+    raw = open(hb.path, "rb").read()
+    assert len(raw) == 7              # a real partial-write prefix
+    assert rd.alive("r0")             # first torn read: grace, not crash
+    # stale payload survives a torn write (degrade, don't forget) ...
+    assert rd.payload("r0")["ready"] is True
+    time.sleep(0.4)
+    # ... but no whole record lands: the member goes stale
+    assert not rd.alive("r0")
+    hb.beat()
+    assert rd.alive("r0")
+
+
+def test_torn_heartbeat_resignation_drops_stale_payload(tmp_path):
+    """A resigned member (file unlinked) must not keep advertising its
+    last beacon — the stale-port bug class."""
+    hb = Heartbeat(str(tmp_path), "r1", 0.05,
+                   payload=lambda: {"port": 1234}, prefix="replica")
+    rd = LivenessReader(str(tmp_path), deadline_s=0.25, prefix="replica")
+    hb.beat()
+    rd.observe("r1")
+    assert rd.payload("r1")["port"] == 1234
+    hb.stop(resign=True)
+    rd.observe("r1")
+    assert rd.payload("r1") is None
+
+
+def test_slow_call_injects_latency_at_trip_site():
+    from mxnet_tpu.resilience import atomic
+    t0 = time.monotonic()
+    with faults.inject(faults.slow_call("router_attempt", 0.2,
+                                        path_part="rX")):
+        atomic.trip("router_attempt", "rX")       # matches: sleeps
+        atomic.trip("router_attempt", "rY")       # no match: instant
+        atomic.trip("serving_predict", "rX")      # other site: instant
+    assert 0.2 <= time.monotonic() - t0 < 1.0
+
+
+def test_pool_config_validation():
+    with pytest.raises(MXNetError):
+        PoolConfig(heartbeat_s=2.0, deadline_s=1.0)
+    with pytest.raises(MXNetError):
+        PoolConfig(surge=0)
+
+
+# -- router over in-process replicas -----------------------------------------
+
+def test_router_routes_live_ready_least_loaded(tmp_path, journal_file):
+    pool = _local_pool(str(tmp_path / "pool"), n=3).start()
+    router = Router(pool, RouterConfig(retries=2))
+    x = np.arange(4, dtype=np.float32)
+    try:
+        for _ in range(24):
+            resp = router.call(x)
+            np.testing.assert_allclose(resp.value, x, atol=1e-6)
+            assert resp.replica in pool.replicas
+            assert resp.attempts == 1
+    finally:
+        router.stop()
+        pool.stop()
+    st = router.stats()
+    assert st["served"] == 24 and st["failures"] == 0
+    # placement spread: ledger-derived least-loaded + rotation must not
+    # pin every request to one replica
+    used = [r for r, row in st["replicas"].items() if row["attempts"]]
+    assert len(used) >= 2
+
+
+def test_router_retries_breaker_opens_and_halfopen_readmits(
+        tmp_path, journal_file):
+    """The in-process twin of the chaos headline: one replica starts
+    failing every request -> retries land on survivors within budget,
+    K consecutive failures open its breaker (requests stop routing
+    there), and after the cooldown a half-open probe re-admits it."""
+    pool = _local_pool(str(tmp_path / "pool"), n=2).start()
+    cfg = RouterConfig(retries=2, breaker_k=2, breaker_cooldown_s=0.4)
+    router = Router(pool, cfg)
+    x = np.arange(3, dtype=np.float32)
+    r0 = pool.replicas["r0"]
+    real_get = r0.server.cache.get
+
+    class Broken:
+        def __call__(self, padded):
+            raise ValueError("injected permanent predictor fault")
+
+    r0.server.cache.get = lambda key, builder: (Broken(), True)
+    try:
+        # drive until r0's breaker opens; every request still succeeds
+        # via the survivor within its own deadline
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            resp = router.call(x, deadline_ms=5000)
+            np.testing.assert_allclose(resp.value, x, atol=1e-6)
+            assert resp.replica == "r1"
+            if router.stats()["replicas"]["r0"]["breaker"] == "open":
+                break
+        st = router.stats()
+        assert st["replicas"]["r0"]["breaker"] == "open"
+        assert st["retries"] >= 1
+        # while open, traffic does not touch r0
+        before = st["replicas"]["r0"]["attempts"]
+        for _ in range(6):
+            router.call(x)
+        assert router.stats()["replicas"]["r0"]["attempts"] == before
+        # heal the replica, wait out the cooldown: half-open probe
+        # re-admits it
+        r0.server.cache.get = real_get
+        time.sleep(cfg.breaker_cooldown_s + 0.1)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            router.call(x)
+            if router.stats()["replicas"]["r0"]["breaker"] == "closed":
+                break
+        assert router.stats()["replicas"]["r0"]["breaker"] == "closed"
+        assert router.stats()["readmissions"] == 1
+    finally:
+        r0.server.cache.get = real_get
+        router.stop()
+        pool.stop()
+    # journaled transition trail: closed -> open -> half_open -> closed
+    trans = [(r["frm"], r["to"], r["reason"])
+             for r in _records(journal_file, "router_breaker")
+             if r["replica"] == "r0"]
+    assert ("closed", "open", "consecutive_failures") in trans
+    assert ("open", "half_open", "cooldown_elapsed") in trans
+    assert ("half_open", "closed", "probe_succeeded") in trans
+    assert _records(journal_file, "router_retry")
+
+
+def test_router_hedges_slow_replica_and_cancels_loser(
+        tmp_path, journal_file):
+    """Tail-latency hedging: a slow replica's attempt is hedged on a
+    fast one after the configured delay; the first response wins and
+    the loser is cancelled at dequeue (serving_cancelled journaled)."""
+    pool = _local_pool(str(tmp_path / "pool"), n=2).start()
+    router = Router(pool, RouterConfig(retries=1, hedge_ms=60.0))
+    x = np.arange(4, dtype=np.float32)
+    try:
+        with faults.inject(faults.slow_call("router_attempt", 0.5,
+                                            path_part="r0", times=None)):
+            for _ in range(8):
+                resp = router.call(x, deadline_ms=5000)
+                np.testing.assert_allclose(resp.value, x, atol=1e-6)
+        st = router.stats()
+        assert st["hedges"] >= 1
+        assert st["hedge_wins"] >= 1
+        time.sleep(0.7)                # let cancelled losers dequeue
+        cancelled = pool.replicas["r0"].server.stats()["cancelled"]
+        assert cancelled >= 1
+    finally:
+        router.stop()
+        pool.stop()
+    hedges = _records(journal_file, "router_hedge")
+    assert hedges and hedges[0]["primary"] == "r0" \
+        and hedges[0]["hedge"] == "r1"
+    assert _records(journal_file, "serving_cancelled")
+
+
+def test_router_routes_around_torn_heartbeat_replica(
+        tmp_path, journal_file):
+    """Torn-heartbeat chaos in the router matrix: when every beacon
+    write for one replica tears (non-atomic writer / full disk shape),
+    its seq never advances — the router treats it exactly like a
+    stalled replica (breaker opens on heartbeat_stall, traffic routes
+    to the survivor) and recovers once whole beats land again."""
+    pool = _local_pool(str(tmp_path / "pool"), n=2, heartbeat_s=0.05,
+                       deadline_s=0.3).start()
+    router = Router(pool, RouterConfig(retries=2,
+                                       breaker_cooldown_s=0.2))
+    x = np.arange(3, dtype=np.float32)
+    try:
+        with faults.inject(faults.torn_heartbeat(
+                path_part="replica-r0", times=None)):
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                resp = router.call(x, deadline_ms=4000)
+                np.testing.assert_allclose(resp.value, x, atol=1e-6)
+                if router.stats()["replicas"]["r0"]["breaker"] == "open":
+                    break
+                time.sleep(0.05)
+            assert router.stats()["replicas"]["r0"]["breaker"] == "open"
+            for _ in range(4):           # degraded: survivor-only
+                assert router.call(x).replica == "r1"
+        # whole beats resume: r0 revives through the half-open probe
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            router.call(x)
+            if router.stats()["replicas"]["r0"]["breaker"] == "closed":
+                break
+            time.sleep(0.05)
+        assert router.stats()["replicas"]["r0"]["breaker"] == "closed"
+    finally:
+        router.stop()
+        pool.stop()
+    opens = [r for r in _records(journal_file, "router_breaker")
+             if r["replica"] == "r0" and r["to"] == "open"]
+    assert opens and opens[0]["reason"] == "heartbeat_stall"
+
+
+def test_hedge_loser_releases_halfopen_probe_slot(tmp_path, journal_file):
+    """A half-open replica whose probe attempt LOSES a hedge race must
+    get its probe slot back — otherwise a healthy replica is silently
+    out of rotation forever (no transition, no timeout)."""
+    pool = _local_pool(str(tmp_path / "pool"), n=2).start()
+    router = Router(pool, RouterConfig(retries=1, hedge_ms=40.0,
+                                       breaker_cooldown_s=0.0))
+    x = np.arange(3, dtype=np.float32)
+    try:
+        router.predict(x)                  # warm both paths
+        # force r0 into open; cooldown 0 -> next pick goes half-open and
+        # its dispatch is the probe — which we make lose the hedge race
+        from mxnet_tpu.serving.router import OPEN
+        br = router._breaker("r0")
+        with router._lock:
+            router._transition("r0", br, OPEN, "test_forced")
+        with faults.inject(faults.slow_call("router_attempt", 0.5,
+                                            path_part="r0", times=None)):
+            deadline = time.monotonic() + 10
+            probed = False
+            while time.monotonic() < deadline and not probed:
+                resp = router.call(x, deadline_ms=5000)
+                np.testing.assert_allclose(resp.value, x, atol=1e-6)
+                probed = router.stats()["replicas"]["r0"]["breaker"] \
+                    != "open"
+            assert probed                  # half_open reached
+        # the slow probe lost (or will lose) its race; once its loser
+        # thread resolves, the slot must be free so r0 can be probed
+        # again and re-admitted
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                router.stats()["replicas"]["r0"]["breaker"] != "closed":
+            router.call(x, deadline_ms=5000)
+            time.sleep(0.05)
+        assert router.stats()["replicas"]["r0"]["breaker"] == "closed"
+        assert not router._breaker("r0").probing
+    finally:
+        router.stop()
+        pool.stop()
+
+
+def test_capacity_floor_sheds_lowest_priority_first(
+        tmp_path, journal_file):
+    """Degradation tier: with half the fleet dead and a 0.9 floor,
+    priority-1 traffic sheds with the tier named on the error while
+    priority-0 traffic still serves."""
+    pool = _local_pool(str(tmp_path / "pool"), n=2, heartbeat_s=0.05,
+                       deadline_s=0.25).start()
+    router = Router(pool, RouterConfig(retries=1, capacity_floor=0.9))
+    x = np.arange(3, dtype=np.float32)
+    try:
+        # both up: every class serves
+        assert np.allclose(router.predict(x, priority=1), x)
+        # r1 resigns; its beacon drops and capacity halves
+        pool.replicas["r1"].stop()
+        time.sleep(0.4)
+        with pytest.raises(ServerOverloaded) as exc:
+            router.predict(x, priority=1)
+        assert exc.value.tier == "capacity_floor"
+        assert np.allclose(router.predict(x, priority=0), x)  # tier 0 ok
+    finally:
+        router.stop()
+        pool.stop()
+    sheds = _records(journal_file, "router_shed")
+    assert sheds and sheds[-1]["tier"] == "capacity_floor" \
+        and sheds[-1]["priority"] == 1
+
+
+def test_rolling_reload_version_stamps_old_or_new_only(
+        tmp_path, journal_file):
+    """Satellite: rolling ``pool.reload()`` while the trainer publishes
+    a NEW commit root mid-roll — every response is stamped with (and
+    numerically matches) exactly the old or the new step; client-visible
+    errors stay zero because at most ``surge`` replicas leave rotation."""
+    ck = str(tmp_path / "ckpt")
+    _commit_scale(ck, 1, 2.0)
+    pool = _local_pool(str(tmp_path / "pool"), n=3, ckpt_root=ck,
+                       reload_poll_s=-1.0).start()
+    router = Router(pool, RouterConfig(retries=3))
+    x = np.ones(4, np.float32)
+    seen, errors, stop = [], [], threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                resp = router.call(x, deadline_ms=8000)
+            except Exception as e:           # pragma: no cover - loud
+                errors.append(repr(e))
+                return
+            seen.append((float(np.asarray(resp.value)[0]),
+                         resp.params_step))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(3)]
+    try:
+        assert all(s.params_step == 1 for s in pool.view())
+        for t in threads:
+            t.start()
+        roll = threading.Thread(target=pool.reload, daemon=True)
+        roll.start()
+        # mid-roll: a fresh step lands; replicas restarted after this
+        # moment pick it up, earlier ones stay on step 1
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                not _records(journal_file, "pool_restart"):
+            time.sleep(0.02)
+        _commit_scale(ck, 2, 5.0)
+        roll.join(timeout=60)
+        assert not roll.is_alive()
+        time.sleep(0.2)
+        final = {s.params_step for s in pool.view()}
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        router.stop()
+        pool.stop()
+    assert not errors, errors[:3]
+    assert seen
+    for value, step in seen:
+        if step == 1:
+            assert abs(value - 2.0) < 1e-6, (value, step)
+        elif step == 2:
+            assert abs(value - 5.0) < 1e-6, (value, step)
+        else:
+            raise AssertionError(f"response from unknown root: "
+                                 f"step={step} value={value}")
+    # the fleet ends split across exactly the old and the new root
+    assert final <= {1, 2}
+    rolls = [r for r in _records(journal_file, "pool_reload")
+             if r.get("phase") == "end"]
+    assert rolls and set(rolls[-1]["steps"].values()) <= {1, 2}
+
+
+# -- the chaos headline (CI tier 0.5 smoke) ----------------------------------
+
+def test_pool_chaos_smoke_sigkill_one_of_three_replicas(
+        tmp_path, journal_file):
+    """SIGKILL 1 of 3 real replica worker processes under closed-loop
+    load: detection within the heartbeat deadline, in-flight requests
+    retried on survivors within their deadline budget, zero corrupt
+    responses, shed-rate under the ceiling, the respawned replica
+    re-admitted through a half-open probe — all trace-correlated and
+    summarized by the doctor's serving-journal report."""
+    from mxnet_tpu.observability import trace as obtrace
+    obtrace.configure(mode="journal")
+    ck = str(tmp_path / "ckpt")
+    _commit_scale(ck, 1, 3.0)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "MXNET_TPU_JOURNAL": journal_file, "PYTHONPATH": REPO,
+           "MXNET_TPU_TRACE": "off"}
+    env.pop("XLA_FLAGS", None)           # 1-device workers start faster
+    cfg = PoolConfig(heartbeat_s=0.25, deadline_s=1.5, monitor_s=0.3)
+    pool = ReplicaPool(str(tmp_path / "pool"), cfg)
+    for i in range(3):
+        pool.add_proc(f"p{i}", {"--model": "scale", "--ckpt-root": ck,
+                                "--window-ms": 1.0,
+                                "--reload-poll-s": -1.0}, env=env)
+    router = Router(pool, RouterConfig(
+        retries=3, breaker_k=2, breaker_cooldown_s=1.0))
+    x = np.arange(4, dtype=np.float32)
+    corrupt, unexpected, ok_count, sheds = [], [], [0], [0]
+    stop = threading.Event()
+    threads = []
+
+    def client(idx):
+        while not stop.is_set():
+            try:
+                resp = router.call(x, deadline_ms=8000)
+            except ServerOverloaded:
+                sheds[0] += 1
+                time.sleep(0.01)
+                continue
+            except Exception as e:
+                unexpected.append(repr(e))
+                time.sleep(0.05)
+                continue
+            v = np.asarray(resp.value)
+            if not np.allclose(v, x * 3.0, atol=1e-5):
+                corrupt.append(v.tolist())
+            ok_count[0] += 1
+            time.sleep(0.005)
+
+    try:
+        pool.start()                     # bounded: spawn deadline inside
+        pool.monitor_start()
+        threads += [threading.Thread(target=client, args=(i,),
+                                     daemon=True) for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)                  # steady-state traffic first
+        served_before = router.stats()["served"]
+        assert served_before > 0
+        t_kill = time.time()
+        pool.replicas["p1"].kill()       # the host-vanished shape
+
+        # (1) detection within the heartbeat deadline (+ monitor tick)
+        deadline = time.monotonic() + 20
+        lost = []
+        while time.monotonic() < deadline and not lost:
+            lost = [r for r in _records(journal_file, "replica_lost")
+                    if r.get("replica") == "p1"]
+            time.sleep(0.05)
+        assert lost, "replica loss never detected"
+        detect_s = lost[0]["ts"] - t_kill
+        assert detect_s <= cfg.deadline_s + cfg.monitor_s + 3.0, detect_s
+
+        # (2) the respawned replica is re-admitted via half-open probe
+        deadline = time.monotonic() + 60
+        readmitted = False
+        while time.monotonic() < deadline and not readmitted:
+            readmitted = any(
+                r["frm"] == "half_open" and r["to"] == "closed"
+                for r in _records(journal_file, "router_breaker")
+                if r.get("replica") == "p1")
+            time.sleep(0.1)
+        assert readmitted, "p1 never re-admitted through half-open"
+        # and actually serves again
+        deadline = time.monotonic() + 30
+        base = router.stats()["replicas"]["p1"]["attempts"]
+        while time.monotonic() < deadline and \
+                router.stats()["replicas"]["p1"]["attempts"] <= base:
+            time.sleep(0.1)
+        assert router.stats()["replicas"]["p1"]["attempts"] > base
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        router.stop()
+        pool.stop()
+        obtrace.reset_tracer()
+
+    # (3) zero corrupt responses, survivors absorbed the retries within
+    # the deadline budget (no DeadlineExceeded/unhandled errors), and
+    # the shed ceiling held
+    assert not corrupt, corrupt[:3]
+    assert not unexpected, unexpected[:5]
+    assert ok_count[0] > served_before
+    total = ok_count[0] + sheds[0]
+    assert sheds[0] / total <= 0.2, (sheds[0], total)
+
+    # (4) transitions are trace-correlated: the breaker flips that fire
+    # inside a routed request carry its trace/span ids
+    breakers = [r for r in _records(journal_file, "router_breaker")
+                if r.get("replica") == "p1"]
+    assert breakers
+    assert any(r.get("trace_id") for r in breakers)
+    retries = _records(journal_file, "router_retry")
+    assert retries and any(r.get("trace_id") for r in retries)
+
+    # (5) the doctor's journal reduction tells the whole story
+    rep = serving_report(journal_file)
+    assert rep["ok"]
+    rt = rep["router"]
+    assert any(row["replica"] == "p1" for row in rt["replicas_lost"])
+    assert "p1" in rt["readmitted"]
+    assert rt["retries"] >= 1
+    transitions = [(t["frm"], t["to"]) for t in rt["breaker_transitions"]]
+    assert ("half_open", "closed") in transitions
+    # the doctor's one-line summary names the recovery
+    from mxnet_tpu.diagnostics.__main__ import _summ_serving
+    line = _summ_serving(rep)
+    assert "replicas lost" in line and "re-admitted" in line
+    # zero corrupt responses server-side too: every batch served from
+    # the one CRC-valid commit root
+    steps = {r.get("params_step")
+             for r in _records(journal_file, "serving_batch")}
+    assert steps <= {1, None}
+
+
+# -- reporting ----------------------------------------------------------------
+
+def test_serving_report_router_section_synthetic(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    recs = [
+        {"kind": "pool_start", "replicas": ["r0", "r1"]},
+        {"kind": "serving_start"},       # replica-local run records
+        {"kind": "serving_batch", "batch": 2, "delivered": 2,
+         "fill": 1.0, "hits": 1, "misses": 1},
+        {"kind": "router_retry", "replica": "r0", "attempt": 1,
+         "error": "ReplicaUnavailable"},
+        {"kind": "router_breaker", "replica": "r0", "frm": "closed",
+         "to": "open", "reason": "heartbeat_stall", "trace_id": "t1"},
+        {"kind": "replica_lost", "replica": "r0", "idle_s": 2.2},
+        {"kind": "pool_restart", "replica": "r0", "ready": True},
+        {"kind": "router_breaker", "replica": "r0", "frm": "open",
+         "to": "half_open", "reason": "cooldown_elapsed"},
+        {"kind": "router_breaker", "replica": "r0", "frm": "half_open",
+         "to": "closed", "reason": "probe_succeeded"},
+        {"kind": "router_hedge", "primary": "r0", "hedge": "r1",
+         "delay_ms": 40.0},
+        {"kind": "router_shed", "tier": "capacity_floor", "priority": 1},
+        {"kind": "serving_stop", "stuck": False},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    rep = serving_report(path)
+    assert rep["ok"] and rep["served"] == 2
+    rt = rep["router"]
+    assert rt["retries"] == 1 and rt["hedges"] == 1
+    assert rt["sheds_by_tier"] == {"capacity_floor": 1}
+    assert rt["replicas_lost"] == [{"replica": "r0", "idle_s": 2.2}]
+    assert rt["restarts"] == 1
+    assert rt["readmitted"] == ["r0"]
+    assert [t["to"] for t in rt["breaker_transitions"]] == \
+        ["open", "half_open", "closed"]
+    assert rt["breaker_transitions"][0]["trace_id"] == "t1"
+
+
+def test_serving_report_anchors_on_pool_start(tmp_path):
+    """With a pool run, the last-run slice anchors at pool_start — the
+    workers' own serving_start records must not truncate the fleet."""
+    path = str(tmp_path / "j.jsonl")
+    recs = [
+        {"kind": "serving_batch", "batch": 9, "delivered": 9,
+         "fill": 1.0},                       # previous run: sliced away
+        {"kind": "pool_start", "replicas": ["r0", "r1"]},
+        {"kind": "serving_start"},
+        {"kind": "serving_batch", "batch": 1, "delivered": 1, "fill": 1.0},
+        {"kind": "serving_start"},
+        {"kind": "serving_batch", "batch": 2, "delivered": 2, "fill": 1.0},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    rep = serving_report(path)
+    assert rep["served"] == 3 and rep["batches"] == 2
+
+
+def test_serving_report_closed_pool_run_then_solo_run(tmp_path):
+    """A pool drill that already CLOSED (pool_stop) followed by a later
+    plain-Server run: the report must describe the solo run, not
+    resurrect the stale fleet's records."""
+    path = str(tmp_path / "j.jsonl")
+    recs = [
+        {"kind": "pool_start", "replicas": ["r0"]},
+        {"kind": "serving_start"},
+        {"kind": "serving_batch", "batch": 9, "delivered": 9, "fill": 1.0},
+        {"kind": "replica_lost", "replica": "r0", "idle_s": 2.0},
+        {"kind": "pool_stop"},
+        {"kind": "serving_start"},           # the new solo run
+        {"kind": "serving_batch", "batch": 2, "delivered": 2, "fill": 1.0},
+        {"kind": "serving_stop", "stuck": False},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    rep = serving_report(path)
+    assert rep["served"] == 2 and rep["batches"] == 1
+    assert "router" not in rep               # the drill is history
+
+
+@pytest.mark.slow
+def test_pool_bench_cli_emits_artifact(tmp_path):
+    """``python -m mxnet_tpu.serving bench --replicas 2`` routes the
+    closed loop through the front door and emits the one-JSON-line +
+    BENCH_serving_pool artifact with router counters and the
+    observability snapshot."""
+    import subprocess
+    import sys
+    artifact = str(tmp_path / "BENCH_serving_pool.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.serving", "bench",
+         "--seconds", "1", "--clients", "2", "--dim", "8",
+         "--replicas", "2", "--out", artifact],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "MXNET_TPU_JOURNAL": "off"})
+    assert out.returncode == 0, out.stderr[-800:]
+    line = [l for l in out.stdout.splitlines()
+            if l.startswith("{") and '"metric"' in l][-1]
+    doc = json.loads(line)
+    assert doc["metric"] == "serving_pool_requests_per_sec"
+    assert doc["value"] and doc["value"] > 0
+    assert doc["router"]["served"] > 0
+    assert "hedges" in doc["router"] and "breaker_opens" in doc["router"]
+    assert doc["router"]["replicas"].keys() == {"r0", "r1"}
+    assert "metrics" in doc["observability"]
+    with open(artifact, encoding="utf-8") as f:
+        assert json.load(f)["metric"] == "serving_pool_requests_per_sec"
+
+
+@pytest.mark.slow
+def test_router_metrics_text_families(tmp_path):
+    from mxnet_tpu.observability.metrics import reset_metrics
+    reset_metrics()
+    pool = _local_pool(str(tmp_path / "pool"), n=2).start()
+    router = Router(pool, RouterConfig())
+    try:
+        router.predict(np.ones(4, np.float32))
+        text = router.metrics_text()
+    finally:
+        router.stop()
+        pool.stop()
+        reset_metrics()
+    assert "mxnet_tpu_router_events" in text
+    assert 'mxnet_tpu_router_breaker_state{replica="r0"} 0' in text
+    assert "mxnet_tpu_router_attempts_total" in text
