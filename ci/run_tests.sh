@@ -72,6 +72,16 @@ python -m pytest tests/test_crash_matrix.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: serving smoke (dynamic batcher) =="
 python -m pytest tests/test_serving.py -q -k smoke -p no:cacheprovider
 
+# tenant-fleet chaos smoke: tenant A fed a corrupt committed checkpoint
+# + oversized-shape flood + predictor poison while tenant B runs
+# closed-loop load on the SAME fleet -> B's p99 stays in its SLO bound
+# with zero corruption errors, A quarantines itself with tenant-labeled
+# structured errors, the quarantine->half-open->re-admit trail is
+# trace-correlated, and the mixed-version reload keeps every response
+# stamped with its own tenant's step (docs/serving.md tenant matrix)
+echo "== tier 0.5: tenant-fleet chaos smoke (tenant isolation) =="
+python -m pytest tests/test_serving_fleet.py -q -k smoke -p no:cacheprovider
+
 # pool chaos smoke: 3 REAL replica worker processes behind the
 # health-routed front door under closed-loop load; SIGKILL one ->
 # detection within the heartbeat deadline, retries complete on

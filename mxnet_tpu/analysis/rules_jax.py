@@ -1380,3 +1380,163 @@ class RankDependentCollectiveEntry(Rule):
                 "share the one-rank decision via a broadcast "
                 "(docs/elastic.md)")
         yield from self._descend(ctx, node, tainted, guarded)
+
+
+@register
+class UnboundedKeyedRegistry(Rule):
+    code = "G14"
+    name = "unbounded-keyed-registry"
+    severity = "error"
+    doc = ("Dict/set attribute in library-code classes indexed by "
+           "externally-supplied keys — the key expression names a "
+           "request-shaped identifier (tenant, request/req id, step, "
+           "path/file name, session/client/user/token, trace/span id) "
+           "and the insert sits in a PUBLIC method — with inserts but "
+           "no eviction/cap on any path in the class. A long-lived "
+           "server then grows host memory one entry per novel key "
+           "forever: the ParamStore bad-step-set hazard class "
+           "(churning commit root), the per-tenant counter-table "
+           "class, the Prometheus label-cardinality class. Bound it: "
+           "LRU-cap with popitem/pop, prune against `len(...)` "
+           "compares, or reset the container on a lifecycle path. "
+           "Containers whose inserts only happen in underscore-private "
+           "methods are out of scope (the caller owns the key space), "
+           "as are key names outside the vocabulary (operator-bounded "
+           "registries). Scope: mxnet_tpu/ library classes.")
+
+    # request-shaped identifier vocabulary: a key built from one of
+    # these tokens is presumed externally supplied (request fields,
+    # tenant ids, file/step names) rather than operator-configured
+    VOCAB = {"tenant", "tenants", "step", "steps", "request", "req",
+             "path", "paths", "file", "files", "fname", "filename",
+             "client", "session", "user", "token", "trace", "span"}
+
+    CONTAINERS = {"dict", "set", "collections.OrderedDict",
+                  "collections.defaultdict", "OrderedDict",
+                  "defaultdict"}
+    EVICTORS = {"pop", "popitem", "clear", "discard", "remove"}
+
+    @staticmethod
+    def _self_attr(node):
+        """'x' for a `self.x` attribute expression, else None."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            return node.attr
+        return None
+
+    def _container_attrs(self, ctx, cls) -> set:
+        """Attrs assigned a fresh dict/set/OrderedDict/defaultdict
+        anywhere in the class (the `self._seen = {}` shape)."""
+        out = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            fresh = isinstance(v, (ast.Dict, ast.Set)) or (
+                isinstance(v, ast.Call)
+                and ctx.resolve_call(v) in self.CONTAINERS)
+            if not fresh:
+                continue
+            for tgt in node.targets:
+                attr = self._self_attr(tgt)
+                if attr:
+                    out.add(attr)
+        return out
+
+    def _evicted_attrs(self, ctx, cls, attrs) -> set:
+        """Attrs with eviction/cap evidence on ANY path: an evictor
+        method call, `del self.x[...]`, a `len(self.x)` inside a
+        Compare (the `while len(...) > cap: popitem()` shape), or a
+        reset-reassignment outside __init__."""
+        out = set()
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        init_nodes = set(ast.walk(init)) if init is not None else set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in self.EVICTORS:
+                attr = self._self_attr(node.func.value)
+                if attr in attrs:
+                    out.add(attr)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = self._self_attr(t.value)
+                        if attr in attrs:
+                            out.add(attr)
+            elif isinstance(node, ast.Compare):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Name) and \
+                            sub.func.id == "len" and sub.args:
+                        attr = self._self_attr(sub.args[0])
+                        if attr in attrs:
+                            out.add(attr)
+            elif isinstance(node, ast.Assign) and node not in init_nodes:
+                for tgt in node.targets:
+                    attr = self._self_attr(tgt)
+                    if attr in attrs:
+                        out.add(attr)       # lifecycle reset path
+        return out
+
+    def _key_is_external(self, key_expr) -> bool:
+        """True when a Name in the key expression carries a
+        vocabulary token (`request_id`, `step`, `fname`, ...)."""
+        for sub in ast.walk(key_expr):
+            if isinstance(sub, ast.Name):
+                tokens = sub.id.lower().split("_")
+                if any(t in self.VOCAB for t in tokens):
+                    return True
+        return False
+
+    def _inserts(self, method):
+        """(line, attr, key_expr) for each insert in one method:
+        `self.x[k] = v`, `self.x.add(k)`, `self.x.setdefault(k, ...)`."""
+        for node in ast.walk(method):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = self._self_attr(tgt.value)
+                        if attr:
+                            yield node.lineno, attr, tgt.slice
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("add", "setdefault") and node.args:
+                attr = self._self_attr(node.func.value)
+                if attr:
+                    yield node.lineno, attr, node.args[0]
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            attrs = self._container_attrs(ctx, cls)
+            if not attrs:
+                continue
+            evicted = self._evicted_attrs(ctx, cls, attrs)
+            for method in cls.body:
+                if not isinstance(method, ast.FunctionDef):
+                    continue
+                if method.name.startswith("_"):
+                    continue           # private: caller owns the keys
+                for line, attr, key_expr in self._inserts(method):
+                    if attr not in attrs or attr in evicted:
+                        continue
+                    if not self._key_is_external(key_expr):
+                        continue
+                    yield self.finding(
+                        ctx, line,
+                        f"unbounded keyed registry: `self.{attr}` is "
+                        "inserted with an externally-supplied key in a "
+                        "public method but nothing in the class ever "
+                        "evicts or caps it — a long-lived server grows "
+                        "one entry per novel key forever; add an LRU "
+                        "cap/pruning (ParamStore's bad-step LRU is the "
+                        "model)")

@@ -160,6 +160,23 @@ def _summ_serving(sv) -> str:
                  f"{len(rt['readmitted'])} re-admitted, "
                  f"{rt['retries']} retries, {rt['hedges']} hedges, "
                  f"{len(rt['breaker_transitions'])} breaker transitions")
+    tn = sv.get("tenants")
+    if tn:
+        # a tenant is "quarantined" per its trail's LAST transition — a
+        # sticky re-admitted flag would hide a tenant that re-quarantined
+        # after an earlier successful probe
+        quarantined = sorted(
+            t for t, row in tn.items()
+            if row["quarantine_trail"]
+            and row["quarantine_trail"][-1]["to"] == "quarantined")
+        readmitted = sorted(t for t, row in tn.items()
+                            if row["readmitted"])
+        trail = sum(len(row["quarantine_trail"]) for row in tn.values())
+        pages = sum(row["page_ins"] for row in tn.values())
+        base += (f"; fleet: {len(tn)} tenants, {trail} quarantine "
+                 f"transitions (quarantined: {quarantined or 'none'}, "
+                 f"re-admitted: {readmitted or 'none'}), "
+                 f"{pages} page-ins")
     return base
 
 

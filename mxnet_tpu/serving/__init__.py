@@ -16,6 +16,14 @@ around it (docs/serving.md):
   deadline-check, journaled per batch;
 - :mod:`.reload` — newest-valid-committed-step hot-reload over
   ``resilience.commit`` (a torn checkpoint can never reach a response);
+- :mod:`.fleet` — the multi-tenant tier: a tenant registry (model +
+  commit root + SLO class per tenant, hot add/remove/reload),
+  SLO-classed admission (priority, deadline floor, token-bucket rate
+  budget; shedding per tenant class first, never global), per-tenant
+  fault domains (corrupt checkpoint / shape flood / predictor poison
+  quarantine ONE tenant behind a half-open-probed breaker), and weight
+  paging for cold tenants (host-RAM tier → device on demand, LRU over
+  the hot set, page-in cost journaled);
 - :mod:`.pool` / :mod:`.router` / :mod:`.worker` / :mod:`.wire` — the
   fault-tolerant replica tier: N Server replicas (in-process or
   subprocess workers) heartbeating readiness beacons onto an
@@ -38,17 +46,22 @@ from __future__ import annotations
 import importlib
 
 __all__ = ["BucketGrid", "CompiledPredictor", "DeadlineExceeded",
-           "LocalReplica", "ParamStore", "PendingResponse", "PoolConfig",
+           "Fleet", "FleetConfig", "LocalReplica", "ParamStore",
+           "PendingResponse", "PoolConfig",
            "PredictorCache", "ProcReplica", "ReplicaPool",
            "ReplicaUnavailable", "RequestCancelled", "RequestError",
-           "Router", "RouterConfig", "RouterResponse", "Server",
-           "ServerConfig", "ServerOverloaded", "ServerStopped",
-           "serving_report"]
+           "Router", "RouterConfig", "RouterResponse", "SLOClass",
+           "Server", "ServerConfig", "ServerOverloaded", "ServerStopped",
+           "TenantQuarantined", "serving_report"]
 
 _LAZY = {
     "BucketGrid": ("buckets", "BucketGrid"),
     "CompiledPredictor": ("cache", "CompiledPredictor"),
     "DeadlineExceeded": ("batcher", "DeadlineExceeded"),
+    "Fleet": ("fleet", "Fleet"),
+    "FleetConfig": ("fleet", "FleetConfig"),
+    "SLOClass": ("fleet", "SLOClass"),
+    "TenantQuarantined": ("fleet", "TenantQuarantined"),
     "LocalReplica": ("pool", "LocalReplica"),
     "ParamStore": ("reload", "ParamStore"),
     "PendingResponse": ("batcher", "PendingResponse"),

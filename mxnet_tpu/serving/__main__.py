@@ -52,6 +52,8 @@ def cmd_bench(args) -> int:
     from ..resilience.atomic import atomic_write
     from .server import Server, ServerConfig
 
+    if args.tenants > 0:
+        return _bench_tenants(args)
     if args.replicas > 1:
         return _bench_pool(args)
 
@@ -137,6 +139,125 @@ def cmd_bench(args) -> int:
         with atomic_write(args.out, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
         print(f"serving bench: artifact written to {args.out}",
+              file=sys.stderr)
+    _emit(doc)
+    j.mark_clean()
+    return 0
+
+
+TENANT_METRIC = "serving_tenant_requests_per_sec"
+
+
+def _bench_tenants(args) -> int:
+    """--tenants N: closed-loop mixed-tenant load against one Fleet —
+    N tenants on one worker/queue/cache, clients spread round-robin.
+    The artifact (BENCH_serving_tenants.json) carries per-tenant
+    p50/p95/p99, shed/quarantine/page-in counters and the observability
+    snapshot — the capacity-and-isolation profile of multi-tenant
+    serving (docs/serving.md)."""
+    import numpy as np
+
+    from ..diagnostics import get_journal
+    from ..metric import LatencySummary
+    from ..observability import snapshot
+    from ..resilience.atomic import atomic_write
+    from .batcher import (DeadlineExceeded, RequestError, ServerOverloaded)
+    from .fleet import Fleet, FleetConfig
+
+    j = get_journal()
+    j.install_handlers(final_cb=lambda: _emit(
+        {"metric": TENANT_METRIC, "value": None, "unit": "req/s",
+         "error": "bench_killed",
+         "detail": f"killed at phase {j.last_phase!r}"}))
+    j.set_phase("serving_tenant_bench_setup")
+    cfg = FleetConfig(max_batch=args.max_batch, max_queue=args.queue,
+                      window_ms=args.window_ms,
+                      default_deadline_ms=args.deadline_ms)
+    fleet = Fleet(cfg)
+    names = [f"t{i}" for i in range(args.tenants)]
+    for name in names:
+        fleet.add_tenant(name,
+                         factory=(lambda: _build_model(args.dim)))
+    fleet.start()
+
+    client_lat = {n: LatencySummary(f"client_{n}_ms") for n in names}
+    stop_at = time.monotonic() + args.seconds
+    ok = [0] * args.clients
+    shed = [0] * args.clients
+    missed = [0] * args.clients
+    errored = [0] * args.clients
+
+    def client(idx):
+        tenant = names[idx % len(names)]
+        rng = np.random.default_rng(idx)
+        while time.monotonic() < stop_at:
+            x = rng.standard_normal(args.dim).astype(np.float32)
+            t0 = time.perf_counter()
+            try:
+                fleet.predict(x, tenant=tenant)
+            except ServerOverloaded:
+                shed[idx] += 1
+                time.sleep(0.002)
+                continue
+            except DeadlineExceeded:
+                missed[idx] += 1
+                continue
+            except RequestError as e:
+                errored[idx] += 1
+                print(f"tenant bench: client {idx} ({tenant}): {e}",
+                      file=sys.stderr)
+                time.sleep(0.01)
+                continue
+            client_lat[tenant].observe(
+                (time.perf_counter() - t0) * 1000.0)
+            ok[idx] += 1
+
+    j.set_phase("serving_tenant_bench_run")
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.seconds + 30)
+    elapsed = time.monotonic() - t_start
+    j.set_phase("serving_tenant_bench_report")
+    fleet.stop(timeout_s=30)
+
+    stats = fleet.stats()
+    total_ok = sum(ok)
+    per_tenant = {}
+    for name in names:
+        row = stats["tenants"][name]
+        per_tenant[name] = {
+            "served": row["served"], "shed": row["shed"],
+            "quarantines": row["quarantines"],
+            "readmissions": row["readmissions"],
+            "page_ins": row["page_ins"],
+            "p50_ms": row["latency_ms"]["p50"],
+            "p95_ms": row["latency_ms"]["p95"],
+            "p99_ms": row["latency_ms"]["p99"],
+            "client_latency_ms": client_lat[name].summary()}
+    doc = {
+        "metric": TENANT_METRIC,
+        "value": round(total_ok / elapsed, 2) if elapsed else None,
+        "unit": f"req/s (tenants={args.tenants}, "
+                f"clients={args.clients}, dim={args.dim})",
+        "elapsed_s": round(elapsed, 2),
+        "completed": total_ok,
+        "client_shed": sum(shed),
+        "client_deadline_miss": sum(missed),
+        "client_errors": sum(errored),
+        "tenants": per_tenant,
+        "server": {k: v for k, v in stats.items() if k != "tenants"},
+        "compiles": stats["cache"]["misses"],
+        "observability": snapshot(),
+    }
+    out = args.out or ""
+    if out:
+        with atomic_write(out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True, default=str)
+        print(f"tenant bench: artifact written to {out}",
               file=sys.stderr)
     _emit(doc)
     j.mark_clean()
@@ -273,13 +394,19 @@ def main(argv=None) -> int:
                    help="> 1 routes the closed loop through a Router "
                         "over N in-process replicas and writes the "
                         "BENCH_serving_pool artifact")
+    b.add_argument("--tenants", type=int, default=0,
+                   help="> 0 runs the closed loop as mixed-tenant load "
+                        "against one Fleet of N tenants and writes the "
+                        "BENCH_serving_tenants artifact (per-tenant "
+                        "p99/shed/quarantine counters)")
     b.add_argument("--hedge-ms", type=float, default=0.0,
                    help="tail-latency hedge delay for --replicas mode "
                         "(0 = off)")
     b.add_argument("--out", default=None,
                    help="artifact path ('' disables; default "
-                        "BENCH_serving.json, or BENCH_serving_pool.json "
-                        "with --replicas > 1)")
+                        "BENCH_serving.json, BENCH_serving_pool.json "
+                        "with --replicas > 1, or "
+                        "BENCH_serving_tenants.json with --tenants)")
     b.set_defaults(fn=cmd_bench)
     w = sub.add_parser("worker", help="replica worker process behind a "
                                       "loopback socket (serving/pool.py "
@@ -289,7 +416,8 @@ def main(argv=None) -> int:
     w.set_defaults(fn=cmd_worker)
     args = ap.parse_args(argv)
     if getattr(args, "out", None) is None and args.cmd == "bench":
-        args.out = ("BENCH_serving_pool.json" if args.replicas > 1
+        args.out = ("BENCH_serving_tenants.json" if args.tenants > 0
+                    else "BENCH_serving_pool.json" if args.replicas > 1
                     else "BENCH_serving.json")
     try:
         return args.fn(args)

@@ -32,25 +32,32 @@ class RequestError(RuntimeError):
     (serving/router.py): True when the same request may succeed on a
     DIFFERENT replica (predictor fault, stopped/overloaded server);
     the shape-reject path overrides it to False on the instance — every
-    replica shares the bucket grid, so retrying is wasted budget."""
+    replica shares the bucket grid, so retrying is wasted budget.
+    ``tenant`` names the fleet tenant the failure belongs to (None on a
+    single-tenant Server) — the tenant-isolation contract requires every
+    structured error to carry its fault domain (docs/serving.md)."""
 
     retryable = True
+    tenant = None
 
 
 class ServerOverloaded(RequestError):
     """Admission rejected: the bounded queue is full — or, with
     ``tier`` set, a pool-level degradation tier acted (the router's
-    capacity-floor shed names which; docs/serving.md).  Raised to the
+    capacity-floor shed, the fleet's per-tenant-class depth budget or
+    token-bucket rate budget; docs/serving.md).  Raised to the
     *submitter* immediately — the explicit load-shed that keeps queue
     latency bounded instead of letting every client get slower."""
 
-    def __init__(self, depth, limit, tier=None):
+    def __init__(self, depth, limit, tier=None, tenant=None):
         super().__init__(f"serving queue full ({depth}/{limit}); request "
                          "shed — retry with backoff or scale out"
-                         + (f" [tier: {tier}]" if tier else ""))
+                         + (f" [tier: {tier}]" if tier else "")
+                         + (f" [tenant: {tenant}]" if tenant else ""))
         self.depth = depth
         self.limit = limit
         self.tier = tier
+        self.tenant = tenant
 
 
 class ServerStopped(RequestError):
@@ -80,13 +87,15 @@ class DeadlineExceeded(RequestError):
 
     retryable = False
 
-    def __init__(self, stage, late_ms, tier=None):
+    def __init__(self, stage, late_ms, tier=None, tenant=None):
         super().__init__(f"deadline exceeded at {stage} "
                          f"({late_ms:.1f} ms late)"
-                         + (f" [tier: {tier}]" if tier else ""))
+                         + (f" [tier: {tier}]" if tier else "")
+                         + (f" [tenant: {tenant}]" if tenant else ""))
         self.stage = stage
         self.late_ms = late_ms
         self.tier = tier
+        self.tenant = tenant
 
 
 class Request:
@@ -94,10 +103,10 @@ class Request:
 
     __slots__ = ("payload", "shape", "key", "enq_t", "deadline_ts",
                  "done", "result", "error", "served_t", "trace",
-                 "cancel", "params_step")
+                 "cancel", "params_step", "tenant")
 
     def __init__(self, payload, shape, key, deadline_s=None, now=None,
-                 cancel=None):
+                 cancel=None, tenant=None):
         now = time.monotonic() if now is None else now
         self.payload = payload
         self.shape = tuple(shape)            # original feature shape
@@ -121,6 +130,10 @@ class Request:
         # stamped by the worker at batch time (the rolling-reload
         # version-stamp contract; None = initializer weights)
         self.params_step = None
+        # fleet tenant this request belongs to (None on a single-tenant
+        # Server): the worker batches per (tenant, key) and every
+        # structured failure carries it (docs/serving.md)
+        self.tenant = tenant
 
     def cancelled(self) -> bool:
         return self.cancel is not None and self.cancel.is_set()
@@ -198,20 +211,23 @@ def drop_expired(pending, on_expired, now=None):
     return pending
 
 
-def take_batch(pending, grid):
+def take_batch(pending, grid, group_key=None):
     """Pop the next micro-batch off ``pending`` (in place): the oldest
-    request's feature-bucket key selects the batch; same-key requests
-    join in FIFO order up to the largest batch bucket.  Returns
-    ``(batch, batch_bucket, feature_key)`` or ``(None, None, None)``
-    when pending is empty."""
+    request's grouping key selects the batch; same-group requests join
+    in FIFO order up to the largest batch bucket.  ``group_key``
+    defaults to the feature-bucket key; the tenant fleet groups by
+    ``(tenant, key)`` so two tenants' requests never share an
+    executable.  Returns ``(batch, batch_bucket, feature_key)`` or
+    ``(None, None, None)`` when pending is empty."""
     if not pending:
         return None, None, None
-    key = pending[0].key
+    gk = group_key if group_key is not None else (lambda r: r.key)
+    head = gk(pending[0])
     batch, rest = [], []
     for req in pending:
-        if req.key == key and len(batch) < grid.max_batch:
+        if gk(req) == head and len(batch) < grid.max_batch:
             batch.append(req)
         else:
             rest.append(req)
     pending[:] = rest
-    return batch, grid.batch_bucket(len(batch)), key
+    return batch, grid.batch_bucket(len(batch)), batch[0].key

@@ -111,6 +111,18 @@ class PredictorCache:
                 self.evictions += 1
         return entry, False
 
+    def drop_where(self, predicate) -> int:
+        """Drop every entry whose key satisfies ``predicate`` (counted
+        as evictions) — the tenant fleet's page-out/remove path: a cold
+        or removed tenant's executables must not occupy LRU slots the
+        hot tenants need.  Returns how many entries were dropped."""
+        with self._lock:
+            doomed = [k for k in self._lru if predicate(k)]
+            for k in doomed:
+                del self._lru[k]
+            self.evictions += len(doomed)
+            return len(doomed)
+
     def __len__(self):
         with self._lock:
             return len(self._lru)

@@ -1,0 +1,702 @@
+"""Tenant fleet — N model families on one serving worker, isolated.
+
+Production traffic is never one model (ROADMAP item 2): a :class:`Fleet`
+generalizes :class:`~.server.Server` from one predictor family to a
+**tenant registry** — each tenant is a model (any Block/HybridBlock/
+imported SymbolBlock), its own commit root (:class:`~.reload.ParamStore`
+per tenant), and an SLO class — multiplexed on the SAME bounded queue,
+worker thread, and compiled-predictor cache.  Tenants hot add/remove/
+reload at runtime; batches group per ``(tenant, feature_key)`` so two
+tenants never share an executable (pjit/named-sharding inside the
+predictor stays the substrate — no application-code change per tenant).
+
+The robustness contract (docs/serving.md failure matrix):
+
+- **SLO-classed admission** — each tenant's class carries a priority,
+  a deadline floor, and a token-bucket rate budget.  Shedding is
+  per-tenant-class FIRST, never global: a lower-priority class loses
+  queue room as depth grows (its share of the bound halves per
+  priority tier) while priority-0 tenants keep the full queue; a
+  tenant over its rate budget sheds only itself.  Every
+  ``ServerOverloaded``/``DeadlineExceeded`` carries the tenant + tier.
+- **Per-tenant fault domains** — a tenant whose committed checkpoint
+  fails CRC, whose shapes reject, or whose predictor throws
+  non-transient errors feeds a per-tenant breaker; at the threshold the
+  tenant is **quarantined** (structured :class:`TenantQuarantined` at
+  admission, queued requests resolved at dequeue without spending batch
+  slots).  After a cooldown the breaker goes half-open: ONE probe
+  request re-admits (success → admitted) or re-quarantines.  Every
+  transition is journaled (``tenant_quarantine``) with trace ids.
+- **Weight paging** — at most ``max_hot_tenants`` tenants keep device
+  parameters + compiled predictors; a cold tenant's parameters live in
+  a host-RAM snapshot and page onto the device on demand (LRU evicts
+  the stalest hot tenant, its executables dropped from the bounded
+  ``PredictorCache``).  Page-in cost is journaled (``tenant_page_in``)
+  and excluded from the batch's ``exec_ms`` so it can never masquerade
+  as a hot tenant's tail latency.
+
+Chaos seam: every tenant predictor call trips the ``serving_tenant``
+site with the tenant name as its path, so ``faults.slow_call``/
+``io_error``/``tenant_poison`` target ONE tenant, composing with the
+existing ``serving_predict``/``router_attempt`` seams.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..diagnostics.journal import get_journal
+from ..metric import LatencySummary
+from ..observability import trace as _trace
+from ..resilience import atomic as _atomic
+from .batcher import RequestError, ServerOverloaded
+from .cache import CompiledPredictor
+from .reload import ParamStore
+from .server import (Server, ServerConfig, _end_span, _env_float,
+                     _env_int)
+
+__all__ = ["Fleet", "FleetConfig", "SLOClass", "TenantQuarantined",
+           "SLO_CLASSES"]
+
+ADMITTED, QUARANTINED, HALF_OPEN = "admitted", "quarantined", "half_open"
+
+
+class TenantQuarantined(RequestError):
+    """The tenant's per-tenant breaker is open: its checkpoint, shapes,
+    or predictor faulted past the threshold and the tenant is out of
+    admission until a half-open probe succeeds.  Not retryable — the
+    fault is the tenant's own artifact (shared commit root / model),
+    so another replica would fail the same way."""
+
+    retryable = False
+
+    def __init__(self, tenant, reason, state=QUARANTINED):
+        super().__init__(
+            f"tenant {tenant!r} quarantined ({reason}) — its own "
+            "checkpoint/shape/predictor faults tripped the per-tenant "
+            "breaker; other tenants are unaffected")
+        self.tenant = tenant
+        self.reason = reason
+        self.state = state
+
+
+@dataclass(frozen=True)
+class SLOClass:
+    """One admission class: ``priority`` 0 is highest (keeps the full
+    queue bound; each tier below halves its share), ``deadline_floor_ms``
+    lifts any shorter requested deadline (the class's latency promise is
+    also its minimum patience), ``rate_rps``/``burst`` arm a per-tenant
+    token bucket (0 = unlimited)."""
+
+    name: str = "standard"
+    priority: int = 0
+    deadline_floor_ms: float = 0.0
+    rate_rps: float = 0.0
+    burst: float = 8.0
+
+
+SLO_CLASSES = {
+    "gold": SLOClass("gold", priority=0),
+    "silver": SLOClass("silver", priority=1),
+    "bronze": SLOClass("bronze", priority=2),
+}
+
+
+@dataclass
+class FleetConfig(ServerConfig):
+    """Fleet knobs on top of :class:`ServerConfig` (docs/serving.md;
+    ``MXNET_TPU_TENANT_*`` env vars set fleet-wide defaults)."""
+
+    max_hot_tenants: int = field(default_factory=lambda: _env_int(
+        "MXNET_TPU_TENANT_MAX_HOT", 4))
+    tenant_breaker_k: int = field(default_factory=lambda: _env_int(
+        "MXNET_TPU_TENANT_BREAKER_K", 3))
+    tenant_cooldown_s: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_TENANT_COOLDOWN_S", 5.0))
+
+
+class _TokenBucket:
+    """Per-tenant rate budget: ``rate_rps`` tokens/s up to ``burst``;
+    an admission costs one token.  0 rate = unlimited."""
+
+    __slots__ = ("rate", "burst", "tokens", "stamp")
+
+    def __init__(self, rate_rps, burst):
+        self.rate = float(rate_rps)
+        self.burst = max(float(burst), 1.0)
+        self.tokens = self.burst
+        self.stamp = time.monotonic()
+
+    def allow(self) -> bool:
+        if self.rate <= 0:
+            return True
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class TenantState:
+    """One tenant's fault domain: model handle (device block when hot,
+    host-RAM parameter snapshot when cold), ParamStore, SLO class,
+    breaker, rate bucket, counters, and latency summary."""
+
+    def __init__(self, name, factory, store, slo):
+        self.name = name
+        self.factory = factory
+        self.store = store
+        self.slo = slo
+        self.block = None              # device-resident only while hot
+        self.host_params = None        # name -> np.ndarray cold snapshot
+        self.params_step = None
+        self.last_reload_check = None
+        self.bucket = _TokenBucket(slo.rate_rps, slo.burst)
+        self.latency = LatencySummary(f"tenant_{name}_ms")
+        # breaker
+        self.state = ADMITTED
+        self.failures = 0
+        self.opened_t = None
+        self.probing = False
+        self.reason = None
+        self.removed = False
+        self.reload_forced = False     # reload_tenant() -> worker applies
+        self.counters = {"accepted": 0, "served": 0, "shed": 0,
+                         "rejected_shape": 0, "quarantine_rejects": 0,
+                         "errors": 0, "deadline_miss": 0, "reloads": 0,
+                         "page_ins": 0, "page_outs": 0, "quarantines": 0,
+                         "readmissions": 0}
+
+
+class Fleet(Server):
+    """Multi-tenant serving engine: one worker thread, one bounded
+    queue, N isolated tenant families.  ``submit(x, tenant=...)`` is
+    the whole client-side difference from a single-tenant Server."""
+
+    def __init__(self, config=None, ctx=None):
+        super().__init__(block=None, config=config or FleetConfig(),
+                         ctx=ctx)
+        if not isinstance(self.config, FleetConfig):
+            # a plain ServerConfig still works: fleet knobs fall back
+            # to the env/default values
+            base, self.config = self.config, FleetConfig()
+            for f in base.__dataclass_fields__:
+                setattr(self.config, f, getattr(base, f))
+        self.tenants: "OrderedDict[str, TenantState]" = OrderedDict()
+        self._hot: "OrderedDict[str, bool]" = OrderedDict()  # LRU, newest last
+        self._tlock = threading.RLock()
+        self._group_key = lambda r: (r.tenant, r.key)
+
+    # -- tenant registry (hot add/remove/reload) -------------------------
+    def add_tenant(self, name, factory=None, block=None, ckpt_root=None,
+                   slo=None, params_file=None) -> "Fleet":
+        """Register (or hot-add, while serving) one tenant.  ``factory``
+        builds its initialized block on page-in; a prebuilt ``block``
+        is wrapped into a factory and starts hot-eligible.  ``slo`` is
+        an :class:`SLOClass` or a preset name (``gold|silver|bronze``,
+        default gold)."""
+        name = str(name)
+        if factory is None and block is None:
+            raise ValueError(f"tenant {name!r} needs factory= or block=")
+        if factory is None:
+            factory = lambda: block                      # noqa: E731
+        if isinstance(slo, str):
+            slo = SLO_CLASSES[slo]
+        slo = slo or SLO_CLASSES["gold"]
+        store = ParamStore(ckpt_root, params_file=params_file) \
+            if ckpt_root else None
+        with self._tlock:
+            if name in self.tenants and not self.tenants[name].removed:
+                raise ValueError(f"tenant {name!r} already registered")
+            self.tenants[name] = TenantState(name, factory, store, slo)
+        get_journal().event("tenant_add", tenant=name, slo=slo.name,
+                            priority=slo.priority, ckpt_root=ckpt_root,
+                            rate_rps=slo.rate_rps)
+        return self
+
+    def remove_tenant(self, name) -> None:
+        """Hot-remove: admission rejects immediately; queued requests
+        are resolved structurally at dequeue; device parameters and
+        compiled predictors are dropped."""
+        name = str(name)
+        with self._tlock:
+            ts = self.tenants.pop(name, None)
+            if ts is None:
+                raise KeyError(f"unknown tenant {name!r}")
+            ts.removed = True
+            ts.block = None
+            ts.host_params = None
+            self._hot.pop(name, None)
+        dropped = self.cache.drop_where(lambda k: k[0] == name)
+        get_journal().event("tenant_remove", tenant=name,
+                            predictors_dropped=dropped,
+                            **ts.counters)
+
+    def reload_tenant(self, name) -> None:
+        """Request an immediate hot-reload poll for one tenant.  The
+        reload is applied by the WORKER between batches (the hot-reload
+        contract) — never on the caller's thread, where it could swap
+        parameter arrays under a predictor that reads them per call
+        (torn old/new mix).  A cold tenant picks up the newest valid
+        step at page-in regardless."""
+        with self._tlock:
+            self.tenants[str(name)].reload_forced = True
+
+    # -- admission (tenant hooks on Server.submit) -----------------------
+    def _admit_tenant(self, tenant, payload):
+        if tenant is None:
+            err = RequestError("fleet requests must name a tenant "
+                               "(submit(x, tenant=...))")
+            err.retryable = False
+            raise err
+        with self._tlock:
+            ts = self.tenants.get(str(tenant))
+            if ts is None or ts.removed:
+                err = RequestError(f"unknown tenant {tenant!r} — not in "
+                                   "this fleet's registry")
+                err.retryable = True   # another replica may serve it
+                err.tenant = tenant
+                raise err
+            self._breaker_gate(ts)
+            if not ts.bucket.allow():
+                ts.counters["shed"] += 1
+                self._release_probe(ts)
+                with self._lock:
+                    self.counters["shed"] += 1
+                get_journal().event("serving_shed", tenant=ts.name,
+                                    tier="rate_budget",
+                                    rate_rps=ts.slo.rate_rps)
+                raise ServerOverloaded(
+                    self._queue.qsize(), self.config.max_queue,
+                    tier="rate_budget", tenant=ts.name)
+        return ts
+
+    def _release_probe(self, ts):
+        """A half-open probe that never reaches the device (shed,
+        cancelled, deadline-missed) frees the probe slot — or the
+        tenant would silently stay half-open forever."""
+        if ts.state == HALF_OPEN:
+            ts.probing = False
+
+    def _breaker_gate(self, ts):
+        """Quarantine gate at admission (caller holds ``_tlock``): a
+        quarantined tenant rejects until the cooldown elapses, then
+        goes half-open and admits exactly ONE probe."""
+        if ts.state == ADMITTED:
+            return
+        if ts.state == QUARANTINED:
+            cooldown = self.config.tenant_cooldown_s
+            if ts.opened_t is None or \
+                    time.monotonic() - ts.opened_t < cooldown:
+                ts.counters["quarantine_rejects"] += 1
+                raise TenantQuarantined(ts.name, ts.reason or "faulted")
+            self._transition(ts, HALF_OPEN, "cooldown_elapsed")
+        # half-open: one probe in flight at a time.  A probe-slot
+        # rejection is RETRYABLE — it says this replica's slot is busy,
+        # not that the tenant's artifact is broken, so the router may
+        # try a replica where the tenant is fully admitted.
+        if ts.probing:
+            ts.counters["quarantine_rejects"] += 1
+            err = TenantQuarantined(ts.name, "probe in flight", HALF_OPEN)
+            err.retryable = True
+            raise err
+        ts.probing = True
+
+    def _transition(self, ts, to, reason):
+        frm, ts.state = ts.state, to
+        if to == QUARANTINED:
+            ts.opened_t = time.monotonic()
+            ts.probing = False
+            ts.counters["quarantines"] += 1
+        if to == ADMITTED:
+            ts.failures = 0
+            ts.probing = False
+            if frm == HALF_OPEN:
+                ts.counters["readmissions"] += 1
+        ts.reason = reason
+        # the transition gets its own span (inheriting the request/batch
+        # trace when one is active, a fresh root otherwise) so the
+        # quarantine -> half-open -> re-admit trail is ALWAYS
+        # trace-correlated in the journal, whichever thread trips it
+        with _trace.span("tenant_quarantine", tenant=ts.name, frm=frm,
+                         to=to, reason=reason):
+            get_journal().event("tenant_quarantine", tenant=ts.name,
+                                frm=frm, to=to, reason=reason,
+                                failures=ts.failures)
+
+    def _tenant_failure(self, ts, reason):
+        """One breaker feed: shape reject, corrupt committed checkpoint,
+        or non-transient predictor error.  K consecutive failures — or
+        any failure while half-open — quarantine the tenant (only)."""
+        with self._tlock:
+            ts.failures += 1
+            if ts.state == HALF_OPEN:
+                self._transition(ts, QUARANTINED, f"probe_failed:{reason}")
+            elif ts.state == ADMITTED and \
+                    ts.failures >= self.config.tenant_breaker_k:
+                self._transition(ts, QUARANTINED, reason)
+
+    def _note_reject(self, tenant):
+        with self._tlock:
+            ts = self.tenants.get(str(tenant)) \
+                if tenant is not None else None
+            if ts is None:
+                return
+            ts.counters["rejected_shape"] += 1
+        self._tenant_failure(ts, "shape_reject")
+
+    def _note_shed(self, tenant):
+        with self._tlock:
+            ts = self.tenants.get(str(tenant)) \
+                if tenant is not None else None
+            if ts is not None:
+                ts.counters["shed"] += 1
+                self._release_probe(ts)
+
+    def _note_accept(self, tenant):
+        with self._tlock:
+            ts = self.tenants.get(str(tenant)) \
+                if tenant is not None else None
+            if ts is not None:
+                ts.counters["accepted"] += 1
+
+    def _note_cancelled(self, tenant):
+        with self._tlock:
+            ts = self.tenants.get(str(tenant)) \
+                if tenant is not None else None
+            if ts is not None:
+                self._release_probe(ts)
+
+    def _note_deadline_miss(self, tenant):
+        with self._tlock:
+            ts = self.tenants.get(str(tenant)) \
+                if tenant is not None else None
+            if ts is not None:
+                ts.counters["deadline_miss"] += 1
+                self._release_probe(ts)
+
+    def _effective_deadline(self, deadline_ms, ts):
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        floor = ts.slo.deadline_floor_ms if ts is not None else 0.0
+        if floor and deadline_ms is not None and 0 < deadline_ms < floor:
+            return floor
+        return deadline_ms
+
+    def _class_gate(self, ts, tenant):
+        """Shed per tenant CLASS first, never global: priority p keeps
+        ``max_queue / 2**p`` of the shared bound, so as depth grows the
+        lowest classes shed while priority-0 traffic still lands."""
+        if ts is None or ts.slo.priority <= 0:
+            return
+        share = int(self.config.max_queue / (2 ** ts.slo.priority))
+        depth = self._queue.qsize()
+        if depth >= max(share, 1):
+            with self._tlock:
+                ts.counters["shed"] += 1
+                self._release_probe(ts)
+            with self._lock:
+                self.counters["shed"] += 1
+            get_journal().event("serving_shed", tenant=ts.name,
+                                tier="class_budget", depth=depth,
+                                share=share, priority=ts.slo.priority)
+            raise ServerOverloaded(depth, share, tier="class_budget",
+                                   tenant=ts.name)
+
+    # -- worker-side sweeps ----------------------------------------------
+    def _sweep_unroutable(self, pending):
+        """Resolve queued requests of quarantined/removed tenants at
+        dequeue — a poisoned flood must not keep spending batch slots
+        (the half-open probe is the one exception)."""
+        keep = []
+        for req in pending:
+            with self._tlock:
+                ts = self.tenants.get(req.tenant)
+                drop = None
+                if ts is None or ts.removed:
+                    drop = RequestError(
+                        f"tenant {req.tenant!r} removed while queued")
+                    drop.tenant = req.tenant
+                elif ts.state == QUARANTINED:
+                    ts.counters["quarantine_rejects"] += 1
+                    drop = TenantQuarantined(ts.name,
+                                             ts.reason or "faulted")
+            if drop is None:
+                keep.append(req)
+            else:
+                _end_span(req, "quarantined")
+                req.set_error(drop)
+        pending[:] = keep
+
+    # -- predictor acquisition + weight paging ---------------------------
+    def _acquire_predictor(self, batch, bucket, key):
+        tenant = batch[0].tenant
+        with self._tlock:
+            ts = self.tenants.get(tenant)
+            if ts is None or ts.removed:
+                raise RequestError(f"tenant {tenant!r} removed")
+        block = self._page_in(ts)
+        cache_key = (tenant, bucket, key, self._dtype.str)
+        return self.cache.get(
+            cache_key, lambda: CompiledPredictor(block, ctx=self._ctx))
+
+    def _page_in(self, ts):
+        """Device-residency for one tenant (worker thread only): hot
+        tenants just refresh LRU position; a cold tenant builds its
+        block, restores the host-RAM snapshot, catches up to the newest
+        valid committed step, and may page out the stalest hot tenant.
+        The heavy build runs OUTSIDE ``_tlock`` so admission on other
+        tenants never waits on a page-in; the cost is journaled so
+        paging reads as paging — never as a hot tenant's tail latency
+        (the batch's ``exec_ms`` excludes this window)."""
+        with self._tlock:
+            if ts.block is not None:
+                self._hot[ts.name] = True
+                self._hot.move_to_end(ts.name)
+                return ts.block
+            host = ts.host_params
+        t0 = time.perf_counter()
+        block = ts.factory()
+        if host:
+            from .. import ndarray as nd
+            block.load_dict({k: nd.array(v) for k, v in host.items()},
+                            ctx=self._ctx, ignore_extra=True)
+        doomed = []
+        with self._tlock:
+            if ts.removed:
+                # remove_tenant raced the build: do not resurrect the
+                # tenant into the hot set off a stale handle
+                raise RequestError(f"tenant {ts.name!r} removed")
+            ts.host_params = None
+            ts.block = block
+            ts.counters["page_ins"] += 1
+            self._hot[ts.name] = True
+            self._hot.move_to_end(ts.name)
+            while len(self._hot) > max(self.config.max_hot_tenants, 1):
+                cold_name, _ = self._hot.popitem(last=False)
+                cold = self.tenants.get(cold_name)
+                if cold is not None:
+                    doomed.append(cold)
+            hot_now = list(self._hot)
+        # the device->host snapshot of evicted tenants runs OUTSIDE the
+        # lock: a page-out must not stall admission on other tenants
+        # (cold blocks are only ever touched by this worker thread)
+        for cold in doomed:
+            self._page_out(cold)
+        self._reload_tenant(ts, force=True)    # newest valid step now
+        get_journal().event(
+            "tenant_page_in", tenant=ts.name,
+            cost_ms=round((time.perf_counter() - t0) * 1000.0, 2),
+            evicted=[c.name for c in doomed], hot=hot_now)
+        return block
+
+    def _page_out(self, ts):
+        """Snapshot parameters to host RAM, release the device block,
+        and drop the tenant's compiled predictors.  Worker thread only;
+        operates on a local block handle so a concurrent
+        ``remove_tenant`` (which nulls ``ts.block``) can't trip it."""
+        block = ts.block
+        if block is None:
+            return
+        snap = {}
+        for name, param in block._structural_names().items():
+            try:
+                arr = param.data(param.list_ctx()[0])
+            except Exception:
+                continue               # uninitialized: factory rebuilds it
+            snap[name] = np.asarray(getattr(arr, "_data", arr))
+        with self._tlock:
+            if not ts.removed:
+                ts.host_params = snap
+            ts.block = None
+            ts.counters["page_outs"] += 1
+        dropped = self.cache.drop_where(lambda k: k[0] == ts.name)
+        get_journal().event("tenant_page_out", tenant=ts.name,
+                            n_params=len(snap),
+                            predictors_dropped=dropped)
+
+    # -- execution hooks --------------------------------------------------
+    def _trip_sites(self, batch):
+        _atomic.trip("serving_predict", self._metrics_id)
+        # per-tenant chaos seam: path carries the tenant name so
+        # faults.slow_call/io_error/tenant_poison target one tenant
+        _atomic.trip("serving_tenant", batch[0].tenant)
+
+    def _note_predict_error(self, batch, exc):
+        ts = self.tenants.get(batch[0].tenant)
+        if ts is None:
+            return
+        ts.counters["errors"] += len(batch)
+        self._tenant_failure(ts, f"predictor_error:{type(exc).__name__}")
+
+    def _batch_step(self, batch):
+        ts = self.tenants.get(batch[0].tenant)
+        return None if ts is None else ts.params_step
+
+    def _batch_fields(self, batch):
+        ts = self.tenants.get(batch[0].tenant)
+        # the serving_batch record's p50/p95/p99 are FLEET-wide (the
+        # shared latency summary); stamp this tenant's own p99 too so
+        # the per-tenant report never attributes another tenant's tail
+        # to this one
+        p99 = None if ts is None or not ts.latency.count \
+            else ts.latency.percentile(99)
+        return {"tenant": batch[0].tenant, "tenant_p99_ms": p99}
+
+    def _observe_latency(self, req, ms):
+        self.latency.observe(ms)
+        ts = self.tenants.get(req.tenant)
+        if ts is not None:
+            ts.latency.observe(ms)
+
+    def _batch_succeeded(self, batch):
+        ts = self.tenants.get(batch[0].tenant)
+        if ts is None:
+            return
+        ts.counters["served"] += sum(1 for r in batch
+                                     if r.error is None)
+        with self._tlock:
+            if ts.state == HALF_OPEN:
+                self._transition(ts, ADMITTED, "probe_succeeded")
+            else:
+                ts.failures = 0        # consecutive-failure semantics
+                ts.probing = False
+
+    # -- hot-reload (per tenant) ------------------------------------------
+    def _maybe_reload(self, force=False):
+        poll_s = self.config.reload_poll_s
+        if poll_s < 0 and not force:
+            return False
+        now = time.monotonic()
+        any_reloaded = False
+        with self._tlock:
+            states = [ts for ts in self.tenants.values()
+                      if ts.store is not None and ts.block is not None]
+        for ts in states:
+            forced = ts.reload_forced
+            if not force and not forced and \
+                    ts.last_reload_check is not None and \
+                    now - ts.last_reload_check < poll_s:
+                continue
+            ts.reload_forced = False
+            any_reloaded |= self._reload_tenant(ts, force=force or forced)
+        return any_reloaded
+
+    def _reload_tenant(self, ts, force=False):
+        """One tenant's poll/validate/apply cycle.  A corrupt committed
+        candidate (CRC fail — ``ckpt_fallback`` journaled by the store)
+        or an inapplicable dict (architecture drift) feeds THIS tenant's
+        breaker and nobody else's."""
+        store = ts.store
+        if store is None or ts.block is None:
+            return False
+        ts.last_reload_check = time.monotonic()
+        corrupt_before = store.corrupt_seen
+        got = store.poll()
+        corrupt_delta = store.corrupt_seen - corrupt_before
+        for _ in range(corrupt_delta):
+            self._tenant_failure(ts, "ckpt_corrupt")
+        if got is None:
+            return False
+        step, loaded = got
+        prev = ts.params_step
+        loaded = {k: v for k, v in loaded.items()
+                  if not k.startswith("__")}
+        try:
+            self._check_reloadable_block(ts.block, loaded)
+            ts.block.load_dict(loaded, ctx=self._ctx, ignore_extra=True)
+        except Exception as e:
+            store.mark_bad(step, revert_to=prev)
+            get_journal().event("serving_reload_failed", tenant=ts.name,
+                                step=step, error=type(e).__name__,
+                                detail=str(e)[:300])
+            self._tenant_failure(ts, "ckpt_inapplicable")
+            return False
+        ts.params_step = step
+        ts.counters["reloads"] += 1
+        with self._lock:
+            self.counters["reloads"] += 1
+        get_journal().event("serving_reload", tenant=ts.name, step=step,
+                            n_params=len(loaded), prev_step=prev)
+        return True
+
+    def _check_reloadable_block(self, block, loaded):
+        """``Server._check_reloadable`` against an explicit block (the
+        fleet has N of them)."""
+        saved_block, self.block = self.block, block
+        try:
+            self._check_reloadable(loaded)
+        finally:
+            self.block = saved_block
+
+    # -- reporting ---------------------------------------------------------
+    def tenant_stats(self) -> dict:
+        out = {}
+        with self._tlock:
+            states = list(self.tenants.values())
+        for ts in states:
+            out[ts.name] = {
+                "state": ts.state, "reason": ts.reason,
+                "slo": ts.slo.name, "priority": ts.slo.priority,
+                "hot": ts.block is not None,
+                "params_step": ts.params_step,
+                "latency_ms": ts.latency.summary(),
+                **ts.counters}
+        return out
+
+    def stats(self) -> dict:
+        st = super().stats()
+        st["tenants"] = self.tenant_stats()
+        return st
+
+    def beacon(self) -> dict:
+        """Readiness beacon + served-tenant advertisement: the replica
+        pool's heartbeat ledger (elastic.membership) carries which
+        tenants this replica serves and their quarantine state, so a
+        tenant-aware router can place around a quarantined tenant
+        without touching the replica."""
+        doc = super().beacon()
+        with self._tlock:
+            doc["tenants"] = {ts.name: {"state": ts.state,
+                                        "step": ts.params_step}
+                              for ts in self.tenants.values()}
+        return doc
+
+    def metrics_text(self) -> str:
+        """Server families plus the tenant-labeled families:
+        ``mxnet_tpu_serving_tenant_events{tenant,event}``,
+        ``..._tenant_state`` (0 admitted / 1 half-open / 2 quarantined),
+        and ``..._tenant_latency_ms{tenant,quantile}``."""
+        from ..observability import metrics as _m
+        super().metrics_text()         # mirrors the fleet-wide families
+        reg = _m.default_registry()
+        code = {ADMITTED: 0, HALF_OPEN: 1, QUARANTINED: 2}
+        ev = reg.gauge("mxnet_tpu_serving_tenant_events",
+                       "per-tenant serving counters (cumulative)",
+                       ("tenant", "event"))
+        stg = reg.gauge("mxnet_tpu_serving_tenant_state",
+                        "tenant breaker (0 admitted, 1 half-open, "
+                        "2 quarantined)", ("tenant",))
+        lq = reg.gauge("mxnet_tpu_serving_tenant_latency_ms",
+                       "per-tenant end-to-end latency percentiles",
+                       ("tenant", "quantile"))
+        counter_keys = ("accepted", "served", "shed", "rejected_shape",
+                        "quarantine_rejects", "errors", "deadline_miss",
+                        "reloads", "page_ins", "page_outs",
+                        "quarantines", "readmissions")
+        for name, row in self.tenant_stats().items():
+            stg.labels(tenant=name).set(code.get(row["state"], 0))
+            for k in counter_keys:
+                ev.labels(tenant=name, event=k).set(row[k])
+            lat = row["latency_ms"]
+            if lat["count"]:
+                for q in ("p50", "p95", "p99"):
+                    lq.labels(tenant=name, quantile=q).set(lat[q])
+        return reg.prometheus_text()

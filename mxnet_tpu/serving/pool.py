@@ -120,6 +120,10 @@ class ReplicaState:
     port: object = None
     pid: object = None
     idle_s: float = 0.0
+    # served-tenant advertisement from a fleet replica's beacon:
+    # {tenant: {"state": admitted|half_open|quarantined, "step": N}};
+    # None = single-tenant replica (tenant-agnostic placement)
+    tenants: object = None
 
 
 def _wait_for(predicate, deadline_s, poll_s=0.05, what="condition"):
@@ -167,7 +171,7 @@ class LocalReplica:
         self._hb.start()
         return self
 
-    def predict(self, x, deadline_ms, cancel=None):
+    def predict(self, x, deadline_ms, cancel=None, tenant=None):
         """One attempt on this replica; returns ``(array, meta)`` or
         raises a structured serving error."""
         srv = self.server
@@ -175,7 +179,8 @@ class LocalReplica:
             raise ReplicaUnavailable(self.id, "not started")
         budget_s = (deadline_ms / 1000.0 if deadline_ms
                     else srv.config.result_timeout_s)
-        resp = srv.submit(x, deadline_ms=deadline_ms, cancel=cancel)
+        resp = srv.submit(x, deadline_ms=deadline_ms, cancel=cancel,
+                          tenant=tenant)
         value = resp.result(timeout_s=budget_s + 5.0)
         return value, {"replica": self.id,
                        "params_step": resp.params_step}
@@ -266,29 +271,45 @@ class ProcReplica:
     def _raise_remote(header):
         name = header.get("error", "RequestError")
         detail = header.get("detail", "")
+        tenant = header.get("tenant")
         if name == "DeadlineExceeded":
             raise DeadlineExceeded(header.get("stage", "remote"),
-                                   float(header.get("late_ms", 0.0)))
+                                   float(header.get("late_ms", 0.0)),
+                                   tenant=tenant)
         if name == "ServerOverloaded":
             raise ServerOverloaded(header.get("depth", -1),
                                    header.get("limit", -1),
-                                   tier=header.get("tier"))
+                                   tier=header.get("tier"),
+                                   tenant=tenant)
         if name == "ServerStopped":
             raise ServerStopped(detail or "replica stopped")
+        if name == "TenantQuarantined":
+            from .fleet import TenantQuarantined
+            err = TenantQuarantined(tenant,
+                                    header.get("reason", detail or
+                                               "remote quarantine"))
+            # preserve the wire verdict: a half-open probe-slot-busy
+            # rejection is retryable on another replica; the class
+            # default (False) only fits a real quarantine
+            err.retryable = bool(header.get("retryable", False))
+            raise err
         err = RequestError(f"{name}: {detail}")
         err.retryable = bool(header.get("retryable", True))
+        err.tenant = tenant
         raise err
 
-    def predict(self, x, deadline_ms, cancel=None):
+    def predict(self, x, deadline_ms, cancel=None, tenant=None):
         # `cancel` has no remote lever: a losing hedge's reply is simply
         # discarded by the router (in-process replicas do cancel at
         # dequeue; docs/serving.md notes the asymmetry)
         x = np.ascontiguousarray(x)
         budget_s = deadline_ms / 1000.0 if deadline_ms else 60.0
+        header = {"cmd": "predict", "shape": list(x.shape),
+                  "dtype": str(x.dtype), "deadline_ms": deadline_ms}
+        if tenant is not None:
+            header["tenant"] = str(tenant)
         header, payload = self._roundtrip(
-            {"cmd": "predict", "shape": list(x.shape),
-             "dtype": str(x.dtype), "deadline_ms": deadline_ms},
-            x.tobytes(), budget_s=budget_s)
+            header, x.tobytes(), budget_s=budget_s)
         if not header.get("ok"):
             self._raise_remote(header)
         out = np.frombuffer(payload, dtype=header["dtype"]).reshape(
@@ -426,7 +447,8 @@ class ReplicaPool:
                 params_step=doc.get("params_step"),
                 last_batch_age_s=doc.get("last_batch_age_s"),
                 port=doc.get("port"), pid=doc.get("pid"),
-                idle_s=round(idle or 0.0, 3)))
+                idle_s=round(idle or 0.0, 3),
+                tenants=doc.get("tenants")))
         self._view_cache = (out, now)
         return out
 

@@ -19,12 +19,20 @@ requests simply ride whichever version their batch started with.
 from __future__ import annotations
 
 import os
+from collections import OrderedDict
 
 from ..base import MXNetError
 from ..diagnostics.journal import get_journal
 from ..resilience import commit as _commit
 
 __all__ = ["ParamStore"]
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 class ParamStore:
@@ -34,13 +42,25 @@ class ParamStore:
     dir; default picks the first ``*.params`` manifest entry (a
     ``Block.save_parameters`` or ``HybridBlock.export`` artifact —
     ``arg:``/``aux:`` prefixes are handled by ``load_dict``).
-    """
 
-    def __init__(self, root, params_file=None):
+    The remembered bad-step set is an LRU bounded by ``max_bad_steps``
+    (``MXNET_TPU_SERVING_BAD_STEPS_CAP``, default 64): a long-lived
+    server polling a churning commit root must not grow host memory
+    one entry per corrupt candidate forever.  Evicting a remembered
+    step only costs a re-validation (journaled ``ckpt_fallback`` again)
+    if that step ever resurfaces as a candidate."""
+
+    def __init__(self, root, params_file=None, max_bad_steps=None):
         self.root = root
         self.params_file = params_file
         self.loaded_step = None
-        self._bad_steps = set()
+        self.corrupt_seen = 0          # lifetime count of NEW bad steps
+                                       # (the fleet's per-tenant breaker
+                                       # reads the delta after poll())
+        self._bad_steps = OrderedDict()        # step -> None, LRU order
+        self._bad_cap = max(int(
+            _env_int("MXNET_TPU_SERVING_BAD_STEPS_CAP", 64)
+            if max_bad_steps is None else max_bad_steps), 1)
 
     def _pick_file(self, step, manifest):
         if self.params_file is not None:
@@ -77,8 +97,12 @@ class ParamStore:
                 # ValueError: torn/corrupt per the manifest CRCs;
                 # MXNetError: container-level CRC/truncation from nd.load;
                 # OSError: the step dir raced a trainer's keep-last-k GC
-                # between listing and read — gone is just another skip
-                self._bad_steps.add(step)
+                # between listing and read — gone is just another skip.
+                # Only the first two count as CORRUPTION (corrupt_seen,
+                # which the fleet feeds to a tenant breaker): a benign
+                # GC race must never quarantine a healthy tenant.
+                self._remember_bad(step,
+                                   corrupt=not isinstance(e, OSError))
                 get_journal().event(
                     "ckpt_fallback", root=self.root, step=step,
                     consumer="serving", error=type(e).__name__,
@@ -88,10 +112,32 @@ class ParamStore:
             return step, loaded
         return None
 
+    def _remember_bad(self, step, corrupt=True):
+        """LRU-insert one bad step under the cap; an eviction is
+        journaled once (dedup note) so the operator can see the memory
+        is bounded, not leaking skips silently.  ``corrupt=False``
+        remembers the skip without counting it as corruption (GC races,
+        architecture drift — they feed no breaker)."""
+        if step in self._bad_steps:
+            self._bad_steps.move_to_end(step)
+        else:
+            if corrupt:
+                self.corrupt_seen += 1
+            self._bad_steps[step] = None
+        while len(self._bad_steps) > self._bad_cap:
+            evicted, _ = self._bad_steps.popitem(last=False)
+            get_journal().event(
+                "ckpt_fallback", root=self.root, step=evicted,
+                consumer="serving", note="bad-step memory evicted "
+                "(LRU cap) — re-journals only if it resurfaces",
+                cap=self._bad_cap)
+
     def mark_bad(self, step, revert_to=None):
         """Remember ``step`` as unusable and roll ``loaded_step`` back
         to ``revert_to`` — the server's hook for a checkpoint that
         validated but failed to APPLY (architecture drift), keeping the
-        bad-step bookkeeping in one place."""
-        self._bad_steps.add(step)
+        bad-step bookkeeping in one place.  Not a CRC corruption: the
+        caller already classified (and breaker-fed) this failure, so it
+        must not double-count through ``corrupt_seen``."""
+        self._remember_bad(step, corrupt=False)
         self.loaded_step = revert_to

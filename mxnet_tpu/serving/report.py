@@ -26,7 +26,13 @@ _KINDS = ("serving_start", "serving_stop", "serving_batch", "serving_shed",
           "pool_restart", "pool_reload", "replica_lost",
           "replica_respawn_exhausted", "router_start", "router_stop",
           "router_retry", "router_hedge", "router_breaker", "router_shed",
-          "router_budget_exhausted")
+          "router_budget_exhausted",
+          # the tenant-fleet tier (serving/fleet.py)
+          "tenant_add", "tenant_remove", "tenant_quarantine",
+          "tenant_page_in", "tenant_page_out")
+
+_TENANT_KINDS = ("tenant_add", "tenant_remove", "tenant_quarantine",
+                 "tenant_page_in", "tenant_page_out")
 
 _POOL_KINDS = ("pool_start", "pool_stop", "pool_spawn", "pool_drain",
                "pool_restart", "pool_reload", "replica_lost",
@@ -155,6 +161,74 @@ def serving_report(path) -> dict:
     router = _router_section(records)
     if router is not None:
         out["router"] = router
+    tenants = _tenant_section(records)
+    if tenants is not None:
+        out["tenants"] = tenants
+    return out
+
+
+def _tenant_section(records) -> dict | None:
+    """Tenant-fleet reduction of the last run: per tenant — traffic
+    counts, tenant-classed sheds, the quarantine→half-open→re-admit
+    trail in order (with trace ids), paging counts + total page-in cost
+    (so paging can be told apart from tail latency), and reload steps.
+    The operator view of one tenant-isolation chaos drill
+    (docs/serving.md failure matrix)."""
+    named = [r for r in records
+             if r["kind"] in _TENANT_KINDS or r.get("tenant") is not None]
+    if not any(r["kind"] in _TENANT_KINDS for r in records):
+        return None
+    out: dict = {}
+
+    def row(name):
+        if name not in out:
+            out[name] = {"batches": 0, "served": 0, "shed": 0,
+                         "sheds_by_tier": {}, "rejected_shape": 0,
+                         "deadline_miss": 0, "quarantine_trail": [],
+                         "readmitted": False, "page_ins": 0,
+                         "page_in_cost_ms": 0.0, "page_outs": 0,
+                         "reload_steps": [], "removed": False,
+                         "last_p99_ms": None}
+        return out[name]
+
+    for r in named:
+        name = r.get("tenant")
+        if name is None:
+            continue
+        kind = r["kind"]
+        t = row(name)
+        if kind == "serving_batch":
+            t["batches"] += 1
+            t["served"] += int(r.get("delivered", r.get("batch", 0)))
+            # tenant_p99_ms is THIS tenant's own summary (the record's
+            # p99_ms is fleet-wide and would attribute other tenants'
+            # tails to this one)
+            t["last_p99_ms"] = r.get("tenant_p99_ms")
+        elif kind == "serving_shed":
+            t["shed"] += 1
+            tier = r.get("tier", "queue_full")
+            t["sheds_by_tier"][tier] = t["sheds_by_tier"].get(tier, 0) + 1
+        elif kind == "serving_reject":
+            t["rejected_shape"] += 1
+        elif kind == "serving_deadline_miss":
+            t["deadline_miss"] += 1
+        elif kind == "tenant_quarantine":
+            t["quarantine_trail"].append(
+                {"frm": r.get("frm"), "to": r.get("to"),
+                 "reason": r.get("reason"),
+                 "trace_id": r.get("trace_id")})
+            if r.get("frm") == "half_open" and r.get("to") == "admitted":
+                t["readmitted"] = True
+        elif kind == "tenant_page_in":
+            t["page_ins"] += 1
+            t["page_in_cost_ms"] = round(
+                t["page_in_cost_ms"] + float(r.get("cost_ms") or 0.0), 2)
+        elif kind == "tenant_page_out":
+            t["page_outs"] += 1
+        elif kind == "serving_reload":
+            t["reload_steps"].append(r.get("step"))
+        elif kind == "tenant_remove":
+            t["removed"] = True
     return out
 
 

@@ -41,6 +41,7 @@ import os
 import queue as _queue
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -146,6 +147,9 @@ class Router:
         self._breakers: dict = {}            # rid -> _Breaker
         self._latency: dict = {}             # rid -> LatencySummary
         self._attempt_counts: dict = {}      # rid -> attempts routed
+        # tenant -> request/served/failure counts; LRU-capped (the keys
+        # are request-supplied tenant names — see _note_tenant)
+        self._tenant_counts: OrderedDict = OrderedDict()
         self.counters = {"requests": 0, "served": 0, "attempts": 0,
                          "retries": 0, "hedges": 0, "hedge_wins": 0,
                          "shed": 0, "no_capacity": 0, "failures": 0,
@@ -157,15 +161,18 @@ class Router:
             capacity_floor=self.config.capacity_floor)
 
     # -- client surface --------------------------------------------------
-    def predict(self, x, deadline_ms=None, priority=0):
+    def predict(self, x, deadline_ms=None, priority=0, tenant=None):
         """Route one sample; returns the result value.  Raises the same
         structured errors a single Server does, plus the router tiers
         (``ServerOverloaded(tier=...)``, ``DeadlineExceeded(
-        stage='router_budget')``)."""
+        stage='router_budget')``).  ``tenant`` targets a fleet tenant:
+        placement prefers replicas whose beacon advertises it
+        un-quarantined, and the tenant rides the wire frame."""
         return self.call(x, deadline_ms=deadline_ms,
-                         priority=priority).value
+                         priority=priority, tenant=tenant).value
 
-    def call(self, x, deadline_ms=None, priority=0) -> RouterResponse:
+    def call(self, x, deadline_ms=None, priority=0,
+             tenant=None) -> RouterResponse:
         cfg = self.config
         if deadline_ms is None:
             deadline_ms = cfg.default_deadline_ms
@@ -173,11 +180,14 @@ class Router:
         x = np.asarray(x)
         with self._lock:
             self.counters["requests"] += 1
-        with _trace.span("router_request", priority=priority):
+        self._note_tenant(tenant, "requests")
+        with _trace.span("router_request", priority=priority,
+                         tenant=tenant):
             return self._call_traced(x, deadline_ms, deadline_ts,
-                                     priority)
+                                     priority, tenant)
 
-    def _call_traced(self, x, deadline_ms, deadline_ts, priority):
+    def _call_traced(self, x, deadline_ms, deadline_ts, priority,
+                     tenant=None):
         cfg = self.config
         t0 = time.monotonic()
         self._admit(priority)
@@ -191,18 +201,19 @@ class Router:
             remaining = deadline_ts - time.monotonic()
             if remaining <= 0:
                 break
-            state = self._pick(exclude=tried)
+            state = self._pick(exclude=tried, tenant=tenant)
             if state is None and tried:
                 # every untried replica is unroutable: widen back out
                 # rather than fail a retryable request early
-                state = self._pick(exclude=set())
+                state = self._pick(exclude=set(), tenant=tenant)
             if state is None:
-                self._shed("no_capacity", priority)
+                self._note_tenant(tenant, "failures")
+                self._shed("no_capacity", priority, tenant=tenant)
             tried.add(state.id)
             attempts += 1
             try:
                 value, meta, hedged = self._attempt(
-                    state, x, remaining, attempt)
+                    state, x, remaining, attempt, tenant)
             except RequestError as exc:
                 last_exc = exc
                 hedged_any = hedged_any or getattr(exc, "_hedged", False)
@@ -210,12 +221,14 @@ class Router:
                                      exc)
                 if not getattr(exc, "retryable", False) \
                         or attempt >= cfg.retries:
+                    self._note_tenant(tenant, "failures")
                     raise
                 with self._lock:
                     self.counters["retries"] += 1
                 get_journal().event(
                     "router_retry", replica=state.id, attempt=attempt + 1,
-                    error=type(exc).__name__, detail=str(exc)[:200])
+                    error=type(exc).__name__, detail=str(exc)[:200],
+                    tenant=tenant)
                 pause = min(delays[attempt],
                             max(deadline_ts - time.monotonic(), 0.0))
                 if pause > 0:
@@ -226,6 +239,7 @@ class Router:
                                  (time.monotonic() - t0) * 1000.0)
             with self._lock:
                 self.counters["served"] += 1
+            self._note_tenant(tenant, "served")
             return RouterResponse(
                 value, meta["replica"], meta.get("params_step"),
                 attempts, hedged_any,
@@ -233,23 +247,44 @@ class Router:
         # deadline budget exhausted across retries
         late_ms = max(time.monotonic() - deadline_ts, 0.0) * 1000.0
         err = DeadlineExceeded("router_budget", late_ms,
-                               tier="retry_budget")
+                               tier="retry_budget", tenant=tenant)
         err.__cause__ = last_exc
+        self._note_tenant(tenant, "failures")
         get_journal().event("router_budget_exhausted",
-                            attempts=attempts,
+                            attempts=attempts, tenant=tenant,
                             last_error=type(last_exc).__name__
                             if last_exc else None)
         raise err
 
+    # -- per-tenant bookkeeping ------------------------------------------
+    _TENANT_CAP = 256          # LRU bound: tenant names arrive on the
+                               # request path, so this registry must not
+                               # grow one entry per novel string forever
+
+    def _note_tenant(self, tenant, key):
+        if tenant is None:
+            return
+        with self._lock:
+            row = self._tenant_counts.get(tenant)
+            if row is None:
+                row = self._tenant_counts[tenant] = {
+                    "requests": 0, "served": 0, "failures": 0}
+                while len(self._tenant_counts) > self._TENANT_CAP:
+                    self._tenant_counts.pop(
+                        next(iter(self._tenant_counts)))
+            else:
+                self._tenant_counts.move_to_end(tenant)
+            row[key] += 1
+
     # -- admission tiers -------------------------------------------------
-    def _shed(self, tier, priority, usable=0, total=None):
+    def _shed(self, tier, priority, usable=0, total=None, tenant=None):
         total = len(self.pool.replicas) if total is None else total
         key = "no_capacity" if tier == "no_capacity" else "shed"
         with self._lock:
             self.counters[key] += 1
         get_journal().event("router_shed", tier=tier, priority=priority,
-                            usable=usable, total=total)
-        raise ServerOverloaded(usable, total, tier=tier)
+                            usable=usable, total=total, tenant=tenant)
+        raise ServerOverloaded(usable, total, tier=tier, tenant=tenant)
 
     def _admit(self, priority):
         """Graceful degradation: when live+ready capacity is below the
@@ -312,14 +347,31 @@ class Router:
         # half-open: admissible only while no probe is in flight
         return not br.probing
 
-    def _pick(self, exclude):
+    @staticmethod
+    def _serves_tenant(state, tenant) -> bool:
+        """Tenant-aware placement gate: a fleet replica advertises its
+        tenants (+ quarantine state) in the beacon; route a tenant
+        request only where the tenant is present and un-quarantined.
+        Replicas without a tenant table are tenant-agnostic (a
+        single-tenant worker behind a fleet-free pool)."""
+        if tenant is None or state.tenants is None:
+            return True
+        row = state.tenants.get(str(tenant))
+        if row is None:
+            return False
+        return (row or {}).get("state") != "quarantined"
+
+    def _pick(self, exclude, tenant=None):
         """Least-loaded among live + ready + breaker-admitted replicas
-        (queue depth from the ledger; ties rotate round-robin)."""
+        that serve the tenant (queue depth from the ledger; ties rotate
+        round-robin)."""
         view = self.pool.view()            # ledger file I/O: OUTSIDE the
         candidates = []                    # lock — a slow shared FS must
         with self._lock:                   # not stall every router thread
             for s in view:
                 if s.id in exclude:
+                    continue
+                if not self._serves_tenant(s, tenant):
                     continue
                 if not self._allow(s.id, s.alive, s.ready):
                     continue
@@ -390,7 +442,7 @@ class Router:
                 delay_ms = max(delay_ms, p99 * cfg.hedge_p99_factor)
         return delay_ms / 1000.0
 
-    def _dispatch(self, state, x, budget_s, cancel):
+    def _dispatch(self, state, x, budget_s, cancel, tenant=None):
         """One attempt on one replica (runs in the caller thread or a
         hedge thread).  The trip site is the slow-replica chaos seam —
         path carries the replica id so ``faults.slow_call`` can target
@@ -402,15 +454,18 @@ class Router:
                 self._attempt_counts.get(state.id, 0) + 1
         replica = self.pool.replicas[state.id]
         deadline_ms = budget_s * 1000.0
-        with _trace.span("router_attempt", replica=state.id):
-            return replica.predict(x, deadline_ms, cancel=cancel)
+        with _trace.span("router_attempt", replica=state.id,
+                         tenant=tenant):
+            return replica.predict(x, deadline_ms, cancel=cancel,
+                                   tenant=tenant)
 
-    def _attempt(self, state, x, budget_s, attempt_no):
+    def _attempt(self, state, x, budget_s, attempt_no, tenant=None):
         """Primary attempt with optional hedging; returns
         ``(value, meta, hedged)`` or raises the decisive error."""
         hedge_s = self._hedge_delay_s(state.id)
         if hedge_s is None or hedge_s >= budget_s:
-            value, meta = self._dispatch(state, x, budget_s, None)
+            value, meta = self._dispatch(state, x, budget_s, None,
+                                         tenant)
             return value, meta, False
 
         results = _queue.Queue(maxsize=4)    # bounded: <= 2 writers
@@ -426,7 +481,7 @@ class Router:
             try:
                 remaining = budget_s - (time.monotonic() - t_start)
                 v, m = self._dispatch(st, x, max(remaining, 0.01),
-                                      cancels[st.id])
+                                      cancels[st.id], tenant)
                 results.put_nowait((st, None, v, m))
                 arm.end(status="ok")
             except BaseException as e:
@@ -446,7 +501,8 @@ class Router:
         except _queue.Empty:
             first = None
         if first is None:
-            hedge_state = self._pick(exclude=set(in_flight))
+            hedge_state = self._pick(exclude=set(in_flight),
+                                     tenant=tenant)
             if hedge_state is not None:
                 hedged = True
                 with self._lock:
@@ -507,6 +563,8 @@ class Router:
         with self._lock:
             counters = dict(self.counters)
             attempts = dict(self._attempt_counts)
+            tenants = {t: dict(row)
+                       for t, row in self._tenant_counts.items()}
         per_replica = {}
         for rid in self.pool.replicas:
             br = self._breakers.get(rid)
@@ -516,7 +574,10 @@ class Router:
                 "breaker": br.state if br else CLOSED,
                 "p99_ms": lat.percentile(99) if lat is not None
                 and lat.count else None}
-        return {**counters, "replicas": per_replica}
+        out = {**counters, "replicas": per_replica}
+        if tenants:
+            out["tenants"] = tenants
+        return out
 
     def metrics_text(self) -> str:
         """Prometheus exposition: the router counters/breaker/latency
@@ -528,8 +589,15 @@ class Router:
         ev = reg.gauge("mxnet_tpu_router_events",
                        "router counters (cumulative)", ("event",))
         for k, v in st.items():
-            if k != "replicas":
+            if k not in ("replicas", "tenants"):
                 ev.labels(event=k).set(v)
+        if st.get("tenants"):
+            tev = reg.gauge("mxnet_tpu_router_tenant_events",
+                            "per-tenant router counters (cumulative)",
+                            ("tenant", "event"))
+            for t, row in st["tenants"].items():
+                for k, v in row.items():
+                    tev.labels(tenant=t, event=k).set(v)
         brg = reg.gauge("mxnet_tpu_router_breaker_state",
                         "per-replica breaker (0 closed, 1 half-open, "
                         "2 open)", ("replica",))

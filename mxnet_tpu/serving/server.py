@@ -222,34 +222,81 @@ class Server:
                 "(device wedged mid-batch? see the journal)")
         self._worker = None
 
+    # -- tenant hooks (overridden by serving/fleet.py) -----------------------
+    def _admit_tenant(self, tenant, payload):
+        """Tenant-registry admission gate.  The single-tenant Server
+        serves exactly one anonymous family; the fleet overrides this
+        with registry lookup, quarantine gate, and the token-bucket
+        rate budget.  Returns the tenant state handle (None here)."""
+        if tenant is not None:
+            err = RequestError(
+                f"unknown tenant {tenant!r}: this replica serves a "
+                "single-tenant Server, not a fleet")
+            err.tenant = tenant
+            raise err
+        return None
+
+    def _note_reject(self, tenant):
+        """Shape-reject bookkeeping hook (the fleet feeds its per-tenant
+        breaker here — an oversized-shape flood is a tenant fault)."""
+
+    def _effective_deadline(self, deadline_ms, tstate):
+        """Apply the tenant's SLO deadline floor (fleet); identity for
+        the single-tenant Server."""
+        return self.config.default_deadline_ms if deadline_ms is None \
+            else deadline_ms
+
+    def _class_gate(self, tstate, tenant):
+        """Per-tenant-class queue-depth budget (fleet): shed LOWER
+        priority classes first while the shared queue fills.  No-op for
+        the single-tenant Server (only the hard bound sheds)."""
+
+    def _note_shed(self, tenant):
+        """Per-tenant shed counter hook (fleet)."""
+
+    def _note_accept(self, tenant):
+        """Per-tenant accept counter hook (fleet)."""
+
     # -- client surface ------------------------------------------------------
-    def submit(self, x, deadline_ms=None, cancel=None) -> PendingResponse:
+    def submit(self, x, deadline_ms=None, cancel=None,
+               tenant=None) -> PendingResponse:
         """Admit one sample (NO batch axis).  Raises
         :class:`RequestError` for a shape outside the bucket grid,
         :class:`ServerOverloaded` when the bounded queue is full, and
         :class:`ServerStopped` once ``stop()`` has closed admission.
         ``cancel`` (a ``threading.Event``) is checked at dequeue — the
         hedging router sets it on the losing attempt so a request whose
-        twin already answered never spends a batch slot."""
+        twin already answered never spends a batch slot.  ``tenant``
+        targets a fleet tenant (serving/fleet.py); on a single-tenant
+        Server a non-None tenant is a structured error."""
         payload = np.asarray(x, dtype=self._dtype)
+        if tenant is not None:
+            # normalize ONCE at the door: every downstream lookup
+            # (registry, dequeue sweep, counters, journal) is by the
+            # string key the fleet registered
+            tenant = str(tenant)
+        tstate = self._admit_tenant(tenant, payload)
         key = self.grid.feature_key(payload.shape)
         if key is None:
             with self._lock:
                 self.counters["rejected_shape"] += 1
             get_journal().event("serving_reject", shape=list(payload.shape),
-                                grid=repr(self.grid))
+                                grid=repr(self.grid), tenant=tenant)
+            self._note_reject(tenant)
             err = RequestError(
                 f"request shape {tuple(payload.shape)} exceeds the bucket "
                 f"grid {self.grid!r} — oversized inputs are rejected, "
-                "never compiled")
+                "never compiled"
+                + (f" [tenant: {tenant}]" if tenant else ""))
             err.retryable = False      # every replica shares the grid
+            err.tenant = tenant
             raise err
-        if deadline_ms is None:
-            deadline_ms = self.config.default_deadline_ms
+        deadline_ms = self._effective_deadline(deadline_ms, tstate)
         deadline_s = None if deadline_ms is None or deadline_ms <= 0 \
             else deadline_ms / 1000.0
+        self._class_gate(tstate, tenant)
         req = Request(payload, payload.shape, key, deadline_s=deadline_s,
-                      cancel=cancel)
+                      cancel=cancel, tenant=tenant)
         # one linked span tree per request (docs/observability.md):
         # the root opens here and is closed by whichever thread resolves
         # the request; the worker's batch span links back via span IDs.
@@ -269,11 +316,13 @@ class Server:
             with self._lock:
                 self.counters["shed"] += 1
             get_journal().event("serving_shed", depth=self._queue.qsize(),
-                                limit=self.config.max_queue,
+                                limit=self.config.max_queue, tenant=tenant,
                                 **_req_ids(req))
+            self._note_shed(tenant)
             _end_span(req, "shed")
             raise ServerOverloaded(self._queue.qsize(),
-                                   self.config.max_queue) from None
+                                   self.config.max_queue,
+                                   tenant=tenant) from None
         if stopped:
             with self._lock:
                 self.counters["rejected_stopped"] += 1
@@ -286,11 +335,13 @@ class Server:
                          depth=self._queue.qsize())
         with self._lock:
             self.counters["accepted"] += 1
+        self._note_accept(tenant)
         return PendingResponse(req, self.config.result_timeout_s)
 
-    def predict(self, x, deadline_ms=None, timeout_s=None):
+    def predict(self, x, deadline_ms=None, timeout_s=None, tenant=None):
         """Synchronous convenience: submit + wait."""
-        return self.submit(x, deadline_ms=deadline_ms).result(timeout_s)
+        return self.submit(x, deadline_ms=deadline_ms,
+                           tenant=tenant).result(timeout_s)
 
     def queue_depth(self) -> int:
         """Current admission-queue depth (approximate, lock-free) — the
@@ -431,9 +482,19 @@ class Server:
         """Expire, group, and run one micro-batch off ``pending``."""
         drop_expired(pending, self._on_dequeue_expired)
         self._drop_cancelled(pending)
-        batch, bucket, key = take_batch(pending, self.grid)
+        self._sweep_unroutable(pending)
+        batch, bucket, key = take_batch(pending, self.grid,
+                                        self._group_key)
         if batch:
             self._process(batch, bucket, key)
+
+    # worker-loop grouping/sweep hooks (serving/fleet.py overrides:
+    # per-(tenant, key) batches; quarantined/removed tenants' queued
+    # requests resolved structurally instead of spending batch slots)
+    _group_key = None
+
+    def _sweep_unroutable(self, pending):
+        pass
 
     def _drop_cancelled(self, pending):
         """The dequeue half of hedging: a request whose cancel event is
@@ -445,6 +506,7 @@ class Server:
                 with self._lock:
                     self.counters["cancelled"] += 1
                 get_journal().event("serving_cancelled", **_req_ids(req))
+                self._note_cancelled(req.tenant)
                 _end_span(req, "cancelled")
                 req.set_error(RequestCancelled(
                     "cancelled at dequeue (hedged twin already answered)"))
@@ -452,14 +514,23 @@ class Server:
                 keep.append(req)
         pending[:] = keep
 
+    def _note_cancelled(self, tenant):
+        """Per-tenant cancel hook (the fleet frees a half-open probe
+        slot here)."""
+
     def _on_dequeue_expired(self, req):
         late = req.late_ms()
         with self._lock:
             self.counters["deadline_miss_dequeue"] += 1
         get_journal().event("serving_deadline_miss", stage="dequeue",
-                            late_ms=round(late, 2), **_req_ids(req))
+                            late_ms=round(late, 2), tenant=req.tenant,
+                            **_req_ids(req))
+        self._note_deadline_miss(req.tenant)
         _end_span(req, "deadline_miss_dequeue")
-        req.set_error(DeadlineExceeded("dequeue", late))
+        req.set_error(DeadlineExceeded("dequeue", late, tenant=req.tenant))
+
+    def _note_deadline_miss(self, tenant):
+        """Per-tenant deadline-miss counter hook (fleet)."""
 
     def _fail_remaining(self, pending, why="stopped"):
         while True:
@@ -491,22 +562,62 @@ class Server:
                                for i in [_req_ids(r)] if i]) as bsp:
             self._process_traced(batch, bucket, key, n, cfg, bsp)
 
+    # -- predictor hooks (overridden by serving/fleet.py) --------------------
+    def _acquire_predictor(self, batch, bucket, key):
+        """Return ``(predictor, hit)`` for this batch.  The fleet
+        overrides with per-tenant executables + weight paging (a cold
+        tenant pages host-RAM parameters onto the device here, OUTSIDE
+        the timed execute window, journaled ``tenant_page_in``)."""
+        cache_key = (bucket, key, self._dtype.str)
+        return self.cache.get(
+            cache_key, lambda: CompiledPredictor(self.block, ctx=self._ctx))
+
+    def _trip_sites(self, batch):
+        """Chaos seams consulted per predictor call:
+        ``faults.slow_call("serving_predict", ...)`` injects device
+        latency, ``faults.io_error`` rides the transient retry path.
+        The fleet adds the per-tenant ``serving_tenant`` site."""
+        _atomic.trip("serving_predict", self._metrics_id)
+
+    def _note_predict_error(self, batch, exc):
+        """Non-transient predictor failure hook — the fleet feeds its
+        per-tenant breaker here (a poisoned tenant quarantines itself,
+        never the fleet)."""
+
+    def _batch_step(self, batch):
+        """Checkpoint step stamped on this batch's responses (the
+        fleet answers per tenant)."""
+        return self._params_step
+
+    def _batch_fields(self, batch) -> dict:
+        """Extra journal fields for the ``serving_batch`` record (the
+        fleet adds ``tenant``)."""
+        return {}
+
+    def _observe_latency(self, req, ms):
+        self.latency.observe(ms)
+
+    def _batch_succeeded(self, batch):
+        """Delivered-batch hook — the fleet's half-open tenant probe
+        re-admission rides this."""
+
     def _process_traced(self, batch, bucket, key, n, cfg, bsp):
         padded = np.full((bucket,) + key, cfg.pad_value, dtype=self._dtype)
         for i, req in enumerate(batch):
             padded[(i,) + tuple(slice(0, d) for d in req.shape)] = req.payload
-        cache_key = (bucket, key, self._dtype.str)
-        predictor, hit = self.cache.get(
-            cache_key, lambda: CompiledPredictor(self.block, ctx=self._ctx))
+        tenant = batch[0].tenant
+        try:
+            predictor, hit = self._acquire_predictor(batch, bucket, key)
+        except Exception as exc:
+            self._fail_batch(batch, n, bucket, tenant, exc,
+                             where="serving_page_in")
+            return
         t0 = time.perf_counter()
         try:
             # a cache miss's first call traces + compiles the padded
             # shape: the timed compile event for this jit-miss site
             def _run_predictor(p):
-                # chaos seam: faults.slow_call("serving_predict", ...)
-                # injects device latency here, faults.io_error rides the
-                # same retry path as a real transient device error
-                _atomic.trip("serving_predict", self._metrics_id)
+                self._trip_sites(batch)
                 return predictor(p)
 
             with _obs.maybe_compile_span(
@@ -518,15 +629,8 @@ class Server:
                     retry_on=cfg.transient_errors, what="serving_predict")
             outs = [np.asarray(o) for o in outs]
         except Exception as exc:
-            with self._lock:
-                self.counters["errors"] += n
-            get_journal().crash(exc, where="serving_predict",
-                                batch=n, bucket=bucket)
-            err = RequestError(f"predictor failed: "
-                               f"{type(exc).__name__}: {exc}")
-            for req in batch:
-                _end_span(req, "error")
-                req.set_error(err)
+            self._fail_batch(batch, n, bucket, tenant, exc,
+                             where="serving_predict")
             return
         t1 = time.perf_counter()
         exec_ms = (t1 - t0) * 1000.0
@@ -534,6 +638,7 @@ class Server:
         import jax
         now = time.monotonic()
         delivered = 0
+        step = self._batch_step(batch)
         for i, req in enumerate(batch):
             if req.expired(now):
                 late = req.late_ms(now)
@@ -542,9 +647,12 @@ class Server:
                 get_journal().event("serving_deadline_miss",
                                     stage="post_batch",
                                     late_ms=round(late, 2),
+                                    tenant=req.tenant,
                                     **_req_ids(req))
+                self._note_deadline_miss(req.tenant)
                 _end_span(req, "deadline_miss_post_batch")
-                req.set_error(DeadlineExceeded("post_batch", late), now)
+                req.set_error(DeadlineExceeded("post_batch", late,
+                                               tenant=req.tenant), now)
                 continue
             rows = []
             for o in outs:
@@ -562,14 +670,16 @@ class Server:
                               bucket=bucket)
                 _trace.event("respond", parent=req.trace)
             _end_span(req, "ok")
-            req.params_step = self._params_step    # version stamp
+            req.params_step = step                 # version stamp
             req.set_result(result, now)
             delivered += 1
-            self.latency.observe((now - req.enq_t) * 1000.0)
+            self._observe_latency(req, (now - req.enq_t) * 1000.0)
         self._last_batch_t = time.monotonic()
         with self._lock:
             self.counters["served"] += delivered
             self.counters["batches"] += 1
+        if delivered:
+            self._batch_succeeded(batch)
         lat = self.latency.summary()
         cache_st = self.cache.stats()      # one snapshot: consistent trio
         get_journal().event(
@@ -578,10 +688,28 @@ class Server:
             pad_waste=BucketGrid.pad_waste(
                 n, bucket, [r.shape for r in batch], key),
             cache_hit=hit, exec_ms=round(exec_ms, 2),
-            params_step=self._params_step,
+            params_step=step,
             hits=cache_st["hits"], misses=cache_st["misses"],
             evictions=cache_st["evictions"],
-            p50_ms=lat["p50"], p95_ms=lat["p95"], p99_ms=lat["p99"])
+            p50_ms=lat["p50"], p95_ms=lat["p95"], p99_ms=lat["p99"],
+            **self._batch_fields(batch))
+
+    def _fail_batch(self, batch, n, bucket, tenant, exc, where):
+        """Resolve every member of a failed batch with a structured,
+        tenant-labeled error, journal the crash, and feed the tenant
+        fault-domain hook."""
+        with self._lock:
+            self.counters["errors"] += n
+        get_journal().crash(exc, where=where, batch=n, bucket=bucket,
+                            tenant=tenant)
+        self._note_predict_error(batch, exc)
+        err = RequestError(f"predictor failed: "
+                           f"{type(exc).__name__}: {exc}"
+                           + (f" [tenant: {tenant}]" if tenant else ""))
+        err.tenant = tenant
+        for req in batch:
+            _end_span(req, "error")
+            req.set_error(err)
 
     # -- hot-reload ----------------------------------------------------------
     def _check_reloadable(self, loaded):

@@ -9,13 +9,15 @@ each direction::
     payload_len bytes             (raw C-order array bytes, optional)
 
 Requests: ``{"cmd": "predict", "shape": [...], "dtype": "float32",
-"deadline_ms": ..., ...}`` + array bytes; control commands (``drain``,
-``resume``, ``stats``, ``ping``, ``stop``) carry no payload.  Responses:
+"deadline_ms": ..., "tenant": <name|absent>, ...}`` + array bytes —
+``tenant`` targets one fleet tenant on a multi-tenant worker (absent on
+a single-tenant replica); control commands (``drain``, ``resume``,
+``stats``, ``ping``, ``stop``) carry no payload.  Responses:
 ``{"ok": true, "shape": [...], "dtype": ..., "params_step": N}`` +
 array bytes, or ``{"ok": false, "error": <class name>, "retryable":
-bool, ...}`` — the router maps ``error`` back onto the structured
-serving exceptions (batcher.py) so a remote failure raises exactly like
-a local one.
+bool, "tenant": <name|absent>, ...}`` — the router maps ``error`` back
+onto the structured serving exceptions (batcher.py, fleet.py) so a
+remote failure raises exactly like a local one, fault domain included.
 
 Every read is bounded by the socket timeout the caller set (the G8
 discipline: a dead peer is a structured error, never a hang), and both

@@ -25,6 +25,13 @@ Chaos seam: ``MXNET_TPU_TESTING_SLOW_PREDICT_S=<s>`` installs a
 ``faults.slow_call("serving_predict", s)`` plan at startup — the
 slow-replica shape for hedging/breaker drills, injected in the worker
 process where a real slow device would live.
+
+Fleet mode: ``--tenants "a=scale,b=mlp@/ckpt/b"`` runs a multi-tenant
+:class:`~.fleet.Fleet` behind the same socket — predict frames carry a
+``tenant`` header, failures come back tenant-labeled, and the beacon
+advertises the served tenants + their quarantine state so a
+tenant-aware router places around a quarantined tenant without ever
+touching this process.
 """
 from __future__ import annotations
 
@@ -79,11 +86,31 @@ def _error_doc(exc) -> dict:
     doc = {"ok": False, "error": type(exc).__name__,
            "retryable": bool(getattr(exc, "retryable", True)),
            "detail": str(exc)[:300]}
-    for attr in ("stage", "late_ms", "depth", "limit", "tier"):
+    for attr in ("stage", "late_ms", "depth", "limit", "tier",
+                 "tenant", "reason"):
         v = getattr(exc, attr, None)
         if v is not None:
             doc[attr] = v
     return doc
+
+
+def _parse_tenants(spec: str) -> list:
+    """``--tenants "a=scale,b=mlp@/ckpt/b"`` → [(name, model, root)].
+    ``@root`` is optional; the model is one of the worker models."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, rest = part.partition("=")
+        if not rest:
+            raise ValueError(f"tenant spec {part!r} is not "
+                             "name=model[@ckpt_root]")
+        model, _, root = rest.partition("@")
+        out.append((name.strip(), model.strip(), root.strip() or None))
+    if not out:
+        raise ValueError(f"--tenants {spec!r} names no tenants")
+    return out
 
 
 class _Front:
@@ -177,7 +204,8 @@ class _Front:
                     else self.server.config.result_timeout_s)
         conn.settimeout(budget_s + 10.0)
         try:
-            resp = self.server.submit(x, deadline_ms=deadline_ms)
+            resp = self.server.submit(x, deadline_ms=deadline_ms,
+                                      tenant=header.get("tenant"))
             out = np.asarray(resp.result(timeout_s=budget_s + 5.0))
         except RequestError as exc:
             wire.send_frame(conn, _error_doc(exc))
@@ -215,6 +243,12 @@ def add_worker_args(parser) -> None:
     parser.add_argument("--dim", type=int, default=16)
     parser.add_argument("--ckpt-root", default=None,
                         help="resilience.commit root for hot-reload")
+    parser.add_argument("--tenants", default=None,
+                        help="run a multi-tenant Fleet instead of a "
+                             "single-tenant Server: comma list of "
+                             "name=model[@ckpt_root]; requests then "
+                             "carry a tenant header and the beacon "
+                             "advertises the served tenants")
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--window-ms", type=float, default=2.0)
     parser.add_argument("--max-queue", type=int, default=64)
@@ -237,13 +271,29 @@ def cmd_worker(args) -> int:
         atomic.set_fault_hook(faults.FaultPlan(
             faults.slow_call("serving_predict", float(slow_s))))
 
-    net = _build_block(args.model, args.dim)
-    cfg = ServerConfig(max_batch=args.max_batch, window_ms=args.window_ms,
-                       max_queue=args.max_queue,
-                       default_deadline_ms=args.deadline_ms,
-                       reload_poll_s=args.reload_poll_s)
-    store = ParamStore(args.ckpt_root) if args.ckpt_root else None
-    server = Server(net, config=cfg, param_store=store).start()
+    if getattr(args, "tenants", None):
+        from .fleet import Fleet, FleetConfig
+        cfg = FleetConfig(max_batch=args.max_batch,
+                          window_ms=args.window_ms,
+                          max_queue=args.max_queue,
+                          default_deadline_ms=args.deadline_ms,
+                          reload_poll_s=args.reload_poll_s)
+        server = Fleet(config=cfg)
+        for name, model, root in _parse_tenants(args.tenants):
+            server.add_tenant(
+                name,
+                factory=(lambda m=model: _build_block(m, args.dim)),
+                ckpt_root=root)
+        server.start()
+    else:
+        net = _build_block(args.model, args.dim)
+        cfg = ServerConfig(max_batch=args.max_batch,
+                           window_ms=args.window_ms,
+                           max_queue=args.max_queue,
+                           default_deadline_ms=args.deadline_ms,
+                           reload_poll_s=args.reload_poll_s)
+        store = ParamStore(args.ckpt_root) if args.ckpt_root else None
+        server = Server(net, config=cfg, param_store=store).start()
 
     front = _Front(server, args)
     hb = Heartbeat(args.hb_dir, args.replica_id, args.heartbeat_s,

@@ -39,9 +39,11 @@ import time
 from ..resilience import atomic
 
 __all__ = ["CRASH_POINTS", "FaultError", "FaultPlan", "FaultRule",
-           "PoisonSchedule", "SimulatedCrash", "crash", "inject",
-           "io_error", "poison_batch", "poison_grads", "sigkill",
-           "sigterm", "slow_call", "torn_heartbeat", "write_offsets"]
+           "PoisonError", "PoisonSchedule", "SimulatedCrash",
+           "corrupt_params", "crash", "inject", "io_error",
+           "poison_batch", "poison_grads", "sigkill", "sigterm",
+           "slow_call", "tenant_poison", "torn_heartbeat",
+           "write_offsets"]
 
 # every phase of one atomic file write, in order — plus the commit
 # protocol's own points (publish = the step-dir rename commit point)
@@ -68,6 +70,17 @@ class FaultError(OSError):
     def __init__(self, point, path):
         super().__init__(5, f"injected I/O error at {point}", path)
         self.point = point
+
+
+class PoisonError(RuntimeError):
+    """Injected NON-transient predictor failure: deliberately not an
+    OSError, so the serving transient-retry path must NOT absorb it —
+    it feeds a fleet tenant's breaker instead (docs/serving.md)."""
+
+    def __init__(self, point, path):
+        super().__init__(f"injected predictor poison at {point} ({path})")
+        self.point = point
+        self.path = path
 
 
 class FaultRule:
@@ -127,13 +140,54 @@ def io_error(point, path_part=None, times=1) -> FaultRule:
 
 def slow_call(site, delay_s, path_part=None, times=None) -> FaultRule:
     """Inject ``delay_s`` of latency at a named trip site (e.g. the
-    server's ``serving_predict`` or the pool router's ``router_attempt``,
-    whose path carries the replica id — ``path_part`` targets one
-    replica). Nothing fails, everything is just late: the slow-replica
-    chaos shape that tail-latency hedging and circuit breakers must
-    route around (docs/serving.md failure matrix)."""
+    server's ``serving_predict``, the pool router's ``router_attempt``
+    whose path carries the replica id, or the fleet's ``serving_tenant``
+    whose path carries the tenant name — ``path_part`` targets one
+    replica/tenant). Nothing fails, everything is just late: the
+    slow-replica chaos shape that tail-latency hedging and circuit
+    breakers must route around (docs/serving.md failure matrix)."""
     return FaultRule(site, None, path_part=path_part, times=times,
                      action=lambda p, f, n: time.sleep(delay_s))
+
+
+def tenant_poison(tenant, times=None) -> FaultRule:
+    """Poison ONE fleet tenant's predictor: the ``serving_tenant`` trip
+    site (serving/fleet.py — its path is the tenant name) raises a
+    non-transient :class:`PoisonError` whenever ``tenant``'s batch
+    executes.  The per-tenant isolation drill: the poisoned tenant must
+    quarantine itself (``TenantQuarantined`` after the breaker
+    threshold) while every other tenant's p99 stays put.  Composes
+    with ``slow_call``/``io_error`` at the same site for per-tenant
+    latency/transient injection."""
+    return FaultRule("serving_tenant", lambda p, f, n: PoisonError(p, f),
+                     path_part=str(tenant), times=times)
+
+
+def corrupt_params(root, step, params_file=None, flip_at=None):
+    """Bit-flip a COMMITTED parameter shard post-CRC-manifest: the
+    committed step dir's ``.params`` payload (or ``params_file``) gets
+    one byte XOR'd in place, manifest untouched — the silent-storage-
+    rot shape (cosmic ray, firmware bug, torn RAID rebuild) that only
+    CRC validation can catch.  ``resilience.commit.validate_step`` must
+    now fail the step and a serving ``ParamStore`` must skip it
+    (``ckpt_fallback``), feeding the owning tenant's breaker in a
+    fleet.  Returns the corrupted file's path."""
+    from ..resilience import commit as _commit
+    d = _commit.step_dir(root, step)
+    if params_file is None:
+        names = sorted(f for f in os.listdir(d) if f.endswith(".params"))
+        if not names:
+            raise ValueError(f"no .params payload in {d}")
+        params_file = names[0]
+    path = os.path.join(d, params_file)
+    with open(path, "r+b") as f:
+        data = f.read()
+        if not data:
+            raise ValueError(f"{path} is empty; nothing to corrupt")
+        at = len(data) // 2 if flip_at is None else int(flip_at)
+        f.seek(at)
+        f.write(bytes([data[at] ^ 0xFF]))
+    return path
 
 
 def torn_heartbeat(path_part="hb/", keep_bytes=7, times=1) -> FaultRule:

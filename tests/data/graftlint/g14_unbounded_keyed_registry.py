@@ -1,0 +1,109 @@
+# graftlint: scope=library
+"""G14 fixture: dict/set class attributes indexed by externally-supplied
+keys (request ids, tenant names, step numbers, file names) with inserts
+in public methods but no eviction/cap anywhere in the class — the
+long-lived-server memory-growth hazard class.  Parsed only, never
+executed."""
+from collections import OrderedDict
+
+
+class BadSessionTable:
+    """Grows one entry per novel request/tenant forever."""
+
+    def __init__(self):
+        self._by_request = {}
+        self._seen_steps = set()
+        self._tenant_rows = OrderedDict()
+
+    def admit(self, request_id, doc):
+        self._by_request[request_id] = doc  # expect: G14
+
+    def remember(self, step):
+        self._seen_steps.add(step)  # expect: G14
+
+    def observe(self, tenant):
+        self._tenant_rows.setdefault(tenant, 0)  # expect: G14
+
+
+class BadFileScanner:
+    """The churning-commit-root shape: keys are file names scanned off
+    disk, remembered without bound."""
+
+    def __init__(self):
+        self._bad_files = set()
+
+    def scan(self, names):
+        for fname in names:
+            self._bad_files.add(fname)  # expect: G14
+
+
+class GoodLruCapped:
+    """Same insert, but the class caps the container (len compare +
+    popitem) — the ParamStore bad-step LRU shape."""
+
+    def __init__(self, cap=64):
+        self._by_request = OrderedDict()
+        self._cap = cap
+
+    def admit(self, request_id, doc):
+        self._by_request[request_id] = doc
+        while len(self._by_request) > self._cap:
+            self._by_request.popitem(last=False)
+
+
+class GoodEvictsOnCompletion:
+    """The container has a pop path: entries leave when work finishes."""
+
+    def __init__(self):
+        self._inflight = {}
+
+    def admit(self, request_id, doc):
+        self._inflight[request_id] = doc
+
+    def complete(self, request_id):
+        return self._inflight.pop(request_id, None)
+
+
+class GoodLifecycleReset:
+    """Reassigned on a lifecycle path: bounded per run, not per key."""
+
+    def __init__(self):
+        self._by_request = {}
+
+    def admit(self, request_id, doc):
+        self._by_request[request_id] = doc
+
+    def start_epoch(self):
+        self._by_request = {}
+
+
+class GoodPrivateInsertOnly:
+    """Inserts only in private methods: the class's own callers own the
+    key space (a construction-time registry), out of scope."""
+
+    def __init__(self):
+        self._by_request = {}
+
+    def _admit(self, request_id, doc):
+        self._by_request[request_id] = doc
+
+
+class GoodOperatorKeys:
+    """Key name outside the request-shaped vocabulary: an
+    operator-bounded registry (models, modes, kernels)."""
+
+    def __init__(self):
+        self._by_mode = {}
+
+    def register(self, mode, fn):
+        self._by_mode[mode] = fn
+
+
+class SuppressedTwin:
+    """The disable-comment twin stays silent."""
+
+    def __init__(self):
+        self._by_request = {}
+
+    def admit(self, request_id, doc):
+        self._by_request[request_id] = doc  # graftlint: disable=G14 fixture twin

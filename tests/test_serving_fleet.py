@@ -1,0 +1,615 @@
+"""Multi-tenant model-fleet serving (docs/serving.md tenant matrix).
+
+The headline chaos gate (CI tier 0.5, ``-k smoke``): tenant A is fed a
+corrupt committed checkpoint + an oversized-shape flood + predictor
+poison (``faults.tenant_poison`` on the ``serving_tenant`` trip site)
+while tenant B runs closed-loop load on the SAME fleet — B's p99 stays
+inside its SLO bound with zero structural-corruption errors, A fails
+structurally with tenant-labeled errors and quarantines ITSELF, the
+quarantine→half-open→re-admit trail is trace-correlated in the journal,
+and ``doctor --serving-journal`` renders it.
+
+Around it: SLO-classed admission (token-bucket rate budget, per-class
+queue shares, deadline floors), weight paging (host-RAM tier → device
+on demand, LRU hot set, journaled page-in cost), hot add/remove/reload,
+mixed-version fleets on different commit roots reloading concurrently
+(every response version-stamped with its OWN tenant's old-or-new step),
+the ParamStore bad-step LRU cap, tenant-aware router placement over a
+fleet replica pool, and the bench/report/metrics surfaces.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.diagnostics.journal import reset_journal
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.resilience import atomic, commit
+from mxnet_tpu.serving import (Fleet, FleetConfig, ParamStore,
+                               RequestError, SLOClass, ServerOverloaded,
+                               TenantQuarantined, serving_report)
+from mxnet_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    reset_journal(path)
+    try:
+        yield path
+    finally:
+        reset_journal("stderr")
+
+
+def _records(path, kind=None):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+class Scale(HybridBlock):
+    """y = x * w: shape-agnostic, and the weight value IS the served
+    checkpoint's fingerprint (version-stamp and corruption assertions
+    ride it)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.w = self.params.get("w", shape=(1,), init="ones")
+
+    def hybrid_forward(self, F, x, w):
+        return x * w
+
+
+def _scale_factory():
+    net = Scale()
+    net.initialize()
+    return net
+
+
+def _commit_scale(root, step, value):
+    stage = commit.prepare_stage(root, step)
+    nd.save(os.path.join(stage, "net.params"),
+            {"w": nd.array(np.asarray([value], np.float32))})
+    return commit.finalize(root, step)
+
+
+def _fleet(**cfg_kw):
+    cfg_kw.setdefault("max_batch", 4)
+    cfg_kw.setdefault("window_ms", 1.0)
+    cfg_kw.setdefault("reload_poll_s", 0.05)
+    return Fleet(FleetConfig(**cfg_kw))
+
+
+# -- the chaos gate (CI tier 0.5) --------------------------------------------
+
+def test_fleet_smoke_tenant_isolation_chaos_gate(tmp_path, journal_file):
+    """Corrupt checkpoint + oversized-shape flood + predictor poison on
+    tenant A; closed-loop load on tenant B, same fleet.  B: p99 inside
+    its SLO bound, ZERO structural-corruption errors (every response
+    bit-exact from B's own valid step).  A: every failure structured
+    and tenant-labeled, quarantine trips, half-open probe re-admits.
+    The trail is trace-correlated in the journal and the doctor's
+    serving-journal report renders it."""
+    from mxnet_tpu.diagnostics.__main__ import _summ_serving
+    from mxnet_tpu.observability import trace as obtrace
+    obtrace.configure(mode="ring")
+    try:
+        root_a = str(tmp_path / "ckpt_a")
+        root_b = str(tmp_path / "ckpt_b")
+        _commit_scale(root_a, 101, 5.0)
+        _commit_scale(root_b, 201, 2.0)
+        # A's NEWER step is silently corrupted post-commit (bit flip
+        # behind the CRC manifest): it must be skipped, journaled, and
+        # fed to A's breaker — never served
+        _commit_scale(root_a, 102, 9.0)
+        faults.corrupt_params(root_a, 102)
+
+        fleet = _fleet(tenant_breaker_k=3, tenant_cooldown_s=0.5,
+                       max_queue=64, dim_buckets={0: (4, 16)})
+        fleet.add_tenant("A", factory=_scale_factory, ckpt_root=root_a)
+        fleet.add_tenant("B", factory=_scale_factory, ckpt_root=root_b)
+        fleet.start()
+
+        x = np.ones(4, np.float32)
+        b_lat, b_errors = [], []
+        stop = threading.Event()
+
+        def b_loop():
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    out = fleet.predict(x, tenant="B", deadline_ms=2000)
+                except RequestError as e:     # structural = failure
+                    b_errors.append(e)
+                    continue
+                b_lat.append((time.perf_counter() - t0) * 1000.0)
+                if not np.array_equal(np.asarray(out),
+                                      x * np.float32(2.0)):
+                    b_errors.append(AssertionError(f"corrupt B: {out}"))
+
+        bt = threading.Thread(target=b_loop, daemon=True)
+        bt.start()
+
+        # phase 1: A serves its newest VALID step (101, w=5), not the
+        # corrupt 102
+        out = np.asarray(fleet.predict(x, tenant="A"))
+        assert np.array_equal(out, x * np.float32(5.0))
+
+        # phase 2: oversized-shape flood + predictor poison on A
+        a_errors = []
+        plan = faults.FaultPlan(faults.tenant_poison("A", times=8))
+        prev = atomic.set_fault_hook(plan)
+        try:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                try:
+                    fleet.predict(np.ones(4096, np.float32), tenant="A")
+                except RequestError as e:
+                    a_errors.append(e)
+                try:
+                    fleet.predict(x, tenant="A")
+                except RequestError as e:
+                    a_errors.append(e)
+                if fleet.tenant_stats()["A"]["state"] == "quarantined":
+                    break
+            else:
+                pytest.fail("tenant A never quarantined under "
+                            "shape-flood + poison")
+        finally:
+            atomic.set_fault_hook(prev)
+
+        # quarantined: admission now rejects structurally
+        with pytest.raises(TenantQuarantined) as qe:
+            fleet.predict(x, tenant="A")
+        a_errors.append(qe.value)
+
+        # A's failures: ALL structured serving errors, ALL labeled A
+        assert a_errors
+        assert all(isinstance(e, RequestError) for e in a_errors)
+        assert all(e.tenant == "A" for e in a_errors)
+        assert any(isinstance(e, TenantQuarantined) for e in a_errors)
+
+        # phase 3: cooldown -> half-open probe re-admits A (poison plan
+        # is exhausted), and A still serves its valid step
+        time.sleep(0.6)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                out = fleet.predict(x, tenant="A", deadline_ms=2000)
+                break
+            except RequestError:
+                time.sleep(0.1)
+        else:
+            pytest.fail("tenant A never re-admitted after cooldown")
+        assert np.array_equal(np.asarray(out), x * np.float32(5.0))
+        a_row = fleet.tenant_stats()["A"]
+        assert a_row["state"] == "admitted"
+        assert a_row["readmissions"] >= 1
+
+        # tenant B rode through the whole drill untouched
+        stop.set()
+        bt.join(timeout=10)
+        assert not b_errors, f"tenant B was degraded: {b_errors[:3]}"
+        assert len(b_lat) >= 20
+        p99 = sorted(b_lat)[int(0.99 * (len(b_lat) - 1))]
+        assert p99 < 1500.0, f"tenant B p99 {p99:.0f}ms out of SLO"
+        b_row = fleet.tenant_stats()["B"]
+        assert b_row["state"] == "admitted"
+        assert b_row["errors"] == 0 and b_row["quarantines"] == 0
+        fleet.stop()
+
+        # journal: corrupt candidate skipped + breaker-fed, and the
+        # quarantine -> half_open -> admitted trail is present with
+        # trace correlation on the worker-side transition
+        fallbacks = [r for r in _records(journal_file, "ckpt_fallback")
+                     if r.get("step") == 102]
+        assert fallbacks, "corrupt step 102 never journaled"
+        trail = _records(journal_file, "tenant_quarantine")
+        a_trail = [(r["frm"], r["to"]) for r in trail
+                   if r["tenant"] == "A"]
+        assert ("admitted", "quarantined") in a_trail
+        assert ("quarantined", "half_open") in a_trail
+        assert ("half_open", "admitted") in a_trail
+        assert all(r["tenant"] == "A" for r in trail)
+        assert any(r.get("trace_id") for r in trail
+                   if r["to"] == "quarantined"), \
+            "quarantine transition not trace-correlated"
+
+        # doctor renders the drill
+        rep = serving_report(journal_file)
+        assert rep["ok"]
+        tn = rep["tenants"]
+        assert tn["A"]["quarantine_trail"] and tn["A"]["readmitted"]
+        assert tn["A"]["rejected_shape"] >= 1
+        assert tn["B"]["served"] >= 20
+        assert not tn["B"]["quarantine_trail"]
+        summ = _summ_serving(rep)
+        assert "fleet: 2 tenants" in summ and "re-admitted: ['A']" in summ
+    finally:
+        obtrace.reset_tracer()
+
+
+# -- mixed-version fleets (satellite: rolling-reload x tenant axis) ----------
+
+def test_fleet_smoke_mixed_version_reload_stamps_own_tenant_step(
+        tmp_path, journal_file):
+    """Two tenants on DIFFERENT commit roots hot-reload concurrently
+    under traffic: every response is version-stamped with exactly its
+    own tenant's old-or-new step — never the other tenant's, never a
+    torn value."""
+    root_a = str(tmp_path / "ckpt_a")
+    root_b = str(tmp_path / "ckpt_b")
+    _commit_scale(root_a, 100, 10.0)
+    _commit_scale(root_b, 200, 20.0)
+    fleet = _fleet(reload_poll_s=0.02)
+    fleet.add_tenant("A", factory=_scale_factory, ckpt_root=root_a)
+    fleet.add_tenant("B", factory=_scale_factory, ckpt_root=root_b)
+    fleet.start()
+    x = np.ones(2, np.float32)
+    value_by_step = {100: 10.0, 101: 11.0, 200: 20.0, 201: 21.0}
+    allowed = {"A": {100, 101}, "B": {200, 201}}
+    bad = []
+    stop = threading.Event()
+
+    def client(tenant):
+        while not stop.is_set():
+            resp = fleet.submit(x, tenant=tenant, deadline_ms=4000)
+            try:
+                out = np.asarray(resp.result(10.0))
+            except RequestError:
+                continue              # startup race: not a stamp issue
+            step = resp.params_step
+            if step not in allowed[tenant]:
+                bad.append((tenant, step, "foreign or missing step"))
+            elif not np.array_equal(
+                    out, x * np.float32(value_by_step[step])):
+                bad.append((tenant, step, out.tolist()))
+
+    threads = [threading.Thread(target=client, args=(t,), daemon=True)
+               for t in ("A", "B") for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)
+    # both roots publish a new step mid-traffic, concurrently
+    ca = threading.Thread(
+        target=lambda: _commit_scale(root_a, 101, 11.0), daemon=True)
+    cb = threading.Thread(
+        target=lambda: _commit_scale(root_b, 201, 21.0), daemon=True)
+    ca.start(), cb.start()
+    ca.join(10), cb.join(10)
+    time.sleep(0.6)                   # let both reloads land under load
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    fleet.stop()
+    assert not bad, f"version-stamp violations: {bad[:5]}"
+    steps = {(r["tenant"], r["step"])
+             for r in _records(journal_file, "serving_reload")}
+    assert ("A", 101) in steps and ("B", 201) in steps
+    stamped = {r["tenant"]: r.get("params_step")
+               for r in _records(journal_file, "serving_batch")}
+    assert stamped.get("A") in allowed["A"]
+    assert stamped.get("B") in allowed["B"]
+
+
+# -- ParamStore bad-step LRU (satellite) -------------------------------------
+
+def test_param_store_bad_step_memory_lru_capped(tmp_path, journal_file):
+    """A long-lived server scanning a churning commit root must not
+    grow the remembered corrupt-candidate set without bound: the LRU
+    cap holds, evictions journal a dedup note, and an evicted step that
+    resurfaces is simply re-validated (and re-skipped)."""
+    root = str(tmp_path / "ckpt")
+    store = ParamStore(root, max_bad_steps=4)
+    for step in range(1, 10):
+        _commit_scale(root, step, float(step))
+        faults.corrupt_params(root, step)
+    assert store.poll() is None           # every candidate corrupt
+    assert len(store._bad_steps) <= 4
+    assert store.corrupt_seen == 9
+    notes = [r for r in _records(journal_file, "ckpt_fallback")
+             if r.get("note")]
+    assert notes and notes[0]["cap"] == 4
+    # a now-valid newest step still wins through the churn
+    _commit_scale(root, 10, 10.0)
+    step, loaded = store.poll()
+    assert step == 10
+    # poll again: nothing newer -> None, remembered steps stay capped
+    assert store.poll() is None
+    assert len(store._bad_steps) <= 4
+
+
+def test_corrupt_params_flips_committed_shard_post_manifest(tmp_path):
+    """``faults.corrupt_params`` corrupts the payload BEHIND the CRC
+    manifest: commit listing still shows the step, validation fails,
+    and a ParamStore skips it to the previous valid step."""
+    root = str(tmp_path / "ckpt")
+    _commit_scale(root, 1, 1.0)
+    _commit_scale(root, 2, 2.0)
+    path = faults.corrupt_params(root, 2)
+    assert path.endswith("net.params")
+    assert 2 in commit.committed_steps(root)
+    with pytest.raises(ValueError):
+        commit.validate_step(root, 2)
+    store = ParamStore(root)
+    step, loaded = store.poll()
+    assert step == 1
+    assert store.corrupt_seen == 1
+
+
+# -- SLO-classed admission ---------------------------------------------------
+
+def test_rate_budget_sheds_only_its_tenant(journal_file):
+    """A tenant over its token-bucket rate budget sheds with a
+    tenant-labeled ``rate_budget`` tier; the unlimited tenant on the
+    same fleet is untouched."""
+    fleet = _fleet(max_queue=64)
+    fleet.add_tenant("greedy", factory=_scale_factory,
+                     slo=SLOClass("capped", rate_rps=1.0, burst=2))
+    fleet.add_tenant("calm", factory=_scale_factory)
+    fleet.start()
+    x = np.ones(2, np.float32)
+    sheds = []
+    for _ in range(6):
+        try:
+            fleet.predict(x, tenant="greedy")
+        except ServerOverloaded as e:
+            sheds.append(e)
+    assert sheds and all(e.tenant == "greedy" for e in sheds)
+    assert all(e.tier == "rate_budget" for e in sheds)
+    for _ in range(4):                 # calm tenant admits freely
+        fleet.predict(x, tenant="calm")
+    st = fleet.tenant_stats()
+    assert st["calm"]["shed"] == 0 and st["greedy"]["shed"] >= 1
+    fleet.stop()
+    tiers = {r.get("tier") for r in _records(journal_file, "serving_shed")}
+    assert "rate_budget" in tiers
+
+
+def test_class_budget_sheds_lower_priority_first(journal_file):
+    """With the queue part-full, a bronze (priority-2) tenant loses its
+    queue share and sheds ``class_budget`` while the gold tenant still
+    admits — per-tenant-class shedding, never global."""
+    fleet = _fleet(max_queue=16, window_ms=50.0, max_batch=2)
+    fleet.add_tenant("gold", factory=_scale_factory, slo="gold")
+    fleet.add_tenant("bronze", factory=_scale_factory, slo="bronze")
+    # do NOT start the worker: requests pile in the queue
+    x = np.ones(2, np.float32)
+    pending = [fleet.submit(x, tenant="gold") for _ in range(6)]
+    assert fleet.queue_depth() >= 4    # bronze share = 16/4 = 4
+    with pytest.raises(ServerOverloaded) as ei:
+        fleet.submit(x, tenant="bronze")
+    assert ei.value.tier == "class_budget"
+    assert ei.value.tenant == "bronze"
+    # gold still admits at this depth
+    pending.append(fleet.submit(x, tenant="gold"))
+    st = fleet.tenant_stats()
+    assert st["bronze"]["shed"] == 1 and st["gold"]["shed"] == 0
+    fleet.start()                      # drain what we queued
+    for p in pending:
+        np.asarray(p.result(10.0))
+    fleet.stop()
+
+
+def test_deadline_floor_lifts_short_deadlines():
+    """An SLO deadline floor lifts a shorter requested deadline (the
+    class's latency promise is also its minimum patience)."""
+    fleet = _fleet()
+    fleet.add_tenant("floored", factory=_scale_factory,
+                     slo=SLOClass("floored", deadline_floor_ms=500.0))
+    fleet.start()
+    x = np.ones(2, np.float32)
+    resp = fleet.submit(x, tenant="floored", deadline_ms=1.0)
+    # floor=500ms: a 1ms request deadline would have expired at
+    # dequeue on any busy box; the floor makes it servable
+    np.asarray(resp.result(10.0))
+    fleet.stop()
+
+
+def test_unknown_tenant_and_tenantless_submit_are_structured():
+    fleet = _fleet()
+    fleet.add_tenant("only", factory=_scale_factory)
+    fleet.start()
+    x = np.ones(2, np.float32)
+    with pytest.raises(RequestError) as ei:
+        fleet.predict(x, tenant="ghost")
+    assert "ghost" in str(ei.value) and ei.value.tenant == "ghost"
+    with pytest.raises(RequestError):
+        fleet.predict(x)               # fleet requests must name one
+    fleet.stop()
+
+
+# -- weight paging -----------------------------------------------------------
+
+def test_weight_paging_lru_respects_hot_bound_and_values(journal_file):
+    """Three tenants, two hot slots: the LRU pages the stalest tenant
+    to host RAM (predictors dropped, journaled with cost), page-in
+    restores bit-exact parameters, and the hot set never exceeds the
+    bound."""
+    fleet = _fleet(max_hot_tenants=2)
+    vals = {"a": 3.0, "b": 5.0, "c": 7.0}
+    for name, v in vals.items():
+        def factory(v=v):
+            net = Scale()
+            net.initialize()
+            net.w.set_data(nd.array(np.asarray([v], np.float32)))
+            return net
+        fleet.add_tenant(name, factory=factory)
+    fleet.start()
+    x = np.ones(2, np.float32)
+    for _ in range(3):
+        for name, v in vals.items():
+            out = np.asarray(fleet.predict(x, tenant=name))
+            assert np.array_equal(out, x * np.float32(v)), name
+    fleet.stop()
+    st = fleet.tenant_stats()
+    assert sum(1 for r in st.values() if r["hot"]) <= 2
+    assert sum(r["page_outs"] for r in st.values()) >= 3
+    pages = _records(journal_file, "tenant_page_in")
+    assert pages and all("cost_ms" in r for r in pages)
+    assert all(len(r["hot"]) <= 2 for r in pages)
+    outs = _records(journal_file, "tenant_page_out")
+    assert outs and all(r["n_params"] == 1 for r in outs)
+
+
+def test_tenant_hot_add_remove_under_traffic(journal_file):
+    """Tenants join and leave a RUNNING fleet: the new tenant serves
+    immediately, the removed tenant's queued work resolves structurally
+    and its executables are dropped."""
+    fleet = _fleet()
+    fleet.add_tenant("stay", factory=_scale_factory)
+    fleet.start()
+    x = np.ones(2, np.float32)
+    fleet.predict(x, tenant="stay")
+    fleet.add_tenant("late", factory=_scale_factory)   # hot add
+    assert np.array_equal(np.asarray(fleet.predict(x, tenant="late")),
+                          x)
+    fleet.remove_tenant("late")
+    with pytest.raises(RequestError) as ei:
+        fleet.predict(x, tenant="late")
+    assert ei.value.tenant == "late"
+    fleet.predict(x, tenant="stay")    # survivor unaffected
+    fleet.stop()
+    kinds = {r["kind"] for r in _records(journal_file)}
+    assert "tenant_add" in kinds and "tenant_remove" in kinds
+
+
+# -- observability + router integration --------------------------------------
+
+def test_fleet_metrics_text_tenant_families():
+    fleet = _fleet()
+    fleet.add_tenant("m0", factory=_scale_factory)
+    fleet.add_tenant("m1", factory=_scale_factory, slo="silver")
+    fleet.start()
+    x = np.ones(2, np.float32)
+    fleet.predict(x, tenant="m0")
+    text = fleet.metrics_text()
+    fleet.stop()
+    assert 'mxnet_tpu_serving_tenant_events{tenant="m0",' \
+           'event="served"} 1' in text
+    assert 'mxnet_tpu_serving_tenant_state{tenant="m1"} 0' in text
+    assert 'mxnet_tpu_serving_tenant_latency_ms{tenant="m0",' \
+           'quantile="p99"}' in text
+
+
+def test_router_places_tenant_aware_over_fleet_pool(tmp_path,
+                                                    journal_file):
+    """A pool of fleet replicas advertises served tenants in the
+    heartbeat beacon; the router routes a tenant request only to a
+    replica serving that tenant (and raises structured no-capacity for
+    a tenant nobody serves)."""
+    from mxnet_tpu.serving import PoolConfig, ReplicaPool, Router
+
+    def fleet_factory(names):
+        def factory():
+            f = _fleet()
+            for n in names:
+                f.add_tenant(n, factory=_scale_factory)
+            return f
+        return factory
+
+    pool = ReplicaPool(str(tmp_path / "pool"),
+                       PoolConfig(heartbeat_s=0.1, deadline_s=0.6))
+    pool.add_local("r0", fleet_factory(["alpha"]))
+    pool.add_local("r1", fleet_factory(["beta"]))
+    pool.start()
+    router = Router(pool)
+    try:
+        x = np.ones(2, np.float32)
+        for _ in range(4):
+            resp = router.call(x, tenant="alpha")
+            assert resp.replica == "r0"
+            resp = router.call(x, tenant="beta")
+            assert resp.replica == "r1"
+        with pytest.raises(ServerOverloaded) as ei:
+            router.call(x, tenant="nobody", deadline_ms=500)
+        assert ei.value.tier == "no_capacity"
+        assert ei.value.tenant == "nobody"
+        st = router.stats()
+        assert st["tenants"]["alpha"]["served"] == 4
+        assert st["tenants"]["nobody"]["failures"] == 1
+        assert "mxnet_tpu_router_tenant_events" in router.metrics_text()
+    finally:
+        router.stop()
+        pool.stop()
+
+
+def test_proc_worker_fleet_mode_serves_tenants_and_beacons(tmp_path):
+    """A REAL subprocess worker in --tenants mode: requests carry the
+    tenant header, failures come back tenant-labeled, and the beacon
+    advertises the served tenants."""
+    from mxnet_tpu.serving import PoolConfig, ReplicaPool
+    root = str(tmp_path / "ckpt_a")
+    _commit_scale(root, 7, 4.0)
+    pool = ReplicaPool(str(tmp_path / "pool"),
+                       PoolConfig(heartbeat_s=0.25, deadline_s=2.0))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+           "MXNET_TPU_JOURNAL": "off"}
+    env.pop("XLA_FLAGS", None)
+    pool.add_proc("w0", {"--tenants": f"a=scale@{root},b=scale",
+                         "--reload-poll-s": "0.2"}, env=env)
+    try:
+        pool.start()
+        view = pool.view()[0]
+        assert set(view.tenants) == {"a", "b"}
+        rep = pool.replicas["w0"]
+        x = np.ones(3, np.float32)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:     # reload lands async
+            out, meta = rep.predict(x, 2000, tenant="a")
+            if meta["params_step"] == 7:
+                break
+            time.sleep(0.2)
+        assert np.array_equal(out, x * np.float32(4.0))
+        assert meta["params_step"] == 7
+        out, meta = rep.predict(x, 2000, tenant="b")
+        assert np.array_equal(out, x)          # initializer weights
+        with pytest.raises(RequestError) as ei:
+            rep.predict(x, 2000, tenant="ghost")
+        assert ei.value.tenant == "ghost"
+    finally:
+        pool.stop()
+
+
+def test_tenant_bench_cli_emits_artifact(tmp_path):
+    """``python -m mxnet_tpu.serving bench --tenants 2`` emits the one
+    JSON line + BENCH_serving_tenants artifact with per-tenant p99 and
+    quarantine/shed counters and the observability snapshot."""
+    import subprocess
+    import sys
+    artifact = str(tmp_path / "BENCH_serving_tenants.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.serving", "bench",
+         "--seconds", "1", "--clients", "2", "--dim", "8",
+         "--tenants", "2", "--out", artifact],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "MXNET_TPU_JOURNAL": "off"})
+    assert out.returncode == 0, out.stderr[-800:]
+    line = [l for l in out.stdout.splitlines()
+            if l.startswith("{") and '"metric"' in l][-1]
+    doc = json.loads(line)
+    assert doc["metric"] == "serving_tenant_requests_per_sec"
+    assert doc["value"] and doc["value"] > 0
+    assert doc["tenants"].keys() == {"t0", "t1"}
+    for row in doc["tenants"].values():
+        assert row["served"] > 0 and "p99_ms" in row
+        assert "quarantines" in row and "shed" in row
+    assert "metrics" in doc["observability"]
+    with open(artifact, encoding="utf-8") as f:
+        assert json.load(f)["metric"] == \
+            "serving_tenant_requests_per_sec"
