@@ -56,6 +56,9 @@ def get_lib():
         if not os.path.exists(_lib_path) or (
                 srcs and os.path.getmtime(_lib_path)
                 < max(os.path.getmtime(s) for s in srcs)):
+            # init-once: the lock exists to make every other thread
+            # wait for the one-time deadlined build
+            # graftlint: disable=G15 init-once build serializer
             if not _build() and not os.path.exists(_lib_path):
                 return None
         try:

@@ -8,14 +8,22 @@ undeadlined subprocesses, silent device-failure swallows). See
 runtime half of the same defense lives in ``mxnet_tpu/diagnostics``.
 
 CLI: ``python -m mxnet_tpu.analysis [paths] [--format=text|json|sarif]
-[--write-baseline] [--rules=...]``.
+[--write-baseline] [--rules=...] [--jobs N] [--changed-only REF]``.
+
+The interprocedural tier (G15-G19: lock discipline, rank uniformity
+through helpers, dropped deadlines) runs on the per-module call-graph +
+function-summary engine in :mod:`.callgraph` / :mod:`.summaries` —
+cycle-safe fixpoint propagation, per-file summary cache keyed by
+content fingerprint.
 """
 from .core import (Finding, Rule, FileContext, all_rules, load_rules,
                    lint_file, run, DEFAULT_PATHS, DEFAULT_EXCLUDES)
 from .baseline import load_baseline, partition, write_baseline
-from .cli import main, repo_root
+from .cli import changed_only_paths, main, repo_root
+from .summaries import ModuleSummaries, SummaryCache, module_summaries
 
 __all__ = ["Finding", "Rule", "FileContext", "all_rules", "load_rules",
            "lint_file", "run", "DEFAULT_PATHS", "DEFAULT_EXCLUDES",
            "load_baseline", "partition", "write_baseline", "main",
-           "repo_root"]
+           "repo_root", "changed_only_paths", "ModuleSummaries",
+           "SummaryCache", "module_summaries"]

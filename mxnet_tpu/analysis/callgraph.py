@@ -1,0 +1,374 @@
+"""Per-module call graph — the skeleton under the interprocedural rules.
+
+The hardest defects of the serving/elastic PRs were *cross-function*
+concurrency mistakes (ledger I/O reached through a helper while a router
+lock was held; a probe slot latched because the release lived in a
+function the exception path never called). Per-function AST walking
+structurally cannot see them. This module gives the analyzer the missing
+edge set: for one parsed file it indexes every function/method (nested
+defs included), resolves the calls between them (``self.m()`` through
+the class — and through same-module base classes — ``name()`` to the
+module function, ``Cls.m()`` explicitly), and tracks the receiver kinds
+the concurrency rules care about: lock objects, queues/threads/events,
+sockets, subprocess handles.
+
+Known limits (documented in docs/static_analysis.md): dynamic dispatch
+(``getattr``/callbacks), decorators that swap the callee, cross-module
+calls (summaries are per-module; repo-internal blocking APIs —
+``atomic_write``, journal ``event`` — are classified by resolved dotted
+name instead), and aliased bound methods (``f = self.m; f()``).
+
+Stdlib-only, like every analysis module: reasons about source, never
+imports the runtime.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+__all__ = ["ModuleIndex", "FunctionInfo", "build_index", "lock_key",
+           "classify_blocking", "resolve_callee", "module_imports"]
+
+# ---------------------------------------------------------------------------
+# receiver vocabularies
+# ---------------------------------------------------------------------------
+
+LOCK_MAKERS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "multiprocessing.Lock", "multiprocessing.RLock",
+    "multiprocessing.Semaphore", "multiprocessing.BoundedSemaphore",
+}
+# name heuristic for lock-shaped receivers constructed elsewhere (an
+# inherited `self._lock`, a lock handed in as an argument): the leaf
+# identifier reads like a lock
+_LOCKISH_RE = re.compile(r"(?:lock|mutex|semaphore|sem)s?$", re.IGNORECASE)
+
+QUEUE_MAKERS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+                "queue.SimpleQueue", "multiprocessing.Queue",
+                "multiprocessing.JoinableQueue"}
+THREAD_MAKERS = {"threading.Thread", "threading.Timer",
+                 "multiprocessing.Process"}
+EVENT_MAKERS = {"threading.Event", "threading.Barrier"}
+SOCKET_MAKERS = {"socket.socket", "socket.create_connection"}
+PROC_MAKERS = {"subprocess.Popen"}
+
+# blocking by resolved dotted name, with the kind each one carries
+_SLEEP_CALLS = {"time.sleep"}
+_FILE_CALLS = {
+    "open", "io.open", "os.replace", "os.rename", "os.listdir",
+    "os.scandir", "os.makedirs", "os.mkdir", "os.unlink", "os.remove",
+    "os.rmdir", "os.fsync", "os.stat", "shutil.rmtree", "shutil.copy",
+    "shutil.copy2", "shutil.copytree", "shutil.move",
+    "tempfile.mkstemp", "tempfile.NamedTemporaryFile",
+}
+_SUBPROCESS_CALLS = {"subprocess.run", "subprocess.call",
+                     "subprocess.check_call", "subprocess.check_output",
+                     "subprocess.Popen"}
+_SOCKET_CALLS = {"socket.create_connection", "socket.getaddrinfo",
+                 "urllib.request.urlopen"}
+# repo-internal file APIs, matched on the resolved leaf so both the
+# relative-import and absolute spellings classify (docs/checkpointing.md:
+# these all end in fsync/replace — real file I/O wherever they run)
+_REPO_FILE_LEAVES = {"atomic_write", "fsync_dir", "sweep_tmp"}
+# blocking waits on tracked receivers, by attribute
+_WAIT_ATTRS = {
+    "queue": {"get", "put", "join"},
+    "thread": {"join"},
+    "event": {"wait"},
+    "socket": {"recv", "recv_into", "accept", "connect", "sendall",
+               "send", "makefile"},
+    "proc": {"communicate", "wait"},
+}
+_JOURNAL_ATTRS = {"event", "crash", "set_phase"}
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FunctionInfo:
+    """One function/method in the module index."""
+
+    __slots__ = ("key", "name", "cls", "node", "line", "public")
+
+    def __init__(self, key, name, cls, node):
+        self.key = key
+        self.name = name
+        self.cls = cls
+        self.node = node
+        self.line = node.lineno
+        self.public = not name.startswith("_")
+
+
+class ModuleIndex:
+    """Functions, classes (with same-module base chains), and tracked
+    receivers of one parsed module."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, list] = {}      # class -> same-module bases
+        self._methods: dict[str, set] = {}      # class -> method names
+        self.receivers: dict[str, str] = {}     # dotted recv -> kind
+        self.lock_recvs: set = set()            # dotted recvs made from
+        self._collect(ctx.tree)                 # LOCK_MAKERS
+
+    # -- construction -------------------------------------------------------
+    def _collect(self, tree):
+        def visit(node, cls, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    bases = [b for b in
+                             (_dotted(e) for e in child.bases) if b]
+                    self.classes[child.name] = bases
+                    self._methods.setdefault(child.name, set())
+                    visit(child, child.name, "")
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    key = (f"{cls}.{child.name}" if cls
+                           else f"{prefix}{child.name}")
+                    # first definition wins (a repeated def is a W-tier
+                    # problem, not ours)
+                    self.functions.setdefault(
+                        key, FunctionInfo(key, child.name, cls, child))
+                    if cls:
+                        self._methods[cls].add(child.name)
+                    visit(child, None, key + ".")
+                else:
+                    visit(child, cls, prefix)
+
+        visit(tree, None, "")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)) \
+                    and node.value is not None:
+                value, targets = node.value, [node.target]
+            else:
+                continue
+            if not isinstance(value, ast.Call):
+                continue
+            name = self.ctx.resolve(value.func)
+            if name in LOCK_MAKERS:
+                pool = "lock"
+            elif name in QUEUE_MAKERS:
+                pool = "queue"
+            elif name in THREAD_MAKERS:
+                pool = "thread"
+            elif name in EVENT_MAKERS:
+                pool = "event"
+            elif name in SOCKET_MAKERS:
+                pool = "socket"
+            elif name in PROC_MAKERS:
+                pool = "proc"
+            else:
+                continue
+            for t in targets:
+                dotted = _dotted(t)
+                if not dotted:
+                    continue
+                if pool == "lock":
+                    self.lock_recvs.add(dotted)
+                else:
+                    self.receivers[dotted] = pool
+
+    # -- method resolution through same-module base chains ------------------
+    def method_owner(self, cls, name, _seen=None):
+        """The class (this one or a same-module ancestor) defining
+        ``name``, or None."""
+        if cls not in self._methods:
+            return None
+        _seen = _seen or set()
+        if cls in _seen:
+            return None                  # cyclic bases: malformed input
+        _seen.add(cls)
+        if name in self._methods[cls]:
+            return cls
+        for base in self.classes.get(cls, ()):
+            owner = self.method_owner(base.split(".")[-1], name, _seen)
+            if owner:
+                return owner
+        return None
+
+
+def build_index(ctx) -> ModuleIndex:
+    return ModuleIndex(ctx)
+
+
+def resolve_callee(index: ModuleIndex, call: ast.Call, cls, fnkey):
+    """Same-module function key a call targets, or None (external /
+    dynamic). ``cls`` / ``fnkey`` locate the call site for ``self.m()``
+    and nested-def resolution."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        nested = f"{fnkey}.{func.id}" if fnkey else None
+        if nested and nested in index.functions:
+            return nested
+        if func.id in index.functions:
+            return func.id
+        if func.id in index.classes:     # constructor: Cls() runs __init__
+            owner = index.method_owner(func.id, "__init__")
+            if owner:
+                return f"{owner}.__init__"
+        return None
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        recv = func.value.id
+        if recv in ("self", "cls") and cls:
+            owner = index.method_owner(cls, func.attr)
+            if owner:
+                return f"{owner}.{func.attr}"
+            return None
+        if recv in index.classes:
+            owner = index.method_owner(recv, func.attr)
+            if owner:
+                return f"{owner}.{func.attr}"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# lock identity
+# ---------------------------------------------------------------------------
+
+def lock_key(index: ModuleIndex, expr, cls, fnkey):
+    """Canonical key for a lock-shaped expression, or None.
+
+    An expression is a lock when its dotted receiver was constructed
+    from a lock maker anywhere in the module, or (heuristic — inherited
+    or injected locks have no same-module construction) its leaf
+    identifier reads like one (``_lock``, ``_beat_lock``, ``sem``).
+    Keys are scoped so two classes' ``self._lock`` never alias:
+    ``Cls::self._lock`` / ``<module>::NAME`` / ``fn::local``."""
+    dotted = _dotted(expr)
+    if dotted is None:
+        return None
+    leaf = dotted.rsplit(".", 1)[-1]
+    if dotted not in index.lock_recvs and not _LOCKISH_RE.search(leaf):
+        return None
+    if dotted.startswith("self.") or dotted.startswith("cls."):
+        scope = cls or fnkey or "<module>"
+        return f"{scope}::self.{dotted.split('.', 1)[1]}"
+    if "." not in dotted and dotted in index.lock_recvs:
+        return f"<module>::{dotted}"
+    if "." not in dotted:
+        # bare lockish name: module global if assigned at module scope
+        # from a maker was handled above; otherwise a local
+        return f"{fnkey or '<module>'}::{dotted}"
+    return f"{fnkey or cls or '<module>'}::{dotted}"
+
+
+def lock_display(key: str) -> str:
+    return key.split("::", 1)[-1]
+
+
+# ---------------------------------------------------------------------------
+# blocking-call classification
+# ---------------------------------------------------------------------------
+
+def _has_timeout(call: ast.Call) -> bool:
+    kw = {k.arg for k in call.keywords}
+    if None in kw:                       # **kwargs: trust the caller
+        return True
+    return "timeout" in kw or "deadline_s" in kw or "deadline_ms" in kw
+
+
+def _journal_write(ctx, call: ast.Call) -> bool:
+    """True for journal-append calls: ``get_journal().event(...)``,
+    ``self._journal.event(...)``, ``journal.event(...)`` — the ledger
+    class of file I/O the PR-9/PR-10 lock audits were about."""
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in _JOURNAL_ATTRS:
+        return False
+    value = func.value
+    if isinstance(value, ast.Call):
+        name = ctx.resolve(value.func) or ""
+        return name.rsplit(".", 1)[-1] == "get_journal"
+    dotted = _dotted(value) or ""
+    resolved = ctx.resolve(value) or dotted
+    leaf = dotted.rsplit(".", 1)[-1].lower()
+    return "journal" in leaf or resolved.endswith(".journal")
+
+
+def classify_blocking(index: ModuleIndex, call: ast.Call):
+    """``(kind, what, deadlined)`` for a blocking call, else None.
+
+    Kinds: ``sleep`` | ``file`` | ``journal`` | ``socket`` | ``wait`` |
+    ``subprocess``. ``deadlined`` reports whether a timeout/deadline
+    argument is present — a deadlined wait is still a wait (holding a
+    lock across it stalls every peer for the full budget), so G15 keeps
+    flagging it; G19 uses the distinction the other way around."""
+    ctx = index.ctx
+    name = ctx.resolve(call.func)
+    if name in _SLEEP_CALLS:
+        return "sleep", name, False
+    if name in _FILE_CALLS:
+        return "file", name, False
+    if name in _SUBPROCESS_CALLS:
+        return "subprocess", name, _has_timeout(call)
+    if name in _SOCKET_CALLS:
+        return "socket", name, _has_timeout(call)
+    if name and name.rsplit(".", 1)[-1] in _REPO_FILE_LEAVES:
+        return "file", name.rsplit(".", 1)[-1], False
+    if _journal_write(ctx, call):
+        return "journal", "journal write", False
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        recv = _dotted(func.value)
+        kind = index.receivers.get(recv) if recv else None
+        if kind and func.attr in _WAIT_ATTRS.get(kind, ()):
+            if kind == "queue" and func.attr in ("get", "put"):
+                # non-blocking forms (block=False / get_nowait-style
+                # positional False) are not waits
+                blk = call.args[0] if call.args and func.attr == "get" \
+                    else None
+                for k in call.keywords:
+                    if k.arg == "block":
+                        blk = k.value
+                if isinstance(blk, ast.Constant) and blk.value is False:
+                    return None
+            return "wait", f"{recv}.{func.attr}", _has_timeout(call)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# import graph (for --changed-only reverse dependents)
+# ---------------------------------------------------------------------------
+
+_IMPORT_RE = re.compile(
+    r"^\s*(?:from\s+([.\w]+)\s+import\b|import\s+([\w.]+(?:\s*,\s*[\w.]+)*))")
+
+
+def module_imports(path_rel: str, src: str) -> set:
+    """Dotted modules this file imports (cheap line scan — the
+    changed-only selector must not pay a full parse per candidate).
+    Relative imports resolve against the file's package."""
+    pkg = path_rel.replace("\\", "/").rsplit("/", 1)[0].replace("/", ".") \
+        if "/" in path_rel else ""
+    out = set()
+    for line in src.splitlines():
+        m = _IMPORT_RE.match(line)
+        if not m:
+            continue
+        if m.group(1):
+            mod = m.group(1)
+            if mod.startswith("."):
+                level = len(mod) - len(mod.lstrip("."))
+                rest = mod.lstrip(".")
+                parts = pkg.split(".") if pkg else []
+                if level - 1 <= len(parts):
+                    base = parts[:len(parts) - (level - 1)]
+                    mod = ".".join(base + ([rest] if rest else []))
+                else:
+                    continue
+            out.add(mod)
+        else:
+            for piece in m.group(2).split(","):
+                out.add(piece.strip().split(" ")[0])
+    return out

@@ -7,12 +7,15 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 from . import baseline as _baseline
+from . import callgraph as _callgraph
 from . import core, emitters
+from . import summaries as _summaries
 
-__all__ = ["main", "repo_root"]
+__all__ = ["main", "repo_root", "changed_only_paths"]
 
 
 def repo_root() -> str:
@@ -46,7 +49,85 @@ def _build_parser():
                    help="print the rule catalog and exit")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print baselined findings (text format)")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="lint files on N processes (0 = one per CPU, "
+                        "capped; default 1). Fork-based; platforms "
+                        "without fork fall back to serial")
+    p.add_argument("--changed-only", default=None, metavar="REF",
+                   help="lint only files changed vs this git ref (plus "
+                        "untracked), AND their reverse import-graph "
+                        "dependents — so interprocedural findings don't "
+                        "go stale. The pre-commit hook's mode")
+    p.add_argument("--no-cache", action="store_true",
+                   help="skip the per-file summary cache "
+                        "(ci/lint_summary_cache.json)")
     return p
+
+
+def _git_lines(root, args_):
+    out = subprocess.run(["git"] + args_, cwd=root, capture_output=True,
+                         text=True, timeout=30)
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr.strip() or "git failed")
+    return [ln for ln in out.stdout.splitlines() if ln.strip()]
+
+
+def _depends_on(imports: set, mod: str) -> bool:
+    """Does a file with these imported modules depend on ``mod``?
+    Exact import, an import of any submodule of it, or the
+    ``from <parent-pkg> import <leaf>`` shape (one level)."""
+    for i in imports:
+        if i == mod or i.startswith(mod + "."):
+            return True
+        if mod.startswith(i + ".") and mod.count(".") == i.count(".") + 1:
+            return True
+    return False
+
+
+def changed_only_paths(root, ref, surface=None) -> list:
+    """Repo-relative .py paths to lint for ``--changed-only REF``: the
+    files changed vs the ref (plus untracked), intersected with the
+    default scan surface (fixture dirs stay excluded), plus the
+    TRANSITIVE reverse import-graph dependents — a caller of an edited
+    helper can gain or lose an interprocedural finding without itself
+    changing, so dependents must re-lint or G15-G19 results go stale.
+    Deeper-than-one-level package re-exports are a documented limit
+    (docs/static_analysis.md)."""
+    changed = {c.replace(os.sep, "/")
+               for c in _git_lines(root, ["diff", "--name-only", ref,
+                                          "--"])}
+    changed |= {c.replace(os.sep, "/")
+                for c in _git_lines(root, ["ls-files", "--others",
+                                           "--exclude-standard"])}
+    changed = {c for c in changed if c.endswith(".py")}
+    if surface is None:
+        surface = {os.path.relpath(fp, root).replace(os.sep, "/")
+                   for fp in core.iter_py(core.DEFAULT_PATHS, root=root)}
+    selected = changed & surface
+    if not selected:
+        return []
+    mod_of, imports = {}, {}
+    for rel in surface:
+        mod = rel[:-3].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[:-len(".__init__")]
+        mod_of[rel] = mod
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                imports[rel] = _callgraph.module_imports(rel, f.read())
+        except OSError:
+            imports[rel] = set()
+    grew = True
+    while grew:
+        grew = False
+        mods = {mod_of[r] for r in selected}
+        for rel, imps in imports.items():
+            if rel in selected:
+                continue
+            if any(_depends_on(imps, m) for m in mods):
+                selected.add(rel)
+                grew = True
+    return sorted(selected)
 
 
 def main(argv=None) -> int:
@@ -70,7 +151,8 @@ def main(argv=None) -> int:
             return 2
         rules = [registry[c] for c in wanted]
 
-    if args.write_baseline and (args.paths or args.rules) \
+    if args.write_baseline \
+            and (args.paths or args.rules or args.changed_only) \
             and not args.baseline:
         # a narrowed scan regenerating the COMMITTED baseline would
         # silently drop every out-of-scope entry
@@ -87,7 +169,39 @@ def main(argv=None) -> int:
             print(f"no .py files found under: {' '.join(miss)}",
                   file=sys.stderr)
             return 2
-    findings, n_files = core.run(args.paths or None, rules=rules, root=root)
+    paths = args.paths or None
+    if args.changed_only:
+        if args.paths:
+            print("--changed-only computes its own path set; drop the "
+                  "explicit paths", file=sys.stderr)
+            return 2
+        try:
+            paths = changed_only_paths(root, args.changed_only)
+        except (RuntimeError, OSError, subprocess.SubprocessError) as e:
+            print(f"--changed-only {args.changed_only}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not paths:
+            print(f"graftlint: no changed .py files vs "
+                  f"{args.changed_only}")
+            return 0
+
+    cache = None
+    if not args.no_cache and not args.list_rules:
+        cpath = os.path.join(root, _summaries.DEFAULT_CACHE)
+        if os.path.isdir(os.path.dirname(cpath)):
+            cache = _summaries.SummaryCache.load(cpath)
+    prev_cache = _summaries.set_active_cache(cache)
+    try:
+        findings, n_files = core.run(paths, rules=rules, root=root,
+                                     jobs=args.jobs)
+    finally:
+        _summaries.set_active_cache(prev_cache)
+        if cache is not None:
+            try:
+                cache.save(keep=4096)
+            except OSError:
+                pass             # a read-only checkout still lints fine
     if n_files == 0:
         # the default scan finding nothing means repo_root() is not a
         # checkout (e.g. an installed wheel) — not a clean pass

@@ -103,6 +103,7 @@ def register(cls):
 def load_rules() -> dict[str, Rule]:
     """Import the rule modules (idempotent) and return the registry."""
     from . import rules_generic, rules_jax   # noqa  (registration side effect)
+    from . import rules_concurrency          # noqa  (registration side effect)
     return dict(sorted(_RULES.items()))
 
 
@@ -136,9 +137,19 @@ class FileContext:
         self._library = (self.path.startswith("mxnet_tpu/")
                          or bool(_SCOPE_RE.search(head)))
 
-    @staticmethod
-    def _import_aliases(tree) -> dict[str, str]:
+    @property
+    def package(self) -> str:
+        """Dotted package of this file derived from its repo-relative
+        path (``mxnet_tpu/serving/router.py`` → ``mxnet_tpu.serving``)
+        — the base relative imports resolve against."""
+        parts = self.path.split("/")
+        if parts and parts[-1].endswith(".py"):
+            parts = parts[:-1]          # __init__.py and modules alike
+        return ".".join(p for p in parts if p)
+
+    def _import_aliases(self, tree) -> dict[str, str]:
         aliases = {}
+        pkg_parts = self.package.split(".") if self.package else []
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
@@ -147,12 +158,26 @@ class FileContext:
                     else:
                         root = a.name.split(".")[0]
                         aliases.setdefault(root, root)
-            elif isinstance(node, ast.ImportFrom) and node.module \
-                    and not node.level:
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # relative import: resolve against the file's package
+                    # so `from ..diagnostics.journal import get_journal`
+                    # in mxnet_tpu/serving/ becomes the full dotted name
+                    # (the interprocedural rules classify repo-internal
+                    # APIs — journal writes, atomic_write — by it)
+                    if node.level > len(pkg_parts):
+                        continue
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                    mod = ".".join(base + ([node.module] if node.module
+                                           else []))
+                elif node.module:
+                    mod = node.module
+                else:
+                    continue
                 for a in node.names:
                     if a.name == "*":
                         continue
-                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+                    aliases[a.asname or a.name] = f"{mod}.{a.name}"
         return aliases
 
     def is_library(self) -> bool:
@@ -337,15 +362,50 @@ def missing_paths(paths, excludes=DEFAULT_EXCLUDES, root="."):
             is None]
 
 
-def run(paths=None, rules=None, excludes=DEFAULT_EXCLUDES, root="."):
+def _lint_one(args):
+    """Worker body for the ``--jobs`` pool: lint one file by rule CODES
+    (rule instances don't cross process boundaries; the registry in the
+    forked child resolves them) and drain the child's summary-cache
+    delta so the parent can merge + persist it."""
+    fp, codes, root = args
+    from . import summaries as _summaries
+    registry = load_rules()
+    rules = [registry[c] for c in codes if c in registry]
+    findings = lint_file(fp, rules=rules, root=root)
+    return findings, _summaries.drain_active_cache()
+
+
+def run(paths=None, rules=None, excludes=DEFAULT_EXCLUDES, root=".",
+        jobs=1):
     """Lint ``paths`` (default: the repo surface). Returns
     ``(findings, n_files)``. See :func:`iter_py` for how excludes
-    interact with explicitly named paths."""
+    interact with explicitly named paths. ``jobs > 1`` fans files out
+    over a fork-based process pool (0 = one per CPU, capped); platforms
+    without fork fall back to serial — parallelism is a speedup, never
+    a behavior change."""
+    from . import summaries as _summaries
     paths = paths or DEFAULT_PATHS
     rules = rules if rules is not None else all_rules()
-    findings, n_files = [], 0
-    for fp in iter_py(paths, excludes=excludes, root=root):
-        n_files += 1
+    files = list(iter_py(paths, excludes=excludes, root=root))
+    if jobs == 0:
+        jobs = min(os.cpu_count() or 1, 8)
+    jobs = min(jobs, max(len(files), 1))
+    findings = []
+    if jobs > 1:
+        try:
+            import multiprocessing as mp
+            codes = [r.code for r in rules]
+            with mp.get_context("fork").Pool(jobs) as pool:
+                for fnd, delta in pool.imap_unordered(
+                        _lint_one, [(fp, codes, root) for fp in files],
+                        chunksize=4):
+                    findings.extend(fnd)
+                    _summaries.merge_cache_delta(delta)
+            findings.sort(key=Finding.sort_key)
+            return findings, len(files)
+        except (ImportError, ValueError, OSError):
+            findings = []        # no fork on this platform: run serial
+    for fp in files:
         findings.extend(lint_file(fp, rules=rules, root=root))
     findings.sort(key=Finding.sort_key)
-    return findings, n_files
+    return findings, len(files)

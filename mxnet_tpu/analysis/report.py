@@ -1,0 +1,66 @@
+"""doctor-facing lint summary — ``python -m mxnet_tpu.diagnostics
+doctor --lint <repo-root>``.
+
+One in-process graftlint run over the checkout, reduced to the numbers
+an operator triages by: file count, new-vs-baselined split, per-rule
+finding counts, summary-cache hit rate, and wall clock. Rides the
+diagnostics ``_REPORT_TABLE`` like every other report surface.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from . import baseline as _baseline
+from . import core
+from . import summaries as _summaries
+from .cli import repo_root
+
+__all__ = ["lint_report"]
+
+
+def lint_report(root=None) -> dict:
+    """Run graftlint over ``root`` (default: this checkout) and return
+    the doctor summary dict. Never raises — a broken checkout reports
+    ``ok: False`` with the reason, like every doctor section."""
+    root = os.path.abspath(root) if root else repo_root()
+    t0 = time.perf_counter()
+    cache = None
+    cpath = os.path.join(root, _summaries.DEFAULT_CACHE)
+    if os.path.isdir(os.path.dirname(cpath)):
+        cache = _summaries.SummaryCache.load(cpath)
+    prev = _summaries.set_active_cache(cache)
+    # fork-based --jobs is unsafe once jax's own threads exist in this
+    # process (doctor imports the runtime); serial + warm cache is fast
+    # enough, and a wedged doctor would be the worst possible irony
+    jobs = 1 if "jax" in sys.modules else 0
+    try:
+        findings, n_files = core.run(root=root, jobs=jobs)
+    except (OSError, SyntaxError) as e:
+        return {"ok": False, "error": type(e).__name__,
+                "detail": str(e)[:300], "root": root}
+    finally:
+        _summaries.set_active_cache(prev)
+        if cache is not None:
+            try:
+                cache.save(keep=4096)
+            except OSError:
+                pass
+    if n_files == 0:
+        return {"ok": False, "error": "no_files",
+                "detail": f"no .py files under {root}", "root": root}
+    try:
+        entries = _baseline.load_baseline(
+            os.path.join(root, _baseline.DEFAULT_BASELINE))
+    except ValueError as e:
+        return {"ok": False, "error": "bad_baseline",
+                "detail": str(e)[:300], "root": root}
+    new, based = _baseline.partition(findings, entries)
+    rules: dict = {}
+    for f in new:
+        rules[f.code] = rules.get(f.code, 0) + 1
+    return {"ok": True, "root": root, "files": n_files,
+            "new": len(new), "baselined": len(based), "rules": rules,
+            "cache": cache.stats() if cache is not None else None,
+            "wall_s": round(time.perf_counter() - t0, 2)}

@@ -1,0 +1,397 @@
+"""Concurrency / lock-discipline rules (G15-G19) — the interprocedural
+tier, built on :mod:`.callgraph` + :mod:`.summaries`.
+
+Every rule here is grounded in a cross-function defect this repo
+actually shipped and then paid to find dynamically (chaos tests, hand
+archaeology — CHANGES.md PRs 9-10):
+
+- the router held its placement lock across ledger file I/O until a
+  code comment (not a tool) moved the read outside;
+- breaker/quarantine transitions journaled (file I/O) from inside
+  counter critical sections in both the router and the tenant fleet;
+- the half-open probe slot latched forever when an exception path
+  skipped its release;
+- the heartbeat ``beat()`` staged its atomic write under a lock whose
+  only job was papering over a shared temp-file race;
+- rank-dependent collective entry hid behind helper functions where the
+  per-function G12 could not see it.
+
+The per-function rules (G1-G14) reason about one scope at a time; these
+five reason about what a function *reaches*. All five scope to
+``mxnet_tpu/`` library code, like G4/G8.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import callgraph as cg
+from . import summaries as sm
+from .core import Rule, register
+from .rules_jax import RankDependentCollectiveEntry
+
+_BLOCK_NOUN = {"sleep": "a sleep", "file": "file I/O",
+               "journal": "a journal write", "socket": "socket I/O",
+               "wait": "a blocking wait", "subprocess": "a subprocess"}
+
+# kinds that constitute a *wait* for G19's purposes (file I/O completes
+# on its own; a wait can be indefinite without a deadline)
+_WAIT_KINDS = ("wait", "sleep", "subprocess", "socket")
+
+
+def _chain_str(path) -> str:
+    return " -> ".join(k.split(".")[-1] + "()" for k in path)
+
+
+@register
+class BlockingCallUnderLock(Rule):
+    code = "G15"
+    name = "blocking-call-under-lock"
+    severity = "error"
+    doc = ("A lock-holding region (`with self._lock:` or any tracked "
+           "lock) reaches a blocking operation — file/socket I/O, a "
+           "journal write, time.sleep, a queue/thread/event wait, a "
+           "subprocess — directly or TRANSITIVELY through any chain of "
+           "same-module calls (the summary engine's reach set). Every "
+           "thread that touches the lock then stalls behind one slow "
+           "write or wedged wait; on a slow shared filesystem that is "
+           "the whole front door. Move the I/O outside the critical "
+           "section: mutate state under the lock, collect the payload, "
+           "emit after release. Regression notes — the PR-9 router "
+           "held its placement lock across ledger reads (fixed by a "
+           "comment then, enforced here now; the pre-fix shape is the "
+           "tests/data/graftlint/hist_lock_held_ledger_io.py fixture); "
+           "this PR's audit moved the router/fleet breaker-transition "
+           "journal writes (serving/router.py `_transition`, "
+           "serving/fleet.py `_transition`/`_admit_tenant`) and the "
+           "heartbeat's staged atomic write "
+           "(elastic/membership.py `Heartbeat.beat`) outside their "
+           "locks. A deadlined wait under a lock still counts: peers "
+           "stall for the full budget. Held-region tracking is "
+           "`with`-based — blocking work between an explicit "
+           ".acquire()/.release() straddle is not attributed to the "
+           "lock (G17's territory; docs/static_analysis.md known "
+           "limits). Scope: mxnet_tpu/ library code.")
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        ms = sm.for_context(ctx)
+        seen = set()
+        for key, s in ms.functions.items():
+            for kind, what, line, held, _deadlined in s.blocks:
+                if not held or (line, what) in seen:
+                    continue
+                seen.add((line, what))
+                locks = ", ".join(sorted(
+                    {cg.lock_display(h) for h in held}))
+                yield self.finding(
+                    ctx, line,
+                    f"{_BLOCK_NOUN[kind]} ({what}) while holding "
+                    f"{locks} — every thread touching the lock stalls "
+                    f"behind it; mutate under the lock, do the "
+                    f"{_BLOCK_NOUN[kind].split()[-1]} after release")
+            for callee, line, held, _fin in s.calls:
+                if not held or callee not in ms.reach:
+                    continue
+                reached = ms.reach[callee]
+                if not reached:
+                    continue
+                if (line, callee) in seen:
+                    continue
+                seen.add((line, callee))
+                kind, what = sorted(reached)[0]
+                path, op_line = ms.chain(callee, (kind, what))
+                via = _chain_str(path) if path else callee
+                locks = ", ".join(sorted(
+                    {cg.lock_display(h) for h in held}))
+                yield self.finding(
+                    ctx, line,
+                    f"call under {locks} reaches {_BLOCK_NOUN[kind]} "
+                    f"({what} via {via}, line {op_line}) — the lock is "
+                    f"held across it on every path through the chain; "
+                    f"hoist the blocking step out of the critical "
+                    f"section")
+
+
+@register
+class LockOrderCycle(Rule):
+    code = "G16"
+    name = "lock-order-cycle"
+    severity = "error"
+    doc = ("Two locks acquired in opposite orders somewhere in the same "
+           "module — A then B on one path (nested `with`, or a call "
+           "under A into a function that takes B), B then A on another. "
+           "Two threads each holding their first lock deadlock forever, "
+           "and nothing times out because locks have no deadline. "
+           "Pick one global order (document it where the locks are "
+           "constructed) or collapse the sections onto one lock. "
+           "Reentrant same-lock nesting (RLock) is not a cycle and is "
+           "not flagged. Scope: mxnet_tpu/ library code.")
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        ms = sm.for_context(ctx)
+        orders: dict = {}         # (outer, inner) -> (line, via)
+        for key, s in ms.functions.items():
+            for lk, line, held in s.acq_with:
+                for h in held:
+                    if h != lk:
+                        orders.setdefault((h, lk), (line, None))
+            for callee, line, held, _fin in s.calls:
+                if callee not in ms.trans_acquires:
+                    continue
+                for h in held:
+                    for lk in ms.trans_acquires[callee]:
+                        if lk != h:
+                            orders.setdefault((h, lk), (line, callee))
+        reported = set()
+        for (a, b), (line, via) in sorted(orders.items(),
+                                          key=lambda kv: kv[1][0]):
+            if (b, a) not in orders or frozenset((a, b)) in reported:
+                continue
+            reported.add(frozenset((a, b)))
+            other_line = orders[(b, a)][0]
+            da, db = cg.lock_display(a), cg.lock_display(b)
+            suffix = f" (via {via.split('.')[-1]}())" if via else ""
+            yield self.finding(
+                ctx, line,
+                f"lock-order cycle: {da} -> {db} here{suffix}, but "
+                f"{db} -> {da} at line {other_line} — two threads each "
+                f"holding their first lock deadlock with no timeout; "
+                f"pick one global order or merge the critical sections")
+
+
+@register
+class LeakedAcquire(Rule):
+    code = "G17"
+    name = "leaked-acquire"
+    severity = "error"
+    doc = ("Explicit `.acquire()` on a lock/semaphore with no "
+           "exception-safe release: no `.release()` in a `finally:` of "
+           "the same function, and no `finally:`-called helper that "
+           "transitively releases it (the summary engine checks the "
+           "callees too). The first exception between acquire and the "
+           "straight-line release latches the slot forever — every "
+           "later waiter queues behind a resource nobody holds. This "
+           "is the PR-9 latched-probe class: the half-open breaker's "
+           "one probe slot was claimed at placement and an exception "
+           "path skipped the release, silently keeping the replica out "
+           "of rotation until restart (pre-fix shape: "
+           "tests/data/graftlint/hist_latched_probe.py). Prefer "
+           "`with lock:`; when acquire/release must straddle "
+           "statements, release in a `finally:` (directly or via a "
+           "cleanup helper). Scope: mxnet_tpu/ library code.")
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        ms = sm.for_context(ctx)
+        for key, s in ms.functions.items():
+            safe = {lk for lk, _line, fin in s.releases if fin}
+            for callee, _line, _held, fin in s.calls:
+                if fin and callee in ms.trans_releases:
+                    safe |= ms.trans_releases[callee]
+            for lk, line, fin in s.acq_exp:
+                if fin or lk in safe:
+                    continue
+                yield self.finding(
+                    ctx, line,
+                    f"{cg.lock_display(lk)}.acquire() with no release "
+                    f"on the exception path — the first raise between "
+                    f"acquire and release latches the slot forever "
+                    f"(the latched-probe class); use `with`, or "
+                    f"release in a finally: (a finally-called cleanup "
+                    f"helper counts)")
+
+
+@register
+class InterprocRankUniformity(Rule):
+    code = "G18"
+    name = "interprocedural-rank-uniformity"
+    severity = "error"
+    doc = ("G12 extended through helpers: a host-level collective "
+           "(multihost_utils.sync_global_devices / process_allgather / "
+           "broadcast_one_to_all / assert_equal) entered under a "
+           "condition whose value flows from jax.process_index() VIA A "
+           "FUNCTION RETURN — `if self._is_leader():` where _is_leader "
+           "returns a process_index comparison, or a name assigned "
+           "from such a call. Some ranks enter the collective, others "
+           "don't, and the entered ranks wait forever (docs/"
+           "elastic.md). The rank-taint summary propagates through "
+           "same-module call chains with cycle-safe fixpoint, so "
+           "burying the rank check N helpers deep no longer hides it. "
+           "Direct `process_index()` guards stay G12's findings; this "
+           "rule fires only on helper-returned taint. Make entry "
+           "unconditional; decide on one rank and share the verdict "
+           "via a broadcast. Scope: mxnet_tpu/ library code.")
+
+    COLLECTIVES = RankDependentCollectiveEntry.COLLECTIVES
+    RANK_SOURCES = RankDependentCollectiveEntry.RANK_SOURCES
+
+    def check(self, ctx):
+        if not ctx.is_library() or "multihost_utils" not in ctx.src:
+            return
+        ms = sm.for_context(ctx)
+        if not any(ms.rank_taint.values()):
+            return
+        index = ms.index
+        for info in index.functions.values():
+            yield from self._check_fn(ctx, ms, index, info)
+
+    # -- helper-taint plumbing ------------------------------------------
+    def _tainted_call(self, ms, index, node, cls, fnkey) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        callee = cg.resolve_callee(index, node, cls, fnkey)
+        return bool(callee) and ms.rank_taint.get(callee, False)
+
+    def _local_taint(self, ctx, ms, index, info) -> set:
+        tainted: set = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in sm._scope_walk(info.node):
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)) \
+                        and node.value is not None:
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                dirty = any(
+                    self._tainted_call(ms, index, sub, info.cls, info.key)
+                    or (isinstance(sub, ast.Name) and sub.id in tainted)
+                    for sub in ast.walk(value))
+                if not dirty:
+                    continue
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name) \
+                                and sub.id not in tainted:
+                            tainted.add(sub.id)
+                            changed = True
+        return tainted
+
+    def _mentions_helper_rank(self, ctx, ms, index, info, node,
+                              tainted) -> bool:
+        """True when the condition's taint arrives through a helper
+        return (and NOT directly from process_index — that is G12's
+        finding, not ours)."""
+        direct = helper = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and \
+                    ctx.resolve(sub.func) in self.RANK_SOURCES:
+                direct = True
+            elif self._tainted_call(ms, index, sub, info.cls, info.key):
+                helper = True
+            elif isinstance(sub, ast.Name) and sub.id in tainted:
+                helper = True
+        return helper and not direct
+
+    # -- guarded descent (G12's shape, helper-taint flavored) -----------
+    def _check_fn(self, ctx, ms, index, info):
+        tainted = self._local_taint(ctx, ms, index, info)
+
+        def mentions(node):
+            return self._mentions_helper_rank(ctx, ms, index, info,
+                                              node, tainted)
+
+        def descend(node, guarded):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue          # separate scope, visited on its own
+                if isinstance(child, (ast.If, ast.While)):
+                    rank_test = mentions(child.test)
+                    yield from descend(child.test, guarded)
+                    for part in child.body + child.orelse:
+                        yield from walk_stmt(part, guarded or rank_test)
+                    continue
+                if isinstance(child, ast.IfExp):
+                    rank_test = mentions(child.test)
+                    yield from descend(child.test, guarded)
+                    for part in (child.body, child.orelse):
+                        yield from walk_stmt(part, guarded or rank_test)
+                    continue
+                if isinstance(child, ast.BoolOp):
+                    seen_rank = False
+                    for operand in child.values:
+                        yield from walk_stmt(operand,
+                                             guarded or seen_rank)
+                        seen_rank = seen_rank or mentions(operand)
+                    continue
+                if guarded and isinstance(child, ast.Call) and \
+                        ctx.resolve(child.func) in self.COLLECTIVES:
+                    yield self._flag(ctx, child)
+                yield from descend(child, guarded)
+
+        def walk_stmt(node, guarded):
+            if guarded and isinstance(node, ast.Call) and \
+                    ctx.resolve(node.func) in self.COLLECTIVES:
+                yield self._flag(ctx, node)
+            yield from descend(node, guarded)
+
+        yield from descend(info.node, False)
+
+    def _flag(self, ctx, node):
+        return self.finding(
+            ctx, node.lineno,
+            "collective guarded by a condition whose rank-taint flows "
+            "through a helper return (process_index via a function) — "
+            "guarded ranks wait forever for peers that never arrive; "
+            "make entry unconditional and broadcast the one-rank "
+            "decision (docs/elastic.md)")
+
+
+@register
+class DeadlineDropped(Rule):
+    code = "G19"
+    name = "deadline-dropped"
+    severity = "warning"
+    doc = ("A PUBLIC function accepts a deadline/timeout parameter but "
+           "never reads it, while transitively reaching a blocking "
+           "wait (sleep, tracked get/join/wait, socket, subprocess) "
+           "through the call graph. The API *promises* a bounded wait "
+           "and silently delivers an unbounded one — the caller's "
+           "budget never reaches the thing that actually blocks, so a "
+           "wedged dependency produces the same information-free hang "
+           "the deadline existed to prevent (the G5/G13 class, hidden "
+           "behind a signature). Thread the parameter through to every "
+           "transitive wait (pass it down, or convert it to a "
+           "monotonic deadline compared inside the loop); reads "
+           "inside nested closures count. Regression note: this rule's "
+           "first repo audit caught serving/pool.py "
+           "ProcReplica.restart(deadline_s=...) accepting a deadline "
+           "and running its whole stop ladder (socket roundtrip + "
+           "three subprocess waits) on fixed constants — fixed in the "
+           "same PR by threading the budget through every wait. "
+           "Scope: mxnet_tpu/ library code.")
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        ms = sm.for_context(ctx)
+        for key, s in ms.functions.items():
+            if not s.public or not s.deadline_params:
+                continue
+            unread = [p for p in s.deadline_params
+                      if p not in s.deadline_read]
+            if not unread:
+                continue
+            reached = ms.reach.get(key, ())
+            waits = sorted(w for w in reached if w[0] in _WAIT_KINDS)
+            if not waits:
+                continue
+            kind, what = waits[0]
+            path, op_line = ms.chain(key, (kind, what))
+            via = f" (reaches {what}, line {op_line}" + \
+                (f", via {_chain_str(path)}" if path and len(path) > 1
+                 else "") + ")"
+            names = ", ".join(repr(p) for p in unread)
+            yield self.finding(
+                ctx, s.line,
+                f"deadline parameter {names} accepted but never read "
+                f"while the function transitively blocks{via} — the "
+                f"caller's budget never reaches the wait; thread it "
+                f"through or drop it from the signature")

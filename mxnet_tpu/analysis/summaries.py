@@ -1,0 +1,519 @@
+"""Function summaries + cycle-safe fixpoint — graftlint's interprocedural
+memory.
+
+For every function in a module this computes one summary:
+
+- **blocks** — the blocking operations it performs directly (file /
+  socket / journal I/O, ``time.sleep``, tracked-receiver ``get/join/
+  wait``, subprocess), each annotated with the locks held at the site;
+- **locks** — which lock keys it acquires (``with`` and explicit
+  ``.acquire()``) and releases, and whether a release sits on an
+  exception-safe path (a ``finally`` body);
+- **rank taint** — whether its return value derives from
+  ``jax.process_index()`` (directly or through another tainted
+  same-module function);
+- **deadline** — which ``deadline``/``timeout`` parameters it accepts
+  and whether each is ever read (threads toward a wait) at all.
+
+Direct facts propagate transitively over the call graph by fixpoint
+iteration (monotone set joins, so recursion/cycles converge instead of
+recursing forever), giving the G15-G19 rules answers like "does this
+``with self._lock:`` body *reach* file I/O through any chain of
+helpers".
+
+Summaries are cached per file, keyed by a content fingerprint
+(sha1 of source + engine schema version), in
+``ci/lint_summary_cache.json`` next to the baseline — re-runs and CI
+skip the extraction walk for unchanged files; the fixpoint re-runs from
+the cached direct facts (cheap, and identical by construction since the
+fingerprint pins the whole module text, line numbers included).
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+
+from . import callgraph as cg
+
+__all__ = ["FunctionSummary", "ModuleSummaries", "SummaryCache",
+           "module_summaries", "for_context", "set_active_cache",
+           "drain_active_cache", "merge_cache_delta", "active_cache"]
+
+_SCHEMA_VERSION = 1
+DEFAULT_CACHE = os.path.join("ci", "lint_summary_cache.json")
+
+_RANK_SOURCES = {"jax.process_index"}
+_DEADLINE_PARAM_RE = re.compile(r"deadline|timeout", re.IGNORECASE)
+
+
+class FunctionSummary:
+    """Direct (non-transitive) facts of one function; plain-data so it
+    round-trips through the JSON cache."""
+
+    __slots__ = ("key", "line", "public", "blocks", "calls", "acq_with",
+                 "acq_exp", "releases", "rank_direct", "rank_calls",
+                 "deadline_params", "deadline_read")
+
+    def __init__(self, key, line, public):
+        self.key = key
+        self.line = line
+        self.public = public
+        self.blocks = []      # (kind, what, line, (locks...), deadlined)
+        self.calls = []       # (callee_key, line, (locks...), in_finally)
+        self.acq_with = []    # (lock_key, line, (locks_held_before...))
+        self.acq_exp = []     # (lock_key, line, in_finally)
+        self.releases = []    # (lock_key, line, in_finally)
+        self.rank_direct = False
+        self.rank_calls = []  # same-module callees feeding the return
+        self.deadline_params = []
+        self.deadline_read = []
+
+    def to_dict(self):
+        return {"line": self.line, "public": self.public,
+                "blocks": [list(b) for b in self.blocks],
+                "calls": [list(c) for c in self.calls],
+                "acq_with": [list(a) for a in self.acq_with],
+                "acq_exp": [list(a) for a in self.acq_exp],
+                "releases": [list(r) for r in self.releases],
+                "rank_direct": self.rank_direct,
+                "rank_calls": list(self.rank_calls),
+                "deadline_params": list(self.deadline_params),
+                "deadline_read": list(self.deadline_read)}
+
+    @classmethod
+    def from_dict(cls, key, d):
+        s = cls(key, int(d["line"]), bool(d["public"]))
+        s.blocks = [(b[0], b[1], int(b[2]), tuple(b[3]), bool(b[4]))
+                    for b in d["blocks"]]
+        s.calls = [(c[0], int(c[1]), tuple(c[2]), bool(c[3]))
+                   for c in d["calls"]]
+        s.acq_with = [(a[0], int(a[1]), tuple(a[2]))
+                      for a in d["acq_with"]]
+        s.acq_exp = [(a[0], int(a[1]), bool(a[2])) for a in d["acq_exp"]]
+        s.releases = [(r[0], int(r[1]), bool(r[2]))
+                      for r in d["releases"]]
+        s.rank_direct = bool(d["rank_direct"])
+        s.rank_calls = list(d["rank_calls"])
+        s.deadline_params = list(d["deadline_params"])
+        s.deadline_read = list(d["deadline_read"])
+        return s
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+def _extract_function(index, info):
+    """One function's direct facts: a structure-aware walk tracking the
+    held-lock set through ``with`` nesting and the in-``finally`` flag
+    through try statements. Nested defs/lambdas are separate scopes —
+    code inside them does not run when this function does."""
+    s = FunctionSummary(info.key, info.line, info.public)
+    cls, fnkey = info.cls, info.key
+
+    def walk(node, held, fin):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new = list(held)
+            for item in node.items:
+                lk = cg.lock_key(index, item.context_expr, cls, fnkey)
+                if lk:
+                    s.acq_with.append((lk, item.context_expr.lineno,
+                                       tuple(new)))
+                    new.append(lk)
+                else:
+                    walk(item.context_expr, tuple(new), fin)
+                if item.optional_vars is not None:
+                    walk(item.optional_vars, tuple(new), fin)
+            for st in node.body:
+                walk(st, tuple(new), fin)
+            return
+        if isinstance(node, ast.Try):
+            for st in node.body:
+                walk(st, held, fin)
+            for h in node.handlers:
+                if h.type is not None:
+                    walk(h.type, held, fin)
+                for st in h.body:
+                    walk(st, held, fin)
+            for st in node.orelse:
+                walk(st, held, fin)
+            for st in node.finalbody:
+                walk(st, held, True)
+            return
+        if isinstance(node, ast.Call):
+            b = cg.classify_blocking(index, node)
+            if b:
+                kind, what, deadlined = b
+                s.blocks.append((kind, what, node.lineno, held, deadlined))
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in (
+                    "acquire", "release"):
+                lk = cg.lock_key(index, func.value, cls, fnkey)
+                if lk:
+                    if func.attr == "acquire":
+                        s.acq_exp.append((lk, node.lineno, fin))
+                    else:
+                        s.releases.append((lk, node.lineno, fin))
+            callee = cg.resolve_callee(index, node, cls, fnkey)
+            if callee:
+                s.calls.append((callee, node.lineno, held, fin))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held, fin)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held, fin)
+
+    for st in info.node.body:
+        walk(st, (), False)
+    _extract_rank(index, info, s)
+    _extract_deadline(info, s)
+    return s
+
+
+def _is_rank_call(ctx, node) -> bool:
+    return isinstance(node, ast.Call) and \
+        ctx.resolve(node.func) in _RANK_SOURCES
+
+
+def _scope_walk(fn_node):
+    """This function's own nodes — stops at nested def/lambda
+    boundaries (their assignments and returns are their own)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _extract_rank(index, info, s):
+    """Return-value rank taint: does this function's return derive from
+    ``jax.process_index()`` — directly, through a local name, or through
+    a same-module call (resolved later by the fixpoint)?"""
+    ctx = index.ctx
+    tainted: set = set()
+    name_keys: dict = {}            # name -> same-module callee keys
+    assigns = []
+    for node in _scope_walk(info.node):
+        if isinstance(node, ast.Assign):
+            assigns.append((node.targets, node.value))
+        elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)) \
+                and node.value is not None:
+            assigns.append(([node.target], node.value))
+    changed = True
+    while changed:                  # local two-level flows: a = pi(); b = a
+        changed = False
+        for targets, value in assigns:
+            dirty = False
+            keys = set()
+            for sub in ast.walk(value):
+                if _is_rank_call(ctx, sub):
+                    dirty = True
+                elif isinstance(sub, ast.Name):
+                    if sub.id in tainted:
+                        dirty = True
+                    keys |= name_keys.get(sub.id, set())
+                elif isinstance(sub, ast.Call):
+                    callee = cg.resolve_callee(index, sub, info.cls,
+                                               info.key)
+                    if callee:
+                        keys.add(callee)
+            if not dirty and not keys:
+                continue
+            for t in targets:
+                for sub in ast.walk(t):
+                    if not isinstance(sub, ast.Name):
+                        continue
+                    if dirty and sub.id not in tainted:
+                        tainted.add(sub.id)
+                        changed = True
+                    if keys - name_keys.get(sub.id, set()):
+                        name_keys[sub.id] = \
+                            name_keys.get(sub.id, set()) | keys
+                        changed = True
+    rank_calls: set = set()
+    for node in _scope_walk(info.node):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        for sub in ast.walk(node.value):
+            if _is_rank_call(ctx, sub):
+                s.rank_direct = True
+            elif isinstance(sub, ast.Name):
+                if sub.id in tainted:
+                    s.rank_direct = True
+                rank_calls |= name_keys.get(sub.id, set())
+            elif isinstance(sub, ast.Call):
+                callee = cg.resolve_callee(index, sub, info.cls, info.key)
+                if callee:
+                    rank_calls.add(callee)
+    s.rank_calls = sorted(rank_calls)
+
+
+def _extract_deadline(info, s):
+    a = info.node.args
+    params = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)
+              if _DEADLINE_PARAM_RE.search(p.arg)]
+    if not params:
+        return
+    read = set()
+    # whole-subtree walk deliberately: a nested closure capturing the
+    # deadline param (a hedge thread's run()) IS threading it
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Name) and node.id in params \
+                and isinstance(node.ctx, (ast.Load, ast.Del)):
+            read.add(node.id)
+    s.deadline_params = params
+    s.deadline_read = sorted(read)
+
+
+# ---------------------------------------------------------------------------
+# module summaries + fixpoint
+# ---------------------------------------------------------------------------
+
+class ModuleSummaries:
+    """All function summaries of one module plus the transitive facts
+    computed by fixpoint over the call graph."""
+
+    def __init__(self, ctx, functions):
+        self.ctx = ctx
+        self.functions = functions            # key -> FunctionSummary
+        self._index = None
+        edges = {k: [c for (c, _l, _h, _f) in s.calls if c in functions]
+                 for k, s in functions.items()}
+        self.edges = edges
+        # transitive blocking ops: {key: {(kind, what)}}
+        self.reach = self._fixpoint(
+            {k: {(b[0], b[1]) for b in s.blocks}
+             for k, s in functions.items()}, edges)
+        # transitive lock acquisitions
+        self.trans_acquires = self._fixpoint(
+            {k: {a[0] for a in s.acq_with} | {a[0] for a in s.acq_exp}
+             for k, s in functions.items()}, edges)
+        # transitive releases (for exception-path analysis: a helper
+        # called from a finally that releases the slot counts)
+        self.trans_releases = self._fixpoint(
+            {k: {r[0] for r in s.releases} for k, s in functions.items()},
+            edges)
+        # rank taint: boolean fixpoint over return-flow edges
+        taint = {k: s.rank_direct for k, s in functions.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, s in functions.items():
+                if taint[k]:
+                    continue
+                if any(taint.get(c, False) for c in s.rank_calls):
+                    taint[k] = True
+                    changed = True
+        self.rank_taint = taint
+
+    @staticmethod
+    def _fixpoint(direct, edges):
+        """Monotone set join to a fixed point — cycle-safe by
+        construction (the sets only grow and are bounded by the union
+        of all direct facts)."""
+        reach = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, callees in edges.items():
+                r = reach[k]
+                n = len(r)
+                for c in callees:
+                    if c != k and c in reach:
+                        r |= reach[c]
+                if len(r) != n:
+                    changed = True
+        return reach
+
+    @property
+    def index(self) -> "cg.ModuleIndex":
+        """The (lazily built) AST-side module index — rules that walk
+        the tree (G18) use it; cache hits that don't never pay for it."""
+        if self._index is None:
+            self._index = cg.build_index(self.ctx)
+        return self._index
+
+    def chain(self, start, kind_what):
+        """Shortest call chain (list of function keys) from ``start`` to
+        a function whose DIRECT blocks contain ``kind_what``, plus the
+        op line in that function — for human-readable findings."""
+        target = None
+        frontier = [(start, [start])]
+        seen = {start}
+        while frontier:
+            nxt = []
+            for key, path in frontier:
+                s = self.functions.get(key)
+                if s is None:
+                    continue
+                for b in s.blocks:
+                    if (b[0], b[1]) == kind_what:
+                        return path, b[2]
+                for c, _l, _h, _f in s.calls:
+                    if c in self.functions and c not in seen:
+                        seen.add(c)
+                        nxt.append((c, path + [c]))
+            frontier = nxt
+        return target, None
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+class SummaryCache:
+    """Fingerprint-keyed per-file summary store. ``new`` entries are
+    kept apart from the loaded ones so a forked ``--jobs`` worker can
+    drain its delta back to the parent, which merges and persists."""
+
+    def __init__(self, path=None):
+        self.path = path
+        self._data: dict = {}
+        self.new: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    @classmethod
+    def load(cls, path):
+        c = cls(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            if isinstance(data, dict) and \
+                    data.get("version") == _SCHEMA_VERSION:
+                c._data = data.get("entries", {})
+        except (OSError, ValueError):
+            pass                     # unreadable cache: rebuild silently
+        return c
+
+    def get(self, fp):
+        entry = self.new.get(fp) or self._data.get(fp)
+        if entry is not None:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return entry
+
+    def put(self, fp, entry):
+        self.new[fp] = entry
+
+    def save(self, keep=None):
+        """Persist (atomically: tmp + replace — the lint tier practices
+        what it lints). ``keep`` bounds the entry count; stale entries
+        (files since edited) are the ones dropped first."""
+        if not self.path:
+            return
+        entries = {**self._data, **self.new}
+        if keep is not None and len(entries) > keep:
+            fresh = set(self.new)
+            for fp in list(entries):
+                if len(entries) <= keep:
+                    break
+                if fp not in fresh:
+                    del entries[fp]
+        payload = {"version": _SCHEMA_VERSION, "entries": entries}
+        # pid-unique staging: a pre-commit hook and a manual run saving
+        # concurrently must not interleave into one tmp (the shared
+        # temp-file class atomic_write solves with per-call suffixes;
+        # analysis stays runtime-free — ci/lint.py path-loads it — so
+        # the tmp+replace pattern is by hand, not atomic_write)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            # graftlint: disable=G7 hand-rolled tmp + os.replace below
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+                f.write("\n")
+            os.replace(tmp, self.path)
+        except OSError:
+            try:                   # the cache is an optimization: a
+                os.unlink(tmp)     # failed save must not fail the lint
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {"hits": self.hits, "misses": self.misses,
+                "hit_rate": round(self.hits / total, 3) if total else None}
+
+
+_active_cache: SummaryCache | None = None
+
+
+def set_active_cache(cache) -> SummaryCache | None:
+    """Install (or, with None, remove) the process-wide cache; returns
+    the previous one so callers can nest/restore."""
+    global _active_cache
+    prev, _active_cache = _active_cache, cache
+    return prev
+
+
+def active_cache():
+    return _active_cache
+
+
+def drain_active_cache():
+    """(new_entries, hits, misses) accumulated since the last drain —
+    the ``--jobs`` worker's return payload."""
+    c = _active_cache
+    if c is None:
+        return {}, 0, 0
+    delta = (dict(c.new), c.hits, c.misses)
+    c.new.clear()
+    c.hits = c.misses = 0
+    return delta
+
+
+def merge_cache_delta(delta) -> None:
+    """Fold a worker's drained delta into the parent's active cache."""
+    c = _active_cache
+    if c is None:
+        return
+    new, hits, misses = delta
+    c.new.update(new)
+    c.hits += hits
+    c.misses += misses
+
+
+def fingerprint(src: str) -> str:
+    raw = f"{src}\x00schema{_SCHEMA_VERSION}".encode("utf-8", "replace")
+    return hashlib.sha1(raw).hexdigest()
+
+
+def module_summaries(ctx, cache=None) -> ModuleSummaries:
+    """Summaries for one :class:`~.core.FileContext`, through the cache
+    when one is active (content fingerprint pins the whole file text,
+    so cached line numbers are exact by construction)."""
+    cache = cache if cache is not None else _active_cache
+    fp = fingerprint(ctx.src)
+    if cache is not None:
+        entry = cache.get(fp)
+        if entry is not None:
+            funcs = {k: FunctionSummary.from_dict(k, d)
+                     for k, d in entry.items()}
+            return ModuleSummaries(ctx, funcs)
+    index = cg.build_index(ctx)
+    funcs = {key: _extract_function(index, info)
+             for key, info in index.functions.items()}
+    if cache is not None:
+        cache.put(fp, {k: s.to_dict() for k, s in funcs.items()})
+    ms = ModuleSummaries(ctx, funcs)
+    ms._index = index               # already built: share it
+    return ms
+
+
+def for_context(ctx) -> ModuleSummaries:
+    """Memoized per-FileContext accessor — every G15-G19 rule shares ONE
+    summary computation per file (the shared-AST contract)."""
+    ms = getattr(ctx, "_mod_summaries", None)
+    if ms is None:
+        ms = module_summaries(ctx)
+        ctx._mod_summaries = ms
+    return ms

@@ -136,6 +136,11 @@ def _metrics_report(path: str) -> dict:
     return report.metrics_report(path)
 
 
+def _lint_report(root: str) -> dict:
+    from ..analysis import report
+    return report.lint_report(root)
+
+
 def _summ_checkpoint(ck) -> str:
     if ck.get("newest_step") is None:
         return f"checkpoint root {ck['root']}: no committed steps"
@@ -208,6 +213,17 @@ def _summ_metrics(mt) -> str:
             f"{int(mt.get('compiles_total', 0))} compiles")
 
 
+def _summ_lint(lt) -> str:
+    rules = ", ".join(f"{k}={v}" for k, v in sorted(lt["rules"].items()))
+    cache = lt.get("cache") or {}
+    hr = cache.get("hit_rate")
+    return (f"lint: {lt['files']} files in {lt['wall_s']}s, "
+            f"{lt['new']} new / {lt['baselined']} baselined"
+            + (f" ({rules})" if rules else "")
+            + f"; summary-cache hit-rate "
+              f"{'n/a' if hr is None else hr}")
+
+
 # One row per report surface: adding a reporter means adding one row
 # here, not editing three code paths (argument registration, report
 # assembly, and the stderr summary all iterate this table).
@@ -237,6 +253,11 @@ _REPORT_TABLE = (
      "observability.snapshot() dump): summarize compile counts/times "
      "and step-phase percentiles (docs/observability.md)",
      _metrics_report, _summ_metrics),
+    ("lint", "--lint", None, "DIR",
+     "repo checkout root: run graftlint (all tiers incl. the "
+     "interprocedural G15-G19) and summarize per-rule finding counts "
+     "and the summary-cache hit rate (docs/static_analysis.md)",
+     _lint_report, _summ_lint),
 )
 
 

@@ -233,6 +233,9 @@ def get_journal() -> Journal:
     global _global
     with _global_lock:
         if _global is None:
+            # init-once: opening the sink under the lock IS the
+            # singleton contract (uncontended after the first call)
+            # graftlint: disable=G15 init-once sink open
             _global = Journal()
         return _global
 
@@ -244,5 +247,8 @@ def reset_journal(path: str | None = None) -> Journal:
     with _global_lock:
         if _global is not None:
             _global.close()
+        # sink rotation: close-old/open-new must be atomic vs writers
+        # or a record lands in a closed handle
+        # graftlint: disable=G15 atomic sink rotation
         _global = Journal(path)
         return _global

@@ -144,9 +144,18 @@ class Heartbeat:
         self._thread = None
         # beat() is called by the daemon AND by lifecycle code that
         # wants a state change published immediately (a draining
-        # replica); both stage into the same pid-derived temp file, so
-        # concurrent beats must serialize or they tear each other
+        # replica).  The lock covers only in-memory state (seq bump,
+        # dirty/writer flags) — the ledger write runs OUTSIDE it (G15:
+        # no file I/O under a lock).  Publish order still matches beat
+        # order because exactly ONE writer is in flight at a time: a
+        # beat arriving mid-write marks _dirty and returns, and the
+        # in-flight writer loops, re-sampling the payload until the
+        # flag stays clear — so the last write always reflects a sample
+        # taken at-or-after the last beat() (a racing stale daemon
+        # sample can never overwrite a lifecycle not-ready flip)
         self._beat_lock = threading.Lock()
+        self._dirty = False
+        self._writing = False
 
     @property
     def path(self) -> str:
@@ -155,22 +164,44 @@ class Heartbeat:
 
     def beat(self) -> None:
         """Write one heartbeat now (the daemon calls this on a timer;
-        lifecycle code calls it to publish a payload change at once)."""
+        lifecycle code calls it to publish a payload change at once).
+        When another thread's write is in flight this returns after
+        marking the state dirty — the in-flight writer re-samples and
+        republishes, so the caller's change still lands promptly and
+        never loses to a stale concurrent sample."""
         with self._beat_lock:
             self._seq += 1
-            doc = {"member": self.member, "pid": os.getpid(),
-                   "seq": self._seq}
-            if self.payload is not None:
-                try:
-                    doc.update(self.payload())
-                except Exception as e:   # liveness must outlive a broken
-                    doc["payload_error"] = type(e).__name__   # provider
-            try:
-                with atomic.atomic_write(self.path, "w",
-                                         durable=False) as f:
-                    json.dump(doc, f)
-            except OSError:
-                pass     # a transient hb write failure must not kill us
+            self._dirty = True
+            if self._writing:
+                return        # the in-flight writer republishes for us
+            self._writing = True
+        try:
+            while True:
+                with self._beat_lock:
+                    if not self._dirty:
+                        # exit decision + flag clear are ONE critical
+                        # section: a beat() landing after this release
+                        # sees _writing False and writes itself
+                        self._writing = False
+                        return
+                    self._dirty = False
+                    doc = {"member": self.member, "pid": os.getpid(),
+                           "seq": self._seq}
+                if self.payload is not None:
+                    try:
+                        doc.update(self.payload())
+                    except Exception as e:   # liveness must outlive a
+                        doc["payload_error"] = type(e).__name__  # broken
+                try:                                          # provider
+                    with atomic.atomic_write(self.path, "w",
+                                             durable=False) as f:
+                        json.dump(doc, f)
+                except OSError:
+                    pass     # a transient hb write failure must not
+        except BaseException:                             # kill us
+            with self._beat_lock:     # next beat() becomes the writer
+                self._writing = False
+            raise
 
     def start(self) -> "Heartbeat":
         if self._thread is not None:

@@ -203,12 +203,23 @@ class Tracer:
         self._lock = threading.Lock()
         self.recorded = 0
         self.dropped = 0
-        if self._bad_mode is not None:
-            from ..diagnostics.journal import get_journal
-            get_journal().event(
-                "trace_bad_mode", value=self._bad_mode,
-                detail=f"MXNET_TPU_TRACE={self._bad_mode!r} not in "
-                       f"{MODES}; tracing stays off")
+
+    def journal_bad_mode(self) -> None:
+        """Journal a rejected ``MXNET_TPU_TRACE`` value, once.  A
+        separate step (not ``__init__``) because construction happens
+        under ``_tracer_lock`` and the journal is file I/O no lock may
+        hold across (G15); get_tracer/configure call this after
+        release."""
+        with self._lock:     # claim-once: two first-users must not
+            bad = self._bad_mode          # both journal the same note
+            self._bad_mode = None
+        if bad is None:
+            return
+        from ..diagnostics.journal import get_journal
+        get_journal().event(
+            "trace_bad_mode", value=bad,
+            detail=f"MXNET_TPU_TRACE={bad!r} not in "
+                   f"{MODES}; tracing stays off")
 
     def _record(self, sp: Span) -> None:
         d = sp.to_dict()
@@ -251,7 +262,9 @@ def get_tracer() -> Tracer:
     with _tracer_lock:
         if _tracer is None:
             _tracer = Tracer()
-        return _tracer
+        t = _tracer
+    t.journal_bad_mode()            # journal I/O: after the lock
+    return t
 
 
 def configure(mode=None, ring=None) -> Tracer:
@@ -260,7 +273,9 @@ def configure(mode=None, ring=None) -> Tracer:
     global _tracer
     with _tracer_lock:
         _tracer = Tracer(mode=mode, ring=ring)
-        return _tracer
+        t = _tracer
+    t.journal_bad_mode()            # journal I/O: after the lock
+    return t
 
 
 def reset_tracer() -> Tracer:

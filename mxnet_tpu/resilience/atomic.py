@@ -27,6 +27,7 @@ Stdlib-only; transient fsync/replace failures ride
 from __future__ import annotations
 
 import contextlib
+import itertools
 import os
 
 from ..diagnostics.journal import get_journal
@@ -36,6 +37,15 @@ __all__ = ["atomic_write", "fsync_dir", "set_fault_hook", "sweep_tmp",
            "trip"]
 
 _TMP_MARK = ".tmp."
+# per-call staging suffix: <path>.tmp.<pid>.<n>.  The counter makes
+# concurrent writers to the SAME path stage into DIFFERENT temp files —
+# pid alone is not unique across threads, and the pre-fix heartbeat
+# beat() had to hold a lock across this whole write only to keep the
+# daemon and a lifecycle publish from tearing each other's staging file
+# (graftlint G15's lock-held-file-I/O class). Replace order decides the
+# winner; both candidates are whole documents, so readers still only
+# ever observe complete old or complete new bytes.
+_tmp_seq = itertools.count()
 
 _fault_hook = None
 
@@ -108,14 +118,16 @@ def fsync_dir(path: str) -> None:
 def atomic_write(path, mode: str = "wb", encoding: str | None = None,
                  durable: bool = True):
     """Write ``path`` all-or-nothing: yield a file handle over
-    ``<path>.tmp.<pid>``; on clean exit flush + fsync + ``os.replace``
-    into place (+ parent-directory fsync when ``durable``).
+    ``<path>.tmp.<pid>.<n>`` (per-call unique — concurrent writers to
+    one path never share a staging file); on clean exit flush + fsync +
+    ``os.replace`` into place (+ parent-directory fsync when
+    ``durable``).
 
     ``mode`` must be a write mode ('wb', 'w'); text mode takes
     ``encoding``. The temp lives in the target's directory so the
     rename never crosses a filesystem boundary."""
     path = os.fspath(path)
-    tmp = f"{path}{_TMP_MARK}{os.getpid()}"
+    tmp = f"{path}{_TMP_MARK}{os.getpid()}.{next(_tmp_seq)}"
     trip("open", tmp)
     kwargs = {} if "b" in mode else {"encoding": encoding or "utf-8"}
     f = open(tmp, mode, **kwargs)

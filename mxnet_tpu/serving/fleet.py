@@ -256,26 +256,35 @@ class Fleet(Server):
                                "(submit(x, tenant=...))")
             err.retryable = False
             raise err
-        with self._tlock:
-            ts = self.tenants.get(str(tenant))
-            if ts is None or ts.removed:
-                err = RequestError(f"unknown tenant {tenant!r} — not in "
-                                   "this fleet's registry")
-                err.retryable = True   # another replica may serve it
-                err.tenant = tenant
-                raise err
-            self._breaker_gate(ts)
-            if not ts.bucket.allow():
-                ts.counters["shed"] += 1
-                self._release_probe(ts)
-                with self._lock:
-                    self.counters["shed"] += 1
-                get_journal().event("serving_shed", tenant=ts.name,
-                                    tier="rate_budget",
-                                    rate_rps=ts.slo.rate_rps)
-                raise ServerOverloaded(
-                    self._queue.qsize(), self.config.max_queue,
-                    tier="rate_budget", tenant=ts.name)
+        events: list = []
+        shed = False
+        try:
+            with self._tlock:
+                ts = self.tenants.get(str(tenant))
+                if ts is None or ts.removed:
+                    err = RequestError(f"unknown tenant {tenant!r} — "
+                                       "not in this fleet's registry")
+                    err.retryable = True   # another replica may serve it
+                    err.tenant = tenant
+                    raise err
+                self._breaker_gate(ts, events)
+                if not ts.bucket.allow():
+                    ts.counters["shed"] += 1
+                    self._release_probe(ts)
+                    shed = True
+        finally:
+            # the quarantine gate raises THROUGH this admission path:
+            # its transitions must journal either way, outside _tlock
+            self._emit_quarantine(events)
+        if shed:
+            with self._lock:
+                self.counters["shed"] += 1
+            get_journal().event("serving_shed", tenant=ts.name,
+                                tier="rate_budget",
+                                rate_rps=ts.slo.rate_rps)
+            raise ServerOverloaded(
+                self._queue.qsize(), self.config.max_queue,
+                tier="rate_budget", tenant=ts.name)
         return ts
 
     def _release_probe(self, ts):
@@ -285,10 +294,11 @@ class Fleet(Server):
         if ts.state == HALF_OPEN:
             ts.probing = False
 
-    def _breaker_gate(self, ts):
-        """Quarantine gate at admission (caller holds ``_tlock``): a
-        quarantined tenant rejects until the cooldown elapses, then
-        goes half-open and admits exactly ONE probe."""
+    def _breaker_gate(self, ts, events):
+        """Quarantine gate at admission (caller holds ``_tlock``;
+        transition payloads append to ``events`` for post-lock
+        emission): a quarantined tenant rejects until the cooldown
+        elapses, then goes half-open and admits exactly ONE probe."""
         if ts.state == ADMITTED:
             return
         if ts.state == QUARANTINED:
@@ -297,7 +307,8 @@ class Fleet(Server):
                     time.monotonic() - ts.opened_t < cooldown:
                 ts.counters["quarantine_rejects"] += 1
                 raise TenantQuarantined(ts.name, ts.reason or "faulted")
-            self._transition(ts, HALF_OPEN, "cooldown_elapsed")
+            events.append(
+                self._transition(ts, HALF_OPEN, "cooldown_elapsed"))
         # half-open: one probe in flight at a time.  A probe-slot
         # rejection is RETRYABLE — it says this replica's slot is busy,
         # not that the tenant's artifact is broken, so the router may
@@ -310,6 +321,12 @@ class Fleet(Server):
         ts.probing = True
 
     def _transition(self, ts, to, reason):
+        """Mutate one tenant breaker (caller holds ``_tlock``) and
+        return the journal payload.  Emission happens via
+        :meth:`_emit_quarantine` AFTER the lock releases (G15): the
+        pre-fix shape opened a span and wrote the journal from inside
+        the admission/bookkeeping critical sections, so one slow
+        journal write stalled every tenant's admission."""
         frm, ts.state = ts.state, to
         if to == QUARANTINED:
             ts.opened_t = time.monotonic()
@@ -321,27 +338,35 @@ class Fleet(Server):
             if frm == HALF_OPEN:
                 ts.counters["readmissions"] += 1
         ts.reason = reason
-        # the transition gets its own span (inheriting the request/batch
-        # trace when one is active, a fresh root otherwise) so the
-        # quarantine -> half-open -> re-admit trail is ALWAYS
-        # trace-correlated in the journal, whichever thread trips it
-        with _trace.span("tenant_quarantine", tenant=ts.name, frm=frm,
-                         to=to, reason=reason):
-            get_journal().event("tenant_quarantine", tenant=ts.name,
-                                frm=frm, to=to, reason=reason,
-                                failures=ts.failures)
+        return {"tenant": ts.name, "frm": frm, "to": to,
+                "reason": reason, "failures": ts.failures}
+
+    @staticmethod
+    def _emit_quarantine(events) -> None:
+        """Journal deferred quarantine transitions (outside ``_tlock``).
+        Each gets its own span (inheriting the request/batch trace when
+        one is active, a fresh root otherwise) so the quarantine ->
+        half-open -> re-admit trail is ALWAYS trace-correlated in the
+        journal, whichever thread trips it."""
+        for ev in events:
+            attrs = {k: v for k, v in ev.items() if k != "failures"}
+            with _trace.span("tenant_quarantine", **attrs):
+                get_journal().event("tenant_quarantine", **ev)
 
     def _tenant_failure(self, ts, reason):
         """One breaker feed: shape reject, corrupt committed checkpoint,
         or non-transient predictor error.  K consecutive failures — or
         any failure while half-open — quarantine the tenant (only)."""
+        events: list = []
         with self._tlock:
             ts.failures += 1
             if ts.state == HALF_OPEN:
-                self._transition(ts, QUARANTINED, f"probe_failed:{reason}")
+                events.append(self._transition(
+                    ts, QUARANTINED, f"probe_failed:{reason}"))
             elif ts.state == ADMITTED and \
                     ts.failures >= self.config.tenant_breaker_k:
-                self._transition(ts, QUARANTINED, reason)
+                events.append(self._transition(ts, QUARANTINED, reason))
+        self._emit_quarantine(events)
 
     def _note_reject(self, tenant):
         with self._tlock:
@@ -562,12 +587,15 @@ class Fleet(Server):
             return
         ts.counters["served"] += sum(1 for r in batch
                                      if r.error is None)
+        events: list = []
         with self._tlock:
             if ts.state == HALF_OPEN:
-                self._transition(ts, ADMITTED, "probe_succeeded")
+                events.append(
+                    self._transition(ts, ADMITTED, "probe_succeeded"))
             else:
                 ts.failures = 0        # consecutive-failure semantics
                 ts.probing = False
+        self._emit_quarantine(events)
 
     # -- hot-reload (per tenant) ------------------------------------------
     def _maybe_reload(self, force=False):

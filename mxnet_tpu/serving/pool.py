@@ -198,9 +198,11 @@ class LocalReplica:
     def restart(self, deadline_s=None):
         """Replace the server with a fresh ``factory()`` build — which
         re-reads the newest valid committed step from its ParamStore at
-        ``start()`` (the upgrade path)."""
+        ``start()`` (the upgrade path).  ``deadline_s`` bounds the old
+        server's stop."""
         if self.server is not None:
-            self.server.stop(timeout_s=30.0)
+            self.server.stop(timeout_s=30.0 if deadline_s is None
+                             else max(float(deadline_s), 1.0))
         self.server = self.factory()
         self.server.start()
         self._draining = False
@@ -329,22 +331,34 @@ class ProcReplica:
     def restart(self, deadline_s=None):
         """Stop (graceful ``stop`` frame, then terminate/kill fallback)
         and spawn a fresh worker — which reads the newest CRC-valid
-        committed step at startup."""
+        committed step at startup.  ``deadline_s`` bounds the whole
+        stop ladder — pre-fix it was accepted and silently dropped
+        while every wait ran on fixed constants (the exact G19 class
+        this PR's audit flagged); without one the historical
+        5/15/10/10 ladder applies."""
         proc = self.proc
         if proc is not None and proc.poll() is None:
+            deadline = None if deadline_s is None \
+                else time.monotonic() + max(float(deadline_s), 1.0)
+
+            def budget(default):
+                if deadline is None:
+                    return default
+                return max(min(default, deadline - time.monotonic()), 1.0)
+
             try:
-                self._roundtrip({"cmd": "stop"}, budget_s=5.0)
+                self._roundtrip({"cmd": "stop"}, budget_s=budget(5.0))
             except ReplicaUnavailable:
                 pass
             try:
-                proc.wait(timeout=15.0)
+                proc.wait(timeout=budget(15.0))
             except subprocess.TimeoutExpired:
                 proc.terminate()
                 try:
-                    proc.wait(timeout=10.0)
+                    proc.wait(timeout=budget(10.0))
                 except subprocess.TimeoutExpired:
                     proc.kill()
-                    proc.wait(timeout=10.0)
+                    proc.wait(timeout=budget(10.0))
         self.proc = None
         self.start()
 
@@ -507,7 +521,7 @@ class ReplicaPool:
         # as its own respawns, or it races this restart with another
         self._last_respawn[rid] = time.monotonic()
         with self._lock:
-            self.replicas[rid].restart()
+            self.replicas[rid].restart(deadline_s=deadline_s)
         ready = self.wait_ready([rid])
         get_journal().event("pool_restart", replica=rid,
                             residual=residual, ready=ready)

@@ -140,8 +140,9 @@ class Router:
     def __init__(self, pool, config=None):
         self.pool = pool
         self.config = config or RouterConfig()
-        # reentrant: breaker transitions are journaled from inside
-        # counter/placement critical sections
+        # serializes counters/breakers/placement.  No I/O ever runs
+        # under it: breaker transitions mutate inside and journal via
+        # _emit_breaker after release (graftlint G15)
         self._lock = threading.RLock()
         self._rr = itertools.count()         # least-loaded tiebreak
         self._breakers: dict = {}            # rid -> _Breaker
@@ -308,32 +309,45 @@ class Router:
         return br
 
     def _transition(self, rid, br, to, reason):
+        """Mutate one breaker (caller holds ``_lock``) and return the
+        journal payload.  The journal write is file I/O every router
+        thread would serialize behind, so callers emit the payload via
+        :meth:`_emit_breaker` AFTER releasing the lock (G15) — the
+        pre-fix shape journaled from inside the placement/counter
+        critical sections."""
         frm, br.state = br.state, to
         if to == OPEN:
             br.opened_t = time.monotonic()
             br.probing = False
-            with self._lock:
-                self.counters["breaker_opens"] += 1
+            self.counters["breaker_opens"] += 1
         if to == CLOSED:
             br.failures = 0
             br.probing = False
             if frm == HALF_OPEN:
-                with self._lock:
-                    self.counters["readmissions"] += 1
+                self.counters["readmissions"] += 1
         br.reason = reason
-        get_journal().event("router_breaker", replica=rid, frm=frm,
-                            to=to, reason=reason, failures=br.failures)
+        return {"replica": rid, "frm": frm, "to": to, "reason": reason,
+                "failures": br.failures}
 
-    def _allow(self, rid, alive, ready) -> bool:
-        """Breaker gate for one candidate.  Only a heartbeat STALL opens
-        the breaker here — a merely not-ready replica (draining, mid-
-        restart) is out of rotation without being declared broken.  The
-        half-open probe slot is claimed by ``_pick`` for the replica
-        actually SELECTED, never during candidate enumeration."""
+    @staticmethod
+    def _emit_breaker(events) -> None:
+        """Journal deferred breaker transitions (outside every lock)."""
+        for ev in events:
+            get_journal().event("router_breaker", **ev)
+
+    def _allow(self, rid, alive, ready, events) -> bool:
+        """Breaker gate for one candidate (caller holds ``_lock``;
+        transition payloads append to ``events`` for post-lock
+        emission).  Only a heartbeat STALL opens the breaker here — a
+        merely not-ready replica (draining, mid-restart) is out of
+        rotation without being declared broken.  The half-open probe
+        slot is claimed by ``_pick`` for the replica actually SELECTED,
+        never during candidate enumeration."""
         br = self._breaker(rid)
         if br.state == CLOSED:
             if not alive:
-                self._transition(rid, br, OPEN, "heartbeat_stall")
+                events.append(
+                    self._transition(rid, br, OPEN, "heartbeat_stall"))
                 return False
             return ready
         if not alive or not ready:
@@ -341,7 +355,8 @@ class Router:
         if br.state == OPEN:
             if br.opened_t is not None and time.monotonic() - br.opened_t \
                     >= self.config.breaker_cooldown_s:
-                self._transition(rid, br, HALF_OPEN, "cooldown_elapsed")
+                events.append(self._transition(rid, br, HALF_OPEN,
+                                               "cooldown_elapsed"))
             else:
                 return False
         # half-open: admissible only while no probe is in flight
@@ -367,15 +382,17 @@ class Router:
         round-robin)."""
         view = self.pool.view()            # ledger file I/O: OUTSIDE the
         candidates = []                    # lock — a slow shared FS must
-        with self._lock:                   # not stall every router thread
+        events: list = []                  # not stall every router thread
+        with self._lock:
             for s in view:
                 if s.id in exclude:
                     continue
                 if not self._serves_tenant(s, tenant):
                     continue
-                if not self._allow(s.id, s.alive, s.ready):
+                if not self._allow(s.id, s.alive, s.ready, events):
                     continue
                 candidates.append(s)
+        self._emit_breaker(events)         # journal I/O: after release
         if not candidates:
             return None
         depth = min(s.queue_depth for s in candidates)
@@ -402,25 +419,32 @@ class Router:
             self._release_probe(rid)
             return
         br = self._breaker(rid)
+        events: list = []
         with self._lock:
             br.failures += 1
             if br.state == HALF_OPEN:
-                self._transition(rid, br, OPEN, "probe_failed")
+                events.append(
+                    self._transition(rid, br, OPEN, "probe_failed"))
             elif br.state == CLOSED \
                     and br.failures >= self.config.breaker_k:
-                self._transition(rid, br, OPEN, "consecutive_failures")
+                events.append(self._transition(rid, br, OPEN,
+                                               "consecutive_failures"))
+        self._emit_breaker(events)
 
     def _record_success(self, rid, latency_ms):
         br = self._breaker(rid)
+        events: list = []
         with self._lock:
             if br.state == HALF_OPEN:
-                self._transition(rid, br, CLOSED, "probe_succeeded")
+                events.append(
+                    self._transition(rid, br, CLOSED, "probe_succeeded"))
             else:
                 br.failures = 0
             lat = self._latency.get(rid)
             if lat is None:
                 lat = self._latency.setdefault(
                     rid, LatencySummary(f"router_{rid}_ms"))
+        self._emit_breaker(events)
         lat.observe(latency_ms)
 
     def _release_probe(self, rid):

@@ -211,9 +211,14 @@ class Server:
         if not stuck:
             # straggler sweep: anything still queued after the worker
             # exited (the drain=False path, or a sentinel that couldn't
-            # be enqueued) fails structurally under the admission lock
+            # be enqueued) fails structurally.  Only the queue DRAIN
+            # needs the admission lock (atomic vs a racing submit's
+            # closed-check + put); the per-request journal writes
+            # happen after release (G15: no I/O under the admit lock)
+            stragglers: list = []
             with self._admit_lock:
-                self._fail_remaining([], why="straggler")
+                self._drain_queue(stragglers)
+            self._fail_remaining(stragglers, why="straggler")
         get_journal().event("serving_stop", drained=bool(drain),
                             stuck=stuck, **self.stats())
         if stuck:
@@ -476,6 +481,7 @@ class Server:
                         pending.append(item)
                 while pending:
                     self._flush(pending)
+            self._drain_queue(pending)   # racing submits since the sweep
             self._fail_remaining(pending)
 
     def _flush(self, pending):
@@ -532,7 +538,7 @@ class Server:
     def _note_deadline_miss(self, tenant):
         """Per-tenant deadline-miss counter hook (fleet)."""
 
-    def _fail_remaining(self, pending, why="stopped"):
+    def _drain_queue(self, pending):
         while True:
             try:
                 item = self._queue.get_nowait()
@@ -540,6 +546,8 @@ class Server:
                 break
             if item is not _STOP:
                 pending.append(item)
+
+    def _fail_remaining(self, pending, why="stopped"):
         for req in pending:
             with self._lock:
                 self.counters["rejected_stopped"] += 1

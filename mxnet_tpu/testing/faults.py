@@ -198,12 +198,16 @@ def torn_heartbeat(path_part="hb/", keep_bytes=7, times=1) -> FaultRule:
     readers must degrade (the member reads as stale until a whole
     record lands) and never crash (docs/elastic.md)."""
     def _tear(point, path, nbytes):
-        tmp = f"{path}{atomic._TMP_MARK}{os.getpid()}"
-        try:
-            with open(tmp, "r+b") as f:
-                f.truncate(int(keep_bytes))
-        except OSError:
-            pass                 # no temp staged: nothing to tear
+        import glob as _glob
+        # staged temps are per-call unique (<path>.tmp.<pid>.<n>):
+        # tear whichever is in flight for this path
+        pattern = _glob.escape(f"{path}{atomic._TMP_MARK}") + "*"
+        for tmp in _glob.glob(pattern):
+            try:
+                with open(tmp, "r+b") as f:
+                    f.truncate(int(keep_bytes))
+            except OSError:
+                pass             # no temp staged: nothing to tear
     return FaultRule("replace", None, path_part=path_part, times=times,
                      action=_tear)
 

@@ -1,0 +1,65 @@
+# graftlint: scope=library
+"""G15 fixture: blocking operations reached while holding a lock —
+directly, and transitively through same-module helper chains (the
+summary engine's reach set).  Parsed only, never executed."""
+import json
+import queue
+import threading
+import time
+
+
+class BadDirect:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue(maxsize=8)
+
+    def bad_sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)  # expect: G15
+
+    def bad_read_under_lock(self, path):
+        with self._lock:
+            with open(path, encoding="utf-8") as f:  # expect: G15
+                return f.read()
+
+    def bad_deadlined_wait_under_lock(self):
+        # a timeout does not excuse the wait: every peer stalls on the
+        # lock for the full budget
+        with self._lock:
+            return self._q.get(timeout=1.0)  # expect: G15
+
+
+class BadTransitive:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def _load(self, path):
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+
+    def _hop(self, path):
+        # one more hop: the reach set must cross TWO call edges
+        return self._load(path)
+
+    def bad_reaches_file_io(self, path):
+        with self._lock:
+            return self._hop(path)  # expect: G15
+
+
+class GoodShapes:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._staged = None
+
+    def good_mutate_then_read(self, path):
+        # the fixed shape: mutate under the lock, do the I/O after
+        with self._lock:
+            doc = dict(self._staged or ())
+        with open(path, encoding="utf-8") as f:
+            return doc, f.read()
+
+    def good_disable_twin(self):
+        with self._lock:
+            # init-once-style sanctioned exception
+            # graftlint: disable=G15 fixture twin: justified exception
+            time.sleep(0.01)

@@ -1,0 +1,67 @@
+# graftlint: scope=library
+"""G16 fixture: two locks acquired in opposite orders in one module —
+nested ``with`` on one path, a call-under-lock into a lock-taking
+helper on the other.  Two threads each holding their first lock
+deadlock with no timeout.  Parsed only, never executed."""
+import threading
+
+
+class BadCycle:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+
+    def path_one(self):
+        with self._state_lock:
+            with self._io_lock:  # expect: G16
+                return 1
+
+    def _take_state(self):
+        with self._state_lock:
+            return 2
+
+    def path_two(self):
+        # the inverse order arrives INTERPROCEDURALLY: io_lock held,
+        # then a helper that takes state_lock
+        with self._io_lock:
+            return self._take_state()
+
+
+class GoodOrder:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+
+    def one(self):
+        with self._state_lock:
+            with self._io_lock:
+                return 1
+
+    def two(self):
+        # same global order everywhere: no cycle
+        with self._state_lock:
+            with self._io_lock:
+                return 2
+
+    def reentrant(self):
+        # same-lock nesting (RLock style) is not a cycle
+        with self._state_lock:
+            with self._state_lock:
+                return 3
+
+
+class GoodDisableTwin:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+
+    def path_one(self):
+        with self._state_lock:
+            # graftlint: disable=G16 fixture twin: justified exception
+            with self._io_lock:
+                return 1
+
+    def path_two(self):
+        with self._io_lock:
+            with self._state_lock:
+                return 2

@@ -1,0 +1,56 @@
+# graftlint: scope=library
+"""G17 fixture: explicit ``.acquire()`` with no exception-safe release
+— straight-line release only (the first raise in between latches the
+slot forever), vs the finally / finally-called-helper shapes that pass.
+Parsed only, never executed."""
+import threading
+
+
+class BadLatch:
+    def __init__(self):
+        self._slot_sem = threading.BoundedSemaphore(1)
+        self._lock = threading.Lock()
+
+    def bad_straight_line(self, work):
+        self._slot_sem.acquire()  # expect: G17
+        result = work()           # a raise here latches the slot
+        self._slot_sem.release()
+        return result
+
+    def bad_no_release_at_all(self):
+        self._lock.acquire()  # expect: G17
+        return True
+
+
+class GoodShapes:
+    def __init__(self):
+        self._slot_sem = threading.BoundedSemaphore(1)
+
+    def good_finally(self, work):
+        self._slot_sem.acquire()
+        try:
+            return work()
+        finally:
+            self._slot_sem.release()
+
+    def _cleanup(self):
+        self._slot_sem.release()
+
+    def good_helper_release(self, work):
+        # the release lives in a helper the finally always calls — the
+        # summary engine's transitive release set must see it
+        self._slot_sem.acquire()
+        try:
+            return work()
+        finally:
+            self._cleanup()
+
+    def good_with_statement(self, work):
+        with self._slot_sem:
+            return work()
+
+    def good_disable_twin(self, work):
+        # ownership handoff: another thread releases by design
+        # graftlint: disable=G17 fixture twin: justified exception
+        self._slot_sem.acquire()
+        return work()

@@ -1,0 +1,58 @@
+# graftlint: scope=library
+"""G18 fixture: host-level collectives guarded by conditions whose
+rank-taint flows through FUNCTION RETURNS — the shapes per-function G12
+structurally cannot see (no ``process_index`` text in the guarded
+scope).  Parsed only, never executed."""
+import jax
+from jax.experimental import multihost_utils
+
+
+def _is_coordinator():
+    return jax.process_index() == 0
+
+
+def _is_leader_deep():
+    # taint through a second hop: the fixpoint must propagate it
+    return _is_coordinator()
+
+
+def bad_helper_guard(tree):
+    if _is_coordinator():
+        multihost_utils.process_allgather(tree)  # expect: G18
+
+
+def bad_deep_helper_guard(tag):
+    if _is_leader_deep():
+        multihost_utils.sync_global_devices(tag)  # expect: G18
+
+
+def bad_assigned_verdict(tree):
+    main = _is_coordinator()
+    if main:
+        multihost_utils.process_allgather(tree)  # expect: G18
+
+
+def good_world_size_guard(tree):
+    # world-SIZE conditionals are rank-uniform: every rank agrees
+    if jax.process_count() > 1:
+        multihost_utils.process_allgather(tree)
+
+
+def good_unconditional(tag):
+    multihost_utils.sync_global_devices(tag)
+
+
+def _shard_count():
+    return jax.device_count()
+
+
+def good_untainted_helper(tree):
+    # a helper that does NOT derive from process_index is no guard
+    if _shard_count() > 8:
+        multihost_utils.process_allgather(tree)
+
+
+def good_disable_twin(tree):
+    if _is_coordinator():
+        # graftlint: disable=G18 fixture twin: justified exception
+        multihost_utils.process_allgather(tree)

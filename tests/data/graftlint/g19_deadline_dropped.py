@@ -1,0 +1,54 @@
+# graftlint: scope=library
+"""G19 fixture: public APIs that accept a deadline/timeout parameter,
+never read it, and still (transitively) block — the signature promises
+a bounded wait and delivers an unbounded one.  Parsed only, never
+executed."""
+import queue
+import subprocess
+import time
+
+_q = queue.Queue(maxsize=4)
+
+
+def bad_dropped_timeout(x, timeout_s):  # expect: G19
+    _q.put(x, timeout=1.0)
+    # fixed constants: the caller's budget never arrives at the wait
+    return _q.get(timeout=5.0)
+
+
+def bad_dropped_deadline_via_helper(cmd, deadline_ms):  # expect: G19
+    # the blocking wait is a call-graph hop away: still this API's lie
+    return _spin(cmd)
+
+
+def _spin(cmd):
+    return subprocess.run(cmd, timeout=30.0)
+
+
+def good_threaded(x, timeout_s):
+    return _q.get(timeout=timeout_s)
+
+
+def good_deadline_loop(flag, deadline_s):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if flag():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def good_closure_read(x, timeout_s):
+    # reads inside nested closures count as threading the budget
+    def attempt():
+        return _q.get(timeout=timeout_s)
+    return attempt()
+
+
+def good_no_blocking(config, timeout_s):
+    config["timeout_s"] = timeout_s      # stored, and nothing blocks
+    return config
+
+
+def good_disable_twin(x, timeout_s):  # graftlint: disable=G19 twin
+    return _q.get(timeout=5.0)

@@ -1,0 +1,22 @@
+# graftlint: scope=library
+"""Historical fixture — the PR-9 half-open probe slot, PRE-fix: the
+breaker admits exactly ONE probe request; the slot was claimed at
+placement and released only on the success path, so the first
+exception between claim and release latched it forever — the replica
+silently never re-admitted until restart (found by chaos archaeology,
+fixed by hand in PR 10's hedge-path sweep).  The shipped code models
+the slot as a boolean under the router lock; this fixture models it as
+the semaphore it behaves as, the shape G17 catches statically.
+Parsed only, never executed."""
+import threading
+
+
+class PreFixBreaker:
+    def __init__(self):
+        self._probe_sem = threading.BoundedSemaphore(1)
+
+    def probe(self, replica, request):
+        self._probe_sem.acquire()  # expect: G17
+        value = replica.predict(request)   # raises on a failed probe...
+        self._probe_sem.release()          # ...and the slot never frees
+        return value

@@ -1,0 +1,35 @@
+# graftlint: scope=library
+"""Historical fixture — the PR-9 router, PRE-fix: the placement
+decision read the heartbeat ledger (one beacon file per replica) while
+holding the router lock, so one slow shared-filesystem read stalled
+every router thread behind the front door.  The shipped fix hoisted
+``pool.view()`` out of the critical section guarded only by a code
+comment; G15's interprocedural reach now enforces it (the I/O sits two
+call edges below the ``with``).  Parsed only, never executed."""
+import json
+import os
+import threading
+
+
+class PreFixRouter:
+    def __init__(self, hb_dir):
+        self._lock = threading.Lock()
+        self.hb_dir = hb_dir
+
+    def _read_beacon(self, rid):
+        path = os.path.join(self.hb_dir, f"replica-{rid}.json")
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+
+    def _view(self):
+        out = []
+        for name in os.listdir(self.hb_dir):
+            out.append(self._read_beacon(name.split("-", 1)[1]))
+        return out
+
+    def pick(self, exclude):
+        with self._lock:
+            candidates = [s for s in self._view()  # expect: G15
+                          if s["id"] not in exclude]
+        return min(candidates, key=lambda s: s["queue_depth"],
+                   default=None)

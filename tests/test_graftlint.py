@@ -359,6 +359,7 @@ def test_cli_rules_filter_and_errors():
     out = _cli(["--list-rules"])
     assert out.returncode == 0
     for code in ["G1", "G2", "G3", "G4", "G5", "G6", "G7", "G8", "G9",
+                 "G15", "G16", "G17", "G18", "G19",
                  "E1", "W1", "W2", "W3", "W4", "W5", "W6"]:
         assert code in out.stdout
 

@@ -1,0 +1,398 @@
+"""graftlint v2 — the interprocedural tier: call-graph construction
+(same-module resolution, base-class methods, nested defs), summary
+extraction + cycle-safe fixpoint (blocking reach, lock orders, rank
+taint), the fingerprint-keyed summary cache, ``--jobs`` parity,
+``--changed-only`` selection with reverse import-graph dependents, the
+doctor ``--lint`` report, and the audit fixes the engine drove
+(heartbeat beat outside its lock on unique temps, restart deadlines
+threaded)."""
+import ast
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from mxnet_tpu.analysis import callgraph as cg
+from mxnet_tpu.analysis import cli as lint_cli
+from mxnet_tpu.analysis import core
+from mxnet_tpu.analysis import summaries as sm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "graftlint")
+
+
+def _ctx(src, path="mxnet_tpu/fake_mod.py"):
+    return core.FileContext(path, src, ast.parse(src))
+
+
+def _summ(src, cache=None):
+    return sm.module_summaries(_ctx(src), cache=cache)
+
+
+# -- call-graph construction -------------------------------------------------
+
+def test_callgraph_resolves_self_module_and_base_methods():
+    src = (
+        "def helper():\n"
+        "    return 1\n"
+        "class Base:\n"
+        "    def shared(self):\n"
+        "        return helper()\n"
+        "class Child(Base):\n"
+        "    def go(self):\n"
+        "        return self.shared()\n"
+    )
+    ctx = _ctx(src)
+    index = cg.build_index(ctx)
+    assert set(index.functions) == {"helper", "Base.shared", "Child.go"}
+    call = next(n for n in ast.walk(index.functions["Child.go"].node)
+                if isinstance(n, ast.Call))
+    # self.shared() resolves through the same-module base chain
+    assert cg.resolve_callee(index, call, "Child", "Child.go") == \
+        "Base.shared"
+
+
+def test_callgraph_nested_defs_are_separate_scopes():
+    src = (
+        "import time\n"
+        "import threading\n"
+        "_lk = threading.Lock()\n"
+        "def outer():\n"
+        "    def inner():\n"
+        "        time.sleep(1)\n"
+        "    with _lk:\n"
+        "        return inner\n"            # DEFINED under the lock,
+    )                                       # never CALLED under it
+    ms = _summ(src)
+    assert "outer.inner" in ms.functions
+    # the sleep belongs to inner, and outer never calls it: no G15 food
+    assert not ms.functions["outer"].blocks
+    assert ("sleep", "time.sleep") in ms.reach["outer.inner"]
+    assert ("sleep", "time.sleep") not in ms.reach["outer"]
+
+
+# -- fixpoint ----------------------------------------------------------------
+
+def test_fixpoint_converges_on_recursion_and_cycles():
+    """a <-> b mutual recursion plus a self-recursive c: the monotone
+    join must terminate and both cycle members must reach the sleep."""
+    src = (
+        "import time\n"
+        "def a(n):\n"
+        "    time.sleep(0.1)\n"
+        "    return b(n - 1)\n"
+        "def b(n):\n"
+        "    return a(n) if n else 0\n"
+        "def c(n):\n"
+        "    return c(n - 1) if n else b(0)\n"
+    )
+    ms = _summ(src)
+    for fn in ("a", "b", "c"):
+        assert ("sleep", "time.sleep") in ms.reach[fn], fn
+    path, line = ms.chain("c", ("sleep", "time.sleep"))
+    assert path[0] == "c" and path[-1] == "a" and line == 3
+
+
+def test_rank_taint_propagates_through_returns_and_cycles():
+    src = (
+        "import jax\n"
+        "def direct():\n"
+        "    return jax.process_index() == 0\n"
+        "def hop():\n"
+        "    v = direct()\n"
+        "    return v\n"
+        "def cycle_a():\n"
+        "    return cycle_b() or hop()\n"
+        "def cycle_b():\n"
+        "    return cycle_a()\n"
+        "def clean():\n"
+        "    return 42\n"
+    )
+    ms = _summ(src)
+    assert ms.rank_taint["direct"] and ms.rank_taint["hop"]
+    assert ms.rank_taint["cycle_a"] and ms.rank_taint["cycle_b"]
+    assert not ms.rank_taint["clean"]
+
+
+def test_deadline_param_read_tracking_includes_closures():
+    src = (
+        "import queue\n"
+        "q = queue.Queue(maxsize=2)\n"
+        "def dropped(x, timeout_s):\n"
+        "    return q.get(timeout=5.0)\n"
+        "def threaded(x, timeout_s):\n"
+        "    def attempt():\n"
+        "        return q.get(timeout=timeout_s)\n"
+        "    return attempt()\n"
+    )
+    ms = _summ(src)
+    d, t = ms.functions["dropped"], ms.functions["threaded"]
+    assert d.deadline_params == ["timeout_s"] and d.deadline_read == []
+    assert t.deadline_read == ["timeout_s"]
+
+
+def test_lock_regions_annotate_blocks_and_orders():
+    src = (
+        "import threading, time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                time.sleep(1)\n"
+    )
+    ms = _summ(src)
+    s = ms.functions["C.one"]
+    (kind, what, _line, held, _dl), = s.blocks
+    assert kind == "sleep" and len(held) == 2
+    (outer, _l1, held0), (inner, _l2, held1) = s.acq_with
+    assert held0 == () and outer in held1
+
+
+# -- summary cache -----------------------------------------------------------
+
+def test_summary_cache_hit_equals_computed(tmp_path):
+    path = os.path.join(FIXTURES, "g15_blocking_under_lock.py")
+    src = open(path, encoding="utf-8").read()
+    cache = sm.SummaryCache(str(tmp_path / "c.json"))
+    cold = sm.module_summaries(
+        core.FileContext(path, src, ast.parse(src)), cache=cache)
+    assert cache.misses == 1 and cache.hits == 0
+    warm = sm.module_summaries(
+        core.FileContext(path, src, ast.parse(src)), cache=cache)
+    assert cache.hits == 1
+    assert warm.reach == cold.reach
+    assert warm.trans_acquires == cold.trans_acquires
+    assert {k: s.to_dict() for k, s in warm.functions.items()} == \
+        {k: s.to_dict() for k, s in cold.functions.items()}
+
+
+def test_summary_cache_invalidates_on_content_change(tmp_path):
+    cache = sm.SummaryCache(str(tmp_path / "c.json"))
+    _summ("def f():\n    return 1\n", cache=cache)
+    _summ("def f():\n    return 2\n", cache=cache)   # edited: must MISS
+    assert cache.misses == 2 and cache.hits == 0
+
+
+def test_summary_cache_roundtrips_and_survives_corruption(tmp_path):
+    cpath = str(tmp_path / "c.json")
+    cache = sm.SummaryCache(cpath)
+    src = "import time\ndef f():\n    time.sleep(1)\n"
+    _summ(src, cache=cache)
+    cache.save()
+    reloaded = sm.SummaryCache.load(cpath)
+    ms = _summ(src, cache=reloaded)
+    assert reloaded.hits == 1
+    assert ("sleep", "time.sleep") in ms.reach["f"]
+    with open(cpath, "w") as f:
+        f.write("{ corrupt json")
+    broken = sm.SummaryCache.load(cpath)       # must not raise
+    _summ(src, cache=broken)
+    assert broken.misses == 1
+
+
+def test_findings_identical_with_and_without_cache(tmp_path):
+    """The acceptance shape: a cache hit changes nothing about the
+    findings — fingerprint pins the file text, lines included."""
+    cache = sm.SummaryCache(str(tmp_path / "c.json"))
+    prev = sm.set_active_cache(cache)
+    try:
+        first = core.run([FIXTURES], root=REPO)[0]
+        second = core.run([FIXTURES], root=REPO)[0]
+    finally:
+        sm.set_active_cache(prev)
+    assert cache.hits > 0
+    nocache = core.run([FIXTURES], root=REPO)[0]
+    as_key = lambda fs: [f.sort_key() for f in fs]
+    assert as_key(first) == as_key(second) == as_key(nocache)
+
+
+# -- --jobs parity -----------------------------------------------------------
+
+def test_jobs_parallel_findings_match_serial():
+    serial, n1 = core.run([FIXTURES], root=REPO)
+    parallel, n2 = core.run([FIXTURES], root=REPO, jobs=4)
+    assert n1 == n2
+    assert [f.sort_key() for f in serial] == \
+        [f.sort_key() for f in parallel]
+    assert serial, "fixture corpus must produce findings"
+
+
+# -- historical fixtures (the engine catches the real PR-9/10 bugs) ----------
+
+def test_historical_latched_probe_is_flagged():
+    path = os.path.join(FIXTURES, "hist_latched_probe.py")
+    found = core.lint_file(path, rules=[core.load_rules()["G17"]],
+                           root=REPO)
+    assert len(found) == 1 and found[0].code == "G17"
+    assert "latches the slot" in found[0].message
+
+
+def test_historical_lock_held_ledger_io_is_flagged():
+    path = os.path.join(FIXTURES, "hist_lock_held_ledger_io.py")
+    found = core.lint_file(path, rules=[core.load_rules()["G15"]],
+                           root=REPO)
+    assert len(found) == 1 and found[0].code == "G15"
+    assert "_view" in found[0].message     # names the call chain
+
+
+# -- the audited subsystems stay clean ---------------------------------------
+
+@pytest.mark.parametrize("subsystem", [
+    "mxnet_tpu/serving", "mxnet_tpu/elastic", "mxnet_tpu/observability",
+    "mxnet_tpu/diagnostics", "mxnet_tpu/resilience"])
+def test_concurrency_rules_clean_on_audited_subsystems(subsystem):
+    """The audit-and-fix acceptance: every live G15-G19 finding was
+    fixed in this PR (router/fleet transition journaling deferred past
+    the locks, heartbeat write outside its lock, restart deadlines
+    threaded), none baselined."""
+    registry = core.load_rules()
+    rules = [registry[c] for c in ("G15", "G16", "G17", "G18", "G19")]
+    findings, n = core.run([subsystem], rules=rules, root=REPO)
+    assert n >= 4 and findings == []
+
+
+# -- --changed-only ----------------------------------------------------------
+
+def _git(cwd, *args):
+    out = subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t"] + list(args),
+        cwd=cwd, capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+def test_changed_only_selects_reverse_dependents(tmp_path):
+    root = str(tmp_path)
+    files = {
+        "helper.py": "def f():\n    return 1\n",
+        "caller.py": "import helper\n\n\ndef g():\n    return helper.f()\n",
+        "indirect.py": "import caller\n\n\ndef h():\n    return caller.g()\n",
+        "unrelated.py": "def z():\n    return 0\n",
+    }
+    for name, src in files.items():
+        (tmp_path / name).write_text(src)
+    _git(root, "init", "-q")
+    _git(root, "add", ".")
+    _git(root, "commit", "-qm", "seed")
+    (tmp_path / "helper.py").write_text("def f():\n    return 2\n")
+    surface = set(files)
+    got = lint_cli.changed_only_paths(root, "HEAD", surface=surface)
+    # the edit + its transitive reverse importers; unrelated stays out
+    assert got == ["caller.py", "helper.py", "indirect.py"]
+    # untracked files count as changed
+    (tmp_path / "fresh.py").write_text("x = 1\n")
+    got = lint_cli.changed_only_paths(root, "HEAD",
+                                      surface=surface | {"fresh.py"})
+    assert "fresh.py" in got
+    # a clean tree selects nothing
+    _git(root, "add", ".")
+    _git(root, "commit", "-qm", "apply")
+    assert lint_cli.changed_only_paths(root, "HEAD",
+                                       surface=surface) == []
+
+
+def test_changed_only_cli_flags():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--changed-only",
+         "HEAD", "mxnet_tpu/engine.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 2
+    assert "own path set" in out.stderr
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--write-baseline",
+         "--changed-only", "HEAD"],
+        cwd=REPO, capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 2 and "clobber" in out.stderr
+
+
+# -- doctor --lint -----------------------------------------------------------
+
+def test_doctor_lint_report_shape():
+    from mxnet_tpu.analysis.report import lint_report
+    rep = lint_report(REPO)
+    assert rep["ok"] is True
+    assert rep["files"] > 200 and rep["new"] == 0
+    assert rep["rules"] == {}              # empty-baseline steady state
+    assert rep["wall_s"] > 0
+    cache = rep["cache"]
+    assert cache is None or set(cache) == {"hits", "misses", "hit_rate"}
+
+
+def test_doctor_lint_report_on_broken_root(tmp_path):
+    from mxnet_tpu.analysis.report import lint_report
+    rep = lint_report(str(tmp_path))       # no .py files at all
+    assert rep["ok"] is False and rep["error"] == "no_files"
+
+
+# -- audit-fix regressions (runtime behavior) --------------------------------
+
+def test_atomic_write_concurrent_same_path_never_tears(tmp_path):
+    """The heartbeat-race fix at its root: per-call-unique staging
+    temps let concurrent writers target one path safely — every
+    observable state of the file is a complete document."""
+    from mxnet_tpu.resilience.atomic import atomic_write
+    path = str(tmp_path / "beacon.json")
+    errors = []
+
+    def hammer(tag):
+        try:
+            for i in range(100):
+                with atomic_write(path, "w", durable=False) as f:
+                    json.dump({"tag": tag, "i": i, "pad": "x" * 256}, f)
+                with open(path, encoding="utf-8") as f:
+                    doc = json.load(f)      # torn JSON would raise here
+                assert set(doc) == {"tag", "i", "pad"}
+        except Exception as e:              # surfaced to the main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert errors == []
+    leftovers = [n for n in os.listdir(tmp_path) if ".tmp." in n]
+    assert leftovers == [], "clean exits must not litter temps"
+
+
+def test_heartbeat_beat_concurrent_with_daemon(tmp_path):
+    """PR-10's beat()-vs-daemon race, now without holding a lock across
+    the write: concurrent beats keep the seq file a whole document and
+    the seq strictly advances within each writer."""
+    from mxnet_tpu.elastic.membership import Heartbeat
+    hb = Heartbeat(str(tmp_path), 0, interval_s=0.005,
+                   payload=lambda: {"ready": True})
+    hb.start()
+    try:
+        for _ in range(200):
+            hb.beat()                      # lifecycle publishes, racing
+            with open(hb.path, encoding="utf-8") as f:
+                doc = json.load(f)         # the daemon's own beats
+            assert doc["member"] == 0 and "seq" in doc
+    finally:
+        hb.stop(resign=True)
+
+
+def test_proc_restart_threads_deadline_into_stop_ladder():
+    """The G19 audit fix: ProcReplica.restart(deadline_s=) must bound
+    every wait in the stop ladder instead of dropping the budget."""
+    import inspect
+
+    from mxnet_tpu.serving.pool import ProcReplica
+    src = inspect.getsource(ProcReplica.restart)
+    assert "deadline_s" in src and "budget(" in src
+    # and the summary engine agrees: the param is read
+    ms = sm.module_summaries(_ctx(
+        open(os.path.join(REPO, "mxnet_tpu/serving/pool.py"),
+             encoding="utf-8").read(),
+        path="mxnet_tpu/serving/pool.py"))
+    s = ms.functions["ProcReplica.restart"]
+    assert "deadline_s" in s.deadline_read

@@ -126,6 +126,33 @@ def test_torn_heartbeat_reader_degrades_then_revives(tmp_path):
     assert rd.alive("r0")
 
 
+def test_concurrent_beat_never_loses_payload_flip(tmp_path):
+    """A lifecycle ``beat()`` (drain publishing not-ready) racing the
+    daemon's timer beat must never lose: the published record always
+    reflects a payload sample taken at-or-after the LAST beat.  The
+    G15 audit moved the ledger write outside the beat lock; the
+    single-in-flight-writer protocol (dirty flag + re-sample loop) is
+    what keeps a stale concurrent sample from landing last — this
+    hammers it."""
+    state = {"ready": True}
+    hb = Heartbeat(str(tmp_path), "r9", 999,     # no daemon: we drive
+                   payload=lambda: dict(state), prefix="replica")
+
+    for _ in range(50):
+        state["ready"] = True
+        hb.beat()
+        flip = threading.Thread(target=hb.beat)   # the racing "daemon"
+        flip.start()
+        state["ready"] = False                    # lifecycle flip ...
+        hb.beat()                                 # ... published now
+        flip.join()
+        with open(hb.path) as f:
+            doc = json.load(f)
+        assert doc["ready"] is False, \
+            "stale ready=True sample overwrote the not-ready flip"
+    assert json.load(open(hb.path))["seq"] == 150
+
+
 def test_torn_heartbeat_resignation_drops_stale_payload(tmp_path):
     """A resigned member (file unlinked) must not keep advertising its
     last beacon — the stale-port bug class."""
