@@ -139,6 +139,15 @@ python -m pytest tests/test_pallas.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: observability smoke (trace + exporters) =="
 python -m pytest tests/test_observability.py -q -k smoke -p no:cacheprovider
 
+# distributed-trace smoke: a 3-replica pool under load sharing one
+# trace run dir, SIGKILL one replica -> ONE trace_id links the router
+# request root to worker-side request spans across the wire, the
+# killed replica's flight-recorder dump is present and parseable, and
+# the merged cross-process Perfetto trace + doctor --timeline critical
+# path assemble from per-process files alone (docs/observability.md)
+echo "== tier 0.5: distributed-trace smoke (SIGKILL -> assembled story) =="
+python -m pytest tests/test_distributed_trace.py -q -k smoke -p no:cacheprovider
+
 # quick unit tier: core ndarray/op/autograd/gluon/io surface, no
 # model-zoo or multi-process tests (ref: runtime_functions.sh unittest
 # vs nightly split)

@@ -345,6 +345,243 @@ class InterprocRankUniformity(Rule):
 
 
 @register
+class LeakedOpenSpan(Rule):
+    code = "G20"
+    name = "leaked-open-span"
+    severity = "error"
+    doc = ("A manually-managed trace span (`sp = trace.start_span(...)`)"
+           " whose `.end()` is not reached on an exception path: no "
+           "`with sp:` use, no `.end()` in a `finally:` of the same "
+           "function, and no `finally:`-called same-module helper that "
+           "ends the span passed to it (the summary engine maps "
+           "argument positions through the call graph, the G17 "
+           "leaked-acquire shape applied to spans). The first raise "
+           "between the open and the straight-line `.end()` leaks the "
+           "span: it never reaches the ring/journal, its children "
+           "dangle, and the request it represents vanishes from every "
+           "assembled timeline — the invisible twin of the latched "
+           "probe slot. Ownership transfer is not a leak and is not "
+           "flagged: a span stored on an object/container, returned, "
+           "yielded, aliased, or handed to a callee that does not end "
+           "it is ended by whoever owns it now (the serving request "
+           "root's cross-thread lifecycle) — a resolved callee that "
+           "DOES end the passed span is treated like a direct .end() "
+           "at the call site, so a straight-line helper close is still "
+           "a leak. Regression note: the first repo "
+           "audit caught the router's hedge-arm span "
+           "(serving/router.py) ending in try AND except but never in "
+           "finally — restructured onto `with` in the same PR. Scope: "
+           "mxnet_tpu/ library code.")
+
+    _OPEN_LEAF = ".start_span"
+
+    def _is_open(self, ctx, node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = ctx.resolve(node.func)
+        return bool(name) and (name == "start_span"
+                               or name.endswith(self._OPEN_LEAF))
+
+    # -- interproc half: which params does a function end? ---------------
+    def _param_ends(self, index) -> dict:
+        """``{fn_key: {param position}}`` on which ``.end()`` is called
+        — directly, or by forwarding the param to a same-module callee
+        that (transitively) ends it; monotone fixpoint, cycle-safe."""
+        params = {k: [a.arg for a in (info.node.args.posonlyargs
+                                      + info.node.args.args)]
+                  for k, info in index.functions.items()}
+        ends: dict = {k: set() for k in index.functions}
+        changed = True
+        while changed:
+            changed = False
+            for key, info in index.functions.items():
+                names = params[key]
+                if not names:
+                    continue
+                for node in sm._scope_walk(info.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    if isinstance(f, ast.Attribute) and f.attr == "end" \
+                            and isinstance(f.value, ast.Name) \
+                            and f.value.id in names:
+                        i = names.index(f.value.id)
+                        if i not in ends[key]:
+                            ends[key].add(i)
+                            changed = True
+                    callee = cg.resolve_callee(index, node, info.cls, key)
+                    if not callee or callee not in ends:
+                        continue
+                    cparams = params.get(callee, [])
+                    off = 1 if cparams[:1] in (["self"], ["cls"]) \
+                        and isinstance(f, ast.Attribute) else 0
+                    for j, arg in enumerate(node.args):
+                        if isinstance(arg, ast.Name) and arg.id in names \
+                                and (j + off) in ends[callee]:
+                            i = names.index(arg.id)
+                            if i not in ends[key]:
+                                ends[key].add(i)
+                                changed = True
+                    for kw in node.keywords:
+                        if kw.arg and isinstance(kw.value, ast.Name) \
+                                and kw.value.id in names \
+                                and kw.arg in cparams \
+                                and cparams.index(kw.arg) in ends[callee]:
+                            i = names.index(kw.value.id)
+                            if i not in ends[key]:
+                                ends[key].add(i)
+                                changed = True
+        return ends
+
+    # -- per-function analysis -------------------------------------------
+    def check(self, ctx):
+        if not ctx.is_library() or "start_span" not in ctx.src:
+            return
+        ms = sm.for_context(ctx)
+        index = ms.index
+        ends = self._param_ends(index)
+        for info in index.functions.values():
+            yield from self._check_fn(ctx, index, info, ends)
+
+    def _check_fn(self, ctx, index, info, ends):
+        opens: dict = {}       # name -> open line
+        safe: set = set()      # exception-safe end / with-managed
+        escaped: set = set()   # ownership transferred: not ours to end
+        has_end: set = set()   # any .end() at all (message precision)
+
+        def note_call(node, fin):
+            """An ``x.end()`` / helper-forwarding call; returns the
+            span names this call uses so the walker skips re-escaping
+            them."""
+            used: set = set()
+            f = node.func
+            if isinstance(f, ast.Attribute) and isinstance(f.value,
+                                                           ast.Name):
+                nm = f.value.id
+                if nm in opens:
+                    used.add(nm)
+                    if f.attr == "end":
+                        has_end.add(nm)
+                        if fin:
+                            safe.add(nm)
+                    elif f.attr not in ("set_attrs", "context"):
+                        escaped.add(nm)   # unknown method: hand off
+            callee = cg.resolve_callee(index, node, info.cls, info.key)
+            cparams = ([a.arg for a in
+                        (index.functions[callee].node.args.posonlyargs
+                         + index.functions[callee].node.args.args)]
+                       if callee in index.functions else [])
+            off = 1 if cparams[:1] in (["self"], ["cls"]) \
+                and isinstance(f, ast.Attribute) else 0
+            for j, arg in enumerate(node.args):
+                if isinstance(arg, ast.Name) and arg.id in opens:
+                    used.add(arg.id)
+                    if callee and (j + off) in ends.get(callee, ()):
+                        has_end.add(arg.id)
+                        if fin:
+                            safe.add(arg.id)
+                    else:
+                        escaped.add(arg.id)   # handed to an opaque callee
+            for kw in node.keywords:
+                if isinstance(kw.value, ast.Name) and kw.value.id in opens:
+                    used.add(kw.value.id)
+                    if callee and kw.arg and kw.arg in cparams \
+                            and cparams.index(kw.arg) in ends.get(
+                                callee, ()):
+                        has_end.add(kw.value.id)
+                        if fin:
+                            safe.add(kw.value.id)
+                    else:
+                        escaped.add(kw.value.id)
+            return used
+
+        def walk(node, fin):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return                    # separate scope
+            if isinstance(node, ast.Try):
+                for st in node.body:
+                    walk(st, fin)
+                for h in node.handlers:
+                    for st in h.body:
+                        walk(st, fin)
+                for st in node.orelse:
+                    walk(st, fin)
+                for st in node.finalbody:
+                    walk(st, True)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Name) and ce.id in opens:
+                        safe.add(ce.id)   # __exit__ ends it
+                    elif self._is_open(ctx, ce):
+                        pass              # `with start_span(...)`: safe
+                    else:
+                        walk(ce, fin)
+                    if item.optional_vars is not None and \
+                            self._is_open(ctx, ce):
+                        ov = item.optional_vars
+                        if isinstance(ov, ast.Name):
+                            opens.setdefault(ov.id, ce.lineno)
+                            safe.add(ov.id)
+                for st in node.body:
+                    walk(st, fin)
+                return
+            if isinstance(node, ast.Assign) and \
+                    self._is_open(ctx, node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        opens.setdefault(t.id, node.value.lineno)
+                    # an attribute/subscript target is ownership
+                    # transfer at birth (the request object owns it)
+                walk(node.value, fin)
+                return
+            if isinstance(node, ast.Call):
+                used = note_call(node, fin)
+                f = node.func
+                # don't re-visit the receiver/arg Names note_call
+                # already classified (the receiver Name nests inside
+                # an Attribute — skipping the whole func node there)
+                if not (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in used):
+                    walk(f, fin)
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in used:
+                        continue
+                    walk(arg, fin)
+                for kw in node.keywords:
+                    if isinstance(kw.value, ast.Name) \
+                            and kw.value.id in used:
+                        continue
+                    walk(kw.value, fin)
+                return
+            if isinstance(node, ast.Name) and node.id in opens:
+                # any other use — returned, yielded, stored, aliased,
+                # in a container — transfers ownership
+                escaped.add(node.id)
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child, fin)
+
+        for st in info.node.body:
+            walk(st, False)
+        for name, line in sorted(opens.items(), key=lambda kv: kv[1]):
+            if name in safe or name in escaped:
+                continue
+            how = ("its .end() is never on a finally: path"
+                   if name in has_end else "it is never .end()ed")
+            yield self.finding(
+                ctx, line,
+                f"start_span() result {name!r} leaks on the exception "
+                f"path — {how}, so the first raise loses the span (and "
+                f"every child) from the assembled timeline; use "
+                f"`with`, or end it in a finally: (a finally-called "
+                f"helper that ends the passed span counts)")
+
+
+@register
 class DeadlineDropped(Rule):
     code = "G19"
     name = "deadline-dropped"

@@ -136,6 +136,11 @@ def _metrics_report(path: str) -> dict:
     return report.metrics_report(path)
 
 
+def _timeline_report(run_dir: str) -> dict:
+    from ..observability import aggregate
+    return aggregate.timeline_report(run_dir)
+
+
 def _lint_report(root: str) -> dict:
     from ..analysis import report
     return report.lint_report(root)
@@ -204,8 +209,26 @@ def _summ_guardrails(gr) -> str:
 
 def _summ_trace(tr) -> str:
     top = ", ".join(f"{s['name']}={s['dur_s']}s" for s in tr["slowest"][:3])
-    return (f"trace: {tr['spans']} spans in {tr['traces']} traces; "
-            f"slowest: {top or 'n/a'}")
+    drops = tr.get("ring_drops", 0)
+    return (f"trace: {tr['spans']} spans in {tr['traces']} traces"
+            + (f", {drops} ring drops (raise MXNET_TPU_TRACE_RING)"
+               if drops else "")
+            + f"; slowest: {top or 'n/a'}")
+
+
+def _summ_timeline(tl) -> str:
+    cp = tl.get("critical_path") or {}
+    flights = tl.get("flight_dumps") or []
+    base = (f"timeline: {len(tl['processes'])} processes "
+            f"({tl['traced_processes']} traced) in {tl['path']}"
+            + (f"; flight dumps: {', '.join(flights)}" if flights
+               else ""))
+    if cp.get("ok"):
+        chain = " -> ".join(
+            f"{s['name']}@{s['proc']}" for s in cp["steps"][:6])
+        base += (f"; trace {cp['trace_id']}: {cp['wall_ms']}ms across "
+                 f"{len(cp['processes'])} processes: {chain}")
+    return base
 
 
 def _summ_metrics(mt) -> str:
@@ -253,6 +276,12 @@ _REPORT_TABLE = (
      "observability.snapshot() dump): summarize compile counts/times "
      "and step-phase percentiles (docs/observability.md)",
      _metrics_report, _summ_metrics),
+    ("timeline", "--timeline", "MXNET_TPU_TRACE_DIR", "DIR",
+     "pod run directory of per-process journals + flight dumps "
+     "(MXNET_TPU_TRACE_DIR during the run): assemble the cross-process "
+     "critical path of the slowest routed request — including any "
+     "SIGKILLed replica's flight-recorder tail (docs/observability.md)",
+     _timeline_report, _summ_timeline),
     ("lint", "--lint", None, "DIR",
      "repo checkout root: run graftlint (all tiers incl. the "
      "interprocedural G15-G19) and summarize per-rule finding counts "

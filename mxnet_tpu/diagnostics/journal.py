@@ -39,9 +39,15 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 
 __all__ = ["Journal", "get_journal", "reset_journal",
            "set_trace_ids_provider"]
+
+# always-on bounded ring of recent records (the flight recorder's
+# journal half): in-memory appends are cheap enough to keep even with
+# the sink off, and a crash dump then always has the last-N breadcrumbs
+RECENT_CAP_DEFAULT = 256
 
 # Correlation hook (docs/observability.md): observability.trace registers
 # its current_ids() here so every record written inside an active span
@@ -80,6 +86,12 @@ class Journal:
         self._final_cbs: list = []
         self._final_done = False
         self._clean = False
+        try:
+            cap = int(os.environ.get("MXNET_TPU_JOURNAL_RECENT",
+                                     RECENT_CAP_DEFAULT))
+        except ValueError:
+            cap = RECENT_CAP_DEFAULT
+        self._recent: deque = deque(maxlen=max(cap, 1))
 
     # -- core record writer --------------------------------------------------
     def event(self, kind: str, _heartbeat: bool = False, **fields) -> dict:
@@ -96,10 +108,16 @@ class Journal:
             if ids:
                 for k, v in ids.items():
                     rec.setdefault(k, v)
-        if self._off:
-            return rec
-        line = json.dumps(rec, default=str)
+        line = None if self._off else json.dumps(rec, default=str)
         with self._lock:
+            # the bounded recent ring is kept even with the sink off —
+            # it is the flight recorder's journal half (heartbeats
+            # excluded: they carry no postmortem signal and would
+            # evict the records that do)
+            if not _heartbeat:
+                self._recent.append(rec)
+            if line is None:
+                return rec
             try:
                 fh = self._fh if self._fh is not None else sys.stderr
                 fh.write(line + "\n")
@@ -109,6 +127,13 @@ class Journal:
             if not _heartbeat:
                 self.last_activity = time.monotonic()
         return rec
+
+    def recent(self) -> list:
+        """Snapshot of the bounded recent-records ring (oldest first) —
+        the journal tail a flight-recorder dump preserves for a process
+        that can no longer be asked (docs/observability.md)."""
+        with self._lock:
+            return list(self._recent)
 
     # -- phases --------------------------------------------------------------
     @property
@@ -198,6 +223,16 @@ class Journal:
                     os.kill(os.getpid(), signal.SIGTERM)
 
             signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            pass
+
+    def remove_final_cb(self, final_cb) -> None:
+        """Unregister a finalizer added via ``install_handlers(final_cb=
+        ...)``: the component that registered it shut down cleanly and
+        already wrote its own artifact — the exit-time callback would
+        only overwrite it (and keep the component reachable forever)."""
+        try:
+            self._final_cbs.remove(final_cb)
         except ValueError:
             pass
 

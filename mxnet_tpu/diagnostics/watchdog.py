@@ -26,10 +26,41 @@ import time
 
 from .journal import Journal, get_journal
 
-__all__ = ["Watchdog"]
+__all__ = ["Watchdog", "add_stall_callback", "remove_stall_callback"]
 
 DEFAULT_INTERVAL_S = 15.0
 DEFAULT_STALL_S = 120.0
+
+# process-wide stall hooks: called (no args) once per stall episode by
+# ANY running watchdog, right after its stall record lands.  The slot
+# the observability flight recorder registers its wedge dump into —
+# a provider slot, not an import, so this module stays import-light
+_stall_callbacks: list = []
+_stall_cb_lock = threading.Lock()
+
+
+def add_stall_callback(fn) -> None:
+    with _stall_cb_lock:
+        if fn not in _stall_callbacks:
+            _stall_callbacks.append(fn)
+
+
+def remove_stall_callback(fn) -> None:
+    with _stall_cb_lock:
+        try:
+            _stall_callbacks.remove(fn)
+        except ValueError:
+            pass
+
+
+def _fire_stall_callbacks() -> None:
+    with _stall_cb_lock:
+        cbs = list(_stall_callbacks)
+    for cb in cbs:
+        try:
+            cb()
+        except Exception:
+            pass            # a broken dump hook must not kill the watchdog
 
 
 def _env_float(name: str, default: float) -> float:
@@ -137,6 +168,10 @@ class Watchdog:
                         stall_threshold_s=self.stall_s,
                         rss_mb=_rss_mb(),
                         tracebacks=_all_thread_tracebacks())
+                    # the wedge hook: a registered flight recorder dumps
+                    # its span/journal rings while the process can still
+                    # be read (the driver's kill comes later)
+                    _fire_stall_callbacks()
             else:
                 self._dumped = False     # progress resumed: re-arm
 

@@ -203,7 +203,7 @@ class ElasticDriver:
             try:
                 with _trace.span("elastic_recover",
                                  epoch=self.cohort.epoch,
-                                 attempt=self.rebuilds):
+                                 attempt=self.rebuilds) as sp:
                     j.event("rank_lost",
                             lost=getattr(err, "lost", []),
                             survivors=getattr(err, "survivors", []),
@@ -218,6 +218,14 @@ class ElasticDriver:
                     # under it; the leader publishes the new epoch
                     trainer = None
                     members = self.cohort.resize(getattr(err, "lost", []))
+                    # join the leader's recovery trace: the epoch record
+                    # just adopted carries the leader's trace id (it was
+                    # stamped inside ITS elastic_recover span), so every
+                    # survivor's subsequent recovery records —
+                    # elastic_retrace, reshard_restore, the final span —
+                    # correlate under ONE pod-wide trace
+                    doc = self.cohort.read_epoch_doc() or {}
+                    _trace.adopt_trace(sp, doc.get("recovery_trace"))
                     _ckpt.set_group(CohortGroup(self.cohort, members))
                     j.event("elastic_retrace", reason="cohort_resize",
                             epoch=self.cohort.epoch,

@@ -389,10 +389,21 @@ class Cohort:
         (None, None) before formation. A torn/unparsable newest record is
         skipped (atomic_write makes that near-impossible, but a reader
         must never wedge on half a ledger)."""
+        doc = self.read_epoch_doc()
+        if doc is None:
+            return None, None
+        return int(doc["epoch"]), [int(r) for r in doc["members"]]
+
+    def read_epoch_doc(self):
+        """The newest well-formed epoch record as a dict (or None):
+        beyond (epoch, members) it carries the writer's provenance —
+        ``written_by``, ``reason``, and for a resize the leader's
+        ``recovery_trace`` id that every survivor's ``elastic_recover``
+        span adopts (docs/elastic.md, docs/observability.md)."""
         try:
             names = sorted(os.listdir(self.epoch_dir), reverse=True)
         except OSError:
-            return None, None
+            return None
         for name in names:
             if not name.startswith("epoch-") or not name.endswith(".json"):
                 continue
@@ -400,14 +411,25 @@ class Cohort:
                 with open(os.path.join(self.epoch_dir, name),
                           encoding="utf-8") as f:
                     doc = json.load(f)
-                return int(doc["epoch"]), [int(r) for r in doc["members"]]
+                int(doc["epoch"])
+                [int(r) for r in doc["members"]]
+                return doc
             except (OSError, ValueError, KeyError, TypeError):
                 continue
-        return None, None
+        return None
 
     def _write_epoch(self, k, members, reason):
         doc = {"epoch": int(k), "members": sorted(int(r) for r in members),
                "written_by": self.rank, "reason": reason}
+        # the leader stamps its active trace into the ledger record so
+        # every adopter can join its recovery trace — the ledger is the
+        # one channel all survivors already read.  Lazy import: this
+        # module stays import-light; observability.trace is stdlib-only
+        # and current_ids() is {} with tracing off (schema unchanged)
+        from ..observability import trace as _trace
+        ids = _trace.current_ids()
+        if ids.get("trace_id"):
+            doc["recovery_trace"] = ids["trace_id"]
         with atomic.atomic_write(self._epoch_path(k), "w") as f:
             json.dump(doc, f)
         return doc

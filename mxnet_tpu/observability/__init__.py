@@ -29,25 +29,31 @@ wedged.
 """
 from __future__ import annotations
 
-from . import export, instrument, metrics, report, trace
+from . import aggregate, export, flight, instrument, metrics, report, trace
+from .aggregate import (aggregate_chrome, critical_path, scan_run_dir,
+                        timeline_report)
 from .export import (chrome_trace_from_journal, export_chrome,
                      serve_metrics, to_chrome_trace)
+from .flight import FlightRecorder, install_from_env
 from .metrics import (Counter, Gauge, LatencySummary, MetricsRegistry,
                       Summary, default_registry, prometheus_text,
                       reset_metrics)
-from .trace import (SpanContext, Tracer, annotate, configure,
+from .trace import (SpanContext, Tracer, adopt_trace, annotate, configure,
                     current_context, current_ids, current_span, enabled,
-                    event, get_tracer, reset_tracer, span, start_span)
+                    event, get_tracer, identity, reset_tracer, span,
+                    start_span)
 
 __all__ = [
-    "Counter", "Gauge", "LatencySummary", "MetricsRegistry", "Summary",
-    "SpanContext", "Tracer", "annotate", "chrome_trace_from_journal",
-    "compile_stats", "configure", "current_context", "current_ids",
-    "current_span", "default_registry", "enabled", "event", "export",
-    "export_chrome", "get_tracer", "instrument", "metrics",
-    "prometheus_text", "report", "reset_metrics", "reset_tracer",
-    "serve_metrics", "snapshot", "span", "start_span", "to_chrome_trace",
-    "trace",
+    "Counter", "FlightRecorder", "Gauge", "LatencySummary",
+    "MetricsRegistry", "Summary", "SpanContext", "Tracer", "adopt_trace",
+    "aggregate", "aggregate_chrome", "annotate",
+    "chrome_trace_from_journal", "compile_stats", "configure",
+    "critical_path", "current_context", "current_ids", "current_span",
+    "default_registry", "enabled", "event", "export", "export_chrome",
+    "flight", "get_tracer", "identity", "install_from_env", "instrument",
+    "metrics", "prometheus_text", "report", "reset_metrics",
+    "reset_tracer", "scan_run_dir", "serve_metrics", "snapshot", "span",
+    "start_span", "timeline_report", "to_chrome_trace", "trace",
 ]
 
 

@@ -1,12 +1,22 @@
-"""Trace CLI: ``python -m mxnet_tpu.observability dump|report``.
+"""Trace CLI: ``python -m mxnet_tpu.observability
+dump|report|aggregate|timeline``.
 
-``dump``    convert a JSONL journal's ``kind="span"`` records (written
-            with ``MXNET_TPU_TRACE=journal``) to Chrome trace-event
-            JSON loadable in Perfetto (ui.perfetto.dev → Open trace).
-``report``  print the stdlib trace summary (``doctor --trace`` body)
-            as one JSON line.
+``dump``       convert ONE JSONL journal's ``kind="span"`` records
+               (written with ``MXNET_TPU_TRACE=journal``) to Chrome
+               trace-event JSON loadable in Perfetto
+               (ui.perfetto.dev → Open trace).
+``report``     print the stdlib trace summary (``doctor --trace`` body)
+               as one JSON line.
+``aggregate``  merge a POD RUN DIRECTORY (per-process journals +
+               flight-recorder dumps, ``MXNET_TPU_TRACE_DIR`` during
+               the run) into one anchor-aligned Perfetto trace — one
+               pid per process, SIGKILLed replicas' flight tails
+               included (docs/observability.md).
+``timeline``   the cross-process critical-path summary of one trace
+               (default: the slowest routed request) as ONE JSON line —
+               the ``doctor --timeline`` body.
 
-Both read journals only — no jax, usable from a wedged environment.
+All read files only — no jax, usable from a wedged environment.
 """
 from __future__ import annotations
 
@@ -14,7 +24,20 @@ import argparse
 import json
 import sys
 
-from . import export, report
+from . import aggregate, export, report
+
+
+def _write_doc(doc, out) -> None:
+    if out:
+        from ..resilience.atomic import atomic_write
+        with atomic_write(out, "w") as f:
+            json.dump(doc, f)
+        print(json.dumps({"ok": True, "out": out,
+                          "events": len(doc["traceEvents"])}),
+              flush=True)
+    else:
+        json.dump(doc, sys.stdout)
+        sys.stdout.write("\n")
 
 
 def main(argv=None) -> int:
@@ -32,6 +55,20 @@ def main(argv=None) -> int:
     r = sub.add_parser("report", help="summarize journal span records; "
                                       "ONE JSON line on stdout")
     r.add_argument("--journal", required=True)
+    a = sub.add_parser("aggregate",
+                       help="merge a pod run dir (per-process journals "
+                            "+ flight dumps) into one Perfetto trace")
+    a.add_argument("--dir", required=True,
+                   help="run directory (MXNET_TPU_TRACE_DIR of the run)")
+    a.add_argument("--out", default=None,
+                   help="output path (default: stdout)")
+    t = sub.add_parser("timeline",
+                       help="cross-process critical path of one trace; "
+                            "ONE JSON line on stdout")
+    t.add_argument("--dir", required=True)
+    t.add_argument("--trace-id", default=None,
+                   help="trace to follow (default: slowest routed "
+                        "request)")
     args = ap.parse_args(argv)
 
     if args.cmd == "dump":
@@ -40,17 +77,22 @@ def main(argv=None) -> int:
         except OSError as e:
             print(json.dumps({"ok": False, "error": str(e)}), flush=True)
             return 1
-        if args.out:
-            from ..resilience.atomic import atomic_write
-            with atomic_write(args.out, "w") as f:
-                json.dump(doc, f)
-            print(json.dumps({"ok": True, "out": args.out,
-                              "events": len(doc["traceEvents"])}),
-                  flush=True)
-        else:
-            json.dump(doc, sys.stdout)
-            sys.stdout.write("\n")
+        _write_doc(doc, args.out)
         return 0
+
+    if args.cmd == "aggregate":
+        try:
+            doc = aggregate.aggregate_chrome(args.dir)
+        except OSError as e:
+            print(json.dumps({"ok": False, "error": str(e)}), flush=True)
+            return 1
+        _write_doc(doc, args.out)
+        return 0
+
+    if args.cmd == "timeline":
+        rep = aggregate.timeline_report(args.dir, trace_id=args.trace_id)
+        print(json.dumps(rep), flush=True)
+        return 0 if rep.get("ok") else 1
 
     rep = report.trace_report(args.journal)
     print(json.dumps(rep), flush=True)

@@ -3,9 +3,17 @@ stdlib ``/metrics`` HTTP endpoint.
 
 Chrome trace-event format (the subset Perfetto's JSON importer
 accepts): one complete event (``"ph": "X"``) per finished span with
-microsecond ``ts``/``dur``, ``pid`` = rank, ``tid`` = thread name, and
-the trace/span/parent IDs under ``args`` so the Perfetto query engine
-can reconstruct the tree and join against journal records.
+microsecond ``ts``/``dur``, ``tid`` = thread name, and the
+trace/span/parent IDs under ``args`` so the Perfetto query engine can
+reconstruct the tree and join against journal records.
+
+Track identity: ``pid`` is the span's rank UNLESS any span in the
+document carries a ``replica`` tag — two replicas on one host share a
+rank, and keying pid on rank alone interleaved them into one unreadable
+track (the PR-12 pid-collision fix).  With replicas present, each
+distinct (rank, replica) process gets its own synthetic pid plus a
+``process_name`` metadata event (``"ph": "M"``) naming it, so Perfetto
+shows one labeled track group per process.
 
 Sources: the live tracer ring (:func:`to_chrome_trace` /
 :func:`export_chrome`) or a diagnostics JSONL journal written with
@@ -26,12 +34,14 @@ __all__ = ["chrome_trace_from_journal", "export_chrome", "serve_metrics",
            "spans_to_chrome", "to_chrome_trace"]
 
 
-def _chrome_event(d: dict) -> dict:
+def _chrome_event(d: dict, pid: int) -> dict:
     args = dict(d.get("attrs") or {})
     args["trace_id"] = d.get("trace_id")
     args["span_id"] = d.get("span_id")
     if d.get("parent_id"):
         args["parent_id"] = d["parent_id"]
+    if d.get("replica") is not None:
+        args["replica"] = d["replica"]
     start = float(d.get("start_s") or 0.0)
     dur = d.get("dur_s")
     return {"name": str(d.get("name", "?")),
@@ -39,16 +49,71 @@ def _chrome_event(d: dict) -> dict:
             "ph": "X",
             "ts": round(start * 1e6, 3),
             "dur": round(float(dur or 0.0) * 1e6, 3),
-            "pid": int(d.get("rank") or 0),
+            "pid": pid,
             "tid": str(d.get("thread") or "main"),
             "args": args}
 
 
-def spans_to_chrome(spans) -> dict:
+def process_key(d: dict) -> tuple:
+    """The process identity a span belongs to: (rank, replica).  Rank
+    alone is NOT enough — two subprocess replicas on one host both
+    read rank 0 (the merged-trace pid collision this keying fixes)."""
+    return (int(d.get("rank") or 0), d.get("replica"))
+
+
+def process_label(key: tuple) -> str:
+    rank, replica = key
+    if replica is not None:
+        return f"replica {replica}"
+    return f"rank {rank}"
+
+
+def assign_pids(keys) -> dict:
+    """Stable pid per process key.  Rank-only processes keep
+    ``pid == rank`` (the pre-replica documents stay bit-identical);
+    replica-tagged processes get synthetic pids above every rank so
+    no two processes ever share a track."""
+    keys = sorted(keys, key=lambda k: (k[1] is not None, k))
+    pids, used = {}, set()
+    for key in keys:
+        rank, replica = key
+        if replica is None and rank not in used:
+            pids[key] = rank
+            used.add(rank)
+    nxt = max(used, default=-1) + 1
+    for key in keys:
+        if key in pids:
+            continue
+        pids[key] = nxt
+        used.add(nxt)
+        nxt += 1
+    return pids
+
+
+def _metadata_event(pid: int, label: str) -> dict:
+    return {"name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": label}}
+
+
+def spans_to_chrome(spans, labels=None) -> dict:
     """Span dicts (``Span.to_dict`` / journal ``span`` records) → a
-    Chrome trace-event document (``{"traceEvents": [...]}``)."""
-    return {"traceEvents": [_chrome_event(d) for d in spans],
-            "displayTimeUnit": "ms"}
+    Chrome trace-event document (``{"traceEvents": [...]}``).
+
+    ``labels`` (optional ``{process_key: str}``) overrides the track
+    names.  Metadata ``process_name`` events are emitted only when the
+    document spans more than one process or any span carries a replica
+    tag — single-process rank-keyed documents stay exactly the
+    pre-PR-12 golden shape."""
+    spans = list(spans)
+    keys = {process_key(d) for d in spans}
+    pids = assign_pids(keys)
+    events = []
+    if labels or len(keys) > 1 or any(k[1] is not None for k in keys):
+        for key in sorted(pids, key=lambda k: pids[k]):
+            label = (labels or {}).get(key) or process_label(key)
+            events.append(_metadata_event(pids[key], label))
+    events.extend(_chrome_event(d, pids[process_key(d)]) for d in spans)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def to_chrome_trace(tracer=None) -> dict:

@@ -19,13 +19,9 @@ import json
 __all__ = ["metrics_report", "read_span_records", "trace_report"]
 
 
-def read_span_records(path) -> list:
-    """``kind="span"`` records of a JSONL journal, junk/torn lines
-    tolerated — THE span scanner, shared with the Perfetto exporter
-    (export.chrome_trace_from_journal) so the doctor report and the
-    dump can never diverge on what counts as a span.  Raises OSError
-    when the file is unreadable."""
-    spans = []
+def _iter_records(path):
+    """Parsed dict records of a JSONL journal, junk/torn lines
+    tolerated.  Raises OSError when the file is unreadable."""
     with open(path, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
@@ -35,23 +31,41 @@ def read_span_records(path) -> list:
                 rec = json.loads(line)
             except ValueError:
                 continue                     # torn tail of a killed writer
-            if isinstance(rec, dict) and rec.get("kind") == "span":
-                spans.append(rec)
-    return spans
+            if isinstance(rec, dict):
+                yield rec
 
 
-def _read_spans(path):
-    try:
-        return read_span_records(path), None
-    except OSError as e:
-        return None, f"cannot read {path}: {e.strerror or e}"
+def read_span_records(path) -> list:
+    """``kind="span"`` records of a JSONL journal, junk/torn lines
+    tolerated — THE span scanner, shared with the Perfetto exporter
+    (export.chrome_trace_from_journal) so the doctor report and the
+    dump can never diverge on what counts as a span.  Raises OSError
+    when the file is unreadable."""
+    return [r for r in _iter_records(path) if r.get("kind") == "span"]
 
 
 def trace_report(path) -> dict:
-    """Summarize the ``span`` records of a journal file."""
-    spans, err = _read_spans(path)
-    if spans is None:
-        return {"ok": False, "path": path, "error": err}
+    """Summarize the ``span`` records of a journal file.  One pass
+    collects both the spans and the run's highest journaled
+    ``trace_ring_drops`` marker (the counts are cumulative so
+    max == total) — journals are unbounded, the report must not scale
+    at 2x the file."""
+    spans: list = []
+    ring_drops = 0
+    try:
+        for rec in _iter_records(path):
+            kind = rec.get("kind")
+            if kind == "span":
+                spans.append(rec)
+            elif kind == "trace_ring_drops":
+                try:
+                    ring_drops = max(ring_drops,
+                                     int(rec.get("dropped") or 0))
+                except (TypeError, ValueError):
+                    pass         # junk-tolerant, like every other line
+    except OSError as e:
+        return {"ok": False, "path": path,
+                "error": f"cannot read {path}: {e.strerror or e}"}
     if not spans:
         return {"ok": False, "path": path,
                 "error": "no span records in journal (was "
@@ -77,6 +91,7 @@ def trace_report(path) -> dict:
                      key=lambda s: -float(s["dur_s"]))[:5]
     return {"ok": True, "path": path,
             "spans": len(spans), "traces": len(traces),
+            "ring_drops": ring_drops,
             "by_name": {n: _stats(d) for n, d in sorted(by_name.items())},
             "slowest": [{"name": s.get("name"),
                          "dur_s": round(float(s["dur_s"]), 6),

@@ -25,6 +25,17 @@ Knobs::
                           journal = ring + one JSONL record per span
     MXNET_TPU_TRACE_RING  ring capacity in spans (default 4096)
 
+Pod attribution (docs/observability.md distributed tracing): spans are
+tagged with the process rank (``MXTPU_PROC_ID``), the serving replica
+identity (``MXNET_TPU_REPLICA_ID``, stamped by the replica pool into
+every worker's environment) and, in anchor/flight records, the pod run
+id (``MXNET_TPU_POD_RUN_ID``) — so a run directory of per-process
+journals assembles into ONE attributable cross-process trace
+(observability/aggregate.py).  Journal mode emits one ``trace_anchor``
+record pairing this process's wall clock with its ``perf_counter``
+timeline, the alignment point the aggregator uses to place every
+process's monotonic span timestamps on a shared wall clock.
+
 Import-light by the journal's own contract: stdlib only, no jax, no
 mxnet_tpu runtime — exporters must work while everything else is wedged.
 """
@@ -37,13 +48,25 @@ import threading
 import time
 from collections import deque
 
-__all__ = ["MODES", "Span", "SpanContext", "Tracer", "annotate",
-           "configure", "current_context", "current_ids", "current_span",
-           "enabled", "event", "get_tracer", "mode", "record",
-           "reset_tracer", "span", "start_span"]
+__all__ = ["MODES", "Span", "SpanContext", "Tracer", "adopt_trace",
+           "annotate", "configure", "current_context", "current_ids",
+           "current_span", "enabled", "event", "get_tracer", "identity",
+           "mode", "record", "reset_tracer", "span", "start_span"]
 
 MODES = ("off", "ring", "journal")
 DEFAULT_RING = 4096
+DROPS_METRIC = "mxnet_tpu_trace_ring_drops_total"
+
+
+def anchor_doc(tracer=None) -> dict:
+    """The clock-alignment payload (shared by the journal
+    ``trace_anchor`` record and the flight-recorder dump): an atomic
+    wall/perf_counter sample pair, the tracer's span-timeline epoch, and
+    the pod identity block."""
+    tracer = tracer if tracer is not None else get_tracer()
+    return {"wall_s": round(time.time(), 6),
+            "perf_s": round(time.perf_counter(), 6),
+            "epoch_s": round(tracer.epoch, 6), **identity()}
 
 # process-unique trace-id prefix: two traces from two processes (multi-
 # host ranks sharing one journal file) can never collide
@@ -59,6 +82,30 @@ def _rank() -> int:
         return int(os.environ.get("MXTPU_PROC_ID", "0"))
     except ValueError:
         return 0
+
+
+def _replica():
+    """Serving-replica identity for span tagging — the replica pool
+    stamps ``MXNET_TPU_REPLICA_ID`` into every worker's environment so
+    two replicas that share a rank (two workers on one host) stay
+    distinguishable in a merged trace (the Perfetto pid-collision fix).
+    None outside a pool worker."""
+    return os.environ.get("MXNET_TPU_REPLICA_ID") or None
+
+
+def identity() -> dict:
+    """This process's pod-attribution block: rank, replica (when the
+    pool stamped one), pid, and the pod run id — the fields anchor and
+    flight-recorder records carry so ``observability/aggregate.py`` can
+    attribute every per-process file (docs/observability.md)."""
+    doc = {"rank": _rank(), "pid": os.getpid()}
+    rep = _replica()
+    if rep is not None:
+        doc["replica"] = rep
+    run_id = os.environ.get("MXNET_TPU_POD_RUN_ID")
+    if run_id:
+        doc["run_id"] = run_id
+    return doc
 
 
 class SpanContext:
@@ -82,7 +129,8 @@ class Span:
     steps under NTP cannot produce negative durations, the G11 class)."""
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
-                 "rank", "thread", "t0", "dur_s", "_token", "_ended")
+                 "rank", "replica", "thread", "t0", "dur_s", "_token",
+                 "_ended")
 
     def __init__(self, name, trace_id, parent_id, attrs, t0=None):
         self.name = name
@@ -91,6 +139,7 @@ class Span:
         self.parent_id = parent_id
         self.attrs = attrs
         self.rank = _rank()
+        self.replica = _replica()
         self.thread = threading.current_thread().name
         self.t0 = time.perf_counter() if t0 is None else t0
         self.dur_s = None
@@ -137,6 +186,8 @@ class Span:
              "dur_s": (round(self.dur_s, 6)
                        if self.dur_s is not None else None),
              "rank": self.rank, "thread": self.thread}
+        if self.replica is not None:
+            d["replica"] = self.replica
         if self.attrs:
             d["attrs"] = self.attrs
         return d
@@ -203,34 +254,77 @@ class Tracer:
         self._lock = threading.Lock()
         self.recorded = 0
         self.dropped = 0
+        # one clock-alignment anchor per journal-mode tracer: written by
+        # journal_startup() (after the tracer lock releases, like the
+        # bad-mode note) so the aggregator can map this process's
+        # perf_counter span timeline onto the shared wall clock
+        self._anchor_pending = mode == "journal"
 
-    def journal_bad_mode(self) -> None:
-        """Journal a rejected ``MXNET_TPU_TRACE`` value, once.  A
-        separate step (not ``__init__``) because construction happens
-        under ``_tracer_lock`` and the journal is file I/O no lock may
-        hold across (G15); get_tracer/configure call this after
-        release."""
+    def journal_startup(self) -> None:
+        """Journal the once-per-tracer startup records — a rejected
+        ``MXNET_TPU_TRACE`` value and, in journal mode, the
+        ``trace_anchor`` clock-alignment record.  A separate step (not
+        ``__init__``) because construction happens under
+        ``_tracer_lock`` and the journal is file I/O no lock may hold
+        across (G15); get_tracer/configure call this after release."""
         with self._lock:     # claim-once: two first-users must not
             bad = self._bad_mode          # both journal the same note
             self._bad_mode = None
-        if bad is None:
-            return
+            anchor = self._anchor_pending
+            self._anchor_pending = False
+        if bad is not None:
+            from ..diagnostics.journal import get_journal
+            get_journal().event(
+                "trace_bad_mode", value=bad,
+                detail=f"MXNET_TPU_TRACE={bad!r} not in "
+                       f"{MODES}; tracing stays off")
+        if anchor:
+            self.journal_anchor()
+
+    def journal_anchor(self) -> dict:
+        """Write this process's clock-alignment anchor: one wall-clock /
+        perf_counter sample pair plus the tracer epoch and the pod
+        identity block.  The aggregator computes ``wall = wall_s -
+        perf_s + epoch_s + span.start_s`` from it — intra-process span
+        precision stays monotonic, only ONE wall sample is trusted per
+        process (the G11 discipline applied across processes)."""
         from ..diagnostics.journal import get_journal
-        get_journal().event(
-            "trace_bad_mode", value=bad,
-            detail=f"MXNET_TPU_TRACE={bad!r} not in "
-                   f"{MODES}; tracing stays off")
+        return get_journal().event("trace_anchor", **anchor_doc(self))
 
     def _record(self, sp: Span) -> None:
         d = sp.to_dict()
         with self._lock:
             if len(self._ring) == self.ring_size:
                 self.dropped += 1
+                dropped = self.dropped
+            else:
+                dropped = None
             self._ring.append(d)
             self.recorded += 1
+        if dropped is not None:
+            self._note_drop(dropped)
         if self.mode == "journal":
             from ..diagnostics.journal import get_journal
             get_journal().event("span", **d)
+
+    def _note_drop(self, dropped: int) -> None:
+        """Ring-overflow accounting (outside the ring lock): bump the
+        ``mxnet_tpu_trace_ring_drops_total`` metric family, and journal
+        a marker on the first drop (then every 1000th) so silent span
+        loss under load is visible in ``doctor --trace`` without a
+        per-drop journal write."""
+        try:
+            from .metrics import default_registry
+            default_registry().counter(
+                DROPS_METRIC,
+                "spans dropped from the bounded trace ring "
+                "(raise MXNET_TPU_TRACE_RING)").inc()
+        except Exception:
+            pass                     # accounting must never kill tracing
+        if dropped == 1 or dropped % 1000 == 0:
+            from ..diagnostics.journal import get_journal
+            get_journal().event("trace_ring_drops", dropped=dropped,
+                                ring_size=self.ring_size)
 
     def spans(self) -> list:
         """Snapshot of the ring (oldest first), as plain dicts."""
@@ -263,7 +357,7 @@ def get_tracer() -> Tracer:
         if _tracer is None:
             _tracer = Tracer()
         t = _tracer
-    t.journal_bad_mode()            # journal I/O: after the lock
+    t.journal_startup()             # journal I/O: after the lock
     return t
 
 
@@ -274,7 +368,7 @@ def configure(mode=None, ring=None) -> Tracer:
     with _tracer_lock:
         _tracer = Tracer(mode=mode, ring=ring)
         t = _tracer
-    t.journal_bad_mode()            # journal I/O: after the lock
+    t.journal_startup()             # journal I/O: after the lock
     return t
 
 
@@ -359,6 +453,23 @@ def current_context() -> SpanContext | None:
     span or with tracing off)."""
     sp = _current.get()
     return sp.context() if sp is not None else None
+
+
+def adopt_trace(sp, trace_id) -> bool:
+    """Re-stamp an OPEN span onto another process's trace — the elastic
+    recovery join: every survivor opens its own ``elastic_recover``
+    span, the leader publishes its trace id through the epoch ledger,
+    and survivors adopt it so the whole pod's recovery records share
+    ONE trace (docs/elastic.md).  Only the span's trace lineage changes;
+    child spans and journal records created AFTER adoption inherit the
+    adopted id (``current_ids`` reads the live span).  No-op (False) on
+    the disabled no-op span, a closed span, or a null/identical id."""
+    if not trace_id or sp is None or sp is _NOOP:
+        return False
+    if getattr(sp, "_ended", True) or sp.trace_id == trace_id:
+        return False
+    sp.trace_id = trace_id
+    return True
 
 
 def annotate(**attrs) -> bool:

@@ -29,6 +29,40 @@ def _emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
+def _setup_trace_dir(trace_dir, label):
+    """``--trace-dir``: make this bench a traced pod run — journal +
+    spans stream to ``<dir>/journal-<label>.jsonl``, the flight
+    recorder runs, and subprocess workers (a proc-replica pool) inherit
+    the dir through ``MXNET_TPU_TRACE_DIR``.  Returns the recorder (or
+    None).  Call BEFORE get_journal() so the handlers bind to the
+    run-dir sink."""
+    if not trace_dir:
+        return None
+    import os
+
+    from ..diagnostics.journal import reset_journal
+    from ..observability import flight
+    from ..observability import trace as obtrace
+    os.makedirs(trace_dir, exist_ok=True)
+    os.environ["MXNET_TPU_TRACE_DIR"] = str(trace_dir)
+    reset_journal(os.path.join(str(trace_dir),
+                               f"journal-{label}.jsonl"))
+    obtrace.configure(mode="journal")
+    return flight.FlightRecorder(str(trace_dir), label=label).install()
+
+
+def _embed_distributed_trace(doc, trace_dir, recorder):
+    """Fold the assembled cross-process snapshot into a BENCH artifact:
+    the ``doctor --timeline`` body (per-process span counts, flight
+    dumps, the slowest request's cross-process critical path)."""
+    if not trace_dir:
+        return
+    if recorder is not None:
+        recorder.stop(dump=True)
+    from ..observability import aggregate
+    doc["distributed_trace"] = aggregate.timeline_report(str(trace_dir))
+
+
 def _diagnostic(error: str, detail: str) -> dict:
     return {"metric": METRIC, "value": None, "unit": "req/s",
             "error": error, "detail": detail}
@@ -164,6 +198,7 @@ def _bench_tenants(args) -> int:
     from .batcher import (DeadlineExceeded, RequestError, ServerOverloaded)
     from .fleet import Fleet, FleetConfig
 
+    recorder = _setup_trace_dir(args.trace_dir, "tenant-bench")
     j = get_journal()
     j.install_handlers(final_cb=lambda: _emit(
         {"metric": TENANT_METRIC, "value": None, "unit": "req/s",
@@ -253,6 +288,7 @@ def _bench_tenants(args) -> int:
         "compiles": stats["cache"]["misses"],
         "observability": snapshot(),
     }
+    _embed_distributed_trace(doc, args.trace_dir, recorder)
     out = args.out or ""
     if out:
         with atomic_write(out, "w") as f:
@@ -285,6 +321,7 @@ def _bench_pool(args) -> int:
     from .router import Router, RouterConfig
     from .server import Server, ServerConfig
 
+    recorder = _setup_trace_dir(args.trace_dir, "router-bench")
     j = get_journal()
     j.install_handlers(final_cb=lambda: _emit(
         {"metric": POOL_METRIC, "value": None, "unit": "req/s",
@@ -366,6 +403,7 @@ def _bench_pool(args) -> int:
         "pool": pool_view,
         "observability": snapshot(),
     }
+    _embed_distributed_trace(doc, args.trace_dir, recorder)
     out = args.out or ""
     if out:
         with atomic_write(out, "w") as f:
@@ -402,6 +440,13 @@ def main(argv=None) -> int:
     b.add_argument("--hedge-ms", type=float, default=0.0,
                    help="tail-latency hedge delay for --replicas mode "
                         "(0 = off)")
+    b.add_argument("--trace-dir", default=None,
+                   help="run the bench as a traced pod run: spans + "
+                        "journal stream into this directory, the "
+                        "flight recorder runs, and the artifact embeds "
+                        "the assembled cross-process snapshot "
+                        "(doctor --timeline body) under "
+                        "'distributed_trace'")
     b.add_argument("--out", default=None,
                    help="artifact path ('' disables; default "
                         "BENCH_serving.json, BENCH_serving_pool.json "

@@ -94,6 +94,13 @@ class PoolConfig:
     max_respawns: int = 3                       # crash-loop budget/replica
     monitor_s: float = 0.5                      # auto-respawn poll interval
     poll_s: float = 0.05
+    # shared-FS run directory for pod-scope tracing: when set, every
+    # subprocess worker streams spans+journal to its OWN
+    # <trace_dir>/journal-<rid>.jsonl and runs the flight recorder
+    # there, the input observability/aggregate.py assembles into one
+    # cross-process Perfetto trace (docs/observability.md)
+    trace_dir: object = field(default_factory=lambda: os.environ.get(
+        "MXNET_TPU_TRACE_DIR") or None)
 
     def __post_init__(self):
         if self.deadline_s <= self.heartbeat_s:
@@ -310,6 +317,10 @@ class ProcReplica:
                   "dtype": str(x.dtype), "deadline_ms": deadline_ms}
         if tenant is not None:
             header["tenant"] = str(tenant)
+        # propagate the router's trace context across the process
+        # boundary: the worker re-anchors its serving_request root
+        # under these ids (docs/observability.md distributed tracing)
+        wire.attach_trace(header)
         header, payload = self._roundtrip(
             header, x.tobytes(), budget_s=budget_s)
         if not header.get("ok"):
@@ -402,6 +413,25 @@ class ReplicaPool:
         self.cfg = config or PoolConfig()
         self.hb_dir = os.path.join(self.root, "hb")
         os.makedirs(self.hb_dir, exist_ok=True)
+        # pod run id: ONE identity every replica (and this router-side
+        # process) stamps on its records so a shared-FS run directory
+        # is attributable after the fact; adopt the ambient id when a
+        # launcher already published one
+        self.run_id = os.environ.get("MXNET_TPU_POD_RUN_ID") or \
+            f"pod-{os.urandom(4).hex()}"
+        # publish it in THIS process too: trace.identity() reads the
+        # environment, so without this the router-side anchors/flight
+        # dumps would carry no run_id while every worker's do.  A
+        # journal-mode tracer configured BEFORE the pool already wrote
+        # its startup anchor without the id — re-anchor so the run is
+        # attributable (newest anchor wins in the aggregator; same
+        # epoch, so alignment is unchanged)
+        if "MXNET_TPU_POD_RUN_ID" not in os.environ:
+            os.environ["MXNET_TPU_POD_RUN_ID"] = self.run_id
+            from ..observability import trace as _trace
+            tracer = _trace.get_tracer()
+            if tracer.mode == "journal":
+                tracer.journal_anchor()
         self.reader = LivenessReader(self.hb_dir, self.cfg.deadline_s,
                                      prefix="replica")
         self.replicas: dict = {}
@@ -426,8 +456,38 @@ class ReplicaPool:
 
     def add_proc(self, rid, worker_args, env=None) -> "ReplicaPool":
         """Add a subprocess replica (``worker_args``: CLI flag → value,
-        e.g. ``{"--model": "scale", "--ckpt-root": root}``)."""
-        self.replicas[str(rid)] = ProcReplica(
+        e.g. ``{"--model": "scale", "--ckpt-root": root}``).  The worker
+        inherits the pod run id and its replica identity through the
+        environment (every record it writes is attributable), and — when
+        the pool has a ``trace_dir`` — its own journal/trace/flight
+        sinks under that shared run directory."""
+        rid = str(rid)
+        # a caller env built as {**os.environ, ...} INHERITS an ambient
+        # MXNET_TPU_TRACE — only a value that differs from the ambient
+        # one is a deliberate per-worker override
+        caller_trace = (env is not None and "MXNET_TPU_TRACE" in env
+                        and env["MXNET_TPU_TRACE"]
+                        != os.environ.get("MXNET_TPU_TRACE"))
+        env = dict(os.environ if env is None else env)
+        env.setdefault("MXNET_TPU_POD_RUN_ID", self.run_id)
+        env["MXNET_TPU_REPLICA_ID"] = rid
+        trace_dir = self.cfg.trace_dir
+        if trace_dir:
+            os.makedirs(trace_dir, exist_ok=True)
+            # forced, not setdefault: one journal PER PROCESS is the
+            # assembly contract — pointing every worker at one shared
+            # file would interleave the per-process timelines
+            env["MXNET_TPU_TRACE_DIR"] = str(trace_dir)
+            env["MXNET_TPU_JOURNAL"] = os.path.join(
+                str(trace_dir), f"journal-{rid}.jsonl")
+            # journal mode is forced over anything AMBIENT: an
+            # inherited `ring`/`off` would leave the forced per-worker
+            # journal empty of spans and the assembled timeline blank
+            # with no hint why.  Only an env the CALLER built and
+            # passed with the knob set is a deliberate override.
+            if not caller_trace:
+                env["MXNET_TPU_TRACE"] = "journal"
+        self.replicas[rid] = ProcReplica(
             rid, worker_args, self.hb_dir, self.cfg,
             self._port_of, env=env)
         return self
@@ -481,7 +541,9 @@ class ReplicaPool:
         get_journal().event("pool_start", root=self.root,
                             replicas=sorted(self.replicas),
                             heartbeat_s=self.cfg.heartbeat_s,
-                            deadline_s=self.cfg.deadline_s)
+                            deadline_s=self.cfg.deadline_s,
+                            run_id=self.run_id,
+                            trace_dir=self.cfg.trace_dir)
         for rep in self.replicas.values():
             rep.start()
         if wait_ready and not self.wait_ready():

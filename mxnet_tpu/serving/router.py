@@ -500,17 +500,21 @@ class Router:
         def run(st):
             # arm threads re-anchor under the request span explicitly
             # (contextvars don't cross threads; docs/observability.md)
-            arm = _trace.start_span("router_hedge_arm", parent=ctx,
-                                    replica=st.id)
-            try:
-                remaining = budget_s - (time.monotonic() - t_start)
-                v, m = self._dispatch(st, x, max(remaining, 0.01),
-                                      cancels[st.id], tenant)
-                results.put_nowait((st, None, v, m))
-                arm.end(status="ok")
-            except BaseException as e:
-                results.put_nowait((st, e, None, None))
-                arm.end(status=type(e).__name__)
+            # — entered as the thread's current span so the nested
+            # router_attempt span AND the wire frame's propagated trace
+            # context both join the request's trace, and the span ends
+            # on every exception path (the G20 leaked-open-span shape)
+            with _trace.start_span("router_hedge_arm", parent=ctx,
+                                   replica=st.id) as arm:
+                try:
+                    remaining = budget_s - (time.monotonic() - t_start)
+                    v, m = self._dispatch(st, x, max(remaining, 0.01),
+                                          cancels[st.id], tenant)
+                    results.put_nowait((st, None, v, m))
+                    arm.set_attrs(status="ok")
+                except BaseException as e:
+                    results.put_nowait((st, e, None, None))
+                    arm.set_attrs(status=type(e).__name__)
 
         def launch(st):
             cancels[st.id] = threading.Event()

@@ -264,7 +264,7 @@ class Server:
 
     # -- client surface ------------------------------------------------------
     def submit(self, x, deadline_ms=None, cancel=None,
-               tenant=None) -> PendingResponse:
+               tenant=None, parent=None) -> PendingResponse:
         """Admit one sample (NO batch axis).  Raises
         :class:`RequestError` for a shape outside the bucket grid,
         :class:`ServerOverloaded` when the bounded queue is full, and
@@ -273,7 +273,12 @@ class Server:
         hedging router sets it on the losing attempt so a request whose
         twin already answered never spends a batch slot.  ``tenant``
         targets a fleet tenant (serving/fleet.py); on a single-tenant
-        Server a non-None tenant is a structured error."""
+        Server a non-None tenant is a structured error.  ``parent`` (a
+        trace ``SpanContext``) re-anchors this request's root span under
+        a caller in ANOTHER process — the worker front door passes the
+        wire frame's propagated context here so the replica-side span
+        tree joins the router's trace (docs/observability.md); in-process
+        callers leave it None and the contextvar parent applies."""
         payload = np.asarray(x, dtype=self._dtype)
         if tenant is not None:
             # normalize ONCE at the door: every downstream lookup
@@ -311,6 +316,7 @@ class Server:
         traced = _trace.enabled()
         if traced:
             req.trace = _trace.start_span("serving_request",
+                                          parent=parent,
                                           shape=list(payload.shape))
         try:
             with self._admit_lock:

@@ -19,6 +19,18 @@ bool, "tenant": <name|absent>, ...}`` — the router maps ``error`` back
 onto the structured serving exceptions (batcher.py, fleet.py) so a
 remote failure raises exactly like a local one, fault domain included.
 
+Trace propagation (docs/observability.md distributed tracing): predict
+and error frames carry a compact trace context — ``{"v": 1, "trace":
+{"trace_id": ..., "span_id": ...}}`` — so the worker's ``serving_request``
+/``serving_batch`` spans become true children of the router's request
+root instead of unlinked per-process orphans.  ``v`` is the wire
+protocol version: a reader that sees a NEWER major version than it
+speaks must treat unknown header fields as advisory (this reader
+ignores them), and ``trace`` is always optional — tracing off on either
+side degrades to trace-free frames that a pre-trace peer parses
+unchanged (frames always gain ``v``, which unknown-key-tolerant
+readers — including the pre-trace ones — simply ignore).
+
 Every read is bounded by the socket timeout the caller set (the G8
 discipline: a dead peer is a structured error, never a hang), and both
 length fields are sanity-capped so a garbage peer cannot make a reader
@@ -29,12 +41,42 @@ from __future__ import annotations
 import json
 import struct
 
-__all__ = ["MAX_HEADER", "MAX_PAYLOAD", "WireError", "recv_frame",
-           "send_frame"]
+__all__ = ["MAX_HEADER", "MAX_PAYLOAD", "PROTOCOL_VERSION", "WireError",
+           "attach_trace", "extract_parent", "recv_frame", "send_frame"]
 
 _PREFIX = struct.Struct("!II")
 MAX_HEADER = 1 << 20             # 1 MiB of JSON is already a bug
 MAX_PAYLOAD = 1 << 30            # caps a corrupt length field, not traffic
+PROTOCOL_VERSION = 1             # bump on incompatible header changes
+
+
+def attach_trace(header: dict) -> dict:
+    """Stamp the protocol version + the CALLING context's trace ids
+    onto an outgoing frame header (in place; returns it).  With tracing
+    off — or outside any span — the header gains only ``v``, which a
+    trace-unaware peer (like any unknown key) simply ignores."""
+    from ..observability import trace as _trace
+    header.setdefault("v", PROTOCOL_VERSION)
+    ids = _trace.current_ids()
+    if ids:
+        header["trace"] = ids
+    return header
+
+
+def extract_parent(header: dict):
+    """The propagated trace context of an incoming frame as a
+    :class:`~..observability.trace.SpanContext` (the ``parent=`` a
+    server-side root span re-anchors under), or None when the frame
+    carries none / a malformed one — a garbage peer must degrade to an
+    un-parented trace, never an error."""
+    doc = header.get("trace")
+    if not isinstance(doc, dict):
+        return None
+    tid, sid = doc.get("trace_id"), doc.get("span_id")
+    if not isinstance(tid, str) or not isinstance(sid, str):
+        return None
+    from ..observability import trace as _trace
+    return _trace.SpanContext(tid, sid)
 
 
 class WireError(ValueError):

@@ -82,8 +82,9 @@ def _build_block(model: str, dim: int):
     return net
 
 
-def _error_doc(exc) -> dict:
-    doc = {"ok": False, "error": type(exc).__name__,
+def _error_doc(exc, request_header=None) -> dict:
+    doc = {"ok": False, "v": wire.PROTOCOL_VERSION,
+           "error": type(exc).__name__,
            "retryable": bool(getattr(exc, "retryable", True)),
            "detail": str(exc)[:300]}
     for attr in ("stage", "late_ms", "depth", "limit", "tier",
@@ -91,6 +92,12 @@ def _error_doc(exc) -> dict:
         v = getattr(exc, attr, None)
         if v is not None:
             doc[attr] = v
+    # error frames echo the request's propagated trace context so the
+    # router side can correlate a remote failure against its own
+    # request root (docs/observability.md distributed tracing)
+    trace_ctx = (request_header or {}).get("trace")
+    if isinstance(trace_ctx, dict):
+        doc["trace"] = trace_ctx
     return doc
 
 
@@ -162,7 +169,7 @@ class _Front:
             except Exception as exc:       # defect, not traffic: journal
                 get_journal().crash(exc, where="replica_worker")
                 try:
-                    wire.send_frame(conn, _error_doc(exc))
+                    wire.send_frame(conn, _error_doc(exc, header))
                 except OSError:
                     pass
 
@@ -189,13 +196,13 @@ class _Front:
             self.stop_evt.set()
         else:
             wire.send_frame(conn, _error_doc(
-                RequestError(f"unknown command {cmd!r}")))
+                RequestError(f"unknown command {cmd!r}"), header))
 
     def _predict(self, conn, header, payload):
         from .batcher import RequestError, ServerStopped
         if self.draining or self.stop_evt.is_set():
             err = ServerStopped("replica draining")
-            wire.send_frame(conn, _error_doc(err))
+            wire.send_frame(conn, _error_doc(err, header))
             return
         x = np.frombuffer(payload, dtype=header["dtype"]).reshape(
             header["shape"])
@@ -203,22 +210,28 @@ class _Front:
         budget_s = (deadline_ms / 1000.0 if deadline_ms
                     else self.server.config.result_timeout_s)
         conn.settimeout(budget_s + 10.0)
+        # the frame's propagated trace context re-anchors this replica's
+        # serving_request root under the router's request span — ONE
+        # trace_id across both processes' journals
+        parent = wire.extract_parent(header)
         try:
             resp = self.server.submit(x, deadline_ms=deadline_ms,
-                                      tenant=header.get("tenant"))
+                                      tenant=header.get("tenant"),
+                                      parent=parent)
             out = np.asarray(resp.result(timeout_s=budget_s + 5.0))
         except RequestError as exc:
-            wire.send_frame(conn, _error_doc(exc))
+            wire.send_frame(conn, _error_doc(exc, header))
             return
         if not isinstance(out, np.ndarray):
             err = RequestError("replica model returned a non-array tree; "
                                "the wire protocol ships single arrays")
             err.retryable = False
-            wire.send_frame(conn, _error_doc(err))
+            wire.send_frame(conn, _error_doc(err, header))
             return
         wire.send_frame(
             conn,
-            {"ok": True, "shape": list(out.shape), "dtype": str(out.dtype),
+            {"ok": True, "v": wire.PROTOCOL_VERSION,
+             "shape": list(out.shape), "dtype": str(out.dtype),
              "params_step": resp.params_step},
             np.ascontiguousarray(out).tobytes())
 
@@ -258,11 +271,20 @@ def add_worker_args(parser) -> None:
 
 def cmd_worker(args) -> int:
     from ..elastic.membership import Heartbeat
+    from ..observability import flight
     from .reload import ParamStore
     from .server import Server, ServerConfig
 
+    # pod attribution: every span/anchor/flight record this process
+    # writes names the replica, even when the worker is launched by
+    # hand rather than through ReplicaPool's env stamping
+    os.environ.setdefault("MXNET_TPU_REPLICA_ID", str(args.replica_id))
     j = get_journal()
     j.set_phase("replica_worker_setup")
+    # flight recorder (MXNET_TPU_TRACE_DIR): bounded span/journal ring
+    # dumped on SIGTERM/crash/wedge + flushed periodically, so even a
+    # SIGKILLed worker leaves its last-N spans for the postmortem
+    recorder = flight.install_from_env()
 
     slow_s = os.environ.get("MXNET_TPU_TESTING_SLOW_PREDICT_S")
     if slow_s:
@@ -315,6 +337,8 @@ def cmd_worker(args) -> int:
             server.stop(timeout_s=30.0)
         finally:
             hb.stop(resign=True)
+        if recorder is not None:
+            recorder.stop(dump=True)       # the clean-exit flight dump
         j.event("replica_worker_stop", replica=args.replica_id)
     return 0
 

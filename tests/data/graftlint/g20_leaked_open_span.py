@@ -1,0 +1,74 @@
+# graftlint: scope=library
+"""G20 fixture: ``start_span()`` with no exception-safe ``.end()`` —
+the first raise loses the span (and its children) from the assembled
+timeline — vs the with / finally / finally-called-helper / ownership-
+transfer shapes that pass.  Parsed only, never executed."""
+from mxnet_tpu.observability import trace
+
+
+class BadSpans:
+    def bad_straight_line(self, work):
+        sp = trace.start_span("attempt")  # expect: G20
+        result = work()           # a raise here leaks the span
+        sp.end(status="ok")
+        return result
+
+    def bad_no_end_at_all(self, work):
+        sp = trace.start_span("attempt")  # expect: G20
+        sp.set_attrs(step=1)
+        return work()
+
+    def bad_try_except_no_finally(self, work):
+        # the pre-fix router hedge-arm shape: ended on BOTH branches,
+        # but an exception inside the except body (or one neither
+        # branch catches) still leaks it — only finally is safe
+        sp = trace.start_span("attempt")  # expect: G20
+        try:
+            out = work()
+            sp.end(status="ok")
+            return out
+        except ValueError as e:
+            sp.end(status=type(e).__name__)
+            raise
+
+
+class GoodShapes:
+    def good_with(self, work):
+        with trace.start_span("attempt") as sp:
+            sp.set_attrs(phase="run")
+            return work()
+
+    def good_finally(self, work):
+        sp = trace.start_span("attempt")
+        try:
+            return work()
+        finally:
+            sp.end()
+
+    def _close(self, span, status="ok"):
+        span.end(status=status)
+
+    def good_helper_end(self, work):
+        # the finally-called helper ends the span it is handed — the
+        # param-position fixpoint must see it (the G17 helper shape)
+        sp = trace.start_span("attempt")
+        try:
+            return work()
+        finally:
+            self._close(sp)
+
+    def good_ownership_transfer(self, req):
+        # stored on the request: whoever resolves the request ends it
+        # (the serving_request cross-thread lifecycle) — not a leak
+        req.trace = trace.start_span("serving_request")
+        return req
+
+    def good_returned(self):
+        sp = trace.start_span("attempt")
+        return sp                  # the caller owns the end now
+
+    def good_disable_twin(self, registry, work):
+        # handed to a registry another thread drains and ends
+        # graftlint: disable=G20 fixture twin: justified exception
+        sp = trace.start_span("attempt")
+        return work()

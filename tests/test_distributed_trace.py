@@ -1,0 +1,548 @@
+"""Pod-scope distributed tracing (docs/observability.md).
+
+The headline chaos drill (CI tier 0.5, ``-k smoke``): a 3-replica pool
+under closed-loop load with a shared-FS trace run directory, SIGKILL
+one replica mid-traffic, and assemble the full cross-process story from
+the wreckage — ONE trace_id links the router's request root to the
+worker-side request spans across the wire, the killed replica's
+flight-recorder dump is present and parseable, and ``doctor
+--timeline`` renders the merged critical path from per-process files
+alone.
+
+Around it: wire-level propagation units (attach/extract, Server.submit
+re-anchoring), clock alignment with skewed anchors, Perfetto pid
+disambiguation for replicas sharing a rank, trace-ring drop-count
+visibility, and multi-survivor elastic recovery-trace adoption through
+the epoch ledger.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.diagnostics.journal import reset_journal
+from mxnet_tpu.observability import aggregate, export, flight
+from mxnet_tpu.observability import trace as obtrace
+from mxnet_tpu.serving import wire
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def ring():
+    tracer = obtrace.configure(mode="ring")
+    try:
+        yield tracer
+    finally:
+        obtrace.reset_tracer()
+
+
+def _records(path, kind=None):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+def _write_jsonl(path, records):
+    with open(path, "w", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+# -- wire-level propagation units --------------------------------------------
+
+def test_wire_attach_and_extract_trace_roundtrip(ring):
+    with obtrace.span("router_request") as root:
+        header = wire.attach_trace({"cmd": "predict"})
+    assert header["v"] == wire.PROTOCOL_VERSION
+    assert header["trace"] == {"trace_id": root.trace_id,
+                               "span_id": root.span_id}
+    ctx = wire.extract_parent(header)
+    assert ctx.trace_id == root.trace_id
+    assert ctx.span_id == root.span_id
+
+
+def test_wire_attach_trace_off_and_garbage_degrade():
+    obtrace.configure(mode="off")
+    try:
+        header = wire.attach_trace({"cmd": "predict"})
+        assert header["v"] == wire.PROTOCOL_VERSION
+        assert "trace" not in header       # bit-compatible with pre-trace
+    finally:
+        obtrace.reset_tracer()
+    # malformed propagated contexts degrade to no parent, never an error
+    assert wire.extract_parent({}) is None
+    assert wire.extract_parent({"trace": "junk"}) is None
+    assert wire.extract_parent({"trace": {"trace_id": 7}}) is None
+
+
+def test_server_submit_reanchors_under_wire_parent(ring):
+    """The worker-side half: a propagated SpanContext makes the
+    serving_request root a true child of the remote router span."""
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.serving import Server, ServerConfig
+
+    class Scale(HybridBlock):
+        def hybrid_forward(self, F, x):
+            return x * 2.0
+
+    net = Scale()
+    net.initialize()
+    srv = Server(net, ServerConfig(max_batch=2, window_ms=1.0)).start()
+    parent = obtrace.SpanContext("feedc0de000001", "abcd1234")
+    try:
+        out = srv.submit(np.ones(3, np.float32),
+                         parent=parent).result(timeout_s=30)
+    finally:
+        srv.stop()
+    np.testing.assert_allclose(np.asarray(out), 2.0 * np.ones(3))
+    roots = [s for s in obtrace.get_tracer().spans()
+             if s["name"] == "serving_request"]
+    assert roots, "no serving_request span recorded"
+    assert roots[-1]["trace_id"] == "feedc0de000001"
+    assert roots[-1]["parent_id"] == "abcd1234"
+    # children stay in the adopted trace
+    kids = [s for s in obtrace.get_tracer().spans()
+            if s.get("parent_id") == roots[-1]["span_id"]]
+    assert kids and all(s["trace_id"] == "feedc0de000001" for s in kids)
+
+
+# -- clock alignment ----------------------------------------------------------
+
+def _anchored_journal(path, replica, wall_s, perf_s, epoch_s, spans):
+    recs = [{"kind": "trace_anchor", "ts": wall_s, "wall_s": wall_s,
+             "perf_s": perf_s, "epoch_s": epoch_s, "rank": 0,
+             "replica": replica, "pid": 100 + hash(replica) % 50,
+             "run_id": "pod-test"}]
+    for sp in spans:
+        recs.append({"kind": "span", "rank": 0, "replica": replica,
+                     "thread": "main", "ts": wall_s + 9.0, **sp})
+    _write_jsonl(path, recs)
+
+
+def test_clock_alignment_with_skewed_anchors(tmp_path):
+    """Two processes whose monotonic clocks are wildly apart (different
+    boot epochs) land on ONE wall timeline via their anchors: the
+    worker's span starts 200 ms after the router's even though its raw
+    start_s is numerically smaller."""
+    # router: perf clock near 50 s, span at epoch+2.0 -> wall 992.0
+    _anchored_journal(
+        str(tmp_path / "journal-router.jsonl"), "router",
+        wall_s=1000.0, perf_s=50.0, epoch_s=40.0,
+        spans=[{"name": "router_request", "trace_id": "T1",
+                "span_id": "a1", "parent_id": None,
+                "start_s": 2.0, "dur_s": 0.5}])
+    # worker: perf clock near 100k s (skew ~27 h), span -> wall 992.2
+    _anchored_journal(
+        str(tmp_path / "journal-w0.jsonl"), "w0",
+        wall_s=1000.2, perf_s=99999.0, epoch_s=99990.0,
+        spans=[{"name": "serving_request", "trace_id": "T1",
+                "span_id": "b1", "parent_id": "a1",
+                "start_s": 1.0, "dur_s": 0.3}])
+    procs = aggregate.scan_run_dir(str(tmp_path))
+    assert len(procs) == 2
+    cp = aggregate.critical_path(procs, trace_id="T1")
+    assert cp["ok"] and [s["name"] for s in cp["steps"]] == \
+        ["router_request", "serving_request"]
+    assert cp["steps"][0]["start_ms"] == 0.0
+    assert abs(cp["steps"][1]["start_ms"] - 200.0) < 1.0
+    assert abs(cp["wall_ms"] - 500.0) < 1.0      # router span bounds it
+    assert sorted(cp["processes"]) == ["replica router", "replica w0"]
+    # the merged Perfetto doc is ordered on the same wall timeline
+    doc = aggregate.aggregate_chrome(str(tmp_path))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["router_request",
+                                      "serving_request"]
+    assert abs(xs[1]["ts"] - xs[0]["ts"] - 200e3) < 1e3
+
+
+def test_clock_alignment_falls_back_to_record_ts(tmp_path):
+    """A journal with no anchor (older writer, torn head) still places
+    spans via each record's own write-time ts minus duration."""
+    _write_jsonl(str(tmp_path / "journal-x.jsonl"), [
+        {"kind": "span", "name": "serving_request", "trace_id": "T2",
+         "span_id": "c1", "parent_id": None, "rank": 0,
+         "thread": "main", "start_s": 5.0, "dur_s": 0.4, "ts": 2000.4}])
+    procs = aggregate.scan_run_dir(str(tmp_path))
+    assert len(procs) == 1 and procs[0].anchor is None
+    cp = aggregate.critical_path(procs, trace_id="T2")
+    assert cp["ok"] and cp["steps"][0]["name"] == "serving_request"
+    assert abs(cp["wall_ms"] - 400.0) < 1.0
+
+
+# -- Perfetto pid disambiguation (satellite) ----------------------------------
+
+def test_spans_to_chrome_disambiguates_replicas_sharing_a_rank(ring):
+    with obtrace.span("a"):
+        pass
+    base = obtrace.get_tracer().spans()
+    r1 = [{**s, "replica": "r1"} for s in base]
+    r2 = [{**s, "replica": "r2"} for s in base]
+    doc = export.spans_to_chrome(base + r1 + r2)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    pids = {e["args"].get("replica"): e["pid"] for e in xs}
+    # three processes, three distinct tracks — rank alone keyed all of
+    # these onto pid 0 before the fix
+    assert len(set(pids.values())) == 3
+    metas = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert {"replica r1", "replica r2"} <= metas
+    # rank-only single-process documents stay the pre-fix golden shape
+    solo = export.spans_to_chrome(base)
+    assert all(e["ph"] == "X" and e["pid"] == 0
+               for e in solo["traceEvents"])
+
+
+def test_aggregate_assigns_one_pid_per_process(tmp_path):
+    for rep in ("a", "b"):
+        _anchored_journal(
+            str(tmp_path / f"journal-{rep}.jsonl"), rep,
+            wall_s=500.0, perf_s=10.0, epoch_s=10.0,
+            spans=[{"name": "serving_batch", "trace_id": f"T{rep}",
+                    "span_id": f"s{rep}", "parent_id": None,
+                    "start_s": 0.1, "dur_s": 0.1}])
+    doc = aggregate.aggregate_chrome(str(tmp_path))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len({e["pid"] for e in xs}) == 2
+    metas = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert metas == {"replica a", "replica b"}
+
+
+def test_dedupe_keeps_same_span_ids_across_incarnations(tmp_path):
+    """A respawned worker restarts its span counter, and a trace id
+    minted ELSEWHERE (the router's, propagated over the wire) can
+    reach both incarnations — e.g. a retry of the same request after
+    the respawn.  The two spans share (trace_id, span_id) but belong
+    to different incarnations (different anchor epochs appended to the
+    SAME journal): both must survive dedupe, while a true duplicate of
+    one span (journal + flight flush, same incarnation) collapses."""
+    jf = str(tmp_path / "journal-w.jsonl")
+    span1 = {"name": "serving_request", "trace_id": "ROUTER-T",
+             "span_id": "00000005", "parent_id": None,
+             "start_s": 0.1, "dur_s": 0.1}
+    span2 = dict(span1, start_s=0.2)     # incarnation 2, counter reset
+    recs = [{"kind": "trace_anchor", "ts": 500.0, "wall_s": 500.0,
+             "perf_s": 10.0, "epoch_s": 10.0, "rank": 0, "replica": "w",
+             "pid": 111, "run_id": "pod-test"},
+            {"kind": "span", "rank": 0, "replica": "w", "ts": 500.3,
+             **span1},
+            {"kind": "trace_anchor", "ts": 560.0, "wall_s": 560.0,
+             "perf_s": 4.0, "epoch_s": 4.0, "rank": 0, "replica": "w",
+             "pid": 222, "run_id": "pod-test"},
+            {"kind": "span", "rank": 0, "replica": "w", "ts": 560.3,
+             **span2},
+            # same-incarnation duplicate of span2 (a periodic flight
+            # flush replayed into the journal scanner's view) collapses
+            {"kind": "span", "rank": 0, "replica": "w", "ts": 560.3,
+             **span2}]
+    _write_jsonl(jf, recs)
+    (proc,) = aggregate.scan_run_dir(str(tmp_path))
+    assert len(proc.spans) == 2
+    # and they sit at their OWN incarnations' wall offsets
+    walls = sorted(proc.span_wall_start(d) for d in proc.spans)
+    assert walls == [pytest.approx(500.1), pytest.approx(560.2)]
+
+
+def test_flight_dump_merges_with_journal_by_identity(tmp_path):
+    """A flight dump whose label doesn't share the journal's filename
+    stem — the recorder's default ``rank<r>-pid<pid>`` label next to a
+    ``journal-r0.jsonl`` (elastic per-rank flow, no replica id) — is
+    still the SAME process: the pod identity block joins them onto one
+    pid, and the flight-flushed copy of a journaled span collapses
+    instead of appearing twice on the merged timeline."""
+    span = {"name": "elastic_recover", "trace_id": "T1",
+            "span_id": "00000001", "parent_id": None,
+            "start_s": 0.5, "dur_s": 0.2}
+    ident = {"rank": 0, "pid": 1234, "run_id": "pod-test"}
+    _write_jsonl(str(tmp_path / "journal-r0.jsonl"),
+                 [{"kind": "trace_anchor", "ts": 500.0, "wall_s": 500.0,
+                   "perf_s": 10.0, "epoch_s": 10.0, **ident},
+                  {"kind": "span", "ts": 500.8, **ident, **span}])
+    with open(tmp_path / "flight-rank0-pid1234.json", "w") as f:
+        json.dump({"kind": "flight", "reason": "periodic", "seq": 3,
+                   "label": "rank0-pid1234", "last_phase": "recover",
+                   "anchor": {"wall_s": 500.9, "perf_s": 10.9,
+                              "epoch_s": 10.0, **ident},
+                   "trace": {"dropped": 0},
+                   "spans": [dict(span)], "journal_tail": [], **ident}, f)
+    (proc,) = aggregate.scan_run_dir(str(tmp_path))
+    assert sorted(proc.sources) == ["flight-rank0-pid1234.json",
+                                    "journal-r0.jsonl"]
+    assert len(proc.spans) == 1          # journal copy == flight copy
+    assert proc.flight and proc.flight["reason"] == "periodic"
+    doc = aggregate.aggregate_chrome(str(tmp_path))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 1 and len({e["pid"] for e in xs}) == 1
+
+
+# -- trace-ring drops (satellite) ---------------------------------------------
+
+def test_ring_drops_counted_metric_and_doctor_visible(tmp_path):
+    from mxnet_tpu.diagnostics.__main__ import _summ_trace
+    from mxnet_tpu.observability.metrics import (default_registry,
+                                                 reset_metrics)
+    from mxnet_tpu.observability.report import trace_report
+    jf = str(tmp_path / "j.jsonl")
+    reset_journal(jf)
+    reset_metrics()
+    obtrace.configure(mode="journal", ring=2)
+    try:
+        for i in range(5):
+            with obtrace.span(f"s{i}"):
+                pass
+        stats = obtrace.get_tracer().stats()
+        assert stats["dropped"] == 3
+        snap = default_registry().snapshot()
+        fam = snap.get(obtrace.DROPS_METRIC)
+        assert fam and sum(float(v)
+                           for v in fam["values"].values()) == 3.0
+    finally:
+        obtrace.reset_tracer()
+        reset_journal("stderr")
+        reset_metrics()
+    markers = _records(jf, "trace_ring_drops")
+    assert markers and markers[0]["dropped"] == 1
+    rep = trace_report(jf)
+    assert rep["ok"] and rep["ring_drops"] >= 1
+    assert "ring drops" in _summ_trace(rep)
+
+
+def test_flight_dump_carries_ring_drop_counts(tmp_path, ring):
+    obtrace.configure(mode="ring", ring=1)
+    try:
+        for _ in range(3):
+            with obtrace.span("x"):
+                pass
+        fr = flight.FlightRecorder(str(tmp_path), label="t", flush_s=0)
+        path = fr.dump("test")
+    finally:
+        obtrace.reset_tracer()
+    doc = flight.read_flight(path)
+    assert doc["trace"]["dropped"] == 2
+    rep = aggregate.timeline_report(str(tmp_path))
+    row = [p for p in rep["processes"] if "flight" in p][0]
+    assert row["flight"]["ring_drops"] == 2
+
+
+# -- elastic: multi-survivor recovery-trace adoption --------------------------
+
+def test_flight_stop_dump_survives_process_exit(tmp_path, ring):
+    """A clean ``stop(dump=True)`` dump is the component's own
+    artifact: stop() must UNREGISTER the journal final_cb so the
+    exit-time finalizer can't overwrite ``reason="stop"`` with
+    ``reason="final"`` (pre-fix, every cleanly-stopped worker's dump
+    read ``final``)."""
+    from mxnet_tpu.diagnostics.journal import Journal
+
+    j = Journal(str(tmp_path / "j.jsonl"))
+    fr = flight.FlightRecorder(str(tmp_path), label="w", flush_s=0,
+                               journal=j)
+    fr.install()
+    with obtrace.span("work"):
+        pass
+    fr.stop(dump=True)
+    j._finalize("atexit")            # simulated not-clean process exit
+    doc = flight.read_flight(fr.path)
+    assert doc["reason"] == "stop"
+    assert j._final_cbs == []        # stopped recorders unreachable
+
+
+def test_two_survivors_adopt_leader_recovery_trace(tmp_path, ring):
+    """The epoch ledger is the recovery-trace channel: the leader
+    publishes epoch k+1 inside its elastic_recover span, the other
+    survivor adopts the stamped trace id, and both spans (plus every
+    record written after adoption) share ONE trace."""
+    from mxnet_tpu.elastic.membership import Cohort, CohortConfig
+    cfg = CohortConfig(heartbeat_s=0.1, deadline_s=5.0, barrier_s=30.0,
+                       poll_s=0.01)
+    root = str(tmp_path / "cohort")
+    cohorts = {r: Cohort(root, r, cfg).start() for r in (0, 1)}
+    results = {}
+
+    def form(r):
+        cohorts[r].form(2)
+
+    threads = [threading.Thread(target=form, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+
+    def recover(r):
+        with obtrace.span("elastic_recover", rank_sim=r) as sp:
+            cohorts[r].resize([])
+            doc = cohorts[r].read_epoch_doc() or {}
+            obtrace.adopt_trace(sp, doc.get("recovery_trace"))
+            results[r] = {"trace_id": sp.trace_id,
+                          "span_id": sp.span_id,
+                          "recovery_trace": doc.get("recovery_trace")}
+
+    threads = [threading.Thread(target=recover, args=(r,))
+               for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for c in cohorts.values():
+        c.stop(resign=True)
+    assert set(results) == {0, 1}, "a survivor never finished resize"
+    stamped = results[0]["recovery_trace"]
+    assert stamped, "leader did not stamp a recovery trace"
+    # the leader kept its own trace; the survivor adopted it
+    assert results[0]["trace_id"] == stamped
+    assert results[1]["trace_id"] == stamped
+    # the recorded spans agree (both survivors' elastic_recover spans
+    # are in one trace)
+    spans = [s for s in obtrace.get_tracer().spans()
+             if s["name"] == "elastic_recover"]
+    assert len(spans) == 2
+    assert {s["trace_id"] for s in spans} == {stamped}
+
+
+# -- the chaos headline (CI tier 0.5 smoke) -----------------------------------
+
+def test_smoke_distributed_trace_sigkill_drill(tmp_path):
+    """3 REAL replica workers + a traced router process sharing one run
+    directory; SIGKILL one worker under load; assemble the merged
+    cross-process trace from per-process files alone and prove: one
+    trace_id spans the router and worker journals, the killed replica's
+    flight-recorder dump survived and parses, and doctor --timeline
+    renders the critical path including the wreckage."""
+    from mxnet_tpu.diagnostics.__main__ import _summ_timeline
+    from mxnet_tpu.serving import (PoolConfig, ReplicaPool, Router,
+                                   RouterConfig, ServerOverloaded)
+
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    # the router-side process journals into the SAME run dir
+    reset_journal(os.path.join(run_dir, "journal-router.jsonl"))
+    obtrace.configure(mode="journal")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+           "MXNET_TPU_TRACE_FLIGHT_S": "0.25"}
+    for k in ("XLA_FLAGS", "MXNET_TPU_JOURNAL", "MXNET_TPU_TRACE",
+              "MXNET_TPU_TRACE_DIR"):
+        env.pop(k, None)
+    cfg = PoolConfig(heartbeat_s=0.25, deadline_s=1.5, monitor_s=0.3,
+                     trace_dir=run_dir)
+    pool = ReplicaPool(str(tmp_path / "pool"), cfg)
+    for i in range(3):
+        pool.add_proc(f"p{i}", {"--model": "scale", "--window-ms": 1.0},
+                      env=env)
+    router = Router(pool, RouterConfig(retries=3, breaker_k=2,
+                                       breaker_cooldown_s=1.0))
+    x = np.arange(4, dtype=np.float32)
+    stop = threading.Event()
+    unexpected = []
+
+    def client():
+        while not stop.is_set():
+            try:
+                router.call(x, deadline_ms=8000)
+            except ServerOverloaded:
+                time.sleep(0.01)
+            except Exception as e:           # pragma: no cover - loud
+                unexpected.append(repr(e))
+                time.sleep(0.05)
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(2)]
+    killed_flight = os.path.join(run_dir, "flight-replica-p1.json")
+    try:
+        pool.start()
+        pool.monitor_start()
+        for t in threads:
+            t.start()
+        time.sleep(1.5)                      # steady traced traffic
+        assert router.stats()["served"] > 0
+        # the periodic flush must have landed at least one dump before
+        # the kill — that file IS the postmortem
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                not os.path.exists(killed_flight):
+            time.sleep(0.05)
+        assert os.path.exists(killed_flight), "no pre-kill flight flush"
+        pool.replicas["p1"].kill()           # the host-vanished shape
+        # detection: the monitor journals replica_lost in the router
+        # journal (the run dir's router process file)
+        router_journal = os.path.join(run_dir, "journal-router.jsonl")
+        deadline = time.monotonic() + 30
+        lost = []
+        while time.monotonic() < deadline and not lost:
+            lost = [r for r in _records(router_journal, "replica_lost")
+                    if r.get("replica") == "p1"]
+            time.sleep(0.05)
+        assert lost, "SIGKILLed replica never detected"
+        time.sleep(0.3)                      # a little post-kill traffic
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        router.stop()
+        pool.stop()
+        obtrace.reset_tracer()
+        reset_journal("stderr")
+    assert not unexpected, unexpected[:5]
+
+    # (1) ONE trace_id spans the wire: a router_request span in the
+    # router journal shares its trace with a serving_request span in a
+    # WORKER journal (different process, same trace)
+    router_spans = _records(os.path.join(run_dir,
+                                         "journal-router.jsonl"), "span")
+    router_traces = {s["trace_id"] for s in router_spans
+                     if s["name"] == "router_request"}
+    assert router_traces
+    worker_traces = set()
+    for i in range(3):
+        wj = os.path.join(run_dir, f"journal-p{i}.jsonl")
+        if not os.path.exists(wj):
+            continue
+        worker_traces |= {s["trace_id"] for s in _records(wj, "span")
+                          if s["name"] == "serving_request"}
+    crossed = router_traces & worker_traces
+    assert crossed, "no trace crossed the process boundary"
+
+    # (2) the killed replica's flight dump is present and parseable,
+    # with its span ring and clock anchor intact
+    doc = flight.read_flight(killed_flight)
+    assert doc["replica"] == "p1" and doc["run_id"] == pool.run_id
+    assert isinstance(doc["spans"], list)
+    assert {"wall_s", "perf_s", "epoch_s"} <= set(doc["anchor"])
+
+    # (3) assembly from per-process files alone: every process present,
+    # p1 contributes its flight wreckage, and the critical path of the
+    # slowest routed request crosses processes
+    rep = aggregate.timeline_report(run_dir)
+    assert rep["ok"]
+    labels = {p["proc"] for p in rep["processes"]}
+    assert {"replica p0", "replica p1", "replica p2"} <= labels
+    assert len(rep["processes"]) == 4        # + the router process
+    assert "replica p1" in rep["flight_dumps"]
+    cp = rep["critical_path"]
+    assert cp["ok"] and len(cp["processes"]) >= 2
+    names = [s["name"] for s in cp["steps"]]
+    assert names[0] == "router_request"
+    assert "serving_request" in names and "execute" in names
+
+    # (4) the merged Perfetto doc keys one pid per process
+    chrome = aggregate.aggregate_chrome(run_dir)
+    xs = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+    assert len({e["pid"] for e in xs}) >= 4
+    metas = {e["args"]["name"] for e in chrome["traceEvents"]
+             if e["ph"] == "M"}
+    assert any("p1" in m and "flight" in m for m in metas)
+
+    # (5) the doctor line tells the story in one sentence
+    line = _summ_timeline(rep)
+    assert "flight" in line and "processes" in line

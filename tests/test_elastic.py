@@ -584,6 +584,18 @@ def test_smoke_elastic_chaos_rank_loss_survivor_continues(tmp_path):
     assert by_kind["reshard_restore"][-1].get("trace_id") == tid
     assert any(r.get("trace_id") == tid
                for r in by_kind["cohort_resize"])
+    # the leader stamped its recovery trace into the epoch ledger — the
+    # channel every survivor adopts its elastic_recover span from
+    # (multi-survivor adoption is unit-tested in
+    # test_distributed_trace.py; here the leader IS the one survivor)
+    epoch_docs = []
+    epoch_dir = os.path.join(base, "cohort", "epoch")
+    for name in sorted(os.listdir(epoch_dir)):
+        with open(os.path.join(epoch_dir, name)) as f:
+            epoch_docs.append(json.load(f))
+    resizes = [d for d in epoch_docs if d.get("reason") == "resize"]
+    assert resizes, "no resize epoch record on the ledger"
+    assert resizes[-1].get("recovery_trace") == tid
 
     # bit-exactness: the tree the survivor restored equals the committed
     # step's assembled global tree (written by BOTH ranks as 2 shards)

@@ -247,14 +247,124 @@ def test_historical_lock_held_ledger_io_is_flagged():
     "mxnet_tpu/serving", "mxnet_tpu/elastic", "mxnet_tpu/observability",
     "mxnet_tpu/diagnostics", "mxnet_tpu/resilience"])
 def test_concurrency_rules_clean_on_audited_subsystems(subsystem):
-    """The audit-and-fix acceptance: every live G15-G19 finding was
-    fixed in this PR (router/fleet transition journaling deferred past
-    the locks, heartbeat write outside its lock, restart deadlines
-    threaded), none baselined."""
+    """The audit-and-fix acceptance: every live G15-G20 finding was
+    fixed (router/fleet transition journaling deferred past the locks,
+    heartbeat write outside its lock, restart deadlines threaded, the
+    hedge-arm span restructured onto `with`), none baselined."""
     registry = core.load_rules()
-    rules = [registry[c] for c in ("G15", "G16", "G17", "G18", "G19")]
+    rules = [registry[c]
+             for c in ("G15", "G16", "G17", "G18", "G19", "G20")]
     findings, n = core.run([subsystem], rules=rules, root=REPO)
     assert n >= 4 and findings == []
+
+
+# -- G20 leaked-open-span -----------------------------------------------------
+
+_G20_PRELUDE = "from mxnet_tpu.observability import trace\n"
+
+
+def _g20_run(src, tmp_path):
+    path = tmp_path / "fake_spans.py"
+    path.write_text("# graftlint: scope=library\n" + _G20_PRELUDE + src)
+    return core.lint_file(str(path), rules=[core.load_rules()["G20"]],
+                          root=str(tmp_path))
+
+
+def test_g20_param_end_fixpoint_two_hops(tmp_path):
+    """A finally-called helper that forwards the span to ANOTHER helper
+    that ends it counts as exception-safe — the param-position fixpoint
+    follows the chain; the SAME helper on a straight-line path does
+    not (a raise before it leaks the span), and a helper that merely
+    annotates transfers nothing (silent handoff, documented limit)."""
+    src = (
+        "def _really_close(sp, status='ok'):\n"
+        "    sp.end(status=status)\n"
+        "def _close(span):\n"
+        "    _really_close(span)\n"
+        "def _annotate(span):\n"
+        "    span.set_attrs(seen=True)\n"
+        "def good(work):\n"
+        "    sp = trace.start_span('a')\n"
+        "    try:\n"
+        "        return work()\n"
+        "    finally:\n"
+        "        _close(sp)\n"
+        "def bad(work):\n"
+        "    sp = trace.start_span('a')\n"
+        "    out = work()\n"      # a raise here leaks sp: _close is
+        "    _close(sp)\n"        # straight-line, not finally
+        "    return out\n"
+    )
+    found = _g20_run(src, tmp_path)
+    assert len(found) == 1 and found[0].code == "G20"
+    assert "never on a finally: path" in found[0].message
+    # the finding sits on bad()'s open, not good()'s
+    assert "start_span('a')" in open(tmp_path / "fake_spans.py")\
+        .read().splitlines()[found[0].line - 1]
+    assert found[0].line > 10
+
+
+def test_g20_keyword_forwarding_and_method_offset(tmp_path):
+    """self-method helpers (param offset past ``self``) and keyword
+    forwarding both resolve to the right param position."""
+    src = (
+        "class R:\n"
+        "    def _close(self, span, status='ok'):\n"
+        "        span.end(status=status)\n"
+        "    def good_kw(self, work):\n"
+        "        sp = trace.start_span('a')\n"
+        "        try:\n"
+        "            return work()\n"
+        "        finally:\n"
+        "            self._close(span=sp)\n"
+        "    def good_pos(self, work):\n"
+        "        sp = trace.start_span('a')\n"
+        "        try:\n"
+        "            return work()\n"
+        "        finally:\n"
+        "            self._close(sp)\n"
+    )
+    assert _g20_run(src, tmp_path) == []
+
+
+def test_g20_ownership_transfer_shapes_are_silent(tmp_path):
+    """Stored / returned / aliased / handed-to-opaque-callee spans are
+    ownership transfers, not leaks (the serving_request lifecycle)."""
+    src = (
+        "def stored(req):\n"
+        "    req.trace = trace.start_span('root')\n"
+        "def returned():\n"
+        "    sp = trace.start_span('root')\n"
+        "    return sp\n"
+        "def aliased():\n"
+        "    sp = trace.start_span('root')\n"
+        "    keep = sp\n"
+        "    return keep\n"
+        "def queued(q):\n"
+        "    sp = trace.start_span('root')\n"
+        "    q.put_nowait(sp)\n"
+    )
+    assert _g20_run(src, tmp_path) == []
+
+
+def test_g20_historical_hedge_arm_shape_is_flagged(tmp_path):
+    """The real pre-fix router bug: the hedge arm span ended in try AND
+    except — no finally, so an exception in the except body (or an
+    uncaught type) leaked it."""
+    src = (
+        "def run(results, dispatch, st):\n"
+        "    arm = trace.start_span('router_hedge_arm')\n"
+        "    try:\n"
+        "        v = dispatch(st)\n"
+        "        results.put_nowait((st, None, v))\n"
+        "        arm.end(status='ok')\n"
+        "    except BaseException as e:\n"
+        "        results.put_nowait((st, e, None))\n"
+        "        arm.end(status=type(e).__name__)\n"
+    )
+    found = _g20_run(src, tmp_path)
+    assert len(found) == 1 and found[0].code == "G20"
+    assert "never on a finally: path" in found[0].message
 
 
 # -- --changed-only ----------------------------------------------------------
