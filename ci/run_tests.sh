@@ -88,6 +88,14 @@ python -m pytest tests/test_crash_matrix.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: serving smoke (dynamic batcher) =="
 python -m pytest tests/test_serving.py -q -k smoke -p no:cacheprovider
 
+# warm-start smoke: serve -> stop -> restart on the same AOT cache dir
+# -> the second start performs ZERO XLA compiles for the warmed bucket
+# set (compile_stats) with bit-identical responses, and a bit-flipped
+# entry degrades to a compile with a journaled aot_fallback — the
+# bounded-startup guarantee (docs/serving.md AOT cache)
+echo "== tier 0.5: warm-start smoke (persistent AOT cache) =="
+python -m pytest tests/test_aotcache.py -q -k smoke -p no:cacheprovider
+
 # tenant-fleet chaos smoke: tenant A fed a corrupt committed checkpoint
 # + oversized-shape flood + predictor poison while tenant B runs
 # closed-loop load on the SAME fleet -> B's p99 stays in its SLO bound

@@ -1540,3 +1540,134 @@ class UnboundedKeyedRegistry(Rule):
                         "one entry per novel key forever; add an LRU "
                         "cap/pruning (ParamStore's bad-step LRU is the "
                         "model)")
+
+
+@register
+class UnvalidatedCacheDeserialize(Rule):
+    code = "G21"
+    name = "unvalidated-cache-deserialize"
+    severity = "error"
+    doc = ("Deserializing a persisted executable/pickle without a "
+           "version-envelope or CRC check on the read path: a function "
+           "that both reads file bytes AND hands them to an unguarded "
+           "deserializer (pickle.load/loads, marshal, an Unpickler, "
+           "jax.export.deserialize, serialize_executable."
+           "deserialize_and_load) will happily load a torn write, a "
+           "bit-flipped sector, or a stale-toolchain artifact as live "
+           "state — the failure is wrong NUMERICS or a segfaulting "
+           "executable, not a clean error.  The AOT compile cache "
+           "(serving/aotcache.py) is the model read path: magic + "
+           "bounds + CRC32 + a jax/jaxlib/backend envelope are all "
+           "verified (serving/aot_report.read_entry) before any byte "
+           "reaches the deserializer.  Evidence that satisfies the "
+           "rule, anywhere in the same function: a zlib/binascii CRC "
+           "or hashlib digest call, or identifiers carrying "
+           "crc/checksum/magic/envelope/sha tokens (a delegated "
+           "validate helper names itself).  Deserializing bytes the "
+           "caller passed in (no file read in the function) is out of "
+           "scope — the reader that pulled them off disk owns the "
+           "check.  Scope: mxnet_tpu/ library code.")
+
+    # unguarded deserializers of attacker/corruption-visible bytes
+    # (pickle.Unpickler itself is NOT here: the constructor only wraps
+    # the stream — the .load() call is the deserialize, matched below)
+    DESERIALIZERS = {"pickle.load", "pickle.loads",
+                     "marshal.load", "marshal.loads",
+                     "jax.export.deserialize"}
+    DESER_SUFFIX = ("deserialize_and_load",)
+    # file-read shapes: open() in the function, or .read()/.read_bytes()
+    READ_ATTRS = {"read", "read_bytes"}
+    # validation evidence: digest calls or validation-named identifiers
+    EVIDENCE_CALLS = {"zlib.crc32", "binascii.crc32"}
+    EVIDENCE_PREFIX = ("hashlib.",)
+    EVIDENCE_TOKENS = {"crc", "crc32", "checksum", "magic", "envelope",
+                       "sha1", "sha256", "digest"}
+
+    @staticmethod
+    def _scope_nodes(scope):
+        """Nodes of this function only — nested defs/lambdas are their
+        own read paths and carry their own evidence."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _is_deserializer(self, ctx, call) -> bool:
+        name = ctx.resolve_call(call)
+        if name:
+            if name in self.DESERIALIZERS:
+                return True
+            if name.endswith(self.DESER_SUFFIX):
+                return True
+        # method spelling: anything.load() on an Unpickler instance is
+        # out of reach without types; catch the documented pattern
+        # Unpickler(...).load() in one expression
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "load" and \
+                isinstance(f.value, ast.Call):
+            inner = ctx.resolve_call(f.value)
+            if inner and inner.endswith("Unpickler"):
+                return True
+        return False
+
+    def _reads_file(self, ctx, fn) -> bool:
+        for node in self._scope_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve_call(node)
+            if name == "open":
+                return True
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in self.READ_ATTRS:
+                return True
+        return False
+
+    def _has_evidence(self, ctx, fn) -> bool:
+        for node in self._scope_nodes(fn):
+            if isinstance(node, ast.Call):
+                name = ctx.resolve_call(node)
+                if name and (name in self.EVIDENCE_CALLS or
+                             name.startswith(self.EVIDENCE_PREFIX)):
+                    return True
+            ident = None
+            if isinstance(node, ast.Name):
+                ident = node.id
+            elif isinstance(node, ast.Attribute):
+                ident = node.attr
+            if ident:
+                tokens = ident.lower().split("_")
+                if any(t in self.EVIDENCE_TOKENS for t in tokens):
+                    return True
+        return False
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            deser_lines = [
+                n.lineno for n in self._scope_nodes(fn)
+                if isinstance(n, ast.Call)
+                and self._is_deserializer(ctx, n)]
+            if not deser_lines:
+                continue
+            if not self._reads_file(ctx, fn):
+                continue            # caller-supplied bytes: reader owns it
+            if self._has_evidence(ctx, fn):
+                continue
+            for line in deser_lines:
+                yield self.finding(
+                    ctx, line,
+                    "unvalidated cache deserialize: this function reads "
+                    "persisted bytes and hands them to a deserializer "
+                    "with no CRC/version-envelope check in sight — a "
+                    "torn or stale entry becomes wrong numerics instead "
+                    "of a clean fallback; validate first "
+                    "(serving/aot_report.read_entry is the model) or "
+                    "route through a checked reader")

@@ -141,6 +141,13 @@ def _timeline_report(run_dir: str) -> dict:
     return aggregate.timeline_report(run_dir)
 
 
+def _aot_report(dirpath: str) -> dict:
+    # imported directly (not via the serving package's heavy siblings):
+    # aot_report is stdlib-only, so the audit runs while jax is wedged
+    from ..serving import aot_report
+    return aot_report.aot_report(dirpath)
+
+
 def _lint_report(root: str) -> dict:
     from ..analysis import report
     return report.lint_report(root)
@@ -231,6 +238,14 @@ def _summ_timeline(tl) -> str:
     return base
 
 
+def _summ_aot(ar) -> str:
+    envs = len(ar.get("envelopes") or {})
+    return (f"aot-cache: {ar['entries']} entries, {ar['bytes']} bytes, "
+            f"{envs} envelope version(s), {ar['stale']} stale, "
+            f"{ar['corrupt_total']} corrupt"
+            + (f" ({ar['corrupt']})" if ar["corrupt"] else ""))
+
+
 def _summ_metrics(mt) -> str:
     return (f"metrics: {mt['families']} families, "
             f"{int(mt.get('compiles_total', 0))} compiles")
@@ -282,6 +297,11 @@ _REPORT_TABLE = (
      "critical path of the slowest routed request — including any "
      "SIGKILLed replica's flight-recorder tail (docs/observability.md)",
      _timeline_report, _summ_timeline),
+    ("aot", "--aot-dir", "MXNET_TPU_AOT_CACHE_DIR", "DIR",
+     "persistent AOT executable-cache root: audit entry/byte counts, "
+     "envelope versions, stale and corrupt entries — CRC-validated "
+     "without deserializing anything (docs/serving.md AOT cache)",
+     _aot_report, _summ_aot),
     ("lint", "--lint", None, "DIR",
      "repo checkout root: run graftlint (all tiers incl. the "
      "interprocedural G15-G19) and summarize per-rule finding counts "

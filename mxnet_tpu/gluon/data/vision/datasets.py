@@ -121,6 +121,7 @@ class CIFAR10(_DownloadedDataset):
         if os.path.isdir(pickle_dir):
             for name in self._batches():
                 with open(os.path.join(pickle_dir, name), "rb") as f:
+                    # graftlint: disable=G21 operator-placed standard dataset file
                     entry = pickle.load(f, encoding="latin1")
                 datas.append(np.asarray(entry["data"], dtype=np.uint8)
                              .reshape(-1, 3, 32, 32))
@@ -155,6 +156,7 @@ class CIFAR100(_DownloadedDataset):
         if not os.path.exists(path):
             raise MXNetError(f"no CIFAR-100 files found under {self._root}")
         with open(path, "rb") as f:
+            # graftlint: disable=G21 operator-placed standard dataset file
             entry = pickle.load(f, encoding="latin1")
         self._data = np.asarray(entry["data"], dtype=np.uint8) \
             .reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)

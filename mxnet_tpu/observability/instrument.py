@@ -26,12 +26,16 @@ import time
 from . import trace
 from .metrics import default_registry
 
-__all__ = ["compile_span", "maybe_compile_span", "step_phase",
-           "PHASE_METRIC", "COMPILE_COUNT_METRIC", "COMPILE_MS_METRIC"]
+__all__ = ["aot_load_span", "compile_span", "maybe_compile_span",
+           "step_phase", "PHASE_METRIC", "COMPILE_COUNT_METRIC",
+           "COMPILE_MS_METRIC", "AOT_LOAD_COUNT_METRIC",
+           "AOT_LOAD_MS_METRIC"]
 
 PHASE_METRIC = "mxnet_tpu_step_phase_ms"
 COMPILE_COUNT_METRIC = "mxnet_tpu_xla_compiles_total"
 COMPILE_MS_METRIC = "mxnet_tpu_xla_compile_ms"
+AOT_LOAD_COUNT_METRIC = "mxnet_tpu_aot_loads_total"
+AOT_LOAD_MS_METRIC = "mxnet_tpu_aot_load_ms"
 
 
 _phase_cache = None
@@ -81,6 +85,27 @@ def compile_span(site, **attrs):
                         "XLA trace/lower/compile events",
                         ("site",)).labels(site=site).inc()
             reg.summary(COMPILE_MS_METRIC, "XLA compile wall time, ms",
+                        ("site",)).labels(site=site).observe(ms)
+
+
+@contextlib.contextmanager
+def aot_load_span(site, **attrs):
+    """One deserialized-executable load at ``site``: counted, timed,
+    and traced as ``aot_load`` — deliberately a DIFFERENT site family
+    from ``xla_compile`` so a warm start's ``compile_stats()`` reads
+    zero compiles honestly (docs/observability.md)."""
+    reg = default_registry()
+    t0 = time.perf_counter()
+    with trace.span("aot_load", site=site, **attrs):
+        try:
+            yield
+        finally:
+            ms = (time.perf_counter() - t0) * 1000.0
+            reg.counter(AOT_LOAD_COUNT_METRIC,
+                        "deserialized AOT executable loads",
+                        ("site",)).labels(site=site).inc()
+            reg.summary(AOT_LOAD_MS_METRIC,
+                        "AOT executable load wall time, ms",
                         ("site",)).labels(site=site).observe(ms)
 
 

@@ -12,6 +12,12 @@ around it (docs/serving.md):
 - :mod:`.cache` — a bounded LRU of compiled predictors built on
   ``gluon.block.functional_apply`` (params as runtime args: hot-reload
   retraces nothing);
+- :mod:`.aotcache` / :mod:`.aot_report` — the persistent tier behind
+  that LRU: serialized AOT executables on disk, keyed by (padded
+  shape, dtype, param-tree structure fingerprint) under a CRC +
+  jax/jaxlib/backend envelope, so a restart, pool-worker respawn, or
+  tenant page-in *loads* its bucket lattice instead of recompiling it
+  (zero-cold-start; ``aot_report`` is the stdlib audit half);
 - :mod:`.server` — the worker loop: shed → coalesce → pad → execute →
   deadline-check, journaled per batch;
 - :mod:`.reload` — newest-valid-committed-step hot-reload over
@@ -45,7 +51,8 @@ from __future__ import annotations
 
 import importlib
 
-__all__ = ["BucketGrid", "CompiledPredictor", "DeadlineExceeded",
+__all__ = ["AOTCache", "BucketGrid", "CompiledPredictor",
+           "DeadlineExceeded",
            "Fleet", "FleetConfig", "LocalReplica", "ParamStore",
            "PendingResponse", "PoolConfig",
            "PredictorCache", "ProcReplica", "ReplicaPool",
@@ -55,6 +62,7 @@ __all__ = ["BucketGrid", "CompiledPredictor", "DeadlineExceeded",
            "TenantQuarantined", "serving_report"]
 
 _LAZY = {
+    "AOTCache": ("aotcache", "AOTCache"),
     "BucketGrid": ("buckets", "BucketGrid"),
     "CompiledPredictor": ("cache", "CompiledPredictor"),
     "DeadlineExceeded": ("batcher", "DeadlineExceeded"),

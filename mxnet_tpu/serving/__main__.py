@@ -169,6 +169,9 @@ def cmd_bench(args) -> int:
         "compile_bound_ok":
             stats["cache"]["misses"] <= server.grid.grid_bound(),
     }
+    if args.warm_start:
+        j.set_phase("serving_bench_warm_start")
+        doc["warm_start"] = _warm_start_ab(args)
     if args.out:
         with atomic_write(args.out, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
@@ -177,6 +180,68 @@ def cmd_bench(args) -> int:
     _emit(doc)
     j.mark_clean()
     return 0
+
+
+def _warm_start_ab(args) -> dict:
+    """Cold-vs-warm startup A/B on a fresh AOT cache dir: phase 1
+    builds + starts + prewarms a server against an EMPTY store (pays
+    the compiles, writes through), phase 2 repeats on the SAME store
+    (loads).  Startup ms covers construct → start (incl. prewarm) →
+    first response — the operator-visible restart cost; the compile/
+    load split comes from ``observability.compile_stats()`` deltas."""
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ..observability import compile_stats, reset_metrics
+    from .aotcache import AOTCache
+    from .server import Server, ServerConfig
+
+    aot_dir = tempfile.mkdtemp(prefix="mxtpu-aot-ab-")
+    probe = AOTCache.maybe(aot_dir)
+    if probe is None or probe.mode != "rw":
+        # the kill switch / ro mode makes the A/B meaningless — report
+        # that instead of KeyError-ing mid-phase or measuring a no-op
+        shutil.rmtree(aot_dir, ignore_errors=True)
+        return {"disabled": True,
+                "reason": "MXNET_TPU_AOT_CACHE="
+                          f"{os.environ.get('MXNET_TPU_AOT_CACHE')!r} "
+                          "(warm-start A/B needs a writable cache)"}
+    x = np.ones(args.dim, dtype=np.float32)
+
+    def phase():
+        reset_metrics()
+        t0 = time.perf_counter()
+        net = _build_model(args.dim)
+        cfg = ServerConfig(max_batch=args.max_batch,
+                           window_ms=args.window_ms,
+                           default_deadline_ms=args.deadline_ms,
+                           aot_dir=aot_dir,
+                           aot_prewarm=((args.dim,),))
+        server = Server(net, config=cfg).start()
+        server.predict(x)
+        ms = round((time.perf_counter() - t0) * 1000.0, 2)
+        cs = compile_stats()
+        aot = server.stats()["aot"]
+        server.stop(timeout_s=30)
+        return {"startup_ms": ms, "compiles": cs["compiles"],
+                "aot_loads": cs["aot_loads"],
+                "aot_load_ms": cs["aot_load_ms"], "cache": aot}
+
+    try:
+        cold = phase()
+        warm = phase()
+    finally:
+        shutil.rmtree(aot_dir, ignore_errors=True)
+    out = {"cold": cold, "warm": warm,
+           "cold_startup_ms": cold["startup_ms"],
+           "warm_startup_ms": warm["startup_ms"],
+           "warm_zero_compiles": warm["compiles"] == 0}
+    if warm["startup_ms"]:
+        out["speedup"] = round(cold["startup_ms"] / warm["startup_ms"], 2)
+    return out
 
 
 TENANT_METRIC = "serving_tenant_requests_per_sec"
@@ -414,6 +479,81 @@ def _bench_pool(args) -> int:
     return 0
 
 
+WARM_METRIC = "aot_warm_entries"
+
+
+def _parse_shapes(spec: str) -> tuple:
+    """``"16"`` / ``"8x128,8x256"`` → feature shapes (no batch axis)."""
+    shapes = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            shapes.append(tuple(int(d) for d in part.split("x")))
+        except ValueError:
+            raise ValueError(f"bad --shapes entry {part!r}: expected "
+                             "comma-separated DxDx... ints") from None
+    if not shapes:
+        raise ValueError(f"--shapes {spec!r} names no shapes")
+    return tuple(shapes)
+
+
+def cmd_warm(args) -> int:
+    """``warm --dir ROOT``: offline prewarm — compile + persist a
+    model's bucket lattice ahead of deploy, so the FIRST serving start
+    on that cache dir is already warm.  Emits one JSON line (entry
+    counts, loaded/compiled split, directory audit) and exits 0 on a
+    fully-warmed lattice."""
+    from ..diagnostics import get_journal
+    from . import aot_report
+    from .server import Server, ServerConfig
+    from .worker import _build_block
+
+    j = get_journal()
+    j.install_handlers(final_cb=lambda: _emit(_diagnostic(
+        "warm_killed", f"killed at phase {j.last_phase!r}")))
+    j.set_phase("aot_warm_setup")
+    shapes = _parse_shapes(args.shapes if args.shapes is not None
+                           else str(args.dim))
+    net = _build_block(args.model, args.dim)
+    cfg = ServerConfig(max_batch=args.max_batch, aot_dir=args.dir)
+    server = Server(net, config=cfg)     # never started: no worker, no
+    # fail BEFORE the lattice compile: warming with the cache switched
+    # off (or read-only) would pay every compile and persist nothing —
+    # a deploy that trusts the exit code would then start cold
+    if server.aot is None or server.aot.mode != "rw":
+        mode = None if server.aot is None else server.aot.mode
+        _emit(_diagnostic(
+            "aot_cache_not_writable",
+            f"MXNET_TPU_AOT_CACHE mode {mode!r} — `warm` needs a "
+            "writable cache; nothing would be persisted"))
+        j.mark_clean()
+        return 1
+    j.set_phase("aot_warm_run")          # traffic — just the lattice
+    res = server.prewarm(shapes)
+    j.set_phase("aot_warm_report")
+    aot_stats = server.aot.stats()
+    doc = {"metric": WARM_METRIC,
+           "value": res["warmed"],
+           "unit": f"entries (model={args.model}, dim={args.dim}, "
+                   f"shapes={[list(s) for s in shapes]})",
+           **res,
+           "aot": aot_stats,
+           "dir_report": aot_report.aot_report(args.dir)}
+    _emit(doc)
+    j.mark_clean()
+    # the exit code is the deploy gate: a backend that cannot serialize
+    # its executables compiles the lattice but persists NOTHING
+    # (journaled aot_store_failed) — that must not read as warmed
+    if aot_stats["store_failures"] > 0:
+        print(f"warm: {aot_stats['store_failures']} store(s) failed — "
+              "the cache dir is NOT fully seeded (see aot_store_failed "
+              "journal records)", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.serving",
@@ -447,12 +587,34 @@ def main(argv=None) -> int:
                         "the assembled cross-process snapshot "
                         "(doctor --timeline body) under "
                         "'distributed_trace'")
+    b.add_argument("--warm-start", action="store_true",
+                   help="run a cold-vs-warm startup A/B on a fresh AOT "
+                        "cache dir after the closed loop and embed "
+                        "cold/warm startup ms + the zero-compile proof "
+                        "under 'warm_start' in the artifact "
+                        "(docs/serving.md AOT cache)")
     b.add_argument("--out", default=None,
                    help="artifact path ('' disables; default "
                         "BENCH_serving.json, BENCH_serving_pool.json "
                         "with --replicas > 1, or "
                         "BENCH_serving_tenants.json with --tenants)")
     b.set_defaults(fn=cmd_bench)
+    wm = sub.add_parser(
+        "warm", help="offline prewarm: compile + persist a model's "
+                     "bucket lattice into an AOT cache dir ahead of "
+                     "deploy; ONE JSON line on stdout (docs/serving.md)")
+    wm.add_argument("--dir", required=True,
+                    help="AOT cache root (MXNET_TPU_AOT_CACHE_DIR of "
+                         "the serving processes that should start warm)")
+    wm.add_argument("--model", default="mlp", help="scale|mlp (the "
+                    "worker model zoo; serving/worker.py)")
+    wm.add_argument("--dim", type=int, default=16)
+    wm.add_argument("--max-batch", type=int, default=8)
+    wm.add_argument("--shapes", default=None,
+                    help="comma-separated feature shapes to warm, each "
+                         "DxDx... (no batch axis; default the model "
+                         "--dim)")
+    wm.set_defaults(fn=cmd_warm)
     w = sub.add_parser("worker", help="replica worker process behind a "
                                       "loopback socket (serving/pool.py "
                                       "spawns these; docs/serving.md)")

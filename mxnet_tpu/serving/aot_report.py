@@ -1,0 +1,173 @@
+"""AOT-cache entry format + directory report — stdlib-only.
+
+One persisted executable per file under the cache root
+(``MXNET_TPU_AOT_CACHE_DIR``), committed atomically by
+``serving/aotcache.py``::
+
+    MAGIC(4) | u32 header_len | u32 header_crc32 | header_json | body
+
+The JSON header is the entry's CRC manifest: a ``format`` version, the
+compatibility ``envelope`` (jax/jaxlib versions, backend platform,
+device kind, local topology), the cache ``key`` (padded shape, dtype,
+param-tree structure fingerprint), and a ``sections`` list naming each
+body section with its byte length and CRC32.  A reader validates magic,
+bounds, header CRC, format, envelope, and every section CRC **before**
+any bytes reach a deserializer (graftlint G21's contract) — any failure
+degrades to a normal compile, never to wrong numerics.
+
+This module owns the byte-level read/validate half so the doctor
+(``python -m mxnet_tpu.diagnostics doctor --aot-dir DIR``) can audit a
+cache directory — entry/byte counts, envelope versions, stale and
+corrupt entries — without importing jax (the same wedged-backend
+contract as ``serving/report.py``); ``aotcache.py`` imports the format
+constants from here.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+
+__all__ = ["MAGIC", "FORMAT_VERSION", "SUFFIX", "aot_report",
+           "pack_entry", "read_entry", "validate_entry"]
+
+MAGIC = b"MXAO"
+FORMAT_VERSION = 1
+SUFFIX = ".aot"
+_FIXED = struct.Struct("<4sII")          # magic, header_len, header_crc
+_MAX_HEADER = 1 << 20                    # a sane header is a few KB
+
+
+def pack_entry(header: dict, sections: dict) -> bytes:
+    """Serialize one entry: ``sections`` (name -> bytes) are CRC'd into
+    the header manifest and concatenated in sorted-name order."""
+    manifest = []
+    body = b""
+    for name in sorted(sections):
+        data = sections[name]
+        manifest.append({"name": name, "len": len(data),
+                         "crc32": zlib.crc32(data) & 0xFFFFFFFF})
+        body += data
+    doc = dict(header)
+    doc["format"] = FORMAT_VERSION
+    doc["sections"] = manifest
+    hdr = json.dumps(doc, sort_keys=True).encode("utf-8")
+    return _FIXED.pack(MAGIC, len(hdr),
+                       zlib.crc32(hdr) & 0xFFFFFFFF) + hdr + body
+
+
+def read_entry(path: str):
+    """Validate + parse one entry file.  Returns ``(header, sections,
+    None)`` on success (``sections``: name -> bytes) or ``(None, None,
+    reason)`` — reason one of ``unreadable|truncated|magic|header_crc|
+    header_json|format|section_len|section_crc``.  Every length is
+    bounds-checked and every CRC verified before a byte is returned, so
+    callers may hand sections straight to a deserializer."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return None, None, "unreadable"
+    if len(raw) < _FIXED.size:
+        return None, None, "truncated"
+    magic, hlen, hcrc = _FIXED.unpack_from(raw)
+    if magic != MAGIC:
+        return None, None, "magic"
+    if hlen > _MAX_HEADER or len(raw) < _FIXED.size + hlen:
+        return None, None, "truncated"
+    hdr = raw[_FIXED.size:_FIXED.size + hlen]
+    if (zlib.crc32(hdr) & 0xFFFFFFFF) != hcrc:
+        return None, None, "header_crc"
+    try:
+        header = json.loads(hdr.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None, None, "header_json"
+    if not isinstance(header, dict) or \
+            header.get("format") != FORMAT_VERSION:
+        return None, None, "format"
+    sections = {}
+    off = _FIXED.size + hlen
+    for sec in header.get("sections") or ():
+        if not isinstance(sec, dict):
+            return None, None, "header_json"
+        try:
+            n = int(sec["len"])
+            crc = int(sec["crc32"])
+            name = str(sec["name"])
+        except (KeyError, TypeError, ValueError):
+            return None, None, "header_json"
+        if n < 0 or off + n > len(raw):
+            return None, None, "section_len"
+        data = raw[off:off + n]
+        if (zlib.crc32(data) & 0xFFFFFFFF) != crc:
+            return None, None, "section_crc"
+        sections[name] = data
+        off += n
+    return header, sections, None
+
+
+def validate_entry(path: str):
+    """``read_entry`` without keeping the bytes: ``(header, None)`` or
+    ``(None, reason)`` — the doctor's audit primitive."""
+    header, _sections, reason = read_entry(path)
+    return header, reason
+
+
+def _iter_entries(dirpath):
+    try:
+        names = os.listdir(dirpath)
+    except OSError as e:
+        return None, f"cannot read {dirpath}: {e.strerror or e}"
+    return sorted(n for n in names if n.endswith(SUFFIX)), None
+
+
+def aot_report(dirpath) -> dict:
+    """Audit one cache directory: entry/byte counts, the envelope
+    version histogram, corrupt entries by reason, and how many entries
+    are stale relative to the NEWEST entry's envelope (a partial
+    upgrade leaves old-envelope entries behind; they are never loaded,
+    only GC'd).  Always returns a dict; ``ok`` False + ``error`` when
+    the directory is unreadable or empty."""
+    names, err = _iter_entries(dirpath)
+    if names is None:
+        return {"ok": False, "dir": str(dirpath), "error": err}
+    entries = []
+    corrupt: dict = {}
+    total_bytes = 0
+    for name in names:
+        path = os.path.join(dirpath, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        total_bytes += st.st_size
+        header, reason = validate_entry(path)
+        if header is None:
+            corrupt[reason] = corrupt.get(reason, 0) + 1
+            continue
+        entries.append({"name": name, "bytes": st.st_size,
+                        "mtime": st.st_mtime,
+                        "envelope": header.get("envelope") or {},
+                        "key": header.get("key") or {}})
+    if not names:
+        return {"ok": False, "dir": str(dirpath),
+                "error": "no cache entries"}
+    envelopes: dict = {}
+    for e in entries:
+        tag = json.dumps(e["envelope"], sort_keys=True)
+        envelopes[tag] = envelopes.get(tag, 0) + 1
+    stale = 0
+    if entries:
+        newest = max(entries, key=lambda e: e["mtime"])
+        current = json.dumps(newest["envelope"], sort_keys=True)
+        stale = sum(1 for e in entries
+                    if json.dumps(e["envelope"], sort_keys=True) != current)
+    return {"ok": True, "dir": str(dirpath),
+            "entries": len(entries),
+            "bytes": total_bytes,
+            "corrupt": corrupt,
+            "corrupt_total": sum(corrupt.values()),
+            "stale": stale,
+            "envelopes": envelopes,
+            "keys": [e["key"] for e in entries]}

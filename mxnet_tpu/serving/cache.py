@@ -27,6 +27,24 @@ from ..gluon.block import functional_apply
 
 __all__ = ["CompiledPredictor", "PredictorCache"]
 
+_key_spec_memo = None
+
+
+def key_spec():
+    """Abstract (shape, dtype) of one serving PRNG key, computed ONCE
+    per process (the first call consumes a single global-stream key).
+    Both the AOT arg signature and the cache fingerprint read it, so a
+    cold start and a warm start advance the global PRNG stream by the
+    same amount — a per-operation ``next_key()`` here would skew the
+    stream cold-vs-warm and cost a backend dial per cache lookup.  The
+    impl (and so the dtype) is fixed per process by ``MXNET_PRNG_IMPL``;
+    a mid-process reseed keeps it."""
+    global _key_spec_memo
+    if _key_spec_memo is None:
+        k = _rng.next_key()
+        _key_spec_memo = jax.ShapeDtypeStruct(k.shape, k.dtype)
+    return _key_spec_memo
+
 
 class CompiledPredictor:
     """One jitted inference program at one padded shape.
@@ -35,12 +53,22 @@ class CompiledPredictor:
     arrays (so a between-batches hot-reload is picked up with no
     recompile), threads a fresh PRNG key, and returns the flat tuple of
     output device arrays plus the traced output treedef.
+
+    Two dispatch paths share one calling convention: the lazy
+    ``jax.jit`` closure (compiles at first call — the historical path)
+    and an ahead-of-time ``jax.stages.Compiled`` executable installed by
+    :meth:`aot_compile` (an eager lower+compile) or
+    :meth:`from_serialized` (a deserialized on-disk executable,
+    ``serving/aotcache.py``).  Parameters stay runtime arguments on both
+    paths, so the zero-retrace hot-reload contract is unchanged.
     """
 
     def __init__(self, block, ctx=None):
         self._block = block
         self._ctx = ctx
         self._treedef = None
+        self._compiled = None          # AOT executable when present
+        self.aot = None                # None | "compiled" | "loaded"
 
         def fn(key, tr_datas, aux_datas, x):
             outs, treedef, _aux_new = functional_apply(
@@ -53,12 +81,80 @@ class CompiledPredictor:
 
         self._jitted = jax.jit(fn)
 
-    def __call__(self, x_padded):
+    def _runtime_args(self):
         trainable, aux = self._block._param_split()
-        tr_datas = [p._data[0]._data for p in trainable]
-        aux_datas = [p._data[0]._data for p in aux]
-        outs = self._jitted(_rng.next_key(), tr_datas, aux_datas, x_padded)
+        return ([p._data[0]._data for p in trainable],
+                [p._data[0]._data for p in aux])
+
+    @property
+    def ready(self) -> bool:
+        """True once an executable exists — a first call will NOT pay
+        an XLA compile (the server's compile-span gate reads this)."""
+        return self._compiled is not None
+
+    def __call__(self, x_padded):
+        tr_datas, aux_datas = self._runtime_args()
+        fn = self._compiled if self._compiled is not None else self._jitted
+        outs = fn(_rng.next_key(), tr_datas, aux_datas, x_padded)
         return outs, self._treedef
+
+    # -- ahead-of-time path (serving/aotcache.py) ---------------------------
+    def _arg_specs(self, x_shape, x_dtype):
+        """Abstract arg signature of one padded-shape call: (key,
+        trainable arrays, aux arrays, x) as ShapeDtypeStructs matching
+        what ``__call__`` passes at runtime.  The key spec comes from
+        the process-memoized :func:`key_spec` so its (impl-dependent)
+        dtype is exact without consuming a stream key per build."""
+        tr_datas, aux_datas = self._runtime_args()
+
+        def spec(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        return (key_spec(), [spec(a) for a in tr_datas],
+                [spec(a) for a in aux_datas],
+                jax.ShapeDtypeStruct(tuple(x_shape), x_dtype))
+
+    def aot_compile(self, x_shape, x_dtype) -> "CompiledPredictor":
+        """Lower + compile at the padded shape ahead of the first call
+        (tracing captures the output treedef as a side effect).  The
+        resulting executable is bit-identical to what the lazy path
+        would build — and is what :meth:`serialize_aot` persists."""
+        lowered = self._jitted.lower(*self._arg_specs(x_shape, x_dtype))
+        self._compiled = lowered.compile()
+        self.aot = "compiled"
+        return self
+
+    def serialize_aot(self):
+        """(executable payload bytes, pytree blob bytes) for the disk
+        store.  Raises when the backend's compilation does not support
+        serialization — the cache degrades to memory-only."""
+        import pickle
+
+        from jax.experimental import serialize_executable as _se
+        if self._compiled is None:
+            raise ValueError("predictor has no AOT executable to "
+                             "serialize (call aot_compile first)")
+        payload, in_tree, out_tree = _se.serialize(self._compiled)
+        trees = pickle.dumps((in_tree, out_tree, self._treedef))
+        return payload, trees
+
+    @classmethod
+    def from_serialized(cls, block, payload, trees, ctx=None,
+                        backend=None):
+        """Rebuild a predictor from persisted bytes WITHOUT tracing or
+        compiling.  ``payload``/``trees`` must already be CRC- and
+        envelope-validated by the caller (serving/aotcache.py is the one
+        read path; graftlint G21 enforces the discipline)."""
+        import pickle
+
+        from jax.experimental import serialize_executable as _se
+        obj = cls(block, ctx=ctx)
+        in_tree, out_tree, treedef = pickle.loads(trees)
+        obj._compiled = _se.deserialize_and_load(
+            payload, in_tree, out_tree, backend=backend)
+        obj._treedef = treedef
+        obj.aot = "loaded"
+        return obj
 
 
 class PredictorCache:

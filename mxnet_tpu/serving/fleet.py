@@ -54,7 +54,6 @@ from ..metric import LatencySummary
 from ..observability import trace as _trace
 from ..resilience import atomic as _atomic
 from .batcher import RequestError, ServerOverloaded
-from .cache import CompiledPredictor
 from .reload import ParamStore
 from .server import (Server, ServerConfig, _end_span, _env_float,
                      _env_int)
@@ -160,6 +159,9 @@ class TenantState:
         self.last_reload_check = None
         self.bucket = _TokenBucket(slo.rate_rps, slo.burst)
         self.latency = LatencySummary(f"tenant_{name}_ms")
+        # padded shapes this tenant served while hot, LRU order — the
+        # page-in executable-restore set (bounded: grid cells, capped)
+        self.warm_shapes: "OrderedDict[tuple, bool]" = OrderedDict()
         # breaker
         self.state = ADMITTED
         self.failures = 0
@@ -467,10 +469,21 @@ class Fleet(Server):
             ts = self.tenants.get(tenant)
             if ts is None or ts.removed:
                 raise RequestError(f"tenant {tenant!r} removed")
+            # remember the shape for the page-in executable restore
+            # (LRU, capped at this tenant's SHARE of the predictor
+            # cache — restoring a full cache_entries worth would evict
+            # every other hot tenant's executables on one page-in)
+            ts.warm_shapes[(bucket, key)] = True
+            ts.warm_shapes.move_to_end((bucket, key))
+            share = max(1, self.config.cache_entries
+                        // max(self.config.max_hot_tenants, 1))
+            while len(ts.warm_shapes) > share:
+                ts.warm_shapes.popitem(last=False)
         block = self._page_in(ts)
         cache_key = (tenant, bucket, key, self._dtype.str)
         return self.cache.get(
-            cache_key, lambda: CompiledPredictor(block, ctx=self._ctx))
+            cache_key,
+            lambda: self._build_predictor(block, bucket, key))
 
     def _page_in(self, ts):
         """Device-residency for one tenant (worker thread only): hot
@@ -516,11 +529,43 @@ class Fleet(Server):
         for cold in doomed:
             self._page_out(cold)
         self._reload_tenant(ts, force=True)    # newest valid step now
+        cost_ms = round((time.perf_counter() - t0) * 1000.0, 2)
+        # executable restore rides the AOT disk tier: the shapes this
+        # tenant served while hot reload in milliseconds instead of
+        # recompiling on its first post-page-in batches.  Timed
+        # SEPARATELY from cost_ms (the weight-restore cost) so neither
+        # masquerades as the other in the paging ledger.
+        restored, restore_ms = self._restore_predictors(ts, block)
         get_journal().event(
-            "tenant_page_in", tenant=ts.name,
-            cost_ms=round((time.perf_counter() - t0) * 1000.0, 2),
+            "tenant_page_in", tenant=ts.name, cost_ms=cost_ms,
+            predictors_restored=restored, restore_ms=restore_ms,
             evicted=[c.name for c in doomed], hot=hot_now)
         return block
+
+    def _restore_predictors(self, ts, block):
+        """Reload this tenant's warm-shape executables from the AOT
+        disk cache (worker thread, outside ``_tlock``).  Strictly
+        LOAD-only: a disk miss (entry GC'd, store failed, ro store
+        never seeded) is skipped, never compiled — proactively
+        recompiling shapes that may not recur would turn paging into a
+        compile storm that stalls every tenant's batches.  Without the
+        disk tier this is a no-op for the same reason."""
+        if self.aot is None:
+            return 0, 0.0
+        with self._tlock:
+            shapes = list(ts.warm_shapes)
+        t0 = time.perf_counter()
+        restored = 0
+        for bucket, key in shapes:
+            pred = self.aot.load(block, (bucket,) + key, self._dtype,
+                                 ctx=self._ctx)
+            if pred is None:
+                continue               # cold disk: first batch compiles
+            _entry, hit = self.cache.get(
+                (ts.name, bucket, key, self._dtype.str), lambda: pred)
+            if not hit:
+                restored += 1
+        return restored, round((time.perf_counter() - t0) * 1000.0, 2)
 
     def _page_out(self, ts):
         """Snapshot parameters to host RAM, release the device block,
@@ -663,6 +708,51 @@ class Fleet(Server):
             self._check_reloadable(loaded)
         finally:
             self.block = saved_block
+
+    # -- bucket-lattice prewarm (per tenant) -------------------------------
+    def prewarm(self, shapes=None, tenants=None) -> dict:
+        """Fleet prewarm: page in up to ``max_hot_tenants`` tenants
+        (``tenants`` names them; default registration order) and build
+        each one's batch-bucket × feature-shape lattice — disk loads
+        when the AOT cache has the entries, compiles otherwise.  Runs
+        on the caller's thread before the worker starts (the
+        ``Server.start`` hook) or between batches."""
+        shapes = shapes if shapes is not None else self.config.aot_prewarm
+        t0 = time.perf_counter()
+        with self._tlock:
+            names = [str(n) for n in tenants] if tenants is not None \
+                else list(self.tenants)
+            names = names[:max(self.config.max_hot_tenants, 1)]
+        warmed = loaded = compiled = 0
+        skipped = []
+        for name in names:
+            with self._tlock:
+                ts = self.tenants.get(name)
+                if ts is None or ts.removed:
+                    continue
+            block = self._page_in(ts)
+            for shape in shapes or ():
+                key = self.grid.feature_key(tuple(shape))
+                if key is None:
+                    skipped.append(list(shape))
+                    continue
+                for bucket in self.grid.batch_buckets:
+                    entry, hit = self.cache.get(
+                        (name, bucket, key, self._dtype.str),
+                        lambda b=bucket, k=key:
+                            self._build_ready_predictor(block, b, k))
+                    if hit:
+                        continue
+                    warmed += 1
+                    if entry.aot == "loaded":
+                        loaded += 1
+                    else:
+                        compiled += 1
+        out = {"warmed": warmed, "loaded": loaded, "compiled": compiled,
+               "skipped": skipped, "tenants": names,
+               "ms": round((time.perf_counter() - t0) * 1000.0, 2)}
+        get_journal().event("aot_prewarm", **out)
+        return out
 
     # -- reporting ---------------------------------------------------------
     def tenant_stats(self) -> dict:

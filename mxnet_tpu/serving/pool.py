@@ -101,6 +101,12 @@ class PoolConfig:
     # cross-process Perfetto trace (docs/observability.md)
     trace_dir: object = field(default_factory=lambda: os.environ.get(
         "MXNET_TPU_TRACE_DIR") or None)
+    # shared AOT executable-cache root (serving/aotcache.py): every
+    # subprocess worker inherits it, so a rolling reload(surge=k)'s
+    # fresh workers LOAD their bucket lattice from disk instead of
+    # recompiling it under live traffic — the zero-cold-start restart
+    aot_dir: object = field(default_factory=lambda: os.environ.get(
+        "MXNET_TPU_AOT_CACHE_DIR") or None)
 
     def __post_init__(self):
         if self.deadline_s <= self.heartbeat_s:
@@ -471,6 +477,11 @@ class ReplicaPool:
         env = dict(os.environ if env is None else env)
         env.setdefault("MXNET_TPU_POD_RUN_ID", self.run_id)
         env["MXNET_TPU_REPLICA_ID"] = rid
+        if self.cfg.aot_dir:
+            # forced over ambient: the POOL's cache root is the warm-
+            # restart contract — a respawned/rolled worker must land on
+            # the same store its predecessor populated
+            env["MXNET_TPU_AOT_CACHE_DIR"] = str(self.cfg.aot_dir)
         trace_dir = self.cfg.trace_dir
         if trace_dir:
             os.makedirs(trace_dir, exist_ok=True)

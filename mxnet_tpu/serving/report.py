@@ -29,7 +29,13 @@ _KINDS = ("serving_start", "serving_stop", "serving_batch", "serving_shed",
           "router_budget_exhausted",
           # the tenant-fleet tier (serving/fleet.py)
           "tenant_add", "tenant_remove", "tenant_quarantine",
-          "tenant_page_in", "tenant_page_out")
+          "tenant_page_in", "tenant_page_out",
+          # the persistent AOT executable cache (serving/aotcache.py)
+          "aot_store", "aot_store_failed", "aot_fallback",
+          "aot_prewarm", "aot_gc")
+
+_AOT_KINDS = ("aot_store", "aot_store_failed", "aot_fallback",
+              "aot_prewarm", "aot_gc")
 
 _TENANT_KINDS = ("tenant_add", "tenant_remove", "tenant_quarantine",
                  "tenant_page_in", "tenant_page_out")
@@ -164,7 +170,40 @@ def serving_report(path) -> dict:
     tenants = _tenant_section(records)
     if tenants is not None:
         out["tenants"] = tenants
+    aot = _aot_section(records)
+    if aot is not None:
+        out["aot"] = aot
     return out
+
+
+def _aot_section(records) -> dict | None:
+    """AOT-cache reduction of the last run: stores, fallbacks by
+    reason (the corrupt/stale/truncated ledger), prewarm loaded-vs-
+    compiled split, and GC evictions — the warm-start story one journal
+    tells (docs/serving.md AOT cache)."""
+    aot = [r for r in records if r["kind"] in _AOT_KINDS]
+    if not aot:
+        return None
+    fallbacks: dict = {}
+    for r in aot:
+        if r["kind"] == "aot_fallback":
+            reason = str(r.get("reason", "unknown"))
+            fallbacks[reason] = fallbacks.get(reason, 0) + 1
+    prewarms = [r for r in aot if r["kind"] == "aot_prewarm"]
+    return {
+        "stores": sum(1 for r in aot if r["kind"] == "aot_store"),
+        "store_failures": sum(1 for r in aot
+                              if r["kind"] == "aot_store_failed"),
+        "fallbacks": fallbacks,
+        "fallback_total": sum(fallbacks.values()),
+        "prewarmed": {
+            "loaded": sum(int(r.get("loaded", 0)) for r in prewarms),
+            "compiled": sum(int(r.get("compiled", 0)) for r in prewarms),
+            "ms": round(sum(float(r.get("ms", 0.0)) for r in prewarms),
+                        2)},
+        "gc_evicted": sum(int(r.get("evicted", 0)) for r in aot
+                          if r["kind"] == "aot_gc"),
+    }
 
 
 def _tenant_section(records) -> dict | None:

@@ -92,6 +92,11 @@ class ServerConfig:
         "MXNET_TPU_SERVING_CACHE", 16))
     reload_poll_s: float = field(default_factory=lambda: _env_float(
         "MXNET_TPU_SERVING_RELOAD_S", 10.0))
+    # persistent AOT executable cache (serving/aotcache.py): a restart
+    # on the same dir loads executables instead of compiling them
+    aot_dir: str | None = field(default_factory=lambda: os.environ.get(
+        "MXNET_TPU_AOT_CACHE_DIR") or None)
+    aot_prewarm: tuple | None = None         # feature shapes warmed at start
     idle_poll_s: float = 0.05                # worker wake granularity
     dtype: str = "float32"                   # request payload dtype
     pad_value: float = 0.0
@@ -105,7 +110,8 @@ class ServerConfig:
                 "window_ms": self.window_ms,
                 "default_deadline_ms": self.default_deadline_ms,
                 "cache_entries": self.cache_entries,
-                "reload_poll_s": self.reload_poll_s, "dtype": self.dtype}
+                "reload_poll_s": self.reload_poll_s, "dtype": self.dtype,
+                "aot_dir": self.aot_dir}
 
 
 class Server:
@@ -124,6 +130,12 @@ class Server:
         self.grid = BucketGrid(cfg.max_batch, cfg.batch_buckets,
                                cfg.dim_buckets)
         self.cache = PredictorCache(cfg.cache_entries)
+        # the disk tier behind the LRU: None unless configured (env or
+        # config) and not switched off — docs/serving.md AOT cache
+        self.aot = None
+        if cfg.aot_dir:
+            from .aotcache import AOTCache
+            self.aot = AOTCache.maybe(cfg.aot_dir)
         self.param_store = param_store
         self.latency = LatencySummary("request_latency_ms")
         self._ctx = ctx
@@ -178,6 +190,8 @@ class Server:
         get_journal().event("serving_start", config=self.config.summary(),
                             grid=repr(self.grid))
         self._maybe_reload(force=True)     # begin on the newest valid step
+        if self.config.aot_prewarm:
+            self.prewarm()                 # warm the lattice pre-traffic
         self._worker = threading.Thread(
             target=self._run, name="mxtpu-serving-worker", daemon=True)
         self._worker.start()
@@ -359,17 +373,57 @@ class Server:
         replica pool's drain-wait and readiness beacon read it."""
         return self._queue.qsize()
 
+    # -- bucket-lattice prewarm (docs/serving.md AOT cache) ------------------
+    def prewarm(self, shapes=None) -> dict:
+        """Build (load-or-compile) the predictor for every batch bucket
+        × feature shape ahead of traffic.  ``shapes``: per-request
+        feature shapes (NO batch axis; default ``config.aot_prewarm``).
+        With the AOT cache configured this is the warm-restart path —
+        the second start on the same dir performs zero XLA compiles;
+        without it, it simply front-loads the compiles.  Returns
+        ``{warmed, loaded, compiled, skipped, ms}`` and journals an
+        ``aot_prewarm`` record."""
+        shapes = shapes if shapes is not None else self.config.aot_prewarm
+        t0 = time.perf_counter()
+        warmed = loaded = compiled = 0
+        skipped = []
+        for shape in shapes or ():
+            key = self.grid.feature_key(tuple(shape))
+            if key is None:
+                skipped.append(list(shape))    # outside the grid
+                continue
+            for bucket in self.grid.batch_buckets:
+                entry, hit = self.cache.get(
+                    (bucket, key, self._dtype.str),
+                    lambda b=bucket, k=key:
+                        self._build_ready_predictor(self.block, b, k))
+                if hit:
+                    continue
+                warmed += 1
+                if entry.aot == "loaded":
+                    loaded += 1
+                else:
+                    compiled += 1
+        out = {"warmed": warmed, "loaded": loaded, "compiled": compiled,
+               "skipped": skipped,
+               "ms": round((time.perf_counter() - t0) * 1000.0, 2)}
+        get_journal().event("aot_prewarm", **out)
+        return out
+
     def stats(self) -> dict:
         with self._lock:
             counters = dict(self.counters)
         t = self._last_batch_t
-        return {"queue_depth": self.queue_depth(),
-                "params_step": self._params_step,
-                "last_batch_age_s": None if t is None
-                else round(time.monotonic() - t, 3),
-                "cache": self.cache.stats(),
-                "latency_ms": self.latency.summary(),
-                **counters}
+        out = {"queue_depth": self.queue_depth(),
+               "params_step": self._params_step,
+               "last_batch_age_s": None if t is None
+               else round(time.monotonic() - t, 3),
+               "cache": self.cache.stats(),
+               "latency_ms": self.latency.summary(),
+               **counters}
+        if self.aot is not None:
+            out["aot"] = self.aot.stats()
+        return out
 
     def beacon(self) -> dict:
         """Cheap readiness facts for a replica-pool heartbeat payload
@@ -577,6 +631,33 @@ class Server:
             self._process_traced(batch, bucket, key, n, cfg, bsp)
 
     # -- predictor hooks (overridden by serving/fleet.py) --------------------
+    def _build_predictor(self, block, bucket, key):
+        """One predictor for one padded shape: disk-first when the AOT
+        cache is configured (a valid entry loads with zero compiles —
+        ``aot_load`` span; a miss compiles eagerly and writes through),
+        else the historical lazy-jit closure (compiles at first call)."""
+        if self.aot is not None:
+            return self.aot.load_or_compile(
+                block, (bucket,) + key, self._dtype, ctx=self._ctx)
+        return CompiledPredictor(block, ctx=self._ctx)
+
+    def _build_ready_predictor(self, block, bucket, key):
+        """The prewarm builder: ALWAYS returns a ready (AOT-compiled or
+        disk-loaded) predictor.  A lazy closure here would poison the
+        accounting twice over — prewarm would report a warm lattice it
+        never built, and the first real request would find a cache hit
+        whose untimed first-call compile hides inside the batch's
+        ``exec_ms``."""
+        if self.aot is not None:
+            return self.aot.load_or_compile(
+                block, (bucket,) + key, self._dtype, ctx=self._ctx)
+        pred = CompiledPredictor(block, ctx=self._ctx)
+        with _obs.compile_span("serving_predictor",
+                               shape=[bucket, *key],
+                               dtype=self._dtype.str, aot=True):
+            pred.aot_compile((bucket,) + key, self._dtype)
+        return pred
+
     def _acquire_predictor(self, batch, bucket, key):
         """Return ``(predictor, hit)`` for this batch.  The fleet
         overrides with per-tenant executables + weight paging (a cold
@@ -584,7 +665,8 @@ class Server:
         the timed execute window, journaled ``tenant_page_in``)."""
         cache_key = (bucket, key, self._dtype.str)
         return self.cache.get(
-            cache_key, lambda: CompiledPredictor(self.block, ctx=self._ctx))
+            cache_key,
+            lambda: self._build_predictor(self.block, bucket, key))
 
     def _trip_sites(self, batch):
         """Chaos seams consulted per predictor call:
@@ -629,13 +711,17 @@ class Server:
         t0 = time.perf_counter()
         try:
             # a cache miss's first call traces + compiles the padded
-            # shape: the timed compile event for this jit-miss site
+            # shape: the timed compile event for this jit-miss site.
+            # An AOT-built predictor (loaded OR eagerly compiled in the
+            # builder, which timed itself) is already `ready` — its
+            # first call includes no compile, so no span here
             def _run_predictor(p):
                 self._trip_sites(batch)
                 return predictor(p)
 
             with _obs.maybe_compile_span(
-                    not hit, "serving_predictor", bucket=bucket,
+                    not hit and not predictor.ready,
+                    "serving_predictor", bucket=bucket,
                     key=list(key), dtype=self._dtype.str,
                     includes_execute=True):
                 outs, treedef = retry_call(

@@ -267,6 +267,11 @@ def add_worker_args(parser) -> None:
     parser.add_argument("--max-queue", type=int, default=64)
     parser.add_argument("--deadline-ms", type=float, default=2000.0)
     parser.add_argument("--reload-poll-s", type=float, default=0.5)
+    parser.add_argument("--aot-dir", default=None,
+                        help="persistent AOT executable-cache root "
+                             "(default MXNET_TPU_AOT_CACHE_DIR — the "
+                             "pool stamps it into the worker env so "
+                             "restarts start warm; docs/serving.md)")
 
 
 def cmd_worker(args) -> int:
@@ -293,13 +298,17 @@ def cmd_worker(args) -> int:
         atomic.set_fault_hook(faults.FaultPlan(
             faults.slow_call("serving_predict", float(slow_s))))
 
+    # --aot-dir beats the inherited env; both default through the
+    # ServerConfig field (MXNET_TPU_AOT_CACHE_DIR)
+    aot_kw = {"aot_dir": args.aot_dir} if getattr(args, "aot_dir", None) \
+        else {}
     if getattr(args, "tenants", None):
         from .fleet import Fleet, FleetConfig
         cfg = FleetConfig(max_batch=args.max_batch,
                           window_ms=args.window_ms,
                           max_queue=args.max_queue,
                           default_deadline_ms=args.deadline_ms,
-                          reload_poll_s=args.reload_poll_s)
+                          reload_poll_s=args.reload_poll_s, **aot_kw)
         server = Fleet(config=cfg)
         for name, model, root in _parse_tenants(args.tenants):
             server.add_tenant(
@@ -313,7 +322,7 @@ def cmd_worker(args) -> int:
                            window_ms=args.window_ms,
                            max_queue=args.max_queue,
                            default_deadline_ms=args.deadline_ms,
-                           reload_poll_s=args.reload_poll_s)
+                           reload_poll_s=args.reload_poll_s, **aot_kw)
         store = ParamStore(args.ckpt_root) if args.ckpt_root else None
         server = Server(net, config=cfg, param_store=store).start()
 

@@ -1,0 +1,54 @@
+# graftlint: scope=library
+"""G21 fixture: unvalidated cache deserialize (read path without a
+CRC/version-envelope check).  Lines marked BAD must be flagged; GOOD
+lines must not.  The disable-twin documents the suppression syntax."""
+import pickle
+import zlib
+
+from jax.experimental import serialize_executable
+
+
+def bad_pickle_read(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)  # expect: G21
+
+
+def bad_executable_read(path, in_tree, out_tree):
+    with open(path, "rb") as f:
+        payload = f.read()
+    return serialize_executable.deserialize_and_load(  # expect: G21
+        payload, in_tree, out_tree)
+
+
+def bad_unpickler_read(path):
+    f = open(path, "rb")
+    return pickle.Unpickler(f).load()  # expect: G21
+
+
+def good_crc_checked_read(path, expect_crc):
+    with open(path, "rb") as f:
+        payload = f.read()
+    if zlib.crc32(payload) != expect_crc:           # GOOD: CRC evidence
+        raise ValueError("torn cache entry")
+    return pickle.loads(payload)
+
+
+def good_envelope_checked_read(path, current_envelope):
+    with open(path, "rb") as f:
+        blob = f.read()
+    envelope, body = blob[:64], blob[64:]           # GOOD: envelope token
+    if envelope != current_envelope:
+        raise ValueError("stale toolchain")
+    return pickle.loads(body)
+
+
+def good_caller_supplied(blob):
+    # GOOD: no file read here — whoever pulled these bytes off disk
+    # owns the validation (the aotcache.load -> from_serialized split)
+    return pickle.loads(blob)
+
+
+def disable_twin_read(path):
+    with open(path, "rb") as f:
+        # the entry below is length-framed by a checked container
+        return pickle.load(f)  # graftlint: disable=G21 container validated upstream
