@@ -96,6 +96,23 @@ python -m pytest tests/test_serving.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: warm-start smoke (persistent AOT cache) =="
 python -m pytest tests/test_aotcache.py -q -k smoke -p no:cacheprovider
 
+# sharded-serving smoke: the SAME weights served through a 2-device
+# tensor-parallel predictor and a plain single-device server answer
+# bit-identically (the default plan column-shards the output dim — no
+# cross-shard reduction), with the placement journaled shard_place
+# (docs/serving.md tensor-parallel predictors)
+echo "== tier 0.5: sharded-serving smoke (tensor-parallel bit parity) =="
+python -m pytest tests/test_serving_sharded.py -q -k smoke -p no:cacheprovider
+
+# decode smoke: a tensor-parallel server on a 2-device CPU mesh runs 8
+# concurrent autoregressive streams with staggered lengths through the
+# continuous batcher -> every stream bit-identical to the reference
+# within its deadline, ZERO XLA compiles outside the warmed program
+# set, and a cancelled stream frees its slot for a successor
+# (docs/serving.md continuous batching)
+echo "== tier 0.5: decode smoke (continuous batching, zero mid-run compiles) =="
+python -m pytest tests/test_decode.py -q -k smoke -p no:cacheprovider
+
 # tenant-fleet chaos smoke: tenant A fed a corrupt committed checkpoint
 # + oversized-shape flood + predictor poison while tenant B runs
 # closed-loop load on the SAME fleet -> B's p99 stays in its SLO bound

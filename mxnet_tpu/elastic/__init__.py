@@ -34,7 +34,7 @@ __all__ = ["BarrierTimeout", "Cohort", "CohortConfig", "CohortGroup",
            "LivenessReader", "RankLost", "allreduce_mean",
            "assemble_entries", "broadcast", "broadcast_json",
            "elastic_metadata", "elastic_report", "place_global",
-           "read_global_entries", "reshard_report"]
+           "place_named", "read_global_entries", "reshard_report"]
 
 _LAZY = {
     "BarrierTimeout": ("membership", "BarrierTimeout"),
@@ -52,6 +52,7 @@ _LAZY = {
     "elastic_metadata": ("driver", "elastic_metadata"),
     "assemble_entries": ("reshard", "assemble_entries"),
     "place_global": ("reshard", "place_global"),
+    "place_named": ("reshard", "place_named"),
     "read_global_entries": ("reshard", "read_global_entries"),
     "reshard_report": ("reshard", "reshard_report"),
     "elastic_report": ("report", "elastic_report"),

@@ -41,8 +41,8 @@ from ..base import MXNetError
 from ..diagnostics.journal import get_journal
 from ..parallel import _ckpt
 
-__all__ = ["assemble_entries", "place_global", "read_global_entries",
-           "reshard_report"]
+__all__ = ["assemble_entries", "place_global", "place_named",
+           "read_global_entries", "reshard_report"]
 
 
 def _parse_idx(ik):
@@ -142,6 +142,28 @@ def place_global(name, cur, host):
             "master_dtype mismatch")
     return jax.make_array_from_callback(cur.shape, cur.sharding,
                                         lambda idx: host[idx])
+
+
+def place_named(name, mesh, spec, host):
+    """Drop a full host array onto ``NamedSharding(mesh, spec)`` — the
+    INITIAL placement twin of :func:`place_global` (which needs a live
+    array to copy the sharding from).  Same contract: only this
+    process's addressable shards touch a device.  The serving shard
+    planner (serving/shardplan.py) uses this to land checkpoint weights
+    straight onto the serving mesh, exactly how elastic restore places
+    assembled entries."""
+    from jax.sharding import NamedSharding
+    host = np.asarray(host)
+    sharding = NamedSharding(mesh, spec)
+    try:
+        return jax.make_array_from_callback(host.shape, sharding,
+                                            lambda idx: host[idx])
+    except ValueError as e:
+        raise MXNetError(
+            f"reshard: entry {name!r} {host.dtype}{tuple(host.shape)} "
+            f"cannot be placed as {spec} on mesh "
+            f"{dict(zip(mesh.axis_names, mesh.devices.shape))}: {e}") \
+            from None
 
 
 def journal_reshard(root, step, meta, n_new, entries, consumer):

@@ -48,7 +48,24 @@ from . import _ckpt
 from .mesh import current_mesh
 
 __all__ = ["ShardedTrainer", "functional_apply",
-           "allreduce_across_processes"]
+           "allreduce_across_processes", "project_spec"]
+
+
+def project_spec(mesh, spec):
+    """A PartitionSpec projected onto ``mesh``: axis names the mesh
+    doesn't have degrade to replication on that dim.  A dim sharded over
+    SEVERAL axes — ``P(("data", "model"), None)`` — keeps exactly the
+    axes the mesh still has.  Shared by the trainer's survivor-mesh
+    rebuild and the serving shard planner (serving/shardplan.py)."""
+    out = []
+    for a in spec:
+        if isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if x in mesh.axis_names)
+            out.append(kept if len(kept) > 1
+                       else (kept[0] if kept else None))
+        else:
+            out.append(a if a is None or a in mesh.axis_names else None)
+    return PartitionSpec(*out)
 
 
 # ---------------------------------------------------------------------------
@@ -965,23 +982,9 @@ class ShardedTrainer(GuardedTrainerMixin):
     # a fresh trainer and pull the newest committed checkpoint back in
     # through the topology-free reader.
 
-    @staticmethod
-    def _spec_on(mesh, spec):
-        """A PartitionSpec projected onto ``mesh``: axis names the new
-        mesh doesn't have degrade to replication on that dim (the
-        survivor mesh may legitimately have dropped an axis). A dim
-        sharded over SEVERAL axes — ``P(("data", "model"), None)`` —
-        keeps exactly the axes the mesh still has."""
-        out = []
-        for a in spec:
-            if isinstance(a, (tuple, list)):
-                kept = tuple(x for x in a if x in mesh.axis_names)
-                out.append(kept if len(kept) > 1
-                           else (kept[0] if kept else None))
-            else:
-                out.append(a if a is None or a in mesh.axis_names
-                           else None)
-        return PartitionSpec(*out)
+    # module-level project_spec, kept as a method name because the
+    # elastic lanes (and their tests) reach it through the trainer
+    _spec_on = staticmethod(project_spec)
 
     def rebuild_mesh(self, mesh):
         """Re-place parameters, aux buffers, optimizer state and guard

@@ -18,6 +18,14 @@ around it (docs/serving.md):
   jax/jaxlib/backend envelope, so a restart, pool-worker respawn, or
   tenant page-in *loads* its bucket lattice instead of recompiling it
   (zero-cold-start; ``aot_report`` is the stdlib audit half);
+- :mod:`.shardplan` — the tensor-parallel serving plan: a
+  ``NamedSharding`` per parameter/activation derived from one axes
+  spec, so predictors compile GSPMD-partitioned and checkpoint shards
+  land on the serving mesh exactly as elastic restore would place them;
+- :mod:`.decode` — the continuous-batching decode engine beside the
+  one-shot batcher: a fixed slot pool, prefill/decode split, per-step
+  rebatching on a dedicated single-cell lattice (decode never compiles
+  outside it), per-sequence deadlines/cancellation;
 - :mod:`.server` — the worker loop: shed → coalesce → pad → execute →
   deadline-check, journaled per batch;
 - :mod:`.reload` — newest-valid-committed-step hot-reload over
@@ -52,20 +60,26 @@ from __future__ import annotations
 import importlib
 
 __all__ = ["AOTCache", "BucketGrid", "CompiledPredictor",
-           "DeadlineExceeded",
+           "DeadlineExceeded", "DecodeConfig", "DecodeEngine",
+           "DecodeModel", "DecodeStream",
            "Fleet", "FleetConfig", "LocalReplica", "ParamStore",
            "PendingResponse", "PoolConfig",
            "PredictorCache", "ProcReplica", "ReplicaPool",
            "ReplicaUnavailable", "RequestCancelled", "RequestError",
            "Router", "RouterConfig", "RouterResponse", "SLOClass",
            "Server", "ServerConfig", "ServerOverloaded", "ServerStopped",
-           "TenantQuarantined", "serving_report"]
+           "ShardPlan", "SlotsExhausted",
+           "TenantQuarantined", "TinyLM", "serving_report"]
 
 _LAZY = {
     "AOTCache": ("aotcache", "AOTCache"),
     "BucketGrid": ("buckets", "BucketGrid"),
     "CompiledPredictor": ("cache", "CompiledPredictor"),
     "DeadlineExceeded": ("batcher", "DeadlineExceeded"),
+    "DecodeConfig": ("decode", "DecodeConfig"),
+    "DecodeEngine": ("decode", "DecodeEngine"),
+    "DecodeModel": ("decode", "DecodeModel"),
+    "DecodeStream": ("decode", "DecodeStream"),
     "Fleet": ("fleet", "Fleet"),
     "FleetConfig": ("fleet", "FleetConfig"),
     "SLOClass": ("fleet", "SLOClass"),
@@ -87,6 +101,9 @@ _LAZY = {
     "ServerConfig": ("server", "ServerConfig"),
     "ServerOverloaded": ("batcher", "ServerOverloaded"),
     "ServerStopped": ("batcher", "ServerStopped"),
+    "ShardPlan": ("shardplan", "ShardPlan"),
+    "SlotsExhausted": ("batcher", "SlotsExhausted"),
+    "TinyLM": ("decode", "TinyLM"),
     "serving_report": ("report", "serving_report"),
 }
 

@@ -86,6 +86,8 @@ def cmd_bench(args) -> int:
     from ..resilience.atomic import atomic_write
     from .server import Server, ServerConfig
 
+    if args.decode > 0:
+        return _bench_decode(args)
     if args.tenants > 0:
         return _bench_tenants(args)
     if args.replicas > 1:
@@ -242,6 +244,129 @@ def _warm_start_ab(args) -> dict:
     if warm["startup_ms"]:
         out["speedup"] = round(cold["startup_ms"] / warm["startup_ms"], 2)
     return out
+
+
+DECODE_METRIC = "serving_decode_tokens_per_sec"
+
+
+def _bench_decode(args) -> int:
+    """--decode S: closed-loop autoregressive streams against one
+    Server's continuous batcher (S decode slots, ``--clients`` stream
+    generators, staggered prompt/generation lengths).  The artifact
+    (BENCH_serving_decode.json) carries tokens/s, the decode journal
+    reduction (steps/s, slot-occupancy histogram) and the zero-mid-run-
+    compile proof: after warmup, ``counters["compiles"]`` must not move
+    (docs/serving.md continuous batching)."""
+    import numpy as np   # noqa: F401  (parity with siblings)
+
+    from ..diagnostics import get_journal
+    from ..metric import LatencySummary
+    from ..resilience.atomic import atomic_write
+    from .batcher import (DeadlineExceeded, RequestError, ServerOverloaded,
+                          SlotsExhausted)
+    from .decode import DecodeConfig, TinyLM
+    from .server import Server, ServerConfig
+
+    j = get_journal()
+    j.install_handlers(final_cb=lambda: _emit(
+        {"metric": DECODE_METRIC, "value": None, "unit": "tok/s",
+         "error": "bench_killed",
+         "detail": f"killed at phase {j.last_phase!r}"}))
+    j.set_phase("serving_decode_bench_setup")
+    model = TinyLM()
+    cfg = ServerConfig(
+        max_batch=args.max_batch, max_queue=args.queue,
+        window_ms=args.window_ms,
+        default_deadline_ms=args.deadline_ms,
+        decode_model=model,
+        decode=DecodeConfig(slots=args.decode,
+                            default_deadline_ms=args.deadline_ms))
+    server = Server(_build_model(args.dim), config=cfg)
+    server.start()
+    compiles_at_ready = server.decoder.counters["compiles"]
+
+    stream_lat = LatencySummary("stream_latency_ms")
+    stop_at = time.monotonic() + args.seconds
+    ok = [0] * args.clients
+    toks = [0] * args.clients
+    shed = [0] * args.clients
+    missed = [0] * args.clients
+    errored = [0] * args.clients
+    corrupt = []
+
+    def client(idx):
+        import numpy as np
+        rng = np.random.default_rng(idx)
+        while time.monotonic() < stop_at:
+            # staggered lengths: prompts 1..16, generations 4..32
+            prompt = [int(t) for t in
+                      rng.integers(0, model.vocab,
+                                   size=int(rng.integers(1, 17)))]
+            n = int(rng.integers(4, 33))
+            t0 = time.perf_counter()
+            try:
+                got = server.decode(prompt, max_new_tokens=n)
+            except (ServerOverloaded, SlotsExhausted):
+                shed[idx] += 1
+                time.sleep(0.002)
+                continue
+            except DeadlineExceeded:
+                missed[idx] += 1
+                continue
+            except RequestError as e:
+                errored[idx] += 1
+                print(f"decode bench: client {idx}: {e}",
+                      file=sys.stderr)
+                time.sleep(0.01)
+                continue
+            if list(got) != model.reference(prompt, n):
+                corrupt.append(prompt)    # bit-exactness is the contract
+            stream_lat.observe((time.perf_counter() - t0) * 1000.0)
+            ok[idx] += 1
+            toks[idx] += len(got)
+
+    j.set_phase("serving_decode_bench_run")
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.seconds + 30)
+    elapsed = time.monotonic() - t_start
+    j.set_phase("serving_decode_bench_report")
+    dstats = server.decoder.stats()
+    server.stop(timeout_s=30)
+
+    total_tok = sum(toks)
+    doc = {
+        "metric": DECODE_METRIC,
+        "value": round(total_tok / elapsed, 2) if elapsed else None,
+        "unit": f"tok/s (slots={args.decode}, clients={args.clients})",
+        "elapsed_s": round(elapsed, 2),
+        "streams_completed": sum(ok),
+        "tokens_out": total_tok,
+        "client_shed": sum(shed),
+        "client_deadline_miss": sum(missed),
+        "client_errors": sum(errored),
+        "corrupt_streams": len(corrupt),
+        "stream_latency_ms": stream_lat.summary(),
+        "decode": dstats,
+        "compiles_after_warmup":
+            dstats["compiles"] - compiles_at_ready,
+        "compile_bound_ok": dstats["compiles"] == compiles_at_ready,
+    }
+    out = args.out or ""
+    if out:
+        with atomic_write(out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True, default=str)
+        print(f"decode bench: artifact written to {out}",
+              file=sys.stderr)
+    _emit(doc)
+    j.mark_clean()
+    # corrupt output or a mid-run compile is a failed bench, not a
+    # slower one — the exit code is the gate
+    return 0 if not corrupt and doc["compile_bound_ok"] else 1
 
 
 TENANT_METRIC = "serving_tenant_requests_per_sec"
@@ -577,6 +702,12 @@ def main(argv=None) -> int:
                         "against one Fleet of N tenants and writes the "
                         "BENCH_serving_tenants artifact (per-tenant "
                         "p99/shed/quarantine counters)")
+    b.add_argument("--decode", type=int, default=0,
+                   help="> 0 runs the closed loop as autoregressive "
+                        "decode streams against one Server's continuous "
+                        "batcher with N slots and writes the "
+                        "BENCH_serving_decode artifact (tokens/s, "
+                        "occupancy, zero-mid-run-compile proof)")
     b.add_argument("--hedge-ms", type=float, default=0.0,
                    help="tail-latency hedge delay for --replicas mode "
                         "(0 = off)")
@@ -623,7 +754,8 @@ def main(argv=None) -> int:
     w.set_defaults(fn=cmd_worker)
     args = ap.parse_args(argv)
     if getattr(args, "out", None) is None and args.cmd == "bench":
-        args.out = ("BENCH_serving_tenants.json" if args.tenants > 0
+        args.out = ("BENCH_serving_decode.json" if args.decode > 0
+                    else "BENCH_serving_tenants.json" if args.tenants > 0
                     else "BENCH_serving_pool.json" if args.replicas > 1
                     else "BENCH_serving.json")
     try:

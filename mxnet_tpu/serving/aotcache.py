@@ -135,13 +135,20 @@ class AOTCache:
         return self._envelope
 
     @staticmethod
-    def fingerprint(block, x_dtype) -> str:
+    def fingerprint(block, x_dtype, plan=None) -> str:
         """Param-tree *structure* fingerprint: block identity (class +
         repr — layer configs/activations print there) + structural
         parameter names/shapes + the runtime array shapes/dtypes in
         ``_param_split`` order + the PRNG key dtype (the impl bakes a
         different program).  Parameter VALUES are absent by design:
         hot-reload swaps values, never the program.
+
+        With a shard plan the mesh signature + rule set join the key
+        material (``plan.fingerprint_token``) — a tensor-parallel
+        executable is only valid on its exact mesh shape, and the same
+        model served single-device and sharded must occupy two entries.
+        ``plan=None`` contributes NOTHING to the hash, byte-identical to
+        the pre-plan scheme, so existing caches stay warm.
 
         Memoized on the block (``__dict__`` directly — bypasses Block's
         attribute registration): page-in restores call this once per
@@ -150,14 +157,17 @@ class AOTCache:
         post-hoc structural mutation (``cast``, added children) changes
         the runtime arg avals, which the AOT executable's own argument
         check rejects loudly — staleness can't reach numerics."""
-        dt_key = str(np.dtype(x_dtype))
+        plan_token = None if plan is None else plan.fingerprint_token()
+        dt_key = (str(np.dtype(x_dtype)), plan_token)
         memo = block.__dict__.setdefault("_aot_fp_memo", {})
         got = memo.get(dt_key)
         if got is not None:
             return got
         from .cache import key_spec
         parts = [f"{type(block).__module__}.{type(block).__qualname__}",
-                 repr(block), dt_key]
+                 repr(block), dt_key[0]]
+        if plan_token is not None:
+            parts.append(f"plan:{plan_token}")
         names = block._structural_names()
         parts.append("|".join(
             f"{k}:{tuple(p.shape) if p.shape else ()}"
@@ -172,20 +182,20 @@ class AOTCache:
         memo[dt_key] = hashlib.sha1(raw).hexdigest()
         return memo[dt_key]
 
-    def entry_path(self, block, shape, dtype) -> str:
-        fp = self.fingerprint(block, dtype)
+    def entry_path(self, block, shape, dtype, plan=None) -> str:
+        fp = self.fingerprint(block, dtype, plan=plan)
         digest = hashlib.sha1(
             f"{fp}|{tuple(shape)}|{np.dtype(dtype)}".encode()).hexdigest()
         return os.path.join(self.root, f"aot-{digest[:24]}{_fmt.SUFFIX}")
 
     # -- read path ---------------------------------------------------------
     def load(self, block, shape, dtype, ctx=None,
-             site="serving_predictor"):
+             site="serving_predictor", plan=None):
         """Return a loaded :class:`CompiledPredictor` or None (cold
         miss / invalidated entry).  Never raises for a bad entry: every
         failure past existence journals an ``aot_fallback`` with its
         reason and the caller compiles normally."""
-        path = self.entry_path(block, shape, dtype)
+        path = self.entry_path(block, shape, dtype, plan=plan)
         if not os.path.exists(path):
             self._note("misses", "miss")
             return None
@@ -206,7 +216,8 @@ class AOTCache:
                                     bytes=len(payload) + len(trees),
                                     shape=list(shape)):
                 pred = CompiledPredictor.from_serialized(
-                    block, payload, trees, ctx=ctx, backend=backend)
+                    block, payload, trees, ctx=ctx, backend=backend,
+                    plan=plan)
         except Exception as exc:
             return self._fallback(path,
                                   f"deserialize:{type(exc).__name__}")
@@ -233,21 +244,24 @@ class AOTCache:
             pass
 
     # -- write path --------------------------------------------------------
-    def store(self, pred, block, shape, dtype) -> bool:
+    def store(self, pred, block, shape, dtype, plan=None) -> bool:
         """Persist one AOT-compiled predictor (no-op in ``ro`` mode).
         A backend that cannot serialize its executables degrades to
         memory-only caching, journaled once per store attempt."""
         if self.mode != "rw":
             return False
-        path = self.entry_path(block, shape, dtype)
+        path = self.entry_path(block, shape, dtype, plan=plan)
         t0 = time.perf_counter()
         try:
             payload, trees = pred.serialize_aot()
+            key_doc = {"shape": list(shape),
+                       "dtype": str(np.dtype(dtype)),
+                       "fingerprint": self.fingerprint(block, dtype,
+                                                       plan=plan)}
+            if plan is not None:
+                key_doc["shard_plan"] = plan.fingerprint_token()
             blob = _fmt.pack_entry(
-                {"envelope": self.envelope(),
-                 "key": {"shape": list(shape),
-                         "dtype": str(np.dtype(dtype)),
-                         "fingerprint": self.fingerprint(block, dtype)},
+                {"envelope": self.envelope(), "key": key_doc,
                  "created": time.time()},
                 {"exec": payload, "trees": trees})
             with _atomic.atomic_write(path, "wb") as f:
@@ -267,19 +281,22 @@ class AOTCache:
 
     # -- the one entry point the serving cache uses ------------------------
     def load_or_compile(self, block, shape, dtype, ctx=None,
-                        site="serving_predictor"):
+                        site="serving_predictor", plan=None):
         """Disk-first predictor build: a valid entry loads (``aot_load``
         span, no compile); otherwise compile eagerly at the padded shape
         (``xla_compile`` span, same site family as the lazy path) and
-        write through."""
-        pred = self.load(block, shape, dtype, ctx=ctx, site=site)
+        write through.  ``plan`` keys (and shards) the executable — a
+        tensor-parallel replica restarting on the same mesh loads its
+        partitioned programs with zero XLA compiles."""
+        pred = self.load(block, shape, dtype, ctx=ctx, site=site,
+                         plan=plan)
         if pred is not None:
             return pred
-        pred = CompiledPredictor(block, ctx=ctx)
+        pred = CompiledPredictor(block, ctx=ctx, plan=plan)
         with _obs.compile_span(site, shape=list(shape),
                                dtype=str(np.dtype(dtype)), aot=True):
             pred.aot_compile(tuple(shape), dtype)
-        self.store(pred, block, shape, dtype)
+        self.store(pred, block, shape, dtype, plan=plan)
         return pred
 
     # -- bookkeeping -------------------------------------------------------

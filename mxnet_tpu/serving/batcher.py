@@ -22,7 +22,7 @@ import time
 
 __all__ = ["DeadlineExceeded", "PendingResponse", "Request",
            "RequestCancelled", "RequestError", "ServerOverloaded",
-           "ServerStopped", "drop_expired", "take_batch"]
+           "ServerStopped", "SlotsExhausted", "drop_expired", "take_batch"]
 
 
 class RequestError(RuntimeError):
@@ -69,6 +69,23 @@ class ServerStopped(RequestError):
     def __init__(self, detail="server stopped"):
         super().__init__(f"{detail} — admission closed; submit to "
                          "another replica or restart the server")
+
+
+class SlotsExhausted(RequestError):
+    """Decode-slot admission rejected: every KV-cache slot is occupied
+    and the stream asked not to queue (serving/decode.py,
+    ``queue_on_busy=False``).  Retryable — unlike a shape reject, a
+    DIFFERENT replica may well have a free slot, so the pool router's
+    retry loop treats this as a placement miss, not a dead request."""
+
+    def __init__(self, slots, queued=0, tenant=None):
+        super().__init__(f"all {slots} decode slots occupied "
+                         f"({queued} queued); stream not admitted — "
+                         "retry on another replica"
+                         + (f" [tenant: {tenant}]" if tenant else ""))
+        self.slots = slots
+        self.queued = queued
+        self.tenant = tenant
 
 
 class RequestCancelled(RequestError):

@@ -19,6 +19,15 @@ The number of distinct compiled shapes is then bounded by
 ``grid_bound()`` = |batch buckets| x prod(|axis buckets|) per distinct
 unbucketed-dims signature — bounded by configuration, never by traffic.
 
+Autoregressive decode gets its own lattice (:meth:`BucketGrid.for_decode`):
+a decode step is always ``(slots, step_width)`` — the slot pool is a
+fixed-size resident batch, not a traffic-dependent one — so snapping it
+onto the prefill grid would pad the one-token step axis up to the
+smallest prefill bucket (a 4x-16x compute waste every step) and alias
+decode executables with prefill ones.  The decode grid has exactly one
+cell; ``grid_bound() == 1`` is the decode engine's zero-mid-run-compile
+guarantee.
+
 Stdlib-only: the grid is pure shape math, imported by the doctor and
 tests without touching jax.
 """
@@ -59,6 +68,19 @@ class BucketGrid:
                 raise ValueError(f"dim_buckets[{axis}] must be positive "
                                  f"ints, got {sizes}")
             self.dim_buckets[int(axis)] = sizes
+
+    @classmethod
+    def for_decode(cls, slots, step_width=1):
+        """The dedicated decode-step lattice: ONE cell, ``(slots,
+        step_width)``.  A ``(slots, 1)`` step tensor snaps to itself —
+        never to the smallest prefill bucket — and ``grid_bound() == 1``
+        makes 'decode steps never compile outside the lattice' a
+        checkable invariant rather than a hope."""
+        if int(slots) < 1 or int(step_width) < 1:
+            raise ValueError(f"decode grid needs slots >= 1 and "
+                             f"step_width >= 1, got ({slots}, {step_width})")
+        return cls(max_batch=int(slots), batch_buckets=(int(slots),),
+                   dim_buckets={0: (int(step_width),)})
 
     @property
     def max_batch(self) -> int:

@@ -61,11 +61,20 @@ class CompiledPredictor:
     :meth:`from_serialized` (a deserialized on-disk executable,
     ``serving/aotcache.py``).  Parameters stay runtime arguments on both
     paths, so the zero-retrace hot-reload contract is unchanged.
+
+    With a :class:`~.shardplan.ShardPlan` the SAME program becomes a
+    GSPMD tensor-parallel executable: parameters arrive already placed
+    on the plan's mesh (their ``NamedSharding`` rides the runtime
+    arguments on the lazy path and the abstract arg specs on the AOT
+    path), the padded input is committed to the plan's activation
+    sharding before dispatch, and XLA partitions the computation —
+    no second code path, exactly one executable per padded shape.
     """
 
-    def __init__(self, block, ctx=None):
+    def __init__(self, block, ctx=None, plan=None):
         self._block = block
         self._ctx = ctx
+        self.plan = plan
         self._treedef = None
         self._compiled = None          # AOT executable when present
         self.aot = None                # None | "compiled" | "loaded"
@@ -94,8 +103,16 @@ class CompiledPredictor:
 
     def __call__(self, x_padded):
         tr_datas, aux_datas = self._runtime_args()
+        key = _rng.next_key()
+        if self.plan is not None:
+            # commit the padded batch (and the key) to the plan's
+            # shardings BEFORE dispatch so the lazy and AOT paths see
+            # identical arg placements (one executable, either way in)
+            x_padded = jax.device_put(
+                x_padded, self.plan.activation_sharding(x_padded.shape))
+            key = jax.device_put(key, self.plan.replicated())
         fn = self._compiled if self._compiled is not None else self._jitted
-        outs = fn(_rng.next_key(), tr_datas, aux_datas, x_padded)
+        outs = fn(key, tr_datas, aux_datas, x_padded)
         return outs, self._treedef
 
     # -- ahead-of-time path (serving/aotcache.py) ---------------------------
@@ -104,15 +121,33 @@ class CompiledPredictor:
         trainable arrays, aux arrays, x) as ShapeDtypeStructs matching
         what ``__call__`` passes at runtime.  The key spec comes from
         the process-memoized :func:`key_spec` so its (impl-dependent)
-        dtype is exact without consuming a stream key per build."""
+        dtype is exact without consuming a stream key per build.
+
+        Under a shard plan the specs carry shardings: parameters use the
+        LIVE arrays' placements (the plan already landed them on the
+        mesh), the input uses the plan's activation sharding, and the
+        key replicates — so an AOT lowering partitions exactly like the
+        lazy path's first call."""
         tr_datas, aux_datas = self._runtime_args()
+        plan = self.plan
 
         def spec(a):
-            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if plan is None:
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        sharding=a.sharding)
 
-        return (key_spec(), [spec(a) for a in tr_datas],
-                [spec(a) for a in aux_datas],
-                jax.ShapeDtypeStruct(tuple(x_shape), x_dtype))
+        ks = key_spec()
+        if plan is not None:
+            ks = jax.ShapeDtypeStruct(ks.shape, ks.dtype,
+                                      sharding=plan.replicated())
+            x_spec = jax.ShapeDtypeStruct(
+                tuple(x_shape), x_dtype,
+                sharding=plan.activation_sharding(tuple(x_shape)))
+        else:
+            x_spec = jax.ShapeDtypeStruct(tuple(x_shape), x_dtype)
+        return (ks, [spec(a) for a in tr_datas],
+                [spec(a) for a in aux_datas], x_spec)
 
     def aot_compile(self, x_shape, x_dtype) -> "CompiledPredictor":
         """Lower + compile at the padded shape ahead of the first call
@@ -140,7 +175,7 @@ class CompiledPredictor:
 
     @classmethod
     def from_serialized(cls, block, payload, trees, ctx=None,
-                        backend=None):
+                        backend=None, plan=None):
         """Rebuild a predictor from persisted bytes WITHOUT tracing or
         compiling.  ``payload``/``trees`` must already be CRC- and
         envelope-validated by the caller (serving/aotcache.py is the one
@@ -148,7 +183,7 @@ class CompiledPredictor:
         import pickle
 
         from jax.experimental import serialize_executable as _se
-        obj = cls(block, ctx=ctx)
+        obj = cls(block, ctx=ctx, plan=plan)
         in_tree, out_tree, treedef = pickle.loads(trees)
         obj._compiled = _se.deserialize_and_load(
             payload, in_tree, out_tree, backend=backend)

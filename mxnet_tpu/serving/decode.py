@@ -1,0 +1,743 @@
+"""Continuous-batching autoregressive decode engine.
+
+The one-shot batcher (serving/server.py) coalesces *independent*
+requests into micro-batches; autoregressive generation breaks its model:
+a sequence is hundreds of tiny dependent steps, and batching whole
+sequences start-to-finish would make every caller wait for the longest
+one.  This engine is the serving tier's second executor, run beside the
+one-shot worker:
+
+- a fixed **slot pool** (``MXNET_TPU_DECODE_SLOTS``) of resident
+  per-sequence state (the KV-cache analog) admits streams — admission is
+  against slots, not traffic, so device memory is bounded by
+  configuration;
+- **prefill/decode split**: a newly admitted prompt is absorbed in
+  padded chunks on a small prefill lattice (powers of two up to
+  ``MXNET_TPU_DECODE_PREFILL_CHUNK``), then the stream joins the
+  resident step batch;
+- **per-step rebatching**: every decode step runs ONE executable over
+  the full ``(slots, 1)`` token tensor with an active mask — a stream
+  finishing frees its slot for the next queued prompt *between steps*,
+  never by restarting the batch.  The step shape snaps onto the
+  dedicated decode lattice (:meth:`~.buckets.BucketGrid.for_decode`,
+  ``grid_bound() == 1``), never onto the smallest prefill bucket;
+- **exact compile accounting**: programs are AOT-lowered
+  (``jit(fn).lower(...).compile()``) into an explicit program cache, so
+  ``stats()["compiles"]`` counts every XLA build and the zero-mid-run-
+  compile guarantee is a checkable number, not a hope;
+- **deadlines + cancellation**: per-stream absolute deadlines are
+  checked at admission and every step (a mid-decode expiry preempts the
+  stream and frees its slot); ``DecodeStream.cancel()`` frees the slot
+  at the next step boundary.  Failures are the structured batcher
+  errors the pool router already classifies — ``SlotsExhausted`` is
+  retryable (another replica may have a free slot), a deadline miss is
+  not;
+- every step journals ``decode_step`` (occupancy, step latency);
+  admissions/finishes/cancels/preempts journal their own records — the
+  doctor's ``decode`` section summarizes them (serving/report.py).
+
+With a :class:`~.shardplan.ShardPlan` the resident state and the step
+batch are committed to the plan's mesh (replicated — the toy state is
+tiny; a model's ``DecodeModel`` impl can shard its own state), so a
+decode engine co-exists with tensor-parallel predictors on one fleet.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..diagnostics.journal import get_journal
+from ..metric import LatencySummary
+from ..observability import instrument as _obs
+from .batcher import (DeadlineExceeded, RequestError, ServerOverloaded,
+                      ServerStopped, SlotsExhausted)
+from .buckets import BucketGrid
+from .server import _env_float, _env_int
+
+__all__ = ["DecodeConfig", "DecodeEngine", "DecodeModel", "DecodeStream",
+           "TinyLM"]
+
+_STOP = object()
+_engine_seq = itertools.count()
+
+
+def _pow2_up_to(n):
+    out, b = [], 1
+    while b < n:
+        out.append(b)
+        b *= 2
+    out.append(int(n))
+    return tuple(out)
+
+
+class DecodeModel:
+    """The contract the engine drives — three PURE, jax-traceable
+    functions over a slot-resident state pytree (a dict of arrays whose
+    leading dim is the slot count).  ``max_len`` bounds per-slot
+    positions; admission enforces ``prompt + max_new_tokens <= max_len``.
+
+    ``init_state(slots)``
+        The resident pool: {name: array[(slots, ...)]} — the KV-cache
+        analog, allocated once and reused across stream generations.
+    ``prefill_fn(state, slot, tokens, length, start)``
+        Absorb one padded prompt chunk (``tokens[(chunk,)]``, valid
+        prefix ``length``) into ``slot`` at absolute offset ``start``;
+        ``start == 0`` must RESET the slot (a freed slot's stale state
+        can never leak into its next occupant).  Returns the new state.
+    ``step_fn(state, tokens, active)``
+        One decode step over the whole pool: absorb ``tokens[(slots,
+        1)]`` (each stream's previously emitted token) where ``active``,
+        and return ``(state, next_tokens[(slots,)])``.
+    """
+
+    max_len = 256
+
+    def init_state(self, slots):
+        raise NotImplementedError
+
+    def prefill_fn(self, state, slot, tokens, length, start):
+        raise NotImplementedError
+
+    def step_fn(self, state, tokens, active):
+        raise NotImplementedError
+
+
+class TinyLM(DecodeModel):
+    """Deterministic toy LM — integer hash-chain "attention".
+
+    Next token is a pure function of (running hash, position), both
+    updated by exact int32 arithmetic, so the engine's output is
+    bit-checkable against :meth:`reference` (a pure-python replay) —
+    the decode analog of the Scale block's value-fingerprint trick.
+    The ``kv`` buffer records absorbed tokens per slot: a genuinely
+    resident per-sequence array that makes slot occupancy (and the
+    start==0 reset contract) real rather than notional.
+    """
+
+    def __init__(self, vocab=251, max_len=256):
+        self.vocab = int(vocab)
+        self.max_len = int(max_len)
+
+    def init_state(self, slots):
+        return {"pos": np.zeros((slots,), np.int32),
+                "acc": np.zeros((slots,), np.int32),
+                "kv": np.zeros((slots, self.max_len), np.int32)}
+
+    def prefill_fn(self, state, slot, tokens, length, start):
+        import jax
+        import jax.numpy as jnp
+        V = self.vocab
+        fresh = start == 0
+        acc0 = jnp.where(fresh, 0, state["acc"][slot])
+        row0 = jnp.where(fresh, jnp.zeros_like(state["kv"][slot]),
+                         state["kv"][slot])
+
+        def body(i, carry):
+            acc, row = carry
+            use = i < length
+            tok = tokens[i]
+            idx = jnp.where(use, start + i, row.shape[0])   # OOB → drop
+            row = row.at[idx].set(tok, mode="drop")
+            acc = jnp.where(use, (acc * 31 + tok + 1) % V, acc)
+            return acc, row
+
+        acc, row = jax.lax.fori_loop(0, tokens.shape[0], body, (acc0, row0))
+        return {"pos": state["pos"].at[slot].set(start + length),
+                "acc": state["acc"].at[slot].set(acc),
+                "kv": state["kv"].at[slot].set(row)}
+
+    def step_fn(self, state, tokens, active):
+        import jax.numpy as jnp
+        V = self.vocab
+        tok = tokens[:, 0]
+        acc = jnp.where(active, (state["acc"] * 31 + tok + 1) % V,
+                        state["acc"])
+        pos = state["pos"]
+        slots = tok.shape[0]
+        idx = jnp.where(active, pos, state["kv"].shape[1])   # OOB → drop
+        kv = state["kv"].at[(jnp.arange(slots), idx)].set(tok, mode="drop")
+        pos = jnp.where(active, pos + 1, pos)
+        nxt = ((acc * 33 + pos * 7 + 5) % V).astype(jnp.int32)
+        return {"pos": pos, "acc": acc, "kv": kv}, nxt
+
+    def reference(self, prompt, n):
+        """Pure-python replay of prefill(prompt[:-1]) + n steps — the
+        bit-exact oracle for engine tests."""
+        V = self.vocab
+        acc = pos = 0
+        for t in prompt[:-1]:
+            acc = (acc * 31 + int(t) + 1) % V
+            pos += 1
+        out, tok = [], int(prompt[-1])
+        for _ in range(n):
+            acc = (acc * 31 + tok + 1) % V
+            pos += 1
+            tok = (acc * 33 + pos * 7 + 5) % V
+            out.append(tok)
+        return out
+
+
+@dataclass
+class DecodeConfig:
+    """Decode-engine knobs (docs/serving.md; ``MXNET_TPU_DECODE_*`` env
+    vars set fleet-wide defaults)."""
+
+    slots: int = field(default_factory=lambda: _env_int(
+        "MXNET_TPU_DECODE_SLOTS", 8))
+    prefill_chunk: int = field(default_factory=lambda: _env_int(
+        "MXNET_TPU_DECODE_PREFILL_CHUNK", 32))
+    # idle admission window: how long the worker waits for a first
+    # stream when NO slot is occupied.  With streams active, admission
+    # is non-blocking between steps (waiting would tax every token).
+    window_ms: float = field(default_factory=lambda: _env_float(
+        "MXNET_TPU_DECODE_WINDOW_MS", 20.0))
+    max_queue: int = 64                      # bounded slot-wait queue
+    max_new_tokens: int = 64                 # per-stream default cap
+    default_deadline_ms: float = 10000.0
+    queue_on_busy: bool = True               # False: SlotsExhausted now
+    result_timeout_s: float = 60.0
+
+    def summary(self) -> dict:
+        return {"slots": self.slots, "prefill_chunk": self.prefill_chunk,
+                "window_ms": self.window_ms, "max_queue": self.max_queue,
+                "max_new_tokens": self.max_new_tokens,
+                "default_deadline_ms": self.default_deadline_ms,
+                "queue_on_busy": self.queue_on_busy}
+
+
+class DecodeStream:
+    """Caller-side handle for one admitted stream.
+
+    ``result(timeout_s)`` blocks (bounded) until the stream finishes,
+    then returns the generated token list or raises the structured
+    error; ``tokens`` snapshots partial progress; ``cancel()`` frees
+    the slot at the next step boundary (or drops the stream from the
+    queue before admission)."""
+
+    __slots__ = ("prompt", "max_new", "deadline_ts", "enq_t", "tenant",
+                 "done", "error", "slot", "pending_tok", "_generated",
+                 "_timeout_s", "admit_t", "finish_t", "cancel_evt")
+
+    def __init__(self, prompt, max_new, deadline_s, tenant, timeout_s):
+        now = time.monotonic()
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline_ts = None if deadline_s is None else now + deadline_s
+        self.enq_t = now
+        self.tenant = tenant
+        self.done = threading.Event()
+        self.error = None
+        self.slot = None
+        self.pending_tok = int(prompt[-1])   # next step's input token
+        self._generated = []
+        self._timeout_s = timeout_s
+        self.admit_t = None
+        self.finish_t = None
+        self.cancel_evt = threading.Event()
+
+    # -- caller surface --------------------------------------------------
+    def cancel(self):
+        self.cancel_evt.set()
+
+    def cancelled(self) -> bool:
+        return self.cancel_evt.is_set()
+
+    @property
+    def tokens(self):
+        return list(self._generated)
+
+    def result(self, timeout_s=None):
+        timeout_s = self._timeout_s if timeout_s is None else timeout_s
+        if not self.done.wait(timeout=timeout_s):
+            raise RequestError(
+                f"decode stream unresolved within {timeout_s:g}s (engine "
+                "stopped or wedged — check the serving journal)")
+        if self.error is not None:
+            raise self.error
+        return list(self._generated)
+
+    # -- engine side -----------------------------------------------------
+    def expired(self, now=None) -> bool:
+        return self.deadline_ts is not None and \
+            (time.monotonic() if now is None else now) > self.deadline_ts
+
+    def late_ms(self, now=None) -> float:
+        if self.deadline_ts is None:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        return max(now - self.deadline_ts, 0.0) * 1000.0
+
+    def _finish(self, now=None):
+        self.finish_t = time.monotonic() if now is None else now
+        self.done.set()
+
+    def _fail(self, exc, now=None):
+        self.error = exc
+        self._finish(now)
+
+
+class DecodeEngine:
+    """The continuous batcher: one worker thread owns the slot pool and
+    the device, callers enqueue prompts into a bounded queue (or bounce
+    with :class:`SlotsExhausted` when ``queue_on_busy=False``)."""
+
+    def __init__(self, model, config=None, plan=None):
+        self.model = model
+        self.config = config or DecodeConfig()
+        cfg = self.config
+        if cfg.slots < 1:
+            raise ValueError(f"DecodeEngine needs slots >= 1, got "
+                             f"{cfg.slots}")
+        self.plan = plan
+        # the two lattices: a dedicated single-cell decode grid for the
+        # (slots, 1) step tensor, a pow2 chunk grid for prefill.  The
+        # snap invariant is asserted once here, not trusted per step.
+        self.grid = BucketGrid.for_decode(cfg.slots)
+        assert (self.grid.batch_bucket(cfg.slots),) + \
+            self.grid.feature_key((1,)) == (cfg.slots, 1)
+        self.prefill_buckets = _pow2_up_to(cfg.prefill_chunk)
+        self._id = f"dec{next(_engine_seq)}"
+        self._queue = queue.Queue(maxsize=cfg.max_queue)
+        self._slots = [None] * cfg.slots     # slot -> DecodeStream
+        self._state = None                   # resident model state
+        self._programs = {}                  # ("step",)|("prefill", b)
+        self._worker = None
+        self._stopping = threading.Event()
+        self._closed = False
+        self._admit_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self.step_latency = LatencySummary("decode_step_ms")
+        self.counters = {"submitted": 0, "admitted": 0, "completed": 0,
+                         "cancelled": 0, "preempted": 0, "shed": 0,
+                         "rejected": 0, "steps": 0, "compiles": 0,
+                         "tokens_out": 0}
+
+    # -- programs (explicit AOT cache: compiles are counted, never
+    #    implicit — the zero-mid-run-compile invariant is checkable) ----
+    def _spec(self, a):
+        import jax
+        if self.plan is None:
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                    sharding=self.plan.replicated())
+
+    def _commit(self, a):
+        """Place one host/device array per the plan (identity without
+        one) — AOT executables are strict about input placements."""
+        if self.plan is None:
+            return a
+        import jax
+        return jax.device_put(a, self.plan.replicated())
+
+    def _ensure_state(self):
+        if self._state is None:
+            st = self.model.init_state(self.config.slots)
+            self._state = {k: self._commit(np.asarray(v))
+                           for k, v in st.items()}
+        return self._state
+
+    def _state_specs(self):
+        return {k: self._spec(v) for k, v in self._ensure_state().items()}
+
+    def _program(self, key):
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        import jax
+        i32 = np.dtype(np.int32)
+        sspec = self._state_specs()
+
+        def scalar():
+            return self._spec(np.zeros((), i32))
+
+        if key[0] == "step":
+            fn = jax.jit(self.model.step_fn)
+            args = (sspec,
+                    self._spec(np.zeros((self.config.slots, 1), i32)),
+                    self._spec(np.zeros((self.config.slots,), bool)))
+        else:
+            fn = jax.jit(self.model.prefill_fn)
+            args = (sspec, scalar(),
+                    self._spec(np.zeros((key[1],), i32)),
+                    scalar(), scalar())
+        with _obs.compile_span("decode_program", program=list(key),
+                               engine=self._id):
+            prog = fn.lower(*args).compile()
+        with self._lock:
+            self.counters["compiles"] += 1
+        self._programs[key] = prog
+        return prog
+
+    def warmup(self) -> dict:
+        """Build the WHOLE program set (one step executable + one
+        prefill executable per chunk bucket) ahead of traffic — after
+        this, a compile during decode is a defect, and the tier-0.5
+        smoke asserts exactly that.  Returns {programs, compiled, ms}
+        and journals ``decode_warmup``."""
+        t0 = time.perf_counter()
+        before = self.counters["compiles"]
+        self._program(("step",))
+        for b in self.prefill_buckets:
+            self._program(("prefill", b))
+        out = {"programs": len(self._programs),
+               "compiled": self.counters["compiles"] - before,
+               "ms": round((time.perf_counter() - t0) * 1000.0, 2)}
+        get_journal().event("decode_warmup", engine=self._id, **out)
+        return out
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        if self._worker is not None and self._worker.is_alive():
+            return self
+        self._stopping.clear()
+        with self._admit_lock:
+            self._closed = False
+        self._ensure_state()
+        get_journal().event("decode_start", engine=self._id,
+                            config=self.config.summary(),
+                            grid=repr(self.grid),
+                            prefill_buckets=list(self.prefill_buckets))
+        self._worker = threading.Thread(
+            target=self._run, name="mxtpu-decode-worker", daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self, timeout_s=30.0, drain=True):
+        """With ``drain``, every admitted stream (active or queued) runs
+        to completion before the worker exits; without, all resolve with
+        :class:`ServerStopped`.  Admission closes first; bounded join."""
+        if self._worker is None:
+            return
+        with self._admit_lock:
+            self._closed = True
+        if not drain:
+            self._stopping.set()
+        try:
+            self._queue.put(_STOP, timeout=timeout_s)
+        except queue.Full:
+            self._stopping.set()
+        self._worker.join(timeout=timeout_s)
+        stuck = self._worker.is_alive()
+        if not stuck:
+            leftovers = []
+            with self._admit_lock:
+                self._drain_queue(leftovers)
+            self._fail_streams(leftovers)
+        get_journal().event("decode_stop", engine=self._id,
+                            drained=bool(drain), stuck=stuck,
+                            **self.stats())
+        if stuck:
+            raise RequestError(
+                f"decode worker did not stop within {timeout_s:g}s "
+                "(device wedged mid-step? see the journal)")
+        self._worker = None
+
+    # -- client surface --------------------------------------------------
+    def submit(self, tokens, max_new_tokens=None, deadline_ms=None,
+               tenant=None) -> DecodeStream:
+        """Admit one prompt (1-D int token sequence).  Raises
+        :class:`RequestError` for an empty/oversized prompt (not
+        retryable — every replica shares ``max_len``),
+        :class:`SlotsExhausted` when ``queue_on_busy=False`` and no
+        slot is free (retryable: placement miss),
+        :class:`ServerOverloaded` when the slot-wait queue is full, and
+        :class:`ServerStopped` after ``stop()``."""
+        cfg = self.config
+        prompt = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        max_new = cfg.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        with self._lock:
+            self.counters["submitted"] += 1
+        if not prompt or max_new < 1 or \
+                len(prompt) + max_new > self.model.max_len:
+            with self._lock:
+                self.counters["rejected"] += 1
+            err = RequestError(
+                f"decode request rejected: prompt={len(prompt)} tokens + "
+                f"max_new={max_new} exceeds max_len="
+                f"{self.model.max_len} (or is empty) — oversized streams "
+                "are rejected, never compiled")
+            err.retryable = False
+            err.tenant = tenant
+            raise err
+        deadline_ms = cfg.default_deadline_ms if deadline_ms is None \
+            else deadline_ms
+        deadline_s = None if deadline_ms is None or deadline_ms <= 0 \
+            else deadline_ms / 1000.0
+        stream = DecodeStream(prompt, max_new, deadline_s, tenant,
+                              cfg.result_timeout_s)
+        if not cfg.queue_on_busy:
+            free = sum(1 for s in self._slots if s is None)
+            queued = self._queue.qsize()
+            if free == 0 or queued > 0:
+                with self._lock:
+                    self.counters["shed"] += 1
+                raise SlotsExhausted(cfg.slots, queued=queued,
+                                     tenant=tenant)
+        try:
+            with self._admit_lock:
+                stopped = self._closed
+                if not stopped:
+                    self._queue.put_nowait(stream)
+        except queue.Full:
+            with self._lock:
+                self.counters["shed"] += 1
+            get_journal().event("decode_shed", engine=self._id,
+                                depth=self._queue.qsize(),
+                                limit=cfg.max_queue, tenant=tenant)
+            raise ServerOverloaded(self._queue.qsize(), cfg.max_queue,
+                                   tier="decode_queue",
+                                   tenant=tenant) from None
+        if stopped:
+            raise ServerStopped("decode engine is stopping")
+        return stream
+
+    def generate(self, tokens, max_new_tokens=None, deadline_ms=None,
+                 timeout_s=None, tenant=None):
+        """Synchronous convenience: submit + wait → token list."""
+        return self.submit(tokens, max_new_tokens=max_new_tokens,
+                           deadline_ms=deadline_ms,
+                           tenant=tenant).result(timeout_s)
+
+    def occupancy(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def stats(self) -> dict:
+        with self._lock:
+            counters = dict(self.counters)
+        return {"slots": self.config.slots,
+                "occupied": self.occupancy(),
+                "queue_depth": self.queue_depth(),
+                "programs": sorted("/".join(str(p) for p in k)
+                                   for k in self._programs),
+                "grid_bound": self.grid.grid_bound(),
+                "step_ms": self.step_latency.summary(),
+                **counters}
+
+    # -- worker ----------------------------------------------------------
+    def _run(self):
+        j = get_journal()
+        draining = False
+        try:
+            while True:
+                if self._stopping.is_set():
+                    break
+                draining = self._admit(draining)
+                active = [i for i, s in enumerate(self._slots)
+                          if s is not None]
+                if not active:
+                    if draining and self._queue.qsize() == 0:
+                        break
+                    if not draining:
+                        # idle: block (bounded) for the first stream
+                        try:
+                            item = self._queue.get(
+                                timeout=self.config.window_ms / 1000.0)
+                        except queue.Empty:
+                            continue
+                        if item is _STOP:
+                            draining = True
+                            continue
+                        self._admit_one(item)
+                    continue
+                self._step(active)
+        except BaseException as exc:        # worker must die loudly
+            j.crash(exc, where="decode_worker")
+            raise
+        finally:
+            leftovers = [s for s in self._slots if s is not None]
+            self._slots = [None] * self.config.slots
+            self._drain_queue(leftovers)
+            self._fail_streams(leftovers)
+
+    def _drain_queue(self, out):
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if item is not _STOP:
+                out.append(item)
+
+    def _fail_streams(self, streams):
+        for s in streams:
+            s._fail(ServerStopped("decode engine stopped before this "
+                                  "stream finished"))
+        streams.clear()
+
+    def _admit(self, draining):
+        """Fill free slots from the queue (non-blocking — with active
+        streams, waiting here would tax every token of every stream).
+        Returns the updated draining flag."""
+        while any(s is None for s in self._slots):
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return draining
+            if item is _STOP:
+                draining = True
+                continue
+            self._admit_one(item)
+        return draining
+
+    def _admit_one(self, stream):
+        now = time.monotonic()
+        if stream.cancelled():
+            with self._lock:
+                self.counters["cancelled"] += 1
+            get_journal().event("decode_cancel", engine=self._id,
+                                stage="queued", generated=0,
+                                tenant=stream.tenant)
+            stream._fail(RequestError("decode stream cancelled before "
+                                      "admission"), now)
+            stream.error.retryable = False
+            return
+        if stream.expired(now):
+            with self._lock:
+                self.counters["preempted"] += 1
+            get_journal().event("decode_deadline_miss", engine=self._id,
+                                stage="admit",
+                                late_ms=round(stream.late_ms(now), 2),
+                                tenant=stream.tenant)
+            stream._fail(DeadlineExceeded("decode_admit",
+                                          stream.late_ms(now),
+                                          tenant=stream.tenant), now)
+            return
+        slot = self._slots.index(None)
+        t0 = time.perf_counter()
+        chunks = self._prefill(slot, stream.prompt[:-1])
+        stream.slot = slot
+        stream.admit_t = now
+        self._slots[slot] = stream
+        with self._lock:
+            self.counters["admitted"] += 1
+        get_journal().event(
+            "decode_admit", engine=self._id, slot=slot,
+            prompt=len(stream.prompt), chunks=chunks,
+            max_new=stream.max_new, occupancy=self.occupancy(),
+            queue_depth=self.queue_depth(), tenant=stream.tenant,
+            prefill_ms=round((time.perf_counter() - t0) * 1000.0, 2))
+
+    def _prefill(self, slot, toks) -> int:
+        """Absorb a prompt prefix into ``slot`` in padded chunks on the
+        prefill lattice.  ``start == 0`` on the first chunk resets the
+        slot (the model contract).  Returns the chunk count."""
+        i32 = np.int32
+        chunk = self.config.prefill_chunk
+        off, chunks = 0, 0
+        state = self._ensure_state()
+        if not toks:
+            # single-token prompt: no prefix, but the slot must still
+            # reset — run one empty chunk (length 0, start 0)
+            toks = []
+        while True:
+            take = min(chunk, len(toks) - off)
+            if chunks and take <= 0:
+                break
+            take = max(take, 0)
+            bucket = self.prefill_buckets[0]
+            for b in self.prefill_buckets:
+                if take <= b:
+                    bucket = b
+                    break
+            padded = np.zeros((bucket,), i32)
+            padded[:take] = toks[off:off + take]
+            prog = self._program(("prefill", bucket))
+            state = prog(state, self._commit(np.asarray(slot, i32)),
+                         self._commit(padded),
+                         self._commit(np.asarray(take, i32)),
+                         self._commit(np.asarray(off, i32)))
+            off += take
+            chunks += 1
+            if off >= len(toks):
+                break
+        self._state = state
+        return chunks
+
+    def _step(self, active):
+        """One continuous-batching step: sweep cancels/deadlines, run
+        the ``(slots, 1)`` executable, scatter tokens, finish/free."""
+        cfg = self.config
+        now = time.monotonic()
+        live = []
+        for i in active:
+            s = self._slots[i]
+            if s.cancelled():
+                self._slots[i] = None
+                with self._lock:
+                    self.counters["cancelled"] += 1
+                get_journal().event("decode_cancel", engine=self._id,
+                                    stage="active", slot=i,
+                                    generated=len(s._generated),
+                                    occupancy=self.occupancy(),
+                                    tenant=s.tenant)
+                err = RequestError(
+                    f"decode stream cancelled after "
+                    f"{len(s._generated)} tokens")
+                err.retryable = False
+                s._fail(err, now)
+            elif s.expired(now):
+                self._slots[i] = None
+                with self._lock:
+                    self.counters["preempted"] += 1
+                get_journal().event("decode_preempt", engine=self._id,
+                                    slot=i,
+                                    late_ms=round(s.late_ms(now), 2),
+                                    generated=len(s._generated),
+                                    occupancy=self.occupancy(),
+                                    tenant=s.tenant)
+                s._fail(DeadlineExceeded("decode_step", s.late_ms(now),
+                                         tenant=s.tenant), now)
+            else:
+                live.append(i)
+        if not live:
+            return
+        toks = np.zeros((cfg.slots, 1), np.int32)
+        mask = np.zeros((cfg.slots,), bool)
+        for i in live:
+            toks[i, 0] = self._slots[i].pending_tok
+            mask[i] = True
+        prog = self._program(("step",))
+        t0 = time.perf_counter()
+        state, nxt = prog(self._ensure_state(), self._commit(toks),
+                          self._commit(mask))
+        nxt = np.asarray(nxt)
+        step_ms = (time.perf_counter() - t0) * 1000.0
+        self._state = state
+        self.step_latency.observe(step_ms)
+        finished = 0
+        now = time.monotonic()
+        for i in live:
+            s = self._slots[i]
+            tok = int(nxt[i])
+            s._generated.append(tok)
+            s.pending_tok = tok
+            if len(s._generated) >= s.max_new:
+                self._slots[i] = None
+                finished += 1
+                get_journal().event(
+                    "decode_finish", engine=self._id, slot=i,
+                    generated=len(s._generated),
+                    ms=round((now - s.enq_t) * 1000.0, 2),
+                    occupancy=self.occupancy(), tenant=s.tenant)
+                s._finish(now)
+        with self._lock:
+            self.counters["steps"] += 1
+            self.counters["tokens_out"] += len(live)
+            self.counters["completed"] += finished
+        lat = self.step_latency.summary()
+        get_journal().event(
+            "decode_step", engine=self._id, active=len(live),
+            slots=cfg.slots,
+            occupancy=round(len(live) / cfg.slots, 4),
+            step_ms=round(step_ms, 3), finished=finished,
+            queue_depth=self.queue_depth(),
+            p50_ms=lat["p50"], p95_ms=lat["p95"])

@@ -506,6 +506,12 @@ class Fleet(Server):
             from .. import ndarray as nd
             block.load_dict({k: nd.array(v) for k, v in host.items()},
                             ctx=self._ctx, ignore_extra=True)
+        if self.plan is not None:
+            # land THIS tenant's weights on the serving mesh before any
+            # executable restore — the AOT entries were lowered against
+            # the plan's shardings, so a single-device block here would
+            # fault every restored predictor's first batch
+            self.plan.place(block, site="tenant_page_in")
         doomed = []
         with self._tlock:
             if ts.removed:
@@ -558,7 +564,7 @@ class Fleet(Server):
         restored = 0
         for bucket, key in shapes:
             pred = self.aot.load(block, (bucket,) + key, self._dtype,
-                                 ctx=self._ctx)
+                                 ctx=self._ctx, plan=self.plan)
             if pred is None:
                 continue               # cold disk: first batch compiles
             _entry, hit = self.cache.get(
@@ -683,8 +689,17 @@ class Fleet(Server):
         loaded = {k: v for k, v in loaded.items()
                   if not k.startswith("__")}
         try:
-            self._check_reloadable_block(ts.block, loaded)
-            ts.block.load_dict(loaded, ctx=self._ctx, ignore_extra=True)
+            norm = self._check_reloadable_block(ts.block, loaded)
+            if self.plan is not None:
+                # sharded lane mirrors Server._maybe_reload: re-drop each
+                # host entry onto the live array's NamedSharding so the
+                # tenant's compiled predictors keep their placements
+                self.plan.adopt_entries(
+                    ts.block, {k: v.asnumpy() if hasattr(v, "asnumpy")
+                               else np.asarray(v) for k, v in norm.items()})
+            else:
+                ts.block.load_dict(loaded, ctx=self._ctx,
+                                   ignore_extra=True)
         except Exception as e:
             store.mark_bad(step, revert_to=prev)
             get_journal().event("serving_reload_failed", tenant=ts.name,
@@ -705,7 +720,7 @@ class Fleet(Server):
         fleet has N of them)."""
         saved_block, self.block = self.block, block
         try:
-            self._check_reloadable(loaded)
+            return self._check_reloadable(loaded)
         finally:
             self.block = saved_block
 

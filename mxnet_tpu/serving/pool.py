@@ -45,7 +45,7 @@ from ..base import MXNetError
 from ..diagnostics.journal import get_journal
 from ..elastic.membership import Heartbeat, LivenessReader
 from .batcher import (DeadlineExceeded, RequestError, ServerOverloaded,
-                      ServerStopped)
+                      ServerStopped, SlotsExhausted)
 from . import wire
 
 __all__ = ["LocalReplica", "PoolConfig", "ProcReplica", "ReplicaPool",
@@ -198,6 +198,23 @@ class LocalReplica:
         return value, {"replica": self.id,
                        "params_step": resp.params_step}
 
+    def decode(self, tokens, max_new_tokens=None, deadline_ms=None,
+               cancel=None, tenant=None):
+        """One decode attempt on this replica's continuous batcher;
+        returns ``(token list, meta)`` or raises a structured serving
+        error (``SlotsExhausted`` → the router tries another replica)."""
+        srv = self.server
+        if srv is None:
+            raise ReplicaUnavailable(self.id, "not started")
+        budget_s = (deadline_ms / 1000.0 if deadline_ms
+                    else srv.config.result_timeout_s)
+        stream = srv.decode_submit(tokens, max_new_tokens=max_new_tokens,
+                                   deadline_ms=deadline_ms, tenant=tenant)
+        if cancel is not None and cancel.is_set():
+            stream.cancel()
+        toks = stream.result(timeout_s=budget_s + 5.0)
+        return toks, {"replica": self.id, "generated": len(toks)}
+
     def drain(self, deadline_s) -> int:
         self._draining = True
         self._hb.beat()                    # publish not-ready immediately
@@ -298,6 +315,10 @@ class ProcReplica:
                                    tenant=tenant)
         if name == "ServerStopped":
             raise ServerStopped(detail or "replica stopped")
+        if name == "SlotsExhausted":
+            raise SlotsExhausted(header.get("slots", -1),
+                                 queued=header.get("queued", 0),
+                                 tenant=tenant)
         if name == "TenantQuarantined":
             from .fleet import TenantQuarantined
             err = TenantQuarantined(tenant,
@@ -335,6 +356,29 @@ class ProcReplica:
             header["shape"])
         return out, {"replica": self.id,
                      "params_step": header.get("params_step")}
+
+    def decode(self, tokens, max_new_tokens=None, deadline_ms=None,
+               cancel=None, tenant=None):
+        """One remote decode attempt: the prompt ships as int32 payload
+        bytes, the generated tokens come back the same way.  ``cancel``
+        has no remote lever mid-stream (same asymmetry as predict
+        hedging) — the router simply discards a stale reply."""
+        arr = np.ascontiguousarray(
+            np.asarray(tokens, dtype=np.int32).reshape(-1))
+        budget_s = deadline_ms / 1000.0 if deadline_ms else 60.0
+        header = {"cmd": "decode", "count": int(arr.size),
+                  "deadline_ms": deadline_ms}
+        if max_new_tokens is not None:
+            header["max_new"] = int(max_new_tokens)
+        if tenant is not None:
+            header["tenant"] = str(tenant)
+        wire.attach_trace(header)
+        header, payload = self._roundtrip(
+            header, arr.tobytes(), budget_s=budget_s)
+        if not header.get("ok"):
+            self._raise_remote(header)
+        out = np.frombuffer(payload, dtype=np.int32).tolist()
+        return out, {"replica": self.id, "generated": len(out)}
 
     def drain(self, deadline_s) -> int:
         try:

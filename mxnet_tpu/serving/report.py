@@ -32,13 +32,24 @@ _KINDS = ("serving_start", "serving_stop", "serving_batch", "serving_shed",
           "tenant_page_in", "tenant_page_out",
           # the persistent AOT executable cache (serving/aotcache.py)
           "aot_store", "aot_store_failed", "aot_fallback",
-          "aot_prewarm", "aot_gc")
+          "aot_prewarm", "aot_gc",
+          # the continuous-batching decode engine (serving/decode.py)
+          "decode_start", "decode_stop", "decode_warmup", "decode_admit",
+          "decode_step", "decode_finish", "decode_cancel",
+          "decode_preempt", "decode_deadline_miss", "decode_shed",
+          # the tensor-parallel plan (serving/shardplan.py)
+          "shard_place")
 
 _AOT_KINDS = ("aot_store", "aot_store_failed", "aot_fallback",
               "aot_prewarm", "aot_gc")
 
 _TENANT_KINDS = ("tenant_add", "tenant_remove", "tenant_quarantine",
                  "tenant_page_in", "tenant_page_out")
+
+_DECODE_KINDS = ("decode_start", "decode_stop", "decode_warmup",
+                 "decode_admit", "decode_step", "decode_finish",
+                 "decode_cancel", "decode_preempt",
+                 "decode_deadline_miss", "decode_shed")
 
 _POOL_KINDS = ("pool_start", "pool_stop", "pool_spawn", "pool_drain",
                "pool_restart", "pool_reload", "replica_lost",
@@ -173,6 +184,69 @@ def serving_report(path) -> dict:
     aot = _aot_section(records)
     if aot is not None:
         out["aot"] = aot
+    decode = _decode_section(records)
+    if decode is not None:
+        out["decode"] = decode
+    placements = [r for r in records if r["kind"] == "shard_place"]
+    if placements:
+        last_place = placements[-1]
+        out["sharding"] = {"mesh": last_place.get("mesh"),
+                           "params": last_place.get("params"),
+                           "site": last_place.get("site"),
+                           "placements": len(placements)}
+    return out
+
+
+def _decode_section(records) -> dict | None:
+    """Continuous-batching reduction of the last run: slot-occupancy
+    histogram (how full the pool actually ran), steps/s throughput,
+    admit/finish/preempt/cancel/shed ledger, and warmup compile counts
+    — the operator view of one decode run (docs/serving.md continuous
+    batching)."""
+    dec = [r for r in records if r["kind"] in _DECODE_KINDS]
+    if not dec:
+        return None
+    count = lambda k: sum(1 for r in dec if r["kind"] == k)  # noqa: E731
+    steps = [r for r in dec if r["kind"] == "decode_step"]
+    finishes = [r for r in dec if r["kind"] == "decode_finish"]
+    # occupancy histogram keyed by ACTIVE slot count: {"3": 41} reads
+    # "41 steps ran with 3 slots live" — the fill story for the pool
+    occupancy: dict = {}
+    for r in steps:
+        k = str(int(r.get("active", 0)))
+        occupancy[k] = occupancy.get(k, 0) + 1
+    span_s = (float(steps[-1].get("ts", 0.0)) -
+              float(steps[0].get("ts", 0.0))) if len(steps) > 1 else 0.0
+    cancels = {"queued": 0, "active": 0}
+    for r in dec:
+        if r["kind"] == "decode_cancel":
+            stage = str(r.get("stage", "active"))
+            cancels[stage] = cancels.get(stage, 0) + 1
+    warmups = [r for r in dec if r["kind"] == "decode_warmup"]
+    out = {
+        "steps": len(steps),
+        "steps_per_s": round(len(steps) / span_s, 2) if span_s > 0
+        else None,
+        "occupancy_hist": occupancy,
+        "admitted": count("decode_admit"),
+        "finished": len(finishes),
+        "tokens_out": sum(int(r.get("generated", 0)) for r in finishes),
+        "preempted": count("decode_preempt"),
+        "cancelled": cancels,
+        "cancelled_total": sum(cancels.values()),
+        "deadline_miss_admit": count("decode_deadline_miss"),
+        "shed": count("decode_shed"),
+        "warmup_programs": sum(int(r.get("programs", 0))
+                               for r in warmups),
+    }
+    if steps:
+        last = steps[-1]
+        out["last_step"] = {k: last.get(k) for k in
+                            ("active", "slots", "occupancy", "step_ms",
+                             "queue_depth", "p50_ms", "p95_ms")}
+    stops = [r for r in dec if r["kind"] == "decode_stop"]
+    if stops:
+        out["clean_stop"] = not stops[-1].get("stuck", False)
     return out
 
 

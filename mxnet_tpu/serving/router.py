@@ -257,6 +257,106 @@ class Router:
                             if last_exc else None)
         raise err
 
+    # -- decode streams (serving/decode.py) ------------------------------
+    def decode(self, tokens, max_new_tokens=None, deadline_ms=None,
+               priority=0, tenant=None):
+        """Route one autoregressive stream to a replica's continuous
+        batcher; returns the generated token list."""
+        return self.decode_call(tokens, max_new_tokens=max_new_tokens,
+                                deadline_ms=deadline_ms, priority=priority,
+                                tenant=tenant).value
+
+    def decode_call(self, tokens, max_new_tokens=None, deadline_ms=None,
+                    priority=0, tenant=None) -> RouterResponse:
+        """Decode through the same placement/retry/breaker machinery as
+        :meth:`call`, with one deliberate difference: NO hedging.  A
+        decode stream is stateful on its replica (it occupies a KV slot
+        and generates token by token), so a hedged twin would double-
+        generate and double-occupy slots for the whole stream, not just
+        one batch — the tail-latency lever for decode is the slot pool
+        and per-step deadline, not a second copy.  ``SlotsExhausted``
+        is retryable: a replica with a full slot pool is a placement
+        miss, and the retry loop moves the stream to another replica
+        (feeding the breaker nothing — busy is not broken)."""
+        cfg = self.config
+        if deadline_ms is None:
+            deadline_ms = cfg.default_deadline_ms
+        deadline_ts = time.monotonic() + deadline_ms / 1000.0
+        t0 = time.monotonic()
+        with self._lock:
+            self.counters["requests"] += 1
+        self._note_tenant(tenant, "requests")
+        with _trace.span("router_decode", priority=priority,
+                         tenant=tenant):
+            self._admit(priority)
+            delays = backoff_delays(cfg.retries, cfg.retry_base_s,
+                                    cfg.retry_max_s, cfg.retry_jitter)
+            tried: set = set()
+            attempts = 0
+            last_exc = None
+            for attempt in range(cfg.retries + 1):
+                remaining = deadline_ts - time.monotonic()
+                if remaining <= 0:
+                    break
+                state = self._pick(exclude=tried, tenant=tenant)
+                if state is None and tried:
+                    state = self._pick(exclude=set(), tenant=tenant)
+                if state is None:
+                    self._note_tenant(tenant, "failures")
+                    self._shed("no_capacity", priority, tenant=tenant)
+                tried.add(state.id)
+                attempts += 1
+                _atomic.trip("router_attempt", state.id)
+                with self._lock:
+                    self.counters["attempts"] += 1
+                    self._attempt_counts[state.id] = \
+                        self._attempt_counts.get(state.id, 0) + 1
+                replica = self.pool.replicas[state.id]
+                try:
+                    with _trace.span("router_attempt", replica=state.id,
+                                     tenant=tenant, op="decode"):
+                        value, meta = replica.decode(
+                            tokens, max_new_tokens=max_new_tokens,
+                            deadline_ms=remaining * 1000.0,
+                            tenant=tenant)
+                except RequestError as exc:
+                    last_exc = exc
+                    self._record_failure(state.id, exc)
+                    if not getattr(exc, "retryable", False) \
+                            or attempt >= cfg.retries:
+                        self._note_tenant(tenant, "failures")
+                        raise
+                    with self._lock:
+                        self.counters["retries"] += 1
+                    get_journal().event(
+                        "router_retry", replica=state.id, op="decode",
+                        attempt=attempt + 1, error=type(exc).__name__,
+                        detail=str(exc)[:200], tenant=tenant)
+                    pause = min(delays[attempt],
+                                max(deadline_ts - time.monotonic(), 0.0))
+                    if pause > 0:
+                        time.sleep(pause)
+                    continue
+                self._record_success(meta["replica"],
+                                     (time.monotonic() - t0) * 1000.0)
+                with self._lock:
+                    self.counters["served"] += 1
+                self._note_tenant(tenant, "served")
+                return RouterResponse(
+                    value, meta["replica"], meta.get("params_step"),
+                    attempts, False,
+                    round((time.monotonic() - t0) * 1000.0, 3))
+            late_ms = max(time.monotonic() - deadline_ts, 0.0) * 1000.0
+            err = DeadlineExceeded("router_budget", late_ms,
+                                   tier="retry_budget", tenant=tenant)
+            err.__cause__ = last_exc
+            self._note_tenant(tenant, "failures")
+            get_journal().event("router_budget_exhausted", op="decode",
+                                attempts=attempts, tenant=tenant,
+                                last_error=type(last_exc).__name__
+                                if last_exc else None)
+            raise err
+
     # -- per-tenant bookkeeping ------------------------------------------
     _TENANT_CAP = 256          # LRU bound: tenant names arrive on the
                                # request path, so this registry must not

@@ -104,6 +104,16 @@ class ServerConfig:
     device_retries: int = 2                  # transient-error retries
     transient_errors: tuple = (OSError,)     # retried via resilience.retry
     result_timeout_s: float = 60.0           # PendingResponse default wait
+    # tensor-parallel serving (serving/shardplan.py): a ShardPlan, or
+    # None for the historical single-device path.  Left None, the
+    # Server also consults MXNET_TPU_SERVING_MESH at construction
+    # (plan_from_env) so a worker can opt in by environment alone.
+    shard_plan: object = None
+    # continuous-batching decode (serving/decode.py): a DecodeModel to
+    # serve autoregressive streams beside the one-shot batcher; its
+    # knobs ride ``decode`` (a DecodeConfig; None = env defaults)
+    decode_model: object = None
+    decode: object = None
 
     def summary(self) -> dict:
         return {"max_batch": self.max_batch, "max_queue": self.max_queue,
@@ -111,7 +121,11 @@ class ServerConfig:
                 "default_deadline_ms": self.default_deadline_ms,
                 "cache_entries": self.cache_entries,
                 "reload_poll_s": self.reload_poll_s, "dtype": self.dtype,
-                "aot_dir": self.aot_dir}
+                "aot_dir": self.aot_dir,
+                "decode": None if self.decode_model is None
+                else type(self.decode_model).__name__,
+                "shard_plan": None if self.shard_plan is None
+                else self.shard_plan.fingerprint_token()}
 
 
 class Server:
@@ -130,6 +144,27 @@ class Server:
         self.grid = BucketGrid(cfg.max_batch, cfg.batch_buckets,
                                cfg.dim_buckets)
         self.cache = PredictorCache(cfg.cache_entries)
+        # tensor-parallel plan: explicit config wins; a bare axes spec
+        # (str/dict) is promoted; unset falls back to the environment
+        # knob so a subprocess worker opts in without code changes
+        from .shardplan import ShardPlan, plan_from_env
+        plan = cfg.shard_plan
+        if plan is None:
+            plan = plan_from_env()
+        elif isinstance(plan, (str, dict)):
+            plan = ShardPlan(axes=plan)
+        self.plan = cfg.shard_plan = plan
+        self._placed = False           # weights landed on the plan mesh
+        # the continuous batcher (serving/decode.py): its own worker
+        # thread + slot pool, started/stopped with this server, sharing
+        # the plan's mesh so decode state co-exists with tensor-parallel
+        # predictors
+        self.decoder = None
+        if cfg.decode_model is not None:
+            from .decode import DecodeConfig, DecodeEngine
+            self.decoder = DecodeEngine(
+                cfg.decode_model, cfg.decode or DecodeConfig(),
+                plan=self.plan)
         # the disk tier behind the LRU: None unless configured (env or
         # config) and not switched off — docs/serving.md AOT cache
         self.aot = None
@@ -189,9 +224,20 @@ class Server:
         # initial reload so that reload is attributed to this run
         get_journal().event("serving_start", config=self.config.summary(),
                             grid=repr(self.grid))
+        if self.plan is not None and not self._placed:
+            # land the weights on the serving mesh BEFORE the initial
+            # reload: the reload lane then re-drops host entries onto
+            # these exact shardings via reshard.place_global
+            self.plan.place(self.block, site="serving_start")
+            self._placed = True
         self._maybe_reload(force=True)     # begin on the newest valid step
         if self.config.aot_prewarm:
             self.prewarm()                 # warm the lattice pre-traffic
+        if self.decoder is not None:
+            # warm the WHOLE decode program set before traffic: a
+            # compile during decode is a defect, not a cold start
+            self.decoder.start()
+            self.decoder.warmup()
         self._worker = threading.Thread(
             target=self._run, name="mxtpu-serving-worker", daemon=True)
         self._worker.start()
@@ -208,6 +254,8 @@ class Server:
         can't hang the caller past ``timeout_s``."""
         if self._worker is None:
             return
+        if self.decoder is not None:
+            self.decoder.stop(timeout_s=timeout_s, drain=drain)
         with self._admit_lock:
             self._closed = True
         if not drain:
@@ -368,6 +416,31 @@ class Server:
         return self.submit(x, deadline_ms=deadline_ms,
                            tenant=tenant).result(timeout_s)
 
+    def decode_submit(self, tokens, max_new_tokens=None, deadline_ms=None,
+                      tenant=None):
+        """Admit one autoregressive stream to the continuous batcher
+        (``config.decode_model``); returns a
+        :class:`~.decode.DecodeStream`.  The tenant label threads into
+        every decode journal record and error — the engine's slot pool
+        itself is shared (admission is against slots, not per-tenant
+        executables)."""
+        if self.decoder is None:
+            err = RequestError(
+                "this server has no decode engine (config.decode_model "
+                "is unset) — decode streams are not servable here")
+            err.retryable = False
+            err.tenant = tenant
+            raise err
+        return self.decoder.submit(tokens, max_new_tokens=max_new_tokens,
+                                   deadline_ms=deadline_ms, tenant=tenant)
+
+    def decode(self, tokens, max_new_tokens=None, deadline_ms=None,
+               timeout_s=None, tenant=None):
+        """Synchronous decode convenience: submit + wait → token list."""
+        return self.decode_submit(
+            tokens, max_new_tokens=max_new_tokens, deadline_ms=deadline_ms,
+            tenant=tenant).result(timeout_s)
+
     def queue_depth(self) -> int:
         """Current admission-queue depth (approximate, lock-free) — the
         replica pool's drain-wait and readiness beacon read it."""
@@ -423,6 +496,10 @@ class Server:
                **counters}
         if self.aot is not None:
             out["aot"] = self.aot.stats()
+        if self.plan is not None:
+            out["shard_plan"] = self.plan.fingerprint_token()
+        if self.decoder is not None:
+            out["decode"] = self.decoder.stats()
         return out
 
     def beacon(self) -> dict:
@@ -638,8 +715,9 @@ class Server:
         else the historical lazy-jit closure (compiles at first call)."""
         if self.aot is not None:
             return self.aot.load_or_compile(
-                block, (bucket,) + key, self._dtype, ctx=self._ctx)
-        return CompiledPredictor(block, ctx=self._ctx)
+                block, (bucket,) + key, self._dtype, ctx=self._ctx,
+                plan=self.plan)
+        return CompiledPredictor(block, ctx=self._ctx, plan=self.plan)
 
     def _build_ready_predictor(self, block, bucket, key):
         """The prewarm builder: ALWAYS returns a ready (AOT-compiled or
@@ -650,8 +728,9 @@ class Server:
         ``exec_ms``."""
         if self.aot is not None:
             return self.aot.load_or_compile(
-                block, (bucket,) + key, self._dtype, ctx=self._ctx)
-        pred = CompiledPredictor(block, ctx=self._ctx)
+                block, (bucket,) + key, self._dtype, ctx=self._ctx,
+                plan=self.plan)
+        pred = CompiledPredictor(block, ctx=self._ctx, plan=self.plan)
         with _obs.compile_span("serving_predictor",
                                shape=[bucket, *key],
                                dtype=self._dtype.str, aot=True):
@@ -814,7 +893,9 @@ class Server:
     # -- hot-reload ----------------------------------------------------------
     def _check_reloadable(self, loaded):
         """Shape-check every entry against the live parameters up front
-        (arg:/aux: prefixes normalized like ``load_dict``)."""
+        (arg:/aux: prefixes normalized like ``load_dict``).  Returns the
+        normalized structural-name → array dict (the sharded reload lane
+        places from it)."""
         params = self.block._structural_names()
         norm = {(k.partition(":")[2] if k.partition(":")[0] in
                  ("arg", "aux") and ":" in k else k): v
@@ -828,6 +909,7 @@ class Server:
                     f"checkpoint parameter {key!r} is {got}, live "
                     f"parameter is {tuple(param.shape)} — architecture "
                     "drift; not hot-reloadable")
+        return norm
 
     def _maybe_reload(self, force=False):
         store = self.param_store
@@ -851,8 +933,18 @@ class Server:
             # validate the WHOLE dict against the live parameter shapes
             # before touching any of them — a validated-but-inapplicable
             # checkpoint (architecture drift) must never half-apply
-            self._check_reloadable(loaded)
-            self.block.load_dict(loaded, ctx=self._ctx, ignore_extra=True)
+            norm = self._check_reloadable(loaded)
+            if self.plan is not None and self._placed:
+                # sharded lane: re-drop each host entry onto the LIVE
+                # array's NamedSharding via reshard.place_global — the
+                # compiled predictors were lowered against these
+                # placements, so a reload must preserve them exactly
+                self.plan.adopt_entries(
+                    self.block, {k: v.asnumpy() if hasattr(v, "asnumpy")
+                                 else np.asarray(v) for k, v in norm.items()})
+            else:
+                self.block.load_dict(loaded, ctx=self._ctx,
+                                     ignore_extra=True)
         except MXNetError as e:
             store.mark_bad(step, revert_to=prev)
             get_journal().event("serving_reload_failed", step=step,

@@ -88,7 +88,7 @@ def _error_doc(exc, request_header=None) -> dict:
            "retryable": bool(getattr(exc, "retryable", True)),
            "detail": str(exc)[:300]}
     for attr in ("stage", "late_ms", "depth", "limit", "tier",
-                 "tenant", "reason"):
+                 "tenant", "reason", "slots", "queued"):
         v = getattr(exc, attr, None)
         if v is not None:
             doc[attr] = v
@@ -178,6 +178,8 @@ class _Front:
         cmd = header.get("cmd")
         if cmd == "predict":
             self._predict(conn, header, payload)
+        elif cmd == "decode":
+            self._decode(conn, header, payload)
         elif cmd == "drain":
             self.draining = True
             deadline = float(header.get("deadline_s", 20.0))
@@ -235,6 +237,32 @@ class _Front:
              "params_step": resp.params_step},
             np.ascontiguousarray(out).tobytes())
 
+    def _decode(self, conn, header, payload):
+        from .batcher import RequestError, ServerStopped
+        if self.draining or self.stop_evt.is_set():
+            wire.send_frame(conn, _error_doc(
+                ServerStopped("replica draining"), header))
+            return
+        prompt = np.frombuffer(payload, dtype=np.int32)
+        deadline_ms = header.get("deadline_ms")
+        budget_s = (deadline_ms / 1000.0 if deadline_ms
+                    else self.server.config.result_timeout_s)
+        conn.settimeout(budget_s + 10.0)
+        try:
+            stream = self.server.decode_submit(
+                prompt, max_new_tokens=header.get("max_new"),
+                deadline_ms=deadline_ms, tenant=header.get("tenant"))
+            toks = stream.result(timeout_s=budget_s + 5.0)
+        except RequestError as exc:
+            wire.send_frame(conn, _error_doc(exc, header))
+            return
+        out = np.asarray(toks, dtype=np.int32)
+        wire.send_frame(
+            conn,
+            {"ok": True, "v": wire.PROTOCOL_VERSION,
+             "generated": int(out.size)},
+            np.ascontiguousarray(out).tobytes())
+
 
 def _wait_queue_empty(server, deadline_s, poll_s=0.02) -> int:
     """Bounded drain wait: poll until the admission queue is empty or
@@ -272,6 +300,19 @@ def add_worker_args(parser) -> None:
                              "(default MXNET_TPU_AOT_CACHE_DIR — the "
                              "pool stamps it into the worker env so "
                              "restarts start warm; docs/serving.md)")
+    parser.add_argument("--mesh-axes", default=None,
+                        help="tensor-parallel serving mesh axes, e.g. "
+                             "'model=-1' or 'batch=2,model=4' (default "
+                             "MXNET_TPU_SERVING_MESH; unset = "
+                             "single-device)")
+    parser.add_argument("--decode-slots", type=int, default=0,
+                        help="run a continuous-batching decode engine "
+                             "with this many KV slots beside the "
+                             "one-shot batcher (0 = off; the engine "
+                             "serves the deterministic TinyLM toy)")
+    parser.add_argument("--decode-max-len", type=int, default=256,
+                        help="decode engine per-slot capacity "
+                             "(prompt + generated tokens)")
 
 
 def cmd_worker(args) -> int:
@@ -302,6 +343,15 @@ def cmd_worker(args) -> int:
     # ServerConfig field (MXNET_TPU_AOT_CACHE_DIR)
     aot_kw = {"aot_dir": args.aot_dir} if getattr(args, "aot_dir", None) \
         else {}
+    # --mesh-axes beats MXNET_TPU_SERVING_MESH (which ServerConfig
+    # consults when shard_plan stays None); a bare axes string is
+    # promoted to a ShardPlan by the Server
+    if getattr(args, "mesh_axes", None):
+        aot_kw["shard_plan"] = args.mesh_axes
+    if getattr(args, "decode_slots", 0):
+        from .decode import DecodeConfig, TinyLM
+        aot_kw["decode_model"] = TinyLM(max_len=args.decode_max_len)
+        aot_kw["decode"] = DecodeConfig(slots=args.decode_slots)
     if getattr(args, "tenants", None):
         from .fleet import Fleet, FleetConfig
         cfg = FleetConfig(max_batch=args.max_batch,

@@ -561,3 +561,86 @@ def test_warm_cli_then_warm_server(tmp_path):
     assert probe.returncode == 0, probe.stderr[-2000:]
     cs = json.loads(probe.stdout.strip().splitlines()[-1])
     assert cs["compiles"] == 0 and cs["aot_loads"] == 4, cs
+
+
+# -- tensor-parallel keys (serving/shardplan.py joins the key material) ------
+
+def _two_device_plan():
+    import jax
+
+    from mxnet_tpu.serving.shardplan import ShardPlan
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    return ShardPlan(axes={"model": 2}, devices=jax.devices()[:2])
+
+
+def test_plan_joins_the_cache_key(tmp_path):
+    """The same model served single-device and sharded must occupy two
+    distinct entries: a tensor-parallel executable is only valid on its
+    exact mesh shape."""
+    cache = AOTCache.maybe(str(tmp_path / "aot"))
+    net = _mlp()
+    plan = _two_device_plan()
+    fp_plain = cache.fingerprint(net, np.float32)
+    fp_plan = cache.fingerprint(net, np.float32, plan=plan)
+    assert fp_plain != fp_plan
+    assert cache.entry_path(net, (8, 16), np.float32) != \
+        cache.entry_path(net, (8, 16), np.float32, plan=plan)
+    # a DIFFERENT mesh shape is a different key again
+    import jax
+    if len(jax.devices()) >= 4:
+        from mxnet_tpu.serving.shardplan import ShardPlan
+        plan4 = ShardPlan(axes={"model": 4}, devices=jax.devices()[:4])
+        assert cache.fingerprint(net, np.float32, plan=plan4) != fp_plan
+
+
+def test_plan_none_key_is_byte_compatible_with_the_historical_scheme(
+        tmp_path):
+    """``plan=None`` must contribute NOTHING to the hash — existing
+    single-device caches stay warm across this change.  The expected
+    digest below is the pre-plan recipe recomputed by hand; if this
+    test breaks, every deployed cache goes cold on upgrade."""
+    import hashlib
+
+    from mxnet_tpu.serving.cache import key_spec
+    cache = AOTCache.maybe(str(tmp_path / "aot"))
+    net = _mlp()
+    parts = [f"{type(net).__module__}.{type(net).__qualname__}",
+             repr(net), str(np.dtype(np.float32))]
+    names = net._structural_names()
+    parts.append("|".join(
+        f"{k}:{tuple(p.shape) if p.shape else ()}"
+        for k, p in sorted(names.items())))
+    trainable, aux = net._param_split()
+    for tag, params in (("tr", trainable), ("aux", aux)):
+        for p in params:
+            d = p._data[0]._data
+            parts.append(f"{tag}:{tuple(d.shape)}:{d.dtype}")
+    parts.append(str(key_spec().dtype))
+    expected = hashlib.sha1(
+        "\x1f".join(parts).encode("utf-8", "replace")).hexdigest()
+    assert cache.fingerprint(net, np.float32) == expected
+    assert cache.fingerprint(net, np.float32, plan=None) == expected
+
+
+def test_sharded_store_load_roundtrip_bit_identical(tmp_path):
+    """A sharded executable stores and loads under its plan key, and
+    the loaded predictor's outputs match the compiled one bitwise."""
+    from mxnet_tpu.serving.cache import CompiledPredictor
+    cache = AOTCache.maybe(str(tmp_path / "aot"))
+    net = _mlp()
+    plan = _two_device_plan()
+    plan.place(net, site="test")
+    pred = CompiledPredictor(net, plan=plan)
+    pred.aot_compile((8, 16), np.float32)
+    assert cache.store(pred, net, (8, 16), np.float32, plan=plan)
+    got = cache.load(net, (8, 16), np.float32, plan=plan)
+    assert got is not None
+    x = np.random.default_rng(3).standard_normal((8, 16)) \
+        .astype(np.float32)
+    a, _ = pred(x)
+    b, _ = got(x)
+    for u, v in zip(a, b):
+        assert np.array_equal(np.asarray(u), np.asarray(v))
+    # the plain (no-plan) key does NOT see the sharded entry
+    assert cache.load(net, (8, 16), np.float32) is None

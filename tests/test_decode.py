@@ -1,0 +1,447 @@
+"""mxnet_tpu.serving.decode — the continuous-batching decode engine.
+
+Covers the acceptance criteria of the decode story (docs/serving.md):
+bit-identical autoregressive output vs the pure-python reference under
+concurrent staggered streams, ZERO XLA compiles outside the warmed
+program set (the dedicated single-cell decode lattice + pow2 prefill
+buckets), slot admission (SlotsExhausted vs queue), per-sequence
+deadlines (admit-stage miss and mid-stream preempt), cancellation
+freeing its slot mid-stream, drain-on-stop completing queued work, the
+journal/doctor ``decode`` reduction, and the Server/Router integration
+(retryable SlotsExhausted moves a stream to another replica).
+
+The ``smoke`` test runs in CI tier 0.5 (ci/run_tests.sh) on a 2-device
+CPU mesh; the subprocess-worker test is marked ``slow``.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.diagnostics.journal import reset_journal
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving import (BucketGrid, DeadlineExceeded, RequestError,
+                               Server, ServerConfig, SlotsExhausted)
+from mxnet_tpu.serving.decode import DecodeConfig, DecodeEngine, TinyLM
+from mxnet_tpu.serving.report import serving_report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    reset_journal(path)
+    try:
+        yield path
+    finally:
+        reset_journal("stderr")
+
+
+def _records(path, kind=None):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+def _engine(**kw):
+    cfg_kw = {"slots": kw.pop("slots", 4),
+              "window_ms": kw.pop("window_ms", 1.0)}
+    cfg_kw.update({k: kw.pop(k) for k in list(kw)
+                   if k in ("queue_on_busy", "max_queue", "max_new_tokens",
+                            "default_deadline_ms", "prefill_chunk")})
+    model = kw.pop("model", None) or TinyLM()
+    eng = DecodeEngine(model, DecodeConfig(**cfg_kw), **kw)
+    eng.start()
+    eng.warmup()
+    return eng, model
+
+
+def _mkblock(dim=4):
+    net = nn.Dense(dim, in_units=dim)
+    net.initialize()
+    return net
+
+
+# -- the bucket-lattice pin (decode never snaps to a prefill bucket) ---------
+
+def test_for_decode_lattice_is_single_cell():
+    grid = BucketGrid.for_decode(8)
+    assert grid.grid_bound() == 1
+    # the ONE shape decode steps ever present snaps to the one cell
+    assert grid.batch_bucket(8) == 8
+    assert grid.feature_key((1,)) == (1,)
+
+
+def test_decode_step_shape_never_lands_in_a_prefill_bucket():
+    """The regression this pins: a (slots, 1) decode-step tensor fed to
+    a generic serving grid snaps to the smallest PREFILL bucket (batch
+    rounded up, feature dim bucketed), which would add a per-step
+    compile for every slot-count; the dedicated decode grid maps it to
+    exactly its own cell, so step recompiles are impossible by
+    construction."""
+    serving_grid = BucketGrid(max_batch=16, batch_buckets=(4, 8, 16),
+                              dim_buckets={0: (32, 64)})
+    decode_grid = BucketGrid.for_decode(6)
+    # the generic grid distorts the decode shape: batch 6 -> bucket 8,
+    # feature 1 -> bucket 32 — a different executable per distortion
+    assert serving_grid.batch_bucket(6) == 8
+    assert serving_grid.feature_key((1,)) == (32,)
+    # the decode grid is the identity on its one shape...
+    assert decode_grid.batch_bucket(6) == 6
+    assert decode_grid.feature_key((1,)) == (1,)
+    # ...and bounds compiles at exactly one executable
+    assert decode_grid.grid_bound() == 1
+
+
+def test_for_decode_rejects_degenerate_pools():
+    with pytest.raises(ValueError):
+        BucketGrid.for_decode(0)
+    with pytest.raises(ValueError):
+        BucketGrid.for_decode(4, step_width=0)
+
+
+# -- bit-exactness + the zero-mid-run-compile guarantee ----------------------
+
+def test_streams_bit_identical_and_zero_midrun_compiles():
+    eng, model = _engine(slots=4)
+    try:
+        warm = eng.counters["compiles"]
+        streams = []
+        for i in range(10):            # staggered prompts + lengths
+            prompt = [(i * 13 + j) % model.vocab
+                      for j in range(1 + (i % 7))]
+            n = 5 + (i * 3) % 20
+            streams.append((eng.submit(prompt, max_new_tokens=n),
+                            prompt, n))
+        for s, prompt, n in streams:
+            assert s.result(timeout_s=60) == model.reference(prompt, n)
+        assert eng.counters["compiles"] == warm, \
+            "decode compiled outside the warmed program set"
+        assert eng.counters["completed"] == 10
+    finally:
+        eng.stop()
+
+
+def test_prefill_chunking_covers_long_prompts():
+    """A prompt longer than every prefill bucket runs as a chain of
+    bucket-sized chunks (start offsets thread the absorb position) —
+    output must equal the reference exactly, with no new compiles."""
+    eng, model = _engine(slots=2, prefill_chunk=8)
+    try:
+        warm = eng.counters["compiles"]
+        prompt = list(range(1, 60))    # 59 tokens over 8-wide buckets
+        got = eng.generate(prompt, max_new_tokens=12)
+        assert got == model.reference(prompt, 12)
+        assert eng.counters["compiles"] == warm
+    finally:
+        eng.stop()
+
+
+# -- slot admission ----------------------------------------------------------
+
+def test_slots_exhausted_is_retryable_and_queue_path_completes():
+    model = TinyLM(max_len=20000)
+    eng, _ = _engine(model=model, slots=1, queue_on_busy=False)
+    try:
+        long_stream = eng.submit([1, 2, 3], max_new_tokens=15000)
+        deadline = time.monotonic() + 30
+        while eng.occupancy() < 1:     # wait for the slot to be taken
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        with pytest.raises(SlotsExhausted) as ei:
+            eng.submit([4, 5], max_new_tokens=4)
+        assert ei.value.retryable     # router moves it to another replica
+        assert ei.value.slots == 1
+        long_stream.cancel()
+        with pytest.raises(RequestError):
+            long_stream.result(timeout_s=60)
+    finally:
+        eng.stop()
+
+
+def test_cancel_mid_stream_frees_slot_with_partial_tokens():
+    model = TinyLM(max_len=20000)
+    eng, _ = _engine(model=model, slots=2)
+    try:
+        victim = eng.submit([7, 8, 9], max_new_tokens=15000)
+        deadline = time.monotonic() + 30
+        while not victim.tokens:       # stream is actively generating
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        victim.cancel()
+        with pytest.raises(RequestError) as ei:
+            victim.result(timeout_s=60)
+        assert not ei.value.retryable  # caller asked; not a router retry
+        got = len(victim.tokens)
+        assert 0 < got < 15000
+        # the slot is free again: a fresh stream admits and completes
+        deadline = time.monotonic() + 30
+        while eng.occupancy() > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        assert eng.generate([1], max_new_tokens=3) == \
+            model.reference([1], 3)
+        assert eng.counters["cancelled"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_deadline_preempts_mid_stream(journal_file):
+    model = TinyLM(max_len=200000)
+    eng, _ = _engine(model=model, slots=1)
+    try:
+        s = eng.submit([1], max_new_tokens=150000, deadline_ms=80.0)
+        with pytest.raises(DeadlineExceeded):
+            s.result(timeout_s=60)
+        assert eng.counters["preempted"] == 1
+        # the preempted stream's slot is reusable immediately
+        assert eng.generate([2], max_new_tokens=3) == \
+            model.reference([2], 3)
+    finally:
+        eng.stop()
+    assert _records(journal_file, "decode_preempt")
+
+
+def test_drain_on_stop_completes_queued_streams():
+    eng, model = _engine(slots=1, queue_on_busy=True)
+    streams = [(eng.submit([i + 1], max_new_tokens=6), [i + 1])
+               for i in range(5)]
+    eng.stop(drain=True)               # queued streams must still finish
+    for s, prompt in streams:
+        assert s.result(timeout_s=1) == model.reference(prompt, 6)
+
+
+def test_submit_validation_rejects_oversized_request():
+    eng, model = _engine(slots=2)
+    try:
+        with pytest.raises(RequestError) as ei:
+            eng.submit([1, 2], max_new_tokens=model.max_len)
+        assert not ei.value.retryable  # malformed everywhere, don't retry
+        with pytest.raises(RequestError):
+            eng.submit([], max_new_tokens=4)
+    finally:
+        eng.stop()
+
+
+# -- journal + doctor reduction ---------------------------------------------
+
+def test_serving_report_decode_section(journal_file):
+    eng, model = _engine(slots=4)
+    try:
+        for i in range(6):
+            eng.generate([i + 1], max_new_tokens=4 + i)
+    finally:
+        eng.stop()
+    rep = serving_report(journal_file)
+    dec = rep.get("decode")
+    assert dec is not None
+    assert dec["finished"] == 6
+    assert dec["admitted"] == 6
+    assert dec["tokens_out"] == sum(4 + i for i in range(6))
+    assert dec["steps"] > 0
+    assert sum(dec["occupancy_hist"].values()) == dec["steps"]
+    assert dec["warmup_programs"] > 0
+    assert dec["clean_stop"]
+
+
+# -- Server + Router integration --------------------------------------------
+
+def test_server_decode_beside_predict(journal_file):
+    model = TinyLM()
+    srv = Server(_mkblock(), config=ServerConfig(
+        window_ms=1.0, decode_model=model,
+        decode=DecodeConfig(slots=2, window_ms=1.0)))
+    srv.start()
+    try:
+        x = np.ones(4, dtype=np.float32)
+        y = np.asarray(srv.predict(x))          # one-shot path still up
+        assert y.shape == (4,)
+        assert srv.decode([3, 1, 4], max_new_tokens=9) == \
+            model.reference([3, 1, 4], 9)
+        assert "decode" in srv.stats()
+    finally:
+        srv.stop()
+    # the engine stops WITH the server, journaled
+    assert _records(journal_file, "decode_stop")
+
+
+def test_server_without_decode_model_rejects():
+    srv = Server(_mkblock(), config=ServerConfig(window_ms=1.0))
+    srv.start()
+    try:
+        with pytest.raises(RequestError) as ei:
+            srv.decode([1], max_new_tokens=2)
+        assert not ei.value.retryable
+    finally:
+        srv.stop()
+
+
+def test_router_decode_retries_exhausted_replica_onto_free_one(
+        tmp_path, journal_file):
+    """Replica A's single slot is pinned by a long stream; the router
+    must land the new stream on B (SlotsExhausted = placement miss,
+    retryable) — and A's breaker must NOT count it as a failure."""
+    from mxnet_tpu.serving.pool import PoolConfig, ReplicaPool
+    from mxnet_tpu.serving.router import Router, RouterConfig
+
+    model = TinyLM(max_len=20000)
+
+    def factory():
+        return Server(_mkblock(), config=ServerConfig(
+            window_ms=1.0, decode_model=model,
+            decode=DecodeConfig(slots=1, window_ms=1.0,
+                                queue_on_busy=False)))
+
+    pool = ReplicaPool(str(tmp_path / "pool"),
+                       PoolConfig(heartbeat_s=0.1, deadline_s=2.0))
+    pool.add_local("a", factory)
+    pool.add_local("b", factory)
+    pool.start()
+    router = Router(pool, RouterConfig(hedge_ms=-1.0, retries=3))
+    try:
+        # pin BOTH replicas' slots, then free one: the router may try
+        # the busy one first but must settle on the free one
+        pins = {rid: pool.replicas[rid].server.decode_submit(
+            [9], max_new_tokens=15000) for rid in ("a", "b")}
+        deadline = time.monotonic() + 30
+        while any(pool.replicas[r].server.decoder.occupancy() < 1
+                  for r in ("a", "b")):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        pins["b"].cancel()
+        with pytest.raises(RequestError):
+            pins["b"].result(timeout_s=60)
+        deadline = time.monotonic() + 30
+        while pool.replicas["b"].server.decoder.occupancy() > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        got = router.decode([2, 7], max_new_tokens=8, deadline_ms=20000)
+        assert got == model.reference([2, 7], 8)
+        pins["a"].cancel()
+        # busy-is-not-broken: no breaker transition was recorded
+        assert not _records(journal_file, "router_breaker")
+    finally:
+        router.stop()
+        pool.stop()
+
+
+# -- CI tier-0.5 smoke -------------------------------------------------------
+
+def test_decode_smoke_sharded_continuous_batching(journal_file):
+    """The tier-0.5 decode smoke (ci/run_tests.sh): a tensor-parallel
+    server on a 2-device CPU mesh runs 8 concurrent autoregressive
+    streams with staggered prompt/generation lengths through the
+    continuous batcher — every stream bit-identical to the reference
+    within its deadline, ZERO XLA compiles outside the warmed program
+    set, and a cancelled stream frees its slot for a successor."""
+    import jax
+
+    from mxnet_tpu.serving.shardplan import ShardPlan
+    model = TinyLM(max_len=20000)
+    plan = ShardPlan(axes={"model": 2}, devices=jax.devices()[:2])
+    srv = Server(_mkblock(8), config=ServerConfig(
+        window_ms=1.0, shard_plan=plan, decode_model=model,
+        decode=DecodeConfig(slots=4, window_ms=1.0)))
+    srv.start()
+    try:
+        eng = srv.decoder
+        warm = eng.counters["compiles"]
+        assert warm > 0                # warmup really built the set
+
+        results = {}
+        def client(i):
+            prompt = [(i * 11 + j) % model.vocab
+                      for j in range(1 + (i % 5))]
+            n = 6 + (i * 5) % 24
+            got = srv.decode(prompt, max_new_tokens=n,
+                             deadline_ms=30000)
+            results[i] = (got == model.reference(prompt, n))
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(results) == 8 and all(results.values()), results
+
+        # cancellation frees its slot: pin a long stream, cancel it,
+        # then a successor admits and completes on the freed slot
+        victim = srv.decode_submit([5], max_new_tokens=15000)
+        deadline = time.monotonic() + 30
+        while not victim.tokens:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        victim.cancel()
+        with pytest.raises(RequestError):
+            victim.result(timeout_s=60)
+        assert srv.decode([6], max_new_tokens=4) == \
+            model.reference([6], 4)
+
+        assert eng.counters["compiles"] == warm, \
+            "decode compiled mid-run"
+        assert eng.counters["cancelled"] >= 1
+    finally:
+        srv.stop()
+    # the journal tells the same story through the doctor reduction
+    rep = serving_report(journal_file)
+    assert rep["decode"]["finished"] >= 9
+    assert rep["decode"]["cancelled_total"] >= 1
+    assert rep["sharding"]["params"] >= 1
+
+
+# -- subprocess worker (wire protocol) ---------------------------------------
+
+@pytest.mark.slow
+def test_proc_worker_decode_roundtrip(tmp_path):
+    """A real subprocess replica with --decode-slots serves decode over
+    the wire protocol bit-identically; a second concurrent stream rides
+    the same worker."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.resilience import commit
+    from mxnet_tpu.serving.pool import PoolConfig, ReplicaPool
+    from mxnet_tpu.serving.router import Router, RouterConfig
+
+    model = TinyLM()
+    ck = str(tmp_path / "ckpt")
+    stage = commit.prepare_stage(ck, 1)
+    nd.save(os.path.join(stage, "net.params"),
+            {"w": nd.array(np.asarray([3.0], np.float32))})
+    commit.finalize(ck, 1)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+           "MXNET_TPU_TRACE": "off"}
+    env.pop("XLA_FLAGS", None)
+    pool = ReplicaPool(str(tmp_path / "pool"),
+                       PoolConfig(heartbeat_s=0.25, deadline_s=2.5))
+    pool.add_proc("p0", {"--model": "scale", "--ckpt-root": ck,
+                         "--window-ms": 1.0, "--reload-poll-s": -1.0,
+                         "--decode-slots": 2}, env=env)
+    pool.start()
+    router = Router(pool, RouterConfig(hedge_ms=-1.0))
+    try:
+        import concurrent.futures as cf
+        def one(i):
+            p = [i + 1, i + 2, i + 3]
+            n = 10 + i
+            return router.decode(p, max_new_tokens=n) == \
+                model.reference(p, n)
+        with cf.ThreadPoolExecutor(4) as ex:
+            assert all(ex.map(one, range(4)))
+        # predict still serves on the same worker
+        x = np.arange(4, dtype=np.float32)
+        resp = router.call(x, deadline_ms=8000)
+        assert np.allclose(np.asarray(resp.value), x * 3.0, atol=1e-5)
+    finally:
+        router.stop()
+        pool.stop()

@@ -1,0 +1,305 @@
+"""Tensor-parallel serving (serving/shardplan.py, docs/serving.md).
+
+Acceptance criteria: on a >= 2-device CPU mesh a sharded predictor
+serves bit-identically to the single-device reference (the default rule
+column-shards the OUTPUT dim, so no reduction crosses shards);
+checkpoint weights land on the serving mesh through the SAME
+``elastic.reshard`` placement the elastic restore path uses
+(``place_named`` at startup, ``place_global``-style adoption on hot
+reload); and an AOT warm restart of a sharded replica performs ZERO XLA
+compiles (the mesh signature joins the cache key).  The ``smoke`` test
+runs in CI tier 0.5.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import observability as obs
+from mxnet_tpu.diagnostics.journal import reset_journal
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving import Server, ServerConfig
+from mxnet_tpu.serving.shardplan import (ShardPlan, parse_axes,
+                                         plan_from_env)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    reset_journal(path)
+    try:
+        yield path
+    finally:
+        reset_journal("stderr")
+
+
+def _records(path, kind=None):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+def _mlp(dim=8, seed=11):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=dim))
+        net.add(nn.Dense(4, in_units=16))
+    net.initialize()
+    return net
+
+
+def _snapshot(block):
+    """Host copies of every parameter, keyed structurally — the
+    weight-clone idiom the fleet's page-out uses."""
+    out = {}
+    for name, param in block._structural_names().items():
+        arr = param.data(param.list_ctx()[0])
+        out[name] = np.asarray(getattr(arr, "_data", arr))
+    return out
+
+
+def _clone_into(dst, src):
+    from mxnet_tpu import nd
+    dst.load_dict({k: nd.array(v) for k, v in _snapshot(src).items()},
+                  ignore_extra=True)
+
+
+def _plan(n=2, **kw):
+    import jax
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs >= {n} devices")
+    return ShardPlan(axes={"model": n}, devices=jax.devices()[:n], **kw)
+
+
+# -- spec derivation ---------------------------------------------------------
+
+def test_default_rule_shards_the_output_dim():
+    """MXNet blocks store (out, in): the tensor-parallel default is
+    P("model", None) — a column-split matmul that concatenates, never
+    reduces, so sharded outputs are bit-identical by construction."""
+    plan = _plan()
+    assert tuple(plan.param_spec("dense0_weight", (16, 8))) == \
+        ("model", None)
+    # vectors/scalars replicate (a sharded bias would change the math)
+    assert tuple(plan.param_spec("dense0_bias", (16,))) == ()
+    # 4-D conv kernels shard dim 0 (out channels) too
+    assert tuple(plan.param_spec("conv0_weight", (16, 3, 3, 3))) == \
+        ("model", None, None, None)
+
+
+def test_indivisible_dims_degrade_to_replication():
+    plan = _plan()
+    assert tuple(plan.param_spec("odd_weight", (7, 8))) == (None, None)
+    assert "odd_weight" in plan.degraded
+
+
+def test_param_rules_override_the_default():
+    from jax.sharding import PartitionSpec as P
+    plan = _plan(param_rules=((r"_weight$", P(None, "model")),))
+    # an (in, out) layout opts into row sharding via rules
+    assert tuple(plan.param_spec("dense0_weight", (16, 8))) == \
+        (None, "model")
+
+
+def test_parse_axes_and_env_plan(monkeypatch):
+    assert parse_axes("model=-1") == {"model": -1}
+    assert parse_axes("batch=2, model=4") == {"batch": 2, "model": 4}
+    monkeypatch.delenv("MXNET_TPU_SERVING_MESH", raising=False)
+    assert plan_from_env() is None
+    monkeypatch.setenv("MXNET_TPU_SERVING_MESH", "off")
+    assert plan_from_env() is None
+    import jax
+    if len(jax.devices()) >= 2:
+        monkeypatch.setenv("MXNET_TPU_SERVING_MESH", "model=2")
+        plan = plan_from_env(devices=jax.devices()[:2])
+        assert plan is not None and plan.axes == {"model": 2}
+
+
+# -- weight placement rides elastic.reshard ----------------------------------
+
+def test_place_named_lands_the_planned_sharding():
+    from jax.sharding import NamedSharding
+
+    from mxnet_tpu.elastic.reshard import place_named
+    plan = _plan()
+    host = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+    spec = plan.param_spec("w_weight", host.shape)
+    arr = place_named("w_weight", plan.mesh, spec, host)
+    assert isinstance(arr.sharding, NamedSharding)
+    assert arr.sharding == plan.param_sharding("w_weight", host.shape)
+    np.testing.assert_array_equal(np.asarray(arr), host)
+    # each shard holds exactly its row slice (really partitioned, not
+    # replicated under a named label)
+    assert arr.addressable_shards[0].data.shape == (8, 8)
+
+
+def test_place_global_preserves_the_serving_sharding():
+    """Hot reload drops host entries onto the LIVE array's sharding —
+    the compiled predictors were lowered against those placements."""
+    from mxnet_tpu.elastic.reshard import place_global, place_named
+    plan = _plan()
+    spec = plan.param_spec("w_weight", (16, 8))
+    cur = place_named("w_weight", plan.mesh, spec,
+                      np.zeros((16, 8), np.float32))
+    host = np.random.default_rng(0).standard_normal((16, 8)) \
+        .astype(np.float32)
+    arr = place_global("w_weight", cur, host)
+    assert arr.sharding == cur.sharding
+    np.testing.assert_array_equal(np.asarray(arr), host)
+
+
+def test_plan_place_and_adopt_entries(journal_file):
+    from jax.sharding import NamedSharding
+    plan = _plan()
+    net = _mlp()
+    plan.place(net, site="test_place")
+    recs = _records(journal_file, "shard_place")
+    assert recs and recs[-1]["site"] == "test_place"
+    assert recs[-1]["mesh"]["axes"] == {"model": 2}
+    shardings = {}
+    for name, param in net._structural_names().items():
+        arr = param.data(param.list_ctx()[0])._data
+        assert isinstance(arr.sharding, NamedSharding)
+        shardings[name] = arr.sharding
+    # adopt_entries swaps VALUES while every placement survives
+    new = {k: v + 1.0 for k, v in _snapshot(net).items()}
+    plan.adopt_entries(net, new)
+    for name, param in net._structural_names().items():
+        arr = param.data(param.list_ctx()[0])._data
+        assert arr.sharding == shardings[name]
+        np.testing.assert_array_equal(np.asarray(arr), new[name])
+
+
+# -- the serving acceptance criteria -----------------------------------------
+
+def test_smoke_sharded_predictor_bit_identical_to_single_device(
+        journal_file):
+    """The tier-0.5 sharded smoke: the SAME weights served through a
+    2-device tensor-parallel Server and a plain single-device Server
+    answer bit-identically across bucket shapes, and the placement is
+    journaled."""
+    plan = _plan()
+    ref_net, tp_net = _mlp(), _mlp(seed=99)
+    _clone_into(tp_net, ref_net)
+    ref = Server(ref_net, config=ServerConfig(window_ms=1.0)).start()
+    tp = Server(tp_net, config=ServerConfig(window_ms=1.0,
+                                            shard_plan=plan)).start()
+    try:
+        rng = np.random.default_rng(5)
+        for n in (1, 3, 8):
+            xs = [rng.standard_normal(8).astype(np.float32)
+                  for _ in range(n)]
+            for x in xs:
+                a = np.asarray(ref.predict(x))
+                b = np.asarray(tp.predict(x))
+                np.testing.assert_array_equal(a, b)
+    finally:
+        ref.stop()
+        tp.stop()
+    recs = _records(journal_file, "shard_place")
+    assert recs and recs[-1]["site"] == "serving_start"
+
+
+def test_sharded_through_router_matches_single_device(tmp_path):
+    from mxnet_tpu.serving.pool import PoolConfig, ReplicaPool
+    from mxnet_tpu.serving.router import Router, RouterConfig
+    ref_net = _mlp()
+    snap = _snapshot(ref_net)
+
+    def factory():
+        from mxnet_tpu import nd
+        net = _mlp(seed=123)
+        net.load_dict({k: nd.array(v) for k, v in snap.items()},
+                      ignore_extra=True)
+        return Server(net, config=ServerConfig(
+            window_ms=1.0, shard_plan=_plan()))
+
+    ref = Server(ref_net, config=ServerConfig(window_ms=1.0)).start()
+    pool = ReplicaPool(str(tmp_path / "pool"),
+                       PoolConfig(heartbeat_s=0.1, deadline_s=2.0))
+    pool.add_local("tp0", factory)
+    pool.start()
+    router = Router(pool, RouterConfig(hedge_ms=-1.0))
+    try:
+        x = np.random.default_rng(9).standard_normal(8) \
+            .astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(router.call(x, deadline_ms=10000).value),
+            np.asarray(ref.predict(x)))
+    finally:
+        router.stop()
+        pool.stop()
+        ref.stop()
+
+
+def test_sharded_warm_restart_zero_compiles(tmp_path):
+    """AOT warm restart of a tensor-parallel replica: the second start
+    on the same cache dir (same mesh) loads every warmed bucket with
+    ZERO XLA compiles and answers bit-identically."""
+    root = str(tmp_path / "aot")
+    snap = None
+    x = np.ones(8, np.float32)
+
+    def boot():
+        nonlocal snap
+        from mxnet_tpu import nd
+        net = _mlp()
+        if snap is None:
+            snap = _snapshot(net)
+        else:
+            net.load_dict({k: nd.array(v) for k, v in snap.items()},
+                          ignore_extra=True)
+        cfg = ServerConfig(window_ms=1.0, shard_plan=_plan(),
+                           aot_dir=root, aot_prewarm=((8,),))
+        return Server(net, config=cfg).start()
+
+    obs.reset_metrics()
+    cold = boot()
+    try:
+        y_cold = np.asarray(cold.predict(x))
+        assert obs.compile_stats()["compiles"] > 0
+        assert cold.stats()["aot"]["stores"] > 0
+    finally:
+        cold.stop()
+
+    obs.reset_metrics()
+    warm = boot()
+    try:
+        y_warm = np.asarray(warm.predict(x))
+        cs = obs.compile_stats()
+        assert cs["compiles"] == 0, cs     # the zero-cold-start proof
+        assert cs["aot_loads"] > 0
+        np.testing.assert_array_equal(y_cold, y_warm)
+    finally:
+        warm.stop()
+
+    # a DIFFERENT mesh shape must NOT load those entries (key includes
+    # the mesh signature): 4-device boot compiles fresh
+    import jax
+    if len(jax.devices()) >= 4:
+        from mxnet_tpu import nd
+        net = _mlp(seed=321)
+        net.load_dict({k: nd.array(v) for k, v in snap.items()},
+                      ignore_extra=True)
+        cfg = ServerConfig(window_ms=1.0, shard_plan=_plan(4),
+                           aot_dir=root, aot_prewarm=((8,),))
+        obs.reset_metrics()
+        other = Server(net, config=cfg).start()
+        try:
+            np.testing.assert_array_equal(np.asarray(other.predict(x)),
+                                          y_cold)
+            assert obs.compile_stats()["compiles"] > 0
+        finally:
+            other.stop()
