@@ -27,7 +27,8 @@ import ast
 import re
 
 __all__ = ["ModuleIndex", "FunctionInfo", "build_index", "lock_key",
-           "classify_blocking", "resolve_callee", "module_imports"]
+           "classify_blocking", "resolve_callee", "resolve_func_ref",
+           "module_imports"]
 
 # ---------------------------------------------------------------------------
 # receiver vocabularies
@@ -43,6 +44,11 @@ LOCK_MAKERS = {
 # inherited `self._lock`, a lock handed in as an argument): the leaf
 # identifier reads like a lock
 _LOCKISH_RE = re.compile(r"(?:lock|mutex|semaphore|sem)s?$", re.IGNORECASE)
+# condition variables get their own vocabulary on top of the lock one:
+# G25 cares that `.wait()` sits in a predicate loop, which only makes
+# sense for Condition receivers (an Event.wait is level-triggered)
+COND_MAKERS = {"threading.Condition"}
+_CONDISH_RE = re.compile(r"(?:cond|cv|condition)s?$", re.IGNORECASE)
 
 QUEUE_MAKERS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
                 "queue.SimpleQueue", "multiprocessing.Queue",
@@ -119,7 +125,9 @@ class ModuleIndex:
         self._methods: dict[str, set] = {}      # class -> method names
         self.receivers: dict[str, str] = {}     # dotted recv -> kind
         self.lock_recvs: set = set()            # dotted recvs made from
-        self._collect(ctx.tree)                 # LOCK_MAKERS
+        self.cond_recvs: set = set()            # LOCK_MAKERS / COND_MAKERS
+        self._thread_cls = None                 # memo: thread_classes()
+        self._collect(ctx.tree)
 
     # -- construction -------------------------------------------------------
     def _collect(self, tree):
@@ -177,6 +185,8 @@ class ModuleIndex:
                     continue
                 if pool == "lock":
                     self.lock_recvs.add(dotted)
+                    if name in COND_MAKERS:
+                        self.cond_recvs.add(dotted)
                 else:
                     self.receivers[dotted] = pool
 
@@ -198,9 +208,53 @@ class ModuleIndex:
                 return owner
         return None
 
+    # -- thread-subclass detection (their run() is a thread root) -----------
+    def thread_classes(self) -> set:
+        """Class names whose base chain (following same-module links)
+        reaches ``threading.Thread`` / ``multiprocessing.Process`` —
+        their ``run`` methods execute on the spawned thread."""
+        if self._thread_cls is not None:
+            return self._thread_cls
+
+        def resolve_base(dotted):
+            parts = dotted.split(".")
+            expansion = self.ctx.aliases.get(parts[0])
+            if expansion:
+                parts = expansion.split(".") + parts[1:]
+            return ".".join(parts)
+
+        def escapes(cls, seen):
+            if cls in seen:
+                return False             # cyclic bases: malformed input
+            seen.add(cls)
+            for base in self.classes.get(cls, ()):
+                if resolve_base(base) in THREAD_MAKERS:
+                    return True
+                leaf = base.split(".")[-1]
+                if leaf in self.classes and escapes(leaf, seen):
+                    return True
+            return False
+
+        self._thread_cls = {c for c in self.classes if escapes(c, set())}
+        return self._thread_cls
+
 
 def build_index(ctx) -> ModuleIndex:
     return ModuleIndex(ctx)
+
+
+def _site_class(index: ModuleIndex, cls, fnkey):
+    """The class ``self`` refers to at a site: the enclosing method's
+    class, or — inside a nested def of a method, whose FunctionInfo
+    carries no class — the class named by the key prefix (a closure's
+    ``self`` is the method's)."""
+    if cls:
+        return cls
+    if fnkey and "." in fnkey:
+        head = fnkey.split(".", 1)[0]
+        if head in index.classes:
+            return head
+    return None
 
 
 def resolve_callee(index: ModuleIndex, call: ast.Call, cls, fnkey):
@@ -221,15 +275,51 @@ def resolve_callee(index: ModuleIndex, call: ast.Call, cls, fnkey):
         return None
     if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
         recv = func.value.id
-        if recv in ("self", "cls") and cls:
-            owner = index.method_owner(cls, func.attr)
-            if owner:
-                return f"{owner}.{func.attr}"
+        if recv in ("self", "cls"):
+            site_cls = _site_class(index, cls, fnkey)
+            if site_cls:
+                owner = index.method_owner(site_cls, func.attr)
+                if owner:
+                    return f"{owner}.{func.attr}"
             return None
         if recv in index.classes:
             owner = index.method_owner(recv, func.attr)
             if owner:
                 return f"{owner}.{func.attr}"
+    return None
+
+
+def resolve_func_ref(index: ModuleIndex, node, cls, fnkey):
+    """Same-module function key a *function reference* (not a call)
+    points at — ``self._run`` passed as a Thread target, a nested
+    ``worker`` handed to a pool, a SIBLING nested def spawned from a
+    launcher closure — or None. The thread-escape analysis uses this
+    to turn spawn sites into call-graph roots."""
+    if isinstance(node, ast.Name):
+        scope = fnkey or ""
+        while scope:                 # enclosing scopes, innermost first
+            # a class prefix is a namespace, not a lexical scope — a
+            # bare name never resolves to an unqualified method
+            if scope == fnkey or scope in index.functions:
+                cand = f"{scope}.{node.id}"
+                if cand in index.functions:
+                    return cand
+            scope = scope.rsplit(".", 1)[0] if "." in scope else ""
+        if node.id in index.functions:
+            return node.id
+        return None
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        recv = node.value.id
+        if recv in ("self", "cls"):
+            site_cls = _site_class(index, cls, fnkey)
+            if site_cls:
+                owner = index.method_owner(site_cls, node.attr)
+                if owner:
+                    return f"{owner}.{node.attr}"
+        elif recv in index.classes:
+            owner = index.method_owner(recv, node.attr)
+            if owner:
+                return f"{owner}.{node.attr}"
     return None
 
 

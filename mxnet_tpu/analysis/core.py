@@ -32,6 +32,7 @@ import hashlib
 import io
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass
 
@@ -104,11 +105,45 @@ def load_rules() -> dict[str, Rule]:
     """Import the rule modules (idempotent) and return the registry."""
     from . import rules_generic, rules_jax   # noqa  (registration side effect)
     from . import rules_concurrency          # noqa  (registration side effect)
+    from . import rules_races                # noqa  (registration side effect)
     return dict(sorted(_RULES.items()))
 
 
 def all_rules() -> list[Rule]:
     return list(load_rules().values())
+
+
+# per-rule wall-time accumulation (doctor --lint): None = off. The
+# first rule to touch a file's summaries pays the shared extraction
+# walk, so interprocedural timing concentrates on the lowest-numbered
+# G15+ rule — documented in docs/static_analysis.md.
+_rule_timings: dict | None = None
+
+
+def collect_rule_timings(enabled=True) -> None:
+    """Turn per-rule timing on/off (process-wide; forked ``--jobs``
+    children inherit the setting and drain their share back)."""
+    global _rule_timings
+    _rule_timings = {} if enabled else None
+
+
+def drain_rule_timings() -> dict:
+    """``{code: [wall_s, raw_finding_count]}`` accumulated since the
+    last drain; resets the accumulator (stays enabled)."""
+    global _rule_timings
+    if _rule_timings is None:
+        return {}
+    out, _rule_timings = _rule_timings, {}
+    return out
+
+
+def merge_rule_timings(delta) -> None:
+    if _rule_timings is None or not delta:
+        return
+    for code, (wall, count) in delta.items():
+        rec = _rule_timings.setdefault(code, [0.0, 0])
+        rec[0] += wall
+        rec[1] += count
 
 
 def _dotted_parts(node):
@@ -271,7 +306,15 @@ def lint_file(path: str, rules=None, root: str | None = None):
     ctx = FileContext(rel, src, tree)
     findings = []
     for rule in rules:
-        findings.extend(rule.check(ctx))
+        if _rule_timings is None:
+            findings.extend(rule.check(ctx))
+        else:
+            t0 = time.perf_counter()
+            fnd = list(rule.check(ctx))
+            rec = _rule_timings.setdefault(rule.code, [0.0, 0])
+            rec[0] += time.perf_counter() - t0
+            rec[1] += len(fnd)
+            findings.extend(fnd)
     if not findings:
         return []       # clean file: skip the suppression/span passes
     sup = _suppressions(ctx.lines)
@@ -372,7 +415,7 @@ def _lint_one(args):
     registry = load_rules()
     rules = [registry[c] for c in codes if c in registry]
     findings = lint_file(fp, rules=rules, root=root)
-    return findings, _summaries.drain_active_cache()
+    return findings, _summaries.drain_active_cache(), drain_rule_timings()
 
 
 def run(paths=None, rules=None, excludes=DEFAULT_EXCLUDES, root=".",
@@ -396,11 +439,12 @@ def run(paths=None, rules=None, excludes=DEFAULT_EXCLUDES, root=".",
             import multiprocessing as mp
             codes = [r.code for r in rules]
             with mp.get_context("fork").Pool(jobs) as pool:
-                for fnd, delta in pool.imap_unordered(
+                for fnd, delta, timings in pool.imap_unordered(
                         _lint_one, [(fp, codes, root) for fp in files],
                         chunksize=4):
                     findings.extend(fnd)
                     _summaries.merge_cache_delta(delta)
+                    merge_rule_timings(timings)
             findings.sort(key=Finding.sort_key)
             return findings, len(files)
         except (ImportError, ValueError, OSError):

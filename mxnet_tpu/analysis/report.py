@@ -35,12 +35,15 @@ def lint_report(root=None) -> dict:
     # process (doctor imports the runtime); serial + warm cache is fast
     # enough, and a wedged doctor would be the worst possible irony
     jobs = 1 if "jax" in sys.modules else 0
+    core.collect_rule_timings(True)
     try:
         findings, n_files = core.run(root=root, jobs=jobs)
     except (OSError, SyntaxError) as e:
         return {"ok": False, "error": type(e).__name__,
                 "detail": str(e)[:300], "root": root}
     finally:
+        timings = core.drain_rule_timings()
+        core.collect_rule_timings(False)
         _summaries.set_active_cache(prev)
         if cache is not None:
             try:
@@ -60,7 +63,16 @@ def lint_report(root=None) -> dict:
     rules: dict = {}
     for f in new:
         rules[f.code] = rules.get(f.code, 0) + 1
+    # per-rule cost/yield: wall-clock spent inside each rule's check()
+    # and the RAW sites it flagged (before suppressions/baseline —
+    # inline-disabled sites still cost their detection time). The
+    # first interprocedural rule per file pays the shared summary
+    # extraction, so its wall time reads high by design.
+    rule_stats = {
+        code: {"wall_ms": round(wall * 1000.0, 2), "findings": count}
+        for code, (wall, count) in sorted(timings.items())}
     return {"ok": True, "root": root, "files": n_files,
             "new": len(new), "baselined": len(based), "rules": rules,
+            "rule_stats": rule_stats,
             "cache": cache.stats() if cache is not None else None,
             "wall_s": round(time.perf_counter() - t0, 2)}

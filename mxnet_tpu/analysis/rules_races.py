@@ -1,0 +1,284 @@
+"""Data-race rules (G22-G25) — static thread-escape + lockset analysis.
+
+G15-G19 check lock *discipline* (what happens while a lock is held);
+nothing checked lock *consistency*: that a shared field is protected by
+the SAME lock everywhere, or by any lock at all. That gap is exactly
+where the serving stack's worst shipped bugs lived — the PR-9
+latched-probe TOCTOU and the PR-11 ``Heartbeat.beat()`` stale-overwrite
+were both fields whose sites disagreed about the protecting lock.
+
+This family runs a static variant of the Eraser lockset algorithm on
+the schema-v2 summaries:
+
+- **thread escape** (:class:`~.summaries.ModuleSummaries`
+  ``thread_roots`` / ``thread_reachable``): spawn targets
+  (``Thread(target=...)``, ``Timer`` callbacks, ``*callback*``
+  registrations) and Thread-subclass ``run()`` methods seed a forward
+  reachability pass, so every function knows whether it can run
+  concurrently with the object's other methods;
+- **per-class locksets**: every ``self._x`` site carries the locks
+  lexically held there, widened by ``entry_locks`` (a private helper
+  only ever called under ``self._lock`` inherits it). An attribute is
+  *thread-shared* when it is touched from a thread-reachable function
+  and from at least one other function.
+
+Deliberate asymmetries (FP control, documented in
+docs/static_analysis.md): unlocked READS of an otherwise-locked field
+are tolerated (single-reader snapshots, monitoring counters — G24
+covers the read-then-act case that actually corrupts state), and
+``__init__`` writes are ignored (Eraser's init refinement: the object
+is not published yet). Scope: mxnet_tpu/ library code.
+"""
+from __future__ import annotations
+
+from . import callgraph as cg
+from . import summaries as sm
+from .core import Rule, register
+
+__all__ = ["race_model"]
+
+
+class _Site:
+    __slots__ = ("mode", "fn", "method", "line", "locks", "treach")
+
+    def __init__(self, mode, fn, method, line, locks, treach):
+        self.mode = mode          # "r" | "w" | "c"
+        self.fn = fn              # full function key
+        self.method = method      # method name (init refinement)
+        self.line = line
+        self.locks = locks        # frozenset of effective lock keys
+        self.treach = treach      # on a thread-reachable path
+
+
+def _locks_str(locks) -> str:
+    return ", ".join(sorted(cg.lock_display(k) for k in locks)) \
+        or "no lock"
+
+
+def race_model(ctx):
+    """``{(cls, attr): [Site, ...]}`` for every class attribute of the
+    file, memoized per FileContext — the four race rules share one
+    pass. Sites carry EFFECTIVE locksets (lexically held + guaranteed
+    on entry) and the thread-reachability of their function."""
+    model = getattr(ctx, "_race_model", None)
+    if model is not None:
+        return model
+    ms = sm.for_context(ctx)
+    model = {}
+    for key, s in ms.functions.items():
+        if "." not in key:
+            continue
+        head, rest = key.split(".", 1)
+        if head in ms.functions:
+            continue              # nested def in a module function
+        method = rest.split(".", 1)[0]
+        entry = ms.entry_locks.get(key, frozenset())
+        treach = key in ms.thread_reachable
+        for attr, mode, line, held in s.attrs:
+            site = _Site(mode, key, method, line,
+                         frozenset(held) | entry, treach)
+            model.setdefault((head, attr), []).append(site)
+    for sites in model.values():
+        sites.sort(key=lambda st: (st.line, st.fn))
+    ctx._race_model = model
+    return model
+
+
+def _live(sites):
+    """Init-refined accesses: ``__init__`` runs before the object is
+    published, so its writes don't participate in the lockset."""
+    return [st for st in sites if st.method != "__init__"]
+
+
+def _thread_shared(live) -> bool:
+    return any(st.treach for st in live) and len({st.fn for st in live}) > 1
+
+
+@register
+class UnlockedSharedMutation(Rule):
+    code = "G22"
+    name = "unlocked-shared-mutation"
+    severity = "error"
+    doc = ("A class attribute is mutated with NO lock held while other "
+           "sites of the same attribute take a lock for it — on a "
+           "class whose methods run concurrently (the module spawns a "
+           "thread that reaches them). The locked sites prove the "
+           "author considered the field shared; the unlocked write is "
+           "then a torn update waiting for load (Eraser's core "
+           "signal: the candidate lockset intersects to empty with a "
+           "non-trivial starting set). Effective locksets include "
+           "entry locks — a helper only ever called under the lock "
+           "does NOT flag. Unlocked reads are deliberately tolerated "
+           "(snapshot/monitoring patterns); `__init__` writes are "
+           "pre-publication and ignored. Fix: take the same lock the "
+           "other sites take, or — for genuine single-writer fields — "
+           "document the ownership with an inline disable. Scope: "
+           "mxnet_tpu/ library code.")
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        ms = sm.for_context(ctx)
+        if not ms.thread_roots:
+            return
+        for (cls, attr), sites in sorted(race_model(ctx).items()):
+            live = _live(sites)
+            if not _thread_shared(live):
+                continue
+            locked = [st for st in live if st.locks]
+            if not locked:
+                continue
+            bare = [st for st in live if st.mode == "w" and not st.locks]
+            if not bare:
+                continue
+            guard = _locks_str(set().union(*(st.locks for st in locked)))
+            for st in bare:
+                yield self.finding(
+                    ctx, st.line,
+                    f"`self.{attr}` mutated with no lock on a "
+                    f"thread-shared path, but other sites guard it "
+                    f"with {guard} (e.g. line {locked[0].line}) — a "
+                    f"concurrent peer can interleave mid-update; take "
+                    f"the same lock here")
+
+
+@register
+class InconsistentLockset(Rule):
+    code = "G23"
+    name = "inconsistent-lockset"
+    severity = "error"
+    doc = ("Two sites protect the SAME class attribute with DISJOINT "
+           "locks on a class whose methods run concurrently — each "
+           "site is individually 'locked' but no common lock orders "
+           "the two accesses, so they interleave exactly as if "
+           "unlocked. This is the shape of the PR-11 "
+           "`Heartbeat.beat()` stale-overwrite bug (the daemon and the "
+           "caller each took their own lock around the shared ledger "
+           "state; the pre-fix shape is the "
+           "tests/data/graftlint/hist_heartbeat_overwrite.py "
+           "fixture). Only pairs with at least one WRITE flag "
+           "(read/read needs no ordering); attributes with an "
+           "unlocked write are G22's territory, not double-reported "
+           "here. Fix: pick ONE lock for the field and use it at "
+           "every site. Scope: mxnet_tpu/ library code.")
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        ms = sm.for_context(ctx)
+        if not ms.thread_roots:
+            return
+        for (cls, attr), sites in sorted(race_model(ctx).items()):
+            live = _live(sites)
+            if not _thread_shared(live):
+                continue
+            writes = [st for st in live if st.mode == "w"]
+            if not writes or any(not st.locks for st in writes):
+                continue              # no writes / G22's case
+            flagged = False
+            for w in writes:
+                for other in live:
+                    if other is w or not other.locks:
+                        continue
+                    if w.locks & other.locks:
+                        continue
+                    yield self.finding(
+                        ctx, max(w.line, other.line),
+                        f"`self.{attr}` written under "
+                        f"{_locks_str(w.locks)} (line {w.line}) but "
+                        f"accessed under disjoint "
+                        f"{_locks_str(other.locks)} (line "
+                        f"{other.line}) — no common lock orders the "
+                        f"two, so they interleave as if unlocked; "
+                        f"protect the field with ONE lock everywhere")
+                    flagged = True
+                    break
+                if flagged:
+                    break             # one finding per attribute
+
+
+@register
+class CheckThenActRace(Rule):
+    code = "G24"
+    name = "check-then-act-race"
+    severity = "error"
+    doc = ("A membership test over a shared dict/set (`if k not in "
+           "self._x:`) guards a mutation of the same attribute, but no "
+           "single lock spans BOTH the check and the act — between "
+           "them a concurrent peer can invalidate the answer, so two "
+           "threads both pass the test and both mutate (TOCTOU). This "
+           "is the shape behind the PR-9 latched half-open probe "
+           "(membership checked during enumeration, slot claimed "
+           "later; the pre-fix shape is the "
+           "tests/data/graftlint/hist_latched_probe_toctou.py "
+           "fixture). Flags only attributes that are thread-shared "
+           "(touched from a thread-reachable function and at least "
+           "one other); a `with lock:` enclosing both check and act — "
+           "including via entry locks — is the fix and silences it. "
+           "Scope: mxnet_tpu/ library code.")
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        ms = sm.for_context(ctx)
+        if not ms.thread_roots:
+            return
+        model = race_model(ctx)
+        for key, s in sorted(ms.functions.items()):
+            if "." not in key:
+                continue
+            head = key.split(".", 1)[0]
+            if head in ms.functions:
+                continue
+            entry = ms.entry_locks.get(key, frozenset())
+            for attr, t_line, t_locks, a_line, a_locks in s.toctou:
+                live = _live(model.get((head, attr), ()))
+                if not _thread_shared(live):
+                    continue
+                eff_t = frozenset(t_locks) | entry
+                eff_a = frozenset(a_locks) | entry
+                if eff_t & eff_a:
+                    continue          # one lock spans check AND act
+                yield self.finding(
+                    ctx, a_line,
+                    f"`self.{attr}` mutated based on a membership "
+                    f"test at line {t_line}, but no lock spans both "
+                    f"(check under {_locks_str(eff_t)}, act under "
+                    f"{_locks_str(eff_a)}) — the answer can go stale "
+                    f"between them and two threads both act; hold one "
+                    f"lock across the check and the mutation")
+
+
+@register
+class CondWaitWithoutPredicateLoop(Rule):
+    code = "G25"
+    name = "cond-wait-without-predicate-loop"
+    severity = "error"
+    doc = ("`Condition.wait()` outside a `while` predicate loop. "
+           "Condition waits are edge-triggered and legally subject to "
+           "spurious wakeups, and with multiple waiters a single "
+           "notify can wake the wrong one after the predicate was "
+           "consumed — an `if`-guarded (or unguarded) wait then "
+           "proceeds on a false premise. Python's own docs mandate "
+           "the loop; `wait_for(pred)` embeds it and is the "
+           "recommended spelling. Receivers count as conditions when "
+           "constructed from `threading.Condition` in this module or "
+           "when the name reads like one (`_cv`, `_cond`); "
+           "`Event.wait()` is level-triggered and exempt. Scope: "
+           "mxnet_tpu/ library code.")
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        ms = sm.for_context(ctx)
+        for _key, s in sorted(ms.functions.items()):
+            for recv, line, in_loop in s.cond_waits:
+                if in_loop:
+                    continue
+                yield self.finding(
+                    ctx, line,
+                    f"`{recv}.wait()` is not re-checked in a `while` "
+                    f"predicate loop — spurious wakeups and consumed "
+                    f"notifies resume with the predicate false; use "
+                    f"`while not pred: {recv}.wait()` or "
+                    f"`{recv}.wait_for(pred)`")

@@ -42,7 +42,11 @@ __all__ = ["FunctionSummary", "ModuleSummaries", "SummaryCache",
            "module_summaries", "for_context", "set_active_cache",
            "drain_active_cache", "merge_cache_delta", "active_cache"]
 
-_SCHEMA_VERSION = 1
+# v2: attribute accesses with held locksets, thread-spawn targets,
+# Condition waits — the G22-G25 race family. Bumping the version
+# changes every fingerprint, so a pre-G22 cache cold-starts cleanly
+# instead of serving summaries without the new fields.
+_SCHEMA_VERSION = 2
 DEFAULT_CACHE = os.path.join("ci", "lint_summary_cache.json")
 
 _RANK_SOURCES = {"jax.process_index"}
@@ -55,7 +59,8 @@ class FunctionSummary:
 
     __slots__ = ("key", "line", "public", "blocks", "calls", "acq_with",
                  "acq_exp", "releases", "rank_direct", "rank_calls",
-                 "deadline_params", "deadline_read")
+                 "deadline_params", "deadline_read", "attrs", "toctou",
+                 "cond_waits", "spawns", "thread_run")
 
     def __init__(self, key, line, public):
         self.key = key
@@ -70,6 +75,16 @@ class FunctionSummary:
         self.rank_calls = []  # same-module callees feeding the return
         self.deadline_params = []
         self.deadline_read = []
+        # race-family facts (schema v2)
+        self.attrs = []       # (attr, "r"|"w"|"c", line, (locks...))
+        self.toctou = []      # (attr, test_line, (test_locks...),
+        #                        act_line, (act_locks...)) — a write to
+        #                        `self.attr` guarded by a membership
+        #                        test of the same attr
+        self.cond_waits = []  # (recv, line, in_while_loop)
+        self.spawns = []      # same-module fn keys passed as thread
+        #                       targets / callbacks — thread roots
+        self.thread_run = False  # run() of a Thread subclass
 
     def to_dict(self):
         return {"line": self.line, "public": self.public,
@@ -81,7 +96,13 @@ class FunctionSummary:
                 "rank_direct": self.rank_direct,
                 "rank_calls": list(self.rank_calls),
                 "deadline_params": list(self.deadline_params),
-                "deadline_read": list(self.deadline_read)}
+                "deadline_read": list(self.deadline_read),
+                "attrs": [list(a) for a in self.attrs],
+                "toctou": [[t[0], t[1], list(t[2]), t[3], list(t[4])]
+                           for t in self.toctou],
+                "cond_waits": [list(c) for c in self.cond_waits],
+                "spawns": list(self.spawns),
+                "thread_run": self.thread_run}
 
     @classmethod
     def from_dict(cls, key, d):
@@ -99,6 +120,14 @@ class FunctionSummary:
         s.rank_calls = list(d["rank_calls"])
         s.deadline_params = list(d["deadline_params"])
         s.deadline_read = list(d["deadline_read"])
+        s.attrs = [(a[0], a[1], int(a[2]), tuple(a[3]))
+                   for a in d["attrs"]]
+        s.toctou = [(t[0], int(t[1]), tuple(t[2]), int(t[3]), tuple(t[4]))
+                    for t in d["toctou"]]
+        s.cond_waits = [(c[0], int(c[1]), bool(c[2]))
+                        for c in d["cond_waits"]]
+        s.spawns = list(d["spawns"])
+        s.thread_run = bool(d["thread_run"])
         return s
 
 
@@ -106,15 +135,97 @@ class FunctionSummary:
 # extraction
 # ---------------------------------------------------------------------------
 
+# container methods that mutate the receiver in place — a call through
+# `self._x.append(...)` is a WRITE of `self._x` for lockset purposes
+_MUTATORS = {"append", "appendleft", "add", "insert", "extend", "pop",
+             "popitem", "popleft", "remove", "discard", "clear",
+             "update", "setdefault", "sort", "reverse"}
+# thread-target parameter names (Thread(target=...), Timer(t, function=...))
+_TARGET_KWARGS = {"target", "function"}
+
+
+def _self_attr(node):
+    """Bare attribute name for a one-level ``self.X`` / ``cls.X``
+    access, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in ("self", "cls"):
+        return node.attr
+    return None
+
+
 def _extract_function(index, info):
     """One function's direct facts: a structure-aware walk tracking the
     held-lock set through ``with`` nesting and the in-``finally`` flag
     through try statements. Nested defs/lambdas are separate scopes —
-    code inside them does not run when this function does."""
+    code inside them does not run when this function does.
+
+    Schema v2 also records, per ``self._x`` site, the lockset held
+    there (the raw material of the G22-G25 Eraser-style analysis),
+    membership-test guards over later writes of the same attribute
+    (G24's check-then-act pairs), ``Condition.wait()`` sites with their
+    enclosing-``while`` flag (G25), and thread-spawn targets (the
+    thread-escape roots)."""
     s = FunctionSummary(info.key, info.line, info.public)
     cls, fnkey = info.cls, info.key
+    if info.name == "run" and cls and cls in index.thread_classes():
+        s.thread_run = True
 
-    def walk(node, held, fin):
+    def tracked(attr):
+        # lock/queue/event/... receivers are synchronization objects,
+        # not shared data; method names are class namespace, not state
+        dotted = f"self.{attr}"
+        if dotted in index.lock_recvs or dotted in index.receivers:
+            return False
+        if cg._LOCKISH_RE.search(attr):
+            return False
+        if cls and index.method_owner(cls, attr):
+            return False
+        return True
+
+    def record(attr, mode, line, held, guards):
+        if not tracked(attr):
+            return
+        s.attrs.append((attr, mode, line, tuple(held)))
+        if mode == "w":
+            for g_attr, g_line, g_locks in guards:
+                if g_attr == attr:
+                    s.toctou.append((attr, g_line, tuple(g_locks),
+                                     line, tuple(held)))
+
+    def record_target(t, held, fin, loop, guards):
+        """Assignment/delete target: classify ``self.X``-rooted stores
+        as writes, walk everything else (slices, chained receivers)
+        for the reads they contain."""
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                record_target(e, held, fin, loop, guards)
+            return
+        if isinstance(t, ast.Starred):
+            record_target(t.value, held, fin, loop, guards)
+            return
+        attr = _self_attr(t)
+        if attr:                                   # self.x = ...
+            record(attr, "w", t.lineno, held, guards)
+            return
+        if isinstance(t, ast.Subscript):
+            attr = _self_attr(t.value)
+            if attr:                               # self.x[k] = ...
+                record(attr, "w", t.lineno, held, guards)
+            else:
+                walk(t.value, held, fin, loop, guards)
+            walk(t.slice, held, fin, loop, guards)
+            return
+        if isinstance(t, ast.Attribute):
+            attr = _self_attr(t.value)
+            if attr:                               # self.x.field = ...
+                record(attr, "w", t.lineno, held, guards)
+            else:
+                walk(t.value, held, fin, loop, guards)
+            return
+        walk(t, held, fin, loop, guards)
+
+    def walk(node, held, fin, loop, guards):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda)):
             return
@@ -127,24 +238,69 @@ def _extract_function(index, info):
                                        tuple(new)))
                     new.append(lk)
                 else:
-                    walk(item.context_expr, tuple(new), fin)
+                    walk(item.context_expr, tuple(new), fin, loop, guards)
                 if item.optional_vars is not None:
-                    walk(item.optional_vars, tuple(new), fin)
+                    walk(item.optional_vars, tuple(new), fin, loop, guards)
             for st in node.body:
-                walk(st, tuple(new), fin)
+                walk(st, tuple(new), fin, loop, guards)
             return
         if isinstance(node, ast.Try):
             for st in node.body:
-                walk(st, held, fin)
+                walk(st, held, fin, loop, guards)
             for h in node.handlers:
                 if h.type is not None:
-                    walk(h.type, held, fin)
+                    walk(h.type, held, fin, loop, guards)
                 for st in h.body:
-                    walk(st, held, fin)
+                    walk(st, held, fin, loop, guards)
             for st in node.orelse:
-                walk(st, held, fin)
+                walk(st, held, fin, loop, guards)
             for st in node.finalbody:
-                walk(st, held, True)
+                walk(st, held, True, loop, guards)
+            return
+        if isinstance(node, ast.While):
+            walk(node.test, held, fin, loop, guards)
+            for st in node.body:
+                walk(st, held, fin, True, guards)
+            for st in node.orelse:
+                walk(st, held, fin, loop, guards)
+            return
+        if isinstance(node, ast.If):
+            # a membership test over `self.X` guards BOTH branches (In
+            # conditions the hit path, NotIn the miss path — either way
+            # a mutation below depends on the possibly-stale answer)
+            new_guards = guards
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Compare):
+                    for op, cmp_ in zip(sub.ops, sub.comparators):
+                        if not isinstance(op, (ast.In, ast.NotIn)):
+                            continue
+                        attr = _self_attr(cmp_)
+                        if attr:
+                            new_guards = new_guards + (
+                                (attr, sub.lineno, tuple(held)),)
+            walk(node.test, held, fin, loop, guards)
+            for st in node.body:
+                walk(st, held, fin, loop, new_guards)
+            for st in node.orelse:
+                walk(st, held, fin, loop, new_guards)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                record_target(t, held, fin, loop, guards)
+            walk(node.value, held, fin, loop, guards)
+            return
+        if isinstance(node, ast.AnnAssign):
+            record_target(node.target, held, fin, loop, guards)
+            if node.value is not None:
+                walk(node.value, held, fin, loop, guards)
+            return
+        if isinstance(node, ast.AugAssign):
+            record_target(node.target, held, fin, loop, guards)
+            walk(node.value, held, fin, loop, guards)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                record_target(t, held, fin, loop, guards)
             return
         if isinstance(node, ast.Call):
             b = cg.classify_blocking(index, node)
@@ -152,25 +308,78 @@ def _extract_function(index, info):
                 kind, what, deadlined = b
                 s.blocks.append((kind, what, node.lineno, held, deadlined))
             func = node.func
-            if isinstance(func, ast.Attribute) and func.attr in (
-                    "acquire", "release"):
-                lk = cg.lock_key(index, func.value, cls, fnkey)
-                if lk:
-                    if func.attr == "acquire":
-                        s.acq_exp.append((lk, node.lineno, fin))
-                    else:
-                        s.releases.append((lk, node.lineno, fin))
+            name = index.ctx.resolve(func)
+            skip_func = False
+            if name in cg.THREAD_MAKERS:
+                cands = [kw.value for kw in node.keywords
+                         if kw.arg in _TARGET_KWARGS]
+                cands += node.args[1:2]     # Thread(group, target) /
+                for c in cands:             # Timer(interval, function)
+                    ref = cg.resolve_func_ref(index, c, cls, fnkey)
+                    if ref:
+                        s.spawns.append(ref)
+            elif isinstance(func, ast.Attribute) and \
+                    "callback" in func.attr:
+                # registration APIs (add_stall_callback, ...): the
+                # registered function runs on someone else's thread
+                for c in list(node.args) + [k.value for k in
+                                            node.keywords]:
+                    ref = cg.resolve_func_ref(index, c, cls, fnkey)
+                    if ref:
+                        s.spawns.append(ref)
+            if isinstance(func, ast.Attribute):
+                if func.attr in ("acquire", "release"):
+                    lk = cg.lock_key(index, func.value, cls, fnkey)
+                    if lk:
+                        if func.attr == "acquire":
+                            s.acq_exp.append((lk, node.lineno, fin))
+                        else:
+                            s.releases.append((lk, node.lineno, fin))
+                inner = _self_attr(func.value)
+                if inner is not None and func.attr in _MUTATORS:
+                    record(inner, "w", node.lineno, held, guards)
+                    skip_func = True    # don't double-record the read
+                if func.attr == "wait":
+                    recv = cg._dotted(func.value)
+                    if recv is not None and (
+                            recv in index.cond_recvs or
+                            (cg._CONDISH_RE.search(
+                                recv.rsplit(".", 1)[-1]) and
+                             index.receivers.get(recv) != "event")):
+                        s.cond_waits.append((recv, node.lineno, loop))
             callee = cg.resolve_callee(index, node, cls, fnkey)
             if callee:
                 s.calls.append((callee, node.lineno, held, fin))
             for child in ast.iter_child_nodes(node):
-                walk(child, held, fin)
+                if skip_func and child is func:
+                    continue
+                walk(child, held, fin, loop, guards)
+            return
+        if isinstance(node, ast.Compare):
+            checked = []
+            for op, cmp_ in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.In, ast.NotIn)):
+                    attr = _self_attr(cmp_)
+                    if attr:
+                        record(attr, "c", cmp_.lineno, held, guards)
+                        checked.append(cmp_)
+            for child in ast.iter_child_nodes(node):
+                if any(child is c for c in checked):
+                    continue            # already recorded as a check
+                walk(child, held, fin, loop, guards)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr and isinstance(node.ctx, ast.Load):
+                record(attr, "r", node.lineno, held, guards)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held, fin, loop, guards)
             return
         for child in ast.iter_child_nodes(node):
-            walk(child, held, fin)
+            walk(child, held, fin, loop, guards)
 
     for st in info.node.body:
-        walk(st, (), False)
+        walk(st, (), False, False, ())
     _extract_rank(index, info, s)
     _extract_deadline(info, s)
     return s
@@ -313,6 +522,67 @@ class ModuleSummaries:
                     taint[k] = True
                     changed = True
         self.rank_taint = taint
+        # thread escape: forward reachability from spawn targets and
+        # Thread-subclass run() methods — a function in this set can
+        # run concurrently with the object's other methods
+        roots = {c for s in functions.values() for c in s.spawns
+                 if c in functions}
+        roots |= {k for k, s in functions.items() if s.thread_run}
+        self.thread_roots = roots
+        reach_t = set(roots)
+        frontier = list(roots)
+        while frontier:
+            k = frontier.pop()
+            for c in edges.get(k, ()):
+                if c not in reach_t:
+                    reach_t.add(c)
+                    frontier.append(c)
+        self.thread_reachable = reach_t
+        self.entry_locks = self._entry_locks(functions, roots)
+
+    @staticmethod
+    def _entry_locks(functions, roots):
+        """Locks guaranteed held on ENTRY to each function: the
+        intersection, over every same-module call site, of the locks
+        the caller holds there plus the caller's own entry set. Public
+        functions, thread roots, and functions with no same-module
+        caller start open (anyone may call them with nothing held); a
+        private helper only ever invoked as ``with self._lock:
+        self._helper()`` inherits the lock — so its attribute writes
+        don't read as unlocked to the G22/G23 lockset analysis.
+        Decreasing intersection fixpoint from the full lock universe;
+        cycle-safe because the sets only shrink."""
+        callers: dict = {}
+        for k, s in functions.items():
+            for c, _l, held, _f in s.calls:
+                if c in functions:
+                    callers.setdefault(c, []).append((k, held))
+        universe = frozenset(
+            a[0] for s in functions.values()
+            for a in list(s.acq_with) + list(s.acq_exp))
+        entry = {}
+        for k, s in functions.items():
+            # nested defs (key prefix is itself a function) are only
+            # reachable through their parent — never externally public
+            nested = "." in k and k.rsplit(".", 1)[0] in functions
+            open_entry = (k in roots or not callers.get(k)
+                          or (s.public and not nested))
+            entry[k] = frozenset() if open_entry else universe
+        changed = True
+        while changed:
+            changed = False
+            for k in entry:
+                if not entry[k]:
+                    continue
+                new = None
+                for caller, held in callers.get(k, ()):
+                    site = entry[caller] | set(held)
+                    new = site if new is None else (new & site)
+                new = frozenset(new or ())
+                if new != entry[k]:
+                    entry[k] = new
+                    changed = True
+        return entry
 
     @staticmethod
     def _fixpoint(direct, edges):

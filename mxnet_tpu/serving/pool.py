@@ -500,6 +500,9 @@ class ReplicaPool:
     # -- construction ----------------------------------------------------
     def add_local(self, rid, factory) -> "ReplicaPool":
         """Add an in-process replica built by ``factory() -> Server``."""
+        # builder-phase single writer: add_* run before start()/
+        # monitor_start() spawn any thread that could observe the dict
+        # graftlint: disable=G22 construction precedes thread creation
         self.replicas[str(rid)] = LocalReplica(rid, factory, self.hb_dir,
                                                self.cfg)
         return self
@@ -542,6 +545,8 @@ class ReplicaPool:
             # passed with the knob set is a deliberate override.
             if not caller_trace:
                 env["MXNET_TPU_TRACE"] = "journal"
+        # builder-phase single writer (see add_local)
+        # graftlint: disable=G22 construction precedes thread creation
         self.replicas[rid] = ProcReplica(
             rid, worker_args, self.hb_dir, self.cfg,
             self._port_of, env=env)

@@ -1,0 +1,101 @@
+# graftlint: scope=library
+"""G22 fixture: a class attribute mutated with NO lock on a
+thread-shared path while other sites of the same attribute take a lock
+for it — the Eraser empty-intersection signal.  The worker thread is
+the escape root (``Thread(target=self._run)``); the snapshot method's
+locked read proves the author considers the field shared.  Parsed
+only, never executed."""
+import threading
+
+
+class BadCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {"served": 0}
+        self._stop = threading.Event()
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            self._stats["served"] += 1  # expect: G22
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._stats)
+
+
+class GoodCounter:
+    """Same shape, the same lock at every site: silent."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {"served": 0}
+        self._stop = threading.Event()
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            with self._lock:
+                self._stats["served"] += 1
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._stats)
+
+
+class GoodHelperUnderEntryLock:
+    """The bare-looking write lives in a private helper only ever
+    called under the lock — the entry-lock analysis credits it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {"served": 0}
+        self._stop = threading.Event()
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _bump(self):
+        self._stats["served"] += 1      # entry lock: always under _lock
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            with self._lock:
+                self._bump()
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._stats)
+
+
+class DisabledTwin:
+    """The violation with a reasoned suppression: stays silent."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {"served": 0}
+        self._stop = threading.Event()
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            # graftlint: disable=G22 single-writer: only this thread mutates
+            self._stats["served"] += 1
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._stats)

@@ -1,0 +1,77 @@
+# graftlint: scope=library
+"""G23 fixture: two sites protect the SAME attribute with DISJOINT
+locks — each site is individually "locked" but no common lock orders
+the accesses, so they interleave exactly as if unlocked (the PR-11
+``Heartbeat.beat()`` stale-overwrite class).  Parsed only, never
+executed."""
+import threading
+
+
+class BadSplitLocks:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self._doc = {"seq": 0}
+        self._stop = threading.Event()
+        self._daemon = None
+
+    def start(self):
+        self._daemon = threading.Thread(target=self._run, daemon=True)
+        self._daemon.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            with self._io_lock:
+                self._doc["staged"] = True
+
+    def publish(self, doc):
+        with self._state_lock:
+            self._doc = dict(doc)  # expect: G23
+
+
+class GoodOneLock:
+    """Same split between daemon and caller, ONE lock: silent."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._doc = {"seq": 0}
+        self._stop = threading.Event()
+        self._daemon = None
+
+    def start(self):
+        self._daemon = threading.Thread(target=self._run, daemon=True)
+        self._daemon.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            with self._lock:
+                self._doc["staged"] = True
+
+    def publish(self, doc):
+        with self._lock:
+            self._doc = dict(doc)
+
+
+class DisabledTwin:
+    """The violation with a reasoned suppression: stays silent."""
+
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self._doc = {"seq": 0}
+        self._stop = threading.Event()
+        self._daemon = None
+
+    def start(self):
+        self._daemon = threading.Thread(target=self._run, daemon=True)
+        self._daemon.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            with self._io_lock:
+                self._doc["staged"] = True
+
+    def publish(self, doc):
+        with self._state_lock:
+            # graftlint: disable=G23 doc swap is an atomic ref replace
+            self._doc = dict(doc)

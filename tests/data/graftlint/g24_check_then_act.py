@@ -1,0 +1,90 @@
+# graftlint: scope=library
+"""G24 fixture: a membership test over a shared dict/set guards a
+mutation of the same attribute, but no single lock spans BOTH the
+check and the act — between them a concurrent peer invalidates the
+answer and two threads both mutate (TOCTOU; the latched half-open
+probe class).  Parsed only, never executed."""
+import threading
+
+
+class BadCheckThenAct:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+        self._stop = threading.Event()
+        self._refresher = None
+
+    def start(self):
+        self._refresher = threading.Thread(target=self._refresh,
+                                           daemon=True)
+        self._refresher.start()
+
+    def _refresh(self):
+        while not self._stop.wait(0.01):
+            self.ensure("hot")
+
+    def ensure(self, key):
+        if key not in self._cache:      # the answer goes stale here...
+            with self._lock:
+                self._cache[key] = object()  # expect: G24
+
+    def get(self, key):
+        with self._lock:
+            return self._cache.get(key)
+
+
+class GoodLockSpansBoth:
+    """Check AND act under one critical section: silent."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+        self._stop = threading.Event()
+        self._refresher = None
+
+    def start(self):
+        self._refresher = threading.Thread(target=self._refresh,
+                                           daemon=True)
+        self._refresher.start()
+
+    def _refresh(self):
+        while not self._stop.wait(0.01):
+            self.ensure("hot")
+
+    def ensure(self, key):
+        with self._lock:
+            if key not in self._cache:
+                self._cache[key] = object()
+
+    def get(self, key):
+        with self._lock:
+            return self._cache.get(key)
+
+
+class DisabledTwin:
+    """The violation with a reasoned suppression: stays silent."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+        self._stop = threading.Event()
+        self._refresher = None
+
+    def start(self):
+        self._refresher = threading.Thread(target=self._refresh,
+                                           daemon=True)
+        self._refresher.start()
+
+    def _refresh(self):
+        while not self._stop.wait(0.01):
+            self.ensure("hot")
+
+    def ensure(self, key):
+        if key not in self._cache:
+            with self._lock:
+                # graftlint: disable=G24 idempotent insert, losers overwrite
+                self._cache[key] = object()
+
+    def get(self, key):
+        with self._lock:
+            return self._cache.get(key)

@@ -1,0 +1,64 @@
+# graftlint: scope=library
+"""G25 fixture: ``Condition.wait()`` not re-checked in a ``while``
+predicate loop — spurious wakeups and consumed notifies resume with
+the predicate false.  ``wait_for`` embeds the loop and is the
+recommended spelling; ``Event.wait`` is level-triggered and exempt.
+Parsed only, never executed."""
+import threading
+
+
+class BadWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def put(self, x):
+        with self._cv:
+            self._items.append(x)
+            self._cv.notify()
+
+    def take(self):
+        with self._cv:
+            if not self._items:
+                self._cv.wait(timeout=1.0)  # expect: G25
+            return self._items.pop(0) if self._items else None
+
+
+class GoodWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+        self._halt = threading.Event()
+
+    def put(self, x):
+        with self._cv:
+            self._items.append(x)
+            self._cv.notify()
+
+    def take_loop(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(timeout=1.0)
+            return self._items.pop(0)
+
+    def take_wait_for(self):
+        with self._cv:
+            self._cv.wait_for(lambda: len(self._items) > 0, timeout=1.0)
+            return self._items.pop(0) if self._items else None
+
+    def event_wait_is_exempt(self):
+        # level-triggered: no predicate loop required
+        return self._halt.wait(timeout=1.0)
+
+
+class DisabledTwin:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def take(self):
+        with self._cv:
+            if not self._items:
+                # graftlint: disable=G25 single waiter, timeout re-derives
+                self._cv.wait(timeout=1.0)
+            return self._items.pop(0) if self._items else None

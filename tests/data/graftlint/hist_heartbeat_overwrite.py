@@ -1,0 +1,36 @@
+# graftlint: scope=library
+"""Historical fixture — the PR-11 ``Heartbeat.beat()`` stale-overwrite,
+PRE-fix: the beacon daemon staged the shared document under its own
+I/O lock while ``beat()`` advanced the same document under the state
+lock.  Each site was "locked", but with no common lock between them
+the daemon's already-sampled (stale) document could land AFTER a
+fresher ``beat()`` write and roll the published state backwards — the
+inconsistent-lockset class G23 exists for.  Parsed only, never
+executed."""
+import threading
+
+
+class PreFixHeartbeat:
+    def __init__(self, interval_s=0.5):
+        self._interval_s = interval_s
+        self._state_lock = threading.Lock()   # beat()'s mutations
+        self._io_lock = threading.Lock()      # the daemon's staging
+        self._doc = {"seq": 0, "ready": False}
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._interval_s):
+            with self._io_lock:
+                # sampled here, stale by the time a concurrent beat()
+                # lands under the OTHER lock
+                self._doc = dict(self._doc, staged=True)
+
+    def beat(self, ready):
+        with self._state_lock:
+            self._doc["seq"] += 1  # expect: G23
+            self._doc["ready"] = bool(ready)

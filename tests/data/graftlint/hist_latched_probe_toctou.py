@@ -1,0 +1,37 @@
+# graftlint: scope=library
+"""Historical fixture — the PR-9 half-open probe admission, PRE-fix,
+seen through G24's lens: the breaker admits exactly ONE probe per
+quarantined replica, but membership in the probing set was checked
+during candidate enumeration and the slot claimed later, with no lock
+spanning the two.  Under hedged load two dispatch threads both passed
+the ``not in`` test and both admitted a probe — the "exactly one"
+invariant silently broke (the companion hist_latched_probe.py fixture
+shows the same bug's leak-on-exception face, G17's territory).
+Parsed only, never executed."""
+import threading
+
+
+class PreFixProbeAdmission:
+    def __init__(self):
+        self._probing = set()
+        self._stop = threading.Event()
+        self._sweeper = None
+
+    def start(self):
+        self._sweeper = threading.Thread(target=self._sweep, daemon=True)
+        self._sweeper.start()
+
+    def _sweep(self):
+        while not self._stop.wait(0.05):
+            for rid in ("a", "b"):
+                self.try_admit_probe(rid)
+
+    def try_admit_probe(self, rid):
+        # request threads race the sweeper through this same gate
+        if rid not in self._probing:
+            self._probing.add(rid)  # expect: G24
+            return True
+        return False
+
+    def probing(self):
+        return set(self._probing)

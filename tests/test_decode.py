@@ -197,6 +197,73 @@ def test_cancel_mid_stream_frees_slot_with_partial_tokens():
         eng.stop()
 
 
+def test_cancel_hammer_slots_freed_exactly_once_no_stale_tokens():
+    """The G22-G25 audit's dynamic companion: hammer ``cancel()`` from
+    racing caller threads against slot admission and per-step
+    rebatching.  Every stream must terminate decisively (tokens or
+    RequestError, never limbo), every slot must be freed exactly once
+    (counter conservation: completed + cancelled == submitted), and no
+    freed slot may serve a stale sequence — every SURVIVING stream's
+    tokens must still be bit-identical to the pure-python reference."""
+    import random
+    model = TinyLM()
+    eng, _ = _engine(model=model, slots=2, queue_on_busy=True,
+                     max_queue=64)
+    results = []                           # (stream, prompt, max_new)
+    res_lock = threading.Lock()
+
+    def submitter(seed):
+        rng = random.Random(seed)
+        for i in range(8):
+            prompt = [rng.randrange(1, 200)
+                      for _ in range(rng.randrange(1, 5))]
+            max_new = rng.randrange(3, 9)
+            st = eng.submit(prompt, max_new_tokens=max_new)
+            with res_lock:
+                results.append((st, prompt, max_new))
+            if rng.random() < 0.5:         # racing cancel: sometimes
+                time.sleep(rng.random() * 0.01)   # queued, sometimes
+                st.cancel()                       # active, sometimes done
+            time.sleep(rng.random() * 0.002)
+
+    try:
+        threads = [threading.Thread(target=submitter, args=(s,))
+                   for s in (11, 23, 47)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "submitter wedged"
+        survived = cancelled = 0
+        for st, prompt, max_new in results:
+            try:
+                toks = st.result(timeout_s=120)
+            except RequestError:
+                cancelled += 1             # decisive: failed, not limbo
+                continue
+            survived += 1
+            # a stale slot (freed twice, or serving the predecessor's
+            # sequence) would break bit-identity with the reference
+            assert toks == model.reference(prompt, max_new)
+        assert survived + cancelled == len(results) == 24
+        # every slot freed exactly once: the ledger balances with no
+        # shed/rejected/preempted leakage and the pool drains empty
+        deadline = time.monotonic() + 30
+        while eng.occupancy() > 0 or eng.queue_depth() > 0:
+            assert time.monotonic() < deadline, "slot never freed"
+            time.sleep(0.005)
+        c = eng.stats()
+        assert c["submitted"] == 24
+        assert c["completed"] + c["cancelled"] == 24
+        assert c["completed"] == survived
+        assert c["shed"] == c["rejected"] == c["preempted"] == 0
+        # freed slots stay serviceable after the storm
+        assert eng.generate([5], max_new_tokens=3) == \
+            model.reference([5], 3)
+    finally:
+        eng.stop()
+
+
 def test_deadline_preempts_mid_stream(journal_file):
     model = TinyLM(max_len=200000)
     eng, _ = _engine(model=model, slots=1)

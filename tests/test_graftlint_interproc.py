@@ -196,6 +196,30 @@ def test_summary_cache_roundtrips_and_survives_corruption(tmp_path):
     assert broken.misses == 1
 
 
+def test_summary_cache_schema_bump_cold_starts(tmp_path):
+    """A cache written by an older schema must be IGNORED wholesale,
+    even when its entries are keyed by the current fingerprints: the
+    race rules read summary fields (attrs/toctou/spawns) that v1
+    entries simply don't carry, and serving a stale entry would mask
+    every G22-G25 finding on a cache hit."""
+    src = "def f():\n    return 1\n"
+    cpath = str(tmp_path / "c.json")
+    # forge a pre-G22 cache: right fingerprints, wrong schema version
+    poisoned = {"version": sm._SCHEMA_VERSION - 1,
+                "entries": {sm.fingerprint(src): {"bogus": True}}}
+    with open(cpath, "w") as f:
+        json.dump(poisoned, f)
+    cache = sm.SummaryCache.load(cpath)
+    assert cache._data == {}               # gated out at load
+    ms = _summ(src, cache=cache)
+    assert cache.misses == 1 and cache.hits == 0
+    assert "f" in ms.functions             # recomputed, not the poison
+    # and the rewrite persists under the CURRENT version
+    cache.save()
+    with open(cpath) as f:
+        assert json.load(f)["version"] == sm._SCHEMA_VERSION
+
+
 def test_findings_identical_with_and_without_cache(tmp_path):
     """The acceptance shape: a cache hit changes nothing about the
     findings — fingerprint pins the file text, lines included."""
@@ -241,6 +265,121 @@ def test_historical_lock_held_ledger_io_is_flagged():
     assert "_view" in found[0].message     # names the call chain
 
 
+def test_historical_heartbeat_overwrite_is_flagged():
+    """The PR-11 beat() stale-overwrite, pre-fix: two locks that never
+    meet on one document is exactly G23's inconsistent-lockset class."""
+    path = os.path.join(FIXTURES, "hist_heartbeat_overwrite.py")
+    found = core.lint_file(path, rules=[core.load_rules()["G23"]],
+                           root=REPO)
+    assert [(f.line, f.code) for f in found] == [(35, "G23")]
+    assert "_doc" in found[0].message
+
+
+def test_historical_probe_toctou_is_flagged():
+    """The PR-9 half-open probe admission, pre-fix: membership checked
+    and the slot claimed with no lock spanning the pair — G24."""
+    path = os.path.join(FIXTURES, "hist_latched_probe_toctou.py")
+    found = core.lint_file(path, rules=[core.load_rules()["G24"]],
+                           root=REPO)
+    assert [(f.line, f.code) for f in found] == [(32, "G24")]
+    assert "_probing" in found[0].message
+
+
+# -- race-detector engine (thread escape, entry locks) -----------------------
+
+def test_thread_escape_roots_and_reachability():
+    src = (
+        "import threading\n"
+        "class Worker(threading.Thread):\n"
+        "    def run(self):\n"
+        "        self.step()\n"
+        "    def step(self):\n"
+        "        return 1\n"
+        "class Owner:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "        threading.Timer(1.0, self._expire).start()\n"
+        "    def _loop(self):\n"
+        "        self._tick()\n"
+        "    def _expire(self):\n"
+        "        pass\n"
+        "    def _tick(self):\n"
+        "        pass\n"
+        "    def untouched(self):\n"
+        "        pass\n"
+    )
+    ms = _summ(src)
+    assert ms.thread_roots == {"Worker.run", "Owner._loop",
+                               "Owner._expire"}
+    # reachability follows call edges out of the roots
+    assert {"Worker.step", "Owner._tick"} <= ms.thread_reachable
+    assert "Owner.untouched" not in ms.thread_reachable
+    assert "Owner.start" not in ms.thread_reachable
+
+
+def test_thread_escape_callback_registration():
+    src = (
+        "class Bus:\n"
+        "    def subscribe(self, reg):\n"
+        "        reg.add_callback(self._on_event)\n"
+        "    def _on_event(self, msg):\n"
+        "        self._handle(msg)\n"
+        "    def _handle(self, msg):\n"
+        "        pass\n"
+    )
+    ms = _summ(src)
+    assert "Bus._on_event" in ms.thread_roots
+    assert "Bus._handle" in ms.thread_reachable
+
+
+def test_entry_locks_credit_private_helpers():
+    """A private helper whose every same-module caller holds the lock
+    inherits it as an entry lock; a public method stays open-entry
+    (external callers are assumed lockless)."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def public(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n"
+        "    def other(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n"
+        "    def _bump(self):\n"
+        "        pass\n"
+    )
+    ms = _summ(src)
+    assert ms.entry_locks["C._bump"] == {"C::self._lock"}
+    assert ms.entry_locks["C.public"] == set()
+    # one lockless caller breaks the credit
+    ms2 = _summ(src + "    def sloppy(self):\n        self._bump()\n")
+    assert ms2.entry_locks["C._bump"] == set()
+
+
+def test_nested_def_sibling_thread_target_resolves():
+    """The router hedge shape: ``Thread(target=run)`` from inside a
+    sibling nested def — the target must resolve through the enclosing
+    method's scope, and ``self.m()`` from the nested def through the
+    enclosing class."""
+    src = (
+        "import threading\n"
+        "class R:\n"
+        "    def dispatch(self):\n"
+        "        def run():\n"
+        "            self._attempt()\n"
+        "        def launch():\n"
+        "            threading.Thread(target=run).start()\n"
+        "        launch()\n"
+        "    def _attempt(self):\n"
+        "        pass\n"
+    )
+    ms = _summ(src)
+    assert "R.dispatch.run" in ms.thread_roots
+    assert "R._attempt" in ms.thread_reachable
+
+
 # -- the audited subsystems stay clean ---------------------------------------
 
 @pytest.mark.parametrize("subsystem", [
@@ -253,7 +392,8 @@ def test_concurrency_rules_clean_on_audited_subsystems(subsystem):
     hedge-arm span restructured onto `with`), none baselined."""
     registry = core.load_rules()
     rules = [registry[c]
-             for c in ("G15", "G16", "G17", "G18", "G19", "G20")]
+             for c in ("G15", "G16", "G17", "G18", "G19", "G20",
+                       "G22", "G23", "G24", "G25")]
     findings, n = core.run([subsystem], rules=rules, root=REPO)
     assert n >= 4 and findings == []
 
@@ -433,6 +573,16 @@ def test_doctor_lint_report_shape():
     assert rep["wall_s"] > 0
     cache = rep["cache"]
     assert cache is None or set(cache) == {"hits", "misses", "hit_rate"}
+    # per-rule cost/yield: every race rule reports, raw counts include
+    # the inline-disabled pool.py builder writes (they cost detection
+    # time even though suppressed from the finding list)
+    stats = rep["rule_stats"]
+    for code in ("G22", "G23", "G24", "G25"):
+        assert set(stats[code]) == {"wall_ms", "findings"}
+        assert stats[code]["wall_ms"] >= 0
+    assert stats["G22"]["findings"] >= 2   # the audited pool.py writes
+    assert sum(s["wall_ms"] for s in stats.values()) <= \
+        rep["wall_s"] * 1000.0
 
 
 def test_doctor_lint_report_on_broken_root(tmp_path):
