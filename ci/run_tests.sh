@@ -133,6 +133,18 @@ python -m pytest tests/test_serving_fleet.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: pool chaos smoke (replica SIGKILL -> reroute) =="
 python -m pytest tests/test_serving_pool.py -q -k smoke -p no:cacheprovider
 
+# canary deploy chaos smoke: a REGRESSED (CRC-valid, wrong-answer)
+# step is canaried onto 1 of 3 replicas under closed-loop load -> the
+# sampled output-parity gate trips, the fleet auto-rolls-back within
+# the deadline budget, zero responses whose value contradicts their
+# version stamp, control replicas never serve the bad root (blast
+# radius = the canary set), the rolled-back store stays PINNED against
+# the bad-but-newest commit, and the trace-correlated deploy trail is
+# rendered by doctor --serving-journal (docs/serving.md canary
+# deployment)
+echo "== tier 0.5: canary deploy chaos smoke (parity gate -> rollback) =="
+python -m pytest tests/test_serving_deploy.py -q -k smoke -p no:cacheprovider
+
 # guardrail chaos smoke: poison a batch (NaN) -> the fused guard skips
 # the step bitwise and journals it; a persistent-poison divergence drill
 # rolls back bit-exact to the last committed step — the run stays green

@@ -194,6 +194,16 @@ def _summ_serving(sv) -> str:
                  f"transitions (quarantined: {quarantined or 'none'}, "
                  f"re-admitted: {readmitted or 'none'}), "
                  f"{pages} page-ins")
+    dp = sv.get("deploy")
+    if dp:
+        last = dp.get("last") or {}
+        base += (f"; deploy: step {last.get('from_step')}"
+                 f"->{last.get('to_step')} {last.get('result', '?')}"
+                 + (f" ({last['reason']})" if last.get("reason") else "")
+                 + f", {dp['gate_evals']} gate evals "
+                 f"({dp['gate_breaches']} breaches), "
+                 f"{dp['mirror_mismatches']} parity mismatches, "
+                 f"{dp['rollbacks']} rollbacks")
     return base
 
 

@@ -62,6 +62,7 @@ import importlib
 __all__ = ["AOTCache", "BucketGrid", "CompiledPredictor",
            "DeadlineExceeded", "DecodeConfig", "DecodeEngine",
            "DecodeModel", "DecodeStream",
+           "DeployConfig", "DeployController", "DeployInProgress",
            "Fleet", "FleetConfig", "LocalReplica", "ParamStore",
            "PendingResponse", "PoolConfig",
            "PredictorCache", "ProcReplica", "ReplicaPool",
@@ -80,6 +81,9 @@ _LAZY = {
     "DecodeEngine": ("decode", "DecodeEngine"),
     "DecodeModel": ("decode", "DecodeModel"),
     "DecodeStream": ("decode", "DecodeStream"),
+    "DeployConfig": ("deploy", "DeployConfig"),
+    "DeployController": ("deploy", "DeployController"),
+    "DeployInProgress": ("pool", "DeployInProgress"),
     "Fleet": ("fleet", "Fleet"),
     "FleetConfig": ("fleet", "FleetConfig"),
     "SLOClass": ("fleet", "SLOClass"),

@@ -86,6 +86,8 @@ def cmd_bench(args) -> int:
     from ..resilience.atomic import atomic_write
     from .server import Server, ServerConfig
 
+    if getattr(args, "deploy", False):
+        return _bench_deploy(args)
     if args.decode > 0:
         return _bench_decode(args)
     if args.tenants > 0:
@@ -604,6 +606,192 @@ def _bench_pool(args) -> int:
     return 0
 
 
+DEPLOY_METRIC = "serving_deploy_rollback_ms"
+
+
+def _bench_deploy(args) -> int:
+    """--deploy: canary-gated deployment drill under closed-loop load —
+    one GOOD deploy (identical weights recommitted: parity mirrors
+    agree, gates pass, promote) and one BAD deploy (regress_params-
+    poisoned step: parity gate trips, auto-rollback), with every
+    response's version stamp checked against its value.  The artifact
+    (BENCH_serving_deploy.json) carries gate-eval and rollback counters;
+    the exit code is the gate: nonzero when the good deploy failed to
+    promote, the bad deploy failed to roll back, or ANY response's
+    value contradicted its stamp."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from .. import nd
+    from ..diagnostics import get_journal
+    from ..resilience import commit
+    from ..resilience.atomic import atomic_write
+    from ..testing import faults
+    from .batcher import (DeadlineExceeded, RequestError, ServerOverloaded)
+    from .deploy import DeployConfig, DeployController
+    from .pool import PoolConfig, ReplicaPool
+    from .reload import ParamStore
+    from .router import Router, RouterConfig
+    from .server import Server, ServerConfig
+
+    j = get_journal()
+    j.install_handlers(final_cb=lambda: _emit(
+        {"metric": DEPLOY_METRIC, "value": None, "unit": "ms",
+         "error": "bench_killed",
+         "detail": f"killed at phase {j.last_phase!r}"}))
+    j.set_phase("serving_deploy_bench_setup")
+
+    from ..gluon.block import HybridBlock
+
+    class Scale(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.w = self.params.get("w", shape=(1,), init="ones")
+
+        def hybrid_forward(self, F, x, w):
+            return x * w
+
+    def commit_scale(root, step, value):
+        stage = commit.prepare_stage(root, step)
+        nd.save(os.path.join(stage, "net.params"),
+                {"w": nd.array(np.asarray([value], np.float32))})
+        return commit.finalize(root, step)
+
+    ck = tempfile.mkdtemp(prefix="mxtpu-deploy-bench-ckpt-")
+    commit_scale(ck, 1, 3.0)
+    scfg = ServerConfig(max_batch=args.max_batch, max_queue=args.queue,
+                        window_ms=args.window_ms,
+                        default_deadline_ms=args.deadline_ms)
+
+    def factory():
+        net = Scale()
+        net.initialize()
+        return Server(net, config=scfg, param_store=ParamStore(ck))
+
+    n = max(args.replicas, 3)
+    root = tempfile.mkdtemp(prefix="mxtpu-deploy-bench-")
+    pool = ReplicaPool(root, PoolConfig(heartbeat_s=0.2, deadline_s=1.5))
+    for i in range(n):
+        pool.add_local(f"r{i}", factory)
+    pool.start()
+    router = Router(pool, RouterConfig(
+        default_deadline_ms=args.deadline_ms))
+    base_deadline = time.monotonic() + 30.0
+    while time.monotonic() < base_deadline:      # baseline adoption
+        if all(s.params_step == 1 for s in pool.view()):
+            break
+        time.sleep(0.05)
+    else:
+        _emit({"metric": DEPLOY_METRIC, "value": None, "unit": "ms",
+               "error": "baseline_never_adopted",
+               "detail": "replicas never converged on step 1"})
+        return 1
+
+    # every response's value must match its version stamp's weight —
+    # a stamped-3 answer computed with w=3's weights is the one
+    # corruption class a canary may NEVER leak
+    w_by_step = {None: 1.0, 1: 3.0, 2: 3.0, 3: 30.0}
+    stop = threading.Event()
+    ok = [0] * args.clients
+    shed = [0] * args.clients
+    errored = [0] * args.clients
+    corrupt = [0] * args.clients
+    stamps = [dict() for _ in range(args.clients)]
+
+    def client(idx):
+        rng = np.random.default_rng(idx)
+        while not stop.is_set():
+            x = rng.standard_normal(args.dim).astype(np.float32)
+            try:
+                resp = router.call(x)     # RouterResponse: value + stamp
+            except (ServerOverloaded, DeadlineExceeded):
+                shed[idx] += 1
+                time.sleep(0.002)
+                continue
+            except RequestError:
+                errored[idx] += 1
+                time.sleep(0.01)
+                continue
+            st = resp.params_step
+            want = x * w_by_step.get(st, float("nan"))
+            got = resp.value
+            got = got.asnumpy() if hasattr(got, "asnumpy") else got
+            if not np.allclose(np.asarray(got).ravel(), want,
+                               rtol=1e-4, atol=1e-5):
+                corrupt[idx] += 1
+            stamps[idx][st] = stamps[idx].get(st, 0) + 1
+            ok[idx] += 1
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+
+    dcfg = DeployConfig(canary_k=1, window_s=0.4, promote_after=2,
+                        min_samples=5, mirror_fraction=0.25,
+                        rollback_s=15.0, deadline_s=30.0)
+    ctl = DeployController(pool, router, ck, dcfg)
+
+    j.set_phase("serving_deploy_bench_good")
+    commit_scale(ck, 2, 3.0)          # same weights: parity must agree
+    good = ctl.deploy(2)
+
+    j.set_phase("serving_deploy_bench_bad")
+    commit_scale(ck, 3, 3.0)
+    faults.regress_params(ck, 3, scale=10.0)   # CRC-valid, wrong answers
+    bad = ctl.deploy(3)
+
+    j.set_phase("serving_deploy_bench_report")
+    time.sleep(0.5)                   # post-rollback traffic window
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.monotonic() - t_start
+    router.stop()
+    pool.stop()
+
+    merged = {}
+    for d in stamps:
+        for k, v in d.items():
+            merged[k] = merged.get(k, 0) + v
+    total_corrupt = sum(corrupt)
+    passed = (good.get("result") == "promoted"
+              and bad.get("result") == "rolled_back"
+              and total_corrupt == 0)
+    doc = {
+        "metric": DEPLOY_METRIC,
+        "value": bad.get("rollback_ms"),
+        "unit": f"ms (replicas={n}, clients={args.clients}, "
+                f"canary_k={dcfg.canary_k})",
+        "elapsed_s": round(elapsed, 2),
+        "completed": sum(ok),
+        "client_shed": sum(shed),
+        "client_errors": sum(errored),
+        "corrupt_responses": total_corrupt,
+        "responses_by_step": {str(k): v for k, v in merged.items()},
+        "good_deploy": good,
+        "bad_deploy": bad,
+        "gate_evals": (good.get("gate_evals", 0)
+                       + bad.get("gate_evals", 0)),
+        "rollbacks": int(bad.get("result") == "rolled_back"),
+        "promotions": int(good.get("result") == "promoted"),
+        "rollback_reason": bad.get("reason"),
+        "passed": passed,
+    }
+    out = args.out or ""
+    if out:
+        with atomic_write(out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True, default=str)
+        print(f"deploy bench: artifact written to {out}", file=sys.stderr)
+    _emit(doc)
+    j.mark_clean()
+    return 0 if passed else 1
+
+
 WARM_METRIC = "aot_warm_entries"
 
 
@@ -708,6 +896,14 @@ def main(argv=None) -> int:
                         "batcher with N slots and writes the "
                         "BENCH_serving_decode artifact (tokens/s, "
                         "occupancy, zero-mid-run-compile proof)")
+    b.add_argument("--deploy", action="store_true",
+                   help="run the canary-gated deployment drill instead "
+                        "of the raw closed loop: one good deploy "
+                        "(promote) + one regress_params-poisoned deploy "
+                        "(parity gate trips, auto-rollback) under load, "
+                        "with stamp-vs-value corruption checks; writes "
+                        "BENCH_serving_deploy.json and exits nonzero "
+                        "when any gate outcome or response is wrong")
     b.add_argument("--hedge-ms", type=float, default=0.0,
                    help="tail-latency hedge delay for --replicas mode "
                         "(0 = off)")
@@ -754,7 +950,8 @@ def main(argv=None) -> int:
     w.set_defaults(fn=cmd_worker)
     args = ap.parse_args(argv)
     if getattr(args, "out", None) is None and args.cmd == "bench":
-        args.out = ("BENCH_serving_decode.json" if args.decode > 0
+        args.out = ("BENCH_serving_deploy.json" if args.deploy
+                    else "BENCH_serving_decode.json" if args.decode > 0
                     else "BENCH_serving_tenants.json" if args.tenants > 0
                     else "BENCH_serving_pool.json" if args.replicas > 1
                     else "BENCH_serving.json")

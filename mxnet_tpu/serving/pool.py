@@ -48,8 +48,8 @@ from .batcher import (DeadlineExceeded, RequestError, ServerOverloaded,
                       ServerStopped, SlotsExhausted)
 from . import wire
 
-__all__ = ["LocalReplica", "PoolConfig", "ProcReplica", "ReplicaPool",
-           "ReplicaState", "ReplicaUnavailable"]
+__all__ = ["DeployInProgress", "LocalReplica", "PoolConfig", "ProcReplica",
+           "ReplicaPool", "ReplicaState", "ReplicaUnavailable"]
 
 
 def _env_float(name, default):
@@ -64,6 +64,21 @@ def _env_int(name, default):
         return int(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+class DeployInProgress(MXNetError):
+    """A canary deployment owns the pool: fleet-mutating lifecycle ops
+    (``reload``, another ``deploy``) are REFUSED, not queued — two
+    concurrent version rollouts would tear the old-xor-new response
+    contract mid-flight (docs/serving.md, canary deployment)."""
+
+    def __init__(self, owner, op):
+        super().__init__(
+            f"{op} refused: deployment {owner!r} is in progress — wait "
+            "for it to promote or roll back (DeployController serializes "
+            "fleet version changes)")
+        self.owner = owner
+        self.op = op
 
 
 class ReplicaUnavailable(RequestError):
@@ -164,6 +179,7 @@ class LocalReplica:
         self.cfg = config
         self.server = None
         self._draining = False
+        self._pin = None               # deploy pin; survives restart()
         self._hb = Heartbeat(hb_dir, self.id, config.heartbeat_s,
                              payload=self._beacon, prefix="replica")
 
@@ -179,10 +195,26 @@ class LocalReplica:
     def start(self):
         if self.server is None:
             self.server = self.factory()
+        if self._pin is not None:
+            # pin BEFORE start: the initial force-reload then lands on
+            # the pinned step, not the newest committed one
+            self.server.pin_params(self._pin)
         self.server.start()
         self._draining = False
         self._hb.start()
         return self
+
+    def pin(self, step):
+        """Pin (or with None unpin) this replica's ParamStore to one
+        step.  The pin is remembered on the HANDLE too, so a later
+        ``restart()``'s fresh factory build starts pinned — a respawned
+        canary/rolled-back replica cannot drift off its assigned
+        version.  Returns True when a live server took the pin now."""
+        self._pin = None if step is None else int(step)
+        srv = self.server
+        if srv is None:
+            return False
+        return bool(srv.pin_params(self._pin))
 
     def predict(self, x, deadline_ms, cancel=None, tenant=None):
         """One attempt on this replica; returns ``(array, meta)`` or
@@ -234,6 +266,8 @@ class LocalReplica:
             self.server.stop(timeout_s=30.0 if deadline_s is None
                              else max(float(deadline_s), 1.0))
         self.server = self.factory()
+        if self._pin is not None:
+            self.server.pin_params(self._pin)
         self.server.start()
         self._draining = False
         self._hb.beat()
@@ -389,6 +423,26 @@ class ProcReplica:
             return 0                   # already gone: nothing to drain
         return int(header.get("residual", 0))
 
+    def pin(self, step):
+        """Pin (or with None unpin) the worker's ParamStore to one step.
+        Two levers, both needed: a ``pin`` wire frame moves the LIVE
+        worker now, and ``--pin-step`` in ``worker_args`` makes the next
+        (re)spawn start pinned — a canary respawned by the monitor
+        mid-deploy must come back on its assigned version, not the
+        newest root.  Returns True when the live worker acked."""
+        if step is None:
+            self.worker_args.pop("--pin-step", None)
+        else:
+            self.worker_args["--pin-step"] = int(step)
+        try:
+            header, _ = self._roundtrip(
+                {"cmd": "pin",
+                 "step": None if step is None else int(step)},
+                budget_s=10.0)
+        except ReplicaUnavailable:
+            return False               # not up: the arg pins the spawn
+        return bool(header.get("ok")) and bool(header.get("pinned"))
+
     def restart(self, deadline_s=None):
         """Stop (graceful ``stop`` frame, then terminate/kill fallback)
         and spawn a fresh worker — which reads the newest CRC-valid
@@ -496,6 +550,8 @@ class ReplicaPool:
         self._monitor_stop = threading.Event()
         self._monitor = None
         self._lock = threading.Lock()      # lifecycle ops serialize
+        self._deploy_owner = None          # guarded by _lock; set while a
+                                           # DeployController owns the pool
 
     # -- construction ----------------------------------------------------
     def add_local(self, rid, factory) -> "ReplicaPool":
@@ -651,13 +707,54 @@ class ReplicaPool:
             raise MXNetError(f"replica {rid!r} did not come back ready "
                              f"within {self.cfg.spawn_s:g}s after restart")
 
+    # -- deploy ownership (serving/deploy.py) ----------------------------
+    def deploy_acquire(self, owner) -> None:
+        """Claim exclusive fleet-version ownership for a deployment.
+        Raises :class:`DeployInProgress` when another deploy holds it —
+        refused, not queued (two rollouts would tear old-xor-new)."""
+        owner = str(owner)
+        with self._lock:
+            holder = self._deploy_owner
+            if holder is None:
+                self._deploy_owner = owner
+        if holder is not None:
+            raise DeployInProgress(holder, "deploy")
+
+    def deploy_release(self, owner) -> None:
+        """Release deploy ownership (idempotent; only the holder's tag
+        releases)."""
+        with self._lock:
+            if self._deploy_owner == str(owner):
+                self._deploy_owner = None
+
+    def deploy_owner(self):
+        with self._lock:
+            return self._deploy_owner
+
+    def pin_step(self, rid, step) -> bool:
+        """Pin one replica to ``step`` (None unpins) through its handle
+        — live store pin for in-process replicas, wire frame + respawn
+        arg for subprocess workers.  Journaled so the deploy trail shows
+        which replica was held on which version."""
+        rid = str(rid)
+        with self._lock:
+            took = self.replicas[rid].pin(step)
+        get_journal().event("pool_pin", replica=rid, step=step,
+                            live=bool(took))
+        return bool(took)
+
     def reload(self, surge=None, deadline_s=None) -> dict:
         """Rolling fleet upgrade: drain + restart every replica, at most
         ``surge`` out of rotation at a time, each restart landing on the
         newest CRC-valid committed step at ITS restart moment (a step
         published mid-roll splits the fleet across exactly the old and
-        the new root — never a torn state).  Returns the post-roll
-        ``{replica: params_step}`` map."""
+        the new root — never a torn state).  Refused with
+        :class:`DeployInProgress` while a canary deployment owns the
+        pool.  Returns the post-roll ``{replica: params_step}`` map."""
+        with self._lock:
+            holder = self._deploy_owner
+        if holder is not None:
+            raise DeployInProgress(holder, "reload")
         surge = self.cfg.surge if surge is None else max(int(surge), 1)
         rids = sorted(self.replicas)
         get_journal().event("pool_reload", phase="begin", surge=surge,

@@ -57,6 +57,8 @@ class ParamStore:
         self.corrupt_seen = 0          # lifetime count of NEW bad steps
                                        # (the fleet's per-tenant breaker
                                        # reads the delta after poll())
+        self.pinned_step = None        # deploy pin: poll() never advances
+                                       # past this step while set
         self._bad_steps = OrderedDict()        # step -> None, LRU order
         self._bad_cap = max(int(
             _env_int("MXNET_TPU_SERVING_BAD_STEPS_CAP", 64)
@@ -82,6 +84,8 @@ class ParamStore:
         never fatal."""
         from .. import ndarray as nd
         for step in sorted(_commit.committed_steps(self.root), reverse=True):
+            if self.pinned_step is not None and step > self.pinned_step:
+                continue             # pinned: newer commits are invisible
             if self.loaded_step is not None and step <= self.loaded_step:
                 return None          # newest usable is already serving
             if step in self._bad_steps:
@@ -131,6 +135,35 @@ class ParamStore:
                 consumer="serving", note="bad-step memory evicted "
                 "(LRU cap) — re-journals only if it resurfaces",
                 cap=self._bad_cap)
+
+    def pin_step(self, step):
+        """Freeze the store at ``step``: :meth:`poll` ignores every
+        newer commit until ``pin_step(None)`` unpins.  The deploy
+        controller's rollback lever — a rolled-back replica pinned to
+        the old step cannot silently re-adopt the bad root on its next
+        poll (docs/serving.md, canary deployment).  Pinning does NOT
+        load anything by itself; pair with :meth:`load_step` (or let
+        ``Server.pin_params`` drive the apply) when the live step must
+        change."""
+        self.pinned_step = None if step is None else int(step)
+
+    def load_step(self, step):
+        """Load exactly ``step`` — validated like :meth:`poll`, but an
+        explicit target instead of newest-wins, and downgrades are
+        allowed (``step`` may be older than ``loaded_step``).  Raises on
+        a torn/missing/unparseable step instead of skipping: the caller
+        asked for THIS step, so there is no safe substitute.  On success
+        ``loaded_step`` moves to ``step``."""
+        from .. import ndarray as nd
+        step = int(step)
+        manifest = _commit.validate_step(self.root, step)   # ValueError on CRC
+        fname = self._pick_file(step, manifest)
+        loaded = nd.load(
+            os.path.join(_commit.step_dir(self.root, step), fname))
+        if not isinstance(loaded, dict):
+            raise MXNetError(f"{fname} is not a parameter dict")
+        self.loaded_step = step
+        return step, loaded
 
     def mark_bad(self, step, revert_to=None):
         """Remember ``step`` as unusable and roll ``loaded_step`` back

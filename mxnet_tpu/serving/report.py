@@ -38,7 +38,15 @@ _KINDS = ("serving_start", "serving_stop", "serving_batch", "serving_shed",
           "decode_step", "decode_finish", "decode_cancel",
           "decode_preempt", "decode_deadline_miss", "decode_shed",
           # the tensor-parallel plan (serving/shardplan.py)
-          "shard_place")
+          "shard_place",
+          # the canary deployment controller (serving/deploy.py)
+          "deploy_start", "canary_up", "gate_eval", "promote",
+          "rollback", "deploy_done", "deploy_mirror_mismatch",
+          "pool_pin")
+
+_DEPLOY_KINDS = ("deploy_start", "canary_up", "gate_eval", "promote",
+                 "rollback", "deploy_done", "deploy_mirror_mismatch",
+                 "pool_pin")
 
 _AOT_KINDS = ("aot_store", "aot_store_failed", "aot_fallback",
               "aot_prewarm", "aot_gc")
@@ -187,6 +195,9 @@ def serving_report(path) -> dict:
     decode = _decode_section(records)
     if decode is not None:
         out["decode"] = decode
+    deploy = _deploy_section(records)
+    if deploy is not None:
+        out["deploy"] = deploy
     placements = [r for r in records if r["kind"] == "shard_place"]
     if placements:
         last_place = placements[-1]
@@ -342,6 +353,52 @@ def _tenant_section(records) -> dict | None:
             t["reload_steps"].append(r.get("step"))
         elif kind == "tenant_remove":
             t["removed"] = True
+    return out
+
+
+def _deploy_section(records) -> dict | None:
+    """Canary-deployment reduction of the last run: the full
+    deploy_start→canary_up→gate_eval…→promote/rollback→deploy_done
+    trail in order (with trace ids — one ``deploy`` span covers it),
+    gate-breach/mirror-mismatch counters, and the last deployment's
+    outcome.  The operator view of one deploy drill (docs/serving.md,
+    canary deployment)."""
+    dep = [r for r in records if r["kind"] in _DEPLOY_KINDS]
+    if not any(r["kind"] == "deploy_start" for r in dep) \
+            and not any(r["kind"] == "deploy_done" for r in dep):
+        return None
+    count = lambda k: sum(1 for r in dep if r["kind"] == k)  # noqa: E731
+    trail = []
+    for r in dep:
+        if r["kind"] == "pool_pin":
+            continue                     # pins are counted, not trailed
+        row = {"kind": r["kind"], "trace_id": r.get("trace_id")}
+        for k in ("from_step", "to_step", "step", "verdict", "reasons",
+                  "reason", "result", "replicas", "n", "canary",
+                  "rollback_ms"):
+            if r.get(k) is not None:
+                row[k] = r.get(k)
+        trail.append(row)
+    dones = [r for r in dep if r["kind"] == "deploy_done"]
+    evals = [r for r in dep if r["kind"] == "gate_eval"]
+    out = {
+        "deploys": count("deploy_start"),
+        "gate_evals": len(evals),
+        "gate_breaches": sum(1 for r in evals
+                             if r.get("verdict") == "breach"),
+        "mirror_mismatches": count("deploy_mirror_mismatch"),
+        "promotions": count("promote"),
+        "rollbacks": count("rollback"),
+        "pins": count("pool_pin"),
+        "trail": trail,
+    }
+    if dones:
+        last = dones[-1]
+        out["last"] = {k: last.get(k) for k in
+                       ("result", "reason", "from_step", "to_step",
+                        "canary", "gate_evals", "rollback_ms",
+                        "converged", "deploy_ms")
+                       if last.get(k) is not None}
     return out
 
 

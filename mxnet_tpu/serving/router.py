@@ -117,19 +117,61 @@ class _Breaker:
 class RouterResponse:
     """One routed result plus its provenance: which replica answered,
     which checkpoint step served it (the rolling-reload version stamp),
-    how many attempts it took, and whether a hedge fired."""
+    how many attempts it took, and whether a hedge fired.  During a
+    canary deployment ``deploy_role`` tags the placement arm ("canary"
+    or "control"); None outside a deploy."""
 
     __slots__ = ("value", "replica", "params_step", "attempts", "hedged",
-                 "latency_ms")
+                 "latency_ms", "deploy_role")
 
     def __init__(self, value, replica, params_step, attempts, hedged,
-                 latency_ms):
+                 latency_ms, deploy_role=None):
         self.value = value
         self.replica = replica
         self.params_step = params_step
         self.attempts = attempts
         self.hedged = hedged
         self.latency_ms = latency_ms
+        self.deploy_role = deploy_role
+
+
+class _DeployTap:
+    """Canary/control bookkeeping for ONE deployment, installed by the
+    DeployController via :meth:`Router.set_deploy` and torn down on
+    promote/rollback.  Counters are guarded by the router lock; the two
+    latency summaries are internally thread-safe.  The tap is a fresh
+    window — it observes only traffic DURING the deploy, so the gate
+    comparison is live canary-vs-control, not polluted by pre-deploy
+    history."""
+
+    __slots__ = ("canary", "mirror_every", "rtol", "atol", "_n",
+                 "lat_canary", "lat_control", "served", "failures",
+                 "mirrors", "mirror_mismatch", "mirror_errors",
+                 "mirror_skipped", "mirror_inflight", "max_inflight")
+
+    def __init__(self, canary, mirror_fraction, rtol, atol,
+                 max_inflight=4):
+        self.canary = frozenset(map(str, canary))
+        # deterministic 1-in-N sampling (no RNG on the request path);
+        # fraction <= 0 disables mirroring
+        self.mirror_every = (0 if mirror_fraction <= 0
+                             else max(int(round(1.0 / mirror_fraction)), 1))
+        self.rtol = float(rtol)
+        self.atol = float(atol)
+        self._n = 0
+        self.lat_canary = LatencySummary("deploy_canary_ms")
+        self.lat_control = LatencySummary("deploy_control_ms")
+        self.served = {"canary": 0, "control": 0}
+        self.failures = {"canary": 0, "control": 0}
+        self.mirrors = 0
+        self.mirror_mismatch = 0
+        self.mirror_errors = 0
+        self.mirror_skipped = 0
+        self.mirror_inflight = 0
+        self.max_inflight = int(max_inflight)   # bounded mirror threads
+
+    def role(self, rid) -> str:
+        return "canary" if str(rid) in self.canary else "control"
 
 
 class Router:
@@ -145,6 +187,9 @@ class Router:
         # _emit_breaker after release (graftlint G15)
         self._lock = threading.RLock()
         self._rr = itertools.count()         # least-loaded tiebreak
+        self._deploy = None                  # _DeployTap while a canary
+                                             # deployment is live (guarded
+                                             # by _lock)
         self._breakers: dict = {}            # rid -> _Breaker
         self._latency: dict = {}             # rid -> LatencySummary
         self._attempt_counts: dict = {}      # rid -> attempts routed
@@ -236,15 +281,28 @@ class Router:
                     time.sleep(pause)
                 continue
             hedged_any = hedged_any or hedged
-            self._record_success(meta["replica"],
-                                 (time.monotonic() - t0) * 1000.0)
+            latency_ms = (time.monotonic() - t0) * 1000.0
+            self._record_success(meta["replica"], latency_ms)
             with self._lock:
                 self.counters["served"] += 1
+                tap = self._deploy
+            role = None
+            if tap is not None:
+                role = tap.role(meta["replica"])
+                (tap.lat_canary if role == "canary"
+                 else tap.lat_control).observe(latency_ms)
+                with self._lock:
+                    tap.served[role] += 1
+                if role == "control":
+                    # parity sampling: mirror a fraction of control-served
+                    # requests onto a canary replica and compare outputs
+                    self._maybe_mirror(tap, x, value, deadline_ms, tenant)
             self._note_tenant(tenant, "served")
             return RouterResponse(
                 value, meta["replica"], meta.get("params_step"),
                 attempts, hedged_any,
-                round((time.monotonic() - t0) * 1000.0, 3))
+                round((time.monotonic() - t0) * 1000.0, 3),
+                deploy_role=role)
         # deadline budget exhausted across retries
         late_ms = max(time.monotonic() - deadline_ts, 0.0) * 1000.0
         err = DeadlineExceeded("router_budget", late_ms,
@@ -508,6 +566,9 @@ class Router:
     def _record_failure(self, rid, exc):
         with self._lock:
             self.counters["failures"] += 1
+            tap = self._deploy
+            if tap is not None:
+                tap.failures[tap.role(rid)] += 1
         # busy is not broken, and a non-retryable caller error (shape
         # reject, cancelled hedge) says nothing about replica health;
         # deadline misses DO count — a replica too slow to answer in
@@ -576,6 +637,11 @@ class Router:
             self.counters["attempts"] += 1
             self._attempt_counts[state.id] = \
                 self._attempt_counts.get(state.id, 0) + 1
+            tap = self._deploy
+        if tap is not None and state.id in tap.canary:
+            # distinct chaos seam from router_attempt: faults.slow_canary
+            # targets exactly canary-bound dispatches (live or mirrored)
+            _atomic.trip("deploy_canary", state.id)
         replica = self.pool.replicas[state.id]
         deadline_ms = budget_s * 1000.0
         with _trace.span("router_attempt", replica=state.id,
@@ -686,6 +752,115 @@ class Router:
         err._hedged = hedged
         raise err
 
+    # -- canary deployment tap (serving/deploy.py) -----------------------
+    def set_deploy(self, canary, mirror_fraction=0.0, rtol=1e-5,
+                   atol=1e-6) -> "_DeployTap":
+        """Install the canary/control tap for one deployment: responses
+        gain ``deploy_role``, canary-bound dispatches trip the
+        ``deploy_canary`` chaos site, and (``mirror_fraction`` > 0) a
+        deterministic 1-in-N sample of control-served requests is
+        mirrored onto a canary replica and compared tolerance-gated.
+        One deploy at a time — installing over a live tap is a bug in
+        the caller (the pool's deploy ownership already serializes)."""
+        tap = _DeployTap(canary, mirror_fraction, rtol, atol)
+        with self._lock:
+            self._deploy = tap
+        return tap
+
+    def clear_deploy(self) -> None:
+        with self._lock:
+            self._deploy = None
+
+    def deploy_stats(self):
+        """One consistent snapshot of the live tap (None outside a
+        deploy) — the DeployController's gate-evaluation source."""
+        with self._lock:
+            tap = self._deploy
+            if tap is None:
+                return None
+            out = {"canary": sorted(tap.canary),
+                   "served": dict(tap.served),
+                   "failures": dict(tap.failures),
+                   "mirrors": tap.mirrors,
+                   "mirror_mismatch": tap.mirror_mismatch,
+                   "mirror_errors": tap.mirror_errors,
+                   "mirror_skipped": tap.mirror_skipped}
+        for arm, lat in (("canary", tap.lat_canary),
+                         ("control", tap.lat_control)):
+            out[f"{arm}_count"] = lat.count
+            out[f"{arm}_p99_ms"] = lat.percentile(99) if lat.count else None
+        return out
+
+    def _maybe_mirror(self, tap, x, expect, deadline_ms, tenant):
+        """Sampling + in-flight-cap gate for one mirror candidate; the
+        actual duplicate dispatch runs on a bounded daemon thread so the
+        client never pays the second attempt's latency."""
+        with self._lock:
+            if tap is not self._deploy or tap.mirror_every <= 0:
+                return
+            tap._n += 1
+            if tap._n % tap.mirror_every:
+                return
+            if tap.mirror_inflight >= tap.max_inflight:
+                tap.mirror_skipped += 1    # bounded, never queued: a slow
+                return                     # canary must not pile threads
+            tap.mirror_inflight += 1
+        ctx = _trace.current_context()
+        threading.Thread(
+            target=self._run_mirror,
+            args=(tap, x, expect, deadline_ms, tenant, ctx),
+            daemon=True, name="mxtpu-router-mirror").start()
+
+    def _run_mirror(self, tap, x, expect, deadline_ms, tenant, ctx):
+        """One mirrored parity probe: duplicate the request onto an
+        alive+ready canary replica, compare against the control answer
+        bit-wise within (rtol, atol).  A mismatch journals
+        ``deploy_mirror_mismatch`` (trace-correlated under the request
+        span); a transport/predict failure counts as a mirror error —
+        the gate reads both."""
+        try:
+            with _trace.start_span("deploy_mirror", parent=ctx) as sp:
+                view = self.pool.view()
+                cands = [s for s in view if s.id in tap.canary
+                         and s.alive and s.ready]
+                if not cands:
+                    with self._lock:
+                        tap.mirrors += 1
+                        tap.mirror_errors += 1
+                    sp.set_attrs(status="no_canary")
+                    return
+                st = cands[next(self._rr) % len(cands)]
+                _atomic.trip("deploy_canary", st.id)
+                try:
+                    got, meta = self.pool.replicas[st.id].predict(
+                        x, deadline_ms, cancel=None, tenant=tenant)
+                except Exception as e:
+                    with self._lock:
+                        tap.mirrors += 1
+                        tap.mirror_errors += 1
+                    sp.set_attrs(status=type(e).__name__)
+                    return
+                a = np.asarray(got, dtype=np.float64)
+                b = np.asarray(expect, dtype=np.float64)
+                ok = a.shape == b.shape and bool(
+                    np.allclose(a, b, rtol=tap.rtol, atol=tap.atol))
+                with self._lock:
+                    tap.mirrors += 1
+                    if not ok:
+                        tap.mirror_mismatch += 1
+                sp.set_attrs(status="ok" if ok else "mismatch",
+                             replica=st.id)
+                if not ok:
+                    delta = (float(np.max(np.abs(a - b)))
+                             if a.shape == b.shape else None)
+                    get_journal().event(
+                        "deploy_mirror_mismatch", replica=st.id,
+                        step=meta.get("params_step"),
+                        max_abs_delta=delta)
+        finally:
+            with self._lock:
+                tap.mirror_inflight -= 1
+
     # -- reporting -------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
@@ -705,6 +880,9 @@ class Router:
         out = {**counters, "replicas": per_replica}
         if tenants:
             out["tenants"] = tenants
+        deploy = self.deploy_stats()
+        if deploy is not None:
+            out["deploy"] = deploy
         return out
 
     def metrics_text(self) -> str:
@@ -717,8 +895,26 @@ class Router:
         ev = reg.gauge("mxnet_tpu_router_events",
                        "router counters (cumulative)", ("event",))
         for k, v in st.items():
-            if k not in ("replicas", "tenants"):
+            if k not in ("replicas", "tenants", "deploy"):
                 ev.labels(event=k).set(v)
+        dep = st.get("deploy")
+        if dep:
+            dg = reg.gauge("mxnet_tpu_deploy_arm",
+                           "live canary-vs-control stats for the active "
+                           "deployment", ("arm", "stat"))
+            for arm in ("canary", "control"):
+                dg.labels(arm=arm, stat="served").set(dep["served"][arm])
+                dg.labels(arm=arm, stat="failures").set(
+                    dep["failures"][arm])
+                if dep.get(f"{arm}_p99_ms") is not None:
+                    dg.labels(arm=arm, stat="p99_ms").set(
+                        dep[f"{arm}_p99_ms"])
+            mg = reg.gauge("mxnet_tpu_deploy_mirrors",
+                           "mirrored parity probes for the active "
+                           "deployment", ("outcome",))
+            mg.labels(outcome="total").set(dep["mirrors"])
+            mg.labels(outcome="mismatch").set(dep["mirror_mismatch"])
+            mg.labels(outcome="error").set(dep["mirror_errors"])
         if st.get("tenants"):
             tev = reg.gauge("mxnet_tpu_router_tenant_events",
                             "per-tenant router counters (cumulative)",

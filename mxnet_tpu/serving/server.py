@@ -187,6 +187,9 @@ class Server:
         self._closed = False
         self._params_step = None
         self._last_reload_check = None
+        self._pin_dirty = False        # guarded by _lock; set by pin_params
+                                       # (controller thread), consumed by the
+                                       # worker thread in _maybe_reload
         self._last_batch_t = None
         self._metrics_httpd = None
         # exposition identity: the serving metric families are process-
@@ -911,23 +914,27 @@ class Server:
                     "drift; not hot-reloadable")
         return norm
 
-    def _maybe_reload(self, force=False):
+    def pin_params(self, step):
+        """Pin the hot-reload store to ``step`` (None unpins) — the
+        deploy controller's per-replica version lever.  The pin itself
+        lands immediately (``poll`` stops advancing past it); when the
+        LIVE step differs from the pin, the actual load+apply happens on
+        the worker thread at its next loop turn, the same between-batches
+        seam every other reload uses — including a DOWNGRADE back to an
+        older step, which is the rollback path.  Returns True when a
+        store exists to pin."""
         store = self.param_store
         if store is None:
             return False
-        poll_s = self.config.reload_poll_s
-        if poll_s < 0 and not force:
-            return False
-        now = time.monotonic()
-        if not force and self._last_reload_check is not None and \
-                now - self._last_reload_check < poll_s:
-            return False
-        self._last_reload_check = now
-        got = store.poll()
-        if got is None:
-            return False
-        step, loaded = got
-        prev = self._params_step
+        store.pin_step(step)
+        with self._lock:
+            self._pin_dirty = step is not None
+        return True
+
+    def _apply_params(self, step, loaded, prev):
+        """Apply an already-loaded parameter dict; shared by the poll
+        lane and the explicit pin/rollback lane."""
+        store = self.param_store
         loaded = {k: v for k, v in loaded.items() if not k.startswith("__")}
         try:
             # validate the WHOLE dict against the live parameter shapes
@@ -956,3 +963,46 @@ class Server:
         get_journal().event("serving_reload", step=step,
                             n_params=len(loaded), prev_step=prev)
         return True
+
+    def _apply_pin(self, store):
+        """Converge the live step onto the pinned one — runs on the
+        worker thread.  Unlike the poll lane this is an EXPLICIT load of
+        one named step (downgrades allowed): there is no safe substitute
+        for a rollback target, so a failure journals and stays on the
+        current version rather than hunting for an alternative."""
+        pinned = store.pinned_step
+        if pinned is None or self._params_step == pinned:
+            return False
+        prev = self._params_step
+        try:
+            step, loaded = store.load_step(pinned)
+        except (ValueError, MXNetError, OSError) as e:
+            get_journal().event("serving_reload_failed", step=pinned,
+                                error=type(e).__name__, detail=str(e)[:300])
+            return False
+        return self._apply_params(step, loaded, prev)
+
+    def _maybe_reload(self, force=False):
+        store = self.param_store
+        if store is None:
+            return False
+        with self._lock:
+            pin_dirty, self._pin_dirty = self._pin_dirty, False
+        if pin_dirty:
+            # the pin lane bypasses the poll throttle (and a disabled
+            # poller): a deploy rollback must land within its deadline
+            # budget, not at the operator's reload cadence
+            return self._apply_pin(store)
+        poll_s = self.config.reload_poll_s
+        if poll_s < 0 and not force:
+            return False
+        now = time.monotonic()
+        if not force and self._last_reload_check is not None and \
+                now - self._last_reload_check < poll_s:
+            return False
+        self._last_reload_check = now
+        got = store.poll()
+        if got is None:
+            return False
+        step, loaded = got
+        return self._apply_params(step, loaded, self._params_step)

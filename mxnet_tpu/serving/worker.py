@@ -193,6 +193,15 @@ class _Front:
             wire.send_frame(conn, {"ok": True, "stats": st})
         elif cmd == "ping":
             wire.send_frame(conn, {"ok": True, "pid": os.getpid()})
+        elif cmd == "pin":
+            # deploy-controller lever: pin/unpin the ParamStore to one
+            # step; the worker thread converges the live version at its
+            # next loop turn (Server.pin_params)
+            step = header.get("step")
+            pin = getattr(self.server, "pin_params", None)
+            took = bool(pin(step)) if pin is not None else False
+            wire.send_frame(conn, {"ok": True, "pinned": took,
+                                   "step": step})
         elif cmd == "stop":
             wire.send_frame(conn, {"ok": True})
             self.stop_evt.set()
@@ -295,6 +304,11 @@ def add_worker_args(parser) -> None:
     parser.add_argument("--max-queue", type=int, default=64)
     parser.add_argument("--deadline-ms", type=float, default=2000.0)
     parser.add_argument("--reload-poll-s", type=float, default=0.5)
+    parser.add_argument("--pin-step", type=int, default=None,
+                        help="pin the ParamStore to this committed step "
+                             "at startup (deploy canary/rollback: the "
+                             "worker neither advances past nor drifts "
+                             "off its assigned version until unpinned)")
     parser.add_argument("--aot-dir", default=None,
                         help="persistent AOT executable-cache root "
                              "(default MXNET_TPU_AOT_CACHE_DIR — the "
@@ -374,6 +388,9 @@ def cmd_worker(args) -> int:
                            default_deadline_ms=args.deadline_ms,
                            reload_poll_s=args.reload_poll_s, **aot_kw)
         store = ParamStore(args.ckpt_root) if args.ckpt_root else None
+        if store is not None and getattr(args, "pin_step", None) is not None:
+            store.pin_step(args.pin_step)   # before start(): the initial
+                                            # force-reload lands on the pin
         server = Server(net, config=cfg, param_store=store).start()
 
     front = _Front(server, args)

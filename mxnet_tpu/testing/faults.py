@@ -41,9 +41,9 @@ from ..resilience import atomic
 __all__ = ["CRASH_POINTS", "FaultError", "FaultPlan", "FaultRule",
            "PoisonError", "PoisonSchedule", "SimulatedCrash",
            "corrupt_params", "crash", "inject", "io_error",
-           "poison_batch", "poison_grads", "sigkill", "sigterm",
-           "slow_call", "tenant_poison", "torn_heartbeat",
-           "write_offsets"]
+           "poison_batch", "poison_grads", "regress_params", "sigkill",
+           "sigterm", "slow_call", "slow_canary", "tenant_poison",
+           "torn_heartbeat", "write_offsets"]
 
 # every phase of one atomic file write, in order — plus the commit
 # protocol's own points (publish = the step-dir rename commit point)
@@ -188,6 +188,55 @@ def corrupt_params(root, step, params_file=None, flip_at=None):
         f.seek(at)
         f.write(bytes([data[at] ^ 0xFF]))
     return path
+
+
+def regress_params(root, step, scale=10.0, params_file=None):
+    """Systematically skew a COMMITTED step's weights and RE-MANIFEST
+    it, so CRC validation PASSES while every output is wrong-but-finite
+    — the silent model regression (bad training run, mis-exported
+    quantization, wrong branch promoted) that no storage checksum can
+    catch.  The counterpart of :func:`corrupt_params`: that one leaves
+    the manifest stale so the CRC gate rejects the step; this one is
+    indistinguishable from a healthy checkpoint until you LOOK AT THE
+    ANSWERS, which is exactly what the deploy controller's mirrored
+    parity gate does (docs/serving.md, canary deployment).  Every
+    ``.params`` array is multiplied by ``scale``; returns the skewed
+    file's path."""
+    import numpy as np
+    from .. import ndarray as nd
+    from ..resilience import commit as _commit
+    d = _commit.step_dir(root, step)
+    manifest = _commit.read_manifest(d)
+    if params_file is None:
+        names = sorted(f for f in manifest["files"]
+                       if f.endswith(".params"))
+        if not names:
+            raise ValueError(f"no .params payload in {d}")
+        params_file = names[0]
+    path = os.path.join(d, params_file)
+    loaded = nd.load(path)
+    skewed = {k: nd.array(np.asarray(v.asnumpy()) * float(scale))
+              for k, v in loaded.items()}
+    nd.save(path, skewed)
+    # refresh the CRCs over the skewed payload: the step stays fully
+    # commit-protocol-valid — the whole point of this fault shape
+    _commit.write_manifest(d, step, manifest.get("meta") or {})
+    return path
+
+
+def slow_canary(delay_s, replica=None, times=None) -> FaultRule:
+    """Inject ``delay_s`` of latency at the ``deploy_canary`` trip site
+    (serving/router.py): every canary-bound dispatch — live traffic
+    routed to a canary replica AND mirrored parity probes — during a
+    deployment, optionally narrowed to one ``replica`` id.  Control
+    traffic is untouched, so the deploy p99 gate sees a clean
+    canary-vs-control latency split: the slow-canary chaos shape that
+    must roll back on the p99 delta, distinctly from a numerically bad
+    canary (:func:`regress_params`)."""
+    return FaultRule("deploy_canary", None,
+                     path_part=None if replica is None else str(replica),
+                     times=times,
+                     action=lambda p, f, n: time.sleep(delay_s))
 
 
 def torn_heartbeat(path_part="hb/", keep_bytes=7, times=1) -> FaultRule:

@@ -1,0 +1,569 @@
+"""Canary-gated deployment controller (docs/serving.md, canary
+deployment).
+
+The headline chaos drill (CI tier 0.5, ``-k smoke``): a trainer commits
+a REGRESSED step (systematically skewed weights, CRC-valid — the
+corruption class checksums cannot catch) onto a 3-replica pool under
+closed-loop load; the deploy controller canaries it onto exactly one
+replica, the sampled output-parity gate trips on the first mirrored
+comparison, and the fleet auto-rolls-back — zero responses whose value
+contradicts their version stamp, control replicas never serve the bad
+root (blast radius = the canary set by construction), the rolled-back
+store stays PINNED so the bad-but-newest commit cannot be silently
+re-adopted, and the whole trail is journaled under one ``deploy`` trace
+span for ``doctor --serving-journal``.
+
+Around it: the good-path promote (with a concurrent ``pool.reload()``
+refused mid-canary as structured ``DeployInProgress``), the slow-canary
+p99 gate, the canary-lost hard signal (heartbeat gone mid-canary), the
+``ParamStore`` pin regression, ``regress_params`` itself, and the
+journal reduction's deploy section.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.diagnostics.journal import reset_journal
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.resilience import commit
+from mxnet_tpu.serving import (DeployConfig, DeployController,
+                               DeployInProgress, ParamStore, PoolConfig,
+                               ReplicaPool, Router, RouterConfig, Server,
+                               ServerConfig, serving_report)
+from mxnet_tpu.testing import faults
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    reset_journal(path)
+    try:
+        yield path
+    finally:
+        reset_journal("stderr")
+
+
+def _records(path, kind=None):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+class Scale(HybridBlock):
+    """y = x * w: the weight value IS the served checkpoint's
+    fingerprint, so stamp-vs-value assertions ride it."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.w = self.params.get("w", shape=(1,), init="ones")
+
+    def hybrid_forward(self, F, x, w):
+        return x * w
+
+
+def _commit_scale(root, step, value):
+    stage = commit.prepare_stage(root, step)
+    nd.save(os.path.join(stage, "net.params"),
+            {"w": nd.array(np.asarray([value], np.float32))})
+    return commit.finalize(root, step)
+
+
+def _local_pool(root, n=3, ckpt_root=None, heartbeat_s=0.1,
+                deadline_s=0.6, **server_kw):
+    server_kw.setdefault("max_batch", 4)
+    server_kw.setdefault("window_ms", 1.0)
+    server_kw.setdefault("reload_poll_s", -1.0)   # pin lane only: the
+    # deploy controller must fully drive versions, not race a poller
+
+    def factory():
+        net = Scale()
+        net.initialize()
+        store = ParamStore(ckpt_root) if ckpt_root else None
+        return Server(net, config=ServerConfig(**server_kw),
+                      param_store=store)
+
+    pool = ReplicaPool(root, PoolConfig(heartbeat_s=heartbeat_s,
+                                        deadline_s=deadline_s))
+    for i in range(n):
+        pool.add_local(f"r{i}", factory)
+    return pool
+
+
+def _wait_steps(pool, step, deadline_s=15.0):
+    """Bounded wait for every replica beacon to advertise ``step`` —
+    the first beat can race the startup force-reload."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if all(s.params_step == step for s in pool.view()):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _wait_record(path, kind, deadline_s=20.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        recs = _records(path, kind)
+        if recs:
+            return recs
+        time.sleep(0.02)
+    return []
+
+
+# -- satellites: faults + ParamStore pin -------------------------------------
+
+def test_regress_params_is_crc_valid_but_skewed(tmp_path):
+    """``regress_params`` models the failure CRC cannot catch: the
+    weights are systematically scaled, the manifest is REWRITTEN over
+    the skewed bytes, so validation passes and only behavior (output
+    parity) can notice."""
+    ck = str(tmp_path / "ckpt")
+    _commit_scale(ck, 1, 3.0)
+    path = faults.regress_params(ck, 1, scale=10.0)
+    assert path.endswith("net.params")
+    commit.validate_step(ck, 1)              # CRC-valid: no ValueError
+    loaded = nd.load(path)
+    assert abs(float(np.asarray(loaded["w"].asnumpy())[0]) - 30.0) < 1e-5
+    # contrast: corrupt_params leaves a stale manifest that FAILS
+    faults.corrupt_params(ck, 1)
+    with pytest.raises(ValueError):
+        commit.validate_step(ck, 1)
+
+
+def test_param_store_pin_ignores_newer_commits(tmp_path):
+    """Regression (the rollback lever): a pinned store must ignore
+    newer commits until unpinned — a rolled-back replica cannot
+    re-adopt the bad-but-newest root on its next poll."""
+    ck = str(tmp_path / "ckpt")
+    _commit_scale(ck, 1, 2.0)
+    store = ParamStore(ck)
+    step, loaded = store.poll()
+    assert step == 1 and "w" in loaded
+    store.pin_step(1)
+    _commit_scale(ck, 2, 5.0)                # newer lands on disk ...
+    assert store.poll() is None              # ... and stays invisible
+    assert store.loaded_step == 1
+    # explicit load of the pinned step is a downgrade-capable no-op path
+    step, loaded = store.load_step(1)
+    assert step == 1
+    store.pin_step(None)                     # unpin: newest-wins resumes
+    step, loaded = store.poll()
+    assert step == 2
+    assert abs(float(np.asarray(loaded["w"].asnumpy())[0]) - 5.0) < 1e-5
+    # pin below loaded_step + load_step downgrades explicitly
+    store.pin_step(1)
+    step, _ = store.load_step(1)
+    assert step == 1 and store.loaded_step == 1
+
+
+def test_slow_canary_rule_targets_deploy_trip_site():
+    from mxnet_tpu.resilience import atomic
+    t0 = time.monotonic()
+    with faults.inject(faults.slow_canary(0.2, replica="rX")):
+        atomic.trip("deploy_canary", "rX")    # matches: sleeps
+        atomic.trip("deploy_canary", "rY")    # other replica: instant
+        atomic.trip("router_attempt", "rX")   # other site: instant
+    assert 0.2 <= time.monotonic() - t0 < 1.0
+
+
+# -- controller validation ----------------------------------------------------
+
+def test_deploy_config_validation():
+    with pytest.raises(MXNetError):
+        DeployConfig(canary_k=0)
+    with pytest.raises(MXNetError):
+        DeployConfig(window_s=0.0)
+    with pytest.raises(MXNetError):
+        DeployConfig(mirror_fraction=1.5)
+    with pytest.raises(MXNetError):
+        DeployConfig(deadline_s=1.0, window_s=2.0)
+
+
+def test_deploy_noop_and_refusals(tmp_path, journal_file):
+    ck = str(tmp_path / "ckpt")
+    _commit_scale(ck, 1, 2.0)
+    pool = _local_pool(str(tmp_path / "pool"), n=2, ckpt_root=ck).start()
+    router = Router(pool, RouterConfig())
+    try:
+        cfg = DeployConfig(canary_k=1, window_s=0.2, deadline_s=5.0)
+        ctl = DeployController(pool, router, ck, cfg)
+        assert ctl.deploy(1)["result"] == "noop"     # already serving it
+        with pytest.raises(MXNetError):              # no control arm left
+            DeployController(pool, router, ck,
+                             DeployConfig(canary_k=2, window_s=0.2,
+                                          deadline_s=5.0)).deploy(1)
+        with pytest.raises(ValueError):              # uncommitted step
+            ctl.deploy(99)
+        empty = str(tmp_path / "empty")
+        os.makedirs(empty)
+        with pytest.raises(MXNetError):              # nothing to deploy
+            DeployController(pool, router, empty, cfg).deploy()
+    finally:
+        router.stop()
+        pool.stop()
+
+
+# -- the good path + DeployInProgress refusal --------------------------------
+
+def test_good_deploy_promotes_and_reload_refused_mid_canary(
+        tmp_path, journal_file):
+    """Clean canary → promote: gates pass on live p99/error stats, the
+    remaining replicas roll forward, every replica ends unpinned on the
+    new step — and mid-canary the pool refuses a concurrent
+    ``pool.reload()`` (and a second deploy) with structured
+    ``DeployInProgress`` instead of tearing the version contract.
+    Every response during the canary carries exactly the canary or the
+    control step, never a third."""
+    ck = str(tmp_path / "ckpt")
+    _commit_scale(ck, 1, 2.0)
+    pool = _local_pool(str(tmp_path / "pool"), n=3, ckpt_root=ck).start()
+    router = Router(pool, RouterConfig(retries=3))
+    x = np.ones(4, np.float32)
+    seen, errors, stop = [], [], threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                resp = router.call(x, deadline_ms=8000)
+            except Exception as e:            # pragma: no cover - loud
+                errors.append(repr(e))
+                return
+            seen.append((float(np.asarray(resp.value)[0]),
+                         resp.params_step))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(3)]
+    result = {}
+    try:
+        assert _wait_steps(pool, 1)
+        for t in threads:
+            t.start()
+        _commit_scale(ck, 2, 5.0)
+        # weights genuinely change, so parity mirroring is OFF: the
+        # promote decision rides the statistical gates alone
+        cfg = DeployConfig(canary_k=1, window_s=0.3, promote_after=2,
+                           min_samples=5, mirror_fraction=0.0,
+                           rollback_s=15.0, deadline_s=45.0)
+        ctl = DeployController(pool, router, ck, cfg)
+
+        def run():
+            try:
+                result.update(ctl.deploy(2))
+            except Exception as e:            # pragma: no cover - loud
+                result["error"] = repr(e)
+
+        dep = threading.Thread(target=run, daemon=True)
+        dep.start()
+        assert _wait_record(journal_file, "canary_up"), \
+            "canary never came up"
+        # mid-canary: fleet mutations are refused, not queued
+        with pytest.raises(DeployInProgress) as ei:
+            pool.reload()
+        assert ei.value.op == "reload"
+        with pytest.raises(DeployInProgress):
+            DeployController(pool, router, ck, cfg).deploy(2)
+        dep.join(timeout=60)
+        assert not dep.is_alive()
+        final_steps = [s.params_step for s in pool.view()]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        router.stop()
+        pool.stop()
+    assert not errors, errors[:3]
+    assert result.get("result") == "promoted", result
+    assert result["gate_evals"] >= 2
+    # the fleet converged on the new step, unpinned (newest-wins resumes)
+    assert final_steps and all(s == 2 for s in final_steps)
+    for rep in pool.replicas.values():
+        assert rep.server.param_store.pinned_step is None
+    assert pool.deploy_owner() is None
+    # old-xor-new, numerically matched: never a third version
+    assert seen
+    for value, step in seen:
+        assert step in (1, 2), (value, step)
+        want = 2.0 if step == 1 else 5.0
+        assert abs(value - want) < 1e-5, (value, step)
+    assert {s for _, s in seen} == {1, 2}
+
+
+# -- gate breaches ------------------------------------------------------------
+
+def test_slow_canary_p99_gate_rolls_back(tmp_path, journal_file):
+    """A canary that answers correctly but SLOWLY must still fail: the
+    p99 gate compares fresh per-arm latency windows and rolls back."""
+    ck = str(tmp_path / "ckpt")
+    _commit_scale(ck, 1, 2.0)
+    pool = _local_pool(str(tmp_path / "pool"), n=3, ckpt_root=ck).start()
+    router = Router(pool, RouterConfig(retries=3))
+    x = np.ones(4, np.float32)
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                router.call(x, deadline_ms=8000)
+            except Exception:                  # pragma: no cover
+                time.sleep(0.01)
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        _commit_scale(ck, 2, 2.0)              # same weights: only the
+        cfg = DeployConfig(canary_k=1, window_s=0.5, promote_after=3,
+                           min_samples=5, mirror_fraction=0.0,
+                           p99_ratio=1.5, p99_floor_ms=50.0,
+                           rollback_s=15.0, deadline_s=45.0)
+        ctl = DeployController(pool, router, ck, cfg)
+        with faults.inject(faults.slow_canary(0.25, replica="r0")):
+            result = ctl.deploy(2)             # latency distinguishes
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        router.stop()
+        pool.stop()
+    assert result["result"] == "rolled_back", result
+    assert result["reason"] == "p99"
+    assert result["converged"]
+    evals = _records(journal_file, "gate_eval")
+    assert evals and evals[-1]["verdict"] == "breach"
+    assert evals[-1]["canary_p99_ms"] > evals[-1]["control_p99_ms"]
+
+
+def test_canary_lost_hard_signal_rolls_back_without_traffic(
+        tmp_path, journal_file):
+    """A canary losing its heartbeat mid-canary (the SIGKILL/host-
+    vanished shape) is an immediate breach — no statistics, no
+    min_samples wait, no traffic needed at all."""
+    ck = str(tmp_path / "ckpt")
+    _commit_scale(ck, 1, 2.0)
+    pool = _local_pool(str(tmp_path / "pool"), n=3, ckpt_root=ck).start()
+    router = Router(pool, RouterConfig())
+    result = {}
+    try:
+        _commit_scale(ck, 2, 5.0)
+        cfg = DeployConfig(canary_k=1, window_s=0.3, promote_after=50,
+                           min_samples=10_000, mirror_fraction=0.0,
+                           rollback_s=10.0, deadline_s=30.0)
+        ctl = DeployController(pool, router, ck, cfg)
+
+        def run():
+            result.update(ctl.deploy(2))
+
+        dep = threading.Thread(target=run, daemon=True)
+        dep.start()
+        assert _wait_record(journal_file, "canary_up")
+        pool.replicas["r0"]._hb.stop()         # beats stop; goes stale
+        dep.join(timeout=60)
+        assert not dep.is_alive()
+    finally:
+        router.stop()
+        pool.stop()
+    assert result.get("result") == "rolled_back", result
+    assert result["reason"] == "canary_lost"
+    # the handle remembers the rollback pin: a monitor respawn of this
+    # replica would come back pinned to the old step
+    assert pool.replicas["r0"]._pin == 1
+
+
+# -- the chaos headline (CI tier 0.5 smoke) ----------------------------------
+
+def test_deploy_chaos_smoke_regressed_canary_parity_rollback(
+        tmp_path, journal_file):
+    """A REGRESSED (CRC-valid, wrong-answer) step is canaried onto 1 of
+    3 replicas under closed-loop load: the sampled output-parity gate
+    trips, the fleet auto-rolls-back within the deadline budget, and
+
+    - zero responses whose value contradicts their version stamp;
+    - the bad step is only ever served BY the canary (blast radius
+      = the canary set, measured client-side per replica);
+    - after rollback no response carries the bad step;
+    - the rolled-back store stays pinned: the bad-but-newest commit
+      is not re-adopted;
+    - the full trail (deploy_start → canary_up → gate_eval → rollback
+      → deploy_done) shares one trace id, and the doctor's
+      serving-journal reduction + one-line summary render it."""
+    from mxnet_tpu.observability import trace as obtrace
+    obtrace.configure(mode="journal")
+    ck = str(tmp_path / "ckpt")
+    _commit_scale(ck, 1, 3.0)
+    pool = _local_pool(str(tmp_path / "pool"), n=3, ckpt_root=ck).start()
+    router = Router(pool, RouterConfig(retries=3))
+    w_by_step = {1: 3.0, 2: 30.0}       # step 2 is regressed 10x
+    seen, errors, stop = [], [], threading.Event()
+
+    def client(idx):
+        rng = np.random.default_rng(idx)
+        while not stop.is_set():
+            x = rng.standard_normal(4).astype(np.float32)
+            try:
+                resp = router.call(x, deadline_ms=8000)
+            except Exception as e:            # pragma: no cover - loud
+                errors.append(repr(e))
+                time.sleep(0.05)
+                continue
+            ok = np.allclose(np.asarray(resp.value),
+                             x * w_by_step.get(resp.params_step,
+                                               float("nan")),
+                             rtol=1e-4, atol=1e-5)
+            seen.append((resp.params_step, resp.replica, bool(ok),
+                         time.monotonic()))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(3)]
+    try:
+        assert _wait_steps(pool, 1)
+        for t in threads:
+            t.start()
+        # the trainer publishes the SAME weights ... then a systematic
+        # regression lands on them, CRC-valid: only parity can see it
+        _commit_scale(ck, 2, 3.0)
+        faults.regress_params(ck, 2, scale=10.0)
+        cfg = DeployConfig(canary_k=1, window_s=0.3, promote_after=3,
+                           min_samples=5, mirror_fraction=0.25,
+                           mismatch_budget=0, rollback_s=10.0,
+                           deadline_s=45.0)
+        ctl = DeployController(pool, router, ck, cfg)
+        result = ctl.deploy(2)
+        t_done = time.monotonic()
+        time.sleep(0.5)                        # post-rollback traffic
+        final_steps = [s.params_step for s in pool.view()]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        router.stop()
+        pool.stop()
+        obtrace.reset_tracer()
+
+    # terminal state: rolled back on parity, within the deadline budget
+    assert result["result"] == "rolled_back", result
+    assert result["reason"] == "parity"
+    assert result["converged"]
+    assert result["rollback_ms"] <= cfg.rollback_s * 1000.0
+    assert not errors, errors[:3]
+    assert seen
+
+    # (1) zero stamp-contradicting responses, and never a third version
+    bad = [row for row in seen if not row[2]]
+    assert not bad, bad[:3]
+    assert {s for s, _, _, _ in seen} <= {1, 2}
+
+    # (2) blast radius: the bad step only ever came from the canary,
+    # and the control replicas served the old fingerprint throughout
+    canary_rid = result["canary"][0]
+    assert {r for s, r, _, _ in seen if s == 2} <= {canary_rid}
+    for s, r, _, _ in seen:
+        if r != canary_rid:
+            assert s == 1, (s, r)
+
+    # (3) nothing carries the bad step after rollback completed
+    late_bad = [row for row in seen
+                if row[0] == 2 and row[3] > t_done + 0.25]
+    assert not late_bad, late_bad[:3]
+
+    # (4) the rolled-back canary is pinned: newest-on-disk (the bad
+    # step) stays invisible to its store
+    store = pool.replicas[canary_rid].server.param_store
+    assert store.pinned_step == 1
+    assert store.poll() is None
+    assert final_steps and all(s == 1 for s in final_steps)
+
+    # (5) the journal trail is complete and trace-correlated
+    mism = _records(journal_file, "deploy_mirror_mismatch")
+    assert mism, "parity mismatch never journaled"
+    trail = {k: _records(journal_file, k)
+             for k in ("deploy_start", "canary_up", "gate_eval",
+                       "rollback", "deploy_done")}
+    for kind, recs in trail.items():
+        assert recs, f"missing {kind} record"
+    tids = {r.get("trace_id") for recs in trail.values() for r in recs}
+    assert len(tids) == 1 and None not in tids, tids
+    assert trail["rollback"][0]["reason"] == "parity"
+    assert trail["deploy_done"][-1]["result"] == "rolled_back"
+
+    # (6) the doctor renders the whole story
+    rep = serving_report(journal_file)
+    assert rep["ok"]
+    dp = rep["deploy"]
+    assert dp["deploys"] == 1 and dp["rollbacks"] == 1
+    assert dp["mirror_mismatches"] >= 1
+    assert dp["last"]["result"] == "rolled_back"
+    assert dp["last"]["reason"] == "parity"
+    kinds = [row["kind"] for row in dp["trail"]]
+    assert kinds[0] == "deploy_start" and kinds[-1] == "deploy_done"
+    assert "gate_eval" in kinds and "rollback" in kinds
+    from mxnet_tpu.diagnostics.__main__ import _summ_serving
+    line = _summ_serving(rep)
+    assert "deploy" in line and "rolled_back" in line
+    assert "parity" in line or "rollback" in line
+
+
+# -- journal reduction (synthetic) -------------------------------------------
+
+def test_serving_report_deploy_section_synthetic(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    rows = [
+        {"kind": "pool_start", "root": "/p", "replicas": ["r0", "r1"]},
+        {"kind": "deploy_start", "trace_id": "t9", "from_step": 1,
+         "to_step": 2, "canary": ["r0"], "control": ["r1"]},
+        {"kind": "pool_pin", "trace_id": "t9", "replica": "r1", "step": 1,
+         "live": True},
+        {"kind": "canary_up", "trace_id": "t9", "replicas": ["r0"],
+         "step": 2},
+        {"kind": "gate_eval", "trace_id": "t9", "n": 1,
+         "verdict": "insufficient", "reasons": []},
+        {"kind": "gate_eval", "trace_id": "t9", "n": 2,
+         "verdict": "breach", "reasons": ["parity"]},
+        {"kind": "deploy_mirror_mismatch", "trace_id": "t9",
+         "replica": "r0", "step": 2, "max_abs_delta": 27.0},
+        {"kind": "rollback", "trace_id": "t9", "reason": "parity",
+         "from_step": 2, "to_step": 1, "replicas": ["r0"]},
+        {"kind": "deploy_done", "trace_id": "t9", "result": "rolled_back",
+         "reason": "parity", "from_step": 1, "to_step": 2,
+         "canary": ["r0"], "gate_evals": 2, "rollback_ms": 120.0,
+         "converged": True},
+    ]
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps({"ts": 1.0, **row}) + "\n")
+    rep = serving_report(path)
+    dp = rep["deploy"]
+    assert dp["deploys"] == 1
+    assert dp["gate_evals"] == 2 and dp["gate_breaches"] == 1
+    assert dp["mirror_mismatches"] == 1
+    assert dp["rollbacks"] == 1 and dp["promotions"] == 0
+    assert dp["pins"] == 1
+    kinds = [r["kind"] for r in dp["trail"]]
+    assert kinds == ["deploy_start", "canary_up", "gate_eval",
+                     "gate_eval", "deploy_mirror_mismatch", "rollback",
+                     "deploy_done"]
+    assert all(r["trace_id"] == "t9" for r in dp["trail"])
+    last = dp["last"]
+    assert last["result"] == "rolled_back" and last["reason"] == "parity"
+    assert last["rollback_ms"] == 120.0
+    from mxnet_tpu.diagnostics.__main__ import _summ_serving
+    line = _summ_serving(rep)
+    assert "rolled_back" in line and "parity" in line
+    assert "1 rollbacks" in line
