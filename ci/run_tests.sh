@@ -161,6 +161,16 @@ python -m pytest tests/test_guardrails.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: elastic chaos smoke (rank loss -> resharded resume) =="
 python -m pytest tests/test_elastic.py -q -k smoke -p no:cacheprovider
 
+# autotune smoke: the closed-loop autotuner's table discipline on CPU —
+# a committed tuned table survives the corruption/truncation/envelope
+# fuzz matrix (defaults + exact journaled tuned_fallback reason, zero
+# crashes), runtime consumers (pallas.dispatch, Server) demonstrably
+# load tuned knobs with a journaled tuned_load, and a tuned block is
+# bit-identical to the default tiling; the full ≤8-trial search CLI
+# loop is `slow` (docs/autotune.md)
+echo "== tier 0.5: autotune smoke (tuned-table fuzz + consumer load) =="
+python -m pytest tests/test_autotune.py -q -k smoke -p no:cacheprovider
+
 # pallas interpret smoke: every registered custom kernel passes its CPU
 # interpret-mode parity gate vs its XLA reference (forward AND custom_vjp
 # gradients), the non-TPU fallback journals its reason, and dropout keys

@@ -1,0 +1,27 @@
+"""Closed-loop autotuner: search the runtime's knob space against the
+real harnesses and commit versioned tuned tables the runtime loads
+(docs/autotune.md).
+
+- :mod:`.space`  — typed, validity-gated search spaces over real knobs
+- :mod:`.runner` — deadlined-subprocess trial evaluation + journaling
+- :mod:`.search` — seeded random / successive-halving / coordinate
+  descent, budget-bounded
+- :mod:`.table`  — versioned CRC'd tuned tables (commit, load, audit)
+
+CLI: ``python -m mxnet_tpu.autotune search|show|apply``.  All four
+modules are stdlib-importable (no jax at import time) so ``doctor
+--tuned`` can audit a table on a wedged host.
+"""
+from . import search, space, table
+from .search import Budget, run_search
+from .space import (Space, bucket_space, decode_space,
+                    pallas_block_space, router_space, serving_space)
+from .table import (ENV_TABLE, TABLE_FORMAT, audit_table, build_table,
+                    commit_table, read_table, tuned_for)
+
+__all__ = [
+    "Budget", "ENV_TABLE", "Space", "TABLE_FORMAT", "audit_table",
+    "bucket_space", "build_table", "commit_table", "decode_space",
+    "pallas_block_space", "read_table", "router_space", "run_search",
+    "search", "serving_space", "space", "table", "tuned_for",
+]

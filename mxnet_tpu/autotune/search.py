@@ -1,0 +1,198 @@
+"""Search strategies over knob spaces (stdlib-only; docs/autotune.md).
+
+Three composable strategies, all budget-bounded (trial count AND
+wall-clock, monotonic — G11) and seeded (reproducible searches):
+
+- :func:`random_search` — seeded uniform sampling over the valid
+  domain, always including the built-in default configuration (the
+  A/B baseline: the committed winner can never measure worse than the
+  default on the same harness, because the default is in the pool);
+- :func:`successive_halving` — evaluate a wide rung cheaply (a
+  fraction of the full trial resource), keep the top half, re-evaluate
+  the survivors with more resource; noise-robust on short benches;
+- :func:`coordinate_descent` — single-axis refinement around the
+  incumbent using :meth:`Space.neighbors` (only valid moves exist).
+
+``evaluate(config, resource=1.0)`` is the trial runner's closure; it
+returns an object with ``.fitness`` (higher is better; None = the
+configuration failed its gate and never competes).
+"""
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["Budget", "random_search", "successive_halving",
+           "coordinate_descent", "run_search"]
+
+_NEG_INF = float("-inf")
+
+
+def _fit(result) -> float:
+    f = getattr(result, "fitness", None)
+    return _NEG_INF if f is None else float(f)
+
+
+@dataclass
+class Budget:
+    """Hard bounds on a search: trial count and wall-clock seconds.
+    ``start()`` arms the monotonic deadline; strategies call
+    :meth:`allow` before every trial."""
+
+    max_trials: int = 16
+    wall_s: float = 120.0
+    spent: int = 0
+    _deadline: Optional[float] = field(default=None, repr=False)
+
+    def start(self) -> "Budget":
+        if self._deadline is None:
+            self._deadline = time.monotonic() + float(self.wall_s)
+        return self
+
+    def exhausted(self) -> Optional[str]:
+        if self.spent >= self.max_trials:
+            return f"trials:{self.spent}/{self.max_trials}"
+        if self._deadline is not None \
+                and time.monotonic() >= self._deadline:
+            return f"wall_clock:{self.wall_s:g}s"
+        return None
+
+    def allow(self) -> bool:
+        if self.exhausted() is not None:
+            return False
+        self.spent += 1
+        return True
+
+
+def _key(config: dict):
+    return tuple(sorted((k, tuple(v) if isinstance(v, (list, tuple))
+                         else v) for k, v in config.items()))
+
+
+def _dedup(seen: set, config: dict) -> bool:
+    k = _key(config)
+    if k in seen:
+        return False
+    seen.add(k)
+    return True
+
+
+def random_search(space, evaluate, budget: Budget, rng: random.Random,
+                  include_default: bool = True,
+                  resource: float = 1.0) -> List:
+    """Seeded random sampling (deduplicated).  The built-in default is
+    trial #1 so every search's history contains the A/B baseline."""
+    budget.start()
+    results, seen = [], set()
+    if include_default and space.reason(dict(space.default)) is None:
+        if _dedup(seen, space.default) and budget.allow():
+            results.append(evaluate(dict(space.default),
+                                    resource=resource))
+    for _ in range(64 * budget.max_trials):
+        if budget.exhausted() is not None:
+            break
+        cfg = space.sample(rng)
+        if not _dedup(seen, cfg):
+            continue
+        if not budget.allow():
+            break
+        results.append(evaluate(cfg, resource=resource))
+    return results
+
+
+def successive_halving(space, evaluate, budget: Budget,
+                       rng: random.Random, n0: int = 8,
+                       keep: float = 0.5, resource0: float = 0.25,
+                       grow: float = 2.0) -> List:
+    """Rung 0 evaluates up to ``n0`` sampled configs (default included)
+    at ``resource0`` of the full trial resource; each rung keeps the
+    top ``keep`` fraction and multiplies the resource by ``grow`` until
+    one survivor remains or the budget runs dry."""
+    budget.start()
+    results, seen = [], set()
+    pool = []
+    if space.reason(dict(space.default)) is None:
+        pool.append(dict(space.default))
+        _dedup(seen, space.default)
+    for _ in range(64 * n0):
+        if len(pool) >= n0:
+            break
+        cfg = space.sample(rng)
+        if _dedup(seen, cfg):
+            pool.append(cfg)
+    resource = resource0
+    while pool and budget.exhausted() is None:
+        rung = []
+        for cfg in pool:
+            if not budget.allow():
+                break
+            res = evaluate(dict(cfg), resource=min(resource, 1.0))
+            results.append(res)
+            rung.append((res, cfg))
+        rung.sort(key=lambda rc: _fit(rc[0]), reverse=True)
+        survivors = [cfg for res, cfg in rung if _fit(res) > _NEG_INF]
+        if len(survivors) <= 1:
+            break
+        pool = survivors[:max(1, int(len(survivors) * keep))]
+        if len(pool) == len(survivors):   # keep=1.0 would never shrink
+            pool = pool[:-1] or pool[:1]
+        if resource >= 1.0 and len(pool) <= 1:
+            break
+        resource = min(1.0, resource * grow)
+    return results
+
+
+def coordinate_descent(space, evaluate, budget: Budget, start: dict,
+                       rounds: int = 2, resource: float = 1.0,
+                       start_fitness: Optional[float] = None) -> List:
+    """Greedy single-axis refinement from ``start``: sweep each axis's
+    valid neighbors, adopt any strict improvement, stop after a full
+    round without one (or at the budget)."""
+    budget.start()
+    results = []
+    best_cfg = dict(start)
+    best_fit = _NEG_INF if start_fitness is None else float(start_fitness)
+    if start_fitness is None:
+        if not budget.allow():
+            return results
+        res = evaluate(dict(best_cfg), resource=resource)
+        results.append(res)
+        best_fit = _fit(res)
+    for _ in range(max(1, rounds)):
+        improved = False
+        for name in sorted(space.params):
+            for cand in space.neighbors(best_cfg, name):
+                if not budget.allow():
+                    return results
+                res = evaluate(cand, resource=resource)
+                results.append(res)
+                if _fit(res) > best_fit:
+                    best_fit, best_cfg = _fit(res), dict(cand)
+                    improved = True
+        if not improved:
+            break
+    return results
+
+
+def run_search(space, evaluate, budget: Budget, seed: int = 0,
+               halving_n0: int = 0, descent_rounds: int = 1) -> List:
+    """The composed pipeline one knob family runs: random sampling
+    (default first) — or successive halving when ``halving_n0`` > 0 —
+    then coordinate descent from the incumbent.  Returns the full
+    trial history; the caller picks ``max(history, key=fitness)``."""
+    rng = random.Random(int(seed))
+    budget.start()
+    if halving_n0 > 0:
+        history = successive_halving(space, evaluate, budget, rng,
+                                     n0=halving_n0)
+    else:
+        history = random_search(space, evaluate, budget, rng)
+    scored = [r for r in history if _fit(r) > _NEG_INF]
+    if scored and descent_rounds > 0 and budget.exhausted() is None:
+        best = max(scored, key=_fit)
+        history += coordinate_descent(
+            space, evaluate, budget, dict(best.config),
+            rounds=descent_rounds, start_fitness=_fit(best))
+    return history

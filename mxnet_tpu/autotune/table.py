@@ -1,0 +1,351 @@
+"""Tuned tables: versioned, CRC-guarded knob documents the runtime loads.
+
+The autotuner's output is data, not code edits: one JSON document
+holding machine-chosen values for the real knobs — per-op×shape-class
+Pallas block shapes, serving ``window_ms``/queue bound, router hedge
+delay, decode slot count, bucket lattices — committed atomically via
+``resilience.atomic`` and loaded at runtime by ``pallas.dispatch()``,
+``Server``/``BucketGrid``, and ``Router`` (``MXNET_TPU_TUNED_TABLE``).
+
+Discipline mirrors the AOT cache (serving/aot_report.py, graftlint
+G21): the document carries a format tag, a CRC over its canonical
+serialization, and a compatibility envelope (platform, device kind,
+jax version) — a table tuned on one toolchain/topology never applies
+on another.  The read path validates bounds, JSON, format, CRC,
+schema, and envelope **before** any knob value is believed; every
+failure degrades to the built-in defaults with ONE journaled
+``tuned_fallback{reason}`` per (path, reason) — never a crash, never
+silently wrong.  Successful consumers journal ``tuned_load`` with the
+values they applied, so a run's effective configuration is always in
+the journal.
+
+Stdlib-only except :func:`current_envelope` (one lazy guarded backend
+dial); :func:`audit_table` never dials — ``doctor --tuned`` works while
+jax itself is wedged.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+
+from ..diagnostics.journal import get_journal
+from ..resilience import atomic as _atomic
+
+__all__ = ["TABLE_FORMAT", "ENV_TABLE", "KNOB_FAMILIES", "build_table",
+           "commit_table", "read_table", "validate_schema", "table_crc",
+           "current_envelope", "tuned_for", "knob", "pallas_entry",
+           "audit_table", "reset_cache"]
+
+TABLE_FORMAT = "mxtpu-tuned-v1"
+ENV_TABLE = "MXNET_TPU_TUNED_TABLE"
+# a tuned table is a small document; a multi-megabyte file at this path
+# is some other artifact (or garbage) — reject before json.loads sees it
+MAX_TABLE_BYTES = 1 << 20
+KNOB_FAMILIES = ("pallas", "serving", "router", "decode", "buckets")
+_SCALARS = {"serving": ("window_ms", "max_queue"),
+            "router": ("hedge_ms",),
+            "decode": ("slots",)}
+# re-stat throttle for the cached runtime loader: dispatch() consults
+# the table per dispatch decision, which must not cost a stat() each —
+# a freshly applied table is picked up within this window
+_RECHECK_S = 1.0
+
+_lock = threading.Lock()
+_cache: dict = {}          # path -> {stat, doc, reason, checked}
+_journaled: set = set()    # (path, reason) tuned_fallback dedupe
+_envelope = None
+
+
+# ---------------------------------------------------------------------------
+# document construction
+# ---------------------------------------------------------------------------
+def canonical_bytes(doc: dict) -> bytes:
+    """Canonical serialization (sorted keys, no whitespace) of ``doc``
+    WITHOUT its ``crc32`` field — the bytes the CRC covers."""
+    body = {k: v for k, v in doc.items() if k != "crc32"}
+    return json.dumps(body, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def table_crc(doc: dict) -> int:
+    return zlib.crc32(canonical_bytes(doc)) & 0xFFFFFFFF
+
+
+def current_envelope() -> dict:
+    """Compatibility envelope of THIS process (one guarded backend
+    dial, memoized): the platform/device-kind/jax-version triple a
+    table must match to apply."""
+    global _envelope
+    if _envelope is None:
+        import jax
+
+        from ..diagnostics import guard
+        dev = guard.devices(local=True)
+        _envelope = {"platform": dev[0].platform,
+                     "device_kind": dev[0].device_kind,
+                     "jax": jax.__version__}
+    return _envelope
+
+
+def build_table(knobs: dict, provenance: dict | None = None,
+                envelope: dict | None = None,
+                created: float | None = None) -> dict:
+    """Assemble a tuned-table document (validated; raises ValueError on
+    a malformed knob set — the WRITER must not produce a table the
+    reader would reject)."""
+    doc = {"format": TABLE_FORMAT,
+           "created": time.time() if created is None else float(created),
+           "envelope": dict(envelope if envelope is not None
+                            else current_envelope()),
+           "provenance": dict(provenance or {}),
+           "knobs": knobs}
+    reason = validate_schema(doc)
+    if reason is not None:
+        raise ValueError(f"refusing to build invalid tuned table: {reason}")
+    doc["crc32"] = table_crc(doc)
+    return doc
+
+
+def commit_table(doc: dict, path: str) -> str:
+    """Atomically commit ``doc`` to ``path`` (tmp + fsync + replace —
+    a racing reader observes complete old or complete new bytes, never
+    a torn table).  Journals ``tuned_commit``."""
+    reason = validate_schema(doc)
+    if reason is not None:
+        raise ValueError(f"refusing to commit invalid tuned table: {reason}")
+    if doc.get("crc32") != table_crc(doc):
+        raise ValueError("refusing to commit tuned table with stale crc32")
+    path = os.fspath(path)
+    with _atomic.atomic_write(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    get_journal().event("tuned_commit", path=path,
+                        families=sorted(doc["knobs"]),
+                        crc32=doc["crc32"])
+    return path
+
+
+# ---------------------------------------------------------------------------
+# validation (pure; shared by writer, loader, and the doctor audit)
+# ---------------------------------------------------------------------------
+def _pos_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool) and v > 0
+
+
+def _num(v) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and v >= 0)
+
+
+def validate_schema(doc) -> str | None:
+    """Structural validity of a parsed table document; returns a
+    ``schema:<detail>`` reason or None.  Does NOT check CRC/envelope —
+    the read path layers those."""
+    if not isinstance(doc, dict):
+        return "schema:not_object"
+    if not isinstance(doc.get("envelope"), dict):
+        return "schema:envelope"
+    knobs = doc.get("knobs")
+    if not isinstance(knobs, dict) or not knobs:
+        return "schema:knobs"
+    for family, body in knobs.items():
+        if family not in KNOB_FAMILIES:
+            return f"schema:family:{family}"
+        if family in _SCALARS:
+            if not isinstance(body, dict):
+                return f"schema:{family}"
+            for name, v in body.items():
+                if name not in _SCALARS[family] or not _num(v):
+                    return f"schema:{family}.{name}"
+        elif family == "pallas":
+            if not isinstance(body, dict):
+                return "schema:pallas"
+            for kernel, classes in body.items():
+                if not isinstance(classes, dict):
+                    return f"schema:pallas.{kernel}"
+                for cls, entry in classes.items():
+                    block = (entry or {}).get("block") \
+                        if isinstance(entry, dict) else None
+                    if (not isinstance(block, list) or len(block) != 2
+                            or not all(_pos_int(b) for b in block)):
+                        return f"schema:pallas.{kernel}.{cls}"
+        elif family == "buckets":
+            if not isinstance(body, dict):
+                return "schema:buckets"
+            batch = body.get("batch")
+            if batch is not None:
+                if (not isinstance(batch, list) or not batch
+                        or not all(_pos_int(b) for b in batch)
+                        or sorted(batch) != batch):
+                    return "schema:buckets.batch"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# read path
+# ---------------------------------------------------------------------------
+def read_table(path: str, envelope: dict | None = None):
+    """Read + fully validate one table file: returns ``(doc, None)`` or
+    ``(None, reason)`` with reason in {missing, unreadable, too_large,
+    json, format, crc, schema:*, envelope, stale}.  With ``envelope``,
+    platform/device-kind mismatch is ``envelope`` and a jax-version
+    drift is ``stale`` — performance data from another toolchain never
+    applies silently.  Never raises for a bad file."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return None, "missing"
+    if size > MAX_TABLE_BYTES:
+        return None, "too_large"
+    try:
+        with open(path, "rb") as f:
+            raw = f.read(MAX_TABLE_BYTES + 1)
+    except OSError:
+        return None, "unreadable"
+    if len(raw) > MAX_TABLE_BYTES:
+        return None, "too_large"
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None, "json"
+    if not isinstance(doc, dict) or doc.get("format") != TABLE_FORMAT:
+        return None, "format"
+    if doc.get("crc32") != table_crc(doc):
+        return None, "crc"
+    reason = validate_schema(doc)
+    if reason is not None:
+        return None, reason
+    if envelope is not None:
+        have = doc["envelope"]
+        for key in ("platform", "device_kind"):
+            if have.get(key) != envelope.get(key):
+                return None, "envelope"
+        if have.get("jax") != envelope.get("jax"):
+            return None, "stale"
+    return doc, None
+
+
+def _journal_fallback(path: str, reason: str, site: str) -> None:
+    key = (path, reason)
+    with _lock:
+        if key in _journaled:
+            return
+        _journaled.add(key)
+    get_journal().event("tuned_fallback", path=path, reason=reason,
+                        site=site, fallback="builtin_defaults")
+
+
+def tuned_for(site: str = "runtime"):
+    """The active tuned table (``MXNET_TPU_TUNED_TABLE``) or None.
+
+    Cached per path with a ``stat()`` no more than once per second —
+    cheap enough for ``dispatch()``'s per-decision consult, fresh
+    enough that an ``apply`` lands within a second.  Invalid/stale/
+    mismatched tables return None with a deduped journaled
+    ``tuned_fallback{reason}``; the caller keeps built-in defaults."""
+    path = os.environ.get(ENV_TABLE, "").strip()
+    if not path:
+        return None
+    now = time.monotonic()
+    with _lock:
+        ent = _cache.get(path)
+        if ent is not None and now - ent["checked"] < _RECHECK_S:
+            return ent["doc"]
+    # all file I/O (stat, read, the backend dial for the envelope) runs
+    # OUTSIDE the lock (graftlint G15); worst case two racing threads
+    # both read the file once
+    try:
+        st = os.stat(path)
+        stat_key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        stat_key = None
+    with _lock:
+        ent = _cache.get(path)
+        if ent is not None and ent["stat"] == stat_key:
+            ent["checked"] = now
+            return ent["doc"]
+    if stat_key is None:
+        doc, reason = None, "missing"
+    else:
+        doc, reason = read_table(path, envelope=current_envelope())
+    with _lock:
+        _cache[path] = {"stat": stat_key, "doc": doc, "reason": reason,
+                        "checked": now}
+    if reason is not None:
+        _journal_fallback(path, reason, site)
+    return doc
+
+
+def knob(doc, family: str, name: str, default=None):
+    """One scalar knob from a loaded table (None-safe)."""
+    if doc is None:
+        return default
+    body = doc.get("knobs", {}).get(family)
+    if not isinstance(body, dict):
+        return default
+    return body.get(name, default)
+
+
+def pallas_entry(doc, kernel: str, shape_class: str):
+    """Per-kernel tuned entry for one shape class (exact class first,
+    then the ``*`` wildcard); None when untuned."""
+    if doc is None:
+        return None
+    classes = doc.get("knobs", {}).get("pallas", {}).get(kernel)
+    if not isinstance(classes, dict):
+        return None
+    return classes.get(shape_class) or classes.get("*")
+
+
+def reset_cache() -> None:
+    """Drop the loader cache + journal dedupe (tests; also lets one
+    process observe a re-commit immediately)."""
+    global _envelope
+    with _lock:
+        _cache.clear()
+        _journaled.clear()
+        _envelope = None
+
+
+# ---------------------------------------------------------------------------
+# doctor audit (stdlib-only: no jax, no envelope dial)
+# ---------------------------------------------------------------------------
+def _flatten_knobs(knobs: dict) -> dict:
+    flat = {}
+    for family, body in sorted(knobs.items()):
+        if family == "pallas":
+            for kernel, classes in sorted(body.items()):
+                for cls, entry in sorted(classes.items()):
+                    block = entry.get("block")
+                    flat[f"pallas.{kernel}.{cls}"] = \
+                        f"block={block[0]}x{block[1]}"
+        elif family == "buckets":
+            for name, v in sorted(body.items()):
+                flat[f"buckets.{name}"] = v
+        else:
+            for name, v in sorted(body.items()):
+                flat[f"{family}.{name}"] = v
+    return flat
+
+
+def audit_table(path: str) -> dict:
+    """``doctor --tuned`` body: validate format/CRC/schema and report
+    the table's own envelope, provenance refs, and per-knob values —
+    WITHOUT comparing the envelope (no backend dial; the audit must run
+    while jax is wedged) and without applying anything."""
+    path = os.fspath(path)
+    doc, reason = read_table(path)      # no envelope: stdlib-only
+    if doc is None:
+        return {"ok": False, "path": path, "error": reason}
+    prov = doc.get("provenance", {})
+    return {"ok": True, "path": path, "format": doc["format"],
+            "created": doc.get("created"), "crc32": doc.get("crc32"),
+            "envelope": doc["envelope"],
+            "envelope_checked": False,
+            "trials": prov.get("trials"),
+            "journal": prov.get("journal"),
+            "artifact": prov.get("artifact"),
+            "search": prov.get("search"),
+            "knobs": _flatten_knobs(doc["knobs"])}

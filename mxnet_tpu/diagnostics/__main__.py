@@ -272,6 +272,22 @@ def _summ_lint(lt) -> str:
               f"{'n/a' if hr is None else hr}")
 
 
+def _tuned_report(path) -> dict:
+    from ..autotune import table
+    return table.audit_table(path)
+
+
+def _summ_tuned(tt) -> str:
+    knobs = tt.get("knobs") or {}
+    env = tt.get("envelope") or {}
+    shown = ", ".join(f"{k}={v}" for k, v in sorted(knobs.items())[:4])
+    more = len(knobs) - 4
+    return (f"tuned: {tt['format']} crc={tt['crc32']} "
+            f"[{env.get('platform')}/{env.get('device_kind')}/"
+            f"jax {env.get('jax')}], {tt.get('trials')} trials; "
+            f"{shown}" + (f" (+{more} more)" if more > 0 else ""))
+
+
 # One row per report surface: adding a reporter means adding one row
 # here, not editing three code paths (argument registration, report
 # assembly, and the stderr summary all iterate this table).
@@ -317,6 +333,12 @@ _REPORT_TABLE = (
      "interprocedural G15-G19) and summarize per-rule finding counts "
      "and the summary-cache hit rate (docs/static_analysis.md)",
      _lint_report, _summ_lint),
+    ("tuned", "--tuned", "MXNET_TPU_TUNED_TABLE", "PATH",
+     "autotuner tuned-table file: validate format/CRC/schema and report "
+     "its envelope, trial provenance refs, and per-knob values — "
+     "stdlib-only, nothing is applied and no backend is dialed "
+     "(docs/autotune.md)",
+     _tuned_report, _summ_tuned),
 )
 
 

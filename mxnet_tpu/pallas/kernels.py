@@ -47,6 +47,23 @@ def _block(n, cap):
     return 1
 
 
+def _block_pair(r, c, block, row_cap=512, col_cap=256):
+    """Resolve the (block_r, block_c) tiling for an (r, c) view. An
+    explicit/tuned ``block`` wins when it tiles the view exactly;
+    anything else clamps to the default — the epilogues are elementwise
+    over the tile grid, so every exact tiling is bit-identical, and a
+    non-divisor block (stale tuned table, wrong shape class) must degrade
+    to the default rather than leave remainder rows unwritten."""
+    if block is not None:
+        try:
+            br, bc = int(block[0]), int(block[1])
+        except (TypeError, ValueError, IndexError):
+            br = bc = 0
+        if 0 < br <= r and 0 < bc <= c and r % br == 0 and c % bc == 0:
+            return br, bc
+    return _block(r, row_cap), _block(c, col_cap)
+
+
 def _act_fn(act_type):
     fns = {
         None: lambda x: x,
@@ -82,12 +99,23 @@ def _check_vec(name, v, y):
     return None
 
 
+def _epilogue_tune_key(y, *rest, **params):
+    """Shape class of an epilogue dispatch ("RxC") — the tuned-table key
+    under which a committed block shape applies to this call."""
+    if getattr(y, "ndim", 0) != 2:
+        return None
+    return f"{y.shape[0]}x{y.shape[1]}"
+
+
 # ---------------------------------------------------------------------------
 # conv epilogue: act(scale * y + bias [+ res]) in one VMEM pass
 # ---------------------------------------------------------------------------
-def _conv_epilogue_ref(y, scale, bias, res=None, act_type="relu"):
+def _conv_epilogue_ref(y, scale, bias, res=None, act_type="relu",
+                       block=None):
     """The XLA reference (the semantic contract): fp32 accumulation, cast
-    back to y's dtype — matching the kernel's internal math."""
+    back to y's dtype — matching the kernel's internal math. ``block`` is
+    the Pallas tier's tiling knob; tiling doesn't change semantics, so
+    the reference accepts and ignores it (fallback keeps one signature)."""
     out = (y.astype(jnp.float32) * scale.astype(jnp.float32)
            + bias.astype(jnp.float32))
     if res is not None:
@@ -95,11 +123,10 @@ def _conv_epilogue_ref(y, scale, bias, res=None, act_type="relu"):
     return _act_fn(act_type)(out).astype(y.dtype)
 
 
-def _conv_epilogue_call(y, scale, bias, res, act_type, interpret):
+def _conv_epilogue_call(y, scale, bias, res, act_type, interpret, block):
     from jax.experimental import pallas as pl
     r, c = y.shape
-    br = _block(r, 512)
-    bc = _block(c, 256)
+    br, bc = _block_pair(r, c, block)
     act = _act_fn(act_type)
     data = pl.BlockSpec((br, bc), lambda i, j: (i, j))
 
@@ -124,17 +151,18 @@ def _conv_epilogue_call(y, scale, bias, res, act_type, interpret):
         interpret=interpret)(*args)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _ce_res(act_type, interpret, y, scale, bias, res):
-    return _conv_epilogue_call(y, scale, bias, res, act_type, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ce_res(act_type, interpret, block, y, scale, bias, res):
+    return _conv_epilogue_call(y, scale, bias, res, act_type, interpret,
+                               block)
 
 
-def _ce_res_fwd(act_type, interpret, y, scale, bias, res):
-    return (_ce_res(act_type, interpret, y, scale, bias, res),
+def _ce_res_fwd(act_type, interpret, block, y, scale, bias, res):
+    return (_ce_res(act_type, interpret, block, y, scale, bias, res),
             (y, scale, bias, res))
 
 
-def _ce_res_bwd(act_type, interpret, saved, g):
+def _ce_res_bwd(act_type, interpret, block, saved, g):
     y, scale, bias, res = saved
     _, vjp = jax.vjp(
         lambda a, s, b, r: _conv_epilogue_ref(a, s, b, r,
@@ -146,16 +174,18 @@ def _ce_res_bwd(act_type, interpret, saved, g):
 _ce_res.defvjp(_ce_res_fwd, _ce_res_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _ce_nores(act_type, interpret, y, scale, bias):
-    return _conv_epilogue_call(y, scale, bias, None, act_type, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ce_nores(act_type, interpret, block, y, scale, bias):
+    return _conv_epilogue_call(y, scale, bias, None, act_type, interpret,
+                               block)
 
 
-def _ce_nores_fwd(act_type, interpret, y, scale, bias):
-    return _ce_nores(act_type, interpret, y, scale, bias), (y, scale, bias)
+def _ce_nores_fwd(act_type, interpret, block, y, scale, bias):
+    return (_ce_nores(act_type, interpret, block, y, scale, bias),
+            (y, scale, bias))
 
 
-def _ce_nores_bwd(act_type, interpret, saved, g):
+def _ce_nores_bwd(act_type, interpret, block, saved, g):
     y, scale, bias = saved
     _, vjp = jax.vjp(
         lambda a, s, b: _conv_epilogue_ref(a, s, b, act_type=act_type),
@@ -166,7 +196,8 @@ def _ce_nores_bwd(act_type, interpret, saved, g):
 _ce_nores.defvjp(_ce_nores_fwd, _ce_nores_bwd)
 
 
-def _conv_epilogue_supports(y, scale, bias, res=None, act_type="relu"):
+def _conv_epilogue_supports(y, scale, bias, res=None, act_type="relu",
+                            block=None):
     if y.ndim != 2:
         return f"not_2d:{y.shape}"
     if y.size == 0:
@@ -210,12 +241,16 @@ def _conv_epilogue_example():
     doc="act(scale*y + bias [+ res]) over 2D rows in one VMEM pass — the "
         "RN50 conv-fusion bandwidth lever (docs/perf_notes.md; promoted "
         "from benchmarks/conv_epilogue_probe.py). scale/bias broadcast "
-        "as (1, C) columns or (R, 1) rows.")
+        "as (1, C) columns or (R, 1) rows. block=(br, bc) overrides the "
+        "default tiling (tuned tables; any exact tiling is bit-identical, "
+        "invalid blocks clamp to the default).",
+    tune_key=_epilogue_tune_key)
 def _conv_epilogue_pallas(y, scale, bias, res=None, interpret=False,
-                          act_type="relu"):
+                          act_type="relu", block=None):
+    block = None if block is None else (int(block[0]), int(block[1]))
     if res is None:
-        return _ce_nores(act_type, bool(interpret), y, scale, bias)
-    return _ce_res(act_type, bool(interpret), y, scale, bias, res)
+        return _ce_nores(act_type, bool(interpret), block, y, scale, bias)
+    return _ce_res(act_type, bool(interpret), block, y, scale, bias, res)
 
 
 # ---------------------------------------------------------------------------
@@ -237,7 +272,8 @@ def dropout_bits(key, shape, layer=0, tick=0, shard=0):
     return jax.random.bits(key, tuple(shape), dtype=jnp.uint8)
 
 
-def _matmul_epilogue_ref(y, bias, bits=None, act_type="gelu", p=0.0):
+def _matmul_epilogue_ref(y, bias, bits=None, act_type="gelu", p=0.0,
+                         block=None):
     out = _act_fn(act_type)(y.astype(jnp.float32)
                             + bias.astype(jnp.float32))
     if bits is not None and p > 0:
@@ -246,11 +282,10 @@ def _matmul_epilogue_ref(y, bias, bits=None, act_type="gelu", p=0.0):
     return out.astype(y.dtype)
 
 
-def _matmul_epilogue_call(y, bias, bits, act_type, p, interpret):
+def _matmul_epilogue_call(y, bias, bits, act_type, p, interpret, block):
     from jax.experimental import pallas as pl
     r, c = y.shape
-    br = _block(r, 512)
-    bc = _block(c, 256)
+    br, bc = _block_pair(r, c, block)
     act = _act_fn(act_type)
     data = pl.BlockSpec((br, bc), lambda i, j: (i, j))
     thresh = keep_threshold(p)
@@ -276,16 +311,18 @@ def _matmul_epilogue_call(y, bias, bits, act_type, p, interpret):
         interpret=interpret)(*args)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def _me_drop(act_type, p, interpret, y, bias, bits):
-    return _matmul_epilogue_call(y, bias, bits, act_type, p, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _me_drop(act_type, p, interpret, block, y, bias, bits):
+    return _matmul_epilogue_call(y, bias, bits, act_type, p, interpret,
+                                 block)
 
 
-def _me_drop_fwd(act_type, p, interpret, y, bias, bits):
-    return _me_drop(act_type, p, interpret, y, bias, bits), (y, bias, bits)
+def _me_drop_fwd(act_type, p, interpret, block, y, bias, bits):
+    return (_me_drop(act_type, p, interpret, block, y, bias, bits),
+            (y, bias, bits))
 
 
-def _me_drop_bwd(act_type, p, interpret, saved, g):
+def _me_drop_bwd(act_type, p, interpret, block, saved, g):
     y, bias, bits = saved
     _, vjp = jax.vjp(
         lambda a, b: _matmul_epilogue_ref(a, b, bits, act_type=act_type,
@@ -298,16 +335,17 @@ def _me_drop_bwd(act_type, p, interpret, saved, g):
 _me_drop.defvjp(_me_drop_fwd, _me_drop_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _me_nodrop(act_type, interpret, y, bias):
-    return _matmul_epilogue_call(y, bias, None, act_type, 0.0, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _me_nodrop(act_type, interpret, block, y, bias):
+    return _matmul_epilogue_call(y, bias, None, act_type, 0.0, interpret,
+                                 block)
 
 
-def _me_nodrop_fwd(act_type, interpret, y, bias):
-    return _me_nodrop(act_type, interpret, y, bias), (y, bias)
+def _me_nodrop_fwd(act_type, interpret, block, y, bias):
+    return _me_nodrop(act_type, interpret, block, y, bias), (y, bias)
 
 
-def _me_nodrop_bwd(act_type, interpret, saved, g):
+def _me_nodrop_bwd(act_type, interpret, block, saved, g):
     y, bias = saved
     _, vjp = jax.vjp(
         lambda a, b: _matmul_epilogue_ref(a, b, act_type=act_type), y, bias)
@@ -317,7 +355,8 @@ def _me_nodrop_bwd(act_type, interpret, saved, g):
 _me_nodrop.defvjp(_me_nodrop_fwd, _me_nodrop_bwd)
 
 
-def _matmul_epilogue_supports(y, bias, bits=None, act_type="gelu", p=0.0):
+def _matmul_epilogue_supports(y, bias, bits=None, act_type="gelu", p=0.0,
+                              block=None):
     if y.ndim != 2:
         return f"not_2d:{y.shape}"
     if y.size == 0:
@@ -362,12 +401,17 @@ def _matmul_epilogue_example():
         "BERT MFU lever (docs/perf_notes.md: dropout-in-epilogue, "
         "docs/roadmap.md items 3-4). Mask semantics bit-identical to "
         "ops/nn.py Dropout; bits come from dropout_bits() under the "
-        "PR-1 (layer, tick, shard) fold discipline.")
+        "PR-1 (layer, tick, shard) fold discipline. block=(br, bc) "
+        "overrides the default tiling (tuned tables; invalid blocks "
+        "clamp to the default).",
+    tune_key=_epilogue_tune_key)
 def _matmul_epilogue_pallas(y, bias, bits=None, interpret=False,
-                            act_type="gelu", p=0.0):
+                            act_type="gelu", p=0.0, block=None):
+    block = None if block is None else (int(block[0]), int(block[1]))
     if bits is None or p <= 0:
-        return _me_nodrop(act_type, bool(interpret), y, bias)
-    return _me_drop(act_type, float(p), bool(interpret), y, bias, bits)
+        return _me_nodrop(act_type, bool(interpret), block, y, bias)
+    return _me_drop(act_type, float(p), bool(interpret), block, y, bias,
+                    bits)
 
 
 # ---------------------------------------------------------------------------

@@ -72,13 +72,18 @@ class KernelSpec:
     example: Optional[Callable] = None    # () -> (args, params) for the gate
     doc: str = ""
     differentiable: bool = True
+    # (*args, **params) -> shape-class string ("RxC") | None: the tuned-
+    # table key under which an autotuned block shape applies to a call.
+    # None = the kernel takes no tuned knobs (autotune never touches it).
+    tune_key: Optional[Callable] = None
 
 
 def register_kernel(name: str, *, xla_reference: Callable, tolerance: float,
                     backends: Sequence[str] = ("tpu",),
                     supports: Optional[Callable] = None,
                     example: Optional[Callable] = None,
-                    doc: str = "", differentiable: bool = True):
+                    doc: str = "", differentiable: bool = True,
+                    tune_key: Optional[Callable] = None):
     """Decorator registering ``fn`` as the custom impl of kernel ``name``."""
     def deco(fn):
         with _lock:
@@ -90,7 +95,7 @@ def register_kernel(name: str, *, xla_reference: Callable, tolerance: float,
                 tolerance=float(tolerance), backends=tuple(backends),
                 supports=supports, example=example,
                 doc=doc or (fn.__doc__ or ""),
-                differentiable=differentiable)
+                differentiable=differentiable, tune_key=tune_key)
         return fn
     return deco
 
@@ -165,6 +170,55 @@ def _journal_once(kernel: str, reason: str, **fields) -> None:
                         **fields)
 
 
+# tuned-table injection: one journal line per (kernel, shape_class)
+# outcome — dispatch is per-op-call hot, the journal is not
+_tuned_logged: set = set()
+
+
+def _tuned_block(spec: "KernelSpec", args, params):
+    """The tuned block for this dispatch, or None: consult the active
+    tuned table (MXNET_TPU_TUNED_TABLE via autotune.table.tuned_for —
+    cached, validated, never raises) at the kernel's shape class.  An
+    entry that would not tile the class exactly is refused here with a
+    journaled ``tuned_fallback`` (the kernels would clamp it anyway —
+    refusing early keeps the journal truthful about what actually ran)."""
+    from ..autotune import table as _tt
+    doc = _tt.tuned_for("pallas")
+    if doc is None:
+        return None
+    cls = spec.tune_key(*args, **params)
+    if not cls:
+        return None
+    entry = _tt.pallas_entry(doc, spec.name, cls)
+    blk = entry.get("block") if isinstance(entry, dict) else None
+    if blk is None:
+        return None
+    log_key = (spec.name, cls)
+    try:
+        r, c = (int(v) for v in cls.split("x"))
+        br, bc = int(blk[0]), int(blk[1])
+        ok = 0 < br <= r and 0 < bc <= c and r % br == 0 and c % bc == 0
+    except (TypeError, ValueError):
+        ok = False
+    with _lock:
+        first = log_key not in _tuned_logged
+        if first:
+            _tuned_logged.add(log_key)
+    if not ok:
+        if first:
+            from ..diagnostics import get_journal
+            get_journal().event(
+                "tuned_fallback", reason="invalid_block", site="pallas",
+                kernel=spec.name, shape_class=cls, block=blk,
+                fallback="builtin_defaults")
+        return None
+    if first:
+        from ..diagnostics import get_journal
+        get_journal().event("tuned_load", site="pallas", kernel=spec.name,
+                            shape_class=cls, block=[br, bc])
+    return (br, bc)
+
+
 def _note(kernel: str, tier: str, reason: Optional[str] = None) -> None:
     with _lock:
         rec = _prov.setdefault(kernel, {"pallas": 0, "xla": 0,
@@ -192,6 +246,7 @@ def reset_provenance() -> None:
     with _lock:
         _prov.clear()
         _journaled.clear()
+        _tuned_logged.clear()
 
 
 def dispatch(name: str, *args, interpret: bool = False, **params):
@@ -231,6 +286,12 @@ def dispatch(name: str, *args, interpret: bool = False, **params):
     # fallback happened (docs/observability.md)
     from ..observability import trace as _trace
     if reason is None:
+        # tuned tiling rides the pallas tier only — an explicit block=
+        # always wins, the reference tier never sees injected knobs
+        if spec.tune_key is not None and "block" not in params:
+            blk = _tuned_block(spec, args, params)
+            if blk is not None:
+                params = dict(params, block=blk)
         _note(name, "pallas")
         _trace.annotate(**{f"pallas.{name}": "pallas"})
         return spec.pallas_impl(*args, interpret=interpret, **params)

@@ -68,6 +68,47 @@ def _diagnostic(error: str, detail: str) -> dict:
             "error": error, "detail": detail}
 
 
+ARRIVAL_FORMAT = "mxtpu-arrival-v1"
+
+
+def _load_arrival(path):
+    """Parse a recorded arrival trace: ``{"format": "mxtpu-arrival-v1",
+    "events": [{"dt_ms": float[, "dim": int]}, ...]}``.  Each client
+    thread replays the inter-arrival gaps (and per-event feature dims,
+    which must match the served model) in order, looping until
+    ``--seconds`` expires — the same burst structure every run, so two
+    benches under different knobs see identical offered load.  Returns
+    ``(events, None)`` or ``(None, reason)`` — a malformed trace is a
+    structured bench error, never a crash mid-run."""
+    import os
+    if not os.path.exists(path):
+        return None, f"missing:{path}"
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, f"unparseable:{type(e).__name__}"
+    if not isinstance(doc, dict) or doc.get("format") != ARRIVAL_FORMAT:
+        return None, f"format:{doc.get('format') if isinstance(doc, dict) else type(doc).__name__}"
+    events = doc.get("events")
+    if not isinstance(events, list) or not events:
+        return None, "no_events"
+    out = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            return None, f"event:{i}:not_object"
+        dt = ev.get("dt_ms")
+        if not isinstance(dt, (int, float)) or isinstance(dt, bool) \
+                or dt < 0 or dt > 60_000:
+            return None, f"event:{i}:dt_ms:{dt!r}"
+        dim = ev.get("dim")
+        if dim is not None and (not isinstance(dim, int)
+                                or isinstance(dim, bool) or dim <= 0):
+            return None, f"event:{i}:dim:{dim!r}"
+        out.append((float(dt), dim))
+    return out, None
+
+
 def _build_model(dim):
     from ..gluon import nn
     net = nn.HybridSequential()
@@ -83,6 +124,7 @@ def cmd_bench(args) -> int:
 
     from ..diagnostics import get_journal
     from ..metric import LatencySummary
+    from ..observability import snapshot
     from ..resilience.atomic import atomic_write
     from .server import Server, ServerConfig
 
@@ -95,6 +137,15 @@ def cmd_bench(args) -> int:
     if args.replicas > 1:
         return _bench_pool(args)
 
+    arrival = None
+    if args.arrival:
+        arrival, why = _load_arrival(args.arrival)
+        if arrival is None:
+            _emit(_diagnostic("bad_arrival_trace",
+                              f"{args.arrival}: {why}"))
+            return 1
+
+    recorder = _setup_trace_dir(args.trace_dir, "serving-bench")
     j = get_journal()
     j.install_handlers(final_cb=lambda: _emit(_diagnostic(
         "bench_killed", f"killed at phase {j.last_phase!r} before "
@@ -118,8 +169,23 @@ def cmd_bench(args) -> int:
         from .batcher import (DeadlineExceeded, RequestError,
                               ServerOverloaded)
         rng = np.random.default_rng(idx)
+        pos = idx % len(arrival) if arrival else 0
         while time.monotonic() < stop_at:
-            x = rng.standard_normal(args.dim).astype(np.float32)
+            dim = args.dim
+            if arrival:
+                # replay mode: honor the recorded inter-arrival gap (and
+                # per-event dim) instead of the closed loop's immediate
+                # resubmit; the trace loops until --seconds expires
+                dt_ms, ev_dim = arrival[pos]
+                pos = (pos + 1) % len(arrival)
+                if ev_dim:
+                    dim = ev_dim
+                if dt_ms > 0:
+                    time.sleep(min(dt_ms / 1000.0,
+                                   max(0.0, stop_at - time.monotonic())))
+                    if time.monotonic() >= stop_at:
+                        break
+            x = rng.standard_normal(dim).astype(np.float32)
             t0 = time.perf_counter()
             try:
                 server.predict(x)
@@ -172,13 +238,18 @@ def cmd_bench(args) -> int:
         "grid_bound": server.grid.grid_bound(),
         "compile_bound_ok":
             stats["cache"]["misses"] <= server.grid.grid_bound(),
+        "observability": snapshot(),
     }
+    if arrival:
+        doc["arrival"] = {"trace": args.arrival, "events": len(arrival),
+                          "mode": "replay"}
     if args.warm_start:
         j.set_phase("serving_bench_warm_start")
         doc["warm_start"] = _warm_start_ab(args)
+    _embed_distributed_trace(doc, args.trace_dir, recorder)
     if args.out:
         with atomic_write(args.out, "w") as f:
-            json.dump(doc, f, indent=1, sort_keys=True)
+            json.dump(doc, f, indent=1, sort_keys=True, default=str)
         print(f"serving bench: artifact written to {args.out}",
               file=sys.stderr)
     _emit(doc)
@@ -904,6 +975,15 @@ def main(argv=None) -> int:
                         "with stamp-vs-value corruption checks; writes "
                         "BENCH_serving_deploy.json and exits nonzero "
                         "when any gate outcome or response is wrong")
+    b.add_argument("--arrival", default=None,
+                   help="replay a recorded arrival trace (JSON: "
+                        "{'format': 'mxtpu-arrival-v1', 'events': "
+                        "[{'dt_ms': F[, 'dim': N]}, ...]}) instead of "
+                        "the closed loop's immediate resubmit: each "
+                        "client honors the recorded inter-arrival gaps "
+                        "in order, looping until --seconds expires — "
+                        "identical offered load across A/B runs "
+                        "(benchmarks/arrival_smoke.json)")
     b.add_argument("--hedge-ms", type=float, default=0.0,
                    help="tail-latency hedge delay for --replicas mode "
                         "(0 = off)")

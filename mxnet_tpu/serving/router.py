@@ -174,6 +174,25 @@ class _DeployTap:
         return "canary" if str(rid) in self.canary else "control"
 
 
+def _apply_tuned_router(cfg) -> None:
+    """Fill the router hedge delay from the active tuned table — same
+    precedence as the Server's knobs (explicit env var or constructor
+    value off the built-in default wins; applied values journal one
+    ``tuned_load``)."""
+    from ..autotune import table as _tt
+    doc = _tt.tuned_for("router")
+    if doc is None:
+        return
+    if "MXNET_TPU_POOL_HEDGE_MS" in os.environ or cfg.hedge_ms != 0.0:
+        return
+    h = _tt.knob(doc, "router", "hedge_ms")
+    if h is None or float(h) == cfg.hedge_ms:
+        return
+    cfg.hedge_ms = float(h)
+    get_journal().event("tuned_load", site="router",
+                        hedge_ms=cfg.hedge_ms)
+
+
 class Router:
     """The front door over one :class:`~.pool.ReplicaPool` (thread-safe;
     call :meth:`predict` / :meth:`call` from any number of client
@@ -182,6 +201,7 @@ class Router:
     def __init__(self, pool, config=None):
         self.pool = pool
         self.config = config or RouterConfig()
+        _apply_tuned_router(self.config)
         # serializes counters/breakers/placement.  No I/O ever runs
         # under it: breaker transitions mutate inside and journal via
         # _emit_breaker after release (graftlint G15)

@@ -128,6 +128,54 @@ class ServerConfig:
                 else self.shard_plan.fingerprint_token()}
 
 
+def _apply_tuned_server(cfg) -> None:
+    """Fill serving knobs from the active tuned table (autotune.table,
+    ``MXNET_TPU_TUNED_TABLE``) — but only where NOTHING else chose the
+    value: an explicit env var or a constructor argument that moved a
+    knob off its built-in default always wins over the table (explicit
+    > tuned > built-in).  Applied values journal one ``tuned_load``;
+    an invalid/stale/mismatched table journals ``tuned_fallback`` in
+    the loader and changes nothing here."""
+    from ..autotune import table as _tt
+    doc = _tt.tuned_for("server")
+    if doc is None:
+        return
+    applied = {}
+    if "MXNET_TPU_SERVING_WINDOW_MS" not in os.environ \
+            and cfg.window_ms == 5.0:
+        w = _tt.knob(doc, "serving", "window_ms")
+        if w is not None and float(w) != cfg.window_ms:
+            cfg.window_ms = float(w)
+            applied["window_ms"] = cfg.window_ms
+    if "MXNET_TPU_SERVING_MAX_QUEUE" not in os.environ \
+            and cfg.max_queue == 128:
+        q = _tt.knob(doc, "serving", "max_queue")
+        if q is not None and int(q) != cfg.max_queue:
+            cfg.max_queue = int(q)
+            applied["max_queue"] = cfg.max_queue
+    if cfg.batch_buckets is None:
+        bb = _tt.knob(doc, "buckets", "batch")
+        if bb:
+            # the lattice must still admit a full coalesced batch: clamp
+            # to max_batch and keep max_batch as the top bucket
+            lat = sorted({int(b) for b in bb if int(b) <= cfg.max_batch}
+                         | {int(cfg.max_batch)})
+            cfg.batch_buckets = tuple(lat)
+            applied["batch_buckets"] = lat
+    if cfg.decode_model is not None \
+            and "MXNET_TPU_DECODE_SLOTS" not in os.environ:
+        s = _tt.knob(doc, "decode", "slots")
+        if s is not None:
+            if cfg.decode is None:
+                from .decode import DecodeConfig
+                cfg.decode = DecodeConfig()
+            if cfg.decode.slots == 8 and int(s) != cfg.decode.slots:
+                cfg.decode.slots = int(s)
+                applied["decode_slots"] = cfg.decode.slots
+    if applied:
+        get_journal().event("tuned_load", site="server", **applied)
+
+
 class Server:
     """Dynamic-batching inference server around one Gluon block.
 
@@ -140,6 +188,7 @@ class Server:
     def __init__(self, block, config=None, param_store=None, ctx=None):
         self.block = block
         self.config = config or ServerConfig()
+        _apply_tuned_server(self.config)
         cfg = self.config
         self.grid = BucketGrid(cfg.max_batch, cfg.batch_buckets,
                                cfg.dim_buckets)

@@ -370,8 +370,14 @@ def test_bench_pallas_flag(clean_tier, monkeypatch, capsys):
     monkeypatch.setattr("sys.argv", ["bench.py", "--pallas", "off",
                                      "--body"])
     monkeypatch.setattr(bench, "_run_body", lambda: 0)
-    assert bench.main() == 0
-    assert os.environ["MXNET_TPU_PALLAS"] == "off"
+    try:
+        assert bench.main() == 0
+        assert os.environ["MXNET_TPU_PALLAS"] == "off"
+    finally:
+        # bench.main set the var itself; delenv on an absent var
+        # registers no undo, so restore by hand or it leaks into
+        # every later test in the process
+        os.environ.pop("MXNET_TPU_PALLAS", None)
 
 
 def test_blockwise_reference_chunking_is_exact(clean_tier):
