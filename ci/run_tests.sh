@@ -161,6 +161,33 @@ python -m pytest tests/test_guardrails.py -q -k smoke -p no:cacheprovider
 echo "== tier 0.5: elastic chaos smoke (rank loss -> resharded resume) =="
 python -m pytest tests/test_elastic.py -q -k smoke -p no:cacheprovider
 
+# chaos mini-campaign: the five single-fault drills above are also
+# registered as conductor scenarios (mxnet_tpu/chaos/scenarios.py), so
+# faults COMPOSE: here a seeded 2-fault schedule (torn heartbeat +
+# disk_full at the replace phase — the seed pins both) lands mid-window
+# on the same 3-replica pool the SIGKILL smoke drives, every declared
+# invariant is evaluated, and the CHAOS_rNN.json artifact must
+# parse-check; a failing invariant ships a shrunk reproducer and rc 1
+# (docs/chaos.md).  Hard wall budget: a hung campaign is a failure,
+# not a stall.
+echo "== tier 0.5: chaos mini-campaign (composed faults via conductor) =="
+CHAOS_DIR="$(mktemp -d)"
+timeout -k 10 120 python -m mxnet_tpu.chaos run pool --seed 9 \
+    --faults 2 --classes durability,resource --budget 5 \
+    --out-dir "$CHAOS_DIR" > /dev/null
+python - "$CHAOS_DIR" <<'EOF'
+import sys
+from mxnet_tpu.chaos.artifact import latest_artifact, read_artifact
+path = latest_artifact(sys.argv[1])
+doc = read_artifact(path)
+kinds = [s["kind"] for s in doc["schedule"]]
+assert "disk_full" in kinds, kinds
+assert doc["ok"], f"failed invariants: {doc['failed']}"
+print(f"chaos mini-campaign PASS: {len(kinds)} composed faults "
+      f"({', '.join(kinds)}), artifact {path}")
+EOF
+rm -rf "$CHAOS_DIR"
+
 # autotune smoke: the closed-loop autotuner's table discipline on CPU —
 # a committed tuned table survives the corruption/truncation/envelope
 # fuzz matrix (defaults + exact journaled tuned_fallback reason, zero

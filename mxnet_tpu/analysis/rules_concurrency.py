@@ -1,5 +1,5 @@
-"""Concurrency / lock-discipline rules (G15-G19) — the interprocedural
-tier, built on :mod:`.callgraph` + :mod:`.summaries`.
+"""Concurrency / lock-discipline rules (G15-G20, G26) — the
+interprocedural tier, built on :mod:`.callgraph` + :mod:`.summaries`.
 
 Every rule here is grounded in a cross-function defect this repo
 actually shipped and then paid to find dynamically (chaos tests, hand
@@ -579,6 +579,135 @@ class LeakedOpenSpan(Rule):
                 f"every child) from the assembled timeline; use "
                 f"`with`, or end it in a finally: (a finally-called "
                 f"helper that ends the passed span counts)")
+
+
+@register
+class SwallowedDurableWriteError(Rule):
+    code = "G26"
+    name = "swallowed-durable-write-error"
+    severity = "error"
+    doc = ("A broad exception handler (bare `except:`, `except "
+           "Exception:`, `except BaseException:`) wrapped around a "
+           "durable-write call chain — the protected code reaches a "
+           "commit point (atomic_write, os.replace/os.rename, "
+           "os.fsync, fsync_dir) directly or TRANSITIVELY through "
+           "same-module helpers (the summary engine's reach set) — "
+           "and the handler neither re-raises nor journals. The write "
+           "that was supposed to outlive the process failed, and the "
+           "process carried on as if it had landed: the checkpoint "
+           "loader restores a step that was never committed, the "
+           "heartbeat reader trusts a beat that never hit disk. The "
+           "chaos tier's disk_full/io_error faults exist precisely to "
+           "drive these paths — a swallowing handler turns every one "
+           "of those injections into a silent no-op instead of a "
+           "journaled degrade. A handler is fine if it re-raises "
+           "(bare `raise` or `raise X`) or records the failure "
+           "through the journal surface (`.event()`, `.crash()`, "
+           "`.set_phase()`, `note_disk_full()`); a TYPED handler "
+           "(`except OSError:`) is not flagged — naming the type is "
+           "the visible contract G26 wants (resilience.retry's "
+           "ENOSPC fail-fast is exactly that shape). Scope: "
+           "mxnet_tpu/ library code.")
+
+    BROAD = {"Exception", "BaseException"}
+    # (kind, what) block facts that constitute a durability commit
+    # point — plain open/read I/O stays G6/G21 territory
+    DURABLE = {("file", w) for w in (
+        "os.replace", "os.rename", "os.fsync",
+        "atomic_write", "fsync_dir")}
+    _JOURNAL_ATTRS = {"event", "crash", "set_phase"}
+
+    def _is_broad(self, handler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        names = t.elts if isinstance(t, ast.Tuple) else [t]
+        return any(isinstance(e, ast.Name) and e.id in self.BROAD
+                   for e in names)
+
+    def _handler_recovers(self, handler) -> bool:
+        """Re-raise or journal anywhere in the handler body (nested
+        defs excluded — code in them does not run on this path)."""
+        stack = list(handler.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                f = node.func
+                leaf = f.attr if isinstance(f, ast.Attribute) \
+                    else (f.id if isinstance(f, ast.Name) else None)
+                if leaf in self._JOURNAL_ATTRS \
+                        or leaf == "note_disk_full":
+                    return True
+            stack.extend(ast.iter_child_nodes(node))
+        return False
+
+    def _durable_site(self, ctx, ms, index, info, stmts):
+        """First durable write the protected statements reach:
+        ``(line, what, via, op_line)`` — direct commit-point calls
+        first, then same-module callees whose transitive reach set
+        contains one."""
+        transitive = None
+        stack = list(stmts)
+        while stack:
+            node = stack.pop(0)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                b = cg.classify_blocking(index, node)
+                if b and (b[0], b[1]) in self.DURABLE:
+                    return node.lineno, b[1], None, node.lineno
+                callee = cg.resolve_callee(index, node, info.cls,
+                                           info.key)
+                if transitive is None and callee:
+                    hits = sorted(self.DURABLE
+                                  & set(ms.reach.get(callee, ())))
+                    if hits:
+                        path, op_line = ms.chain(callee, hits[0])
+                        transitive = (node.lineno, hits[0][1],
+                                      _chain_str(path) if path
+                                      else callee, op_line)
+            stack.extend(ast.iter_child_nodes(node))
+        return transitive
+
+    def check(self, ctx):
+        if not ctx.is_library():
+            return
+        ms = sm.for_context(ctx)
+        index = ms.index
+        for info in index.functions.values():
+            for node in sm._scope_walk(info.node):
+                if not isinstance(node, ast.Try):
+                    continue
+                site = None
+                for handler in node.handlers:
+                    if not self._is_broad(handler) \
+                            or self._handler_recovers(handler):
+                        continue
+                    if site is None:
+                        # only the PROTECTED code counts (body + else)
+                        site = self._durable_site(
+                            ctx, ms, index, info,
+                            list(node.body) + list(node.orelse))
+                        if site is None:
+                            break
+                    _line, what, via, op_line = site
+                    reach = f"{what} via {via}, line {op_line}" \
+                        if via else what
+                    yield self.finding(
+                        ctx, handler.lineno,
+                        f"broad except swallows a durable-write "
+                        f"failure (the try body reaches {reach}) — "
+                        f"the commit never landed and nothing will "
+                        f"ever say so; narrow the catch to the "
+                        f"expected type, re-raise, or journal the "
+                        f"failure (.event/.crash/note_disk_full) "
+                        f"before degrading")
 
 
 @register

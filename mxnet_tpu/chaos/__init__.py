@@ -1,0 +1,40 @@
+"""Chaos campaign engine: seeded fault schedules over live scenarios.
+
+The repo's resilience claims each grew up with a bespoke drill — a
+pool test that SIGKILLs a replica, a crash matrix for the commit
+protocol, a canary regression, a cohort losing a rank.  Production
+does not schedule faults one at a time: a host dies WHILE a disk fills
+WHILE a deploy is mid-canary.  This package runs those same live
+setups under *composed*, seeded fault schedules and judges the runs
+against declared invariants:
+
+- :mod:`.schedule` — seeded generation + spec↔rule lowering (the
+  resource-exhaustion family — ``disk_full``, ``disk_budget``,
+  ``fd_exhaust``, ``partition`` — lives in
+  :mod:`mxnet_tpu.testing.faults` with the rest of the catalog);
+- :mod:`.scenarios` — the registered live systems (pool, crash_matrix,
+  fleet, deploy, elastic);
+- :mod:`.invariants` — what "survived" means, one verdict each;
+- :mod:`.conductor` — run → judge → shrink → artifact;
+- :mod:`.shrink` — ddmin to a minimal failing schedule;
+- :mod:`.artifact` / :mod:`.report` — ``CHAOS_rNN.json`` +
+  ``doctor --chaos``;
+- ``python -m mxnet_tpu.chaos run|replay|report`` — the CLI
+  (docs/chaos.md).
+"""
+from __future__ import annotations
+
+from .artifact import latest_artifact, read_artifact, write_artifact
+from .conductor import execute, run_campaign
+from .invariants import INVARIANTS, evaluate
+from .report import chaos_report
+from .schedule import FAULT_CLASSES, build, describe, generate
+from .scenarios import SCENARIOS, Scenario, ScenarioRun, get, names, \
+    register
+from .shrink import ddmin
+
+__all__ = ["FAULT_CLASSES", "INVARIANTS", "SCENARIOS", "Scenario",
+           "ScenarioRun", "build", "chaos_report", "ddmin", "describe",
+           "evaluate", "execute", "generate", "get", "latest_artifact",
+           "names", "read_artifact", "register", "run_campaign",
+           "write_artifact"]

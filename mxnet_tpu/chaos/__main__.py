@@ -1,0 +1,164 @@
+"""Campaign CLI: ``python -m mxnet_tpu.chaos run|replay|report``.
+
+``run``     — generate a seeded schedule for a registered scenario,
+              execute it under load, evaluate every declared invariant,
+              shrink on failure, and write ``CHAOS_rNN.json``.
+              rc 0 = all invariants held, 1 = a campaign failed.
+``replay``  — re-run an artifact's schedule (shrunk reproducer by
+              default, ``--full`` for the original) from its recorded
+              seed.  rc mirrors ``run``.
+``report``  — summarize a directory of artifacts (the ``doctor
+              --chaos`` digest).  rc 0 = no failures recorded.
+
+One JSON line on stdout (the artifact/report document); human detail on
+stderr — same contract as ``python -m mxnet_tpu.diagnostics``.
+
+Env defaults: ``MXNET_TPU_CHAOS_SEED`` (seed when ``--seed`` is
+omitted; falls back to a time-derived seed, printed so any run is
+reproducible after the fact) and ``MXNET_TPU_CHAOS_BUDGET_S`` (load
+window + shrink-probe budget per execution, default 8).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import artifact, conductor, report, scenarios, schedule
+
+__all__ = ["main"]
+
+
+def _emit(obj) -> None:
+    print(json.dumps(obj, default=str), flush=True)
+
+
+def _default_seed() -> int:
+    env = os.environ.get("MXNET_TPU_CHAOS_SEED")
+    if env:
+        return int(env)
+    return int(time.time() * 1000) % (1 << 31)
+
+
+def _default_budget() -> float:
+    try:
+        return float(os.environ.get("MXNET_TPU_CHAOS_BUDGET_S", 8.0))
+    except ValueError:
+        return 8.0
+
+
+def cmd_run(args) -> int:
+    seed = args.seed if args.seed is not None else _default_seed()
+    classes = None
+    if args.classes:
+        classes = [c.strip() for c in args.classes.split(",") if c.strip()]
+        bad = [c for c in classes if c not in schedule.FAULT_CLASSES]
+        if bad:
+            print(f"chaos: unknown fault class(es) {bad} (choose from "
+                  f"{', '.join(schedule.FAULT_CLASSES)})", file=sys.stderr)
+            return 2
+    print(f"chaos: scenario={args.scenario} seed={seed} "
+          f"faults={args.faults} budget={args.budget:g}s",
+          file=sys.stderr)
+    doc = conductor.run_campaign(
+        args.scenario, seed, n_faults=args.faults, classes=classes,
+        budget_s=args.budget, out_dir=args.out_dir,
+        shrink=not args.no_shrink)
+    for line in doc["schedule_human"]:
+        print(f"chaos:   {line}", file=sys.stderr)
+    for v in doc["verdicts"]:
+        mark = "ok " if v["ok"] else "FAIL"
+        print(f"chaos: [{mark}] {v['name']}: {v['detail']}",
+              file=sys.stderr)
+    if doc.get("shrunk"):
+        print(f"chaos: shrunk reproducer ({len(doc['shrunk'])} fault(s)):",
+              file=sys.stderr)
+        for line in doc["shrunk_human"]:
+            print(f"chaos:   {line}", file=sys.stderr)
+    print(f"chaos: artifact {doc['path']}", file=sys.stderr)
+    _emit(doc)
+    return 0 if doc["ok"] else 1
+
+
+def cmd_replay(args) -> int:
+    doc = artifact.read_artifact(args.artifact)
+    specs = doc["schedule"] if (args.full or not doc.get("shrunk")) \
+        else doc["shrunk"]
+    print(f"chaos: replaying {args.artifact}: scenario={doc['scenario']} "
+          f"seed={doc['seed']} ({len(specs)} fault(s), "
+          f"{'full' if specs is doc['schedule'] else 'shrunk'})",
+          file=sys.stderr)
+    out = conductor.run_campaign(
+        doc["scenario"], doc["seed"], schedule=specs,
+        budget_s=args.budget if args.budget is not None
+        else float(doc.get("budget_s", _default_budget())),
+        out_dir=args.out_dir, shrink=False)
+    for v in out["verdicts"]:
+        mark = "ok " if v["ok"] else "FAIL"
+        print(f"chaos: [{mark}] {v['name']}: {v['detail']}",
+              file=sys.stderr)
+    _emit(out)
+    return 0 if out["ok"] else 1
+
+
+def cmd_report(args) -> int:
+    rep = report.chaos_report(args.dir)
+    _emit(rep)
+    if not rep.get("ok"):
+        print(f"chaos: {rep.get('detail', rep.get('error'))}",
+              file=sys.stderr)
+        return 1
+    print(f"chaos: {report.summarize(rep)}", file=sys.stderr)
+    return 0 if rep["failures"] == 0 else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.chaos",
+        description="seeded chaos campaigns over registered scenarios "
+                    "(docs/chaos.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("run", help="run one seeded campaign")
+    r.add_argument("scenario", choices=scenarios.names(),
+                   help="registered scenario")
+    r.add_argument("--seed", type=int, default=None,
+                   help="schedule seed (default MXNET_TPU_CHAOS_SEED "
+                        "or time-derived, echoed to stderr)")
+    r.add_argument("--faults", type=int, default=4,
+                   help="schedule size (default 4: one per fault class)")
+    r.add_argument("--classes", default=None,
+                   help="comma list of fault classes the first draws "
+                        "must cover (default: every class the scenario "
+                        "supports, in catalog order)")
+    r.add_argument("--budget", type=float, default=_default_budget(),
+                   help="load-window seconds per execution (default "
+                        "MXNET_TPU_CHAOS_BUDGET_S or 8)")
+    r.add_argument("--out-dir", default=".",
+                   help="artifact + workdir root (default CWD)")
+    r.add_argument("--no-shrink", action="store_true",
+                   help="skip delta-debugging on failure")
+    r.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("replay", help="re-run an artifact's schedule")
+    p.add_argument("artifact", help="CHAOS_rNN.json path")
+    p.add_argument("--full", action="store_true",
+                   help="replay the original schedule, not the shrunk "
+                        "reproducer")
+    p.add_argument("--budget", type=float, default=None)
+    p.add_argument("--out-dir", default=".")
+    p.set_defaults(fn=cmd_replay)
+
+    d = sub.add_parser("report", help="summarize a directory of "
+                                      "artifacts")
+    d.add_argument("dir", help="directory holding CHAOS_r*.json")
+    d.set_defaults(fn=cmd_report)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""``CHAOS_rNN.json`` artifacts — a campaign you can hand someone.
+
+Same revisioned-artifact convention as the repo's bench/tuner outputs:
+``next_rev`` scans for the highest existing ``CHAOS_r*.json`` and the
+document is written atomically, so a campaign interrupted mid-report
+never leaves a torn artifact (the chaos engine holds itself to the
+invariants it gates everyone else on).
+
+An artifact is a *reproducer*: ``python -m mxnet_tpu.chaos replay
+CHAOS_r01.json`` re-runs the shrunk schedule (or, with ``--full``, the
+original) against the same scenario from the recorded seed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+
+from ..resilience.atomic import atomic_write
+
+__all__ = ["latest_artifact", "next_rev", "read_artifact",
+           "write_artifact"]
+
+_PAT = re.compile(r"^CHAOS_r(\d+)\.json$")
+
+
+def _revs(dirpath) -> list:
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _PAT.match(name)
+        if m:
+            out.append((int(m.group(1)), name))
+    return sorted(out)
+
+
+def next_rev(dirpath) -> int:
+    revs = _revs(dirpath)
+    return (revs[-1][0] + 1) if revs else 1
+
+
+def latest_artifact(dirpath):
+    """Path of the newest ``CHAOS_rNN.json`` under ``dirpath`` (or
+    None)."""
+    revs = _revs(dirpath)
+    return os.path.join(dirpath, revs[-1][1]) if revs else None
+
+
+def write_artifact(dirpath, doc) -> str:
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, f"CHAOS_r{next_rev(dirpath):02d}.json")
+    with atomic_write(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True, default=str)
+    return path
+
+
+def read_artifact(path) -> dict:
+    """Parse + schema-check one artifact; raises ValueError naming the
+    defect (a replay must fail loudly on a torn/foreign file)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise ValueError(f"{path}: unreadable ({e.strerror or e})") from e
+    except ValueError as e:
+        raise ValueError(f"{path}: not valid JSON ({e})") from e
+    if not isinstance(doc, dict) or doc.get("kind") != "chaos":
+        raise ValueError(f"{path}: not a chaos artifact")
+    for key in ("scenario", "seed", "schedule", "verdicts"):
+        if key not in doc:
+            raise ValueError(f"{path}: missing {key!r}")
+    if not isinstance(doc["schedule"], list):
+        raise ValueError(f"{path}: schedule is not a list")
+    return doc

@@ -1,0 +1,244 @@
+"""The campaign conductor — run, judge, shrink, report.
+
+One campaign = one seeded fault schedule composed over one registered
+scenario (chaos/scenarios.py) under closed-loop client load:
+
+1. **generate** — draw the schedule from ``random.Random(seed)``
+   against the scenario's declared targets (≥1 fault per supported
+   class by default: process kill, durability, latency, resource
+   exhaustion composed in ONE window, not one-at-a-time drills);
+2. **execute** — fresh workdir + fresh journal, the schedule's fault
+   rules live in :func:`mxnet_tpu.testing.faults.inject` while client
+   threads hammer ``run.tick()`` and a timeline thread fires the timed
+   actions (kills, disk-budget heals) on the campaign clock;
+3. **evaluate** — every declared invariant gets a verdict
+   (chaos/invariants.py); a campaign with an unevaluated invariant is
+   a bug, not a pass;
+4. **shrink** — on any failed invariant, delta-debug the schedule
+   (chaos/shrink.py) down to a minimal failing subset by same-seed
+   replay, so the artifact ships a reproducer measured in faults, not
+   a haystack;
+5. **artifact** — persist ``CHAOS_rNN.json`` (seed, schedule,
+   verdicts, shrunk reproducer, observability snapshot) for
+   ``python -m mxnet_tpu.chaos replay|report`` and ``doctor --chaos``.
+
+Determinism contract: everything random flows from the seed through
+:func:`chaos.schedule.generate`; execution replays the SAME spec list,
+so ``replay(artifact)`` and every shrink probe run the schedule the
+original campaign ran (modulo thread timing — faults fire on
+deterministic trip predicates, not wall clock, except the explicitly
+timed actions).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+
+from ..diagnostics.journal import get_journal, reset_journal
+from ..observability import trace as obtrace
+from ..resilience.retry import reset_disk_full_notes
+from ..testing import faults
+from . import invariants as inv
+from . import scenarios as scen
+from . import schedule as sched
+from .shrink import ddmin
+
+__all__ = ["execute", "run_campaign"]
+
+# kinds whose firings must leave a deduped disk_full journal record
+# (fd_exhaust is EMFILE, not ENOSPC — it degrades as an ordinary
+# I/O error, outside the fail-fast + note_disk_full contract)
+_DISK_KINDS = ("disk_full", "disk_budget")
+
+
+def _campaign_dir(base, tag):
+    """Fresh campaign root: a rerun with the same scenario+seed must not
+    inherit the previous run's ledger/journal/checkpoints (stale cohort
+    epochs would silently change what the faults land on)."""
+    d = os.path.join(base, tag)
+    if os.path.isdir(d):
+        shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def execute(scenario, specs, *, workdir, budget_s=8.0,
+            window_s=None) -> dict:
+    """One full execution: build the scenario in ``workdir``, inject the
+    schedule, drive the closed-loop clients for the load window, stop,
+    and return observations + verdicts.  Fully re-entrant: every call
+    gets its own journal sink and a clean disk-full dedup set, so a
+    shrink probe observes exactly what a fresh campaign would."""
+    os.makedirs(workdir, exist_ok=True)
+    journal_path = os.path.join(workdir, "journal.jsonl")
+    reset_journal(journal_path)
+    obtrace.reset_tracer()
+    obtrace.configure(mode="journal")
+    reset_disk_full_notes()
+    window_s = float(budget_s if window_s is None else window_s)
+    built = None
+    run = None
+    stopped = False
+    stop = threading.Event()
+    threads = []
+    try:
+        run = scenario.build(workdir)
+        needs_kill = any(s["kind"] == "kill" for s in specs)
+        built = sched.build(specs, kill=run.kill if needs_kill else None)
+        get_journal().event("chaos_campaign", scenario=scenario.name,
+                            n_faults=len(specs),
+                            kinds=[s["kind"] for s in specs])
+        # the plan starts EMPTY: each rule is armed at its at_s on the
+        # campaign clock, so warm-up runs clean and "a disk fills at
+        # 2.7s" means exactly that — in the original run, in replay,
+        # and in every shrink probe
+        with faults.inject() as plan:
+            run.start()
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        run.tick()
+                    except Exception as exc:
+                        # an exception ESCAPING tick() is exactly what
+                        # structured_only exists to catch — record it
+                        # and keep the client alive (a silently dead
+                        # client would read as a hang, not a finding)
+                        run.counters.add("unexpected",
+                                         f"tick escaped: {exc!r}")
+                        time.sleep(0.05)
+
+            for i in range(max(1, scenario.clients)):
+                t = threading.Thread(target=client, daemon=True,
+                                     name=f"chaos-client-{i}")
+                t.start()
+                threads.append(t)
+            timeline = sorted(
+                [(at_s, label,
+                  (lambda r=rule: plan.rules.append(r)))
+                 for at_s, label, rule in built.rules] + built.timed,
+                key=lambda t: t[0])
+            t0 = time.monotonic()
+            for at_s, label, action in timeline:
+                delay = at_s - (time.monotonic() - t0)
+                if delay > 0 and stop.wait(min(delay, window_s)):
+                    break
+                try:
+                    action()
+                except Exception as exc:     # a dead lever is a finding,
+                    get_journal().event(     # not a conductor crash
+                        "chaos_action_failed", action=label,
+                        error=repr(exc))
+            remaining = window_s - (time.monotonic() - t0)
+            if remaining > 0:
+                stop.wait(remaining)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+        # teardown runs with the faults DISARMED: drain/GC is the
+        # recovery path, not part of the injected window
+        run.stop()
+        stopped = True
+        fired = list(plan.log)
+    finally:
+        stop.set()
+        if run is not None and not stopped:
+            try:
+                run.stop()
+            except Exception:
+                pass             # best-effort cleanup after a crash
+        obtrace.reset_tracer()
+        reset_journal("stderr")
+    obs = run.observations()
+    obs["journal"] = journal_path
+    obs["fired"] = fired
+    obs["disk_fired"] = sum(
+        1 for spec, (_at, _label, rule)
+        in zip(specs_without_timed(specs), built.rules)
+        if spec["kind"] in _DISK_KINDS and getattr(rule, "fired", 0))
+    verdicts = inv.evaluate(scenario.invariants, obs)
+    failed = [v["name"] for v in verdicts if not v["ok"]]
+    return {"ok": not failed, "failed": failed, "verdicts": verdicts,
+            "observations": obs, "specs": list(specs)}
+
+
+def specs_without_timed(specs):
+    """The sub-list of specs that lowered into live fault RULES (kill
+    specs lower into timed actions instead) — index-aligned with
+    ``BuiltSchedule.rules``."""
+    return [s for s in specs if s["kind"] != "kill"]
+
+
+def run_campaign(scenario_name, seed, *, n_faults=4, classes=None,
+                 budget_s=8.0, out_dir=".", schedule=None,
+                 shrink=True) -> dict:
+    """Run one campaign end-to-end and write its ``CHAOS_rNN.json``.
+
+    ``schedule`` (a spec list) overrides generation — that is the
+    replay path; otherwise :func:`chaos.schedule.generate` draws it
+    from ``seed``.  Returns the artifact document (with ``"path"``
+    added when it was persisted)."""
+    from .artifact import write_artifact
+    scenario = scen.get(scenario_name)
+    specs = list(schedule) if schedule is not None else sched.generate(
+        seed, scenario.targets, n_faults=n_faults, classes=classes)
+    base = _campaign_dir(out_dir, f"chaos-{scenario_name}-{int(seed)}")
+    result = execute(scenario, specs, budget_s=budget_s,
+                     workdir=os.path.join(base, "run"))
+    shrunk = None
+    if not result["ok"] and shrink and len(specs) > 1:
+        failed = set(result["failed"])
+        probe_n = [0]
+
+        def still_fails(subset):
+            probe_n[0] += 1
+            sub = execute(scenario, subset, budget_s=budget_s,
+                          workdir=os.path.join(
+                              base, f"shrink-{probe_n[0]:02d}"))
+            return bool(failed & set(sub["failed"]))
+
+        shrunk = ddmin(specs, still_fails)
+    doc = {
+        "kind": "chaos",
+        "scenario": scenario_name,
+        "seed": int(seed),
+        "budget_s": float(budget_s),
+        "ok": result["ok"],
+        "failed": result["failed"],
+        "schedule": specs,
+        "schedule_human": [sched.describe(s) for s in specs],
+        "verdicts": result["verdicts"],
+        "shrunk": shrunk,
+        "shrunk_human": ([sched.describe(s) for s in shrunk]
+                         if shrunk else None),
+        "observability": _snapshot(result["observations"]),
+    }
+    doc["path"] = write_artifact(out_dir, doc)
+    return doc
+
+
+def _snapshot(obs) -> dict:
+    """The artifact's observability digest: counters, firing log, the
+    scenario extras — everything JSON-serializable, nothing huge."""
+    snap = {"counters": obs.get("counters"),
+            "fired": [list(t) for t in (obs.get("fired") or [])],
+            "disk_fired": obs.get("disk_fired", 0),
+            "kills": obs.get("kills"),
+            "journal": obs.get("journal")}
+    for key in ("deploy", "resize", "tenant_ok"):
+        if key in obs:
+            snap[key] = obs[key]
+    reads = obs.get("reads")
+    if reads is not None:
+        bad = [r for r in reads if not r.get("valid")]
+        snap["reads"] = {"total": len(reads), "invalid": len(bad),
+                         "invalid_sample": bad[:4]}
+    # the journal's degrade trail, summarized by kind
+    kinds: dict = {}
+    for rec in inv.journal_records(obs.get("journal", "")):
+        k = rec.get("kind", "?")
+        kinds[k] = kinds.get(k, 0) + 1
+    snap["journal_kinds"] = dict(sorted(kinds.items()))
+    return snap

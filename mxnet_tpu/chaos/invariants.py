@@ -1,0 +1,259 @@
+"""Declared campaign invariants — what "survived the faults" *means*.
+
+A scenario declares its invariants as ``(name, params)`` pairs; after
+the load window closes the conductor evaluates every one against the
+run's observations (client-side response accounting, the run journal,
+the on-disk checkpoint store) and records a verdict per invariant::
+
+    {"name": ..., "ok": bool, "detail": <one line>, "params": {...}}
+
+Every declared invariant is ALWAYS evaluated — a campaign artifact with
+a missing verdict is a bug, not a pass — and any ``ok: false`` verdict
+sends the schedule to the shrinker (chaos/shrink.py).
+
+Observations contract (what scenario runners put in ``obs``):
+
+- ``counters``: ``{"ok", "shed", "degraded"}`` ints + ``corrupt`` /
+  ``unexpected`` sample lists from the closed-loop clients;
+- ``journal``: the run's JSONL journal path;
+- ``kills``: ``[{"target", "t_kill"}]`` (wall-clock ts, matches record
+  ``ts``);
+- ``fired``: the FaultPlan's firing log ``[(point, path, nbytes)]``;
+- ``cfg``: scenario timing (``deadline_s``, ``monitor_s``, ...);
+- ``workdir`` / optional ``ckpt_root`` / scenario-specific extras
+  (``reads`` for the crash-matrix store audit, ``deploy`` for the
+  canary result, ``resize`` for the cohort).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["INVARIANTS", "evaluate", "journal_records", "register"]
+
+INVARIANTS: dict = {}
+
+
+def register(name):
+    def deco(fn):
+        INVARIANTS[name] = fn
+        return fn
+    return deco
+
+
+def journal_records(path, kind=None) -> list:
+    """All (well-formed) records of the run journal, optionally one
+    kind — torn lines read as absent, never as a reader crash."""
+    out = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if kind is None or rec.get("kind") == kind:
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def evaluate(declared, obs) -> list:
+    """Run every declared invariant; unknown names are a failing
+    verdict (a typo must not read as a pass)."""
+    verdicts = []
+    for name, params in declared:
+        fn = INVARIANTS.get(name)
+        if fn is None:
+            verdicts.append({"name": name, "ok": False, "params": params,
+                             "detail": "unknown invariant"})
+            continue
+        try:
+            ok, detail = fn(obs, **params)
+        except Exception as exc:
+            ok, detail = False, f"evaluator crashed: {exc!r}"
+        verdicts.append({"name": name, "ok": bool(ok), "params": params,
+                         "detail": detail})
+    return verdicts
+
+
+@register("progress")
+def _progress(obs, min_ok=1):
+    """The system kept serving: the clients completed requests."""
+    ok = obs["counters"]["ok"]
+    return ok >= int(min_ok), f"{ok} ok responses (need >= {min_ok})"
+
+
+@register("zero_corrupt")
+def _zero_corrupt(obs):
+    """No response ever carried a wrong/corrupt value — degrade to
+    sheds and structured errors, never to corruption."""
+    bad = obs["counters"].get("corrupt") or []
+    return not bad, (f"{len(bad)} corrupt responses; first: {bad[:2]}"
+                     if bad else "0 corrupt responses")
+
+
+@register("structured_only")
+def _structured_only(obs):
+    """Every client-visible failure was a structured serving error."""
+    bad = obs["counters"].get("unexpected") or []
+    return not bad, (f"{len(bad)} unstructured errors; first: {bad[:3]}"
+                     if bad else "all failures structured")
+
+
+@register("shed_rate")
+def _shed_rate(obs, ceiling=0.5):
+    """Load shedding stayed under the declared ceiling."""
+    c = obs["counters"]
+    total = c["ok"] + c["shed"] + c.get("degraded", 0)
+    if total == 0:
+        return False, "no requests completed at all"
+    rate = c["shed"] / total
+    return rate <= float(ceiling), \
+        f"shed rate {rate:.3f} (ceiling {ceiling}, {c['shed']}/{total})"
+
+
+@register("recovery_deadline")
+def _recovery_deadline(obs, slack_s=3.0):
+    """Every killed replica's loss was detected (journaled
+    ``replica_lost``) within heartbeat deadline + monitor tick +
+    slack."""
+    kills = obs.get("kills") or []
+    if not kills:
+        return True, "no kills scheduled"
+    cfg = obs.get("cfg") or {}
+    bound = (float(cfg.get("deadline_s", 3.0))
+             + float(cfg.get("monitor_s", 0.5)) + float(slack_s))
+    lost = journal_records(obs["journal"], "replica_lost")
+    lines = []
+    ok = True
+    for k in kills:
+        hits = [r for r in lost if r.get("replica") == k["target"]
+                and r.get("ts", 0) >= k["t_kill"]]
+        if not hits:
+            ok = False
+            lines.append(f"{k['target']}: never detected")
+            continue
+        dt = hits[0]["ts"] - k["t_kill"]
+        if dt > bound:
+            ok = False
+        lines.append(f"{k['target']}: detected in {dt:.2f}s "
+                     f"(bound {bound:.2f}s)")
+    return ok, "; ".join(lines)
+
+
+@register("no_litter")
+def _no_litter(obs, subdir=None):
+    """No staged ``.tmp.*`` litter survived the campaign (ENOSPC and
+    recoverable-error cleanup both unlink their temp)."""
+    root = obs["workdir"] if subdir is None \
+        else os.path.join(obs["workdir"], subdir)
+    litter = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        litter += [os.path.join(dirpath, n) for n in filenames
+                   if ".tmp." in n]
+    return not litter, (f"{len(litter)} staged temp(s): {litter[:3]}"
+                        if litter else "no staged litter")
+
+
+@register("store_old_or_new")
+def _store_old_or_new(obs):
+    """The checkpoint store is bit-exact old-or-new: every committed
+    step still validates against its CRC manifest and at least one
+    restorable step exists."""
+    from ..resilience import commit
+    root = obs.get("ckpt_root")
+    if not root:
+        return False, "scenario observations carry no ckpt_root"
+    steps = commit.committed_steps(root)
+    if not steps:
+        return False, "no committed steps survived"
+    bad = []
+    for s in steps:
+        try:
+            commit.validate_step(root, s)
+        except ValueError as exc:
+            bad.append(f"step {s}: {exc}")
+    return not bad, ("; ".join(bad[:3]) if bad
+                     else f"{len(steps)} committed steps all validate")
+
+
+@register("reads_old_or_new")
+def _reads_old_or_new(obs):
+    """Every mid-campaign reader observation was a complete committed
+    value (the crash-matrix audit: old or new, never torn)."""
+    reads = obs.get("reads") or []
+    bad = [r for r in reads if not r.get("valid")]
+    if not reads:
+        return False, "no reader observations recorded"
+    return not bad, (f"{len(bad)}/{len(reads)} torn/invalid reads; "
+                     f"first: {bad[:2]}" if bad
+                     else f"{len(reads)} reads all old-or-new")
+
+
+@register("canary_rolled_back")
+def _canary_rolled_back(obs):
+    """The deploy scenario's gate: a regressed candidate must have been
+    caught (rolled back) by the parity mirror, never promoted."""
+    dep = obs.get("deploy") or {}
+    if dep.get("error"):
+        return False, f"deploy controller crashed: {dep['error']}"
+    if not dep:
+        return False, "deploy produced no result inside the window"
+    result = dep.get("result")
+    return result == "rolled_back", \
+        f"deploy result {result!r} (reason {dep.get('reason')!r})"
+
+
+@register("cohort_resized")
+def _cohort_resized(obs):
+    """The elastic scenario's gate: after the scheduled rank kill the
+    survivor resized to a working smaller cohort (journaled
+    ``cohort_resize``) instead of hanging or crashing."""
+    if not obs.get("kills"):
+        return True, "no rank kill scheduled"
+    rz = obs.get("resize") or {}
+    if not rz.get("members"):
+        return False, "rank killed but the survivor never resized"
+    recs = journal_records(obs["journal"], "cohort_resize")
+    if not recs:
+        return False, "resize happened but was never journaled"
+    detect = rz.get("detect_s")
+    return True, (f"resized to {rz['members']} (lost {rz.get('lost')}"
+                  + (f", detected in {detect:.2f}s" if detect else "")
+                  + ")")
+
+
+@register("protected_tenant")
+def _protected_tenant(obs, tenant):
+    """The fleet scenario's isolation gate: the NON-targeted tenant
+    kept serving while its neighbor was poisoned/slowed."""
+    ok_by_tenant = obs.get("tenant_ok") or {}
+    n = ok_by_tenant.get(tenant, 0)
+    return n >= 1, (f"protected tenant {tenant!r}: {n} ok responses"
+                    if n else f"protected tenant {tenant!r} served "
+                              "NOTHING — isolation failed")
+
+
+@register("degrades_journaled")
+def _degrades_journaled(obs):
+    """Silent degrades are forbidden: injected disk exhaustion that
+    fired must have its deduped ``disk_full`` journal record, and the
+    router's degrade trail (retries/breaker flips), when present,
+    carries trace ids."""
+    lines = []
+    ok = True
+    if obs.get("disk_fired", 0) > 0:
+        recs = journal_records(obs["journal"], "disk_full")
+        if not recs:
+            ok = False
+            lines.append("disk exhaustion fired but no disk_full record")
+        else:
+            lines.append(f"{len(recs)} disk_full record(s)")
+    for kind in ("router_retry", "router_breaker"):
+        recs = journal_records(obs["journal"], kind)
+        if recs and not any(r.get("trace_id") for r in recs):
+            ok = False
+            lines.append(f"{kind} records carry no trace ids")
+    return ok, "; ".join(lines) or "no degrade trail to audit"

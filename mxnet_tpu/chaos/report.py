@@ -1,0 +1,75 @@
+"""``doctor --chaos`` reporter: summarize a directory of campaign
+artifacts.
+
+Stdlib-only (the doctor must be able to audit chaos results while jax
+is wedged): reads every ``CHAOS_rNN.json`` under the directory, counts
+pass/fail per scenario, and surfaces the newest failure's failed
+invariants + shrunk reproducer size — the triage entry point after a
+red CI chaos gate.
+"""
+from __future__ import annotations
+
+import os
+
+from .artifact import _revs, read_artifact
+
+__all__ = ["chaos_report", "summarize"]
+
+
+def chaos_report(dirpath) -> dict:
+    """Digest of all chaos artifacts under ``dirpath`` (doctor --chaos
+    row; shape mirrors the other stdlib-only doctor reporters)."""
+    revs = _revs(dirpath)
+    if not revs:
+        return {"ok": False, "error": "no_artifacts",
+                "detail": f"no CHAOS_r*.json under {dirpath!r}"}
+    campaigns = []
+    unreadable = []
+    for rev, name in revs:
+        path = os.path.join(dirpath, name)
+        try:
+            doc = read_artifact(path)
+        except ValueError as exc:
+            unreadable.append({"rev": rev, "error": str(exc)})
+            continue
+        failed = [v["name"] for v in doc.get("verdicts", [])
+                  if not v.get("ok")]
+        campaigns.append({
+            "rev": rev,
+            "scenario": doc.get("scenario"),
+            "seed": doc.get("seed"),
+            "ok": bool(doc.get("ok")),
+            "n_faults": len(doc.get("schedule") or []),
+            "classes": sorted({s.get("cls") for s in
+                               (doc.get("schedule") or [])} - {None}),
+            "failed": failed,
+            "shrunk_to": (len(doc["shrunk"]) if doc.get("shrunk")
+                          else None),
+        })
+    fails = [c for c in campaigns if not c["ok"]]
+    return {"ok": True, "path": dirpath,
+            "campaigns": len(campaigns), "failures": len(fails),
+            "unreadable": unreadable,
+            "last": campaigns[-1] if campaigns else None,
+            "last_failure": fails[-1] if fails else None,
+            "rows": campaigns}
+
+
+def summarize(rep) -> str:
+    """One stderr line for the doctor (mirrors _summ_* shape)."""
+    base = (f"chaos: {rep['campaigns']} campaign(s), "
+            f"{rep['failures']} failed")
+    last = rep.get("last")
+    if last:
+        base += (f"; last: r{last['rev']:02d} {last['scenario']} "
+                 f"seed={last['seed']} "
+                 f"{len(last['classes'])} fault classes "
+                 f"({'PASS' if last['ok'] else 'FAIL'})")
+    lf = rep.get("last_failure")
+    if lf:
+        base += (f"; newest failure: {', '.join(lf['failed'])}"
+                 + (f", shrunk to {lf['shrunk_to']} fault(s)"
+                    if lf.get("shrunk_to") else ""))
+    if rep.get("unreadable"):
+        base += f"; {len(rep['unreadable'])} unreadable artifact(s)"
+    return base

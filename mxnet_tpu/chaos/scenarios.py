@@ -1,0 +1,680 @@
+"""Registered campaign scenarios — the live systems faults compose over.
+
+Each scenario wraps one of the repo's existing chaos-drill setups (the
+tier-0.5 smokes in ``ci/run_tests.sh``) as a uniform runner the
+conductor can drive:
+
+- ``pool``          — 3-replica health-routed pool under closed-loop
+                      load (tests/test_serving_pool.py's headline drill,
+                      in-process so the fault hook reaches every layer);
+- ``crash_matrix``  — the checkpoint commit loop with a concurrent
+                      old-or-new reader (tests/test_crash_matrix.py);
+- ``fleet``         — two tenants on one fleet, poison/latency on one,
+                      the other's traffic protected
+                      (tests/test_serving_fleet.py);
+- ``deploy``        — canary deployment of a CRC-valid regressed step
+                      under load; the parity gate must roll back
+                      (tests/test_serving_deploy.py);
+- ``elastic``       — a 2-member in-process cohort losing a rank
+                      mid-run; the survivor resizes and continues
+                      (tests/test_elastic.py).
+
+A scenario declares fault ``targets`` (what the schedule generator may
+draw: replica ids, latency/partition trip sites, path fragments) and
+``invariants`` (chaos/invariants.py names + params, ALL evaluated after
+every campaign).  Runners follow one protocol::
+
+    run = scenario.build(workdir)   # heavyweight deps imported here
+    run.start()
+    run.tick()                      # ONE closed-loop client step
+    run.kill(target)                # process-fault lever (optional)
+    run.stop()
+    obs = run.observations()
+
+Adding a scenario = subclass :class:`ScenarioRun`, declare targets +
+invariants, call :func:`register` (docs/chaos.md walks through it).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["Scenario", "ScenarioRun", "SCENARIOS", "Counters", "get",
+           "names", "register"]
+
+
+class Counters:
+    """Thread-safe closed-loop client accounting (N client threads)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ok = 0
+        self.shed = 0
+        self.degraded = 0
+        self.corrupt: list = []
+        self.unexpected: list = []
+
+    def bump(self, field):
+        with self._lock:
+            setattr(self, field, getattr(self, field) + 1)
+
+    def add(self, field, item, cap=16):
+        with self._lock:
+            lst = getattr(self, field)
+            if len(lst) < cap:
+                lst.append(item)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"ok": self.ok, "shed": self.shed,
+                    "degraded": self.degraded,
+                    "corrupt": list(self.corrupt),
+                    "unexpected": list(self.unexpected)}
+
+
+class Scenario:
+    """Registry row: construction is lazy (``build`` imports the heavy
+    serving/elastic stacks only when a campaign actually runs)."""
+
+    def __init__(self, name, doc, builder, targets, invariants,
+                 clients=2):
+        self.name = name
+        self.doc = doc
+        self.builder = builder
+        self.targets = dict(targets)
+        self.invariants = list(invariants)
+        self.clients = int(clients)
+
+    def build(self, workdir):
+        return self.builder(workdir)
+
+
+SCENARIOS: dict = {}
+
+
+def register(scenario) -> Scenario:
+    SCENARIOS[scenario.name] = scenario
+    return scenario
+
+
+def get(name) -> Scenario:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        raise ValueError(f"unknown scenario {name!r} "
+                         f"(registered: {', '.join(names())})") from None
+
+
+def names() -> list:
+    return sorted(SCENARIOS)
+
+
+class ScenarioRun:
+    """Base runner: subclasses fill in start/tick/stop (+ kill when the
+    scenario supports process faults)."""
+
+    def __init__(self, workdir):
+        self.workdir = str(workdir)
+        self.counters = Counters()
+        self.kills: list = []
+        self.cfg_doc: dict = {}
+
+    def start(self):
+        raise NotImplementedError
+
+    def tick(self):
+        raise NotImplementedError
+
+    def kill(self, target):
+        raise NotImplementedError(f"{type(self).__name__} has no "
+                                  "process-kill lever")
+
+    def stop(self):
+        raise NotImplementedError
+
+    def observations(self) -> dict:
+        return {"counters": self.counters.snapshot(),
+                "kills": list(self.kills), "cfg": dict(self.cfg_doc),
+                "workdir": self.workdir}
+
+
+# -- shared fixtures ---------------------------------------------------------
+
+def _scale_net():
+    """y = x*w — the weight value IS the served step's fingerprint."""
+    from ..gluon.block import HybridBlock
+
+    class Scale(HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.w = self.params.get("w", shape=(1,), init="ones")
+
+        def hybrid_forward(self, F, x, w):
+            return x * w
+
+    net = Scale()
+    net.initialize()
+    return net
+
+
+def commit_scale(root, step, value):
+    """Commit one Scale checkpoint whose weight is ``value``."""
+    import numpy as np
+    from .. import nd
+    from ..resilience import commit
+    stage = commit.prepare_stage(root, step)
+    nd.save(os.path.join(stage, "net.params"),
+            {"w": nd.array(np.asarray([float(value)], np.float32))})
+    return commit.finalize(root, step)
+
+
+# -- pool: the flagship (3 replicas, closed-loop, full fault surface) --------
+
+class PoolRun(ScenarioRun):
+    def __init__(self, workdir):
+        super().__init__(workdir)
+        import numpy as np
+        from ..serving import (ParamStore, PoolConfig, ReplicaPool,
+                               Router, RouterConfig, Server, ServerConfig)
+        self._np = np
+        self.ckpt = os.path.join(self.workdir, "ckpt")
+        commit_scale(self.ckpt, 1, 3.0)
+        cfg = PoolConfig(heartbeat_s=0.1, deadline_s=0.6, monitor_s=0.15,
+                         spawn_s=3.0, max_respawns=8, drain_s=2.0)
+        self.cfg_doc = {"deadline_s": cfg.deadline_s,
+                        "monitor_s": cfg.monitor_s}
+        self.pool = ReplicaPool(os.path.join(self.workdir, "pool"), cfg)
+
+        def factory(_Server=Server, _SC=ServerConfig, _PS=ParamStore):
+            return _Server(_scale_net(),
+                           config=_SC(max_batch=4, window_ms=1.0,
+                                      reload_poll_s=0.1),
+                           param_store=_PS(self.ckpt))
+
+        for i in range(3):
+            self.pool.add_local(f"r{i}", factory)
+        self.router_cls = (Router, RouterConfig)
+        self.router = None
+        self.x = np.arange(4, dtype=np.float32)
+
+    def start(self):
+        Router, RouterConfig = self.router_cls
+        self.pool.start()
+        self.pool.monitor_start()
+        self.router = Router(self.pool, RouterConfig(
+            retries=3, breaker_k=2, breaker_cooldown_s=0.5))
+
+    def tick(self):
+        from ..serving import ServerOverloaded
+        from ..serving.batcher import RequestError
+        np, c = self._np, self.counters
+        try:
+            resp = self.router.call(self.x, deadline_ms=2000)
+        except ServerOverloaded:
+            c.bump("shed")
+            time.sleep(0.01)
+            return
+        except RequestError:
+            c.bump("degraded")
+            time.sleep(0.01)
+            return
+        except Exception as exc:
+            c.add("unexpected", repr(exc))
+            time.sleep(0.02)
+            return
+        v = np.asarray(resp.value)
+        if not np.allclose(v, self.x * 3.0, atol=1e-5):
+            c.add("corrupt", v.tolist())
+        c.bump("ok")
+        time.sleep(0.004)
+
+    def kill(self, target):
+        self.kills.append({"target": str(target), "t_kill": time.time(),
+                           "t_mono": time.monotonic()})
+        self.pool.replicas[str(target)].kill()
+
+    def stop(self):
+        if self.router is not None:
+            self.router.stop()
+        self.pool.stop()
+
+    def observations(self):
+        obs = super().observations()
+        obs["ckpt_root"] = self.ckpt
+        return obs
+
+
+register(Scenario(
+    "pool",
+    "3-replica health-routed pool under closed-loop load",
+    PoolRun,
+    targets={"replicas": ["r0", "r1", "r2"], "kill": True,
+             "latency_site": "router_attempt",
+             "partition_site": "router_attempt",
+             "hb_path_part": "hb/",
+             "classes": ("process", "durability", "latency", "resource")},
+    invariants=[("progress", {}), ("zero_corrupt", {}),
+                ("structured_only", {}), ("shed_rate", {"ceiling": 0.5}),
+                ("recovery_deadline", {"slack_s": 4.0}),
+                ("store_old_or_new", {}), ("no_litter", {}),
+                ("degrades_journaled", {})],
+    clients=3))
+
+
+# -- crash_matrix: the commit loop + old-or-new reader -----------------------
+
+class CrashMatrixRun(ScenarioRun):
+    def __init__(self, workdir):
+        super().__init__(workdir)
+        self.ckpt = os.path.join(self.workdir, "ckpt")
+        commit_scale(self.ckpt, 1, 1.0)
+        self.step = 1
+        self.reads: list = []
+        self.cfg_doc = {}
+        self._lock = threading.Lock()
+
+    def start(self):
+        pass
+
+    def tick(self):
+        import numpy as np
+        from .. import nd
+        from ..base import MXNetError
+        from ..resilience import commit
+        from ..testing.faults import SimulatedCrash
+        c = self.counters
+        with self._lock:
+            nxt = self.step + 1
+            try:
+                commit_scale(self.ckpt, nxt, float(nxt))
+                self.step = nxt
+                c.bump("ok")
+            except SimulatedCrash:
+                c.bump("degraded")       # the kill shape: litter is GC'd
+            except (OSError, ValueError, MXNetError):
+                c.bump("degraded")
+            except Exception as exc:
+                c.add("unexpected", repr(exc))
+            # the reader: newest restorable step must load bit-exact
+            try:
+                found = commit.find_restorable(self.ckpt)
+                if found is None:
+                    self.reads.append({"valid": False,
+                                       "error": "no restorable step"})
+                else:
+                    step = found[0]
+                    d = commit.step_dir(self.ckpt, step)
+                    w = nd.load(os.path.join(d, "net.params"))["w"]
+                    val = float(np.asarray(w.asnumpy()).reshape(-1)[0])
+                    self.reads.append({"step": step,
+                                       "valid": val == float(step)})
+            except Exception as exc:
+                self.reads.append({"valid": False, "error": repr(exc)})
+        time.sleep(0.002)
+
+    def stop(self):
+        from ..resilience import commit
+        from ..resilience.atomic import sweep_tmp
+        # the GC a recovering trainer runs: stale staging + tmp litter
+        commit.gc_steps(self.ckpt, keep_last=None)
+        for step in commit.committed_steps(self.ckpt):
+            sweep_tmp(commit.step_dir(self.ckpt, step))
+
+    def observations(self):
+        obs = super().observations()
+        obs["ckpt_root"] = self.ckpt
+        obs["reads"] = list(self.reads)
+        return obs
+
+
+register(Scenario(
+    "crash_matrix",
+    "checkpoint commit loop with a concurrent old-or-new reader",
+    CrashMatrixRun,
+    targets={"classes": ("durability", "resource"),
+             "crash_path_part": "ckpt"},
+    invariants=[("progress", {}), ("structured_only", {}),
+                ("reads_old_or_new", {}), ("store_old_or_new", {}),
+                ("degrades_journaled", {})],
+    clients=1))
+
+
+# -- fleet: tenant isolation under poison ------------------------------------
+
+class FleetRun(ScenarioRun):
+    def __init__(self, workdir):
+        super().__init__(workdir)
+        import numpy as np
+        from ..serving import Fleet, FleetConfig
+        self._np = np
+        root_a = os.path.join(self.workdir, "ckpt_a")
+        root_b = os.path.join(self.workdir, "ckpt_b")
+        commit_scale(root_a, 101, 5.0)
+        commit_scale(root_b, 201, 2.0)
+        # tenant factories build initialized BLOCKS; the fleet wraps
+        # them and hot-reloads each tenant from its own commit root
+        self.fleet = Fleet(FleetConfig(max_batch=4, window_ms=1.0,
+                                       reload_poll_s=0.05,
+                                       tenant_breaker_k=3,
+                                       tenant_cooldown_s=0.5))
+        self.fleet.add_tenant("A", factory=_scale_net, ckpt_root=root_a)
+        self.fleet.add_tenant("B", factory=_scale_net, ckpt_root=root_b)
+        self.x = np.ones(4, np.float32)
+        self.w_by_step = {"A": {101: 5.0}, "B": {201: 2.0}}
+        self.tenant_ok = {"A": 0, "B": 0}
+        self._flip = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        from ..serving.batcher import RequestError
+        np = self._np
+        self.fleet.start()
+        # warm-up OUTSIDE the judged window: each tenant must stamp its
+        # own committed step before responses are held to old-or-new
+        deadline = time.monotonic() + 15.0
+        for tenant, steps in self.w_by_step.items():
+            while time.monotonic() < deadline:
+                try:
+                    resp = self.fleet.submit(self.x, tenant=tenant,
+                                             deadline_ms=2000)
+                    np.asarray(resp.result(5.0))
+                except RequestError:
+                    time.sleep(0.02)
+                    continue
+                if resp.params_step in steps:
+                    break
+                time.sleep(0.02)
+
+    def tick(self):
+        from ..serving.batcher import RequestError
+        np, c = self._np, self.counters
+        with self._lock:
+            self._flip += 1
+            tenant = "A" if self._flip % 2 else "B"
+        try:
+            resp = self.fleet.submit(self.x, tenant=tenant,
+                                     deadline_ms=2000)
+            out = np.asarray(resp.result(10.0))
+        except RequestError:
+            c.bump("degraded")       # poison/quarantine: structured
+            time.sleep(0.01)
+            return
+        except Exception as exc:
+            c.add("unexpected", repr(exc))
+            time.sleep(0.02)
+            return
+        w = self.w_by_step[tenant].get(resp.params_step)
+        if w is None or not np.allclose(out, self.x * w, atol=1e-5):
+            c.add("corrupt", [tenant, resp.params_step, out.tolist()])
+        else:
+            with self._lock:
+                # keys are the fixed two-tenant roster, not open-ended
+                self.tenant_ok[tenant] += 1  # graftlint: disable=G14 bounded roster
+            c.bump("ok")
+        time.sleep(0.004)
+
+    def stop(self):
+        self.fleet.stop()
+
+    def observations(self):
+        obs = super().observations()
+        obs["tenant_ok"] = dict(self.tenant_ok)
+        return obs
+
+
+register(Scenario(
+    "fleet",
+    "two tenants on one fleet; poison on A must not touch B",
+    FleetRun,
+    targets={"poison_tenants": ["A"], "latency_site": "serving_tenant",
+             "latency_path_part": "A",
+             "classes": ("process", "latency", "resource")},
+    invariants=[("progress", {}), ("zero_corrupt", {}),
+                ("structured_only", {}), ("shed_rate", {"ceiling": 0.5}),
+                ("protected_tenant", {"tenant": "B"}),
+                ("no_litter", {}), ("degrades_journaled", {})],
+    clients=2))
+
+
+# -- deploy: canary a regressed step; parity gate must roll back -------------
+
+class DeployRun(ScenarioRun):
+    def __init__(self, workdir):
+        super().__init__(workdir)
+        import numpy as np
+        from ..serving import (DeployConfig, DeployController, ParamStore,
+                               PoolConfig, ReplicaPool, Router,
+                               RouterConfig, Server, ServerConfig)
+        from ..testing import faults as _faults
+        self._np = np
+        self.ckpt = os.path.join(self.workdir, "ckpt")
+        commit_scale(self.ckpt, 1, 3.0)
+        cfg = PoolConfig(heartbeat_s=0.1, deadline_s=0.6, monitor_s=0.15,
+                         drain_s=2.0)
+        self.cfg_doc = {"deadline_s": cfg.deadline_s,
+                        "monitor_s": cfg.monitor_s}
+        self.pool = ReplicaPool(os.path.join(self.workdir, "pool"), cfg)
+
+        def factory(_Server=Server, _SC=ServerConfig, _PS=ParamStore):
+            return _Server(_scale_net(),
+                           config=_SC(max_batch=4, window_ms=1.0,
+                                      reload_poll_s=-1.0),
+                           param_store=_PS(self.ckpt))
+
+        for i in range(3):
+            self.pool.add_local(f"r{i}", factory)
+        self._deploy_cls = (DeployConfig, DeployController)
+        self._router_cls = (Router, RouterConfig)
+        self._faults = _faults
+        self.router = None
+        self.w_by_step = {1: 3.0, 2: 30.0}
+        self.result: dict = {}
+        self._deploy_thread = None
+
+    def start(self):
+        Router, RouterConfig = self._router_cls
+        DeployConfig, DeployController = self._deploy_cls
+        self.pool.start()
+        self.router = Router(self.pool, RouterConfig(retries=3))
+        # the regression lands mid-flight, CRC-valid: only parity sees it
+        commit_scale(self.ckpt, 2, 3.0)
+        self._faults.regress_params(self.ckpt, 2, scale=10.0)
+        ctl = DeployController(self.pool, self.router, self.ckpt,
+                               DeployConfig(canary_k=1, window_s=0.3,
+                                            promote_after=3,
+                                            min_samples=5,
+                                            mirror_fraction=0.25,
+                                            mismatch_budget=0,
+                                            rollback_s=10.0,
+                                            deadline_s=45.0))
+
+        def _run():
+            try:
+                self.result.update(ctl.deploy(2))
+            except Exception as exc:
+                self.result["error"] = repr(exc)
+
+        self._deploy_thread = threading.Thread(target=_run, daemon=True)
+        self._deploy_thread.start()
+
+    def tick(self):
+        from ..serving import ServerOverloaded
+        from ..serving.batcher import RequestError
+        np, c = self._np, self.counters
+        x = np.arange(4, dtype=np.float32)
+        try:
+            resp = self.router.call(x, deadline_ms=4000)
+        except ServerOverloaded:
+            c.bump("shed")
+            time.sleep(0.01)
+            return
+        except RequestError:
+            c.bump("degraded")
+            time.sleep(0.01)
+            return
+        except Exception as exc:
+            c.add("unexpected", repr(exc))
+            time.sleep(0.02)
+            return
+        w = self.w_by_step.get(resp.params_step)
+        if w is None or not np.allclose(np.asarray(resp.value), x * w,
+                                        rtol=1e-4, atol=1e-5):
+            c.add("corrupt", [resp.params_step,
+                              np.asarray(resp.value).tolist()])
+        c.bump("ok")
+        time.sleep(0.003)
+
+    def stop(self):
+        if self._deploy_thread is not None:
+            self._deploy_thread.join(timeout=60.0)
+        if self.router is not None:
+            self.router.stop()
+        self.pool.stop()
+
+    def observations(self):
+        obs = super().observations()
+        obs["deploy"] = dict(self.result)
+        return obs
+
+
+register(Scenario(
+    "deploy",
+    "canary a CRC-valid regressed step; the parity gate rolls back",
+    DeployRun,
+    targets={"replicas": ["r0", "r1", "r2"], "kill": False,
+             "latency_site": "deploy_canary", "hb_path_part": "hb/",
+             "classes": ("durability", "latency", "resource")},
+    invariants=[("progress", {}), ("zero_corrupt", {}),
+                ("structured_only", {}),
+                ("canary_rolled_back", {}), ("no_litter", {}),
+                ("degrades_journaled", {})],
+    clients=2))
+
+
+# -- elastic: 2-member cohort, rank loss -> resized survivor -----------------
+
+class CohortRun(ScenarioRun):
+    def __init__(self, workdir):
+        super().__init__(workdir)
+        from .. import elastic
+        # barrier_s must be SHORT relative to the campaign window: a
+        # one-sided barrier-write failure parks the healthy peer until
+        # the barrier deadline, and a 10s park would eat the window
+        cfg = dict(heartbeat_s=0.1, deadline_s=0.6, barrier_s=2.0,
+                   poll_s=0.01)
+        self.cfg_doc = {"deadline_s": cfg["deadline_s"], "monitor_s": 0.0}
+        root = os.path.join(self.workdir, "cohort")
+        self.c0 = elastic.Cohort(root, 0, elastic.CohortConfig(**cfg))
+        self.c1 = elastic.Cohort(root, 1, elastic.CohortConfig(**cfg))
+        self._elastic = elastic
+        self.solo = False
+        self.dead = False
+        self.round = 0
+        self.resize: dict = {}
+        self._lock = threading.Lock()
+
+    def start(self):
+        self.c0.start()
+        self.c1.start()
+        t = threading.Thread(target=lambda: self.c1.form(2), daemon=True)
+        t.start()
+        self.c0.form(2)
+        t.join(timeout=30.0)
+
+    def tick(self):
+        elastic, c = self._elastic, self.counters
+        # the lock guards only the scenario's bookkeeping (round, solo,
+        # resize); the barriers/joins/sleeps run outside it — the
+        # single client and the conductor's kill lever must never queue
+        # behind a blocked barrier
+        with self._lock:
+            self.round += 1
+            tag = f"chaos-{self.round}"
+            solo, dead = self.solo, self.dead
+        if solo:
+            try:
+                self.c0.barrier(tag)
+                c.bump("ok")
+            except (OSError, elastic.BarrierTimeout):
+                c.bump("degraded")         # injected barrier-write I/O
+            except Exception as exc:
+                c.add("unexpected", repr(exc))
+            time.sleep(0.01)
+            return
+        t = None
+        if not dead:
+            # a killed rank's process is GONE: it must stop
+            # dropping barrier files or the loss is undetectable
+            t = threading.Thread(
+                target=lambda: self._quiet_barrier(self.c1, tag),
+                daemon=True)
+            t.start()
+        try:
+            self.c0.barrier(tag)
+            c.bump("ok")
+        except elastic.RankLost as e:
+            detect_s = (time.monotonic() - self.kills[-1]["t_mono"]
+                        if self.kills else None)
+            try:
+                members = self.c0.resize(e.lost)
+            except OSError:
+                # injected I/O failure mid-epoch-publish: the next
+                # barrier raises RankLost again and resize retries
+                c.bump("degraded")
+            else:
+                with self._lock:
+                    self.resize = {"lost": list(e.lost),
+                                   "members": list(members),
+                                   "detect_s": detect_s}
+                    self.solo = True
+                c.bump("degraded")
+        except (OSError, elastic.BarrierTimeout):
+            # injected barrier-write I/O, or the round expired because
+            # a peer's (faulted) barrier file never landed — both are
+            # structured degrades; the next round starts a fresh tag
+            c.bump("degraded")
+        except Exception as exc:
+            c.add("unexpected", repr(exc))
+        if t is not None:
+            t.join(timeout=4.0)
+        time.sleep(0.01)
+
+    @staticmethod
+    def _quiet_barrier(cohort, tag):
+        try:
+            cohort.barrier(tag)
+        except Exception:
+            pass                 # the doomed rank's view is not the story
+
+    def kill(self, target):
+        # host-vanished for rank 1: heartbeat stalls without resigning,
+        # and the rank stops answering barriers (tick checks .dead)
+        self.kills.append({"target": str(target), "t_kill": time.time(),
+                           "t_mono": time.monotonic()})
+        self.dead = True
+        self.c1._hb.stop(resign=False)
+
+    def stop(self):
+        self.c0.stop()
+        try:
+            self.c1.stop()
+        except Exception:
+            pass
+
+    def observations(self):
+        obs = super().observations()
+        obs["resize"] = dict(self.resize)
+        return obs
+
+
+register(Scenario(
+    "elastic",
+    "2-member cohort loses a rank; survivor resizes and continues",
+    CohortRun,
+    targets={"replicas": ["1"], "kill": True, "hb_path_part": "hb",
+             "classes": ("process", "durability", "resource")},
+    invariants=[("progress", {}), ("structured_only", {}),
+                ("cohort_resized", {}), ("degrades_journaled", {})],
+    clients=1))

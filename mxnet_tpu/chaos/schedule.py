@@ -1,0 +1,216 @@
+"""Seeded fault-schedule generation — the campaign's randomness, bottled.
+
+A schedule is a plain JSON list of fault *specs*.  Every spec carries
+its catalog ``kind``, its fault ``cls`` (one of :data:`FAULT_CLASSES`),
+a fire offset ``at_s`` inside the load window, and kind-specific args.
+:func:`generate` draws one from ``random.Random(seed)`` against a
+scenario's declared targets — same seed + same targets → byte-identical
+schedule, which is what makes a ``CHAOS_rNN.json`` artifact a
+*reproducer* instead of a war story.  :func:`build` turns specs back
+into live :mod:`mxnet_tpu.testing.faults` rules plus timed conductor
+actions (process kills, budget heals); replay and delta-debugging
+shrink both go through it, so a shrunk sub-schedule executes exactly
+like the slice of the original it came from.
+"""
+from __future__ import annotations
+
+import random
+
+from ..testing import faults
+
+__all__ = ["FAULT_CLASSES", "build", "describe", "generate"]
+
+# one fault per class is the composition floor the conductor aims for:
+# a kill, a torn/errored durable write, injected latency, and resource
+# exhaustion — the production composition single-fault drills never see
+FAULT_CLASSES = ("process", "durability", "latency", "resource")
+
+# catalog kind -> fault class (generation + coverage accounting).
+# tenant_poison is the fleet's process-fault analog: a sick predictor
+# in a pool the scenario cannot SIGKILL ranks of.
+CATALOG = {
+    "kill": "process",
+    "tenant_poison": "process",
+    "io_error": "durability",
+    "torn_heartbeat": "durability",
+    "crash": "durability",
+    "slow_call": "latency",
+    "partition": "latency",
+    "disk_full": "resource",
+    "disk_budget": "resource",
+    "fd_exhaust": "resource",
+}
+
+
+def _gen_spec(rng, kind, targets, window_s):
+    """One catalog draw against the scenario's declared targets."""
+    at_s = round(rng.uniform(0.15, 0.6) * window_s, 3)
+    spec = {"kind": kind, "cls": CATALOG[kind], "at_s": at_s}
+    replicas = list(targets.get("replicas") or ())
+    if kind == "kill":
+        spec["target"] = rng.choice(replicas)
+    elif kind == "tenant_poison":
+        spec["tenant"] = rng.choice(list(targets["poison_tenants"]))
+        spec["times"] = rng.randint(4, 8)
+    elif kind == "io_error":
+        spec["point"] = rng.choice(("fsync", "replace"))
+        spec["times"] = rng.randint(1, 2)
+    elif kind == "torn_heartbeat":
+        spec["path_part"] = targets.get("hb_path_part", "hb/")
+        spec["times"] = 1
+    elif kind == "crash":
+        spec["point"] = rng.choice(("write", "fsync", "replace"))
+        spec["path_part"] = targets.get("crash_path_part")
+        spec["times"] = 1
+    elif kind == "slow_call":
+        spec["site"] = targets.get("latency_site", "serving_predict")
+        spec["delay_s"] = round(rng.uniform(0.05, 0.2), 3)
+        spec["path_part"] = targets.get("latency_path_part")
+        spec["times"] = rng.randint(2, 5)
+    elif kind == "partition":
+        spec["site"] = targets.get("partition_site", "wire_send")
+        spec["peer"] = rng.choice(replicas) if replicas else None
+        spec["stall_s"] = round(rng.uniform(0.3, 0.8), 3)
+        spec["times"] = 1
+    elif kind == "disk_full":
+        spec["point"] = rng.choice(("write", "fsync", "replace"))
+        spec["path_part"] = targets.get("disk_path_part")
+        spec["times"] = rng.randint(1, 2)
+    elif kind == "disk_budget":
+        spec["free_bytes"] = rng.randrange(512, 8192)
+        spec["heal_after_s"] = round(rng.uniform(0.3, 0.6) * window_s, 3)
+    elif kind == "fd_exhaust":
+        spec["site"] = rng.choice(
+            tuple(targets.get("fd_sites") or ("open",)))
+        spec["times"] = rng.randint(1, 3)
+    return spec
+
+
+def generate(seed, targets, n_faults=4, classes=None,
+             window_s=8.0) -> list:
+    """Draw ``n_faults`` specs from the catalog, deterministically from
+    ``seed``.  The first draws cover ``classes`` (default: every class
+    the scenario supports, in :data:`FAULT_CLASSES` order — the ≥4-class
+    composition floor); the rest are free draws.  Only kinds the
+    scenario declared targets for are eligible."""
+    rng = random.Random(int(seed))
+    supported = set(targets.get("classes") or FAULT_CLASSES)
+    kinds = [k for k, c in sorted(CATALOG.items())
+             if c in supported and _eligible(k, targets)]
+    if not kinds:
+        raise ValueError("scenario declares no usable fault targets")
+    want = [c for c in (classes or FAULT_CLASSES) if c in supported]
+    specs = []
+    for cls in want[:int(n_faults)]:
+        pool = [k for k in kinds if CATALOG[k] == cls]
+        if pool:
+            specs.append(_gen_spec(rng, rng.choice(pool), targets,
+                                   window_s))
+    while len(specs) < int(n_faults):
+        specs.append(_gen_spec(rng, rng.choice(kinds), targets,
+                               window_s))
+    return specs
+
+
+def _eligible(kind, targets):
+    if kind == "kill":
+        return bool(targets.get("replicas")) and targets.get("kill", True)
+    if kind == "tenant_poison":
+        return bool(targets.get("poison_tenants"))
+    if kind == "partition":
+        return bool(targets.get("partition_site"))
+    if kind == "slow_call":
+        return bool(targets.get("latency_site"))
+    if kind == "crash":
+        return bool(targets.get("crash_path_part"))
+    return True
+
+
+class BuiltSchedule:
+    """A schedule lowered to executables.
+
+    ``rules`` is ``[(at_s, label, FaultRule)]`` — each rule is ARMED at
+    its ``at_s`` on the campaign clock (the conductor appends it to the
+    live, initially-empty :class:`~mxnet_tpu.testing.faults.FaultPlan`),
+    so a fault drawn "at 4.6s" really does land mid-run instead of
+    tripping the scenario's warm-up.  ``timed`` is the remaining action
+    list ``[(at_s, label, callable)]``: process kills and disk-budget
+    heals.  Order on both is index-aligned with the non-kill /
+    kill-spec slices of the input, so firing counts can be attributed
+    back to specs."""
+
+    def __init__(self, rules, timed):
+        self.rules = rules
+        self.timed = sorted(timed, key=lambda t: t[0])
+
+
+def _lower_rule(spec):
+    kind = spec["kind"]
+    if kind == "tenant_poison":
+        return faults.tenant_poison(spec["tenant"],
+                                    times=spec.get("times"))
+    if kind == "io_error":
+        return faults.io_error(spec["point"],
+                               times=spec.get("times", 1))
+    if kind == "torn_heartbeat":
+        return faults.torn_heartbeat(
+            path_part=spec.get("path_part", "hb/"),
+            times=spec.get("times", 1))
+    if kind == "crash":
+        return faults.crash(spec["point"],
+                            path_part=spec.get("path_part"),
+                            times=spec.get("times", 1))
+    if kind == "slow_call":
+        return faults.slow_call(spec["site"], spec["delay_s"],
+                                path_part=spec.get("path_part"),
+                                times=spec.get("times"))
+    if kind == "partition":
+        return faults.partition(peer=spec.get("peer"),
+                                stall_s=spec["stall_s"],
+                                site=spec["site"],
+                                times=spec.get("times", 1))
+    if kind == "disk_full":
+        return faults.disk_full(spec["point"],
+                                path_part=spec.get("path_part"),
+                                times=spec.get("times", 1))
+    if kind == "disk_budget":
+        return faults.disk_budget(spec["free_bytes"])
+    if kind == "fd_exhaust":
+        return faults.fd_exhaust(spec["site"],
+                                 path_part=spec.get("path_part"),
+                                 times=spec.get("times", 1))
+    raise ValueError(f"unknown fault kind {kind!r}")
+
+
+def build(specs, kill=None) -> BuiltSchedule:
+    """Lower specs to armed-at rules + timed actions.  ``kill`` is the
+    scenario's process-kill lever (``kill(target)``); required only
+    when the schedule contains a ``kill`` spec."""
+    rules, timed = [], []
+    for spec in specs:
+        kind = spec["kind"]
+        at_s = float(spec.get("at_s", 0.0))
+        if kind == "kill":
+            if kill is None:
+                raise ValueError("schedule has a kill but the scenario "
+                                 "offers no kill lever")
+            target = spec["target"]
+            timed.append((at_s, f"kill:{target}",
+                          lambda t=target: kill(t)))
+            continue
+        rule = _lower_rule(spec)
+        rules.append((at_s, f"arm:{kind}", rule))
+        if kind == "disk_budget":
+            heal = spec.get("heal_after_s")
+            if heal is not None:
+                timed.append((float(heal), "heal:disk_budget",
+                              lambda r=rule: r.budget.heal(1 << 40)))
+    return BuiltSchedule(rules, timed)
+
+
+def describe(spec) -> str:
+    """One human line per spec (artifact summaries, doctor --chaos)."""
+    extra = {k: v for k, v in spec.items()
+             if k not in ("kind", "cls", "at_s")}
+    inner = ", ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+    return f"{spec['kind']}[{spec['cls']}] @{spec['at_s']}s ({inner})"

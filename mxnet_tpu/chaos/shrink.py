@@ -1,0 +1,57 @@
+"""Schedule shrinking — delta-debugging a failing fault schedule.
+
+``ddmin`` (Zeller's minimizing delta debugging) over the spec list:
+split into ``n`` chunks, try each chunk alone, then each complement;
+recurse on whichever still fails with finer granularity until no
+smaller subset reproduces.  The test predicate is "same-seed replay of
+this subset still violates (one of) the original failed invariants" —
+the conductor supplies it as a closure over :func:`chaos.conductor
+.execute` with a fresh workdir per probe.
+
+The result is 1-minimal: removing ANY single remaining fault makes the
+failure disappear.  That is what turns a 6-fault war story into a
+"kill r1 + disk_full at replace" reproducer a human can actually debug.
+"""
+from __future__ import annotations
+
+__all__ = ["ddmin"]
+
+
+def ddmin(items, still_fails, max_probes=64) -> list:
+    """Minimize ``items`` (a list) under ``still_fails(subset) -> bool``.
+
+    ``still_fails`` must be True for the full list (the caller only
+    shrinks schedules that already failed); probes are capped by
+    ``max_probes`` — on budget exhaustion the smallest failing subset
+    found so far is returned (still a valid reproducer, maybe not
+    1-minimal)."""
+    current = list(items)
+    n = 2
+    probes = 0
+    while len(current) >= 2 and probes < int(max_probes):
+        chunk = max(1, len(current) // n)
+        subsets = [current[i:i + chunk]
+                   for i in range(0, len(current), chunk)]
+        reduced = False
+        # each chunk alone, then each complement
+        candidates = list(subsets)
+        if len(subsets) > 2:
+            candidates += [[x for s in subsets[:i] + subsets[i + 1:]
+                            for x in s]
+                           for i in range(len(subsets))]
+        for cand in candidates:
+            if not cand or len(cand) >= len(current):
+                continue
+            probes += 1
+            if still_fails(cand):
+                current = cand
+                n = max(n - 1, 2)
+                reduced = True
+                break
+            if probes >= int(max_probes):
+                break
+        if not reduced:
+            if n >= len(current):
+                break
+            n = min(n * 2, len(current))
+    return current

@@ -277,6 +277,16 @@ def _tuned_report(path) -> dict:
     return table.audit_table(path)
 
 
+def _chaos_report(dirpath) -> dict:
+    from ..chaos import report
+    return report.chaos_report(dirpath)
+
+
+def _summ_chaos(cr) -> str:
+    from ..chaos import report
+    return report.summarize(cr)
+
+
 def _summ_tuned(tt) -> str:
     knobs = tt.get("knobs") or {}
     env = tt.get("envelope") or {}
@@ -339,6 +349,12 @@ _REPORT_TABLE = (
      "stdlib-only, nothing is applied and no backend is dialed "
      "(docs/autotune.md)",
      _tuned_report, _summ_tuned),
+    ("chaos", "--chaos", "MXNET_TPU_CHAOS_DIR", "DIR",
+     "directory of chaos-campaign artifacts (CHAOS_rNN.json from "
+     "python -m mxnet_tpu.chaos run): summarize campaigns, failed "
+     "invariants, and shrunk reproducers — stdlib-only, nothing is "
+     "executed (docs/chaos.md)",
+     _chaos_report, _summ_chaos),
 )
 
 

@@ -92,6 +92,11 @@ class Journal:
         except ValueError:
             cap = RECENT_CAP_DEFAULT
         self._recent: deque = deque(maxlen=max(cap, 1))
+        # sink-write degrade accounting (full/unwritable disk, closed
+        # capture stream): drop-and-count, never raise into the caller
+        self.write_drops = 0
+        self._drops_uncounted = 0
+        self._drop_noted = False
 
     # -- core record writer --------------------------------------------------
     def event(self, kind: str, _heartbeat: bool = False, **fields) -> dict:
@@ -123,10 +128,42 @@ class Journal:
                 fh.write(line + "\n")
                 fh.flush()
             except (ValueError, OSError):
-                pass              # a closed capture stream must never crash us
+                # full disk / closed capture stream: the hot path must
+                # never pay for telemetry — drop the line and count it
+                self._note_write_drop()
             if not _heartbeat:
                 self.last_activity = time.monotonic()
         return rec
+
+    def _note_write_drop(self) -> None:
+        """One sink write failed (caller holds the lock). The record
+        stays in the recent ring — only the durable line is lost — so
+        the count goes to ``mxnet_tpu_journal_write_drops_total`` (when
+        the metrics registry is already loaded; this module must not
+        import it into a wedged process) plus ONE stderr note per sink.
+        """
+        self.write_drops += 1
+        self._drops_uncounted += 1
+        mod = sys.modules.get("mxnet_tpu.observability.metrics")
+        if mod is not None:
+            try:
+                mod.default_registry().counter(
+                    "mxnet_tpu_journal_write_drops_total",
+                    "journal records dropped because the sink write "
+                    "failed (full/unwritable disk or closed stream)",
+                ).inc(self._drops_uncounted)
+                self._drops_uncounted = 0
+            except Exception:
+                pass             # accounting must never crash the journal
+        if not self._drop_noted:
+            self._drop_noted = True
+            try:
+                sys.stderr.write(
+                    f"mxnet_tpu: journal sink {self.path!r} unwritable; "
+                    "dropping records (see "
+                    "mxnet_tpu_journal_write_drops_total)\n")
+            except (ValueError, OSError):
+                pass             # stderr itself may be the dead sink
 
     def recent(self) -> list:
         """Snapshot of the bounded recent-records ring (oldest first) —

@@ -104,6 +104,7 @@ class FlightRecorder:
         self._on_stall = lambda: self.dump("stall")
         self._on_final = lambda: self.dump("final")
         self.dumps = 0
+        self.drops = 0
 
     @property
     def path(self) -> str:
@@ -150,10 +151,30 @@ class FlightRecorder:
             os.makedirs(self.out_dir, exist_ok=True)
             with atomic_write(self.path, "w", durable=False) as f:
                 json.dump(doc, f, default=str)
-        except (OSError, ValueError):
+        except (OSError, ValueError) as exc:
+            self._note_drop(exc)
             return None
         self.dumps += 1
         return self.path
+
+    def _note_drop(self, exc) -> None:
+        """A dump write failed (full/unwritable run dir): degrade to
+        drop-and-count — bump the drops metric, journal ONE marker per
+        recorder (the journal itself degrades under the same disk), and
+        keep flying.  The previous complete dump stays on disk."""
+        self.drops += 1
+        try:
+            from .metrics import default_registry
+            default_registry().counter(
+                "mxnet_tpu_flight_dump_drops_total",
+                "flight-recorder dumps dropped because the run-dir "
+                "write failed (full/unwritable disk)").inc()
+        except Exception:
+            pass                 # accounting must never ground the recorder
+        if self.drops == 1:
+            self._journal.event("flight_dump_failed", path=self.path,
+                                error=type(exc).__name__,
+                                detail=str(exc)[:200])
 
     # -- lifecycle -------------------------------------------------------
     def install(self) -> "FlightRecorder":

@@ -31,7 +31,7 @@ import itertools
 import os
 
 from ..diagnostics.journal import get_journal
-from .retry import retry_call
+from .retry import is_disk_full, note_disk_full, retry_call
 
 __all__ = ["atomic_write", "fsync_dir", "set_fault_hook", "sweep_tmp",
            "trip"]
@@ -128,9 +128,16 @@ def atomic_write(path, mode: str = "wb", encoding: str | None = None,
     rename never crosses a filesystem boundary."""
     path = os.fspath(path)
     tmp = f"{path}{_TMP_MARK}{os.getpid()}.{next(_tmp_seq)}"
-    trip("open", tmp)
     kwargs = {} if "b" in mode else {"encoding": encoding or "utf-8"}
-    f = open(tmp, mode, **kwargs)
+    try:
+        trip("open", tmp)
+        f = open(tmp, mode, **kwargs)
+    except Exception as exc:
+        # nothing staged yet — but an exhausted disk discovered at open
+        # still deserves its (deduped) degrade record
+        if is_disk_full(exc):
+            note_disk_full(path, op="atomic_write")
+        raise
 
     def _do_fsync():
         trip("fsync", tmp)
@@ -154,12 +161,17 @@ def atomic_write(path, mode: str = "wb", encoding: str | None = None,
         trip("after_replace", path)
         if durable:
             fsync_dir(path)
-    except Exception:
+    except Exception as exc:
         # recoverable failure: don't litter. A BaseException (simulated
         # or real crash) skips this, leaving the torn tmp like a dead
-        # process would.
+        # process would. On a full disk the unlink comes FIRST — it is
+        # the one action that frees space — then the deduped degrade
+        # record (retry_call already noted fsync/replace exhaustion;
+        # the dedup set keeps this to one record per target path).
         with contextlib.suppress(OSError):
             os.unlink(tmp)
+        if is_disk_full(exc):
+            note_disk_full(path, op="atomic_write")
         raise
 
 

@@ -17,19 +17,63 @@ Contract:
 - Only exceptions in ``retry_on`` are retried; everything else —
   including BaseException crash stand-ins from the fault-injection
   harness — propagates immediately.
+- Resource exhaustion is NOT transient: ENOSPC/EDQUOT fail fast on the
+  first attempt (freeing space is the remedy, retrying only burns the
+  budget and delays the cleanup that frees the staged temp), with one
+  deduped ``disk_full`` journal record per path.
 
 Stdlib-only (no jax): importable from the same wedge-proof contexts as
 ``diagnostics.journal``.
 """
 from __future__ import annotations
 
+import errno
 import os
 import random
+import threading
 import time
 
 from ..diagnostics.journal import get_journal
 
-__all__ = ["backoff_delays", "retry_call"]
+__all__ = ["backoff_delays", "is_disk_full", "note_disk_full",
+           "reset_disk_full_notes", "retry_call"]
+
+# exhaustion errnos no retry budget can fix
+_FAIL_FAST_ERRNOS = frozenset(
+    e for e in (errno.ENOSPC, getattr(errno, "EDQUOT", None))
+    if e is not None)
+
+# paths whose disk_full record already landed (dedup: a full disk makes
+# EVERY writer fail — one structured record per path tells the story,
+# a thousand would bury it and feed the very disk that is full)
+_noted_lock = threading.Lock()
+_noted_paths: set = set()
+
+
+def is_disk_full(exc) -> bool:
+    """True for the exhaustion errnos (ENOSPC/EDQUOT) that must fail
+    fast instead of riding the transient-retry path."""
+    return isinstance(exc, OSError) and exc.errno in _FAIL_FAST_ERRNOS
+
+
+def note_disk_full(path, op: str) -> bool:
+    """Journal one structured ``disk_full`` record for ``path`` (deduped
+    process-wide: repeats on the same path are dropped). Returns whether
+    a record was written — callers use it to avoid double-logging."""
+    key = str(path)
+    with _noted_lock:
+        if key in _noted_paths:
+            return False
+        _noted_paths.add(key)
+    get_journal().event("disk_full", path=key, op=str(op))
+    return True
+
+
+def reset_disk_full_notes() -> None:
+    """Forget the dedup set (tests / a driver that verified space was
+    actually freed and wants the next exhaustion journaled afresh)."""
+    with _noted_lock:
+        _noted_paths.clear()
 
 
 def _env_int(name: str, default: int) -> int:
@@ -82,6 +126,13 @@ def retry_call(fn, *args, retries: int | None = None,
         try:
             return fn(*args, **kwargs)
         except retry_on as exc:
+            if is_disk_full(exc):
+                # exhaustion, not a transient: retrying burns the whole
+                # budget against a full disk and delays the temp-file
+                # cleanup that actually frees space
+                note_disk_full(getattr(exc, "filename", None) or what,
+                               op=what)
+                raise
             if delay is None:
                 raise
             get_journal().event(

@@ -44,6 +44,7 @@ import numpy as np
 from ..base import MXNetError
 from ..diagnostics.journal import get_journal
 from ..elastic.membership import Heartbeat, LivenessReader
+from ..resilience import atomic as _atomic
 from .batcher import (DeadlineExceeded, RequestError, ServerOverloaded,
                       ServerStopped, SlotsExhausted)
 from . import wire
@@ -270,12 +271,36 @@ class LocalReplica:
             self.server.pin_params(self._pin)
         self.server.start()
         self._draining = False
+        # a replica whose beacon daemon died with it (the chaos
+        # conductor's in-process kill stops the heartbeat thread without
+        # resigning, the host-vanished shape) must come back BEATING, or
+        # the monitor re-detects it as lost every deadline and burns the
+        # crash-loop budget on a healthy server; start() is a no-op when
+        # the daemon is still running and beats once either way
+        self._hb.start()
         self._hb.beat()
 
     def stop(self):
         if self.server is not None:
             self.server.stop(timeout_s=30.0)
         self._hb.stop(resign=True)
+
+    def kill(self):
+        """In-process stand-in for the host-vanished shape (the chaos
+        conductor's process-kill on a local pool): the beacon daemon
+        stops WITHOUT resigning — the seq file goes stale exactly as a
+        SIGKILLed worker's would — and the server handle is torn away so
+        dispatches fail structured (``ReplicaUnavailable``).  The pool
+        monitor must detect, journal ``replica_lost`` and restart it
+        with zero cooperation from this handle.  The orphaned server
+        winds down on a background thread: a kill must not block the
+        killer, and in-flight requests fail over like the process died."""
+        self._hb.stop(resign=False)
+        srv, self.server = self.server, None
+        if srv is not None:
+            threading.Thread(target=lambda: srv.stop(timeout_s=5.0),
+                             daemon=True,
+                             name=f"mxtpu-kill-{self.id}").start()
 
     def pid(self):
         return os.getpid()
@@ -323,10 +348,15 @@ class ProcReplica:
         if port is None:
             raise ReplicaUnavailable(self.id, "no port in beacon yet")
         try:
+            # chaos seams (docs/chaos.md): ``wire_connect`` is the
+            # fd_exhaust socket-open site, ``wire_send`` the partition
+            # site — both carry the replica id so a plan targets one peer
+            _atomic.trip("wire_connect", self.id)
             with socket.create_connection(
                     ("127.0.0.1", int(port)),
                     timeout=min(budget_s, 5.0)) as s:
                 s.settimeout(budget_s + 5.0)
+                _atomic.trip("wire_send", self.id)
                 wire.send_frame(s, header, payload)
                 return wire.recv_frame(s)
         except (OSError, wire.WireError) as e:

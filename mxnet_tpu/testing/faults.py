@@ -32,18 +32,21 @@ a reader always sees the old or the new checkpoint, fully intact.
 from __future__ import annotations
 
 import contextlib
+import errno
 import os
 import signal
+import threading
 import time
 
 from ..resilience import atomic
 
-__all__ = ["CRASH_POINTS", "FaultError", "FaultPlan", "FaultRule",
-           "PoisonError", "PoisonSchedule", "SimulatedCrash",
-           "corrupt_params", "crash", "inject", "io_error",
-           "poison_batch", "poison_grads", "regress_params", "sigkill",
-           "sigterm", "slow_call", "slow_canary", "tenant_poison",
-           "torn_heartbeat", "write_offsets"]
+__all__ = ["CRASH_POINTS", "DiskBudget", "DiskFullError", "FaultError",
+           "FaultPlan", "FaultRule", "FdExhaustError", "PoisonError",
+           "PoisonSchedule", "SimulatedCrash", "corrupt_params", "crash",
+           "disk_budget", "disk_full", "fd_exhaust", "inject", "io_error",
+           "partition", "poison_batch", "poison_grads", "regress_params",
+           "sigkill", "sigterm", "slow_call", "slow_canary",
+           "tenant_poison", "torn_heartbeat", "write_offsets"]
 
 # every phase of one atomic file write, in order — plus the commit
 # protocol's own points (publish = the step-dir rename commit point)
@@ -69,6 +72,28 @@ class FaultError(OSError):
 
     def __init__(self, point, path):
         super().__init__(5, f"injected I/O error at {point}", path)
+        self.point = point
+
+
+class DiskFullError(OSError):
+    """Injected ENOSPC: the resource-exhaustion shape retries cannot
+    fix — ``resilience.retry`` classifies it fail-fast (freeing space
+    is the remedy, not patience)."""
+
+    def __init__(self, point, path):
+        super().__init__(errno.ENOSPC,
+                         f"injected disk full at {point}", path)
+        self.point = point
+
+
+class FdExhaustError(OSError):
+    """Injected EMFILE at a descriptor-allocating site (file open,
+    socket connect): the fd-starvation shape a leaked-handle bug
+    produces in production."""
+
+    def __init__(self, point, path):
+        super().__init__(errno.EMFILE,
+                         f"injected fd exhaustion at {point}", path)
         self.point = point
 
 
@@ -259,6 +284,104 @@ def torn_heartbeat(path_part="hb/", keep_bytes=7, times=1) -> FaultRule:
                 pass             # no temp staged: nothing to tear
     return FaultRule("replace", None, path_part=path_part, times=times,
                      action=_tear)
+
+
+# -- resource exhaustion (the chaos conductor's new family) -----------------
+
+def disk_full(point="write", path_part=None, after_bytes=None,
+              times=None) -> FaultRule:
+    """ENOSPC at one durable-write trip point (``write`` fires on the
+    chunk that would carry the file past ``after_bytes``; ``fsync`` /
+    ``replace`` model a filesystem that only discovers exhaustion at
+    the flush/rename edge).  Unlike :func:`io_error`'s EIO, the retry
+    layer must NOT absorb this — it fails fast, cleans the staged temp,
+    and journals one deduped ``disk_full`` record per path."""
+    return FaultRule(point, lambda p, f, n: DiskFullError(p, f),
+                     path_part=path_part, after_bytes=after_bytes,
+                     times=times)
+
+
+class DiskBudget:
+    """One shrinking free-space budget shared by EVERY durable writer —
+    the budget-mode ``disk_full``.  Each staged ``write`` draws its byte
+    count down; once the budget is exhausted, all matched write phases
+    raise ENOSPC until :meth:`heal` refills it.  This is the composed
+    production shape (journals, flight dumps, checkpoint commits, AOT
+    store, tuned tables all competing for the same full disk), which
+    single-point injection cannot reproduce."""
+
+    def __init__(self, free_bytes):
+        self.free = int(free_bytes)
+        self._lock = threading.Lock()
+
+    def draw(self, size) -> bool:
+        """Charge ``size`` staged bytes; True once the budget is gone."""
+        with self._lock:
+            self.free -= int(size or 0)
+            return self.free < 0
+
+    def exhausted(self) -> bool:
+        with self._lock:
+            return self.free < 0
+
+    def heal(self, free_bytes) -> None:
+        """Refill (space was freed): writers succeed again."""
+        with self._lock:
+            self.free = int(free_bytes)
+
+
+class _BudgetRule(FaultRule):
+    """Budget-mode rule: matches any durable-write phase once the shared
+    :class:`DiskBudget` runs dry (``write`` phases charge it first)."""
+
+    _POINTS = ("open", "write", "fsync", "replace")
+
+    def __init__(self, budget, path_part=None):
+        super().__init__(None, lambda p, f, n: DiskFullError(p, f),
+                         path_part=path_part)
+        self.budget = budget
+
+    def matches(self, point, path, nbytes, size):
+        if point not in self._POINTS:
+            return False
+        if self.path_part is not None and self.path_part not in (path or ""):
+            return False
+        if point == "write":
+            return self.budget.draw(size)
+        return self.budget.exhausted()
+
+
+def disk_budget(free_bytes, path_part=None) -> _BudgetRule:
+    """Budget-mode disk_full: one rule whose shared :class:`DiskBudget`
+    (exposed as ``rule.budget``) every durable writer draws down —
+    whichever writer lands the exhausting byte trips, and every later
+    durable phase keeps tripping until ``rule.budget.heal(n)``."""
+    return _BudgetRule(DiskBudget(free_bytes), path_part=path_part)
+
+
+def fd_exhaust(site="open", path_part=None, times=None) -> FaultRule:
+    """EMFILE at a descriptor-allocating trip site: the atomic-write
+    ``open`` point, or the pool client's ``wire_connect`` socket-open
+    site (its path carries the replica id).  Consumers must surface a
+    structured degrade — never hang or corrupt — because no retry
+    budget can conjure descriptors back."""
+    return FaultRule(site, lambda p, f, n: FdExhaustError(p, f),
+                     path_part=path_part, times=times)
+
+
+def partition(peer=None, stall_s=1.0, site="wire_send",
+              times=1) -> FaultRule:
+    """Wire-level partition: frames to the matched ``peer`` stall
+    ``stall_s`` — past the socket timeout the caller budgeted — then
+    the link heals (``times`` bounds the partition window).  ``site``
+    is ``wire_send`` (ProcReplica's frame-send seam, path = replica id)
+    by default; an in-process pool partitions at ``router_attempt``
+    instead.  The router must see a bounded structured timeout and
+    reroute, exactly as for a dead peer — except this peer comes back."""
+    return FaultRule(site, None,
+                     path_part=None if peer is None else str(peer),
+                     times=times,
+                     action=lambda p, f, n: time.sleep(float(stall_s)))
 
 
 class FaultPlan:

@@ -1,0 +1,74 @@
+# graftlint: scope=library
+"""G26 fixture: swallowed durable-write error — a broad except around
+a commit-point call chain whose handler neither re-raises nor
+journals.  Lines marked BAD must be flagged; GOOD lines must not.
+The disable-twin documents the suppression syntax."""
+import json
+import os
+
+from mxnet_tpu.diagnostics.journal import get_journal
+from mxnet_tpu.resilience.atomic import atomic_write
+
+
+def _stage_then_replace(path, doc):
+    tmp = path + ".tmp.1"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)       # the commit point, one helper deep
+
+
+def bad_bare_pass(path, doc):
+    try:
+        with atomic_write(path, "w") as f:
+            json.dump(doc, f)
+    except Exception:  # expect: G26
+        pass
+
+
+def bad_helper_chain(path, doc):
+    try:
+        _stage_then_replace(path, doc)
+    except:  # expect: G26
+        return None
+    return path
+
+
+def good_typed_handler(path, doc):
+    try:
+        _stage_then_replace(path, doc)
+    except OSError:        # GOOD: typed — the visible failure contract
+        return None
+    return path
+
+
+def good_journaled(path, doc):
+    try:
+        _stage_then_replace(path, doc)
+    except Exception as exc:    # GOOD: the failure is journaled
+        get_journal().event("write_failed", path=path, error=repr(exc))
+        return None
+    return path
+
+
+def good_reraise(path, doc):
+    try:
+        _stage_then_replace(path, doc)
+    except Exception as exc:    # GOOD: annotate-and-reraise
+        doc["error"] = repr(exc)
+        raise
+
+
+def good_no_durable_write(path):
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except Exception:     # GOOD: a read path — no commit point inside
+        return None
+
+
+def disable_twin(path, doc):
+    try:
+        _stage_then_replace(path, doc)
+    except Exception:  # graftlint: disable=G26 caller checks the returned marker
+        return None
+    return path

@@ -1,0 +1,389 @@
+"""The chaos campaign engine (mxnet_tpu/chaos/; docs/chaos.md): seeded
+fault-schedule generation, the conductor's execute/judge/shrink loop,
+``CHAOS_rNN.json`` artifacts, and the resource-exhaustion fault family.
+
+Two tests run the conductor END TO END:
+
+- ``test_pool_campaign_end_to_end`` — a seeded campaign composing all
+  four fault classes against the live 3-replica pool scenario, every
+  declared invariant evaluated, artifact written and report-readable;
+- ``test_planted_invariant_shrinks_and_replays`` — a scenario with a
+  deliberately unsatisfiable invariant: the campaign must FAIL, ddmin
+  must shrink the schedule to a tiny reproducer, the artifact's seed
+  must regenerate the exact schedule, and replaying the shrunk subset
+  must still fail.
+
+The rest is unit coverage: generator determinism + class composition,
+ddmin 1-minimality and probe cap, artifact revisioning + schema
+rejection, the doctor reporter, ENOSPC fail-fast + deduped journal
+records, and journal drop-and-count under a dead sink.
+"""
+import errno
+import json
+import os
+import time
+
+import pytest
+
+from mxnet_tpu.chaos import artifact as art
+from mxnet_tpu.chaos import invariants as inv
+from mxnet_tpu.chaos import report
+from mxnet_tpu.chaos import scenarios as scen
+from mxnet_tpu.chaos import schedule as sched
+from mxnet_tpu.chaos.conductor import run_campaign
+from mxnet_tpu.chaos.shrink import ddmin
+from mxnet_tpu.diagnostics import journal
+from mxnet_tpu.resilience import atomic, retry
+from mxnet_tpu.testing import faults
+
+POOL_SEED = 11          # verified green: every invariant passes
+
+
+def _records(path, kind):
+    return inv.journal_records(path, kind)
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_holds_the_five_drill_scenarios():
+    got = set(scen.names())
+    assert {"pool", "crash_matrix", "fleet", "deploy",
+            "elastic"} <= got
+    with pytest.raises(ValueError, match="unknown scenario"):
+        scen.get("nope")
+
+
+# -- schedule generation -----------------------------------------------------
+
+def test_generate_is_deterministic_and_composes_all_classes():
+    targets = scen.get("pool").targets
+    a = sched.generate(17, targets, n_faults=4)
+    b = sched.generate(17, targets, n_faults=4)
+    assert a == b                       # the reproducer contract
+    assert {s["cls"] for s in a} == set(sched.FAULT_CLASSES)
+    for s in a:
+        assert s["kind"] in sched.CATALOG
+        assert s["at_s"] > 0
+    c = sched.generate(18, targets, n_faults=4)
+    assert c != a                       # the seed actually matters
+
+
+def test_generate_respects_declared_classes():
+    targets = scen.get("crash_matrix").targets      # no process/latency
+    specs = sched.generate(3, targets, n_faults=4)
+    assert {s["cls"] for s in specs} <= {"durability", "resource"}
+    assert not any(s["kind"] == "kill" for s in specs)
+
+
+def test_build_kill_spec_requires_a_kill_lever():
+    spec = {"kind": "kill", "cls": "process", "at_s": 1.0,
+            "target": "r0"}
+    with pytest.raises(ValueError, match="no kill lever"):
+        sched.build([spec], kill=None)
+    fired = []
+    built = sched.build([spec], kill=fired.append)
+    assert not built.rules
+    [(at_s, label, action)] = built.timed
+    action()
+    assert fired == ["r0"] and label == "kill:r0"
+
+
+# -- ddmin -------------------------------------------------------------------
+
+def test_ddmin_is_one_minimal():
+    items = list(range(8))
+
+    def still_fails(subset):
+        return {2, 5} <= set(subset)    # the failure needs exactly two
+
+    out = ddmin(items, still_fails)
+    assert sorted(out) == [2, 5]
+
+
+def test_ddmin_probe_cap_returns_a_valid_reproducer():
+    items = list(range(8))
+
+    def still_fails(subset):
+        return {2, 5} <= set(subset)
+
+    out = ddmin(items, still_fails, max_probes=1)
+    assert still_fails(out)             # maybe not minimal, still fails
+
+
+# -- artifacts ---------------------------------------------------------------
+
+def _doc(**over):
+    doc = {"kind": "chaos", "scenario": "pool", "seed": 7, "ok": True,
+           "schedule": [], "verdicts": []}
+    doc.update(over)
+    return doc
+
+
+def test_artifact_revisioning_and_roundtrip(tmp_path):
+    d = str(tmp_path)
+    p1 = art.write_artifact(d, _doc(seed=1))
+    p2 = art.write_artifact(d, _doc(seed=2))
+    assert os.path.basename(p1) == "CHAOS_r01.json"
+    assert os.path.basename(p2) == "CHAOS_r02.json"
+    assert art.latest_artifact(d) == p2
+    assert art.read_artifact(p2)["seed"] == 2
+
+
+def test_read_artifact_rejects_torn_and_foreign_files(tmp_path):
+    bad = tmp_path / "CHAOS_r01.json"
+    bad.write_text("{ torn")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        art.read_artifact(str(bad))
+    bad.write_text(json.dumps({"kind": "bench"}))
+    with pytest.raises(ValueError, match="not a chaos artifact"):
+        art.read_artifact(str(bad))
+    doc = _doc()
+    del doc["verdicts"]
+    bad.write_text(json.dumps(doc))
+    with pytest.raises(ValueError, match="missing 'verdicts'"):
+        art.read_artifact(str(bad))
+
+
+def test_report_digest_and_no_artifacts(tmp_path):
+    d = str(tmp_path)
+    rep = report.chaos_report(d)
+    assert rep["ok"] is False and rep["error"] == "no_artifacts"
+    art.write_artifact(d, _doc(seed=1))
+    art.write_artifact(d, _doc(
+        seed=2, ok=False, failed=["progress"],
+        verdicts=[{"name": "progress", "ok": False, "detail": "x"}],
+        schedule=[{"kind": "kill", "cls": "process", "at_s": 1.0}],
+        shrunk=[{"kind": "kill", "cls": "process", "at_s": 1.0}]))
+    rep = report.chaos_report(d)
+    assert rep["ok"] and rep["campaigns"] == 2 and rep["failures"] == 1
+    assert rep["last_failure"]["failed"] == ["progress"]
+    assert rep["last_failure"]["shrunk_to"] == 1
+    line = report.summarize(rep)
+    assert "chaos: 2 campaign(s), 1 failed" in line
+    assert "shrunk to 1 fault(s)" in line
+
+
+# -- invariant evaluation ----------------------------------------------------
+
+def test_evaluate_fails_loudly_on_unknown_or_crashing_invariant():
+    [v] = inv.evaluate([("tpyo", {})], {})
+    assert v["ok"] is False and v["detail"] == "unknown invariant"
+
+    @inv.register("_chaos_test_boom")
+    def _boom(obs):
+        raise RuntimeError("no")
+
+    try:
+        [v] = inv.evaluate([("_chaos_test_boom", {})], {})
+        assert v["ok"] is False and "evaluator crashed" in v["detail"]
+    finally:
+        inv.INVARIANTS.pop("_chaos_test_boom", None)
+
+
+# -- resource-exhaustion fault family ----------------------------------------
+
+def test_disk_full_fails_fast_old_preserved_no_litter(tmp_path):
+    target = tmp_path / "state.json"
+    target.write_text('{"v": "old"}')
+    jpath = str(tmp_path / "journal.jsonl")
+    journal.reset_journal(jpath)
+    retry.reset_disk_full_notes()
+    try:
+        with faults.inject(faults.disk_full("replace", times=1)):
+            with pytest.raises(faults.DiskFullError) as ei:
+                with atomic.atomic_write(str(target), "w") as f:
+                    f.write('{"v": "new"}')
+        assert ei.value.errno == errno.ENOSPC
+        # old bytes intact, no staged temp litter, ONE deduped record
+        assert json.loads(target.read_text()) == {"v": "old"}
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+        recs = _records(jpath, "disk_full")
+        assert len(recs) == 1 and recs[0]["op"].startswith("replace")
+    finally:
+        journal.reset_journal("stderr")
+        retry.reset_disk_full_notes()
+
+
+def test_fd_exhaust_trips_open_with_emfile(tmp_path):
+    target = str(tmp_path / "x.json")
+    with faults.inject(faults.fd_exhaust("open", times=1)):
+        with pytest.raises(faults.FdExhaustError) as ei:
+            with atomic.atomic_write(target, "w") as f:
+                f.write("{}")
+    assert ei.value.errno == errno.EMFILE
+    assert not os.listdir(tmp_path)     # nothing was ever staged
+
+
+def test_disk_budget_draw_exhaust_heal():
+    b = faults.DiskBudget(10)
+    assert b.draw(4) is False and b.exhausted() is False
+    assert b.draw(7) is True and b.exhausted() is True
+    b.heal(100)
+    assert b.exhausted() is False
+
+    rule = faults.disk_budget(5)
+    assert rule.matches("fsync", "p", None, None) is False
+    assert rule.matches("write", "p", 0, 6) is True     # the exhausting draw
+    for point in faults._BudgetRule._POINTS:
+        assert rule.matches(point, "p", None, 0) is True
+    assert rule.matches("publish", "p", None, None) is False
+    rule.budget.heal(1 << 20)
+    assert rule.matches("fsync", "p", None, None) is False
+
+
+def test_partition_stalls_only_the_matched_peer():
+    rule = faults.partition(peer="r1", stall_s=0.15, times=1)
+    with faults.inject(rule):
+        t0 = time.monotonic()
+        atomic.trip("wire_send", "r2")          # other peer: no stall
+        assert time.monotonic() - t0 < 0.1
+        t0 = time.monotonic()
+        atomic.trip("wire_send", "r1")
+        assert time.monotonic() - t0 >= 0.15
+        t0 = time.monotonic()
+        atomic.trip("wire_send", "r1")          # window over (times=1)
+        assert time.monotonic() - t0 < 0.1
+
+
+# -- ENOSPC fail-fast + dedup (resilience.retry) -----------------------------
+
+def test_retry_fails_fast_on_enospc_with_one_deduped_record(tmp_path):
+    jpath = str(tmp_path / "journal.jsonl")
+    journal.reset_journal(jpath)
+    retry.reset_disk_full_notes()
+    calls = []
+
+    def full_disk():
+        calls.append(1)
+        raise OSError(errno.ENOSPC, "no space", str(tmp_path / "t"))
+
+    try:
+        with pytest.raises(OSError):
+            retry.retry_call(full_disk, retries=3, base_s=0.001)
+        assert len(calls) == 1          # no retry budget burned
+        assert len(_records(jpath, "disk_full")) == 1
+        with pytest.raises(OSError):    # same path: record deduped
+            retry.retry_call(full_disk, retries=3, base_s=0.001)
+        assert len(_records(jpath, "disk_full")) == 1
+        retry.reset_disk_full_notes()   # space verified freed: re-arm
+        with pytest.raises(OSError):
+            retry.retry_call(full_disk, retries=3, base_s=0.001)
+        assert len(_records(jpath, "disk_full")) == 2
+    finally:
+        journal.reset_journal("stderr")
+        retry.reset_disk_full_notes()
+
+
+def test_is_disk_full_classification():
+    assert retry.is_disk_full(OSError(errno.ENOSPC, "x"))
+    assert retry.is_disk_full(faults.DiskFullError("write", "p"))
+    assert not retry.is_disk_full(OSError(errno.EIO, "x"))
+    assert not retry.is_disk_full(ValueError("x"))
+
+
+# -- journal sink degrade: drop-and-count ------------------------------------
+
+def test_journal_drops_and_counts_when_sink_dies(tmp_path):
+    jpath = str(tmp_path / "j.jsonl")
+    j = journal.reset_journal(jpath)
+    try:
+        j.event("alive")
+        j._fh.close()                   # the sink dies under the process
+        j.event("dropped_1")            # must NOT raise into the caller
+        j.event("dropped_2")
+        assert j.write_drops == 2
+        # only the durable lines are lost — the recent ring (the flight
+        # recorder's journal half) kept every record
+        kinds = [r["kind"] for r in j.recent()]
+        assert "dropped_2" in kinds
+    finally:
+        journal.reset_journal("stderr")
+
+
+# -- the conductor, end to end -----------------------------------------------
+
+def test_pool_campaign_end_to_end(tmp_path):
+    scenario = scen.get("pool")
+    doc = run_campaign("pool", POOL_SEED, budget_s=6.0,
+                       out_dir=str(tmp_path))
+    # every declared invariant got a verdict — no silent skips
+    declared = [name for name, _p in scenario.invariants]
+    assert [v["name"] for v in doc["verdicts"]] == declared
+    assert doc["ok"] is True, doc["verdicts"]
+    # the schedule composed all four fault classes in ONE window
+    assert {s["cls"] for s in doc["schedule"]} == set(sched.FAULT_CLASSES)
+    # the artifact is on disk, schema-valid, and report-readable
+    got = art.read_artifact(doc["path"])
+    assert got["seed"] == POOL_SEED and got["scenario"] == "pool"
+    assert got["schedule"] == doc["schedule"]
+    rep = report.chaos_report(str(tmp_path))
+    assert rep["campaigns"] == 1 and rep["failures"] == 0
+    assert len(rep["last"]["classes"]) == 4
+    # the snapshot carries the degrade trail the invariants judged
+    snap = doc["observability"]
+    assert snap["counters"]["ok"] >= 1
+    assert "journal_kinds" in snap
+
+
+class _PlantedRun(scen.ScenarioRun):
+    """Minimal durable-writer scenario for the planted-failure test: every
+    tick stages a ~4KB document through atomic_write (so budget-mode
+    disk_full exhausts within the window) behind its own trip point."""
+
+    def start(self):
+        pass
+
+    def tick(self):
+        p = os.path.join(self.workdir, "planted.json")
+        try:
+            atomic.trip("planted_op", p)
+            with atomic.atomic_write(p, "w") as f:
+                json.dump({"ok": True, "pad": "x" * 4096}, f)
+            self.counters.bump("ok")
+        except OSError:
+            self.counters.bump("degraded")
+        time.sleep(0.005)
+
+    def stop(self):
+        pass
+
+
+def test_planted_invariant_shrinks_and_replays(tmp_path):
+    targets = {"classes": ("durability", "resource")}
+
+    @inv.register("planted_no_degrades")
+    def _planted(obs):
+        d = obs["counters"]["degraded"]
+        return d == 0, f"{d} degraded ticks"
+
+    scen.register(scen.Scenario(
+        "planted", "durable writer whose declared invariant forbids the "
+        "degrades the schedule is guaranteed to cause",
+        _PlantedRun, targets=targets,
+        invariants=[("progress", {}), ("planted_no_degrades", {})],
+        clients=1))
+    try:
+        # short window: generate against it so every at_s lands inside
+        specs = sched.generate(7, targets, n_faults=4, window_s=1.0)
+        assert {s["cls"] for s in specs} == {"durability", "resource"}
+        doc = run_campaign("planted", 7, schedule=specs, budget_s=1.2,
+                           out_dir=str(tmp_path))
+        assert doc["ok"] is False
+        assert "planted_no_degrades" in doc["failed"]
+        # ddmin shrank the 4-fault schedule to a tiny reproducer
+        assert doc["shrunk"] is not None
+        assert 1 <= len(doc["shrunk"]) <= 2, doc["shrunk_human"]
+        # the artifact seed regenerates the exact schedule (determinism
+        # is what makes the artifact a reproducer, not a war story)
+        regen = sched.generate(doc["seed"], targets, n_faults=4,
+                               window_s=1.0)
+        assert regen == doc["schedule"]
+        # replaying ONLY the shrunk subset still violates the invariant
+        redo = run_campaign("planted", doc["seed"],
+                            schedule=doc["shrunk"], shrink=False,
+                            budget_s=1.2, out_dir=str(tmp_path))
+        assert redo["ok"] is False
+        assert "planted_no_degrades" in redo["failed"]
+    finally:
+        scen.SCENARIOS.pop("planted", None)
+        inv.INVARIANTS.pop("planted_no_degrades", None)

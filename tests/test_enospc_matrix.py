@@ -1,0 +1,270 @@
+"""The ENOSPC fuzz matrix (ISSUE satellite of the chaos engine;
+docs/chaos.md): every durable writer in the repo driven to disk-full at
+each atomic-write phase (``write``, ``fsync``, ``replace``) via the new
+``faults.disk_full`` rule, proving the three-part exhaustion contract:
+
+1. **bit-exact old-or-new** — a reader after the failed write observes
+   the complete previous bytes (or the complete new ones, never torn);
+2. **no litter** — the staged ``.tmp.*`` file is unlinked immediately
+   (an ENOSPC cleanup that LEAVES litter feeds the full disk);
+3. **degrade record** — one deduped ``disk_full`` journal record lands
+   (plus the writer's own structured degrade, for the writers that
+   absorb the failure instead of raising).
+
+Writers covered: the ``nd.save`` container, the checkpoint commit
+protocol, the AOT store entry, the tuned-table commit, journal sink
+rotation, and the flight-recorder dump.  The final test is the
+observability hot-path regression: spans + periodic flight flushes on a
+disk_full-injected trace dir must degrade to drop-and-count, never
+raise into the serving/trainer loop.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.autotune import table as attable
+from mxnet_tpu.chaos.scenarios import commit_scale
+from mxnet_tpu.diagnostics import journal
+from mxnet_tpu.observability import flight as obflight
+from mxnet_tpu.observability import trace as obtrace
+from mxnet_tpu.resilience import commit, retry
+from mxnet_tpu.testing import faults
+
+PHASES = ("write", "fsync", "replace")
+
+
+@pytest.fixture
+def jpath(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    journal.reset_journal(path)
+    retry.reset_disk_full_notes()
+    try:
+        yield path
+    finally:
+        journal.reset_journal("stderr")
+        retry.reset_disk_full_notes()
+
+
+def _records(path, kind):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+def _no_litter(root):
+    litter = []
+    for dirpath, _d, names in os.walk(root):
+        litter += [os.path.join(dirpath, n) for n in names
+                   if ".tmp." in n]
+    assert not litter, litter
+
+
+def _assert_degrade_recorded(jpath):
+    assert _records(jpath, "disk_full"), \
+        "exhaustion fired but no disk_full journal record landed"
+
+
+# -- nd.save container -------------------------------------------------------
+
+@pytest.mark.parametrize("phase", PHASES)
+def test_nd_save_enospc(tmp_path, jpath, phase):
+    path = str(tmp_path / "net.params")
+    old = np.arange(6, dtype=np.float32)
+    nd.save(path, {"w": nd.array(old)})
+    before = open(path, "rb").read()
+    with faults.inject(faults.disk_full(phase, times=1)):
+        with pytest.raises(OSError) as ei:
+            nd.save(path, {"w": nd.array(old * 2)})
+    assert retry.is_disk_full(ei.value)
+    assert open(path, "rb").read() == before        # bit-exact old
+    np.testing.assert_array_equal(nd.load(path)["w"].asnumpy(), old)
+    _no_litter(tmp_path)
+    _assert_degrade_recorded(jpath)
+
+
+# -- checkpoint commit protocol ----------------------------------------------
+
+@pytest.mark.parametrize("phase", PHASES)
+def test_commit_protocol_enospc(tmp_path, jpath, phase):
+    root = str(tmp_path / "ckpt")
+    commit_scale(root, 1, 1.0)
+    with faults.inject(faults.disk_full(phase, times=1)):
+        with pytest.raises(OSError) as ei:
+            commit_scale(root, 2, 2.0)
+    assert retry.is_disk_full(ei.value)
+    # the recovery a restarting trainer runs: stale staging swept, the
+    # previous committed step restorable and CRC-valid
+    commit.gc_steps(root, keep_last=None)
+    found = commit.find_restorable(root)
+    assert found is not None and found[0] == 1
+    commit.validate_step(root, 1)
+    w = nd.load(os.path.join(commit.step_dir(root, 1), "net.params"))["w"]
+    assert float(np.asarray(w.asnumpy()).reshape(-1)[0]) == 1.0
+    _no_litter(root)
+    _assert_degrade_recorded(jpath)
+
+
+# -- tuned-table commit ------------------------------------------------------
+
+def _table_doc(window_ms):
+    return attable.build_table(
+        {"serving": {"window_ms": float(window_ms), "max_queue": 64}},
+        provenance={"trials": 1},
+        envelope={"platform": "cpu", "device_kind": "test", "jax": "0"})
+
+
+@pytest.mark.parametrize("phase", PHASES)
+def test_tuned_table_enospc(tmp_path, jpath, phase):
+    path = str(tmp_path / "tuned.json")
+    attable.commit_table(_table_doc(2.0), path)
+    before = open(path, "rb").read()
+    with faults.inject(faults.disk_full(phase, times=1)):
+        with pytest.raises(OSError) as ei:
+            attable.commit_table(_table_doc(4.0), path)
+    assert retry.is_disk_full(ei.value)
+    assert open(path, "rb").read() == before
+    doc = json.loads(before)
+    assert doc["crc32"] == attable.table_crc(doc)   # still CRC-valid
+    assert doc["knobs"]["serving"]["window_ms"] == 2.0
+    _no_litter(tmp_path)
+    _assert_degrade_recorded(jpath)
+
+
+# -- AOT store entry (degrades, never raises into the compile path) ----------
+
+@pytest.mark.parametrize("phase", PHASES)
+def test_aot_store_entry_enospc(tmp_path, jpath, phase):
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serving import AOTCache
+
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    root = str(tmp_path / "aot")
+    cache = AOTCache(root)
+    pred1 = cache.load_or_compile(net, (1, 4), np.float32)
+    assert pred1 is not None and cache.counters["stores"] == 1
+    [entry] = [n for n in os.listdir(root) if not n.startswith(".")]
+    before = open(os.path.join(root, entry), "rb").read()
+    with faults.inject(faults.disk_full(phase, times=1)):
+        pred2 = cache.load_or_compile(net, (2, 4), np.float32)
+    # the compile path survives: a working predictor despite the failed
+    # store, the failure journaled, the existing entry untouched
+    assert pred2 is not None
+    assert cache.counters["store_failures"] == 1
+    assert _records(jpath, "aot_store_failed")
+    assert open(os.path.join(root, entry), "rb").read() == before
+    _no_litter(root)
+    _assert_degrade_recorded(jpath)
+
+
+# -- journal sink rotation ---------------------------------------------------
+
+def test_journal_rotation_onto_full_disk_drops_and_counts(tmp_path):
+    """Rotating the journal sink onto a full disk must not raise into
+    writers: appends degrade to drop-and-count (the ENOSPC analog of
+    the dead-sink case in test_chaos.py), the pre-rotation sink's bytes
+    stay intact, and the drops metric is incremented."""
+    from mxnet_tpu.observability import metrics as obmetrics
+
+    old_sink = str(tmp_path / "j1.jsonl")
+    j = journal.reset_journal(old_sink)
+    try:
+        j.event("before_rotation")
+        old_bytes = open(old_sink, "rb").read()
+
+        j = journal.reset_journal(str(tmp_path / "j2.jsonl"))
+
+        class _FullDisk:
+            def write(self, _line):
+                raise OSError(28, "No space left on device")
+
+            def flush(self):
+                pass
+
+            def close(self):
+                pass
+
+        j._fh = _FullDisk()
+        drops0 = obmetrics.default_registry().counter(
+            "mxnet_tpu_journal_write_drops_total", "").labels().value
+        j.event("a")                    # must NOT raise into the caller
+        j.event("b")
+        assert j.write_drops == 2
+        assert obmetrics.default_registry().counter(
+            "mxnet_tpu_journal_write_drops_total", "").labels().value \
+            == drops0 + 2
+        # the ring (flight half) kept the records; old sink untouched
+        assert "b" in [r["kind"] for r in j.recent()]
+        assert open(old_sink, "rb").read() == old_bytes
+    finally:
+        journal.reset_journal("stderr")
+
+
+# -- flight-recorder dump (degrades, never raises) ---------------------------
+
+@pytest.mark.parametrize("phase", PHASES)
+def test_flight_dump_enospc(tmp_path, jpath, phase):
+    out = str(tmp_path / "trace")
+    rec = obflight.FlightRecorder(out, label="t", flush_s=0)
+    assert rec.dump("baseline") is not None
+    before = open(rec.path, "rb").read()
+    with faults.inject(faults.disk_full(phase, path_part="flight-",
+                                        times=1)):
+        assert rec.dump("under_enospc") is None     # degrade, no raise
+    assert rec.drops == 1
+    # the previous complete dump IS the postmortem — still whole
+    assert open(rec.path, "rb").read() == before
+    assert obflight.read_flight(rec.path)["reason"] == "baseline"
+    assert len(_records(jpath, "flight_dump_failed")) == 1
+    _no_litter(out)
+    _assert_degrade_recorded(jpath)
+
+
+# -- the hot-path regression: traffic on a disk_full-injected trace dir ------
+
+def test_observability_hot_path_survives_full_trace_dir(tmp_path, jpath):
+    """The serving-worker shape: spans streaming to the journal and
+    periodic flight flushes while the trace dir is persistently ENOSPC
+    — every write degrades to drop-and-count, nothing raises into the
+    request loop, and the degrade trail is deduped (ONE
+    flight_dump_failed marker, one stderr note) instead of a record
+    per request."""
+    from mxnet_tpu.observability import metrics as obmetrics
+
+    out = str(tmp_path / "trace")
+    obtrace.reset_tracer()
+    obtrace.configure(mode="journal")
+    rec = obflight.FlightRecorder(out, label="w", flush_s=0)
+    drops0 = obmetrics.default_registry().counter(
+        "mxnet_tpu_flight_dump_drops_total", "").labels().value
+    try:
+        # times=None: the disk stays full for the whole loop
+        with faults.inject(faults.disk_full("write", path_part="flight-",
+                                            times=None)):
+            for i in range(8):          # the request loop
+                with obtrace.span("serving_predict", request=i):
+                    pass
+                rec.dump("periodic")
+        assert rec.dumps == 0 and rec.drops == 8
+        assert obmetrics.default_registry().counter(
+            "mxnet_tpu_flight_dump_drops_total", "").labels().value == drops0 + 8
+        assert len(_records(jpath, "flight_dump_failed")) == 1
+        # the disk heals: the very next flush lands a complete dump
+        assert rec.dump("healed") is not None
+        assert obflight.read_flight(rec.path)["reason"] == "healed"
+        # span records still reached the (healthy) journal throughout
+        spans = [r for r in _records(jpath, "span")
+                 if r.get("name") == "serving_predict"]
+        assert len(spans) == 8
+    finally:
+        obtrace.reset_tracer()

@@ -590,7 +590,8 @@ def test_doctor_dispatch_table_covers_all_reporters():
     from mxnet_tpu.diagnostics import __main__ as dmain
     keys = [row[0] for row in dmain._REPORT_TABLE]
     assert keys == ["checkpoint", "serving", "guardrails", "trace",
-                    "metrics", "timeline", "aot", "lint", "tuned"]
+                    "metrics", "timeline", "aot", "lint", "tuned",
+                    "chaos"]
     for _key, flag, _env, _mv, _help, load, summ in dmain._REPORT_TABLE:
         assert flag.startswith("--") and callable(load) and callable(summ)
 
